@@ -1,0 +1,23 @@
+"""mpi_operator_tpu — a TPU-native job operator framework.
+
+A brand-new implementation of the capabilities of kubeflow/mpi-operator
+(reference: /root/reference, a Go Kubernetes operator) re-designed TPU-first:
+
+- The ``MPIJob`` v2beta1 API surface (launcher/worker replica specs, run
+  policies with suspend/resume + Kueue managedBy delegation, gang scheduling,
+  elastic host discovery) is reconciled by a level-triggered controller into
+  Services, ConfigMaps, Secrets, worker Pods and a launcher Job.
+- Process-group bootstrap is idiomatic TPU: an ``mpiImplementation: JAX``
+  path injects JAX coordination-service env (JAX_COORDINATOR_ADDRESS /
+  JAX_PROCESS_ID / JAX_NUM_PROCESSES) so jax.distributed.initialize() forms
+  XLA collectives over ICI/DCN — no mpirun/SSH/hostfile required.  The
+  OpenMPI / Intel MPI / MPICH env matrices are retained for CPU parity.
+- The cluster substrate is pluggable: the same controller drives a real
+  Kubernetes API server or the bundled in-memory API machinery
+  (``mpi_operator_tpu.k8s``) plus local pod runtime
+  (``mpi_operator_tpu.runtime``) for hermetic single-host operation.
+- ``models/``, ``ops/`` and ``parallel/`` hold the JAX/Flax workload stack
+  (pi, MNIST, ResNet, Llama) sharded via jax.sharding.Mesh + pjit.
+"""
+
+__version__ = "0.1.0"

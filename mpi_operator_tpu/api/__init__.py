@@ -1,0 +1,9 @@
+"""MPIJob v2beta1 API surface (types, constants, defaulting, validation)."""
+
+from .types import (  # noqa: F401
+    MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy, SchedulingPolicy, JobStatus,
+    JobCondition, ReplicaStatus,
+)
+from . import constants  # noqa: F401
+from .defaults import set_defaults_mpijob  # noqa: F401
+from .validation import validate_mpijob  # noqa: F401

@@ -1,0 +1,88 @@
+"""API-level constants.
+
+Parity with /root/reference/pkg/apis/kubeflow/v2beta1/constants.go and
+types.go enums, extended with the TPU-native `JAX` implementation and its
+coordinator-env contract (the reference's extension point is the
+MPIImplementation enum, types.go:199-223).
+"""
+
+API_GROUP = "kubeflow.org"
+API_VERSION = "v2beta1"
+GROUP_VERSION = f"{API_GROUP}/{API_VERSION}"
+KIND = "MPIJob"
+
+# constants.go:19-25
+ENV_KUBEFLOW_NAMESPACE = "KUBEFLOW_NAMESPACE"
+OPERATOR_NAME = "mpi-operator"
+
+# Replica types (types.go:209-215)
+REPLICA_TYPE_LAUNCHER = "Launcher"
+REPLICA_TYPE_WORKER = "Worker"
+
+# MPI implementations (types.go:219-223) + the TPU-native path.
+IMPL_OPENMPI = "OpenMPI"
+IMPL_INTEL = "Intel"
+IMPL_MPICH = "MPICH"
+IMPL_JAX = "JAX"
+VALID_IMPLEMENTATIONS = (IMPL_OPENMPI, IMPL_INTEL, IMPL_MPICH, IMPL_JAX)
+
+# CleanPodPolicy (types.go:46-51)
+CLEAN_POD_POLICY_UNDEFINED = ""
+CLEAN_POD_POLICY_ALL = "All"
+CLEAN_POD_POLICY_RUNNING = "Running"
+CLEAN_POD_POLICY_NONE = "None"
+VALID_CLEAN_POD_POLICIES = (CLEAN_POD_POLICY_NONE, CLEAN_POD_POLICY_RUNNING,
+                            CLEAN_POD_POLICY_ALL)
+
+# RestartPolicy (types.go:371-382)
+RESTART_POLICY_ALWAYS = "Always"
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+RESTART_POLICY_NEVER = "Never"
+RESTART_POLICY_EXIT_CODE = "ExitCode"
+VALID_RESTART_POLICIES = (RESTART_POLICY_NEVER, RESTART_POLICY_ON_FAILURE)
+
+DEFAULT_RESTART_POLICY = RESTART_POLICY_NEVER
+DEFAULT_LAUNCHER_RESTART_POLICY = RESTART_POLICY_ON_FAILURE
+
+# LauncherCreationPolicy (types.go:157-166)
+LAUNCHER_CREATION_AT_STARTUP = "AtStartup"
+LAUNCHER_CREATION_WAIT_FOR_WORKERS_READY = "WaitForWorkersReady"
+
+# managedBy (types.go:96-102)
+KUBEFLOW_JOB_CONTROLLER = "kubeflow.org/mpi-operator"
+MULTIKUEUE_CONTROLLER = "kueue.x-k8s.io/multikueue"
+VALID_MANAGED_BY = (KUBEFLOW_JOB_CONTROLLER, MULTIKUEUE_CONTROLLER)
+
+# Job condition types (types.go:311-340)
+JOB_CREATED = "Created"
+JOB_RUNNING = "Running"
+JOB_RESTARTING = "Restarting"
+JOB_SUCCEEDED = "Succeeded"
+JOB_SUSPENDED = "Suspended"
+JOB_FAILED = "Failed"
+
+# Well-known labels (constants.go:30-45)
+REPLICA_INDEX_LABEL = "training.kubeflow.org/replica-index"
+REPLICA_TYPE_LABEL = "training.kubeflow.org/replica-type"
+OPERATOR_NAME_LABEL = "training.kubeflow.org/operator-name"
+JOB_NAME_LABEL = "training.kubeflow.org/job-name"
+JOB_ROLE_LABEL = "training.kubeflow.org/job-role"
+
+DEFAULT_SLOTS_PER_WORKER = 1
+DEFAULT_SSH_AUTH_MOUNT_PATH = "/root/.ssh"
+
+# --- TPU-native bootstrap contract (the JAX implementation) -------------
+# Environment the controller injects so jax.distributed.initialize() can
+# form the process group over ICI/DCN — replaces the reference's
+# hostfile/SSH wiring (mpi_job_controller.go:181-215,1612-1628).
+JAX_COORDINATOR_ADDRESS_ENV = "JAX_COORDINATOR_ADDRESS"
+JAX_COORDINATOR_PORT_ENV = "JAX_COORDINATOR_PORT"
+JAX_PROCESS_ID_ENV = "JAX_PROCESS_ID"
+JAX_NUM_PROCESSES_ENV = "JAX_NUM_PROCESSES"
+JAX_LOCAL_DEVICE_COUNT_ENV = "JAX_LOCAL_DEVICE_COUNT"
+DEFAULT_JAX_COORDINATOR_PORT = 8476
+
+# GKE TPU scheduling surface (workers request chips instead of GPUs).
+TPU_RESOURCE = "google.com/tpu"
+GKE_TPU_TOPOLOGY_NODE_SELECTOR = "cloud.google.com/gke-tpu-topology"
+GKE_TPU_ACCELERATOR_NODE_SELECTOR = "cloud.google.com/gke-tpu-accelerator"

@@ -1,0 +1,50 @@
+"""Defaulting for MPIJob.
+
+Parity with SetDefaults_MPIJob
+(/root/reference/pkg/apis/kubeflow/v2beta1/default.go:26-80):
+slotsPerWorker=1, sshAuthMountPath=/root/.ssh, OpenMPI, AtStartup,
+cleanPodPolicy=None, launcher replicas=1 + OnFailure, worker replicas=0 +
+Never.
+"""
+
+from __future__ import annotations
+
+from . import constants
+from .types import MPIJob, ReplicaSpec
+
+
+def _set_defaults_launcher(spec: ReplicaSpec | None) -> None:
+    """default.go:27-37."""
+    if spec is None:
+        return
+    if not spec.restart_policy:
+        spec.restart_policy = constants.DEFAULT_LAUNCHER_RESTART_POLICY
+    if spec.replicas is None:
+        spec.replicas = 1
+
+
+def _set_defaults_worker(spec: ReplicaSpec | None) -> None:
+    """default.go:40-50."""
+    if spec is None:
+        return
+    if not spec.restart_policy:
+        spec.restart_policy = constants.DEFAULT_RESTART_POLICY
+    if spec.replicas is None:
+        spec.replicas = 0
+
+
+def set_defaults_mpijob(job: MPIJob) -> MPIJob:
+    """default.go:60-80 (mutates and returns `job`)."""
+    if job.spec.run_policy.clean_pod_policy is None:
+        job.spec.run_policy.clean_pod_policy = constants.CLEAN_POD_POLICY_NONE
+    if job.spec.slots_per_worker is None:
+        job.spec.slots_per_worker = constants.DEFAULT_SLOTS_PER_WORKER
+    if not job.spec.ssh_auth_mount_path:
+        job.spec.ssh_auth_mount_path = constants.DEFAULT_SSH_AUTH_MOUNT_PATH
+    if not job.spec.mpi_implementation:
+        job.spec.mpi_implementation = constants.IMPL_OPENMPI
+    if not job.spec.launcher_creation_policy:
+        job.spec.launcher_creation_policy = constants.LAUNCHER_CREATION_AT_STARTUP
+    _set_defaults_launcher(job.spec.mpi_replica_specs.get(constants.REPLICA_TYPE_LAUNCHER))
+    _set_defaults_worker(job.spec.mpi_replica_specs.get(constants.REPLICA_TYPE_WORKER))
+    return job

@@ -1,0 +1,126 @@
+"""MPIJob API types.
+
+Full v2beta1 surface of the reference CRD
+(/root/reference/pkg/apis/kubeflow/v2beta1/types.go:27-382): replica
+specs, RunPolicy (cleanPodPolicy, TTL, activeDeadline, backoff, gang
+SchedulingPolicy, suspend, managedBy), slotsPerWorker,
+runLauncherAsWorker, sshAuthMountPath, launcherCreationPolicy and the
+MPIImplementation enum — which here additionally admits ``JAX`` for the
+TPU-native bootstrap path.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..k8s.meta import ObjectMeta
+from ..k8s.core import PodTemplateSpec
+from . import constants
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (types.go:56-94)."""
+    min_available: Optional[int] = None
+    queue: str = ""
+    min_resources: Optional[dict] = None
+    priority_class: str = ""
+    schedule_timeout_seconds: Optional[int] = None
+
+
+@dataclass
+class RunPolicy:
+    """Runtime policies (types.go:107-153)."""
+    clean_pod_policy: Optional[str] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+    suspend: Optional[bool] = None
+    managed_by: Optional[str] = None
+
+
+@dataclass
+class ReplicaSpec:
+    """Launcher/Worker replica description (types.go:348-362)."""
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: str = ""
+
+
+@dataclass
+class MPIJobSpec:
+    """types.go:168-204."""
+    slots_per_worker: Optional[int] = None
+    run_launcher_as_worker: Optional[bool] = None
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    mpi_replica_specs: dict = field(default_factory=dict)  # type -> ReplicaSpec
+    ssh_auth_mount_path: str = ""
+    launcher_creation_policy: str = ""
+    mpi_implementation: str = ""
+
+
+@dataclass
+class JobCondition:
+    """types.go:283-306."""
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[datetime.datetime] = None
+    last_transition_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class ReplicaStatus:
+    """types.go:258-280."""
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    selector: str = ""
+
+
+@dataclass
+class JobStatus:
+    """types.go:226-255."""
+    conditions: list = field(default_factory=list)
+    replica_statuses: dict = field(default_factory=dict)  # type -> ReplicaStatus
+    start_time: Optional[datetime.datetime] = None
+    completion_time: Optional[datetime.datetime] = None
+    last_reconcile_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class MPIJob:
+    api_version: str = constants.GROUP_VERSION
+    kind: str = constants.KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: MPIJobSpec = field(default_factory=MPIJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    @property
+    def launcher_spec(self) -> Optional[ReplicaSpec]:
+        return self.spec.mpi_replica_specs.get(constants.REPLICA_TYPE_LAUNCHER)
+
+    @property
+    def worker_spec(self) -> Optional[ReplicaSpec]:
+        return self.spec.mpi_replica_specs.get(constants.REPLICA_TYPE_WORKER)
+
+
+def worker_replicas(job: MPIJob) -> int:
+    spec = job.worker_spec
+    if spec is not None and spec.replicas is not None:
+        return spec.replicas
+    return 0
+
+
+def run_launcher_as_worker(job: MPIJob) -> bool:
+    """mpi_job_controller.go:1483-1485."""
+    return bool(job.spec.run_launcher_as_worker)
+
+
+def is_suspended(job: MPIJob) -> bool:
+    """isMPIJobSuspended (mpi_job_controller.go)."""
+    return bool(job.spec.run_policy.suspend)

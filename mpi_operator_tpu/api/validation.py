@@ -1,0 +1,152 @@
+"""Validation for MPIJob.
+
+Parity with ValidateMPIJob
+(/root/reference/pkg/apis/kubeflow/validation/validation.go:49-160),
+including the load-bearing DNS-1035 check on the *worst-case worker pod
+hostname* (validation.go:55-68) which guarantees stable worker DNS.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from . import constants
+from .types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy
+
+_DNS1035_RE = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
+_DNS1035_MAX_LEN = 63
+
+
+@dataclass
+class FieldError:
+    field: str
+    message: str
+
+    def __str__(self) -> str:  # matches field.Error rendering loosely
+        return f"{self.field}: {self.message}"
+
+
+def is_dns1035_label(value: str) -> list[str]:
+    """apimachinery IsDNS1035Label equivalent."""
+    errs = []
+    if len(value) > _DNS1035_MAX_LEN:
+        errs.append(f"must be no more than {_DNS1035_MAX_LEN} characters")
+    if not _DNS1035_RE.match(value):
+        errs.append("a DNS-1035 label must consist of lower case alphanumeric"
+                    " characters or '-', start with an alphabetic character,"
+                    " and end with an alphanumeric character")
+    return errs
+
+
+def validate_mpijob(job: MPIJob) -> list[FieldError]:
+    """validation.go:49-53."""
+    errs = _validate_name(job)
+    errs += _validate_spec(job.spec, "spec")
+    return errs
+
+
+def _validate_name(job: MPIJob) -> list[FieldError]:
+    """validation.go:55-68: the largest worker hostname must be a valid
+    DNS-1035 label."""
+    replicas = 1
+    worker = job.spec.mpi_replica_specs.get(constants.REPLICA_TYPE_WORKER)
+    if worker is not None and worker.replicas is not None and worker.replicas > 0:
+        replicas = worker.replicas
+    max_hostname = f"{job.metadata.name}-worker-{replicas - 1}"
+    errs = is_dns1035_label(max_hostname)
+    if errs:
+        return [FieldError("metadata.name",
+                           f"will not able to create pod and service with "
+                           f"invalid DNS label {max_hostname!r}: "
+                           + ", ".join(errs))]
+    return []
+
+
+def _validate_spec(spec: MPIJobSpec, path: str) -> list[FieldError]:
+    """validation.go:70-85."""
+    errs = _validate_replica_specs(spec.mpi_replica_specs,
+                                   f"{path}.mpiReplicaSpecs")
+    if spec.slots_per_worker is None:
+        errs.append(FieldError(f"{path}.slotsPerWorker",
+                               "must have number of slots per worker"))
+    elif spec.slots_per_worker < 0:
+        errs.append(FieldError(f"{path}.slotsPerWorker",
+                               "must be greater than or equal to 0"))
+    errs += _validate_run_policy(spec.run_policy, f"{path}.runPolicy")
+    if not spec.ssh_auth_mount_path:
+        errs.append(FieldError(f"{path}.sshAuthMountPath",
+                               "must have a mount path for SSH credentials"))
+    if spec.mpi_implementation not in constants.VALID_IMPLEMENTATIONS:
+        errs.append(FieldError(
+            f"{path}.mpiImplementation",
+            f"unsupported value {spec.mpi_implementation!r}: supported values:"
+            f" {', '.join(constants.VALID_IMPLEMENTATIONS)}"))
+    return errs
+
+
+def _validate_run_policy(policy: RunPolicy, path: str) -> list[FieldError]:
+    """validation.go:87-110."""
+    errs: list[FieldError] = []
+    if policy.clean_pod_policy is None:
+        errs.append(FieldError(f"{path}.cleanPodPolicy",
+                               "must have clean Pod policy"))
+    elif policy.clean_pod_policy not in constants.VALID_CLEAN_POD_POLICIES:
+        errs.append(FieldError(
+            f"{path}.cleanPodPolicy",
+            f"unsupported value {policy.clean_pod_policy!r}: supported values:"
+            f" {', '.join(constants.VALID_CLEAN_POD_POLICIES)}"))
+    for name, value in (("ttlSecondsAfterFinished", policy.ttl_seconds_after_finished),
+                        ("activeDeadlineSeconds", policy.active_deadline_seconds),
+                        ("backoffLimit", policy.backoff_limit)):
+        if value is not None and value < 0:
+            errs.append(FieldError(f"{path}.{name}",
+                                   "must be greater than or equal to 0"))
+    if (policy.managed_by is not None
+            and policy.managed_by not in constants.VALID_MANAGED_BY):
+        errs.append(FieldError(
+            f"{path}.managedBy",
+            f"unsupported value {policy.managed_by!r}: supported values:"
+            f" {', '.join(constants.VALID_MANAGED_BY)}"))
+    return errs
+
+
+def _validate_replica_specs(specs: dict, path: str) -> list[FieldError]:
+    """validation.go:112-160."""
+    errs: list[FieldError] = []
+    if not specs:
+        errs.append(FieldError(path, "must have replica specs"))
+        return errs
+    launcher = specs.get(constants.REPLICA_TYPE_LAUNCHER)
+    launcher_path = f"{path}[Launcher]"
+    if launcher is None:
+        errs.append(FieldError(launcher_path, "must have Launcher replica spec"))
+    else:
+        errs += _validate_replica_spec(launcher, launcher_path)
+        if launcher.replicas is not None and launcher.replicas != 1:
+            errs.append(FieldError(f"{launcher_path}.replicas", "must be 1"))
+    worker = specs.get(constants.REPLICA_TYPE_WORKER)
+    if worker is not None:
+        worker_path = f"{path}[Worker]"
+        errs += _validate_replica_spec(worker, worker_path)
+        if worker.replicas is not None and worker.replicas <= 0:
+            errs.append(FieldError(f"{worker_path}.replicas",
+                                   "must be greater than or equal to 1"))
+    return errs
+
+
+def _validate_replica_spec(spec: ReplicaSpec, path: str) -> list[FieldError]:
+    """validation.go:148-160."""
+    errs: list[FieldError] = []
+    if spec.replicas is None:
+        errs.append(FieldError(f"{path}.replicas",
+                               "must define number of replicas"))
+    if spec.restart_policy not in constants.VALID_RESTART_POLICIES:
+        errs.append(FieldError(
+            f"{path}.restartPolicy",
+            f"unsupported value {spec.restart_policy!r}: supported values:"
+            f" {', '.join(constants.VALID_RESTART_POLICIES)}"))
+    if not spec.template.spec.containers:
+        errs.append(FieldError(f"{path}.template.spec.containers",
+                               "must define at least one container"))
+    return errs

@@ -1,0 +1,9 @@
+"""Self-contained Kubernetes-compatible API machinery.
+
+The reference operator leans on k8s.io/client-go + generated clients
+(/root/reference/pkg/client, ~2.4k generated LoC).  This package is the
+TPU-native framework's equivalent: typed objects, an in-memory API server
+with resourceVersion/watch semantics, shared informers, listers and a
+rate-limited workqueue — enough to run the controller hermetically (unit,
+integration) and against a thin HTTP shim in real deployments.
+"""

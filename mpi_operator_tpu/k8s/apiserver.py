@@ -1,0 +1,354 @@
+"""In-memory API server + clientset facade.
+
+The hermetic substrate the controller reconciles against: typed object
+store with uid/resourceVersion assignment, optimistic-concurrency Update,
+status subresource, label-selector List, watch streams, owner-reference
+cascade deletion, and client-go-fake-style action recording + reactor
+injection (the reference's unit fixture leans on k8sfake.NewSimpleClientset
+reactors, pkg/controller/mpi_job_controller_test.go:70-213).
+
+In a real deployment the same `Clientset` interface can be backed by an
+HTTP client to kube-apiserver; everything above this module is
+substrate-agnostic.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .meta import Clock, deep_copy
+from .selectors import match_labels
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class ApiError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+
+
+def not_found(kind: str, name: str) -> ApiError:
+    return ApiError("NotFound", f"{kind} {name!r} not found")
+
+
+def already_exists(kind: str, name: str) -> ApiError:
+    return ApiError("AlreadyExists", f"{kind} {name!r} already exists")
+
+
+def conflict(kind: str, name: str) -> ApiError:
+    return ApiError("Conflict", f"{kind} {name!r} resource version conflict")
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, ApiError) and err.code == "NotFound"
+
+
+def is_already_exists(err: Exception) -> bool:
+    return isinstance(err, ApiError) and err.code == "AlreadyExists"
+
+
+def is_conflict(err: Exception) -> bool:
+    return isinstance(err, ApiError) and err.code == "Conflict"
+
+
+@dataclass
+class Action:
+    """A recorded client action (verb, kind, namespace, name, object)."""
+    verb: str
+    kind: str
+    namespace: str
+    name: str = ""
+    obj: Any = None
+    subresource: str = ""
+
+    def matches(self, verb: str, kind: str) -> bool:
+        return self.verb == verb and self.kind == kind
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    obj: Any
+
+
+class Watch:
+    """A single watch stream; iterate or poll events."""
+
+    def __init__(self, server: "ApiServer", key):
+        import queue
+        self._q: "queue.Queue[WatchEvent]" = queue.Queue()
+        self._server = server
+        self._key = key
+        self.stopped = False
+
+    def _send(self, ev: WatchEvent):
+        if not self.stopped:
+            self._q.put(ev)
+
+    def next(self, timeout: float | None = None) -> Optional[WatchEvent]:
+        import queue
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self):
+        self.stopped = True
+        self._server._remove_watch(self._key, self)
+
+
+class ApiServer:
+    """Thread-safe in-memory object store with k8s API semantics."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self._lock = threading.RLock()
+        # (api_version, kind) -> {(namespace, name) -> obj}
+        self._store: dict = {}
+        self._rv = 0
+        self._watches: dict = {}  # (api_version, kind) -> [Watch]
+
+    # -- helpers ----------------------------------------------------------
+    def _gvk(self, obj) -> tuple:
+        return (obj.api_version, obj.kind)
+
+    def _bucket(self, gvk) -> dict:
+        return self._store.setdefault(gvk, {})
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, gvk, ev_type: str, obj) -> None:
+        for w in list(self._watches.get(gvk, [])):
+            w._send(WatchEvent(ev_type, deep_copy(obj)))
+
+    def _remove_watch(self, gvk, w) -> None:
+        with self._lock:
+            if w in self._watches.get(gvk, []):
+                self._watches[gvk].remove(w)
+
+    # -- verbs ------------------------------------------------------------
+    def create(self, obj):
+        with self._lock:
+            gvk = self._gvk(obj)
+            obj = deep_copy(obj)
+            key = (obj.metadata.namespace, obj.metadata.name)
+            bucket = self._bucket(gvk)
+            if key in bucket:
+                raise already_exists(obj.kind, obj.metadata.name)
+            if not obj.metadata.uid:
+                obj.metadata.uid = str(uuid.uuid4())
+            obj.metadata.resource_version = self._next_rv()
+            if obj.metadata.creation_timestamp is None:
+                obj.metadata.creation_timestamp = self.clock.now()
+            bucket[key] = obj
+            self._notify(gvk, ADDED, obj)
+            return deep_copy(obj)
+
+    def get(self, api_version: str, kind: str, namespace: str, name: str):
+        with self._lock:
+            bucket = self._bucket((api_version, kind))
+            obj = bucket.get((namespace, name))
+            if obj is None:
+                raise not_found(kind, f"{namespace}/{name}")
+            return deep_copy(obj)
+
+    def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[dict] = None) -> list:
+        with self._lock:
+            out = []
+            for (ns, _), obj in sorted(self._bucket((api_version, kind)).items()):
+                if namespace is not None and ns != namespace:
+                    continue
+                if match_labels(label_selector, obj.metadata.labels):
+                    out.append(deep_copy(obj))
+            return out
+
+    def update(self, obj, subresource: str = ""):
+        with self._lock:
+            gvk = self._gvk(obj)
+            obj = deep_copy(obj)
+            key = (obj.metadata.namespace, obj.metadata.name)
+            bucket = self._bucket(gvk)
+            current = bucket.get(key)
+            if current is None:
+                raise not_found(obj.kind, obj.metadata.name)
+            if (obj.metadata.resource_version
+                    and obj.metadata.resource_version != current.metadata.resource_version):
+                raise conflict(obj.kind, obj.metadata.name)
+            if subresource == "status":
+                # Status update: keep current spec/meta, take new status.
+                merged = deep_copy(current)
+                merged.status = obj.status
+                obj = merged
+            else:
+                # Spec update never mutates status through this path.
+                if hasattr(current, "status") and hasattr(obj, "status"):
+                    obj.status = deep_copy(current.status)
+                obj.metadata.uid = current.metadata.uid
+                obj.metadata.creation_timestamp = current.metadata.creation_timestamp
+            obj.metadata.resource_version = self._next_rv()
+            bucket[key] = obj
+            self._notify(gvk, MODIFIED, obj)
+            return deep_copy(obj)
+
+    def delete(self, api_version: str, kind: str, namespace: str, name: str):
+        with self._lock:
+            bucket = self._bucket((api_version, kind))
+            obj = bucket.pop((namespace, name), None)
+            if obj is None:
+                raise not_found(kind, f"{namespace}/{name}")
+            self._notify((api_version, kind), DELETED, obj)
+            self._cascade_delete(obj)
+            return deep_copy(obj)
+
+    def _cascade_delete(self, owner) -> None:
+        """Owner-reference garbage collection: deleting an owner removes
+        objects whose controller ownerReference uid matches (standard k8s GC;
+        the reference relies on it for Service/ConfigMap/Secret cleanup)."""
+        owner_uid = owner.metadata.uid
+        for gvk in list(self._store.keys()):
+            bucket = self._store[gvk]
+            for key in [k for k, o in bucket.items()
+                        if any(ref.uid == owner_uid and ref.controller
+                               for ref in o.metadata.owner_references)]:
+                dead = bucket.pop(key)
+                self._notify(gvk, DELETED, dead)
+                self._cascade_delete(dead)
+
+    def watch(self, api_version: str, kind: str) -> Watch:
+        with self._lock:
+            gvk = (api_version, kind)
+            w = Watch(self, gvk)
+            self._watches.setdefault(gvk, []).append(w)
+            return w
+
+
+class ResourceClient:
+    """Typed per-kind, per-namespace client (clientset surface)."""
+
+    def __init__(self, cs: "Clientset", api_version: str, kind: str,
+                 namespace: str):
+        self._cs = cs
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+
+    def _invoke(self, action: Action, default: Callable):
+        return self._cs._dispatch(action, default)
+
+    def create(self, obj):
+        if not obj.metadata.namespace:
+            obj.metadata.namespace = self.namespace
+        action = Action("create", self.kind, self.namespace,
+                        obj.metadata.name, obj)
+        return self._invoke(action, lambda: self._cs.server.create(obj))
+
+    def get(self, name: str):
+        action = Action("get", self.kind, self.namespace, name)
+        return self._invoke(action, lambda: self._cs.server.get(
+            self.api_version, self.kind, self.namespace, name))
+
+    def list(self, label_selector: Optional[dict] = None) -> list:
+        action = Action("list", self.kind, self.namespace)
+        return self._invoke(action, lambda: self._cs.server.list(
+            self.api_version, self.kind, self.namespace, label_selector))
+
+    def update(self, obj):
+        action = Action("update", self.kind, self.namespace,
+                        obj.metadata.name, obj)
+        return self._invoke(action, lambda: self._cs.server.update(obj))
+
+    def update_status(self, obj):
+        action = Action("update", self.kind, self.namespace,
+                        obj.metadata.name, obj, subresource="status")
+        return self._invoke(action,
+                            lambda: self._cs.server.update(obj, "status"))
+
+    def delete(self, name: str):
+        action = Action("delete", self.kind, self.namespace, name)
+        return self._invoke(action, lambda: self._cs.server.delete(
+            self.api_version, self.kind, self.namespace, name))
+
+    def watch(self) -> Watch:
+        return self._cs.server.watch(self.api_version, self.kind)
+
+
+class Clientset:
+    """Facade bundling the typed clients the controller needs.
+
+    Mirrors the reference's four clientsets (kube, kubeflow, volcano,
+    scheduler-plugins — cmd/mpi-operator/app/server.go:258-299) behind one
+    object; also records actions and supports prepend-able reactors like
+    client-go's fake clientset.
+    """
+
+    def __init__(self, server: Optional[ApiServer] = None,
+                 clock: Optional[Clock] = None):
+        self.server = server or ApiServer(clock=clock)
+        self._reactors: list = []
+        self.actions: list[Action] = []
+        self._lock = threading.Lock()
+
+    # -- reactors / action log (test hooks) -------------------------------
+    def prepend_reactor(self, verb: str, kind: str,
+                        fn: Callable[[Action], tuple]) -> None:
+        """fn(action) -> (handled, result). May raise to inject errors."""
+        self._reactors.insert(0, (verb, kind, fn))
+
+    def clear_actions(self) -> None:
+        with self._lock:
+            self.actions.clear()
+
+    def _dispatch(self, action: Action, default: Callable):
+        with self._lock:
+            self.actions.append(action)
+        for verb, kind, fn in self._reactors:
+            if (verb in ("*", action.verb)) and (kind in ("*", action.kind)):
+                handled, result = fn(action)
+                if handled:
+                    if isinstance(result, Exception):
+                        raise result
+                    return result
+        return default()
+
+    # -- typed accessors ---------------------------------------------------
+    def pods(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "v1", "Pod", ns)
+
+    def services(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "v1", "Service", ns)
+
+    def config_maps(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "v1", "ConfigMap", ns)
+
+    def secrets(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "v1", "Secret", ns)
+
+    def events(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "v1", "Event", ns)
+
+    def jobs(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "batch/v1", "Job", ns)
+
+    def mpi_jobs(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "kubeflow.org/v2beta1", "MPIJob", ns)
+
+    def volcano_pod_groups(self, ns: str) -> ResourceClient:
+        from .scheduling import VOLCANO_API_VERSION
+        return ResourceClient(self, VOLCANO_API_VERSION, "PodGroup", ns)
+
+    def sched_plugins_pod_groups(self, ns: str) -> ResourceClient:
+        from .scheduling import SCHED_PLUGINS_API_VERSION
+        return ResourceClient(self, SCHED_PLUGINS_API_VERSION, "PodGroup", ns)
+
+    def leases(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "coordination.k8s.io/v1", "Lease", ns)

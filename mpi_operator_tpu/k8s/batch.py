@@ -1,0 +1,90 @@
+"""batch/v1 Job object model.
+
+The launcher runs as a batch/v1 Job (reference:
+pkg/controller/mpi_job_controller.go:1554-1580 newLauncherJob); the
+controller reads Job conditions JobComplete/JobFailed for terminal state
+(mpi_job_controller.go isJobFinished / getJobConditionStatus).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+from .core import PodTemplateSpec
+
+JOB_COMPLETE = "Complete"
+JOB_FAILED = "Failed"
+JOB_SUSPENDED = "Suspended"
+
+POD_REPLACEMENT_POLICY_FAILED = "Failed"
+POD_REPLACEMENT_POLICY_TERMINATING_OR_FAILED = "TerminatingOrFailed"
+
+
+@dataclass
+class JobCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class LabelSelector:
+    match_labels: dict = field(default_factory=dict)
+    match_expressions: list = field(default_factory=list)
+
+
+@dataclass
+class JobSpec:
+    parallelism: Optional[int] = None
+    completions: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    suspend: Optional[bool] = None
+    pod_replacement_policy: Optional[str] = None
+
+
+@dataclass
+class JobStatus:
+    conditions: list = field(default_factory=list)
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    start_time: Optional[datetime.datetime] = None
+    completion_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class Job:
+    api_version: str = "batch/v1"
+    kind: str = "Job"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+def job_condition_status(job: Job, cond_type: str) -> str:
+    for c in job.status.conditions:
+        if c.type == cond_type:
+            return c.status
+    return "Unknown"
+
+
+def is_job_finished(job: Job) -> bool:
+    """Launcher terminal-state check (reference mpi_job_controller.go
+    isJobFinished: JobComplete or JobFailed condition True)."""
+    from .core import CONDITION_TRUE
+    return (job_condition_status(job, JOB_COMPLETE) == CONDITION_TRUE
+            or job_condition_status(job, JOB_FAILED) == CONDITION_TRUE)
+
+
+def is_job_succeeded(job: Job) -> bool:
+    from .core import CONDITION_TRUE
+    return job_condition_status(job, JOB_COMPLETE) == CONDITION_TRUE

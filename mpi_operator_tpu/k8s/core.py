@@ -1,0 +1,274 @@
+"""core/v1 object model: Pod, Service, ConfigMap, Secret, Event.
+
+The subset of k8s.io/api/core/v1 the operator constructs and inspects
+(reference: pkg/controller/mpi_job_controller.go object builders at
+:1335-1674 and pod phase checks at :840-858, :1143-1164).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+
+# Pod phases (k8s.io/api/core/v1 PodPhase)
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+CONDITION_TRUE = "True"
+CONDITION_FALSE = "False"
+CONDITION_UNKNOWN = "Unknown"
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+# Secret types / keys (corev1.SecretTypeSSHAuth, corev1.SSHAuthPrivateKey)
+SECRET_TYPE_SSH_AUTH = "kubernetes.io/ssh-auth"
+SSH_AUTH_PRIVATE_KEY = "ssh-privatekey"
+
+CLUSTER_IP_NONE = "None"
+DNS_CLUSTER_FIRST_WITH_HOST_NET = "ClusterFirstWithHostNet"
+
+RESTART_POLICY_ALWAYS = "Always"
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+RESTART_POLICY_NEVER = "Never"
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+    value_from: Optional[dict] = None
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: Optional[bool] = None
+    sub_path: str = ""
+
+
+@dataclass
+class KeyToPath:
+    key: str = ""
+    path: str = ""
+    mode: Optional[int] = None
+
+
+@dataclass
+class ConfigMapVolumeSource:
+    name: str = ""
+    items: list = field(default_factory=list)
+    default_mode: Optional[int] = None
+
+
+@dataclass
+class SecretVolumeSource:
+    secret_name: str = ""
+    items: list = field(default_factory=list)
+    default_mode: Optional[int] = None
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    config_map: Optional[ConfigMapVolumeSource] = None
+    secret: Optional[SecretVolumeSource] = None
+    empty_dir: Optional[dict] = None
+    host_path: Optional[dict] = None
+
+
+@dataclass
+class ResourceRequirements:
+    limits: dict = field(default_factory=dict)
+    requests: dict = field(default_factory=dict)
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+    protocol: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: list = field(default_factory=list)
+    args: list = field(default_factory=list)
+    working_dir: str = ""
+    env: list = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_mounts: list = field(default_factory=list)
+    ports: list = field(default_factory=list)
+    security_context: Optional[dict] = None
+
+
+@dataclass
+class PodDNSConfig:
+    nameservers: list = field(default_factory=list)
+    searches: list = field(default_factory=list)
+    options: list = field(default_factory=list)
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = ""
+    value: str = ""
+    effect: str = ""
+    toleration_seconds: Optional[int] = None
+
+
+@dataclass
+class PodSpec:
+    containers: list = field(default_factory=list)
+    init_containers: list = field(default_factory=list)
+    volumes: list = field(default_factory=list)
+    restart_policy: str = ""
+    hostname: str = ""
+    subdomain: str = ""
+    host_network: bool = False
+    dns_policy: str = ""
+    dns_config: Optional[PodDNSConfig] = None
+    node_selector: dict = field(default_factory=dict)
+    tolerations: list = field(default_factory=list)
+    scheduling_gates: list = field(default_factory=list)
+    scheduler_name: str = ""
+    priority_class_name: str = ""
+    service_account_name: str = ""
+    security_context: Optional[dict] = None
+    termination_grace_period_seconds: Optional[int] = None
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class ContainerState:
+    terminated: Optional[ContainerStateTerminated] = None
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: Optional[ContainerState] = None
+    ready: bool = False
+    restart_count: int = 0
+
+
+@dataclass
+class PodStatus:
+    phase: str = ""
+    conditions: list = field(default_factory=list)
+    reason: str = ""
+    message: str = ""
+    container_statuses: list = field(default_factory=list)
+    pod_ip: str = ""
+    host_ip: str = ""
+
+
+@dataclass
+class Pod:
+    api_version: str = "v1"
+    kind: str = "Pod"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: Optional[int] = None
+    protocol: str = ""
+
+
+@dataclass
+class ServiceSpec:
+    cluster_ip: str = ""
+    selector: dict = field(default_factory=dict)
+    publish_not_ready_addresses: bool = False
+    ports: list = field(default_factory=list)
+
+
+@dataclass
+class Service:
+    api_version: str = "v1"
+    kind: str = "Service"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+
+@dataclass
+class ConfigMap:
+    api_version: str = "v1"
+    kind: str = "ConfigMap"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict = field(default_factory=dict)
+    binary_data: dict = field(default_factory=dict)
+
+
+@dataclass
+class Secret:
+    api_version: str = "v1"
+    kind: str = "Secret"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = ""
+    data: dict = field(default_factory=dict)  # str -> bytes
+
+
+@dataclass
+class ObjectReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    api_version: str = "v1"
+    kind: str = "Event"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    type: str = ""
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+
+
+def pod_running_and_ready(pod: Pod) -> bool:
+    """isPodRunningAndReady equivalent (WaitForWorkersReady gating,
+    reference: mpi_job_controller.go countReadyWorkerPods / workersReady)."""
+    if pod.status.phase != POD_RUNNING:
+        return False
+    for cond in pod.status.conditions:
+        if cond.type == "Ready" and cond.status == CONDITION_TRUE:
+            return True
+    return False

@@ -1,0 +1,197 @@
+"""ObjectMeta / OwnerReference / serialization base for API objects.
+
+Equivalent of k8s.io/apimachinery metav1 as used by the reference operator
+(object construction in pkg/controller/mpi_job_controller.go, ownership
+checks via metav1.GetControllerOf).  All API objects are dataclasses with
+snake_case attributes; (de)serialization converts to the camelCase JSON
+names so manifests round-trip with real Kubernetes YAML.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import datetime
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# Clock (injectable for tests, like the reference fixture's fake clock)
+# ---------------------------------------------------------------------------
+
+class Clock:
+    def now(self) -> datetime.datetime:
+        return datetime.datetime.now(datetime.timezone.utc)
+
+
+class FakeClock(Clock):
+    """Deterministic clock for tests (reference fixture injects clocktesting
+    at pkg/controller/mpi_job_controller_test.go:70-213)."""
+
+    def __init__(self, start: Optional[datetime.datetime] = None):
+        self._now = start or datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+        self._lock = threading.Lock()
+
+    def now(self) -> datetime.datetime:
+        with self._lock:
+            return self._now
+
+    def step(self, seconds: float) -> None:
+        with self._lock:
+            self._now += datetime.timedelta(seconds=seconds)
+
+    def set(self, when: datetime.datetime) -> None:
+        with self._lock:
+            self._now = when
+
+
+def format_time(t: datetime.datetime) -> str:
+    return t.astimezone(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def parse_time(s: str) -> datetime.datetime:
+    return datetime.datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ").replace(
+        tzinfo=datetime.timezone.utc)
+
+
+# ---------------------------------------------------------------------------
+# snake_case <-> camelCase serialization
+# ---------------------------------------------------------------------------
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    out = parts[0] + "".join(p.capitalize() for p in parts[1:])
+    # Kubernetes JSON uses a handful of irregular names.
+    return {"clusterIp": "clusterIP", "podIp": "podIP", "hostIp": "hostIP",
+            "uid": "uid", "ttlSecondsAfterFinished": "ttlSecondsAfterFinished",
+            }.get(out, out)
+
+
+_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def _snake(name: str) -> str:
+    return _SNAKE_RE.sub("_", name).lower()
+
+
+def to_dict(obj: Any) -> Any:
+    """Serialize a dataclass tree to a JSON-compatible dict, dropping empty
+    fields (matching k8s `omitempty` rendering)."""
+    if dataclasses.is_dataclass(obj):
+        out = {}
+        for f in dataclasses.fields(obj):
+            val = to_dict(getattr(obj, f.name))
+            # omitempty: drop None/empty containers/empty strings.  0 and
+            # False are kept — they are meaningful for Optional fields
+            # (e.g. worker replicas=0 mirrors Go's non-nil *int32).
+            if val is None or val == "" or val == {} or val == []:
+                continue
+            out[_camel(f.name)] = val
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items() if v is not None}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    if isinstance(obj, datetime.datetime):
+        return format_time(obj)
+    if isinstance(obj, bytes):
+        import base64
+        return base64.b64encode(obj).decode()
+    return obj
+
+
+def from_dict(cls, data: Any):
+    """Deserialize a JSON dict into dataclass `cls` (best-effort typed)."""
+    if data is None:
+        return None
+    if not dataclasses.is_dataclass(cls):
+        return data
+    import typing
+    kwargs = {}
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    for key, val in data.items():
+        name = _snake(key)
+        if name not in fields:
+            continue
+        ftype = hints.get(name, Any)
+        kwargs[name] = _coerce(ftype, val)
+    return cls(**kwargs)
+
+
+def _coerce(ftype, val):
+    import typing
+    origin = typing.get_origin(ftype)
+    if origin is typing.Union:  # Optional[T]
+        args = [a for a in typing.get_args(ftype) if a is not type(None)]
+        if len(args) == 1:
+            return _coerce(args[0], val)
+        return val
+    if origin in (list, tuple) and isinstance(val, list):
+        (elem,) = typing.get_args(ftype) or (Any,)
+        return [_coerce(elem, v) for v in val]
+    if origin is dict and isinstance(val, dict):
+        args = typing.get_args(ftype)
+        if len(args) == 2:
+            return {k: _coerce(args[1], v) for k, v in val.items()}
+        return val
+    if ftype is datetime.datetime and isinstance(val, str):
+        return parse_time(val)
+    if dataclasses.is_dataclass(ftype) and isinstance(val, dict):
+        return from_dict(ftype, val)
+    return val
+
+
+# ---------------------------------------------------------------------------
+# Core meta types
+# ---------------------------------------------------------------------------
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    generation: int = 0
+    creation_timestamp: Optional[datetime.datetime] = None
+    deletion_timestamp: Optional[datetime.datetime] = None
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+    owner_references: list = field(default_factory=list)
+    finalizers: list = field(default_factory=list)
+
+
+def new_controller_ref(owner, api_version: str, kind: str) -> OwnerReference:
+    """metav1.NewControllerRef equivalent (used throughout
+    mpi_job_controller.go object constructors)."""
+    return OwnerReference(api_version=api_version, kind=kind,
+                          name=owner.metadata.name, uid=owner.metadata.uid,
+                          controller=True, block_owner_deletion=True)
+
+
+def get_controller_of(obj) -> Optional[OwnerReference]:
+    """metav1.GetControllerOf equivalent (ownership checks, e.g.
+    mpi_job_controller.go:758-779 getLauncherJob)."""
+    for ref in obj.metadata.owner_references:
+        if ref.controller:
+            return ref
+    return None
+
+
+def deep_copy(obj):
+    """DeepCopy discipline: informer caches must never be mutated
+    (reference: mpi_job_controller.go:591-594)."""
+    return copy.deepcopy(obj)

@@ -1,0 +1,70 @@
+"""Kubernetes resource Quantity arithmetic.
+
+Parity target: the resource math used by the gang-scheduling adapters
+(reference: pkg/controller/podgroup.go:403-433 `addResources`,
+`calPGMinResource`).  Supports the decimal/binary suffixes that appear in
+pod resource lists ("100m" CPU, "1Gi" memory, plain integers for
+google.com/tpu chips).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+_BINARY = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4,
+           "Pi": 1024**5, "Ei": 1024**6}
+_DECIMAL = {"n": Fraction(1, 10**9), "u": Fraction(1, 10**6),
+            "m": Fraction(1, 1000), "k": 10**3, "M": 10**6, "G": 10**9,
+            "T": 10**12, "P": 10**15, "E": 10**18}
+
+
+def parse_quantity(value) -> Fraction:
+    """Parse a quantity string (or number) into an exact Fraction."""
+    if isinstance(value, Fraction):
+        return value
+    if isinstance(value, (int, float)):
+        return Fraction(value).limit_denominator(10**9)
+    s = str(value).strip()
+    if not s:
+        raise ValueError("empty quantity")
+    for suffix, mult in _BINARY.items():
+        if s.endswith(suffix):
+            return Fraction(s[: -len(suffix)]) * mult
+    for suffix, mult in _DECIMAL.items():
+        if s.endswith(suffix):
+            return Fraction(s[: -len(suffix)]) * Fraction(mult)
+    return Fraction(s)
+
+
+def format_quantity(value: Fraction) -> str:
+    """Render a Fraction back to a canonical quantity string."""
+    if value.denominator == 1:
+        return str(value.numerator)
+    milli = value * 1000
+    if milli.denominator == 1:
+        return f"{milli.numerator}m"
+    # Fall back to a decimal string with enough precision.
+    return str(float(value))
+
+
+def add_resource_lists(a: dict | None, b: dict | None) -> dict:
+    """Sum two ResourceLists ({"cpu": "100m", ...}) key-wise.
+
+    Mirrors addResources (reference: pkg/controller/podgroup.go:420-433).
+    """
+    out: dict[str, Fraction] = {}
+    for src in (a or {}), (b or {}):
+        for key, val in src.items():
+            out[key] = out.get(key, Fraction(0)) + parse_quantity(val)
+    return {k: format_quantity(v) for k, v in sorted(out.items())}
+
+
+def max_resource_lists(a: dict | None, b: dict | None) -> dict:
+    """Key-wise max of two ResourceLists."""
+    out: dict[str, Fraction] = {}
+    for src in (a or {}), (b or {}):
+        for key, val in src.items():
+            q = parse_quantity(val)
+            if key not in out or q > out[key]:
+                out[key] = q
+    return {k: format_quantity(v) for k, v in sorted(out.items())}

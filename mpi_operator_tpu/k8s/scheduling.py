@@ -1,0 +1,53 @@
+"""PodGroup object models for gang scheduling.
+
+Two API flavors, matching the reference's dual support
+(pkg/controller/podgroup.go:68 VolcanoCtrl with
+scheduling.volcano.sh/v1beta1, :197 SchedulerPluginsCtrl with
+scheduling.x-k8s.io/v1alpha1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+
+VOLCANO_API_VERSION = "scheduling.volcano.sh/v1beta1"
+SCHED_PLUGINS_API_VERSION = "scheduling.x-k8s.io/v1alpha1"
+
+VOLCANO_POD_GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"
+SCHED_PLUGINS_POD_GROUP_LABEL = "scheduling.x-k8s.io/pod-group"
+
+
+@dataclass
+class VolcanoPodGroupSpec:
+    min_member: int = 0
+    queue: str = ""
+    priority_class_name: str = ""
+    min_resources: dict = field(default_factory=dict)
+
+
+@dataclass
+class VolcanoPodGroup:
+    api_version: str = VOLCANO_API_VERSION
+    kind: str = "PodGroup"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: VolcanoPodGroupSpec = field(default_factory=VolcanoPodGroupSpec)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class SchedPluginsPodGroupSpec:
+    min_member: int = 0
+    min_resources: dict = field(default_factory=dict)
+    schedule_timeout_seconds: Optional[int] = None
+
+
+@dataclass
+class SchedPluginsPodGroup:
+    api_version: str = SCHED_PLUGINS_API_VERSION
+    kind: str = "PodGroup"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: SchedPluginsPodGroupSpec = field(default_factory=SchedPluginsPodGroupSpec)
+    status: dict = field(default_factory=dict)

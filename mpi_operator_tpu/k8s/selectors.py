@@ -1,0 +1,48 @@
+"""Label selector matching (metav1.LabelSelectorAsSelector subset).
+
+Used for pod listing by job selector (reference:
+pkg/controller/mpi_job_controller.go:1694-1706 jobPods and selector
+construction in workerSelector).
+"""
+
+from __future__ import annotations
+
+
+def match_labels(selector: dict | None, labels: dict | None) -> bool:
+    if not selector:
+        return True
+    labels = labels or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def match_label_selector(selector, labels: dict | None) -> bool:
+    """Match a LabelSelector (matchLabels + matchExpressions In/NotIn/
+    Exists/DoesNotExist)."""
+    if selector is None:
+        return True
+    labels = labels or {}
+    ml = getattr(selector, "match_labels", None)
+    if ml is None and isinstance(selector, dict):
+        ml = selector.get("match_labels") or selector.get("matchLabels")
+    if ml and not match_labels(ml, labels):
+        return False
+    exprs = getattr(selector, "match_expressions", None)
+    if exprs is None and isinstance(selector, dict):
+        exprs = selector.get("match_expressions") or selector.get("matchExpressions")
+    for expr in exprs or []:
+        key = expr.get("key")
+        op = expr.get("operator")
+        values = expr.get("values") or []
+        if op == "In":
+            if labels.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if labels.get(key) in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+    return True

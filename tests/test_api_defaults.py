@@ -1,0 +1,73 @@
+"""Defaulting tests — parity with
+/root/reference/pkg/apis/kubeflow/v2beta1/default_test.go."""
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.defaults import set_defaults_mpijob
+from mpi_operator_tpu.api.types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy
+
+
+def test_empty_job_gets_full_defaults():
+    job = MPIJob()
+    set_defaults_mpijob(job)
+    assert job.spec.slots_per_worker == 1
+    assert job.spec.ssh_auth_mount_path == "/root/.ssh"
+    assert job.spec.mpi_implementation == constants.IMPL_OPENMPI
+    assert job.spec.launcher_creation_policy == constants.LAUNCHER_CREATION_AT_STARTUP
+    assert job.spec.run_policy.clean_pod_policy == constants.CLEAN_POD_POLICY_NONE
+
+
+def test_launcher_defaults():
+    job = MPIJob(spec=MPIJobSpec(mpi_replica_specs={
+        constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(),
+    }))
+    set_defaults_mpijob(job)
+    launcher = job.launcher_spec
+    assert launcher.replicas == 1
+    assert launcher.restart_policy == constants.RESTART_POLICY_ON_FAILURE
+
+
+def test_worker_defaults():
+    job = MPIJob(spec=MPIJobSpec(mpi_replica_specs={
+        constants.REPLICA_TYPE_WORKER: ReplicaSpec(),
+    }))
+    set_defaults_mpijob(job)
+    worker = job.worker_spec
+    assert worker.replicas == 0
+    assert worker.restart_policy == constants.RESTART_POLICY_NEVER
+
+
+def test_defaults_do_not_override_user_values():
+    job = MPIJob(spec=MPIJobSpec(
+        slots_per_worker=4,
+        ssh_auth_mount_path="/home/user/.ssh",
+        mpi_implementation=constants.IMPL_JAX,
+        launcher_creation_policy=constants.LAUNCHER_CREATION_WAIT_FOR_WORKERS_READY,
+        run_policy=RunPolicy(clean_pod_policy=constants.CLEAN_POD_POLICY_ALL),
+        mpi_replica_specs={
+            constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                replicas=1, restart_policy=constants.RESTART_POLICY_NEVER),
+            constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                replicas=8, restart_policy=constants.RESTART_POLICY_ON_FAILURE),
+        }))
+    set_defaults_mpijob(job)
+    assert job.spec.slots_per_worker == 4
+    assert job.spec.ssh_auth_mount_path == "/home/user/.ssh"
+    assert job.spec.mpi_implementation == constants.IMPL_JAX
+    assert (job.spec.launcher_creation_policy
+            == constants.LAUNCHER_CREATION_WAIT_FOR_WORKERS_READY)
+    assert job.spec.run_policy.clean_pod_policy == constants.CLEAN_POD_POLICY_ALL
+    assert job.launcher_spec.restart_policy == constants.RESTART_POLICY_NEVER
+    assert job.worker_spec.replicas == 8
+    assert job.worker_spec.restart_policy == constants.RESTART_POLICY_ON_FAILURE
+
+
+def test_defaulting_is_idempotent():
+    job = MPIJob(spec=MPIJobSpec(mpi_replica_specs={
+        constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(),
+        constants.REPLICA_TYPE_WORKER: ReplicaSpec(),
+    }))
+    set_defaults_mpijob(job)
+    import copy
+    snapshot = copy.deepcopy(job)
+    set_defaults_mpijob(job)
+    assert job == snapshot

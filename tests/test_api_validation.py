@@ -1,0 +1,146 @@
+"""Validation tests — parity with
+/root/reference/pkg/apis/kubeflow/validation/validation_test.go
+(table-driven)."""
+
+import pytest
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.defaults import set_defaults_mpijob
+from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec, ReplicaSpec,
+                                        RunPolicy)
+from mpi_operator_tpu.api.validation import validate_mpijob
+from mpi_operator_tpu.k8s.core import Container, PodSpec, PodTemplateSpec
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+
+def valid_job(name="test", workers=2, impl=constants.IMPL_OPENMPI) -> MPIJob:
+    job = MPIJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=MPIJobSpec(
+            mpi_implementation=impl,
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(spec=PodSpec(
+                        containers=[Container(name="launcher", image="img")]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=PodTemplateSpec(spec=PodSpec(
+                        containers=[Container(name="worker", image="img")]))),
+            }))
+    return set_defaults_mpijob(job)
+
+
+def test_valid_job_passes():
+    assert validate_mpijob(valid_job()) == []
+
+
+def test_valid_jax_job_passes():
+    assert validate_mpijob(valid_job(impl=constants.IMPL_JAX)) == []
+
+
+def test_missing_replica_specs():
+    job = valid_job()
+    job.spec.mpi_replica_specs = {}
+    errs = validate_mpijob(job)
+    assert any("must have replica specs" in e.message for e in errs)
+
+
+def test_missing_launcher():
+    job = valid_job()
+    del job.spec.mpi_replica_specs[constants.REPLICA_TYPE_LAUNCHER]
+    errs = validate_mpijob(job)
+    assert any("Launcher" in e.field for e in errs)
+
+
+def test_launcher_replicas_must_be_one():
+    job = valid_job()
+    job.launcher_spec.replicas = 2
+    errs = validate_mpijob(job)
+    assert any(e.message == "must be 1" for e in errs)
+
+
+def test_worker_replicas_must_be_positive():
+    job = valid_job()
+    job.worker_spec.replicas = 0
+    errs = validate_mpijob(job)
+    assert any("greater than or equal to 1" in e.message for e in errs)
+
+
+def test_invalid_dns1035_name():
+    # "1-job-worker-1" starts with a digit -> invalid DNS-1035 label.
+    job = valid_job(name="1-job")
+    errs = validate_mpijob(job)
+    assert any(e.field == "metadata.name" for e in errs)
+
+
+def test_long_name_with_many_workers_rejected():
+    # hostname <job>-worker-<n> must fit in 63 chars (validation.go:55-68).
+    job = valid_job(name="a" * 60, workers=100)
+    errs = validate_mpijob(job)
+    assert any(e.field == "metadata.name" for e in errs)
+
+
+def test_invalid_clean_pod_policy():
+    job = valid_job()
+    job.spec.run_policy.clean_pod_policy = "Sometimes"
+    errs = validate_mpijob(job)
+    assert any("cleanPodPolicy" in e.field for e in errs)
+
+
+def test_missing_clean_pod_policy():
+    job = valid_job()
+    job.spec.run_policy.clean_pod_policy = None
+    errs = validate_mpijob(job)
+    assert any("must have clean Pod policy" in e.message for e in errs)
+
+
+@pytest.mark.parametrize("field_name", ["ttl_seconds_after_finished",
+                                        "active_deadline_seconds",
+                                        "backoff_limit"])
+def test_negative_run_policy_fields(field_name):
+    job = valid_job()
+    setattr(job.spec.run_policy, field_name, -1)
+    errs = validate_mpijob(job)
+    assert any("greater than or equal to 0" in e.message for e in errs)
+
+
+def test_invalid_managed_by():
+    job = valid_job()
+    job.spec.run_policy.managed_by = "example.com/other"
+    errs = validate_mpijob(job)
+    assert any("managedBy" in e.field for e in errs)
+
+
+def test_valid_managed_by_multikueue():
+    job = valid_job()
+    job.spec.run_policy.managed_by = constants.MULTIKUEUE_CONTROLLER
+    assert validate_mpijob(job) == []
+
+
+def test_invalid_implementation():
+    job = valid_job()
+    job.spec.mpi_implementation = "Gloo"
+    errs = validate_mpijob(job)
+    assert any("mpiImplementation" in e.field for e in errs)
+
+
+def test_invalid_restart_policy():
+    job = valid_job()
+    job.worker_spec.restart_policy = constants.RESTART_POLICY_ALWAYS
+    errs = validate_mpijob(job)
+    assert any("restartPolicy" in e.field for e in errs)
+
+
+def test_missing_containers():
+    job = valid_job()
+    job.worker_spec.template.spec.containers = []
+    errs = validate_mpijob(job)
+    assert any("containers" in e.field for e in errs)
+
+
+def test_negative_slots_rejected():
+    job = valid_job()
+    job.spec.slots_per_worker = -1
+    errs = validate_mpijob(job)
+    assert any("slotsPerWorker" in e.field for e in errs)

@@ -1,0 +1,214 @@
+"""Tests for the in-memory API machinery (apiserver, informers, workqueue,
+quantity math) — the substrate equivalent of client-go fakes used by the
+reference fixture (pkg/controller/mpi_job_controller_test.go:70-213)."""
+
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu.k8s.apiserver import (ApiError, Clientset, is_conflict,
+                                            is_not_found)
+from mpi_operator_tpu.k8s.core import ConfigMap, Pod
+from mpi_operator_tpu.k8s.informers import InformerFactory
+from mpi_operator_tpu.k8s.meta import (ObjectMeta, OwnerReference, deep_copy,
+                                       new_controller_ref)
+from mpi_operator_tpu.k8s.quantity import (add_resource_lists, parse_quantity)
+from mpi_operator_tpu.k8s.workqueue import (RateLimitingQueue,
+                                            default_controller_rate_limiter)
+
+
+# --- apiserver -----------------------------------------------------------
+
+def test_create_get_roundtrip_and_uid_assignment():
+    cs = Clientset()
+    pod = Pod(metadata=ObjectMeta(name="p1", namespace="ns"))
+    created = cs.pods("ns").create(pod)
+    assert created.metadata.uid
+    assert created.metadata.resource_version
+    got = cs.pods("ns").get("p1")
+    assert got.metadata.uid == created.metadata.uid
+
+
+def test_create_duplicate_fails():
+    cs = Clientset()
+    cs.pods("ns").create(Pod(metadata=ObjectMeta(name="p1", namespace="ns")))
+    with pytest.raises(ApiError) as exc:
+        cs.pods("ns").create(Pod(metadata=ObjectMeta(name="p1", namespace="ns")))
+    assert exc.value.code == "AlreadyExists"
+
+
+def test_get_missing_raises_not_found():
+    cs = Clientset()
+    with pytest.raises(ApiError) as exc:
+        cs.pods("ns").get("nope")
+    assert is_not_found(exc.value)
+
+
+def test_update_conflict_on_stale_resource_version():
+    cs = Clientset()
+    created = cs.config_maps("ns").create(
+        ConfigMap(metadata=ObjectMeta(name="c", namespace="ns"),
+                  data={"k": "v1"}))
+    fresh = deep_copy(created)
+    fresh.data["k"] = "v2"
+    cs.config_maps("ns").update(fresh)
+    stale = deep_copy(created)
+    stale.data["k"] = "v3"
+    with pytest.raises(ApiError) as exc:
+        cs.config_maps("ns").update(stale)
+    assert is_conflict(exc.value)
+
+
+def test_status_subresource_does_not_touch_spec():
+    from mpi_operator_tpu.k8s.batch import Job, JobSpec
+    cs = Clientset()
+    job = cs.jobs("ns").create(Job(metadata=ObjectMeta(name="j", namespace="ns"),
+                                   spec=JobSpec(backoff_limit=3)))
+    job.spec.backoff_limit = 99  # must NOT be persisted via update_status
+    job.status.active = 1
+    updated = cs.jobs("ns").update_status(job)
+    assert updated.status.active == 1
+    assert updated.spec.backoff_limit == 3
+
+
+def test_spec_update_does_not_touch_status():
+    from mpi_operator_tpu.k8s.batch import Job, JobSpec
+    cs = Clientset()
+    job = cs.jobs("ns").create(Job(metadata=ObjectMeta(name="j", namespace="ns")))
+    job.status.active = 5
+    job = cs.jobs("ns").update_status(job)
+    job.spec.backoff_limit = 1
+    job.status.active = 99  # ignored by spec update
+    updated = cs.jobs("ns").update(job)
+    assert updated.spec.backoff_limit == 1
+    assert updated.status.active == 5
+
+
+def test_list_with_label_selector_and_namespace_scoping():
+    cs = Clientset()
+    for ns, name, labels in [("a", "p1", {"app": "x"}),
+                             ("a", "p2", {"app": "y"}),
+                             ("b", "p3", {"app": "x"})]:
+        cs.pods(ns).create(Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                                   labels=labels)))
+    assert [p.metadata.name for p in cs.pods("a").list({"app": "x"})] == ["p1"]
+    assert len(cs.pods("a").list()) == 2
+
+
+def test_owner_cascade_delete():
+    cs = Clientset()
+    owner = cs.config_maps("ns").create(
+        ConfigMap(metadata=ObjectMeta(name="owner", namespace="ns")))
+    ref = OwnerReference(api_version="v1", kind="ConfigMap", name="owner",
+                         uid=owner.metadata.uid, controller=True)
+    cs.pods("ns").create(Pod(metadata=ObjectMeta(
+        name="child", namespace="ns", owner_references=[ref])))
+    cs.config_maps("ns").delete("owner")
+    with pytest.raises(ApiError):
+        cs.pods("ns").get("child")
+
+
+def test_reactor_injection_and_action_recording():
+    cs = Clientset()
+
+    def fail_create(action):
+        return True, ApiError("Forbidden", "injected")
+
+    cs.prepend_reactor("create", "Pod", fail_create)
+    with pytest.raises(ApiError) as exc:
+        cs.pods("ns").create(Pod(metadata=ObjectMeta(name="p", namespace="ns")))
+    assert exc.value.code == "Forbidden"
+    assert cs.actions[-1].matches("create", "Pod")
+
+
+def test_deep_copy_discipline():
+    cs = Clientset()
+    cs.pods("ns").create(Pod(metadata=ObjectMeta(name="p", namespace="ns",
+                                                 labels={"a": "1"})))
+    got = cs.pods("ns").get("p")
+    got.metadata.labels["a"] = "MUTATED"
+    assert cs.pods("ns").get("p").metadata.labels["a"] == "1"
+
+
+# --- informers -----------------------------------------------------------
+
+def test_informer_list_watch_sync():
+    cs = Clientset()
+    cs.pods("ns").create(Pod(metadata=ObjectMeta(name="pre", namespace="ns")))
+    factory = InformerFactory(cs)
+    inf = factory.pods()
+    added = []
+    inf.add_event_handler(on_add=lambda o: added.append(o.metadata.name))
+    factory.start_all()
+    assert factory.wait_for_cache_sync()
+    cs.pods("ns").create(Pod(metadata=ObjectMeta(name="post", namespace="ns")))
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and len(added) < 2:
+        time.sleep(0.01)
+    assert sorted(added) == ["post", "pre"]
+    assert inf.lister.get("ns", "post") is not None
+    cs.pods("ns").delete("post")
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and inf.lister.get("ns", "post"):
+        time.sleep(0.01)
+    assert inf.lister.get("ns", "post") is None
+    factory.stop_all()
+
+
+# --- workqueue -----------------------------------------------------------
+
+def test_workqueue_dedup_and_reprocess():
+    q = RateLimitingQueue()
+    q.add("k")
+    q.add("k")  # dedup while queued
+    item, _ = q.get(timeout=1)
+    assert item == "k"
+    q.add("k")  # re-add while processing -> requeued at done()
+    q.done("k")
+    item, _ = q.get(timeout=1)
+    assert item == "k"
+    q.done("k")
+    assert len(q) == 0
+
+
+def test_workqueue_rate_limiter_backoff_grows_and_forget_resets():
+    rl = default_controller_rate_limiter()
+    d1 = rl.when("x")
+    d2 = rl.when("x")
+    assert d2 > d1
+    assert rl.num_requeues("x") == 2
+    rl.forget("x")
+    assert rl.num_requeues("x") == 0
+
+
+def test_workqueue_shutdown_unblocks_getters():
+    q = RateLimitingQueue()
+    results = []
+
+    def getter():
+        results.append(q.get(timeout=5))
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.05)
+    q.shutdown()
+    t.join(timeout=2)
+    assert results and results[0][1] is True
+
+
+# --- quantity ------------------------------------------------------------
+
+def test_quantity_parsing():
+    assert parse_quantity("100m") == parse_quantity("0.1")
+    assert parse_quantity("1Gi") == 1024 ** 3
+    assert parse_quantity("2") == 2
+    assert parse_quantity("1k") == 1000
+
+
+def test_add_resource_lists():
+    total = add_resource_lists({"cpu": "100m", "memory": "1Gi"},
+                               {"cpu": "900m", "google.com/tpu": "4"})
+    assert total["cpu"] == "1"
+    assert total["memory"] == "1073741824"
+    assert total["google.com/tpu"] == "4"
