@@ -1,0 +1,4 @@
+"""The MPIJob reconcile controller (TPU-native re-architecture of
+/root/reference/pkg/controller)."""
+
+from .controller import MPIJobController  # noqa: F401

@@ -1,0 +1,465 @@
+"""Object constructors for the reconcile loop.
+
+Parity targets in /root/reference/pkg/controller/mpi_job_controller.go:
+newConfigMap (:1335-1380), updateDiscoverHostsInConfigMap (:1383-1407),
+newJobService (:1409-1438), newSSHAuthSecret (:1442-1477), newWorker
+(:1499-1552), newLauncherJob (:1554-1580), newLauncherPodTemplate
+(:1585-1674), setupSSHOnPod (:1793-1816), env matrices (:117-219).
+
+TPU-native addition: the ``JAX`` implementation replaces the
+hostfile/SSH column with coordination-service env injection —
+JAX_COORDINATOR_ADDRESS points at process 0's stable DNS name (the
+launcher when runLauncherAsWorker, else worker-0), JAX_PROCESS_ID comes
+from the replica index, JAX_NUM_PROCESSES from the replica count, and
+slotsPerWorker maps to JAX_LOCAL_DEVICE_COUNT (chips per host).  XLA then
+forms collectives over ICI/DCN with no SSH, no hostfile, no mpirun.
+"""
+
+from __future__ import annotations
+
+from ..api import constants
+from ..api.types import MPIJob, ReplicaSpec, run_launcher_as_worker, worker_replicas
+from ..k8s import batch, core
+from ..k8s.core import (ConfigMap, ConfigMapVolumeSource, Container, EnvVar,
+                        KeyToPath, Pod, PodDNSConfig, PodTemplateSpec, Secret,
+                        SecretVolumeSource, Service, ServiceSpec, Volume,
+                        VolumeMount)
+from ..k8s.meta import deep_copy, new_controller_ref, ObjectMeta
+
+# Naming / mount constants (mpi_job_controller.go:74-96)
+CONFIG_SUFFIX = "-config"
+CONFIG_VOLUME_NAME = "mpi-job-config"
+CONFIG_MOUNT_PATH = "/etc/mpi"
+HOSTFILE_NAME = "hostfile"
+DISCOVER_HOSTS_SCRIPT_NAME = "discover_hosts.sh"
+SSH_AUTH_SECRET_SUFFIX = "-ssh"
+SSH_AUTH_VOLUME = "ssh-auth"
+ROOT_SSH_PATH = "/root/.ssh"
+LAUNCHER = "launcher"
+WORKER = "worker"
+LAUNCHER_SUFFIX = "-launcher"
+WORKER_SUFFIX = "-worker"
+SSH_PUBLIC_KEY = "ssh-publickey"
+SSH_PRIVATE_KEY_FILE = "id_rsa"
+SSH_PUBLIC_KEY_FILE = "id_rsa.pub"
+SSH_AUTHORIZED_KEYS_FILE = "authorized_keys"
+
+OPENMPI_SLOTS_ENV = "OMPI_MCA_orte_set_default_slots"
+INTEL_MPI_SLOTS_ENV = "I_MPI_PERHOST"
+
+# Env matrices (mpi_job_controller.go:169-219)
+LAUNCHER_ENV = [EnvVar("K_MPI_JOB_ROLE", LAUNCHER)]
+WORKER_ENV = [EnvVar("K_MPI_JOB_ROLE", WORKER)]
+OMPI_ENV = [
+    EnvVar("OMPI_MCA_orte_keep_fqdn_hostnames", "true"),
+    EnvVar("OMPI_MCA_orte_default_hostfile",
+           f"{CONFIG_MOUNT_PATH}/{HOSTFILE_NAME}"),
+    EnvVar("OMPI_MCA_plm_rsh_args", "-o ConnectionAttempts=10"),
+]
+INTEL_ENV = [
+    EnvVar("I_MPI_HYDRA_HOST_FILE", f"{CONFIG_MOUNT_PATH}/{HOSTFILE_NAME}"),
+    EnvVar("I_MPI_HYDRA_BOOTSTRAP_EXEC_EXTRA_ARGS",
+           "-o ConnectionAttempts=10"),
+]
+MPICH_ENV = [
+    EnvVar("HYDRA_HOST_FILE", f"{CONFIG_MOUNT_PATH}/{HOSTFILE_NAME}"),
+    EnvVar("HYDRA_LAUNCH_EXTRA_ARGS", "-o ConnectionAttempts=10"),
+]
+# Accelerator hygiene on a non-worker launcher (:216-219): GPU env blanked;
+# TPU analogue forces the launcher's JAX onto CPU so it cannot grab chips.
+NVIDIA_DISABLE_ENV = [EnvVar("NVIDIA_VISIBLE_DEVICES", ""),
+                      EnvVar("NVIDIA_DRIVER_CAPABILITIES", "")]
+JAX_LAUNCHER_CPU_ENV = [EnvVar("JAX_PLATFORMS", "cpu")]
+
+SSH_VOLUME_ITEMS = [
+    KeyToPath(core.SSH_AUTH_PRIVATE_KEY, SSH_PRIVATE_KEY_FILE),
+    KeyToPath(SSH_PUBLIC_KEY, SSH_PUBLIC_KEY_FILE),
+    KeyToPath(SSH_PUBLIC_KEY, SSH_AUTHORIZED_KEYS_FILE),
+]
+CONFIG_VOLUME_ITEMS = [
+    KeyToPath(HOSTFILE_NAME, HOSTFILE_NAME, mode=0o444),
+    KeyToPath(DISCOVER_HOSTS_SCRIPT_NAME, DISCOVER_HOSTS_SCRIPT_NAME,
+              mode=0o555),
+]
+
+
+def worker_name(job: MPIJob, index: int) -> str:
+    return f"{job.metadata.name}{WORKER_SUFFIX}-{index}"
+
+
+def launcher_name(job: MPIJob) -> str:
+    return f"{job.metadata.name}{LAUNCHER_SUFFIX}"
+
+
+def default_labels(job_name: str, role: str) -> dict:
+    """defaultLabels (:1772-1778)."""
+    return {
+        constants.OPERATOR_NAME_LABEL: constants.OPERATOR_NAME,
+        constants.JOB_NAME_LABEL: job_name,
+        constants.JOB_ROLE_LABEL: role,
+    }
+
+
+def worker_selector(job_name: str) -> dict:
+    """workerSelector (:1780-1783)."""
+    return default_labels(job_name, WORKER)
+
+
+def _owner_ref(job: MPIJob):
+    return new_controller_ref(job, constants.GROUP_VERSION, constants.KIND)
+
+
+def _domain_format(cluster_domain: str) -> str:
+    fmt = "{host}.{svc}.{ns}.svc"
+    if cluster_domain:
+        fmt += f".{cluster_domain}"
+    return fmt
+
+
+def _host_fqdn(host: str, job: MPIJob, cluster_domain: str) -> str:
+    return _domain_format(cluster_domain).format(
+        host=host, svc=job.metadata.name, ns=job.metadata.namespace)
+
+
+def is_jax(job: MPIJob) -> bool:
+    return job.spec.mpi_implementation == constants.IMPL_JAX
+
+
+def uses_ssh(job: MPIJob) -> bool:
+    """The JAX path needs no SSH transport; MPI paths do."""
+    return not is_jax(job)
+
+
+# ---------------------------------------------------------------------------
+# Coordinator math (the TPU-native bootstrap contract)
+# ---------------------------------------------------------------------------
+
+def num_processes(job: MPIJob) -> int:
+    """World size: workers, plus the launcher when it runs as a worker."""
+    return worker_replicas(job) + (1 if run_launcher_as_worker(job) else 0)
+
+
+def coordinator_host(job: MPIJob, cluster_domain: str) -> str:
+    """Process 0's stable DNS name: launcher when runLauncherAsWorker,
+    else worker-0 (headless-Service-backed, like the reference's hostfile
+    entries at :1349-1361)."""
+    if run_launcher_as_worker(job):
+        return _host_fqdn(launcher_name(job), job, cluster_domain)
+    return _host_fqdn(worker_name(job, 0), job, cluster_domain)
+
+
+def jax_env(job: MPIJob, process_id: int, cluster_domain: str) -> list:
+    port = constants.DEFAULT_JAX_COORDINATOR_PORT
+    return [
+        EnvVar(constants.JAX_COORDINATOR_ADDRESS_ENV,
+               f"{coordinator_host(job, cluster_domain)}:{port}"),
+        EnvVar(constants.JAX_COORDINATOR_PORT_ENV, str(port)),
+        EnvVar(constants.JAX_PROCESS_ID_ENV, str(process_id)),
+        EnvVar(constants.JAX_NUM_PROCESSES_ENV, str(num_processes(job))),
+        EnvVar(constants.JAX_LOCAL_DEVICE_COUNT_ENV,
+               str(job.spec.slots_per_worker or 1)),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ConfigMap (hostfile + discover_hosts.sh)
+# ---------------------------------------------------------------------------
+
+def new_config_map(job: MPIJob, workers: int, cluster_domain: str) -> ConfigMap:
+    """newConfigMap (:1335-1380).  For JAX the hostfile is informational
+    (one FQDN per line) — bootstrap rides the coordinator env instead."""
+    slots = job.spec.slots_per_worker or 1
+    lines = []
+
+    def host_line(host: str) -> str:
+        fqdn = _host_fqdn(host, job, cluster_domain)
+        impl = job.spec.mpi_implementation
+        if impl == constants.IMPL_OPENMPI:
+            return f"{fqdn} slots={slots}"
+        if impl in (constants.IMPL_INTEL, constants.IMPL_MPICH):
+            return f"{fqdn}:{slots}"
+        return fqdn  # JAX: plain host list for debugging/tooling
+
+    if run_launcher_as_worker(job):
+        lines.append(host_line(launcher_name(job)))
+    for i in range(workers):
+        lines.append(host_line(worker_name(job, i)))
+
+    return ConfigMap(
+        metadata=ObjectMeta(
+            name=job.metadata.name + CONFIG_SUFFIX,
+            namespace=job.metadata.namespace,
+            labels={"app": job.metadata.name},
+            owner_references=[_owner_ref(job)]),
+        data={HOSTFILE_NAME: "".join(line + "\n" for line in lines)})
+
+
+def update_discover_hosts_in_config_map(config_map: ConfigMap, job: MPIJob,
+                                        running_pods: list,
+                                        cluster_domain: str) -> None:
+    """updateDiscoverHostsInConfigMap (:1383-1407): regenerate the elastic
+    host-discovery script from *running* worker pods, sorted by name."""
+    pods = sorted(running_pods, key=lambda p: p.metadata.name)
+    lines = ["#!/bin/sh"]
+    if run_launcher_as_worker(job):
+        lines.append("echo " + _host_fqdn(launcher_name(job), job,
+                                          cluster_domain))
+    for pod in pods:
+        lines.append("echo " + _domain_format(cluster_domain).format(
+            host=pod.metadata.name, svc=job.metadata.name,
+            ns=pod.metadata.namespace))
+    config_map.data[DISCOVER_HOSTS_SCRIPT_NAME] = "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Service
+# ---------------------------------------------------------------------------
+
+def new_job_service(job: MPIJob) -> Service:
+    """newJobService (:1409-1438): one headless Service fronting launcher
+    and workers for stable per-pod DNS."""
+    selector = {
+        constants.OPERATOR_NAME_LABEL: constants.OPERATOR_NAME,
+        constants.JOB_NAME_LABEL: job.metadata.name,
+    }
+    return Service(
+        metadata=ObjectMeta(
+            name=job.metadata.name,
+            namespace=job.metadata.namespace,
+            labels={"app": job.metadata.name},
+            owner_references=[_owner_ref(job)]),
+        spec=ServiceSpec(
+            cluster_ip=core.CLUSTER_IP_NONE,
+            selector=selector,
+            # True only with runLauncherAsWorker to avoid the launcher-ready
+            # deadlock (:1433-1435).  The JAX path needs it whenever workers
+            # must resolve the coordinator before it is Ready.
+            publish_not_ready_addresses=(run_launcher_as_worker(job)
+                                         or is_jax(job))))
+
+
+# ---------------------------------------------------------------------------
+# SSH Secret (MPI implementations only)
+# ---------------------------------------------------------------------------
+
+def new_ssh_auth_secret(job: MPIJob) -> Secret:
+    """newSSHAuthSecret (:1442-1477): fresh ECDSA P-521 keypair, private
+    PEM + OpenSSH public key."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    private_key = ec.generate_private_key(ec.SECP521R1())
+    private_pem = private_key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption())
+    public_ssh = private_key.public_key().public_bytes(
+        serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
+
+    return Secret(
+        metadata=ObjectMeta(
+            name=job.metadata.name + SSH_AUTH_SECRET_SUFFIX,
+            namespace=job.metadata.namespace,
+            labels={"app": job.metadata.name},
+            owner_references=[_owner_ref(job)]),
+        type=core.SECRET_TYPE_SSH_AUTH,
+        data={core.SSH_AUTH_PRIVATE_KEY: private_pem,
+              SSH_PUBLIC_KEY: public_ssh + b"\n"})
+
+
+def setup_ssh_on_pod(pod_spec, job: MPIJob) -> None:
+    """setupSSHOnPod (:1793-1816)."""
+    mode = 0o600 if job.spec.ssh_auth_mount_path == ROOT_SSH_PATH else None
+    pod_spec.volumes.append(Volume(
+        name=SSH_AUTH_VOLUME,
+        secret=SecretVolumeSource(
+            secret_name=job.metadata.name + SSH_AUTH_SECRET_SUFFIX,
+            items=deep_copy(SSH_VOLUME_ITEMS),
+            default_mode=mode)))
+    pod_spec.containers[0].volume_mounts.append(VolumeMount(
+        name=SSH_AUTH_VOLUME, mount_path=job.spec.ssh_auth_mount_path))
+
+
+# ---------------------------------------------------------------------------
+# Worker Pod
+# ---------------------------------------------------------------------------
+
+def set_restart_policy(template: PodTemplateSpec, spec: ReplicaSpec) -> None:
+    """setRestartPolicy (:1722-1728): ExitCode maps to Never."""
+    if spec.restart_policy == constants.RESTART_POLICY_EXIT_CODE:
+        template.spec.restart_policy = core.RESTART_POLICY_NEVER
+    else:
+        template.spec.restart_policy = spec.restart_policy
+
+
+def worker_replica_index_label(job: MPIJob, index: int) -> str:
+    """workerReplicaIndexLabel (:1487-1494): pad by one when the launcher
+    runs as a worker so all PodGroup members carry unique indices."""
+    if run_launcher_as_worker(job):
+        return str(index + 1)
+    return str(index)
+
+
+def new_worker(job: MPIJob, index: int, pod_group_ctrl=None) -> Pod:
+    """newWorker (:1499-1552)."""
+    name = worker_name(job, index)
+    template = deep_copy(job.worker_spec.template)
+
+    labels = dict(template.metadata.labels)
+    labels.update(default_labels(job.metadata.name, WORKER))
+    labels[constants.REPLICA_INDEX_LABEL] = worker_replica_index_label(job, index)
+    template.metadata.labels = labels
+
+    template.spec.hostname = name
+    template.spec.subdomain = job.metadata.name  # matches the Service name
+    if template.spec.host_network:
+        template.spec.dns_policy = core.DNS_CLUSTER_FIRST_WITH_HOST_NET
+    # Intel/MPICH workers reach the launcher by bare hostname (:1519-1525).
+    search = f"{job.metadata.name}.{job.metadata.namespace}.svc.cluster.local"
+    if template.spec.dns_config is None:
+        template.spec.dns_config = PodDNSConfig(searches=[search])
+    else:
+        template.spec.dns_config.searches.append(search)
+    set_restart_policy(template, job.worker_spec)
+
+    container = template.spec.containers[0]
+    if not container.command and not container.args:
+        if uses_ssh(job):
+            container.command = ["/usr/sbin/sshd", "-De"]
+        # JAX workers run the user's image entrypoint: the workload calls
+        # jax.distributed.initialize() from the injected env.
+    container.env = list(container.env) + deep_copy(WORKER_ENV)
+    if is_jax(job):
+        process_id = index + (1 if run_launcher_as_worker(job) else 0)
+        container.env += jax_env(job, process_id, cluster_domain="")
+    if uses_ssh(job):
+        setup_ssh_on_pod(template.spec, job)
+
+    if pod_group_ctrl is not None:
+        pod_group_ctrl.decorate_pod_template(template, job.metadata.name)
+
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=job.metadata.namespace,
+            labels=template.metadata.labels,
+            annotations=dict(template.metadata.annotations),
+            owner_references=[_owner_ref(job)]),
+        spec=template.spec)
+
+
+# ---------------------------------------------------------------------------
+# Launcher Job
+# ---------------------------------------------------------------------------
+
+def new_launcher_job(job: MPIJob, pod_group_ctrl=None, recorder=None) -> batch.Job:
+    """newLauncherJob (:1554-1580)."""
+    launcher = batch.Job(
+        metadata=ObjectMeta(
+            name=launcher_name(job),
+            namespace=job.metadata.namespace,
+            labels={"app": job.metadata.name},
+            owner_references=[_owner_ref(job)]),
+        spec=batch.JobSpec(
+            ttl_seconds_after_finished=job.spec.run_policy.ttl_seconds_after_finished,
+            active_deadline_seconds=job.spec.run_policy.active_deadline_seconds,
+            backoff_limit=job.spec.run_policy.backoff_limit,
+            template=new_launcher_pod_template(job, pod_group_ctrl, recorder),
+            # Guard against recreating terminating pods (:1571-1574).
+            pod_replacement_policy=batch.POD_REPLACEMENT_POLICY_FAILED))
+    if job.spec.run_policy.suspend:
+        launcher.spec.suspend = True
+    return launcher
+
+
+def new_launcher_pod_template(job: MPIJob, pod_group_ctrl=None,
+                              recorder=None) -> PodTemplateSpec:
+    """newLauncherPodTemplate (:1585-1674)."""
+    name = launcher_name(job)
+    template = deep_copy(job.launcher_spec.template)
+
+    labels = dict(template.metadata.labels)
+    labels.update(default_labels(job.metadata.name, LAUNCHER))
+    template.metadata.labels = labels
+    if pod_group_ctrl is not None:
+        pod_group_ctrl.decorate_pod_template(template, job.metadata.name)
+    if run_launcher_as_worker(job):
+        template.metadata.labels[constants.REPLICA_INDEX_LABEL] = "0"
+
+    template.spec.hostname = name
+    template.spec.subdomain = job.metadata.name
+    if template.spec.host_network:
+        template.spec.dns_policy = core.DNS_CLUSTER_FIRST_WITH_HOST_NET
+
+    container = template.spec.containers[0]
+    container.env = list(container.env) + deep_copy(LAUNCHER_ENV)
+    slots = str(job.spec.slots_per_worker or 1)
+    impl = job.spec.mpi_implementation
+    if impl == constants.IMPL_OPENMPI:
+        container.env += deep_copy(OMPI_ENV)
+        container.env.append(EnvVar(OPENMPI_SLOTS_ENV, slots))
+    elif impl == constants.IMPL_INTEL:
+        container.env += deep_copy(INTEL_ENV)
+        container.env.append(EnvVar(INTEL_MPI_SLOTS_ENV, slots))
+    elif impl == constants.IMPL_MPICH:
+        container.env += deep_copy(MPICH_ENV)
+    elif impl == constants.IMPL_JAX:
+        # Launcher is process 0 when it runs as a worker; otherwise it is a
+        # pure driver that still receives the coordinator address for
+        # monitoring (but no process id).
+        if run_launcher_as_worker(job):
+            container.env += jax_env(job, 0, cluster_domain="")
+        else:
+            port = constants.DEFAULT_JAX_COORDINATOR_PORT
+            container.env.append(EnvVar(
+                constants.JAX_COORDINATOR_ADDRESS_ENV,
+                f"{coordinator_host(job, '')}:{port}"))
+            container.env.append(EnvVar(constants.JAX_NUM_PROCESSES_ENV,
+                                        str(num_processes(job))))
+
+    if not run_launcher_as_worker(job):
+        # Accelerator hygiene (:1629-1635): no GPUs, and for JAX pin the
+        # launcher to CPU so it cannot claim the TPU chips.
+        container.env += deep_copy(NVIDIA_DISABLE_ENV)
+        if is_jax(job):
+            container.env += deep_copy(JAX_LAUNCHER_CPU_ENV)
+
+    if uses_ssh(job):
+        setup_ssh_on_pod(template.spec, job)
+
+    if template.spec.restart_policy and recorder is not None:
+        recorder.event(job, core.EVENT_TYPE_WARNING,
+                       "SetPodTemplateRestartPolicy",
+                       "Restart policy in pod template overridden by restart"
+                       " policy in replica spec")
+    set_restart_policy(template, job.launcher_spec)
+
+    # hostfile + discover_hosts.sh volume (:1647-1662) — all impls get it;
+    # for JAX it is debugging/elastic-tooling surface.
+    template.spec.volumes = list(template.spec.volumes) + [Volume(
+        name=CONFIG_VOLUME_NAME,
+        config_map=ConfigMapVolumeSource(
+            name=job.metadata.name + CONFIG_SUFFIX,
+            items=deep_copy(CONFIG_VOLUME_ITEMS)))]
+    container.volume_mounts.append(VolumeMount(
+        name=CONFIG_VOLUME_NAME, mount_path=CONFIG_MOUNT_PATH))
+
+    return PodTemplateSpec(
+        metadata=ObjectMeta(labels=template.metadata.labels,
+                            annotations=dict(template.metadata.annotations),
+                            owner_references=[_owner_ref(job)]),
+        spec=template.spec)
+
+
+def sync_launcher_scheduling_directives(launcher: batch.Job,
+                                        desired: PodTemplateSpec) -> None:
+    """syncLauncherSchedulingDirectives (:1685-1692): Kueue (KEP-2926)
+    mutable scheduling directives."""
+    launcher.spec.template.metadata.labels = {
+        **launcher.spec.template.metadata.labels, **desired.metadata.labels}
+    launcher.spec.template.metadata.annotations = {
+        **launcher.spec.template.metadata.annotations,
+        **desired.metadata.annotations}
+    launcher.spec.template.spec.node_selector = desired.spec.node_selector
+    launcher.spec.template.spec.tolerations = desired.spec.tolerations
+    launcher.spec.template.spec.scheduling_gates = desired.spec.scheduling_gates

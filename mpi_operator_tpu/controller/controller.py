@@ -1,0 +1,670 @@
+"""MPIJobController — the level-triggered reconcile loop.
+
+Re-architecture of /root/reference/pkg/controller/mpi_job_controller.go
+(:223-1325): workqueue-driven sync of one MPIJob into a headless Service,
+ConfigMap (hostfile + discover_hosts.sh), SSH Secret (MPI impls), N worker
+Pods, one launcher Job and an optional PodGroup, plus the status/condition
+engine, suspend/resume and cleanup.  The controller only ever writes API
+objects — pods bootstrap their own process group from injected env (JAX
+coordination service over ICI/DCN, or mpirun/SSH for MPI parity), exactly
+like the reference never touches the data plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..api import constants
+from ..api.defaults import set_defaults_mpijob
+from ..api.types import MPIJob, worker_replicas
+from ..api.validation import validate_mpijob
+from ..k8s import batch, core
+from ..k8s.apiserver import Clientset, is_not_found
+from ..k8s.informers import InformerFactory
+from ..k8s.meta import Clock, deep_copy, get_controller_of
+from ..k8s.selectors import match_label_selector
+from ..k8s.workqueue import RateLimitingQueue
+from . import builders, status as status_pkg
+from .events import Recorder
+from .metrics import new_operator_metrics
+from .status import (MPI_JOB_EVICT_REASON, MPI_JOB_FAILED_REASON,
+                     MPI_JOB_RESUMED_REASON, MPI_JOB_RUNNING_REASON,
+                     MPI_JOB_SUCCEEDED_REASON, MPI_JOB_SUSPENDED_REASON,
+                     MPI_JOB_CREATED_REASON, get_condition,
+                     initialize_replica_statuses, is_finished,
+                     update_job_conditions)
+
+logger = logging.getLogger("mpi_operator_tpu.controller")
+
+# Event reasons (mpi_job_controller.go:60-116)
+ERR_RESOURCE_EXISTS = "ErrResourceExists"
+MESSAGE_RESOURCE_EXISTS = ('Resource "%s" of Kind "%s" already exists and is'
+                           ' not managed by MPIJob')
+VALIDATION_ERROR = "ValidationError"
+EVENT_MESSAGE_LIMIT = 1024
+
+JOB_BACKOFF_LIMIT_EXCEEDED_REASON = "BackoffLimitExceeded"
+
+
+def truncate_message(message: str) -> str:
+    """truncateMessage (:1830-1837)."""
+    if len(message) <= EVENT_MESSAGE_LIMIT:
+        return message
+    return message[:EVENT_MESSAGE_LIMIT - 3] + "..."
+
+
+def managed_by_external_controller(managed_by: Optional[str]) -> Optional[str]:
+    """managedByExternalController (:1839-1844)."""
+    if managed_by is not None and managed_by != constants.KUBEFLOW_JOB_CONTROLLER:
+        return managed_by
+    return None
+
+
+def is_clean_up_pods(clean_pod_policy: Optional[str]) -> bool:
+    """isCleanUpPods (:1765-1770)."""
+    return clean_pod_policy in (constants.CLEAN_POD_POLICY_ALL,
+                                constants.CLEAN_POD_POLICY_RUNNING)
+
+
+def is_controlled_by(obj, job: MPIJob) -> bool:
+    ref = get_controller_of(obj)
+    return ref is not None and ref.uid == job.metadata.uid
+
+
+class MPIJobController:
+    """NewMPIJobController equivalent (:268-462)."""
+
+    def __init__(self, clientset: Clientset,
+                 informer_factory: Optional[InformerFactory] = None,
+                 pod_group_ctrl=None,
+                 recorder=None,
+                 clock: Optional[Clock] = None,
+                 cluster_domain: str = "",
+                 namespace: Optional[str] = None,
+                 metrics: Optional[dict] = None):
+        self.client = clientset
+        self.clock = clock or Clock()
+        self.cluster_domain = cluster_domain
+        self.namespace = namespace
+        self.pod_group_ctrl = pod_group_ctrl
+        self.recorder = recorder or Recorder(clientset)
+        self.metrics = metrics or new_operator_metrics()
+
+        factory = informer_factory or InformerFactory(clientset, namespace)
+        self.factory = factory
+        self.mpi_job_informer = factory.mpi_jobs()
+        self.pod_informer = factory.pods()
+        self.service_informer = factory.services()
+        self.config_map_informer = factory.config_maps()
+        self.secret_informer = factory.secrets()
+        self.job_informer = factory.jobs()
+        if pod_group_ctrl is not None:
+            self.pod_group_informer = pod_group_ctrl.informer(factory)
+        else:
+            self.pod_group_informer = None
+
+        self.queue = RateLimitingQueue()
+        self._workers: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+        # Event handlers (:392-457): MPIJob changes enqueue directly; owned
+        # objects route through handle_object.
+        self.mpi_job_informer.add_event_handler(
+            on_add=self._add_mpi_job,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=lambda obj: None)
+        for informer in (self.pod_informer, self.service_informer,
+                         self.config_map_informer, self.secret_informer,
+                         self.job_informer):
+            informer.add_event_handler(
+                on_add=self.handle_object,
+                on_update=self._handle_object_update,
+                on_delete=self.handle_object)
+        if self.pod_group_informer is not None:
+            self.pod_group_informer.add_event_handler(
+                on_add=self.handle_object,
+                on_update=self._handle_object_update,
+                on_delete=self.handle_object)
+
+    # ------------------------------------------------------------------
+    # Queue plumbing
+    # ------------------------------------------------------------------
+    def _add_mpi_job(self, obj) -> None:
+        """addMPIJob (:1236-1242)."""
+        self.enqueue(obj)
+
+    def enqueue(self, job) -> None:
+        """enqueueMPIJob (:1247-1255)."""
+        self.queue.add_rate_limited(
+            f"{job.metadata.namespace}/{job.metadata.name}")
+
+    def handle_object(self, obj) -> None:
+        """handleObject (:1262-1312): find the owning MPIJob and enqueue
+        it; pods owned by a (launcher) Job hop one level up."""
+        ref = get_controller_of(obj)
+        if ref is None:
+            return
+        if ref.api_version == "batch/v1" and ref.kind == "Job":
+            job_obj = self.job_informer.lister.get(obj.metadata.namespace,
+                                                   ref.name)
+            if job_obj is None:
+                return
+            ref = get_controller_of(job_obj)
+            if ref is None:
+                return
+        if (ref.kind != constants.KIND
+                or ref.api_version != constants.GROUP_VERSION):
+            return
+        mpi_job = self.mpi_job_informer.lister.get(obj.metadata.namespace,
+                                                   ref.name)
+        if mpi_job is None:
+            return
+        self.enqueue(mpi_job)
+
+    def _handle_object_update(self, old, new) -> None:
+        """handleObjectUpdate (:1314-1324): skip resync no-ops."""
+        if (old is not None and new.metadata.resource_version
+                == old.metadata.resource_version):
+            return
+        self.handle_object(new)
+
+    # ------------------------------------------------------------------
+    # Run loop
+    # ------------------------------------------------------------------
+    def run(self, threadiness: int = 2) -> None:
+        """Run (:465-503): start informers, wait for sync, spawn workers."""
+        self.factory.start_all()
+        if not self.factory.wait_for_cache_sync():
+            raise RuntimeError("failed to wait for caches to sync")
+        for i in range(threadiness):
+            t = threading.Thread(target=self._run_worker, daemon=True,
+                                 name=f"mpijob-worker-{i}")
+            t.start()
+            self._workers.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        for t in self._workers:
+            t.join(timeout=2)
+        self.factory.stop_all()
+
+    def _run_worker(self) -> None:
+        """runWorker/processNextWorkItem (:505-561)."""
+        while not self._stop.is_set():
+            key, shutdown = self.queue.get(timeout=0.2)
+            if shutdown:
+                return
+            if key is None:
+                continue
+            try:
+                self.sync_handler(key)
+                self.queue.forget(key)
+            except Exception as exc:  # requeue with backoff
+                logger.warning("error syncing %s: %s", key, exc)
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)
+
+    # ------------------------------------------------------------------
+    # The sync
+    # ------------------------------------------------------------------
+    def sync_handler(self, key: str) -> None:
+        """syncHandler (:567-741)."""
+        namespace, _, name = key.partition("/")
+        shared = self.mpi_job_informer.lister.get(namespace, name)
+        if shared is None:
+            logger.debug("MPIJob has been deleted: %s", key)
+            return
+        # NEVER modify informer cache objects (:591-594).
+        mpi_job = deep_copy(shared)
+        set_defaults_mpijob(mpi_job)
+
+        manager = managed_by_external_controller(
+            mpi_job.spec.run_policy.managed_by)
+        if manager is not None:
+            logger.debug("Skipping MPIJob managed by %s", manager)
+            return
+
+        if mpi_job.metadata.deletion_timestamp is not None:
+            return
+
+        errs = validate_mpijob(mpi_job)
+        if errs:
+            msg = truncate_message(
+                "Found validation errors: " + "; ".join(map(str, errs)))
+            self.recorder.event(mpi_job, core.EVENT_TYPE_WARNING,
+                                VALIDATION_ERROR, msg)
+            return  # do not requeue
+
+        if not mpi_job.status.conditions:
+            msg = (f"MPIJob {namespace}/{name} is created.")
+            update_job_conditions(mpi_job, constants.JOB_CREATED,
+                                  core.CONDITION_TRUE,
+                                  MPI_JOB_CREATED_REASON, msg, self.clock)
+            self.recorder.event(mpi_job, core.EVENT_TYPE_NORMAL,
+                                "MPIJobCreated", msg)
+            self.metrics["jobs_created"].inc()
+
+        # Terminal + CompletionTime set -> clean up per policy (:625-633).
+        if is_finished(mpi_job.status) and mpi_job.status.completion_time is not None:
+            if is_clean_up_pods(mpi_job.spec.run_policy.clean_pod_policy):
+                self._clean_up_worker_pods(mpi_job)
+                self._update_status(mpi_job)
+            return
+
+        if mpi_job.status.start_time is None and not self._suspended(mpi_job):
+            mpi_job.status.start_time = self.clock.now()
+
+        launcher = self._get_launcher_job(mpi_job)
+
+        workers: list = []
+        done = launcher is not None and batch.is_job_finished(launcher)
+        if not done:
+            self._get_or_create_service(mpi_job, builders.new_job_service(mpi_job))
+            config = self._get_or_create_config_map(mpi_job)
+            if config is None:
+                raise RuntimeError("getting or creating ConfigMap")
+            if builders.uses_ssh(mpi_job):
+                self._get_or_create_ssh_auth_secret(mpi_job)
+
+            if not self._suspended(mpi_job):
+                if self.pod_group_ctrl is not None:
+                    if self._get_or_create_pod_group(mpi_job) is None:
+                        raise RuntimeError("getting or creating PodGroup")
+                workers = self._get_or_create_workers(mpi_job)
+            if launcher is None:
+                at_startup = (mpi_job.spec.launcher_creation_policy
+                              == constants.LAUNCHER_CREATION_AT_STARTUP)
+                if at_startup or self._count_ready_workers(workers) == len(workers):
+                    try:
+                        launcher = self.client.jobs(namespace).create(
+                            builders.new_launcher_job(
+                                mpi_job, self.pod_group_ctrl, self.recorder))
+                    except Exception as exc:
+                        self.recorder.eventf(
+                            mpi_job, core.EVENT_TYPE_WARNING,
+                            MPI_JOB_FAILED_REASON,
+                            "launcher pod created failed: %s", exc)
+                        raise
+                else:
+                    logger.debug("Waiting for workers %s to start.", key)
+
+        # Suspend/resume alignment of the launcher Job (:690-724).
+        if launcher is not None:
+            if not self._suspended(mpi_job) and bool(launcher.spec.suspend):
+                launcher_copy = deep_copy(launcher)
+                # Clear StartTime via the status subresource first: a Job
+                # template is immutable once StartTime is set (:693-703).
+                if launcher_copy.status.start_time is not None:
+                    launcher_copy.status.start_time = None
+                    launcher_copy = self.client.jobs(namespace).update_status(
+                        launcher_copy)
+                desired = builders.new_launcher_pod_template(
+                    mpi_job, self.pod_group_ctrl, self.recorder)
+                builders.sync_launcher_scheduling_directives(launcher_copy,
+                                                             desired)
+                launcher_copy.spec.suspend = False
+                launcher = self.client.jobs(namespace).update(launcher_copy)
+            elif self._suspended(mpi_job) and not bool(launcher.spec.suspend):
+                launcher_copy = deep_copy(launcher)
+                launcher_copy.spec.suspend = True
+                launcher = self.client.jobs(namespace).update(launcher_copy)
+
+        if self._suspended(mpi_job):
+            self._clean_up_worker_pods(mpi_job)
+
+        self._update_mpi_job_status(mpi_job, launcher, workers)
+
+    # ------------------------------------------------------------------
+    # get-or-create helpers
+    # ------------------------------------------------------------------
+    def _suspended(self, job: MPIJob) -> bool:
+        return bool(job.spec.run_policy.suspend)
+
+    def _resource_exists_error(self, job: MPIJob, name: str, kind: str):
+        msg = MESSAGE_RESOURCE_EXISTS % (name, kind)
+        self.recorder.event(job, core.EVENT_TYPE_WARNING,
+                            ERR_RESOURCE_EXISTS, msg)
+        return RuntimeError(msg)
+
+    def _get_launcher_job(self, job: MPIJob):
+        """getLauncherJob (:758-779)."""
+        launcher = self.job_informer.lister.get(
+            job.metadata.namespace, builders.launcher_name(job))
+        if launcher is None:
+            return None
+        if not is_controlled_by(launcher, job):
+            raise self._resource_exists_error(job, launcher.metadata.name,
+                                              "Job")
+        return launcher
+
+    def _get_or_create_service(self, job: MPIJob, new_svc):
+        """getOrCreateService (:913-936)."""
+        svc = self.service_informer.lister.get(job.metadata.namespace,
+                                               new_svc.metadata.name)
+        if svc is None:
+            return self.client.services(job.metadata.namespace).create(new_svc)
+        if not is_controlled_by(svc, job):
+            raise self._resource_exists_error(job, svc.metadata.name,
+                                              "Service")
+        if (svc.spec.selector != new_svc.spec.selector
+                or svc.spec.publish_not_ready_addresses
+                != new_svc.spec.publish_not_ready_addresses):
+            svc = deep_copy(svc)
+            svc.spec.selector = new_svc.spec.selector
+            svc.spec.publish_not_ready_addresses = \
+                new_svc.spec.publish_not_ready_addresses
+            return self.client.services(job.metadata.namespace).update(svc)
+        return svc
+
+    def _get_running_worker_pods(self, job: MPIJob) -> list:
+        """getRunningWorkerPods (:840-858)."""
+        pods = self.pod_informer.lister.list(
+            job.metadata.namespace,
+            builders.worker_selector(job.metadata.name))
+        return [p for p in pods if p.status.phase == core.POD_RUNNING]
+
+    def _get_or_create_config_map(self, job: MPIJob):
+        """getOrCreateConfigMap (:875-911)."""
+        new_cm = builders.new_config_map(job, worker_replicas(job),
+                                         self.cluster_domain)
+        running = self._get_running_worker_pods(job)
+        builders.update_discover_hosts_in_config_map(new_cm, job, running,
+                                                     self.cluster_domain)
+        cm = self.config_map_informer.lister.get(
+            job.metadata.namespace, job.metadata.name + builders.CONFIG_SUFFIX)
+        if cm is None:
+            return self.client.config_maps(job.metadata.namespace).create(new_cm)
+        if not is_controlled_by(cm, job):
+            raise self._resource_exists_error(job, cm.metadata.name,
+                                              "ConfigMap")
+        if cm.data != new_cm.data:
+            cm = deep_copy(cm)
+            cm.data = new_cm.data
+            return self.client.config_maps(job.metadata.namespace).update(cm)
+        return cm
+
+    def _get_or_create_ssh_auth_secret(self, job: MPIJob):
+        """getOrCreateSSHAuthSecret (:940-969): recreate only when the key
+        *names* drift (key material is preserved across syncs)."""
+        secret = self.secret_informer.lister.get(
+            job.metadata.namespace,
+            job.metadata.name + builders.SSH_AUTH_SECRET_SUFFIX)
+        if secret is None:
+            return self.client.secrets(job.metadata.namespace).create(
+                builders.new_ssh_auth_secret(job))
+        if not is_controlled_by(secret, job):
+            raise self._resource_exists_error(job, secret.metadata.name,
+                                              "Secret")
+        new_secret = builders.new_ssh_auth_secret(job)
+        if sorted(secret.data.keys()) != sorted(new_secret.data.keys()):
+            secret = deep_copy(secret)
+            secret.data = new_secret.data
+            return self.client.secrets(job.metadata.namespace).update(secret)
+        return secret
+
+    def _get_or_create_pod_group(self, job: MPIJob):
+        """getOrCreatePodGroups (:782-807)."""
+        ctrl = self.pod_group_ctrl
+        new_pg = ctrl.new_pod_group(job)
+        pg = ctrl.get_pod_group(job.metadata.namespace, new_pg.metadata.name)
+        if pg is None:
+            return ctrl.create_pod_group(new_pg)
+        if not is_controlled_by(pg, job):
+            raise self._resource_exists_error(job, pg.metadata.name,
+                                              "PodGroup")
+        if not ctrl.pg_specs_equal(pg, new_pg):
+            return ctrl.update_pod_group(pg, new_pg)
+        return pg
+
+    def _delete_pod_group(self, job: MPIJob) -> None:
+        """deletePodGroups (:810-837)."""
+        ctrl = self.pod_group_ctrl
+        pg = ctrl.get_pod_group(job.metadata.namespace, job.metadata.name)
+        if pg is None:
+            return
+        if not is_controlled_by(pg, job):
+            raise self._resource_exists_error(job, pg.metadata.name,
+                                              "PodGroup")
+        ctrl.delete_pod_group(job.metadata.namespace, job.metadata.name)
+
+    def _get_or_create_workers(self, job: MPIJob) -> list:
+        """getOrCreateWorker (:982-1042)."""
+        workers: list = []
+        spec = job.worker_spec
+        if spec is None:
+            return workers
+        replicas = spec.replicas or 0
+
+        # Scale-down: remove pods whose index >= replicas (:998-1014).
+        pods = self.pod_informer.lister.list(
+            job.metadata.namespace, builders.worker_selector(job.metadata.name))
+        if len(pods) > replicas:
+            for pod in pods:
+                index_str = pod.metadata.labels.get(constants.REPLICA_INDEX_LABEL)
+                if index_str is None:
+                    continue
+                try:
+                    index = int(index_str)
+                except ValueError:
+                    continue
+                if index >= replicas:
+                    self.client.pods(pod.metadata.namespace).delete(
+                        pod.metadata.name)
+
+        for i in range(replicas):
+            pod = self.pod_informer.lister.get(job.metadata.namespace,
+                                               builders.worker_name(job, i))
+            if pod is None:
+                try:
+                    pod = self.client.pods(job.metadata.namespace).create(
+                        builders.new_worker(job, i, self.pod_group_ctrl))
+                except Exception as exc:
+                    self.recorder.eventf(job, core.EVENT_TYPE_WARNING,
+                                         MPI_JOB_FAILED_REASON,
+                                         "worker pod created failed: %s", exc)
+                    raise
+            if not is_controlled_by(pod, job):
+                raise self._resource_exists_error(job, pod.metadata.name,
+                                                  "Pod")
+            workers.append(pod)
+        return workers
+
+    def _count_ready_workers(self, workers: list) -> int:
+        """countReadyWorkerPods (:860-871)."""
+        return sum(1 for p in workers
+                   if any(c.type == "Ready" and c.status == core.CONDITION_TRUE
+                          for c in p.status.conditions))
+
+    def _delete_worker_pods(self, job: MPIJob) -> None:
+        """deleteWorkerPods (:1052-1092)."""
+        spec = job.worker_spec
+        if spec is None:
+            return
+        for i in range(spec.replicas or 0):
+            name = builders.worker_name(job, i)
+            pod = self.pod_informer.lister.get(job.metadata.namespace, name)
+            if pod is None:
+                continue
+            if not is_controlled_by(pod, job):
+                raise self._resource_exists_error(job, pod.metadata.name,
+                                                  "Pod")
+            # CleanPodPolicyRunning keeps terminated pods (:1077-1084).
+            if (job.spec.run_policy.clean_pod_policy
+                    == constants.CLEAN_POD_POLICY_RUNNING
+                    and pod.status.phase not in (core.POD_RUNNING,
+                                                 core.POD_PENDING)):
+                continue
+            try:
+                self.client.pods(job.metadata.namespace).delete(name)
+            except Exception as exc:
+                if not is_not_found(exc):
+                    raise
+
+    def _clean_up_worker_pods(self, job: MPIJob) -> None:
+        """cleanUpWorkerPods (:743-755)."""
+        self._delete_worker_pods(job)
+        initialize_replica_statuses(job, constants.REPLICA_TYPE_WORKER)
+        if self.pod_group_ctrl is not None:
+            self._delete_pod_group(job)
+        job.status.replica_statuses[constants.REPLICA_TYPE_WORKER].active = 0
+
+    # ------------------------------------------------------------------
+    # Status
+    # ------------------------------------------------------------------
+    def _launcher_pods(self, launcher) -> list:
+        """jobPods (:1694-1710)."""
+        pods = self.pod_informer.lister.list(launcher.metadata.namespace)
+        selector = launcher.spec.selector
+        out = []
+        for p in pods:
+            ref = get_controller_of(p)
+            if ref is not None and ref.uid == launcher.metadata.uid:
+                out.append(p)
+            elif selector is not None and match_label_selector(
+                    selector, p.metadata.labels) and ref is None:
+                out.append(p)
+        return out
+
+    def _update_mpi_job_status(self, job: MPIJob, launcher, workers: list) -> None:
+        """updateMPIJobStatus (:1094-1200)."""
+        old_status = deep_copy(job.status)
+
+        if self._suspended(job):
+            if update_job_conditions(job, constants.JOB_SUSPENDED,
+                                     core.CONDITION_TRUE,
+                                     MPI_JOB_SUSPENDED_REASON,
+                                     "MPIJob suspended", self.clock):
+                self.recorder.event(job, core.EVENT_TYPE_NORMAL,
+                                    "MPIJobSuspended", "MPIJob suspended")
+        elif get_condition(job.status, constants.JOB_SUSPENDED) is not None:
+            if update_job_conditions(job, constants.JOB_SUSPENDED,
+                                     core.CONDITION_FALSE,
+                                     MPI_JOB_RESUMED_REASON,
+                                     "MPIJob resumed", self.clock):
+                self.recorder.event(job, core.EVENT_TYPE_NORMAL,
+                                    "MPIJobResumed", "MPIJob resumed")
+                job.status.start_time = self.clock.now()
+
+        launcher_pods_cnt = 0
+        if launcher is not None:
+            launcher_pods = self._launcher_pods(launcher)
+            launcher_pods_cnt = sum(
+                1 for p in launcher_pods if p.status.phase == core.POD_RUNNING)
+            initialize_replica_statuses(job, constants.REPLICA_TYPE_LAUNCHER)
+            launcher_status = job.status.replica_statuses[
+                constants.REPLICA_TYPE_LAUNCHER]
+            launcher_status.failed = launcher.status.failed
+            if batch.is_job_succeeded(launcher):
+                launcher_status.succeeded = 1
+                msg = (f"MPIJob {job.metadata.namespace}/"
+                       f"{job.metadata.name} successfully completed.")
+                self.recorder.event(job, core.EVENT_TYPE_NORMAL,
+                                    MPI_JOB_SUCCEEDED_REASON, msg)
+                if job.status.completion_time is None:
+                    job.status.completion_time = launcher.status.completion_time
+                update_job_conditions(job, constants.JOB_SUCCEEDED,
+                                      core.CONDITION_TRUE,
+                                      MPI_JOB_SUCCEEDED_REASON, msg,
+                                      self.clock)
+                self.metrics["jobs_successful"].inc()
+            elif batch.job_condition_status(launcher, batch.JOB_FAILED) \
+                    == core.CONDITION_TRUE:
+                self._update_failed_status(job, launcher, launcher_pods)
+            else:
+                launcher_status.active = launcher_pods_cnt
+            self.metrics["job_info"].with_label_values(
+                launcher.metadata.name, job.metadata.namespace).set(1)
+
+        running = 0
+        evict = 0
+        initialize_replica_statuses(job, constants.REPLICA_TYPE_WORKER)
+        worker_status = job.status.replica_statuses[constants.REPLICA_TYPE_WORKER]
+        for pod in workers:
+            if pod.status.phase == core.POD_FAILED:
+                worker_status.failed += 1
+                if pod.status.reason == "Evicted":
+                    evict += 1
+            elif pod.status.phase == core.POD_SUCCEEDED:
+                worker_status.succeeded += 1
+            elif pod.status.phase == core.POD_RUNNING:
+                running += 1
+                worker_status.active += 1
+        if evict > 0:
+            msg = f"{evict}/{len(workers)} workers are evicted"
+            update_job_conditions(job, constants.JOB_FAILED,
+                                  core.CONDITION_TRUE, MPI_JOB_EVICT_REASON,
+                                  msg, self.clock)
+            self.recorder.event(job, core.EVENT_TYPE_WARNING,
+                                MPI_JOB_EVICT_REASON, msg)
+
+        if self._suspended(job):
+            msg = (f"MPIJob {job.metadata.namespace}/{job.metadata.name}"
+                   f" is suspended.")
+            update_job_conditions(job, constants.JOB_RUNNING,
+                                  core.CONDITION_FALSE,
+                                  MPI_JOB_SUSPENDED_REASON, msg, self.clock)
+        elif is_finished(job.status):
+            # Terminal: never re-emit Running=True (:1169-1188); backfill
+            # Running=False at completionTime if it was never observed.
+            if get_condition(job.status, constants.JOB_RUNNING) is None:
+                msg = (f"MPIJob {job.metadata.namespace}/{job.metadata.name}"
+                       f" is finished but Running condition was never set.")
+                from ..api.types import JobCondition
+                when = job.status.completion_time or self.clock.now()
+                job.status.conditions.append(JobCondition(
+                    type=constants.JOB_RUNNING, status=core.CONDITION_FALSE,
+                    reason=MPI_JOB_RUNNING_REASON, message=msg,
+                    last_update_time=when, last_transition_time=when))
+        elif launcher is not None and launcher_pods_cnt >= 1 \
+                and running == len(workers):
+            msg = (f"MPIJob {job.metadata.namespace}/{job.metadata.name}"
+                   f" is running.")
+            update_job_conditions(job, constants.JOB_RUNNING,
+                                  core.CONDITION_TRUE,
+                                  MPI_JOB_RUNNING_REASON, msg, self.clock)
+            self.recorder.eventf(job, core.EVENT_TYPE_NORMAL, "MPIJobRunning",
+                                 "MPIJob %s/%s is running",
+                                 job.metadata.namespace, job.metadata.name)
+
+        if old_status != job.status:
+            self._update_status(job)
+
+    def _update_failed_status(self, job: MPIJob, launcher, launcher_pods) -> None:
+        """updateMPIJobFailedStatus (:1202-1233)."""
+        failed_cond = None
+        for c in launcher.status.conditions:
+            if c.type == batch.JOB_FAILED:
+                failed_cond = c
+                break
+        reason = (failed_cond.reason if failed_cond else "") or MPI_JOB_FAILED_REASON
+        msg = (failed_cond.message if failed_cond else "") or (
+            f"MPIJob {job.metadata.namespace}/{job.metadata.name} has failed")
+        if reason == JOB_BACKOFF_LIMIT_EXCEEDED_REASON:
+            failed_pods = [p for p in launcher_pods
+                           if p.status.phase == core.POD_FAILED]
+            last = None
+            for p in failed_pods:
+                if last is None or (last.metadata.creation_timestamp
+                                    and p.metadata.creation_timestamp
+                                    and last.metadata.creation_timestamp
+                                    < p.metadata.creation_timestamp):
+                    last = p
+            if last is not None:
+                reason += "/" + last.status.reason
+                msg += ": " + last.status.message
+                msg = truncate_message(msg)
+        self.recorder.event(job, core.EVENT_TYPE_WARNING, reason, msg)
+        if job.status.completion_time is None:
+            job.status.completion_time = self.clock.now()
+        update_job_conditions(job, constants.JOB_FAILED, core.CONDITION_TRUE,
+                              reason, msg, self.clock)
+        self.metrics["jobs_failed"].inc()
+
+    def _update_status(self, job: MPIJob) -> None:
+        """doUpdateJobStatus (:1327-1330)."""
+        job.status.last_reconcile_time = self.clock.now()
+        self.client.mpi_jobs(job.metadata.namespace).update_status(job)

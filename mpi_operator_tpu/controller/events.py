@@ -1,0 +1,54 @@
+"""Event recorder — the user-facing audit trail.
+
+Equivalent of client-go tools/record as used by the reference
+(recorder creation at mpi_job_controller.go:303-308; FakeRecorder in the
+unit fixture).  Events land in the API server as v1 Event objects.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+
+from ..k8s.apiserver import Clientset
+from ..k8s.core import Event, ObjectReference
+from ..k8s.meta import ObjectMeta
+
+
+class Recorder:
+    def __init__(self, clientset: Clientset, component: str = "mpi-job-controller"):
+        self._cs = clientset
+        self.component = component
+
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        ev = Event(
+            metadata=ObjectMeta(
+                name=f"{obj.metadata.name}.{uuid.uuid4().hex[:10]}",
+                namespace=obj.metadata.namespace or "default"),
+            involved_object=ObjectReference(
+                api_version=obj.api_version, kind=obj.kind,
+                name=obj.metadata.name, namespace=obj.metadata.namespace,
+                uid=obj.metadata.uid),
+            type=event_type, reason=reason, message=message)
+        try:
+            self._cs.events(ev.metadata.namespace).create(ev)
+        except Exception:
+            pass  # events are best-effort, like the real recorder
+
+    def eventf(self, obj, event_type: str, reason: str, fmt: str, *args) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)
+
+
+class FakeRecorder:
+    """Captures events for assertions (record.NewFakeRecorder analogue)."""
+
+    def __init__(self):
+        self.events: list[str] = []
+        self._lock = threading.Lock()
+
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        with self._lock:
+            self.events.append(f"{event_type} {reason} {message}")
+
+    def eventf(self, obj, event_type: str, reason: str, fmt: str, *args) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)

@@ -1,0 +1,117 @@
+"""Prometheus-style metrics registry (no external deps).
+
+Parity with the reference's metric surface
+(mpi_job_controller.go:125-141, cmd/mpi-operator/main.go:29-40,
+README.md:227-234): jobs created/successful/failed counters,
+mpi_operator_job_info gauge vector, mpi_operator_is_leader gauge, served
+in Prometheus text exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str, registry: "Registry"):
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+        registry._register(self)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {self._value}\n")
+
+
+class Gauge(Counter):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def expose(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {self._value}\n")
+
+
+class GaugeVec:
+    def __init__(self, name: str, help_text: str, label_names: list,
+                 registry: "Registry"):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._values: dict = {}
+        self._lock = threading.Lock()
+        registry._register(self)
+
+    def with_label_values(self, *values) -> "GaugeVec._Child":
+        return GaugeVec._Child(self, tuple(values))
+
+    class _Child:
+        def __init__(self, parent, key):
+            self._parent = parent
+            self._key = key
+
+        def set(self, value: float) -> None:
+            with self._parent._lock:
+                self._parent._values[self._key] = value
+
+    def get(self, *values) -> float:
+        with self._lock:
+            return self._values.get(tuple(values), 0.0)
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, val in sorted(self._values.items()):
+                labels = ",".join(f'{n}="{v}"'
+                                  for n, v in zip(self.label_names, key))
+                lines.append(f"{self.name}{{{labels}}} {val}")
+        return "\n".join(lines) + "\n"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+
+    def _register(self, metric) -> None:
+        self._metrics.append(metric)
+
+    def expose(self) -> str:
+        return "".join(m.expose() for m in self._metrics)
+
+
+def new_operator_metrics(registry: Registry | None = None):
+    """The reference's metric set (mpi_job_controller.go:125-141 +
+    main.go:29-40)."""
+    registry = registry or Registry()
+    metrics = {
+        "registry": registry,
+        "jobs_created": Counter("mpi_operator_jobs_created_total",
+                                "Counts number of MPI jobs created", registry),
+        "jobs_successful": Counter("mpi_operator_jobs_successful_total",
+                                   "Counts number of MPI jobs successful",
+                                   registry),
+        "jobs_failed": Counter("mpi_operator_jobs_failed_total",
+                               "Counts number of MPI jobs failed", registry),
+        "job_info": GaugeVec("mpi_operator_job_info",
+                             "Information about MPIJob",
+                             ["launcher", "namespace"], registry),
+        "is_leader": Gauge("mpi_operator_is_leader",
+                           "Is this client the leader of this mpi-operator"
+                           " client set?", registry),
+    }
+    return metrics

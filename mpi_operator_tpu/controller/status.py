@@ -1,0 +1,104 @@
+"""Job condition state machine.
+
+Parity with /root/reference/pkg/controller/mpi_job_controller_status.go:
+Created -> Running -> {Succeeded, Failed}, plus Suspended and Restarting;
+transition-time preservation on unchanged status; Running/Restarting
+mutual exclusion; Running forced False on terminal conditions
+(filterOutCondition, :122-144).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import constants
+from ..api.types import JobCondition, JobStatus, MPIJob, ReplicaStatus
+from ..k8s.core import CONDITION_FALSE, CONDITION_TRUE
+from ..k8s.meta import Clock
+
+# Event/condition reasons (mpi_job_controller_status.go:24-39)
+MPI_JOB_CREATED_REASON = "MPIJobCreated"
+MPI_JOB_SUCCEEDED_REASON = "MPIJobSucceeded"
+MPI_JOB_RUNNING_REASON = "MPIJobRunning"
+MPI_JOB_SUSPENDED_REASON = "MPIJobSuspended"
+MPI_JOB_RESUMED_REASON = "MPIJobResumed"
+MPI_JOB_FAILED_REASON = "MPIJobFailed"
+MPI_JOB_EVICT_REASON = "MPIJobEvicted"
+
+
+def initialize_replica_statuses(job: MPIJob, rtype: str) -> None:
+    """initializeMPIJobStatuses (:42-48)."""
+    job.status.replica_statuses[rtype] = ReplicaStatus()
+
+
+def new_condition(ctype: str, status: str, reason: str, message: str,
+                  clock: Clock) -> JobCondition:
+    now = clock.now()
+    return JobCondition(type=ctype, status=status, reason=reason,
+                        message=message, last_update_time=now,
+                        last_transition_time=now)
+
+
+def get_condition(status: JobStatus, ctype: str) -> Optional[JobCondition]:
+    for cond in status.conditions:
+        if cond.type == ctype:
+            return cond
+    return None
+
+
+def has_condition(status: JobStatus, ctype: str) -> bool:
+    return any(c.type == ctype and c.status == CONDITION_TRUE
+               for c in status.conditions)
+
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, constants.JOB_SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, constants.JOB_FAILED)
+
+
+def is_finished(status: JobStatus) -> bool:
+    return is_succeeded(status) or is_failed(status)
+
+
+def update_job_conditions(job: MPIJob, ctype: str, status: str, reason: str,
+                          message: str, clock: Clock) -> bool:
+    """updateMPIJobConditions (:51-54). Returns True if anything changed."""
+    return set_condition(job.status, new_condition(ctype, status, reason,
+                                                   message, clock))
+
+
+def set_condition(status: JobStatus, condition: JobCondition) -> bool:
+    """setCondition (:99-119)."""
+    current = get_condition(status, condition.type)
+    # Do nothing if the condition doesn't change.
+    if (current is not None and current.status == condition.status
+            and current.reason == condition.reason):
+        return False
+    # Preserve lastTransitionTime when only reason/message change.
+    if current is not None and current.status == condition.status:
+        condition.last_transition_time = current.last_transition_time
+    status.conditions = filter_out_condition(status.conditions, condition.type)
+    status.conditions.append(condition)
+    return True
+
+
+def filter_out_condition(conditions: list, ctype: str) -> list:
+    """filterOutCondition (:122-144): drop same-type conditions; Running and
+    Restarting are mutually exclusive; terminal conditions force Running
+    (and stale Failed) to False."""
+    out = []
+    for c in conditions:
+        if ctype == constants.JOB_RESTARTING and c.type == constants.JOB_RUNNING:
+            continue
+        if ctype == constants.JOB_RUNNING and c.type == constants.JOB_RESTARTING:
+            continue
+        if c.type == ctype:
+            continue
+        if (ctype in (constants.JOB_FAILED, constants.JOB_SUCCEEDED)
+                and c.type in (constants.JOB_RUNNING, constants.JOB_FAILED)):
+            c.status = CONDITION_FALSE
+        out.append(c)
+    return out

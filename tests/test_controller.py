@@ -1,0 +1,535 @@
+"""Controller unit tests — the analogue of
+/root/reference/pkg/controller/mpi_job_controller_test.go: a fixture with
+fake clients, hand-loaded informer caches, a fake recorder and a fake
+clock; sync_handler driven directly and resulting objects asserted
+field-by-field."""
+
+import pytest
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.defaults import set_defaults_mpijob
+from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec, ReplicaSpec,
+                                        RunPolicy)
+from mpi_operator_tpu.controller import builders
+from mpi_operator_tpu.controller.controller import MPIJobController
+from mpi_operator_tpu.controller.events import FakeRecorder
+from mpi_operator_tpu.k8s import batch, core
+from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.k8s.core import (Container, Pod, PodCondition, PodSpec,
+                                       PodTemplateSpec)
+from mpi_operator_tpu.k8s.informers import InformerFactory
+from mpi_operator_tpu.k8s.meta import FakeClock, ObjectMeta, deep_copy
+
+
+def new_mpi_job(name="test", workers=2, impl=constants.IMPL_OPENMPI,
+                **spec_kwargs) -> MPIJob:
+    job = MPIJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=MPIJobSpec(
+            mpi_implementation=impl,
+            run_policy=RunPolicy(),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="launcher", image="test-image")]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="worker", image="test-image")]))),
+            },
+            **spec_kwargs))
+    return set_defaults_mpijob(job)
+
+
+class Fixture:
+    """Equivalent of the reference fixture (:70-213): fake clientset,
+    hand-loaded informer caches, fake recorder/clock; no informer threads."""
+
+    def __init__(self, pod_group_ctrl=None):
+        self.clock = FakeClock()
+        self.client = Clientset(clock=self.clock)
+        self.factory = InformerFactory(self.client)
+        self.recorder = FakeRecorder()
+        self.controller = MPIJobController(
+            self.client, informer_factory=self.factory,
+            pod_group_ctrl=pod_group_ctrl, recorder=self.recorder,
+            clock=self.clock)
+
+    def register_job(self, job: MPIJob) -> MPIJob:
+        """Create in the API server and load the informer cache."""
+        created = self.client.mpi_jobs(job.metadata.namespace).create(job)
+        self.factory.mpi_jobs().add_to_cache(created)
+        return created
+
+    def sync(self, job: MPIJob) -> None:
+        self.controller.sync_handler(
+            f"{job.metadata.namespace}/{job.metadata.name}")
+
+    def refresh_caches(self) -> None:
+        """Re-load every informer cache from the API server (simulating
+        watch delivery between syncs)."""
+        for api_version, kind, informer in [
+            ("v1", "Pod", self.factory.pods()),
+            ("v1", "Service", self.factory.services()),
+            ("v1", "ConfigMap", self.factory.config_maps()),
+            ("v1", "Secret", self.factory.secrets()),
+            ("batch/v1", "Job", self.factory.jobs()),
+            ("kubeflow.org/v2beta1", "MPIJob", self.factory.mpi_jobs()),
+        ]:
+            informer._store.clear()
+            for obj in self.client.server.list(api_version, kind):
+                informer.add_to_cache(obj)
+
+    def get_job(self, name="test", ns="default") -> MPIJob:
+        return self.client.mpi_jobs(ns).get(name)
+
+
+# ---------------------------------------------------------------------------
+# Resource creation (TestAllResourcesCreated analogue, ref :572)
+# ---------------------------------------------------------------------------
+
+def test_all_resources_created_openmpi():
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    f.register_job(job)
+    f.sync(job)
+
+    svc = f.client.services("default").get("test")
+    assert svc.spec.cluster_ip == "None"
+    assert svc.spec.selector[constants.JOB_NAME_LABEL] == "test"
+    assert not svc.spec.publish_not_ready_addresses
+
+    cm = f.client.config_maps("default").get("test-config")
+    assert cm.data[builders.HOSTFILE_NAME] == (
+        "test-worker-0.test.default.svc slots=1\n"
+        "test-worker-1.test.default.svc slots=1\n")
+    assert cm.data[builders.DISCOVER_HOSTS_SCRIPT_NAME] == "#!/bin/sh\n"
+
+    secret = f.client.secrets("default").get("test-ssh")
+    assert secret.type == core.SECRET_TYPE_SSH_AUTH
+    assert core.SSH_AUTH_PRIVATE_KEY in secret.data
+    assert builders.SSH_PUBLIC_KEY in secret.data
+    assert secret.data[builders.SSH_PUBLIC_KEY].startswith(b"ecdsa-sha2-nistp521 ")
+
+    for i in range(2):
+        pod = f.client.pods("default").get(f"test-worker-{i}")
+        assert pod.metadata.labels[constants.REPLICA_INDEX_LABEL] == str(i)
+        assert pod.spec.hostname == f"test-worker-{i}"
+        assert pod.spec.subdomain == "test"
+        assert pod.spec.containers[0].command == ["/usr/sbin/sshd", "-De"]
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    assert launcher.spec.pod_replacement_policy == batch.POD_REPLACEMENT_POLICY_FAILED
+    env = {e.name: e.value for e in launcher.spec.template.spec.containers[0].env}
+    assert env["OMPI_MCA_orte_default_hostfile"] == "/etc/mpi/hostfile"
+    assert env[builders.OPENMPI_SLOTS_ENV] == "1"
+    assert env["K_MPI_JOB_ROLE"] == "launcher"
+    assert env["NVIDIA_VISIBLE_DEVICES"] == ""
+
+    status = f.get_job().status
+    types = {c.type: c.status for c in status.conditions}
+    assert types[constants.JOB_CREATED] == "True"
+    assert status.start_time is not None
+
+
+def test_jax_implementation_injects_coordinator_env_and_skips_ssh():
+    f = Fixture()
+    job = new_mpi_job(workers=2, impl=constants.IMPL_JAX, slots_per_worker=4)
+    f.register_job(job)
+    f.sync(job)
+
+    # No SSH secret on the TPU-native path.
+    with pytest.raises(Exception):
+        f.client.secrets("default").get("test-ssh")
+
+    port = constants.DEFAULT_JAX_COORDINATOR_PORT
+    for i in range(2):
+        pod = f.client.pods("default").get(f"test-worker-{i}")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env[constants.JAX_COORDINATOR_ADDRESS_ENV] == \
+            f"test-worker-0.test.default.svc:{port}"
+        assert env[constants.JAX_PROCESS_ID_ENV] == str(i)
+        assert env[constants.JAX_NUM_PROCESSES_ENV] == "2"
+        assert env[constants.JAX_LOCAL_DEVICE_COUNT_ENV] == "4"
+        # workers keep the image entrypoint (no sshd default)
+        assert pod.spec.containers[0].command == []
+        assert not any(v.name == builders.SSH_AUTH_VOLUME
+                       for v in pod.spec.volumes)
+
+    # headless service publishes not-ready addresses so workers can resolve
+    # the coordinator before it is Ready
+    svc = f.client.services("default").get("test")
+    assert svc.spec.publish_not_ready_addresses
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    env = {e.name: e.value for e in launcher.spec.template.spec.containers[0].env}
+    assert env["JAX_PLATFORMS"] == "cpu"  # launcher must not grab TPU chips
+    assert env[constants.JAX_NUM_PROCESSES_ENV] == "2"
+
+
+def test_jax_run_launcher_as_worker_makes_launcher_process_zero():
+    f = Fixture()
+    job = new_mpi_job(workers=2, impl=constants.IMPL_JAX,
+                      run_launcher_as_worker=True)
+    f.register_job(job)
+    f.sync(job)
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    env = {e.name: e.value for e in launcher.spec.template.spec.containers[0].env}
+    port = constants.DEFAULT_JAX_COORDINATOR_PORT
+    assert env[constants.JAX_COORDINATOR_ADDRESS_ENV] == \
+        f"test-launcher.test.default.svc:{port}"
+    assert env[constants.JAX_PROCESS_ID_ENV] == "0"
+    assert env[constants.JAX_NUM_PROCESSES_ENV] == "3"
+    assert "JAX_PLATFORMS" not in env  # it IS a worker: may use TPU
+
+    pod = f.client.pods("default").get("test-worker-0")
+    env = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert env[constants.JAX_PROCESS_ID_ENV] == "1"
+    # index label padded by one (ref :1487-1494)
+    assert pod.metadata.labels[constants.REPLICA_INDEX_LABEL] == "1"
+
+
+def test_worker_config_intel_hostfile_format():
+    f = Fixture()
+    job = new_mpi_job(workers=1, impl=constants.IMPL_INTEL, slots_per_worker=2)
+    f.register_job(job)
+    f.sync(job)
+    cm = f.client.config_maps("default").get("test-config")
+    assert cm.data[builders.HOSTFILE_NAME] == "test-worker-0.test.default.svc:2\n"
+    launcher = f.client.jobs("default").get("test-launcher")
+    env = {e.name: e.value for e in launcher.spec.template.spec.containers[0].env}
+    assert env["I_MPI_HYDRA_HOST_FILE"] == "/etc/mpi/hostfile"
+    assert env[builders.INTEL_MPI_SLOTS_ENV] == "2"
+
+
+def test_cluster_domain_in_hostfile():
+    f = Fixture()
+    f.controller.cluster_domain = "cluster.local"
+    job = new_mpi_job(workers=1)
+    f.register_job(job)
+    f.sync(job)
+    cm = f.client.config_maps("default").get("test-config")
+    assert cm.data[builders.HOSTFILE_NAME] == \
+        "test-worker-0.test.default.svc.cluster.local slots=1\n"
+
+
+def test_discover_hosts_updated_from_running_pods():
+    """TestUpdateDiscoverHostsInConfigMap analogue (ref :2324)."""
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+
+    # Mark worker-1 Running; worker-0 stays Pending.
+    pod = f.client.pods("default").get("test-worker-1")
+    pod.status.phase = core.POD_RUNNING
+    f.client.pods("default").update_status(pod)
+    f.refresh_caches()
+    f.sync(job)
+
+    cm = f.client.config_maps("default").get("test-config")
+    assert cm.data[builders.DISCOVER_HOSTS_SCRIPT_NAME] == (
+        "#!/bin/sh\necho test-worker-1.test.default.svc\n")
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+
+def run_job_to_running(f: Fixture, job: MPIJob) -> None:
+    f.sync(job)
+    f.refresh_caches()
+    for i in range(job.worker_spec.replicas or 0):
+        pod = f.client.pods("default").get(f"test-worker-{i}")
+        pod.status.phase = core.POD_RUNNING
+        f.client.pods("default").update_status(pod)
+    # launcher pod appears (as the runtime would create it for the Job)
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher_pod = Pod(metadata=ObjectMeta(
+        name="test-launcher-abc", namespace="default",
+        labels={"job-name": "test-launcher"},
+        owner_references=[__import__(
+            "mpi_operator_tpu.k8s.meta", fromlist=["new_controller_ref"]
+        ).new_controller_ref(launcher, "batch/v1", "Job")]))
+    launcher_pod.status.phase = core.POD_RUNNING
+    f.client.pods("default").create(launcher_pod)
+    f.refresh_caches()
+    f.sync(job)
+
+
+def test_job_running_condition():
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    f.register_job(job)
+    run_job_to_running(f, job)
+
+    status = f.get_job().status
+    conds = {c.type: c.status for c in status.conditions}
+    assert conds[constants.JOB_RUNNING] == "True"
+    workers = status.replica_statuses[constants.REPLICA_TYPE_WORKER]
+    assert workers.active == 2
+    assert any("MPIJobRunning" in e for e in f.recorder.events)
+
+
+def test_job_succeeded_when_launcher_completes():
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    f.register_job(job)
+    run_job_to_running(f, job)
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher.status.conditions.append(batch.JobCondition(
+        type=batch.JOB_COMPLETE, status="True"))
+    launcher.status.succeeded = 1
+    launcher.status.completion_time = f.clock.now()
+    f.client.jobs("default").update_status(launcher)
+    f.refresh_caches()
+    f.sync(job)
+
+    status = f.get_job().status
+    conds = {c.type: c.status for c in status.conditions}
+    assert conds[constants.JOB_SUCCEEDED] == "True"
+    assert conds[constants.JOB_RUNNING] == "False"  # forced by terminal cond
+    assert status.completion_time is not None
+    assert f.controller.metrics["jobs_successful"].value == 1
+
+
+def test_job_failed_when_launcher_fails():
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    f.register_job(job)
+    run_job_to_running(f, job)
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher.status.conditions.append(batch.JobCondition(
+        type=batch.JOB_FAILED, status="True", reason="BackoffLimitExceeded",
+        message="Job has reached the specified backoff limit"))
+    launcher.status.failed = 3
+    f.client.jobs("default").update_status(launcher)
+    f.refresh_caches()
+    f.sync(job)
+
+    status = f.get_job().status
+    conds = {c.type: c.status for c in status.conditions}
+    assert conds[constants.JOB_FAILED] == "True"
+    assert status.completion_time is not None
+    assert status.replica_statuses[constants.REPLICA_TYPE_LAUNCHER].failed == 3
+    assert f.controller.metrics["jobs_failed"].value == 1
+
+
+def test_finished_job_cleanup_all_policy():
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    job.spec.run_policy.clean_pod_policy = constants.CLEAN_POD_POLICY_ALL
+    f.register_job(job)
+    run_job_to_running(f, job)
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher.status.conditions.append(batch.JobCondition(
+        type=batch.JOB_COMPLETE, status="True"))
+    launcher.status.completion_time = f.clock.now()
+    f.client.jobs("default").update_status(launcher)
+    f.refresh_caches()
+    f.sync(job)   # marks Succeeded + CompletionTime
+    f.refresh_caches()
+    f.sync(job)   # terminal sync -> cleanup
+    for i in range(2):
+        with pytest.raises(Exception):
+            f.client.pods("default").get(f"test-worker-{i}")
+
+
+def test_finished_job_cleanup_running_policy_keeps_terminated_pods():
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    job.spec.run_policy.clean_pod_policy = constants.CLEAN_POD_POLICY_RUNNING
+    f.register_job(job)
+    run_job_to_running(f, job)
+    # worker-1 already Succeeded; worker-0 Running
+    pod = f.client.pods("default").get("test-worker-1")
+    pod.status.phase = core.POD_SUCCEEDED
+    f.client.pods("default").update_status(pod)
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher.status.conditions.append(batch.JobCondition(
+        type=batch.JOB_COMPLETE, status="True"))
+    launcher.status.completion_time = f.clock.now()
+    f.client.jobs("default").update_status(launcher)
+    f.refresh_caches()
+    f.sync(job)
+    f.refresh_caches()
+    f.sync(job)
+    with pytest.raises(Exception):
+        f.client.pods("default").get("test-worker-0")  # running -> deleted
+    assert f.client.pods("default").get("test-worker-1")  # kept
+
+
+def test_scale_down_deletes_high_index_pods():
+    """Elastic scale-down (ref :998-1014)."""
+    f = Fixture()
+    job = new_mpi_job(workers=3)
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+
+    stored = f.get_job()
+    stored.worker_spec.replicas = 1
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+    f.sync(stored)
+
+    assert f.client.pods("default").get("test-worker-0")
+    for i in (1, 2):
+        with pytest.raises(Exception):
+            f.client.pods("default").get(f"test-worker-{i}")
+
+
+def test_suspend_resume_cycle():
+    """TestMPIJobResumingAndSuspending analogue (integration ref :314)."""
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    f.register_job(job)
+    run_job_to_running(f, job)
+
+    # Suspend.
+    stored = f.get_job()
+    stored.spec.run_policy.suspend = True
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+    f.sync(stored)
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    assert launcher.spec.suspend is True
+    for i in range(2):
+        with pytest.raises(Exception):
+            f.client.pods("default").get(f"test-worker-{i}")
+    status = f.get_job().status
+    conds = {c.type: (c.status, c.reason) for c in status.conditions}
+    assert conds[constants.JOB_SUSPENDED] == ("True", "MPIJobSuspended")
+    assert conds[constants.JOB_RUNNING][0] == "False"
+
+    # Simulate the launcher Job having a StartTime (set by job runtime).
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher.status.start_time = f.clock.now()
+    f.client.jobs("default").update_status(launcher)
+    f.refresh_caches()
+
+    # Resume.
+    stored = f.get_job()
+    stored.spec.run_policy.suspend = False
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+    f.clock.step(60)
+    f.sync(stored)
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    assert launcher.spec.suspend is False
+    assert launcher.status.start_time is None  # cleared via status subresource
+    assert f.client.pods("default").get("test-worker-0")
+    status = f.get_job().status
+    conds = {c.type: (c.status, c.reason) for c in status.conditions}
+    assert conds[constants.JOB_SUSPENDED] == ("False", "MPIJobResumed")
+    assert status.start_time is not None
+    assert any("MPIJobResumed" in e for e in f.recorder.events)
+
+
+def test_new_job_suspended_creates_no_workers_and_no_start_time():
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    job.spec.run_policy.suspend = True
+    f.register_job(job)
+    f.sync(job)
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    assert launcher.spec.suspend is True
+    assert f.client.pods("default").list() == []
+    status = f.get_job().status
+    assert status.start_time is None
+    conds = {c.type: c.status for c in status.conditions}
+    assert conds[constants.JOB_SUSPENDED] == "True"
+
+
+def test_managed_by_external_controller_skipped():
+    """TestMPIJobManagedExternally analogue (integration ref :897)."""
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    job.spec.run_policy.managed_by = constants.MULTIKUEUE_CONTROLLER
+    f.register_job(job)
+    f.sync(job)
+    assert f.client.pods("default").list() == []
+    assert f.client.services("default").list() == []
+    assert f.client.jobs("default").list() == []
+
+
+def test_validation_error_event_no_requeue():
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    job.spec.mpi_replica_specs[constants.REPLICA_TYPE_LAUNCHER] = None
+    created = f.client.mpi_jobs("default").create(job)
+    # bypass defaulting damage: directly poison the cached copy
+    created.spec.mpi_replica_specs = {}
+    f.factory.mpi_jobs().add_to_cache(created)
+    f.sync(job)
+    assert any("ValidationError" in e for e in f.recorder.events)
+    assert f.client.pods("default").list() == []
+
+
+def test_worker_eviction_fails_job():
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    f.register_job(job)
+    run_job_to_running(f, job)
+    pod = f.client.pods("default").get("test-worker-0")
+    pod.status.phase = core.POD_FAILED
+    pod.status.reason = "Evicted"
+    f.client.pods("default").update_status(pod)
+    f.refresh_caches()
+    f.sync(job)
+    status = f.get_job().status
+    conds = {c.type: (c.status, c.reason) for c in status.conditions}
+    assert conds[constants.JOB_FAILED] == ("True", "MPIJobEvicted")
+    assert any("workers are evicted" in e for e in f.recorder.events)
+
+
+def test_wait_for_workers_ready_gates_launcher():
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    job.spec.launcher_creation_policy = \
+        constants.LAUNCHER_CREATION_WAIT_FOR_WORKERS_READY
+    f.register_job(job)
+    f.sync(job)
+    with pytest.raises(Exception):
+        f.client.jobs("default").get("test-launcher")
+
+    f.refresh_caches()
+    for i in range(2):
+        pod = f.client.pods("default").get(f"test-worker-{i}")
+        pod.status.phase = core.POD_RUNNING
+        pod.status.conditions.append(PodCondition(type="Ready", status="True"))
+        f.client.pods("default").update_status(pod)
+    f.refresh_caches()
+    f.sync(job)
+    assert f.client.jobs("default").get("test-launcher")
+
+
+def test_launcher_not_owned_raises_and_events():
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    f.register_job(job)
+    rogue = batch.Job(metadata=ObjectMeta(name="test-launcher",
+                                          namespace="default"))
+    f.client.jobs("default").create(rogue)
+    f.refresh_caches()
+    with pytest.raises(RuntimeError):
+        f.sync(job)
+    assert any("ErrResourceExists" in e for e in f.recorder.events)
+
+
+def test_status_update_skipped_when_unchanged():
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+    rv_before = f.get_job().metadata.resource_version
+    f.sync(job)  # no state change -> no status write
+    assert f.get_job().metadata.resource_version == rv_before

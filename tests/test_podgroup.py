@@ -1,0 +1,175 @@
+"""Gang-scheduling adapter tests — parity with
+/root/reference/pkg/controller/podgroup_test.go (964 LoC, table-driven
+minResources/minMember/priority math)."""
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.controller.podgroup import (
+    GANG_SCHEDULER_VOLCANO, SchedulerPluginsCtrl, VolcanoCtrl,
+    VOLCANO_QUEUE_NAME_ANNOTATION, cal_pg_min_resource,
+    calculate_min_available, calculate_priority_class_name,
+    new_pod_group_ctrl)
+from mpi_operator_tpu.api.types import SchedulingPolicy
+from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.k8s.core import ResourceRequirements
+from mpi_operator_tpu.k8s.scheduling import (SCHED_PLUGINS_POD_GROUP_LABEL,
+                                             VOLCANO_POD_GROUP_NAME_ANNOTATION)
+
+from test_controller import Fixture, new_mpi_job
+
+
+def job_with_resources(workers=2, launcher_req=None, worker_req=None,
+                       **kwargs):
+    job = new_mpi_job(workers=workers, **kwargs)
+    if launcher_req:
+        job.launcher_spec.template.spec.containers[0].resources = \
+            ResourceRequirements(requests=launcher_req)
+    if worker_req:
+        job.worker_spec.template.spec.containers[0].resources = \
+            ResourceRequirements(requests=worker_req)
+    return job
+
+
+def test_calculate_min_available_defaults_to_workers_plus_one():
+    assert calculate_min_available(new_mpi_job(workers=4)) == 5
+
+
+def test_calculate_min_available_respects_policy():
+    job = new_mpi_job(workers=4)
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(min_available=2)
+    assert calculate_min_available(job) == 2
+
+
+def test_priority_class_resolution_order():
+    job = new_mpi_job()
+    assert calculate_priority_class_name(job) == ""
+    job.worker_spec.template.spec.priority_class_name = "worker-pc"
+    assert calculate_priority_class_name(job) == "worker-pc"
+    job.launcher_spec.template.spec.priority_class_name = "launcher-pc"
+    assert calculate_priority_class_name(job) == "launcher-pc"
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+        priority_class="policy-pc")
+    assert calculate_priority_class_name(job) == "policy-pc"
+
+
+def test_min_resource_sums_launcher_and_workers():
+    job = job_with_resources(workers=2,
+                             launcher_req={"cpu": "1", "memory": "1Gi"},
+                             worker_req={"cpu": "2", "google.com/tpu": "4"})
+    res = cal_pg_min_resource(3, job)
+    assert res["cpu"] == "5"            # 1 + 2*2
+    assert res["memory"] == "1073741824"
+    assert res["google.com/tpu"] == "8"
+
+
+def test_min_resource_truncates_to_min_member_same_priority():
+    # minMember=2 -> only 1 worker counted (same priority: workers lose).
+    job = job_with_resources(workers=4, launcher_req={"cpu": "1"},
+                             worker_req={"cpu": "2"})
+    res = cal_pg_min_resource(2, job)
+    assert res["cpu"] == "3"  # launcher 1 + (2-1) workers * 2
+
+
+def test_min_resource_limits_fill_missing_requests():
+    job = new_mpi_job(workers=1)
+    job.worker_spec.template.spec.containers[0].resources = \
+        ResourceRequirements(limits={"cpu": "4"})
+    res = cal_pg_min_resource(2, job)
+    assert res["cpu"] == "4"
+
+
+def test_volcano_pod_group_shape():
+    cs = Clientset()
+    ctrl = VolcanoCtrl(cs)
+    job = job_with_resources(workers=2, worker_req={"cpu": "1"})
+    job.metadata.annotations[VOLCANO_QUEUE_NAME_ANNOTATION] = "annotated-q"
+    pg = ctrl.new_pod_group(job)
+    assert pg.spec.min_member == 3
+    assert pg.spec.queue == "annotated-q"
+    # SchedulingPolicy queue overrides the annotation.
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(queue="policy-q")
+    assert ctrl.new_pod_group(job).spec.queue == "policy-q"
+    assert pg.metadata.owner_references[0].kind == "MPIJob"
+
+
+def test_sched_plugins_pod_group_shape():
+    cs = Clientset()
+    ctrl = SchedulerPluginsCtrl(cs)
+    job = new_mpi_job(workers=2)
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+        schedule_timeout_seconds=120)
+    pg = ctrl.new_pod_group(job)
+    assert pg.spec.min_member == 3
+    assert pg.spec.schedule_timeout_seconds == 120
+
+
+def test_decorate_pod_templates():
+    cs = Clientset()
+    job = new_mpi_job()
+    vol = VolcanoCtrl(cs)
+    template = job.worker_spec.template
+    vol.decorate_pod_template(template, "test")
+    assert template.spec.scheduler_name == "volcano"
+    assert template.metadata.annotations[VOLCANO_POD_GROUP_NAME_ANNOTATION] == "test"
+
+    sp = SchedulerPluginsCtrl(cs, scheduler_name="coscheduler")
+    template2 = job.launcher_spec.template
+    sp.decorate_pod_template(template2, "test")
+    assert template2.spec.scheduler_name == "coscheduler"
+    assert template2.metadata.labels[SCHED_PLUGINS_POD_GROUP_LABEL] == "test"
+
+
+def test_factory_selection():
+    cs = Clientset()
+    assert new_pod_group_ctrl("", cs) is None
+    assert isinstance(new_pod_group_ctrl("volcano", cs), VolcanoCtrl)
+    ctrl = new_pod_group_ctrl("my-coscheduler", cs)
+    assert isinstance(ctrl, SchedulerPluginsCtrl)
+    assert ctrl.scheduler_name == "my-coscheduler"
+
+
+def test_controller_creates_and_deletes_pod_group():
+    cs_ctrl = None
+
+    class _F(Fixture):
+        def __init__(self):
+            from mpi_operator_tpu.k8s.meta import FakeClock
+            from mpi_operator_tpu.k8s.informers import InformerFactory
+            from mpi_operator_tpu.controller.controller import MPIJobController
+            from mpi_operator_tpu.controller.events import FakeRecorder
+            self.clock = FakeClock()
+            self.client = Clientset(clock=self.clock)
+            self.factory = InformerFactory(self.client)
+            self.recorder = FakeRecorder()
+            ctrl = VolcanoCtrl(self.client)
+            self.pod_group_ctrl = ctrl
+            self.controller = MPIJobController(
+                self.client, informer_factory=self.factory,
+                pod_group_ctrl=ctrl, recorder=self.recorder, clock=self.clock)
+
+    f = _F()
+    job = new_mpi_job(workers=2)
+    f.register_job(job)
+    f.sync(job)
+
+    pg = f.client.volcano_pod_groups("default").get("test")
+    assert pg.spec.min_member == 3
+    # workers decorated with the group annotation + scheduler name
+    pod = f.client.pods("default").get("test-worker-0")
+    assert pod.spec.scheduler_name == GANG_SCHEDULER_VOLCANO
+    assert pod.metadata.annotations[VOLCANO_POD_GROUP_NAME_ANNOTATION] == "test"
+
+    # Refresh volcano informer cache too.
+    f.refresh_caches()
+    for obj in f.client.server.list("scheduling.volcano.sh/v1beta1",
+                                    "PodGroup"):
+        f.factory.volcano_pod_groups().add_to_cache(obj)
+
+    # Suspend -> PodGroup deleted with the workers.
+    stored = f.get_job()
+    stored.spec.run_policy.suspend = True
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+    f.sync(stored)
+    import pytest
+    with pytest.raises(Exception):
+        f.client.volcano_pod_groups("default").get("test")

@@ -1,0 +1,97 @@
+"""Condition state machine tests — parity with
+/root/reference/pkg/controller/mpi_job_controller_status.go semantics."""
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.types import MPIJob
+from mpi_operator_tpu.controller.status import (
+    filter_out_condition, get_condition, is_finished, new_condition,
+    update_job_conditions)
+from mpi_operator_tpu.k8s.core import CONDITION_FALSE, CONDITION_TRUE
+from mpi_operator_tpu.k8s.meta import FakeClock
+
+
+def test_set_condition_appends_and_orders():
+    clock = FakeClock()
+    job = MPIJob()
+    assert update_job_conditions(job, constants.JOB_CREATED, CONDITION_TRUE,
+                                 "MPIJobCreated", "created", clock)
+    assert update_job_conditions(job, constants.JOB_RUNNING, CONDITION_TRUE,
+                                 "MPIJobRunning", "running", clock)
+    assert [c.type for c in job.status.conditions] == ["Created", "Running"]
+
+
+def test_unchanged_condition_is_noop():
+    clock = FakeClock()
+    job = MPIJob()
+    update_job_conditions(job, constants.JOB_RUNNING, CONDITION_TRUE,
+                          "MPIJobRunning", "msg1", clock)
+    assert not update_job_conditions(job, constants.JOB_RUNNING,
+                                     CONDITION_TRUE, "MPIJobRunning", "msg2",
+                                     clock)
+    assert len(job.status.conditions) == 1
+
+
+def test_transition_time_preserved_when_status_same():
+    clock = FakeClock()
+    job = MPIJob()
+    update_job_conditions(job, constants.JOB_RUNNING, CONDITION_TRUE,
+                          "ReasonA", "msg", clock)
+    t0 = get_condition(job.status, constants.JOB_RUNNING).last_transition_time
+    clock.step(100)
+    # same status, different reason -> update but keep transition time
+    assert update_job_conditions(job, constants.JOB_RUNNING, CONDITION_TRUE,
+                                 "ReasonB", "msg", clock)
+    cond = get_condition(job.status, constants.JOB_RUNNING)
+    assert cond.last_transition_time == t0
+    assert cond.reason == "ReasonB"
+
+
+def test_transition_time_moves_when_status_flips():
+    clock = FakeClock()
+    job = MPIJob()
+    update_job_conditions(job, constants.JOB_RUNNING, CONDITION_TRUE,
+                          "R", "m", clock)
+    t0 = get_condition(job.status, constants.JOB_RUNNING).last_transition_time
+    clock.step(50)
+    update_job_conditions(job, constants.JOB_RUNNING, CONDITION_FALSE,
+                          "R2", "m2", clock)
+    t1 = get_condition(job.status, constants.JOB_RUNNING).last_transition_time
+    assert t1 > t0
+
+
+def test_running_restarting_mutual_exclusion():
+    clock = FakeClock()
+    job = MPIJob()
+    update_job_conditions(job, constants.JOB_RUNNING, CONDITION_TRUE,
+                          "R", "m", clock)
+    update_job_conditions(job, constants.JOB_RESTARTING, CONDITION_TRUE,
+                          "RS", "m", clock)
+    types = [c.type for c in job.status.conditions]
+    assert constants.JOB_RUNNING not in types
+    assert constants.JOB_RESTARTING in types
+    update_job_conditions(job, constants.JOB_RUNNING, CONDITION_TRUE,
+                          "R", "m", clock)
+    types = [c.type for c in job.status.conditions]
+    assert constants.JOB_RESTARTING not in types
+
+
+def test_terminal_condition_forces_running_false():
+    clock = FakeClock()
+    job = MPIJob()
+    update_job_conditions(job, constants.JOB_RUNNING, CONDITION_TRUE,
+                          "R", "m", clock)
+    update_job_conditions(job, constants.JOB_SUCCEEDED, CONDITION_TRUE,
+                          "S", "m", clock)
+    running = get_condition(job.status, constants.JOB_RUNNING)
+    assert running.status == CONDITION_FALSE
+    assert is_finished(job.status)
+
+
+def test_filter_out_condition_drops_same_type():
+    clock = FakeClock()
+    conds = [new_condition(constants.JOB_CREATED, CONDITION_TRUE, "a", "b",
+                           clock),
+             new_condition(constants.JOB_RUNNING, CONDITION_TRUE, "a", "b",
+                           clock)]
+    out = filter_out_condition(conds, constants.JOB_CREATED)
+    assert [c.type for c in out] == [constants.JOB_RUNNING]
