@@ -300,7 +300,8 @@ def worker_replica_index_label(job: MPIJob, index: int) -> str:
     return str(index)
 
 
-def new_worker(job: MPIJob, index: int, pod_group_ctrl=None) -> Pod:
+def new_worker(job: MPIJob, index: int, pod_group_ctrl=None,
+               cluster_domain: str = "") -> Pod:
     """newWorker (:1499-1552)."""
     name = worker_name(job, index)
     template = deep_copy(job.worker_spec.template)
@@ -331,7 +332,7 @@ def new_worker(job: MPIJob, index: int, pod_group_ctrl=None) -> Pod:
     container.env = list(container.env) + deep_copy(WORKER_ENV)
     if is_jax(job):
         process_id = index + (1 if run_launcher_as_worker(job) else 0)
-        container.env += jax_env(job, process_id, cluster_domain="")
+        container.env += jax_env(job, process_id, cluster_domain)
     if uses_ssh(job):
         setup_ssh_on_pod(template.spec, job)
 
@@ -352,7 +353,8 @@ def new_worker(job: MPIJob, index: int, pod_group_ctrl=None) -> Pod:
 # Launcher Job
 # ---------------------------------------------------------------------------
 
-def new_launcher_job(job: MPIJob, pod_group_ctrl=None, recorder=None) -> batch.Job:
+def new_launcher_job(job: MPIJob, pod_group_ctrl=None, recorder=None,
+                     cluster_domain: str = "") -> batch.Job:
     """newLauncherJob (:1554-1580)."""
     launcher = batch.Job(
         metadata=ObjectMeta(
@@ -364,7 +366,8 @@ def new_launcher_job(job: MPIJob, pod_group_ctrl=None, recorder=None) -> batch.J
             ttl_seconds_after_finished=job.spec.run_policy.ttl_seconds_after_finished,
             active_deadline_seconds=job.spec.run_policy.active_deadline_seconds,
             backoff_limit=job.spec.run_policy.backoff_limit,
-            template=new_launcher_pod_template(job, pod_group_ctrl, recorder),
+            template=new_launcher_pod_template(job, pod_group_ctrl, recorder,
+                                               cluster_domain),
             # Guard against recreating terminating pods (:1571-1574).
             pod_replacement_policy=batch.POD_REPLACEMENT_POLICY_FAILED))
     if job.spec.run_policy.suspend:
@@ -373,7 +376,8 @@ def new_launcher_job(job: MPIJob, pod_group_ctrl=None, recorder=None) -> batch.J
 
 
 def new_launcher_pod_template(job: MPIJob, pod_group_ctrl=None,
-                              recorder=None) -> PodTemplateSpec:
+                              recorder=None,
+                              cluster_domain: str = "") -> PodTemplateSpec:
     """newLauncherPodTemplate (:1585-1674)."""
     name = launcher_name(job)
     template = deep_copy(job.launcher_spec.template)
@@ -408,12 +412,12 @@ def new_launcher_pod_template(job: MPIJob, pod_group_ctrl=None,
         # pure driver that still receives the coordinator address for
         # monitoring (but no process id).
         if run_launcher_as_worker(job):
-            container.env += jax_env(job, 0, cluster_domain="")
+            container.env += jax_env(job, 0, cluster_domain)
         else:
             port = constants.DEFAULT_JAX_COORDINATOR_PORT
             container.env.append(EnvVar(
                 constants.JAX_COORDINATOR_ADDRESS_ENV,
-                f"{coordinator_host(job, '')}:{port}"))
+                f"{coordinator_host(job, cluster_domain)}:{port}"))
             container.env.append(EnvVar(constants.JAX_NUM_PROCESSES_ENV,
                                         str(num_processes(job))))
 

@@ -282,7 +282,8 @@ class MPIJobController:
                     try:
                         launcher = self.client.jobs(namespace).create(
                             builders.new_launcher_job(
-                                mpi_job, self.pod_group_ctrl, self.recorder))
+                                mpi_job, self.pod_group_ctrl, self.recorder,
+                                self.cluster_domain))
                     except Exception as exc:
                         self.recorder.eventf(
                             mpi_job, core.EVENT_TYPE_WARNING,
@@ -303,7 +304,8 @@ class MPIJobController:
                     launcher_copy = self.client.jobs(namespace).update_status(
                         launcher_copy)
                 desired = builders.new_launcher_pod_template(
-                    mpi_job, self.pod_group_ctrl, self.recorder)
+                    mpi_job, self.pod_group_ctrl, self.recorder,
+                    self.cluster_domain)
                 builders.sync_launcher_scheduling_directives(launcher_copy,
                                                              desired)
                 launcher_copy.spec.suspend = False
@@ -440,6 +442,11 @@ class MPIJobController:
         replicas = spec.replicas or 0
 
         # Scale-down: remove pods whose index >= replicas (:998-1014).
+        # The label is padded by one under runLauncherAsWorker
+        # (builders.worker_replica_index_label), so un-pad before comparing
+        # — the reference compares the padded label directly and deletes a
+        # still-valid worker; we fix that here.
+        pad = 1 if job.spec.run_launcher_as_worker else 0
         pods = self.pod_informer.lister.list(
             job.metadata.namespace, builders.worker_selector(job.metadata.name))
         if len(pods) > replicas:
@@ -448,7 +455,7 @@ class MPIJobController:
                 if index_str is None:
                     continue
                 try:
-                    index = int(index_str)
+                    index = int(index_str) - pad
                 except ValueError:
                     continue
                 if index >= replicas:
@@ -461,7 +468,8 @@ class MPIJobController:
             if pod is None:
                 try:
                     pod = self.client.pods(job.metadata.namespace).create(
-                        builders.new_worker(job, i, self.pod_group_ctrl))
+                        builders.new_worker(job, i, self.pod_group_ctrl,
+                                            self.cluster_domain))
                 except Exception as exc:
                     self.recorder.eventf(job, core.EVENT_TYPE_WARNING,
                                          MPI_JOB_FAILED_REASON,
@@ -665,6 +673,7 @@ class MPIJobController:
         self.metrics["jobs_failed"].inc()
 
     def _update_status(self, job: MPIJob) -> None:
-        """doUpdateJobStatus (:1327-1330)."""
-        job.status.last_reconcile_time = self.clock.now()
+        """doUpdateJobStatus (:1327-1330).  Deliberately does NOT stamp a
+        per-sync timestamp: a finished job must converge to a no-op write
+        or the MODIFIED watch event would re-enqueue it forever."""
         self.client.mpi_jobs(job.metadata.namespace).update_status(job)

@@ -195,6 +195,12 @@ class ApiServer:
                     obj.status = deep_copy(current.status)
                 obj.metadata.uid = current.metadata.uid
                 obj.metadata.creation_timestamp = current.metadata.creation_timestamp
+            # No-op writes don't bump resourceVersion or fire watch events
+            # (mirrors apiserver/etcd semantics; level-triggered controllers
+            # rely on this to converge instead of self-triggering forever).
+            obj.metadata.resource_version = current.metadata.resource_version
+            if obj == current:
+                return deep_copy(current)
             obj.metadata.resource_version = self._next_rv()
             bucket[key] = obj
             self._notify(gvk, MODIFIED, obj)

@@ -147,7 +147,8 @@ class RateLimitingQueue:
         if delay <= 0:
             self.add(item)
             return
-        timer = threading.Timer(delay, self._timer_fire, args=(item,))
+        timer = threading.Timer(delay, self._timer_fire, args=(item, None))
+        timer.args = (item, timer)
         timer.daemon = True
         with self._cond:
             if self._shutting_down:
@@ -155,7 +156,9 @@ class RateLimitingQueue:
             self._timers.add(timer)
         timer.start()
 
-    def _timer_fire(self, item):
+    def _timer_fire(self, item, timer=None):
+        with self._cond:
+            self._timers.discard(timer)
         self.add(item)
 
     def add_rate_limited(self, item) -> None:

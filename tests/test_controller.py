@@ -533,3 +533,50 @@ def test_status_update_skipped_when_unchanged():
     rv_before = f.get_job().metadata.resource_version
     f.sync(job)  # no state change -> no status write
     assert f.get_job().metadata.resource_version == rv_before
+
+
+def test_scale_down_with_run_launcher_as_worker_unpads_index():
+    """Regression: padded replica-index labels (runLauncherAsWorker) must be
+    un-padded before the scale-down comparison, or a still-valid worker is
+    deleted (defect inherited from reference :998-1014, fixed here)."""
+    f = Fixture()
+    job = new_mpi_job(workers=3, impl=constants.IMPL_JAX,
+                      run_launcher_as_worker=True)
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+    # labels are 1..3; scale to 2 workers -> only worker-2 (label 3) goes.
+    stored = f.get_job()
+    stored.worker_spec.replicas = 2
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+    f.sync(stored)
+    assert f.client.pods("default").get("test-worker-0")
+    assert f.client.pods("default").get("test-worker-1")
+    with pytest.raises(Exception):
+        f.client.pods("default").get("test-worker-2")
+
+
+def test_finished_job_sync_converges_to_noop():
+    """Regression: a finished job must not generate endless status writes
+    (no-op update must not bump resourceVersion / fire watch events)."""
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    job.spec.run_policy.clean_pod_policy = constants.CLEAN_POD_POLICY_ALL
+    f.register_job(job)
+    run_job_to_running(f, job)
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher.status.conditions.append(batch.JobCondition(
+        type=batch.JOB_COMPLETE, status="True"))
+    launcher.status.completion_time = f.clock.now()
+    f.client.jobs("default").update_status(launcher)
+    f.refresh_caches()
+    f.sync(job)     # Succeeded + completionTime
+    f.refresh_caches()
+    f.sync(job)     # cleanup
+    f.refresh_caches()
+    rv = f.get_job().metadata.resource_version
+    for _ in range(3):
+        f.sync(job)
+        f.refresh_caches()
+    assert f.get_job().metadata.resource_version == rv
