@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""jax-pi — Monte-Carlo pi with one allreduce across the process group.
+
+TPU-native analogue of the reference's smoke-test workload
+(/root/reference/examples/v2beta1/pi/pi.cc:19-52: MPI_Init / Comm_rank /
+Comm_size / MPI_Reduce(SUM) / MPI_Barrier): proves rank formation and a
+single global reduction, but over jax.distributed + XLA collectives
+instead of mpirun/SSH.  Runs on TPU chips or CPU devices unchanged.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    samples = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+
+    from mpi_operator_tpu.bootstrap import initialize_from_env
+    env = initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+
+    rank = jax.process_index()
+    world = jax.process_count()
+
+    @jax.jit
+    def count_inside(key):
+        pts = jax.random.uniform(key, (samples, 2), dtype=jnp.float32)
+        return jnp.sum(jnp.sum(pts * pts, axis=-1) <= 1.0)
+
+    key = jax.random.PRNGKey(rank)
+    inside = count_inside(key)
+
+    # Global allreduce across every device of every process: the
+    # single-collective heart of the example (MPI_Reduce parity).
+    from jax.experimental import multihost_utils
+    totals = multihost_utils.process_allgather(
+        jnp.stack([inside.astype(jnp.float64), jnp.float64(samples)]))
+    totals = totals.reshape(-1, 2).sum(axis=0)
+
+    pi = 4.0 * float(totals[0]) / float(totals[1])
+    if rank == 0:
+        print(f"workers={world} samples={int(totals[1])} pi={pi:.6f}")
+    sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

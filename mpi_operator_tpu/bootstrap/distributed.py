@@ -1,0 +1,70 @@
+"""jax.distributed bootstrap from operator-injected env.
+
+The in-pod counterpart of the controller's JAX env injection
+(mpi_operator_tpu/controller/builders.py jax_env): reads
+JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / JAX_NUM_PROCESSES and calls
+``jax.distributed.initialize`` so XLA collectives form over ICI (intra
+slice) or DCN (multislice) — the TPU-native replacement for the
+reference's mpirun → ssh → orted launch path
+(/root/reference/build/base/entrypoint.sh:7-37).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api import constants
+
+
+@dataclass
+class ProcessEnv:
+    coordinator_address: str
+    process_id: int
+    num_processes: int
+    local_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def process_env() -> Optional[ProcessEnv]:
+    """Parse the injected env; None when running outside an MPIJob."""
+    addr = os.environ.get(constants.JAX_COORDINATOR_ADDRESS_ENV)
+    if not addr:
+        return None
+    return ProcessEnv(
+        coordinator_address=addr,
+        process_id=int(os.environ.get(constants.JAX_PROCESS_ID_ENV, "0")),
+        num_processes=int(os.environ.get(constants.JAX_NUM_PROCESSES_ENV, "1")),
+        local_device_count=int(os.environ.get(
+            constants.JAX_LOCAL_DEVICE_COUNT_ENV, "0")))
+
+
+def initialize_from_env(timeout_seconds: float = 120.0) -> Optional[ProcessEnv]:
+    """Initialize jax.distributed from the injected env (no-op outside an
+    MPIJob or for single-process jobs).  Retries while the coordinator's
+    DNS/socket comes up — the analogue of entrypoint.sh's nslookup loop."""
+    env = process_env()
+    if env is None or env.num_processes <= 1:
+        return env
+    import jax
+
+    deadline = time.monotonic() + timeout_seconds
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=env.coordinator_address,
+                num_processes=env.num_processes,
+                process_id=env.process_id)
+            return env
+        except Exception as exc:  # coordinator not up yet
+            last_err = exc
+            time.sleep(1.0)
+    raise TimeoutError(
+        f"jax.distributed.initialize did not connect to "
+        f"{env.coordinator_address} within {timeout_seconds}s: {last_err}")

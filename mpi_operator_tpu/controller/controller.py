@@ -203,7 +203,13 @@ class MPIJobController:
                 self.sync_handler(key)
                 self.queue.forget(key)
             except Exception as exc:  # requeue with backoff
-                logger.warning("error syncing %s: %s", key, exc)
+                from ..k8s.apiserver import is_conflict
+                if is_conflict(exc):
+                    # Expected under informer staleness: the next sync on a
+                    # fresh cache converges (ref :1169-1188 rationale).
+                    logger.debug("conflict syncing %s, requeueing", key)
+                else:
+                    logger.warning("error syncing %s: %s", key, exc)
                 self.queue.add_rate_limited(key)
             finally:
                 self.queue.done(key)

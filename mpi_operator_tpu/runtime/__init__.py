@@ -1,0 +1,16 @@
+"""Local cluster runtime.
+
+The reference delegates pod execution to Kubernetes (kubelet + batch Job
+controller).  This package provides the standalone equivalents so the
+framework runs end-to-end on a single host — the hermetic analogue of the
+reference's kind-based e2e (test/e2e/e2e_suite_test.go):
+
+- ``job_controller``: reconciles batch/v1 Jobs into pods (backoffLimit,
+  suspend, activeDeadlineSeconds, TTL, Complete/Failed conditions).
+- ``kubelet``: runs pods as local subprocesses, materializes
+  ConfigMap/Secret volumes into a sandbox, resolves service DNS to
+  loopback, manages phases/restart policies and captures logs.
+"""
+
+from .job_controller import JobController  # noqa: F401
+from .kubelet import LocalKubelet  # noqa: F401

@@ -1,0 +1,236 @@
+"""Mini batch/v1 Job controller.
+
+Kubernetes provides this for free to the reference operator (the launcher
+is a batch Job, mpi_job_controller.go:1554-1580, and the operator reads
+its Complete/Failed conditions).  Our standalone runtime needs one: it
+reconciles Jobs into pods and maintains Job status with the semantics the
+operator depends on — backoffLimit (default 6) with Failed reason
+"BackoffLimitExceeded", suspend (delete active pods, clear nothing),
+activeDeadlineSeconds ("DeadlineExceeded"), TTLSecondsAfterFinished, and
+completion on one succeeded pod.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..k8s import batch, core
+from ..k8s.apiserver import Clientset, is_not_found
+from ..k8s.meta import (Clock, ObjectMeta, deep_copy, get_controller_of,
+                        new_controller_ref)
+
+logger = logging.getLogger("mpi_operator_tpu.runtime.job")
+
+DEFAULT_BACKOFF_LIMIT = 6
+
+
+class JobController:
+    def __init__(self, clientset: Clientset, clock: Optional[Clock] = None,
+                 namespace: Optional[str] = None):
+        self.client = clientset
+        self.clock = clock or Clock()
+        self.namespace = namespace
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pod_serial = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, interval: float = 0.05) -> None:
+        self._thread = threading.Thread(target=self._loop, args=(interval,),
+                                        daemon=True, name="job-controller")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self, interval: float) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sync_all()
+            except Exception as exc:  # keep reconciling
+                logger.warning("job controller sync error: %s", exc)
+            self._stop.wait(interval)
+
+    # -- reconcile ---------------------------------------------------------
+    def sync_all(self) -> None:
+        for job in self.client.server.list("batch/v1", "Job", self.namespace):
+            self.sync_job(job)
+
+    def _job_pods(self, job: batch.Job) -> list:
+        pods = self.client.server.list("v1", "Pod", job.metadata.namespace)
+        out = []
+        for p in pods:
+            ref = get_controller_of(p)
+            if ref is not None and ref.uid == job.metadata.uid:
+                out.append(p)
+        return out
+
+    def sync_job(self, job: batch.Job) -> None:
+        pods = self._job_pods(job)
+        active = [p for p in pods if p.status.phase in (core.POD_PENDING,
+                                                        core.POD_RUNNING)]
+        succeeded = sum(1 for p in pods if p.status.phase == core.POD_SUCCEEDED)
+        # backoffLimit counts failed pods AND container restarts of live
+        # pods (k8s semantics: restartPolicy=OnFailure retries in-place).
+        failed = sum(1 for p in pods if p.status.phase == core.POD_FAILED)
+        failed += sum(cs.restart_count for p in active
+                      for cs in p.status.container_statuses)
+
+        if batch.is_job_finished(job):
+            self._maybe_ttl_delete(job)
+            return
+
+        ns = job.metadata.namespace
+        changed = deep_copy(job)
+
+        # Suspension (KEP-2232 semantics the operator relies on).
+        if job.spec.suspend:
+            for p in active:
+                try:
+                    self.client.pods(ns).delete(p.metadata.name)
+                except Exception as exc:
+                    if not is_not_found(exc):
+                        raise
+            changed.status.active = 0
+            self._set_condition(changed, batch.JOB_SUSPENDED, "True",
+                                "JobSuspended", "Job suspended")
+            self._update_status_if_changed(job, changed)
+            return
+        else:
+            cond = self._get_condition(changed, batch.JOB_SUSPENDED)
+            if cond is not None and cond.status == "True":
+                self._set_condition(changed, batch.JOB_SUSPENDED, "False",
+                                    "JobResumed", "Job resumed")
+            if changed.status.start_time is None:
+                changed.status.start_time = self.clock.now()
+
+        # Completion.
+        completions = job.spec.completions if job.spec.completions is not None else 1
+        if succeeded >= completions:
+            changed.status.succeeded = succeeded
+            changed.status.active = 0
+            changed.status.completion_time = self.clock.now()
+            self._set_condition(changed, batch.JOB_COMPLETE, "True", "",
+                                "Job completed")
+            self._update_status_if_changed(job, changed)
+            return
+
+        # Failure: backoff limit.
+        backoff = (job.spec.backoff_limit
+                   if job.spec.backoff_limit is not None
+                   else DEFAULT_BACKOFF_LIMIT)
+        if failed > backoff:
+            changed.status.failed = failed
+            changed.status.active = 0
+            changed.status.completion_time = self.clock.now()
+            self._set_condition(changed, batch.JOB_FAILED, "True",
+                                "BackoffLimitExceeded",
+                                "Job has reached the specified backoff limit")
+            self._update_status_if_changed(job, changed)
+            for p in active:
+                try:
+                    self.client.pods(ns).delete(p.metadata.name)
+                except Exception as exc:
+                    if not is_not_found(exc):
+                        raise
+            return
+
+        # Failure: active deadline.
+        if (job.spec.active_deadline_seconds is not None
+                and changed.status.start_time is not None):
+            elapsed = (self.clock.now() - changed.status.start_time).total_seconds()
+            if elapsed > job.spec.active_deadline_seconds:
+                changed.status.failed = failed
+                changed.status.active = 0
+                changed.status.completion_time = self.clock.now()
+                self._set_condition(changed, batch.JOB_FAILED, "True",
+                                    "DeadlineExceeded",
+                                    "Job was active longer than specified"
+                                    " deadline")
+                self._update_status_if_changed(job, changed)
+                for p in active:
+                    try:
+                        self.client.pods(ns).delete(p.metadata.name)
+                    except Exception as exc:
+                        if not is_not_found(exc):
+                            raise
+                return
+
+        # Ensure parallelism (launcher Jobs use 1).
+        parallelism = (job.spec.parallelism
+                       if job.spec.parallelism is not None else 1)
+        terminating_excluded = active  # PodReplacementPolicy=Failed: only
+        # count failed pods as replaceable; our runtime has no graceful
+        # deletion window so active is the right set either way.
+        while len(terminating_excluded) < parallelism:
+            pod = self._new_pod(changed)
+            try:
+                self.client.pods(ns).create(pod)
+            except Exception as exc:
+                logger.warning("creating pod for job %s: %s",
+                               job.metadata.name, exc)
+                break
+            terminating_excluded.append(pod)
+
+        changed.status.active = len(terminating_excluded)
+        changed.status.succeeded = succeeded
+        changed.status.failed = failed
+        self._update_status_if_changed(job, changed)
+
+    def _new_pod(self, job: batch.Job):
+        self._pod_serial += 1
+        template = deep_copy(job.spec.template)
+        labels = dict(template.metadata.labels)
+        labels.setdefault("job-name", job.metadata.name)
+        pod = core.Pod(
+            metadata=ObjectMeta(
+                name=f"{job.metadata.name}-{self._pod_serial:05x}",
+                namespace=job.metadata.namespace,
+                labels=labels,
+                annotations=dict(template.metadata.annotations),
+                owner_references=[new_controller_ref(job, "batch/v1", "Job")]),
+            spec=template.spec)
+        return pod
+
+    # -- helpers -----------------------------------------------------------
+    def _get_condition(self, job: batch.Job, ctype: str):
+        for c in job.status.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+    def _set_condition(self, job: batch.Job, ctype: str, status: str,
+                       reason: str, message: str) -> None:
+        cond = self._get_condition(job, ctype)
+        if cond is not None and cond.status == status:
+            return
+        job.status.conditions = [c for c in job.status.conditions
+                                 if c.type != ctype]
+        job.status.conditions.append(batch.JobCondition(
+            type=ctype, status=status, reason=reason, message=message,
+            last_transition_time=self.clock.now()))
+
+    def _update_status_if_changed(self, old: batch.Job, new: batch.Job) -> None:
+        if old.status != new.status:
+            try:
+                self.client.jobs(new.metadata.namespace).update_status(new)
+            except Exception as exc:
+                if not is_not_found(exc):
+                    logger.warning("updating job status %s: %s",
+                                   new.metadata.name, exc)
+
+    def _maybe_ttl_delete(self, job: batch.Job) -> None:
+        ttl = job.spec.ttl_seconds_after_finished
+        if ttl is None or job.status.completion_time is None:
+            return
+        if (self.clock.now() - job.status.completion_time).total_seconds() >= ttl:
+            try:
+                self.client.jobs(job.metadata.namespace).delete(
+                    job.metadata.name)
+            except Exception as exc:
+                if not is_not_found(exc):
+                    raise

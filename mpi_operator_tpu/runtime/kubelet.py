@@ -1,0 +1,335 @@
+"""LocalKubelet — runs pods as host subprocesses.
+
+Standalone analogue of kubelet for the hermetic runtime: watches pods,
+launches ``spec.containers[0].command + args`` as a subprocess, reflects
+phases (Pending → Running → Succeeded/Failed) and the Ready condition,
+honors restartPolicy (Always/OnFailure restart with backoff; Never
+fails), materializes ConfigMap/Secret volumes into a per-pod sandbox and
+captures logs.
+
+Network model: every pod shares the host's loopback.  Service DNS names
+(``<pod>.<svc>.<ns>.svc[...]``, reference build/base/entrypoint.sh relies
+on cluster DNS here) are resolved at pod start by rewriting env values to
+127.0.0.1, and per-job coordinator ports are allocated to avoid
+collisions (the JAX_COORDINATOR_PORT / :port suffix pair is rewritten
+together) — the local stand-in for the headless Service + stable pod
+hostname machinery (mpi_job_controller.go:1409-1438).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from ..api import constants as api_constants
+from ..k8s import core
+from ..k8s.apiserver import ApiServer, Clientset, is_conflict, is_not_found
+
+logger = logging.getLogger("mpi_operator_tpu.runtime.kubelet")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _PodRunner:
+    def __init__(self, kubelet: "LocalKubelet", pod: core.Pod):
+        self.kubelet = kubelet
+        self.pod_name = pod.metadata.name
+        self.namespace = pod.metadata.namespace
+        self.spec = pod.spec
+        self.sandbox = tempfile.mkdtemp(
+            prefix=f"pod-{self.namespace}-{self.pod_name}-",
+            dir=kubelet.root_dir)
+        self.log_path = os.path.join(self.sandbox, "container.log")
+        self.proc: Optional[subprocess.Popen] = None
+        self.restart_count = 0
+        self.stopped = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=f"pod-{self.pod_name}")
+
+    # -- volume materialization -------------------------------------------
+    def _materialize_volumes(self) -> dict:
+        """Write ConfigMap/Secret volumes under the sandbox; returns a map
+        of volume name -> host dir."""
+        dirs = {}
+        for vol in self.spec.volumes:
+            vol_dir = os.path.join(self.sandbox, "volumes", vol.name)
+            os.makedirs(vol_dir, exist_ok=True)
+            if vol.config_map is not None:
+                try:
+                    cm = self.kubelet.client.config_maps(self.namespace).get(
+                        vol.config_map.name)
+                except Exception:
+                    continue
+                items = vol.config_map.items or [
+                    core.KeyToPath(k, k) for k in cm.data]
+                for item in items:
+                    if item.key not in cm.data:
+                        continue
+                    path = os.path.join(vol_dir, item.path)
+                    with open(path, "w") as f:
+                        f.write(cm.data[item.key])
+                    if item.mode is not None:
+                        os.chmod(path, item.mode)
+            elif vol.secret is not None:
+                try:
+                    secret = self.kubelet.client.secrets(self.namespace).get(
+                        vol.secret.secret_name)
+                except Exception:
+                    continue
+                items = vol.secret.items or [
+                    core.KeyToPath(k, k) for k in secret.data]
+                for item in items:
+                    if item.key not in secret.data:
+                        continue
+                    path = os.path.join(vol_dir, item.path)
+                    data = secret.data[item.key]
+                    mode = "wb" if isinstance(data, bytes) else "w"
+                    with open(path, mode) as f:
+                        f.write(data)
+                    os.chmod(path, (vol.secret.default_mode
+                                    or item.mode or 0o644))
+            dirs[vol.name] = vol_dir
+        return dirs
+
+    # -- env resolution ----------------------------------------------------
+    def _build_env(self, volume_dirs: dict) -> dict:
+        env = dict(os.environ)
+        container = self.spec.containers[0]
+        # Mount paths become sandbox paths, exported via K_MOUNT_<name>.
+        for mount in container.volume_mounts:
+            if mount.name in volume_dirs:
+                safe = re.sub(r"[^A-Za-z0-9]", "_", mount.name).upper()
+                env[f"K_MOUNT_{safe}"] = volume_dirs[mount.name]
+                # Also expose the declared mount path mapping so workloads
+                # can translate /etc/mpi-style paths.
+                env[f"K_MOUNT_PATH_{safe}"] = mount.mount_path
+        env["K_POD_NAME"] = self.pod_name
+        env["K_POD_NAMESPACE"] = self.namespace
+        env["K_SANDBOX_DIR"] = self.sandbox
+
+        for ev in container.env:
+            env[ev.name] = self.kubelet.resolve_env_value(
+                self.namespace, ev.value)
+
+        # Per-job coordinator port remap to avoid cross-job collisions.
+        addr = env.get(api_constants.JAX_COORDINATOR_ADDRESS_ENV)
+        if addr and ":" in addr:
+            host, _, port = addr.rpartition(":")
+            mapped = self.kubelet.job_port(self.namespace,
+                                           self.spec.subdomain or host, port)
+            env[api_constants.JAX_COORDINATOR_ADDRESS_ENV] = f"{host}:{mapped}"
+            if api_constants.JAX_COORDINATOR_PORT_ENV in env:
+                env[api_constants.JAX_COORDINATOR_PORT_ENV] = str(mapped)
+            # resolve the coordinator hostname itself
+            env[api_constants.JAX_COORDINATOR_ADDRESS_ENV] = \
+                self.kubelet.resolve_env_value(
+                    self.namespace,
+                    env[api_constants.JAX_COORDINATOR_ADDRESS_ENV])
+        return env
+
+    # -- main loop ---------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            self._run_inner()
+        except Exception as exc:
+            logger.exception("pod %s runner crashed: %s", self.pod_name, exc)
+            self.kubelet._set_phase(self.namespace, self.pod_name,
+                                    core.POD_FAILED, reason="RunnerError",
+                                    message=str(exc))
+
+    def _run_inner(self) -> None:
+        container = self.spec.containers[0]
+        command = list(container.command) + list(container.args)
+        if not command:
+            # No command: images' entrypoints don't exist locally.
+            self.kubelet._set_phase(self.namespace, self.pod_name,
+                                    core.POD_FAILED, reason="NoCommand",
+                                    message="local runtime requires an"
+                                            " explicit command")
+            return
+        volume_dirs = self._materialize_volumes()
+        env = self._build_env(volume_dirs)
+
+        while not self.stopped.is_set():
+            with open(self.log_path, "ab") as log:
+                self.proc = subprocess.Popen(
+                    command, env=env, stdout=log, stderr=subprocess.STDOUT,
+                    cwd=container.working_dir or self.sandbox)
+            self.kubelet._set_phase(self.namespace, self.pod_name,
+                                    core.POD_RUNNING, ready=True,
+                                    restart_count=self.restart_count)
+            code = self.proc.wait()
+            if self.stopped.is_set():
+                return  # deletion already handled
+            if code == 0:
+                if self.spec.restart_policy == core.RESTART_POLICY_ALWAYS:
+                    self.restart_count += 1
+                    time.sleep(min(0.2 * self.restart_count, 2.0))
+                    continue
+                self.kubelet._set_phase(self.namespace, self.pod_name,
+                                        core.POD_SUCCEEDED)
+                return
+            if self.spec.restart_policy in (core.RESTART_POLICY_ALWAYS,
+                                            core.RESTART_POLICY_ON_FAILURE):
+                self.restart_count += 1
+                time.sleep(min(0.2 * self.restart_count, 2.0))
+                continue
+            self.kubelet._set_phase(
+                self.namespace, self.pod_name, core.POD_FAILED,
+                reason="Error",
+                message=f"container exited with code {code}")
+            return
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def stop(self) -> None:
+        self.stopped.set()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+    def logs(self) -> str:
+        try:
+            with open(self.log_path) as f:
+                return f.read()
+        except OSError:
+            return ""
+
+
+class LocalKubelet:
+    def __init__(self, clientset: Clientset, root_dir: Optional[str] = None,
+                 namespace: Optional[str] = None):
+        self.client = clientset
+        self.namespace = namespace
+        self.root_dir = root_dir or tempfile.mkdtemp(prefix="tpu-kubelet-")
+        self._runners: dict = {}
+        self._ports: dict = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watch = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- DNS / ports -------------------------------------------------------
+    def resolve_env_value(self, namespace: str, value: str) -> str:
+        """Rewrite cluster-DNS hostnames to loopback.  Any token shaped
+        like <host>.<svc>.<ns>.svc[.domain] resolves to 127.0.0.1."""
+        if not value:
+            return value
+        return re.sub(
+            r"[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*"
+            r"\.svc(\.[a-z0-9.]+)?",
+            "127.0.0.1", value)
+
+    def job_port(self, namespace: str, job_key: str, declared_port: str) -> int:
+        with self._lock:
+            key = (namespace, job_key, declared_port)
+            if key not in self._ports:
+                self._ports[key] = _free_port()
+            return self._ports[key]
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._watch = self.client.server.watch("v1", "Pod")
+        # pick up pre-existing pods
+        for pod in self.client.server.list("v1", "Pod", self.namespace):
+            self._on_pod(pod)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kubelet")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch:
+            self._watch.stop()
+        if self._thread:
+            self._thread.join(timeout=2)
+        with self._lock:
+            runners = list(self._runners.values())
+        for r in runners:
+            r.stop()
+        shutil.rmtree(self.root_dir, ignore_errors=True)
+
+    def _loop(self) -> None:
+        from ..k8s.apiserver import ADDED, DELETED
+        while not self._stop.is_set():
+            ev = self._watch.next(timeout=0.1)
+            if ev is None:
+                continue
+            pod = ev.obj
+            if self.namespace is not None and pod.metadata.namespace != self.namespace:
+                continue
+            key = (pod.metadata.namespace, pod.metadata.name)
+            if ev.type == ADDED:
+                self._on_pod(pod)
+            elif ev.type == DELETED:
+                with self._lock:
+                    runner = self._runners.pop(key, None)
+                if runner is not None:
+                    runner.stop()
+
+    def _on_pod(self, pod: core.Pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        with self._lock:
+            if key in self._runners:
+                return
+            if pod.status.phase in (core.POD_SUCCEEDED, core.POD_FAILED):
+                return
+            if pod.spec.scheduling_gates:
+                return  # gated pods wait (Kueue semantics)
+            runner = _PodRunner(self, pod)
+            self._runners[key] = runner
+        runner.start()
+
+    # -- status reflection -------------------------------------------------
+    def _set_phase(self, namespace: str, name: str, phase: str,
+                   ready: bool = False, reason: str = "", message: str = "",
+                   restart_count: int = 0) -> None:
+        for _ in range(5):
+            try:
+                pod = self.client.pods(namespace).get(name)
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                raise
+            pod.status.phase = phase
+            pod.status.reason = reason
+            pod.status.message = message
+            pod.status.conditions = [c for c in pod.status.conditions
+                                     if c.type != "Ready"]
+            pod.status.conditions.append(core.PodCondition(
+                type="Ready",
+                status=core.CONDITION_TRUE if ready else core.CONDITION_FALSE))
+            # Restart counts feed the Job backoffLimit accounting (real
+            # kubelet/Job-controller semantics for restartPolicy=OnFailure).
+            pod.status.container_statuses = [core.ContainerStatus(
+                name=pod.spec.containers[0].name if pod.spec.containers else "",
+                ready=ready, restart_count=restart_count)]
+            try:
+                self.client.pods(namespace).update_status(pod)
+                return
+            except Exception as exc:
+                if is_conflict(exc):
+                    continue
+                raise
+
+    def logs(self, namespace: str, name: str) -> str:
+        with self._lock:
+            runner = self._runners.get((namespace, name))
+        return runner.logs() if runner else ""

@@ -1,0 +1,4 @@
+"""Operator server process: options, leader election, healthz/metrics,
+and the all-in-one LocalCluster runtime."""
+
+from .cluster import LocalCluster  # noqa: F401

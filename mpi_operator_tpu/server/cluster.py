@@ -1,0 +1,92 @@
+"""LocalCluster — the whole control plane in one process.
+
+Wires the in-memory API server, the MPIJob controller, the batch Job
+controller and the LocalKubelet into a single runnable unit: the
+standalone equivalent of "kind cluster + operator Deployment" from the
+reference's e2e suite (test/e2e/e2e_suite_test.go:164-184).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..controller.controller import MPIJobController
+from ..controller.podgroup import new_pod_group_ctrl
+from ..k8s.apiserver import Clientset
+from ..runtime.job_controller import JobController
+from ..runtime.kubelet import LocalKubelet
+
+
+class LocalCluster:
+    def __init__(self, gang_scheduler: str = "",
+                 cluster_domain: str = "",
+                 namespace: Optional[str] = None,
+                 threadiness: int = 2,
+                 run_pods: bool = True):
+        self.client = Clientset()
+        pod_group_ctrl = new_pod_group_ctrl(gang_scheduler, self.client)
+        self.controller = MPIJobController(
+            self.client, pod_group_ctrl=pod_group_ctrl,
+            cluster_domain=cluster_domain, namespace=namespace)
+        self.job_controller = JobController(self.client, namespace=namespace)
+        self.kubelet = LocalKubelet(self.client, namespace=namespace) \
+            if run_pods else None
+        self._threadiness = threadiness
+        self._started = False
+
+    def start(self) -> "LocalCluster":
+        self.controller.run(self._threadiness)
+        self.job_controller.start()
+        if self.kubelet is not None:
+            self.kubelet.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        if self.kubelet is not None:
+            self.kubelet.stop()
+        self.job_controller.stop()
+        self.controller.stop()
+        self._started = False
+
+    def __enter__(self) -> "LocalCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- conveniences ------------------------------------------------------
+    def submit(self, mpi_job):
+        return self.client.mpi_jobs(
+            mpi_job.metadata.namespace or "default").create(mpi_job)
+
+    def wait_for_condition(self, namespace: str, name: str, cond_type: str,
+                           status: str = "True", timeout: float = 60.0):
+        """Poll the MPIJob until the condition appears (e2e helper,
+        analogue of waitForCompletion at test/e2e/mpi_job_test.go:595-631)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.client.mpi_jobs(namespace).get(name)
+            for c in job.status.conditions:
+                if c.type == cond_type and c.status == status:
+                    return job
+            time.sleep(0.05)
+        job = self.client.mpi_jobs(namespace).get(name)
+        conds = [(c.type, c.status, c.reason) for c in job.status.conditions]
+        raise TimeoutError(
+            f"MPIJob {namespace}/{name} never reached {cond_type}={status};"
+            f" conditions={conds}")
+
+    def launcher_logs(self, namespace: str, name: str) -> str:
+        """Concatenated logs of the launcher Job's pods (debugJob analogue,
+        test/e2e/mpi_job_test.go:680)."""
+        if self.kubelet is None:
+            return ""
+        out = []
+        for pod in self.client.server.list("v1", "Pod", namespace):
+            if pod.metadata.labels.get("job-name") == f"{name}-launcher":
+                out.append(self.kubelet.logs(namespace, pod.metadata.name))
+        return "\n".join(out)

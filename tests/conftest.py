@@ -8,7 +8,13 @@ multi-node on one host, SURVEY.md §4).  Must run before jax is imported.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the host may pre-set JAX_PLATFORMS to the real
+# TPU platform, which must never leak into hermetic tests or their
+# subprocess workloads.  PALLAS_AXON_POOL_IPS triggers sitecustomize-based
+# TPU plugin registration in every python process and overrides platform
+# selection — drop it so workload subprocesses get a clean CPU backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

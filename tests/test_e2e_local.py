@@ -1,0 +1,112 @@
+"""End-to-end tests against the full local cluster (apiserver + MPIJob
+controller + batch Job controller + kubelet running real subprocesses) —
+the hermetic analogue of the reference's kind e2e suite
+(/root/reference/test/e2e/mpi_job_test.go)."""
+
+import os
+import sys
+
+import pytest
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy
+from mpi_operator_tpu.k8s.core import Container, PodSpec, PodTemplateSpec
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+from mpi_operator_tpu.server import LocalCluster
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JAX_PI = os.path.join(REPO_ROOT, "examples", "jax_pi.py")
+
+
+def jax_job(name, launcher_cmd, worker_cmd, workers=2, **spec_kwargs):
+    return MPIJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(**spec_kwargs.pop("run_policy", {})),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="launcher", image="local",
+                                  command=launcher_cmd)]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="worker", image="local",
+                                  command=worker_cmd)]))),
+            },
+            **spec_kwargs))
+
+
+def test_e2e_trivial_job_succeeds():
+    """TestMPIJobSuccess analogue: everything runs, job reaches Succeeded."""
+    with LocalCluster() as cluster:
+        job = jax_job(
+            "ok",
+            launcher_cmd=[sys.executable, "-c", "print('launcher done')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"])
+        cluster.submit(job)
+        done = cluster.wait_for_condition("default", "ok",
+                                          constants.JOB_SUCCEEDED,
+                                          timeout=30)
+        assert done.status.completion_time is not None
+        assert "launcher done" in cluster.launcher_logs("default", "ok")
+        # workers are long-running by design; job success comes from the
+        # launcher Job completing (reference semantics).
+
+
+def test_e2e_malformed_command_fails():
+    """'malformed command' e2e analogue (mpi_job_test.go:92-100)."""
+    with LocalCluster() as cluster:
+        job = jax_job(
+            "bad",
+            launcher_cmd=[sys.executable, "-c", "raise SystemExit(1)"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            run_policy={"backoff_limit": 0})
+        cluster.submit(job)
+        failed = cluster.wait_for_condition("default", "bad",
+                                            constants.JOB_FAILED, timeout=30)
+        conds = {c.type: c.reason for c in failed.status.conditions}
+        assert conds[constants.JOB_FAILED] == "BackoffLimitExceeded"
+
+
+def test_e2e_suspend_before_start_then_resume():
+    """TestMPIJobWithSuspend analogue: suspended job creates no running
+    pods; resume completes it."""
+    with LocalCluster() as cluster:
+        job = jax_job(
+            "susp",
+            launcher_cmd=[sys.executable, "-c", "print('go')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            run_policy={"suspend": True})
+        cluster.submit(job)
+        cluster.wait_for_condition("default", "susp", constants.JOB_SUSPENDED,
+                                   timeout=10)
+        assert cluster.client.pods("default").list(
+            {"training.kubeflow.org/job-role": "worker"}) == []
+
+        stored = cluster.client.mpi_jobs("default").get("susp")
+        stored.spec.run_policy.suspend = False
+        cluster.client.mpi_jobs("default").update(stored)
+        cluster.wait_for_condition("default", "susp", constants.JOB_SUCCEEDED,
+                                   timeout=30)
+
+
+def test_e2e_jax_pi_process_group():
+    """The flagship e2e: a real jax.distributed process group (launcher as
+    process 0 + 2 workers on CPU) computes pi with one global allreduce —
+    full parity with the reference's mpi-pi e2e, TPU-native bootstrap."""
+    cmd = [sys.executable, JAX_PI, "200000"]
+    with LocalCluster() as cluster:
+        job = jax_job("pi", launcher_cmd=cmd, worker_cmd=cmd, workers=2,
+                      run_launcher_as_worker=True)
+        cluster.submit(job)
+        done = cluster.wait_for_condition("default", "pi",
+                                          constants.JOB_SUCCEEDED,
+                                          timeout=150)
+        logs = cluster.launcher_logs("default", "pi")
+        assert "workers=3" in logs, logs
+        pi_line = [l for l in logs.splitlines() if "pi=" in l][0]
+        pi = float(pi_line.split("pi=")[1])
+        assert abs(pi - 3.14159) < 0.05, logs
+        assert done.status.completion_time is not None
