@@ -37,23 +37,44 @@ class JobController:
         self._pod_serial = 0
 
     # -- lifecycle ---------------------------------------------------------
-    def start(self, interval: float = 0.05) -> None:
-        self._thread = threading.Thread(target=self._loop, args=(interval,),
+    def start(self, resync_interval: float = 1.0) -> None:
+        """Event-driven: Job/Pod watch events trigger targeted syncs; a
+        periodic full resync drives the time-based paths (deadline, TTL)."""
+        self._job_watch = self.client.server.watch("batch/v1", "Job")
+        self._pod_watch = self.client.server.watch("v1", "Pod")
+        self._thread = threading.Thread(target=self._loop,
+                                        args=(resync_interval,),
                                         daemon=True, name="job-controller")
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        for w in (getattr(self, "_job_watch", None),
+                  getattr(self, "_pod_watch", None)):
+            if w is not None:
+                w.stop()
         if self._thread:
             self._thread.join(timeout=2)
 
-    def _loop(self, interval: float) -> None:
+    def _loop(self, resync_interval: float) -> None:
+        import time as _time
+        next_resync = 0.0
         while not self._stop.is_set():
-            try:
-                self.sync_all()
-            except Exception as exc:  # keep reconciling
-                logger.warning("job controller sync error: %s", exc)
-            self._stop.wait(interval)
+            dirty = False
+            for w in (self._job_watch, self._pod_watch):
+                while True:
+                    ev = w.next(timeout=0)
+                    if ev is None:
+                        break
+                    dirty = True
+            now = _time.monotonic()
+            if dirty or now >= next_resync:
+                try:
+                    self.sync_all()
+                except Exception as exc:  # keep reconciling
+                    logger.warning("job controller sync error: %s", exc)
+                next_resync = now + resync_interval
+            self._stop.wait(0.02)
 
     # -- reconcile ---------------------------------------------------------
     def sync_all(self) -> None:
@@ -96,6 +117,9 @@ class JobController:
                     if not is_not_found(exc):
                         raise
             changed.status.active = 0
+            # KEP-2232: suspension resets startTime so activeDeadlineSeconds
+            # never counts suspended wall time.
+            changed.status.start_time = None
             self._set_condition(changed, batch.JOB_SUSPENDED, "True",
                                 "JobSuspended", "Job suspended")
             self._update_status_if_changed(job, changed)

@@ -81,8 +81,9 @@ class _PodRunner:
                     path = os.path.join(vol_dir, item.path)
                     with open(path, "w") as f:
                         f.write(cm.data[item.key])
-                    if item.mode is not None:
-                        os.chmod(path, item.mode)
+                    mode = item.mode or vol.config_map.default_mode
+                    if mode is not None:
+                        os.chmod(path, mode)
             elif vol.secret is not None:
                 try:
                     secret = self.kubelet.client.secrets(self.namespace).get(
@@ -99,8 +100,10 @@ class _PodRunner:
                     mode = "wb" if isinstance(data, bytes) else "w"
                     with open(path, mode) as f:
                         f.write(data)
-                    os.chmod(path, (vol.secret.default_mode
-                                    or item.mode or 0o644))
+                    # items[].mode takes precedence over defaultMode
+                    # (Kubernetes semantics).
+                    os.chmod(path, (item.mode or vol.secret.default_mode
+                                    or 0o644))
             dirs[vol.name] = vol_dir
         return dirs
 
@@ -267,7 +270,7 @@ class LocalKubelet:
         shutil.rmtree(self.root_dir, ignore_errors=True)
 
     def _loop(self) -> None:
-        from ..k8s.apiserver import ADDED, DELETED
+        from ..k8s.apiserver import ADDED, DELETED, MODIFIED
         while not self._stop.is_set():
             ev = self._watch.next(timeout=0.1)
             if ev is None:
@@ -276,7 +279,9 @@ class LocalKubelet:
             if self.namespace is not None and pod.metadata.namespace != self.namespace:
                 continue
             key = (pod.metadata.namespace, pod.metadata.name)
-            if ev.type == ADDED:
+            if ev.type in (ADDED, MODIFIED):
+                # MODIFIED matters for gated pods: removing schedulingGates
+                # (Kueue's unsuspend flow) must start the pod.
                 self._on_pod(pod)
             elif ev.type == DELETED:
                 with self._lock:
