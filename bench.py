@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-101 training throughput per chip.
+
+The reference's only published number is tensorflow-benchmarks ResNet-101
+under Horovod/NCCL: 308.27 images/sec on 2 GPUs = ~154.2 images/sec per
+device (1 worker pod x 2 GPUs, slotsPerWorker=2; /root/reference/
+README.md:96-143,197-212 — batch 64/device, synthetic data, SGD).
+
+Here: the same workload TPU-native — Flax ResNet-101, bfloat16 compute,
+batch 64, synthetic ImageNet, SGD+momentum — on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMAGES_PER_SEC_PER_DEVICE = 154.2  # README.md:197-210
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mpi_operator_tpu.models.resnet import (ResNet, cross_entropy_loss,
+                                                resnet101_config)
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    model = ResNet(resnet101_config())
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.bfloat16)
+    labels = jax.random.randint(rng, (batch,), 0, 1000)
+    variables = model.init(jax.random.PRNGKey(1), images, train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            return cross_entropy_loss(logits, labels), updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(lambda a, b: a + b, params,
+                                            updates)
+        return new_params, new_stats, new_opt, loss
+
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    # A host read (not just block_until_ready) forces the dispatch chain on
+    # tunneled/remote TPU platforms where readiness is reported eagerly.
+    float(loss)
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    # loss at step N depends on params from step N-1, so fetching the final
+    # loss forces every step in the chain.
+    float(loss)
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = batch * steps / elapsed
+    n_chips = jax.local_device_count()
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet101_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_DEVICE,
+                             3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+"""JAX/Flax workload model families.
+
+Parity targets (SURVEY.md §2.2): the reference ships Horovod TF MNIST and
+tensorflow-benchmarks ResNet-101 as example workloads, plus the mpi-pi
+smoke test.  Here: MNIST CNN, ResNet-50/101, and the Llama-2 family with
+dp/fsdp/tp/sp sharding — all driven through the same MPIJob JAX bootstrap.
+"""
+
+from .llama import LlamaConfig, LlamaModel, llama_param_specs  # noqa: F401
+from .resnet import ResNet, resnet50_config, resnet101_config  # noqa: F401
+from .mnist import MnistCNN  # noqa: F401

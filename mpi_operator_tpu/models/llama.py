@@ -1,0 +1,244 @@
+"""Llama-2 model family, TPU-first.
+
+Decoder-only transformer (RMSNorm, RoPE, SwiGLU, optional GQA) written
+for the (dp, fsdp, tp, sp) mesh: parameters carry Megatron-style
+PartitionSpecs (vocab/heads/hidden over 'tp', the other matmul dim over
+'fsdp'), activations are constrained to P((dp, fsdp), 'sp', ...) so long
+sequences shard over the ring, and attention dispatches to the Pallas
+flash kernel (single shard) or ring attention (sp > 1).  bfloat16
+compute, float32 params/accumulation — MXU-friendly by construction.
+
+Capability target: the "JAX/Flax Llama-2-7B data-parallel (multi-host
+v5e-32 slice)" config tracked in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.ring_attention import ring_attention
+from ..parallel.mesh import BATCH_AXES
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: Optional[int] = None          # None -> MHA (llama2-7b)
+    hidden_dim: Optional[int] = None          # None -> llama2 rule
+    norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    max_seq_len: int = 4096
+    dtype: Any = jnp.bfloat16                 # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False                       # checkpoint each block
+    attention_impl: str = "auto"              # 'auto'|'pallas'|'xla'
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.hidden_dim is not None:
+            return self.hidden_dim
+        # llama2: 4*dim -> 2/3 -> round up to multiple of 256.
+        hidden = int(2 * (4 * self.dim) / 3)
+        return 256 * ((hidden + 255) // 256)
+
+
+def llama2_7b(**overrides) -> LlamaConfig:
+    return LlamaConfig(**{**dict(vocab_size=32000, dim=4096, n_layers=32,
+                                 n_heads=32, max_seq_len=4096), **overrides})
+
+
+def llama2_tiny(**overrides) -> LlamaConfig:
+    """Test/dryrun config: same architecture, toy widths (divisible by
+    tp<=4, heads by 4, vocab by 8)."""
+    return LlamaConfig(**{**dict(vocab_size=256, dim=128, n_layers=2,
+                                 n_heads=4, max_seq_len=256,
+                                 dtype=jnp.float32), **overrides})
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding on [B, S, H, D] with positions [S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S,d/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           self.param_dtype)
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                                  + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+def _constrain(x, *spec_axes):
+    """with_sharding_constraint if a mesh is active (no-op otherwise)."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec_axes))
+    except (ValueError, RuntimeError):
+        return x
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        b, s, _ = x.shape
+        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+            features=feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name)
+        q = dense((cfg.n_heads, cfg.head_dim), "wq")(x)
+        k = dense((cfg.kv_heads, cfg.head_dim), "wk")(x)
+        v = dense((cfg.kv_heads, cfg.head_dim), "wv")(x)
+
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        if cfg.kv_heads != cfg.n_heads:  # GQA: repeat KV groups
+            repeat = cfg.n_heads // cfg.kv_heads
+            k = jnp.repeat(k, repeat, axis=2)
+            v = jnp.repeat(v, repeat, axis=2)
+
+        q = _constrain(q, BATCH_AXES, "sp", "tp", None)
+        k = _constrain(k, BATCH_AXES, "sp", "tp", None)
+        v = _constrain(v, BATCH_AXES, "sp", "tp", None)
+
+        sp_size = 1
+        if self.mesh is not None:
+            sp_size = self.mesh.shape.get("sp", 1)
+        if sp_size > 1:
+            out = ring_attention(q, k, v, self.mesh, causal=True)
+        else:
+            out = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+
+        out = nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              name="wo")(out)
+        return _constrain(out, BATCH_AXES, "sp", None)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            features=feats, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name)
+        gate = dense(cfg.ffn_dim, "w1")(x)
+        up = dense(cfg.ffn_dim, "w3")(x)
+        h = nn.silu(gate) * up
+        h = _constrain(h, BATCH_AXES, "sp", "tp")
+        out = dense(cfg.dim, "w2")(h)
+        return _constrain(out, BATCH_AXES, "sp", None)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        h = x + LlamaAttention(cfg, self.mesh, name="attention")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x),
+            positions)
+        out = h + LlamaMLP(cfg, name="feed_forward")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h))
+        return out
+
+
+class LlamaModel(nn.Module):
+    """Causal LM: tokens [B, S] -> logits [B, S, vocab]."""
+    config: LlamaConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        s = tokens.shape[1]
+        positions = jnp.arange(s)
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="tok_embeddings")(tokens)
+        x = _constrain(x, BATCH_AXES, "sp", None)
+
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, self.mesh, name=f"layers_{i}")(x, positions)
+
+        x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          param_dtype=cfg.param_dtype, name="output")(x)
+        return _constrain(logits, BATCH_AXES, "sp", "tp")
+
+
+def llama_param_specs(config: LlamaConfig):
+    """PartitionSpec pytree matching LlamaModel params: Megatron sharding —
+    head/hidden/vocab dims over 'tp', the opposite matmul dim over 'fsdp'
+    (ZeRO-3), norms replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    attn = {
+        "wq": {"kernel": P("fsdp", "tp", None)},
+        "wk": {"kernel": P("fsdp", "tp", None)},
+        "wv": {"kernel": P("fsdp", "tp", None)},
+        "wo": {"kernel": P("tp", None, "fsdp")},
+    }
+    block = {
+        "attention": attn,
+        "attention_norm": {"scale": P(None)},
+        "feed_forward": {
+            "w1": {"kernel": P("fsdp", "tp")},
+            "w3": {"kernel": P("fsdp", "tp")},
+            "w2": {"kernel": P("tp", "fsdp")},
+        },
+        "ffn_norm": {"scale": P(None)},
+    }
+    params = {f"layers_{i}": block for i in range(config.n_layers)}
+    params["tok_embeddings"] = {"embedding": P("tp", "fsdp")}
+    params["norm"] = {"scale": P(None)}
+    params["output"] = {"kernel": P("fsdp", "tp")}
+    return {"params": params}
+
+
+def next_token_loss(logits, tokens):
+    """Shifted cross-entropy: predict tokens[:, 1:] from logits[:, :-1]."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,27 @@
+"""MNIST CNN — Horovod TF MNIST example parity
+(/root/reference/examples/v2beta1/horovod/tensorflow_mnist.py: two conv
+layers + two dense layers trained data-parallel)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    """Images [B, 28, 28, 1] -> logits [B, 10]."""
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (5, 5), name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(1024, name="fc1")(x))
+        x = nn.Dense(10, name="fc2")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,100 @@
+"""ResNet v1.5 (50/101) in Flax — the throughput benchmark flagship.
+
+Parity target: tensorflow-benchmarks ResNet-101 under Horovod, the
+reference's only published number (308.27 images/sec on 2 GPUs,
+README.md:212; job spec examples/v2beta1/tensorflow-benchmarks/
+tensorflow-benchmarks.yaml).  TPU-first choices: NHWC layout (XLA TPU
+conv-native), bfloat16 compute with float32 variables, BatchNorm with
+per-replica statistics (matching Horovod's unsynced BN).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+def resnet50_config(**kw) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def resnet101_config(**kw) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 23, 3), **kw)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="downsample_conv")(residual)
+            residual = norm(name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Images [B, H, W, 3] -> logits [B, num_classes]."""
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="conv_init")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 epsilon=1e-5, dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype,
+                                 name="bn_init")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, block_count in enumerate(cfg.stage_sizes):
+            for block in range(block_count):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(cfg.width * 2 ** stage, strides,
+                                    cfg.dtype, cfg.param_dtype,
+                                    name=f"stage{stage}_block{block}")(
+                                        x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(cfg.num_classes, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def cross_entropy_loss(logits, labels):
+    import jax
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,12 @@
+"""TPU kernels and collective ops for the workload stack.
+
+- ``attention``: causal flash attention — Pallas TPU kernel on the
+  forward hot path (VMEM-blocked online softmax feeding the MXU), exact
+  gradients via custom_vjp.
+- ``ring_attention``: sequence/context parallelism — KV chunks rotate
+  around the 'sp' mesh axis with ppermute (ICI neighbor exchange) while
+  each device attends its local queries (Liu et al., ring attention).
+"""
+
+from .attention import attention, flash_attention  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

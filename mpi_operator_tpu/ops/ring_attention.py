@@ -1,0 +1,100 @@
+"""Ring attention — sequence/context parallelism over the 'sp' mesh axis.
+
+Long-context scaling: queries stay put while K/V chunks rotate around the
+ring with ``jax.lax.ppermute`` (nearest-neighbor ICI traffic), each step
+folding one chunk into an online-softmax accumulator.  Memory per device
+is O(S/n · S/n) and the S x S matrix never materializes globally.  This
+is the TPU-native answer to the reference's "scale processes, not
+sequence length" gap (SURVEY.md §5 "Long-context: absent").
+
+Layout contract: q, k, v are [B, S_local, H, D] shards of the global
+[B, S, H, D] tensors, sharded along S over the 'sp' axis (shard i holds
+positions [i*S_local, (i+1)*S_local)).  Causal masking uses global
+positions, so chunks ahead of the local queries contribute nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_attention(q, k, v, q_offset, kv_offset, scale, causal):
+    """Blockwise attention of local q against one K/V chunk with global
+    causal positions; returns (scores_max, exp_sum, weighted_acc)."""
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                                    # [b,h,q]
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def _ring_body(q, k, v, axis_name: str, scale: float, causal: bool,
+               all_axes: tuple = ()):
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    q_offset = idx * s_local
+
+    b, _, h, d = q.shape
+    m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    if all_axes:
+        # shard_map type system: loop carries must be device-varying like
+        # the loop outputs they join (see shard_map scan-vma docs).
+        m0, l0, acc0 = (jax.lax.pcast(x, all_axes, to="varying")
+                        for x in (m0, l0, acc0))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        m, l, acc, k_cur, v_cur = carry
+        # After t rotations device idx holds chunk (idx - t) mod n.
+        kv_offset = ((idx - t) % n) * s_local
+        cm, cl, cacc = _chunk_attention(q, k_cur, v_cur, q_offset, kv_offset,
+                                        scale, causal)
+        m_new = jnp.maximum(m, cm)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        beta = jnp.where(jnp.isfinite(cm), jnp.exp(cm - m_safe), 0.0)
+        l_new = l * alpha + cl * beta
+        # alpha/beta are [b,h,q]; acc is [b,q,h,d] -> align as [b,q,h,1].
+        acc_new = (acc * jnp.moveaxis(alpha, 1, 2)[..., None]
+                   + cacc * jnp.moveaxis(beta, 1, 2)[..., None])
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, acc_new, k_next, v_next
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    l_safe = jnp.where(l > 0, l, 1.0)
+    out = acc / jnp.moveaxis(l_safe, 1, 2)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp",
+                   causal: bool = True, batch_axes=("dp", "fsdp"),
+                   head_axis: str = "tp"):
+    """Sequence-parallel attention on [B, S, H, D] tensors sharded along S
+    over ``axis_name`` (and batch/heads over the other mesh axes)."""
+    from jax.sharding import PartitionSpec as P
+
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(batch_axes, axis_name, head_axis, None)
+    body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
+                             causal=causal,
+                             all_axes=tuple(mesh.axis_names))
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return fn(q, k, v)

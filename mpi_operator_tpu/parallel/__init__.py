@@ -1,0 +1,13 @@
+"""SPMD parallelism over jax.sharding.Mesh.
+
+The reference delegates all parallelism to the workload (SURVEY.md §2.3);
+its examples use Horovod DP allreduce.  The TPU-native workload stack
+instead scales through one device mesh: data parallelism ('dp'), ZeRO-3
+style parameter sharding ('fsdp'), Megatron tensor parallelism ('tp') and
+ring-attention sequence/context parallelism ('sp') — XLA inserts the
+collectives (psum / all_gather / reduce_scatter / ppermute) over ICI/DCN
+from sharding annotations, replacing NCCL/MPI calls entirely.
+"""
+
+from .mesh import MeshConfig, create_mesh, batch_sharding  # noqa: F401
+from .train import TrainState, build_train_step  # noqa: F401

@@ -21,3 +21,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The sitecustomize hook imports jax at interpreter startup (before this
+# file runs), so env vars alone can arrive too late for the in-process
+# backend.  The config API works any time before first backend init.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
