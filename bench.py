@@ -75,9 +75,9 @@ def main() -> None:
     float(loss)
     elapsed = time.perf_counter() - start
 
-    images_per_sec = batch * steps / elapsed
-    n_chips = jax.local_device_count()
-    per_chip = images_per_sec / n_chips
+    # train_step is a plain single-device jit: it runs on one chip
+    # regardless of how many the host exposes, so throughput IS per-chip.
+    per_chip = batch * steps / elapsed
     print(json.dumps({
         "metric": "resnet101_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),

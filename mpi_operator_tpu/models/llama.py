@@ -98,13 +98,14 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(x.dtype)
 
 
-def _constrain(x, *spec_axes):
-    """with_sharding_constraint if a mesh is active (no-op otherwise)."""
-    from jax.sharding import PartitionSpec as P
-    try:
-        return jax.lax.with_sharding_constraint(x, P(*spec_axes))
-    except (ValueError, RuntimeError):
+def _constrain(x, mesh, *spec_axes):
+    """with_sharding_constraint against an explicit mesh; no-op only when
+    no mesh was provided (so a broken spec fails loudly, never silently)."""
+    if mesh is None:
         return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec_axes)))
 
 
 class LlamaAttention(nn.Module):
@@ -130,9 +131,9 @@ class LlamaAttention(nn.Module):
             k = jnp.repeat(k, repeat, axis=2)
             v = jnp.repeat(v, repeat, axis=2)
 
-        q = _constrain(q, BATCH_AXES, "sp", "tp", None)
-        k = _constrain(k, BATCH_AXES, "sp", "tp", None)
-        v = _constrain(v, BATCH_AXES, "sp", "tp", None)
+        q = _constrain(q, self.mesh, BATCH_AXES, "sp", "tp", None)
+        k = _constrain(k, self.mesh, BATCH_AXES, "sp", "tp", None)
+        v = _constrain(v, self.mesh, BATCH_AXES, "sp", "tp", None)
 
         sp_size = 1
         if self.mesh is not None:
@@ -145,11 +146,12 @@ class LlamaAttention(nn.Module):
         out = nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                               name="wo")(out)
-        return _constrain(out, BATCH_AXES, "sp", None)
+        return _constrain(out, self.mesh, BATCH_AXES, "sp", None)
 
 
 class LlamaMLP(nn.Module):
     config: LlamaConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -160,9 +162,9 @@ class LlamaMLP(nn.Module):
         gate = dense(cfg.ffn_dim, "w1")(x)
         up = dense(cfg.ffn_dim, "w3")(x)
         h = nn.silu(gate) * up
-        h = _constrain(h, BATCH_AXES, "sp", "tp")
+        h = _constrain(h, self.mesh, BATCH_AXES, "sp", "tp")
         out = dense(cfg.dim, "w2")(h)
-        return _constrain(out, BATCH_AXES, "sp", None)
+        return _constrain(out, self.mesh, BATCH_AXES, "sp", None)
 
 
 class LlamaBlock(nn.Module):
@@ -175,7 +177,7 @@ class LlamaBlock(nn.Module):
         h = x + LlamaAttention(cfg, self.mesh, name="attention")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x),
             positions)
-        out = h + LlamaMLP(cfg, name="feed_forward")(
+        out = h + LlamaMLP(cfg, self.mesh, name="feed_forward")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h))
         return out
 
@@ -192,7 +194,7 @@ class LlamaModel(nn.Module):
         positions = jnp.arange(s)
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="tok_embeddings")(tokens)
-        x = _constrain(x, BATCH_AXES, "sp", None)
+        x = _constrain(x, self.mesh, BATCH_AXES, "sp", None)
 
         block = LlamaBlock
         if cfg.remat:
@@ -203,7 +205,7 @@ class LlamaModel(nn.Module):
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype, name="output")(x)
-        return _constrain(logits, BATCH_AXES, "sp", "tp")
+        return _constrain(logits, self.mesh, BATCH_AXES, "sp", "tp")
 
 
 def llama_param_specs(config: LlamaConfig):

@@ -59,8 +59,7 @@ def _ring_body(q, k, v, axis_name: str, scale: float, causal: bool,
                         for x in (m0, l0, acc0))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(t, carry):
-        m, l, acc, k_cur, v_cur = carry
+    def fold(t, m, l, acc, k_cur, v_cur):
         # After t rotations device idx holds chunk (idx - t) mod n.
         kv_offset = ((idx - t) % n) * s_local
         cm, cl, cacc = _chunk_attention(q, k_cur, v_cur, q_offset, kv_offset,
@@ -73,11 +72,20 @@ def _ring_body(q, k, v, axis_name: str, scale: float, causal: bool,
         # alpha/beta are [b,h,q]; acc is [b,q,h,d] -> align as [b,q,h,1].
         acc_new = (acc * jnp.moveaxis(alpha, 1, 2)[..., None]
                    + cacc * jnp.moveaxis(beta, 1, 2)[..., None])
+        return m_new, l_new, acc_new
+
+    def step(t, carry):
+        m, l, acc, k_cur, v_cur = carry
+        m, l, acc = fold(t, m, l, acc, k_cur, v_cur)
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return m_new, l_new, acc_new, k_next, v_next
+        return m, l, acc, k_next, v_next
 
-    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    # n-1 [fold, rotate] steps, then a final fold — no wasted last
+    # ppermute on the hot path.
+    m, l, acc, k_last, v_last = jax.lax.fori_loop(
+        0, n - 1, step, (m0, l0, acc0, k, v))
+    m, l, acc = fold(n - 1, m, l, acc, k_last, v_last)
     l_safe = jnp.where(l > 0, l, 1.0)
     out = acc / jnp.moveaxis(l_safe, 1, 2)[..., None]
     return out.astype(q.dtype)

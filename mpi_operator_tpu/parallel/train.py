@@ -53,8 +53,6 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
 
     step_fn(state, batch) -> (state, metrics) with donated state buffers.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
 

@@ -96,3 +96,26 @@ def test_attention_dispatcher_xla_path(qkv):
                     causal=True, impl="xla")
     ref, _ = _xla_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1]), True)
     np.testing.assert_allclose(out, ref.transpose(0, 2, 1, 3), atol=2e-5)
+
+
+def test_flash_non_divisible_seq_len():
+    """Regression: seq lengths that don't divide the default blocks must
+    pick a valid divisor instead of crashing."""
+    key = jax.random.PRNGKey(3)
+    b, h, s, d = 1, 2, 320, 64
+    q, k, v = [jax.random.normal(kk, (b, h, s, d), jnp.float32)
+               for kk in jax.random.split(key, 3)]
+    ref, _ = _xla_attention(q, k, v, 1.0 / np.sqrt(d), True)
+    out = flash_attention(q, k, v, None, True, 256, 256, True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_attention_dispatcher_falls_back_for_awkward_lengths():
+    """Prime-ish lengths with no usable block divisor use the XLA path."""
+    key = jax.random.PRNGKey(4)
+    q, k, v = [jax.random.normal(kk, (1, 2, 127, 64), jnp.float32)
+               for kk in jax.random.split(key, 3)]
+    qm, km, vm = [t.transpose(0, 2, 1, 3) for t in (q, k, v)]
+    out = attention(qm, km, vm, causal=True, impl="auto")
+    ref, _ = _xla_attention(q, k, v, 1.0 / np.sqrt(64), True)
+    np.testing.assert_allclose(out, ref.transpose(0, 2, 1, 3), atol=2e-5)
