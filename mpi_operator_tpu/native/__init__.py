@@ -1,0 +1,8 @@
+"""ctypes bindings for the native tpucoll collective library.
+
+Gives Python workloads the same ring-allreduce transport the native
+pi example uses, bootstrapped from the operator-injected coordinator env
+(one contract, two transports — see native/tpucoll.cpp).
+"""
+
+from .collective import Collective, build_native, native_build_dir  # noqa: F401

@@ -1,0 +1,94 @@
+"""Build + ctypes wrapper for native/tpucoll.cpp."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_BUILD_LOCK = threading.Lock()
+
+
+def native_build_dir() -> str:
+    return os.path.join(_NATIVE_DIR, "build")
+
+
+def build_native() -> str:
+    """Build libtpucoll.so + pi_native via make (idempotent); returns the
+    build dir."""
+    with _BUILD_LOCK:
+        build = native_build_dir()
+        lib = os.path.join(build, "libtpucoll.so")
+        exe = os.path.join(build, "pi_native")
+        srcs = [os.path.join(_NATIVE_DIR, f)
+                for f in ("tpucoll.cpp", "pi_native.cpp", "Makefile")]
+        newest_src = max(os.path.getmtime(s) for s in srcs)
+        if all(os.path.exists(p) and os.path.getmtime(p) >= newest_src
+               for p in (lib, exe)):
+            return build
+        proc = subprocess.run(["make", "-C", _NATIVE_DIR],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+        return build
+
+
+class Collective:
+    """Process-group handle over libtpucoll (ring allreduce over TCP)."""
+
+    def __init__(self, rank: Optional[int] = None,
+                 world: Optional[int] = None,
+                 coordinator: Optional[str] = None,
+                 timeout_ms: int = 60_000):
+        from ..api import constants
+
+        rank = rank if rank is not None else int(
+            os.environ.get(constants.JAX_PROCESS_ID_ENV, "0"))
+        world = world if world is not None else int(
+            os.environ.get(constants.JAX_NUM_PROCESSES_ENV, "1"))
+        coordinator = coordinator or os.environ.get(
+            constants.JAX_COORDINATOR_ADDRESS_ENV, "127.0.0.1:8476")
+
+        lib_path = os.path.join(build_native(), "libtpucoll.so")
+        self._lib = ctypes.CDLL(lib_path)
+        self._lib.tc_init.argtypes = [ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_char_p, ctypes.c_int]
+        self._lib.tc_allreduce_double.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long]
+        self._lib.tc_broadcast_double.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_int]
+
+        rc = self._lib.tc_init(rank, world, coordinator.encode(), timeout_ms)
+        if rc != 0:
+            raise RuntimeError(f"tc_init failed (rank={rank}, world={world},"
+                               f" coordinator={coordinator})")
+        self.rank = rank
+        self.world = world
+
+    def allreduce(self, values):
+        """Sum-allreduce a sequence of floats; returns a list."""
+        arr = (ctypes.c_double * len(values))(*values)
+        rc = self._lib.tc_allreduce_double(arr, len(values))
+        if rc != 0:
+            raise RuntimeError("allreduce failed")
+        return list(arr)
+
+    def broadcast(self, values, root: int = 0):
+        arr = (ctypes.c_double * len(values))(*values)
+        rc = self._lib.tc_broadcast_double(arr, len(values), root)
+        if rc != 0:
+            raise RuntimeError("broadcast failed")
+        return list(arr)
+
+    def barrier(self) -> None:
+        if self._lib.tc_barrier() != 0:
+            raise RuntimeError("barrier failed")
+
+    def finalize(self) -> None:
+        self._lib.tc_finalize()

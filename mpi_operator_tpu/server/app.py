@@ -1,0 +1,145 @@
+"""Operator server process.
+
+Parity with /root/reference/cmd/mpi-operator/app/server.go:79-314 +
+cmd/mpi-operator/main.go: flag parsing, client construction, CRD
+existence check, healthz endpoint, optional /metrics endpoint, leader
+election gating the controller, namespace scoping, graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import os
+import socket
+import threading
+import uuid
+from typing import Optional
+
+from .. import version
+from ..api import constants
+from ..controller.controller import MPIJobController
+from ..controller.metrics import new_operator_metrics
+from ..controller.podgroup import new_pod_group_ctrl
+from ..k8s.apiserver import Clientset
+from .leader_election import LeaderElector
+from .options import ServerOption, parse_options
+
+logger = logging.getLogger("mpi_operator_tpu.server")
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "mpi-operator-tpu"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_GET(self):
+        app: "OperatorApp" = self.server.app  # type: ignore[attr-defined]
+        if self.path == "/healthz":
+            # Wired to leader-election liveness (server.go:188-204).
+            healthy = app.healthy()
+            self._respond(200 if healthy else 500,
+                          b"ok" if healthy else b"unhealthy")
+        elif self.path == "/metrics" and app.opt.monitoring_port:
+            body = app.metrics["registry"].expose().encode()
+            self._respond(200, body, "text/plain; version=0.0.4")
+        elif self.path == "/version":
+            self._respond(200, json.dumps(version.info()).encode(),
+                          "application/json")
+        else:
+            self._respond(404, b"not found")
+
+    def _respond(self, code: int, body: bytes,
+                 content_type: str = "text/plain"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class OperatorApp:
+    """app.Run equivalent (server.go:79-188)."""
+
+    def __init__(self, opt: ServerOption, clientset: Optional[Clientset] = None):
+        self.opt = opt
+        self.client = clientset or Clientset()
+        self.metrics = new_operator_metrics()
+        self.controller: Optional[MPIJobController] = None
+        self._http: Optional[http.server.ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self.elector = LeaderElector(
+            self.client, identity=identity,
+            namespace=opt.lock_namespace or opt.namespace or "default",
+            on_started_leading=self._start_controller,
+            on_stopped_leading=self._stop_controller)
+
+    # -- health -------------------------------------------------------------
+    def healthy(self) -> bool:
+        return self.elector._thread is not None and \
+            self.elector._thread.is_alive()
+
+    # -- CRD existence check (server.go:121-124,302-314) --------------------
+    def check_crd_exists(self) -> bool:
+        """With the in-memory API server the MPIJob kind always exists;
+        against a real cluster this probes the discovery endpoint."""
+        try:
+            self.client.mpi_jobs(self.opt.namespace or "default").list()
+            return True
+        except Exception as exc:
+            logger.error("CRD check failed: %s", exc)
+            return False
+
+    # -- lifecycle ------------------------------------------------------------
+    def _start_controller(self) -> None:
+        logger.info("became leader, starting controller")
+        self.metrics["is_leader"].set(1)
+        pod_group_ctrl = new_pod_group_ctrl(self.opt.gang_scheduling_name,
+                                            self.client)
+        self.controller = MPIJobController(
+            self.client,
+            pod_group_ctrl=pod_group_ctrl,
+            cluster_domain=self.opt.cluster_domain,
+            namespace=self.opt.namespace or None,
+            metrics=self.metrics)
+        self.controller.run(self.opt.threadiness)
+
+    def _stop_controller(self) -> None:
+        logger.warning("lost leadership, stopping controller")
+        self.metrics["is_leader"].set(0)
+        if self.controller is not None:
+            self.controller.stop()
+            self.controller = None
+
+    def start(self) -> "OperatorApp":
+        if not self.check_crd_exists():
+            raise SystemExit(1)
+        port = self.opt.healthz_port
+        if port:
+            self._http = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                         _Handler)
+            self._http.app = self  # type: ignore[attr-defined]
+            self._http_thread = threading.Thread(
+                target=self._http.serve_forever, daemon=True, name="healthz")
+            self._http_thread.start()
+        self.elector.run()
+        return self
+
+    def stop(self) -> None:
+        self.elector.stop()
+        self._stop_controller()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+
+
+def run(argv=None) -> OperatorApp:
+    """main() equivalent (cmd/mpi-operator/main.go:42)."""
+    opt = parse_options(argv)
+    if opt.print_version:
+        version.print_version_and_exit()
+    app = OperatorApp(opt)
+    return app.start()

@@ -1,0 +1,140 @@
+"""Lease-based leader election.
+
+Parity with the reference's leaderelection.RunOrDie setup
+(cmd/mpi-operator/app/server.go:206-253: LeaseLock "mpi-operator",
+leaseDuration 15s / renewDeadline 5s / retryPeriod 3s, release on
+cancel): multiple operator replicas coordinate through a Lease object in
+the API server; only the leader runs the controller.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..k8s.apiserver import Clientset, is_conflict, is_not_found
+from ..k8s.meta import Clock, ObjectMeta
+
+LEASE_NAME = "mpi-operator"
+DEFAULT_LEASE_DURATION = 15.0
+DEFAULT_RENEW_DEADLINE = 5.0
+DEFAULT_RETRY_PERIOD = 3.0
+
+
+@dataclass
+class Lease:
+    api_version: str = "coordination.k8s.io/v1"
+    kind: str = "Lease"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict = field(default_factory=dict)
+
+
+class LeaderElector:
+    def __init__(self, clientset: Clientset, identity: str,
+                 namespace: str = "default",
+                 name: str = LEASE_NAME,
+                 lease_duration: float = DEFAULT_LEASE_DURATION,
+                 renew_deadline: float = DEFAULT_RENEW_DEADLINE,
+                 retry_period: float = DEFAULT_RETRY_PERIOD,
+                 on_started_leading: Optional[Callable] = None,
+                 on_stopped_leading: Optional[Callable] = None,
+                 clock: Optional[Clock] = None):
+        self.client = clientset
+        self.identity = identity
+        self.namespace = namespace
+        self.name = name
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.clock = clock or Clock()
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lease manipulation -------------------------------------------------
+    def _try_acquire_or_renew(self) -> bool:
+        now = self.clock.now()
+        leases = self.client.leases(self.namespace)
+        try:
+            lease = leases.get(self.name)
+        except Exception as exc:
+            if not is_not_found(exc):
+                return False
+            lease = Lease(metadata=ObjectMeta(name=self.name,
+                                              namespace=self.namespace),
+                          spec={"holderIdentity": self.identity,
+                                "acquireTime": now.isoformat(),
+                                "renewTime": now.isoformat(),
+                                "leaseDurationSeconds": self.lease_duration})
+            try:
+                leases.create(lease)
+                return True
+            except Exception:
+                return False
+
+        holder = lease.spec.get("holderIdentity")
+        renew = lease.spec.get("renewTime")
+        expired = True
+        if renew is not None:
+            import datetime
+            last = datetime.datetime.fromisoformat(renew)
+            expired = (now - last).total_seconds() > self.lease_duration
+        if holder != self.identity and not expired:
+            return False
+        lease.spec["holderIdentity"] = self.identity
+        lease.spec["renewTime"] = now.isoformat()
+        if holder != self.identity:
+            lease.spec["acquireTime"] = now.isoformat()
+        try:
+            leases.update(lease)
+            return True
+        except Exception as exc:
+            if is_conflict(exc):
+                return False
+            raise
+
+    def release(self) -> None:
+        """Voluntarily release on shutdown (ReleaseOnCancel,
+        server.go:236-239)."""
+        if not self.is_leader:
+            return
+        try:
+            lease = self.client.leases(self.namespace).get(self.name)
+            if lease.spec.get("holderIdentity") == self.identity:
+                lease.spec["holderIdentity"] = ""
+                self.client.leases(self.namespace).update(lease)
+        except Exception:
+            pass
+        self.is_leader = False
+
+    # -- run loop ------------------------------------------------------------
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="leader-elector")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            acquired = self._try_acquire_or_renew()
+            if acquired and not self.is_leader:
+                self.is_leader = True
+                if self.on_started_leading:
+                    self.on_started_leading()
+            elif not acquired and self.is_leader:
+                # Lost the lease (leaderelection fatal path,
+                # server.go:240-244).
+                self.is_leader = False
+                if self.on_stopped_leading:
+                    self.on_stopped_leading()
+            interval = (self.renew_deadline / 2 if self.is_leader
+                        else self.retry_period)
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self.release()

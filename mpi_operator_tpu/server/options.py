@@ -1,0 +1,80 @@
+"""Server options / flags.
+
+Parity with /root/reference/cmd/mpi-operator/app/options/options.go:31-96
+(ServerOption + AddFlags): namespace (or KUBEFLOW_NAMESPACE env),
+threadiness, monitoring port, gang-scheduling name, lock namespace,
+QPS/burst knobs, cluster domain, plus -version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+
+from ..api import constants
+
+
+@dataclass
+class ServerOption:
+    """options.go:31-59."""
+    kubeconfig: str = ""
+    master_url: str = ""
+    threadiness: int = 2
+    monitoring_port: int = 0
+    print_version: bool = False
+    gang_scheduling_name: str = ""
+    namespace: str = ""                       # "" = all namespaces
+    lock_namespace: str = ""
+    healthz_port: int = 8080
+    cluster_domain: str = ""
+    kube_api_qps: float = 5.0
+    kube_api_burst: int = 10
+    controller_rate_limit: float = 10.0
+    controller_burst: int = 100
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    """AddFlags (options.go:61-96)."""
+    parser.add_argument("--kubeconfig", default="",
+                        help="Path to a kubeconfig. Only required if"
+                             " out-of-cluster.")
+    parser.add_argument("--master", dest="master_url", default="",
+                        help="The address of the API server.")
+    parser.add_argument("--threadiness", type=int, default=2,
+                        help="How many worker goroutines process the work"
+                             " queue.")
+    parser.add_argument("--monitoring-port", type=int, default=0,
+                        help="Port for the metrics endpoint; 0 disables.")
+    parser.add_argument("--version", dest="print_version",
+                        action="store_true", help="Print version and exit.")
+    parser.add_argument("--gang-scheduling", dest="gang_scheduling_name",
+                        default="",
+                        help="Gang scheduler: 'volcano' or a"
+                             " scheduler-plugins scheduler name.")
+    parser.add_argument("--namespace", default="",
+                        help="Namespace to monitor (empty = all; env"
+                             " KUBEFLOW_NAMESPACE).")
+    parser.add_argument("--lock-namespace", default="",
+                        help="Namespace for the leader-election lock.")
+    parser.add_argument("--healthz-port", type=int, default=8080,
+                        help="Port for the healthz endpoint.")
+    parser.add_argument("--cluster-domain", default="",
+                        help="Cluster DNS domain appended to host FQDNs.")
+    parser.add_argument("--kube-api-qps", type=float, default=5.0)
+    parser.add_argument("--kube-api-burst", type=int, default=10)
+    parser.add_argument("--controller-rate-limit", type=float, default=10.0)
+    parser.add_argument("--controller-burst", type=int, default=100)
+
+
+def parse_options(argv=None) -> ServerOption:
+    parser = argparse.ArgumentParser(prog="mpi-operator-tpu")
+    add_flags(parser)
+    ns = parser.parse_args(argv)
+    opt = ServerOption(**{f: getattr(ns, f) for f in
+                          ServerOption.__dataclass_fields__
+                          if hasattr(ns, f)})
+    # Env override (options.go:69).
+    if not opt.namespace:
+        opt.namespace = os.environ.get(constants.ENV_KUBEFLOW_NAMESPACE, "")
+    return opt

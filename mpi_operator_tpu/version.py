@@ -1,0 +1,44 @@
+"""Version stamp.
+
+Parity with /root/reference/pkg/version/version.go:21-45 (ldflags-injected
+Version/GitSHA/Built + PrintVersionAndExit); here populated at import from
+the environment or git metadata when available.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import subprocess
+import sys
+
+VERSION = os.environ.get("MPI_OPERATOR_TPU_VERSION", "v0.1.0")
+
+
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def info() -> dict:
+    """version.Info equivalent."""
+    return {
+        "version": VERSION,
+        "gitSHA": _git_sha(),
+        "goVersion": f"python {platform.python_version()}",
+        "platform": f"{platform.system().lower()}/{platform.machine()}",
+    }
+
+
+def print_version_and_exit() -> None:
+    """PrintVersionAndExit (version.go:38-45)."""
+    i = info()
+    print(f"mpi-operator-tpu {i['version']} (git {i['gitSHA']},"
+          f" {i['goVersion']}, {i['platform']})")
+    sys.exit(0)

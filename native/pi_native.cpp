@@ -1,0 +1,57 @@
+// pi_native — Monte-Carlo pi over the tpucoll ring.
+//
+// Native parity with the reference smoke test
+// (/root/reference/examples/v2beta1/pi/pi.cc:19-52: MPI_Init /
+// Comm_rank / Comm_size / MPI_Reduce(SUM) / MPI_Barrier), but the
+// process group forms from the SAME operator-injected env the JAX path
+// uses (JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / JAX_NUM_PROCESSES) —
+// one bootstrap contract, two transports.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+
+extern "C" {
+int tc_init(int rank, int world, const char* coordinator, int timeout_ms);
+int tc_rank();
+int tc_world();
+int tc_allreduce_double(double* data, long n);
+int tc_barrier();
+void tc_finalize();
+}
+
+int main(int argc, char** argv) {
+  long samples = argc > 1 ? std::atol(argv[1]) : 10'000'000;  // pi.cc:35
+  const char* coord = std::getenv("JAX_COORDINATOR_ADDRESS");
+  const char* rank_s = std::getenv("JAX_PROCESS_ID");
+  const char* world_s = std::getenv("JAX_NUM_PROCESSES");
+  if (!coord || !rank_s || !world_s) {
+    std::fprintf(stderr,
+                 "pi_native: JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / "
+                 "JAX_NUM_PROCESSES must be set (operator-injected)\n");
+    return 2;
+  }
+  int rank = std::atoi(rank_s);
+  int world = std::atoi(world_s);
+  if (tc_init(rank, world, coord, 60'000) != 0) return 1;
+
+  std::mt19937_64 gen(4242 + static_cast<unsigned>(rank));  // pi.cc:27
+  std::uniform_real_distribution<double> dist(0.0, 1.0);
+  long inside = 0;
+  for (long i = 0; i < samples; i++) {
+    double x = dist(gen), y = dist(gen);
+    if (x * x + y * y <= 1.0) inside++;
+  }
+
+  double totals[2] = {static_cast<double>(inside),
+                      static_cast<double>(samples)};
+  if (tc_allreduce_double(totals, 2) != 0) return 1;
+  tc_barrier();
+  if (tc_rank() == 0) {
+    std::printf("workers=%d samples=%.0f pi=%.6f\n", tc_world(), totals[1],
+                4.0 * totals[0] / totals[1]);
+  }
+  tc_finalize();
+  return 0;
+}

@@ -1,0 +1,334 @@
+// tpucoll — minimal native collective library (ring allreduce over TCP).
+//
+// The reference's native layer is MPI itself (examples/v2beta1/pi/pi.cc
+// uses MPI_Init/Comm_rank/Comm_size/MPI_Reduce over OpenMPI's orted+SSH
+// fabric).  The TPU-native framework bootstraps process groups from
+// operator-injected coordinator env instead (JAX_COORDINATOR_ADDRESS /
+// JAX_PROCESS_ID / JAX_NUM_PROCESSES); this library gives NATIVE
+// workloads the same contract without any MPI runtime:
+//
+//   rendezvous: every rank opens a ring listener, registers
+//   (rank, port) with the coordinator (process 0), receives the full
+//   address table, then dials its right neighbor -> TCP ring.
+//   allreduce:  ring reduce-scatter + ring allgather (bandwidth-optimal,
+//   the same schedule ICI collectives use).
+//
+// Exposed C ABI (ctypes-friendly): tc_init, tc_rank, tc_world,
+// tc_allreduce_double (sum), tc_broadcast_double, tc_barrier,
+// tc_finalize.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct PeerAddr {
+  std::string host;
+  int port = 0;
+};
+
+struct State {
+  int rank = -1;
+  int world = 0;
+  int right_fd = -1;  // send to (rank+1)%world
+  int left_fd = -1;   // recv from (rank-1+world)%world
+  bool initialized = false;
+};
+
+State g_state;
+
+int die(const char* what) {
+  std::fprintf(stderr, "tpucoll: %s: %s\n", what, std::strerror(errno));
+  return -1;
+}
+
+int send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return -1;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+int recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return -1;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+int listen_any(int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return die("socket");
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = 0;
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+    return die("bind");
+  if (::listen(fd, 16) < 0) return die("listen");
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  *out_port = ntohs(addr.sin_port);
+  return fd;
+}
+
+int listen_on(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return die("socket");
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+    return die("bind coordinator port");
+  if (::listen(fd, 64) < 0) return die("listen");
+  return fd;
+}
+
+// Dial host:port, retrying while the peer's listener comes up (the
+// analogue of the reference base image's DNS/ssh retry loop,
+// build/base/entrypoint.sh:7-37).
+int dial(const std::string& host, int port, int timeout_ms) {
+  char port_str[16];
+  std::snprintf(port_str, sizeof(port_str), "%d", port);
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  int waited = 0;
+  while (true) {
+    addrinfo* res = nullptr;
+    int fd = -1;
+    if (::getaddrinfo(host.c_str(), port_str, &hints, &res) == 0) {
+      for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+        fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+        ::close(fd);
+        fd = -1;
+      }
+      ::freeaddrinfo(res);
+    }
+    if (fd >= 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    if (waited >= timeout_ms) return -1;
+    ::usleep(100 * 1000);
+    waited += 100;
+  }
+}
+
+struct WireMsg {
+  int32_t rank;
+  int32_t port;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Initialize the process group.  coordinator: "host:port" (process 0
+// binds the port).  Returns 0 on success.
+int tc_init(int rank, int world, const char* coordinator, int timeout_ms) {
+  if (g_state.initialized) return 0;
+  g_state.rank = rank;
+  g_state.world = world;
+  if (world <= 1) {
+    g_state.initialized = true;
+    return 0;
+  }
+
+  std::string coord(coordinator);
+  size_t colon = coord.rfind(':');
+  if (colon == std::string::npos) {
+    std::fprintf(stderr, "tpucoll: coordinator must be host:port\n");
+    return -1;
+  }
+  std::string coord_host = coord.substr(0, colon);
+  int coord_port = std::atoi(coord.c_str() + colon + 1);
+
+  int ring_port = 0;
+  int ring_listen = listen_any(&ring_port);
+  if (ring_listen < 0) return -1;
+
+  std::vector<PeerAddr> table(world);
+  if (rank == 0) {
+    int lfd = listen_on(coord_port);
+    if (lfd < 0) return -1;
+    table[0] = {"127.0.0.1", ring_port};  // self; host unused by self
+    std::vector<int> peer_fds(world, -1);
+    for (int i = 1; i < world; i++) {
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int cfd = ::accept(lfd, reinterpret_cast<sockaddr*>(&peer), &plen);
+      if (cfd < 0) return die("accept");
+      WireMsg msg{};
+      if (recv_all(cfd, &msg, sizeof(msg)) < 0) return die("recv register");
+      char host[INET_ADDRSTRLEN];
+      ::inet_ntop(AF_INET, &peer.sin_addr, host, sizeof(host));
+      table[msg.rank] = {host, msg.port};
+      peer_fds[msg.rank] = cfd;
+    }
+    // Coordinator's own reachable host: peers reached us via the
+    // coordinator DNS name; reuse it for the ring table.
+    table[0].host = coord_host;
+    // Broadcast the table: world entries of (port, host\n).
+    std::string blob;
+    for (int i = 0; i < world; i++) {
+      blob += table[i].host + ":" + std::to_string(table[i].port) + "\n";
+    }
+    uint32_t blob_len = static_cast<uint32_t>(blob.size());
+    for (int i = 1; i < world; i++) {
+      if (send_all(peer_fds[i], &blob_len, sizeof(blob_len)) < 0 ||
+          send_all(peer_fds[i], blob.data(), blob.size()) < 0)
+        return die("send table");
+      ::close(peer_fds[i]);
+    }
+    ::close(lfd);
+  } else {
+    int cfd = dial(coord_host, coord_port, timeout_ms);
+    if (cfd < 0) {
+      std::fprintf(stderr, "tpucoll: cannot reach coordinator %s\n",
+                   coordinator);
+      return -1;
+    }
+    WireMsg msg{static_cast<int32_t>(rank), static_cast<int32_t>(ring_port)};
+    if (send_all(cfd, &msg, sizeof(msg)) < 0) return die("register");
+    uint32_t blob_len = 0;
+    if (recv_all(cfd, &blob_len, sizeof(blob_len)) < 0)
+      return die("recv table len");
+    std::string blob(blob_len, '\0');
+    if (recv_all(cfd, blob.data(), blob_len) < 0) return die("recv table");
+    ::close(cfd);
+    size_t pos = 0;
+    for (int i = 0; i < world; i++) {
+      size_t nl = blob.find('\n', pos);
+      std::string line = blob.substr(pos, nl - pos);
+      pos = nl + 1;
+      size_t c = line.rfind(':');
+      table[i] = {line.substr(0, c), std::atoi(line.c_str() + c + 1)};
+    }
+  }
+
+  // Form the ring: dial right neighbor, accept left neighbor.
+  int right = (rank + 1) % world;
+  g_state.right_fd = dial(table[right].host, table[right].port, timeout_ms);
+  if (g_state.right_fd < 0) {
+    std::fprintf(stderr, "tpucoll: cannot reach right neighbor %d\n", right);
+    return -1;
+  }
+  g_state.left_fd = ::accept(ring_listen, nullptr, nullptr);
+  if (g_state.left_fd < 0) return die("accept left");
+  int one = 1;
+  ::setsockopt(g_state.left_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  ::close(ring_listen);
+  g_state.initialized = true;
+  return 0;
+}
+
+int tc_rank() { return g_state.rank; }
+int tc_world() { return g_state.world; }
+
+// Bandwidth-optimal ring allreduce (sum): reduce-scatter then allgather.
+int tc_allreduce_double(double* data, long n) {
+  if (!g_state.initialized) return -1;
+  int world = g_state.world;
+  int rank = g_state.rank;
+  if (world <= 1 || n == 0) return 0;
+
+  std::vector<long> offs(world + 1);
+  for (int i = 0; i <= world; i++) offs[i] = n * i / world;
+  std::vector<double> recv_buf(offs[1] - offs[0] + n / world + 2);
+
+  auto chunk = [&](int i) { return data + offs[(i % world + world) % world]; };
+  auto chunk_len = [&](int i) {
+    int c = (i % world + world) % world;
+    return offs[c + 1] - offs[c];
+  };
+
+  // reduce-scatter: after world-1 steps, chunk (rank+1)%world is complete
+  // at this rank.
+  for (int s = 0; s < world - 1; s++) {
+    int send_c = rank - s;
+    int recv_c = rank - s - 1;
+    long rl = chunk_len(recv_c);
+    if (send_all(g_state.right_fd, chunk(send_c),
+                 sizeof(double) * chunk_len(send_c)) < 0)
+      return die("allreduce send");
+    if (recv_all(g_state.left_fd, recv_buf.data(), sizeof(double) * rl) < 0)
+      return die("allreduce recv");
+    double* dst = chunk(recv_c);
+    for (long i = 0; i < rl; i++) dst[i] += recv_buf[i];
+  }
+  // allgather: circulate the completed chunks.
+  for (int s = 0; s < world - 1; s++) {
+    int send_c = rank + 1 - s;
+    int recv_c = rank - s;
+    if (send_all(g_state.right_fd, chunk(send_c),
+                 sizeof(double) * chunk_len(send_c)) < 0)
+      return die("allgather send");
+    if (recv_all(g_state.left_fd, chunk(recv_c),
+                 sizeof(double) * chunk_len(recv_c)) < 0)
+      return die("allgather recv");
+  }
+  return 0;
+}
+
+int tc_broadcast_double(double* data, long n, int root) {
+  if (!g_state.initialized) return -1;
+  int world = g_state.world;
+  if (world <= 1 || n == 0) return 0;
+  // Pass around the ring root -> root-1.
+  int rank = g_state.rank;
+  if (rank != root) {
+    if (recv_all(g_state.left_fd, data, sizeof(double) * n) < 0)
+      return die("bcast recv");
+  }
+  if ((rank + 1) % world != root) {
+    if (send_all(g_state.right_fd, data, sizeof(double) * n) < 0)
+      return die("bcast send");
+  }
+  return 0;
+}
+
+int tc_barrier() {
+  double token = 0;
+  return tc_allreduce_double(&token, 1);
+}
+
+void tc_finalize() {
+  if (g_state.right_fd >= 0) ::close(g_state.right_fd);
+  if (g_state.left_fd >= 0) ::close(g_state.left_fd);
+  g_state = State{};
+}
+
+}  // extern "C"

@@ -1,0 +1,132 @@
+"""Server layer tests: options parsing, leader election, healthz/metrics
+endpoints, version — parity with the reference's server bootstrap
+(cmd/mpi-operator/app/server.go)."""
+
+import json
+import time
+import urllib.request
+
+from mpi_operator_tpu import version
+from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.server.app import OperatorApp
+from mpi_operator_tpu.server.leader_election import LeaderElector
+from mpi_operator_tpu.server.options import ServerOption, parse_options
+
+
+def test_options_defaults_and_flags():
+    opt = parse_options([])
+    assert opt.threadiness == 2
+    assert opt.healthz_port == 8080
+    assert opt.monitoring_port == 0
+    opt = parse_options(["--threadiness", "4", "--gang-scheduling",
+                         "volcano", "--namespace", "ml",
+                         "--monitoring-port", "9090",
+                         "--cluster-domain", "cluster.local"])
+    assert opt.threadiness == 4
+    assert opt.gang_scheduling_name == "volcano"
+    assert opt.namespace == "ml"
+    assert opt.monitoring_port == 9090
+    assert opt.cluster_domain == "cluster.local"
+
+
+def test_namespace_env_override(monkeypatch):
+    monkeypatch.setenv("KUBEFLOW_NAMESPACE", "from-env")
+    assert parse_options([]).namespace == "from-env"
+
+
+def test_version_info():
+    info = version.info()
+    assert info["version"].startswith("v")
+    assert "python" in info["goVersion"]
+
+
+def test_leader_election_single_winner_and_failover():
+    cs = Clientset()
+    events = []
+    electors = [
+        LeaderElector(cs, identity=f"op-{i}", namespace="kube-system",
+                      lease_duration=0.5, renew_deadline=0.2,
+                      retry_period=0.05,
+                      on_started_leading=lambda i=i: events.append(("up", i)),
+                      on_stopped_leading=lambda i=i: events.append(("down", i)))
+        for i in range(2)
+    ]
+    for e in electors:
+        e.run()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not any(
+            e.is_leader for e in electors):
+        time.sleep(0.02)
+    leaders = [e for e in electors if e.is_leader]
+    assert len(leaders) == 1
+    leader = leaders[0]
+    other = next(e for e in electors if e is not leader)
+
+    # Leader releases -> the other takes over within a lease duration.
+    leader.stop()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not other.is_leader:
+        time.sleep(0.02)
+    assert other.is_leader
+    other.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read()
+
+
+def test_operator_app_endpoints_and_controller_gating():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    opt = ServerOption(healthz_port=port, monitoring_port=1,
+                       gang_scheduling_name="")
+    app = OperatorApp(opt).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and app.controller is None:
+            time.sleep(0.02)
+        assert app.controller is not None  # leader -> controller running
+
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert status == 200 and body == b"ok"
+
+        status, body = _get(f"http://127.0.0.1:{port}/metrics")
+        assert status == 200
+        assert b"mpi_operator_is_leader 1" in body.replace(b".0", b"")
+
+        status, body = _get(f"http://127.0.0.1:{port}/version")
+        assert status == 200
+        assert json.loads(body)["version"]
+    finally:
+        app.stop()
+
+
+def test_operator_app_processes_jobs_end_to_end():
+    """A full operator app (leader-elected controller) reconciles a
+    submitted MPIJob."""
+    import socket
+    from test_controller import new_mpi_job
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    app = OperatorApp(ServerOption(healthz_port=port)).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and app.controller is None:
+            time.sleep(0.02)
+        job = new_mpi_job(workers=2)
+        app.client.mpi_jobs("default").create(job)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                app.client.jobs("default").get("test-launcher")
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert app.client.jobs("default").get("test-launcher")
+        assert len(app.client.pods("default").list()) == 2
+    finally:
+        app.stop()
