@@ -20,8 +20,13 @@ def native_build_dir() -> str:
 
 def build_native() -> str:
     """Build libtpucoll.so + pi_native via make (idempotent); returns the
-    build dir."""
-    with _BUILD_LOCK:
+    build dir.  Guarded by a file lock: concurrent RANKS are separate
+    processes, so a threading.Lock alone cannot serialize the build."""
+    import fcntl
+    os.makedirs(native_build_dir(), exist_ok=True)
+    lock_path = os.path.join(native_build_dir(), ".build.lock")
+    with _BUILD_LOCK, open(lock_path, "w") as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
         build = native_build_dir()
         lib = os.path.join(build, "libtpucoll.so")
         exe = os.path.join(build, "pi_native")

@@ -42,7 +42,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             healthy = app.healthy()
             self._respond(200 if healthy else 500,
                           b"ok" if healthy else b"unhealthy")
-        elif self.path == "/metrics" and app.opt.monitoring_port:
+        elif self.path == "/metrics":
             body = app.metrics["registry"].expose().encode()
             self._respond(200, body, "text/plain; version=0.0.4")
         elif self.path == "/version":
@@ -69,7 +69,7 @@ class OperatorApp:
         self.metrics = new_operator_metrics()
         self.controller: Optional[MPIJobController] = None
         self._http: Optional[http.server.ThreadingHTTPServer] = None
-        self._http_thread: Optional[threading.Thread] = None
+        self._metrics_http: Optional[http.server.ThreadingHTTPServer] = None
         identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
         self.elector = LeaderElector(
             self.client, identity=identity,
@@ -114,26 +114,37 @@ class OperatorApp:
             self.controller.stop()
             self.controller = None
 
+    def _serve(self, port: int, name: str):
+        # Bind all interfaces: kubelet probes and Prometheus scrape the
+        # pod IP, not loopback (reference listens on :8080 / :monitoring).
+        srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        srv.app = self  # type: ignore[attr-defined]
+        thread = threading.Thread(target=srv.serve_forever, daemon=True,
+                                  name=name)
+        thread.start()
+        return srv
+
     def start(self) -> "OperatorApp":
         if not self.check_crd_exists():
             raise SystemExit(1)
-        port = self.opt.healthz_port
-        if port:
-            self._http = http.server.ThreadingHTTPServer(("127.0.0.1", port),
-                                                         _Handler)
-            self._http.app = self  # type: ignore[attr-defined]
-            self._http_thread = threading.Thread(
-                target=self._http.serve_forever, daemon=True, name="healthz")
-            self._http_thread.start()
+        if self.opt.healthz_port:
+            self._http = self._serve(self.opt.healthz_port, "healthz")
+        # A distinct metrics listener, as in the reference (main.go:29-40
+        # serves /metrics on --monitoring-port when nonzero).
+        if self.opt.monitoring_port and \
+                self.opt.monitoring_port != self.opt.healthz_port:
+            self._metrics_http = self._serve(self.opt.monitoring_port,
+                                             "metrics")
         self.elector.run()
         return self
 
     def stop(self) -> None:
         self.elector.stop()
         self._stop_controller()
-        if self._http is not None:
-            self._http.shutdown()
-            self._http.server_close()
+        for srv in (self._http, self._metrics_http):
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
 
 
 def run(argv=None) -> OperatorApp:

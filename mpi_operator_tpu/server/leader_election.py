@@ -82,7 +82,8 @@ class LeaderElector:
             import datetime
             last = datetime.datetime.fromisoformat(renew)
             expired = (now - last).total_seconds() > self.lease_duration
-        if holder != self.identity and not expired:
+        # A voluntarily-released lease (empty holder) is immediately free.
+        if holder and holder != self.identity and not expired:
             return False
         lease.spec["holderIdentity"] = self.identity
         lease.spec["renewTime"] = now.isoformat()
@@ -105,6 +106,9 @@ class LeaderElector:
             lease = self.client.leases(self.namespace).get(self.name)
             if lease.spec.get("holderIdentity") == self.identity:
                 lease.spec["holderIdentity"] = ""
+                # Drop renewTime too so standbys take over immediately
+                # instead of waiting out the lease duration.
+                lease.spec.pop("renewTime", None)
                 self.client.leases(self.namespace).update(lease)
         except Exception:
             pass
@@ -118,7 +122,13 @@ class LeaderElector:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            acquired = self._try_acquire_or_renew()
+            # Any API failure counts as "did not acquire/renew": a leader
+            # steps down (on_stopped_leading fires) instead of the thread
+            # dying with is_leader stuck True (split-brain guard).
+            try:
+                acquired = self._try_acquire_or_renew()
+            except Exception:
+                acquired = False
             if acquired and not self.is_leader:
                 self.is_leader = True
                 if self.on_started_leading:

@@ -22,9 +22,11 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <string>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -76,6 +78,53 @@ int recv_all(int fd, void* buf, size_t n) {
     }
     p += r;
     n -= static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+// Full-duplex exchange: progress the outgoing send and the incoming recv
+// concurrently via poll.  Every rank sends right while receiving left; a
+// naive send-then-recv deadlocks once a chunk exceeds the combined
+// socket buffering, so ring steps MUST use this.
+int send_recv(int out_fd, const void* sbuf, size_t sn, int in_fd, void* rbuf,
+              size_t rn) {
+  const char* sp = static_cast<const char*>(sbuf);
+  char* rp = static_cast<char*>(rbuf);
+  while (sn > 0 || rn > 0) {
+    pollfd fds[2];
+    nfds_t nfds = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (sn > 0) {
+      send_idx = nfds;
+      fds[nfds++] = {out_fd, POLLOUT, 0};
+    }
+    if (rn > 0) {
+      recv_idx = nfds;
+      fds[nfds++] = {in_fd, POLLIN, 0};
+    }
+    if (::poll(fds, nfds, -1) < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (send_idx >= 0 && (fds[send_idx].revents & (POLLOUT | POLLERR))) {
+      ssize_t w = ::send(out_fd, sp, sn, 0);
+      if (w <= 0) {
+        if (w < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+        return -1;
+      }
+      sp += w;
+      sn -= static_cast<size_t>(w);
+    }
+    if (recv_idx >= 0 &&
+        (fds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t r = ::recv(in_fd, rp, rn, 0);
+      if (r <= 0) {
+        if (r < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+        return -1;
+      }
+      rp += r;
+      rn -= static_cast<size_t>(r);
+    }
   }
   return 0;
 }
@@ -281,24 +330,25 @@ int tc_allreduce_double(double* data, long n) {
     int send_c = rank - s;
     int recv_c = rank - s - 1;
     long rl = chunk_len(recv_c);
-    if (send_all(g_state.right_fd, chunk(send_c),
-                 sizeof(double) * chunk_len(send_c)) < 0)
-      return die("allreduce send");
-    if (recv_all(g_state.left_fd, recv_buf.data(), sizeof(double) * rl) < 0)
-      return die("allreduce recv");
+    if (send_recv(g_state.right_fd, chunk(send_c),
+                  sizeof(double) * chunk_len(send_c), g_state.left_fd,
+                  recv_buf.data(), sizeof(double) * rl) < 0)
+      return die("allreduce exchange");
     double* dst = chunk(recv_c);
     for (long i = 0; i < rl; i++) dst[i] += recv_buf[i];
   }
-  // allgather: circulate the completed chunks.
+  // allgather: circulate the completed chunks.  The received chunk is
+  // staged in recv_buf (recv_c may alias send_c's neighbor ranges only
+  // across iterations, but staging keeps each exchange race-free).
   for (int s = 0; s < world - 1; s++) {
     int send_c = rank + 1 - s;
     int recv_c = rank - s;
-    if (send_all(g_state.right_fd, chunk(send_c),
-                 sizeof(double) * chunk_len(send_c)) < 0)
-      return die("allgather send");
-    if (recv_all(g_state.left_fd, chunk(recv_c),
-                 sizeof(double) * chunk_len(recv_c)) < 0)
-      return die("allgather recv");
+    long rl = chunk_len(recv_c);
+    if (send_recv(g_state.right_fd, chunk(send_c),
+                  sizeof(double) * chunk_len(send_c), g_state.left_fd,
+                  recv_buf.data(), sizeof(double) * rl) < 0)
+      return die("allgather exchange");
+    std::memcpy(chunk(recv_c), recv_buf.data(), sizeof(double) * rl);
   }
   return 0;
 }

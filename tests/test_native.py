@@ -102,3 +102,20 @@ def test_e2e_operator_runs_native_pi(build_dir):
         assert "workers=3" in logs, logs
         pi = float(logs.split("pi=")[1].split()[0])
         assert abs(pi - 3.14159) < 0.02
+
+
+def test_large_buffer_allreduce_no_deadlock(build_dir):
+    """Regression: 1M doubles/rank (2MB chunks at world=4) exceeds socket
+    buffering — requires full-duplex ring exchange."""
+    script = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from mpi_operator_tpu.native import Collective\n"
+        "c = Collective()\n"
+        "n = 1_000_000\n"
+        "out = c.allreduce([float(c.rank)] * n)\n"
+        "expected = float(sum(range(c.world)))\n"
+        "assert out[0] == expected and out[-1] == expected, out[:3]\n"
+        "print('BIG-OK', c.rank)\n"
+        "c.finalize()\n" % REPO_ROOT)
+    outs = _spawn_group(lambda r: [sys.executable, "-c", script], world=4)
+    assert all(rc == 0 and "BIG-OK" in out for rc, out in outs), outs

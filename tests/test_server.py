@@ -76,12 +76,17 @@ def _get(url):
         return resp.status, resp.read()
 
 
-def test_operator_app_endpoints_and_controller_gating():
+def _free_port():
     import socket
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    opt = ServerOption(healthz_port=port, monitoring_port=1,
+        return s.getsockname()[1]
+
+
+def test_operator_app_endpoints_and_controller_gating():
+    port = _free_port()
+    metrics_port = _free_port()
+    opt = ServerOption(healthz_port=port, monitoring_port=metrics_port,
                        gang_scheduling_name="")
     app = OperatorApp(opt).start()
     try:
@@ -93,7 +98,8 @@ def test_operator_app_endpoints_and_controller_gating():
         status, body = _get(f"http://127.0.0.1:{port}/healthz")
         assert status == 200 and body == b"ok"
 
-        status, body = _get(f"http://127.0.0.1:{port}/metrics")
+        # Metrics served on the dedicated monitoring port (main.go:29-40).
+        status, body = _get(f"http://127.0.0.1:{metrics_port}/metrics")
         assert status == 200
         assert b"mpi_operator_is_leader 1" in body.replace(b".0", b"")
 
@@ -130,3 +136,55 @@ def test_operator_app_processes_jobs_end_to_end():
         assert len(app.client.pods("default").list()) == 2
     finally:
         app.stop()
+
+
+def test_operator_app_serves_metrics_on_healthz_port_when_shared():
+    """monitoring_port == healthz_port -> one listener serves both."""
+    port = _free_port()
+    app = OperatorApp(ServerOption(healthz_port=port,
+                                   monitoring_port=port)).start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{port}/metrics")
+        assert status == 200 and b"mpi_operator" in body
+    finally:
+        app.stop()
+
+
+def test_leader_election_survives_api_errors():
+    """Regression: a transient API failure must step the leader down (and
+    let it recover), never kill the elector thread (split-brain guard)."""
+    cs = Clientset()
+    ups, downs = [], []
+    elector = LeaderElector(cs, identity="op", namespace="kube-system",
+                            lease_duration=0.4, renew_deadline=0.2,
+                            retry_period=0.05,
+                            on_started_leading=lambda: ups.append(1),
+                            on_stopped_leading=lambda: downs.append(1))
+    elector.run()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not elector.is_leader:
+        time.sleep(0.02)
+    assert elector.is_leader
+
+    from mpi_operator_tpu.k8s.apiserver import ApiError
+    fail = {"on": True}
+
+    def boom(action):
+        if fail["on"]:
+            return True, ApiError("InternalError", "injected outage")
+        return False, None
+
+    cs.prepend_reactor("update", "Lease", boom)
+    cs.prepend_reactor("get", "Lease", boom)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and elector.is_leader:
+        time.sleep(0.02)
+    assert not elector.is_leader and downs  # stepped down, thread alive
+    assert elector._thread.is_alive()
+
+    fail["on"] = False  # API recovers -> leadership re-acquired
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not elector.is_leader:
+        time.sleep(0.02)
+    assert elector.is_leader and len(ups) == 2
+    elector.stop()
