@@ -1,0 +1,33 @@
+# Build/test entry points (parity with /root/reference/Makefile targets:
+# test, generate, verify-generate, images).
+
+PYTHON ?= python
+
+.PHONY: test test-fast native generate verify-generate bench dryrun clean
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast: native
+	$(PYTHON) -m pytest tests/ -q -x --ignore=tests/test_e2e_local.py
+
+native:
+	$(MAKE) -C native
+
+generate:
+	$(PYTHON) -m mpi_operator_tpu.codegen.crd
+
+verify-generate: generate
+	git diff --exit-code manifests/ deploy/ || \
+		(echo "generated manifests drifted; commit 'make generate' output" \
+		 && exit 1)
+
+bench:
+	$(PYTHON) bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PYTHON) __graft_entry__.py 8
+
+clean:
+	$(MAKE) -C native clean

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Llama training over a (dp, fsdp, tp, sp) mesh — the "JAX/Flax
+Llama-2-7B data-parallel (multi-host v5e-32)" config tracked in
+BASELINE.json.  On a multi-host slice the operator injects coordinator
+env, jax.distributed forms the global mesh over ICI/DCN, and this script
+is identical on 1 chip or 32.
+
+--config tiny runs anywhere (tests/dryrun); --config 7b expects a slice.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="tiny", choices=["tiny", "7b"])
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--batch-per-dp", type=int, default=2)
+    parser.add_argument("--seq-len", type=int, default=0,
+                        help="0 = config max_seq_len")
+    parser.add_argument("--dp", type=int, default=-1)
+    parser.add_argument("--fsdp", type=int, default=1)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--remat", action="store_true")
+    args = parser.parse_args()
+
+    from mpi_operator_tpu.bootstrap import initialize_from_env
+    initialize_from_env()
+
+    import jax
+    import optax
+
+    from mpi_operator_tpu.models.llama import (LlamaModel, llama2_7b,
+                                               llama2_tiny,
+                                               llama_param_specs,
+                                               next_token_loss)
+    from mpi_operator_tpu.parallel.mesh import (MeshConfig, create_mesh,
+                                                seq_batch_sharding)
+    from mpi_operator_tpu.parallel.train import build_train_step
+
+    mesh = create_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp,
+                                  sp=args.sp))
+    cfg = llama2_7b(remat=args.remat) if args.config == "7b" \
+        else llama2_tiny(remat=args.remat)
+    model = LlamaModel(cfg, mesh=mesh)
+
+    dp_total = mesh.shape["dp"] * mesh.shape["fsdp"]
+    batch = args.batch_per_dp * dp_total
+    seq = args.seq_len or cfg.max_seq_len
+
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens[:1, :8])
+
+    def loss_fn(params, batch):
+        return next_token_loss(model.apply(params, batch), batch)
+
+    with mesh:
+        init_fn, step_fn = build_train_step(
+            loss_fn, optax.adamw(3e-4), mesh,
+            param_specs=llama_param_specs(cfg), remat=False)
+        state = init_fn(params)
+        tokens = jax.device_put(tokens, seq_batch_sharding(mesh))
+        state, metrics = step_fn(state, tokens)  # compile
+        float(metrics["loss"])
+        start = time.perf_counter()
+        for _ in range(args.steps):
+            state, metrics = step_fn(state, tokens)
+        final_loss = float(metrics["loss"])
+        elapsed = time.perf_counter() - start
+
+    tokens_per_sec = batch * seq * args.steps / elapsed
+    if jax.process_index() == 0:
+        print(f"mesh dp={mesh.shape['dp']} fsdp={mesh.shape['fsdp']}"
+              f" tp={mesh.shape['tp']} sp={mesh.shape['sp']}")
+        print(f"tokens/sec: {tokens_per_sec:.0f} loss={final_loss:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

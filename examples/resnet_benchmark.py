@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""ResNet throughput benchmark — tensorflow-benchmarks parity
+(/root/reference/examples/v2beta1/tensorflow-benchmarks/
+tensorflow-benchmarks.yaml: tf_cnn_benchmarks --model=resnet101
+--batch_size=64 --variable_update=horovod): synthetic ImageNet, SGD,
+bf16, data-parallel over every device of every process, reports
+images/sec total and per chip.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet101",
+                        choices=["resnet50", "resnet101"])
+    parser.add_argument("--batch-per-device", type=int, default=64)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=5)
+    args = parser.parse_args()
+
+    from mpi_operator_tpu.bootstrap import initialize_from_env
+    initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mpi_operator_tpu.models.resnet import (ResNet, cross_entropy_loss,
+                                                resnet50_config,
+                                                resnet101_config)
+    from mpi_operator_tpu.parallel.mesh import MeshConfig, batch_sharding, \
+        create_mesh
+
+    mesh = create_mesh(MeshConfig(dp=-1))
+    n_devices = len(jax.devices())
+    batch = args.batch_per_device * n_devices
+
+    cfg = (resnet101_config() if args.model == "resnet101"
+           else resnet50_config())
+    model = ResNet(cfg)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.bfloat16)
+    labels = jax.random.randint(rng, (batch,), 0, 1000)
+    variables = model.init(jax.random.PRNGKey(1), images[:2], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+
+    with mesh:
+        images = jax.device_put(images, batch_sharding(mesh, extra_dims=3))
+        labels = jax.device_put(labels, batch_sharding(mesh, extra_dims=0))
+
+        @jax.jit
+        def train_step(params, batch_stats, opt_state, images, labels):
+            def loss_fn(p):
+                logits, updates = model.apply(
+                    {"params": p, "batch_stats": batch_stats}, images,
+                    train=True, mutable=["batch_stats"])
+                return (cross_entropy_loss(logits, labels),
+                        updates["batch_stats"])
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = jax.tree_util.tree_map(lambda a, b: a + b, params,
+                                                updates)
+            return new_params, new_stats, new_opt, loss
+
+        for _ in range(args.warmup):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+        float(loss)
+        start = time.perf_counter()
+        for _ in range(args.steps):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+        float(loss)
+        elapsed = time.perf_counter() - start
+
+    total = batch * args.steps / elapsed
+    if jax.process_index() == 0:
+        print(f"total images/sec: {total:.2f}")
+        print(f"images/sec/chip: {total / n_devices:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import datetime
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Dict, List, Optional
 
 from ..k8s.meta import ObjectMeta
 from ..k8s.core import PodTemplateSpec
@@ -56,7 +56,7 @@ class MPIJobSpec:
     slots_per_worker: Optional[int] = None
     run_launcher_as_worker: Optional[bool] = None
     run_policy: RunPolicy = field(default_factory=RunPolicy)
-    mpi_replica_specs: dict = field(default_factory=dict)  # type -> ReplicaSpec
+    mpi_replica_specs: Dict[str, ReplicaSpec] = field(default_factory=dict)
     ssh_auth_mount_path: str = ""
     launcher_creation_policy: str = ""
     mpi_implementation: str = ""
@@ -85,8 +85,8 @@ class ReplicaStatus:
 @dataclass
 class JobStatus:
     """types.go:226-255."""
-    conditions: list = field(default_factory=list)
-    replica_statuses: dict = field(default_factory=dict)  # type -> ReplicaStatus
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
     start_time: Optional[datetime.datetime] = None
     completion_time: Optional[datetime.datetime] = None
     last_reconcile_time: Optional[datetime.datetime] = None

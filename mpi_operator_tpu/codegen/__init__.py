@@ -1,0 +1,11 @@
+"""Code/manifest generation pipeline.
+
+Parity with the reference's codegen (hack/update-codegen.sh +
+controller-gen CRD + openapi swagger, SURVEY.md §2 #12): here the typed
+dataclasses are the single source of truth, and this package derives the
+CRD openAPIV3Schema, RBAC, Deployment and all-in-one deploy manifest from
+them.  `make generate` regenerates; `make verify-generate` (and the test
+suite) fails on drift.
+"""
+
+from .crd import generate_manifests, mpijob_crd  # noqa: F401

@@ -1,0 +1,281 @@
+"""CRD + deploy manifest generation from the API dataclasses.
+
+The reference's equivalents: controller-gen producing
+manifests/base/kubeflow.org_mpijobs.yaml (8,947 lines of openAPIV3Schema)
+and the kustomize base (deployment, RBAC) flattened into
+deploy/v2beta1/mpi-operator.yaml.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import os
+import typing
+
+from ..api import constants
+from ..api.types import MPIJob
+from ..k8s.meta import _camel  # serialization name rules
+
+OPERATOR_IMAGE = "mpioperator/mpi-operator-tpu:latest"
+
+
+# ---------------------------------------------------------------------------
+# dataclass -> openAPIV3Schema
+# ---------------------------------------------------------------------------
+
+_SCALARS = {
+    str: {"type": "string"},
+    int: {"type": "integer"},
+    float: {"type": "number"},
+    bool: {"type": "boolean"},
+    bytes: {"type": "string", "format": "byte"},
+    datetime.datetime: {"type": "string", "format": "date-time"},
+}
+
+_ENUMS = {
+    ("MPIJobSpec", "mpi_implementation"): list(constants.VALID_IMPLEMENTATIONS),
+    ("RunPolicy", "clean_pod_policy"): list(constants.VALID_CLEAN_POD_POLICIES),
+    ("ReplicaSpec", "restart_policy"): ["Always", "OnFailure", "Never",
+                                        "ExitCode"],
+    ("MPIJobSpec", "launcher_creation_policy"): [
+        constants.LAUNCHER_CREATION_AT_STARTUP,
+        constants.LAUNCHER_CREATION_WAIT_FOR_WORKERS_READY],
+}
+
+
+def _schema_for(ftype, owner: str = "", fname: str = "",
+                seen: tuple = ()) -> dict:
+    origin = typing.get_origin(ftype)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(ftype) if a is not type(None)]
+        if len(args) == 1:
+            return _schema_for(args[0], owner, fname, seen)
+        return {"x-kubernetes-preserve-unknown-fields": True}
+    if origin in (list, tuple):
+        args = typing.get_args(ftype)
+        item = _schema_for(args[0], owner, fname, seen) if args else \
+            {"x-kubernetes-preserve-unknown-fields": True}
+        return {"type": "array", "items": item}
+    if origin is dict or ftype is dict:
+        args = typing.get_args(ftype)
+        if len(args) == 2:
+            return {"type": "object",
+                    "additionalProperties": _schema_for(args[1], owner,
+                                                        fname, seen)}
+        return {"type": "object",
+                "x-kubernetes-preserve-unknown-fields": True}
+    if ftype in _SCALARS:
+        schema = dict(_SCALARS[ftype])
+        enum = _ENUMS.get((owner, fname))
+        if enum:
+            schema["enum"] = enum
+        return schema
+    if ftype is typing.Any or ftype is object:
+        return {"x-kubernetes-preserve-unknown-fields": True}
+    if dataclasses.is_dataclass(ftype):
+        if ftype.__name__ in seen:  # recursion guard
+            return {"type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True}
+        return _dataclass_schema(ftype, seen + (ftype.__name__,))
+    return {"x-kubernetes-preserve-unknown-fields": True}
+
+
+def _dataclass_schema(cls, seen: tuple = ()) -> dict:
+    hints = typing.get_type_hints(cls)
+    props = {}
+    for f in dataclasses.fields(cls):
+        if f.name in ("api_version", "kind"):
+            props[_camel(f.name)] = {"type": "string"}
+            continue
+        props[_camel(f.name)] = _schema_for(hints.get(f.name, typing.Any),
+                                            cls.__name__, f.name, seen)
+    doc = (cls.__doc__ or "").strip().split("\n")[0]
+    schema = {"type": "object", "properties": props}
+    if doc:
+        schema["description"] = doc
+    return schema
+
+
+def mpijob_crd() -> dict:
+    """The CRD object (manifests/base/kubeflow.org_mpijobs.yaml parity)."""
+    # mpiReplicaSpecs is a dict[str, ReplicaSpec]; encode the value type.
+    from ..api.types import ReplicaSpec
+    schema = _dataclass_schema(MPIJob)
+    schema["properties"]["spec"]["properties"]["mpiReplicaSpecs"] = {
+        "type": "object",
+        "additionalProperties": _dataclass_schema(ReplicaSpec,
+                                                  ("ReplicaSpec",)),
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"mpijobs.{constants.API_GROUP}"},
+        "spec": {
+            "group": constants.API_GROUP,
+            "names": {"kind": constants.KIND, "listKind": "MPIJobList",
+                      "plural": "mpijobs", "singular": "mpijob"},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": constants.API_VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": schema},
+            }],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Deploy artifacts (manifests/base parity)
+# ---------------------------------------------------------------------------
+
+def service_account() -> dict:
+    return {"apiVersion": "v1", "kind": "ServiceAccount",
+            "metadata": {"name": "mpi-operator", "namespace": "mpi-operator"}}
+
+
+def cluster_role() -> dict:
+    """manifests/base/cluster-role.yaml parity."""
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "mpi-operator"},
+        "rules": [
+            {"apiGroups": [""],
+             "resources": ["configmaps", "secrets", "services"],
+             "verbs": ["create", "list", "watch", "update"]},
+            {"apiGroups": [""], "resources": ["pods"],
+             "verbs": ["create", "get", "list", "watch", "delete",
+                       "update"]},
+            {"apiGroups": [""], "resources": ["events"],
+             "verbs": ["create", "patch"]},
+            {"apiGroups": ["batch"], "resources": ["jobs"],
+             "verbs": ["create", "get", "list", "watch", "update",
+                       "delete"]},
+            {"apiGroups": ["batch"], "resources": ["jobs/status"],
+             "verbs": ["update"]},
+            {"apiGroups": ["kubeflow.org"], "resources": ["mpijobs"],
+             "verbs": ["get", "list", "watch", "update"]},
+            {"apiGroups": ["kubeflow.org"],
+             "resources": ["mpijobs/finalizers", "mpijobs/status"],
+             "verbs": ["update"]},
+            {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"],
+             "verbs": ["create", "get", "update"]},
+            {"apiGroups": ["scheduling.incubator.k8s.io",
+                           "scheduling.sigs.dev",
+                           "scheduling.volcano.sh"],
+             "resources": ["queues", "podgroups"],
+             "verbs": ["create", "get", "list", "watch", "update",
+                       "delete"]},
+            {"apiGroups": ["scheduling.x-k8s.io"],
+             "resources": ["podgroups"],
+             "verbs": ["create", "get", "list", "watch", "update",
+                       "delete"]},
+            {"apiGroups": ["scheduling.k8s.io"],
+             "resources": ["priorityclasses"],
+             "verbs": ["get", "list", "watch"]},
+        ],
+    }
+
+
+def cluster_role_binding() -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "mpi-operator"},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": "mpi-operator"},
+        "subjects": [{"kind": "ServiceAccount", "name": "mpi-operator",
+                      "namespace": "mpi-operator"}],
+    }
+
+
+def deployment() -> dict:
+    """manifests/base/deployment.yaml parity."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "mpi-operator", "namespace": "mpi-operator",
+                     "labels": {"app": "mpi-operator"}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "mpi-operator"}},
+            "template": {
+                "metadata": {"labels": {"app": "mpi-operator"}},
+                "spec": {
+                    "serviceAccountName": "mpi-operator",
+                    "containers": [{
+                        "name": "mpi-operator",
+                        "image": OPERATOR_IMAGE,
+                        "args": ["--monitoring-port", "9090"],
+                        "ports": [{"containerPort": 8080, "name": "healthz"},
+                                  {"containerPort": 9090, "name": "metrics"}],
+                        "livenessProbe": {
+                            "httpGet": {"path": "/healthz", "port": 8080},
+                            "initialDelaySeconds": 5,
+                            "periodSeconds": 10,
+                        },
+                    }],
+                },
+            },
+        },
+    }
+
+
+def namespace() -> dict:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "mpi-operator"}}
+
+
+def kustomization() -> dict:
+    return {"apiVersion": "kustomize.config.k8s.io/v1beta1",
+            "kind": "Kustomization",
+            "resources": ["kubeflow.org_mpijobs.yaml", "namespace.yaml",
+                          "service-account.yaml", "cluster-role.yaml",
+                          "cluster-role-binding.yaml", "deployment.yaml"]}
+
+
+def generate_manifests(repo_root: str) -> list:
+    """Write manifests/base/* and deploy/v2beta1/mpi-operator.yaml;
+    returns the list of written paths."""
+    import yaml
+
+    base = os.path.join(repo_root, "manifests", "base")
+    deploy_dir = os.path.join(repo_root, "deploy", "v2beta1")
+    os.makedirs(base, exist_ok=True)
+    os.makedirs(deploy_dir, exist_ok=True)
+
+    files = {
+        "kubeflow.org_mpijobs.yaml": mpijob_crd(),
+        "namespace.yaml": namespace(),
+        "service-account.yaml": service_account(),
+        "cluster-role.yaml": cluster_role(),
+        "cluster-role-binding.yaml": cluster_role_binding(),
+        "deployment.yaml": deployment(),
+        "kustomization.yaml": kustomization(),
+    }
+    written = []
+    for name, obj in files.items():
+        path = os.path.join(base, name)
+        with open(path, "w") as f:
+            yaml.safe_dump(obj, f, sort_keys=False)
+        written.append(path)
+
+    # All-in-one (deploy/v2beta1/mpi-operator.yaml parity).
+    all_in_one = [files["namespace.yaml"], files["kubeflow.org_mpijobs.yaml"],
+                  files["service-account.yaml"], files["cluster-role.yaml"],
+                  files["cluster-role-binding.yaml"], files["deployment.yaml"]]
+    path = os.path.join(deploy_dir, "mpi-operator.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump_all(all_in_one, f, sort_keys=False)
+    written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for p in generate_manifests(root):
+        print("wrote", p)

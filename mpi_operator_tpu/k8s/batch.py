@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import datetime
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import List, Optional
 
 from .meta import ObjectMeta
 from .core import PodTemplateSpec
@@ -53,7 +53,7 @@ class JobSpec:
 
 @dataclass
 class JobStatus:
-    conditions: list = field(default_factory=list)
+    conditions: List[JobCondition] = field(default_factory=list)
     active: int = 0
     succeeded: int = 0
     failed: int = 0

@@ -8,7 +8,7 @@ The subset of k8s.io/api/core/v1 the operator constructs and inspects
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import List, Optional
 
 from .meta import ObjectMeta
 
@@ -62,14 +62,14 @@ class KeyToPath:
 @dataclass
 class ConfigMapVolumeSource:
     name: str = ""
-    items: list = field(default_factory=list)
+    items: List[KeyToPath] = field(default_factory=list)
     default_mode: Optional[int] = None
 
 
 @dataclass
 class SecretVolumeSource:
     secret_name: str = ""
-    items: list = field(default_factory=list)
+    items: List[KeyToPath] = field(default_factory=list)
     default_mode: Optional[int] = None
 
 
@@ -102,10 +102,10 @@ class Container:
     command: list = field(default_factory=list)
     args: list = field(default_factory=list)
     working_dir: str = ""
-    env: list = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
-    volume_mounts: list = field(default_factory=list)
-    ports: list = field(default_factory=list)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
     security_context: Optional[dict] = None
 
 
@@ -127,9 +127,9 @@ class Toleration:
 
 @dataclass
 class PodSpec:
-    containers: list = field(default_factory=list)
-    init_containers: list = field(default_factory=list)
-    volumes: list = field(default_factory=list)
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
     restart_policy: str = ""
     hostname: str = ""
     subdomain: str = ""
@@ -137,7 +137,7 @@ class PodSpec:
     dns_policy: str = ""
     dns_config: Optional[PodDNSConfig] = None
     node_selector: dict = field(default_factory=dict)
-    tolerations: list = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
     scheduling_gates: list = field(default_factory=list)
     scheduler_name: str = ""
     priority_class_name: str = ""
@@ -177,10 +177,10 @@ class ContainerStatus:
 @dataclass
 class PodStatus:
     phase: str = ""
-    conditions: list = field(default_factory=list)
+    conditions: List[PodCondition] = field(default_factory=list)
     reason: str = ""
     message: str = ""
-    container_statuses: list = field(default_factory=list)
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
     pod_ip: str = ""
     host_ip: str = ""
 
@@ -213,7 +213,7 @@ class ServiceSpec:
     cluster_ip: str = ""
     selector: dict = field(default_factory=dict)
     publish_not_ready_addresses: bool = False
-    ports: list = field(default_factory=list)
+    ports: List[ServicePort] = field(default_factory=list)
 
 
 @dataclass

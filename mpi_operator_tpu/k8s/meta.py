@@ -14,6 +14,7 @@ import dataclasses
 import datetime
 import re
 import threading
+import typing
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -170,7 +171,7 @@ class ObjectMeta:
     deletion_timestamp: Optional[datetime.datetime] = None
     labels: dict = field(default_factory=dict)
     annotations: dict = field(default_factory=dict)
-    owner_references: list = field(default_factory=list)
+    owner_references: typing.List[OwnerReference] = field(default_factory=list)
     finalizers: list = field(default_factory=list)
 
 

@@ -1,0 +1,12 @@
+"""Python SDK for MPIJob.
+
+Parity with /root/reference/sdk/python/v2beta1 (openapi-generated
+V2beta1MPIJob* models + CustomObjectsApi submission, see
+sdk/python/v2beta1/tensorflow-mnist.py:17-19).  Here the typed models ARE
+the framework's API dataclasses — no generation step — and the client
+wraps any Clientset (in-memory LocalCluster or a future HTTP shim), plus
+YAML/dict round-trip and job builder helpers.
+"""
+
+from .client import MPIJobClient  # noqa: F401
+from .builders import new_jax_job, job_from_yaml, job_to_yaml  # noqa: F401

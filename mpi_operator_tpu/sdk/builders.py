@@ -1,0 +1,69 @@
+"""Job construction helpers + YAML round-trip."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import constants
+from ..api.types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy
+from ..k8s.core import Container, PodSpec, PodTemplateSpec
+from ..k8s.meta import ObjectMeta, from_dict, to_dict
+
+
+def new_jax_job(name: str,
+                image: str,
+                command: list,
+                workers: int,
+                namespace: str = "default",
+                slots_per_worker: int = 1,
+                run_launcher_as_worker: bool = True,
+                launcher_command: Optional[list] = None,
+                tpu_chips: int = 0,
+                tpu_topology: str = "",
+                tpu_accelerator: str = "",
+                run_policy: Optional[RunPolicy] = None) -> MPIJob:
+    """Build a TPU-native MPIJob: workers request google.com/tpu chips and
+    GKE topology nodeSelectors; bootstrap rides the JAX coordinator env.
+
+    The analogue of the reference's example YAMLs
+    (examples/v2beta1/pi/pi.yaml) with the JAX implementation.
+    """
+    def pod(cmd, with_tpu: bool) -> PodTemplateSpec:
+        container = Container(name="main", image=image, command=list(cmd))
+        spec = PodSpec(containers=[container])
+        if with_tpu and tpu_chips:
+            container.resources.limits[constants.TPU_RESOURCE] = str(tpu_chips)
+            if tpu_topology:
+                spec.node_selector[
+                    constants.GKE_TPU_TOPOLOGY_NODE_SELECTOR] = tpu_topology
+            if tpu_accelerator:
+                spec.node_selector[
+                    constants.GKE_TPU_ACCELERATOR_NODE_SELECTOR] = \
+                    tpu_accelerator
+        return PodTemplateSpec(spec=spec)
+
+    return MPIJob(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            slots_per_worker=slots_per_worker,
+            run_launcher_as_worker=run_launcher_as_worker,
+            run_policy=run_policy or RunPolicy(),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=pod(launcher_command or command,
+                                 run_launcher_as_worker)),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=pod(command, True)),
+            }))
+
+
+def job_to_yaml(job: MPIJob) -> str:
+    import yaml
+    return yaml.safe_dump(to_dict(job), sort_keys=False)
+
+
+def job_from_yaml(text: str) -> MPIJob:
+    import yaml
+    return from_dict(MPIJob, yaml.safe_load(text))

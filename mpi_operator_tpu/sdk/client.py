@@ -1,0 +1,86 @@
+"""MPIJobClient — user-facing job lifecycle API.
+
+The analogue of the reference SDK's CustomObjectsApi usage
+(sdk/python/v2beta1/tensorflow-mnist.py): create/get/list/delete plus
+wait helpers and condition inspection.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..api import constants
+from ..api.types import MPIJob
+from ..k8s.apiserver import Clientset
+
+
+class MPIJobClient:
+    def __init__(self, clientset: Clientset, namespace: str = "default"):
+        self._cs = clientset
+        self.namespace = namespace
+
+    def _jobs(self, namespace: Optional[str] = None):
+        return self._cs.mpi_jobs(namespace or self.namespace)
+
+    # -- CRUD -------------------------------------------------------------
+    def create(self, job: MPIJob) -> MPIJob:
+        return self._jobs(job.metadata.namespace or None).create(job)
+
+    def get(self, name: str, namespace: Optional[str] = None) -> MPIJob:
+        return self._jobs(namespace).get(name)
+
+    def list(self, namespace: Optional[str] = None) -> list:
+        return self._jobs(namespace).list()
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> None:
+        self._jobs(namespace).delete(name)
+
+    def update(self, job: MPIJob) -> MPIJob:
+        return self._jobs(job.metadata.namespace or None).update(job)
+
+    # -- lifecycle helpers -------------------------------------------------
+    def suspend(self, name: str, namespace: Optional[str] = None) -> MPIJob:
+        job = self.get(name, namespace)
+        job.spec.run_policy.suspend = True
+        return self.update(job)
+
+    def resume(self, name: str, namespace: Optional[str] = None) -> MPIJob:
+        job = self.get(name, namespace)
+        job.spec.run_policy.suspend = False
+        return self.update(job)
+
+    @staticmethod
+    def condition_status(job: MPIJob, cond_type: str) -> Optional[str]:
+        for c in job.status.conditions:
+            if c.type == cond_type:
+                return c.status
+        return None
+
+    def is_succeeded(self, name: str, namespace: Optional[str] = None) -> bool:
+        return self.condition_status(self.get(name, namespace),
+                                     constants.JOB_SUCCEEDED) == "True"
+
+    def wait_for_condition(self, name: str, cond_type: str,
+                           namespace: Optional[str] = None,
+                           timeout: float = 300.0,
+                           poll: float = 0.2) -> MPIJob:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.get(name, namespace)
+            if self.condition_status(job, cond_type) == "True":
+                return job
+            if cond_type != constants.JOB_FAILED and \
+                    self.condition_status(job, constants.JOB_FAILED) == "True":
+                conds = [(c.type, c.status, c.reason, c.message)
+                         for c in job.status.conditions]
+                raise RuntimeError(f"MPIJob {name} failed: {conds}")
+            time.sleep(poll)
+        raise TimeoutError(
+            f"MPIJob {name} did not reach {cond_type} in {timeout}s")
+
+    def wait_for_completion(self, name: str,
+                            namespace: Optional[str] = None,
+                            timeout: float = 300.0) -> MPIJob:
+        return self.wait_for_condition(name, constants.JOB_SUCCEEDED,
+                                       namespace, timeout)

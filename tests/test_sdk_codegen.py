@@ -1,0 +1,121 @@
+"""SDK + codegen tests: builder helpers, YAML round-trip, client
+lifecycle against a live LocalCluster, manifest generation drift guard
+(verify-generate parity, /root/reference/Makefile:96-98)."""
+
+import os
+import sys
+
+import pytest
+import yaml
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.defaults import set_defaults_mpijob
+from mpi_operator_tpu.api.validation import validate_mpijob
+from mpi_operator_tpu.codegen.crd import generate_manifests, mpijob_crd
+from mpi_operator_tpu.sdk import (MPIJobClient, job_from_yaml, job_to_yaml,
+                                  new_jax_job)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_new_jax_job_builder_validates():
+    job = new_jax_job("llama", image="img", command=["python", "train.py"],
+                      workers=8, slots_per_worker=4, tpu_chips=4,
+                      tpu_topology="4x8",
+                      tpu_accelerator="tpu-v5-lite-podslice")
+    set_defaults_mpijob(job)
+    assert validate_mpijob(job) == []
+    worker = job.worker_spec.template.spec
+    assert worker.containers[0].resources.limits["google.com/tpu"] == "4"
+    assert worker.node_selector["cloud.google.com/gke-tpu-topology"] == "4x8"
+
+
+def test_yaml_round_trip():
+    job = new_jax_job("rt", image="img", command=["cmd"], workers=2)
+    set_defaults_mpijob(job)
+    text = job_to_yaml(job)
+    back = job_from_yaml(text)
+    assert back.metadata.name == "rt"
+    assert back.spec.mpi_implementation == constants.IMPL_JAX
+    assert back.worker_spec.replicas == 2
+    assert back.worker_spec.template.spec.containers[0].image == "img"
+    assert job_to_yaml(back) == text
+
+
+@pytest.mark.parametrize("name", ["jax-pi", "pi-native", "mnist",
+                                  "resnet-benchmark", "llama-2-7b"])
+def test_example_manifests_are_valid_mpijobs(name):
+    path = os.path.join(REPO_ROOT, "examples", "v2beta1", f"{name}.yaml")
+    with open(path) as f:
+        job = job_from_yaml(f.read())
+    set_defaults_mpijob(job)
+    assert validate_mpijob(job) == [], name
+    assert job.spec.mpi_implementation == constants.IMPL_JAX
+
+
+def test_sdk_client_full_lifecycle():
+    from mpi_operator_tpu.server import LocalCluster
+    with LocalCluster() as cluster:
+        client = MPIJobClient(cluster.client)
+        job = new_jax_job(
+            "sdk-pi", image="local",
+            command=[sys.executable, "-c", "print('hello from sdk')"],
+            workers=1,
+            launcher_command=[sys.executable, "-c",
+                              "print('hello from sdk')"])
+        # local runtime needs worker commands that outlive the launcher
+        job.worker_spec.template.spec.containers[0].command = [
+            sys.executable, "-c", "import time; time.sleep(30)"]
+        client.create(job)
+        done = client.wait_for_completion("sdk-pi", timeout=30)
+        assert done.status.completion_time is not None
+        assert client.is_succeeded("sdk-pi")
+        assert len(client.list()) == 1
+        client.delete("sdk-pi")
+        assert client.list() == []
+
+
+def test_sdk_suspend_resume():
+    from mpi_operator_tpu.server import LocalCluster
+    with LocalCluster() as cluster:
+        client = MPIJobClient(cluster.client)
+        job = new_jax_job(
+            "sr", image="local",
+            command=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=1,
+            launcher_command=[sys.executable, "-c", "print('ok')"])
+        job.spec.run_policy.suspend = True
+        client.create(job)
+        client.wait_for_condition("sr", constants.JOB_SUSPENDED, timeout=10)
+        client.resume("sr")
+        client.wait_for_completion("sr", timeout=30)
+
+
+def test_crd_schema_shape():
+    crd = mpijob_crd()
+    assert crd["metadata"]["name"] == "mpijobs.kubeflow.org"
+    version = crd["spec"]["versions"][0]
+    assert version["name"] == "v2beta1"
+    assert version["subresources"] == {"status": {}}
+    schema = version["schema"]["openAPIV3Schema"]
+    spec_props = schema["properties"]["spec"]["properties"]
+    assert spec_props["mpiImplementation"]["enum"] == \
+        list(constants.VALID_IMPLEMENTATIONS)
+    replica = spec_props["mpiReplicaSpecs"]["additionalProperties"]
+    assert "template" in replica["properties"]
+    assert yaml.safe_dump(crd)  # serializable
+
+
+def test_generated_manifests_have_no_drift(tmp_path):
+    """verify-generate: regenerating into a scratch dir must match the
+    checked-in manifests byte for byte."""
+    generate_manifests(str(tmp_path))
+    for rel in ["manifests/base/kubeflow.org_mpijobs.yaml",
+                "manifests/base/deployment.yaml",
+                "manifests/base/cluster-role.yaml",
+                "deploy/v2beta1/mpi-operator.yaml"]:
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            checked_in = f.read()
+        with open(os.path.join(tmp_path, rel)) as f:
+            regenerated = f.read()
+        assert checked_in == regenerated, f"drift in {rel}"
