@@ -44,6 +44,8 @@ def main() -> None:
     tx = optax.sgd(0.01, momentum=0.9)
     opt_state = tx.init(params)
 
+    # NOTE: donate_argnums hangs on the tunneled 'axon' platform (buffer
+    # invalidation stalls); plain jit measured faster end-to-end here.
     @jax.jit
     def train_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):

@@ -28,6 +28,10 @@ def main() -> int:
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="enable orbax checkpoint/resume (pairs with"
+                             " the operator's suspend/resume)")
+    parser.add_argument("--checkpoint-every", type=int, default=50)
     args = parser.parse_args()
 
     from mpi_operator_tpu.bootstrap import initialize_from_env
@@ -61,17 +65,29 @@ def main() -> int:
     def loss_fn(params, batch):
         return next_token_loss(model.apply(params, batch), batch)
 
+    mgr = None
+    if args.checkpoint_dir:
+        from mpi_operator_tpu.utils import CheckpointManager
+        mgr = CheckpointManager(args.checkpoint_dir,
+                                every=args.checkpoint_every)
+
     with mesh:
         init_fn, step_fn = build_train_step(
             loss_fn, optax.adamw(3e-4), mesh,
             param_specs=llama_param_specs(cfg), remat=False)
         state = init_fn(params)
-        tokens = jax.device_put(tokens, seq_batch_sharding(mesh))
-        state, metrics = step_fn(state, tokens)  # compile
+        if mgr is not None:
+            state = mgr.restore(state)   # resume after suspend/preemption
+            if int(state.step):
+                print(f"resumed from step {int(state.step)}")
+        state, metrics = step_fn(state, tokens := jax.device_put(
+            tokens, seq_batch_sharding(mesh)))  # compile
         float(metrics["loss"])
         start = time.perf_counter()
         for _ in range(args.steps):
             state, metrics = step_fn(state, tokens)
+            if mgr is not None:
+                mgr.maybe_save(state, int(state.step))
         final_loss = float(metrics["loss"])
         elapsed = time.perf_counter() - start
 

@@ -57,10 +57,25 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
         loss_fn = jax.checkpoint(loss_fn)
 
     def init_fn(params):
+        from jax.sharding import NamedSharding, PartitionSpec as P
         if param_specs is not None:
             params = shard_params(params, param_specs, mesh)
         opt_state = optimizer.init(params)
-        step = jnp.zeros((), jnp.int32)
+        # Every leaf must carry a mesh sharding (param-shaped moments
+        # inherit it from zeros_like; scalars like adam's count do not):
+        # checkpoint-restore commits arrays to their saved shardings, and a
+        # single-device-committed scalar would then conflict with mesh-wide
+        # params under jit.
+        replicated = NamedSharding(mesh, P())
+
+        def _pin(x):
+            if hasattr(x, "sharding") and isinstance(x.sharding,
+                                                     NamedSharding):
+                return x
+            return jax.device_put(x, replicated)
+
+        opt_state = jax.tree_util.tree_map(_pin, opt_state)
+        step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
         return TrainState(step=step, params=params, opt_state=opt_state)
 
     def _step(state: TrainState, batch):

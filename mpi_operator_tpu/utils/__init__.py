@@ -1,0 +1,13 @@
+"""Workload utilities: checkpointing, profiling, logging.
+
+The reference keeps the operator thin and delegates data-plane concerns
+to the workload (SURVEY.md §5: no checkpointing, profiling only as a
+roadmap idea).  The TPU-native stack ships them as workload-side
+utilities: orbax checkpoint/resume (pairs with the control plane's
+suspend/resume so a preempted job restarts from step N), and a
+jax-profiler hook driven by env.
+"""
+
+from .checkpoint import (CheckpointManager, latest_step,  # noqa: F401
+                         restore_checkpoint, save_checkpoint)
+from .profiler import maybe_profile  # noqa: F401

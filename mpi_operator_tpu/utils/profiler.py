@@ -1,0 +1,29 @@
+"""Profiling hook — env-driven jax profiler traces.
+
+The reference only *mentions* Horovod Timeline as a roadmap idea
+(ROADMAP.md:14) and keeps the operator thin; matching that philosophy,
+profiling here is a workload-side opt-in: set ``JAX_PROFILE_DIR`` in the
+MPIJob pod template env and wrap the hot loop in ``maybe_profile()`` —
+traces land per-process for xprof/tensorboard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def maybe_profile(name: str = "train", env_var: str = "JAX_PROFILE_DIR"):
+    """Profile the enclosed block iff the env var points at a directory."""
+    directory = os.environ.get(env_var)
+    if not directory:
+        yield False
+        return
+    import jax
+
+    out = os.path.join(directory,
+                       f"{name}-p{jax.process_index()}")
+    os.makedirs(out, exist_ok=True)
+    with jax.profiler.trace(out):
+        yield True

@@ -110,3 +110,86 @@ def test_e2e_jax_pi_process_group():
         pi = float(pi_line.split("pi=")[1])
         assert abs(pi - 3.14159) < 0.05, logs
         assert done.status.completion_time is not None
+
+
+def test_e2e_elastic_scale_down_and_up():
+    """Elastic worker discovery (SURVEY §3.4): scale down deletes
+    high-index pods and regenerates discover_hosts.sh from running pods;
+    scale up recreates them."""
+    import time
+    with LocalCluster() as cluster:
+        sleep_cmd = [sys.executable, "-c", "import time; time.sleep(60)"]
+        job = jax_job("el", launcher_cmd=sleep_cmd, worker_cmd=sleep_cmd,
+                      workers=3)
+        cluster.submit(job)
+
+        def running_workers():
+            return [p.metadata.name for p in cluster.client.pods(
+                "default").list({"training.kubeflow.org/job-role": "worker"})
+                if p.status.phase == "Running"]
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and len(running_workers()) < 3:
+            time.sleep(0.1)
+        assert len(running_workers()) == 3
+
+        # discover_hosts reflects all running workers.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            cm = cluster.client.config_maps("default").get("el-config")
+            if cm.data.get("discover_hosts.sh", "").count("echo") == 3:
+                break
+            time.sleep(0.1)
+        assert cm.data["discover_hosts.sh"].count("echo") == 3
+
+        # Scale down to 1.
+        stored = cluster.client.mpi_jobs("default").get("el")
+        stored.spec.mpi_replica_specs["Worker"].replicas = 1
+        cluster.client.mpi_jobs("default").update(stored)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and len(running_workers()) != 1:
+            time.sleep(0.1)
+        assert running_workers() == ["el-worker-0"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            cm = cluster.client.config_maps("default").get("el-config")
+            if cm.data.get("discover_hosts.sh", "").count("echo") == 1:
+                break
+            time.sleep(0.1)
+        assert cm.data["discover_hosts.sh"].count("echo") == 1
+
+        # Scale back up to 2.
+        stored = cluster.client.mpi_jobs("default").get("el")
+        stored.spec.mpi_replica_specs["Worker"].replicas = 2
+        cluster.client.mpi_jobs("default").update(stored)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and len(running_workers()) != 2:
+            time.sleep(0.1)
+        assert sorted(running_workers()) == ["el-worker-0", "el-worker-1"]
+
+
+def test_e2e_namespace_scoped_operator_ignores_other_namespaces():
+    """Namespace scoping (server.go:135-142): a namespace-scoped operator
+    must not reconcile jobs elsewhere."""
+    import time
+    from mpi_operator_tpu.server.cluster import LocalCluster as LC
+    cluster = LC(namespace="ml")
+    cluster.start()
+    try:
+        cmd = [sys.executable, "-c", "print('hi')"]
+        ignored = jax_job("other", launcher_cmd=cmd, worker_cmd=cmd,
+                          workers=1)
+        ignored.metadata.namespace = "elsewhere"
+        cluster.client.mpi_jobs("elsewhere").create(ignored)
+
+        watched = jax_job("mine", launcher_cmd=cmd, worker_cmd=[
+            sys.executable, "-c", "import time; time.sleep(30)"], workers=1)
+        watched.metadata.namespace = "ml"
+        cluster.client.mpi_jobs("ml").create(watched)
+        cluster.wait_for_condition("ml", "mine", constants.JOB_SUCCEEDED,
+                                   timeout=30)
+        # the out-of-scope job got no resources at all
+        assert cluster.client.pods("elsewhere").list() == []
+        assert cluster.client.services("elsewhere").list() == []
+    finally:
+        cluster.stop()

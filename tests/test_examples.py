@@ -1,0 +1,47 @@
+"""Smoke tests for the user-facing example scripts (run as real
+subprocesses on CPU, like the kubelet would)."""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO_ROOT, "examples")
+
+
+def _run(script, *args, timeout=240):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout
+
+
+def test_mnist_example_runs():
+    out = _run("mnist_train.py", "--steps", "5", "--batch-per-device", "4")
+    assert "done processes=1 devices=4" in out
+    assert "final_loss=" in out
+
+
+def test_llama_example_tiny_with_tp_and_checkpoint(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    out = _run("llama_train.py", "--config", "tiny", "--steps", "3",
+               "--tp", "2", "--sp", "2", "--seq-len", "64",
+               "--checkpoint-dir", ckpt, "--checkpoint-every", "2")
+    assert "mesh dp=1 fsdp=1 tp=2 sp=2" in out
+    assert "tokens/sec" in out
+    assert os.path.isdir(os.path.join(ckpt, "step_00000002")), out
+    # resume path
+    out2 = _run("llama_train.py", "--config", "tiny", "--steps", "2",
+                "--tp", "2", "--sp", "2", "--seq-len", "64",
+                "--checkpoint-dir", ckpt)
+    assert "resumed from step" in out2
+
+
+def test_jax_pi_single_process():
+    out = _run("jax_pi.py", "100000")
+    assert "workers=1" in out and "pi=" in out
