@@ -18,13 +18,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", default="tiny", choices=["tiny", "7b"])
+    parser.add_argument("--config", default="tiny",
+                        choices=["tiny", "7b", "mixtral-tiny",
+                                 "mixtral-8x7b"])
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--batch-per-dp", type=int, default=2)
     parser.add_argument("--seq-len", type=int, default=0,
                         help="0 = config max_seq_len")
     parser.add_argument("--dp", type=int, default=-1)
     parser.add_argument("--fsdp", type=int, default=1)
+    parser.add_argument("--ep", type=int, default=1)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--remat", action="store_true")
@@ -41,17 +44,18 @@ def main() -> int:
     import optax
 
     from mpi_operator_tpu.models.llama import (LlamaModel, llama2_7b,
-                                               llama2_tiny,
-                                               llama_param_specs,
+                                               llama2_tiny, llama_param_specs,
+                                               mixtral_8x7b, mixtral_tiny,
                                                next_token_loss)
     from mpi_operator_tpu.parallel.mesh import (MeshConfig, create_mesh,
                                                 seq_batch_sharding)
     from mpi_operator_tpu.parallel.train import build_train_step
 
-    mesh = create_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp,
-                                  sp=args.sp))
-    cfg = llama2_7b(remat=args.remat) if args.config == "7b" \
-        else llama2_tiny(remat=args.remat)
+    mesh = create_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, ep=args.ep,
+                                  tp=args.tp, sp=args.sp))
+    cfg = {"7b": llama2_7b, "tiny": llama2_tiny,
+           "mixtral-tiny": mixtral_tiny,
+           "mixtral-8x7b": mixtral_8x7b}[args.config](remat=args.remat)
     model = LlamaModel(cfg, mesh=mesh)
 
     dp_total = mesh.shape["dp"] * mesh.shape["fsdp"]
@@ -61,6 +65,8 @@ def main() -> int:
     tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
                                 cfg.vocab_size)
     params = model.init(jax.random.PRNGKey(1), tokens[:1, :8])
+    if cfg.n_experts > 1:   # drop the aux-loss collection for training
+        params = {"params": params["params"]}
 
     def loss_fn(params, batch):
         return next_token_loss(model.apply(params, batch), batch)

@@ -41,6 +41,8 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False                       # checkpoint each block
     attention_impl: str = "auto"              # 'auto'|'pallas'|'xla'
+    n_experts: int = 0                        # >1 -> MoE MLP (Mixtral-style)
+    top_k: int = 2                            # experts per token
 
     @property
     def head_dim(self) -> int:
@@ -70,6 +72,19 @@ def llama2_tiny(**overrides) -> LlamaConfig:
     return LlamaConfig(**{**dict(vocab_size=256, dim=128, n_layers=2,
                                  n_heads=4, max_seq_len=256,
                                  dtype=jnp.float32), **overrides})
+
+
+def mixtral_tiny(**overrides) -> LlamaConfig:
+    """Tiny Mixtral-style MoE config (expert-parallel dryrun/tests)."""
+    return llama2_tiny(**{**dict(n_experts=4, top_k=2), **overrides})
+
+
+def mixtral_8x7b(**overrides) -> LlamaConfig:
+    """Mixtral-8x7B-shaped config (vocab 32k, dim 4096, 8 experts)."""
+    return LlamaConfig(**{**dict(vocab_size=32000, dim=4096, n_layers=32,
+                                 n_heads=32, n_kv_heads=8, hidden_dim=14336,
+                                 max_seq_len=4096, n_experts=8, top_k=2),
+                          **overrides})
 
 
 def _rope(x, positions, theta: float):
@@ -177,9 +192,16 @@ class LlamaBlock(nn.Module):
         h = x + LlamaAttention(cfg, self.mesh, name="attention")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x),
             positions)
-        out = h + LlamaMLP(cfg, self.mesh, name="feed_forward")(
-            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h))
-        return out
+        normed = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h)
+        if cfg.n_experts > 1:
+            from ..ops.moe import MoEMLP
+            mlp_out = MoEMLP(dim=cfg.dim, ffn_dim=cfg.ffn_dim,
+                             n_experts=cfg.n_experts, top_k=cfg.top_k,
+                             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             mesh=self.mesh, name="feed_forward")(normed)
+        else:
+            mlp_out = LlamaMLP(cfg, self.mesh, name="feed_forward")(normed)
+        return h + mlp_out
 
 
 class LlamaModel(nn.Module):
@@ -220,14 +242,24 @@ def llama_param_specs(config: LlamaConfig):
         "wv": {"kernel": P("fsdp", "tp", None)},
         "wo": {"kernel": P("tp", None, "fsdp")},
     }
-    block = {
-        "attention": attn,
-        "attention_norm": {"scale": P(None)},
-        "feed_forward": {
+    if config.n_experts > 1:
+        # MoE experts over 'ep' (ops/moe.py layout [E, D, F]).
+        feed_forward = {
+            "router": {"kernel": P(None, None)},
+            "w1": P("ep", "fsdp", "tp"),
+            "w3": P("ep", "fsdp", "tp"),
+            "w2": P("ep", "tp", "fsdp"),
+        }
+    else:
+        feed_forward = {
             "w1": {"kernel": P("fsdp", "tp")},
             "w3": {"kernel": P("fsdp", "tp")},
             "w2": {"kernel": P("tp", "fsdp")},
-        },
+        }
+    block = {
+        "attention": attn,
+        "attention_norm": {"scale": P(None)},
+        "feed_forward": feed_forward,
         "ffn_norm": {"scale": P(None)},
     }
     params = {f"layers_{i}": block for i in range(config.n_layers)}
