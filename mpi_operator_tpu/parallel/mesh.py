@@ -21,27 +21,28 @@ class MeshConfig:
     """Mesh axis sizes; -1 on dp means "use all remaining devices"."""
     dp: int = -1     # data parallel (gradients psum; DCN-friendly)
     fsdp: int = 1    # parameter/optimizer sharding (ZeRO-3; ICI)
+    pp: int = 1      # pipeline parallel (stage ring via ppermute; ICI)
     ep: int = 1      # expert parallel (MoE all-to-all; ICI)
     tp: int = 1      # tensor parallel (Megatron matmul sharding; ICI)
     sp: int = 1      # sequence/context parallel (ring attention; ICI)
 
     def resolve(self, n_devices: int) -> tuple:
-        fixed = self.fsdp * self.ep * self.tp * self.sp
+        fixed = self.fsdp * self.pp * self.ep * self.tp * self.sp
         dp = self.dp
         if dp == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
                     f"{n_devices} devices not divisible by"
-                    f" fsdp*ep*tp*sp={fixed}")
+                    f" fsdp*pp*ep*tp*sp={fixed}")
             dp = n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.ep}x{self.tp}x{self.sp} !="
-                f" {n_devices} devices")
-        return (dp, self.fsdp, self.ep, self.tp, self.sp)
+                f"mesh {dp}x{self.fsdp}x{self.pp}x{self.ep}x{self.tp}"
+                f"x{self.sp} != {n_devices} devices")
+        return (dp, self.fsdp, self.pp, self.ep, self.tp, self.sp)
 
 
-AXIS_NAMES = ("dp", "fsdp", "ep", "tp", "sp")
+AXIS_NAMES = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 # Axes over which the batch is sharded (gradient reduction axes).
 BATCH_AXES = ("dp", "fsdp")
 

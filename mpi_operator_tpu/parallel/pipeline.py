@@ -1,0 +1,115 @@
+"""Pipeline parallelism over a 'pp' mesh axis.
+
+GPipe-style SPMD pipelining, TPU-first: per-stage parameters are stacked
+on a leading axis sharded over 'pp' (each device holds its stage), and
+microbatches stream through the ring with ``jax.lax.ppermute`` under a
+``lax.scan`` — nearest-neighbor ICI traffic, static shapes, fully
+differentiable (reverse-mode flows back through the scan/ppermute).
+
+The schedule is the classic M+P-1 step fill-drain pipeline: stage 0
+injects microbatch t at step t, stage P-1 emits microbatch t at step
+t+P-1, and a masked psum broadcasts the finished outputs to every
+device.  Bubble fraction is (P-1)/(M+P-1) — pick M >> P.
+
+No reference counterpart (the reference scales processes, not models —
+SURVEY.md §2.3); this is workload-stack surface for models too large for
+tensor parallelism alone.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_stage_params(per_stage_params: list):
+    """Stack a list of per-stage param pytrees into leading-axis arrays
+    ([P, ...]) ready to shard over 'pp'."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *per_stage_params)
+
+
+def stage_param_specs(stacked_params, inner=None):
+    """PartitionSpec tree: leading axis 'pp', rest from ``inner`` (or
+    replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(leaf):
+        extra = [None] * (leaf.ndim - 1)
+        return P("pp", *extra)
+
+    return jax.tree_util.tree_map(spec, stacked_params)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
+                   mesh, axis_name: str = "pp",
+                   batch_axes=("dp", "fsdp")):
+    """Run x through P pipelined stages.
+
+    - stage_fn(params, x) -> y with y.shape == x.shape (homogeneous
+      stages, transformer-block style).
+    - stacked_params: pytree with leading dim P (stack_stage_params).
+    - microbatches: [M, mb, ...] — M microbatches streamed through.
+
+    Returns [M, mb, ...] outputs (replicated over 'pp', batch dims
+    sharded over ``batch_axes``).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis_name]
+
+    def body(stacked_local, xs):
+        p = jax.lax.axis_index(axis_name)
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        m = xs.shape[0]
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        state0 = jnp.zeros_like(xs[0])
+        outputs0 = jnp.zeros_like(xs)
+
+        def step(carry, t):
+            state, outputs = carry
+            # Stage 0 injects microbatch t (zeros during drain).
+            inject = jnp.where(t < m, xs[jnp.minimum(t, m - 1)],
+                               jnp.zeros_like(state))
+            x_in = jnp.where(p == 0, inject, state)
+            y = stage_fn(params, x_in)
+            state_next = jax.lax.ppermute(y, axis_name, perm)
+            # Last stage finishes microbatch t-(P-1) at step t.
+            out_t = t - (n_stages - 1)
+            emit = (p == n_stages - 1) & (out_t >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(emit, y, jax.lax.dynamic_index_in_dim(
+                    outputs, jnp.maximum(out_t, 0), 0, keepdims=False)),
+                jnp.maximum(out_t, 0), 0)
+            return (state_next, updated), None
+
+        (_, outputs), _ = jax.lax.scan(
+            step, (state0, outputs0), jnp.arange(m + n_stages - 1))
+        # Broadcast the last stage's outputs to every pipeline rank.
+        return jax.lax.psum(
+            jnp.where(p == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+
+    extra = [None] * (microbatches.ndim - 2)
+    x_spec = P(None, batch_axes, *extra)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(stage_param_specs(stacked_params), x_spec),
+        out_specs=x_spec, check_vma=False)
+    return fn(stacked_params, microbatches)
+
+
+def split_microbatches(x, num_microbatches: int):
+    """[B, ...] -> [M, B/M, ...]."""
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (b, num_microbatches)
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+
+def merge_microbatches(x):
+    """[M, mb, ...] -> [B, ...]."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])

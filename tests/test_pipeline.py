@@ -1,0 +1,114 @@
+"""Pipeline parallelism tests: pipelined execution must match sequential
+stage application exactly, be differentiable, and train."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
+from mpi_operator_tpu.parallel.pipeline import (merge_microbatches,
+                                                pipeline_apply,
+                                                split_microbatches,
+                                                stack_stage_params,
+                                                stage_param_specs)
+
+
+def mlp_stage(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + x  # residual keeps shapes homogeneous
+
+
+def make_stage_params(key, d, hidden):
+    k1, k2 = jax.random.split(key)
+    return {"w1": jax.random.normal(k1, (d, hidden)) * 0.1,
+            "b1": jnp.zeros((hidden,)),
+            "w2": jax.random.normal(k2, (hidden, d)) * 0.1}
+
+
+def sequential_apply(per_stage, x):
+    for p in per_stage:
+        x = mlp_stage(p, x)
+    return x
+
+
+def test_pipeline_matches_sequential():
+    d, hidden, n_stages = 16, 32, 4
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages)
+    per_stage = [make_stage_params(k, d, hidden) for k in keys]
+    stacked = stack_stage_params(per_stage)
+
+    mesh = create_mesh(MeshConfig(dp=2, pp=4))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, d))
+    micro = split_microbatches(x, 4)
+
+    with mesh:
+        out = pipeline_apply(mlp_stage, stacked, micro, mesh)
+    ref = sequential_apply(per_stage, x)
+    np.testing.assert_allclose(np.asarray(merge_microbatches(out)),
+                               np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_is_differentiable_and_matches_sequential_grads():
+    d, hidden, n_stages = 8, 16, 2
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages)
+    per_stage = [make_stage_params(k, d, hidden) for k in keys]
+    stacked = stack_stage_params(per_stage)
+    mesh = create_mesh(MeshConfig(dp=4, pp=2))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, d))
+    micro = split_microbatches(x, 4)
+
+    def loss_pipe(stacked):
+        out = pipeline_apply(mlp_stage, stacked, micro, mesh)
+        return jnp.mean(out ** 2)
+
+    def loss_seq(stacked):
+        per = [jax.tree_util.tree_map(lambda a, i=i: a[i], stacked)
+               for i in range(n_stages)]
+        return jnp.mean(sequential_apply(per, x) ** 2)
+
+    with mesh:
+        g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_pipeline_train_step_loss_decreases():
+    d, hidden, n_stages = 8, 16, 2
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages)
+    stacked = stack_stage_params(
+        [make_stage_params(k, d, hidden) for k in keys])
+    mesh = create_mesh(MeshConfig(dp=4, pp=2))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, d))
+    y = jax.random.normal(jax.random.PRNGKey(2), (16, d))
+    micro_x = split_microbatches(x, 4)
+
+    opt = optax.adam(1e-2)
+
+    def loss_fn(stacked):
+        out = merge_microbatches(
+            pipeline_apply(mlp_stage, stacked, micro_x, mesh))
+        return jnp.mean((out - y) ** 2)
+
+    from jax.sharding import NamedSharding
+    with mesh:
+        specs = stage_param_specs(stacked)
+        stacked = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            stacked, specs)
+        opt_state = opt.init(stacked)
+
+        @jax.jit
+        def step(stacked, opt_state):
+            loss, grads = jax.value_and_grad(loss_fn)(stacked)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(stacked, updates), opt_state, loss
+
+        losses = []
+        for _ in range(10):
+            stacked, opt_state, loss = step(stacked, opt_state)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
