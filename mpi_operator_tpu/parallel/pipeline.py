@@ -32,9 +32,8 @@ def stack_stage_params(per_stage_params: list):
                                   *per_stage_params)
 
 
-def stage_param_specs(stacked_params, inner=None):
-    """PartitionSpec tree: leading axis 'pp', rest from ``inner`` (or
-    replicated)."""
+def stage_param_specs(stacked_params):
+    """PartitionSpec tree: leading axis 'pp', other dims replicated."""
     from jax.sharding import PartitionSpec as P
 
     def spec(leaf):
@@ -60,6 +59,13 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
     from jax.sharding import PartitionSpec as P
 
     n_stages = mesh.shape[axis_name]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            # One stage per pipeline rank — a mismatch would silently run
+            # only every (shape[0]/n_stages)-th stage.
+            raise ValueError(
+                f"stacked stage dim {leaf.shape[0]} != mesh"
+                f" {axis_name}={n_stages}")
 
     def body(stacked_local, xs):
         p = jax.lax.axis_index(axis_name)

@@ -93,12 +93,9 @@ def test_pipeline_train_step_loss_decreases():
             pipeline_apply(mlp_stage, stacked, micro_x, mesh))
         return jnp.mean((out - y) ** 2)
 
-    from jax.sharding import NamedSharding
+    from mpi_operator_tpu.parallel.mesh import shard_params
     with mesh:
-        specs = stage_param_specs(stacked)
-        stacked = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
-            stacked, specs)
+        stacked = shard_params(stacked, stage_param_specs(stacked), mesh)
         opt_state = opt.init(stacked)
 
         @jax.jit
@@ -112,3 +109,18 @@ def test_pipeline_train_step_loss_decreases():
             stacked, opt_state, loss = step(stacked, opt_state)
             losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_pipeline_rejects_stage_count_mismatch():
+    """Regression: silently dropping stages (stack=4 on pp=2) must raise."""
+    import pytest
+    d, hidden = 8, 16
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    stacked = stack_stage_params(
+        [make_stage_params(k, d, hidden) for k in keys])
+    mesh = create_mesh(MeshConfig(dp=4, pp=2))
+    micro = split_microbatches(
+        jax.random.normal(jax.random.PRNGKey(1), (16, d)), 4)
+    with pytest.raises(ValueError, match="stacked stage dim"):
+        with mesh:
+            pipeline_apply(mlp_stage, stacked, micro, mesh)
