@@ -1,0 +1,70 @@
+"""Sharded input pipeline helpers.
+
+Multi-host JAX needs every process to feed its local shard of the global
+batch; this module turns per-process numpy batches into global sharded
+arrays.  The reference delegates data loading entirely to the workload
+(tf_cnn_benchmarks' synthetic data, Horovod MNIST downloads) — here the
+framework ships the plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def global_batch_iterator(local_batch_fn: Callable[[int], Sequence],
+                          mesh, shardings,
+                          steps: Optional[int] = None) -> Iterator:
+    """Yield global sharded batches from per-process local batches.
+
+    - local_batch_fn(step) -> tuple of np arrays for THIS process's share
+      (shape [local_batch, ...]).
+    - shardings: matching tuple of NamedShardings for the global arrays.
+
+    Single-process: a plain device_put.  Multi-process: each host
+    contributes its slice via jax.make_array_from_process_local_data, so
+    no host ever materializes the global batch.
+    """
+    import jax
+
+    step = 0
+    while steps is None or step < steps:
+        local = local_batch_fn(step)
+        if jax.process_count() == 1:
+            yield tuple(jax.device_put(arr, s)
+                        for arr, s in zip(local, shardings))
+        else:
+            yield tuple(
+                jax.make_array_from_process_local_data(s, np.asarray(arr))
+                for arr, s in zip(local, shardings))
+        step += 1
+
+
+def synthetic_image_batches(batch_per_process: int, image_size: int = 224,
+                            num_classes: int = 1000,
+                            dtype=np.float32) -> Callable[[int], tuple]:
+    """Deterministic synthetic ImageNet-style batches (benchmark parity
+    with tf_cnn_benchmarks --data_name=synthetic)."""
+    rng = np.random.RandomState(0)
+    images = rng.randn(batch_per_process, image_size, image_size, 3) \
+        .astype(dtype)
+    labels = rng.randint(0, num_classes, size=(batch_per_process,))
+
+    def fn(step: int):
+        return images, labels
+
+    return fn
+
+
+def synthetic_token_batches(batch_per_process: int, seq_len: int,
+                            vocab_size: int) -> Callable[[int], tuple]:
+    """Deterministic synthetic LM token batches."""
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, vocab_size, size=(batch_per_process, seq_len))
+
+    def fn(step: int):
+        return (tokens,)
+
+    return fn

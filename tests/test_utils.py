@@ -82,3 +82,29 @@ def test_maybe_profile_disabled_and_enabled(tmp_path, monkeypatch):
         assert active is True
     out = [p for p in (tmp_path).rglob("*") if p.is_file()]
     assert out, "profiler produced no trace files"
+
+
+def test_global_batch_iterator_single_process():
+    from mpi_operator_tpu.utils.data import (global_batch_iterator,
+                                             synthetic_token_batches)
+    from mpi_operator_tpu.parallel.mesh import seq_batch_sharding
+    mesh = create_mesh(MeshConfig(dp=4, sp=2))
+    fn = synthetic_token_batches(8, seq_len=16, vocab_size=100)
+    it = global_batch_iterator(fn, mesh, (seq_batch_sharding(mesh),),
+                               steps=3)
+    batches = list(it)
+    assert len(batches) == 3
+    (tokens,) = batches[0]
+    assert tokens.shape == (8, 16)
+    assert tokens.sharding.spec == seq_batch_sharding(mesh).spec
+    # deterministic across steps
+    assert (jnp.asarray(batches[0][0]) == jnp.asarray(batches[1][0])).all()
+
+
+def test_synthetic_image_batches_shapes():
+    from mpi_operator_tpu.utils.data import synthetic_image_batches
+    fn = synthetic_image_batches(4, image_size=32, num_classes=10)
+    images, labels = fn(0)
+    assert images.shape == (4, 32, 32, 3)
+    assert labels.shape == (4,)
+    assert labels.max() < 10
