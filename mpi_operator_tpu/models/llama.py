@@ -74,6 +74,15 @@ def llama2_tiny(**overrides) -> LlamaConfig:
                                  dtype=jnp.float32), **overrides})
 
 
+def llama3_8b(**overrides) -> LlamaConfig:
+    """Llama-3-8B-shaped config: GQA (8 kv heads), 128k vocab,
+    rope_theta 500k, 14336 FFN."""
+    return LlamaConfig(**{**dict(vocab_size=128256, dim=4096, n_layers=32,
+                                 n_heads=32, n_kv_heads=8,
+                                 hidden_dim=14336, rope_theta=500000.0,
+                                 max_seq_len=8192), **overrides})
+
+
 def mixtral_tiny(**overrides) -> LlamaConfig:
     """Tiny Mixtral-style MoE config (expert-parallel dryrun/tests)."""
     return llama2_tiny(**{**dict(n_experts=4, top_k=2), **overrides})
@@ -128,12 +137,27 @@ class LlamaAttention(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         cfg = self.config
         b, s, _ = x.shape
         dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
             features=feats, axis=-1, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name=name)
+
+        if decode:
+            # Autoregressive KV cache (flax 'cache' collection).  The
+            # cache index doubles as the position offset for RoPE.
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+            cache_index = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32))
+            positions = cache_index.value + jnp.arange(s)
+
         q = dense((cfg.n_heads, cfg.head_dim), "wq")(x)
         k = dense((cfg.kv_heads, cfg.head_dim), "wk")(x)
         v = dense((cfg.kv_heads, cfg.head_dim), "wv")(x)
@@ -141,27 +165,60 @@ class LlamaAttention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        if cfg.kv_heads != cfg.n_heads:  # GQA: repeat KV groups
-            repeat = cfg.n_heads // cfg.kv_heads
-            k = jnp.repeat(k, repeat, axis=2)
-            v = jnp.repeat(v, repeat, axis=2)
-
-        q = _constrain(q, self.mesh, BATCH_AXES, "sp", "tp", None)
-        k = _constrain(k, self.mesh, BATCH_AXES, "sp", "tp", None)
-        v = _constrain(v, self.mesh, BATCH_AXES, "sp", "tp", None)
-
-        sp_size = 1
-        if self.mesh is not None:
-            sp_size = self.mesh.shape.get("sp", 1)
-        if sp_size > 1:
-            out = ring_attention(q, k, v, self.mesh, causal=True)
+        if decode:
+            idx = cache_index.value
+            k_all = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            cached_k.value = k_all
+            cached_v.value = v_all
+            cache_index.value = idx + s
+            out = _decode_attention(q, k_all, v_all, positions,
+                                    cfg.n_heads // cfg.kv_heads)
         else:
-            out = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            if cfg.kv_heads != cfg.n_heads:  # GQA: repeat KV groups
+                repeat = cfg.n_heads // cfg.kv_heads
+                k = jnp.repeat(k, repeat, axis=2)
+                v = jnp.repeat(v, repeat, axis=2)
+
+            q = _constrain(q, self.mesh, BATCH_AXES, "sp", "tp", None)
+            k = _constrain(k, self.mesh, BATCH_AXES, "sp", "tp", None)
+            v = _constrain(v, self.mesh, BATCH_AXES, "sp", "tp", None)
+
+            sp_size = 1
+            if self.mesh is not None:
+                sp_size = self.mesh.shape.get("sp", 1)
+            if sp_size > 1:
+                out = ring_attention(q, k, v, self.mesh, causal=True)
+            else:
+                out = attention(q, k, v, causal=True,
+                                impl=cfg.attention_impl)
 
         out = nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                               name="wo")(out)
         return _constrain(out, self.mesh, BATCH_AXES, "sp", None)
+
+
+def _decode_attention(q, k_cache, v_cache, positions, gqa_repeat: int):
+    """Cached attention: q [B,S,H,D] against the full cache [B,L,KH,D];
+    keys beyond each query's position are masked (covers both the unused
+    cache tail and intra-step causality)."""
+    import math as _math
+    if gqa_repeat > 1:
+        k_cache = jnp.repeat(k_cache, gqa_repeat, axis=2)
+        v_cache = jnp.repeat(v_cache, gqa_repeat, axis=2)
+    scale = 1.0 / _math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                        k_cache.astype(jnp.float32))
+    kv_pos = jnp.arange(k_cache.shape[1])
+    mask = kv_pos[None, :] <= positions[:, None]           # [S, L]
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                     v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 class LlamaMLP(nn.Module):
@@ -187,11 +244,11 @@ class LlamaBlock(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         cfg = self.config
         h = x + LlamaAttention(cfg, self.mesh, name="attention")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x),
-            positions)
+            positions, decode)
         normed = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h)
         if cfg.n_experts > 1:
             from ..ops.moe import MoEMLP
@@ -210,19 +267,21 @@ class LlamaModel(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, decode: bool = False):
         cfg = self.config
         s = tokens.shape[1]
-        positions = jnp.arange(s)
+        positions = jnp.arange(s)  # decode mode derives real positions
+                                   # from the cache index per layer
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="tok_embeddings")(tokens)
         x = _constrain(x, self.mesh, BATCH_AXES, "sp", None)
 
         block = LlamaBlock
         if cfg.remat:
-            block = nn.remat(LlamaBlock, static_argnums=())
+            block = nn.remat(LlamaBlock, static_argnums=(3,))
         for i in range(cfg.n_layers):
-            x = block(cfg, self.mesh, name=f"layers_{i}")(x, positions)
+            x = block(cfg, self.mesh, name=f"layers_{i}")(x, positions,
+                                                          decode)
 
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
@@ -276,3 +335,34 @@ def next_token_loss(logits, tokens):
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(logz - gold)
+
+
+def greedy_generate(model: LlamaModel, variables, prompt_tokens,
+                    max_new_tokens: int):
+    """KV-cache greedy decoding: prefill the prompt, then one token per
+    step.  Returns [B, max_new_tokens] generated ids."""
+    import flax
+
+    params = {"params": variables["params"]}
+    b = prompt_tokens.shape[0]
+
+    # Prefill: run the prompt with an (initialized-on-the-fly) cache.
+    logits, state = model.apply(params, prompt_tokens, decode=True,
+                                mutable=["cache"])
+    cache = state["cache"]
+    next_token = jnp.argmax(logits[:, -1], axis=-1)
+
+    import functools
+
+    @functools.partial(jax.jit)
+    def step(cache, token):
+        logits, state = model.apply(
+            {**params, "cache": cache}, token[:, None], decode=True,
+            mutable=["cache"])
+        return state["cache"], jnp.argmax(logits[:, -1], axis=-1)
+
+    out = [next_token]
+    for _ in range(max_new_tokens - 1):
+        cache, next_token = step(cache, out[-1])
+        out.append(next_token)
+    return jnp.stack(out, axis=1)
