@@ -193,3 +193,34 @@ def test_e2e_namespace_scoped_operator_ignores_other_namespaces():
         assert cluster.client.services("elsewhere").list() == []
     finally:
         cluster.stop()
+
+
+def test_e2e_scheduling_gates_hold_pods_until_cleared():
+    """Kueue flow: gated pods must not run; clearing gates (a MODIFIED
+    event) starts them (runtime/kubelet.py gated-pod path)."""
+    import time
+    from mpi_operator_tpu.k8s.core import Container, Pod, PodSpec
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    with LocalCluster() as cluster:
+        pod = Pod(metadata=ObjectMeta(name="gated", namespace="default"),
+                  spec=PodSpec(
+                      scheduling_gates=[{"name": "kueue.x-k8s.io/admission"}],
+                      restart_policy="Never",
+                      containers=[Container(
+                          name="c", image="local",
+                          command=[sys.executable, "-c", "print('ran')"])]))
+        cluster.client.pods("default").create(pod)
+        time.sleep(0.6)
+        assert cluster.client.pods("default").get("gated").status.phase == ""
+
+        stored = cluster.client.pods("default").get("gated")
+        stored.spec.scheduling_gates = []
+        cluster.client.pods("default").update(stored)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            phase = cluster.client.pods("default").get("gated").status.phase
+            if phase == "Succeeded":
+                break
+            time.sleep(0.05)
+        assert cluster.client.pods("default").get("gated").status.phase == \
+            "Succeeded"
