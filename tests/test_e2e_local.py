@@ -10,7 +10,8 @@ import pytest
 
 from mpi_operator_tpu.api import constants
 from mpi_operator_tpu.api.types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy
-from mpi_operator_tpu.k8s.core import Container, PodSpec, PodTemplateSpec
+from mpi_operator_tpu.k8s.core import (Container, Pod, PodSpec,
+                                       PodTemplateSpec)
 from mpi_operator_tpu.k8s.meta import ObjectMeta
 from mpi_operator_tpu.server import LocalCluster
 
@@ -199,8 +200,6 @@ def test_e2e_scheduling_gates_hold_pods_until_cleared():
     """Kueue flow: gated pods must not run; clearing gates (a MODIFIED
     event) starts them (runtime/kubelet.py gated-pod path)."""
     import time
-    from mpi_operator_tpu.k8s.core import Container, Pod, PodSpec
-    from mpi_operator_tpu.k8s.meta import ObjectMeta
     with LocalCluster() as cluster:
         pod = Pod(metadata=ObjectMeta(name="gated", namespace="default"),
                   spec=PodSpec(
