@@ -183,7 +183,8 @@ class _PodRunner:
                     time.sleep(min(0.2 * self.restart_count, 2.0))
                     continue
                 self.kubelet._set_phase(self.namespace, self.pod_name,
-                                        core.POD_SUCCEEDED)
+                                        core.POD_SUCCEEDED,
+                                        restart_count=self.restart_count)
                 return
             if self.spec.restart_policy in (core.RESTART_POLICY_ALWAYS,
                                             core.RESTART_POLICY_ON_FAILURE):
@@ -193,7 +194,8 @@ class _PodRunner:
             self.kubelet._set_phase(
                 self.namespace, self.pod_name, core.POD_FAILED,
                 reason="Error",
-                message=f"container exited with code {code}")
+                message=f"container exited with code {code}",
+                restart_count=self.restart_count)
             return
 
     def start(self) -> None:

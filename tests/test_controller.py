@@ -580,3 +580,42 @@ def test_finished_job_sync_converges_to_noop():
         f.sync(job)
         f.refresh_caches()
     assert f.get_job().metadata.resource_version == rv
+
+
+def test_unsuspend_launcher_update_failure_does_not_poison_cache():
+    """Parity with TestUnsuspendLauncherUpdateFailureDoesNotPoisonCache
+    (ref mpi_job_controller_test.go:1163): when the launcher Job update
+    fails mid-unsuspend, the informer-cached Job must stay unmodified
+    (DeepCopy discipline)."""
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    job.spec.run_policy.suspend = True
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+
+    launcher_before = f.factory.jobs().lister.get("default", "test-launcher")
+    assert launcher_before.spec.suspend is True
+
+    # Unsuspend, but make the Job update fail.
+    stored = f.get_job()
+    stored.spec.run_policy.suspend = False
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+
+    from mpi_operator_tpu.k8s.apiserver import ApiError
+
+    def fail_update(action):
+        if action.kind == "Job" and action.subresource != "status":
+            return True, ApiError("InternalError", "injected")
+        return False, None
+
+    f.client.prepend_reactor("update", "Job", fail_update)
+    with pytest.raises(Exception):
+        f.sync(stored)
+
+    # The cached launcher must NOT have been mutated by the failed sync.
+    cached = f.factory.jobs().lister.get("default", "test-launcher")
+    assert cached.spec.suspend is True
+    stored_launcher = f.client.jobs("default").get("test-launcher")
+    assert stored_launcher.spec.suspend is True

@@ -1,0 +1,104 @@
+"""Direct runtime-layer tests: batch Job controller timer paths (active
+deadline, TTL) and kubelet restart policies."""
+
+import sys
+import time
+
+from mpi_operator_tpu.k8s import batch, core
+from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.k8s.core import Container, PodSpec, PodTemplateSpec
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+from mpi_operator_tpu.runtime import JobController, LocalKubelet
+
+
+def _job(name, command, **spec_kwargs):
+    return batch.Job(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=batch.JobSpec(
+            template=PodTemplateSpec(spec=PodSpec(
+                restart_policy="Never",
+                containers=[Container(name="c", command=command)])),
+            **spec_kwargs))
+
+
+def _wait(fn, timeout=15):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_active_deadline_fails_job():
+    cs = Clientset()
+    jc = JobController(cs)
+    jc.start()
+    kl = LocalKubelet(cs)
+    kl.start()
+    try:
+        cs.jobs("default").create(_job(
+            "slow", [sys.executable, "-c", "import time; time.sleep(60)"],
+            active_deadline_seconds=1))
+        assert _wait(lambda: batch.job_condition_status(
+            cs.jobs("default").get("slow"), batch.JOB_FAILED) == "True")
+        conds = {c.type: c.reason
+                 for c in cs.jobs("default").get("slow").status.conditions}
+        assert conds[batch.JOB_FAILED] == "DeadlineExceeded"
+        # active pods were torn down
+        assert _wait(lambda: all(
+            p.status.phase in (core.POD_FAILED, core.POD_SUCCEEDED)
+            for p in cs.pods("default").list()) or
+            cs.pods("default").list() == [])
+    finally:
+        kl.stop()
+        jc.stop()
+
+
+def test_ttl_deletes_finished_job():
+    cs = Clientset()
+    jc = JobController(cs)
+    jc.start()
+    kl = LocalKubelet(cs)
+    kl.start()
+    try:
+        cs.jobs("default").create(_job(
+            "quick", [sys.executable, "-c", "print('ok')"],
+            ttl_seconds_after_finished=1))
+        assert _wait(lambda: batch.is_job_succeeded(
+            cs.jobs("default").get("quick")))
+        def gone():
+            try:
+                cs.jobs("default").get("quick")
+                return False
+            except Exception:
+                return True
+        assert _wait(gone, timeout=10)
+    finally:
+        kl.stop()
+        jc.stop()
+
+
+def test_kubelet_on_failure_restarts_in_place():
+    cs = Clientset()
+    kl = LocalKubelet(cs)
+    kl.start()
+    try:
+        script = ("import os, sys\n"
+                  "marker = os.environ['K_SANDBOX_DIR'] + '/once'\n"
+                  "if os.path.exists(marker):\n"
+                  "    print('second'); sys.exit(0)\n"
+                  "open(marker, 'w').close(); sys.exit(1)\n")
+        container = Container(name="c",
+                              command=[sys.executable, "-c", script])
+        pod = core.Pod(
+            metadata=ObjectMeta(name="flaky", namespace="default"),
+            spec=PodSpec(restart_policy="OnFailure",
+                         containers=[container]))
+        cs.pods("default").create(pod)
+        assert _wait(lambda: cs.pods("default").get("flaky").status.phase
+                     == core.POD_SUCCEEDED)
+        statuses = cs.pods("default").get("flaky").status.container_statuses
+        assert statuses and statuses[0].restart_count >= 1
+    finally:
+        kl.stop()
