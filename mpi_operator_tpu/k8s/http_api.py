@@ -1,0 +1,300 @@
+"""HTTP transport for the API machinery: REST server + remote client.
+
+Makes the control plane deployable across processes/hosts: `ApiHttpServer`
+exposes an ApiServer over REST (create/get/list/update/status/delete +
+streaming watch), and `RemoteApiServer` implements the same interface the
+in-process `Clientset` consumes — so
+``Clientset(server=RemoteApiServer(url))`` drives the identical
+controller code over the network.  This is the substrate-agnosticity the
+reference gets from kube-apiserver + client-go.
+
+Wire shape (kept deliberately simple, not the full kube path grammar):
+
+    /objects/{ns}/{kind}[/{name}][?apiVersion=...&labelSelector=k=v,...]
+    /watch/{kind}?apiVersion=...        (x-ndjson stream)
+    PUT .../{name}/status               (status subresource)
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import registry
+from .apiserver import ApiError, ApiServer, WatchEvent
+
+_ERROR_STATUS = {"NotFound": 404, "AlreadyExists": 409, "Conflict": 409,
+                 "Invalid": 422, "Forbidden": 403}
+
+
+def _parse_selector(raw: Optional[str]) -> Optional[dict]:
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        key, _, val = part.partition("=")
+        out[key] = val
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    @property
+    def store(self) -> ApiServer:
+        return self.server.store  # type: ignore[attr-defined]
+
+    # -- helpers -----------------------------------------------------------
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, exc: ApiError) -> None:
+        self._json(_ERROR_STATUS.get(exc.code, 500),
+                   {"code": exc.code, "message": exc.message})
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        return registry.decode(json.loads(self.rfile.read(length)))
+
+    def _route(self):
+        parsed = urllib.parse.urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = urllib.parse.parse_qs(parsed.query)
+        api_version = query.get("apiVersion", ["v1"])[0]
+        return parts, query, api_version
+
+    # -- verbs -------------------------------------------------------------
+    def do_POST(self):
+        parts, _, _ = self._route()
+        if len(parts) == 3 and parts[0] == "objects":
+            try:
+                created = self.store.create(self._read_body())
+                return self._json(201, registry.encode(created))
+            except ApiError as exc:
+                return self._error(exc)
+        self._json(404, {"code": "NotFound", "message": "no route"})
+
+    def do_GET(self):
+        parts, query, api_version = self._route()
+        try:
+            if parts and parts[0] == "watch" and len(parts) == 2:
+                return self._stream_watch(api_version, parts[1])
+            if len(parts) == 4 and parts[0] == "objects":
+                obj = self.store.get(api_version, parts[2], parts[1],
+                                     parts[3])
+                return self._json(200, registry.encode(obj))
+            if len(parts) == 3 and parts[0] == "objects":
+                selector = _parse_selector(
+                    query.get("labelSelector", [None])[0])
+                ns = None if parts[1] == "-" else parts[1]  # "-" = all
+                items = self.store.list(api_version, parts[2], ns, selector)
+                return self._json(200,
+                                  {"items": [registry.encode(o)
+                                             for o in items]})
+        except ApiError as exc:
+            return self._error(exc)
+        self._json(404, {"code": "NotFound", "message": "no route"})
+
+    def do_PUT(self):
+        parts, _, _ = self._route()
+        try:
+            if len(parts) == 5 and parts[0] == "objects" \
+                    and parts[4] == "status":
+                updated = self.store.update(self._read_body(), "status")
+                return self._json(200, registry.encode(updated))
+            if len(parts) == 4 and parts[0] == "objects":
+                updated = self.store.update(self._read_body())
+                return self._json(200, registry.encode(updated))
+        except ApiError as exc:
+            return self._error(exc)
+        self._json(404, {"code": "NotFound", "message": "no route"})
+
+    def do_DELETE(self):
+        parts, _, api_version = self._route()
+        try:
+            if len(parts) == 4 and parts[0] == "objects":
+                deleted = self.store.delete(api_version, parts[2], parts[1],
+                                            parts[3])
+                return self._json(200, registry.encode(deleted))
+        except ApiError as exc:
+            return self._error(exc)
+        self._json(404, {"code": "NotFound", "message": "no route"})
+
+    def _stream_watch(self, api_version: str, kind: str) -> None:
+        watch = self.store.watch(api_version, kind)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while not self.server.stopping:  # type: ignore[attr-defined]
+                ev = watch.next(timeout=0.5)
+                if ev is None:
+                    chunk = b": keepalive\n"
+                else:
+                    chunk = (json.dumps(
+                        {"type": ev.type,
+                         "object": registry.encode(ev.obj)}) + "\n").encode()
+                self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk
+                                 + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            watch.stop()
+
+
+class ApiHttpServer:
+    """Serve an ApiServer over HTTP."""
+
+    def __init__(self, store: Optional[ApiServer] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store or ApiServer()
+        self._http = ThreadingHTTPServer((host, port), _Handler)
+        self._http.store = self.store  # type: ignore[attr-defined]
+        self._http.stopping = False  # type: ignore[attr-defined]
+        self.port = self._http.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "ApiHttpServer":
+        self._thread = threading.Thread(target=self._http.serve_forever,
+                                        daemon=True, name="api-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.stopping = True  # type: ignore[attr-defined]
+        self._http.shutdown()
+        self._http.server_close()
+
+
+class _RemoteWatch:
+    """Client side of the ndjson watch stream (Watch-compatible)."""
+
+    def __init__(self, url: str):
+        self._q: "queue.Queue[WatchEvent]" = queue.Queue()
+        self.stopped = False
+        self._resp = None
+        self._thread = threading.Thread(target=self._pump, args=(url,),
+                                        daemon=True, name="remote-watch")
+        self._thread.start()
+
+    def _pump(self, url: str) -> None:
+        try:
+            self._resp = urllib.request.urlopen(url)
+            for raw in self._resp:
+                if self.stopped:
+                    return
+                line = raw.strip()
+                if not line or line.startswith(b":"):
+                    continue
+                data = json.loads(line)
+                self._q.put(WatchEvent(data["type"],
+                                       registry.decode(data["object"])))
+        except Exception:
+            pass  # connection closed
+
+    def next(self, timeout: float | None = None) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self.stopped = True
+        try:
+            if self._resp is not None:
+                self._resp.close()
+        except Exception:
+            pass
+
+
+class RemoteApiServer:
+    """ApiServer-interface proxy over HTTP — plug into Clientset(server=...)."""
+
+    def __init__(self, url: str):
+        self.base = url.rstrip("/")
+
+    # -- plumbing ----------------------------------------------------------
+    def _request(self, method: str, path: str, obj=None):
+        data = None
+        headers = {}
+        if obj is not None:
+            data = json.dumps(registry.encode(obj)).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(self.base + path, data=data,
+                                     headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            try:
+                payload = json.loads(exc.read())
+                raise ApiError(payload.get("code", "Unknown"),
+                               payload.get("message", str(exc))) from None
+            except (ValueError, KeyError):
+                raise ApiError("Unknown", str(exc)) from None
+
+    @staticmethod
+    def _qs(api_version: str, **extra) -> str:
+        params = {"apiVersion": api_version, **{k: v for k, v in
+                                                extra.items() if v}}
+        return "?" + urllib.parse.urlencode(params)
+
+    # -- ApiServer interface ----------------------------------------------
+    def create(self, obj):
+        return registry.decode(self._request(
+            "POST",
+            f"/objects/{obj.metadata.namespace}/{obj.kind}"
+            + self._qs(obj.api_version), obj))
+
+    def get(self, api_version: str, kind: str, namespace: str, name: str):
+        return registry.decode(self._request(
+            "GET", f"/objects/{namespace}/{kind}/{name}"
+            + self._qs(api_version)))
+
+    def list(self, api_version: str, kind: str,
+             namespace: Optional[str] = None,
+             label_selector: Optional[dict] = None) -> list:
+        selector = ",".join(f"{k}={v}" for k, v in
+                            (label_selector or {}).items())
+        ns = namespace if namespace is not None else "-"
+        payload = self._request(
+            "GET", f"/objects/{ns}/{kind}"
+            + self._qs(api_version, labelSelector=selector))
+        return [registry.decode(o) for o in payload["items"]]
+
+    def update(self, obj, subresource: str = ""):
+        path = (f"/objects/{obj.metadata.namespace}/{obj.kind}/"
+                f"{obj.metadata.name}")
+        if subresource:
+            path += f"/{subresource}"
+        return registry.decode(self._request("PUT",
+                                             path + self._qs(obj.api_version),
+                                             obj))
+
+    def delete(self, api_version: str, kind: str, namespace: str, name: str):
+        return registry.decode(self._request(
+            "DELETE", f"/objects/{namespace}/{kind}/{name}"
+            + self._qs(api_version)))
+
+    def watch(self, api_version: str, kind: str) -> _RemoteWatch:
+        return _RemoteWatch(
+            self.base + f"/watch/{kind}" + self._qs(api_version))

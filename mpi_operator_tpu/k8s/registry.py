@@ -1,0 +1,66 @@
+"""Kind registry: (apiVersion, kind) -> typed class + wire (de)serializers.
+
+The scheme-registration analogue (reference: pkg/apis/kubeflow/v2beta1/
+register.go:33-37) used by the HTTP transport to reconstruct typed
+objects from JSON.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from .meta import from_dict, to_dict
+
+
+def _kinds() -> dict:
+    from ..api.types import MPIJob
+    from . import batch, core, scheduling
+    from ..server.leader_election import Lease
+
+    return {
+        ("v1", "Pod"): core.Pod,
+        ("v1", "Service"): core.Service,
+        ("v1", "ConfigMap"): core.ConfigMap,
+        ("v1", "Secret"): core.Secret,
+        ("v1", "Event"): core.Event,
+        ("batch/v1", "Job"): batch.Job,
+        ("kubeflow.org/v2beta1", "MPIJob"): MPIJob,
+        (scheduling.VOLCANO_API_VERSION, "PodGroup"):
+            scheduling.VolcanoPodGroup,
+        (scheduling.SCHED_PLUGINS_API_VERSION, "PodGroup"):
+            scheduling.SchedPluginsPodGroup,
+        ("coordination.k8s.io/v1", "Lease"): Lease,
+    }
+
+
+_CACHE: dict = {}
+
+
+def lookup(api_version: str, kind: str):
+    if not _CACHE:
+        _CACHE.update(_kinds())
+    cls = _CACHE.get((api_version, kind))
+    if cls is None:
+        raise KeyError(f"unregistered kind {api_version}/{kind}")
+    return cls
+
+
+def encode(obj) -> dict:
+    wire = to_dict(obj)
+    wire["apiVersion"] = obj.api_version
+    wire["kind"] = obj.kind
+    return wire
+
+
+def decode(data: dict):
+    api_version = data.get("apiVersion", "v1")
+    kind = data.get("kind", "")
+    cls = lookup(api_version, kind)
+    obj = from_dict(cls, data)
+    obj.api_version = api_version
+    obj.kind = kind
+    # Secret data is base64 on the wire (k8s semantics); bytes in memory.
+    if kind == "Secret" and obj.data:
+        obj.data = {k: base64.b64decode(v) if isinstance(v, str) else v
+                    for k, v in obj.data.items()}
+    return obj

@@ -65,7 +65,15 @@ class OperatorApp:
 
     def __init__(self, opt: ServerOption, clientset: Optional[Clientset] = None):
         self.opt = opt
-        self.client = clientset or Clientset()
+        if clientset is None:
+            if opt.master_url:
+                # --master: drive a remote API server over HTTP (the
+                # deployable topology; server.go:108 equivalent).
+                from ..k8s.http_api import RemoteApiServer
+                clientset = Clientset(server=RemoteApiServer(opt.master_url))
+            else:
+                clientset = Clientset()
+        self.client = clientset
         self.metrics = new_operator_metrics()
         self.controller: Optional[MPIJobController] = None
         self._http: Optional[http.server.ThreadingHTTPServer] = None
