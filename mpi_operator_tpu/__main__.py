@@ -1,0 +1,211 @@
+"""Command-line interface.
+
+    python -m mpi_operator_tpu apiserver --port 8001
+    python -m mpi_operator_tpu operator --master http://...:8001
+    python -m mpi_operator_tpu cluster --port 8001     # all-in-one
+    python -m mpi_operator_tpu submit -f job.yaml --master ...
+    python -m mpi_operator_tpu get [-n ns] [--master ...]
+    python -m mpi_operator_tpu suspend/resume/delete NAME [--master ...]
+    python -m mpi_operator_tpu version
+
+The kubectl-shaped surface over the framework: `cluster` runs the
+in-memory API server + operator + Job controller + kubelet in one
+process and serves the store over HTTP so `submit`/`get` work from other
+terminals — the single-host analogue of "kind + operator deployment +
+kubectl apply" from the reference's workflow (README.md quick start).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def _client(master: str):
+    from .k8s.apiserver import Clientset
+    from .k8s.http_api import RemoteApiServer
+    return Clientset(server=RemoteApiServer(master))
+
+
+def cmd_apiserver(args) -> int:
+    from .k8s.http_api import ApiHttpServer
+    server = ApiHttpServer(port=args.port).start()
+    print(f"apiserver listening on {server.url}")
+    _wait_for_signal()
+    server.stop()
+    return 0
+
+
+def cmd_operator(args, extra) -> int:
+    from .server.app import run
+    app = run(extra)
+    print("operator running (leader election + controller)")
+    _wait_for_signal()
+    app.stop()
+    return 0
+
+
+def cmd_cluster(args) -> int:
+    from .k8s.http_api import ApiHttpServer
+    from .server.cluster import LocalCluster
+
+    cluster = LocalCluster()
+    cluster.start()
+    server = ApiHttpServer(store=cluster.client.server,
+                           port=args.port).start()
+    print(f"cluster up: apiserver {server.url}; submit jobs with\n"
+          f"  python -m mpi_operator_tpu submit -f job.yaml"
+          f" --master {server.url}")
+    _wait_for_signal()
+    server.stop()
+    cluster.stop()
+    return 0
+
+
+def cmd_submit(args) -> int:
+    from .sdk import job_from_yaml
+
+    with open(args.file) as f:
+        job = job_from_yaml(f.read())
+    if args.namespace:
+        job.metadata.namespace = args.namespace
+    job.metadata.namespace = job.metadata.namespace or "default"
+    client = _client(args.master)
+    created = client.mpi_jobs(job.metadata.namespace).create(job)
+    print(f"mpijob.kubeflow.org/{created.metadata.name} created")
+    if args.wait:
+        from .sdk import MPIJobClient
+        sdk = MPIJobClient(client, namespace=job.metadata.namespace)
+        done = sdk.wait_for_completion(created.metadata.name,
+                                       timeout=args.timeout)
+        print(f"mpijob {done.metadata.name} succeeded")
+    return 0
+
+
+def _condition_summary(job) -> str:
+    for ctype in ("Failed", "Succeeded", "Suspended", "Running", "Created"):
+        for c in job.status.conditions:
+            if c.type == ctype and c.status == "True":
+                return ctype
+    return "Pending"
+
+
+def cmd_get(args) -> int:
+    client = _client(args.master)
+    jobs = client.mpi_jobs(args.namespace).list()
+    print(f"{'NAME':24} {'STATUS':12} {'WORKERS':8} AGE")
+    for job in jobs:
+        workers = 0
+        spec = job.spec.mpi_replica_specs.get("Worker")
+        if spec is not None and spec.replicas:
+            workers = spec.replicas
+        age = ""
+        if job.metadata.creation_timestamp is not None:
+            import datetime
+            delta = (datetime.datetime.now(datetime.timezone.utc)
+                     - job.metadata.creation_timestamp)
+            age = f"{int(delta.total_seconds())}s"
+        print(f"{job.metadata.name:24} {_condition_summary(job):12}"
+              f" {workers:<8} {age}")
+    return 0
+
+
+def cmd_lifecycle(args, action: str) -> int:
+    from .sdk import MPIJobClient
+    sdk = MPIJobClient(_client(args.master), namespace=args.namespace)
+    if action == "suspend":
+        sdk.suspend(args.name)
+    elif action == "resume":
+        sdk.resume(args.name)
+    else:
+        sdk.delete(args.name)
+    print(f"mpijob.kubeflow.org/{args.name} {action}d"
+          if action != "delete" else
+          f"mpijob.kubeflow.org/{args.name} deleted")
+    return 0
+
+
+def cmd_version(args) -> int:
+    from . import version
+    info = version.info()
+    print(f"mpi-operator-tpu {info['version']} (git {info['gitSHA']},"
+          f" {info['goVersion']}, {info['platform']})")
+    return 0
+
+
+def _wait_for_signal() -> None:
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="mpi-operator-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("apiserver", help="serve the API store over HTTP")
+    p.add_argument("--port", type=int, default=8001)
+
+    sub.add_parser("operator",
+                   help="run the operator (extra flags pass through)")
+
+    p = sub.add_parser("cluster", help="all-in-one local cluster")
+    p.add_argument("--port", type=int, default=8001)
+
+    p = sub.add_parser("submit", help="submit an MPIJob yaml")
+    p.add_argument("-f", "--file", required=True)
+    p.add_argument("-n", "--namespace", default="")
+    p.add_argument("--master", default="http://127.0.0.1:8001")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600.0)
+
+    p = sub.add_parser("get", help="list MPIJobs")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--master", default="http://127.0.0.1:8001")
+
+    for action in ("suspend", "resume", "delete"):
+        p = sub.add_parser(action, help=f"{action} an MPIJob")
+        p.add_argument("name")
+        p.add_argument("-n", "--namespace", default="default")
+        p.add_argument("--master", default="http://127.0.0.1:8001")
+
+    sub.add_parser("version", help="print version")
+
+    args, extra = parser.parse_known_args(argv)
+    try:
+        if args.command == "apiserver":
+            return cmd_apiserver(args)
+        if args.command == "operator":
+            return cmd_operator(args, extra)
+        if args.command == "cluster":
+            return cmd_cluster(args)
+        if args.command == "submit":
+            return cmd_submit(args)
+        if args.command == "get":
+            return cmd_get(args)
+        if args.command in ("suspend", "resume", "delete"):
+            return cmd_lifecycle(args, args.command)
+        if args.command == "version":
+            return cmd_version(args)
+    except Exception as exc:  # clean one-line errors, kubectl-style
+        import urllib.error
+
+        from .k8s.apiserver import ApiError
+        if isinstance(exc, ApiError):
+            print(f"error: {exc.message}", file=sys.stderr)
+        elif isinstance(exc, urllib.error.URLError):
+            print(f"error: cannot reach API server: {exc.reason}",
+                  file=sys.stderr)
+        else:
+            print(f"error: {exc}", file=sys.stderr)
+        return 1
+    parser.error(f"unknown command {args.command}")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,105 @@
+"""CLI tests: the kubectl-shaped surface driving a real cluster process
+over HTTP (the reference workflow's `kubectl apply -f pi.yaml` analogue,
+README.md quick start)."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_cli(*args, timeout=60):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    proc = subprocess.run([sys.executable, "-m", "mpi_operator_tpu", *args],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env, cwd=REPO_ROOT)
+    return proc
+
+
+def test_cli_version():
+    proc = run_cli("version")
+    assert proc.returncode == 0
+    assert "mpi-operator-tpu v" in proc.stdout
+
+
+def test_cli_cluster_submit_get_lifecycle(tmp_path):
+    port = free_port()
+    master = f"http://127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    cluster = subprocess.Popen(
+        [sys.executable, "-m", "mpi_operator_tpu", "cluster", "--port",
+         str(port)], env=env, cwd=REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.monotonic() + 20
+        up = False
+        while time.monotonic() < deadline and not up:
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=1):
+                    up = True
+            except OSError:
+                time.sleep(0.2)
+        assert up, "cluster apiserver never came up"
+
+        job_yaml = tmp_path / "job.yaml"
+        job_yaml.write_text(f"""
+apiVersion: kubeflow.org/v2beta1
+kind: MPIJob
+metadata:
+  name: cli-pi
+spec:
+  mpiImplementation: JAX
+  runLauncherAsWorker: true
+  mpiReplicaSpecs:
+    Launcher:
+      replicas: 1
+      template:
+        spec:
+          containers:
+            - name: l
+              image: local
+              command: ["{sys.executable}", "-c", "print('cli ran me')"]
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+            - name: w
+              image: local
+              command: ["{sys.executable}", "-c",
+                        "import time; time.sleep(30)"]
+""")
+        proc = run_cli("submit", "-f", str(job_yaml), "--master", master,
+                       "--wait", "--timeout", "60")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "cli-pi created" in proc.stdout
+        assert "succeeded" in proc.stdout
+
+        proc = run_cli("get", "--master", master)
+        assert proc.returncode == 0
+        assert "cli-pi" in proc.stdout and "Succeeded" in proc.stdout
+
+        proc = run_cli("delete", "cli-pi", "--master", master)
+        assert proc.returncode == 0
+        proc = run_cli("get", "--master", master)
+        assert "cli-pi" not in proc.stdout
+    finally:
+        cluster.terminate()
+        try:
+            cluster.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            cluster.kill()
