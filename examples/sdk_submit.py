@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""SDK submission sample — parity with the reference's
+sdk/python/v2beta1/tensorflow-mnist.py notebook flow: build an MPIJob
+with the typed models, submit, wait, inspect conditions.
+
+Run against a live cluster:  python -m mpi_operator_tpu cluster --port 8001
+then:                        python examples/sdk_submit.py --master http://127.0.0.1:8001
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--master", default="http://127.0.0.1:8001")
+    parser.add_argument("--workers", type=int, default=2)
+    args = parser.parse_args()
+
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    from mpi_operator_tpu.k8s.http_api import RemoteApiServer
+    from mpi_operator_tpu.sdk import MPIJobClient, new_jax_job
+
+    client = MPIJobClient(Clientset(server=RemoteApiServer(args.master)))
+
+    pi = os.path.join(os.path.dirname(os.path.abspath(__file__)), "jax_pi.py")
+    job = new_jax_job("sdk-pi", image="local",
+                      command=[sys.executable, pi, "500000"],
+                      workers=args.workers)
+    client.create(job)
+    print("submitted sdk-pi; waiting...")
+    done = client.wait_for_completion("sdk-pi", timeout=180)
+    for cond in done.status.conditions:
+        print(f"  {cond.type}={cond.status} ({cond.reason})")
+    client.delete("sdk-pi")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -223,3 +223,26 @@ def test_e2e_scheduling_gates_hold_pods_until_cleared():
             time.sleep(0.05)
         assert cluster.client.pods("default").get("gated").status.phase == \
             "Succeeded"
+
+
+def test_e2e_many_concurrent_jobs():
+    """Concurrency stress: several jobs reconciled simultaneously by the
+    threaded controller all complete with correct per-job resources (the
+    per-key workqueue serialization + DeepCopy discipline under load)."""
+    with LocalCluster(threadiness=4) as cluster:
+        names = [f"par-{i}" for i in range(6)]
+        for name in names:
+            job = jax_job(
+                name,
+                launcher_cmd=[sys.executable, "-c",
+                              f"print('done {name}')"],
+                worker_cmd=[sys.executable, "-c",
+                            "import time; time.sleep(45)"],
+                workers=2)
+            cluster.submit(job)
+        for name in names:
+            done = cluster.wait_for_condition("default", name,
+                                              constants.JOB_SUCCEEDED,
+                                              timeout=60)
+            assert done.status.completion_time is not None
+            assert f"done {name}" in cluster.launcher_logs("default", name)
