@@ -32,10 +32,12 @@ def main() -> int:
                       workers=args.workers)
     client.create(job)
     print("submitted sdk-pi; waiting...")
-    done = client.wait_for_completion("sdk-pi", timeout=180)
-    for cond in done.status.conditions:
-        print(f"  {cond.type}={cond.status} ({cond.reason})")
-    client.delete("sdk-pi")
+    try:
+        done = client.wait_for_completion("sdk-pi", timeout=180)
+        for cond in done.status.conditions:
+            print(f"  {cond.type}={cond.status} ({cond.reason})")
+    finally:
+        client.delete("sdk-pi")   # no leaked job on failure/timeout
     return 0
 
 
