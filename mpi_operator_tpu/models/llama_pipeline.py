@@ -1,0 +1,84 @@
+"""Pipeline-parallel Llama forward/loss.
+
+Bridges the model family to parallel/pipeline.py: the (homogeneous)
+transformer blocks are stacked [n_layers, ...], reshaped into
+[pp_stages, layers_per_stage, ...], and streamed as a GPipe ring — each
+pipeline rank scans its layers_per_stage blocks (``lax.scan``, one
+compiled block body) while microbatches flow through ``ppermute``.
+Embedding / final norm / LM head stay replicated outside the ring.
+
+Weights are interchangeable with LlamaModel: ``stack_block_params``
+converts a standard checkpoint, and the pipelined forward matches
+LlamaModel.apply exactly (tested).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.pipeline import (merge_microbatches, pipeline_apply,
+                                 split_microbatches)
+from .llama import LlamaBlock, LlamaConfig, RMSNorm
+
+
+def stack_block_params(params: dict, config: LlamaConfig) -> dict:
+    """params["params"]["layers_i"] trees -> one tree with leaves
+    [n_layers, ...]."""
+    layers = [params["params"][f"layers_{i}"]
+              for i in range(config.n_layers)]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def _reshape_for_stages(stacked: dict, pp: int) -> dict:
+    """[L, ...] -> [pp, L/pp, ...]."""
+    def reshape(leaf):
+        l = leaf.shape[0]
+        assert l % pp == 0, (l, pp)
+        return leaf.reshape((pp, l // pp) + leaf.shape[1:])
+    return jax.tree_util.tree_map(reshape, stacked)
+
+
+def pipeline_forward(config: LlamaConfig, variables: dict, tokens,
+                     mesh, num_microbatches: int = 4):
+    """Pipelined causal-LM forward: tokens [B, S] -> logits [B, S, V].
+
+    The mesh must carry a 'pp' axis dividing n_layers; batch B must
+    divide num_microbatches (and the per-microbatch batch must divide
+    the dp x fsdp axes).
+    """
+    pp = mesh.shape["pp"]
+    assert config.n_layers % pp == 0, (config.n_layers, pp)
+    params = variables["params"]
+
+    s = tokens.shape[1]
+    positions = jnp.arange(s)
+    emb = params["tok_embeddings"]["embedding"]
+    x = jnp.asarray(emb)[tokens].astype(config.dtype)
+
+    block = LlamaBlock(config)          # single compiled block body
+
+    def stage_fn(stage_params, x):
+        def body(x, layer_params):
+            return block.apply({"params": layer_params}, x, positions), None
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+
+    staged = _reshape_for_stages(stack_block_params(variables, config), pp)
+    micro = split_microbatches(x, num_microbatches)
+    x = merge_microbatches(pipeline_apply(stage_fn, staged, micro, mesh))
+
+    x = RMSNorm(config.norm_eps, config.param_dtype).apply(
+        {"params": params["norm"]}, x)
+    logits = (x @ params["output"]["kernel"].astype(config.dtype))
+    return logits
+
+
+def pipeline_loss(config: LlamaConfig, variables: dict, tokens, mesh,
+                  num_microbatches: int = 4):
+    from .llama import next_token_loss
+    logits = pipeline_forward(config, variables, tokens, mesh,
+                              num_microbatches)
+    return next_token_loss(logits, tokens)
