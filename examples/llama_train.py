@@ -28,6 +28,9 @@ def main() -> int:
     parser.add_argument("--dp", type=int, default=-1)
     parser.add_argument("--fsdp", type=int, default=1)
     parser.add_argument("--ep", type=int, default=1)
+    parser.add_argument("--pp", type=int, default=1,
+                        help=">1 switches to the GPipe pipelined forward")
+    parser.add_argument("--microbatches", type=int, default=4)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--remat", action="store_true")
@@ -51,8 +54,8 @@ def main() -> int:
                                                 seq_batch_sharding)
     from mpi_operator_tpu.parallel.train import build_train_step
 
-    mesh = create_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, ep=args.ep,
-                                  tp=args.tp, sp=args.sp))
+    mesh = create_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, pp=args.pp,
+                                  ep=args.ep, tp=args.tp, sp=args.sp))
     cfg = {"7b": llama2_7b, "tiny": llama2_tiny,
            "mixtral-tiny": mixtral_tiny,
            "mixtral-8x7b": mixtral_8x7b}[args.config](remat=args.remat)
@@ -64,12 +67,20 @@ def main() -> int:
 
     tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
                                 cfg.vocab_size)
-    params = model.init(jax.random.PRNGKey(1), tokens[:1, :8])
+    # init batch must honor the activation shardings (divisible by dp*fsdp)
+    params = model.init(jax.random.PRNGKey(1), tokens[:, :8])
     if cfg.n_experts > 1:   # drop the aux-loss collection for training
         params = {"params": params["params"]}
 
-    def loss_fn(params, batch):
-        return next_token_loss(model.apply(params, batch), batch)
+    if args.pp > 1:
+        from mpi_operator_tpu.models.llama_pipeline import pipeline_loss
+
+        def loss_fn(params, batch):
+            return pipeline_loss(cfg, params, batch, mesh,
+                                 args.microbatches)
+    else:
+        def loss_fn(params, batch):
+            return next_token_loss(model.apply(params, batch), batch)
 
     mgr = None
     if args.checkpoint_dir:
@@ -100,6 +111,7 @@ def main() -> int:
     tokens_per_sec = batch * seq * args.steps / elapsed
     if jax.process_index() == 0:
         print(f"mesh dp={mesh.shape['dp']} fsdp={mesh.shape['fsdp']}"
+              f" pp={mesh.shape['pp']} ep={mesh.shape['ep']}"
               f" tp={mesh.shape['tp']} sp={mesh.shape['sp']}")
         print(f"tokens/sec: {tokens_per_sec:.0f} loss={final_loss:.4f}")
     return 0

@@ -32,7 +32,7 @@ def test_llama_example_tiny_with_tp_and_checkpoint(tmp_path):
     out = _run("llama_train.py", "--config", "tiny", "--steps", "3",
                "--tp", "2", "--sp", "2", "--seq-len", "64",
                "--checkpoint-dir", ckpt, "--checkpoint-every", "2")
-    assert "mesh dp=1 fsdp=1 tp=2 sp=2" in out
+    assert "mesh dp=1 fsdp=1 pp=1 ep=1 tp=2 sp=2" in out
     assert "tokens/sec" in out
     assert os.path.isdir(os.path.join(ckpt, "step_00000002")), out
     # resume path
