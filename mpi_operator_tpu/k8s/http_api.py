@@ -197,19 +197,29 @@ class _RemoteWatch:
         self._thread.start()
 
     def _pump(self, url: str) -> None:
-        try:
-            self._resp = urllib.request.urlopen(url)
-            for raw in self._resp:
-                if self.stopped:
-                    return
-                line = raw.strip()
-                if not line or line.startswith(b":"):
-                    continue
-                data = json.loads(line)
-                self._q.put(WatchEvent(data["type"],
-                                       registry.decode(data["object"])))
-        except Exception:
-            pass  # connection closed
+        import time
+        backoff = 0.2
+        while not self.stopped:
+            try:
+                self._resp = urllib.request.urlopen(url)
+                backoff = 0.2
+                for raw in self._resp:
+                    if self.stopped:
+                        return
+                    line = raw.strip()
+                    if not line or line.startswith(b":"):
+                        continue
+                    data = json.loads(line)
+                    self._q.put(WatchEvent(data["type"],
+                                           registry.decode(data["object"])))
+            except Exception:
+                pass  # connection lost; fall through to reconnect
+            if self.stopped:
+                return
+            # Reconnect with backoff.  Events during the gap are missed;
+            # the informer's periodic resync reconciles them.
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
 
     def next(self, timeout: float | None = None) -> Optional[WatchEvent]:
         try:

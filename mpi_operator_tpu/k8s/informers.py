@@ -44,12 +44,20 @@ class Lister:
 
 
 class SharedInformer:
+    # Periodic relist+diff: heals missed watch events (stream gaps,
+    # reconnects) the way client-go's resync does; level-triggered
+    # consumers re-observe every object each interval.
+    RESYNC_INTERVAL = 30.0
+
     def __init__(self, clientset: Clientset, api_version: str, kind: str,
-                 namespace: Optional[str] = None):
+                 namespace: Optional[str] = None,
+                 resync_interval: Optional[float] = None):
         self._cs = clientset
         self.api_version = api_version
         self.kind = kind
         self.namespace = namespace
+        self.resync_interval = (resync_interval if resync_interval is not None
+                                else self.RESYNC_INTERVAL)
         self._lock = threading.RLock()
         self._store: dict = {}
         self.lister = Lister(self._store, self._lock)
@@ -101,21 +109,49 @@ class SharedInformer:
         self._thread.start()
 
     def _run(self) -> None:
+        import time
+        last_resync = time.monotonic()
         while not self._stopped.is_set():
             ev = self._watch.next(timeout=0.1)
-            if ev is None:
-                continue
-            obj = ev.obj
-            if self.namespace is not None and obj.metadata.namespace != self.namespace:
-                continue
-            key = (obj.metadata.namespace, obj.metadata.name)
-            with self._lock:
+            if ev is not None:
+                obj = ev.obj
+                if self.namespace is not None \
+                        and obj.metadata.namespace != self.namespace:
+                    continue
+                key = (obj.metadata.namespace, obj.metadata.name)
+                with self._lock:
+                    old = self._store.get(key)
+                    if ev.type == DELETED:
+                        self._store.pop(key, None)
+                    else:
+                        self._store[key] = deep_copy(obj)
+                self._dispatch(ev.type, old, obj)
+            if self.resync_interval and \
+                    time.monotonic() - last_resync >= self.resync_interval:
+                last_resync = time.monotonic()
+                try:
+                    self._resync()
+                except Exception:
+                    pass  # transient API failure; next interval retries
+
+    def _resync(self) -> None:
+        """Relist and reconcile the cache with the store, dispatching the
+        implied events (heals watch-stream gaps)."""
+        current = {(o.metadata.namespace, o.metadata.name): o
+                   for o in self._cs.server.list(self.api_version, self.kind,
+                                                 self.namespace)}
+        with self._lock:
+            stale_keys = [k for k in self._store if k not in current]
+            updates = []
+            for key, obj in current.items():
                 old = self._store.get(key)
-                if ev.type == DELETED:
-                    self._store.pop(key, None)
-                else:
-                    self._store[key] = deep_copy(obj)
-            self._dispatch(ev.type, old, obj)
+                self._store[key] = deep_copy(obj)
+                updates.append((old, obj))
+            removed = [self._store.pop(k) for k in stale_keys]
+        for old, obj in updates:
+            self._dispatch(ADDED if old is None else MODIFIED, old, obj)
+        for obj in removed:
+            self._dispatch(DELETED, None, obj)
 
     def stop(self) -> None:
         self._stopped.set()

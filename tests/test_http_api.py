@@ -146,3 +146,35 @@ def test_operator_app_with_master_flag():
     finally:
         app.stop()
         api.stop()
+
+
+def test_remote_watch_reconnects_after_server_restart():
+    """The client watch stream must survive an apiserver restart on the
+    same port (reconnect with backoff)."""
+    server = ApiHttpServer().start()
+    port = server.port
+    cs = Clientset(server=RemoteApiServer(server.url))
+    watch = cs.config_maps("ns").watch()
+    time.sleep(0.3)
+
+    server.stop()
+    time.sleep(0.2)
+    server2 = ApiHttpServer(port=port).start()
+    try:
+        cs2 = Clientset(server=RemoteApiServer(server2.url))
+        deadline = time.monotonic() + 10
+        ev = None
+        created = False
+        while time.monotonic() < deadline and ev is None:
+            if not created:
+                try:
+                    cs2.config_maps("ns").create(ConfigMap(
+                        metadata=ObjectMeta(name="after", namespace="ns")))
+                    created = True
+                except ApiError:
+                    created = True  # AlreadyExists from a prior lap
+            ev = watch.next(timeout=0.5)
+        assert ev is not None and ev.obj.metadata.name == "after"
+    finally:
+        watch.stop()
+        server2.stop()

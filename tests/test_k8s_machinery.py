@@ -278,3 +278,34 @@ def test_apiserver_optimistic_concurrency_under_contention():
         t.join(timeout=60)
     final = int(cs.config_maps("ns").get("counter").data["n"])
     assert final == per_thread * n_threads, final
+
+
+def test_informer_resync_heals_watch_gap():
+    """If the watch stream dies silently, the periodic resync must bring
+    the cache (and handlers) back in sync."""
+    cs = Clientset()
+    factory = InformerFactory(cs)
+    inf = factory.pods()
+    inf.resync_interval = 0.3
+    seen = []
+    inf.add_event_handler(on_add=lambda o: seen.append(("add", o.metadata.name)),
+                          on_delete=lambda o: seen.append(("del", o.metadata.name)))
+    factory.start_all()
+    assert factory.wait_for_cache_sync()
+
+    inf._watch.stop()  # simulate a dead stream (no more events delivered)
+    cs.pods("ns").create(Pod(metadata=ObjectMeta(name="missed", namespace="ns")))
+
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and inf.lister.get("ns", "missed") is None:
+        time.sleep(0.05)
+    assert inf.lister.get("ns", "missed") is not None
+    assert ("add", "missed") in seen
+
+    cs.pods("ns").delete("missed")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and inf.lister.get("ns", "missed"):
+        time.sleep(0.05)
+    assert inf.lister.get("ns", "missed") is None
+    assert ("del", "missed") in seen
+    factory.stop_all()
