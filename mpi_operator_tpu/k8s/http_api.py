@@ -200,10 +200,18 @@ class _RemoteWatch:
         import time
         backoff = 0.2
         while not self.stopped:
+            resp = None
             try:
-                self._resp = urllib.request.urlopen(url)
+                # Read timeout >> keepalive period (0.5s): a silently dead
+                # peer (partition, power loss — no FIN) surfaces as a
+                # timeout and triggers reconnection instead of blocking
+                # forever.
+                resp = urllib.request.urlopen(url, timeout=5)
+                self._resp = resp
+                if self.stopped:  # stop() may have raced the dial
+                    return
                 backoff = 0.2
-                for raw in self._resp:
+                for raw in resp:
                     if self.stopped:
                         return
                     line = raw.strip()
@@ -213,7 +221,13 @@ class _RemoteWatch:
                     self._q.put(WatchEvent(data["type"],
                                            registry.decode(data["object"])))
             except Exception:
-                pass  # connection lost; fall through to reconnect
+                pass  # connection lost/timed out; fall through to reconnect
+            finally:
+                if resp is not None:
+                    try:
+                        resp.close()
+                    except Exception:
+                        pass
             if self.stopped:
                 return
             # Reconnect with backoff.  Events during the gap are missed;

@@ -113,11 +113,13 @@ class SharedInformer:
         last_resync = time.monotonic()
         while not self._stopped.is_set():
             ev = self._watch.next(timeout=0.1)
-            if ev is not None:
+            # Note: the resync check below must run on EVERY iteration —
+            # a `continue` for filtered events would let sustained
+            # cross-namespace traffic starve resync.
+            if ev is not None and (self.namespace is None
+                                   or ev.obj.metadata.namespace
+                                   == self.namespace):
                 obj = ev.obj
-                if self.namespace is not None \
-                        and obj.metadata.namespace != self.namespace:
-                    continue
                 key = (obj.metadata.namespace, obj.metadata.name)
                 with self._lock:
                     old = self._store.get(key)

@@ -162,19 +162,18 @@ def test_remote_watch_reconnects_after_server_restart():
     server2 = ApiHttpServer(port=port).start()
     try:
         cs2 = Clientset(server=RemoteApiServer(server2.url))
-        deadline = time.monotonic() + 10
+        deadline = time.monotonic() + 15
         ev = None
-        created = False
+        lap = 0
+        # A fresh-named create each lap: if a create lands during the
+        # reconnect gap (no replay on watch registration), a later lap's
+        # event still proves the stream recovered.
         while time.monotonic() < deadline and ev is None:
-            if not created:
-                try:
-                    cs2.config_maps("ns").create(ConfigMap(
-                        metadata=ObjectMeta(name="after", namespace="ns")))
-                    created = True
-                except ApiError:
-                    created = True  # AlreadyExists from a prior lap
+            cs2.config_maps("ns").create(ConfigMap(
+                metadata=ObjectMeta(name=f"after-{lap}", namespace="ns")))
+            lap += 1
             ev = watch.next(timeout=0.5)
-        assert ev is not None and ev.obj.metadata.name == "after"
+        assert ev is not None and ev.obj.metadata.name.startswith("after-")
     finally:
         watch.stop()
         server2.stop()
