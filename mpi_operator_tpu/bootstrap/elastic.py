@@ -1,0 +1,62 @@
+"""Elastic host discovery — the workload-side consumer of the
+controller's discover_hosts.sh artifact.
+
+Parity with the Horovod elastic flow (reference
+proposals/elastic-horovod.md:21-30: horovodrun polls
+/etc/mpi/discover_hosts.sh).  The controller regenerates the script from
+*running* worker pods on every sync; this module parses it and watches it
+for membership changes so workloads can react (re-form the world at a
+checkpoint boundary — see docs/proposals/elastic-multislice.md).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Iterator, List, Optional
+
+DISCOVER_SCRIPT = "discover_hosts.sh"
+
+
+def discover_hosts_path() -> Optional[str]:
+    """Locate the mounted discover_hosts.sh: the declared mount path
+    (/etc/mpi) on a real cluster, or the kubelet's sandboxed remap
+    (K_MOUNT_* env) on the local runtime."""
+    for key, val in os.environ.items():
+        if key.startswith("K_MOUNT_") and not key.startswith("K_MOUNT_PATH_"):
+            candidate = os.path.join(val, DISCOVER_SCRIPT)
+            if os.path.exists(candidate):
+                return candidate
+    legacy = "/etc/mpi/" + DISCOVER_SCRIPT
+    return legacy if os.path.exists(legacy) else None
+
+
+def current_hosts(path: Optional[str] = None) -> List[str]:
+    """Parse the script's `echo <fqdn>` lines into a host list."""
+    path = path or discover_hosts_path()
+    if path is None:
+        return []
+    hosts = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("echo "):
+                    hosts.append(line[len("echo "):].strip())
+    except OSError:
+        return []
+    return hosts
+
+
+def watch_hosts(path: Optional[str] = None, poll: float = 1.0,
+                stop=None) -> Iterator[List[str]]:
+    """Yield the host list whenever membership changes (poll-based, like
+    horovodrun's discovery loop).  Yields the initial membership first."""
+    path = path or discover_hosts_path()
+    last: Optional[List[str]] = None
+    while stop is None or not stop.is_set():
+        hosts = current_hosts(path)
+        if hosts != last:
+            last = hosts
+            yield hosts
+        time.sleep(poll)

@@ -60,11 +60,23 @@ class _PodRunner:
                                        name=f"pod-{self.pod_name}")
 
     # -- volume materialization -------------------------------------------
-    def _materialize_volumes(self) -> dict:
+    def refresh_config_volumes(self, config_map_name: str) -> None:
+        """Re-materialize ConfigMap-backed volumes after the ConfigMap
+        changed (kubelet eventually-consistent volume update parity —
+        this is what makes the elastic discover_hosts.sh artifact live
+        inside running pods)."""
+        for vol in self.spec.volumes:
+            if vol.config_map is not None and \
+                    vol.config_map.name == config_map_name:
+                self._materialize_volumes(only=vol.name)
+
+    def _materialize_volumes(self, only: str = None) -> dict:
         """Write ConfigMap/Secret volumes under the sandbox; returns a map
         of volume name -> host dir."""
         dirs = {}
         for vol in self.spec.volumes:
+            if only is not None and vol.name != only:
+                continue
             vol_dir = os.path.join(self.sandbox, "volumes", vol.name)
             os.makedirs(vol_dir, exist_ok=True)
             if vol.config_map is not None:
@@ -252,17 +264,23 @@ class LocalKubelet:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self._watch = self.client.server.watch("v1", "Pod")
+        self._cm_watch = self.client.server.watch("v1", "ConfigMap")
         # pick up pre-existing pods
         for pod in self.client.server.list("v1", "Pod", self.namespace):
             self._on_pod(pod)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="kubelet")
         self._thread.start()
+        self._cm_thread = threading.Thread(target=self._cm_loop, daemon=True,
+                                           name="kubelet-cm")
+        self._cm_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         if self._watch:
             self._watch.stop()
+        if getattr(self, "_cm_watch", None):
+            self._cm_watch.stop()
         if self._thread:
             self._thread.join(timeout=2)
         with self._lock:
@@ -290,6 +308,26 @@ class LocalKubelet:
                     runner = self._runners.pop(key, None)
                 if runner is not None:
                     runner.stop()
+
+    def _cm_loop(self) -> None:
+        from ..k8s.apiserver import MODIFIED
+        while not self._stop.is_set():
+            ev = self._cm_watch.next(timeout=0.1)
+            if ev is None or ev.type != MODIFIED:
+                continue
+            cm = ev.obj
+            if self.namespace is not None and \
+                    cm.metadata.namespace != self.namespace:
+                continue
+            with self._lock:
+                runners = [r for (ns, _), r in self._runners.items()
+                           if ns == cm.metadata.namespace]
+            for runner in runners:
+                try:
+                    runner.refresh_config_volumes(cm.metadata.name)
+                except Exception as exc:
+                    logger.warning("refreshing volumes for %s: %s",
+                                   runner.pod_name, exc)
 
     def _on_pod(self, pod: core.Pod) -> None:
         key = (pod.metadata.namespace, pod.metadata.name)

@@ -246,3 +246,51 @@ def test_e2e_many_concurrent_jobs():
                                               timeout=60)
             assert done.status.completion_time is not None
             assert f"done {name}" in cluster.launcher_logs("default", name)
+
+
+def test_e2e_elastic_discovery_visible_inside_pod():
+    """Full elastic loop: the controller regenerates discover_hosts.sh,
+    the kubelet refreshes the mounted volume in the RUNNING launcher pod,
+    and the workload-side helper (bootstrap.elastic) sees membership
+    change — horovodrun-discovery parity with zero SSH."""
+    import time
+    watcher = (
+        "import sys, time, threading\n"
+        "sys.path.insert(0, %r)\n"
+        "from mpi_operator_tpu.bootstrap import elastic\n"
+        "seen = set()\n"
+        "deadline = time.time() + 40\n"
+        "while time.time() < deadline:\n"
+        "    n = len(elastic.current_hosts())\n"
+        "    if n and n not in seen:\n"
+        "        seen.add(n); print('HOSTS', n, flush=True)\n"
+        "    if {3, 1} <= seen:\n"
+        "        print('ELASTIC-OK', flush=True); sys.exit(0)\n"
+        "    time.sleep(0.2)\n"
+        "sys.exit(1)\n" % REPO_ROOT)
+    with LocalCluster() as cluster:
+        job = jax_job(
+            "eld",
+            launcher_cmd=[sys.executable, "-c", watcher],
+            worker_cmd=[sys.executable, "-c",
+                        "import time; time.sleep(60)"],
+            workers=3)
+        cluster.submit(job)
+
+        # Scale only after the LAUNCHER ITSELF has observed 3 hosts (the
+        # launcher pod may start later than the workers).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                "HOSTS 3" not in cluster.launcher_logs("default", "eld"):
+            time.sleep(0.1)
+        assert "HOSTS 3" in cluster.launcher_logs("default", "eld")
+
+        stored = cluster.client.mpi_jobs("default").get("eld")
+        stored.spec.mpi_replica_specs["Worker"].replicas = 1
+        cluster.client.mpi_jobs("default").update(stored)
+
+        done = cluster.wait_for_condition("default", "eld",
+                                          constants.JOB_SUCCEEDED,
+                                          timeout=60)
+        logs = cluster.launcher_logs("default", "eld")
+        assert "ELASTIC-OK" in logs, logs
