@@ -6,7 +6,10 @@
 - ``ring_attention``: sequence/context parallelism — KV chunks rotate
   around the 'sp' mesh axis with ppermute (ICI neighbor exchange) while
   each device attends its local queries (Liu et al., ring attention).
+- ``rmsnorm``: fused RMSNorm Pallas kernel (one VMEM pass), exact VJP.
+- ``moe``: GShard-style mixture-of-experts dispatch over 'ep'.
 """
 
 from .attention import attention, flash_attention  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .rmsnorm import fused_rmsnorm, rmsnorm  # noqa: F401

@@ -1,0 +1,105 @@
+"""Fused RMSNorm — Pallas TPU kernel with exact custom VJP.
+
+One VMEM pass computes the row rstd and the normalized, scaled output
+(the unfused XLA form reads x twice and materializes the intermediate);
+the backward uses the saved rstd in plain XLA (fuses into the
+surrounding matmuls).  Same dispatch philosophy as ops/attention.py:
+'auto' uses the kernel on a real tpu backend, XLA elsewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_ROW_BLOCK = 256
+
+
+def _rmsnorm_kernel(x_ref, scale_ref, o_ref, rstd_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)                      # [rows, d]
+    rstd = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    o_ref[...] = (x * rstd * scale_ref[...].astype(jnp.float32)) \
+        .astype(o_ref.dtype)
+    rstd_ref[...] = jnp.broadcast_to(rstd, rstd_ref.shape)
+
+
+def _rmsnorm_forward(x, scale, eps: float, interpret: bool):
+    """x: [..., d] -> (y [..., d], rstd [rows])."""
+    from jax.experimental import pallas as pl
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = 1
+    for s in orig_shape[:-1]:
+        rows *= s
+    xr = x.reshape(rows, d)
+
+    block = rows
+    while rows % block or block > _ROW_BLOCK:
+        block -= 1
+
+    out, rstd = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, d), x.dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xr, scale)
+    return out.reshape(orig_shape), rstd.reshape(orig_shape[:-1])
+
+
+def _xla_rmsnorm(x, scale, eps: float):
+    xf = x.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return ((xf * rstd) * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_rmsnorm(x, scale, eps: float = 1e-5, interpret: bool = False):
+    """RMSNorm on [..., d] with learned scale [d]."""
+    out, _ = _rmsnorm_forward(x, scale, eps, interpret)
+    return out
+
+
+def _fwd(x, scale, eps, interpret):
+    out, rstd = _rmsnorm_forward(x, scale, eps, interpret)
+    return out, (x, scale, rstd)
+
+
+def _bwd(eps, interpret, res, g):
+    x, scale, rstd = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    sf = scale.astype(jnp.float32)
+    r = rstd[..., None]                                      # [..., 1]
+    xhat = xf * r
+    dscale = jnp.sum(gf * xhat,
+                     axis=tuple(range(x.ndim - 1))).astype(scale.dtype)
+    gs = gf * sf
+    d = x.shape[-1]
+    dx = r * (gs - xhat * jnp.sum(gs * xhat, axis=-1, keepdims=True) / d)
+    return dx.astype(x.dtype), dscale
+
+
+fused_rmsnorm.defvjp(_fwd, _bwd)
+
+
+def rmsnorm(x, scale, eps: float = 1e-5, impl: str = "auto",
+            interpret: bool = False):
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return fused_rmsnorm(x, scale, eps, interpret)
+    return _xla_rmsnorm(x, scale, eps)

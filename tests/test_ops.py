@@ -119,3 +119,41 @@ def test_attention_dispatcher_falls_back_for_awkward_lengths():
     out = attention(qm, km, vm, causal=True, impl="auto")
     ref, _ = _xla_attention(q, k, v, 1.0 / np.sqrt(64), True)
     np.testing.assert_allclose(out, ref.transpose(0, 2, 1, 3), atol=2e-5)
+
+
+# --- fused RMSNorm -------------------------------------------------------
+
+def test_fused_rmsnorm_matches_xla():
+    from mpi_operator_tpu.ops.rmsnorm import _xla_rmsnorm, fused_rmsnorm
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (4, 96, 128), jnp.float32)
+    scale = jax.random.normal(jax.random.PRNGKey(1), (128,)) * 0.1 + 1.0
+    ref = _xla_rmsnorm(x, scale, 1e-5)
+    out = fused_rmsnorm(x, scale, 1e-5, True)  # interpret mode
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_fused_rmsnorm_gradients_match_autodiff():
+    from mpi_operator_tpu.ops.rmsnorm import _xla_rmsnorm, fused_rmsnorm
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (2, 64, 64), jnp.float32)
+    scale = jax.random.normal(jax.random.PRNGKey(3), (64,)) * 0.1 + 1.0
+
+    def loss_fused(x, s):
+        return jnp.sum(fused_rmsnorm(x, s, 1e-5, True) ** 2)
+
+    def loss_ref(x, s):
+        return jnp.sum(_xla_rmsnorm(x, s, 1e-5).astype(jnp.float32) ** 2)
+
+    gx1, gs1 = jax.grad(loss_fused, argnums=(0, 1))(x, scale)
+    gx2, gs2 = jax.grad(loss_ref, argnums=(0, 1))(x, scale)
+    np.testing.assert_allclose(gx1, gx2, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(gs1, gs2, atol=1e-4, rtol=1e-4)
+
+
+def test_rmsnorm_dispatcher_cpu_uses_xla():
+    from mpi_operator_tpu.ops.rmsnorm import _xla_rmsnorm, rmsnorm
+    x = jax.random.normal(jax.random.PRNGKey(4), (3, 32))
+    scale = jnp.ones((32,))
+    np.testing.assert_allclose(rmsnorm(x, scale),
+                               _xla_rmsnorm(x, scale, 1e-5), atol=1e-6)
