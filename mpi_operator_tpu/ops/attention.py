@@ -155,6 +155,172 @@ def _flash_forward(q, k, v, scale: float, causal: bool, q_block: int,
 
 
 # ---------------------------------------------------------------------------
+# Pallas backward kernels (flash-attention-2 formulation)
+# ---------------------------------------------------------------------------
+#
+# Two blocked kernels share the saved logsumexp and the precomputed
+# delta = rowsum(do * o):
+#   dq kernel:  grid (bh, qi, kj) — kj sequential, accumulates dq[qi]
+#   dkv kernel: grid (bh, kj, qi) — qi sequential, accumulates dk/dv[kj]
+# so the S x S matrices never materialize in the backward either.
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, scale: float, causal: bool,
+                         q_block: int, kv_block: int, num_kv: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * q_block + jax.lax.iota(jnp.int32, q_block)
+            kv_pos = kj * kv_block + jax.lax.iota(jnp.int32, kv_block)
+            s = jnp.where(q_pos[:, None] >= kv_pos[None, :], s, _MASK_VALUE)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(kj * kv_block <= qi * q_block + q_block - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                          causal: bool, q_block: int, kv_block: int,
+                          num_q: int):
+    from jax.experimental import pallas as pl
+
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * q_block + jax.lax.iota(jnp.int32, q_block)
+            kv_pos = kj * kv_block + jax.lax.iota(jnp.int32, kv_block)
+            s = jnp.where(q_pos[:, None] >= kv_pos[None, :], s, _MASK_VALUE)
+        p = jnp.exp(s - lse[:, None])                       # [qb, kvb]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        # q blocks entirely before this kv block contribute nothing.
+        pl.when(qi * q_block + q_block - 1 >= kj * kv_block)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, dout, scale: float, causal: bool,
+                    q_block: int, kv_block: int, interpret: bool):
+    """Blocked backward: returns (dq, dk, dv) on [B, H, S, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    q_block = _pick_block(s, q_block)
+    kv_block = _pick_block(s, kv_block)
+    num_q = s // q_block
+    num_kv = s // kv_block
+
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s, d)
+    vr = v.reshape(b * h, s, d)
+    dor = dout.reshape(b * h, s, d)
+    # delta = rowsum(do * o): cheap bandwidth op, XLA fuses it.
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(b * h, s, 1)
+    lser = lse.reshape(b * h, s, 1)
+
+    q_spec = pl.BlockSpec((1, q_block, d), lambda bh, qi, kj: (bh, qi, 0))
+    kv_spec = pl.BlockSpec((1, kv_block, d), lambda bh, qi, kj: (bh, kj, 0))
+    row_spec = pl.BlockSpec((1, q_block, 1), lambda bh, qi, kj: (bh, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          q_block=q_block, kv_block=kv_block, num_kv=num_kv),
+        grid=(b * h, num_q, num_kv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((q_block, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    # dkv grid: (bh, kj, qi) — note the transposed index maps.
+    q_spec2 = pl.BlockSpec((1, q_block, d), lambda bh, kj, qi: (bh, qi, 0))
+    kv_spec2 = pl.BlockSpec((1, kv_block, d), lambda bh, kj, qi: (bh, kj, 0))
+    row_spec2 = pl.BlockSpec((1, q_block, 1), lambda bh, kj, qi: (bh, qi, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          q_block=q_block, kv_block=kv_block, num_q=num_q),
+        grid=(b * h, num_kv, num_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((kv_block, d), jnp.float32),
+                        pltpu.VMEM((kv_block, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s, d),
+            dv.reshape(b, h, s, d))
+
+
+# ---------------------------------------------------------------------------
 # Reference XLA path + exact backward
 # ---------------------------------------------------------------------------
 
@@ -194,27 +360,8 @@ def _flash_fwd_rule(q, k, v, scale, causal, q_block, kv_block, interpret):
 def _flash_bwd_rule(scale, causal, q_block, kv_block, interpret, res, dout):
     q, k, v, out, lse = res
     scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    do = dout.astype(jnp.float32)
-    of = out.astype(jnp.float32)
-
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf * scale_v, kf)
-    if causal:
-        mask = (jnp.arange(q.shape[2])[:, None]
-                >= jnp.arange(k.shape[2])[None, :])
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jnp.exp(s - lse[..., None])
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", do, vf)
-    delta = jnp.sum(do * of, axis=-1)                      # [b,h,q]
-    ds = p * (dp - delta[..., None])
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale_v
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale_v
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_backward(q, k, v, out, lse, dout, scale_v, causal,
+                           q_block, kv_block, interpret)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -157,3 +157,22 @@ def test_rmsnorm_dispatcher_cpu_uses_xla():
     scale = jnp.ones((32,))
     np.testing.assert_allclose(rmsnorm(x, scale),
                                _xla_rmsnorm(x, scale, 1e-5), atol=1e-6)
+
+
+def test_flash_backward_non_causal_and_uneven_blocks(qkv):
+    """Backward kernels with causal off and q_block != kv_block."""
+    q, k, v = qkv
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, None, False, 128, 32,
+                                       True) ** 2)
+
+    def loss_ref(q, k, v):
+        o, _ = _xla_attention(q, k, v, scale, False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
