@@ -2,15 +2,20 @@
 
 Long-context scaling: queries stay put while K/V chunks rotate around the
 ring with ``jax.lax.ppermute`` (nearest-neighbor ICI traffic), each step
-folding one chunk into an online-softmax accumulator.  Memory per device
-is O(S/n · S/n) and the S x S matrix never materializes globally.  This
-is the TPU-native answer to the reference's "scale processes, not
+folding one chunk into a running (output, logsumexp) pair.  Memory per
+device is O(S/n) activations and the S x S matrix never materializes.
+This is the TPU-native answer to the reference's "scale processes, not
 sequence length" gap (SURVEY.md §5 "Long-context: absent").
+
+Per-chunk compute dispatches by position in the causal structure:
+chunks strictly behind the local queries attend unmasked, the diagonal
+chunk attends causally, future chunks are skipped — and each branch can
+run either as plain XLA ops or as the Pallas flash kernel
+(``impl='flash'``), composing partial results through their logsumexps.
 
 Layout contract: q, k, v are [B, S_local, H, D] shards of the global
 [B, S, H, D] tensors, sharded along S over the 'sp' axis (shard i holds
-positions [i*S_local, (i+1)*S_local)).  Causal masking uses global
-positions, so chunks ahead of the local queries contribute nothing.
+positions [i*S_local, (i+1)*S_local)).
 """
 
 from __future__ import annotations
@@ -21,87 +26,107 @@ import math
 import jax
 import jax.numpy as jnp
 
+from .attention import _MASK_VALUE, _flash_forward, _xla_attention
 
-def _chunk_attention(q, k, v, q_offset, kv_offset, scale, causal):
-    """Blockwise attention of local q against one K/V chunk with global
-    causal positions; returns (scores_max, exp_sum, weighted_acc)."""
-    qf = q.astype(jnp.float32) * scale
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
-    if causal:
-        q_pos = q_offset + jnp.arange(q.shape[1])
-        kv_pos = kv_offset + jnp.arange(k.shape[1])
-        mask = q_pos[:, None] >= kv_pos[None, :]
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    m = jnp.max(s, axis=-1)                                    # [b,h,q]
-    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
-    p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return m, l, acc
+
+def _chunk_dense(q, k, v, scale, causal):
+    """XLA per-chunk attention -> (normalized out, lse), model layout."""
+    out, lse = _xla_attention(q.transpose(0, 2, 1, 3),
+                              k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3), scale, causal)
+    return out.transpose(0, 2, 1, 3).astype(jnp.float32), lse
+
+
+def _chunk_flash(q, k, v, scale, causal, interpret):
+    out, lse = _flash_forward(q.transpose(0, 2, 1, 3),
+                              k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3), scale, causal,
+                              256, 256, interpret)
+    return out.transpose(0, 2, 1, 3).astype(jnp.float32), lse
 
 
 def _ring_body(q, k, v, axis_name: str, scale: float, causal: bool,
-               all_axes: tuple = ()):
+               impl: str, interpret: bool, all_axes: tuple = ()):
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
-    s_local = q.shape[1]
-    q_offset = idx * s_local
+    b, s_local, h, d = q.shape
 
-    b, _, h, d = q.shape
-    m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, s_local), jnp.float32)
-    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    chunk = (_chunk_dense if impl == "dense"
+             else functools.partial(_chunk_flash, interpret=interpret))
+
+    def attend_causal(q, k, v):
+        return chunk(q, k, v, scale, True)
+
+    def attend_full(q, k, v):
+        return chunk(q, k, v, scale, False)
+
+    def attend_skip(q, k, v):
+        return (jnp.zeros((b, s_local, h, d), jnp.float32),
+                jnp.full((b, h, s_local), _MASK_VALUE, jnp.float32))
+
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_local), _MASK_VALUE, jnp.float32)
     if all_axes:
         # shard_map type system: loop carries must be device-varying like
         # the loop outputs they join (see shard_map scan-vma docs).
-        m0, l0, acc0 = (jax.lax.pcast(x, all_axes, to="varying")
-                        for x in (m0, l0, acc0))
+        o0, lse0 = (jax.lax.pcast(x, all_axes, to="varying")
+                    for x in (o0, lse0))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def fold(t, m, l, acc, k_cur, v_cur):
-        # After t rotations device idx holds chunk (idx - t) mod n.
-        kv_offset = ((idx - t) % n) * s_local
-        cm, cl, cacc = _chunk_attention(q, k_cur, v_cur, q_offset, kv_offset,
-                                        scale, causal)
-        m_new = jnp.maximum(m, cm)
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-        beta = jnp.where(jnp.isfinite(cm), jnp.exp(cm - m_safe), 0.0)
-        l_new = l * alpha + cl * beta
-        # alpha/beta are [b,h,q]; acc is [b,q,h,d] -> align as [b,q,h,1].
-        acc_new = (acc * jnp.moveaxis(alpha, 1, 2)[..., None]
-                   + cacc * jnp.moveaxis(beta, 1, 2)[..., None])
-        return m_new, l_new, acc_new
+    def fold(t, o, lse, k_cur, v_cur):
+        kv_idx = (idx - t) % n
+        if causal:
+            # 0: diagonal (causal), 1: behind (full), 2: ahead (skip).
+            branch = jnp.where(kv_idx == idx, 0,
+                               jnp.where(kv_idx < idx, 1, 2))
+            o_c, lse_c = jax.lax.switch(
+                branch, (attend_causal, attend_full, attend_skip),
+                q, k_cur, v_cur)
+        else:
+            o_c, lse_c = attend_full(q, k_cur, v_cur)
+        # Compose the normalized partials through their logsumexps.
+        m = jnp.maximum(lse, lse_c)
+        w_prev = jnp.exp(lse - m)
+        w_new = jnp.exp(lse_c - m)
+        norm = w_prev + w_new
+        norm_safe = jnp.where(norm > 0, norm, 1.0)
+        wp = jnp.moveaxis(w_prev / norm_safe, 1, 2)[..., None]
+        wn = jnp.moveaxis(w_new / norm_safe, 1, 2)[..., None]
+        o_new = o * wp + o_c * wn
+        lse_new = m + jnp.log(norm_safe)
+        return o_new, lse_new
 
     def step(t, carry):
-        m, l, acc, k_cur, v_cur = carry
-        m, l, acc = fold(t, m, l, acc, k_cur, v_cur)
+        o, lse, k_cur, v_cur = carry
+        o, lse = fold(t, o, lse, k_cur, v_cur)
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return m, l, acc, k_next, v_next
+        return o, lse, k_next, v_next
 
     # n-1 [fold, rotate] steps, then a final fold — no wasted last
     # ppermute on the hot path.
-    m, l, acc, k_last, v_last = jax.lax.fori_loop(
-        0, n - 1, step, (m0, l0, acc0, k, v))
-    m, l, acc = fold(n - 1, m, l, acc, k_last, v_last)
-    l_safe = jnp.where(l > 0, l, 1.0)
-    out = acc / jnp.moveaxis(l_safe, 1, 2)[..., None]
-    return out.astype(q.dtype)
+    o, lse, k_last, v_last = jax.lax.fori_loop(
+        0, n - 1, step, (o0, lse0, k, v))
+    o, _ = fold(n - 1, o, lse, k_last, v_last)
+    return o.astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "sp",
                    causal: bool = True, batch_axes=("dp", "fsdp"),
-                   head_axis: str = "tp"):
+                   head_axis: str = "tp", impl: str = "dense",
+                   interpret: bool = False):
     """Sequence-parallel attention on [B, S, H, D] tensors sharded along S
-    over ``axis_name`` (and batch/heads over the other mesh axes)."""
+    over ``axis_name`` (and batch/heads over the other mesh axes).
+
+    impl: 'dense' (XLA per-chunk) or 'flash' (Pallas kernel per chunk —
+    the fully fused long-context path on TPU).
+    """
     from jax.sharding import PartitionSpec as P
 
     scale = 1.0 / math.sqrt(q.shape[-1])
     spec = P(batch_axes, axis_name, head_axis, None)
     body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
-                             causal=causal,
+                             causal=causal, impl=impl, interpret=interpret,
                              all_axes=tuple(mesh.axis_names))
     # check_vma=False: axes the body never touches (e.g. 'ep') are
     # trivially replicated, but the static checker cannot prove it.

@@ -176,3 +176,15 @@ def test_flash_backward_non_causal_and_uneven_blocks(qkv):
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_ring_attention_flash_impl_matches_dense(qkv):
+    """impl='flash' (Pallas kernel per chunk, interpret on CPU) must match
+    the dense-chunk path and the global reference (forward)."""
+    q, k, v = qkv
+    mesh = create_mesh(MeshConfig(dp=2, tp=2, sp=2))
+    ref, _ = _xla_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1]), True)
+    qm, km, vm = [t.transpose(0, 2, 1, 3) for t in (q, k, v)]
+    out = ring_attention(qm, km, vm, mesh, impl="flash", interpret=True)
+    np.testing.assert_allclose(out, ref.transpose(0, 2, 1, 3),
+                               atol=2e-5, rtol=2e-5)
