@@ -106,12 +106,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, q_block: int,
-                   kv_block: int, interpret: bool):
-    """q,k,v: [B, H, S, D] -> (out [B,H,S,D], lse [B,H,S])."""
+                   kv_block: int, interpret: bool, out_dtype=None):
+    """q,k,v: [B, H, S, D] -> (out [B,H,S,D], lse [B,H,S]).
+
+    out_dtype overrides the output dtype (e.g. f32 so ring composition
+    does not round per-chunk while matmul inputs stay bf16 for the MXU).
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, d = q.shape
+    out_dtype = out_dtype or q.dtype
     q_block = _pick_block(s, q_block)
     kv_block = _pick_block(s, kv_block)
     num_kv = s // kv_block
@@ -139,7 +144,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, q_block: int,
             pl.BlockSpec((1, q_block, 1), lambda bh, qi, kj: (bh, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), out_dtype),
             jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -258,8 +263,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, dout, scale: float, causal: bool,
-                    q_block: int, kv_block: int, interpret: bool):
-    """Blocked backward: returns (dq, dk, dv) on [B, H, S, D]."""
+                    q_block: int, kv_block: int, interpret: bool,
+                    dlse=None):
+    """Blocked backward: returns (dq, dk, dv) on [B, H, S, D].
+
+    dlse: optional cotangent of the lse output.  Because
+    d lse_i / d s_ij = p_ij, it folds into the kernels as
+    delta' = delta - dlse — no extra kernel needed.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -276,6 +287,8 @@ def _flash_backward(q, k, v, out, lse, dout, scale: float, causal: bool,
     # delta = rowsum(do * o): cheap bandwidth op, XLA fuses it.
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(b * h, s, 1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32).reshape(b * h, s, 1)
     lser = lse.reshape(b * h, s, 1)
 
     q_spec = pl.BlockSpec((1, q_block, d), lambda bh, qi, kj: (bh, qi, 0))
@@ -365,6 +378,36 @@ def _flash_bwd_rule(scale, causal, q_block, kv_block, interpret, res, dout):
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(q, k, v, scale=None, causal=True,
+                             q_block=DEFAULT_Q_BLOCK,
+                             kv_block=DEFAULT_KV_BLOCK, interpret=False):
+    """Flash attention returning (out_f32, lse) — the composable form
+    ring attention folds across chunks; differentiable including the lse
+    output (its cotangent folds into delta in the backward kernels)."""
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_forward(q, k, v, scale_v, causal, q_block, kv_block,
+                          interpret, out_dtype=jnp.float32)
+
+
+def _flash_lse_fwd(q, k, v, scale, causal, q_block, kv_block, interpret):
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _flash_forward(q, k, v, scale_v, causal, q_block, kv_block,
+                              interpret, out_dtype=jnp.float32)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(scale, causal, q_block, kv_block, interpret, res, cts):
+    q, k, v, out, lse = res
+    dout, dlse = cts
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_backward(q, k, v, out, lse, dout, scale_v, causal,
+                           q_block, kv_block, interpret, dlse=dlse)
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def attention(q, k, v, causal: bool = True, impl: str = "auto",

@@ -26,23 +26,34 @@ import math
 import jax
 import jax.numpy as jnp
 
-from .attention import _MASK_VALUE, _flash_forward, _xla_attention
+from .attention import (_MASK_VALUE, _MIN_PALLAS_BLOCK, DEFAULT_KV_BLOCK,
+                        DEFAULT_Q_BLOCK, _pick_block,
+                        flash_attention_with_lse)
 
 
 def _chunk_dense(q, k, v, scale, causal):
-    """XLA per-chunk attention -> (normalized out, lse), model layout."""
-    out, lse = _xla_attention(q.transpose(0, 2, 1, 3),
-                              k.transpose(0, 2, 1, 3),
-                              v.transpose(0, 2, 1, 3), scale, causal)
-    return out.transpose(0, 2, 1, 3).astype(jnp.float32), lse
+    """XLA per-chunk attention in f32 -> (normalized out, lse), model
+    layout.  f32 throughout so composing chunks never rounds."""
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        pos = jnp.arange(q.shape[1])
+        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s,
+                      _MASK_VALUE)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out, lse
 
 
 def _chunk_flash(q, k, v, scale, causal, interpret):
-    out, lse = _flash_forward(q.transpose(0, 2, 1, 3),
-                              k.transpose(0, 2, 1, 3),
-                              v.transpose(0, 2, 1, 3), scale, causal,
-                              256, 256, interpret)
-    return out.transpose(0, 2, 1, 3).astype(jnp.float32), lse
+    """Pallas kernel per chunk (differentiable incl. lse); f32 outputs
+    so ring composition never rounds while matmul inputs stay bf16."""
+    out, lse = flash_attention_with_lse(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), scale, causal, DEFAULT_Q_BLOCK,
+        DEFAULT_KV_BLOCK, interpret)
+    return out.transpose(0, 2, 1, 3), lse
 
 
 def _ring_body(q, k, v, axis_name: str, scale: float, causal: bool,
@@ -124,6 +135,13 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     from jax.sharding import PartitionSpec as P
 
     scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "flash":
+        # Mirror attention()'s guard: degenerate block sizes (awkward
+        # local sequence lengths) fall back to the dense chunk path.
+        n_sp = mesh.shape[axis_name]
+        s_local = q.shape[1] // n_sp
+        if _pick_block(s_local, DEFAULT_Q_BLOCK) < _MIN_PALLAS_BLOCK:
+            impl = "dense"
     spec = P(batch_axes, axis_name, head_axis, None)
     body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
                              causal=causal, impl=impl, interpret=interpret,
