@@ -43,6 +43,8 @@ class LlamaConfig:
     attention_impl: str = "auto"              # 'auto'|'pallas'|'xla'
     n_experts: int = 0                        # >1 -> MoE MLP (Mixtral-style)
     top_k: int = 2                            # experts per token
+    ring_impl: str = "dense"                  # sp>1 chunk compute:
+                                              # 'dense'|'flash'
 
     @property
     def head_dim(self) -> int:
@@ -190,7 +192,8 @@ class LlamaAttention(nn.Module):
             if self.mesh is not None:
                 sp_size = self.mesh.shape.get("sp", 1)
             if sp_size > 1:
-                out = ring_attention(q, k, v, self.mesh, causal=True)
+                out = ring_attention(q, k, v, self.mesh, causal=True,
+                                     impl=cfg.ring_impl)
             else:
                 out = attention(q, k, v, causal=True,
                                 impl=cfg.attention_impl)
