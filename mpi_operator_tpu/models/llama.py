@@ -340,32 +340,61 @@ def next_token_loss(logits, tokens):
     return jnp.mean(logz - gold)
 
 
-def greedy_generate(model: LlamaModel, variables, prompt_tokens,
-                    max_new_tokens: int):
-    """KV-cache greedy decoding: prefill the prompt, then one token per
-    step.  Returns [B, max_new_tokens] generated ids."""
-    import flax
+def _select_token(logits, temperature: float, top_p: float, rng):
+    """Greedy (temperature=0) or nucleus sampling from [B, V] logits."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # Smallest prefix with mass >= top_p; logits below its threshold
+        # are masked out.
+        cutoff_idx = jnp.sum(cumulative < top_p, axis=-1)
+        threshold = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                        axis=-1)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(model: LlamaModel, variables, prompt_tokens,
+             max_new_tokens: int, temperature: float = 0.0,
+             top_p: float = 1.0, rng=None):
+    """KV-cache decoding: prefill the prompt, then one token per step.
+    temperature=0 is greedy; otherwise nucleus (top-p) sampling.
+    Returns [B, max_new_tokens] generated ids."""
+    import functools
 
     params = {"params": variables["params"]}
-    b = prompt_tokens.shape[0]
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
 
     # Prefill: run the prompt with an (initialized-on-the-fly) cache.
     logits, state = model.apply(params, prompt_tokens, decode=True,
                                 mutable=["cache"])
     cache = state["cache"]
-    next_token = jnp.argmax(logits[:, -1], axis=-1)
-
-    import functools
+    rng, sub = jax.random.split(rng)
+    next_token = _select_token(logits[:, -1], temperature, top_p, sub)
 
     @functools.partial(jax.jit)
-    def step(cache, token):
+    def step(cache, token, rng):
         logits, state = model.apply(
             {**params, "cache": cache}, token[:, None], decode=True,
             mutable=["cache"])
-        return state["cache"], jnp.argmax(logits[:, -1], axis=-1)
+        rng, sub = jax.random.split(rng)
+        return (state["cache"],
+                _select_token(logits[:, -1], temperature, top_p, sub), rng)
 
     out = [next_token]
     for _ in range(max_new_tokens - 1):
-        cache, next_token = step(cache, out[-1])
+        cache, next_token, rng = step(cache, out[-1], rng)
         out.append(next_token)
     return jnp.stack(out, axis=1)
+
+
+def greedy_generate(model: LlamaModel, variables, prompt_tokens,
+                    max_new_tokens: int):
+    """KV-cache greedy decoding (generate with temperature=0)."""
+    return generate(model, variables, prompt_tokens, max_new_tokens,
+                    temperature=0.0)

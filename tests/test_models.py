@@ -228,3 +228,20 @@ def test_kv_cache_decode_matches_full_forward():
         expected = jnp.argmax(logits[:, pos], axis=-1)
         np.testing.assert_array_equal(np.asarray(generated[:, i]),
                                       np.asarray(expected))
+
+
+def test_sampled_generation_shapes_and_determinism():
+    from mpi_operator_tpu.models.llama import generate, llama2_tiny
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 4), 0,
+                                cfg.vocab_size)
+    variables = model.init(jax.random.PRNGKey(1), prompt)
+    rng = jax.random.PRNGKey(7)
+    a = generate(model, variables, prompt, 5, temperature=0.8, top_p=0.9,
+                 rng=rng)
+    b = generate(model, variables, prompt, 5, temperature=0.8, top_p=0.9,
+                 rng=rng)
+    assert a.shape == (2, 5)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same rng
+    assert int(a.max()) < cfg.vocab_size
