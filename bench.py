@@ -7,7 +7,9 @@ device (1 worker pod x 2 GPUs, slotsPerWorker=2; /root/reference/
 README.md:96-143,197-212 — batch 64/device, synthetic data, SGD).
 
 Here: the same workload TPU-native — Flax ResNet-101, bfloat16 compute,
-batch 64, synthetic ImageNet, SGD+momentum — on one TPU chip.
+batch 64 per chip, synthetic ImageNet, SGD+momentum — data-parallel over
+every local chip (single-chip hosts degenerate to one device), reported
+per chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -52,7 +54,8 @@ def main() -> None:
     if n_chips > 1:
         from mpi_operator_tpu.parallel.mesh import MeshConfig, \
             batch_sharding, create_mesh
-        mesh = create_mesh(MeshConfig(dp=n_chips))
+        mesh = create_mesh(MeshConfig(dp=n_chips),
+                           devices=jax.local_devices())
         images = jax.device_put(images, batch_sharding(mesh, extra_dims=3))
         labels = jax.device_put(labels, batch_sharding(mesh, extra_dims=0))
 

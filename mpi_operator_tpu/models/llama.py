@@ -366,6 +366,8 @@ def generate(model: LlamaModel, variables, prompt_tokens,
     Returns [B, max_new_tokens] generated ids."""
     import functools
 
+    if max_new_tokens <= 0:
+        return jnp.zeros((prompt_tokens.shape[0], 0), jnp.int32)
     params = {"params": variables["params"]}
     if rng is None:
         rng = jax.random.PRNGKey(0)
