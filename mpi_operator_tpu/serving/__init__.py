@@ -1,0 +1,3 @@
+"""Model serving over the KV-cache decode path."""
+
+from .server import InferenceServer  # noqa: F401

@@ -1,0 +1,107 @@
+"""Minimal inference HTTP server.
+
+Serves a Llama-family model's KV-cache generation
+(models/llama.generate) over HTTP:
+
+    POST /generate {"tokens": [[...]], "max_new_tokens": 8,
+                    "temperature": 0.0, "top_p": 1.0}
+      -> {"tokens": [[...]]}
+    GET /healthz
+
+Requests execute single-flight behind a lock (the accelerator is a
+serial resource); continuous batching is roadmap.  No reference
+counterpart — the reference is training-only orchestration; this rounds
+out the workload stack's lifecycle (train -> checkpoint -> serve).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def _respond(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._respond(200, {"status": "ok"})
+        else:
+            self._respond(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path != "/generate":
+            return self._respond(404, {"error": "not found"})
+        server: "InferenceServer" = self.server.inference  # type: ignore
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            tokens = req["tokens"]
+            out = server.generate(
+                tokens,
+                max_new_tokens=int(req.get("max_new_tokens", 16)),
+                temperature=float(req.get("temperature", 0.0)),
+                top_p=float(req.get("top_p", 1.0)),
+                seed=req.get("seed"))
+            self._respond(200, {"tokens": out})
+        except Exception as exc:
+            self._respond(400, {"error": str(exc)})
+
+
+class InferenceServer:
+    def __init__(self, model, variables, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.model = model
+        self.variables = variables
+        self._lock = threading.Lock()
+        self._http = ThreadingHTTPServer((host, port), _Handler)
+        self._http.inference = self  # type: ignore[attr-defined]
+        self.port = self._http.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- inference ---------------------------------------------------------
+    def generate(self, tokens, max_new_tokens: int = 16,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 seed=None) -> list:
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.llama import generate
+
+        prompt = jnp.asarray(tokens, jnp.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        rng = jax.random.PRNGKey(int(seed)) if seed is not None else None
+        with self._lock:  # accelerator is single-flight
+            out = generate(self.model, self.variables, prompt,
+                           max_new_tokens, temperature=temperature,
+                           top_p=top_p, rng=rng)
+        return [[int(t) for t in row] for row in out]
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(target=self._http.serve_forever,
+                                        daemon=True, name="inference")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
