@@ -112,6 +112,26 @@ def cmd_get(args) -> int:
     return 0
 
 
+def cmd_describe(args) -> int:
+    client = _client(args.master)
+    job = client.mpi_jobs(args.namespace).get(args.name)
+    print(f"Name:      {job.metadata.name}")
+    print(f"Namespace: {job.metadata.namespace}")
+    print(f"Impl:      {job.spec.mpi_implementation}")
+    worker = job.spec.mpi_replica_specs.get("Worker")
+    print(f"Workers:   {worker.replicas if worker else 0}")
+    print("Conditions:")
+    for c in job.status.conditions:
+        print(f"  {c.type:12} {c.status:6} {c.reason:20} {c.message}")
+    events = [e for e in client.events(args.namespace).list()
+              if e.involved_object.name == args.name]
+    if events:
+        print("Events:")
+        for e in events:
+            print(f"  {e.type:8} {e.reason:22} {e.message}")
+    return 0
+
+
 def cmd_lifecycle(args, action: str) -> int:
     from .sdk import MPIJobClient
     sdk = MPIJobClient(_client(args.master), namespace=args.namespace)
@@ -167,6 +187,11 @@ def main(argv=None) -> int:
     p.add_argument("-n", "--namespace", default="default")
     p.add_argument("--master", default="http://127.0.0.1:8001")
 
+    p = sub.add_parser("describe", help="show MPIJob conditions and events")
+    p.add_argument("name")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--master", default="http://127.0.0.1:8001")
+
     for action in ("suspend", "resume", "delete"):
         p = sub.add_parser(action, help=f"{action} an MPIJob")
         p.add_argument("name")
@@ -187,6 +212,8 @@ def main(argv=None) -> int:
             return cmd_submit(args)
         if args.command == "get":
             return cmd_get(args)
+        if args.command == "describe":
+            return cmd_describe(args)
         if args.command in ("suspend", "resume", "delete"):
             return cmd_lifecycle(args, args.command)
         if args.command == "version":

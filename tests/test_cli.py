@@ -103,3 +103,63 @@ spec:
             cluster.wait(timeout=10)
         except subprocess.TimeoutExpired:
             cluster.kill()
+
+
+def test_cli_describe(tmp_path):
+    port = free_port()
+    master = f"http://127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    cluster = subprocess.Popen(
+        [sys.executable, "-m", "mpi_operator_tpu", "cluster", "--port",
+         str(port)], env=env, cwd=REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", port), timeout=1):
+                    break
+            except OSError:
+                time.sleep(0.2)
+        job_yaml = tmp_path / "d.yaml"
+        job_yaml.write_text(f"""
+apiVersion: kubeflow.org/v2beta1
+kind: MPIJob
+metadata:
+  name: desc-me
+spec:
+  mpiImplementation: JAX
+  runLauncherAsWorker: true
+  mpiReplicaSpecs:
+    Launcher:
+      replicas: 1
+      template:
+        spec:
+          containers:
+            - name: l
+              image: local
+              command: ["{sys.executable}", "-c", "print('x')"]
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+            - name: w
+              image: local
+              command: ["{sys.executable}", "-c",
+                        "import time; time.sleep(30)"]
+""")
+        proc = run_cli("submit", "-f", str(job_yaml), "--master", master,
+                       "--wait", "--timeout", "60")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        proc = run_cli("describe", "desc-me", "--master", master)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "Succeeded" in proc.stdout
+        assert "MPIJobCreated" in proc.stdout  # events section
+    finally:
+        cluster.terminate()
+        try:
+            cluster.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            cluster.kill()
