@@ -1,0 +1,83 @@
+"""HuggingFace Llama checkpoint conversion.
+
+Maps a transformers ``LlamaForCausalLM`` state dict onto the
+LlamaModel param tree, so published Llama-2/3 weights load directly into
+the TPU-native stack (and, in tests, so our implementation is verified
+logit-for-logit against the canonical one).
+
+Weight layout notes: HF Linear stores [out, in]; flax Dense kernels are
+[in, out] (attention projections additionally reshape to
+[in, heads, head_dim] / [heads, head_dim, in]).  The RoPE convention
+(rotate-half) and RMSNorm epsilon semantics match 1:1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .llama import LlamaConfig
+
+
+def _t(w) -> np.ndarray:
+    return np.asarray(w, dtype=np.float32).T
+
+
+def convert_hf_llama(state_dict, config: LlamaConfig) -> dict:
+    """state_dict: name -> tensor (torch tensors or arrays) from
+    ``LlamaForCausalLM``.  Returns {"params": ...} for LlamaModel."""
+
+    def get(name) -> np.ndarray:
+        w = state_dict[name]
+        if hasattr(w, "detach"):
+            w = w.detach().cpu().numpy()
+        return np.asarray(w, dtype=np.float32)
+
+    d = config.dim
+    h, kvh, hd = config.n_heads, config.kv_heads, config.head_dim
+
+    params: dict = {
+        "tok_embeddings": {"embedding": get("model.embed_tokens.weight")},
+        "norm": {"scale": get("model.norm.weight")},
+        "output": {"kernel": _t(get("lm_head.weight"))},
+    }
+    for i in range(config.n_layers):
+        hf = f"model.layers.{i}"
+        params[f"layers_{i}"] = {
+            "attention": {
+                "wq": {"kernel": _t(get(f"{hf}.self_attn.q_proj.weight"))
+                       .reshape(d, h, hd)},
+                "wk": {"kernel": _t(get(f"{hf}.self_attn.k_proj.weight"))
+                       .reshape(d, kvh, hd)},
+                "wv": {"kernel": _t(get(f"{hf}.self_attn.v_proj.weight"))
+                       .reshape(d, kvh, hd)},
+                "wo": {"kernel": _t(get(f"{hf}.self_attn.o_proj.weight"))
+                       .reshape(h, hd, d)},
+            },
+            "attention_norm": {
+                "scale": get(f"{hf}.input_layernorm.weight")},
+            "feed_forward": {
+                "w1": {"kernel": _t(get(f"{hf}.mlp.gate_proj.weight"))},
+                "w3": {"kernel": _t(get(f"{hf}.mlp.up_proj.weight"))},
+                "w2": {"kernel": _t(get(f"{hf}.mlp.down_proj.weight"))},
+            },
+            "ffn_norm": {
+                "scale": get(f"{hf}.post_attention_layernorm.weight")},
+        }
+    return {"params": params}
+
+
+def config_from_hf(hf_config, **overrides) -> LlamaConfig:
+    """Build a LlamaConfig from a transformers LlamaConfig."""
+    import jax.numpy as jnp
+    return LlamaConfig(**{**dict(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        hidden_dim=hf_config.intermediate_size,
+        norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        max_seq_len=hf_config.max_position_embeddings,
+        dtype=jnp.float32,
+    ), **overrides})
