@@ -19,26 +19,43 @@ from .llama import LlamaConfig
 
 
 def _t(w) -> np.ndarray:
-    return np.asarray(w, dtype=np.float32).T
+    return np.ascontiguousarray(w.T)
 
 
 def convert_hf_llama(state_dict, config: LlamaConfig) -> dict:
     """state_dict: name -> tensor (torch tensors or arrays) from
-    ``LlamaForCausalLM``.  Returns {"params": ...} for LlamaModel."""
+    ``LlamaForCausalLM``.  Returns {"params": ...} for LlamaModel.
+
+    Every checkpoint tensor must be consumed (rotary inv_freq buffers
+    excepted) — unexpected keys (bias-bearing variants, layer-count
+    mismatches) fail loudly instead of yielding a silently-wrong model.
+    Tied-embedding checkpoints (no lm_head.weight) reuse the embedding.
+    """
+    import numpy as _np
+
+    param_dtype = _np.dtype(_np.float32 if config.param_dtype is None
+                            else config.param_dtype)
+    consumed = set()
 
     def get(name) -> np.ndarray:
         w = state_dict[name]
+        consumed.add(name)
         if hasattr(w, "detach"):
-            w = w.detach().cpu().numpy()
-        return np.asarray(w, dtype=np.float32)
+            w = w.detach().cpu().float().numpy()
+        return np.asarray(w).astype(param_dtype)
 
     d = config.dim
     h, kvh, hd = config.n_heads, config.kv_heads, config.head_dim
 
+    embedding = get("model.embed_tokens.weight")
+    if "lm_head.weight" in state_dict:
+        head = _t(get("lm_head.weight"))
+    else:
+        head = _t(embedding)  # tie_word_embeddings checkpoints
     params: dict = {
-        "tok_embeddings": {"embedding": get("model.embed_tokens.weight")},
+        "tok_embeddings": {"embedding": embedding},
         "norm": {"scale": get("model.norm.weight")},
-        "output": {"kernel": _t(get("lm_head.weight"))},
+        "output": {"kernel": head},
     }
     for i in range(config.n_layers):
         hf = f"model.layers.{i}"
@@ -63,13 +80,28 @@ def convert_hf_llama(state_dict, config: LlamaConfig) -> dict:
             "ffn_norm": {
                 "scale": get(f"{hf}.post_attention_layernorm.weight")},
         }
+
+    leftover = [k for k in state_dict
+                if k not in consumed and not k.endswith("inv_freq")]
+    if leftover:
+        raise ValueError(
+            f"unconverted checkpoint tensors (config mismatch or"
+            f" unsupported variant): {sorted(leftover)[:8]}...")
     return {"params": params}
 
 
 def config_from_hf(hf_config, **overrides) -> LlamaConfig:
     """Build a LlamaConfig from a transformers LlamaConfig."""
     import jax.numpy as jnp
+    rope_scaling = getattr(hf_config, "rope_scaling", None)
+    if rope_scaling is not None:
+        rope_type = rope_scaling.get("rope_type",
+                                     rope_scaling.get("type", ""))
+        if rope_type != "llama3":
+            raise NotImplementedError(
+                f"rope_scaling type {rope_type!r} not supported")
     return LlamaConfig(**{**dict(
+        rope_scaling=rope_scaling,
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,

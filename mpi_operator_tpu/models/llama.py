@@ -45,6 +45,10 @@ class LlamaConfig:
     top_k: int = 2                            # experts per token
     ring_impl: str = "dense"                  # sp>1 chunk compute:
                                               # 'dense'|'flash'
+    rope_scaling: Optional[dict] = None       # llama3-style NTK scaling:
+                                              # {factor, low_freq_factor,
+                                              #  high_freq_factor,
+                                              #  original_max_position_embeddings}
 
     @property
     def head_dim(self) -> int:
@@ -98,10 +102,31 @@ def mixtral_8x7b(**overrides) -> LlamaConfig:
                           **overrides})
 
 
-def _rope(x, positions, theta: float):
+def _scale_rope_freqs(freqs, scaling: dict):
+    """Llama-3.1 rope scaling: long wavelengths divided by `factor`, short
+    kept, smooth interpolation in between (the 'llama3' rope_type)."""
+    import math as _math
+    factor = scaling["factor"]
+    low = scaling.get("low_freq_factor", 1.0)
+    high = scaling.get("high_freq_factor", 4.0)
+    old_len = scaling.get("original_max_position_embeddings", 8192)
+    wavelen = 2 * _math.pi / freqs
+    low_wavelen = old_len / low
+    high_wavelen = old_len / high
+    smooth = (old_len / wavelen - low) / (high - low)
+    scaled = jnp.where(
+        wavelen > low_wavelen, freqs / factor,
+        jnp.where(wavelen < high_wavelen, freqs,
+                  (1 - smooth) * freqs / factor + smooth * freqs))
+    return scaled
+
+
+def _rope(x, positions, theta: float, scaling: Optional[dict] = None):
     """Rotary embedding on [B, S, H, D] with positions [S]."""
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if scaling is not None:
+        freqs = _scale_rope_freqs(freqs, scaling)
     angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S,d/2]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
@@ -164,8 +189,8 @@ class LlamaAttention(nn.Module):
         k = dense((cfg.kv_heads, cfg.head_dim), "wk")(x)
         v = dense((cfg.kv_heads, cfg.head_dim), "wv")(x)
 
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         if decode:
             idx = cache_index.value

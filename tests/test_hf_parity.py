@@ -48,7 +48,49 @@ def test_greedy_generation_matches_hf(hf_pair):
     with torch.no_grad():
         hf_out = hf_model.generate(
             torch.tensor(prompt), max_new_tokens=6, do_sample=False,
-            pad_token_id=0)
+            pad_token_id=0, eos_token_id=None)
     ours = greedy_generate(model, variables, jnp.asarray(prompt), 6)
     np.testing.assert_array_equal(np.asarray(ours),
                                   hf_out.numpy()[:, prompt.shape[1]:])
+
+
+def test_logits_match_hf_with_llama3_rope_scaling():
+    """Llama-3.1-style rope scaling must match HF exactly too."""
+    hf_config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, rope_theta=500000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32},
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(1)
+    hf_model = transformers.LlamaForCausalLM(hf_config).eval()
+    cfg = config_from_hf(hf_config, attention_impl="xla")
+    model = LlamaModel(cfg)
+    variables = convert_hf_llama(hf_model.state_dict(), cfg)
+    tokens = np.array([[1, 2, 3, 40, 50, 60, 7, 8]])
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(model.apply(variables, jnp.asarray(tokens)))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=2e-4)
+
+
+def test_convert_rejects_unconsumed_tensors(hf_pair):
+    hf_model, model, variables, cfg = hf_pair
+    from mpi_operator_tpu.models.convert import convert_hf_llama
+    sd = dict(hf_model.state_dict())
+    sd["model.layers.9.self_attn.q_proj.weight"] =         sd["model.layers.0.self_attn.q_proj.weight"]
+    with pytest.raises(ValueError, match="unconverted"):
+        convert_hf_llama(sd, cfg)
+
+
+def test_convert_tied_embeddings_fallback(hf_pair):
+    hf_model, model, variables, cfg = hf_pair
+    from mpi_operator_tpu.models.convert import convert_hf_llama
+    sd = {k: v for k, v in hf_model.state_dict().items()
+          if k != "lm_head.weight"}
+    converted = convert_hf_llama(sd, cfg)
+    emb = converted["params"]["tok_embeddings"]["embedding"]
+    np.testing.assert_allclose(converted["params"]["output"]["kernel"],
+                               np.asarray(emb).T)
