@@ -294,3 +294,33 @@ def test_e2e_elastic_discovery_visible_inside_pod():
                                           timeout=60)
         logs = cluster.launcher_logs("default", "eld")
         assert "ELASTIC-OK" in logs, logs
+
+
+def test_e2e_ttl_cleans_launcher_job_mpijob_stays_succeeded():
+    """ttlSecondsAfterFinished flows to the launcher Job; the runtime
+    TTL-deletes it while the MPIJob's terminal status survives."""
+    import time
+    with LocalCluster() as cluster:
+        job = jax_job(
+            "ttl",
+            launcher_cmd=[sys.executable, "-c", "print('fin')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=1, run_policy={"ttl_seconds_after_finished": 1})
+        cluster.submit(job)
+        cluster.wait_for_condition("default", "ttl", constants.JOB_SUCCEEDED,
+                                   timeout=30)
+
+        def launcher_gone():
+            try:
+                cluster.client.jobs("default").get("ttl-launcher")
+                return False
+            except Exception:
+                return True
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not launcher_gone():
+            time.sleep(0.2)
+        assert launcher_gone()
+        final = cluster.client.mpi_jobs("default").get("ttl")
+        conds = {c.type: c.status for c in final.status.conditions}
+        assert conds[constants.JOB_SUCCEEDED] == "True"

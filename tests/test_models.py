@@ -245,3 +245,24 @@ def test_sampled_generation_shapes_and_determinism():
     assert a.shape == (2, 5)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same rng
     assert int(a.max()) < cfg.vocab_size
+
+
+def test_llama_remat_matches_no_remat():
+    """remat (activation checkpointing) must not change the math."""
+    cfg = llama2_tiny()
+    cfg_remat = llama2_tiny(remat=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0,
+                                cfg.vocab_size)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    model_r = LlamaModel(cfg_remat)
+
+    def loss(m, p):
+        return next_token_loss(m.apply(p, tokens), tokens)
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(model, p))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss(model_r, p))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
