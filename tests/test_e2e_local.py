@@ -324,3 +324,56 @@ def test_e2e_ttl_cleans_launcher_job_mpijob_stays_succeeded():
         final = cluster.client.mpi_jobs("default").get("ttl")
         conds = {c.type: c.status for c in final.status.conditions}
         assert conds[constants.JOB_SUCCEEDED] == "True"
+
+
+def test_e2e_wait_for_workers_ready_policy():
+    """launcherCreationPolicy=WaitForWorkersReady: the launcher only runs
+    after every worker is Running+Ready (kubelet sets Ready), and the job
+    still completes."""
+    with LocalCluster() as cluster:
+        job = jax_job(
+            "wfw",
+            launcher_cmd=[sys.executable, "-c", "print('go')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=2,
+            launcher_creation_policy="WaitForWorkersReady")
+        cluster.submit(job)
+        done = cluster.wait_for_condition("default", "wfw",
+                                          constants.JOB_SUCCEEDED,
+                                          timeout=30)
+        assert done.status.completion_time is not None
+
+
+def test_e2e_gang_scheduling_podgroup_lifecycle():
+    """Volcano gang scheduling through the live cluster: PodGroup created
+    with minMember=workers+1, pods decorated, deleted on suspend."""
+    import time
+    from mpi_operator_tpu.server.cluster import LocalCluster as LC
+    cluster = LC(gang_scheduler="volcano")
+    cluster.start()
+    try:
+        job = jax_job(
+            "gang",
+            launcher_cmd=[sys.executable, "-c", "print('go')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=2)
+        cluster.submit(job)
+
+        deadline = time.monotonic() + 15
+        pg = None
+        while time.monotonic() < deadline and pg is None:
+            try:
+                pg = cluster.client.volcano_pod_groups("default").get("gang")
+            except Exception:
+                time.sleep(0.1)
+        assert pg is not None and pg.spec.min_member == 3
+
+        pod = cluster.client.pods("default").get("gang-worker-0")
+        assert pod.spec.scheduler_name == "volcano"
+        assert pod.metadata.annotations[
+            "scheduling.k8s.io/group-name"] == "gang"
+
+        cluster.wait_for_condition("default", "gang",
+                                   constants.JOB_SUCCEEDED, timeout=30)
+    finally:
+        cluster.stop()
