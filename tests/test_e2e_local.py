@@ -327,9 +327,11 @@ def test_e2e_ttl_cleans_launcher_job_mpijob_stays_succeeded():
 
 
 def test_e2e_wait_for_workers_ready_policy():
-    """launcherCreationPolicy=WaitForWorkersReady: the launcher only runs
-    after every worker is Running+Ready (kubelet sets Ready), and the job
-    still completes."""
+    """launcherCreationPolicy=WaitForWorkersReady ordering, made
+    deterministic with scheduling gates: while workers are gated (never
+    Ready) the launcher must NOT be created; ungating the workers lets
+    the launcher start and the job complete."""
+    import time
     with LocalCluster() as cluster:
         job = jax_job(
             "wfw",
@@ -337,7 +339,25 @@ def test_e2e_wait_for_workers_ready_policy():
             worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
             workers=2,
             launcher_creation_policy="WaitForWorkersReady")
+        job.worker_spec.template.spec.scheduling_gates = [
+            {"name": "example.com/hold"}]
         cluster.submit(job)
+
+        # Workers exist but are gated -> not Ready -> no launcher.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(
+                cluster.client.pods("default").list(
+                    {"training.kubeflow.org/job-role": "worker"})) < 2:
+            time.sleep(0.05)
+        time.sleep(1.0)  # several sync rounds
+        with pytest.raises(Exception):
+            cluster.client.jobs("default").get("wfw-launcher")
+
+        # Ungate -> workers run -> launcher created -> Succeeded.
+        for pod in cluster.client.pods("default").list(
+                {"training.kubeflow.org/job-role": "worker"}):
+            pod.spec.scheduling_gates = []
+            cluster.client.pods("default").update(pod)
         done = cluster.wait_for_condition("default", "wfw",
                                           constants.JOB_SUCCEEDED,
                                           timeout=30)
@@ -346,34 +366,47 @@ def test_e2e_wait_for_workers_ready_policy():
 
 def test_e2e_gang_scheduling_podgroup_lifecycle():
     """Volcano gang scheduling through the live cluster: PodGroup created
-    with minMember=workers+1, pods decorated, deleted on suspend."""
+    with minMember=workers+1, pods decorated, and the PodGroup deleted
+    when the job is suspended."""
     import time
-    from mpi_operator_tpu.server.cluster import LocalCluster as LC
-    cluster = LC(gang_scheduler="volcano")
-    cluster.start()
-    try:
+    with LocalCluster(gang_scheduler="volcano") as cluster:
         job = jax_job(
             "gang",
-            launcher_cmd=[sys.executable, "-c", "print('go')"],
+            launcher_cmd=[sys.executable, "-c",
+                          "import time; time.sleep(20)"],
             worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
             workers=2)
         cluster.submit(job)
 
-        deadline = time.monotonic() + 15
-        pg = None
-        while time.monotonic() < deadline and pg is None:
-            try:
-                pg = cluster.client.volcano_pod_groups("default").get("gang")
-            except Exception:
-                time.sleep(0.1)
-        assert pg is not None and pg.spec.min_member == 3
+        def try_get(fn):
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    return fn()
+                except Exception:
+                    time.sleep(0.1)
+            raise AssertionError("object never appeared")
 
-        pod = cluster.client.pods("default").get("gang-worker-0")
+        pg = try_get(
+            lambda: cluster.client.volcano_pod_groups("default").get("gang"))
+        assert pg.spec.min_member == 3
+
+        pod = try_get(
+            lambda: cluster.client.pods("default").get("gang-worker-0"))
         assert pod.spec.scheduler_name == "volcano"
         assert pod.metadata.annotations[
             "scheduling.k8s.io/group-name"] == "gang"
 
-        cluster.wait_for_condition("default", "gang",
-                                   constants.JOB_SUCCEEDED, timeout=30)
-    finally:
-        cluster.stop()
+        # Suspend -> PodGroup (and workers) torn down.
+        stored = cluster.client.mpi_jobs("default").get("gang")
+        stored.spec.run_policy.suspend = True
+        cluster.client.mpi_jobs("default").update(stored)
+        deadline = time.monotonic() + 15
+        gone = False
+        while time.monotonic() < deadline and not gone:
+            try:
+                cluster.client.volcano_pod_groups("default").get("gang")
+                time.sleep(0.1)
+            except Exception:
+                gone = True
+        assert gone
