@@ -9,22 +9,53 @@ README.md:96-143,197-212 — batch 64/device, synthetic data, SGD).
 Here: the same workload TPU-native — Flax ResNet-101, bfloat16 compute,
 batch 64 per chip, synthetic ImageNet, SGD+momentum — data-parallel over
 every local chip (single-chip hosts degenerate to one device), reported
-per chip.
+per chip, plus model FLOPs utilisation (MFU) against the chip's peak.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
+
+Robustness: backend init on a tunneled TPU platform can hang or come up
+UNAVAILABLE for a while.  The measurement therefore runs in a worker
+subprocess under a hard timeout; the parent retries transient failures
+(donation on, then off — buffer donation stalled on the tunneled 'axon'
+platform in round 1) and always prints the one JSON line, with an
+"error" field on terminal failure so the driver parses something.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BASELINE_IMAGES_PER_SEC_PER_DEVICE = 154.2  # README.md:197-210
+BASELINE_IMAGES_PER_SEC_PER_DEVICE = 154.2  # reference README.md:197-210
+METRIC = "resnet101_train_images_per_sec_per_chip"
+UNIT = "images/sec/chip"
+
+# Peak dense bf16 TFLOP/s per chip by TPU generation, for the MFU line.
+PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
 
-def main() -> None:
+def _emit(value: float, mfu=None, error=None, extra=None) -> None:
+    rec = {
+        "metric": METRIC,
+        "value": round(value, 2),
+        "unit": UNIT,
+        "vs_baseline": round(value / BASELINE_IMAGES_PER_SEC_PER_DEVICE, 3),
+    }
+    if mfu is not None:
+        rec["mfu"] = round(mfu, 4)
+    if error is not None:
+        rec["error"] = error
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def worker(donate: bool) -> None:
+    """Runs the actual measurement; prints the JSON line on success."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -59,9 +90,6 @@ def main() -> None:
         images = jax.device_put(images, batch_sharding(mesh, extra_dims=3))
         labels = jax.device_put(labels, batch_sharding(mesh, extra_dims=0))
 
-    # NOTE: donate_argnums hangs on the tunneled 'axon' platform (buffer
-    # invalidation stalls); plain jit measured faster end-to-end here.
-    @jax.jit
     def train_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             logits, updates = model.apply(
@@ -72,9 +100,34 @@ def main() -> None:
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         updates, new_opt = tx.update(grads, opt_state, params)
-        new_params = jax.tree_util.tree_map(lambda a, b: a + b, params,
-                                            updates)
+        new_params = optax.apply_updates(params, updates)
         return new_params, new_stats, new_opt, loss
+
+    donate_argnums = (0, 1, 2) if donate else ()
+    # AOT compile ONCE and drive the loops with the executable (a separate
+    # jit call would recompile the whole ResNet-101 step from scratch —
+    # minutes on a tunneled/remote-compile backend).
+    lowered = jax.jit(train_step, donate_argnums=donate_argnums).lower(
+        params, batch_stats, opt_state, images, labels)
+    train_step = lowered.compile()
+
+    # Global FLOPs per step: from the compiled executable when XLA reports
+    # it (per-device under SPMD partitioning, so scale by n_chips);
+    # analytic ResNet-101 model as fallback (7.8 GFLOPs/image forward at
+    # 224x224, x3 for fwd+bwd — the standard training-cost rule; batch is
+    # already global).
+    flops_per_step = None
+    try:
+        cost = train_step.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = (cost or {}).get("flops")
+        if f and f > 0:
+            flops_per_step = float(f) * n_chips
+    except Exception:
+        pass
+    if flops_per_step is None:
+        flops_per_step = 3.0 * 7.8e9 * batch
 
     for _ in range(warmup):
         params, batch_stats, opt_state, loss = train_step(
@@ -93,14 +146,71 @@ def main() -> None:
     elapsed = time.perf_counter() - start
 
     per_chip = batch * steps / elapsed / n_chips
-    print(json.dumps({
-        "metric": "resnet101_train_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_DEVICE,
-                             3),
-    }))
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = float(os.environ.get(
+        "BENCH_PEAK_TFLOPS", PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])))
+    mfu = (flops_per_step * steps / elapsed) / n_chips / (peak * 1e12)
+    _emit(per_chip, mfu=mfu, extra={
+        "donate": donate, "n_chips": n_chips,
+        "platform": jax.devices()[0].platform,
+        "peak_tflops": peak,
+    })
+
+
+def _attempt(donate: bool, timeout_s: float):
+    """One worker run.  Returns (json_line_or_None, diagnostic_str)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+    if not donate:
+        cmd.append("--no-donate")
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s:.0f}s (donate={donate})"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+                return line, ""
+            except ValueError:
+                pass
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    diag = "; ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
+    return None, f"rc={proc.returncode}: {diag[:500]}"
+
+
+def main() -> None:
+    total_deadline = time.monotonic() + float(
+        os.environ.get("BENCH_TOTAL_TIMEOUT", "1500"))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "600"))
+    errors = []
+    # Donation first (saves HBM and a params copy per step).  A timeout or
+    # crash under donation is treated as the known tunneled-platform
+    # donation stall — fall straight back to donate=False rather than
+    # burning the budget re-trying it; only transient UNAVAILABLE retries
+    # the same configuration.
+    for donate in (True, False):
+        for _ in range(2):
+            budget = total_deadline - time.monotonic()
+            if budget < 60:
+                errors.append("total benchmark budget exhausted")
+                _emit(0.0, error=" | ".join(errors)[:1000])
+                sys.exit(1)
+            line, diag = _attempt(donate, min(attempt_timeout, budget))
+            if line is not None:
+                print(line)
+                sys.stdout.flush()
+                return
+            errors.append(f"donate={donate}: {diag}")
+            if "UNAVAILABLE" not in diag:
+                break  # hang or hard failure -> next configuration
+            time.sleep(10)  # transient tunnel unavailability
+    _emit(0.0, error=" | ".join(errors)[:1000])
+    sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker(donate="--no-donate" not in sys.argv)
+    else:
+        main()

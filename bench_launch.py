@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Benchmark: MPIJob launch-to-first-allreduce latency.
+
+BASELINE.md's second target metric (the reference publishes no number for
+it — the README's sample job shows startTime 22:15:51 -> first useful
+work well over a minute later via image pull + sshd + mpirun).  Here:
+submit an MPIJob running jax-pi (launcher-as-worker + 2 workers, a real
+jax.distributed group on CPU devices), and measure wall-clock from the
+MPIJob's creationTimestamp to completion of the workload's first global
+collective, as reported by the injected MPIJOB_SUBMIT_TIME contract.
+
+Prints ONE JSON line and writes BENCH_LAUNCH.json next to this file.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Hermetic CPU platform for the control plane AND the workload
+# subprocesses (the tunneled TPU env must not leak in).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> int:
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.server import LocalCluster
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    from test_e2e_local import jax_job
+
+    cmd = [sys.executable, os.path.join(REPO_ROOT, "examples", "jax_pi.py"),
+           "100000"]
+    record = {"metric": "launch_to_first_allreduce_seconds", "value": None,
+              "unit": "s", "vs_baseline": None}
+    try:
+        with LocalCluster() as cluster:
+            job = jax_job("launch-bench", launcher_cmd=cmd, worker_cmd=cmd,
+                          workers=2, run_launcher_as_worker=True)
+            cluster.submit(job)
+            cluster.wait_for_condition("default", "launch-bench",
+                                       constants.JOB_SUCCEEDED, timeout=240)
+            logs = cluster.launcher_logs("default", "launch-bench")
+        line = next(l for l in logs.splitlines()
+                    if l.startswith("launch_to_first_allreduce_seconds="))
+        record["value"] = round(float(line.split("=")[1]), 3)
+    except Exception as exc:  # still emit a parseable record
+        record["error"] = str(exc)[:500]
+    print(json.dumps(record))
+    with open(os.path.join(REPO_ROOT, "BENCH_LAUNCH.json"), "w") as f:
+        json.dump(record, f)
+        f.write("\n")
+    return 0 if record["value"] is not None else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,6 +44,12 @@ def main() -> int:
     pi = 4.0 * float(totals[0]) / float(totals[1])
     if rank == 0:
         print(f"workers={world} samples={int(totals[1])} pi={pi:.6f}")
+        # Submit -> first global collective (BASELINE.md target metric);
+        # present only when launched by the operator.
+        from mpi_operator_tpu.bootstrap import launch_latency_seconds
+        latency = launch_latency_seconds()
+        if latency is not None:
+            print(f"launch_to_first_allreduce_seconds={latency:.3f}")
     sys.stdout.flush()
     return 0
 

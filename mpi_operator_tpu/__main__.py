@@ -25,6 +25,12 @@ import time
 
 def _client(master: str):
     from .k8s.apiserver import Clientset
+    # kubectl-style: the same CLI drives a real kube-apiserver (kube REST
+    # grammar, autodetected via GET /apis) or the native cluster protocol.
+    from .k8s.kube_transport import (KubeApiServer, KubeConfig,
+                                     probe_is_kube)
+    if probe_is_kube(master):
+        return Clientset(server=KubeApiServer(KubeConfig(server=master)))
     from .k8s.http_api import RemoteApiServer
     return Clientset(server=RemoteApiServer(master))
 
@@ -62,6 +68,29 @@ def cmd_cluster(args) -> int:
     server.stop()
     cluster.stop()
     return 0
+
+
+def cmd_validate(args) -> int:
+    """Client-side strict schema validation (kubectl --validate=strict
+    analogue) against the generated CRD openAPIV3Schema."""
+    import yaml
+
+    from .codegen.schema_validate import validate_mpijob_dict
+
+    with open(args.file) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    rc = 0
+    for doc in docs:
+        name = (doc.get("metadata") or {}).get("name", "<unnamed>")
+        errors = validate_mpijob_dict(doc)
+        if errors:
+            rc = 1
+            print(f"mpijob.kubeflow.org/{name} INVALID:")
+            for e in errors:
+                print(f"  {e}")
+        else:
+            print(f"mpijob.kubeflow.org/{name} valid")
+    return rc
 
 
 def cmd_submit(args) -> int:
@@ -176,6 +205,10 @@ def main(argv=None) -> int:
     p = sub.add_parser("cluster", help="all-in-one local cluster")
     p.add_argument("--port", type=int, default=8001)
 
+    p = sub.add_parser("validate",
+                       help="strict-validate an MPIJob yaml against the CRD")
+    p.add_argument("-f", "--file", required=True)
+
     p = sub.add_parser("submit", help="submit an MPIJob yaml")
     p.add_argument("-f", "--file", required=True)
     p.add_argument("-n", "--namespace", default="")
@@ -208,6 +241,8 @@ def main(argv=None) -> int:
             return cmd_operator(args, extra)
         if args.command == "cluster":
             return cmd_cluster(args)
+        if args.command == "validate":
+            return cmd_validate(args)
         if args.command == "submit":
             return cmd_submit(args)
         if args.command == "get":

@@ -80,7 +80,18 @@ JAX_COORDINATOR_PORT_ENV = "JAX_COORDINATOR_PORT"
 JAX_PROCESS_ID_ENV = "JAX_PROCESS_ID"
 JAX_NUM_PROCESSES_ENV = "JAX_NUM_PROCESSES"
 JAX_LOCAL_DEVICE_COUNT_ENV = "JAX_LOCAL_DEVICE_COUNT"
+# Epoch-seconds submit timestamp injected into every pod so workloads can
+# report launch-to-first-allreduce latency (BASELINE.md target metric).
+MPIJOB_SUBMIT_TIME_ENV = "MPIJOB_SUBMIT_TIME"
 DEFAULT_JAX_COORDINATOR_PORT = 8476
+
+# Multislice (DCN) coordination env, injected when spec.slices > 1: the
+# megascale transport pattern — one coordinator address shared by every
+# slice, plus each process's slice identity.
+MEGASCALE_COORDINATOR_ADDRESS_ENV = "MEGASCALE_COORDINATOR_ADDRESS"
+MEGASCALE_NUM_SLICES_ENV = "MEGASCALE_NUM_SLICES"
+MEGASCALE_SLICE_ID_ENV = "MEGASCALE_SLICE_ID"
+DEFAULT_MEGASCALE_PORT = 8477
 
 # GKE TPU scheduling surface (workers request chips instead of GPUs).
 TPU_RESOURCE = "google.com/tpu"

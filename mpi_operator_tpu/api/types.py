@@ -52,7 +52,7 @@ class ReplicaSpec:
 
 @dataclass
 class MPIJobSpec:
-    """types.go:168-204."""
+    """types.go:168-204 (+ TPU-native multislice extension)."""
     slots_per_worker: Optional[int] = None
     run_launcher_as_worker: Optional[bool] = None
     run_policy: RunPolicy = field(default_factory=RunPolicy)
@@ -60,6 +60,11 @@ class MPIJobSpec:
     ssh_auth_mount_path: str = ""
     launcher_creation_policy: str = ""
     mpi_implementation: str = ""
+    # TPU multislice (no reference counterpart — SURVEY.md §2.3/§5's
+    # DCN answer): workers are partitioned into this many same-sized
+    # slices; the controller injects MEGASCALE_* coordinator env so XLA
+    # bridges slices over DCN while ICI carries intra-slice collectives.
+    slices: Optional[int] = None
 
 
 @dataclass

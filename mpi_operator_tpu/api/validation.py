@@ -82,6 +82,26 @@ def _validate_spec(spec: MPIJobSpec, path: str) -> list[FieldError]:
             f"{path}.mpiImplementation",
             f"unsupported value {spec.mpi_implementation!r}: supported values:"
             f" {', '.join(constants.VALID_IMPLEMENTATIONS)}"))
+    if spec.slices is not None:
+        worker = spec.mpi_replica_specs.get(constants.REPLICA_TYPE_WORKER)
+        workers = (worker.replicas or 0) if worker is not None else 0
+        if spec.slices < 1:
+            errs.append(FieldError(f"{path}.slices",
+                                   "must be greater than or equal to 1"))
+        elif spec.mpi_implementation != constants.IMPL_JAX:
+            errs.append(FieldError(
+                f"{path}.slices",
+                "multislice requires mpiImplementation: JAX"))
+        elif spec.slices > 1 and spec.run_launcher_as_worker:
+            errs.append(FieldError(
+                f"{path}.slices",
+                "runLauncherAsWorker is incompatible with multislice: the"
+                " launcher does not belong to any slice"))
+        elif workers % spec.slices != 0:
+            errs.append(FieldError(
+                f"{path}.slices",
+                f"worker replicas ({workers}) must be divisible by slices"
+                f" ({spec.slices})"))
     return errs
 
 

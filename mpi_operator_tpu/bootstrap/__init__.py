@@ -1,4 +1,4 @@
 """Workload-side bootstrap helpers (the in-pod half of the contract)."""
 
 from .distributed import (initialize_from_env, process_env,  # noqa: F401
-                          ProcessEnv)
+                          launch_latency_seconds, submit_time, ProcessEnv)

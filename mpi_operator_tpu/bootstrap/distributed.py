@@ -25,10 +25,18 @@ class ProcessEnv:
     process_id: int
     num_processes: int
     local_device_count: int
+    # Multislice identity (spec.slices > 1); slice_id is -1 outside a
+    # multislice job.
+    num_slices: int = 1
+    slice_id: int = -1
 
     @property
     def is_coordinator(self) -> bool:
         return self.process_id == 0
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
 
 
 def process_env() -> Optional[ProcessEnv]:
@@ -41,7 +49,27 @@ def process_env() -> Optional[ProcessEnv]:
         process_id=int(os.environ.get(constants.JAX_PROCESS_ID_ENV, "0")),
         num_processes=int(os.environ.get(constants.JAX_NUM_PROCESSES_ENV, "1")),
         local_device_count=int(os.environ.get(
-            constants.JAX_LOCAL_DEVICE_COUNT_ENV, "0")))
+            constants.JAX_LOCAL_DEVICE_COUNT_ENV, "0")),
+        num_slices=int(os.environ.get(
+            constants.MEGASCALE_NUM_SLICES_ENV, "1")),
+        slice_id=int(os.environ.get(
+            constants.MEGASCALE_SLICE_ID_ENV, "-1")))
+
+
+def submit_time() -> Optional[float]:
+    """Epoch seconds at which the MPIJob was submitted (injected by the
+    controller as MPIJOB_SUBMIT_TIME); None outside an operator-run pod.
+    Workloads use it to report launch-to-first-allreduce latency."""
+    raw = os.environ.get(constants.MPIJOB_SUBMIT_TIME_ENV)
+    return float(raw) if raw else None
+
+
+def launch_latency_seconds() -> Optional[float]:
+    """Seconds elapsed since job submission (None outside an MPIJob).
+    Call right after the first collective completes to measure
+    submit -> first-allreduce, BASELINE.md's second target metric."""
+    t0 = submit_time()
+    return None if t0 is None else time.time() - t0
 
 
 def initialize_from_env(timeout_seconds: float = 120.0) -> Optional[ProcessEnv]:

@@ -43,9 +43,36 @@ _ENUMS = {
         constants.LAUNCHER_CREATION_WAIT_FOR_WORKERS_READY],
 }
 
+_STRING_MAP = {"type": "object", "additionalProperties": {"type": "string"}}
+# Resource lists are quantity maps: values may be "250m"/"1Gi" or plain
+# numbers (the kube int-or-string extension).
+_QUANTITY_MAP = {"type": "object",
+                 "additionalProperties": {"x-kubernetes-int-or-string": True}}
+
+# Structured schemas for fields whose Python type is a plain dict/list
+# (matching the reference CRD's real shapes instead of punting to
+# x-kubernetes-preserve-unknown-fields; compare
+# manifests/base/kubeflow.org_mpijobs.yaml in /root/reference).
+_FIELD_OVERRIDES = {
+    ("ResourceRequirements", "limits"): _QUANTITY_MAP,
+    ("ResourceRequirements", "requests"): _QUANTITY_MAP,
+    ("PodSpec", "node_selector"): _STRING_MAP,
+    ("ObjectMeta", "labels"): _STRING_MAP,
+    ("ObjectMeta", "annotations"): _STRING_MAP,
+    ("ServiceSpec", "selector"): _STRING_MAP,
+    ("PodSpec", "scheduling_gates"): {
+        "type": "array",
+        "items": {"type": "object",
+                  "properties": {"name": {"type": "string"}},
+                  "required": ["name"]}},
+}
+
 
 def _schema_for(ftype, owner: str = "", fname: str = "",
                 seen: tuple = ()) -> dict:
+    override = _FIELD_OVERRIDES.get((owner, fname))
+    if override is not None:
+        return dict(override)
     origin = typing.get_origin(ftype)
     if origin is typing.Union:
         args = [a for a in typing.get_args(ftype) if a is not type(None)]

@@ -1,0 +1,102 @@
+"""Structural openAPIV3Schema validation (kubectl --validate=strict).
+
+Validates a decoded YAML/JSON document against the CRD's generated
+openAPIV3Schema (codegen/crd.py).  Semantics follow kube structural
+schemas: objects with declared ``properties`` are CLOSED unless marked
+``x-kubernetes-preserve-unknown-fields`` (a real apiserver would prune;
+strict client-side validation rejects, which is what catches the
+misspelled-``resources``-key class of error before submit).  Supports
+``x-kubernetes-int-or-string`` for quantity maps.
+
+Parity target: server-side schema validation the reference gets from its
+8,947-line controller-gen CRD (/root/reference/manifests/base/
+kubeflow.org_mpijobs.yaml).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+def validate_schema(instance: Any, schema: dict, path: str = "$") -> List[str]:
+    """Returns a list of human-readable violations (empty = valid)."""
+    errors: List[str] = []
+    _validate(instance, schema, path, errors)
+    return errors
+
+
+def _type_ok(instance: Any, stype: str) -> bool:
+    if stype == "object":
+        return isinstance(instance, dict)
+    if stype == "array":
+        return isinstance(instance, list)
+    if stype == "string":
+        return isinstance(instance, str)
+    if stype == "integer":
+        return isinstance(instance, int) and not isinstance(instance, bool)
+    if stype == "number":
+        return isinstance(instance, (int, float)) \
+            and not isinstance(instance, bool)
+    if stype == "boolean":
+        return isinstance(instance, bool)
+    return True
+
+
+def _validate(instance: Any, schema: dict, path: str,
+              errors: List[str]) -> None:
+    if instance is None:
+        return  # null is always prunable/omitted (omitempty semantics)
+
+    if schema.get("x-kubernetes-int-or-string"):
+        if not isinstance(instance, (int, float, str)) \
+                or isinstance(instance, bool):
+            errors.append(f"{path}: expected int-or-string quantity, got "
+                          f"{type(instance).__name__}")
+        return
+
+    stype = schema.get("type")
+    if stype and not _type_ok(instance, stype):
+        errors.append(f"{path}: expected {stype}, got "
+                      f"{type(instance).__name__}")
+        return
+
+    enum = schema.get("enum")
+    if enum is not None and instance not in enum:
+        errors.append(f"{path}: {instance!r} not one of {enum}")
+
+    if isinstance(instance, dict):
+        props = schema.get("properties")
+        additional = schema.get("additionalProperties")
+        preserve = schema.get("x-kubernetes-preserve-unknown-fields", False)
+        for req in schema.get("required", []):
+            if req not in instance:
+                errors.append(f"{path}: missing required field {req!r}")
+        for key, val in instance.items():
+            key_path = f"{path}.{key}"
+            if props is not None and key in props:
+                _validate(val, props[key], key_path, errors)
+            elif isinstance(additional, dict):
+                _validate(val, additional, key_path, errors)
+            elif additional is True or preserve or (props is None
+                                                    and additional is None):
+                continue  # open object
+            else:
+                errors.append(f"{path}: unknown field {key!r}")
+    elif isinstance(instance, list):
+        items = schema.get("items")
+        if isinstance(items, dict):
+            for i, val in enumerate(instance):
+                _validate(val, items, f"{path}[{i}]", errors)
+
+
+def validate_mpijob_dict(doc: dict) -> List[str]:
+    """Validate a decoded MPIJob manifest against the generated CRD."""
+    from .crd import mpijob_crd
+    schema = mpijob_crd()["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    errors = []
+    if doc.get("apiVersion") != "kubeflow.org/v2beta1":
+        errors.append(f"$.apiVersion: {doc.get('apiVersion')!r} != "
+                      f"'kubeflow.org/v2beta1'")
+    if doc.get("kind") != "MPIJob":
+        errors.append(f"$.kind: {doc.get('kind')!r} != 'MPIJob'")
+    return errors + validate_schema(doc, schema)

@@ -150,7 +150,7 @@ def coordinator_host(job: MPIJob, cluster_domain: str) -> str:
 
 def jax_env(job: MPIJob, process_id: int, cluster_domain: str) -> list:
     port = constants.DEFAULT_JAX_COORDINATOR_PORT
-    return [
+    env = [
         EnvVar(constants.JAX_COORDINATOR_ADDRESS_ENV,
                f"{coordinator_host(job, cluster_domain)}:{port}"),
         EnvVar(constants.JAX_COORDINATOR_PORT_ENV, str(port)),
@@ -159,6 +159,27 @@ def jax_env(job: MPIJob, process_id: int, cluster_domain: str) -> list:
         EnvVar(constants.JAX_LOCAL_DEVICE_COUNT_ENV,
                str(job.spec.slots_per_worker or 1)),
     ]
+    # Submit timestamp -> workloads report launch-to-first-allreduce
+    # latency (BASELINE.md's second target metric).
+    if job.metadata.creation_timestamp is not None:
+        env.append(EnvVar(
+            constants.MPIJOB_SUBMIT_TIME_ENV,
+            f"{job.metadata.creation_timestamp.timestamp():.3f}"))
+    # Multislice (DCN): partition workers into same-sized slices and point
+    # every process at one megascale coordinator (slice 0's worker-0);
+    # XLA bridges slices over DCN, ICI stays intra-slice (SURVEY.md §5).
+    slices = job.spec.slices or 1
+    if slices > 1:
+        per_slice = max(1, num_processes(job) // slices)
+        env.extend([
+            EnvVar(constants.MEGASCALE_COORDINATOR_ADDRESS_ENV,
+                   f"{_host_fqdn(worker_name(job, 0), job, cluster_domain)}"
+                   f":{constants.DEFAULT_MEGASCALE_PORT}"),
+            EnvVar(constants.MEGASCALE_NUM_SLICES_ENV, str(slices)),
+            EnvVar(constants.MEGASCALE_SLICE_ID_ENV,
+                   str(process_id // per_slice)),
+        ])
+    return env
 
 
 # ---------------------------------------------------------------------------

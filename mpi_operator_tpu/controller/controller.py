@@ -530,7 +530,11 @@ class MPIJobController:
     # Status
     # ------------------------------------------------------------------
     def _launcher_pods(self, launcher) -> list:
-        """jobPods (:1694-1710)."""
+        """jobPods (:1694-1710): selector-matching pods controlled by the
+        launcher Job, strictly by ownership (metav1.IsControlledBy).  An
+        orphaned selector-matching pod is NOT adopted — it is excluded and
+        a warning event is emitted so the collision is visible, matching
+        the reference's ownership strictness."""
         pods = self.pod_informer.lister.list(launcher.metadata.namespace)
         selector = launcher.spec.selector
         out = []
@@ -540,7 +544,11 @@ class MPIJobController:
                 out.append(p)
             elif selector is not None and match_label_selector(
                     selector, p.metadata.labels) and ref is None:
-                out.append(p)
+                self.recorder.event(
+                    launcher, core.EVENT_TYPE_WARNING, "OrphanPod",
+                    f"pod {p.metadata.namespace}/{p.metadata.name} matches "
+                    f"the launcher selector but has no controller owner; "
+                    f"not adopting it")
         return out
 
     def _update_mpi_job_status(self, job: MPIJob, launcher, workers: list) -> None:

@@ -38,10 +38,39 @@ RESTART_POLICY_NEVER = "Never"
 
 
 @dataclass
+class ObjectFieldSelector:
+    field_path: str = ""
+    api_version: str = ""
+
+
+@dataclass
+class KeySelector:
+    """configMapKeyRef / secretKeyRef shape."""
+    name: str = ""
+    key: str = ""
+    optional: Optional[bool] = None
+
+
+@dataclass
+class ResourceFieldSelector:
+    container_name: str = ""
+    resource: str = ""
+    divisor: str = ""
+
+
+@dataclass
+class EnvVarSource:
+    field_ref: Optional[ObjectFieldSelector] = None
+    resource_field_ref: Optional[ResourceFieldSelector] = None
+    config_map_key_ref: Optional[KeySelector] = None
+    secret_key_ref: Optional[KeySelector] = None
+
+
+@dataclass
 class EnvVar:
     name: str = ""
     value: str = ""
-    value_from: Optional[dict] = None
+    value_from: Optional[EnvVarSource] = None
 
 
 @dataclass
@@ -74,12 +103,31 @@ class SecretVolumeSource:
 
 
 @dataclass
+class EmptyDirVolumeSource:
+    medium: str = ""
+    size_limit: str = ""
+
+
+@dataclass
+class HostPathVolumeSource:
+    path: str = ""
+    type: str = ""
+
+
+@dataclass
+class PersistentVolumeClaimVolumeSource:
+    claim_name: str = ""
+    read_only: Optional[bool] = None
+
+
+@dataclass
 class Volume:
     name: str = ""
     config_map: Optional[ConfigMapVolumeSource] = None
     secret: Optional[SecretVolumeSource] = None
-    empty_dir: Optional[dict] = None
-    host_path: Optional[dict] = None
+    empty_dir: Optional[EmptyDirVolumeSource] = None
+    host_path: Optional[HostPathVolumeSource] = None
+    persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
 
 
 @dataclass
@@ -99,13 +147,14 @@ class ContainerPort:
 class Container:
     name: str = ""
     image: str = ""
-    command: list = field(default_factory=list)
-    args: list = field(default_factory=list)
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
     working_dir: str = ""
     env: List[EnvVar] = field(default_factory=list)
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     volume_mounts: List[VolumeMount] = field(default_factory=list)
     ports: List[ContainerPort] = field(default_factory=list)
+    image_pull_policy: str = ""
     security_context: Optional[dict] = None
 
 
@@ -126,6 +175,11 @@ class Toleration:
 
 
 @dataclass
+class LocalObjectReference:
+    name: str = ""
+
+
+@dataclass
 class PodSpec:
     containers: List[Container] = field(default_factory=list)
     init_containers: List[Container] = field(default_factory=list)
@@ -142,6 +196,9 @@ class PodSpec:
     scheduler_name: str = ""
     priority_class_name: str = ""
     service_account_name: str = ""
+    image_pull_secrets: List[LocalObjectReference] = field(
+        default_factory=list)
+    affinity: Optional[dict] = None
     security_context: Optional[dict] = None
     termination_grace_period_seconds: Optional[int] = None
 

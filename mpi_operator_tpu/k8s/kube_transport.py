@@ -1,0 +1,667 @@
+"""Real Kubernetes REST transport + hermetic kube-grammar fixture server.
+
+`KubeApiServer` implements the same `ApiServer` interface the in-process
+`Clientset` consumes (create/get/list/update/delete/watch), but speaks
+genuine kube-apiserver path grammar:
+
+    /api/v1/namespaces/{ns}/pods[/{name}[/status]]
+    /apis/kubeflow.org/v2beta1/namespaces/{ns}/mpijobs/...
+    /apis/batch/v1/namespaces/{ns}/jobs/...
+    ?labelSelector=k=v,...     ?watch=true&resourceVersion=N   (ndjson)
+
+with bearer-token + CA trust from flags, a kubeconfig, or the in-cluster
+pod filesystem — so ``python -m mpi_operator_tpu operator --master
+https://...`` drives a real cluster with the existing manifests.  Parity
+target: client construction in the reference
+(/root/reference/cmd/mpi-operator/app/server.go:108,258-299) and its CRD
+existence check (server.go:302-314).
+
+`KubeFixtureServer` serves the SAME grammar over the hermetic in-memory
+`ApiServer` store (faithful details included: list items without
+apiVersion/kind, kube `Status` error bodies, watch bookmarks ignored by
+the client) — the envtest analogue that lets the full e2e suite run
+against the kube wire format without a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import registry
+from .apiserver import ApiError, ApiServer, WatchEvent
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# (apiVersion, Kind) -> lowercase plural resource name (the kube GVR).
+_RESOURCES = {
+    ("v1", "Pod"): "pods",
+    ("v1", "Service"): "services",
+    ("v1", "ConfigMap"): "configmaps",
+    ("v1", "Secret"): "secrets",
+    ("v1", "Event"): "events",
+    ("batch/v1", "Job"): "jobs",
+    ("kubeflow.org/v2beta1", "MPIJob"): "mpijobs",
+    ("scheduling.volcano.sh/v1beta1", "PodGroup"): "podgroups",
+    ("scheduling.x-k8s.io/v1alpha1", "PodGroup"): "podgroups",
+    ("coordination.k8s.io/v1", "Lease"): "leases",
+}
+_KINDS = {(gv, plural): kind for (gv, kind), plural in _RESOURCES.items()}
+
+# kube Status reason <-> our ApiError codes.
+_REASON_TO_CODE = {"NotFound": "NotFound", "AlreadyExists": "AlreadyExists",
+                   "Conflict": "Conflict", "Invalid": "Invalid",
+                   "Forbidden": "Forbidden"}
+_CODE_TO_HTTP = {"NotFound": 404, "AlreadyExists": 409, "Conflict": 409,
+                 "Invalid": 422, "Forbidden": 403}
+
+
+def resource_for(api_version: str, kind: str) -> str:
+    plural = _RESOURCES.get((api_version, kind))
+    if plural is None:
+        raise ApiError("Invalid", f"no resource mapping for "
+                                  f"{api_version}/{kind}")
+    return plural
+
+
+def api_path(api_version: str, kind: str, namespace: Optional[str] = None,
+             name: str = "", subresource: str = "") -> str:
+    """Kube REST path for a GVK: /api/v1/... for the core group,
+    /apis/{group}/{version}/... otherwise."""
+    plural = resource_for(api_version, kind)
+    prefix = f"/apis/{api_version}" if "/" in api_version \
+        else f"/api/{api_version}"
+    path = prefix
+    if namespace:
+        path += f"/namespaces/{namespace}"
+    path += f"/{plural}"
+    if name:
+        path += f"/{name}"
+    if subresource:
+        path += f"/{subresource}"
+    return path
+
+
+def _decode_as(data: dict, api_version: str, kind: str):
+    """Decode a kube object; list items arrive WITHOUT apiVersion/kind
+    (kube strips them inside *List), so inject the requested GVK."""
+    if not data.get("apiVersion"):
+        data = {**data, "apiVersion": api_version, "kind": kind}
+    return registry.decode(data)
+
+
+class KubeConfig:
+    """Connection parameters for a kube-apiserver."""
+
+    def __init__(self, server: str, token: str = "",
+                 ca_file: Optional[str] = None,
+                 insecure_skip_tls_verify: bool = False,
+                 namespace: str = ""):
+        self.server = server.rstrip("/")
+        self.token = token
+        self.ca_file = ca_file
+        self.insecure_skip_tls_verify = insecure_skip_tls_verify
+        self.namespace = namespace
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConfig":
+        """Pod-filesystem config: serviceaccount token + CA + namespace
+        (the rest.InClusterConfig analogue)."""
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError("not running in-cluster: "
+                               "KUBERNETES_SERVICE_HOST unset")
+        with open(os.path.join(SERVICE_ACCOUNT_DIR, "token")) as f:
+            token = f.read().strip()
+        ns_path = os.path.join(SERVICE_ACCOUNT_DIR, "namespace")
+        namespace = ""
+        if os.path.exists(ns_path):
+            with open(ns_path) as f:
+                namespace = f.read().strip()
+        return cls(server=f"https://{host}:{port}", token=token,
+                   ca_file=os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt"),
+                   namespace=namespace)
+
+    @classmethod
+    def from_kubeconfig(cls, path: str,
+                        context: Optional[str] = None) -> "KubeConfig":
+        """Minimal kubeconfig loader: current-context -> cluster server/CA
+        + user bearer token (token or tokenFile)."""
+        import base64
+        import tempfile
+
+        import yaml
+
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context", "")
+        ctx = next((c["context"] for c in cfg.get("contexts", [])
+                    if c["name"] == ctx_name), None)
+        if ctx is None:
+            raise RuntimeError(f"kubeconfig context {ctx_name!r} not found")
+        cluster = next(c["cluster"] for c in cfg["clusters"]
+                       if c["name"] == ctx["cluster"])
+        user = next((u["user"] for u in cfg.get("users", [])
+                     if u["name"] == ctx.get("user")), {})
+        ca_file = cluster.get("certificate-authority")
+        ca_data = cluster.get("certificate-authority-data")
+        if ca_data and not ca_file:
+            tmp = tempfile.NamedTemporaryFile("wb", suffix=".crt",
+                                              delete=False)
+            tmp.write(base64.b64decode(ca_data))
+            tmp.close()
+            ca_file = tmp.name
+        token = user.get("token", "")
+        if not token and user.get("tokenFile"):
+            with open(user["tokenFile"]) as f:
+                token = f.read().strip()
+        return cls(server=cluster["server"], token=token, ca_file=ca_file,
+                   insecure_skip_tls_verify=bool(
+                       cluster.get("insecure-skip-tls-verify")),
+                   namespace=ctx.get("namespace", ""))
+
+
+class _KubeWatch:
+    """Client side of a kube watch stream (Watch-compatible): streaming
+    GET ?watch=true, one JSON event per line, reconnect from the last seen
+    resourceVersion, BOOKMARK events consumed for progress only."""
+
+    def __init__(self, transport: "KubeApiServer", api_version: str,
+                 kind: str):
+        self._t = transport
+        self._api_version = api_version
+        self._kind = kind
+        self._rv: Optional[str] = None
+        self._q: "queue.Queue[WatchEvent]" = queue.Queue()
+        self.stopped = False
+        self._resp = None
+        self._connected = threading.Event()
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name=f"kube-watch-{kind}")
+        self._thread.start()
+
+    def wait_connected(self, timeout: float = 10.0) -> bool:
+        """True once the server has registered the stream (events from
+        that point on are delivered; earlier ones need list/resync)."""
+        return self._connected.wait(timeout)
+
+    def _url(self) -> str:
+        params = {"watch": "true", "allowWatchBookmarks": "true"}
+        if self._rv:
+            params["resourceVersion"] = self._rv
+        return (self._t.base
+                + api_path(self._api_version, self._kind)
+                + "?" + urllib.parse.urlencode(params))
+
+    def _pump(self) -> None:
+        import time
+        backoff = 0.2
+        while not self.stopped:
+            resp = None
+            try:
+                # Read timeout >> server keepalive: a silently dead peer
+                # surfaces as a timeout -> reconnect, not a hang.
+                resp = self._t._open("GET", self._url(), stream=True)
+                self._resp = resp
+                # Response headers received => the server has registered
+                # the watch; events from here on flow to this stream.
+                self._connected.set()
+                if self.stopped:
+                    return
+                backoff = 0.2
+                for raw in resp:
+                    if self.stopped:
+                        return
+                    line = raw.strip()
+                    if not line or line.startswith(b":"):
+                        continue
+                    ev = json.loads(line)
+                    obj_data = ev.get("object") or {}
+                    rv = (obj_data.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if rv:
+                        self._rv = rv
+                    if ev.get("type") == "BOOKMARK":
+                        continue
+                    if ev.get("type") == "ERROR":
+                        # 410 Gone etc: relist from scratch (the informer's
+                        # periodic resync heals the gap).
+                        self._rv = None
+                        break
+                    self._q.put(WatchEvent(
+                        ev["type"], _decode_as(obj_data, self._api_version,
+                                               self._kind)))
+            except Exception:
+                pass  # connection lost; fall through to reconnect
+            finally:
+                if resp is not None:
+                    try:
+                        resp.close()
+                    except Exception:
+                        pass
+            if self.stopped:
+                return
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self.stopped = True
+        try:
+            if self._resp is not None:
+                self._resp.close()
+        except Exception:
+            pass
+
+
+class KubeApiServer:
+    """ApiServer-interface proxy over real kube REST grammar — plug into
+    ``Clientset(server=KubeApiServer(config))``."""
+
+    def __init__(self, config: KubeConfig, timeout: float = 30.0):
+        self.config = config
+        self.base = config.server
+        self.timeout = timeout
+        self._ssl: Optional[ssl.SSLContext] = None
+        if self.base.startswith("https"):
+            if config.insecure_skip_tls_verify:
+                self._ssl = ssl.create_default_context()
+                self._ssl.check_hostname = False
+                self._ssl.verify_mode = ssl.CERT_NONE
+            else:
+                self._ssl = ssl.create_default_context(
+                    cafile=config.ca_file)
+
+    # -- plumbing ----------------------------------------------------------
+    def _open(self, method: str, url: str, body: Optional[bytes] = None,
+              stream: bool = False):
+        headers = {"Accept": "application/json"}
+        if body is not None:
+            headers["Content-Type"] = "application/json"
+        if self.config.token:
+            headers["Authorization"] = f"Bearer {self.config.token}"
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method=method)
+        timeout = 5.0 if stream else self.timeout
+        return urllib.request.urlopen(req, timeout=timeout,
+                                      context=self._ssl)
+
+    def _request(self, method: str, path: str, obj=None,
+                 params: Optional[dict] = None) -> dict:
+        url = self.base + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        body = None
+        if obj is not None:
+            body = json.dumps(registry.encode(obj)).encode()
+        try:
+            with self._open(method, url, body) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            raise self._to_api_error(exc) from None
+
+    @staticmethod
+    def _to_api_error(exc: urllib.error.HTTPError) -> ApiError:
+        try:
+            status = json.loads(exc.read())
+            reason = status.get("reason", "")
+            message = status.get("message", str(exc))
+        except Exception:
+            reason, message = "", str(exc)
+        code = _REASON_TO_CODE.get(reason)
+        if code is None:
+            code = {404: "NotFound", 409: "Conflict", 403: "Forbidden",
+                    422: "Invalid"}.get(exc.code, "Unknown")
+        return ApiError(code, message)
+
+    # -- ApiServer interface ----------------------------------------------
+    def create(self, obj):
+        data = self._request(
+            "POST", api_path(obj.api_version, obj.kind,
+                             obj.metadata.namespace), obj)
+        return _decode_as(data, obj.api_version, obj.kind)
+
+    def get(self, api_version: str, kind: str, namespace: str, name: str):
+        data = self._request(
+            "GET", api_path(api_version, kind, namespace, name))
+        return _decode_as(data, api_version, kind)
+
+    def list(self, api_version: str, kind: str,
+             namespace: Optional[str] = None,
+             label_selector: Optional[dict] = None) -> list:
+        params = {}
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items())
+        data = self._request("GET", api_path(api_version, kind, namespace),
+                             params=params or None)
+        return [_decode_as(item, api_version, kind)
+                for item in data.get("items", [])]
+
+    def update(self, obj, subresource: str = ""):
+        data = self._request(
+            "PUT", api_path(obj.api_version, obj.kind,
+                            obj.metadata.namespace, obj.metadata.name,
+                            subresource), obj)
+        return _decode_as(data, obj.api_version, obj.kind)
+
+    def delete(self, api_version: str, kind: str, namespace: str, name: str):
+        data = self._request(
+            "DELETE", api_path(api_version, kind, namespace, name))
+        if data.get("kind") == "Status":  # kube may return Status not object
+            return None
+        return _decode_as(data, api_version, kind)
+
+    def watch(self, api_version: str, kind: str) -> _KubeWatch:
+        w = _KubeWatch(self, api_version, kind)
+        # Block briefly until the stream is live: informers list AFTER
+        # watch, relying on "events since the watch started" — an
+        # unconnected stream would silently drop that window (healed only
+        # by the 30s resync).
+        w.wait_connected(timeout=10.0)
+        return w
+
+    # -- discovery ---------------------------------------------------------
+    def check_crd(self, name: str = "mpijobs.kubeflow.org") -> bool:
+        """CRD existence probe (reference: server.go:302-314 checkCRDExists
+        via apiextensions client)."""
+        try:
+            self._request(
+                "GET", "/apis/apiextensions.k8s.io/v1/"
+                       f"customresourcedefinitions/{name}")
+            return True
+        except ApiError:
+            return False
+
+
+def probe_is_kube(master_url: str, timeout: float = 5.0) -> bool:
+    """Grammar autodetect for --master: a kube-apiserver answers GET /apis
+    with an APIGroupList; the native ApiHttpServer 404s it."""
+    try:
+        req = urllib.request.Request(master_url.rstrip("/") + "/apis")
+        ctx = None
+        if master_url.startswith("https"):
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ctx) as resp:
+            return json.loads(resp.read()).get("kind") == "APIGroupList"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Hermetic fixture: kube path grammar over the in-memory store
+# ---------------------------------------------------------------------------
+
+class _Route:
+    def __init__(self, api_version: str, kind: str, namespace: Optional[str],
+                 name: str, subresource: str):
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+
+
+class _FixtureHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    @property
+    def store(self) -> ApiServer:
+        return self.server.store  # type: ignore[attr-defined]
+
+    # -- helpers -----------------------------------------------------------
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _status_error(self, http_code: int, reason: str,
+                      message: str) -> None:
+        # Faithful kube error body: a v1 Status object.
+        self._json(http_code, {
+            "kind": "Status", "apiVersion": "v1", "metadata": {},
+            "status": "Failure", "message": message, "reason": reason,
+            "code": http_code})
+
+    def _api_error(self, exc: ApiError) -> None:
+        self._status_error(_CODE_TO_HTTP.get(exc.code, 500), exc.code,
+                           exc.message)
+
+    def _authorized(self) -> bool:
+        token = self.server.token  # type: ignore[attr-defined]
+        if not token:
+            return True
+        header = self.headers.get("Authorization", "")
+        if header == f"Bearer {token}":
+            return True
+        self._status_error(401, "Unauthorized", "invalid bearer token")
+        return False
+
+    def _route(self):
+        parsed = urllib.parse.urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = urllib.parse.parse_qs(parsed.query)
+        route = self._parse_parts(parts)
+        return route, query, parts
+
+    @staticmethod
+    def _parse_parts(parts) -> Optional[_Route]:
+        """/api/v1/... or /apis/{group}/{version}/... with optional
+        namespaces/{ns} scoping, then {plural}[/{name}[/{subresource}]]."""
+        if not parts:
+            return None
+        if parts[0] == "api" and len(parts) >= 2:
+            gv, rest = parts[1], parts[2:]
+        elif parts[0] == "apis" and len(parts) >= 3:
+            gv, rest = f"{parts[1]}/{parts[2]}", parts[3:]
+        else:
+            return None
+        namespace: Optional[str] = None
+        if len(rest) >= 2 and rest[0] == "namespaces":
+            namespace, rest = rest[1], rest[2:]
+        if not rest:
+            return None
+        plural, rest = rest[0], rest[1:]
+        kind = _KINDS.get((gv, plural))
+        if kind is None:
+            return None
+        name = rest[0] if rest else ""
+        subresource = rest[1] if len(rest) > 1 else ""
+        return _Route(gv, kind, namespace, name, subresource)
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        return registry.decode(json.loads(self.rfile.read(length)))
+
+    @staticmethod
+    def _selector(query) -> Optional[dict]:
+        raw = query.get("labelSelector", [None])[0]
+        if not raw:
+            return None
+        out = {}
+        for part in raw.split(","):
+            key, _, val = part.partition("=")
+            out[key] = val
+        return out
+
+    # -- verbs -------------------------------------------------------------
+    def do_GET(self):
+        if not self._authorized():
+            return
+        route, query, parts = self._route()
+        # Discovery endpoints (enough for grammar autodetect + CRD check).
+        if parts == ["apis"]:
+            return self._json(200, {"kind": "APIGroupList",
+                                    "apiVersion": "v1", "groups": []})
+        if parts == ["version"]:
+            return self._json(200, {"major": "1", "minor": "29",
+                                    "gitVersion": "v1.29.0-fixture"})
+        if len(parts) == 5 and parts[:4] == [
+                "apis", "apiextensions.k8s.io", "v1",
+                "customresourcedefinitions"]:
+            crds = self.server.crds  # type: ignore[attr-defined]
+            if parts[4] in crds:
+                return self._json(200, {
+                    "kind": "CustomResourceDefinition",
+                    "apiVersion": "apiextensions.k8s.io/v1",
+                    "metadata": {"name": parts[4]}})
+            return self._status_error(
+                404, "NotFound",
+                f"customresourcedefinitions.apiextensions.k8s.io "
+                f"\"{parts[4]}\" not found")
+        if route is None:
+            return self._status_error(404, "NotFound",
+                                      f"no route for {self.path}")
+        try:
+            if route.name:
+                obj = self.store.get(route.api_version, route.kind,
+                                     route.namespace or "", route.name)
+                return self._json(200, registry.encode(obj))
+            if query.get("watch", ["false"])[0] == "true":
+                return self._stream_watch(route)
+            items = self.store.list(route.api_version, route.kind,
+                                    route.namespace, self._selector(query))
+            wire = []
+            for o in items:
+                item = registry.encode(o)
+                # Faithful: kube strips apiVersion/kind inside *List items.
+                item.pop("apiVersion", None)
+                item.pop("kind", None)
+                wire.append(item)
+            gv = route.api_version
+            return self._json(200, {
+                "kind": f"{route.kind}List", "apiVersion": gv,
+                "metadata": {"resourceVersion": "0"}, "items": wire})
+        except ApiError as exc:
+            return self._api_error(exc)
+
+    def do_POST(self):
+        if not self._authorized():
+            return
+        route, _, _ = self._route()
+        if route is None or route.name:
+            return self._status_error(404, "NotFound",
+                                      f"no route for {self.path}")
+        try:
+            obj = self._read_body()
+            if route.namespace and not obj.metadata.namespace:
+                obj.metadata.namespace = route.namespace
+            created = self.store.create(obj)
+            return self._json(201, registry.encode(created))
+        except ApiError as exc:
+            return self._api_error(exc)
+
+    def do_PUT(self):
+        if not self._authorized():
+            return
+        route, _, _ = self._route()
+        if route is None or not route.name:
+            return self._status_error(404, "NotFound",
+                                      f"no route for {self.path}")
+        try:
+            obj = self._read_body()
+            updated = self.store.update(
+                obj, "status" if route.subresource == "status" else "")
+            return self._json(200, registry.encode(updated))
+        except ApiError as exc:
+            return self._api_error(exc)
+
+    def do_DELETE(self):
+        if not self._authorized():
+            return
+        route, _, _ = self._route()
+        if route is None or not route.name:
+            return self._status_error(404, "NotFound",
+                                      f"no route for {self.path}")
+        try:
+            deleted = self.store.delete(route.api_version, route.kind,
+                                        route.namespace or "", route.name)
+            return self._json(200, registry.encode(deleted))
+        except ApiError as exc:
+            return self._api_error(exc)
+
+    def _stream_watch(self, route: _Route) -> None:
+        watch = self.store.watch(route.api_version, route.kind)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while not self.server.stopping:  # type: ignore[attr-defined]
+                ev = watch.next(timeout=0.5)
+                if ev is None:
+                    chunk = b": keepalive\n"
+                else:
+                    if route.namespace and \
+                            ev.obj.metadata.namespace != route.namespace:
+                        continue
+                    chunk = (json.dumps(
+                        {"type": ev.type,
+                         "object": registry.encode(ev.obj)}) + "\n").encode()
+                self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk
+                                 + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            watch.stop()
+
+
+class KubeFixtureServer:
+    """Serve the in-memory ApiServer over real kube path grammar."""
+
+    def __init__(self, store: Optional[ApiServer] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 token: str = "",
+                 crds: Optional[set] = None):
+        self.store = store or ApiServer()
+        self._http = ThreadingHTTPServer((host, port), _FixtureHandler)
+        self._http.store = self.store  # type: ignore[attr-defined]
+        self._http.stopping = False  # type: ignore[attr-defined]
+        self._http.token = token  # type: ignore[attr-defined]
+        self._http.crds = crds if crds is not None else {  # type: ignore
+            "mpijobs.kubeflow.org"}
+        self.token = token
+        self.port = self._http.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def client_config(self) -> KubeConfig:
+        return KubeConfig(server=self.url, token=self.token)
+
+    def start(self) -> "KubeFixtureServer":
+        self._thread = threading.Thread(target=self._http.serve_forever,
+                                        daemon=True, name="kube-fixture")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.stopping = True  # type: ignore[attr-defined]
+        self._http.shutdown()
+        self._http.server_close()

@@ -37,14 +37,21 @@ def parse_quantity(value) -> Fraction:
 
 
 def format_quantity(value: Fraction) -> str:
-    """Render a Fraction back to a canonical quantity string."""
+    """Render a Fraction back to a canonical quantity string.
+
+    Never produces scientific notation (str(float) renders 1e-07 for
+    sub-milli values, which a real apiserver rejects): exact m/u/n suffix
+    rendering first, then round UP to the nearest nano like Kubernetes'
+    canonicalization of sub-resolution quantities.
+    """
     if value.denominator == 1:
         return str(value.numerator)
-    milli = value * 1000
-    if milli.denominator == 1:
-        return f"{milli.numerator}m"
-    # Fall back to a decimal string with enough precision.
-    return str(float(value))
+    for mult, suffix in ((1000, "m"), (10**6, "u"), (10**9, "n")):
+        scaled = value * mult
+        if scaled.denominator == 1:
+            return f"{scaled.numerator}{suffix}"
+    nanos = -(-value.numerator * 10**9 // value.denominator)  # ceil
+    return f"{nanos}n"
 
 
 def add_resource_lists(a: dict | None, b: dict | None) -> dict:

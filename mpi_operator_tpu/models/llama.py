@@ -393,6 +393,15 @@ def generate(model: LlamaModel, variables, prompt_tokens,
 
     if max_new_tokens <= 0:
         return jnp.zeros((prompt_tokens.shape[0], 0), jnp.int32)
+    # Bound the cache: dynamic_update_slice CLAMPS an out-of-range start
+    # index, so writes past max_seq_len would silently overwrite the cache
+    # tail and degrade generation with no error.  Fail loudly instead.
+    total = prompt_tokens.shape[1] + max_new_tokens
+    if total > model.config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_tokens.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds max_seq_len "
+            f"{model.config.max_seq_len}")
     params = {"params": variables["params"]}
     if rng is None:
         rng = jax.random.PRNGKey(0)

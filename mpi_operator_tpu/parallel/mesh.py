@@ -64,6 +64,49 @@ def create_mesh(config: Optional[MeshConfig] = None, devices=None):
     return Mesh(np.asarray(devices).reshape(shape), AXIS_NAMES)
 
 
+def create_multislice_mesh(config: Optional[MeshConfig] = None,
+                           num_slices: int = 1, devices=None):
+    """Mesh spanning TPU slices: dp rides DCN (outer, across slices),
+    every other axis rides ICI (inner, within a slice).
+
+    On real multislice hardware jax devices carry slice_index and
+    mesh_utils.create_hybrid_device_mesh places them; on a flat device
+    set (CPU dryrun, single slice) the devices are grouped into
+    num_slices contiguous blocks — same topology, virtual slices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    config = config or MeshConfig()
+    if num_slices <= 1:
+        return create_mesh(config, devices)
+    if len(devices) % num_slices != 0:
+        raise ValueError(f"{len(devices)} devices not divisible by "
+                         f"{num_slices} slices")
+    per_slice = len(devices) // num_slices
+    shape = config.resolve(len(devices))
+    dp = shape[0]
+    if dp % num_slices != 0:
+        raise ValueError(
+            f"dp={dp} must be a multiple of num_slices={num_slices}: dp is "
+            f"the only DCN-friendly axis, so every slice boundary must land"
+            f" on it")
+    if all(hasattr(d, "slice_index") for d in devices):
+        from jax.experimental import mesh_utils
+        dcn = (num_slices,) + (1,) * (len(AXIS_NAMES) - 1)
+        ici = (dp // num_slices,) + shape[1:]
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices=devices)
+        return Mesh(mesh_devices, AXIS_NAMES)
+    # Virtual slices: contiguous per-slice blocks; dp's outer dimension
+    # iterates slices, its inner dimension iterates within a slice.
+    arr = np.asarray(devices).reshape((num_slices, per_slice))
+    arr = arr.reshape((num_slices, dp // num_slices) + shape[1:])
+    return Mesh(arr.reshape(shape), AXIS_NAMES)
+
+
 def batch_sharding(mesh, extra_dims: int = 1):
     """NamedSharding for [batch, ...]: batch over (dp, fsdp), rest
     replicated (activations within a layer get their own constraints)."""

@@ -60,19 +60,48 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
+def _wants_remote(opt: ServerOption) -> bool:
+    return bool(opt.master_url or opt.kubeconfig
+                or os.environ.get("KUBERNETES_SERVICE_HOST"))
+
+
+def build_api_transport(opt: ServerOption):
+    """Client construction (server.go:108,258-299 equivalent): kubeconfig,
+    explicit --master (kube or native grammar, autodetected by default),
+    or in-cluster serviceaccount config — in that precedence order."""
+    from ..k8s.kube_transport import (KubeApiServer, KubeConfig,
+                                      probe_is_kube)
+
+    if opt.kubeconfig:
+        cfg = KubeConfig.from_kubeconfig(opt.kubeconfig)
+        if opt.master_url:
+            cfg.server = opt.master_url.rstrip("/")
+        return KubeApiServer(cfg)
+    if opt.master_url:
+        grammar = opt.api_grammar
+        if grammar == "auto":
+            grammar = "kube" if probe_is_kube(opt.master_url) else "native"
+        if grammar == "native":
+            from ..k8s.http_api import RemoteApiServer
+            return RemoteApiServer(opt.master_url)
+        token = ""
+        if opt.token_file:
+            with open(opt.token_file) as f:
+                token = f.read().strip()
+        return KubeApiServer(KubeConfig(
+            server=opt.master_url, token=token, ca_file=opt.ca_file or None,
+            insecure_skip_tls_verify=opt.insecure_skip_tls_verify))
+    return KubeApiServer(KubeConfig.in_cluster())
+
+
 class OperatorApp:
     """app.Run equivalent (server.go:79-188)."""
 
     def __init__(self, opt: ServerOption, clientset: Optional[Clientset] = None):
         self.opt = opt
         if clientset is None:
-            if opt.master_url:
-                # --master: drive a remote API server over HTTP (the
-                # deployable topology; server.go:108 equivalent).
-                from ..k8s.http_api import RemoteApiServer
-                clientset = Clientset(server=RemoteApiServer(opt.master_url))
-            else:
-                clientset = Clientset()
+            clientset = Clientset(server=build_api_transport(opt)) \
+                if _wants_remote(opt) else Clientset()
         self.client = clientset
         self.metrics = new_operator_metrics()
         self.controller: Optional[MPIJobController] = None
@@ -93,7 +122,16 @@ class OperatorApp:
     # -- CRD existence check (server.go:121-124,302-314) --------------------
     def check_crd_exists(self) -> bool:
         """With the in-memory API server the MPIJob kind always exists;
-        against a real cluster this probes the discovery endpoint."""
+        against a real cluster this probes the CRD object itself
+        (server.go:302-314) and falls back to a list probe."""
+        from ..k8s.kube_transport import KubeApiServer
+        server = self.client.server
+        if isinstance(server, KubeApiServer):
+            if server.check_crd("mpijobs.kubeflow.org"):
+                return True
+            logger.error("CRD mpijobs.kubeflow.org not found; install "
+                         "manifests/base/kubeflow.org_mpijobs.yaml first")
+            return False
         try:
             self.client.mpi_jobs(self.opt.namespace or "default").list()
             return True

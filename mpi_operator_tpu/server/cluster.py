@@ -23,8 +23,11 @@ class LocalCluster:
                  cluster_domain: str = "",
                  namespace: Optional[str] = None,
                  threadiness: int = 2,
-                 run_pods: bool = True):
-        self.client = Clientset()
+                 run_pods: bool = True,
+                 client: Optional[Clientset] = None):
+        # An injected client lets the identical stack run over a remote
+        # transport (e.g. KubeApiServer against kube path grammar).
+        self.client = client or Clientset()
         pod_group_ctrl = new_pod_group_ctrl(gang_scheduler, self.client)
         self.controller = MPIJobController(
             self.client, pod_group_ctrl=pod_group_ctrl,

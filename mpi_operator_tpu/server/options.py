@@ -32,6 +32,13 @@ class ServerOption:
     kube_api_burst: int = 10
     controller_rate_limit: float = 10.0
     controller_burst: int = 100
+    # Transport selection for --master: "kube" = real kube-apiserver REST
+    # grammar, "native" = the framework's own ApiHttpServer protocol,
+    # "auto" = probe GET /apis (an APIGroupList means kube).
+    api_grammar: str = "auto"
+    token_file: str = ""
+    ca_file: str = ""
+    insecure_skip_tls_verify: bool = False
 
 
 def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -65,6 +72,17 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--kube-api-burst", type=int, default=10)
     parser.add_argument("--controller-rate-limit", type=float, default=10.0)
     parser.add_argument("--controller-burst", type=int, default=100)
+    parser.add_argument("--api-grammar", dest="api_grammar", default="auto",
+                        choices=("auto", "kube", "native"),
+                        help="Wire protocol for --master: real kube REST"
+                             " grammar, the native protocol, or autodetect.")
+    parser.add_argument("--token-file", dest="token_file", default="",
+                        help="Bearer token file for the kube transport.")
+    parser.add_argument("--ca-file", dest="ca_file", default="",
+                        help="CA bundle for the kube transport.")
+    parser.add_argument("--insecure-skip-tls-verify", action="store_true",
+                        dest="insecure_skip_tls_verify",
+                        help="Skip TLS verification (kube transport).")
 
 
 def parse_options(argv=None) -> ServerOption:

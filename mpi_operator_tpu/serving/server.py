@@ -62,7 +62,10 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class InferenceServer:
-    def __init__(self, model, variables, host: str = "0.0.0.0",
+    # Loopback by default, like RemoteApiServer (k8s/http_api.py):
+    # /generate is unauthenticated and compute-expensive, so exposing it
+    # on all interfaces must be an explicit opt-in (host="0.0.0.0").
+    def __init__(self, model, variables, host: str = "127.0.0.1",
                  port: int = 0):
         self.model = model
         self.variables = variables

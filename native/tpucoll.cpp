@@ -86,6 +86,16 @@ int recv_all(int fd, void* buf, size_t n) {
 // concurrently via poll.  Every rank sends right while receiving left; a
 // naive send-then-recv deadlocks once a chunk exceeds the combined
 // socket buffering, so ring steps MUST use this.
+//
+// The sockets themselves stay in blocking mode (the rendezvous/broadcast
+// paths want blocking semantics), so every transfer here passes
+// MSG_DONTWAIT: a blocking send() on SOCK_STREAM does not return after a
+// partial write — it blocks until the whole requested buffer is queued,
+// which would stall the recv side and reintroduce exactly the distributed
+// deadlock this function exists to prevent once a chunk exceeds
+// sndbuf + peer rcvbuf.  With MSG_DONTWAIT each poll-ready call returns a
+// partial transfer (or EAGAIN on a spurious wakeup) and the loop genuinely
+// interleaves both directions.
 int send_recv(int out_fd, const void* sbuf, size_t sn, int in_fd, void* rbuf,
               size_t rn) {
   const char* sp = static_cast<const char*>(sbuf);
@@ -107,9 +117,11 @@ int send_recv(int out_fd, const void* sbuf, size_t sn, int in_fd, void* rbuf,
       return -1;
     }
     if (send_idx >= 0 && (fds[send_idx].revents & (POLLOUT | POLLERR))) {
-      ssize_t w = ::send(out_fd, sp, sn, 0);
+      ssize_t w = ::send(out_fd, sp, sn, MSG_DONTWAIT | MSG_NOSIGNAL);
       if (w <= 0) {
-        if (w < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+        if (w < 0 && (errno == EINTR || errno == EAGAIN ||
+                      errno == EWOULDBLOCK))
+          continue;
         return -1;
       }
       sp += w;
@@ -117,9 +129,11 @@ int send_recv(int out_fd, const void* sbuf, size_t sn, int in_fd, void* rbuf,
     }
     if (recv_idx >= 0 &&
         (fds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t r = ::recv(in_fd, rp, rn, 0);
+      ssize_t r = ::recv(in_fd, rp, rn, MSG_DONTWAIT);
       if (r <= 0) {
-        if (r < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+        if (r < 0 && (errno == EINTR || errno == EAGAIN ||
+                      errno == EWOULDBLOCK))
+          continue;
         return -1;
       }
       rp += r;

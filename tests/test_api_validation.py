@@ -144,3 +144,34 @@ def test_negative_slots_rejected():
     job.spec.slots_per_worker = -1
     errs = validate_mpijob(job)
     assert any("slotsPerWorker" in e.field for e in errs)
+
+
+def test_multislice_validation():
+    job = valid_job(workers=4, impl=constants.IMPL_JAX)
+    job.spec.slices = 2
+    assert validate_mpijob(job) == []
+
+    job.spec.slices = 3  # 4 workers not divisible by 3
+    errs = validate_mpijob(job)
+    assert any("slices" in e.field and "divisible" in e.message
+               for e in errs)
+
+    job.spec.slices = 0
+    errs = validate_mpijob(job)
+    assert any("slices" in e.field for e in errs)
+
+
+def test_multislice_requires_jax():
+    job = valid_job(workers=4, impl=constants.IMPL_OPENMPI)
+    job.spec.slices = 2
+    errs = validate_mpijob(job)
+    assert any("slices" in e.field and "JAX" in e.message for e in errs)
+
+
+def test_multislice_rejects_run_launcher_as_worker():
+    job = valid_job(workers=4, impl=constants.IMPL_JAX)
+    job.spec.slices = 2
+    job.spec.run_launcher_as_worker = True
+    errs = validate_mpijob(job)
+    assert any("slices" in e.field and "runLauncherAsWorker" in e.message
+               for e in errs)

@@ -151,6 +151,7 @@ def test_jax_implementation_injects_coordinator_env_and_skips_ssh():
         assert env[constants.JAX_PROCESS_ID_ENV] == str(i)
         assert env[constants.JAX_NUM_PROCESSES_ENV] == "2"
         assert env[constants.JAX_LOCAL_DEVICE_COUNT_ENV] == "4"
+        assert float(env[constants.MPIJOB_SUBMIT_TIME_ENV]) > 0
         # workers keep the image entrypoint (no sshd default)
         assert pod.spec.containers[0].command == []
         assert not any(v.name == builders.SSH_AUTH_VOLUME
@@ -619,3 +620,42 @@ def test_unsuspend_launcher_update_failure_does_not_poison_cache():
     assert cached.spec.suspend is True
     stored_launcher = f.client.jobs("default").get("test-launcher")
     assert stored_launcher.spec.suspend is True
+
+
+# ---------------------------------------------------------------------------
+# Ownership strictness (jobPods, ref :1694-1710)
+# ---------------------------------------------------------------------------
+
+def test_launcher_pods_exclude_orphans_with_warning():
+    """Selector-matching pods without a controller owner are NOT adopted
+    (metav1.IsControlledBy strictness) and surface a warning event."""
+    from mpi_operator_tpu.k8s import batch
+    from mpi_operator_tpu.k8s.meta import new_controller_ref
+
+    f = Fixture()
+    launcher = batch.Job(
+        metadata=ObjectMeta(name="test-launcher", namespace="default",
+                            uid="launcher-uid"),
+        spec=batch.JobSpec(
+            selector=batch.LabelSelector(match_labels={"job-name": "test"})))
+
+    owned = core.Pod(metadata=ObjectMeta(
+        name="owned", namespace="default", labels={"job-name": "test"},
+        owner_references=[new_controller_ref(launcher, "batch/v1", "Job")]))
+    orphan = core.Pod(metadata=ObjectMeta(
+        name="orphan", namespace="default", labels={"job-name": "test"}))
+    foreign_ref = new_controller_ref(launcher, "batch/v1", "Job")
+    foreign_ref.uid = "someone-else"
+    foreign = core.Pod(metadata=ObjectMeta(
+        name="foreign", namespace="default", labels={"job-name": "test"},
+        owner_references=[foreign_ref]))
+    for p in (owned, orphan, foreign):
+        f.factory.pods().add_to_cache(p)
+
+    pods = f.controller._launcher_pods(launcher)
+    assert [p.metadata.name for p in pods] == ["owned"]
+    assert any("OrphanPod" in e and "orphan" in e
+               for e in f.recorder.events), f.recorder.events
+    # the foreign-owned pod is excluded silently (owned by another
+    # controller, not an adoption candidate)
+    assert not any("foreign" in e for e in f.recorder.events)

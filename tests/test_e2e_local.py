@@ -111,6 +111,12 @@ def test_e2e_jax_pi_process_group():
         pi = float(pi_line.split("pi=")[1])
         assert abs(pi - 3.14159) < 0.05, logs
         assert done.status.completion_time is not None
+        # submit -> first collective latency is reported (BASELINE.md's
+        # second target metric, via the injected MPIJOB_SUBMIT_TIME)
+        lat_line = [l for l in logs.splitlines()
+                    if l.startswith("launch_to_first_allreduce_seconds=")]
+        assert lat_line, logs
+        assert 0 < float(lat_line[0].split("=")[1]) < 240
 
 
 def test_e2e_elastic_scale_down_and_up():

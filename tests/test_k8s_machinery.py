@@ -206,6 +206,21 @@ def test_quantity_parsing():
     assert parse_quantity("1k") == 1000
 
 
+def test_quantity_formatting_sub_milli():
+    """Sub-milli quantities (reachable via n/u suffixes) must render as
+    valid Kubernetes quantities, never scientific notation like 1e-07."""
+    from mpi_operator_tpu.k8s.quantity import format_quantity
+    assert format_quantity(parse_quantity("100n")) == "100n"
+    assert format_quantity(parse_quantity("5u")) == "5u"
+    assert format_quantity(parse_quantity("1500n")) == "1500n"
+    # Sub-nano rounds UP to the nearest nano (k8s canonicalization).
+    from fractions import Fraction
+    assert format_quantity(Fraction(1, 10**10)) == "1n"
+    assert "e" not in format_quantity(Fraction(1, 10**7))
+    total = add_resource_lists({"cpu": "100n"}, {"cpu": "200n"})
+    assert total["cpu"] == "300n"
+
+
 def test_add_resource_lists():
     total = add_resource_lists({"cpu": "100m", "memory": "1Gi"},
                                {"cpu": "900m", "google.com/tpu": "4"})

@@ -1,0 +1,280 @@
+"""Kube REST transport tests: real kube path grammar end-to-end.
+
+The `KubeApiServer` transport must drive the identical controller stack
+the in-memory substrate does — parity with client construction in the
+reference (/root/reference/cmd/mpi-operator/app/server.go:108,258-314),
+validated hermetically against `KubeFixtureServer` (envtest analogue)
+speaking genuine kube paths, Status errors and watch streams.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.k8s.apiserver import ApiError, ApiServer, Clientset
+from mpi_operator_tpu.k8s.core import Pod, PodSpec, Container
+from mpi_operator_tpu.k8s.kube_transport import (KubeApiServer, KubeConfig,
+                                                 KubeFixtureServer, api_path,
+                                                 probe_is_kube)
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+
+@pytest.fixture()
+def fixture_server():
+    srv = KubeFixtureServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def kube_client(fixture_server):
+    return Clientset(server=KubeApiServer(fixture_server.client_config()))
+
+
+def _pod(name, ns="default", labels=None):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                   labels=labels or {}),
+               spec=PodSpec(containers=[Container(name="c", image="img")]))
+
+
+# --- path grammar ---------------------------------------------------------
+
+def test_api_path_core_group():
+    assert api_path("v1", "Pod", "ns1", "p0") == \
+        "/api/v1/namespaces/ns1/pods/p0"
+    assert api_path("v1", "Pod") == "/api/v1/pods"
+
+
+def test_api_path_named_groups():
+    assert api_path("kubeflow.org/v2beta1", "MPIJob", "team-a") == \
+        "/apis/kubeflow.org/v2beta1/namespaces/team-a/mpijobs"
+    assert api_path("batch/v1", "Job", "ns", "j", "status") == \
+        "/apis/batch/v1/namespaces/ns/jobs/j/status"
+    assert api_path("scheduling.volcano.sh/v1beta1", "PodGroup", "ns") == \
+        "/apis/scheduling.volcano.sh/v1beta1/namespaces/ns/podgroups"
+
+
+# --- CRUD over the wire ---------------------------------------------------
+
+def test_kube_crud_roundtrip(kube_client):
+    pods = kube_client.pods("default")
+    created = pods.create(_pod("p0", labels={"app": "x"}))
+    assert created.metadata.uid and created.metadata.resource_version
+
+    got = pods.get("p0")
+    assert got.spec.containers[0].image == "img"
+
+    got.metadata.labels["extra"] = "y"
+    updated = pods.update(got)
+    assert updated.metadata.labels["extra"] == "y"
+
+    assert [p.metadata.name for p in pods.list()] == ["p0"]
+    pods.delete("p0")
+    with pytest.raises(ApiError) as exc:
+        pods.get("p0")
+    assert exc.value.code == "NotFound"
+
+
+def test_kube_label_selector_list(kube_client):
+    pods = kube_client.pods("default")
+    pods.create(_pod("a", labels={"role": "worker"}))
+    pods.create(_pod("b", labels={"role": "launcher"}))
+    names = [p.metadata.name
+             for p in pods.list(label_selector={"role": "worker"})]
+    assert names == ["a"]
+
+
+def test_kube_conflict_and_already_exists(kube_client):
+    pods = kube_client.pods("default")
+    pods.create(_pod("p0"))
+    with pytest.raises(ApiError) as exc:
+        pods.create(_pod("p0"))
+    assert exc.value.code == "AlreadyExists"
+
+    stale = pods.get("p0")
+    fresh = pods.get("p0")
+    fresh.metadata.labels["v"] = "2"
+    pods.update(fresh)
+    stale.metadata.labels["v"] = "stale"
+    with pytest.raises(ApiError) as exc:
+        pods.update(stale)
+    assert exc.value.code == "Conflict"
+
+
+def test_kube_status_subresource(kube_client):
+    from mpi_operator_tpu.api.defaults import set_defaults_mpijob
+    from mpi_operator_tpu.sdk.builders import new_jax_job
+
+    job = new_jax_job("j0", image="img", command=["true"], workers=1)
+    set_defaults_mpijob(job)
+    jobs = kube_client.mpi_jobs("default")
+    created = jobs.create(job)
+
+    created.status.start_time = None
+    created.spec.run_policy.suspend = True  # spec change via status path
+    from mpi_operator_tpu.api.types import JobCondition
+    created.status.conditions = [JobCondition(
+        type=constants.JOB_CREATED, status="True", reason="r", message="m")]
+    updated = jobs.update_status(created)
+    assert updated.status.conditions[0].type == constants.JOB_CREATED
+    # status subresource must NOT write spec
+    assert not jobs.get("j0").spec.run_policy.suspend
+
+
+def test_kube_secret_base64_roundtrip(kube_client):
+    from mpi_operator_tpu.k8s.core import Secret
+    sec = Secret(metadata=ObjectMeta(name="s", namespace="default"),
+                 data={"key": b"\x00\x01binary"})
+    kube_client.secrets("default").create(sec)
+    got = kube_client.secrets("default").get("s")
+    assert got.data["key"] == b"\x00\x01binary"
+
+
+def test_kube_watch_stream(kube_client, fixture_server):
+    watch = kube_client.pods("default").watch()
+    try:
+        kube_client.pods("default").create(_pod("w0"))
+        ev = watch.next(timeout=10)
+        assert ev is not None and ev.type == "ADDED"
+        assert ev.obj.metadata.name == "w0"
+        kube_client.pods("default").delete("w0")
+        seen = []
+        for _ in range(10):
+            ev = watch.next(timeout=10)
+            if ev is None:
+                break
+            seen.append(ev.type)
+            if ev.type == "DELETED":
+                break
+        assert "DELETED" in seen
+    finally:
+        watch.stop()
+
+
+def test_kube_list_items_lack_gvk_but_decode(fixture_server, kube_client):
+    """Faithful kube detail: list items carry no apiVersion/kind on the
+    wire; the transport injects the requested GVK before decoding."""
+    kube_client.pods("default").create(_pod("p0"))
+    raw = urllib.request.urlopen(
+        fixture_server.url + "/api/v1/namespaces/default/pods", timeout=10)
+    body = json.loads(raw.read())
+    assert body["kind"] == "PodList"
+    assert "apiVersion" not in body["items"][0]
+    pods = kube_client.pods("default").list()
+    assert pods[0].kind == "Pod" and pods[0].api_version == "v1"
+
+
+def test_kube_bearer_token_auth():
+    srv = KubeFixtureServer(token="sekrit").start()
+    try:
+        bad = Clientset(server=KubeApiServer(
+            KubeConfig(server=srv.url, token="wrong")))
+        with pytest.raises(ApiError):
+            bad.pods("default").list()
+        good = Clientset(server=KubeApiServer(srv.client_config()))
+        assert good.pods("default").list() == []
+    finally:
+        srv.stop()
+
+
+def test_kube_error_body_is_status_object(fixture_server):
+    """Errors must be kube v1 Status objects, not ad-hoc JSON."""
+    try:
+        urllib.request.urlopen(
+            fixture_server.url + "/api/v1/namespaces/default/pods/nope",
+            timeout=10)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as exc:
+        body = json.loads(exc.read())
+        assert body["kind"] == "Status" and body["reason"] == "NotFound"
+        assert body["code"] == 404
+
+
+def test_crd_check_and_probe(fixture_server):
+    transport = KubeApiServer(fixture_server.client_config())
+    assert transport.check_crd("mpijobs.kubeflow.org")
+    assert not transport.check_crd("does-not-exist.kubeflow.org")
+    assert probe_is_kube(fixture_server.url)
+
+
+def test_probe_rejects_native_server():
+    from mpi_operator_tpu.k8s.http_api import ApiHttpServer
+    srv = ApiHttpServer().start()
+    try:
+        assert not probe_is_kube(srv.url)
+    finally:
+        srv.stop()
+
+
+def test_kubeconfig_loader(tmp_path):
+    token_file = tmp_path / "token"
+    token_file.write_text("tok-from-file\n")
+    kc = tmp_path / "config"
+    kc.write_text(f"""
+apiVersion: v1
+kind: Config
+current-context: main
+contexts:
+- name: main
+  context:
+    cluster: c1
+    user: u1
+    namespace: team-a
+clusters:
+- name: c1
+  cluster:
+    server: https://10.0.0.1:6443
+    insecure-skip-tls-verify: true
+users:
+- name: u1
+  user:
+    tokenFile: {token_file}
+""")
+    cfg = KubeConfig.from_kubeconfig(str(kc))
+    assert cfg.server == "https://10.0.0.1:6443"
+    assert cfg.token == "tok-from-file"
+    assert cfg.insecure_skip_tls_verify
+    assert cfg.namespace == "team-a"
+
+
+def test_build_api_transport_autodetect(fixture_server):
+    from mpi_operator_tpu.server.app import build_api_transport
+    from mpi_operator_tpu.server.options import ServerOption
+    transport = build_api_transport(
+        ServerOption(master_url=fixture_server.url))
+    assert isinstance(transport, KubeApiServer)
+
+    from mpi_operator_tpu.k8s.http_api import ApiHttpServer, RemoteApiServer
+    native = ApiHttpServer().start()
+    try:
+        transport = build_api_transport(ServerOption(master_url=native.url))
+        assert isinstance(transport, RemoteApiServer)
+    finally:
+        native.stop()
+
+
+# --- the controller stack over the kube grammar ---------------------------
+
+def test_e2e_controller_over_kube_transport(fixture_server):
+    """The identical LocalCluster stack (controller + job controller +
+    kubelet), but every API call rides the kube wire format — the driver
+    proof that the operator works against a kube-grammar apiserver."""
+    import sys
+    client = Clientset(server=KubeApiServer(fixture_server.client_config()))
+    from mpi_operator_tpu.server import LocalCluster
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_e2e_local import jax_job
+
+    with LocalCluster(client=client) as cluster:
+        job = jax_job(
+            "kube-e2e",
+            launcher_cmd=[sys.executable, "-c", "print('pi-done')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=1)
+        cluster.submit(job)
+        cluster.wait_for_condition("default", "kube-e2e",
+                                   constants.JOB_SUCCEEDED, timeout=90)
+        assert "pi-done" in cluster.launcher_logs("default", "kube-e2e")

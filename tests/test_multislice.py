@@ -1,0 +1,172 @@
+"""Multislice (DCN) tests: megascale env injection, virtual-slice mesh,
+and a 2-slice process group formed on CPU.
+
+No reference counterpart file — this is the TPU-native elastic/DCN design
+target from SURVEY.md §2.3/§5 (the reference scales processes over
+SSH/hostfiles; TPU scales slices over DCN with the same
+coordinator-injection pattern).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.server import LocalCluster
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_e2e_local import jax_job  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- controller env injection --------------------------------------------
+
+def test_controller_injects_megascale_env():
+    with LocalCluster(run_pods=False) as cluster:
+        sleep = [sys.executable, "-c", "import time; time.sleep(30)"]
+        job = jax_job("ms", launcher_cmd=sleep, worker_cmd=sleep, workers=4)
+        job.spec.slices = 2
+        cluster.submit(job)
+
+        import time
+        deadline = time.monotonic() + 20
+        pods = []
+        while time.monotonic() < deadline:
+            pods = cluster.client.pods("default").list(
+                {"training.kubeflow.org/job-role": "worker"})
+            if len(pods) == 4:
+                break
+            time.sleep(0.1)
+        assert len(pods) == 4
+
+        by_name = {}
+        for pod in pods:
+            env = {e.name: e.value for e in pod.spec.containers[0].env}
+            by_name[pod.metadata.name] = env
+            assert env[constants.MEGASCALE_NUM_SLICES_ENV] == "2"
+            assert env[constants.MEGASCALE_COORDINATOR_ADDRESS_ENV] == \
+                f"ms-worker-0.ms.default.svc:{constants.DEFAULT_MEGASCALE_PORT}"
+        # 4 workers / 2 slices: workers 0-1 -> slice 0, workers 2-3 -> 1
+        for i in range(4):
+            env = by_name[f"ms-worker-{i}"]
+            assert env[constants.MEGASCALE_SLICE_ID_ENV] == str(i // 2), \
+                (i, env)
+
+
+def test_single_slice_jobs_get_no_megascale_env():
+    with LocalCluster(run_pods=False) as cluster:
+        sleep = [sys.executable, "-c", "import time; time.sleep(30)"]
+        job = jax_job("ss", launcher_cmd=sleep, worker_cmd=sleep, workers=2)
+        cluster.submit(job)
+        import time
+        deadline = time.monotonic() + 20
+        pods = []
+        while time.monotonic() < deadline:
+            pods = cluster.client.pods("default").list(
+                {"training.kubeflow.org/job-role": "worker"})
+            if len(pods) == 2:
+                break
+            time.sleep(0.1)
+        env = {e.name for e in pods[0].spec.containers[0].env}
+        assert constants.MEGASCALE_SLICE_ID_ENV not in env
+
+
+# --- virtual-slice mesh ---------------------------------------------------
+
+def test_multislice_mesh_topology_and_collectives():
+    """dp's outer dimension iterates slices (DCN), inner axes stay within
+    a slice (ICI); a psum over the full mesh still sums everything."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi_operator_tpu.parallel.mesh import (MeshConfig,
+                                                create_multislice_mesh)
+
+    devices = jax.devices()[:8]
+    mesh = create_multislice_mesh(MeshConfig(dp=4, tp=2), num_slices=2,
+                                  devices=devices)
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+
+    # Slice boundary lands on dp: the first half of dp rows must be
+    # exactly slice 0's devices (contiguous virtual slice blocks).
+    arr = mesh.devices.reshape(4, -1)
+    first_slice = {d.id for d in np.asarray(devices[:4]).ravel()}
+    assert {d.id for d in arr[:2].ravel()} == first_slice
+
+    x = jnp.arange(8.0)
+    sharded = jax.device_put(x, NamedSharding(mesh, P(("dp",))))
+
+    @jax.jit
+    def global_sum(v):
+        return jnp.sum(v)
+
+    assert float(global_sum(sharded)) == float(np.arange(8.0).sum())
+
+
+def test_multislice_mesh_rejects_bad_dp():
+    import jax
+    import pytest
+
+    from mpi_operator_tpu.parallel.mesh import (MeshConfig,
+                                                create_multislice_mesh)
+    with pytest.raises(ValueError, match="multiple of num_slices"):
+        create_multislice_mesh(MeshConfig(dp=1, tp=4, sp=2), num_slices=2,
+                               devices=jax.devices()[:8])
+
+
+# --- 2-slice process group on CPU -----------------------------------------
+
+def test_e2e_two_slice_group_forms_on_cpu(tmp_path):
+    """Four worker processes in two virtual slices form ONE
+    jax.distributed group and allreduce their slice ids — proving the
+    DCN coordinator pattern end-to-end on CPU devices.  Workers drop a
+    sentinel file on success; the launcher (which gates MPIJob
+    completion) waits for all four, so worker pods are never reaped
+    mid-collective."""
+    done_dir = str(tmp_path)
+    script = (
+        "import os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from mpi_operator_tpu.bootstrap import (initialize_from_env,\n"
+        "                                        process_env)\n"
+        "env = process_env()\n"
+        "assert env.is_multislice and env.num_slices == 2, env\n"
+        "initialize_from_env()\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import multihost_utils\n"
+        "ids = multihost_utils.process_allgather(\n"
+        "    jnp.array([float(env.slice_id)]))\n"
+        "msg = (f'SLICE-OK id={env.slice_id}'\n"
+        "       f' sum={float(ids.sum()):.0f} world={jax.process_count()}')\n"
+        "print(msg)\n"
+        "open(os.path.join(%r, f'ok-{env.process_id}'), 'w').write(msg)\n"
+        % (REPO_ROOT, done_dir))
+    launcher_script = (
+        "import os, time\n"
+        "deadline = time.monotonic() + 220\n"
+        "while time.monotonic() < deadline:\n"
+        "    if len([f for f in os.listdir(%r)\n"
+        "            if f.startswith('ok-')]) == 4:\n"
+        "        print('ALL-WORKERS-DONE')\n"
+        "        raise SystemExit(0)\n"
+        "    time.sleep(0.5)\n"
+        "raise SystemExit(1)\n" % done_dir)
+    with LocalCluster() as cluster:
+        job = jax_job("ms2",
+                      launcher_cmd=[sys.executable, "-c", launcher_script],
+                      worker_cmd=[sys.executable, "-c", script],
+                      workers=4)
+        job.spec.slices = 2
+        cluster.submit(job)
+        cluster.wait_for_condition("default", "ms2",
+                                   constants.JOB_SUCCEEDED, timeout=240)
+    sentinels = sorted(os.listdir(done_dir))
+    assert sentinels == ["ok-0", "ok-1", "ok-2", "ok-3"], sentinels
+    # every worker formed the 4-process group; slice sum = 0+0+1+1 = 2
+    for name in sentinels:
+        content = open(os.path.join(done_dir, name)).read()
+        assert "sum=2 world=4" in content, content

@@ -105,13 +105,17 @@ def test_e2e_operator_runs_native_pi(build_dir):
 
 
 def test_large_buffer_allreduce_no_deadlock(build_dir):
-    """Regression: 1M doubles/rank (2MB chunks at world=4) exceeds socket
-    buffering — requires full-duplex ring exchange."""
+    """Regression: 8M doubles/rank (16MB chunks at world=4) exceeds socket
+    buffering even with TCP autotuning (tcp_wmem max defaults to ~4-6MB) —
+    requires genuinely full-duplex ring exchange.  A blocking send() on
+    SOCK_STREAM queues the ENTIRE buffer before returning, so without
+    MSG_DONTWAIT inside send_recv every rank wedges in send() and the ring
+    deadlocks here."""
     script = (
         "import sys; sys.path.insert(0, %r)\n"
         "from mpi_operator_tpu.native import Collective\n"
         "c = Collective()\n"
-        "n = 1_000_000\n"
+        "n = 8_000_000\n"
         "out = c.allreduce([float(c.rank)] * n)\n"
         "expected = float(sum(range(c.world)))\n"
         "assert out[0] == expected and out[-1] == expected, out[:3]\n"

@@ -119,3 +119,94 @@ def test_generated_manifests_have_no_drift(tmp_path):
         with open(os.path.join(tmp_path, rel)) as f:
             regenerated = f.read()
         assert checked_in == regenerated, f"drift in {rel}"
+
+
+# ---------------------------------------------------------------------------
+# Structural schema validation (kubectl --validate=strict analogue)
+# ---------------------------------------------------------------------------
+
+def test_crd_schema_covers_pod_template():
+    """The generated schema must model the error-prone PodTemplateSpec
+    parts (containers/resources/env/volumes) instead of punting to
+    x-kubernetes-preserve-unknown-fields (reference CRD embeds the full
+    PodTemplateSpec schema)."""
+    crd = mpijob_crd()
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    tmpl = schema["properties"]["spec"]["properties"]["mpiReplicaSpecs"][
+        "additionalProperties"]["properties"]["template"]
+    pod_spec = tmpl["properties"]["spec"]
+    containers = pod_spec["properties"]["containers"]["items"]
+    assert "resources" in containers["properties"]
+    res = containers["properties"]["resources"]["properties"]["limits"]
+    assert res["additionalProperties"] == {"x-kubernetes-int-or-string": True}
+    env_item = containers["properties"]["env"]["items"]
+    assert "valueFrom" in env_item["properties"]
+    vols = pod_spec["properties"]["volumes"]["items"]["properties"]
+    assert "configMap" in vols and "persistentVolumeClaim" in vols
+
+
+@pytest.mark.parametrize("name", ["jax-pi", "pi-native", "mnist",
+                                  "resnet-benchmark", "llama-2-7b"])
+def test_examples_pass_strict_schema_validation(name):
+    from mpi_operator_tpu.codegen.schema_validate import validate_mpijob_dict
+    with open(os.path.join(REPO_ROOT, "examples", "v2beta1",
+                           f"{name}.yaml")) as f:
+        doc = yaml.safe_load(f)
+    assert validate_mpijob_dict(doc) == []
+
+
+def test_strict_schema_rejects_misspelled_resources():
+    from mpi_operator_tpu.codegen.schema_validate import validate_mpijob_dict
+    with open(os.path.join(REPO_ROOT, "examples", "v2beta1",
+                           "jax-pi.yaml")) as f:
+        doc = yaml.safe_load(f)
+    c = doc["spec"]["mpiReplicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"][0]
+    c["resource"] = c.pop("resources", {"limits": {"cpu": 1}})
+    errors = validate_mpijob_dict(doc)
+    assert any("unknown field 'resource'" in e for e in errors), errors
+
+
+def test_strict_schema_rejects_bad_types_and_enums():
+    from mpi_operator_tpu.codegen.schema_validate import validate_mpijob_dict
+    doc = {
+        "apiVersion": "kubeflow.org/v2beta1", "kind": "MPIJob",
+        "metadata": {"name": "x"},
+        "spec": {
+            "mpiImplementation": "Slurm",        # invalid enum
+            "slotsPerWorker": "two",             # wrong type
+            "mpiReplicaSpecs": {"Worker": {
+                "replicas": 2,
+                "template": {"spec": {"containers": [
+                    {"name": "w", "image": "i",
+                     "resources": {"limits": {"cpu": {"nested": True}}}},
+                ]}}}},
+        },
+    }
+    errors = validate_mpijob_dict(doc)
+    assert any("not one of" in e for e in errors), errors
+    assert any("slotsPerWorker" in e for e in errors), errors
+    assert any("int-or-string" in e for e in errors), errors
+
+
+def test_cli_validate_verb(tmp_path):
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    good = os.path.join(REPO_ROOT, "examples", "v2beta1", "jax-pi.yaml")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu", "validate", "-f", good],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT, timeout=60)
+    assert proc.returncode == 0 and "valid" in proc.stdout
+
+    with open(good) as f:
+        doc = yaml.safe_load(f)
+    doc["spec"]["runPolicy"] = {"cleanPodPolicy": "Sometimes"}
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump(doc))
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu", "validate", "-f",
+         str(bad)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT, timeout=60)
+    assert proc.returncode == 1 and "INVALID" in proc.stdout

@@ -63,6 +63,23 @@ def test_generate_endpoint_bad_request(served):
     assert status == 400 and "error" in body
 
 
+def test_generate_rejects_overlong_request(served):
+    """prompt + max_new_tokens past max_seq_len must 400, not silently
+    wrap the KV cache (dynamic_update_slice clamps out-of-range starts)."""
+    server, _, _, cfg = served
+    status, body = _post(
+        server.url + "/generate",
+        {"tokens": [[1, 2, 3, 4]], "max_new_tokens": cfg.max_seq_len})
+    assert status == 400 and "max_seq_len" in body["error"]
+
+
+def test_server_defaults_to_loopback():
+    """Unauthenticated /generate must not bind all interfaces by default."""
+    import inspect
+    sig = inspect.signature(InferenceServer.__init__)
+    assert sig.parameters["host"].default == "127.0.0.1"
+
+
 def test_healthz(served):
     server, *_ = served
     with urllib.request.urlopen(server.url + "/healthz", timeout=10) as r:
