@@ -25,6 +25,9 @@ verify-generate: generate
 bench:
 	$(PYTHON) bench.py
 
+bench-launch:
+	$(PYTHON) bench_launch.py
+
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PYTHON) __graft_entry__.py 8

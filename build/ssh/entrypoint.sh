@@ -1,0 +1,24 @@
+#!/bin/sh
+# MPIJob worker entrypoint: gate on cluster DNS before starting sshd.
+#
+# Parity target: /root/reference/build/base/entrypoint.sh — a worker pod
+# may be dialed by hostname the instant the launcher starts, but its own
+# headless-Service DNS record appears asynchronously.  Block until this
+# pod can resolve itself, then hand off to sshd (or whatever command the
+# pod spec declares).
+set -eu
+
+fqdn="$(hostname -f 2>/dev/null || hostname)"
+tries=0
+max_tries=300
+until getent hosts "$fqdn" >/dev/null 2>&1; do
+    tries=$((tries + 1))
+    if [ "$tries" -ge "$max_tries" ]; then
+        echo "entrypoint: DNS for ${fqdn} never appeared" >&2
+        exit 1
+    fi
+    sleep 1
+done
+echo "entrypoint: DNS ready for ${fqdn} after ${tries}s"
+
+exec "$@"

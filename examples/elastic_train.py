@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Elastic training driver: re-form the world at checkpoint boundaries.
+
+The TPU-native answer to Elastic Horovod (reference
+proposals/elastic-horovod.md:8-30: horovodrun polls discover_hosts.sh,
+and on membership change rebuilds the allreduce ring from a checkpoint).
+Here the launcher consumes the same operator-maintained membership
+artifact via ``bootstrap.elastic`` and, whenever the running-worker set
+changes:
+
+    1. saves an Orbax checkpoint at the step boundary,
+    2. rebuilds the data-parallel device mesh sized to the new world,
+    3. restores the checkpoint onto the new mesh and keeps training.
+
+On hardware each membership entry is a TPU host; hermetically the mesh
+is carved from virtual CPU devices — same re-forming logic either way.
+
+Prints one line per world change:
+    WORLD-CHANGE step=<n> old=<k> new=<m> restored=<bool>
+and on completion:
+    ELASTIC-TRAIN-OK steps=<n> worlds=<k1>-><k2>... final_loss=<x>
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_state(rng, model, tx):
+    import jax.numpy as jnp
+    params = model.init(rng, jnp.zeros((1, 16), jnp.float32))["params"]
+    return {"params": params, "opt": tx.init(params), "step": 0}
+
+
+def make_train_step(model, tx, mesh):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mpi_operator_tpu.parallel.mesh import batch_sharding
+
+    def loss_fn(params, x, y):
+        pred = model.apply({"params": params}, x)
+        return jnp.mean((pred - y) ** 2)
+
+    @jax.jit
+    def step(state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], x, y)
+        updates, opt = tx.update(grads, state["opt"], state["params"])
+        return {"params": optax.apply_updates(state["params"], updates),
+                "opt": opt, "step": state["step"] + 1}, loss
+
+    def run(state, x, y):
+        x = jax.device_put(x, batch_sharding(mesh, extra_dims=1))
+        y = jax.device_put(y, batch_sharding(mesh, extra_dims=1))
+        return step(state, x, y)
+
+    return run
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--ckpt-dir", required=True)
+    parser.add_argument("--poll", type=float, default=0.2,
+                        help="membership poll interval")
+    parser.add_argument("--stop-file", default=None,
+                        help="finish gracefully once this file exists"
+                             " (deterministic driver control in tests)")
+    args = parser.parse_args()
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mpi_operator_tpu.bootstrap import elastic
+    from mpi_operator_tpu.parallel.mesh import (MeshConfig, create_mesh,
+                                                replicated)
+    from mpi_operator_tpu.utils.checkpoint import (latest_step,
+                                                   restore_checkpoint,
+                                                   save_checkpoint)
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(64)(x))
+            return nn.Dense(16)(x)
+
+    def world_size() -> int:
+        hosts = elastic.current_hosts()
+        return max(1, len(hosts))
+
+    def carve_mesh(world: int):
+        """Data-parallel mesh sized to the current world (clamped to the
+        devices this process can see, and to a divisor of the batch so
+        the batch shards evenly; on hardware world == host count)."""
+        devices = jax.devices()
+        cap = max(1, min(world, len(devices)))
+        dp = max(d for d in range(1, cap + 1) if args.batch % d == 0)
+        return create_mesh(MeshConfig(dp=dp), devices=devices[:dp])
+
+    model = MLP()
+    tx = optax.sgd(0.05)
+    rng = jax.random.PRNGKey(0)
+
+    def place(state, mesh):
+        """Replicate the state over the mesh — restored arrays still live
+        on the PREVIOUS mesh's devices, and mixing placements in one jit
+        is an error."""
+        return jax.device_put(state, replicated(mesh))
+
+    world = world_size()
+    mesh = carve_mesh(world)
+    state = build_state(rng, model, tx)
+    resume = latest_step(args.ckpt_dir)
+    if resume is not None:
+        state = restore_checkpoint(args.ckpt_dir, state, step=resume)
+    state = place(state, mesh)
+    train = make_train_step(model, tx, mesh)
+
+    data_rng = jax.random.PRNGKey(7)
+    worlds_seen = [world]
+    print(f"ELASTIC-TRAIN-START world={world} resume={resume}", flush=True)
+    loss = None
+    while int(state["step"]) < args.steps:
+        if args.stop_file and os.path.exists(args.stop_file):
+            break
+        new_world = world_size()
+        if new_world != world:
+            # Checkpoint boundary: save on the old world, rebuild the
+            # mesh for the new one, restore onto it.
+            step_now = int(state["step"])
+            save_checkpoint(args.ckpt_dir, state, step=step_now)
+            mesh = carve_mesh(new_world)
+            train = make_train_step(model, tx, mesh)
+            fresh = build_state(rng, model, tx)
+            state = place(restore_checkpoint(args.ckpt_dir, fresh,
+                                             step=step_now), mesh)
+            print(f"WORLD-CHANGE step={step_now} old={world} "
+                  f"new={new_world} restored=True", flush=True)
+            world = new_world
+            worlds_seen.append(world)
+        data_rng, k1, k2 = jax.random.split(data_rng, 3)
+        x = jax.random.normal(k1, (args.batch, 16))
+        y = jax.random.normal(k2, (args.batch, 16))
+        state, loss = train(state, x, y)
+        import time
+        time.sleep(args.poll)  # training cadence; lets membership move
+
+    print(f"ELASTIC-TRAIN-OK steps={int(state['step'])} "
+          f"worlds={'->'.join(str(w) for w in worlds_seen)} "
+          f"final_loss={float(loss):.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

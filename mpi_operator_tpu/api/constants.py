@@ -39,7 +39,12 @@ RESTART_POLICY_ALWAYS = "Always"
 RESTART_POLICY_ON_FAILURE = "OnFailure"
 RESTART_POLICY_NEVER = "Never"
 RESTART_POLICY_EXIT_CODE = "ExitCode"
-VALID_RESTART_POLICIES = (RESTART_POLICY_NEVER, RESTART_POLICY_ON_FAILURE)
+# The reference validates only Never/OnFailure (validation.go:40-42) and
+# leaves its declared ExitCode surface unimplemented; here ExitCode is
+# real (gang/slice repair: retryable exits restart the whole worker
+# gang), so it is a valid policy.
+VALID_RESTART_POLICIES = (RESTART_POLICY_NEVER, RESTART_POLICY_ON_FAILURE,
+                          RESTART_POLICY_EXIT_CODE)
 
 DEFAULT_RESTART_POLICY = RESTART_POLICY_NEVER
 DEFAULT_LAUNCHER_RESTART_POLICY = RESTART_POLICY_ON_FAILURE
@@ -84,6 +89,23 @@ JAX_LOCAL_DEVICE_COUNT_ENV = "JAX_LOCAL_DEVICE_COUNT"
 # report launch-to-first-allreduce latency (BASELINE.md target metric).
 MPIJOB_SUBMIT_TIME_ENV = "MPIJOB_SUBMIT_TIME"
 DEFAULT_JAX_COORDINATOR_PORT = 8476
+
+# Gang-restart accounting for RestartPolicy=ExitCode (slice repair):
+# jax.distributed cannot re-form a group around a restarted member, so a
+# retryable worker failure restarts the whole worker gang; this
+# annotation tracks how many times, bounded by runPolicy.backoffLimit.
+GANG_RESTART_COUNT_ANNOTATION = "kubeflow.org/gang-restart-count"
+# ExitCode policy split (reference types.go:376-381, aspirational there):
+# 1-127 permanent, 128-255 (signals, preemption) retryable.
+RETRYABLE_EXIT_CODE_MIN = 128
+
+# Persistent XLA compilation cache for workload pods: cuts
+# launch-to-first-allreduce on restarts, gang repairs and elastic
+# re-forms (JAX reads this env natively).  Overridable/disable-able per
+# job via the annotation ("" disables).
+JAX_COMPILATION_CACHE_ENV = "JAX_COMPILATION_CACHE_DIR"
+DEFAULT_JAX_COMPILATION_CACHE = "/tmp/mpijob-jax-cache"
+JAX_COMPILATION_CACHE_ANNOTATION = "kubeflow.org/jax-compilation-cache"
 
 # Multislice (DCN) coordination env, injected when spec.slices > 1: the
 # megascale transport pattern — one coordinator address shared by every

@@ -145,6 +145,14 @@ def _validate_replica_specs(specs: dict, path: str) -> list[FieldError]:
         errs += _validate_replica_spec(launcher, launcher_path)
         if launcher.replicas is not None and launcher.replicas != 1:
             errs.append(FieldError(f"{launcher_path}.replicas", "must be 1"))
+        # ExitCode is the worker gang-repair policy; on the launcher it
+        # has no semantics (the launcher Job's backoffLimit owns launcher
+        # retries) and would silently degrade to Never.
+        if launcher.restart_policy == constants.RESTART_POLICY_EXIT_CODE:
+            errs.append(FieldError(
+                f"{launcher_path}.restartPolicy",
+                "ExitCode applies to Worker replicas only; use Never or"
+                " OnFailure for the Launcher"))
     worker = specs.get(constants.REPLICA_TYPE_WORKER)
     if worker is not None:
         worker_path = f"{path}[Worker]"

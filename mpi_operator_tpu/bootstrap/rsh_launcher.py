@@ -1,0 +1,261 @@
+"""rsh launcher — mpirun's rank-formation contract without an MPI runtime.
+
+The reference's launcher runs ``mpirun``, which reads the operator's
+hostfile, dials each worker over SSH (sshd in the worker image,
+/root/reference/build/base/Dockerfile:1-31) and execs one process per
+slot with rank env.  This module is that exact contract, TPU-native:
+
+    python -m mpi_operator_tpu.bootstrap.rsh_launcher -- CMD ARGS...
+
+* hostfile discovered from the operator-injected env
+  (OMPI_MCA_orte_default_hostfile / I_MPI_HYDRA_HOST_FILE /
+  HYDRA_HOST_FILE), with both "host slots=N" and "host:N" formats;
+* a DNS-readiness gate retries until every host resolves (the
+  entrypoint.sh:7-37 analogue);
+* each rank is launched through a pluggable rsh agent — ``ssh`` by
+  default (with OMPI_MCA_plm_rsh_args, e.g. -o ConnectionAttempts=10),
+  or any ``agent host cmd...`` program via --rsh (OpenMPI's
+  plm_rsh_agent knob; bootstrap.rsh_local runs ranks locally for
+  single-host/hermetic use);
+* every rank gets coordinator env (JAX_COORDINATOR_ADDRESS=host0:port,
+  JAX_PROCESS_ID, JAX_NUM_PROCESSES) plus OMPI_COMM_WORLD_RANK/SIZE, so
+  both tpucoll-native and jax.distributed workloads form the group.
+
+Exit status is the first nonzero rank status; on any failure the rest of
+the gang is terminated (gang semantics, like mpirun).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+HOSTFILE_ENV_VARS = ("OMPI_MCA_orte_default_hostfile",
+                     "I_MPI_HYDRA_HOST_FILE", "HYDRA_HOST_FILE")
+
+
+@dataclass
+class HostSlots:
+    host: str
+    slots: int = 1
+
+
+def resolve_hostfile_path(env=None) -> Optional[str]:
+    """Hostfile path from the operator env matrices; inside the local
+    kubelet the declared mount path (/etc/mpi) is translated through the
+    K_MOUNT_PATH_*/K_MOUNT_* sandbox mapping."""
+    env = env if env is not None else os.environ
+    declared = None
+    for var in HOSTFILE_ENV_VARS:
+        if env.get(var):
+            declared = env[var]
+            break
+    if declared is None:
+        return None
+    if os.path.exists(declared):
+        return declared
+    # Sandbox translation: find a mount whose declared path prefixes the
+    # hostfile path and rebase onto the materialized volume dir.
+    for key, mount_path in env.items():
+        if not key.startswith("K_MOUNT_PATH_"):
+            continue
+        if declared.startswith(mount_path.rstrip("/") + "/"):
+            host_dir = env.get("K_MOUNT_" + key[len("K_MOUNT_PATH_"):])
+            if host_dir:
+                rel = declared[len(mount_path.rstrip("/")) + 1:]
+                candidate = os.path.join(host_dir, rel)
+                if os.path.exists(candidate):
+                    return candidate
+    return declared  # let the open() failure carry the real path
+
+
+def parse_hostfile(text: str) -> List[HostSlots]:
+    """Both wire formats the operator emits (controller/builders.py
+    host_line): OpenMPI "host slots=N", Intel/MPICH "host:N", bare
+    host lines (JAX informational hostfile)."""
+    out = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^(\S+?)\s+slots=(\d+)$", line)
+        if m:
+            out.append(HostSlots(m.group(1), int(m.group(2))))
+            continue
+        m = re.match(r"^([^\s:]+):(\d+)$", line)
+        if m:
+            out.append(HostSlots(m.group(1), int(m.group(2))))
+            continue
+        out.append(HostSlots(line))
+    return out
+
+
+def wait_for_dns(hosts: List[str], timeout: float, required: bool = True,
+                 log=print) -> bool:
+    """Retry until every host resolves (entrypoint.sh DNS gate analogue).
+    With required=False (non-ssh agents that do not dial the host name)
+    failure downgrades to a warning."""
+    deadline = time.monotonic() + timeout
+    pending = list(dict.fromkeys(hosts))
+    while pending and time.monotonic() < deadline:
+        still = []
+        for host in pending:
+            try:
+                socket.getaddrinfo(host, None)
+            except OSError:
+                still.append(host)
+        pending = still
+        if pending:
+            time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
+    if not pending:
+        return True
+    msg = f"hosts never resolved: {', '.join(pending)}"
+    if required:
+        raise RuntimeError(msg)
+    log(f"rsh_launcher: warning: {msg} (continuing: non-ssh agent)")
+    return False
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def build_rank_commands(hosts: List[HostSlots], workload: List[str],
+                        agent: List[str], agent_args: List[str],
+                        coordinator_port: int,
+                        np: Optional[int] = None,
+                        coordinator: Optional[str] = None) -> List[List[str]]:
+    """One command per rank: agent + args + host + env assignments +
+    workload (the rsh contract: everything after the host is the remote
+    command line)."""
+    total = sum(h.slots for h in hosts)
+    if np is not None:
+        total = min(total, np)
+    if coordinator is None:
+        coordinator = f"{hosts[0].host}:{coordinator_port}"
+    elif ":" not in coordinator:
+        coordinator = f"{coordinator}:{coordinator_port}"
+    cmds = []
+    rank = 0
+    for h in hosts:
+        for _ in range(h.slots):
+            if rank >= total:
+                break
+            assignments = [
+                f"JAX_COORDINATOR_ADDRESS={coordinator}",
+                f"JAX_PROCESS_ID={rank}",
+                f"JAX_NUM_PROCESSES={total}",
+                f"OMPI_COMM_WORLD_RANK={rank}",
+                f"OMPI_COMM_WORLD_SIZE={total}",
+            ]
+            cmds.append(agent + agent_args + [h.host, "env"] + assignments
+                        + workload)
+            rank += 1
+    return cmds
+
+
+def run_gang(cmds: List[List[str]], log=print) -> int:
+    """Launch every rank, stream prefixed output, enforce gang semantics:
+    first nonzero status terminates the rest."""
+    procs = []
+    for rank, cmd in enumerate(cmds):
+        procs.append((rank, subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)))
+
+    failures = []
+    lock = threading.Lock()
+
+    def pump(rank: int, proc: subprocess.Popen) -> None:
+        for line in proc.stdout:
+            log(f"[rank {rank}] {line.rstrip()}")
+        code = proc.wait()
+        if code != 0:
+            with lock:
+                failures.append((rank, code))
+            for _, other in procs:
+                if other.poll() is None:
+                    other.terminate()
+
+    threads = [threading.Thread(target=pump, args=(r, p), daemon=True)
+               for r, p in procs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if failures:
+        rank, code = failures[0]
+        log(f"rsh_launcher: rank {rank} failed with exit code {code}")
+        return code
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rsh_launcher",
+        description="mpirun-style gang launcher over a pluggable rsh agent")
+    parser.add_argument("--rsh", default="ssh",
+                        help="rsh agent (OpenMPI plm_rsh_agent analogue);"
+                             " invoked as: AGENT [args] HOST CMD...")
+    parser.add_argument("--hostfile", default=None,
+                        help="override the env-discovered hostfile path")
+    parser.add_argument("--np", type=int, default=None,
+                        help="cap the number of ranks")
+    parser.add_argument("--port", type=int, default=None,
+                        help="coordinator port (default: "
+                             "JAX_COORDINATOR_PORT or a free port)")
+    parser.add_argument("--coordinator", default=None,
+                        help="override the rank-0 coordinator host[:port]"
+                             " (default: first hostfile entry; use"
+                             " 127.0.0.1 with a local agent)")
+    parser.add_argument("--dns-timeout", type=float, default=300.0)
+    parser.add_argument("workload", nargs="+",
+                        help="rank command (prefix with -- )")
+    args = parser.parse_args(argv)
+
+    hostfile = args.hostfile or resolve_hostfile_path()
+    if hostfile is None:
+        print("rsh_launcher: no hostfile (set --hostfile or run under the"
+              " operator's MPI env matrix)", file=sys.stderr)
+        return 2
+    with open(hostfile) as f:
+        hosts = parse_hostfile(f.read())
+    if not hosts:
+        print(f"rsh_launcher: hostfile {hostfile} is empty",
+              file=sys.stderr)
+        return 2
+
+    agent = shlex.split(args.rsh)
+    agent_args = []
+    if agent and os.path.basename(agent[0]) == "ssh":
+        agent_args = shlex.split(
+            os.environ.get("OMPI_MCA_plm_rsh_args", ""))
+    wait_for_dns([h.host for h in hosts], args.dns_timeout,
+                 required=os.path.basename(agent[0]) == "ssh")
+
+    port = args.port
+    if port is None:
+        declared = os.environ.get("JAX_COORDINATOR_PORT")
+        port = int(declared) if declared else _free_port()
+
+    cmds = build_rank_commands(hosts, args.workload, agent, agent_args,
+                               port, np=args.np,
+                               coordinator=args.coordinator)
+    print(f"rsh_launcher: launching {len(cmds)} ranks across "
+          f"{len(hosts)} hosts (agent: {' '.join(agent)})", flush=True)
+    return run_gang(cmds)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,26 @@
+"""Local rsh agent: run the "remote" command in-place.
+
+``rsh_launcher --rsh "python -m mpi_operator_tpu.bootstrap.rsh_local"``
+turns the SSH gang launch into local process spawns — the single-host /
+hermetic-CI analogue of mpirun's ``plm_rsh_agent`` override.  Contract
+matches rsh/ssh: ``agent HOST CMD...`` executes CMD (the host argument
+is accepted and ignored).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 2:
+        print("usage: rsh_local HOST CMD...", file=sys.stderr)
+        return 2
+    cmd = argv[1:]  # drop the host
+    os.execvp(cmd[0], cmd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -148,7 +148,8 @@ def coordinator_host(job: MPIJob, cluster_domain: str) -> str:
     return _host_fqdn(worker_name(job, 0), job, cluster_domain)
 
 
-def jax_env(job: MPIJob, process_id: int, cluster_domain: str) -> list:
+def jax_env(job: MPIJob, process_id: int, cluster_domain: str,
+            container_env_names=()) -> list:
     port = constants.DEFAULT_JAX_COORDINATOR_PORT
     env = [
         EnvVar(constants.JAX_COORDINATOR_ADDRESS_ENV,
@@ -165,6 +166,19 @@ def jax_env(job: MPIJob, process_id: int, cluster_domain: str) -> list:
         env.append(EnvVar(
             constants.MPIJOB_SUBMIT_TIME_ENV,
             f"{job.metadata.creation_timestamp.timestamp():.3f}"))
+    # Persistent compilation cache: the second life of any process (job
+    # restart, gang repair, elastic re-form) skips XLA recompilation,
+    # directly cutting launch-to-first-allreduce.  Annotation overrides
+    # the path; empty annotation disables.
+    # Injected env is merged AFTER the user's container env and the pod
+    # runtime resolves duplicates last-wins, so an explicit user value
+    # must suppress the default entirely.
+    cache_dir = job.metadata.annotations.get(
+        constants.JAX_COMPILATION_CACHE_ANNOTATION,
+        constants.DEFAULT_JAX_COMPILATION_CACHE)
+    if cache_dir and \
+            constants.JAX_COMPILATION_CACHE_ENV not in container_env_names:
+        env.append(EnvVar(constants.JAX_COMPILATION_CACHE_ENV, cache_dir))
     # Multislice (DCN): partition workers into same-sized slices and point
     # every process at one megascale coordinator (slice 0's worker-0);
     # XLA bridges slices over DCN, ICI stays intra-slice (SURVEY.md §5).
@@ -353,7 +367,9 @@ def new_worker(job: MPIJob, index: int, pod_group_ctrl=None,
     container.env = list(container.env) + deep_copy(WORKER_ENV)
     if is_jax(job):
         process_id = index + (1 if run_launcher_as_worker(job) else 0)
-        container.env += jax_env(job, process_id, cluster_domain)
+        container.env += jax_env(
+            job, process_id, cluster_domain,
+            container_env_names={e.name for e in container.env})
     if uses_ssh(job):
         setup_ssh_on_pod(template.spec, job)
 
@@ -433,7 +449,9 @@ def new_launcher_pod_template(job: MPIJob, pod_group_ctrl=None,
         # pure driver that still receives the coordinator address for
         # monitoring (but no process id).
         if run_launcher_as_worker(job):
-            container.env += jax_env(job, 0, cluster_domain)
+            container.env += jax_env(
+                job, 0, cluster_domain,
+                container_env_names={e.name for e in container.env})
         else:
             port = constants.DEFAULT_JAX_COORDINATOR_PORT
             container.env.append(EnvVar(

@@ -280,6 +280,7 @@ class MPIJobController:
                 if self.pod_group_ctrl is not None:
                     if self._get_or_create_pod_group(mpi_job) is None:
                         raise RuntimeError("getting or creating PodGroup")
+                self._maybe_gang_restart(mpi_job)
                 workers = self._get_or_create_workers(mpi_job)
             if launcher is None:
                 at_startup = (mpi_job.spec.launcher_creation_policy
@@ -438,6 +439,113 @@ class MPIJobController:
             raise self._resource_exists_error(job, pg.metadata.name,
                                               "PodGroup")
         ctrl.delete_pod_group(job.metadata.namespace, job.metadata.name)
+
+    def _maybe_gang_restart(self, job: MPIJob) -> None:
+        """RestartPolicy=ExitCode as slice repair (SURVEY §7 hard part c).
+
+        jax.distributed cannot re-form a group around a single restarted
+        member — an in-place container restart leaves the rejoining rank
+        wedged in initialize while the rest of the gang is mid-training.
+        So with restartPolicy: ExitCode (pods run with Never, making
+        failures visible) a RETRYABLE worker failure (exit 128-255:
+        signals, preemption) deletes the WHOLE worker gang so the next
+        sync recreates it and the group re-forms from the workload's
+        checkpoint; a PERMANENT failure (1-127) fails the MPIJob.  The
+        reference declares this surface but maps it to Never and stops
+        (mpi_job_controller.go:1722-1728); here it is implemented.  Gang
+        restarts are bounded by runPolicy.backoffLimit via an annotation
+        counter."""
+        spec = job.worker_spec
+        if spec is None or \
+                spec.restart_policy != constants.RESTART_POLICY_EXIT_CODE:
+            return
+        if is_finished(job.status):
+            return  # terminal: no repair, no re-emitted failure events
+        pods = self.pod_informer.lister.list(
+            job.metadata.namespace,
+            builders.worker_selector(job.metadata.name))
+        failed = [p for p in pods
+                  if p.status.phase == core.POD_FAILED
+                  and is_controlled_by(p, job)
+                  and p.status.reason != "Evicted"]  # evict path owns those
+        if not failed:
+            return
+        # The lister can be stale: a pod this controller already deleted in
+        # a previous gang restart may still be cached (watch streams carry
+        # no cross-kind ordering), and acting on it would double-count the
+        # restart against backoffLimit.  Confirm each failure against the
+        # live API (same uid, still Failed) before acting.
+        live_failed = []
+        for p in failed:
+            try:
+                live = self.client.pods(p.metadata.namespace).get(
+                    p.metadata.name)
+            except Exception as exc:
+                if is_not_found(exc):
+                    continue  # already deleted: handled
+                raise
+            if live.metadata.uid == p.metadata.uid \
+                    and live.status.phase == core.POD_FAILED:
+                live_failed.append(live)
+        failed = live_failed
+        if not failed:
+            return
+
+        def exit_code(pod) -> int:
+            for cs in pod.status.container_statuses:
+                if cs.state is not None and cs.state.terminated is not None:
+                    return cs.state.terminated.exit_code
+            return 1  # unknown terminal state: treat as permanent
+
+        permanent = [p for p in failed
+                     if exit_code(p) < constants.RETRYABLE_EXIT_CODE_MIN]
+        if permanent:
+            p = permanent[0]
+            msg = (f"worker {p.metadata.name} failed permanently with exit"
+                   f" code {exit_code(p)} (restartPolicy: ExitCode)")
+            update_job_conditions(job, constants.JOB_FAILED,
+                                  core.CONDITION_TRUE,
+                                  MPI_JOB_FAILED_REASON, msg, self.clock)
+            self.recorder.event(job, core.EVENT_TYPE_WARNING,
+                                MPI_JOB_FAILED_REASON, msg)
+            return
+
+        restarts = int(job.metadata.annotations.get(
+            constants.GANG_RESTART_COUNT_ANNOTATION, "0"))
+        limit = job.spec.run_policy.backoff_limit
+        if limit is not None and restarts >= limit:
+            msg = (f"worker gang restarted {restarts} times, "
+                   f"backoffLimit {limit} reached")
+            update_job_conditions(job, constants.JOB_FAILED,
+                                  core.CONDITION_TRUE,
+                                  JOB_BACKOFF_LIMIT_EXCEEDED_REASON, msg,
+                                  self.clock)
+            self.recorder.event(job, core.EVENT_TYPE_WARNING,
+                                JOB_BACKOFF_LIMIT_EXCEEDED_REASON, msg)
+            return
+
+        msg = (f"worker {failed[0].metadata.name} exited with retryable code"
+               f" {exit_code(failed[0])}; restarting the worker gang"
+               f" (restart {restarts + 1})")
+        self.recorder.event(job, core.EVENT_TYPE_NORMAL, "GangRestart", msg)
+        for pod in pods:
+            if is_controlled_by(pod, job):
+                try:
+                    self.client.pods(pod.metadata.namespace).delete(
+                        pod.metadata.name)
+                except Exception as exc:
+                    if not is_not_found(exc):
+                        raise
+        # Persist the counter on the stored object (spec path, not status).
+        stored = self.client.mpi_jobs(job.metadata.namespace).get(
+            job.metadata.name)
+        stored.metadata.annotations[
+            constants.GANG_RESTART_COUNT_ANNOTATION] = str(restarts + 1)
+        updated = self.client.mpi_jobs(job.metadata.namespace).update(stored)
+        # Keep the in-flight copy current so the end-of-sync status write
+        # does not hit an optimistic-concurrency conflict.
+        job.metadata.annotations = updated.metadata.annotations
+        job.metadata.resource_version = updated.metadata.resource_version
 
     def _get_or_create_workers(self, job: MPIJob) -> list:
         """getOrCreateWorker (:982-1042)."""

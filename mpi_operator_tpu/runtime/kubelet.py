@@ -203,11 +203,16 @@ class _PodRunner:
                 self.restart_count += 1
                 time.sleep(min(0.2 * self.restart_count, 2.0))
                 continue
+            # Popen reports signal deaths as -signum; container runtimes
+            # report 128+signum (137/143...).  Match the runtime contract
+            # so ExitCode policy classifies signal kills as retryable.
+            if code < 0:
+                code = 128 - code
             self.kubelet._set_phase(
                 self.namespace, self.pod_name, core.POD_FAILED,
                 reason="Error",
                 message=f"container exited with code {code}",
-                restart_count=self.restart_count)
+                restart_count=self.restart_count, exit_code=code)
             return
 
     def start(self) -> None:
@@ -345,7 +350,8 @@ class LocalKubelet:
     # -- status reflection -------------------------------------------------
     def _set_phase(self, namespace: str, name: str, phase: str,
                    ready: bool = False, reason: str = "", message: str = "",
-                   restart_count: int = 0) -> None:
+                   restart_count: int = 0,
+                   exit_code: Optional[int] = None) -> None:
         for _ in range(5):
             try:
                 pod = self.client.pods(namespace).get(name)
@@ -363,9 +369,16 @@ class LocalKubelet:
                 status=core.CONDITION_TRUE if ready else core.CONDITION_FALSE))
             # Restart counts feed the Job backoffLimit accounting (real
             # kubelet/Job-controller semantics for restartPolicy=OnFailure).
+            # Terminated exit codes feed RestartPolicy=ExitCode semantics
+            # (retryable 128-255 vs permanent 1-127 gang decisions).
+            state = None
+            if exit_code is not None:
+                state = core.ContainerState(
+                    terminated=core.ContainerStateTerminated(
+                        exit_code=exit_code, reason=reason, message=message))
             pod.status.container_statuses = [core.ContainerStatus(
                 name=pod.spec.containers[0].name if pod.spec.containers else "",
-                ready=ready, restart_count=restart_count)]
+                ready=ready, restart_count=restart_count, state=state)]
             try:
                 self.client.pods(namespace).update_status(pod)
                 return

@@ -66,22 +66,74 @@ class LocalCluster:
         return self.client.mpi_jobs(
             mpi_job.metadata.namespace or "default").create(mpi_job)
 
+    def wait_for(self, api_version: str, kind: str, namespace: str,
+                 predicate, timeout: float = 60.0, describe: str = ""):
+        """Watch-driven wait: subscribe FIRST, then evaluate current
+        state, then consume events until `predicate(obj)` holds for some
+        object — no sleep-polling, no missed-transition races (events
+        between the initial list and the stream are already queued)."""
+        watch = self.client.server.watch(api_version, kind)
+        try:
+            for obj in self.client.server.list(api_version, kind, namespace):
+                if predicate(obj):
+                    return obj
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"{kind} in {namespace} never satisfied: "
+                        f"{describe or predicate}")
+                ev = watch.next(timeout=min(remaining, 1.0))
+                if ev is None or ev.type == "DELETED":
+                    continue
+                if ev.obj.metadata.namespace == namespace \
+                        and predicate(ev.obj):
+                    return ev.obj
+        finally:
+            watch.stop()
+
+    def wait_until(self, api_version: str, kind: str, fn,
+                   timeout: float = 60.0, describe: str = "") -> None:
+        """Event-driven aggregate wait: re-evaluate `fn()` (any predicate
+        over cluster state) after every event on the given kind instead
+        of sleep-polling.  A coarse 0.5s tick guards predicates that
+        also depend on other kinds."""
+        watch = self.client.server.watch(api_version, kind)
+        try:
+            if fn():
+                return
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"never satisfied: {describe or fn}")
+                watch.next(timeout=min(remaining, 0.5))
+                if fn():
+                    return
+        finally:
+            watch.stop()
+
     def wait_for_condition(self, namespace: str, name: str, cond_type: str,
                            status: str = "True", timeout: float = 60.0):
-        """Poll the MPIJob until the condition appears (e2e helper,
+        """Watch the MPIJob until the condition appears (e2e helper,
         analogue of waitForCompletion at test/e2e/mpi_job_test.go:595-631)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        def has_condition(job):
+            return job.metadata.name == name and any(
+                c.type == cond_type and c.status == status
+                for c in job.status.conditions)
+
+        try:
+            return self.wait_for("kubeflow.org/v2beta1", "MPIJob", namespace,
+                                 has_condition, timeout=timeout)
+        except TimeoutError:
             job = self.client.mpi_jobs(namespace).get(name)
-            for c in job.status.conditions:
-                if c.type == cond_type and c.status == status:
-                    return job
-            time.sleep(0.05)
-        job = self.client.mpi_jobs(namespace).get(name)
-        conds = [(c.type, c.status, c.reason) for c in job.status.conditions]
-        raise TimeoutError(
-            f"MPIJob {namespace}/{name} never reached {cond_type}={status};"
-            f" conditions={conds}")
+            conds = [(c.type, c.status, c.reason)
+                     for c in job.status.conditions]
+            raise TimeoutError(
+                f"MPIJob {namespace}/{name} never reached "
+                f"{cond_type}={status}; conditions={conds}") from None
 
     def launcher_logs(self, namespace: str, name: str) -> str:
         """Concatenated logs of the launcher Job's pods (debugJob analogue,

@@ -175,3 +175,16 @@ def test_multislice_rejects_run_launcher_as_worker():
     errs = validate_mpijob(job)
     assert any("slices" in e.field and "runLauncherAsWorker" in e.message
                for e in errs)
+
+
+def test_exit_code_restart_policy_worker_only():
+    job = valid_job(workers=2, impl=constants.IMPL_JAX)
+    job.worker_spec.restart_policy = constants.RESTART_POLICY_EXIT_CODE
+    assert validate_mpijob(job) == []
+
+    job.spec.mpi_replica_specs[
+        constants.REPLICA_TYPE_LAUNCHER].restart_policy = \
+        constants.RESTART_POLICY_EXIT_CODE
+    errs = validate_mpijob(job)
+    assert any("Launcher" in e.field and "restartPolicy" in e.field
+               for e in errs)

@@ -659,3 +659,145 @@ def test_launcher_pods_exclude_orphans_with_warning():
     # the foreign-owned pod is excluded silently (owned by another
     # controller, not an adoption candidate)
     assert not any("foreign" in e for e in f.recorder.events)
+
+
+# ---------------------------------------------------------------------------
+# Gang restart (RestartPolicy=ExitCode slice repair; reference declares the
+# ExitCode surface but maps it to Never, :1722-1728)
+# ---------------------------------------------------------------------------
+
+def _fail_worker(f, name, exit_code):
+    pod = f.client.pods("default").get(name)
+    pod.status.phase = core.POD_FAILED
+    pod.status.reason = "Error"
+    pod.status.container_statuses = [core.ContainerStatus(
+        name="worker",
+        state=core.ContainerState(terminated=core.ContainerStateTerminated(
+            exit_code=exit_code, reason="Error")))]
+    f.client.pods("default").update_status(pod)
+
+
+def _exit_code_job(workers=2, **kw):
+    job = new_mpi_job(workers=workers, impl=constants.IMPL_JAX, **kw)
+    job.worker_spec.restart_policy = constants.RESTART_POLICY_EXIT_CODE
+    return job
+
+
+def test_gang_restart_on_retryable_worker_exit():
+    f = Fixture()
+    job = _exit_code_job()
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+
+    _fail_worker(f, "test-worker-1", 143)  # SIGTERM: retryable
+    f.refresh_caches()
+    f.sync(f.get_job())
+
+    # whole gang deleted, counter bumped, event emitted
+    assert f.client.pods("default").list(
+        {"training.kubeflow.org/job-role": "worker"}) == []
+    stored = f.get_job()
+    assert stored.metadata.annotations[
+        constants.GANG_RESTART_COUNT_ANNOTATION] == "1"
+    assert any("GangRestart" in e for e in f.recorder.events)
+    conds = {c.type: c.status for c in stored.status.conditions}
+    assert conds.get(constants.JOB_FAILED) != "True"
+
+    # next sync (informers caught up) recreates the full gang
+    f.refresh_caches()
+    f.sync(f.get_job())
+    names = sorted(p.metadata.name for p in f.client.pods("default").list(
+        {"training.kubeflow.org/job-role": "worker"}))
+    assert names == ["test-worker-0", "test-worker-1"]
+
+
+def test_gang_restart_permanent_exit_fails_job():
+    f = Fixture()
+    job = _exit_code_job()
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+
+    _fail_worker(f, "test-worker-0", 2)  # permanent
+    f.refresh_caches()
+    f.sync(f.get_job())
+
+    stored = f.get_job()
+    conds = {c.type: c.status for c in stored.status.conditions}
+    assert conds[constants.JOB_FAILED] == "True"
+    # no gang deletion: the healthy worker survives
+    names = [p.metadata.name for p in f.client.pods("default").list(
+        {"training.kubeflow.org/job-role": "worker"})]
+    assert "test-worker-1" in names
+    assert not any("GangRestart" in e for e in f.recorder.events)
+
+
+def test_gang_restart_bounded_by_backoff_limit():
+    f = Fixture()
+    job = _exit_code_job()
+    job.spec.run_policy.backoff_limit = 1
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+
+    stored = f.get_job()
+    stored.metadata.annotations[
+        constants.GANG_RESTART_COUNT_ANNOTATION] = "1"
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+
+    _fail_worker(f, "test-worker-0", 137)
+    f.refresh_caches()
+    f.sync(f.get_job())
+
+    stored = f.get_job()
+    conds = {c.type: (c.status, c.reason) for c in stored.status.conditions}
+    assert conds[constants.JOB_FAILED] == ("True", "BackoffLimitExceeded")
+
+
+def test_jax_env_injects_compilation_cache_with_annotation_override():
+    f = Fixture()
+    job = new_mpi_job(workers=1, impl=constants.IMPL_JAX)
+    f.register_job(job)
+    f.sync(job)
+    pod = f.client.pods("default").get("test-worker-0")
+    env = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert env[constants.JAX_COMPILATION_CACHE_ENV] == \
+        constants.DEFAULT_JAX_COMPILATION_CACHE
+
+    f2 = Fixture()
+    job2 = new_mpi_job(name="anno", workers=1, impl=constants.IMPL_JAX)
+    job2.metadata.annotations[
+        constants.JAX_COMPILATION_CACHE_ANNOTATION] = "/data/cache"
+    f2.register_job(job2)
+    f2.sync(job2)
+    pod = f2.client.pods("default").get("anno-worker-0")
+    env = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert env[constants.JAX_COMPILATION_CACHE_ENV] == "/data/cache"
+
+    f3 = Fixture()
+    job3 = new_mpi_job(name="off", workers=1, impl=constants.IMPL_JAX)
+    job3.metadata.annotations[
+        constants.JAX_COMPILATION_CACHE_ANNOTATION] = ""
+    f3.register_job(job3)
+    f3.sync(job3)
+    pod = f3.client.pods("default").get("off-worker-0")
+    names = {e.name for e in pod.spec.containers[0].env}
+    assert constants.JAX_COMPILATION_CACHE_ENV not in names
+
+
+def test_jax_env_respects_user_compilation_cache_env():
+    """A user-set JAX_COMPILATION_CACHE_DIR in the container env must not
+    be overridden by the injected default (injected env merges last and
+    the pod runtime resolves duplicates last-wins)."""
+    f = Fixture()
+    job = new_mpi_job(workers=1, impl=constants.IMPL_JAX)
+    job.worker_spec.template.spec.containers[0].env.append(
+        core.EnvVar(constants.JAX_COMPILATION_CACHE_ENV, "/user/cache"))
+    f.register_job(job)
+    f.sync(job)
+    pod = f.client.pods("default").get("test-worker-0")
+    values = [e.value for e in pod.spec.containers[0].env
+              if e.name == constants.JAX_COMPILATION_CACHE_ENV]
+    assert values == ["/user/cache"]

@@ -135,43 +135,33 @@ def test_e2e_elastic_scale_down_and_up():
                 "default").list({"training.kubeflow.org/job-role": "worker"})
                 if p.status.phase == "Running"]
 
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and len(running_workers()) < 3:
-            time.sleep(0.1)
-        assert len(running_workers()) == 3
+        def discover_echoes():
+            cm = cluster.client.config_maps("default").get("el-config")
+            return cm.data.get("discover_hosts.sh", "").count("echo")
+
+        cluster.wait_until("v1", "Pod", lambda: len(running_workers()) == 3,
+                           timeout=20, describe="3 running workers")
 
         # discover_hosts reflects all running workers.
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
-            cm = cluster.client.config_maps("default").get("el-config")
-            if cm.data.get("discover_hosts.sh", "").count("echo") == 3:
-                break
-            time.sleep(0.1)
-        assert cm.data["discover_hosts.sh"].count("echo") == 3
+        cluster.wait_until("v1", "ConfigMap", lambda: discover_echoes() == 3,
+                           timeout=10, describe="3 discover_hosts entries")
 
         # Scale down to 1.
         stored = cluster.client.mpi_jobs("default").get("el")
         stored.spec.mpi_replica_specs["Worker"].replicas = 1
         cluster.client.mpi_jobs("default").update(stored)
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and len(running_workers()) != 1:
-            time.sleep(0.1)
+        cluster.wait_until("v1", "Pod", lambda: len(running_workers()) == 1,
+                           timeout=20, describe="scale-down to 1 worker")
         assert running_workers() == ["el-worker-0"]
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
-            cm = cluster.client.config_maps("default").get("el-config")
-            if cm.data.get("discover_hosts.sh", "").count("echo") == 1:
-                break
-            time.sleep(0.1)
-        assert cm.data["discover_hosts.sh"].count("echo") == 1
+        cluster.wait_until("v1", "ConfigMap", lambda: discover_echoes() == 1,
+                           timeout=10, describe="1 discover_hosts entry")
 
         # Scale back up to 2.
         stored = cluster.client.mpi_jobs("default").get("el")
         stored.spec.mpi_replica_specs["Worker"].replicas = 2
         cluster.client.mpi_jobs("default").update(stored)
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and len(running_workers()) != 2:
-            time.sleep(0.1)
+        cluster.wait_until("v1", "Pod", lambda: len(running_workers()) == 2,
+                           timeout=20, describe="scale-up to 2 workers")
         assert sorted(running_workers()) == ["el-worker-0", "el-worker-1"]
 
 
@@ -221,14 +211,11 @@ def test_e2e_scheduling_gates_hold_pods_until_cleared():
         stored = cluster.client.pods("default").get("gated")
         stored.spec.scheduling_gates = []
         cluster.client.pods("default").update(stored)
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
-            phase = cluster.client.pods("default").get("gated").status.phase
-            if phase == "Succeeded":
-                break
-            time.sleep(0.05)
-        assert cluster.client.pods("default").get("gated").status.phase == \
-            "Succeeded"
+        cluster.wait_for(
+            "v1", "Pod", "default",
+            lambda p: p.metadata.name == "gated"
+            and p.status.phase == "Succeeded",
+            timeout=10, describe="gated pod runs after gates cleared")
 
 
 def test_e2e_many_concurrent_jobs():
@@ -284,12 +271,12 @@ def test_e2e_elastic_discovery_visible_inside_pod():
         cluster.submit(job)
 
         # Scale only after the LAUNCHER ITSELF has observed 3 hosts (the
-        # launcher pod may start later than the workers).
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and \
-                "HOSTS 3" not in cluster.launcher_logs("default", "eld"):
-            time.sleep(0.1)
-        assert "HOSTS 3" in cluster.launcher_logs("default", "eld")
+        # launcher pod may start later than the workers).  Log content is
+        # not an API object, so tick on Pod events rather than sleeping.
+        cluster.wait_until(
+            "v1", "Pod",
+            lambda: "HOSTS 3" in cluster.launcher_logs("default", "eld"),
+            timeout=30, describe="launcher observed 3 hosts")
 
         stored = cluster.client.mpi_jobs("default").get("eld")
         stored.spec.mpi_replica_specs["Worker"].replicas = 1
@@ -323,10 +310,8 @@ def test_e2e_ttl_cleans_launcher_job_mpijob_stays_succeeded():
             except Exception:
                 return True
 
-        deadline = time.monotonic() + 15
-        while time.monotonic() < deadline and not launcher_gone():
-            time.sleep(0.2)
-        assert launcher_gone()
+        cluster.wait_until("batch/v1", "Job", launcher_gone, timeout=15,
+                           describe="TTL deleted the launcher Job")
         final = cluster.client.mpi_jobs("default").get("ttl")
         conds = {c.type: c.status for c in final.status.conditions}
         assert conds[constants.JOB_SUCCEEDED] == "True"
@@ -350,12 +335,12 @@ def test_e2e_wait_for_workers_ready_policy():
         cluster.submit(job)
 
         # Workers exist but are gated -> not Ready -> no launcher.
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and len(
-                cluster.client.pods("default").list(
-                    {"training.kubeflow.org/job-role": "worker"})) < 2:
-            time.sleep(0.05)
-        time.sleep(1.0)  # several sync rounds
+        cluster.wait_until(
+            "v1", "Pod",
+            lambda: len(cluster.client.pods("default").list(
+                {"training.kubeflow.org/job-role": "worker"})) == 2,
+            timeout=10, describe="both gated workers created")
+        time.sleep(1.0)  # several sync rounds (negative assertion below)
         with pytest.raises(Exception):
             cluster.client.jobs("default").get("wfw-launcher")
 
@@ -384,17 +369,21 @@ def test_e2e_gang_scheduling_podgroup_lifecycle():
             workers=2)
         cluster.submit(job)
 
-        def try_get(fn):
-            deadline = time.monotonic() + 15
-            while time.monotonic() < deadline:
+        def try_get(fn, kind="Pod", api_version="v1"):
+            def exists():
                 try:
-                    return fn()
+                    fn()
+                    return True
                 except Exception:
-                    time.sleep(0.1)
-            raise AssertionError("object never appeared")
+                    return False
+            cluster.wait_until(api_version, kind, exists, timeout=15,
+                               describe="object appears")
+            return fn()
 
         pg = try_get(
-            lambda: cluster.client.volcano_pod_groups("default").get("gang"))
+            lambda: cluster.client.volcano_pod_groups("default").get("gang"),
+            kind="PodGroup",
+            api_version="scheduling.volcano.sh/v1beta1")
         assert pg.spec.min_member == 3
 
         pod = try_get(
@@ -407,12 +396,111 @@ def test_e2e_gang_scheduling_podgroup_lifecycle():
         stored = cluster.client.mpi_jobs("default").get("gang")
         stored.spec.run_policy.suspend = True
         cluster.client.mpi_jobs("default").update(stored)
-        deadline = time.monotonic() + 15
-        gone = False
-        while time.monotonic() < deadline and not gone:
+        def pg_gone():
             try:
                 cluster.client.volcano_pod_groups("default").get("gang")
-                time.sleep(0.1)
+                return False
             except Exception:
-                gone = True
-        assert gone
+                return True
+        cluster.wait_until("scheduling.volcano.sh/v1beta1", "PodGroup",
+                           pg_gone, timeout=15,
+                           describe="PodGroup deleted on suspend")
+
+
+def test_e2e_elastic_autoscale_retrains_through_world_changes(tmp_path):
+    """Elastic autoscale (proposals/elastic-horovod.md:8-30 parity): the
+    elastic_train example consumes membership watch events and re-forms
+    its world at a checkpoint boundary (save -> new mesh -> restore)
+    while the test scales workers 3 -> 1 -> 2 mid-training."""
+    ckpt = str(tmp_path / "ckpt")
+    stop_file = str(tmp_path / "stop")
+    launcher_cmd = [
+        sys.executable, os.path.join(REPO_ROOT, "examples",
+                                     "elastic_train.py"),
+        "--steps", "100000", "--ckpt-dir", ckpt, "--poll", "0.15",
+        "--stop-file", stop_file]
+    worker_cmd = [sys.executable, "-c", "import time; time.sleep(180)"]
+
+    with LocalCluster() as cluster:
+        job = jax_job("auto", launcher_cmd=launcher_cmd,
+                      worker_cmd=worker_cmd, workers=3)
+        cluster.submit(job)
+
+        def logs():
+            return cluster.launcher_logs("default", "auto")
+
+        # training is live and has seen the full 3-worker membership
+        cluster.wait_until(
+            "v1", "Pod",
+            lambda: "world=3" in logs() or "new=3" in logs(),
+            timeout=120, describe="training observed world=3")
+
+        def scale(n):
+            stored = cluster.client.mpi_jobs("default").get("auto")
+            stored.spec.mpi_replica_specs["Worker"].replicas = n
+            cluster.client.mpi_jobs("default").update(stored)
+
+        scale(1)
+        cluster.wait_until("v1", "Pod", lambda: "new=1" in logs(),
+                           timeout=60, describe="world re-formed at 1")
+        scale(2)
+        cluster.wait_until("v1", "Pod", lambda: "new=2" in logs(),
+                           timeout=60, describe="world re-formed at 2")
+
+        open(stop_file, "w").close()  # graceful finish after final world
+        done = cluster.wait_for_condition("default", "auto",
+                                          constants.JOB_SUCCEEDED,
+                                          timeout=180)
+        final = logs()
+    assert done.status.completion_time is not None
+    assert "ELASTIC-TRAIN-OK" in final, final
+    # every world change went through the checkpoint boundary
+    assert "new=1 restored=True" in final.replace(
+        "old=3 ", "").replace("old=2 ", ""), final
+    ok_line = [l for l in final.splitlines()
+               if l.startswith("ELASTIC-TRAIN-OK")][0]
+    assert "->1" in ok_line and "->2" in ok_line, ok_line
+
+
+def test_e2e_gang_restart_recovers_job(tmp_path):
+    """RestartPolicy=ExitCode slice repair, live: one worker dies with a
+    retryable code (SIGTERM-style 143), the controller restarts the WHOLE
+    worker gang, and the job still completes."""
+    marker = str(tmp_path / "already-failed")
+    second_life = str(tmp_path / "second-life")
+    worker_script = (
+        "import os, sys, time\n"
+        "if not os.path.exists(%r):\n"
+        "    open(%r, 'w').close()\n"
+        "    sys.exit(143)\n"   # first life: retryable failure
+        "open(%r, 'w').close()\n"  # second life: the restarted gang
+        "time.sleep(60)\n" % (marker, marker, second_life))
+    # The launcher gates job completion on the SECOND generation running,
+    # so by success the gang restart has demonstrably happened.
+    launcher_script = (
+        "import os, time\n"
+        "deadline = time.monotonic() + 60\n"
+        "while time.monotonic() < deadline:\n"
+        "    if os.path.exists(%r):\n"
+        "        print('LAUNCHER-SAW-RESTART'); raise SystemExit(0)\n"
+        "    time.sleep(0.2)\n"
+        "raise SystemExit(1)\n" % second_life)
+    with LocalCluster() as cluster:
+        job = jax_job("gangr",
+                      launcher_cmd=[sys.executable, "-c", launcher_script],
+                      worker_cmd=[sys.executable, "-c", worker_script],
+                      workers=2)
+        job.worker_spec.restart_policy = constants.RESTART_POLICY_EXIT_CODE
+        cluster.submit(job)
+        done = cluster.wait_for_condition("default", "gangr",
+                                          constants.JOB_SUCCEEDED,
+                                          timeout=60)
+        assert done.metadata.annotations[
+            constants.GANG_RESTART_COUNT_ANNOTATION] == "1"
+        events = [e.reason for e in cluster.client.server.list(
+            "v1", "Event", "default")]
+        assert "GangRestart" in events, events
+    # the restarted (second-generation) gang demonstrably ran: its marker
+    # exists, and job success was gated on it (pods themselves may already
+    # be reaped by cleanPodPolicy after success)
+    assert os.path.exists(second_life)

@@ -1,0 +1,153 @@
+"""rsh launcher tests: the runnable MPI-parity path.
+
+Parity target: the reference e2e really executes mpirun-over-SSH pi jobs
+(/root/reference/test/e2e/mpi_job_test.go:87-205).  Here the launcher's
+rank formation runs for real — hostfile from the operator's ConfigMap,
+env matrix discovery, gang launch through the pluggable rsh agent — with
+a local agent standing in for sshd (no sshd in CI; the build/ssh image
+provides it on real clusters).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mpi_operator_tpu.bootstrap.rsh_launcher import (HostSlots,
+                                                     build_rank_commands,
+                                                     parse_hostfile,
+                                                     resolve_hostfile_path,
+                                                     run_gang, wait_for_dns)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RSH_LOCAL = f"{sys.executable} -m mpi_operator_tpu.bootstrap.rsh_local"
+
+
+def test_parse_hostfile_all_formats():
+    text = ("# comment\n"
+            "a-worker-0.a.default.svc slots=2\n"
+            "a-worker-1.a.default.svc:3\n"
+            "a-worker-2.a.default.svc\n"
+            "\n")
+    hosts = parse_hostfile(text)
+    assert hosts == [HostSlots("a-worker-0.a.default.svc", 2),
+                     HostSlots("a-worker-1.a.default.svc", 3),
+                     HostSlots("a-worker-2.a.default.svc", 1)]
+
+
+def test_resolve_hostfile_path_sandbox_translation(tmp_path):
+    """The kubelet materializes /etc/mpi into a sandbox dir and exports
+    the K_MOUNT_PATH_*/K_MOUNT_* mapping; the launcher must follow it."""
+    (tmp_path / "hostfile").write_text("h slots=1\n")
+    env = {
+        "OMPI_MCA_orte_default_hostfile": "/etc/mpi/hostfile",
+        "K_MOUNT_PATH_MPI_JOB_CONFIG": "/etc/mpi",
+        "K_MOUNT_MPI_JOB_CONFIG": str(tmp_path),
+    }
+    assert resolve_hostfile_path(env) == str(tmp_path / "hostfile")
+
+
+def test_resolve_hostfile_path_direct(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("h:1\n")
+    env = {"I_MPI_HYDRA_HOST_FILE": str(hf)}
+    assert resolve_hostfile_path(env) == str(hf)
+    assert resolve_hostfile_path({}) is None
+
+
+def test_dns_gate_resolves_and_times_out():
+    assert wait_for_dns(["localhost"], timeout=10.0)
+    with pytest.raises(RuntimeError, match="never resolved"):
+        wait_for_dns(["no-such-host.invalid"], timeout=0.5)
+    # non-ssh agents downgrade to a warning
+    assert not wait_for_dns(["no-such-host.invalid"], timeout=0.5,
+                            required=False, log=lambda *_: None)
+
+
+def test_build_rank_commands_env_and_agent_contract():
+    hosts = [HostSlots("h0", 2), HostSlots("h1", 1)]
+    cmds = build_rank_commands(hosts, ["prog", "arg"], ["ssh"],
+                               ["-o", "ConnectionAttempts=10"], 9999)
+    assert len(cmds) == 3
+    # rsh contract: agent + args + host + remote command
+    assert cmds[0][:4] == ["ssh", "-o", "ConnectionAttempts=10", "h0"]
+    assert cmds[2][3] == "h1"
+    assert "JAX_COORDINATOR_ADDRESS=h0:9999" in cmds[2]
+    assert "JAX_PROCESS_ID=2" in cmds[2]
+    assert "JAX_NUM_PROCESSES=3" in cmds[2]
+    assert "OMPI_COMM_WORLD_SIZE=3" in cmds[2]
+    assert cmds[0][-2:] == ["prog", "arg"]
+
+
+def test_run_gang_kills_rest_on_failure():
+    lines = []
+    code = run_gang([
+        [sys.executable, "-c", "import time; time.sleep(30)"],
+        [sys.executable, "-c", "import sys; sys.exit(3)"],
+    ], log=lines.append)
+    assert code == 3
+    assert any("rank 1 failed" in l for l in lines)
+
+
+def test_launcher_runs_native_pi_over_hostfile(tmp_path):
+    """Full rank formation through the launcher binary: hostfile -> rsh
+    agent -> 2 pi_native ranks forming a real TCP ring."""
+    from mpi_operator_tpu.native import build_native
+    exe = os.path.join(build_native(), "pi_native")
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost slots=2\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu.bootstrap.rsh_launcher",
+         "--rsh", RSH_LOCAL, "--hostfile", str(hf), "--",
+         exe, "200000"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "workers=2" in proc.stdout
+    pi = float(proc.stdout.split("pi=")[1].split()[0])
+    assert abs(pi - 3.14159) < 0.05
+
+
+def test_e2e_operator_mpi_path_launches_ranks(tmp_path):
+    """The MPI-parity e2e: an OpenMPI-implementation MPIJob whose
+    launcher is the rsh launcher.  Proves the operator's hostfile
+    ConfigMap + env matrix + volume mounts actually launch rank
+    processes (the reference's TestMPIJobSuccess shape, with the local
+    agent standing in for sshd)."""
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.k8s.core import EnvVar
+    from mpi_operator_tpu.native import build_native
+    from mpi_operator_tpu.server import LocalCluster
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    from test_e2e_local import jax_job
+
+    exe = os.path.join(build_native(), "pi_native")
+    # --coordinator 127.0.0.1: with the local agent the ranks run in the
+    # launcher pod, where the hostfile's cluster-DNS names do not resolve
+    launcher_cmd = [
+        sys.executable, "-m", "mpi_operator_tpu.bootstrap.rsh_launcher",
+        "--rsh", RSH_LOCAL, "--dns-timeout", "5",
+        "--coordinator", "127.0.0.1", "--", exe, "200000"]
+    # workers model the remote hosts; with the local agent the ranks run
+    # in the launcher pod, so workers just hold their slots
+    worker_cmd = [sys.executable, "-c", "import time; time.sleep(60)"]
+
+    with LocalCluster() as cluster:
+        job = jax_job("mpi-pi", launcher_cmd=launcher_cmd,
+                      worker_cmd=worker_cmd, workers=2)
+        job.spec.mpi_implementation = constants.IMPL_OPENMPI
+        launcher = job.spec.mpi_replica_specs[
+            constants.REPLICA_TYPE_LAUNCHER]
+        launcher.template.spec.containers[0].env.append(
+            EnvVar("PYTHONPATH", REPO_ROOT))
+        cluster.submit(job)
+        cluster.wait_for_condition("default", "mpi-pi",
+                                   constants.JOB_SUCCEEDED, timeout=120)
+        logs = cluster.launcher_logs("default", "mpi-pi")
+    assert "launching 2 ranks across 2 hosts" in logs, logs
+    assert "workers=2" in logs, logs
+    pi = float(logs.split("pi=")[1].split()[0])
+    assert abs(pi - 3.14159) < 0.05, logs

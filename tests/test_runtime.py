@@ -102,3 +102,41 @@ def test_kubelet_on_failure_restarts_in_place():
         assert statuses and statuses[0].restart_count >= 1
     finally:
         kl.stop()
+
+
+def test_kubelet_maps_signal_deaths_to_runtime_exit_codes():
+    """Popen reports signal kills as -signum; container runtimes report
+    128+signum — the ExitCode gang policy depends on the latter."""
+    import sys
+    import time
+
+    from mpi_operator_tpu.k8s import core
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    from mpi_operator_tpu.runtime.kubelet import LocalKubelet
+
+    client = Clientset()
+    kubelet = LocalKubelet(client)
+    kubelet.start()
+    try:
+        pod = core.Pod(
+            metadata=ObjectMeta(name="sig", namespace="default"),
+            spec=core.PodSpec(restart_policy="Never", containers=[
+                core.Container(name="c", image="local", command=[
+                    sys.executable, "-c",
+                    "import os, signal; os.kill(os.getpid(),"
+                    " signal.SIGTERM)"])]))
+        client.pods("default").create(pod)
+        deadline = time.monotonic() + 20
+        phase = ""
+        while time.monotonic() < deadline:
+            p = client.pods("default").get("sig")
+            phase = p.status.phase
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.1)
+        assert phase == "Failed"
+        term = p.status.container_statuses[0].state.terminated
+        assert term.exit_code == 128 + 15  # SIGTERM -> 143
+    finally:
+        kubelet.stop()
