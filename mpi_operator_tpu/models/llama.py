@@ -122,14 +122,17 @@ def _scale_rope_freqs(freqs, scaling: dict):
 
 
 def _rope(x, positions, theta: float, scaling: Optional[dict] = None):
-    """Rotary embedding on [B, S, H, D] with positions [S]."""
+    """Rotary embedding on [B, S, H, D]; positions [S] (shared across the
+    batch) or [B, S] (per-row, the variable-length decode path)."""
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     if scaling is not None:
         freqs = _scale_rope_freqs(freqs, scaling)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S,d/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [...,S,d/2]
+    if angles.ndim == 2:
+        angles = angles[None]                                  # [1,S,d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
@@ -173,7 +176,10 @@ class LlamaAttention(nn.Module):
 
         if decode:
             # Autoregressive KV cache (flax 'cache' collection).  The
-            # cache index doubles as the position offset for RoPE.
+            # cache index is PER ROW (shape [B]) and doubles as the
+            # position offset for RoPE — rows decode at independent
+            # positions, which is what variable-length batched serving
+            # needs (generate() sets it to each row's prompt length).
             cached_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
                 (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
@@ -182,8 +188,8 @@ class LlamaAttention(nn.Module):
                 (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
             cache_index = self.variable(
                 "cache", "cache_index",
-                lambda: jnp.zeros((), jnp.int32))
-            positions = cache_index.value + jnp.arange(s)
+                lambda: jnp.zeros((b,), jnp.int32))
+            positions = cache_index.value[:, None] + jnp.arange(s)[None, :]
 
         q = dense((cfg.n_heads, cfg.head_dim), "wq")(x)
         k = dense((cfg.kv_heads, cfg.head_dim), "wk")(x)
@@ -194,10 +200,12 @@ class LlamaAttention(nn.Module):
 
         if decode:
             idx = cache_index.value
-            k_all = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-            v_all = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            # Per-row insertion at each row's own index.
+            row_update = jax.vmap(
+                lambda cache, new, i: jax.lax.dynamic_update_slice(
+                    cache, new, (i, 0, 0)))
+            k_all = row_update(cached_k.value, k.astype(cfg.dtype), idx)
+            v_all = row_update(cached_v.value, v.astype(cfg.dtype), idx)
             cached_k.value = k_all
             cached_v.value = v_all
             cache_index.value = idx + s
@@ -231,8 +239,9 @@ class LlamaAttention(nn.Module):
 
 def _decode_attention(q, k_cache, v_cache, positions, gqa_repeat: int):
     """Cached attention: q [B,S,H,D] against the full cache [B,L,KH,D];
-    keys beyond each query's position are masked (covers both the unused
-    cache tail and intra-step causality)."""
+    keys beyond each query's position are masked (covers the unused cache
+    tail, stale padding slots and intra-step causality).  positions is
+    per-row [B,S]."""
     import math as _math
     if gqa_repeat > 1:
         k_cache = jnp.repeat(k_cache, gqa_repeat, axis=2)
@@ -241,8 +250,8 @@ def _decode_attention(q, k_cache, v_cache, positions, gqa_repeat: int):
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
                         k_cache.astype(jnp.float32))
     kv_pos = jnp.arange(k_cache.shape[1])
-    mask = kv_pos[None, :] <= positions[:, None]           # [S, L]
-    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    mask = kv_pos[None, None, :] <= positions[:, :, None]  # [B, S, L]
+    scores = jnp.where(mask[:, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs,
                      v_cache.astype(jnp.float32))
@@ -383,12 +392,27 @@ def _select_token(logits, temperature: float, top_p: float, rng):
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def _set_cache_index(cache, lengths):
+    """Rewrite every per-layer cache_index leaf to the given [B] vector
+    (variable-length prefill: each row resumes at its own prompt end)."""
+    def rec(node):
+        if hasattr(node, "items"):
+            return {k: (lengths if k == "cache_index" else rec(v))
+                    for k, v in node.items()}
+        return node
+    return rec(cache)
+
+
 def generate(model: LlamaModel, variables, prompt_tokens,
              max_new_tokens: int, temperature: float = 0.0,
-             top_p: float = 1.0, rng=None):
+             top_p: float = 1.0, rng=None, prompt_lengths=None):
     """KV-cache decoding: prefill the prompt, then one token per step.
     temperature=0 is greedy; otherwise nucleus (top-p) sampling.
-    Returns [B, max_new_tokens] generated ids."""
+
+    prompt_tokens [B, S] may be right-padded to a common S; pass
+    prompt_lengths [B] with each row's true length and every row decodes
+    from its own position (per-row cache index; stale padding slots are
+    masked/overwritten).  Returns [B, max_new_tokens] generated ids."""
     import functools
 
     if max_new_tokens <= 0:
@@ -410,8 +434,17 @@ def generate(model: LlamaModel, variables, prompt_tokens,
     logits, state = model.apply(params, prompt_tokens, decode=True,
                                 mutable=["cache"])
     cache = state["cache"]
+    if hasattr(cache, "unfreeze"):  # flax FrozenDict compatibility
+        cache = cache.unfreeze()
+    if prompt_lengths is not None:
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        cache = _set_cache_index(cache, lengths)
+        last_logits = logits[jnp.arange(prompt_tokens.shape[0]),
+                             lengths - 1]
+    else:
+        last_logits = logits[:, -1]
     rng, sub = jax.random.split(rng)
-    next_token = _select_token(logits[:, -1], temperature, top_p, sub)
+    next_token = _select_token(last_logits, temperature, top_p, sub)
 
     @functools.partial(jax.jit)
     def step(cache, token, rng):

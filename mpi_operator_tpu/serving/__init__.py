@@ -1,3 +1,4 @@
 """Model serving over the KV-cache decode path."""
 
+from .batcher import ContinuousBatcher  # noqa: F401
 from .server import InferenceServer  # noqa: F401

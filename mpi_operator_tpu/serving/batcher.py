@@ -1,0 +1,225 @@
+"""Continuous batching for the KV-cache decode path.
+
+Requests arrive at arbitrary times; instead of serializing whole
+generations (single-flight) the batcher keeps B persistent cache slots
+and runs ONE decode step per tick across every active slot — new
+requests are prefilled into free slots between ticks and finished slots
+are freed immediately (vLLM-style iteration-level scheduling, greedy
+decoding).  Built on the per-row cache index (models/llama.py): each
+slot decodes at its own position, so mixed-length, mixed-arrival
+sequences coexist in one batch.
+
+The decode step is jitted once for the fixed slot count; prefill is
+jitted per padded prompt-width bucket (powers of two) to bound
+recompilation.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class _Request:
+    tokens: List[int]
+    max_new_tokens: int
+    done: threading.Event = field(default_factory=threading.Event)
+    output: List[int] = field(default_factory=list)
+    error: Optional[Exception] = None
+
+
+def _bucket(n: int, cap: int) -> int:
+    width = 8
+    while width < n:
+        width *= 2
+    return min(width, cap)  # never pad past the cache length
+
+
+class ContinuousBatcher:
+    """Greedy continuous-batching scheduler over `model`'s decode path."""
+
+    def __init__(self, model, variables, max_slots: int = 4,
+                 device_lock: Optional[threading.Lock] = None):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.variables = variables
+        self.max_slots = max_slots
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Shared with other users of the same device (e.g. the server's
+        # non-batched generate path) so at most one model computation is
+        # in flight at a time; taken per decode tick / prefill, not for
+        # whole generations.
+        self._device_lock = device_lock or threading.Lock()
+
+        cfg = model.config
+        self._jnp = jnp
+        self._jax = jax
+        params = {"params": variables["params"]}
+
+        # Persistent slot cache, initialized by tracing a dummy decode.
+        _, state = model.apply(
+            params, jnp.zeros((max_slots, 1), jnp.int32), decode=True,
+            mutable=["cache"])
+        cache = state["cache"]
+        if hasattr(cache, "unfreeze"):
+            cache = cache.unfreeze()
+        self._cache = self._reset_cache(cache)
+
+        @jax.jit
+        def decode_step(cache, tokens):
+            logits, state = model.apply(
+                {**params, "cache": cache}, tokens[:, None], decode=True,
+                mutable=["cache"])
+            return state["cache"], jnp.argmax(
+                logits[:, -1], axis=-1).astype(jnp.int32)
+
+        self._decode_step = decode_step
+        self._prefill_cache = {}
+        self._max_seq_len = cfg.max_seq_len
+
+    # -- cache plumbing ----------------------------------------------------
+    def _reset_cache(self, cache):
+        return self._jax.tree_util.tree_map(self._jnp.zeros_like, cache)
+
+    def _prefill(self, tokens: List[int]):
+        """Single-sequence prefill -> (cache_row_tree, next_token)."""
+        jax, jnp = self._jax, self._jnp
+        width = _bucket(len(tokens), self._max_seq_len)
+        fn = self._prefill_cache.get(width)
+        if fn is None:
+            params = {"params": self.variables["params"]}
+
+            @jax.jit
+            def prefill(padded, length):
+                logits, state = self.model.apply(
+                    params, padded, decode=True, mutable=["cache"])
+                cache = state["cache"]
+                next_tok = jnp.argmax(logits[0, length - 1]).astype(jnp.int32)
+                return cache, next_tok
+
+            fn = self._prefill_cache[width] = prefill
+        padded = jnp.asarray([tokens + [0] * (width - len(tokens))],
+                             jnp.int32)
+        return fn(padded, len(tokens))
+
+    def _install(self, slot: int, row_cache, length: int):
+        """Copy a batch-1 prefill cache into persistent slot `slot`."""
+        jnp = self._jnp
+        if hasattr(row_cache, "unfreeze"):
+            row_cache = row_cache.unfreeze()
+
+        def rec(dst, src):
+            if hasattr(dst, "items"):
+                return {k: rec(dst[k], src[k]) for k in dst}
+            if dst.ndim >= 2:  # cached_key/value [B, L, KH, D]
+                L = min(dst.shape[1], src.shape[1])
+                return dst.at[slot, :L].set(src[0, :L])
+            return dst.at[slot].set(jnp.int32(length))  # cache_index [B]
+        self._cache = rec(self._cache, row_cache)
+
+    # -- public API --------------------------------------------------------
+    def submit(self, tokens: List[int], max_new_tokens: int,
+               timeout: float = 300.0) -> List[int]:
+        if max_new_tokens <= 0:
+            return []  # match generate()'s [B, 0] semantics
+        if len(tokens) + max_new_tokens > self._max_seq_len:
+            raise ValueError(
+                f"prompt ({len(tokens)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"{self._max_seq_len}")
+        if self._stop.is_set():
+            raise RuntimeError("batcher stopped")
+        req = _Request(list(map(int, tokens)), max_new_tokens)
+        self._queue.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        return req.output
+
+    def start(self) -> "ContinuousBatcher":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="continuous-batcher")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- scheduler loop ----------------------------------------------------
+    def _loop(self) -> None:
+        jnp = self._jnp
+        slots: List[Optional[_Request]] = [None] * self.max_slots
+        next_tokens = jnp.zeros((self.max_slots,), jnp.int32)
+
+        while not self._stop.is_set():
+            # Admit new requests into free slots.
+            admitted = False
+            for i in range(self.max_slots):
+                if slots[i] is not None:
+                    continue
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    with self._device_lock:
+                        row_cache, first = self._prefill(req.tokens)
+                        self._install(i, row_cache, len(req.tokens))
+                    req.output.append(int(first))
+                    if len(req.output) >= req.max_new_tokens:
+                        req.done.set()
+                        continue
+                    slots[i] = req
+                    next_tokens = next_tokens.at[i].set(int(first))
+                    admitted = True
+                except Exception as exc:  # surface, don't kill the loop
+                    req.error = exc
+                    req.done.set()
+
+            if not any(s is not None for s in slots):
+                if not admitted:
+                    # idle: block briefly for work
+                    try:
+                        req = self._queue.get(timeout=0.05)
+                        self._queue.put(req)
+                    except queue.Empty:
+                        pass
+                continue
+
+            # One decode step across every slot (inactive slots decode
+            # garbage into their own rows; they are reset on admit).
+            with self._device_lock:
+                self._cache, out = self._decode_step(self._cache,
+                                                     next_tokens)
+            next_tokens = out
+            for i, req in enumerate(slots):
+                if req is None:
+                    continue
+                req.output.append(int(out[i]))
+                if len(req.output) >= req.max_new_tokens:
+                    req.done.set()
+                    slots[i] = None
+
+        # drain on shutdown (submit() rejects once _stop is set, so this
+        # converges; get_nowait is the only safe concurrent drain)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = RuntimeError("batcher stopped")
+            req.done.set()
+        for req in slots:
+            if req is not None:
+                req.error = RuntimeError("batcher stopped")
+                req.done.set()

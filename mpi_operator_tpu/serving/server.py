@@ -66,7 +66,7 @@ class InferenceServer:
     # /generate is unauthenticated and compute-expensive, so exposing it
     # on all interfaces must be an explicit opt-in (host="0.0.0.0").
     def __init__(self, model, variables, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, max_batch_slots: int = 0):
         self.model = model
         self.variables = variables
         self._lock = threading.Lock()
@@ -74,6 +74,16 @@ class InferenceServer:
         self._http.inference = self  # type: ignore[attr-defined]
         self.port = self._http.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        # Optional continuous batching (greedy single-sequence requests
+        # share decode ticks instead of serializing whole generations).
+        # The batcher shares this server's device lock, so batcher ticks
+        # and non-batched generations still never overlap on the device.
+        self._batcher = None
+        if max_batch_slots > 0:
+            from .batcher import ContinuousBatcher
+            self._batcher = ContinuousBatcher(model, variables,
+                                              max_slots=max_batch_slots,
+                                              device_lock=self._lock)
 
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,
@@ -84,24 +94,50 @@ class InferenceServer:
 
         from ..models.llama import generate
 
-        prompt = jnp.asarray(tokens, jnp.int32)
-        if prompt.ndim == 1:
-            prompt = prompt[None]
+        # Accept one sequence or a batch of VARIABLE-LENGTH sequences
+        # (lists or numpy/jnp arrays): right-pad to a rectangle and let
+        # the per-row cache index decode each row from its own prompt end.
+        if hasattr(tokens, "tolist"):
+            tokens = tokens.tolist()
+        tokens = list(tokens)
+        if tokens and isinstance(tokens[0], (list, tuple)) or \
+                (tokens and hasattr(tokens[0], "tolist")):
+            rows = [list(map(int, r)) for r in tokens]
+        else:
+            rows = [list(map(int, tokens))]
+        if any(not r for r in rows):
+            raise ValueError("empty prompt")
+        # Greedy single-sequence requests ride the continuous batcher so
+        # concurrent clients share decode ticks.
+        if self._batcher is not None and len(rows) == 1 \
+                and temperature == 0.0:
+            return [self._batcher.submit(rows[0], max_new_tokens)]
+        lengths = [len(r) for r in rows]
+        width = max(lengths)
+        prompt = jnp.asarray([r + [0] * (width - len(r)) for r in rows],
+                             jnp.int32)
+        prompt_lengths = jnp.asarray(lengths, jnp.int32) \
+            if len(set(lengths)) > 1 else None
         rng = jax.random.PRNGKey(int(seed)) if seed is not None else None
         with self._lock:  # accelerator is single-flight
             out = generate(self.model, self.variables, prompt,
                            max_new_tokens, temperature=temperature,
-                           top_p=top_p, rng=rng)
+                           top_p=top_p, rng=rng,
+                           prompt_lengths=prompt_lengths)
         return [[int(t) for t in row] for row in out]
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "InferenceServer":
+        if self._batcher is not None:
+            self._batcher.start()
         self._thread = threading.Thread(target=self._http.serve_forever,
                                         daemon=True, name="inference")
         self._thread.start()
         return self
 
     def stop(self) -> None:
+        if self._batcher is not None:
+            self._batcher.stop()
         self._http.shutdown()
         self._http.server_close()
 

@@ -84,3 +84,27 @@ def test_healthz(served):
     server, *_ = served
     with urllib.request.urlopen(server.url + "/healthz", timeout=10) as r:
         assert r.status == 200
+
+
+def test_generate_endpoint_variable_length_batch(served):
+    """A batch of different-length prompts decodes each row exactly as it
+    would alone (per-row KV cache positions)."""
+    server, model, variables, cfg = served
+    prompts = [[1, 2, 3, 4, 5, 6], [9, 8]]
+    status, body = _post(server.url + "/generate",
+                         {"tokens": prompts, "max_new_tokens": 4})
+    assert status == 200
+    for i, p in enumerate(prompts):
+        direct = greedy_generate(model, variables,
+                                 jax.numpy.asarray([p]), 4)
+        np.testing.assert_array_equal(np.asarray(body["tokens"][i]),
+                                      np.asarray(direct[0]), err_msg=str(i))
+
+
+def test_generate_accepts_numpy_arrays(served):
+    """Direct API callers may pass numpy/jnp arrays, not just lists."""
+    server, model, variables, cfg = served
+    arr = np.asarray([[1, 2, 3, 4]])
+    out = server.generate(arr, max_new_tokens=3)
+    direct = greedy_generate(model, variables, jax.numpy.asarray(arr), 3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(direct))
