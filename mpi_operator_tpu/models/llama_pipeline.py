@@ -41,6 +41,23 @@ def _reshape_for_stages(stacked: dict, pp: int) -> dict:
     return jax.tree_util.tree_map(reshape, stacked)
 
 
+
+def _staged_blocks(config: LlamaConfig, variables: dict, positions, pp: int):
+    """Shared per-stage body + stacked params for both pipeline
+    schedules: each stage scans its layers_per_stage blocks (one
+    compiled block body)."""
+    block = LlamaBlock(config)
+
+    def stage_fn(stage_params, x):
+        def body(x, layer_params):
+            return block.apply({"params": layer_params}, x, positions), None
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+
+    staged = _reshape_for_stages(stack_block_params(variables, config), pp)
+    return stage_fn, staged
+
+
 def pipeline_forward(config: LlamaConfig, variables: dict, tokens,
                      mesh, num_microbatches: int = 4):
     """Pipelined causal-LM forward: tokens [B, S] -> logits [B, S, V].
@@ -58,15 +75,7 @@ def pipeline_forward(config: LlamaConfig, variables: dict, tokens,
     emb = params["tok_embeddings"]["embedding"]
     x = jnp.asarray(emb)[tokens].astype(config.dtype)
 
-    block = LlamaBlock(config)          # single compiled block body
-
-    def stage_fn(stage_params, x):
-        def body(x, layer_params):
-            return block.apply({"params": layer_params}, x, positions), None
-        x, _ = jax.lax.scan(body, x, stage_params)
-        return x
-
-    staged = _reshape_for_stages(stack_block_params(variables, config), pp)
+    stage_fn, staged = _staged_blocks(config, variables, positions, pp)
     micro = split_microbatches(x, num_microbatches)
     x = merge_microbatches(pipeline_apply(stage_fn, staged, micro, mesh))
 
@@ -82,3 +91,55 @@ def pipeline_loss(config: LlamaConfig, variables: dict, tokens, mesh,
     logits = pipeline_forward(config, variables, tokens, mesh,
                               num_microbatches)
     return next_token_loss(logits, tokens)
+
+
+def pipeline_loss_and_grads_1f1b(config: LlamaConfig, variables: dict,
+                                 tokens, mesh, num_microbatches: int = 4):
+    """Fused 1F1B training step core: (loss, grads) in one pipelined
+    pass with the 1F1B schedule (parallel/pipeline.pipeline_1f1b) —
+    activation memory bounded by pipeline depth instead of microbatch
+    count, stage forwards rematerialized in the backward.
+
+    Returns (loss, grads) where grads matches variables["params"]'s
+    structure exactly (verified against jax.grad of the sequential
+    model), ready for optax.
+    """
+    from ..parallel.pipeline import pipeline_1f1b, split_microbatches
+    from .llama import next_token_loss
+
+    pp = mesh.shape["pp"]
+    assert config.n_layers % pp == 0, (config.n_layers, pp)
+    params = variables["params"]
+    s = tokens.shape[1]
+    positions = jnp.arange(s)
+    stage_fn, staged = _staged_blocks(config, variables, positions, pp)
+    token_micro = split_microbatches(tokens, num_microbatches)
+    emb = jnp.asarray(params["tok_embeddings"]["embedding"])
+
+    def embed(emb_param):
+        return emb_param[token_micro].astype(config.dtype)
+
+    x_micro, embed_vjp = jax.vjp(embed, emb)
+
+    head_params = {"norm": params["norm"], "output": params["output"]}
+    norm = RMSNorm(config.norm_eps, config.param_dtype)
+
+    def head_fn(hp, y, toks, m):
+        h = norm.apply({"params": hp["norm"]}, y)
+        logits = h @ hp["output"]["kernel"].astype(config.dtype)
+        return next_token_loss(logits, toks)
+
+    loss, stage_grads, head_grads, dx = pipeline_1f1b(
+        stage_fn, head_fn, staged, head_params, x_micro, mesh,
+        aux=token_micro)
+
+    (d_emb,) = embed_vjp(dx.astype(x_micro.dtype))
+    layer_grads = jax.tree_util.tree_map(
+        lambda g: g.reshape((config.n_layers,) + g.shape[2:]), stage_grads)
+    grads = {"tok_embeddings": {"embedding": d_emb},
+             "norm": head_grads["norm"],
+             "output": head_grads["output"]}
+    for i in range(config.n_layers):
+        grads[f"layers_{i}"] = jax.tree_util.tree_map(
+            lambda g: g[i], layer_grads)
+    return loss, grads

@@ -119,3 +119,254 @@ def split_microbatches(x, num_microbatches: int):
 def merge_microbatches(x):
     """[M, mb, ...] -> [B, ...]."""
     return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (fused forward+backward) schedule
+# ---------------------------------------------------------------------------
+
+def _simulate_1f1b(n_stages: int, n_micro: int):
+    """Event-driven static schedule: per (stage, tick) which microbatch to
+    Forward and which to Backward (-1 = idle slot).  Each tick has one F
+    slot and one B slot per stage (the standard SPMD 1F1B step); at most
+    P - p microbatches are in flight at stage p, which is the 1F1B
+    activation-memory bound this schedule exists for."""
+    import numpy as np
+
+    P, M = n_stages, n_micro
+    t_max = 2 * (M + P) + 4
+    fwd = -np.ones((P, t_max), np.int32)
+    bwd = -np.ones((P, t_max), np.int32)
+    fwd_done = np.full((P, M), t_max + 1)
+    bwd_done = np.full((P, M), t_max + 1)
+    nf = [0] * P
+    nb = [0] * P
+
+    end = 0
+    for t in range(t_max):
+        if all(nb[p] == M for p in range(P)):
+            end = t
+            break
+        for p in range(P):
+            # F slot: activation from the left arrived on an EARLIER tick
+            # (stage 0 always has its input), bounded in-flight window.
+            if nf[p] < M:
+                m = nf[p]
+                avail = (p == 0) or (fwd_done[p - 1][m] < t)
+                if avail and (nf[p] - nb[p]) < (P - p):
+                    fwd[p][t] = m
+                    fwd_done[p][m] = t
+                    nf[p] += 1
+            # B slot: dy from the right arrived earlier; the last stage
+            # builds dy from its own F of the same tick (F runs first in
+            # the step body).
+            if nb[p] < M:
+                m = nb[p]
+                ready = (fwd_done[P - 1][m] <= t) if p == P - 1 \
+                    else (bwd_done[p + 1][m] < t)
+                if ready:
+                    bwd[p][t] = m
+                    bwd_done[p][m] = t
+                    nb[p] += 1
+    else:
+        raise RuntimeError("1F1B schedule did not converge")
+    return fwd[:, :end], bwd[:, :end], end
+
+
+def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
+                  head_params, microbatches, mesh, axis_name: str = "pp",
+                  batch_axes=("dp", "fsdp"), aux=None):
+    """Fused forward+backward pipeline with the 1F1B schedule.
+
+    GPipe (`pipeline_apply` + autodiff) keeps one activation per
+    microbatch alive across the whole forward — O(M) memory.  1F1B
+    interleaves each stage's backwards between forwards so at most
+    P - p microbatch inputs are resident per stage (ring buffers of
+    size P), recomputing the stage forward inside the backward (remat).
+
+    - stage_fn(params, x) -> y, homogeneous stages (y.shape == x.shape).
+    - head_fn(head_params, y, m) -> scalar loss for microbatch m
+      (applied at the LAST stage; total loss is the mean over M).  With
+      ``aux`` ([M, mb, ...], sharded like microbatches — e.g. target
+      token ids) the signature becomes head_fn(head_params, y, aux_m, m)
+      and aux is treated as non-differentiable.
+    - stacked_params: pytree with leading dim P; head_params: any pytree.
+    - microbatches: [M, mb, ...].
+
+    Returns (loss, stage_grads, head_grads, dx) where stage_grads has
+    the same stacked [P, ...] layout, and dx [M, mb, ...] is the loss
+    gradient w.r.t. microbatches (feed it to the embedding backward).
+    Gradients are exact (tested against jax.grad of the sequential
+    model).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis_name]
+    m_count = microbatches.shape[0]
+    if m_count < n_stages:
+        raise ValueError(
+            f"1F1B needs microbatches >= stages ({m_count} < {n_stages})")
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stacked stage dim {leaf.shape[0]} != mesh"
+                f" {axis_name}={n_stages}")
+
+    fwd_np, bwd_np, n_ticks = _simulate_1f1b(n_stages, m_count)
+    fwd_table = jnp.asarray(fwd_np)
+    bwd_table = jnp.asarray(bwd_np)
+
+    def body(stacked_local, head_local, xs, xs_aux):
+        p = jax.lax.axis_index(axis_name)
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        mb_shape = xs.shape[1:]
+        last = n_stages - 1
+        right_perm = [(i, i + 1) for i in range(n_stages - 1)]
+        left_perm = [(i + 1, i) for i in range(n_stages - 1)]
+
+        def take_row(table, row):
+            safe = jnp.clip(row, 0, n_stages - 1)
+            return jnp.where((row >= 0) & (row < n_stages),
+                             table[safe], -1)
+
+        zeros_mb = jnp.zeros(mb_shape, xs.dtype)
+        carry0 = {
+            "fwd_buf": jnp.zeros((n_stages,) + mb_shape, xs.dtype),
+            "bwd_buf": jnp.zeros((n_stages,) + mb_shape, jnp.float32),
+            "x_buf": jnp.zeros((n_stages,) + mb_shape, xs.dtype),
+            "grads": jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), params),
+            "head_grads": jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), head_local),
+            "dx": jnp.zeros((m_count,) + mb_shape, jnp.float32),
+            "loss": jnp.float32(0.0),
+        }
+
+        def step(carry, t):
+            my_f = take_row(fwd_table, p)[t]
+            my_b = take_row(bwd_table, p)[t]
+
+            # ---- F slot -------------------------------------------------
+            f_m = jnp.maximum(my_f, 0)
+            x_in = jnp.where(
+                p == 0, xs[f_m],
+                carry["fwd_buf"][f_m % n_stages])
+            y = stage_fn(params, x_in)
+            do_f = my_f >= 0
+            x_buf = jnp.where(
+                do_f,
+                carry["x_buf"].at[f_m % n_stages].set(x_in),
+                carry["x_buf"])
+
+            # Last stage: head loss + dy for this microbatch, queued for
+            # the B slot (possibly this same tick).
+            def head_loss(hp, yy):
+                if xs_aux is None:
+                    return head_fn(hp, yy, f_m)
+                return head_fn(hp, yy, xs_aux[f_m], f_m)
+            (loss_m, (dhead_m, dy_m)) = _head_value_and_grads(
+                head_loss, head_local, y)
+            is_last = p == last
+            f_here = do_f & is_last
+            loss = carry["loss"] + jnp.where(f_here, loss_m / m_count, 0.0)
+            head_grads = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(f_here, g / m_count, 0.0),
+                carry["head_grads"], dhead_m)
+            bwd_buf = jnp.where(
+                f_here,
+                carry["bwd_buf"].at[f_m % n_stages].set(
+                    dy_m.astype(jnp.float32) / m_count),
+                carry["bwd_buf"])
+
+            # ---- B slot (remat: recompute the stage forward) ------------
+            b_m = jnp.maximum(my_b, 0)
+            x_saved = x_buf[b_m % n_stages]
+            dy = bwd_buf[b_m % n_stages].astype(xs.dtype)
+            _, vjp_fn = jax.vjp(lambda pr, xx: stage_fn(pr, xx), params,
+                                x_saved)
+            dparams, dx_m = vjp_fn(dy)
+            do_b = my_b >= 0
+            grads = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(do_b,
+                                               g.astype(jnp.float32), 0.0),
+                carry["grads"], dparams)
+            dx = jnp.where(
+                do_b & (p == 0),
+                carry["dx"].at[b_m].set(dx_m.astype(jnp.float32)),
+                carry["dx"])
+
+            # ---- communication -----------------------------------------
+            # forward activation to the right
+            f_msg = jnp.where(do_f & (p < last), y, zeros_mb)
+            f_in = jax.lax.ppermute(f_msg, axis_name, right_perm)
+            left_f = take_row(fwd_table, p - 1)[t]
+            fwd_buf = jnp.where(
+                (p > 0) & (left_f >= 0),
+                carry["fwd_buf"].at[jnp.maximum(left_f, 0)
+                                    % n_stages].set(f_in),
+                carry["fwd_buf"])
+            # backward gradient to the left
+            b_msg = jnp.where(do_b & (p > 0),
+                              dx_m.astype(jnp.float32),
+                              jnp.zeros(mb_shape, jnp.float32))
+            b_in = jax.lax.ppermute(b_msg, axis_name, left_perm)
+            right_b = take_row(bwd_table, p + 1)[t]
+            bwd_buf = jnp.where(
+                (p < last) & (right_b >= 0),
+                bwd_buf.at[jnp.maximum(right_b, 0) % n_stages].set(b_in),
+                bwd_buf)
+
+            return {"fwd_buf": fwd_buf, "bwd_buf": bwd_buf, "x_buf": x_buf,
+                    "grads": grads, "head_grads": head_grads, "dx": dx,
+                    "loss": loss}, None
+
+        carry, _ = jax.lax.scan(step, carry0, jnp.arange(n_ticks))
+
+        # Collect: loss/head grads live on the last stage, dx on stage 0,
+        # stage grads stay per-stage (leading dim 1 -> 'pp').  Each
+        # batch-axis member saw only its local shard, so loss and param
+        # grads need the data-parallel mean autodiff would have inserted
+        # (dx stays per-shard — it is batch-sharded output).
+        on = lambda cond, x: jnp.where(cond, x, jnp.zeros_like(x))  # noqa
+        dp_axes = tuple(a for a in batch_axes if a in mesh.shape)
+        dp_mean = (lambda v: jax.lax.pmean(v, dp_axes)) if dp_axes \
+            else (lambda v: v)
+        loss = dp_mean(jax.lax.psum(on(p == last, carry["loss"]),
+                                    axis_name))
+        head_grads = jax.tree_util.tree_map(
+            lambda g: dp_mean(jax.lax.psum(on(p == last, g), axis_name)),
+            carry["head_grads"])
+        # dx is d(LOCAL shard mean)/dx_local; the global loss is the mean
+        # over shards, so each shard's input gradient carries 1/n_dp.
+        n_dp = 1
+        for a in dp_axes:
+            n_dp *= mesh.shape[a]
+        dx = jax.lax.psum(on(p == 0, carry["dx"]), axis_name) / n_dp
+        stage_grads = jax.tree_util.tree_map(
+            lambda g: dp_mean(g)[None], carry["grads"])
+        return loss, stage_grads, head_grads, dx
+
+    extra = [None] * (microbatches.ndim - 2)
+    x_spec = P(None, batch_axes, *extra)
+    rep = P()
+    aux_spec = None
+    if aux is not None:
+        aux_spec = P(None, batch_axes, *([None] * (aux.ndim - 2)))
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(stage_param_specs(stacked_params),
+                  jax.tree_util.tree_map(lambda _: rep, head_params),
+                  x_spec, aux_spec),
+        out_specs=(rep,
+                   stage_param_specs(stacked_params),
+                   jax.tree_util.tree_map(lambda _: rep, head_params),
+                   P(None, batch_axes, *extra)),
+        check_vma=False)
+    return fn(stacked_params, head_params, microbatches, aux)
+
+
+def _head_value_and_grads(head_loss, head_params, y):
+    """(loss, (d head_params, d y)) for the last-stage loss head."""
+    loss, vjp_fn = jax.vjp(head_loss, head_params, y)
+    dhead, dy = vjp_fn(jnp.float32(1.0))
+    return loss, (dhead, dy)
