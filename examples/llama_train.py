@@ -29,7 +29,12 @@ def main() -> int:
     parser.add_argument("--fsdp", type=int, default=1)
     parser.add_argument("--ep", type=int, default=1)
     parser.add_argument("--pp", type=int, default=1,
-                        help=">1 switches to the GPipe pipelined forward")
+                        help=">1 switches to the pipelined forward")
+    parser.add_argument("--pipeline-schedule", default="gpipe",
+                        choices=["gpipe", "1f1b"],
+                        help="gpipe: fill-drain + autodiff; 1f1b: fused"
+                             " fwd/bwd, activation memory bounded by"
+                             " pipeline depth")
     parser.add_argument("--microbatches", type=int, default=4)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
@@ -87,6 +92,44 @@ def main() -> int:
         from mpi_operator_tpu.utils import CheckpointManager
         mgr = CheckpointManager(args.checkpoint_dir,
                                 every=args.checkpoint_every)
+
+    if args.pp > 1 and args.pipeline_schedule == "1f1b":
+        # Fused schedule: the pipeline produces (loss, grads) directly,
+        # so the step applies optax to them instead of value_and_grad.
+        from mpi_operator_tpu.models.llama_pipeline import (
+            pipeline_loss_and_grads_1f1b)
+
+        tx = optax.adamw(3e-4)
+        with mesh:
+            opt_state = tx.init(params["params"])
+
+            @jax.jit
+            def f1_step(variables, opt_state, batch):
+                loss, grads = pipeline_loss_and_grads_1f1b(
+                    cfg, variables, batch, mesh, args.microbatches)
+                updates, opt_state = tx.update(grads, opt_state,
+                                               variables["params"])
+                return ({"params": optax.apply_updates(
+                    variables["params"], updates)}, opt_state, loss)
+
+            tokens = jax.device_put(tokens, seq_batch_sharding(mesh))
+            params, opt_state, loss = f1_step(params, opt_state, tokens)
+            float(loss)  # compile + first step
+            start = time.perf_counter()
+            for _ in range(args.steps):
+                params, opt_state, loss = f1_step(params, opt_state,
+                                                  tokens)
+            final_loss = float(loss)
+            elapsed = time.perf_counter() - start
+        tokens_per_sec = batch * seq * args.steps / elapsed
+        if jax.process_index() == 0:
+            print(f"mesh dp={mesh.shape['dp']} fsdp={mesh.shape['fsdp']}"
+                  f" pp={mesh.shape['pp']} ep={mesh.shape['ep']}"
+                  f" tp={mesh.shape['tp']} sp={mesh.shape['sp']}"
+                  f" schedule=1f1b")
+            print(f"tokens/sec: {tokens_per_sec:.0f}"
+                  f" loss={final_loss:.4f}")
+        return 0
 
     with mesh:
         init_fn, step_fn = build_train_step(

@@ -45,3 +45,13 @@ def test_llama_example_tiny_with_tp_and_checkpoint(tmp_path):
 def test_jax_pi_single_process():
     out = _run("jax_pi.py", "100000")
     assert "workers=1" in out and "pi=" in out
+
+
+def test_llama_train_1f1b_schedule():
+    # 4 devices, pp=2 -> dp=2; per-microbatch batch (8/4=2) must divide dp
+    out = _run("llama_train.py", "--config", "tiny", "--steps", "2",
+               "--pp", "2", "--pipeline-schedule", "1f1b",
+               "--microbatches", "4", "--seq-len", "32",
+               "--batch-per-dp", "4")
+    assert "schedule=1f1b" in out
+    assert "tokens/sec" in out and "loss=" in out
