@@ -4,8 +4,8 @@ Requests arrive at arbitrary times; instead of serializing whole
 generations (single-flight) the batcher keeps B persistent cache slots
 and runs ONE decode step per tick across every active slot — new
 requests are prefilled into free slots between ticks and finished slots
-are freed immediately (vLLM-style iteration-level scheduling, greedy
-decoding).  Built on the per-row cache index (models/llama.py): each
+are freed immediately (vLLM-style iteration-level scheduling;
+per-slot greedy or nucleus sampling).  Built on the per-row cache index (models/llama.py): each
 slot decodes at its own position, so mixed-length, mixed-arrival
 sequences coexist in one batch.
 
@@ -26,6 +26,9 @@ from typing import List, Optional
 class _Request:
     tokens: List[int]
     max_new_tokens: int
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
     done: threading.Event = field(default_factory=threading.Event)
     output: List[int] = field(default_factory=list)
     error: Optional[Exception] = None
@@ -39,7 +42,9 @@ def _bucket(n: int, cap: int) -> int:
 
 
 class ContinuousBatcher:
-    """Greedy continuous-batching scheduler over `model`'s decode path."""
+    """Continuous-batching scheduler over `model`'s decode path; each
+    slot carries its own (temperature, top_p, rng) so greedy and
+    sampling requests share decode ticks."""
 
     def __init__(self, model, variables, max_slots: int = 4,
                  device_lock: Optional[threading.Lock] = None):
@@ -73,12 +78,12 @@ class ContinuousBatcher:
         self._cache = self._reset_cache(cache)
 
         @jax.jit
-        def decode_step(cache, tokens):
+        def decode_step(cache, tokens, temps, top_ps, keys):
             logits, state = model.apply(
                 {**params, "cache": cache}, tokens[:, None], decode=True,
                 mutable=["cache"])
-            return state["cache"], jnp.argmax(
-                logits[:, -1], axis=-1).astype(jnp.int32)
+            nxt, keys = _select_rows(logits[:, -1], temps, top_ps, keys)
+            return state["cache"], nxt.astype(jnp.int32), keys
 
         self._decode_step = decode_step
         self._prefill_cache = {}
@@ -88,8 +93,10 @@ class ContinuousBatcher:
     def _reset_cache(self, cache):
         return self._jax.tree_util.tree_map(self._jnp.zeros_like, cache)
 
-    def _prefill(self, tokens: List[int]):
-        """Single-sequence prefill -> (cache_row_tree, next_token)."""
+    def _prefill(self, tokens: List[int], sample_args):
+        """Single-sequence prefill -> (cache_row_tree, next_token, key).
+        sample_args = (temperature, top_p, rng_key) scalars for the new
+        sequence's first sampled token."""
         jax, jnp = self._jax, self._jnp
         width = _bucket(len(tokens), self._max_seq_len)
         fn = self._prefill_cache.get(width)
@@ -97,17 +104,19 @@ class ContinuousBatcher:
             params = {"params": self.variables["params"]}
 
             @jax.jit
-            def prefill(padded, length):
+            def prefill(padded, length, temp, top_p, key):
                 logits, state = self.model.apply(
                     params, padded, decode=True, mutable=["cache"])
                 cache = state["cache"]
-                next_tok = jnp.argmax(logits[0, length - 1]).astype(jnp.int32)
-                return cache, next_tok
+                nxt, key = _select_rows(logits[:, length - 1],
+                                        temp[None], top_p[None],
+                                        key[None])
+                return cache, nxt[0].astype(jnp.int32), key[0]
 
             fn = self._prefill_cache[width] = prefill
         padded = jnp.asarray([tokens + [0] * (width - len(tokens))],
                              jnp.int32)
-        return fn(padded, len(tokens))
+        return fn(padded, len(tokens), *sample_args)
 
     def _install(self, slot: int, row_cache, length: int):
         """Copy a batch-1 prefill cache into persistent slot `slot`."""
@@ -126,7 +135,8 @@ class ContinuousBatcher:
 
     # -- public API --------------------------------------------------------
     def submit(self, tokens: List[int], max_new_tokens: int,
-               timeout: float = 300.0) -> List[int]:
+               timeout: float = 300.0, temperature: float = 0.0,
+               top_p: float = 1.0, seed: Optional[int] = None) -> List[int]:
         if max_new_tokens <= 0:
             return []  # match generate()'s [B, 0] semantics
         if len(tokens) + max_new_tokens > self._max_seq_len:
@@ -136,7 +146,12 @@ class ContinuousBatcher:
                 f"{self._max_seq_len}")
         if self._stop.is_set():
             raise RuntimeError("batcher stopped")
-        req = _Request(list(map(int, tokens)), max_new_tokens)
+        if seed is None:
+            import random
+            seed = random.getrandbits(31)
+        req = _Request(list(map(int, tokens)), max_new_tokens,
+                       temperature=float(temperature), top_p=float(top_p),
+                       seed=int(seed))
         self._queue.put(req)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
@@ -157,9 +172,12 @@ class ContinuousBatcher:
 
     # -- scheduler loop ----------------------------------------------------
     def _loop(self) -> None:
-        jnp = self._jnp
+        jax, jnp = self._jax, self._jnp
         slots: List[Optional[_Request]] = [None] * self.max_slots
         next_tokens = jnp.zeros((self.max_slots,), jnp.int32)
+        temps = jnp.zeros((self.max_slots,), jnp.float32)
+        top_ps = jnp.ones((self.max_slots,), jnp.float32)
+        keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
 
         while not self._stop.is_set():
             # Admit new requests into free slots.
@@ -172,8 +190,13 @@ class ContinuousBatcher:
                 except queue.Empty:
                     break
                 try:
+                    key0 = jax.random.fold_in(
+                        jax.random.PRNGKey(req.seed), len(req.tokens))
+                    sample_args = (jnp.float32(req.temperature),
+                                   jnp.float32(req.top_p), key0)
                     with self._device_lock:
-                        row_cache, first = self._prefill(req.tokens)
+                        row_cache, first, key1 = self._prefill(
+                            req.tokens, sample_args)
                         self._install(i, row_cache, len(req.tokens))
                     req.output.append(int(first))
                     if len(req.output) >= req.max_new_tokens:
@@ -181,6 +204,9 @@ class ContinuousBatcher:
                         continue
                     slots[i] = req
                     next_tokens = next_tokens.at[i].set(int(first))
+                    temps = temps.at[i].set(req.temperature)
+                    top_ps = top_ps.at[i].set(req.top_p)
+                    keys = keys.at[i].set(key1)
                     admitted = True
                 except Exception as exc:  # surface, don't kill the loop
                     req.error = exc
@@ -199,8 +225,8 @@ class ContinuousBatcher:
             # One decode step across every slot (inactive slots decode
             # garbage into their own rows; they are reset on admit).
             with self._device_lock:
-                self._cache, out = self._decode_step(self._cache,
-                                                     next_tokens)
+                self._cache, out, keys = self._decode_step(
+                    self._cache, next_tokens, temps, top_ps, keys)
             next_tokens = out
             for i, req in enumerate(slots):
                 if req is None:
@@ -223,3 +249,27 @@ class ContinuousBatcher:
             if req is not None:
                 req.error = RuntimeError("batcher stopped")
                 req.done.set()
+
+
+def _select_rows(logits, temps, top_ps, keys):
+    """Per-row greedy/nucleus selection: logits [B, V], temps/top_ps [B],
+    keys [B, 2].  Row semantics mirror models.llama._select_token
+    (smallest prefix with mass >= top_p); rows with temperature <= 0 are
+    greedy.  Returns (tokens [B], advanced keys [B, 2])."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cumulative < top_ps[:, None], axis=-1)
+    threshold = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                    axis=-1)
+    nucleus = jnp.where(
+        (scaled < threshold) & (top_ps[:, None] < 1.0), -jnp.inf, scaled)
+    sampled = jax.vmap(lambda l, k: jax.random.categorical(k, l))(
+        nucleus, keys)
+    new_keys = jax.vmap(lambda k: jax.random.split(k, 1)[0])(keys)
+    return jnp.where(temps <= 0.0, greedy, sampled), new_keys

@@ -107,11 +107,13 @@ class InferenceServer:
             rows = [list(map(int, tokens))]
         if any(not r for r in rows):
             raise ValueError("empty prompt")
-        # Greedy single-sequence requests ride the continuous batcher so
-        # concurrent clients share decode ticks.
-        if self._batcher is not None and len(rows) == 1 \
-                and temperature == 0.0:
-            return [self._batcher.submit(rows[0], max_new_tokens)]
+        # Single-sequence requests ride the continuous batcher so
+        # concurrent clients share decode ticks (each slot carries its
+        # own temperature/top_p/rng).
+        if self._batcher is not None and len(rows) == 1:
+            return [self._batcher.submit(
+                rows[0], max_new_tokens, temperature=temperature,
+                top_p=top_p, seed=seed)]
         lengths = [len(r) for r in rows]
         width = max(lengths)
         prompt = jnp.asarray([r + [0] * (width - len(r)) for r in rows],
