@@ -215,6 +215,7 @@ class _KubeWatch:
                 # Response headers received => the server has registered
                 # the watch; events from here on flow to this stream.
                 self._connected.set()
+                self._t._auth_failures = 0  # credentials work again
                 if self.stopped:
                     return
                 backoff = 0.2
@@ -240,6 +241,9 @@ class _KubeWatch:
                     self._q.put(WatchEvent(
                         ev["type"], _decode_as(obj_data, self._api_version,
                                                self._kind)))
+            except urllib.error.HTTPError as exc:
+                if exc.code in (401, 403):
+                    self._t._note_auth_failure(exc)
             except Exception:
                 pass  # connection lost; fall through to reconnect
             finally:
@@ -272,10 +276,18 @@ class KubeApiServer:
     """ApiServer-interface proxy over real kube REST grammar — plug into
     ``Clientset(server=KubeApiServer(config))``."""
 
-    def __init__(self, config: KubeConfig, timeout: float = 30.0):
+    def __init__(self, config: KubeConfig, timeout: float = 30.0,
+                 auth_failure_handler=None):
         self.config = config
         self.base = config.server
         self.timeout = timeout
+        # Called with the HTTPError after repeated 401/403 on a watch
+        # stream — the reference's informer watch-error handler
+        # klog.Fatals there so the pod restarts with fresh RBAC
+        # (mpi_job_controller.go:374-388); the operator wires this to
+        # process exit.
+        self.auth_failure_handler = auth_failure_handler
+        self._auth_failures = 0
         self._ssl: Optional[ssl.SSLContext] = None
         if self.base.startswith("https"):
             if config.insecure_skip_tls_verify:
@@ -374,6 +386,14 @@ class KubeApiServer:
         # by the 30s resync).
         w.wait_connected(timeout=10.0)
         return w
+
+    def _note_auth_failure(self, exc) -> None:
+        """Consecutive 401/403 on watch streams mean our credentials/RBAC
+        went stale; after a few, escalate to the handler (which the
+        operator wires to process exit, kubelet-restart semantics)."""
+        self._auth_failures += 1
+        if self._auth_failures >= 3 and self.auth_failure_handler:
+            self.auth_failure_handler(exc)
 
     # -- discovery ---------------------------------------------------------
     def check_crd(self, name: str = "mpijobs.kubeflow.org") -> bool:

@@ -72,11 +72,19 @@ def build_api_transport(opt: ServerOption):
     from ..k8s.kube_transport import (KubeApiServer, KubeConfig,
                                       probe_is_kube)
 
+    def fatal_auth(exc):
+        # Reference parity (mpi_job_controller.go:374-388): persistent
+        # 401/403 on watch streams -> die so the pod restarts with fresh
+        # serviceaccount credentials/RBAC.
+        logger.error("watch auth failure (%s); exiting for credential "
+                     "refresh", exc)
+        os._exit(1)
+
     if opt.kubeconfig:
         cfg = KubeConfig.from_kubeconfig(opt.kubeconfig)
         if opt.master_url:
             cfg.server = opt.master_url.rstrip("/")
-        return KubeApiServer(cfg)
+        return KubeApiServer(cfg, auth_failure_handler=fatal_auth)
     if opt.master_url:
         grammar = opt.api_grammar
         if grammar == "auto":
@@ -90,8 +98,10 @@ def build_api_transport(opt: ServerOption):
                 token = f.read().strip()
         return KubeApiServer(KubeConfig(
             server=opt.master_url, token=token, ca_file=opt.ca_file or None,
-            insecure_skip_tls_verify=opt.insecure_skip_tls_verify))
-    return KubeApiServer(KubeConfig.in_cluster())
+            insecure_skip_tls_verify=opt.insecure_skip_tls_verify),
+            auth_failure_handler=fatal_auth)
+    return KubeApiServer(KubeConfig.in_cluster(),
+                         auth_failure_handler=fatal_auth)
 
 
 class OperatorApp:

@@ -104,7 +104,7 @@ def test_e2e_jax_pi_process_group():
         cluster.submit(job)
         done = cluster.wait_for_condition("default", "pi",
                                           constants.JOB_SUCCEEDED,
-                                          timeout=240)
+                                          timeout=360)
         logs = cluster.launcher_logs("default", "pi")
         assert "workers=3" in logs, logs
         pi_line = [l for l in logs.splitlines() if "pi=" in l][0]

@@ -52,6 +52,6 @@ def test_llama_train_1f1b_schedule():
     out = _run("llama_train.py", "--config", "tiny", "--steps", "2",
                "--pp", "2", "--pipeline-schedule", "1f1b",
                "--microbatches", "4", "--seq-len", "32",
-               "--batch-per-dp", "4")
+               "--batch-per-dp", "4", timeout=420)
     assert "schedule=1f1b" in out
     assert "tokens/sec" in out and "loss=" in out

@@ -278,3 +278,34 @@ def test_e2e_controller_over_kube_transport(fixture_server):
         cluster.wait_for_condition("default", "kube-e2e",
                                    constants.JOB_SUCCEEDED, timeout=90)
         assert "pi-done" in cluster.launcher_logs("default", "kube-e2e")
+
+
+def test_watch_auth_failure_escalates_to_handler():
+    """Persistent 401 on a watch stream must call the auth-failure
+    handler (reference: informer watch-error handler klog.Fatals on
+    401/403 so the pod restarts with fresh RBAC)."""
+    import threading
+    import time
+
+    srv = KubeFixtureServer(token="good").start()
+    try:
+        fired = threading.Event()
+        transport = KubeApiServer(
+            KubeConfig(server=srv.url, token="expired"),
+            auth_failure_handler=lambda exc: fired.set())
+        watch = transport.watch("v1", "Pod")
+        try:
+            assert fired.wait(timeout=30), "handler never fired"
+        finally:
+            watch.stop()
+
+        # a working token never escalates
+        ok = KubeApiServer(srv.client_config(),
+                           auth_failure_handler=lambda exc: (_ for _ in ()
+                                                             ).throw(
+                               AssertionError("fired on valid auth")))
+        w2 = ok.watch("v1", "Pod")
+        time.sleep(1.0)
+        w2.stop()
+    finally:
+        srv.stop()
