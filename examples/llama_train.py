@@ -38,6 +38,10 @@ def main() -> int:
     parser.add_argument("--microbatches", type=int, default=4)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--num-slices", type=int, default=0,
+                        help="0 = auto from MEGASCALE_NUM_SLICES; >1"
+                             " builds a hybrid DCN/ICI mesh (dp across"
+                             " slices)")
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--checkpoint-dir", default="",
                         help="enable orbax checkpoint/resume (pairs with"
@@ -59,8 +63,15 @@ def main() -> int:
                                                 seq_batch_sharding)
     from mpi_operator_tpu.parallel.train import build_train_step
 
-    mesh = create_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, pp=args.pp,
-                                  ep=args.ep, tp=args.tp, sp=args.sp))
+    cfg_mesh = MeshConfig(dp=args.dp, fsdp=args.fsdp, pp=args.pp,
+                          ep=args.ep, tp=args.tp, sp=args.sp)
+    num_slices = args.num_slices or int(
+        os.environ.get("MEGASCALE_NUM_SLICES", "1"))
+    if num_slices > 1:
+        from mpi_operator_tpu.parallel.mesh import create_multislice_mesh
+        mesh = create_multislice_mesh(cfg_mesh, num_slices=num_slices)
+    else:
+        mesh = create_mesh(cfg_mesh)
     cfg = {"7b": llama2_7b, "tiny": llama2_tiny,
            "mixtral-tiny": mixtral_tiny,
            "mixtral-8x7b": mixtral_8x7b}[args.config](remat=args.remat)

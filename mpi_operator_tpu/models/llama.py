@@ -403,6 +403,33 @@ def _set_cache_index(cache, lengths):
     return rec(cache)
 
 
+
+def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
+                      temperature: float, top_p: float):
+    """Shared decode core for generate()/stream_generate(): prefill the
+    prompt and build the jitted one-token step.  Returns
+    (prefill_logits, cache, step_fn)."""
+    import functools
+
+    params = {"params": variables["params"]}
+    logits, state = model.apply(params, prompt_tokens, decode=True,
+                                mutable=["cache"])
+    cache = state["cache"]
+    if hasattr(cache, "unfreeze"):  # flax FrozenDict compatibility
+        cache = cache.unfreeze()
+
+    @functools.partial(jax.jit)
+    def step(cache, token, rng):
+        logits, state = model.apply(
+            {**params, "cache": cache}, token[:, None], decode=True,
+            mutable=["cache"])
+        rng, sub = jax.random.split(rng)
+        return (state["cache"],
+                _select_token(logits[:, -1], temperature, top_p, sub), rng)
+
+    return logits, cache, step
+
+
 def generate(model: LlamaModel, variables, prompt_tokens,
              max_new_tokens: int, temperature: float = 0.0,
              top_p: float = 1.0, rng=None, prompt_lengths=None):
@@ -413,8 +440,6 @@ def generate(model: LlamaModel, variables, prompt_tokens,
     prompt_lengths [B] with each row's true length and every row decodes
     from its own position (per-row cache index; stale padding slots are
     masked/overwritten).  Returns [B, max_new_tokens] generated ids."""
-    import functools
-
     if max_new_tokens <= 0:
         return jnp.zeros((prompt_tokens.shape[0], 0), jnp.int32)
     # Bound the cache: dynamic_update_slice CLAMPS an out-of-range start
@@ -426,16 +451,11 @@ def generate(model: LlamaModel, variables, prompt_tokens,
             f"prompt ({prompt_tokens.shape[1]}) + max_new_tokens "
             f"({max_new_tokens}) = {total} exceeds max_seq_len "
             f"{model.config.max_seq_len}")
-    params = {"params": variables["params"]}
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    # Prefill: run the prompt with an (initialized-on-the-fly) cache.
-    logits, state = model.apply(params, prompt_tokens, decode=True,
-                                mutable=["cache"])
-    cache = state["cache"]
-    if hasattr(cache, "unfreeze"):  # flax FrozenDict compatibility
-        cache = cache.unfreeze()
+    logits, cache, step = _prefill_and_step(model, variables, prompt_tokens,
+                                            temperature, top_p)
     if prompt_lengths is not None:
         lengths = jnp.asarray(prompt_lengths, jnp.int32)
         cache = _set_cache_index(cache, lengths)
@@ -445,15 +465,6 @@ def generate(model: LlamaModel, variables, prompt_tokens,
         last_logits = logits[:, -1]
     rng, sub = jax.random.split(rng)
     next_token = _select_token(last_logits, temperature, top_p, sub)
-
-    @functools.partial(jax.jit)
-    def step(cache, token, rng):
-        logits, state = model.apply(
-            {**params, "cache": cache}, token[:, None], decode=True,
-            mutable=["cache"])
-        rng, sub = jax.random.split(rng)
-        return (state["cache"],
-                _select_token(logits[:, -1], temperature, top_p, sub), rng)
 
     out = [next_token]
     for _ in range(max_new_tokens - 1):
@@ -467,3 +478,35 @@ def greedy_generate(model: LlamaModel, variables, prompt_tokens,
     """KV-cache greedy decoding (generate with temperature=0)."""
     return generate(model, variables, prompt_tokens, max_new_tokens,
                     temperature=0.0)
+
+
+def stream_generate(model: LlamaModel, variables, prompt_tokens,
+                    max_new_tokens: int, temperature: float = 0.0,
+                    top_p: float = 1.0, rng=None):
+    """Token-by-token generator for ONE sequence ([1, S] or [S] prompt):
+    yields each generated id as soon as its decode step completes — the
+    serving layer's streaming (SSE) source.  Same selection semantics as
+    generate()."""
+    prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
+    if prompt_tokens.ndim == 1:
+        prompt_tokens = prompt_tokens[None]
+    if max_new_tokens <= 0:
+        return
+    total = prompt_tokens.shape[1] + max_new_tokens
+    if total > model.config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_tokens.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds max_seq_len "
+            f"{model.config.max_seq_len}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    logits, cache, step = _prefill_and_step(model, variables, prompt_tokens,
+                                            temperature, top_p)
+    rng, sub = jax.random.split(rng)
+    next_token = _select_token(logits[:, -1], temperature, top_p, sub)
+    yield int(next_token[0])
+
+    for _ in range(max_new_tokens - 1):
+        cache, next_token, rng = step(cache, next_token, rng)
+        yield int(next_token[0])

@@ -32,6 +32,13 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     output: List[int] = field(default_factory=list)
     error: Optional[Exception] = None
+    on_token: Optional[object] = None  # callable(int), streaming hook
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def emit(self, token: int) -> None:
+        self.output.append(token)
+        if self.on_token is not None:
+            self.on_token(token)
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -134,11 +141,8 @@ class ContinuousBatcher:
         self._cache = rec(self._cache, row_cache)
 
     # -- public API --------------------------------------------------------
-    def submit(self, tokens: List[int], max_new_tokens: int,
-               timeout: float = 300.0, temperature: float = 0.0,
-               top_p: float = 1.0, seed: Optional[int] = None) -> List[int]:
-        if max_new_tokens <= 0:
-            return []  # match generate()'s [B, 0] semantics
+    def _enqueue(self, tokens, max_new_tokens, temperature, top_p, seed,
+                 on_token=None) -> _Request:
         if len(tokens) + max_new_tokens > self._max_seq_len:
             raise ValueError(
                 f"prompt ({len(tokens)}) + max_new_tokens "
@@ -151,13 +155,52 @@ class ContinuousBatcher:
             seed = random.getrandbits(31)
         req = _Request(list(map(int, tokens)), max_new_tokens,
                        temperature=float(temperature), top_p=float(top_p),
-                       seed=int(seed))
+                       seed=int(seed), on_token=on_token)
         self._queue.put(req)
+        return req
+
+    def submit(self, tokens: List[int], max_new_tokens: int,
+               timeout: float = 300.0, temperature: float = 0.0,
+               top_p: float = 1.0, seed: Optional[int] = None) -> List[int]:
+        if max_new_tokens <= 0:
+            return []  # match generate()'s [B, 0] semantics
+        req = self._enqueue(tokens, max_new_tokens, temperature, top_p,
+                            seed)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if req.error is not None:
             raise req.error
         return req.output
+
+    def submit_iter(self, tokens: List[int], max_new_tokens: int,
+                    timeout: float = 300.0, temperature: float = 0.0,
+                    top_p: float = 1.0, seed: Optional[int] = None):
+        """Streaming submit: yields each generated id as the batcher
+        produces it (tokens from this slot's decode ticks)."""
+        if max_new_tokens <= 0:
+            return
+        sentinel = object()
+        out: "queue.Queue" = queue.Queue()
+        req = self._enqueue(tokens, max_new_tokens, temperature, top_p,
+                            seed, on_token=out.put)
+        threading.Thread(
+            target=lambda: (req.done.wait(timeout), out.put(sentinel)),
+            daemon=True).start()
+        try:
+            while True:
+                item = out.get(timeout=timeout)
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            # Closed early (client disconnect -> GeneratorExit): cancel
+            # so the batcher frees the slot instead of decoding for
+            # nobody.
+            req.cancelled.set()
+        if req.error is not None:
+            raise req.error
+        if not req.done.is_set():
+            raise TimeoutError("generation timed out")
 
     def start(self) -> "ContinuousBatcher":
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -198,7 +241,7 @@ class ContinuousBatcher:
                         row_cache, first, key1 = self._prefill(
                             req.tokens, sample_args)
                         self._install(i, row_cache, len(req.tokens))
-                    req.output.append(int(first))
+                    req.emit(int(first))
                     if len(req.output) >= req.max_new_tokens:
                         req.done.set()
                         continue
@@ -231,7 +274,11 @@ class ContinuousBatcher:
             for i, req in enumerate(slots):
                 if req is None:
                     continue
-                req.output.append(int(out[i]))
+                if req.cancelled.is_set():
+                    req.done.set()
+                    slots[i] = None
+                    continue
+                req.emit(int(out[i]))
                 if len(req.output) >= req.max_new_tokens:
                     req.done.set()
                     slots[i] = None

@@ -6,12 +6,15 @@ Serves a Llama-family model's KV-cache generation
     POST /generate {"tokens": [[...]], "max_new_tokens": 8,
                     "temperature": 0.0, "top_p": 1.0}
       -> {"tokens": [[...]]}
+    POST /generate {..., "stream": true}   -> text/event-stream (SSE),
+      one data event per token, then {"done": true, "tokens": [...]}
     GET /healthz
 
-Requests execute single-flight behind a lock (the accelerator is a
-serial resource); continuous batching is roadmap.  No reference
-counterpart — the reference is training-only orchestration; this rounds
-out the workload stack's lifecycle (train -> checkpoint -> serve).
+The accelerator is a serial resource behind a per-step device lock;
+with ``max_batch_slots > 0`` concurrent requests share decode ticks via
+the continuous batcher.  No reference counterpart — the reference is
+training-only orchestration; this rounds out the workload stack's
+lifecycle (train -> checkpoint -> serve).
 """
 
 from __future__ import annotations
@@ -50,15 +53,55 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", "0"))
             req = json.loads(self.rfile.read(length))
             tokens = req["tokens"]
-            out = server.generate(
-                tokens,
+            kwargs = dict(
                 max_new_tokens=int(req.get("max_new_tokens", 16)),
                 temperature=float(req.get("temperature", 0.0)),
                 top_p=float(req.get("top_p", 1.0)),
                 seed=req.get("seed"))
+            if req.get("stream"):
+                return self._stream(server, tokens, kwargs)
+            out = server.generate(tokens, **kwargs)
             self._respond(200, {"tokens": out})
         except Exception as exc:
             self._respond(400, {"error": str(exc)})
+
+    def _stream(self, server: "InferenceServer", tokens, kwargs) -> None:
+        """SSE: one `data: {"token": t}` event per generated token, then
+        `data: {"done": true, "tokens": [...]}`."""
+        it = server.stream(tokens, **kwargs)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(payload: dict) -> None:
+            chunk = f"data: {json.dumps(payload)}\n\n".encode()
+            self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk
+                             + b"\r\n")
+            self.wfile.flush()
+
+        produced = []
+        try:
+            try:
+                for tok in it:
+                    produced.append(tok)
+                    emit({"token": tok})
+                emit({"done": True, "tokens": produced})
+            except (BrokenPipeError, ConnectionResetError):
+                # Client went away mid-stream: stop generating (closing
+                # the iterator cancels a batcher slot) and abort the
+                # connection quietly — headers/body already went out, so
+                # a 400 response is impossible.
+                raise
+            except Exception as exc:
+                emit({"error": str(exc)})
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            self.close_connection = True
+        finally:
+            it.close()
 
 
 class InferenceServer:
@@ -127,6 +170,50 @@ class InferenceServer:
                            top_p=top_p, rng=rng,
                            prompt_lengths=prompt_lengths)
         return [[int(t) for t in row] for row in out]
+
+    def stream(self, tokens, max_new_tokens: int = 16,
+               temperature: float = 0.0, top_p: float = 1.0, seed=None):
+        """Yield generated ids one at a time for ONE sequence (the SSE
+        source).  Rides the continuous batcher when enabled; otherwise
+        takes the device lock per decode step so slow stream consumers
+        never monopolize the accelerator."""
+        import jax
+
+        if hasattr(tokens, "tolist"):  # numpy/jnp arrays, like generate()
+            tokens = tokens.tolist()
+        tokens = list(tokens)
+        if tokens and (isinstance(tokens[0], (list, tuple))
+                       or hasattr(tokens[0], "tolist")):
+            if len(tokens) != 1:
+                raise ValueError("streaming supports one sequence")
+            tokens = tokens[0]
+        rows = list(map(int, tokens))
+        if not rows:
+            raise ValueError("empty prompt")
+        if self._batcher is not None:
+            yield from self._batcher.submit_iter(
+                rows, max_new_tokens, temperature=temperature, top_p=top_p,
+                seed=seed)
+            return
+
+        from ..models.llama import stream_generate
+        rng = jax.random.PRNGKey(int(seed)) if seed is not None else None
+        # Take the device lock PER STEP, not for the whole generation: a
+        # slow SSE client must never hold the accelerator hostage while
+        # the socket drains.
+        gen = stream_generate(
+            self.model, self.variables, rows, max_new_tokens,
+            temperature=temperature, top_p=top_p, rng=rng)
+        try:
+            while True:
+                with self._lock:
+                    try:
+                        tok = next(gen)
+                    except StopIteration:
+                        return
+                yield tok
+        finally:
+            gen.close()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "InferenceServer":

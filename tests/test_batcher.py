@@ -142,3 +142,31 @@ def test_sampling_slots_deterministic_and_isolated(setup):
                                jnp.asarray([prompt], jnp.int32), 6)
     np.testing.assert_array_equal(np.asarray(alone),
                                   np.asarray(expected[0]))
+
+
+def test_streaming_through_batcher_matches_greedy():
+    """SSE through the continuous batcher: streamed tokens equal the
+    non-streamed greedy decode."""
+    import json
+    import urllib.request
+
+    from mpi_operator_tpu.serving import InferenceServer
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(2), jnp.zeros((1, 4), jnp.int32))
+    server = InferenceServer(model, variables, host="127.0.0.1",
+                             max_batch_slots=2).start()
+    try:
+        from conftest import read_sse
+        prompt = [2, 7, 1, 8]
+        events = read_sse(server.url + "/generate",
+                          {"tokens": [prompt], "max_new_tokens": 4,
+                           "stream": True})
+        tokens = [e["token"] for e in events if "token" in e]
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([prompt], jnp.int32), 4)
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      np.asarray(expected[0]))
+    finally:
+        server.stop()

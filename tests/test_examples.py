@@ -55,3 +55,10 @@ def test_llama_train_1f1b_schedule():
                "--batch-per-dp", "4", timeout=420)
     assert "schedule=1f1b" in out
     assert "tokens/sec" in out and "loss=" in out
+
+
+def test_llama_train_multislice_mesh():
+    out = _run("llama_train.py", "--config", "tiny", "--steps", "2",
+               "--num-slices", "2", "--tp", "2", "--seq-len", "32",
+               "--batch-per-dp", "2", timeout=420)
+    assert "mesh dp=2" in out and "tokens/sec" in out

@@ -108,3 +108,23 @@ def test_generate_accepts_numpy_arrays(served):
     out = server.generate(arr, max_new_tokens=3)
     direct = greedy_generate(model, variables, jax.numpy.asarray(arr), 3)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(direct))
+
+
+from conftest import read_sse  # noqa: E402
+
+
+def test_streaming_generate_matches_non_streamed(served):
+    """SSE: one event per token; the stream equals the non-streamed
+    greedy result."""
+    server, model, variables, cfg = served
+    prompt = [1, 2, 3, 4, 5]
+    events = read_sse(server.url + "/generate",
+                       {"tokens": [prompt], "max_new_tokens": 5,
+                        "stream": True})
+    tokens = [e["token"] for e in events if "token" in e]
+    assert len(tokens) == 5
+    assert events[-1]["done"] and events[-1]["tokens"] == tokens
+    direct = greedy_generate(model, variables,
+                             jax.numpy.asarray([prompt]), 5)
+    np.testing.assert_array_equal(np.asarray(tokens),
+                                  np.asarray(direct[0]))
