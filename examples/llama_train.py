@@ -42,6 +42,10 @@ def main() -> int:
                         help="0 = auto from MEGASCALE_NUM_SLICES; >1"
                              " builds a hybrid DCN/ICI mesh (dp across"
                              " slices)")
+    parser.add_argument("--data", default="",
+                        help="flat int32 token file streamed by the native"
+                             " loader (mmap + prefetch threads); default:"
+                             " synthetic tokens")
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--checkpoint-dir", default="",
                         help="enable orbax checkpoint/resume (pairs with"
@@ -142,25 +146,60 @@ def main() -> int:
                   f" loss={final_loss:.4f}")
         return 0
 
-    with mesh:
-        init_fn, step_fn = build_train_step(
-            loss_fn, optax.adamw(3e-4), mesh,
-            param_specs=llama_param_specs(cfg), remat=False)
-        state = init_fn(params)
-        if mgr is not None:
-            state = mgr.restore(state)   # resume after suspend/preemption
-            if int(state.step):
-                print(f"resumed from step {int(state.step)}")
-        state, metrics = step_fn(state, tokens := jax.device_put(
-            tokens, seq_batch_sharding(mesh)))  # compile
-        float(metrics["loss"])
-        start = time.perf_counter()
-        for _ in range(args.steps):
-            state, metrics = step_fn(state, tokens)
+    loader = None
+    if args.data:  # closed via try/finally around the training block
+        # Native loader: each process streams ITS shard of the corpus
+        # (pid/nproc from the operator env) and contributes its local
+        # slice of the global batch.
+        from mpi_operator_tpu.native import NativeTokenLoader
+        from mpi_operator_tpu.utils.data import global_batch_iterator
+        n_proc = jax.process_count()
+        if batch % n_proc != 0 or batch < n_proc:
+            raise SystemExit(
+                f"--data requires the global batch ({batch}) to be a"
+                f" positive multiple of the process count ({n_proc})")
+        if dp_total < n_proc:
+            raise SystemExit(
+                f"--data requires dp*fsdp ({dp_total}) >= process count"
+                f" ({n_proc}): each process must own distinct batch rows"
+                f" (corpus shards are disjoint per process)")
+        local_batch = batch // n_proc
+        loader = NativeTokenLoader(args.data, seq_len=seq,
+                                   batch=local_batch)
+        batches = global_batch_iterator(
+            lambda step: (loader.next_batch(),), mesh,
+            (seq_batch_sharding(mesh),))
+        next_tokens = lambda: next(batches)[0]  # noqa: E731
+    else:
+        fixed = None
+        def next_tokens():
+            nonlocal fixed
+            if fixed is None:
+                fixed = jax.device_put(tokens, seq_batch_sharding(mesh))
+            return fixed
+
+    try:
+        with mesh:
+            init_fn, step_fn = build_train_step(
+                loss_fn, optax.adamw(3e-4), mesh,
+                param_specs=llama_param_specs(cfg), remat=False)
+            state = init_fn(params)
             if mgr is not None:
-                mgr.maybe_save(state, int(state.step))
-        final_loss = float(metrics["loss"])
-        elapsed = time.perf_counter() - start
+                state = mgr.restore(state)  # resume after suspend/preemption
+                if int(state.step):
+                    print(f"resumed from step {int(state.step)}")
+            state, metrics = step_fn(state, next_tokens())  # compile
+            float(metrics["loss"])
+            start = time.perf_counter()
+            for _ in range(args.steps):
+                state, metrics = step_fn(state, next_tokens())
+                if mgr is not None:
+                    mgr.maybe_save(state, int(state.step))
+            final_loss = float(metrics["loss"])
+            elapsed = time.perf_counter() - start
+    finally:
+        if loader is not None:
+            loader.close()
 
     tokens_per_sec = batch * seq * args.steps / elapsed
     if jax.process_index() == 0:

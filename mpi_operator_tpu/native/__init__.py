@@ -6,3 +6,4 @@ pi example uses, bootstrapped from the operator-injected coordinator env
 """
 
 from .collective import Collective, build_native, native_build_dir  # noqa: F401
+from .dataloader import NativeTokenLoader, write_token_file  # noqa: F401

@@ -30,11 +30,13 @@ def build_native() -> str:
         build = native_build_dir()
         lib = os.path.join(build, "libtpucoll.so")
         exe = os.path.join(build, "pi_native")
+        data_lib = os.path.join(build, "libtpudata.so")
         srcs = [os.path.join(_NATIVE_DIR, f)
-                for f in ("tpucoll.cpp", "pi_native.cpp", "Makefile")]
+                for f in ("tpucoll.cpp", "pi_native.cpp", "tpudata.cpp",
+                          "Makefile")]
         newest_src = max(os.path.getmtime(s) for s in srcs)
         if all(os.path.exists(p) and os.path.getmtime(p) >= newest_src
-               for p in (lib, exe)):
+               for p in (lib, exe, data_lib)):
             return build
         proc = subprocess.run(["make", "-C", _NATIVE_DIR],
                               capture_output=True, text=True)

@@ -1,0 +1,110 @@
+"""ctypes wrapper for the native token data loader (native/tpudata.cpp).
+
+`NativeTokenLoader` streams [batch, seq_len] int32 batches from a flat
+binary token file with mmap + background prefetch in C++ — file IO
+overlaps device compute with no Python on the hot path.  Sharding
+follows the operator's process contract: one seeded global shuffle per
+epoch (identical on every process), process p consuming windows
+p, p+N, ... — disjoint and exhaustive across the job.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from .collective import build_native
+
+
+def write_token_file(path: str, tokens) -> None:
+    """Write a flat int32 little-endian token file (the loader's input
+    format; use for tokenized corpora and tests)."""
+    arr = np.ascontiguousarray(np.asarray(tokens).reshape(-1),
+                               dtype=np.int32)
+    with open(path, "wb") as f:
+        f.write(arr.tobytes())
+
+
+class NativeTokenLoader:
+    """Iterable over [batch, seq_len] int32 numpy batches."""
+
+    def __init__(self, path: str, seq_len: int, batch: int,
+                 process_id: Optional[int] = None,
+                 num_processes: Optional[int] = None,
+                 seed: int = 0, prefetch_depth: int = 4):
+        from ..api import constants
+
+        process_id = process_id if process_id is not None else int(
+            os.environ.get(constants.JAX_PROCESS_ID_ENV, "0"))
+        num_processes = num_processes if num_processes is not None else int(
+            os.environ.get(constants.JAX_NUM_PROCESSES_ENV, "1"))
+
+        lib_path = os.path.join(build_native(), "libtpudata.so")
+        self._lib = ctypes.CDLL(lib_path)
+        self._lib.dl_open.restype = ctypes.c_void_p
+        self._lib.dl_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            ctypes.c_long, ctypes.c_ulong, ctypes.c_long]
+        self._lib.dl_next.restype = ctypes.c_long
+        self._lib.dl_next.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int32)]
+        self._lib.dl_num_windows.restype = ctypes.c_long
+        self._lib.dl_num_windows.argtypes = [ctypes.c_void_p]
+        self._lib.dl_epoch.restype = ctypes.c_long
+        self._lib.dl_epoch.argtypes = [ctypes.c_void_p]
+        self._lib.dl_close.argtypes = [ctypes.c_void_p]
+
+        self.seq_len = seq_len
+        self.batch = batch
+        self._handle = self._lib.dl_open(
+            path.encode(), seq_len, batch, process_id, num_processes,
+            seed, prefetch_depth)
+        if not self._handle:
+            raise RuntimeError(f"tpudata: cannot open {path}")
+        # GC safety net: joins the producer thread and unmaps the file
+        # even if the caller never calls close().
+        self._finalizer = weakref.finalize(
+            self, self._lib.dl_close, self._handle)
+
+    def _live_handle(self):
+        if not self._handle:
+            raise RuntimeError("tpudata: loader is closed")
+        return self._handle
+
+    @property
+    def num_windows(self) -> int:
+        return int(self._lib.dl_num_windows(self._live_handle()))
+
+    @property
+    def epoch(self) -> int:
+        """Epoch of the most recently consumed batch."""
+        return int(self._lib.dl_epoch(self._live_handle()))
+
+    def next_batch(self) -> np.ndarray:
+        out = np.empty((self.batch, self.seq_len), dtype=np.int32)
+        step = self._lib.dl_next(
+            self._live_handle(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if step < 0:
+            raise RuntimeError("tpudata: loader stopped")
+        return out
+
+    def __iter__(self):
+        while True:
+            yield self.next_batch()
+
+    def close(self) -> None:
+        if self._handle:
+            self._finalizer.detach()
+            self._lib.dl_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NativeTokenLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,226 @@
+// tpudata — native token data loader (mmap + background prefetch).
+//
+// The input side of the runtime, in C++ like the rest of the native
+// layer (tpucoll): training hosts stream [batch, seq_len] int32 token
+// windows from a flat binary corpus without the Python interpreter on
+// the hot path.  The reference delegates data entirely to workloads
+// (synthetic data in tf_cnn_benchmarks); here the framework ships the
+// loader it recommends.
+//
+//   layout    flat little-endian int32 tokens; windows are consecutive
+//             seq_len-token slices (drop remainder)
+//   sharding  one global per-epoch shuffle (seeded, identical on every
+//             process), process p consumes windows p, p+N, p+2N, ... —
+//             disjoint and exhaustive across the job, matching the
+//             operator's process_id/num_processes contract
+//   prefetch  worker threads copy upcoming batches out of the mmap into
+//             a bounded ring; dl_next blocks on a filled slot, so file
+//             IO overlaps device compute
+//
+// C ABI (ctypes-friendly): dl_open, dl_next, dl_num_windows, dl_epoch,
+// dl_close.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <random>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  int64_t step = 0;
+  int64_t epoch = 0;            // epoch the batch was drawn from
+  std::vector<int32_t> tokens;  // batch * seq_len
+};
+
+struct Loader {
+  // immutable after open
+  int32_t* data = nullptr;      // mmap base
+  size_t file_bytes = 0;
+  int64_t n_tokens = 0;
+  int64_t seq_len = 0;
+  int64_t batch = 0;
+  int64_t n_windows = 0;        // global windows in the file
+  int64_t usable_windows = 0;   // truncated to a multiple of num_processes
+  int64_t process_id = 0;
+  int64_t num_processes = 1;
+  uint64_t seed = 0;
+
+  // producer state (single producer thread)
+  std::vector<int64_t> order;   // global shuffled window ids
+  int64_t cursor = 0;           // next local-order position
+  std::atomic<int64_t> epoch{0};           // producer epoch
+  std::atomic<int64_t> consumed_epoch{0};  // epoch of the last dl_next
+  int64_t step = 0;
+
+  // bounded ring
+  size_t depth = 4;
+  std::deque<Batch> ring;
+  std::mutex mu;
+  std::condition_variable not_empty;
+  std::condition_variable not_full;
+  bool stopping = false;
+  std::thread producer;
+};
+
+void reshuffle(Loader* L) {
+  L->order.resize(static_cast<size_t>(L->n_windows));
+  for (int64_t i = 0; i < L->n_windows; i++) L->order[i] = i;
+  std::mt19937_64 rng(L->seed * 1000003ULL +
+                      static_cast<uint64_t>(L->epoch.load()));
+  for (int64_t i = L->n_windows - 1; i > 0; i--) {
+    int64_t j = static_cast<int64_t>(rng() % static_cast<uint64_t>(i + 1));
+    std::swap(L->order[i], L->order[j]);
+  }
+}
+
+// Local view: this process owns order[p], order[p+N], ... within the
+// first usable_windows entries — disjoint across processes and the SAME
+// count everywhere, so every process wraps epochs on the same step and
+// all processes stay on the same permutation.  The (n_windows mod N)
+// remainder of each epoch is skipped; the per-epoch reshuffle rotates
+// different windows into the remainder, so all data is seen over time.
+int64_t local_windows(const Loader* L) {
+  return L->usable_windows / L->num_processes;
+}
+
+void produce_loop(Loader* L) {
+  while (true) {
+    Batch b;
+    b.tokens.resize(static_cast<size_t>(L->batch * L->seq_len));
+    b.step = L->step;
+    b.epoch = L->epoch.load();
+    for (int64_t r = 0; r < L->batch; r++) {
+      if (L->cursor >= local_windows(L)) {
+        L->epoch.fetch_add(1);
+        L->cursor = 0;
+        reshuffle(L);
+      }
+      int64_t pos = L->cursor * L->num_processes + L->process_id;
+      int64_t win = L->order[static_cast<size_t>(pos)];
+      std::memcpy(b.tokens.data() + r * L->seq_len,
+                  L->data + win * L->seq_len,
+                  sizeof(int32_t) * static_cast<size_t>(L->seq_len));
+      L->cursor++;
+    }
+    L->step++;
+
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->not_full.wait(lk, [L] {
+      return L->stopping || L->ring.size() < L->depth;
+    });
+    if (L->stopping) return;
+    L->ring.push_back(std::move(b));
+    L->not_empty.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle (or null).  seq_len/batch in tokens/windows.
+void* dl_open(const char* path, long seq_len, long batch, long process_id,
+              long num_processes, unsigned long seed, long prefetch_depth) {
+  if (seq_len <= 0 || batch <= 0 || num_processes <= 0 ||
+      process_id < 0 || process_id >= num_processes) {
+    std::fprintf(stderr, "tpudata: invalid arguments\n");
+    return nullptr;
+  }
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) {
+    std::fprintf(stderr, "tpudata: cannot open %s\n", path);
+    return nullptr;
+  }
+  struct stat st {};
+  if (::fstat(fd, &st) < 0 || st.st_size < static_cast<long>(sizeof(int32_t))) {
+    std::fprintf(stderr, "tpudata: cannot stat %s\n", path);
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = ::mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                      MAP_PRIVATE, fd, 0);
+  ::close(fd);  // mapping keeps its own reference
+  if (base == MAP_FAILED) {
+    std::fprintf(stderr, "tpudata: mmap failed for %s\n", path);
+    return nullptr;
+  }
+
+  auto* L = new Loader();
+  L->data = static_cast<int32_t*>(base);
+  L->file_bytes = static_cast<size_t>(st.st_size);
+  L->n_tokens = st.st_size / static_cast<long>(sizeof(int32_t));
+  L->seq_len = seq_len;
+  L->batch = batch;
+  L->n_windows = L->n_tokens / seq_len;
+  L->process_id = process_id;
+  L->num_processes = num_processes;
+  L->seed = seed;
+  L->depth = prefetch_depth > 0 ? static_cast<size_t>(prefetch_depth) : 4;
+  L->usable_windows = L->n_windows - (L->n_windows % num_processes);
+  if (L->usable_windows < num_processes) {
+    std::fprintf(stderr,
+                 "tpudata: %lld windows < %ld processes in %s\n",
+                 static_cast<long long>(L->n_windows), num_processes, path);
+    ::munmap(base, L->file_bytes);
+    delete L;
+    return nullptr;
+  }
+  reshuffle(L);
+  L->producer = std::thread(produce_loop, L);
+  return L;
+}
+
+// Copies the next [batch, seq_len] int32 batch into out; returns the
+// step index (>= 0), blocking while prefetch catches up.
+long dl_next(void* handle, int32_t* out) {
+  auto* L = static_cast<Loader*>(handle);
+  Batch b;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->not_empty.wait(lk, [L] { return L->stopping || !L->ring.empty(); });
+    if (L->stopping && L->ring.empty()) return -1;
+    b = std::move(L->ring.front());
+    L->ring.pop_front();
+    L->not_full.notify_one();
+  }
+  L->consumed_epoch.store(b.epoch);
+  std::memcpy(out, b.tokens.data(), sizeof(int32_t) * b.tokens.size());
+  return static_cast<long>(b.step);
+}
+
+long dl_num_windows(void* handle) {
+  return static_cast<long>(static_cast<Loader*>(handle)->n_windows);
+}
+
+// Epoch of the batch most recently CONSUMED via dl_next (not the
+// producer's prefetch position) — safe to drive LR schedules/eval.
+long dl_epoch(void* handle) {
+  return static_cast<long>(
+      static_cast<Loader*>(handle)->consumed_epoch.load());
+}
+
+void dl_close(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->stopping = true;
+    L->not_full.notify_all();
+    L->not_empty.notify_all();
+  }
+  if (L->producer.joinable()) L->producer.join();
+  ::munmap(L->data, L->file_bytes);
+  delete L;
+}
+
+}  // extern "C"

@@ -62,3 +62,17 @@ def test_llama_train_multislice_mesh():
                "--num-slices", "2", "--tp", "2", "--seq-len", "32",
                "--batch-per-dp", "2", timeout=420)
     assert "mesh dp=2" in out and "tokens/sec" in out
+
+
+def test_llama_train_native_data_loader(tmp_path):
+    import numpy as np
+
+    from mpi_operator_tpu.native import write_token_file
+
+    corpus = str(tmp_path / "corpus.bin")
+    write_token_file(corpus,
+                     np.random.RandomState(0).randint(0, 256, size=64 * 32))
+    out = _run("llama_train.py", "--config", "tiny", "--steps", "3",
+               "--seq-len", "32", "--batch-per-dp", "2",
+               "--data", corpus, timeout=420)
+    assert "tokens/sec" in out and "loss=" in out
