@@ -801,3 +801,107 @@ def test_jax_env_respects_user_compilation_cache_env():
     values = [e.value for e in pod.spec.containers[0].env
               if e.name == constants.JAX_COMPILATION_CACHE_ENV]
     assert values == ["/user/cache"]
+
+
+# ---------------------------------------------------------------------------
+# No-op sync paths + per-resource ownership strictness (reference tests
+# TestDoNothingWith*, Test*NotControlledByUs, :531-567,815-908)
+# ---------------------------------------------------------------------------
+
+def test_sync_noop_for_nonexistent_job():
+    f = Fixture()
+    f.controller.sync_handler("default/ghost")  # must not raise
+    assert f.client.pods("default").list() == []
+    assert f.recorder.events == []
+
+
+def test_sync_noop_for_malformed_key():
+    f = Fixture()
+    f.controller.sync_handler("not-a-real-key")  # ns="", name stays whole
+    assert f.recorder.events == []
+
+
+@pytest.mark.parametrize("kind,make", [
+    ("Service", lambda: core.Service(
+        metadata=ObjectMeta(name="test", namespace="default"))),
+    ("ConfigMap", lambda: core.ConfigMap(
+        metadata=ObjectMeta(name="test-config", namespace="default"))),
+    ("Secret", lambda: core.Secret(
+        metadata=ObjectMeta(name="test-ssh", namespace="default"))),
+    ("Pod", lambda: core.Pod(
+        metadata=ObjectMeta(name="test-worker-0", namespace="default"))),
+])
+def test_resources_not_controlled_by_us_error(kind, make):
+    """A same-named object owned by someone else must abort the sync with
+    an ErrResourceExists event, never be adopted or overwritten."""
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    f.register_job(job)
+    obj = make()
+    # no (or foreign) controller ref
+    getattr(f.client, {"Service": "services", "ConfigMap": "config_maps",
+                       "Secret": "secrets", "Pod": "pods"}[kind])(
+        "default").create(obj)
+    f.refresh_caches()
+    with pytest.raises(Exception):
+        f.sync(f.get_job())
+    assert any("ErrResourceExists" in e for e in f.recorder.events), \
+        (kind, f.recorder.events)
+
+
+def test_resume_clears_launcher_start_time():
+    """Resume must clear the launcher Job's StartTime via the status
+    subresource before unsuspending (template-immutability workaround,
+    reference TestResumeMPIJobClearsStartTime)."""
+    f = Fixture()
+    job = new_mpi_job(workers=1, impl=constants.IMPL_JAX)
+    job.spec.run_policy.suspend = True
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    assert launcher.spec.suspend is True
+    launcher.status.start_time = f.clock.now()
+    f.client.jobs("default").update_status(launcher)
+
+    stored = f.get_job()
+    stored.spec.run_policy.suspend = False
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+    f.sync(f.get_job())
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    assert launcher.spec.suspend is False
+    assert launcher.status.start_time is None
+
+
+def test_launcher_succeeded_with_lingering_running_pod():
+    """Job completion is driven by the launcher Job's Complete condition;
+    a stale still-Running launcher pod must not hold Succeeded back
+    (reference TestLauncherSucceededWithRunningPod)."""
+    f = Fixture()
+    job = new_mpi_job(workers=1, impl=constants.IMPL_JAX)
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher.status.conditions.append(batch.JobCondition(
+        type=batch.JOB_COMPLETE, status="True"))
+    launcher.status.completion_time = f.clock.now()
+    launcher.status.succeeded = 1
+    f.client.jobs("default").update_status(launcher)
+
+    from mpi_operator_tpu.k8s.meta import new_controller_ref
+    pod = core.Pod(metadata=ObjectMeta(
+        name="test-launcher-xyz", namespace="default",
+        labels={"job-name": "test-launcher"},
+        owner_references=[new_controller_ref(launcher, "batch/v1", "Job")]),
+        status=core.PodStatus(phase=core.POD_RUNNING))
+    f.client.pods("default").create(pod)
+    f.refresh_caches()
+    f.sync(f.get_job())
+
+    conds = {c.type: c.status for c in f.get_job().status.conditions}
+    assert conds[constants.JOB_SUCCEEDED] == "True"
