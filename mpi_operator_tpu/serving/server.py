@@ -109,9 +109,22 @@ class InferenceServer:
     # /generate is unauthenticated and compute-expensive, so exposing it
     # on all interfaces must be an explicit opt-in (host="0.0.0.0").
     def __init__(self, model, variables, host: str = "127.0.0.1",
-                 port: int = 0, max_batch_slots: int = 0):
+                 port: int = 0, max_batch_slots: int = 0, mesh=None):
         self.model = model
         self.variables = variables
+        self.mesh = mesh
+        if mesh is not None:
+            # Tensor-parallel serving: place the params by their Megatron
+            # PartitionSpecs so decode matmuls shard over 'tp' (and
+            # 'fsdp'); generation then runs under this mesh.
+            from ..models.llama import llama_param_specs
+            from ..parallel.mesh import shard_params
+
+            specs = llama_param_specs(model.config)["params"]
+            self.variables = {
+                **variables,
+                "params": shard_params(variables["params"], specs, mesh),
+            }
         self._lock = threading.Lock()
         self._http = ThreadingHTTPServer((host, port), _Handler)
         self._http.inference = self  # type: ignore[attr-defined]
@@ -124,7 +137,7 @@ class InferenceServer:
         self._batcher = None
         if max_batch_slots > 0:
             from .batcher import ContinuousBatcher
-            self._batcher = ContinuousBatcher(model, variables,
+            self._batcher = ContinuousBatcher(model, self.variables,
                                               max_slots=max_batch_slots,
                                               device_lock=self._lock)
 
