@@ -157,9 +157,11 @@ def worker(donate: bool) -> None:
     })
 
 
-def _attempt(donate: bool, timeout_s: float, env=None):
-    """One worker run.  Returns (json_line_or_None, diagnostic_str)."""
-    cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+def run_bench_worker(script: str, donate: bool, timeout_s: float, env=None):
+    """One `<script> --worker` run in a subprocess under a hard timeout.
+    Returns (json_line_or_None, diagnostic_str).  Shared by bench.py and
+    bench_llama.py so the watchdog/JSON-scan harness cannot drift."""
+    cmd = [sys.executable, script, "--worker"]
     if not donate:
         cmd.append("--no-donate")
     try:
@@ -177,6 +179,11 @@ def _attempt(donate: bool, timeout_s: float, env=None):
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
     diag = "; ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
     return None, f"rc={proc.returncode}: {diag[:500]}"
+
+
+def _attempt(donate: bool, timeout_s: float, env=None):
+    return run_bench_worker(os.path.abspath(__file__), donate, timeout_s,
+                            env=env)
 
 
 def main() -> None:

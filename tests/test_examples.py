@@ -76,3 +76,23 @@ def test_llama_train_native_data_loader(tmp_path):
                "--seq-len", "32", "--batch-per-dp", "2",
                "--data", corpus, timeout=420)
     assert "tokens/sec" in out and "loss=" in out
+
+
+def test_bench_llama_smoke():
+    """bench_llama.py emits one parseable JSON record on a tiny CPU
+    config (the real run needs the TPU chip; this proves the harness)."""
+    import json
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_LLAMA_SEQ": "128",
+                "BENCH_LLAMA_BATCH": "1", "BENCH_LLAMA_WARMUP": "1",
+                "BENCH_LLAMA_STEPS": "2", "BENCH_LLAMA_DIM": "128",
+                "BENCH_LLAMA_LAYERS": "2"})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench_llama.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "llama1b_train_tokens_per_sec_per_chip"
+    assert rec["value"] > 0 and rec["platform"] == "cpu"
