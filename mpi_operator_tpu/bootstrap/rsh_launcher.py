@@ -104,16 +104,28 @@ def wait_for_dns(hosts: List[str], timeout: float, required: bool = True,
     """Retry until every host resolves (entrypoint.sh DNS gate analogue).
     With required=False (non-ssh agents that do not dial the host name)
     failure downgrades to a warning."""
+    # Inside the hermetic kubelet sandbox there is no cluster DNS; pod
+    # FQDNs resolve through the deterministic netsim mapping instead, so
+    # the gate still validates that every hostfile entry is a well-formed
+    # cluster name.  Only for non-ssh agents (required=False): ssh does
+    # its own getaddrinfo, which netsim cannot satisfy, so passing the
+    # gate would just defer the failure to every rank.
+    in_sandbox = "K_SANDBOX_DIR" in os.environ and not required
+
+    def _resolves(host: str) -> bool:
+        try:
+            socket.getaddrinfo(host, None)
+            return True
+        except OSError:
+            if in_sandbox:
+                from ..runtime import netsim
+                return netsim.resolve(host) is not None
+            return False
+
     deadline = time.monotonic() + timeout
     pending = list(dict.fromkeys(hosts))
     while pending and time.monotonic() < deadline:
-        still = []
-        for host in pending:
-            try:
-                socket.getaddrinfo(host, None)
-            except OSError:
-                still.append(host)
-        pending = still
+        pending = [h for h in pending if not _resolves(h)]
         if pending:
             time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
     if not pending:
@@ -249,9 +261,18 @@ def main(argv=None) -> int:
         declared = os.environ.get("JAX_COORDINATOR_PORT")
         port = int(declared) if declared else _free_port()
 
+    coordinator = args.coordinator
+    if coordinator is None and "K_SANDBOX_DIR" in os.environ:
+        # Hermetic runtime: the first hostfile entry is a cluster-DNS pod
+        # name with no real DNS behind it — hand ranks its netsim address
+        # (the per-pod loopback IP the kubelet also injects), so the
+        # FQDN-coordinator path works exactly as it would under cluster
+        # DNS.
+        from ..runtime import netsim
+        coordinator = netsim.resolve(hosts[0].host)
+
     cmds = build_rank_commands(hosts, args.workload, agent, agent_args,
-                               port, np=args.np,
-                               coordinator=args.coordinator)
+                               port, np=args.np, coordinator=coordinator)
     print(f"rsh_launcher: launching {len(cmds)} ranks across "
           f"{len(hosts)} hosts (agent: {' '.join(agent)})", flush=True)
     return run_gang(cmds)

@@ -7,13 +7,16 @@ honors restartPolicy (Always/OnFailure restart with backoff; Never
 fails), materializes ConfigMap/Secret volumes into a per-pod sandbox and
 captures logs.
 
-Network model: every pod shares the host's loopback.  Service DNS names
+Network model: every pod gets its own deterministic loopback address
+(netsim, 127.X.Y.Z — Linux routes all of 127.0.0.0/8 over lo), surfaced
+as ``status.podIP``.  Service DNS names
 (``<pod>.<svc>.<ns>.svc[...]``, reference build/base/entrypoint.sh relies
 on cluster DNS here) are resolved at pod start by rewriting env values to
-127.0.0.1, and per-job coordinator ports are allocated to avoid
-collisions (the JAX_COORDINATOR_PORT / :port suffix pair is rewritten
-together) — the local stand-in for the headless Service + stable pod
-hostname machinery (mpi_job_controller.go:1409-1438).
+the named pod's address, so distinct hosts really are distinct
+endpoints; per-job coordinator ports are still allocated to avoid
+cross-job collisions (the JAX_COORDINATOR_PORT / :port suffix pair is
+rewritten together) — the local stand-in for the headless Service +
+stable pod hostname machinery (mpi_job_controller.go:1409-1438).
 """
 
 from __future__ import annotations
@@ -33,6 +36,7 @@ from typing import Optional
 from ..api import constants as api_constants
 from ..k8s import core
 from ..k8s.apiserver import ApiServer, Clientset, is_conflict, is_not_found
+from . import netsim
 
 logger = logging.getLogger("mpi_operator_tpu.runtime.kubelet")
 
@@ -136,8 +140,7 @@ class _PodRunner:
         env["K_SANDBOX_DIR"] = self.sandbox
 
         for ev in container.env:
-            env[ev.name] = self.kubelet.resolve_env_value(
-                self.namespace, ev.value)
+            env[ev.name] = self.kubelet.resolve_env_value(ev.value)
 
         # Per-job coordinator port remap to avoid cross-job collisions.
         addr = env.get(api_constants.JAX_COORDINATOR_ADDRESS_ENV)
@@ -151,7 +154,6 @@ class _PodRunner:
             # resolve the coordinator hostname itself
             env[api_constants.JAX_COORDINATOR_ADDRESS_ENV] = \
                 self.kubelet.resolve_env_value(
-                    self.namespace,
                     env[api_constants.JAX_COORDINATOR_ADDRESS_ENV])
         return env
 
@@ -243,21 +245,31 @@ class LocalKubelet:
         self.root_dir = root_dir or tempfile.mkdtemp(prefix="tpu-kubelet-")
         self._runners: dict = {}
         self._ports: dict = {}
+        self._pod_ips: dict = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watch = None
         self._thread: Optional[threading.Thread] = None
 
     # -- DNS / ports -------------------------------------------------------
-    def resolve_env_value(self, namespace: str, value: str) -> str:
-        """Rewrite cluster-DNS hostnames to loopback.  Any token shaped
-        like <host>.<svc>.<ns>.svc[.domain] resolves to 127.0.0.1."""
+    def resolve_env_value(self, value: str) -> str:
+        """Rewrite cluster-DNS hostnames to their simulated addresses.
+
+        Pod names (``<pod>.<svc>.<ns>.svc[.domain]``) get the pod's own
+        per-pod loopback address (netsim; the namespace comes from the
+        FQDN itself), so distinct "hosts" really are distinct endpoints;
+        bare service names keep 127.0.0.1 (a headless Service has no
+        single address)."""
         if not value:
             return value
+
+        def _sub(m: "re.Match") -> str:
+            return netsim.resolve(m.group(0)) or "127.0.0.1"
+
         return re.sub(
             r"[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*"
             r"\.svc(\.[a-z0-9.]+)?",
-            "127.0.0.1", value)
+            _sub, value)
 
     def job_port(self, namespace: str, job_key: str, declared_port: str) -> int:
         with self._lock:
@@ -362,6 +374,29 @@ class LocalKubelet:
             pod.status.phase = phase
             pod.status.reason = reason
             pod.status.message = message
+            if phase == core.POD_RUNNING and not pod.status.pod_ip:
+                # Real kubelet semantics: podIP appears once the sandbox
+                # is up; here it is the pod's deterministic netsim address.
+                # The hash space is ~4.2M addresses, so a collision between
+                # two live pods is vanishingly unlikely — but it would
+                # silently collapse the distinct-endpoint guarantee, so
+                # fail the pod loudly instead.
+                ip = netsim.pod_ip(namespace, name)
+                with self._lock:
+                    owner = self._pod_ips.setdefault(ip, (namespace, name))
+                if owner != (namespace, name):
+                    phase = core.POD_FAILED
+                    ready = False
+                    reason = "PodIPCollision"
+                    message = (f"netsim address {ip} already assigned to "
+                               f"pod {owner[0]}/{owner[1]}")
+                    logger.error("pod %s/%s: %s", namespace, name, message)
+                else:
+                    pod.status.pod_ip = ip
+                    pod.status.host_ip = "127.0.0.1"
+                pod.status.phase = phase
+                pod.status.reason = reason
+                pod.status.message = message
             pod.status.conditions = [c for c in pod.status.conditions
                                      if c.type != "Ready"]
             pod.status.conditions.append(core.PodCondition(

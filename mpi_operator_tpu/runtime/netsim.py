@@ -1,0 +1,61 @@
+"""Deterministic per-pod loopback addressing for the hermetic runtime.
+
+A real cluster gives every pod its own IP, and cluster DNS maps the
+headless-Service names the controller builds
+(``<pod>.<service>.<ns>.svc[.<cluster-domain>]``, reference
+mpi_job_controller.go:1409-1438 + build/base/entrypoint.sh's DNS gate)
+to those IPs.  The local runtime used to collapse every such name to
+127.0.0.1, which meant the stable-hostname machinery was never really
+exercised (every "host" was literally the same address).
+
+Linux accepts the entire 127.0.0.0/8 on the loopback interface with no
+configuration, so instead each (namespace, pod) pair maps to its own
+stable loopback address via a keyed hash.  The mapping is computable in
+ANY process with no coordination — the kubelet (env injection), the rsh
+launcher (DNS gate), bootstrap and tests all derive the same answer for
+the same name, which is exactly the property cluster DNS provides.
+
+Address layout: 127.X.Y.Z with X in [64, 127], Z in [1, 254] — ~4.2M
+distinct addresses, disjoint from the conventional 127.0.0.1 so a
+collision with unrelated local services is impossible.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Optional
+
+# <label>(.<label>)*.svc[.<domain>] — the shape of every cluster-DNS name
+# the controller injects (meta.validation guarantees DNS-1035 labels).
+_CLUSTER_DNS_RE = re.compile(
+    r"^([a-z0-9]([-a-z0-9]*[a-z0-9])?)"
+    r"((\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*)"
+    r"\.svc(\.[a-z0-9.]+?)?\.?$")
+
+
+def pod_ip(namespace: str, pod_name: str) -> str:
+    """Stable loopback IP for a pod, identical in every process."""
+    digest = hashlib.blake2s(
+        f"{namespace}/{pod_name}".encode(), digest_size=3).digest()
+    return (f"127.{64 + digest[0] % 64}.{digest[1]}"
+            f".{1 + digest[2] % 254}")
+
+
+def resolve(fqdn: str) -> Optional[str]:
+    """Resolve a cluster-DNS name to its simulated address.
+
+    ``<pod>.<service>.<ns>.svc[...]`` (three or more labels before
+    ``.svc``) resolves to the pod's address; a bare service name
+    (``<service>.<ns>.svc[...]``) has no single backing pod — headless
+    Services resolve to every member — and returns None, as does any
+    non-cluster name.
+    """
+    m = _CLUSTER_DNS_RE.match(fqdn)
+    if not m:
+        return None
+    labels = [m.group(1)] + [p for p in m.group(3).split(".") if p]
+    if len(labels) < 3:
+        return None
+    # <pod>.<service>.<ns>: the pod lives in the trailing namespace label.
+    return pod_ip(labels[-1], labels[0])

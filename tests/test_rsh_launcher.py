@@ -125,12 +125,13 @@ def test_e2e_operator_mpi_path_launches_ranks(tmp_path):
     from test_e2e_local import jax_job
 
     exe = os.path.join(build_native(), "pi_native")
-    # --coordinator 127.0.0.1: with the local agent the ranks run in the
-    # launcher pod, where the hostfile's cluster-DNS names do not resolve
+    # No --coordinator override: the launcher resolves the first hostfile
+    # entry (worker-0's cluster-DNS name) through netsim, so the
+    # FQDN-coordinator path is exercised exactly as under cluster DNS.
     launcher_cmd = [
         sys.executable, "-m", "mpi_operator_tpu.bootstrap.rsh_launcher",
         "--rsh", RSH_LOCAL, "--dns-timeout", "5",
-        "--coordinator", "127.0.0.1", "--", exe, "200000"]
+        "--", exe, "200000"]
     # workers model the remote hosts; with the local agent the ranks run
     # in the launcher pod, so workers just hold their slots
     worker_cmd = [sys.executable, "-c", "import time; time.sleep(60)"]

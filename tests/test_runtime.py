@@ -140,3 +140,67 @@ def test_kubelet_maps_signal_deaths_to_runtime_exit_codes():
         assert term.exit_code == 128 + 15  # SIGTERM -> 143
     finally:
         kubelet.stop()
+
+
+def test_netsim_pod_ip_stable_and_distinct():
+    from mpi_operator_tpu.runtime import netsim
+
+    a = netsim.pod_ip("default", "job-worker-0")
+    b = netsim.pod_ip("default", "job-worker-1")
+    c = netsim.pod_ip("other", "job-worker-0")
+    assert a == netsim.pod_ip("default", "job-worker-0")  # deterministic
+    assert len({a, b, c}) == 3                             # distinct
+    for ip in (a, b, c):
+        octets = [int(x) for x in ip.split(".")]
+        assert octets[0] == 127 and 64 <= octets[1] <= 127
+        assert 1 <= octets[3] <= 254
+
+
+def test_netsim_resolve_cluster_names():
+    from mpi_operator_tpu.runtime import netsim
+
+    # pod FQDN (3 labels before .svc) -> the pod's address, with or
+    # without the cluster domain
+    ip = netsim.pod_ip("ns1", "pi-worker-0")
+    assert netsim.resolve("pi-worker-0.pi.ns1.svc") == ip
+    assert netsim.resolve("pi-worker-0.pi.ns1.svc.cluster.local") == ip
+    # headless service name (2 labels) has no single pod behind it
+    assert netsim.resolve("pi.ns1.svc") is None
+    assert netsim.resolve("pi.ns1.svc.cluster.local") is None
+    # non-cluster names
+    assert netsim.resolve("example.com") is None
+    assert netsim.resolve("localhost") is None
+
+
+def test_kubelet_resolves_pod_names_to_per_pod_ips():
+    from mpi_operator_tpu.runtime import netsim
+
+    kubelet = LocalKubelet.__new__(LocalKubelet)  # resolver is stateless
+    v0 = kubelet.resolve_env_value("pi-worker-0.pi.ns1.svc:8476")
+    v1 = kubelet.resolve_env_value("pi-worker-1.pi.ns1.svc:8476")
+    assert v0 == f"{netsim.pod_ip('ns1', 'pi-worker-0')}:8476"
+    assert v1 == f"{netsim.pod_ip('ns1', 'pi-worker-1')}:8476"
+    assert v0 != v1
+    # bare service names keep the conventional loopback
+    assert kubelet.resolve_env_value("pi.ns1.svc") == "127.0.0.1"
+
+
+def test_kubelet_sets_pod_ip_when_running():
+    client = Clientset()
+    kubelet = LocalKubelet(client)
+    kubelet.start()
+    try:
+        pod = core.Pod(
+            metadata=ObjectMeta(name="ipcheck", namespace="default"),
+            spec=PodSpec(restart_policy="Never", containers=[Container(
+                name="c", command=[sys.executable, "-c",
+                                   "import time; time.sleep(5)"])]))
+        client.pods("default").create(pod)
+        from mpi_operator_tpu.runtime import netsim
+        want = netsim.pod_ip("default", "ipcheck")
+        assert _wait(lambda: client.pods("default").get(
+            "ipcheck").status.pod_ip == want)
+        assert client.pods("default").get("ipcheck").status.host_ip == \
+            "127.0.0.1"
+    finally:
+        kubelet.stop()
