@@ -28,42 +28,121 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_state(rng, model, tx):
-    import jax.numpy as jnp
-    params = model.init(rng, jnp.zeros((1, 16), jnp.float32))["params"]
-    return {"params": params, "opt": tx.init(params), "step": 0}
-
-
-def make_train_step(model, tx, mesh):
+def make_mlp_workload():
+    """Toy regression MLP: fast re-forming path for the hermetic e2e."""
+    import flax.linen as nn
     import jax
     import jax.numpy as jnp
     import optax
 
     from mpi_operator_tpu.parallel.mesh import batch_sharding
 
-    def loss_fn(params, x, y):
-        pred = model.apply({"params": params}, x)
-        return jnp.mean((pred - y) ** 2)
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(64)(x))
+            return nn.Dense(16)(x)
 
-    @jax.jit
-    def step(state, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], x, y)
-        updates, opt = tx.update(grads, state["opt"], state["params"])
-        return {"params": optax.apply_updates(state["params"], updates),
-                "opt": opt, "step": state["step"] + 1}, loss
+    model = MLP()
 
-    def run(state, x, y):
-        x = jax.device_put(x, batch_sharding(mesh, extra_dims=1))
-        y = jax.device_put(y, batch_sharding(mesh, extra_dims=1))
-        return step(state, x, y)
+    def init_state(rng, tx):
+        params = model.init(rng, jnp.zeros((1, 16), jnp.float32))["params"]
+        return {"params": params, "opt": tx.init(params), "step": 0}
 
-    return run
+    def batch(rng, n):
+        k1, k2 = jax.random.split(rng)
+        return (jax.random.normal(k1, (n, 16)),
+                jax.random.normal(k2, (n, 16)))
+
+    def make_step(tx, mesh):
+        def loss_fn(params, x, y):
+            pred = model.apply({"params": params}, x)
+            return jnp.mean((pred - y) ** 2)
+
+        @jax.jit
+        def step(state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"], x, y)
+            updates, opt = tx.update(grads, state["opt"], state["params"])
+            return {"params": optax.apply_updates(state["params"], updates),
+                    "opt": opt, "step": state["step"] + 1}, loss
+
+        def run(state, x, y):
+            x = jax.device_put(x, batch_sharding(mesh, extra_dims=1))
+            y = jax.device_put(y, batch_sharding(mesh, extra_dims=1))
+            return step(state, x, y)
+
+        return run
+
+    return init_state, batch, make_step
+
+
+def make_resnet50_workload(image_size: int):
+    """BASELINE.md's tracked elastic config (Elastic Horovod ResNet-50,
+    reference proposals/elastic-horovod.md:21-30), TPU-native: the same
+    save -> re-mesh -> restore loop around a ResNet-50 classifier.
+    BatchNorm statistics ride in the state next to params, so they
+    survive re-forming like everything else."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mpi_operator_tpu.models.resnet import (ResNet, cross_entropy_loss,
+                                                resnet50_config)
+    from mpi_operator_tpu.parallel.mesh import batch_sharding
+
+    model = ResNet(resnet50_config())
+
+    def init_state(rng, tx):
+        variables = model.init(
+            rng, jnp.zeros((1, image_size, image_size, 3), jnp.bfloat16),
+            train=False)
+        return {"params": variables["params"],
+                "batch_stats": variables["batch_stats"],
+                "opt": tx.init(variables["params"]), "step": 0}
+
+    def batch(rng, n):
+        k1, k2 = jax.random.split(rng)
+        return (jax.random.normal(
+                    k1, (n, image_size, image_size, 3), jnp.bfloat16),
+                jax.random.randint(k2, (n,), 0, 1000))
+
+    def make_step(tx, mesh):
+        def loss_fn(params, batch_stats, x, y):
+            logits, updates = model.apply(
+                {"params": params, "batch_stats": batch_stats}, x,
+                train=True, mutable=["batch_stats"])
+            return cross_entropy_loss(logits, y), updates["batch_stats"]
+
+        @jax.jit
+        def step(state, x, y):
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state["params"],
+                                       state["batch_stats"], x, y)
+            updates, opt = tx.update(grads, state["opt"], state["params"])
+            return {"params": optax.apply_updates(state["params"], updates),
+                    "batch_stats": stats, "opt": opt,
+                    "step": state["step"] + 1}, loss
+
+        def run(state, x, y):
+            x = jax.device_put(x, batch_sharding(mesh, extra_dims=3))
+            y = jax.device_put(y, batch_sharding(mesh, extra_dims=0))
+            return step(state, x, y)
+
+        return run
+
+    return init_state, batch, make_step
 
 
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=60)
     parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--model", choices=("mlp", "resnet50"),
+                        default="mlp",
+                        help="mlp: fast hermetic path; resnet50: the"
+                             " BASELINE.md elastic tracked config")
+    parser.add_argument("--image-size", type=int, default=32,
+                        help="resnet50 input size (224 on hardware)")
     parser.add_argument("--ckpt-dir", required=True)
     parser.add_argument("--poll", type=float, default=0.2,
                         help="membership poll interval")
@@ -72,9 +151,7 @@ def main() -> int:
                              " (deterministic driver control in tests)")
     args = parser.parse_args()
 
-    import flax.linen as nn
     import jax
-    import jax.numpy as jnp
     import optax
 
     from mpi_operator_tpu.bootstrap import elastic
@@ -83,12 +160,6 @@ def main() -> int:
     from mpi_operator_tpu.utils.checkpoint import (latest_step,
                                                    restore_checkpoint,
                                                    save_checkpoint)
-
-    class MLP(nn.Module):
-        @nn.compact
-        def __call__(self, x):
-            x = nn.relu(nn.Dense(64)(x))
-            return nn.Dense(16)(x)
 
     def world_size() -> int:
         hosts = elastic.current_hosts()
@@ -103,8 +174,13 @@ def main() -> int:
         dp = max(d for d in range(1, cap + 1) if args.batch % d == 0)
         return create_mesh(MeshConfig(dp=dp), devices=devices[:dp])
 
-    model = MLP()
-    tx = optax.sgd(0.05)
+    if args.model == "resnet50":
+        init_state, make_batch, make_step = make_resnet50_workload(
+            args.image_size)
+        tx = optax.sgd(0.05, momentum=0.9)
+    else:
+        init_state, make_batch, make_step = make_mlp_workload()
+        tx = optax.sgd(0.05)
     rng = jax.random.PRNGKey(0)
 
     def place(state, mesh):
@@ -115,12 +191,12 @@ def main() -> int:
 
     world = world_size()
     mesh = carve_mesh(world)
-    state = build_state(rng, model, tx)
+    state = init_state(rng, tx)
     resume = latest_step(args.ckpt_dir)
     if resume is not None:
         state = restore_checkpoint(args.ckpt_dir, state, step=resume)
     state = place(state, mesh)
-    train = make_train_step(model, tx, mesh)
+    train = make_step(tx, mesh)
 
     data_rng = jax.random.PRNGKey(7)
     worlds_seen = [world]
@@ -136,17 +212,16 @@ def main() -> int:
             step_now = int(state["step"])
             save_checkpoint(args.ckpt_dir, state, step=step_now)
             mesh = carve_mesh(new_world)
-            train = make_train_step(model, tx, mesh)
-            fresh = build_state(rng, model, tx)
+            train = make_step(tx, mesh)
+            fresh = init_state(rng, tx)
             state = place(restore_checkpoint(args.ckpt_dir, fresh,
                                              step=step_now), mesh)
             print(f"WORLD-CHANGE step={step_now} old={world} "
                   f"new={new_world} restored=True", flush=True)
             world = new_world
             worlds_seen.append(world)
-        data_rng, k1, k2 = jax.random.split(data_rng, 3)
-        x = jax.random.normal(k1, (args.batch, 16))
-        y = jax.random.normal(k2, (args.batch, 16))
+        data_rng, k = jax.random.split(data_rng)
+        x, y = make_batch(k, args.batch)
         state, loss = train(state, x, y)
         import time
         time.sleep(args.poll)  # training cadence; lets membership move

@@ -177,6 +177,12 @@ class _PodRunner:
                                     message="local runtime requires an"
                                             " explicit command")
             return
+        if self.kubelet.claim_pod_ip(self.namespace, self.pod_name) is None:
+            self.kubelet._set_phase(
+                self.namespace, self.pod_name, core.POD_FAILED,
+                reason="PodIPCollision",
+                message="netsim address already assigned to a live pod")
+            return
         volume_dirs = self._materialize_volumes()
         env = self._build_env(volume_dirs)
 
@@ -260,16 +266,31 @@ class LocalKubelet:
         FQDN itself), so distinct "hosts" really are distinct endpoints;
         bare service names keep 127.0.0.1 (a headless Service has no
         single address)."""
-        if not value:
-            return value
+        return netsim.rewrite(value) if value else value
 
-        def _sub(m: "re.Match") -> str:
-            return netsim.resolve(m.group(0)) or "127.0.0.1"
+    def claim_pod_ip(self, namespace: str, name: str) -> Optional[str]:
+        """Claim the pod's deterministic netsim address before launch.
 
-        return re.sub(
-            r"[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*"
-            r"\.svc(\.[a-z0-9.]+)?",
-            _sub, value)
+        The hash space is ~4.2M addresses, so a collision between two
+        live pods is vanishingly unlikely — but it would silently
+        collapse the distinct-endpoint guarantee, so a colliding claim
+        returns None and the runner refuses to launch.  Claims are
+        released when the pod object is deleted."""
+        ip = netsim.pod_ip(namespace, name)
+        with self._lock:
+            owner = self._pod_ips.setdefault(ip, (namespace, name))
+        if owner != (namespace, name):
+            logger.error(
+                "pod %s/%s: netsim address %s already assigned to pod "
+                "%s/%s", namespace, name, ip, owner[0], owner[1])
+            return None
+        return ip
+
+    def release_pod_ip(self, namespace: str, name: str) -> None:
+        ip = netsim.pod_ip(namespace, name)
+        with self._lock:
+            if self._pod_ips.get(ip) == (namespace, name):
+                del self._pod_ips[ip]
 
     def job_port(self, namespace: str, job_key: str, declared_port: str) -> int:
         with self._lock:
@@ -325,6 +346,7 @@ class LocalKubelet:
                     runner = self._runners.pop(key, None)
                 if runner is not None:
                     runner.stop()
+                self.release_pod_ip(*key)
 
     def _cm_loop(self) -> None:
         from ..k8s.apiserver import MODIFIED
@@ -376,27 +398,10 @@ class LocalKubelet:
             pod.status.message = message
             if phase == core.POD_RUNNING and not pod.status.pod_ip:
                 # Real kubelet semantics: podIP appears once the sandbox
-                # is up; here it is the pod's deterministic netsim address.
-                # The hash space is ~4.2M addresses, so a collision between
-                # two live pods is vanishingly unlikely — but it would
-                # silently collapse the distinct-endpoint guarantee, so
-                # fail the pod loudly instead.
-                ip = netsim.pod_ip(namespace, name)
-                with self._lock:
-                    owner = self._pod_ips.setdefault(ip, (namespace, name))
-                if owner != (namespace, name):
-                    phase = core.POD_FAILED
-                    ready = False
-                    reason = "PodIPCollision"
-                    message = (f"netsim address {ip} already assigned to "
-                               f"pod {owner[0]}/{owner[1]}")
-                    logger.error("pod %s/%s: %s", namespace, name, message)
-                else:
-                    pod.status.pod_ip = ip
-                    pod.status.host_ip = "127.0.0.1"
-                pod.status.phase = phase
-                pod.status.reason = reason
-                pod.status.message = message
+                # is up; uniqueness was claimed before launch
+                # (claim_pod_ip), so this is pure status reflection.
+                pod.status.pod_ip = netsim.pod_ip(namespace, name)
+                pod.status.host_ip = "127.0.0.1"
             pod.status.conditions = [c for c in pod.status.conditions
                                      if c.type != "Ready"]
             pod.status.conditions.append(core.PodCondition(

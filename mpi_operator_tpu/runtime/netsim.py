@@ -28,10 +28,14 @@ from typing import Optional
 
 # <label>(.<label>)*.svc[.<domain>] — the shape of every cluster-DNS name
 # the controller injects (meta.validation guarantees DNS-1035 labels).
-_CLUSTER_DNS_RE = re.compile(
-    r"^([a-z0-9]([-a-z0-9]*[a-z0-9])?)"
-    r"((\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*)"
-    r"\.svc(\.[a-z0-9.]+?)?\.?$")
+# Both regexes derive from one label pattern so the substring search
+# (rewrite) and the anchored parse (resolve) cannot drift apart.
+_LABEL = r"[a-z0-9](?:[-a-z0-9]*[a-z0-9])?"
+_SEARCH_RE = re.compile(
+    _LABEL + r"(?:\." + _LABEL + r")*" + r"\.svc(?:\.[a-z0-9.]+)?")
+_ANCHORED_RE = re.compile(
+    r"^(" + _LABEL + r")((?:\." + _LABEL + r")*)"
+    r"\.svc(?:\.[a-z0-9.]+?)?\.?$")
 
 
 def pod_ip(namespace: str, pod_name: str) -> str:
@@ -51,11 +55,19 @@ def resolve(fqdn: str) -> Optional[str]:
     Services resolve to every member — and returns None, as does any
     non-cluster name.
     """
-    m = _CLUSTER_DNS_RE.match(fqdn)
+    m = _ANCHORED_RE.match(fqdn)
     if not m:
         return None
-    labels = [m.group(1)] + [p for p in m.group(3).split(".") if p]
+    labels = [m.group(1)] + [p for p in m.group(2).split(".") if p]
     if len(labels) < 3:
         return None
     # <pod>.<service>.<ns>: the pod lives in the trailing namespace label.
     return pod_ip(labels[-1], labels[0])
+
+
+def rewrite(value: str, fallback: str = "127.0.0.1") -> str:
+    """Rewrite every embedded cluster-DNS name in ``value`` to its
+    simulated address (pod names) or ``fallback`` (service names — a
+    headless Service has no single address)."""
+    return _SEARCH_RE.sub(
+        lambda m: resolve(m.group(0)) or fallback, value)

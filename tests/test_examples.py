@@ -96,3 +96,56 @@ def test_bench_llama_smoke():
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert rec["metric"] == "llama1b_train_tokens_per_sec_per_chip"
     assert rec["value"] > 0 and rec["platform"] == "cpu"
+
+
+def test_elastic_resnet50_reforms_world(tmp_path):
+    """BASELINE.md tracked config (Elastic Horovod ResNet-50 autoscale):
+    the ResNet-50 elastic path saves, re-meshes and restores across a
+    membership change driven through the discover-hosts artifact."""
+    import time
+
+    mpi_dir = tmp_path / "mpi"
+    mpi_dir.mkdir()
+    hosts = mpi_dir / "discover_hosts.sh"
+    hosts.write_text("#!/bin/sh\necho h0\necho h1\n")
+    stop = tmp_path / "stop"
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["K_MOUNT_MPI"] = str(mpi_dir)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(EXAMPLES, "elastic_train.py"),
+         "--model", "resnet50", "--image-size", "32", "--batch", "4",
+         "--steps", "500", "--poll", "0.05",
+         "--ckpt-dir", str(tmp_path / "ckpt"), "--stop-file", str(stop)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        out = []
+        deadline = time.monotonic() + 420
+
+        def pump_until(marker):
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    return False
+                out.append(line)
+                if marker in line:
+                    return True
+            return False
+
+        assert pump_until("ELASTIC-TRAIN-START world=2"), "".join(out)
+        hosts.write_text("#!/bin/sh\necho h0\n")  # scale down 2 -> 1
+        assert pump_until("WORLD-CHANGE"), "".join(out)
+        stop.write_text("")
+        proc.wait(timeout=120)
+        out.append(proc.stdout.read() or "")
+        text = "".join(out)
+        assert proc.returncode == 0, text
+        assert "old=2 new=1 restored=True" in text, text
+        assert "ELASTIC-TRAIN-OK" in text, text
+    finally:
+        if proc.poll() is None:
+            proc.kill()
