@@ -49,6 +49,22 @@ class LlamaConfig:
                                               # {factor, low_freq_factor,
                                               #  high_freq_factor,
                                               #  original_max_position_embeddings}
+    page_size: int = 0                        # >0 -> paged KV cache with
+                                              # this block size (decode)
+    cache_blocks: int = 0                     # paged pool size; 0 -> auto
+                                              # (worst case for the batch)
+
+    @property
+    def blocks_per_row(self) -> int:
+        """Logical blocks per sequence under the paged layout."""
+        return -(-self.max_seq_len // max(1, self.page_size))
+
+    def pool_blocks(self, batch: int) -> int:
+        """Physical pool size: configured, or worst case (every row at
+        max_seq_len) + 1 for the reserved scratch block 0."""
+        if self.cache_blocks:
+            return self.cache_blocks
+        return 1 + batch * self.blocks_per_row
 
     @property
     def head_dim(self) -> int:
@@ -174,18 +190,41 @@ class LlamaAttention(nn.Module):
             features=feats, axis=-1, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name=name)
 
+        paged = decode and cfg.page_size > 0
         if decode:
             # Autoregressive KV cache (flax 'cache' collection).  The
             # cache index is PER ROW (shape [B]) and doubles as the
             # position offset for RoPE — rows decode at independent
             # positions, which is what variable-length batched serving
             # needs (generate() sets it to each row's prompt length).
-            cached_k = self.variable(
-                "cache", "cached_key", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
-            cached_v = self.variable(
-                "cache", "cached_value", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+            if paged:
+                # Paged layout (vLLM-style, static shapes): K/V live in a
+                # shared pool of fixed-size blocks; each row's
+                # block_table maps logical block j to a pool block.
+                # Block 0 is reserved scratch — a row whose table is all
+                # zeros (inactive slot) reads and writes garbage there
+                # without touching any live row's memory.
+                nb = cfg.pool_blocks(b)
+                pool_k = self.variable(
+                    "cache", "pool_key", jnp.zeros,
+                    (nb, cfg.page_size, cfg.kv_heads, cfg.head_dim),
+                    cfg.dtype)
+                pool_v = self.variable(
+                    "cache", "pool_value", jnp.zeros,
+                    (nb, cfg.page_size, cfg.kv_heads, cfg.head_dim),
+                    cfg.dtype)
+                block_table = self.variable(
+                    "cache", "block_table",
+                    lambda: jnp.zeros((b, cfg.blocks_per_row), jnp.int32))
+            else:
+                cached_k = self.variable(
+                    "cache", "cached_key", jnp.zeros,
+                    (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim),
+                    cfg.dtype)
+                cached_v = self.variable(
+                    "cache", "cached_value", jnp.zeros,
+                    (b, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim),
+                    cfg.dtype)
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((b,), jnp.int32))
@@ -198,7 +237,40 @@ class LlamaAttention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
-        if decode:
+        if paged:
+            idx = cache_index.value
+            # Scatter the s new tokens through the block table: token at
+            # sequence position p lands in pool block
+            # table[row, p // page] at offset p % page.  Distinct live
+            # rows own disjoint blocks (the allocator's invariant), so
+            # the flattened scatter indices never collide; inactive rows
+            # all land in scratch block 0, where last-write-wins is fine.
+            logical = jnp.clip(positions // cfg.page_size, 0,
+                               cfg.blocks_per_row - 1)
+            dest_block = jnp.take_along_axis(block_table.value, logical,
+                                             axis=1)            # [B, S]
+            dest_off = positions % cfg.page_size
+            flat_b = dest_block.reshape(-1)
+            flat_o = dest_off.reshape(-1)
+            pool_k.value = pool_k.value.at[flat_b, flat_o].set(
+                k.astype(cfg.dtype).reshape(b * s, cfg.kv_heads,
+                                            cfg.head_dim))
+            pool_v.value = pool_v.value.at[flat_b, flat_o].set(
+                v.astype(cfg.dtype).reshape(b * s, cfg.kv_heads,
+                                            cfg.head_dim))
+            cache_index.value = idx + s
+            # Gather each row's blocks in logical order: the view index
+            # equals the sequence position, so the position mask inside
+            # _decode_attention applies unchanged.
+            k_all = pool_k.value[block_table.value].reshape(
+                b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
+                cfg.head_dim)
+            v_all = pool_v.value[block_table.value].reshape(
+                b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
+                cfg.head_dim)
+            out = _decode_attention(q, k_all, v_all, positions,
+                                    cfg.n_heads // cfg.kv_heads)
+        elif decode:
             idx = cache_index.value
             # Per-row insertion at each row's own index.
             row_update = jax.vmap(
@@ -392,16 +464,45 @@ def _select_token(logits, temperature: float, top_p: float, rng):
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-def _set_cache_index(cache, lengths):
-    """Rewrite every per-layer cache_index leaf to the given [B] vector
-    (variable-length prefill: each row resumes at its own prompt end)."""
+def replace_cache_leaf(cache, name: str, value):
+    """Rewrite every per-layer cache leaf called ``name`` to ``value``
+    (or value(old) when value is callable) — the shared walker for
+    cache_index / block_table surgery here and in serving/batcher.py."""
     def rec(node):
         if hasattr(node, "items"):
-            return {k: (lengths if k == "cache_index" else rec(v))
+            return {k: ((value(v) if callable(value) else value)
+                        if k == name else rec(v))
                     for k, v in node.items()}
         return node
     return rec(cache)
 
+
+def _set_cache_index(cache, lengths):
+    """Rewrite every per-layer cache_index leaf to the given [B] vector
+    (variable-length prefill: each row resumes at its own prompt end)."""
+    return replace_cache_leaf(cache, "cache_index", lengths)
+
+
+
+def _set_block_tables(cache, table):
+    """Rewrite every per-layer block_table leaf to the given [B, MAXB]
+    array (paged layout)."""
+    return replace_cache_leaf(cache, "block_table", table)
+
+
+def canonical_block_table(batch: int, config: LlamaConfig):
+    """Contiguous allocation: row r owns pool blocks
+    [1 + r*blocks_per_row, ...) — block 0 stays reserved scratch.  The
+    whole-batch layout generate() uses (the batcher allocates per slot
+    instead)."""
+    bpr = config.blocks_per_row
+    need = 1 + batch * bpr
+    if config.pool_blocks(batch) < need:
+        raise ValueError(
+            f"cache_blocks={config.cache_blocks} < {need} needed for "
+            f"batch {batch} at max_seq_len {config.max_seq_len} "
+            f"(page_size {config.page_size})")
+    return 1 + jnp.arange(batch * bpr, dtype=jnp.int32).reshape(batch, bpr)
 
 
 def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
@@ -412,8 +513,26 @@ def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
     import functools
 
     params = {"params": variables["params"]}
-    logits, state = model.apply(params, prompt_tokens, decode=True,
-                                mutable=["cache"])
+    if model.config.page_size > 0:
+        # Paged cache: a fresh cache's block tables are all scratch —
+        # install the canonical contiguous allocation before prefill so
+        # every row owns its blocks.
+        cache_shapes = jax.eval_shape(
+            lambda t: model.apply(params, t, decode=True,
+                                  mutable=["cache"])[1]["cache"],
+            prompt_tokens)
+        cache0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+        if hasattr(cache0, "unfreeze"):
+            cache0 = cache0.unfreeze()
+        cache0 = _set_block_tables(cache0, canonical_block_table(
+            prompt_tokens.shape[0], model.config))
+        logits, state = model.apply({**params, "cache": cache0},
+                                    prompt_tokens, decode=True,
+                                    mutable=["cache"])
+    else:
+        logits, state = model.apply(params, prompt_tokens, decode=True,
+                                    mutable=["cache"])
     cache = state["cache"]
     if hasattr(cache, "unfreeze"):  # flax FrozenDict compatibility
         cache = cache.unfreeze()

@@ -12,6 +12,16 @@ sequences coexist in one batch.
 The decode step is jitted once for the fixed slot count; prefill is
 jitted per padded prompt-width bucket (powers of two) to bound
 recompilation.
+
+With ``page_size > 0`` the KV cache is paged (vLLM-style, static
+shapes): K/V live in a shared pool of fixed-size blocks and each slot
+holds a block table instead of a dense max_seq_len row
+(models/llama.py paged decode branch).  A slot's block budget
+(prompt + max_new_tokens) is reserved at admission and returned at
+retirement, so with ``cache_blocks`` below the worst case the pool
+oversubscribes: many short requests share the memory one worst-case
+slot would pin, and admission simply waits for blocks when the pool
+runs dry.
 """
 
 from __future__ import annotations
@@ -54,7 +64,10 @@ class ContinuousBatcher:
     sampling requests share decode ticks."""
 
     def __init__(self, model, variables, max_slots: int = 4,
-                 device_lock: Optional[threading.Lock] = None):
+                 device_lock: Optional[threading.Lock] = None,
+                 page_size: int = 0, cache_blocks: int = 0):
+        import dataclasses
+
         import jax
         import jax.numpy as jnp
 
@@ -71,12 +84,43 @@ class ContinuousBatcher:
         self._device_lock = device_lock or threading.Lock()
 
         cfg = model.config
+        if getattr(cfg, "page_size", 0) > 0:
+            # Prefill runs on the dense layout and the batcher derives
+            # the paged decode model itself — a pre-paged model here
+            # would make prefill read all-scratch tables (garbage) and
+            # break install.  The layout is the batcher's to choose:
+            # pass page_size= to this constructor instead.
+            raise ValueError(
+                "ContinuousBatcher requires a dense-layout model "
+                "(config.page_size == 0); use the page_size argument "
+                "to enable the paged cache")
         self._jnp = jnp
         self._jax = jax
         params = {"params": variables["params"]}
 
+        # Paged KV cache (page_size > 0): decode runs against a shared
+        # block pool with per-slot block tables instead of per-slot dense
+        # rows.  cache_blocks sizes the pool (default: worst case, every
+        # slot at max_seq_len); smaller pools oversubscribe — admission
+        # waits for free blocks, so many short sequences can share the
+        # memory one worst-case slot would pin.  Prefill stays on the
+        # dense layout (batch-1 row, scattered into the pool on install).
+        self.page_size = page_size
+        if page_size > 0:
+            decode_cfg = dataclasses.replace(
+                cfg, page_size=page_size, cache_blocks=cache_blocks)
+            self._decode_model = type(model)(decode_cfg)
+            nb = decode_cfg.pool_blocks(max_slots)
+            self._free_blocks = list(range(1, nb))  # 0 = reserved scratch
+            self._total_blocks = nb - 1
+            self._slot_blocks: dict = {}
+            self._blocks_per_row = decode_cfg.blocks_per_row
+        else:
+            self._decode_model = model
+        decode_model = self._decode_model
+
         # Persistent slot cache, initialized by tracing a dummy decode.
-        _, state = model.apply(
+        _, state = decode_model.apply(
             params, jnp.zeros((max_slots, 1), jnp.int32), decode=True,
             mutable=["cache"])
         cache = state["cache"]
@@ -86,7 +130,7 @@ class ContinuousBatcher:
 
         @jax.jit
         def decode_step(cache, tokens, temps, top_ps, keys):
-            logits, state = model.apply(
+            logits, state = decode_model.apply(
                 {**params, "cache": cache}, tokens[:, None], decode=True,
                 mutable=["cache"])
             nxt, keys = _select_rows(logits[:, -1], temps, top_ps, keys)
@@ -130,6 +174,8 @@ class ContinuousBatcher:
         jnp = self._jnp
         if hasattr(row_cache, "unfreeze"):
             row_cache = row_cache.unfreeze()
+        if self.page_size > 0:
+            return self._install_paged(slot, row_cache, length)
 
         def rec(dst, src):
             if hasattr(dst, "items"):
@@ -140,6 +186,64 @@ class ContinuousBatcher:
             return dst.at[slot].set(jnp.int32(length))  # cache_index [B]
         self._cache = rec(self._cache, row_cache)
 
+    # -- paged-pool plumbing ----------------------------------------------
+    def _blocks_needed(self, total_tokens: int) -> int:
+        return -(-total_tokens // self.page_size)
+
+    def _alloc_blocks(self, slot: int, total_tokens: int) -> bool:
+        """Reserve the slot's whole block budget (prompt + max new
+        tokens, known at admission) or decline."""
+        need = self._blocks_needed(total_tokens)
+        if len(self._free_blocks) < need:
+            return False
+        self._slot_blocks[slot] = [self._free_blocks.pop()
+                                   for _ in range(need)]
+        return True
+
+    def _retire_slot(self, slot: int) -> None:
+        """Return the slot's blocks and point its table back at scratch
+        block 0, so the still-ticking inactive row cannot write into
+        blocks about to be reallocated."""
+        if self.page_size <= 0:
+            return
+        blocks = self._slot_blocks.pop(slot, None)
+        if not blocks:
+            return
+        self._free_blocks.extend(blocks)
+        from ..models.llama import replace_cache_leaf
+        self._cache = replace_cache_leaf(
+            self._cache, "block_table", lambda t: t.at[slot].set(0))
+
+    def _install_paged(self, slot: int, row_cache, length: int):
+        """Scatter a batch-1 dense prefill row into the slot's allocated
+        pool blocks and publish its block table."""
+        jnp = self._jnp
+        blocks = self._slot_blocks[slot]
+        barr = jnp.asarray(blocks, jnp.int32)
+        span = len(blocks) * self.page_size
+        table_row = jnp.zeros((self._blocks_per_row,), jnp.int32)
+        table_row = table_row.at[:len(blocks)].set(barr)
+
+        def rec(dst, src):
+            if "pool_key" in dst:
+                out = dict(dst)
+                for pool, dense in (("pool_key", "cached_key"),
+                                    ("pool_value", "cached_value")):
+                    seq = src[dense][0]          # [L, KH, D]
+                    take = min(seq.shape[0], span)
+                    chunk = jnp.zeros((span,) + seq.shape[1:], seq.dtype)
+                    chunk = chunk.at[:take].set(seq[:take])
+                    out[pool] = dst[pool].at[barr].set(
+                        chunk.reshape(len(blocks), self.page_size,
+                                      *seq.shape[1:]))
+                out["block_table"] = dst["block_table"].at[slot].set(
+                    table_row)
+                out["cache_index"] = dst["cache_index"].at[slot].set(
+                    jnp.int32(length))
+                return out
+            return {k: rec(dst[k], src[k]) for k in dst}
+        self._cache = rec(self._cache, row_cache)
+
     # -- public API --------------------------------------------------------
     def _enqueue(self, tokens, max_new_tokens, temperature, top_p, seed,
                  on_token=None) -> _Request:
@@ -148,6 +252,13 @@ class ContinuousBatcher:
                 f"prompt ({len(tokens)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"{self._max_seq_len}")
+        if self.page_size > 0:
+            need = self._blocks_needed(len(tokens) + max_new_tokens)
+            if need > self._total_blocks:
+                raise ValueError(
+                    f"request needs {need} cache blocks but the pool "
+                    f"only has {self._total_blocks} (cache_blocks too "
+                    f"small)")
         if self._stop.is_set():
             raise RuntimeError("batcher stopped")
         if seed is None:
@@ -221,6 +332,9 @@ class ContinuousBatcher:
         temps = jnp.zeros((self.max_slots,), jnp.float32)
         top_ps = jnp.ones((self.max_slots,), jnp.float32)
         keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
+        # A request that could not get cache blocks waits here (FIFO
+        # order preserved) until retirements free enough of the pool.
+        deferred: Optional[_Request] = None
 
         while not self._stop.is_set():
             # Admit new requests into free slots.
@@ -228,9 +342,16 @@ class ContinuousBatcher:
             for i in range(self.max_slots):
                 if slots[i] is not None:
                     continue
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
+                if deferred is not None:
+                    req, deferred = deferred, None
+                else:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                if self.page_size > 0 and not self._alloc_blocks(
+                        i, len(req.tokens) + req.max_new_tokens):
+                    deferred = req  # pool exhausted; retry after retires
                     break
                 try:
                     key0 = jax.random.fold_in(
@@ -244,6 +365,7 @@ class ContinuousBatcher:
                     req.emit(int(first))
                     if len(req.output) >= req.max_new_tokens:
                         req.done.set()
+                        self._retire_slot(i)
                         continue
                     slots[i] = req
                     next_tokens = next_tokens.at[i].set(int(first))
@@ -254,6 +376,7 @@ class ContinuousBatcher:
                 except Exception as exc:  # surface, don't kill the loop
                     req.error = exc
                     req.done.set()
+                    self._retire_slot(i)
 
             if not any(s is not None for s in slots):
                 if not admitted:
@@ -277,14 +400,19 @@ class ContinuousBatcher:
                 if req.cancelled.is_set():
                     req.done.set()
                     slots[i] = None
+                    self._retire_slot(i)
                     continue
                 req.emit(int(out[i]))
                 if len(req.output) >= req.max_new_tokens:
                     req.done.set()
                     slots[i] = None
+                    self._retire_slot(i)
 
         # drain on shutdown (submit() rejects once _stop is set, so this
         # converges; get_nowait is the only safe concurrent drain)
+        if deferred is not None:
+            deferred.error = RuntimeError("batcher stopped")
+            deferred.done.set()
         while True:
             try:
                 req = self._queue.get_nowait()

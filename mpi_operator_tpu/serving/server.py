@@ -109,7 +109,8 @@ class InferenceServer:
     # /generate is unauthenticated and compute-expensive, so exposing it
     # on all interfaces must be an explicit opt-in (host="0.0.0.0").
     def __init__(self, model, variables, host: str = "127.0.0.1",
-                 port: int = 0, max_batch_slots: int = 0, mesh=None):
+                 port: int = 0, max_batch_slots: int = 0, mesh=None,
+                 kv_page_size: int = 0, kv_cache_blocks: int = 0):
         self.model = model
         self.variables = variables
         self.mesh = mesh
@@ -135,11 +136,18 @@ class InferenceServer:
         # The batcher shares this server's device lock, so batcher ticks
         # and non-batched generations still never overlap on the device.
         self._batcher = None
+        if kv_page_size > 0 and max_batch_slots <= 0:
+            raise ValueError(
+                "kv_page_size requires continuous batching "
+                "(max_batch_slots > 0); the non-batched path uses the "
+                "dense cache")
         if max_batch_slots > 0:
             from .batcher import ContinuousBatcher
             self._batcher = ContinuousBatcher(model, self.variables,
                                               max_slots=max_batch_slots,
-                                              device_lock=self._lock)
+                                              device_lock=self._lock,
+                                              page_size=kv_page_size,
+                                              cache_blocks=kv_cache_blocks)
 
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,

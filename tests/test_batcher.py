@@ -170,3 +170,160 @@ def test_streaming_through_batcher_matches_greedy():
                                       np.asarray(expected[0]))
     finally:
         server.stop()
+
+
+@pytest.fixture(scope="module")
+def paged_setup():
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    # Oversubscribed pool: 3 slots at max_seq_len=256 would need 48
+    # blocks of 16; give 14 so admission has to wait for retirements.
+    batcher = ContinuousBatcher(model, variables, max_slots=3,
+                                page_size=16, cache_blocks=15).start()
+    yield batcher, model, variables
+    batcher.stop()
+
+
+def test_paged_concurrent_requests_match_individual_greedy(paged_setup):
+    """Paged-pool decode must be token-identical to the dense path:
+    six concurrent requests through 3 slots and a 14-block pool (each
+    request needs 1-2 blocks; retirements recycle them)."""
+    batcher, model, variables = paged_setup
+    prompts = [[5, 3, 8, 1], [7, 6], [1, 2, 3, 4, 5, 6, 7],
+               [9], [4, 4, 4], [2, 7, 1, 8, 2, 8]]
+    results = [None] * len(prompts)
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = batcher.submit(prompts[i], 8)
+        except Exception as exc:
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    for i, p in enumerate(prompts):
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([p], jnp.int32), 8)
+        np.testing.assert_array_equal(np.asarray(results[i]),
+                                      np.asarray(expected[0]),
+                                      err_msg=f"prompt {i}")
+
+
+def test_paged_pool_exhaustion_queues_and_recycles(paged_setup):
+    """Requests whose block budget exceeds the free pool wait for
+    retirements instead of failing; block accounting returns to fully
+    free afterwards."""
+    batcher, model, variables = paged_setup
+    # 64 total tokens -> 4 blocks each; 3 in flight need 12 of 14
+    # blocks, so with 3 slots the pool (not the slot count) throttles.
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6]] * 5
+    results = [None] * len(prompts)
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = batcher.submit(prompts[i], 56, timeout=600)
+        except Exception as exc:
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    assert not errors, errors
+    expected = greedy_generate(
+        model, variables, jnp.asarray([prompts[0]], jnp.int32), 56)
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(np.asarray(results[i]),
+                                      np.asarray(expected[0]),
+                                      err_msg=f"request {i}")
+    assert sorted(batcher._free_blocks) == list(range(1, 15))
+    assert batcher._slot_blocks == {}
+
+
+def test_paged_rejects_request_larger_than_pool(paged_setup):
+    batcher, _, _ = paged_setup
+    with pytest.raises(ValueError, match="cache blocks"):
+        batcher.submit([1, 2, 3], 230)  # 15 blocks > 14-block pool
+
+
+def test_paged_generate_matches_dense():
+    """generate() itself under a paged config (canonical block tables)
+    is token-identical to the dense layout, incl. variable lengths."""
+    import dataclasses
+
+    cfg = llama2_tiny()
+    model_d = LlamaModel(cfg)
+    model_p = LlamaModel(dataclasses.replace(cfg, page_size=16))
+    variables = model_d.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 4), jnp.int32))
+    prompts = jnp.asarray([[5, 6, 7, 8, 9, 10, 0, 0],
+                           [11, 12, 13, 0, 0, 0, 0, 0]], jnp.int32)
+    lengths = jnp.asarray([6, 3], jnp.int32)
+    from mpi_operator_tpu.models.llama import generate
+    out_d = generate(model_d, variables, prompts, 12,
+                     prompt_lengths=lengths)
+    out_p = generate(model_p, variables, prompts, 12,
+                     prompt_lengths=lengths)
+    np.testing.assert_array_equal(np.asarray(out_d), np.asarray(out_p))
+    # sampling path shares the rng stream, so it must match too
+    out_ds = generate(model_d, variables, prompts, 8, temperature=0.8,
+                      top_p=0.9, prompt_lengths=lengths)
+    out_ps = generate(model_p, variables, prompts, 8, temperature=0.8,
+                      top_p=0.9, prompt_lengths=lengths)
+    np.testing.assert_array_equal(np.asarray(out_ds), np.asarray(out_ps))
+
+
+def test_http_server_with_paged_batching():
+    """InferenceServer(kv_page_size=...) wires the paged pool through
+    the HTTP batching path with exact results."""
+    import json
+    import urllib.request
+
+    from mpi_operator_tpu.serving import InferenceServer
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 4), jnp.int32))
+    server = InferenceServer(model, variables, host="127.0.0.1",
+                             max_batch_slots=2, kv_page_size=16,
+                             kv_cache_blocks=9).start()
+    try:
+        assert server._batcher.page_size == 16
+        prompts = [[3, 1, 4], [1, 5, 9, 2, 6]]
+        results = [None] * len(prompts)
+
+        def post(i):
+            req = urllib.request.Request(
+                server.url + "/generate",
+                data=json.dumps({"tokens": [prompts[i]],
+                                 "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                results[i] = json.loads(resp.read())["tokens"][0]
+
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for i, p in enumerate(prompts):
+            expected = greedy_generate(model, variables,
+                                       jnp.asarray([p], jnp.int32), 4)
+            np.testing.assert_array_equal(np.asarray(results[i]),
+                                          np.asarray(expected[0]))
+    finally:
+        server.stop()
