@@ -36,6 +36,13 @@ def main() -> int:
                              " fwd/bwd, activation memory bounded by"
                              " pipeline depth")
     parser.add_argument("--microbatches", type=int, default=4)
+    parser.add_argument("--virtual-stages", type=int, default=1,
+                        help="with --pipeline-schedule 1f1b: chunks per"
+                             " pipeline rank (interleaved schedule;"
+                             " bubble shrinks ~1/V)")
+    parser.add_argument("--n-layers", type=int, default=0,
+                        help="override the config's layer count (e.g."
+                             " to divide by pp * virtual-stages)")
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--num-slices", type=int, default=0,
@@ -79,6 +86,9 @@ def main() -> int:
     cfg = {"7b": llama2_7b, "tiny": llama2_tiny,
            "mixtral-tiny": mixtral_tiny,
            "mixtral-8x7b": mixtral_8x7b}[args.config](remat=args.remat)
+    if args.n_layers:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, n_layers=args.n_layers)
     model = LlamaModel(cfg, mesh=mesh)
 
     dp_total = mesh.shape["dp"] * mesh.shape["fsdp"]
@@ -121,7 +131,8 @@ def main() -> int:
             @jax.jit
             def f1_step(variables, opt_state, batch):
                 loss, grads = pipeline_loss_and_grads_1f1b(
-                    cfg, variables, batch, mesh, args.microbatches)
+                    cfg, variables, batch, mesh, args.microbatches,
+                    virtual_stages=args.virtual_stages)
                 updates, opt_state = tx.update(grads, opt_state,
                                                variables["params"])
                 return ({"params": optax.apply_updates(
@@ -141,7 +152,9 @@ def main() -> int:
             print(f"mesh dp={mesh.shape['dp']} fsdp={mesh.shape['fsdp']}"
                   f" pp={mesh.shape['pp']} ep={mesh.shape['ep']}"
                   f" tp={mesh.shape['tp']} sp={mesh.shape['sp']}"
-                  f" schedule=1f1b")
+                  f" schedule=1f1b"
+                  + (f" virtual_stages={args.virtual_stages}"
+                     if args.virtual_stages > 1 else ""))
             print(f"tokens/sec: {tokens_per_sec:.0f}"
                   f" loss={final_loss:.4f}")
         return 0

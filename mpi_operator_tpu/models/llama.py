@@ -261,7 +261,12 @@ class LlamaAttention(nn.Module):
             cache_index.value = idx + s
             # Gather each row's blocks in logical order: the view index
             # equals the sequence position, so the position mask inside
-            # _decode_attention applies unchanged.
+            # _decode_attention applies unchanged.  NOTE: the gather
+            # materializes a dense-sized [B, MAXB*page, KH, D] view per
+            # step (unless XLA fuses it into the attention einsum), so
+            # paging buys CAPACITY (pool below worst case, more live
+            # slots per GB) rather than decode bandwidth; a fused paged
+            # attention kernel is the follow-up that removes the view.
             k_all = pool_k.value[block_table.value].reshape(
                 b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
                 cfg.head_dim)

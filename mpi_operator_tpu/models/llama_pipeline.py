@@ -94,25 +94,33 @@ def pipeline_loss(config: LlamaConfig, variables: dict, tokens, mesh,
 
 
 def pipeline_loss_and_grads_1f1b(config: LlamaConfig, variables: dict,
-                                 tokens, mesh, num_microbatches: int = 4):
+                                 tokens, mesh, num_microbatches: int = 4,
+                                 virtual_stages: int = 1):
     """Fused 1F1B training step core: (loss, grads) in one pipelined
     pass with the 1F1B schedule (parallel/pipeline.pipeline_1f1b) —
     activation memory bounded by pipeline depth instead of microbatch
-    count, stage forwards rematerialized in the backward.
+    count, stage forwards rematerialized in the backward.  With
+    ``virtual_stages > 1`` the interleaved schedule runs instead
+    (pipeline_interleaved_1f1b): each rank holds V chunks of
+    n_layers/(pp*V) blocks and the bubble shrinks ~1/V.
 
     Returns (loss, grads) where grads matches variables["params"]'s
     structure exactly (verified against jax.grad of the sequential
     model), ready for optax.
     """
-    from ..parallel.pipeline import pipeline_1f1b, split_microbatches
+    from ..parallel.pipeline import (pipeline_1f1b,
+                                     pipeline_interleaved_1f1b,
+                                     split_microbatches)
     from .llama import next_token_loss
 
     pp = mesh.shape["pp"]
-    assert config.n_layers % pp == 0, (config.n_layers, pp)
+    n_chunks = pp * virtual_stages
+    assert config.n_layers % n_chunks == 0, (config.n_layers, n_chunks)
     params = variables["params"]
     s = tokens.shape[1]
     positions = jnp.arange(s)
-    stage_fn, staged = _staged_blocks(config, variables, positions, pp)
+    stage_fn, staged = _staged_blocks(config, variables, positions,
+                                      n_chunks)
     token_micro = split_microbatches(tokens, num_microbatches)
     emb = jnp.asarray(params["tok_embeddings"]["embedding"])
 
@@ -129,9 +137,14 @@ def pipeline_loss_and_grads_1f1b(config: LlamaConfig, variables: dict,
         logits = h @ hp["output"]["kernel"].astype(config.dtype)
         return next_token_loss(logits, toks)
 
-    loss, stage_grads, head_grads, dx = pipeline_1f1b(
-        stage_fn, head_fn, staged, head_params, x_micro, mesh,
-        aux=token_micro)
+    if virtual_stages > 1:
+        loss, stage_grads, head_grads, dx = pipeline_interleaved_1f1b(
+            stage_fn, head_fn, staged, head_params, x_micro, mesh,
+            virtual_stages, aux=token_micro)
+    else:
+        loss, stage_grads, head_grads, dx = pipeline_1f1b(
+            stage_fn, head_fn, staged, head_params, x_micro, mesh,
+            aux=token_micro)
 
     (d_emb,) = embed_vjp(dx.astype(x_micro.dtype))
     layer_grads = jax.tree_util.tree_map(

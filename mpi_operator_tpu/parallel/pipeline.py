@@ -322,29 +322,8 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
 
         carry, _ = jax.lax.scan(step, carry0, jnp.arange(n_ticks))
 
-        # Collect: loss/head grads live on the last stage, dx on stage 0,
-        # stage grads stay per-stage (leading dim 1 -> 'pp').  Each
-        # batch-axis member saw only its local shard, so loss and param
-        # grads need the data-parallel mean autodiff would have inserted
-        # (dx stays per-shard — it is batch-sharded output).
-        on = lambda cond, x: jnp.where(cond, x, jnp.zeros_like(x))  # noqa
-        dp_axes = tuple(a for a in batch_axes if a in mesh.shape)
-        dp_mean = (lambda v: jax.lax.pmean(v, dp_axes)) if dp_axes \
-            else (lambda v: v)
-        loss = dp_mean(jax.lax.psum(on(p == last, carry["loss"]),
-                                    axis_name))
-        head_grads = jax.tree_util.tree_map(
-            lambda g: dp_mean(jax.lax.psum(on(p == last, g), axis_name)),
-            carry["head_grads"])
-        # dx is d(LOCAL shard mean)/dx_local; the global loss is the mean
-        # over shards, so each shard's input gradient carries 1/n_dp.
-        n_dp = 1
-        for a in dp_axes:
-            n_dp *= mesh.shape[a]
-        dx = jax.lax.psum(on(p == 0, carry["dx"]), axis_name) / n_dp
-        stage_grads = jax.tree_util.tree_map(
-            lambda g: dp_mean(g)[None], carry["grads"])
-        return loss, stage_grads, head_grads, dx
+        return _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last,
+                             lambda g: g[None])
 
     extra = [None] * (microbatches.ndim - 2)
     x_spec = P(None, batch_axes, *extra)
@@ -370,3 +349,337 @@ def _head_value_and_grads(head_loss, head_params, y):
     loss, vjp_fn = jax.vjp(head_loss, head_params, y)
     dhead, dy = vjp_fn(jnp.float32(1.0))
     return loss, (dhead, dy)
+
+
+def _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last, expand):
+    """Shared 1F1B collect epilogue (plain and interleaved schedules):
+    loss/head grads live on the last stage, dx on stage 0, stage grads
+    stay per-rank (``expand`` restores the 'pp'-sharded leading axis —
+    [None] for [P,...] stacks, [:, None] for [V, P, ...]).  Each
+    batch-axis member saw only its local shard, so loss and param grads
+    get the data-parallel mean autodiff would have inserted; dx is
+    d(LOCAL shard mean)/dx_local and the global loss is the mean over
+    shards, so each shard's input gradient carries 1/n_dp."""
+    on = lambda cond, x: jnp.where(cond, x, jnp.zeros_like(x))  # noqa
+    dp_axes = tuple(a for a in batch_axes if a in mesh.shape)
+    dp_mean = (lambda v: jax.lax.pmean(v, dp_axes)) if dp_axes \
+        else (lambda v: v)
+    loss = dp_mean(jax.lax.psum(on(p == last, carry["loss"]), axis_name))
+    head_grads = jax.tree_util.tree_map(
+        lambda g: dp_mean(jax.lax.psum(on(p == last, g), axis_name)),
+        carry["head_grads"])
+    n_dp = 1
+    for a in dp_axes:
+        n_dp *= mesh.shape[a]
+    dx = jax.lax.psum(on(p == 0, carry["dx"]), axis_name) / n_dp
+    stage_grads = jax.tree_util.tree_map(
+        lambda g: expand(dp_mean(g)), carry["grads"])
+    return loss, stage_grads, head_grads, dx
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) 1F1B schedule
+# ---------------------------------------------------------------------------
+
+def _simulate_interleaved(n_stages: int, n_virtual: int, n_micro: int):
+    """Static schedule for Megatron-style interleaved 1F1B: each
+    pipeline rank owns ``n_virtual`` chunks (rank p holds global stages
+    v*P + p), microbatches cycle through chunks in groups of P, and the
+    warmup depth grows by (V-1)*P forwards — the bubble shrinks ~1/V at
+    the cost of V x the chunk-boundary communication (all of it
+    nearest-neighbor ppermute traffic on the ring, incl. the P-1 -> 0
+    wrap between chunks).
+
+    Returns (fwd_table, bwd_table, n_ticks, ring sizes): tables are
+    [P, T] int32 with entries v*M + m (or -1 idle); ring sizes are the
+    maximum simulated occupancies of the forward-input, backward-input
+    and saved-activation buffers, so the SPMD body can size its ring
+    buffers exactly.
+    """
+    import numpy as np
+
+    P, V, M = n_stages, n_virtual, n_micro
+    if M % P != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs microbatches divisible by stages "
+            f"({M} % {P})")
+    S = P * V
+
+    def f_op(p, k):
+        g, j = divmod(k, P * V)
+        return (j // P, g * P + j % P)        # (chunk, microbatch)
+
+    def b_op(p, k):
+        g, j = divmod(k, P * V)
+        return (V - 1 - j // P, g * P + j % P)
+
+    t_max = 4 * (M * V + P) + 8
+    fwd = -np.ones((P, t_max), np.int64)
+    bwd = -np.ones((P, t_max), np.int64)
+    fwd_done = np.full((S, M), t_max + 1)
+    bwd_done = np.full((S, M), t_max + 1)
+    nf = [0] * P
+    nb = [0] * P
+    caps = [min(M * V, (V - 1) * P + 2 * (P - p - 1) + 1)
+            for p in range(P)]
+
+    end = 0
+    for t in range(t_max):
+        if all(nb[p] == M * V for p in range(P)):
+            end = t
+            break
+        for p in range(P):
+            if nf[p] < M * V and (nf[p] - nb[p]) < caps[p]:
+                v, m = f_op(p, nf[p])
+                s = v * P + p
+                if s == 0 or fwd_done[s - 1][m] < t:
+                    fwd[p][t] = v * M + m
+                    fwd_done[s][m] = t
+                    nf[p] += 1
+            if nb[p] < M * V:
+                v, m = b_op(p, nb[p])
+                s = v * P + p
+                ready = (fwd_done[s][m] <= t) if s == S - 1 \
+                    else (bwd_done[s + 1][m] < t)
+                if ready:
+                    bwd[p][t] = v * M + m
+                    bwd_done[s][m] = t
+                    nb[p] += 1
+    else:
+        raise RuntimeError("interleaved 1F1B schedule did not converge")
+
+    # Exact ring-buffer sizes from the simulated arrival/consume ticks.
+    def max_occupancy(arrivals, consumes):
+        """arrivals/consumes: lists of (tick, key); occupancy counts
+        arrived-not-yet-consumed at each tick."""
+        events = [(t, 1) for t, _ in arrivals] + \
+                 [(t + 1, -1) for t, _ in consumes]
+        occ = best = 0
+        for _, d in sorted(events):
+            occ += d
+            best = max(best, occ)
+        return max(best, 1)
+
+    kf = kb = kx = 1
+    for p in range(P):
+        for v in range(V):
+            s = v * P + p
+            f_arr = [(fwd_done[s - 1][m], m) for m in range(M) if s > 0]
+            f_con = [(fwd_done[s][m], m) for m in range(M) if s > 0]
+            kf = max(kf, max_occupancy(f_arr, f_con))
+            b_arr = [(bwd_done[s + 1][m] if s < S - 1
+                      else fwd_done[s][m], m) for m in range(M)]
+            b_con = [(bwd_done[s][m], m) for m in range(M)]
+            kb = max(kb, max_occupancy(b_arr, b_con))
+            x_arr = [(fwd_done[s][m], m) for m in range(M)]
+            x_con = [(bwd_done[s][m], m) for m in range(M)]
+            kx = max(kx, max_occupancy(x_arr, x_con))
+    return (fwd[:, :end].astype(np.int32), bwd[:, :end].astype(np.int32),
+            end, kf, kb, kx)
+
+
+def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
+                              stacked_params, head_params, microbatches,
+                              mesh, virtual_stages: int,
+                              axis_name: str = "pp",
+                              batch_axes=("dp", "fsdp"), aux=None):
+    """Interleaved (virtual-stage) 1F1B: rank p holds ``virtual_stages``
+    chunks (global stage v*P + p), shrinking the pipeline bubble ~1/V
+    vs `pipeline_1f1b` at the cost of V x the chunk-boundary ppermute
+    traffic (still all nearest-neighbor, incl. the P-1 -> 0 ring wrap).
+
+    - stacked_params: pytree with leading dim S = P * virtual_stages
+      (global stage s = v*P + p at index s, i.e. `stack_stage_params`
+      order); grads come back in the same layout.
+    - stage_fn(params, x) -> y operates on ONE chunk's params.
+    - head_fn / aux / return signature match `pipeline_1f1b`.
+
+    Microbatch count must divide by P (the canonical interleaved
+    grouping).  Gradients are exact (tested against jax.grad of the
+    sequential model).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis_name]
+    n_virtual = virtual_stages
+    total = n_stages * n_virtual
+    m_count = microbatches.shape[0]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != total:
+            raise ValueError(
+                f"stacked stage dim {leaf.shape[0]} != "
+                f"pp*virtual = {total}")
+    if n_virtual == 1:
+        return pipeline_1f1b(stage_fn, head_fn, stacked_params,
+                             head_params, microbatches, mesh,
+                             axis_name=axis_name, batch_axes=batch_axes,
+                             aux=aux)
+
+    fwd_np, bwd_np, n_ticks, kf, kb, kx = _simulate_interleaved(
+        n_stages, n_virtual, m_count)
+    fwd_table = jnp.asarray(fwd_np)
+    bwd_table = jnp.asarray(bwd_np)
+
+    # [S, ...] -> [V, P, ...]: s = v*P + p, so a plain reshape lands
+    # chunk v of rank p at [v, p].
+    def to_vp(leaf):
+        return leaf.reshape((n_virtual, n_stages) + leaf.shape[1:])
+
+    def from_vp(leaf):
+        return leaf.reshape((total,) + leaf.shape[2:])
+
+    stacked_vp = jax.tree_util.tree_map(to_vp, stacked_params)
+
+    def vp_specs(tree):
+        def spec(leaf):
+            return P(None, axis_name, *([None] * (leaf.ndim - 2)))
+        return jax.tree_util.tree_map(spec, tree)
+
+    def body(stacked_local, head_local, xs, xs_aux):
+        p = jax.lax.axis_index(axis_name)
+        # [V, 1, ...] -> [V, ...]
+        chunks = jax.tree_util.tree_map(lambda a: a[:, 0], stacked_local)
+        mb_shape = xs.shape[1:]
+        last = n_stages - 1
+        ring_r = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        ring_l = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def chunk_params(v):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, v, 0, keepdims=False), chunks)
+
+        zeros_mb = jnp.zeros(mb_shape, xs.dtype)
+        carry0 = {
+            "fwd_buf": jnp.zeros((n_virtual, kf) + mb_shape, xs.dtype),
+            "bwd_buf": jnp.zeros((n_virtual, kb) + mb_shape, jnp.float32),
+            "x_buf": jnp.zeros((n_virtual, kx) + mb_shape, xs.dtype),
+            "grads": jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), chunks),
+            "head_grads": jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), head_local),
+            "dx": jnp.zeros((m_count,) + mb_shape, jnp.float32),
+            "loss": jnp.float32(0.0),
+        }
+
+        def decode(e):
+            return e // m_count, e % m_count   # (chunk, microbatch)
+
+        def step(carry, t):
+            my_f = fwd_table[p][t]
+            my_b = bwd_table[p][t]
+            do_f = my_f >= 0
+            do_b = my_b >= 0
+            v_f, m_f = decode(jnp.maximum(my_f, 0))
+            v_b, m_b = decode(jnp.maximum(my_b, 0))
+
+            # ---- F slot -------------------------------------------------
+            x_in = jnp.where((v_f == 0) & (p == 0), xs[m_f],
+                             carry["fwd_buf"][v_f, m_f % kf])
+            params_f = chunk_params(v_f)
+            y = stage_fn(params_f, x_in)
+            x_buf = jnp.where(
+                do_f, carry["x_buf"].at[v_f, m_f % kx].set(x_in),
+                carry["x_buf"])
+
+            # Last global stage (chunk V-1 on rank P-1): head loss + dy,
+            # queued for the B slot (possibly this same tick).
+            def head_loss(hp, yy):
+                if xs_aux is None:
+                    return head_fn(hp, yy, m_f)
+                return head_fn(hp, yy, xs_aux[m_f], m_f)
+            loss_m, (dhead_m, dy_m) = _head_value_and_grads(
+                head_loss, head_local, y)
+            f_here = do_f & (p == last) & (v_f == n_virtual - 1)
+            loss = carry["loss"] + jnp.where(f_here, loss_m / m_count, 0.0)
+            head_grads = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(f_here, g / m_count, 0.0),
+                carry["head_grads"], dhead_m)
+            bwd_buf = jnp.where(
+                f_here,
+                carry["bwd_buf"].at[v_f, m_f % kb].set(
+                    dy_m.astype(jnp.float32) / m_count),
+                carry["bwd_buf"])
+
+            # ---- B slot (remat: recompute the chunk forward) ------------
+            x_saved = x_buf[v_b, m_b % kx]
+            dy = bwd_buf[v_b, m_b % kb].astype(xs.dtype)
+            params_b = chunk_params(v_b)
+            _, vjp_fn = jax.vjp(lambda pr, xx: stage_fn(pr, xx),
+                                params_b, x_saved)
+            dparams, dx_m = vjp_fn(dy)
+            grads = jax.tree_util.tree_map(
+                lambda acc, g: acc.at[v_b].add(
+                    jnp.where(do_b, g.astype(jnp.float32), 0.0)),
+                carry["grads"], dparams)
+            dx = jnp.where(
+                do_b & (p == 0) & (v_b == 0),
+                carry["dx"].at[m_b].set(dx_m.astype(jnp.float32)),
+                carry["dx"])
+
+            # ---- communication -----------------------------------------
+            # Forward activation to the right neighbor (ring wrap P-1->0
+            # crosses a chunk boundary: the receiver files it under
+            # chunk v+1).  The last global stage sends nothing.
+            send_f = do_f & ~((p == last) & (v_f == n_virtual - 1))
+            f_in = jax.lax.ppermute(
+                jnp.where(send_f, y, zeros_mb), axis_name, ring_r)
+            left = (p - 1) % n_stages
+            e_l = fwd_table[left][t]
+            v_l, m_l = decode(jnp.maximum(e_l, 0))
+            recv_f = (e_l >= 0) & ~((left == last) &
+                                    (v_l == n_virtual - 1))
+            v_fs = jnp.where(p == 0, v_l + 1, v_l)
+            fwd_buf = jnp.where(
+                recv_f,
+                carry["fwd_buf"].at[jnp.clip(v_fs, 0, n_virtual - 1),
+                                    m_l % kf].set(f_in),
+                carry["fwd_buf"])
+
+            # Backward gradient to the left neighbor (ring wrap 0->P-1
+            # crosses the chunk boundary downward).  Global stage 0
+            # sends nothing (its dx is the embedding gradient).
+            send_b = do_b & ~((p == 0) & (v_b == 0))
+            b_in = jax.lax.ppermute(
+                jnp.where(send_b, dx_m.astype(jnp.float32),
+                          jnp.zeros(mb_shape, jnp.float32)),
+                axis_name, ring_l)
+            right = (p + 1) % n_stages
+            e_r = bwd_table[right][t]
+            v_r, m_r = decode(jnp.maximum(e_r, 0))
+            recv_b = (e_r >= 0) & ~((right == 0) & (v_r == 0))
+            v_bs = jnp.where(p == last, v_r - 1, v_r)
+            bwd_buf = jnp.where(
+                recv_b,
+                bwd_buf.at[jnp.clip(v_bs, 0, n_virtual - 1),
+                           m_r % kb].set(b_in),
+                bwd_buf)
+
+            return {"fwd_buf": fwd_buf, "bwd_buf": bwd_buf,
+                    "x_buf": x_buf, "grads": grads,
+                    "head_grads": head_grads, "dx": dx,
+                    "loss": loss}, None
+
+        carry, _ = jax.lax.scan(step, carry0, jnp.arange(n_ticks))
+
+        return _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last,
+                             lambda g: g[:, None])
+
+    extra = [None] * (microbatches.ndim - 2)
+    x_spec = P(None, batch_axes, *extra)
+    rep = P()
+    aux_spec = None
+    if aux is not None:
+        aux_spec = P(None, batch_axes, *([None] * (aux.ndim - 2)))
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(vp_specs(stacked_vp),
+                  jax.tree_util.tree_map(lambda _: rep, head_params),
+                  x_spec, aux_spec),
+        out_specs=(rep, vp_specs(stacked_vp),
+                   jax.tree_util.tree_map(lambda _: rep, head_params),
+                   P(None, batch_axes, *extra)),
+        check_vma=False)
+    loss, grads_vp, head_grads, dx = fn(stacked_vp, head_params,
+                                        microbatches, aux)
+    return loss, jax.tree_util.tree_map(from_vp, grads_vp), head_grads, dx

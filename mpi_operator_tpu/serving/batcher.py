@@ -109,7 +109,11 @@ class ContinuousBatcher:
         if page_size > 0:
             decode_cfg = dataclasses.replace(
                 cfg, page_size=page_size, cache_blocks=cache_blocks)
-            self._decode_model = type(model)(decode_cfg)
+            # Keep the model's mesh: dropping it would silently turn the
+            # decode path's activation sharding hints into no-ops under
+            # tensor-parallel serving.
+            self._decode_model = type(model)(
+                decode_cfg, mesh=getattr(model, "mesh", None))
             nb = decode_cfg.pool_blocks(max_slots)
             self._free_blocks = list(range(1, nb))  # 0 = reserved scratch
             self._total_blocks = nb - 1
@@ -349,6 +353,12 @@ class ContinuousBatcher:
                         req = self._queue.get_nowait()
                     except queue.Empty:
                         break
+                if req.cancelled.is_set():
+                    # A dead client's request must not reserve blocks or
+                    # burn a prefill (deferral windows are unbounded
+                    # under an oversubscribed pool).
+                    req.done.set()
+                    continue
                 if self.page_size > 0 and not self._alloc_blocks(
                         i, len(req.tokens) + req.max_new_tokens):
                     deferred = req  # pool exhausted; retry after retires

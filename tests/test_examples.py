@@ -149,3 +149,14 @@ def test_elastic_resnet50_reforms_world(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_llama_train_interleaved_1f1b():
+    # 4 devices, pp=2 x V=2 -> 4 chunks over 4 layers; M=4 % pp == 0
+    out = _run("llama_train.py", "--config", "tiny", "--steps", "2",
+               "--pp", "2", "--pipeline-schedule", "1f1b",
+               "--virtual-stages", "2", "--n-layers", "4",
+               "--microbatches", "4", "--seq-len", "32",
+               "--batch-per-dp", "4", timeout=420)
+    assert "schedule=1f1b virtual_stages=2" in out
+    assert "tokens/sec" in out and "loss=" in out
