@@ -173,6 +173,47 @@ def _simulate_1f1b(n_stages: int, n_micro: int):
     return fwd[:, :end], bwd[:, :end], end
 
 
+def _phase_bounds(fwd_np, bwd_np, n_ticks: int, head_slots=None):
+    """(first tick with any B scheduled, one past the last tick with any
+    F scheduled) — the static warmup/steady/drain split.
+
+    Under shard_map every rank executes the same traced tick body, so a
+    tick costs F + head + B wall-clock even on ranks whose slot is idle
+    (-1): the "pipeline bubble" in lockstep SPMD is masked compute, not
+    idle time.  No B is scheduled anywhere before the first B tick and
+    no F after the last F tick, so those segments can run cheaper bodies
+    (F-only / B-only) with the same carry — cutting ~(P-1) ticks' worth
+    of dead backward compute in warmup and dead forward+head compute in
+    drain.  This is the part of zero-bubble (ZB-H1) scheduling that
+    actually pays under lockstep SPMD; the dX/dW backward split itself
+    does not, because every rank's tick body would still contain one F,
+    one dX and one dW computation regardless of which microbatch (if
+    any) fills each slot, leaving total ticks bounded by the same
+    one-F-slot-per-tick constraint.
+    """
+    import numpy as np
+
+    b_ticks = np.nonzero((bwd_np >= 0).any(axis=0))[0]
+    f_ticks = np.nonzero((fwd_np >= 0).any(axis=0))[0]
+    t_warm = int(b_ticks[0]) if b_ticks.size else n_ticks
+    t_fend = int(f_ticks[-1]) + 1 if f_ticks.size else 0
+    if head_slots is not None:
+        # Gradient-correctness invariant of the split: the head (loss +
+        # dy queueing) only exists in the combined body, so every
+        # head-bearing F slot must land in [t_warm, t_fend).  Holds by
+        # construction today (the simulators schedule the last global
+        # stage's B on the same tick as its F); a simulator change that
+        # delayed the first B past that F would otherwise silently zero
+        # the loss and every gradient.
+        h_ticks = np.nonzero(head_slots)[0]
+        if h_ticks.size and (h_ticks[0] < t_warm or h_ticks[-1] >= t_fend):
+            raise RuntimeError(
+                f"head-bearing F slots at ticks [{h_ticks[0]}, "
+                f"{h_ticks[-1]}] escape the combined segment "
+                f"[{t_warm}, {t_fend})")
+    return t_warm, t_fend
+
+
 def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
                   head_params, microbatches, mesh, axis_name: str = "pp",
                   batch_axes=("dp", "fsdp"), aux=None):
@@ -215,6 +256,8 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
     fwd_np, bwd_np, n_ticks = _simulate_1f1b(n_stages, m_count)
     fwd_table = jnp.asarray(fwd_np)
     bwd_table = jnp.asarray(bwd_np)
+    t_warm, t_fend = _phase_bounds(fwd_np, bwd_np, n_ticks,
+                                   head_slots=fwd_np[-1] >= 0)
 
     def body(stacked_local, head_local, xs, xs_aux):
         p = jax.lax.axis_index(axis_name)
@@ -242,85 +285,112 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
             "loss": jnp.float32(0.0),
         }
 
-        def step(carry, t):
-            my_f = take_row(fwd_table, p)[t]
-            my_b = take_row(bwd_table, p)[t]
+        def make_step(with_f: bool, with_b: bool):
+            # with_f/with_b are trace-time flags: the warmup segment (no
+            # B scheduled on any rank) omits the head + backward compute
+            # from its scan body, the drain segment (no F left) omits the
+            # forward + head — see _phase_bounds.
+            def step(carry, t):
+                x_buf = carry["x_buf"]
+                bwd_buf = carry["bwd_buf"]
+                fwd_buf = carry["fwd_buf"]
+                grads = carry["grads"]
+                head_grads = carry["head_grads"]
+                dx = carry["dx"]
+                loss = carry["loss"]
 
-            # ---- F slot -------------------------------------------------
-            f_m = jnp.maximum(my_f, 0)
-            x_in = jnp.where(
-                p == 0, xs[f_m],
-                carry["fwd_buf"][f_m % n_stages])
-            y = stage_fn(params, x_in)
-            do_f = my_f >= 0
-            x_buf = jnp.where(
-                do_f,
-                carry["x_buf"].at[f_m % n_stages].set(x_in),
-                carry["x_buf"])
+                if with_f:
+                    # ---- F slot ---------------------------------------
+                    my_f = take_row(fwd_table, p)[t]
+                    f_m = jnp.maximum(my_f, 0)
+                    x_in = jnp.where(
+                        p == 0, xs[f_m],
+                        fwd_buf[f_m % n_stages])
+                    y = stage_fn(params, x_in)
+                    do_f = my_f >= 0
+                    x_buf = jnp.where(
+                        do_f,
+                        x_buf.at[f_m % n_stages].set(x_in),
+                        x_buf)
 
-            # Last stage: head loss + dy for this microbatch, queued for
-            # the B slot (possibly this same tick).
-            def head_loss(hp, yy):
-                if xs_aux is None:
-                    return head_fn(hp, yy, f_m)
-                return head_fn(hp, yy, xs_aux[f_m], f_m)
-            (loss_m, (dhead_m, dy_m)) = _head_value_and_grads(
-                head_loss, head_local, y)
-            is_last = p == last
-            f_here = do_f & is_last
-            loss = carry["loss"] + jnp.where(f_here, loss_m / m_count, 0.0)
-            head_grads = jax.tree_util.tree_map(
-                lambda acc, g: acc + jnp.where(f_here, g / m_count, 0.0),
-                carry["head_grads"], dhead_m)
-            bwd_buf = jnp.where(
-                f_here,
-                carry["bwd_buf"].at[f_m % n_stages].set(
-                    dy_m.astype(jnp.float32) / m_count),
-                carry["bwd_buf"])
+                if with_f and with_b:
+                    # Last stage: head loss + dy for this microbatch,
+                    # queued for the B slot (possibly this same tick).
+                    # Last-stage F slots only exist once B slots do, so
+                    # the F-only warmup body never needs the head.
+                    def head_loss(hp, yy):
+                        if xs_aux is None:
+                            return head_fn(hp, yy, f_m)
+                        return head_fn(hp, yy, xs_aux[f_m], f_m)
+                    (loss_m, (dhead_m, dy_m)) = _head_value_and_grads(
+                        head_loss, head_local, y)
+                    f_here = do_f & (p == last)
+                    loss = loss + jnp.where(f_here, loss_m / m_count, 0.0)
+                    head_grads = jax.tree_util.tree_map(
+                        lambda acc, g: acc + jnp.where(f_here,
+                                                       g / m_count, 0.0),
+                        head_grads, dhead_m)
+                    bwd_buf = jnp.where(
+                        f_here,
+                        bwd_buf.at[f_m % n_stages].set(
+                            dy_m.astype(jnp.float32) / m_count),
+                        bwd_buf)
 
-            # ---- B slot (remat: recompute the stage forward) ------------
-            b_m = jnp.maximum(my_b, 0)
-            x_saved = x_buf[b_m % n_stages]
-            dy = bwd_buf[b_m % n_stages].astype(xs.dtype)
-            _, vjp_fn = jax.vjp(lambda pr, xx: stage_fn(pr, xx), params,
-                                x_saved)
-            dparams, dx_m = vjp_fn(dy)
-            do_b = my_b >= 0
-            grads = jax.tree_util.tree_map(
-                lambda acc, g: acc + jnp.where(do_b,
-                                               g.astype(jnp.float32), 0.0),
-                carry["grads"], dparams)
-            dx = jnp.where(
-                do_b & (p == 0),
-                carry["dx"].at[b_m].set(dx_m.astype(jnp.float32)),
-                carry["dx"])
+                if with_b:
+                    # ---- B slot (remat: recompute the stage forward) --
+                    my_b = take_row(bwd_table, p)[t]
+                    b_m = jnp.maximum(my_b, 0)
+                    x_saved = x_buf[b_m % n_stages]
+                    dy = bwd_buf[b_m % n_stages].astype(xs.dtype)
+                    _, vjp_fn = jax.vjp(lambda pr, xx: stage_fn(pr, xx),
+                                        params, x_saved)
+                    dparams, dx_m = vjp_fn(dy)
+                    do_b = my_b >= 0
+                    grads = jax.tree_util.tree_map(
+                        lambda acc, g: acc + jnp.where(
+                            do_b, g.astype(jnp.float32), 0.0),
+                        grads, dparams)
+                    dx = jnp.where(
+                        do_b & (p == 0),
+                        dx.at[b_m].set(dx_m.astype(jnp.float32)),
+                        dx)
 
-            # ---- communication -----------------------------------------
-            # forward activation to the right
-            f_msg = jnp.where(do_f & (p < last), y, zeros_mb)
-            f_in = jax.lax.ppermute(f_msg, axis_name, right_perm)
-            left_f = take_row(fwd_table, p - 1)[t]
-            fwd_buf = jnp.where(
-                (p > 0) & (left_f >= 0),
-                carry["fwd_buf"].at[jnp.maximum(left_f, 0)
-                                    % n_stages].set(f_in),
-                carry["fwd_buf"])
-            # backward gradient to the left
-            b_msg = jnp.where(do_b & (p > 0),
-                              dx_m.astype(jnp.float32),
-                              jnp.zeros(mb_shape, jnp.float32))
-            b_in = jax.lax.ppermute(b_msg, axis_name, left_perm)
-            right_b = take_row(bwd_table, p + 1)[t]
-            bwd_buf = jnp.where(
-                (p < last) & (right_b >= 0),
-                bwd_buf.at[jnp.maximum(right_b, 0) % n_stages].set(b_in),
-                bwd_buf)
+                # ---- communication --------------------------------------
+                if with_f:
+                    # forward activation to the right
+                    f_msg = jnp.where(do_f & (p < last), y, zeros_mb)
+                    f_in = jax.lax.ppermute(f_msg, axis_name, right_perm)
+                    left_f = take_row(fwd_table, p - 1)[t]
+                    fwd_buf = jnp.where(
+                        (p > 0) & (left_f >= 0),
+                        fwd_buf.at[jnp.maximum(left_f, 0)
+                                   % n_stages].set(f_in),
+                        fwd_buf)
+                if with_b:
+                    # backward gradient to the left
+                    b_msg = jnp.where(do_b & (p > 0),
+                                      dx_m.astype(jnp.float32),
+                                      jnp.zeros(mb_shape, jnp.float32))
+                    b_in = jax.lax.ppermute(b_msg, axis_name, left_perm)
+                    right_b = take_row(bwd_table, p + 1)[t]
+                    bwd_buf = jnp.where(
+                        (p < last) & (right_b >= 0),
+                        bwd_buf.at[jnp.maximum(right_b, 0)
+                                   % n_stages].set(b_in),
+                        bwd_buf)
 
-            return {"fwd_buf": fwd_buf, "bwd_buf": bwd_buf, "x_buf": x_buf,
-                    "grads": grads, "head_grads": head_grads, "dx": dx,
-                    "loss": loss}, None
+                return {"fwd_buf": fwd_buf, "bwd_buf": bwd_buf,
+                        "x_buf": x_buf, "grads": grads,
+                        "head_grads": head_grads, "dx": dx,
+                        "loss": loss}, None
+            return step
 
-        carry, _ = jax.lax.scan(step, carry0, jnp.arange(n_ticks))
+        carry = carry0
+        for lo, hi, stp in ((0, t_warm, make_step(True, False)),
+                            (t_warm, t_fend, make_step(True, True)),
+                            (t_fend, n_ticks, make_step(False, True))):
+            if hi > lo:
+                carry, _ = jax.lax.scan(stp, carry, jnp.arange(lo, hi))
 
         return _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last,
                              lambda g: g[None])
@@ -519,6 +589,10 @@ def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
         n_stages, n_virtual, m_count)
     fwd_table = jnp.asarray(fwd_np)
     bwd_table = jnp.asarray(bwd_np)
+    # Head-bearing F slots: chunk V-1 on the last rank (entry v*M + m).
+    t_warm, t_fend = _phase_bounds(
+        fwd_np, bwd_np, n_ticks,
+        head_slots=fwd_np[-1] >= (n_virtual - 1) * m_count)
 
     # [S, ...] -> [V, P, ...]: s = v*P + p, so a plain reshape lands
     # chunk v of rank p at [v, p].
@@ -565,102 +639,129 @@ def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
         def decode(e):
             return e // m_count, e % m_count   # (chunk, microbatch)
 
-        def step(carry, t):
-            my_f = fwd_table[p][t]
-            my_b = bwd_table[p][t]
-            do_f = my_f >= 0
-            do_b = my_b >= 0
-            v_f, m_f = decode(jnp.maximum(my_f, 0))
-            v_b, m_b = decode(jnp.maximum(my_b, 0))
+        def make_step(with_f: bool, with_b: bool):
+            # Same warmup/steady/drain specialization as pipeline_1f1b
+            # (_phase_bounds): the last global stage's first F coincides
+            # with the first B tick, so the F-only warmup body never
+            # needs the head, and the B-only drain body never runs F.
+            def step(carry, t):
+                x_buf = carry["x_buf"]
+                bwd_buf = carry["bwd_buf"]
+                fwd_buf = carry["fwd_buf"]
+                grads = carry["grads"]
+                head_grads = carry["head_grads"]
+                dx = carry["dx"]
+                loss = carry["loss"]
 
-            # ---- F slot -------------------------------------------------
-            x_in = jnp.where((v_f == 0) & (p == 0), xs[m_f],
-                             carry["fwd_buf"][v_f, m_f % kf])
-            params_f = chunk_params(v_f)
-            y = stage_fn(params_f, x_in)
-            x_buf = jnp.where(
-                do_f, carry["x_buf"].at[v_f, m_f % kx].set(x_in),
-                carry["x_buf"])
+                if with_f:
+                    # ---- F slot ---------------------------------------
+                    my_f = fwd_table[p][t]
+                    do_f = my_f >= 0
+                    v_f, m_f = decode(jnp.maximum(my_f, 0))
+                    x_in = jnp.where((v_f == 0) & (p == 0), xs[m_f],
+                                     fwd_buf[v_f, m_f % kf])
+                    params_f = chunk_params(v_f)
+                    y = stage_fn(params_f, x_in)
+                    x_buf = jnp.where(
+                        do_f, x_buf.at[v_f, m_f % kx].set(x_in),
+                        x_buf)
 
-            # Last global stage (chunk V-1 on rank P-1): head loss + dy,
-            # queued for the B slot (possibly this same tick).
-            def head_loss(hp, yy):
-                if xs_aux is None:
-                    return head_fn(hp, yy, m_f)
-                return head_fn(hp, yy, xs_aux[m_f], m_f)
-            loss_m, (dhead_m, dy_m) = _head_value_and_grads(
-                head_loss, head_local, y)
-            f_here = do_f & (p == last) & (v_f == n_virtual - 1)
-            loss = carry["loss"] + jnp.where(f_here, loss_m / m_count, 0.0)
-            head_grads = jax.tree_util.tree_map(
-                lambda acc, g: acc + jnp.where(f_here, g / m_count, 0.0),
-                carry["head_grads"], dhead_m)
-            bwd_buf = jnp.where(
-                f_here,
-                carry["bwd_buf"].at[v_f, m_f % kb].set(
-                    dy_m.astype(jnp.float32) / m_count),
-                carry["bwd_buf"])
+                if with_f and with_b:
+                    # Last global stage (chunk V-1 on rank P-1): head
+                    # loss + dy, queued for the B slot (possibly this
+                    # same tick).
+                    def head_loss(hp, yy):
+                        if xs_aux is None:
+                            return head_fn(hp, yy, m_f)
+                        return head_fn(hp, yy, xs_aux[m_f], m_f)
+                    loss_m, (dhead_m, dy_m) = _head_value_and_grads(
+                        head_loss, head_local, y)
+                    f_here = do_f & (p == last) & (v_f == n_virtual - 1)
+                    loss = loss + jnp.where(f_here, loss_m / m_count, 0.0)
+                    head_grads = jax.tree_util.tree_map(
+                        lambda acc, g: acc + jnp.where(f_here,
+                                                       g / m_count, 0.0),
+                        head_grads, dhead_m)
+                    bwd_buf = jnp.where(
+                        f_here,
+                        bwd_buf.at[v_f, m_f % kb].set(
+                            dy_m.astype(jnp.float32) / m_count),
+                        bwd_buf)
 
-            # ---- B slot (remat: recompute the chunk forward) ------------
-            x_saved = x_buf[v_b, m_b % kx]
-            dy = bwd_buf[v_b, m_b % kb].astype(xs.dtype)
-            params_b = chunk_params(v_b)
-            _, vjp_fn = jax.vjp(lambda pr, xx: stage_fn(pr, xx),
-                                params_b, x_saved)
-            dparams, dx_m = vjp_fn(dy)
-            grads = jax.tree_util.tree_map(
-                lambda acc, g: acc.at[v_b].add(
-                    jnp.where(do_b, g.astype(jnp.float32), 0.0)),
-                carry["grads"], dparams)
-            dx = jnp.where(
-                do_b & (p == 0) & (v_b == 0),
-                carry["dx"].at[m_b].set(dx_m.astype(jnp.float32)),
-                carry["dx"])
+                if with_b:
+                    # ---- B slot (remat: recompute the chunk forward) --
+                    my_b = bwd_table[p][t]
+                    do_b = my_b >= 0
+                    v_b, m_b = decode(jnp.maximum(my_b, 0))
+                    x_saved = x_buf[v_b, m_b % kx]
+                    dy = bwd_buf[v_b, m_b % kb].astype(xs.dtype)
+                    params_b = chunk_params(v_b)
+                    _, vjp_fn = jax.vjp(lambda pr, xx: stage_fn(pr, xx),
+                                        params_b, x_saved)
+                    dparams, dx_m = vjp_fn(dy)
+                    grads = jax.tree_util.tree_map(
+                        lambda acc, g: acc.at[v_b].add(
+                            jnp.where(do_b, g.astype(jnp.float32), 0.0)),
+                        grads, dparams)
+                    dx = jnp.where(
+                        do_b & (p == 0) & (v_b == 0),
+                        dx.at[m_b].set(dx_m.astype(jnp.float32)),
+                        dx)
 
-            # ---- communication -----------------------------------------
-            # Forward activation to the right neighbor (ring wrap P-1->0
-            # crosses a chunk boundary: the receiver files it under
-            # chunk v+1).  The last global stage sends nothing.
-            send_f = do_f & ~((p == last) & (v_f == n_virtual - 1))
-            f_in = jax.lax.ppermute(
-                jnp.where(send_f, y, zeros_mb), axis_name, ring_r)
-            left = (p - 1) % n_stages
-            e_l = fwd_table[left][t]
-            v_l, m_l = decode(jnp.maximum(e_l, 0))
-            recv_f = (e_l >= 0) & ~((left == last) &
-                                    (v_l == n_virtual - 1))
-            v_fs = jnp.where(p == 0, v_l + 1, v_l)
-            fwd_buf = jnp.where(
-                recv_f,
-                carry["fwd_buf"].at[jnp.clip(v_fs, 0, n_virtual - 1),
-                                    m_l % kf].set(f_in),
-                carry["fwd_buf"])
+                # ---- communication --------------------------------------
+                if with_f:
+                    # Forward activation to the right neighbor (ring wrap
+                    # P-1->0 crosses a chunk boundary: the receiver files
+                    # it under chunk v+1).  The last global stage sends
+                    # nothing.
+                    send_f = do_f & ~((p == last) & (v_f == n_virtual - 1))
+                    f_in = jax.lax.ppermute(
+                        jnp.where(send_f, y, zeros_mb), axis_name, ring_r)
+                    left = (p - 1) % n_stages
+                    e_l = fwd_table[left][t]
+                    v_l, m_l = decode(jnp.maximum(e_l, 0))
+                    recv_f = (e_l >= 0) & ~((left == last) &
+                                            (v_l == n_virtual - 1))
+                    v_fs = jnp.where(p == 0, v_l + 1, v_l)
+                    fwd_buf = jnp.where(
+                        recv_f,
+                        fwd_buf.at[jnp.clip(v_fs, 0, n_virtual - 1),
+                                   m_l % kf].set(f_in),
+                        fwd_buf)
 
-            # Backward gradient to the left neighbor (ring wrap 0->P-1
-            # crosses the chunk boundary downward).  Global stage 0
-            # sends nothing (its dx is the embedding gradient).
-            send_b = do_b & ~((p == 0) & (v_b == 0))
-            b_in = jax.lax.ppermute(
-                jnp.where(send_b, dx_m.astype(jnp.float32),
-                          jnp.zeros(mb_shape, jnp.float32)),
-                axis_name, ring_l)
-            right = (p + 1) % n_stages
-            e_r = bwd_table[right][t]
-            v_r, m_r = decode(jnp.maximum(e_r, 0))
-            recv_b = (e_r >= 0) & ~((right == 0) & (v_r == 0))
-            v_bs = jnp.where(p == last, v_r - 1, v_r)
-            bwd_buf = jnp.where(
-                recv_b,
-                bwd_buf.at[jnp.clip(v_bs, 0, n_virtual - 1),
-                           m_r % kb].set(b_in),
-                bwd_buf)
+                if with_b:
+                    # Backward gradient to the left neighbor (ring wrap
+                    # 0->P-1 crosses the chunk boundary downward).
+                    # Global stage 0 sends nothing (its dx is the
+                    # embedding gradient).
+                    send_b = do_b & ~((p == 0) & (v_b == 0))
+                    b_in = jax.lax.ppermute(
+                        jnp.where(send_b, dx_m.astype(jnp.float32),
+                                  jnp.zeros(mb_shape, jnp.float32)),
+                        axis_name, ring_l)
+                    right = (p + 1) % n_stages
+                    e_r = bwd_table[right][t]
+                    v_r, m_r = decode(jnp.maximum(e_r, 0))
+                    recv_b = (e_r >= 0) & ~((right == 0) & (v_r == 0))
+                    v_bs = jnp.where(p == last, v_r - 1, v_r)
+                    bwd_buf = jnp.where(
+                        recv_b,
+                        bwd_buf.at[jnp.clip(v_bs, 0, n_virtual - 1),
+                                   m_r % kb].set(b_in),
+                        bwd_buf)
 
-            return {"fwd_buf": fwd_buf, "bwd_buf": bwd_buf,
-                    "x_buf": x_buf, "grads": grads,
-                    "head_grads": head_grads, "dx": dx,
-                    "loss": loss}, None
+                return {"fwd_buf": fwd_buf, "bwd_buf": bwd_buf,
+                        "x_buf": x_buf, "grads": grads,
+                        "head_grads": head_grads, "dx": dx,
+                        "loss": loss}, None
+            return step
 
-        carry, _ = jax.lax.scan(step, carry0, jnp.arange(n_ticks))
+        carry = carry0
+        for lo, hi, stp in ((0, t_warm, make_step(True, False)),
+                            (t_warm, t_fend, make_step(True, True)),
+                            (t_fend, n_ticks, make_step(False, True))):
+            if hi > lo:
+                carry, _ = jax.lax.scan(stp, carry, jnp.arange(lo, hi))
 
         return _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last,
                              lambda g: g[:, None])

@@ -204,6 +204,31 @@ def test_1f1b_schedule_properties():
         assert ticks <= 2 * (M + P), (P, M, ticks)
 
 
+def test_phase_bounds_split_warmup_steady_drain():
+    from mpi_operator_tpu.parallel.pipeline import (
+        _phase_bounds, _simulate_1f1b, _simulate_interleaved)
+    for P, M in [(2, 4), (4, 8), (3, 3), (4, 16)]:
+        fwd, bwd, ticks = _simulate_1f1b(P, M)
+        t_warm, t_fend = _phase_bounds(fwd, bwd, ticks)
+        # segments partition [0, ticks) and are honest: no B before
+        # t_warm, no F at/after t_fend, both present in the middle
+        assert 0 < t_warm <= t_fend <= ticks
+        assert not (bwd[:, :t_warm] >= 0).any()
+        assert not (fwd[:, t_fend:] >= 0).any()
+        assert (bwd[:, t_warm:t_fend] >= 0).any()
+        assert (fwd[:, t_warm:t_fend] >= 0).any()
+        # warmup/drain are each at least the pipeline depth - 1
+        if P > 1:
+            assert t_warm >= P - 1
+            assert ticks - t_fend >= P - 1
+    for P, V, M in [(2, 2, 4), (4, 2, 8), (2, 3, 6)]:
+        fwd, bwd, ticks, *_ = _simulate_interleaved(P, V, M)
+        t_warm, t_fend = _phase_bounds(fwd, bwd, ticks)
+        assert 0 < t_warm <= t_fend <= ticks
+        assert not (bwd[:, :t_warm] >= 0).any()
+        assert not (fwd[:, t_fend:] >= 0).any()
+
+
 def test_1f1b_loss_and_grads_match_sequential():
     """The fused 1F1B pipeline must produce EXACTLY the loss and
     gradients of the plain sequential model (params, head and input
