@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import attention
+from ..ops.paged_attention import paged_decode_attention
 from ..ops.ring_attention import ring_attention
 from ..parallel.mesh import BATCH_AXES
 
@@ -259,22 +260,31 @@ class LlamaAttention(nn.Module):
                 v.astype(cfg.dtype).reshape(b * s, cfg.kv_heads,
                                             cfg.head_dim))
             cache_index.value = idx + s
-            # Gather each row's blocks in logical order: the view index
-            # equals the sequence position, so the position mask inside
-            # _decode_attention applies unchanged.  NOTE: the gather
-            # materializes a dense-sized [B, MAXB*page, KH, D] view per
-            # step (unless XLA fuses it into the attention einsum), so
-            # paging buys CAPACITY (pool below worst case, more live
-            # slots per GB) rather than decode bandwidth; a fused paged
-            # attention kernel is the follow-up that removes the view.
-            k_all = pool_k.value[block_table.value].reshape(
-                b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
-                cfg.head_dim)
-            v_all = pool_v.value[block_table.value].reshape(
-                b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
-                cfg.head_dim)
-            out = _decode_attention(q, k_all, v_all, positions,
-                                    cfg.n_heads // cfg.kv_heads)
+            if s == 1:
+                # Single-token decode (the serving hot path): fused
+                # paged attention straight against the pool — per-row
+                # HBM traffic proportional to the row's actual context
+                # length, no dense view (ops/paged_attention.py; the
+                # Pallas kernel engages per attention_impl gating).
+                out = paged_decode_attention(
+                    q[:, 0], pool_k.value, pool_v.value,
+                    block_table.value, idx + 1,
+                    impl=cfg.attention_impl)[:, None]
+            else:
+                # Multi-token (prefill into a paged cache): gather each
+                # row's blocks in logical order — the view index equals
+                # the sequence position, so the position mask inside
+                # _decode_attention applies unchanged.  The dense-sized
+                # view is acceptable here (prefill happens once per
+                # sequence, and needs intra-step causality).
+                k_all = pool_k.value[block_table.value].reshape(
+                    b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
+                    cfg.head_dim)
+                v_all = pool_v.value[block_table.value].reshape(
+                    b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
+                    cfg.head_dim)
+                out = _decode_attention(q, k_all, v_all, positions,
+                                        cfg.n_heads // cfg.kv_heads)
         elif decode:
             idx = cache_index.value
             # Per-row insertion at each row's own index.

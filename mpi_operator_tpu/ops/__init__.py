@@ -8,8 +8,11 @@
   each device attends its local queries (Liu et al., ring attention).
 - ``rmsnorm``: fused RMSNorm Pallas kernel (one VMEM pass), exact VJP.
 - ``moe``: GShard-style mixture-of-experts dispatch over 'ep'.
+- ``paged_decode_attention``: serving decode against the paged KV pool —
+  scalar-prefetched block tables, per-row-length HBM traffic.
 """
 
 from .attention import attention, flash_attention  # noqa: F401
+from .paged_attention import paged_decode_attention  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .rmsnorm import fused_rmsnorm, rmsnorm  # noqa: F401

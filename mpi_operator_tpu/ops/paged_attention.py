@@ -1,0 +1,194 @@
+"""Paged decode attention: single-query attention over a block-pooled
+KV cache (vLLM-style paging) as a Pallas TPU kernel.
+
+The serving path stores KV in a shared pool of fixed-size blocks
+(``models/llama.py`` paged branch); the naive decode step gathers every
+row's blocks into a dense [B, MAXB*page, KH, D] view before attending —
+a worst-case-sized HBM round trip per token.  This kernel attends
+directly against the pool: the per-row block table and valid lengths
+are scalar-prefetched into SMEM, each grid step DMAs exactly one live
+KV block (the index map revisits the last live block for dead tail
+pages, which Pallas coalesces into "no DMA"), and an online softmax
+accumulates in VMEM.  HBM traffic per row is therefore proportional to
+its ACTUAL context length, not the pool's worst case — the point of
+paging — and the dense view never materializes.
+
+Layout: queries for one decode step arrive as [B, H, D]; the pool is
+[NB, page, KH, D]; GQA folds the H = KH * G query heads into [Gp, D]
+MXU tiles per KV head (G padded up to the f32 sublane multiple).
+
+No reference counterpart: kubeflow/mpi-operator ships no kernels
+(SURVEY.md §2.2); this is TPU-native workload-stack surface.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .attention import _MASK_VALUE, _STATS_LANES
+
+# f32 sublane multiple: the q group tile is padded up to this many rows
+# so the [Gp, D] block is always legal to tile.
+_SUBLANES = 8
+
+
+def _xla_paged(q, pool_k, pool_v, block_table, lengths, scale):
+    """Reference path: dense gather + masked softmax.  Numerically the
+    spec the kernel is tested against (and the non-TPU fallback)."""
+    b, h, d = q.shape
+    nb, page, kh, _ = pool_k.shape
+    maxb = block_table.shape[1]
+    g = h // kh
+    k_all = pool_k[block_table].reshape(b, maxb * page, kh, d)
+    v_all = pool_v[block_table].reshape(b, maxb * page, kh, d)
+    if g > 1:
+        k_all = jnp.repeat(k_all, g, axis=2)
+        v_all = jnp.repeat(v_all, g, axis=2)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32) * scale,
+                   k_all.astype(jnp.float32))
+    pos = jnp.arange(maxb * page)
+    mask = pos[None, :] < lengths[:, None]                  # [B, L]
+    s = jnp.where(mask[:, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v_all.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, scale: float, page: int,
+                  kh: int, maxb: int):
+    from jax.experimental import pallas as pl
+
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    b = bh // kh
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _MASK_VALUE)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale         # [Gp, D]
+        k = k_ref[0, :, 0].astype(jnp.float32)              # [page, D]
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = j * page + jax.lax.iota(jnp.int32, page)
+        s = jnp.where((pos < length)[None, :], s, _MASK_VALUE)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    # Dead tail pages (whole page past the row's length) are skipped:
+    # no compute, and their block index maps to the last live block so
+    # no DMA is issued either.
+    pl.when(j * page < length)(_compute)
+
+    @pl.when(j == maxb - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_paged(q, pool_k, pool_v, block_table, lengths, scale,
+                  interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    nb, page, kh, _ = pool_k.shape
+    maxb = block_table.shape[1]
+    g = h // kh
+    gp = max(_SUBLANES, -(-g // _SUBLANES) * _SUBLANES)
+
+    # [B, H, D] -> [B, KH, Gp, D] f32 (tiny: one decode step of q).
+    qg = q.astype(jnp.float32).reshape(b, kh, g, d)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+
+    def kv_index(bh, j, tbl, lens):
+        row = bh // kh
+        last_live = jnp.maximum(lens[row] - 1, 0) // page
+        jj = jnp.minimum(j, last_live)
+        return (tbl[row, jj], 0, bh % kh, 0)
+
+    kernel = functools.partial(_paged_kernel, scale=scale, page=page,
+                               kh=kh, maxb=maxb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * kh, maxb),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, d),
+                         lambda bh, j, tbl, lens: (bh // kh, bh % kh,
+                                                   0, 0)),
+            pl.BlockSpec((1, page, 1, d), kv_index),
+            pl.BlockSpec((1, page, 1, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, d),
+                               lambda bh, j, tbl, lens: (bh // kh,
+                                                         bh % kh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, d), jnp.float32),                # acc
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),     # m
+            pltpu.VMEM((gp, _STATS_LANES), jnp.float32),     # l
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, gp, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, pool_k, pool_v)
+    return out[:, :, :g, :].reshape(b, h, d).astype(q.dtype)
+
+
+def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
+                           scale=None, impl: str = "auto",
+                           interpret: bool = False):
+    """One decode step of attention against a paged KV pool.
+
+    - q: [B, H, D] — this step's queries (sequence dim already squeezed).
+    - pool_k / pool_v: [NB, page, KH, D] shared block pools.
+    - block_table: [B, MAXB] int32 — logical block j of row b lives in
+      pool block ``block_table[b, j]``.
+    - lengths: [B] int32 — valid tokens per row INCLUDING the one just
+      scattered into the pool (>= 1; the kernel masks everything at and
+      beyond each row's length).
+
+    impl: 'pallas' | 'xla' | 'auto' (pallas on real 'tpu' backends —
+    the tunneled 'axon' platform executes Pallas kernels slower than
+    XLA, same gating as ops.attention).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    h = q.shape[1]
+    kh = pool_k.shape[2]
+    if h % kh:
+        raise ValueError(f"n_heads {h} not a multiple of kv_heads {kh}")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return _pallas_paged(q, pool_k, pool_v, block_table, lengths,
+                             scale, interpret)
+    return _xla_paged(q, pool_k, pool_v, block_table, lengths, scale)

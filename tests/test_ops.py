@@ -208,3 +208,83 @@ def test_ring_attention_flash_impl_differentiable(qkv):
     g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(qm, km, vm)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention
+# ---------------------------------------------------------------------------
+
+def _paged_setup(b, h, kh, d, page, maxb, dtype=jnp.float32, seed=0):
+    nb = 1 + b * maxb
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(keys[0], (b, h, d), dtype)
+    pk = jax.random.normal(keys[1], (nb, page, kh, d), dtype)
+    pv = jax.random.normal(keys[2], (nb, page, kh, d), dtype)
+    # rows own disjoint blocks (the allocator invariant); block 0 is
+    # the scratch block
+    rng = np.random.default_rng(seed)
+    table = jnp.asarray(
+        1 + rng.permutation(b * maxb).reshape(b, maxb), jnp.int32)
+    return q, pk, pv, table
+
+
+@pytest.mark.parametrize("h,kh", [(8, 2), (4, 4), (8, 1)])
+def test_paged_decode_kernel_matches_reference(h, kh):
+    from mpi_operator_tpu.ops.paged_attention import paged_decode_attention
+    b, d, page, maxb = 3, 64, 16, 4
+    q, pk, pv, table = _paged_setup(b, h, kh, d, page, maxb)
+    for lens in ([1, 17, 64], [16, 32, 5], [64, 15, 48]):
+        lengths = jnp.asarray(lens, jnp.int32)
+        ref = paged_decode_attention(q, pk, pv, table, lengths,
+                                     impl="xla")
+        out = paged_decode_attention(q, pk, pv, table, lengths,
+                                     impl="pallas", interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_ignores_garbage_in_dead_blocks():
+    """Tokens at/past each row's length must not leak into the output,
+    whatever the pool holds there."""
+    from mpi_operator_tpu.ops.paged_attention import paged_decode_attention
+    b, h, kh, d, page, maxb = 2, 4, 2, 64, 8, 3
+    q, pk, pv, table = _paged_setup(b, h, kh, d, page, maxb)
+    lengths = jnp.asarray([9, 3], jnp.int32)
+    ref = paged_decode_attention(q, pk, pv, table, lengths, impl="xla")
+    # poison everything beyond the live prefix of every row
+    poison_k, poison_v = pk, pv
+    for row in range(b):
+        live_blocks = -(-int(lengths[row]) // page)
+        for j in range(maxb):
+            blk = int(table[row, j])
+            start = int(lengths[row]) - j * page if j == live_blocks - 1 \
+                else (0 if j >= live_blocks else page)
+            if start < page:
+                start = max(start, 0)
+                poison_k = poison_k.at[blk, start:].set(1e4)
+                poison_v = poison_v.at[blk, start:].set(1e4)
+    for impl, kw in (("xla", {}), ("pallas", {"interpret": True})):
+        out = paged_decode_attention(q, poison_k, poison_v, table,
+                                     lengths, impl=impl, **kw)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_bf16_pool():
+    from mpi_operator_tpu.ops.paged_attention import paged_decode_attention
+    b, h, kh, d, page, maxb = 2, 8, 4, 128, 16, 4
+    q, pk, pv, table = _paged_setup(b, h, kh, d, page, maxb,
+                                    dtype=jnp.bfloat16, seed=3)
+    lengths = jnp.asarray([33, 64], jnp.int32)
+    ref = paged_decode_attention(q, pk, pv, table, lengths, impl="xla")
+    out = paged_decode_attention(q, pk, pv, table, lengths,
+                                 impl="pallas", interpret=True)
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+def test_paged_decode_rejects_bad_gqa():
+    from mpi_operator_tpu.ops.paged_attention import paged_decode_attention
+    q, pk, pv, table = _paged_setup(2, 6, 4, 64, 8, 2)
+    with pytest.raises(ValueError):
+        paged_decode_attention(q, pk, pv, table,
+                               jnp.asarray([1, 1], jnp.int32))
