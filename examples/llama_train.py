@@ -54,6 +54,9 @@ def main() -> int:
                              " loader (mmap + prefetch threads); default:"
                              " synthetic tokens")
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--accum-steps", type=int, default=1,
+                        help="gradient-accumulation microbatches per"
+                             " optimizer update (divides the batch)")
     parser.add_argument("--checkpoint-dir", default="",
                         help="enable orbax checkpoint/resume (pairs with"
                              " the operator's suspend/resume)")
@@ -117,6 +120,12 @@ def main() -> int:
         from mpi_operator_tpu.utils import CheckpointManager
         mgr = CheckpointManager(args.checkpoint_dir,
                                 every=args.checkpoint_every)
+
+    if args.accum_steps > 1 and args.pp > 1:
+        raise SystemExit(
+            "--accum-steps applies to the non-pipeline path; pipeline "
+            "schedules already stream --microbatches per optimizer "
+            "update (raise that instead)")
 
     if args.pp > 1 and args.pipeline_schedule == "1f1b":
         # Fused schedule: the pipeline produces (loss, grads) directly,
@@ -195,7 +204,8 @@ def main() -> int:
         with mesh:
             init_fn, step_fn = build_train_step(
                 loss_fn, optax.adamw(3e-4), mesh,
-                param_specs=llama_param_specs(cfg), remat=False)
+                param_specs=llama_param_specs(cfg), remat=False,
+                accum_steps=args.accum_steps)
             state = init_fn(params)
             if mgr is not None:
                 state = mgr.restore(state)  # resume after suspend/preemption

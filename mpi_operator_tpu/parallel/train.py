@@ -43,16 +43,32 @@ jax.tree_util.register_pytree_node(
 def build_train_step(loss_fn: Callable, optimizer, mesh,
                      param_specs=None,
                      donate: bool = True,
-                     remat: bool = False):
+                     remat: bool = False,
+                     accum_steps: int = 1):
     """Build (init_fn, step_fn).
 
     - loss_fn(params, batch) -> scalar loss (called under jit/mesh).
     - optimizer: an optax GradientTransformation.
     - param_specs: pytree of PartitionSpec for params (None = replicated).
     - remat: wrap loss in jax.checkpoint to trade FLOPs for HBM.
+    - accum_steps: >1 runs the batch as that many gradient-accumulation
+      microbatches under one optimizer update (lax.scan, f32 gradient
+      accumulator) — activation memory drops ~accum_steps x for the
+      same effective batch.  The microbatch split is strided (row r ->
+      microbatch r % accum_steps), so each microbatch keeps the full
+      batch's (dp, fsdp) sharding instead of collapsing onto a fraction
+      of the mesh — which requires the batch dim to divide by
+      accum_steps x (dp*fsdp), enforced at trace time.  Gradients equal
+      the full-batch step's exactly (for the usual mean-reduction
+      losses) up to f32 reassociation.
 
     step_fn(state, batch) -> (state, metrics) with donated state buffers.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    _batch_shards = 1
+    for axis in ("dp", "fsdp"):
+        _batch_shards *= mesh.shape.get(axis, 1)
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
 
@@ -78,8 +94,50 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
         step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
         return TrainState(step=step, params=params, opt_state=opt_state)
 
+    def _accumulate(params, batch):
+        """Mean loss/grads over accum_steps strided microbatches."""
+        def split(x):
+            b = x.shape[0]
+            if b % (accum_steps * _batch_shards):
+                # Divisibility by accum_steps alone would trace, but the
+                # strided microbatches could no longer keep every
+                # (dp, fsdp) shard populated — XLA would insert a batch
+                # reshuffle per microbatch, silently defeating the point
+                # of the strided split.
+                raise ValueError(
+                    f"batch dim {b} not divisible by accum_steps"
+                    f" {accum_steps} x batch shards {_batch_shards}"
+                    f" (dp*fsdp)")
+            # [B, ...] -> [A, B/A, ...] with row r in microbatch
+            # r % A: dim 0 of the original stays the contiguous-major
+            # axis of the reshape, so the microbatch rows remain spread
+            # over every (dp, fsdp) shard.
+            return jnp.moveaxis(
+                x.reshape((b // accum_steps, accum_steps) + x.shape[1:]),
+                1, 0)
+
+        micro = jax.tree_util.tree_map(split, batch)
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def body(carry, mb):
+            loss_acc, g_acc = carry
+            loss, g = jax.value_and_grad(loss_fn)(params, mb)
+            g_acc = jax.tree_util.tree_map(
+                lambda a, x: a + x.astype(jnp.float32), g_acc, g)
+            return (loss_acc + loss.astype(jnp.float32), g_acc), None
+
+        (loss_sum, g_sum), _ = jax.lax.scan(
+            body, (jnp.float32(0.0), g0), micro)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / accum_steps).astype(p.dtype), g_sum, params)
+        return loss_sum / accum_steps, grads
+
     def _step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        else:
+            loss, grads = _accumulate(state.params, batch)
         updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                                   state.params)
         new_params = jax.tree_util.tree_map(

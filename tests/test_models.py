@@ -319,3 +319,65 @@ def test_equal_length_generate_unchanged_by_per_row_cache():
         tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out),
                                   np.asarray(tokens[:, 5:]))
+
+
+def test_grad_accumulation_matches_full_batch_step():
+    """accum_steps=k must produce the same update as one full-batch step
+    (mean-reduction loss; strided split keeps dp sharding)."""
+    import optax
+    from mpi_operator_tpu.models.llama import (LlamaModel, llama2_tiny,
+                                               llama_param_specs,
+                                               next_token_loss)
+    from mpi_operator_tpu.parallel.mesh import (MeshConfig, batch_sharding,
+                                                create_mesh)
+
+    mesh = create_mesh(MeshConfig(dp=4, fsdp=2))
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg, mesh=mesh)
+    # batch must divide by accum_steps * dp*fsdp = 4 * 8
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (32, 32), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    def loss_fn(p, batch):
+        return next_token_loss(model.apply(p, batch), batch)
+
+    states = {}
+    with mesh:
+        sharded = jax.device_put(tokens, batch_sharding(mesh, extra_dims=1))
+        for accum in (1, 4):
+            init_fn, step_fn = build_train_step(
+                loss_fn, optax.sgd(1e-2), mesh,
+                param_specs=llama_param_specs(cfg), donate=False,
+                accum_steps=accum)
+            state = init_fn(params)
+            state, metrics = step_fn(state, sharded)
+            states[accum] = (state, float(metrics["loss"]))
+
+    assert np.isclose(states[1][1], states[4][1], rtol=1e-5), \
+        (states[1][1], states[4][1])
+    flat1 = jax.tree_util.tree_leaves(states[1][0].params)
+    flat4 = jax.tree_util.tree_leaves(states[4][0].params)
+    for a, b in zip(flat1, flat4):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_grad_accumulation_rejects_indivisible_batch():
+    import optax
+    from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(dp=8))
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch @ p) ** 2)
+
+    with mesh:
+        init_fn, step_fn = build_train_step(loss_fn, optax.sgd(1e-2), mesh,
+                                            donate=False, accum_steps=3)
+        state = init_fn(jnp.ones((4, 2)))
+        with pytest.raises(ValueError, match="not divisible"):
+            step_fn(state, jnp.ones((8, 4)))
+    with pytest.raises(ValueError, match="accum_steps"):
+        build_train_step(loss_fn, optax.sgd(1e-2), mesh, accum_steps=0)
