@@ -242,10 +242,13 @@ class LlamaAttention(nn.Module):
             idx = cache_index.value
             # Scatter the s new tokens through the block table: token at
             # sequence position p lands in pool block
-            # table[row, p // page] at offset p % page.  Distinct live
-            # rows own disjoint blocks (the allocator's invariant), so
-            # the flattened scatter indices never collide; inactive rows
-            # all land in scratch block 0, where last-write-wins is fine.
+            # table[row, p // page] at offset p % page.  Live rows may
+            # SHARE read-only prefix blocks (serving prefix cache), but
+            # every row only ever writes at positions >= its own prompt
+            # suffix start, which the allocator maps to private blocks —
+            # so the flattened scatter indices never collide; inactive
+            # rows all land in scratch block 0, where last-write-wins is
+            # fine.
             logical = jnp.clip(positions // cfg.page_size, 0,
                                cfg.blocks_per_row - 1)
             dest_block = jnp.take_along_axis(block_table.value, logical,

@@ -22,6 +22,18 @@ retirement, so with ``cache_blocks`` below the worst case the pool
 oversubscribes: many short requests share the memory one worst-case
 slot would pin, and admission simply waits for blocks when the pool
 runs dry.
+
+Paged mode also prefix-caches (``prefix_cache=True``): full prompt
+blocks are content-addressed by their token prefix, so a request whose
+prompt begins with a previously-seen prefix points its block table at
+the existing pool blocks (refcounted; evicted LRU only at refcount 0
+under pool pressure) and prefills ONLY the suffix — the suffix runs
+through the paged multi-token decode branch as a batch-1 apply whose
+block table already maps the shared prefix, writing exclusively into
+the slot's private blocks.  K/V of a position depends only on its token
+prefix (causal attention, absolute RoPE), so reuse is exact; shared
+blocks are never written again because every later write lands at
+positions at or past the owning slot's prompt suffix.
 """
 
 from __future__ import annotations
@@ -65,7 +77,8 @@ class ContinuousBatcher:
 
     def __init__(self, model, variables, max_slots: int = 4,
                  device_lock: Optional[threading.Lock] = None,
-                 page_size: int = 0, cache_blocks: int = 0):
+                 page_size: int = 0, cache_blocks: int = 0,
+                 prefix_cache: bool = True):
         import dataclasses
 
         import jax
@@ -118,7 +131,20 @@ class ContinuousBatcher:
             self._free_blocks = list(range(1, nb))  # 0 = reserved scratch
             self._total_blocks = nb - 1
             self._slot_blocks: dict = {}
+            self._slot_shared: dict = {}   # slot -> shared-prefix blocks
             self._blocks_per_row = decode_cfg.blocks_per_row
+            # Prefix cache: token-prefix tuple -> pool block id holding
+            # that prefix's page of K/V; _block_meta refcounts registered
+            # blocks (refs = live slots whose tables map the block;
+            # refs == 0 blocks stay cached until evicted under pressure).
+            self._prefix_cache = bool(prefix_cache)
+            self._registry: dict = {}
+            self._block_meta: dict = {}
+            self._prefix_clock = 0
+            self._retire_count = 0
+            self.prefix_stats = {"lookups": 0, "hit_blocks": 0,
+                                 "hit_tokens": 0, "evicted": 0}
+            self._suffix_prefill_cache: dict = {}
         else:
             self._decode_model = model
         decode_model = self._decode_model
@@ -194,26 +220,121 @@ class ContinuousBatcher:
     def _blocks_needed(self, total_tokens: int) -> int:
         return -(-total_tokens // self.page_size)
 
-    def _alloc_blocks(self, slot: int, total_tokens: int) -> bool:
-        """Reserve the slot's whole block budget (prompt + max new
-        tokens, known at admission) or decline."""
-        need = self._blocks_needed(total_tokens)
-        if len(self._free_blocks) < need:
-            return False
-        self._slot_blocks[slot] = [self._free_blocks.pop()
-                                   for _ in range(need)]
+    def _chain_key(self, parent: Optional[int], tokens: List[int],
+                   j: int):
+        """Content key of prompt block j: (parent pool block id, that
+        page's tokens).  The parent id stands in for the whole prefix —
+        O(page) per block instead of O(prefix) — and is unambiguous
+        while the parent is registered; leaf-first eviction (children
+        before parents) keeps stale parent ids from ever matching."""
+        page = self.page_size
+        return (parent, tuple(tokens[j * page:(j + 1) * page]))
+
+    def _match_prefix(self, tokens: List[int]) -> List[int]:
+        """Longest chain of cached full prompt blocks, capped so at
+        least one prompt token is left to prefill (its logits seed the
+        first sampled token)."""
+        if not self._prefix_cache:
+            return []
+        hits: List[int] = []
+        parent: Optional[int] = None
+        max_full = (len(tokens) - 1) // self.page_size
+        self.prefix_stats["lookups"] += 1
+        for j in range(max_full):
+            blk = self._registry.get(self._chain_key(parent, tokens, j))
+            if blk is None:
+                break
+            hits.append(blk)
+            parent = blk
+        return hits
+
+    def _alloc_blocks(self, slot: int, total_tokens: int,
+                      tokens: Optional[List[int]] = None) -> bool:
+        """Reserve the slot's block budget (prompt + max new tokens,
+        known at admission) or decline.  Cached prefix blocks satisfy
+        the head of the budget; refcount-0 cached blocks are evicted
+        LRU to make room before declining."""
+        shared = self._match_prefix(tokens) if tokens else []
+        need = self._blocks_needed(total_tokens) - len(shared)
+        shared_set = set(shared)
+        while len(self._free_blocks) < need:
+            # Leaf-first LRU eviction: a block is evictable once no slot
+            # references it AND no registered child chains through it
+            # (children always have refs <= parent's, so freeing leaves
+            # unlocks parents on subsequent passes).
+            victim = min(
+                (b for b, m in self._block_meta.items()
+                 if m["refs"] == 0 and not m["children"]
+                 and b not in shared_set),
+                key=lambda b: self._block_meta[b]["last"], default=None)
+            if victim is None:
+                return False
+            meta = self._block_meta.pop(victim)
+            del self._registry[meta["key"]]
+            if meta["parent"] is not None:
+                parent_meta = self._block_meta.get(meta["parent"])
+                if parent_meta is not None:
+                    parent_meta["children"].discard(victim)
+            self._free_blocks.append(victim)
+            self.prefix_stats["evicted"] += 1
+        self._prefix_clock += 1
+        for blk in shared:
+            meta = self._block_meta[blk]
+            meta["refs"] += 1
+            meta["last"] = self._prefix_clock
+        self.prefix_stats["hit_blocks"] += len(shared)
+        self.prefix_stats["hit_tokens"] += len(shared) * self.page_size
+        priv = [self._free_blocks.pop() for _ in range(need)]
+        self._slot_blocks[slot] = shared + priv
+        self._slot_shared[slot] = len(shared)
         return True
 
+    def _register_blocks(self, slot: int, tokens: List[int]) -> None:
+        """Content-address this slot's full prompt blocks for future
+        prefix hits (the slot itself holds one reference on each)."""
+        if not self._prefix_cache:
+            return
+        blocks = self._slot_blocks[slot]
+        parent = (blocks[self._slot_shared[slot] - 1]
+                  if self._slot_shared[slot] else None)
+        for j in range(self._slot_shared[slot],
+                       len(tokens) // self.page_size):
+            key = self._chain_key(parent, tokens, j)
+            existing = self._registry.get(key)
+            if existing is not None:
+                # concurrent duplicate; keep the first, chain onward
+                # through it so later blocks of THIS prompt still
+                # register under the canonical parent
+                parent = existing
+                continue
+            blk = blocks[j]
+            self._registry[key] = blk
+            self._block_meta[blk] = {"key": key, "refs": 1,
+                                     "last": self._prefix_clock,
+                                     "parent": parent, "children": set()}
+            if parent is not None and parent in self._block_meta:
+                self._block_meta[parent]["children"].add(blk)
+            parent = blk
+
     def _retire_slot(self, slot: int) -> None:
-        """Return the slot's blocks and point its table back at scratch
-        block 0, so the still-ticking inactive row cannot write into
-        blocks about to be reallocated."""
+        """Drop the slot's block references and point its table back at
+        scratch block 0, so the still-ticking inactive row cannot write
+        into blocks about to be reallocated.  Registered blocks stay in
+        the prefix cache at refcount-1 (evicted only under pressure);
+        unregistered ones return to the free list."""
         if self.page_size <= 0:
             return
         blocks = self._slot_blocks.pop(slot, None)
+        self._slot_shared.pop(slot, None)
         if not blocks:
             return
-        self._free_blocks.extend(blocks)
+        for blk in blocks:
+            meta = self._block_meta.get(blk)
+            if meta is not None:
+                meta["refs"] -= 1
+            else:
+                self._free_blocks.append(blk)
+        self._retire_count += 1
         from ..models.llama import replace_cache_leaf
         self._cache = replace_cache_leaf(
             self._cache, "block_table", lambda t: t.at[slot].set(0))
@@ -247,6 +368,75 @@ class ContinuousBatcher:
                 return out
             return {k: rec(dst[k], src[k]) for k in dst}
         self._cache = rec(self._cache, row_cache)
+
+    # -- prefix-cached suffix prefill --------------------------------------
+    def _suffix_fn(self, width: int):
+        """Jitted per suffix-width bucket: batch-1 apply of the PAGED
+        model on the prompt suffix.  The batch-1 view aliases the shared
+        pools and maps the slot's table (shared prefix + private blocks)
+        with cache_index = shared_len, so the multi-token paged decode
+        branch attends across the cached prefix while scattering suffix
+        K/V only into the private blocks (every write position is
+        >= shared_len)."""
+        fn = self._suffix_prefill_cache.get(width)
+        if fn is None:
+            jax, jnp = self._jax, self._jnp
+            params = {"params": self.variables["params"]}
+            decode_model = self._decode_model
+
+            @jax.jit
+            def suffix_prefill(cache, table_row, shared_len, padded,
+                               length, temp, top_p, key):
+                def to_b1(node):
+                    if "pool_key" in node:
+                        return {**node, "block_table": table_row[None],
+                                "cache_index": shared_len[None]}
+                    return {k: to_b1(v) for k, v in node.items()}
+
+                logits, state = decode_model.apply(
+                    {**params, "cache": to_b1(cache)}, padded,
+                    decode=True, mutable=["cache"])
+
+                def back(dst, src):
+                    if "pool_key" in dst:
+                        return {**dst, "pool_key": src["pool_key"],
+                                "pool_value": src["pool_value"]}
+                    return {k: back(dst[k], src[k]) for k in dst}
+
+                nxt, key = _select_rows(logits[:, length - 1],
+                                        temp[None], top_p[None],
+                                        key[None])
+                return (back(cache, state["cache"]),
+                        nxt[0].astype(jnp.int32), key[0])
+
+            fn = self._suffix_prefill_cache[width] = suffix_prefill
+        return fn
+
+    def _prefill_suffix(self, slot: int, tokens: List[int], sample_args):
+        """Prefill only the uncached prompt suffix into `slot` (the
+        shared prefix is already resident in the pool), publish the
+        slot's table, and sample the first token."""
+        jnp = self._jnp
+        blocks = self._slot_blocks[slot]
+        shared_len = self._slot_shared[slot] * self.page_size
+        suffix = tokens[shared_len:]
+        width = _bucket(len(suffix), self._max_seq_len)
+        table_row = jnp.zeros((self._blocks_per_row,), jnp.int32)
+        table_row = table_row.at[:len(blocks)].set(
+            jnp.asarray(blocks, jnp.int32))
+        padded = jnp.asarray([suffix + [0] * (width - len(suffix))],
+                             jnp.int32)
+        temp, top_p, key = sample_args
+        new_cache, first, key1 = self._suffix_fn(width)(
+            self._cache, table_row, jnp.int32(shared_len), padded,
+            len(suffix), temp, top_p, key)
+        from ..models.llama import replace_cache_leaf
+        new_cache = replace_cache_leaf(
+            new_cache, "block_table", lambda t: t.at[slot].set(table_row))
+        self._cache = replace_cache_leaf(
+            new_cache, "cache_index",
+            lambda t: t.at[slot].set(jnp.int32(len(tokens))))
+        return first, key1
 
     # -- public API --------------------------------------------------------
     def _enqueue(self, tokens, max_new_tokens, temperature, top_p, seed,
@@ -339,6 +529,7 @@ class ContinuousBatcher:
         # A request that could not get cache blocks waits here (FIFO
         # order preserved) until retirements free enough of the pool.
         deferred: Optional[_Request] = None
+        deferred_mark = -1
 
         while not self._stop.is_set():
             # Admit new requests into free slots.
@@ -347,6 +538,12 @@ class ContinuousBatcher:
                 if slots[i] is not None:
                     continue
                 if deferred is not None:
+                    if (self.page_size > 0
+                            and deferred_mark == self._retire_count):
+                        # Nothing retired since the failed allocation:
+                        # the (prefix-match + eviction-scan) retry
+                        # cannot succeed, so don't burn it every tick.
+                        break
                     req, deferred = deferred, None
                 else:
                     try:
@@ -360,18 +557,28 @@ class ContinuousBatcher:
                     req.done.set()
                     continue
                 if self.page_size > 0 and not self._alloc_blocks(
-                        i, len(req.tokens) + req.max_new_tokens):
+                        i, len(req.tokens) + req.max_new_tokens,
+                        tokens=req.tokens):
                     deferred = req  # pool exhausted; retry after retires
+                    deferred_mark = self._retire_count
                     break
                 try:
                     key0 = jax.random.fold_in(
                         jax.random.PRNGKey(req.seed), len(req.tokens))
                     sample_args = (jnp.float32(req.temperature),
                                    jnp.float32(req.top_p), key0)
+                    shared = (self._slot_shared.get(i, 0)
+                              if self.page_size > 0 else 0)
                     with self._device_lock:
-                        row_cache, first, key1 = self._prefill(
-                            req.tokens, sample_args)
-                        self._install(i, row_cache, len(req.tokens))
+                        if shared > 0:
+                            first, key1 = self._prefill_suffix(
+                                i, req.tokens, sample_args)
+                        else:
+                            row_cache, first, key1 = self._prefill(
+                                req.tokens, sample_args)
+                            self._install(i, row_cache, len(req.tokens))
+                    if self.page_size > 0:
+                        self._register_blocks(i, req.tokens)
                     req.emit(int(first))
                     if len(req.output) >= req.max_new_tokens:
                         req.done.set()

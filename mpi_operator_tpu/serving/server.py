@@ -110,7 +110,8 @@ class InferenceServer:
     # on all interfaces must be an explicit opt-in (host="0.0.0.0").
     def __init__(self, model, variables, host: str = "127.0.0.1",
                  port: int = 0, max_batch_slots: int = 0, mesh=None,
-                 kv_page_size: int = 0, kv_cache_blocks: int = 0):
+                 kv_page_size: int = 0, kv_cache_blocks: int = 0,
+                 kv_prefix_cache: bool = True):
         self.model = model
         self.variables = variables
         self.mesh = mesh
@@ -147,7 +148,8 @@ class InferenceServer:
                                               max_slots=max_batch_slots,
                                               device_lock=self._lock,
                                               page_size=kv_page_size,
-                                              cache_blocks=kv_cache_blocks)
+                                              cache_blocks=kv_cache_blocks,
+                                              prefix_cache=kv_prefix_cache)
 
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,

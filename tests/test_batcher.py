@@ -327,3 +327,169 @@ def test_http_server_with_paged_batching():
                                           np.asarray(expected[0]))
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Prefix caching (paged pool)
+# ---------------------------------------------------------------------------
+
+def _pool_accounting_ok(b):
+    """free + registered + unregistered-slot-held must equal the pool."""
+    held = sum(1 for blocks in b._slot_blocks.values()
+               for blk in blocks if blk not in b._block_meta)
+    return (len(b._free_blocks) + len(b._block_meta) + held
+            == b._total_blocks)
+
+
+def test_prefix_cache_hits_are_token_identical():
+    """A repeated prompt reuses its cached full blocks (suffix-only
+    prefill) and still produces exactly the dense path's tokens."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                page_size=16).start()
+    try:
+        prompt = list(range(1, 41))                 # 40 tokens: 2 full blocks
+        cold = batcher.submit(prompt, 8)
+        assert batcher.prefix_stats["hit_blocks"] == 0
+        warm = batcher.submit(prompt, 8)
+        assert batcher.prefix_stats["hit_blocks"] == 2
+        assert cold == warm
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([prompt], jnp.int32), 8)
+        np.testing.assert_array_equal(np.asarray(warm),
+                                      np.asarray(expected[0]))
+
+        # divergent continuation: shares only the first block
+        other = list(range(1, 17)) + [99] * 20
+        out = batcher.submit(other, 8)
+        assert batcher.prefix_stats["hit_blocks"] == 3
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([other], jnp.int32), 8)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(expected[0]))
+
+        # page-aligned prompt: the last full block is held back so one
+        # token remains to prefill
+        aligned = [7] * 32
+        batcher.submit(aligned, 4)
+        before = batcher.prefix_stats["hit_blocks"]
+        out = batcher.submit(aligned, 4)
+        assert batcher.prefix_stats["hit_blocks"] == before + 1
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([aligned], jnp.int32), 4)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(expected[0]))
+        assert _pool_accounting_ok(batcher)
+        assert all(m["refs"] == 0 for m in batcher._block_meta.values())
+    finally:
+        batcher.stop()
+
+
+def test_prefix_cache_sampling_deterministic_across_hit():
+    """The suffix path must reproduce the cold path's sampled tokens for
+    the same seed (same logits, same rng stream)."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                page_size=16).start()
+    try:
+        prompt = list(range(3, 40))
+        cold = batcher.submit(prompt, 8, temperature=0.8, top_p=0.9,
+                              seed=42)
+        warm = batcher.submit(prompt, 8, temperature=0.8, top_p=0.9,
+                              seed=42)
+        assert batcher.prefix_stats["hit_blocks"] > 0
+        assert cold == warm
+    finally:
+        batcher.stop()
+
+
+def test_prefix_cache_eviction_under_pool_pressure():
+    """Refcount-0 cached blocks are evicted LRU to satisfy new
+    allocations; accounting stays exact and outputs stay correct."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    # pool of 3 usable blocks: one 48-token budget fills it
+    batcher = ContinuousBatcher(model, variables, max_slots=1,
+                                page_size=16, cache_blocks=4).start()
+    try:
+        p1 = list(range(1, 41))          # 2 full blocks cached at retire
+        out1 = batcher.submit(p1, 8)
+        assert len(batcher._block_meta) == 2
+        p2 = [88] * 40                   # needs 3 blocks -> evicts both
+        batcher.submit(p2, 8)
+        assert batcher.prefix_stats["evicted"] == 2
+        assert _pool_accounting_ok(batcher)
+        # p1's blocks are gone; resubmission recomputes and still matches
+        again = batcher.submit(p1, 8)
+        assert again == out1
+        assert _pool_accounting_ok(batcher)
+    finally:
+        batcher.stop()
+
+
+def test_prefix_cache_disabled_never_registers():
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=1,
+                                page_size=16, prefix_cache=False).start()
+    try:
+        prompt = list(range(1, 41))
+        a = batcher.submit(prompt, 4)
+        b = batcher.submit(prompt, 4)
+        assert a == b
+        assert batcher.prefix_stats == {"lookups": 0, "hit_blocks": 0,
+                                        "hit_tokens": 0, "evicted": 0}
+        assert batcher._registry == {} and batcher._block_meta == {}
+        assert sorted(batcher._free_blocks) == list(
+            range(1, batcher._total_blocks + 1))
+    finally:
+        batcher.stop()
+
+
+def test_prefix_cache_concurrent_sharing_exact():
+    """Prime the cache, then run concurrent hits that share live blocks
+    (refcounts > 1) — all outputs match the dense path."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=3,
+                                page_size=16).start()
+    try:
+        prompt = list(range(5, 45))
+        batcher.submit(prompt, 4)        # prime
+        results = [None] * 3
+        errors = []
+
+        def run(i):
+            try:
+                results[i] = batcher.submit(prompt, 8)
+            except Exception as exc:
+                errors.append(exc)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([prompt], jnp.int32), 8)
+        for r in results:
+            np.testing.assert_array_equal(np.asarray(r),
+                                          np.asarray(expected[0]))
+        assert _pool_accounting_ok(batcher)
+        assert all(m["refs"] == 0 for m in batcher._block_meta.values())
+    finally:
+        batcher.stop()
