@@ -7,5 +7,6 @@ dp/fsdp/tp/sp sharding — all driven through the same MPIJob JAX bootstrap.
 """
 
 from .llama import LlamaConfig, LlamaModel, llama_param_specs  # noqa: F401
+from .speculative import speculative_generate  # noqa: F401
 from .resnet import ResNet, resnet50_config, resnet101_config  # noqa: F401
 from .mnist import MnistCNN  # noqa: F401

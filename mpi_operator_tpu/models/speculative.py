@@ -1,0 +1,165 @@
+"""Speculative decoding (greedy, lossless).
+
+A small draft model proposes ``draft_len`` tokens autoregressively; the
+target model scores all of them in ONE forward (the multi-token decode
+branch) and keeps the longest prefix that matches its own greedy
+choices, plus one corrected/bonus token.  With temperature=0 the output
+is EXACTLY ``greedy_generate(target, ...)`` — acceptance only ever
+reproduces the target's argmax — while the number of expensive target
+forwards drops toward max_new_tokens / (draft_len + 1) as draft
+agreement rises.  On TPU the win compounds: the verify forward is a
+batched matmul-heavy step (MXU-friendly) replacing draft_len+1
+bandwidth-bound single-token steps.
+
+Cache bookkeeping is functional, like generate(): both models' caches
+advance through jitted applies, and each round rolls the per-row
+``cache_index`` back over rejected positions (stale K/V beyond the
+index is masked and overwritten before it can ever be read — the same
+contract the batcher relies on).  The draft is re-fed the last TWO
+committed tokens each round (rewriting one identical K/V entry), which
+uniformly covers the all-accepted case where its cache is one token
+behind.
+
+No reference counterpart: kubeflow/mpi-operator ships no inference
+stack; this is TPU-native serving surface (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .llama import (LlamaModel, _prefill_and_step, _set_cache_index,
+                    replace_cache_leaf)
+
+
+def _jit_greedy_multi(model, variables, width: int):
+    """Jitted width-token greedy decode apply: (cache, tokens [B, w]) ->
+    (cache, argmax tokens [B, w])."""
+    params = {"params": variables["params"]}
+
+    @jax.jit
+    def fn(cache, tokens):
+        logits, state = model.apply({**params, "cache": cache}, tokens,
+                                    decode=True, mutable=["cache"])
+        return state["cache"], jnp.argmax(logits, axis=-1)
+
+    return fn
+
+
+def speculative_generate(model: LlamaModel, variables,
+                         draft_model: LlamaModel, draft_variables,
+                         prompt_tokens, max_new_tokens: int,
+                         draft_len: int = 4, return_stats: bool = False):
+    """Greedy speculative decoding; token-identical to
+    ``greedy_generate(model, variables, prompt_tokens, max_new_tokens)``.
+
+    - model / draft_model must share a vocabulary; the draft is
+      typically a much smaller model (fewer layers/width).
+    - draft_len: proposals per round; each round costs draft_len draft
+      forwards + ONE target forward and commits 1..draft_len+1 tokens.
+    - Reserves draft_len + 1 positions of cache headroom beyond
+      prompt + max_new_tokens (the last verify round may write past the
+      needed tokens).
+
+    Returns [B, max_new_tokens] (plus a stats dict with
+    ``target_forwards`` / ``draft_forwards`` / ``rounds`` /
+    ``accepted_drafts`` when return_stats).
+    """
+    import numpy as np
+
+    prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
+    b, s = prompt_tokens.shape
+    if max_new_tokens <= 0:
+        out = jnp.zeros((b, 0), jnp.int32)
+        return (out, {"target_forwards": 0, "draft_forwards": 0,
+                      "rounds": 0, "accepted_drafts": 0}) \
+            if return_stats else out
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    total = s + max_new_tokens + draft_len + 1
+    for which, m in (("model", model), ("draft_model", draft_model)):
+        if total > m.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + "
+                f"speculation headroom ({draft_len + 1}) = {total} "
+                f"exceeds {which}.max_seq_len {m.config.max_seq_len}")
+
+    stats = {"target_forwards": 1, "draft_forwards": 1, "rounds": 0,
+             "accepted_drafts": 0}
+
+    # Prefill both models (counted above); t_last = target's first token.
+    logits, cache, _ = _prefill_and_step(model, variables, prompt_tokens,
+                                         0.0, 1.0)
+    _, d_cache, _ = _prefill_and_step(draft_model, draft_variables,
+                                      prompt_tokens, 0.0, 1.0)
+    t_last = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    draft_step = _jit_greedy_multi(draft_model, draft_variables, 1)
+    draft_feed2 = _jit_greedy_multi(draft_model, draft_variables, 2)
+    verify = _jit_greedy_multi(model, variables, draft_len + 1)
+
+    out = np.zeros((b, max_new_tokens), np.int32)
+    done = np.zeros((b,), np.int64)        # per-row emitted count
+    out[:, 0] = np.asarray(t_last)
+    done += 1
+    # history: [B, S + max_new] committed tokens (prompt + emitted),
+    # m_row: committed-and-cached length per row (t_last excluded).
+    history = np.concatenate(
+        [np.asarray(prompt_tokens), out], axis=1)
+    m_row = np.full((b,), s, np.int64)
+
+    while done.min() < max_new_tokens:
+        stats["rounds"] += 1
+        # --- draft proposes draft_len tokens -------------------------
+        # Re-feed the last two committed tokens at index m-1 (one
+        # identical rewrite) so the draft cache is current through m,
+        # then extend one token at a time.
+        d_cache = _set_cache_index(
+            d_cache, jnp.asarray(m_row - 1, jnp.int32))
+        feed = jnp.asarray(
+            np.stack([history[np.arange(b), m_row - 1],
+                      history[np.arange(b), m_row]], axis=1), jnp.int32)
+        d_cache, g2 = draft_feed2(d_cache, feed)
+        stats["draft_forwards"] += 1
+        drafts = [g2[:, -1]]
+        for _ in range(draft_len - 1):
+            d_cache, g1 = draft_step(d_cache, drafts[-1][:, None])
+            stats["draft_forwards"] += 1
+            drafts.append(g1[:, -1])
+        drafted = jnp.stack(drafts, axis=1)             # [B, k]
+
+        # --- target verifies in one forward --------------------------
+        t_last = jnp.asarray(history[np.arange(b), m_row], jnp.int32)
+        cache = _set_cache_index(cache, jnp.asarray(m_row, jnp.int32))
+        cache, greedy = verify(
+            cache, jnp.concatenate([t_last[:, None], drafted], axis=1))
+        stats["target_forwards"] += 1
+
+        # --- acceptance ----------------------------------------------
+        d_np = np.asarray(drafted)
+        g_np = np.asarray(greedy)                       # [B, k+1]
+        match = d_np == g_np[:, :-1]
+        accepted = np.cumprod(match, axis=1).sum(axis=1)  # [B]
+        stats["accepted_drafts"] += int(
+            accepted[done < max_new_tokens].sum())
+        for row in range(b):
+            if done[row] >= max_new_tokens:
+                continue  # finished row: cache index stays parked
+            j = int(accepted[row])
+            emit = g_np[row, :j + 1]                    # d1..dj, bonus
+            take = min(len(emit), max_new_tokens - done[row])
+            out[row, done[row]:done[row] + take] = emit[:take]
+            history[row, s + done[row]:s + done[row] + take] = emit[:take]
+            done[row] += take
+            if take == j + 1:
+                m_row[row] += j + 1
+            else:
+                # Row finished mid-round: park its index at the last
+                # committed token so later (garbage) rounds for other
+                # rows keep this row's reads/writes in bounds.
+                m_row[row] = s + max_new_tokens - 1
+
+    if return_stats:
+        return jnp.asarray(out), stats
+    return jnp.asarray(out)

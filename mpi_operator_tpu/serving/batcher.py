@@ -257,6 +257,15 @@ class ContinuousBatcher:
         shared = self._match_prefix(tokens) if tokens else []
         need = self._blocks_needed(total_tokens) - len(shared)
         shared_set = set(shared)
+        if need > len(self._free_blocks) + sum(
+                1 for b, m in self._block_meta.items()
+                if m["refs"] == 0 and b not in shared_set):
+            # Infeasible even after full eviction: decline WITHOUT
+            # evicting, so a too-large deferred request does not wipe
+            # the reusable prefix cache for nothing.  (Every refs-0
+            # block is eventually reachable by leaf-first eviction:
+            # children always have refs <= their parent's.)
+            return False
         while len(self._free_blocks) < need:
             # Leaf-first LRU eviction: a block is evictable once no slot
             # references it AND no registered child chains through it
@@ -339,6 +348,13 @@ class ContinuousBatcher:
         self._cache = replace_cache_leaf(
             self._cache, "block_table", lambda t: t.at[slot].set(0))
 
+    def _table_row(self, blocks: List[int]):
+        """Slot block-table row: allocated blocks in logical order,
+        unmapped tail entries at scratch block 0."""
+        jnp = self._jnp
+        row = jnp.zeros((self._blocks_per_row,), jnp.int32)
+        return row.at[:len(blocks)].set(jnp.asarray(blocks, jnp.int32))
+
     def _install_paged(self, slot: int, row_cache, length: int):
         """Scatter a batch-1 dense prefill row into the slot's allocated
         pool blocks and publish its block table."""
@@ -346,8 +362,7 @@ class ContinuousBatcher:
         blocks = self._slot_blocks[slot]
         barr = jnp.asarray(blocks, jnp.int32)
         span = len(blocks) * self.page_size
-        table_row = jnp.zeros((self._blocks_per_row,), jnp.int32)
-        table_row = table_row.at[:len(blocks)].set(barr)
+        table_row = self._table_row(blocks)
 
         def rec(dst, src):
             if "pool_key" in dst:
@@ -421,9 +436,7 @@ class ContinuousBatcher:
         shared_len = self._slot_shared[slot] * self.page_size
         suffix = tokens[shared_len:]
         width = _bucket(len(suffix), self._max_seq_len)
-        table_row = jnp.zeros((self._blocks_per_row,), jnp.int32)
-        table_row = table_row.at[:len(blocks)].set(
-            jnp.asarray(blocks, jnp.int32))
+        table_row = self._table_row(blocks)
         padded = jnp.asarray([suffix + [0] * (width - len(suffix))],
                              jnp.int32)
         temp, top_p, key = sample_args

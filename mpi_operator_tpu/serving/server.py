@@ -111,10 +111,19 @@ class InferenceServer:
     def __init__(self, model, variables, host: str = "127.0.0.1",
                  port: int = 0, max_batch_slots: int = 0, mesh=None,
                  kv_page_size: int = 0, kv_cache_blocks: int = 0,
-                 kv_prefix_cache: bool = True):
+                 kv_prefix_cache: bool = True,
+                 draft_model=None, draft_variables=None):
         self.model = model
         self.variables = variables
         self.mesh = mesh
+        # Optional speculative decoding (greedy requests on the
+        # non-batched path): a small same-vocab draft model proposes,
+        # the target verifies — output is exactly the greedy decode.
+        if (draft_model is None) != (draft_variables is None):
+            raise ValueError(
+                "draft_model and draft_variables go together")
+        self.draft_model = draft_model
+        self.draft_variables = draft_variables
         if mesh is not None:
             # Tensor-parallel serving: place the params by their Megatron
             # PartitionSpecs so decode matmuls shard over 'tp' (and
@@ -187,11 +196,28 @@ class InferenceServer:
         prompt_lengths = jnp.asarray(lengths, jnp.int32) \
             if len(set(lengths)) > 1 else None
         rng = jax.random.PRNGKey(int(seed)) if seed is not None else None
+        draft_len = 4
+        # Both models bound the speculation window; a request that only
+        # fits the target falls back to plain decode instead of erroring.
+        spec_fits = all(
+            prompt.shape[1] + max_new_tokens + draft_len + 1
+            <= m.config.max_seq_len
+            for m in (self.model, self.draft_model)
+            if m is not None)
+        speculate = (self.draft_model is not None and temperature <= 0.0
+                     and prompt_lengths is None and spec_fits)
         with self._lock:  # accelerator is single-flight
-            out = generate(self.model, self.variables, prompt,
-                           max_new_tokens, temperature=temperature,
-                           top_p=top_p, rng=rng,
-                           prompt_lengths=prompt_lengths)
+            if speculate:
+                from ..models.speculative import speculative_generate
+                out = speculative_generate(
+                    self.model, self.variables, self.draft_model,
+                    self.draft_variables, prompt, max_new_tokens,
+                    draft_len=draft_len)
+            else:
+                out = generate(self.model, self.variables, prompt,
+                               max_new_tokens, temperature=temperature,
+                               top_p=top_p, rng=rng,
+                               prompt_lengths=prompt_lengths)
         return [[int(t) for t in row] for row in out]
 
     def stream(self, tokens, max_new_tokens: int = 16,

@@ -1,0 +1,129 @@
+"""Speculative decoding: greedy losslessness + forward-count wins."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_operator_tpu.models.llama import (LlamaModel, greedy_generate,
+                                           llama2_tiny)
+from mpi_operator_tpu.models.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def target():
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # Same vocab, half the depth/width, DIFFERENT weights: disagrees
+    # with the target often, so rejection paths actually run.
+    cfg = dataclasses.replace(llama2_tiny(), n_layers=1, dim=32,
+                              n_heads=2, n_kv_heads=2)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(7),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def test_perfect_draft_is_lossless_and_skips_target_forwards(target):
+    """Draft == target: every proposal accepted, so the target runs
+    ~max_new/(k+1) forwards instead of max_new."""
+    model, variables = target
+    prompt = jnp.asarray([[5, 3, 8, 1, 9, 2]], jnp.int32)
+    expected = greedy_generate(model, variables, prompt, 16)
+    out, stats = speculative_generate(model, variables, model, variables,
+                                      prompt, 16, draft_len=4,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
+    # 1 prefill + ceil(15/5) verify rounds = 4 target forwards vs 16
+    assert stats["target_forwards"] <= 1 + -(-15 // 5)
+    assert stats["accepted_drafts"] > 0
+
+
+def test_imperfect_draft_is_still_exact(target, draft):
+    """Losslessness: whatever the draft proposes, the output equals the
+    target's own greedy decode."""
+    model, variables = target
+    d_model, d_variables = draft
+    for prompt in ([[5, 3, 8, 1]], [[11, 7], [2, 9]]):
+        p = jnp.asarray(prompt, jnp.int32)
+        expected = greedy_generate(model, variables, p, 12)
+        out, stats = speculative_generate(
+            model, variables, d_model, d_variables, p, 12, draft_len=3,
+            return_stats=True)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(expected))
+        # never more target forwards than plain decode would use
+        assert stats["target_forwards"] <= 12 + 1
+
+
+def test_batched_rows_advance_independently(target, draft):
+    """Rows accept different draft counts per round; each row's output
+    must still match its own sequential greedy decode."""
+    model, variables = target
+    d_model, d_variables = draft
+    p = jnp.asarray([[5, 3, 8, 1], [9, 9, 2, 4], [1, 2, 3, 4]],
+                    jnp.int32)
+    expected = greedy_generate(model, variables, p, 10)
+    out = speculative_generate(model, variables, d_model, d_variables,
+                               p, 10, draft_len=2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
+
+
+def test_speculative_on_paged_target(target, draft):
+    """The verify forward runs through the paged multi-token branch
+    when the target uses a paged cache."""
+    model, variables = target
+    d_model, d_variables = draft
+    paged = LlamaModel(dataclasses.replace(model.config, page_size=16))
+    p = jnp.asarray([[5, 3, 8, 1, 2]], jnp.int32)
+    expected = greedy_generate(model, variables, p, 10)
+    out = speculative_generate(paged, variables, d_model, d_variables,
+                               p, 10, draft_len=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
+
+
+def test_headroom_and_draft_len_validation(target):
+    model, variables = target
+    p = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="draft_len"):
+        speculative_generate(model, variables, model, variables, p, 4,
+                             draft_len=0)
+    too_many = model.config.max_seq_len  # no headroom left
+    with pytest.raises(ValueError, match="headroom"):
+        speculative_generate(model, variables, model, variables, p,
+                             too_many, draft_len=4)
+
+
+def test_zero_max_new_tokens(target):
+    model, variables = target
+    p = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out = speculative_generate(model, variables, model, variables, p, 0)
+    assert out.shape == (1, 0)
+
+
+def test_inference_server_speculative_path(target, draft):
+    """InferenceServer(draft_model=...) serves greedy requests through
+    speculative decoding with identical results."""
+    from mpi_operator_tpu.serving import InferenceServer
+
+    model, variables = target
+    d_model, d_variables = draft
+    plain = InferenceServer(model, variables)
+    spec = InferenceServer(model, variables, draft_model=d_model,
+                           draft_variables=d_variables)
+    prompt = [5, 3, 8, 1, 9]
+    assert spec.generate(prompt, 12) == plain.generate(prompt, 12)
+    # sampling requests fall back to the plain path (seeded -> equal)
+    assert spec.generate(prompt, 8, temperature=0.7, seed=3) == \
+        plain.generate(prompt, 8, temperature=0.7, seed=3)
+    with pytest.raises(ValueError, match="go together"):
+        InferenceServer(model, variables, draft_model=d_model)
