@@ -28,6 +28,12 @@ bench:
 bench-launch:
 	$(PYTHON) bench_launch.py
 
+bench-llama:
+	$(PYTHON) bench_llama.py
+
+bench-serve:
+	$(PYTHON) bench_serve.py
+
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PYTHON) __graft_entry__.py 8

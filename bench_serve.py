@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Benchmark: serving decode throughput through the continuous batcher.
+
+The reference publishes no serving numbers (it has no inference stack);
+this measures the framework's own serving path end to end — paged KV
+pool, continuous batching, fused paged decode attention — and reports
+generated tokens/sec across concurrent requests, plus the prefix-cache
+prefill speedup (time-to-first-token, cold vs warm).
+
+Model: a Llama-shaped decoder sized by BENCH_SERVE_DIM/LAYERS (defaults
+target a single v5e chip; CPU smoke-tests pass smaller overrides).
+
+Prints ONE JSON line: {"metric": "serve_decode_tokens_per_sec", ...}.
+Same robustness pattern as bench.py: worker subprocess under a hard
+timeout, terminal-error JSON so callers always parse a record.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench import run_bench_worker  # noqa: E402
+
+METRIC = "serve_decode_tokens_per_sec"
+UNIT = "tokens/sec"
+
+
+def _emit(value: float, error=None, extra=None) -> None:
+    rec = {"metric": METRIC, "value": round(value, 1), "unit": UNIT,
+           "vs_baseline": None}
+    if error is not None:
+        rec["error"] = error
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def worker(donate: bool) -> None:  # donate unused; harness symmetry
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    dim = int(os.environ.get("BENCH_SERVE_DIM", "2048"))
+    n_layers = int(os.environ.get("BENCH_SERVE_LAYERS", "16"))
+    seq = int(os.environ.get("BENCH_SERVE_SEQ", "2048"))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
+    page = int(os.environ.get("BENCH_SERVE_PAGE", "16"))
+    new_tokens = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "64"))
+    prompt_len = int(os.environ.get("BENCH_SERVE_PROMPT", "128"))
+
+    cfg = LlamaConfig(vocab_size=32000, dim=dim, n_layers=n_layers,
+                      n_heads=max(1, dim // 128),
+                      n_kv_heads=max(1, dim // 512), max_seq_len=seq)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=slots,
+                                page_size=page).start()
+    try:
+        import numpy as np
+        rng = np.random.default_rng(0)
+        prompts = [list(map(int, rng.integers(1, cfg.vocab_size,
+                                              prompt_len)))
+                   for _ in range(2 * slots)]
+
+        # Warmup: compile prefill buckets + decode step.
+        batcher.submit(prompts[0], 2, timeout=1200)
+
+        # Throughput: 2x slots concurrent requests, decode-dominated.
+        results = [None] * len(prompts)
+
+        def run(i):
+            results[i] = batcher.submit(prompts[i], new_tokens,
+                                        timeout=1200)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        assert all(r is not None and len(r) == new_tokens
+                   for r in results)
+        tps = len(prompts) * new_tokens / elapsed
+
+        # Prefix-cache TTFT: identical prompt, cold vs warm prefill.
+        ttft_prompt = list(map(int, rng.integers(1, cfg.vocab_size,
+                                                 prompt_len)))
+        t0 = time.perf_counter()
+        batcher.submit(ttft_prompt, 1, timeout=1200)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        batcher.submit(ttft_prompt, 1, timeout=1200)
+        warm = time.perf_counter() - t0
+
+        _emit(tps, extra={
+            "platform": jax.devices()[0].platform,
+            "n_requests": len(prompts), "slots": slots,
+            "prompt_len": prompt_len, "new_tokens": new_tokens,
+            "page_size": page,
+            "ttft_cold_s": round(cold, 4), "ttft_warm_s": round(warm, 4),
+            "prefix_hit_blocks": batcher.prefix_stats["hit_blocks"],
+        })
+    finally:
+        batcher.stop()
+
+
+def main() -> None:
+    attempt_timeout = float(
+        os.environ.get("BENCH_SERVE_ATTEMPT_TIMEOUT", "900"))
+    line, diag = run_bench_worker(os.path.abspath(__file__), True,
+                                  attempt_timeout)
+    if line is not None:
+        print(line)
+        return
+    _emit(0.0, error=diag[:1000])
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker(donate="--no-donate" not in sys.argv)
+    else:
+        main()

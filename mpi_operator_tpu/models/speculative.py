@@ -29,13 +29,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .llama import (LlamaModel, _prefill_and_step, _set_cache_index,
-                    replace_cache_leaf)
+from .llama import LlamaModel, _prefill_and_step, _set_cache_index
 
 
-def _jit_greedy_multi(model, variables, width: int):
-    """Jitted width-token greedy decode apply: (cache, tokens [B, w]) ->
-    (cache, argmax tokens [B, w])."""
+def _jit_greedy_decode(model, variables):
+    """Jitted greedy decode apply: (cache, tokens [B, w]) ->
+    (cache, argmax tokens [B, w]); jit re-specializes per width."""
     params = {"params": variables["params"]}
 
     @jax.jit
@@ -95,9 +94,9 @@ def speculative_generate(model: LlamaModel, variables,
                                       prompt_tokens, 0.0, 1.0)
     t_last = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
 
-    draft_step = _jit_greedy_multi(draft_model, draft_variables, 1)
-    draft_feed2 = _jit_greedy_multi(draft_model, draft_variables, 2)
-    verify = _jit_greedy_multi(model, variables, draft_len + 1)
+    draft_decode = _jit_greedy_decode(draft_model, draft_variables)
+    draft_step = draft_feed2 = draft_decode
+    verify = _jit_greedy_decode(model, variables)
 
     out = np.zeros((b, max_new_tokens), np.int32)
     done = np.zeros((b,), np.int64)        # per-row emitted count
@@ -152,13 +151,10 @@ def speculative_generate(model: LlamaModel, variables,
             out[row, done[row]:done[row] + take] = emit[:take]
             history[row, s + done[row]:s + done[row] + take] = emit[:take]
             done[row] += take
-            if take == j + 1:
-                m_row[row] += j + 1
-            else:
-                # Row finished mid-round: park its index at the last
-                # committed token so later (garbage) rounds for other
-                # rows keep this row's reads/writes in bounds.
-                m_row[row] = s + max_new_tokens - 1
+            # Maintains m_row = s + done - 1 (last committed token),
+            # which keeps every later history read in bounds even for
+            # rows that finish mid-round.
+            m_row[row] += take
 
     if return_stats:
         return jnp.asarray(out), stats
