@@ -48,6 +48,151 @@ _STRING_MAP = {"type": "object", "additionalProperties": {"type": "string"}}
 # numbers (the kube int-or-string extension).
 _QUANTITY_MAP = {"type": "object",
                  "additionalProperties": {"x-kubernetes-int-or-string": True}}
+_STRING_LIST = {"type": "array", "items": {"type": "string"}}
+
+# --- k8s core shapes for fields kept as plain dicts in Python --------------
+# These mirror the reference CRD's controller-gen output for the same
+# fields (manifests/base/kubeflow.org_mpijobs.yaml in /root/reference);
+# closed structural schemas so a misspelled key is rejected instead of
+# silently pruned.
+
+_LABEL_SELECTOR_REQUIREMENT = {
+    "type": "object",
+    "properties": {"key": {"type": "string"},
+                   "operator": {"type": "string"},
+                   "values": _STRING_LIST},
+    "required": ["key", "operator"]}
+
+_LABEL_SELECTOR = {
+    "type": "object",
+    "properties": {
+        "matchLabels": _STRING_MAP,
+        "matchExpressions": {"type": "array",
+                             "items": _LABEL_SELECTOR_REQUIREMENT}}}
+
+_NODE_SELECTOR_REQUIREMENT = _LABEL_SELECTOR_REQUIREMENT
+
+_NODE_SELECTOR_TERM = {
+    "type": "object",
+    "properties": {
+        "matchExpressions": {"type": "array",
+                             "items": _NODE_SELECTOR_REQUIREMENT},
+        "matchFields": {"type": "array",
+                        "items": _NODE_SELECTOR_REQUIREMENT}}}
+
+_NODE_AFFINITY = {
+    "type": "object",
+    "properties": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "type": "object",
+            "properties": {"nodeSelectorTerms": {
+                "type": "array", "items": _NODE_SELECTOR_TERM}},
+            "required": ["nodeSelectorTerms"]},
+        "preferredDuringSchedulingIgnoredDuringExecution": {
+            "type": "array",
+            "items": {"type": "object",
+                      "properties": {"weight": {"type": "integer"},
+                                     "preference": _NODE_SELECTOR_TERM},
+                      "required": ["weight", "preference"]}}}}
+
+_POD_AFFINITY_TERM = {
+    "type": "object",
+    "properties": {
+        "labelSelector": _LABEL_SELECTOR,
+        "namespaceSelector": _LABEL_SELECTOR,
+        "namespaces": _STRING_LIST,
+        "topologyKey": {"type": "string"},
+        "matchLabelKeys": _STRING_LIST,
+        "mismatchLabelKeys": _STRING_LIST},
+    "required": ["topologyKey"]}
+
+_WEIGHTED_POD_AFFINITY_TERM = {
+    "type": "object",
+    "properties": {"weight": {"type": "integer"},
+                   "podAffinityTerm": _POD_AFFINITY_TERM},
+    "required": ["weight", "podAffinityTerm"]}
+
+_POD_AFFINITY = {
+    "type": "object",
+    "properties": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "type": "array", "items": _POD_AFFINITY_TERM},
+        "preferredDuringSchedulingIgnoredDuringExecution": {
+            "type": "array", "items": _WEIGHTED_POD_AFFINITY_TERM}}}
+
+_AFFINITY = {
+    "type": "object",
+    "properties": {"nodeAffinity": _NODE_AFFINITY,
+                   "podAffinity": _POD_AFFINITY,
+                   "podAntiAffinity": _POD_AFFINITY}}
+
+_SE_LINUX_OPTIONS = {
+    "type": "object",
+    "properties": {k: {"type": "string"}
+                   for k in ("user", "role", "type", "level")}}
+
+_WINDOWS_OPTIONS = {
+    "type": "object",
+    "properties": {
+        "gmsaCredentialSpecName": {"type": "string"},
+        "gmsaCredentialSpec": {"type": "string"},
+        "runAsUserName": {"type": "string"},
+        "hostProcess": {"type": "boolean"}}}
+
+_SECCOMP_PROFILE = {
+    "type": "object",
+    "properties": {"type": {"type": "string"},
+                   "localhostProfile": {"type": "string"}},
+    "required": ["type"]}
+
+_APP_ARMOR_PROFILE = _SECCOMP_PROFILE  # same {type, localhostProfile} shape
+
+_CONTAINER_SECURITY_CONTEXT = {
+    "type": "object",
+    "properties": {
+        "capabilities": {"type": "object",
+                         "properties": {"add": _STRING_LIST,
+                                        "drop": _STRING_LIST}},
+        "privileged": {"type": "boolean"},
+        "seLinuxOptions": _SE_LINUX_OPTIONS,
+        "windowsOptions": _WINDOWS_OPTIONS,
+        "runAsUser": {"type": "integer", "format": "int64"},
+        "runAsGroup": {"type": "integer", "format": "int64"},
+        "runAsNonRoot": {"type": "boolean"},
+        "readOnlyRootFilesystem": {"type": "boolean"},
+        "allowPrivilegeEscalation": {"type": "boolean"},
+        "procMount": {"type": "string"},
+        "seccompProfile": _SECCOMP_PROFILE,
+        "appArmorProfile": _APP_ARMOR_PROFILE}}
+
+_POD_SECURITY_CONTEXT = {
+    "type": "object",
+    "properties": {
+        "seLinuxOptions": _SE_LINUX_OPTIONS,
+        "windowsOptions": _WINDOWS_OPTIONS,
+        "runAsUser": {"type": "integer", "format": "int64"},
+        "runAsGroup": {"type": "integer", "format": "int64"},
+        "runAsNonRoot": {"type": "boolean"},
+        "supplementalGroups": {"type": "array",
+                               "items": {"type": "integer",
+                                         "format": "int64"}},
+        "supplementalGroupsPolicy": {"type": "string"},
+        "fsGroup": {"type": "integer", "format": "int64"},
+        "fsGroupChangePolicy": {"type": "string"},
+        "sysctls": {"type": "array",
+                    "items": {"type": "object",
+                              "properties": {"name": {"type": "string"},
+                                             "value": {"type": "string"}},
+                              "required": ["name", "value"]}},
+        "seccompProfile": _SECCOMP_PROFILE,
+        "appArmorProfile": _APP_ARMOR_PROFILE,
+        "seLinuxChangePolicy": {"type": "string"}}}
+
+_DNS_CONFIG_OPTIONS = {
+    "type": "array",
+    "items": {"type": "object",
+              "properties": {"name": {"type": "string"},
+                             "value": {"type": "string"}}}}
 
 # Structured schemas for fields whose Python type is a plain dict/list
 # (matching the reference CRD's real shapes instead of punting to
@@ -59,12 +204,20 @@ _FIELD_OVERRIDES = {
     ("PodSpec", "node_selector"): _STRING_MAP,
     ("ObjectMeta", "labels"): _STRING_MAP,
     ("ObjectMeta", "annotations"): _STRING_MAP,
+    ("ObjectMeta", "finalizers"): _STRING_LIST,
     ("ServiceSpec", "selector"): _STRING_MAP,
     ("PodSpec", "scheduling_gates"): {
         "type": "array",
         "items": {"type": "object",
                   "properties": {"name": {"type": "string"}},
                   "required": ["name"]}},
+    ("PodSpec", "affinity"): _AFFINITY,
+    ("PodSpec", "security_context"): _POD_SECURITY_CONTEXT,
+    ("Container", "security_context"): _CONTAINER_SECURITY_CONTEXT,
+    ("PodDNSConfig", "nameservers"): _STRING_LIST,
+    ("PodDNSConfig", "searches"): _STRING_LIST,
+    ("PodDNSConfig", "options"): _DNS_CONFIG_OPTIONS,
+    ("SchedulingPolicy", "min_resources"): _QUANTITY_MAP,
 }
 
 

@@ -46,6 +46,13 @@ def conflict(kind: str, name: str) -> ApiError:
     return ApiError("Conflict", f"{kind} {name!r} resource version conflict")
 
 
+def expired(kind: str, rv: str) -> ApiError:
+    """410 Gone: the requested watch resourceVersion fell out of the
+    retained event window (apiserver 'too old resource version')."""
+    return ApiError("Expired",
+                    f"too old resource version: {rv} ({kind})")
+
+
 def is_not_found(err: Exception) -> bool:
     return isinstance(err, ApiError) and err.code == "NotFound"
 
@@ -107,6 +114,11 @@ class Watch:
 class ApiServer:
     """Thread-safe in-memory object store with k8s API semantics."""
 
+    # Retained watch-event history entries (all kinds pooled); a watch
+    # starting from an RV older than the window gets 410 Expired, the
+    # same contract a real apiserver derives from its etcd cache.
+    HISTORY_LIMIT = 2048
+
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()
         self._lock = threading.RLock()
@@ -114,6 +126,11 @@ class ApiServer:
         self._store: dict = {}
         self._rv = 0
         self._watches: dict = {}  # (api_version, kind) -> [Watch]
+        # [(event_rv, gvk, WatchEvent)] ordered by rv; every rv bump
+        # emits exactly one event (delete bumps too), so the window
+        # [_purged_rv+1 .. _rv] is fully replayable.
+        self._history: list = []
+        self._purged_rv = 0
 
     # -- helpers ----------------------------------------------------------
     def _gvk(self, obj) -> tuple:
@@ -127,8 +144,21 @@ class ApiServer:
         return str(self._rv)
 
     def _notify(self, gvk, ev_type: str, obj) -> None:
+        ev = WatchEvent(ev_type, deep_copy(obj))
+        try:
+            ev_rv = int(obj.metadata.resource_version)
+        except (TypeError, ValueError):
+            ev_rv = self._rv
+        self._history.append((ev_rv, gvk, ev))
+        while len(self._history) > self.HISTORY_LIMIT:
+            self._purged_rv = max(self._purged_rv, self._history.pop(0)[0])
         for w in list(self._watches.get(gvk, [])):
             w._send(WatchEvent(ev_type, deep_copy(obj)))
+
+    def current_rv(self) -> str:
+        """The store-wide resourceVersion a List response carries."""
+        with self._lock:
+            return str(self._rv)
 
     def _remove_watch(self, gvk, w) -> None:
         with self._lock:
@@ -212,6 +242,9 @@ class ApiServer:
             obj = bucket.pop((namespace, name), None)
             if obj is None:
                 raise not_found(kind, f"{namespace}/{name}")
+            # A real apiserver bumps the RV on delete; the DELETED event
+            # carries the new version (required for exact watch replay).
+            obj.metadata.resource_version = self._next_rv()
             self._notify((api_version, kind), DELETED, obj)
             self._cascade_delete(obj)
             return deep_copy(obj)
@@ -227,13 +260,35 @@ class ApiServer:
                         if any(ref.uid == owner_uid and ref.controller
                                for ref in o.metadata.owner_references)]:
                 dead = bucket.pop(key)
+                # Same RV bump as a direct delete: every DELETED event
+                # must carry a fresh RV or watch-history replay (and a
+                # live client's resume RV) would rewind to the object's
+                # stale last-write version.
+                dead.metadata.resource_version = self._next_rv()
                 self._notify(gvk, DELETED, dead)
                 self._cascade_delete(dead)
 
-    def watch(self, api_version: str, kind: str) -> Watch:
+    def watch(self, api_version: str, kind: str,
+              resource_version: Optional[str] = None) -> Watch:
+        """Open a watch stream.
+
+        ``resource_version`` None/""/"0" starts from now (events only
+        from this call on).  A specific RV replays every retained event
+        with rv > RV first (atomically with registration, so nothing is
+        dropped in between), matching apiserver watch-cache semantics;
+        an RV older than the retained window raises 410 Expired
+        (``ApiError("Expired")``) so clients exercise their relist path.
+        """
         with self._lock:
             gvk = (api_version, kind)
             w = Watch(self, gvk)
+            if resource_version not in (None, "", "0"):
+                rv = int(resource_version)
+                if rv < self._purged_rv:
+                    raise expired(kind, resource_version)
+                for ev_rv, g, ev in self._history:
+                    if g == gvk and ev_rv > rv:
+                        w._send(WatchEvent(ev.type, deep_copy(ev.obj)))
             self._watches.setdefault(gvk, []).append(w)
             return w
 

@@ -59,9 +59,9 @@ _KINDS = {(gv, plural): kind for (gv, kind), plural in _RESOURCES.items()}
 # kube Status reason <-> our ApiError codes.
 _REASON_TO_CODE = {"NotFound": "NotFound", "AlreadyExists": "AlreadyExists",
                    "Conflict": "Conflict", "Invalid": "Invalid",
-                   "Forbidden": "Forbidden"}
+                   "Forbidden": "Forbidden", "Expired": "Expired"}
 _CODE_TO_HTTP = {"NotFound": 404, "AlreadyExists": 409, "Conflict": 409,
-                 "Invalid": 422, "Forbidden": 403}
+                 "Invalid": 422, "Forbidden": 403, "Expired": 410}
 
 
 def resource_for(api_version: str, kind: str) -> str:
@@ -195,7 +195,13 @@ class _KubeWatch:
         return self._connected.wait(timeout)
 
     def _url(self) -> str:
-        params = {"watch": "true", "allowWatchBookmarks": "true"}
+        # timeoutSeconds bounds the stream server-side (client-go
+        # requests 5-10 min): the server ends an idle watch gracefully
+        # and the client reconnects from its last RV — the client-side
+        # read timeout is only a dead-peer backstop, NOT a keepalive
+        # deadline (a real apiserver sends nothing between events).
+        params = {"watch": "true", "allowWatchBookmarks": "true",
+                  "timeoutSeconds": str(self._t.watch_timeout_seconds)}
         if self._rv:
             params["resourceVersion"] = self._rv
         return (self._t.base
@@ -208,8 +214,9 @@ class _KubeWatch:
         while not self.stopped:
             resp = None
             try:
-                # Read timeout >> server keepalive: a silently dead peer
-                # surfaces as a timeout -> reconnect, not a hang.
+                # Read timeout >> watch timeoutSeconds: the server ends
+                # the stream first in the healthy case; only a silently
+                # dead peer trips the client-side timeout -> reconnect.
                 resp = self._t._open("GET", self._url(), stream=True)
                 self._resp = resp
                 # Response headers received => the server has registered
@@ -244,6 +251,11 @@ class _KubeWatch:
             except urllib.error.HTTPError as exc:
                 if exc.code in (401, 403):
                     self._t._note_auth_failure(exc)
+                elif exc.code == 410:
+                    # Expired RV rejected before streaming began:
+                    # restart from "now"; the informer's resync heals
+                    # the replay gap (same as the in-stream ERROR path).
+                    self._rv = None
             except Exception:
                 pass  # connection lost; fall through to reconnect
             finally:
@@ -263,11 +275,31 @@ class _KubeWatch:
         except queue.Empty:
             return None
 
+    def _break_connection(self) -> None:
+        """Sever the live stream (tests simulate network partitions);
+        the pump reconnects from its last RV."""
+        resp = self._resp
+        if resp is None:
+            return
+        try:
+            sock = resp.fp.raw._sock  # type: ignore[union-attr]
+            import socket as _socket
+            sock.shutdown(_socket.SHUT_RDWR)
+        except Exception:
+            pass
+
     def stop(self) -> None:
         self.stopped = True
+        resp = self._resp
+        if resp is None:
+            return
+        # Shut the socket down FIRST: close() waits on the io buffer
+        # lock held by the pump thread's blocked read (which, with the
+        # long idle-watch read timeout, may not return for minutes);
+        # shutdown() breaks that read immediately.
+        self._break_connection()
         try:
-            if self._resp is not None:
-                self._resp.close()
+            resp.close()
         except Exception:
             pass
 
@@ -277,10 +309,19 @@ class KubeApiServer:
     ``Clientset(server=KubeApiServer(config))``."""
 
     def __init__(self, config: KubeConfig, timeout: float = 30.0,
-                 auth_failure_handler=None):
+                 auth_failure_handler=None,
+                 watch_read_timeout: float = 330.0,
+                 watch_timeout_seconds: int = 300):
         self.config = config
         self.base = config.server
         self.timeout = timeout
+        # Watch streams idle for minutes on a real apiserver (no
+        # keepalives; bookmarks are ~1/min at best).  The client read
+        # timeout must exceed the requested server-side timeoutSeconds
+        # so the server closes first; 5s here caused reconnect churn
+        # every 5s on every idle informer (round-2 review finding).
+        self.watch_read_timeout = watch_read_timeout
+        self.watch_timeout_seconds = watch_timeout_seconds
         # Called with the HTTPError after repeated 401/403 on a watch
         # stream — the reference's informer watch-error handler
         # klog.Fatals there so the pod restarts with fresh RBAC
@@ -308,7 +349,7 @@ class KubeApiServer:
             headers["Authorization"] = f"Bearer {self.config.token}"
         req = urllib.request.Request(url, data=body, headers=headers,
                                      method=method)
-        timeout = 5.0 if stream else self.timeout
+        timeout = self.watch_read_timeout if stream else self.timeout
         return urllib.request.urlopen(req, timeout=timeout,
                                       context=self._ssl)
 
@@ -561,7 +602,7 @@ class _FixtureHandler(BaseHTTPRequestHandler):
                                      route.namespace or "", route.name)
                 return self._json(200, registry.encode(obj))
             if query.get("watch", ["false"])[0] == "true":
-                return self._stream_watch(route)
+                return self._stream_watch(route, query)
             items = self.store.list(route.api_version, route.kind,
                                     route.namespace, self._selector(query))
             wire = []
@@ -572,9 +613,13 @@ class _FixtureHandler(BaseHTTPRequestHandler):
                 item.pop("kind", None)
                 wire.append(item)
             gv = route.api_version
+            # Monotonic store-wide RV, not "0": clients resume watches
+            # from the List RV, so a pinned value would silently replay
+            # or drop events (round-2 review finding).
             return self._json(200, {
                 "kind": f"{route.kind}List", "apiVersion": gv,
-                "metadata": {"resourceVersion": "0"}, "items": wire})
+                "metadata": {"resourceVersion": self.store.current_rv()},
+                "items": wire})
         except ApiError as exc:
             return self._api_error(exc)
 
@@ -623,27 +668,70 @@ class _FixtureHandler(BaseHTTPRequestHandler):
         except ApiError as exc:
             return self._api_error(exc)
 
-    def _stream_watch(self, route: _Route) -> None:
-        watch = self.store.watch(route.api_version, route.kind)
+    def _write_chunk(self, chunk: bytes) -> None:
+        self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+        self.wfile.flush()
+
+    def _stream_watch(self, route: _Route, query) -> None:
+        import time as _time
+        self.server.watch_requests += 1  # type: ignore[attr-defined]
+        rv = query.get("resourceVersion", [None])[0]
+        timeout_s = query.get("timeoutSeconds", [None])[0]
+        deadline = (_time.monotonic() + float(timeout_s)
+                    if timeout_s else None)
+        try:
+            watch = self.store.watch(route.api_version, route.kind,
+                                     resource_version=rv)
+        except ApiError as exc:
+            if exc.code != "Expired":
+                return self._api_error(exc)
+            # Expired RV: kube streams a single ERROR event carrying a
+            # 410 Status, then ends the watch — the client must relist.
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                self._write_chunk((json.dumps({
+                    "type": "ERROR",
+                    "object": {"kind": "Status", "apiVersion": "v1",
+                               "metadata": {}, "status": "Failure",
+                               "message": exc.message, "reason": "Expired",
+                               "code": 410}}) + "\n").encode())
+                self._write_chunk(b"")  # terminal chunk: clean end
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            return
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
+        # Like a real apiserver, NOTHING is written between events (no
+        # keepalive comments): idle-watch robustness on the client side
+        # is exercised for real.  ``keepalive_interval`` opts back in.
+        keepalive = self.server.keepalive_interval  # type: ignore
+        last_write = _time.monotonic()
         try:
             while not self.server.stopping:  # type: ignore[attr-defined]
+                if deadline is not None and _time.monotonic() >= deadline:
+                    # Server-side timeoutSeconds elapsed: end cleanly
+                    # (terminal chunk) so the client reconnects at once.
+                    self._write_chunk(b"")
+                    break
                 ev = watch.next(timeout=0.5)
                 if ev is None:
-                    chunk = b": keepalive\n"
-                else:
-                    if route.namespace and \
-                            ev.obj.metadata.namespace != route.namespace:
-                        continue
-                    chunk = (json.dumps(
-                        {"type": ev.type,
-                         "object": registry.encode(ev.obj)}) + "\n").encode()
-                self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk
-                                 + b"\r\n")
-                self.wfile.flush()
+                    if (keepalive is not None
+                            and _time.monotonic() - last_write >= keepalive):
+                        self._write_chunk(b": keepalive\n")
+                        last_write = _time.monotonic()
+                    continue
+                if route.namespace and \
+                        ev.obj.metadata.namespace != route.namespace:
+                    continue
+                self._write_chunk((json.dumps(
+                    {"type": ev.type,
+                     "object": registry.encode(ev.obj)}) + "\n").encode())
+                last_write = _time.monotonic()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
@@ -656,12 +744,17 @@ class KubeFixtureServer:
     def __init__(self, store: Optional[ApiServer] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  token: str = "",
-                 crds: Optional[set] = None):
+                 crds: Optional[set] = None,
+                 keepalive_interval: Optional[float] = None):
         self.store = store or ApiServer()
         self._http = ThreadingHTTPServer((host, port), _FixtureHandler)
         self._http.store = self.store  # type: ignore[attr-defined]
         self._http.stopping = False  # type: ignore[attr-defined]
         self._http.token = token  # type: ignore[attr-defined]
+        # None (default) = real-apiserver behavior: silence between
+        # events; set to a float to emit ": keepalive" comment chunks.
+        self._http.keepalive_interval = keepalive_interval  # type: ignore
+        self._http.watch_requests = 0  # type: ignore[attr-defined]
         self._http.crds = crds if crds is not None else {  # type: ignore
             "mpijobs.kubeflow.org"}
         self.token = token
@@ -671,6 +764,11 @@ class KubeFixtureServer:
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def watch_requests(self) -> int:
+        """Watch GETs served so far (reconnect-churn assertions)."""
+        return self._http.watch_requests  # type: ignore[attr-defined]
 
     def client_config(self) -> KubeConfig:
         return KubeConfig(server=self.url, token=self.token)

@@ -140,14 +140,16 @@ def speculative_generate(model: LlamaModel, variables,
         g_np = np.asarray(greedy)                       # [B, k+1]
         match = d_np == g_np[:, :-1]
         accepted = np.cumprod(match, axis=1).sum(axis=1)  # [B]
-        stats["accepted_drafts"] += int(
-            accepted[done < max_new_tokens].sum())
         for row in range(b):
             if done[row] >= max_new_tokens:
                 continue  # finished row: cache index stays parked
             j = int(accepted[row])
             emit = g_np[row, :j + 1]                    # d1..dj, bonus
             take = min(len(emit), max_new_tokens - done[row])
+            # Count only drafts actually committed: a truncated emit
+            # (take < len(emit)) drops trailing drafts, and the final
+            # position of emit is the bonus token, not a draft.
+            stats["accepted_drafts"] += min(j, take)
             out[row, done[row]:done[row] + take] = emit[:take]
             history[row, s + done[row]:s + done[row] + take] = emit[:take]
             done[row] += take

@@ -551,6 +551,14 @@ class ContinuousBatcher:
                 if slots[i] is not None:
                     continue
                 if deferred is not None:
+                    if deferred.cancelled.is_set():
+                        # Reap a dead deferred request immediately: the
+                        # no-retirement gate below would otherwise pin
+                        # it (and stall all later FIFO requests) until
+                        # some unrelated retirement bumps _retire_count.
+                        deferred.done.set()
+                        deferred = None
+                        continue
                     if (self.page_size > 0
                             and deferred_mark == self._retire_count):
                         # Nothing retired since the failed allocation:

@@ -493,3 +493,35 @@ def test_prefix_cache_concurrent_sharing_exact():
         assert all(m["refs"] == 0 for m in batcher._block_meta.values())
     finally:
         batcher.stop()
+
+
+def test_cancelled_deferred_request_is_reaped_without_retirement():
+    """Round-2 advisor regression: a deferred request whose client went
+    away must be dropped even when NOTHING retires — the no-retirement
+    fast-path gate must not pin a cancelled request (and stall every
+    later FIFO request) until some unrelated retirement happens."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    # 17 usable blocks; A pins 16 of them for its whole (long) decode.
+    batcher = ContinuousBatcher(model, variables, max_slots=3,
+                                page_size=16, cache_blocks=18).start()
+    try:
+        req_a = batcher._enqueue(list(range(1, 41)), 216, 0.0, 1.0, 0)
+        # B needs 2 blocks > 1 free -> deferred; then its client dies.
+        req_b = batcher._enqueue(list(range(1, 17)), 8, 0.0, 1.0, 0)
+        import time
+        deadline = time.monotonic() + 10
+        while not req_a.output and time.monotonic() < deadline:
+            time.sleep(0.01)  # A admitted (prefill emitted its token)
+        req_b.cancelled.set()
+        # C fits in the free block; admission must reach it while A is
+        # still decoding (no retirement has bumped _retire_count).
+        out_c = batcher.submit([5, 6, 7, 8], 4, timeout=30)
+        assert len(out_c) == 4
+        assert not req_a.done.is_set(), \
+            "A retired first: the test no longer proves the reap path"
+        assert req_b.done.is_set() and req_b.error is None
+    finally:
+        batcher.stop()

