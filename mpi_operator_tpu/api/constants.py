@@ -65,6 +65,10 @@ JOB_RESTARTING = "Restarting"
 JOB_SUCCEEDED = "Succeeded"
 JOB_SUSPENDED = "Suspended"
 JOB_FAILED = "Failed"
+# Gang-scheduling feedback (no reference counterpart: the reference
+# only verifies gating in e2e; here the controller consumes PodGroup
+# status back into a visible MPIJob-level signal).
+JOB_WORKERS_GATED = "WorkersGated"
 
 # Well-known labels (constants.go:30-45)
 REPLICA_INDEX_LABEL = "training.kubeflow.org/replica-index"

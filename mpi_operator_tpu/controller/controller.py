@@ -21,7 +21,7 @@ from ..api.defaults import set_defaults_mpijob
 from ..api.types import MPIJob, worker_replicas
 from ..api.validation import validate_mpijob
 from ..k8s import batch, core
-from ..k8s.apiserver import Clientset, is_not_found
+from ..k8s.apiserver import Clientset, is_conflict, is_not_found
 from ..k8s.informers import InformerFactory
 from ..k8s.meta import Clock, deep_copy, get_controller_of
 from ..k8s.selectors import match_label_selector
@@ -203,7 +203,6 @@ class MPIJobController:
                 self.sync_handler(key)
                 self.queue.forget(key)
             except Exception as exc:  # requeue with backoff
-                from ..k8s.apiserver import is_conflict
                 if is_conflict(exc):
                     # Expected under informer staleness: the next sync on a
                     # fresh cache converges (ref :1169-1188 rationale).
@@ -227,6 +226,10 @@ class MPIJobController:
         # NEVER modify informer cache objects (:591-594).
         mpi_job = deep_copy(shared)
         set_defaults_mpijob(mpi_job)
+        # Snapshot BEFORE any mutation: the end-of-sync persistence guard
+        # must see every condition set during this sync (the reference
+        # diffs against the pristine lister object, :1196-1199).
+        pristine_status = deep_copy(mpi_job.status)
 
         manager = managed_by_external_controller(
             mpi_job.spec.run_policy.managed_by)
@@ -278,8 +281,10 @@ class MPIJobController:
 
             if not self._suspended(mpi_job):
                 if self.pod_group_ctrl is not None:
-                    if self._get_or_create_pod_group(mpi_job) is None:
+                    pod_group = self._get_or_create_pod_group(mpi_job)
+                    if pod_group is None:
                         raise RuntimeError("getting or creating PodGroup")
+                    self._sync_pod_group_feedback(mpi_job, pod_group)
                 self._maybe_gang_restart(mpi_job)
                 workers = self._get_or_create_workers(mpi_job)
             if launcher is None:
@@ -325,7 +330,8 @@ class MPIJobController:
         if self._suspended(mpi_job):
             self._clean_up_worker_pods(mpi_job)
 
-        self._update_mpi_job_status(mpi_job, launcher, workers)
+        self._update_mpi_job_status(mpi_job, launcher, workers,
+                                    old_status=pristine_status)
 
     # ------------------------------------------------------------------
     # get-or-create helpers
@@ -428,6 +434,34 @@ class MPIJobController:
         if not ctrl.pg_specs_equal(pg, new_pg):
             return ctrl.update_pod_group(pg, new_pg)
         return pg
+
+    def _sync_pod_group_feedback(self, job: MPIJob, pg) -> None:
+        """Close the gang-scheduling loop: PodGroup status (Volcano
+        status.phase / scheduler-plugins phase + Unschedulable
+        condition) becomes an MPIJob WorkersGated condition and Events,
+        so an unsatisfiable gang is visible on the job itself instead
+        of only on N Pending pods.  Silent PodGroups (no phase yet — no
+        gang scheduler running) change nothing."""
+        scheduled, reason, message = \
+            self.pod_group_ctrl.pod_group_scheduled(pg)
+        if scheduled is None:
+            return
+        current = get_condition(job.status, constants.JOB_WORKERS_GATED)
+        if not scheduled:
+            changed = update_job_conditions(
+                job, constants.JOB_WORKERS_GATED, core.CONDITION_TRUE,
+                reason, message, self.clock)
+            if changed:
+                self.recorder.eventf(job, core.EVENT_TYPE_NORMAL, reason,
+                                     "workers gated by gang scheduler: %s",
+                                     message)
+        elif current is not None \
+                and current.status == core.CONDITION_TRUE:
+            update_job_conditions(
+                job, constants.JOB_WORKERS_GATED, core.CONDITION_FALSE,
+                reason, message, self.clock)
+            self.recorder.eventf(job, core.EVENT_TYPE_NORMAL, reason,
+                                 "gang satisfied: %s", message)
 
     def _delete_pod_group(self, job: MPIJob) -> None:
         """deletePodGroups (:810-837)."""
@@ -537,15 +571,32 @@ class MPIJobController:
                     if not is_not_found(exc):
                         raise
         # Persist the counter on the stored object (spec path, not status).
-        stored = self.client.mpi_jobs(job.metadata.namespace).get(
-            job.metadata.name)
-        stored.metadata.annotations[
-            constants.GANG_RESTART_COUNT_ANNOTATION] = str(restarts + 1)
-        updated = self.client.mpi_jobs(job.metadata.namespace).update(stored)
-        # Keep the in-flight copy current so the end-of-sync status write
-        # does not hit an optimistic-concurrency conflict.
-        job.metadata.annotations = updated.metadata.annotations
-        job.metadata.resource_version = updated.metadata.resource_version
+        # Conflict-retried: the pods are already gone, so losing this write
+        # to a concurrent status update would lose the restart accounting
+        # (and with it the backoffLimit bound) while the restart proceeds.
+        for _ in range(5):
+            stored = self.client.mpi_jobs(job.metadata.namespace).get(
+                job.metadata.name)
+            stored.metadata.annotations[
+                constants.GANG_RESTART_COUNT_ANNOTATION] = str(restarts + 1)
+            try:
+                updated = self.client.mpi_jobs(
+                    job.metadata.namespace).update(stored)
+            except Exception as exc:
+                if is_conflict(exc):
+                    continue
+                raise
+            # Keep the in-flight copy current so the end-of-sync status
+            # write does not hit an optimistic-concurrency conflict.
+            job.metadata.annotations = updated.metadata.annotations
+            job.metadata.resource_version = updated.metadata.resource_version
+            break
+        else:
+            # Losing the counter would let a crash-looping gang restart
+            # past backoffLimit invisibly; surface the failure so the
+            # sync requeues rather than proceeding unaccounted.
+            raise RuntimeError(
+                "persisting gang-restart count: conflicts exhausted")
 
     def _get_or_create_workers(self, job: MPIJob) -> list:
         """getOrCreateWorker (:982-1042)."""
@@ -659,9 +710,11 @@ class MPIJobController:
                     f"not adopting it")
         return out
 
-    def _update_mpi_job_status(self, job: MPIJob, launcher, workers: list) -> None:
+    def _update_mpi_job_status(self, job: MPIJob, launcher, workers: list,
+                               old_status=None) -> None:
         """updateMPIJobStatus (:1094-1200)."""
-        old_status = deep_copy(job.status)
+        if old_status is None:
+            old_status = deep_copy(job.status)
 
         if self._suspended(job):
             if update_job_conditions(job, constants.JOB_SUSPENDED,

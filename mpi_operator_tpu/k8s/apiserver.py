@@ -179,6 +179,11 @@ class ApiServer:
             obj.metadata.resource_version = self._next_rv()
             if obj.metadata.creation_timestamp is None:
                 obj.metadata.creation_timestamp = self.clock.now()
+            if gvk == ("v1", "Pod") and not obj.status.phase:
+                # kube defaults pod phase to Pending at admission; an
+                # unscheduled (e.g. gang-gated) pod must count as active
+                # for Job controllers, not as missing.
+                obj.status.phase = "Pending"
             bucket[key] = obj
             self._notify(gvk, ADDED, obj)
             return deep_copy(obj)

@@ -1,0 +1,247 @@
+"""GangSchedulerSim — a minimal Volcano / coscheduling stand-in.
+
+The reference e2e installs the real Volcano and scheduler-plugins via
+helm and verifies pods actually *gate* on the PodGroup — stay Pending
+until the whole gang fits (test/e2e/e2e_suite_test.go:186-243,
+test/e2e/mpi_job_test.go:341-436).  The hermetic runtime reproduces the
+same observable contract without a cluster:
+
+- Pods whose ``spec.schedulerName`` names a gang scheduler are ignored
+  by the LocalKubelet (exactly like the default kube-scheduler ignores
+  them) until this simulator *binds* them, which it records as the
+  ``scheduling.local/bound`` pod annotation.
+- The simulator binds a gang only when every member exists AND the gang
+  fits the configured capacity (``minMember <= capacity``); capacity is
+  the stand-in for allocatable cluster resources.
+- Until then it publishes honest PodGroup status — Volcano
+  ``status.phase: Pending`` with an ``Unschedulable`` condition, or the
+  scheduler-plugins phase grammar — which the controller consumes back
+  into the MPIJob ``WorkersGated`` condition
+  (controller.py ``_sync_pod_group_feedback``).
+
+This closes the loop the round-2 review flagged: PodGroup status is no
+longer write-only, and the e2e scheduler-sim refuses to run pods until
+minMember is satisfiable instead of relying on hand-cleared gates.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..k8s.apiserver import Clientset, is_conflict, is_not_found
+from ..k8s.scheduling import (SCHED_PLUGINS_API_VERSION,
+                              SCHED_PLUGINS_POD_GROUP_LABEL,
+                              VOLCANO_API_VERSION,
+                              VOLCANO_POD_GROUP_NAME_ANNOTATION)
+
+logger = logging.getLogger("mpi_operator_tpu.runtime.gangsim")
+
+# Pods carrying this annotation with value "true" have been placed by
+# the gang scheduler; the LocalKubelet refuses to run gang-scheduled
+# pods without it (the binding act of a real scheduler).
+BOUND_ANNOTATION = "scheduling.local/bound"
+
+_VOLCANO = (VOLCANO_API_VERSION, "PodGroup")
+_SCHED_PLUGINS = (SCHED_PLUGINS_API_VERSION, "PodGroup")
+
+
+def pod_gang_name(pod) -> Optional[str]:
+    """The PodGroup a pod belongs to, per the decoration the controller
+    applied (podgroup.py decorate_pod_template)."""
+    name = (pod.metadata.annotations or {}).get(
+        VOLCANO_POD_GROUP_NAME_ANNOTATION)
+    if name:
+        return name
+    return (pod.metadata.labels or {}).get(SCHED_PLUGINS_POD_GROUP_LABEL)
+
+
+class GangSchedulerSim:
+    """Watches PodGroups + member pods; binds whole gangs or reports
+    why it can't.
+
+    ``capacity`` is the number of pods the simulated cluster can place
+    at once (None = unbounded).  ``set_capacity`` mid-run models nodes
+    joining/leaving — the next reconcile re-evaluates every gang.
+    """
+
+    def __init__(self, clientset: Clientset, capacity: Optional[int] = None,
+                 namespace: Optional[str] = None):
+        self.client = clientset
+        self.namespace = namespace
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watches: list = []
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def capacity(self) -> Optional[int]:
+        with self._lock:
+            return self._capacity
+
+    def set_capacity(self, capacity: Optional[int]) -> None:
+        with self._lock:
+            self._capacity = capacity
+        self._kick.set()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "GangSchedulerSim":
+        for api_version, kind in (_VOLCANO, _SCHED_PLUGINS, ("v1", "Pod")):
+            self._watches.append(
+                self.client.server.watch(api_version, kind))
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="gang-scheduler-sim")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for w in self._watches:
+            w.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        # Reconcile every ~0.15s tick (cheap and idempotent; relists, so
+        # the watches exist only to bound memory, not to carry state) —
+        # set_capacity kicks an immediate pass.
+        while not self._stop.is_set():
+            # Drain watch queues fully: one event per tick would let the
+            # backlog grow without bound under pod churn (reconcile's own
+            # binds generate events too).
+            for w in self._watches:
+                while w.next(timeout=0) is not None:
+                    pass
+            self._kick.clear()
+            try:
+                self.reconcile_once()
+            except Exception:
+                logger.exception("gang reconcile failed")
+            self._kick.wait(timeout=0.15)
+
+    # -- the scheduler -----------------------------------------------------
+    def reconcile_once(self) -> None:
+        # Capacity is a cluster-wide pool: pods already bound (placed)
+        # debit it, so concurrent gangs cannot over-commit.  Gangs are
+        # visited in creation order — FIFO admission, like a real queue.
+        used = sum(
+            1 for p in self.client.server.list("v1", "Pod", self.namespace)
+            if (p.metadata.annotations or {}).get(BOUND_ANNOTATION) == "true"
+            and p.status.phase not in ("Succeeded", "Failed"))
+        groups = []
+        for api_version, _ in (_VOLCANO, _SCHED_PLUGINS):
+            for pg in self.client.server.list(
+                    api_version, "PodGroup", self.namespace):
+                groups.append((api_version, pg))
+        groups.sort(key=lambda item: (
+            str(item[1].metadata.creation_timestamp or ""),
+            item[1].metadata.name))
+        for api_version, pg in groups:
+            used += self._sync_group(api_version, pg, used)
+
+    def _members(self, namespace: str, group: str) -> list:
+        return [p for p in self.client.server.list("v1", "Pod", namespace)
+                if pod_gang_name(p) == group]
+
+    def _sync_group(self, api_version: str, pg, used: int) -> int:
+        """Reconcile one gang; returns how many *new* placements it made
+        so the caller can debit the shared capacity pool."""
+        ns = pg.metadata.namespace
+        members = self._members(ns, pg.metadata.name)
+        min_member = pg.spec.min_member or 0
+        capacity = self.capacity
+        volcano = api_version == VOLCANO_API_VERSION
+
+        members.sort(key=lambda p: p.metadata.name)  # deterministic order
+        bound = [p for p in members
+                 if (p.metadata.annotations or {}).get(
+                     BOUND_ANNOTATION) == "true"]
+        unbound = [p for p in members if p not in bound]
+        # `used` already counts this gang's bound pods; free slots are
+        # what the rest of the cluster leaves over.
+        free = None if capacity is None else capacity - used
+
+        if len(bound) >= min_member > 0:
+            # Gang is placed; keep reporting the placed phase, and bind
+            # stragglers (replacement pods after a scale-up) only as
+            # capacity allows — they still debit the pool.
+            extra = unbound if free is None else unbound[:max(0, free)]
+            for pod in extra:
+                self._bind(pod)
+            self._set_status(api_version, pg, "Running" if volcano
+                             else "Scheduled")
+            return len(extra)
+
+        if free is not None and min_member > free + len(bound):
+            reason = (f"{min_member}/{min_member} tasks unschedulable: "
+                      f"gang needs {min_member} slots, cluster capacity "
+                      f"is {capacity} ({free} free)")
+            phase = "Pending" if volcano else "Unschedulable"
+            self._set_status(api_version, pg, phase, unschedulable=reason)
+            return 0
+        if len(members) < min_member:
+            # Gang incomplete — a real gang scheduler waits for all
+            # members before placing any (the whole point).
+            phase = "Pending" if volcano else "PreScheduling"
+            self._set_status(api_version, pg, phase)
+            return 0
+
+        # Gang fits (min_member - len(bound) <= free): bind members up
+        # to the free slots — minMember guaranteed, extras while
+        # capacity remains (a real scheduler places what fits beyond
+        # the gang minimum).
+        placeable = unbound if free is None else unbound[:free]
+        for pod in placeable:
+            self._bind(pod)
+        self._set_status(api_version, pg, "Running" if volcano
+                         else "Scheduled")
+        return len(placeable)
+
+    def _bind(self, pod) -> None:
+        if (pod.metadata.annotations or {}).get(BOUND_ANNOTATION) == "true":
+            return
+        for _ in range(5):
+            try:
+                fresh = self.client.pods(pod.metadata.namespace).get(
+                    pod.metadata.name)
+                fresh.metadata.annotations = dict(
+                    fresh.metadata.annotations or {})
+                fresh.metadata.annotations[BOUND_ANNOTATION] = "true"
+                self.client.pods(pod.metadata.namespace).update(fresh)
+                return
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                if not is_conflict(exc):
+                    raise
+
+    def _set_status(self, api_version: str, pg, phase: str,
+                    unschedulable: str = "") -> None:
+        conditions = []
+        if unschedulable:
+            conditions = [{"type": "Unschedulable", "status": "True",
+                           "reason": "NotEnoughResources",
+                           "message": unschedulable}]
+        status = {"phase": phase, "conditions": conditions}
+        if (pg.status or {}) == status:
+            return
+        ctl = (self.client.volcano_pod_groups
+               if api_version == VOLCANO_API_VERSION
+               else self.client.sched_plugins_pod_groups)
+        for _ in range(5):
+            try:
+                fresh = ctl(pg.metadata.namespace).get(pg.metadata.name)
+                if (fresh.status or {}) == status:
+                    return
+                fresh.status = status
+                ctl(pg.metadata.namespace).update_status(fresh)
+                return
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                if not is_conflict(exc):
+                    raise

@@ -36,7 +36,7 @@ from typing import Optional
 from ..api import constants as api_constants
 from ..k8s import core
 from ..k8s.apiserver import ApiServer, Clientset, is_conflict, is_not_found
-from . import netsim
+from . import gangsim, netsim
 
 logger = logging.getLogger("mpi_operator_tpu.runtime.kubelet")
 
@@ -377,6 +377,16 @@ class LocalKubelet:
                 return
             if pod.spec.scheduling_gates:
                 return  # gated pods wait (Kueue semantics)
+            if gangsim.pod_gang_name(pod) is not None and \
+                    (pod.metadata.annotations or {}).get(
+                        gangsim.BOUND_ANNOTATION) != "true":
+                # Gang-decorated pods (PodGroup annotation/label) stay
+                # Pending until the gang scheduler binds them (reference
+                # e2e contract: test/e2e/mpi_job_test.go:341-436 — pods
+                # of an unsatisfiable PodGroup never run).  Pods with a
+                # custom schedulerName but no gang membership run
+                # normally — only the gang contract is simulated.
+                return
             runner = _PodRunner(self, pod)
             self._runners[key] = runner
         runner.start()

@@ -14,6 +14,7 @@ from typing import Optional
 from ..controller.controller import MPIJobController
 from ..controller.podgroup import new_pod_group_ctrl
 from ..k8s.apiserver import Clientset
+from ..runtime.gangsim import GangSchedulerSim
 from ..runtime.job_controller import JobController
 from ..runtime.kubelet import LocalKubelet
 
@@ -24,6 +25,7 @@ class LocalCluster:
                  namespace: Optional[str] = None,
                  threadiness: int = 2,
                  run_pods: bool = True,
+                 gang_capacity: Optional[int] = None,
                  client: Optional[Clientset] = None):
         # An injected client lets the identical stack run over a remote
         # transport (e.g. KubeApiServer against kube path grammar).
@@ -35,6 +37,13 @@ class LocalCluster:
         self.job_controller = JobController(self.client, namespace=namespace)
         self.kubelet = LocalKubelet(self.client, namespace=namespace) \
             if run_pods else None
+        # When gang scheduling is on, pods gate on the (simulated)
+        # scheduler actually placing the gang — reference e2e contract
+        # (e2e_suite_test.go:186-243); gang_capacity models allocatable
+        # cluster slots (None = always satisfiable).
+        self.gang_sim = GangSchedulerSim(
+            self.client, capacity=gang_capacity, namespace=namespace) \
+            if gang_scheduler and run_pods else None
         self._threadiness = threadiness
         self._started = False
 
@@ -43,12 +52,16 @@ class LocalCluster:
         self.job_controller.start()
         if self.kubelet is not None:
             self.kubelet.start()
+        if self.gang_sim is not None:
+            self.gang_sim.start()
         self._started = True
         return self
 
     def stop(self) -> None:
         if not self._started:
             return
+        if self.gang_sim is not None:
+            self.gang_sim.stop()
         if self.kubelet is not None:
             self.kubelet.stop()
         self.job_controller.stop()

@@ -206,7 +206,8 @@ def test_e2e_scheduling_gates_hold_pods_until_cleared():
                           command=[sys.executable, "-c", "print('ran')"])]))
         cluster.client.pods("default").create(pod)
         time.sleep(0.6)
-        assert cluster.client.pods("default").get("gated").status.phase == ""
+        assert cluster.client.pods("default").get(
+            "gated").status.phase == "Pending"
 
         stored = cluster.client.pods("default").get("gated")
         stored.spec.scheduling_gates = []
@@ -504,3 +505,87 @@ def test_e2e_gang_restart_recovers_job(tmp_path):
     # exists, and job success was gated on it (pods themselves may already
     # be reaped by cleanPodPolicy after success)
     assert os.path.exists(second_life)
+
+
+def test_e2e_unsatisfiable_gang_surfaces_workers_gated():
+    """Round-3 gang feedback loop: an unsatisfiable PodGroup (gang needs
+    3 slots, simulated cluster capacity 2) keeps every pod Pending --
+    the reference e2e contract (test/e2e/mpi_job_test.go:341-436) -- AND
+    surfaces as an MPIJob-level WorkersGated condition built from the
+    PodGroup status the gang scheduler publishes.  Raising capacity
+    binds the gang, flips the condition, and the job completes."""
+    with LocalCluster(gang_scheduler="volcano", gang_capacity=2) as cluster:
+        job = jax_job(
+            "gated",
+            launcher_cmd=[sys.executable, "-c", "print('ran')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=2)  # minMember = 3 > capacity 2
+        cluster.submit(job)
+
+        gated = cluster.wait_for_condition(
+            "default", "gated", constants.JOB_WORKERS_GATED, timeout=30)
+        cond = next(c for c in gated.status.conditions
+                    if c.type == constants.JOB_WORKERS_GATED)
+        assert cond.reason == "PodGroupPending"
+        assert "capacity is 2" in cond.message
+
+        # The gang scheduler refuses to place the gang: nothing runs.
+        for pod in cluster.client.pods("default").list():
+            assert pod.status.phase not in ("Running", "Succeeded"), \
+                pod.metadata.name
+
+        # Capacity arrives (nodes join) -> gang binds -> job completes.
+        cluster.gang_sim.set_capacity(3)
+        done = cluster.wait_for_condition("default", "gated",
+                                          constants.JOB_SUCCEEDED,
+                                          timeout=30)
+        assert done.status.completion_time is not None
+        # The gate visibly lifted.
+        gate = next(c for c in done.status.conditions
+                    if c.type == constants.JOB_WORKERS_GATED)
+        assert gate.status == "False"
+
+
+def test_e2e_gang_capacity_is_a_shared_pool():
+    """Two gangs contending for capacity 3: FIFO admission places the
+    first gang (3 slots) and holds the second until the first finishes
+    releasing its slots -- capacity is a cluster-wide pool, not a
+    per-gang threshold."""
+    import time
+    with LocalCluster(gang_scheduler="volcano", gang_capacity=3) as cluster:
+        first = jax_job(
+            "pool-a",
+            launcher_cmd=[sys.executable, "-c", "import time; time.sleep(2)"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=2,
+            run_policy={"clean_pod_policy": "All"})
+        cluster.submit(first)
+        cluster.wait_until(
+            "v1", "Pod",
+            lambda: any(p.status.phase == "Running"
+                        for p in cluster.client.pods("default").list()),
+            timeout=20, describe="first gang runs")
+
+        second = jax_job(
+            "pool-b",
+            launcher_cmd=[sys.executable, "-c", "print('b ran')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=2)
+        cluster.submit(second)
+
+        gated = cluster.wait_for_condition(
+            "default", "pool-b", constants.JOB_WORKERS_GATED, timeout=20)
+        cond = next(c for c in gated.status.conditions
+                    if c.type == constants.JOB_WORKERS_GATED)
+        assert "0 free" in cond.message
+        assert all(p.status.phase == "Pending"
+                   for p in cluster.client.pods("default").list()
+                   if p.metadata.name.startswith("pool-b"))
+
+        # First gang completes; cleanPodPolicy All releases its slots ->
+        # the second gang is admitted and completes.
+        cluster.wait_for_condition("default", "pool-a",
+                                   constants.JOB_SUCCEEDED, timeout=30)
+        done = cluster.wait_for_condition("default", "pool-b",
+                                          constants.JOB_SUCCEEDED, timeout=40)
+        assert done.status.completion_time is not None

@@ -173,3 +173,45 @@ def test_controller_creates_and_deletes_pod_group():
     import pytest
     with pytest.raises(Exception):
         f.client.volcano_pod_groups("default").get("test")
+
+
+def test_pod_group_scheduled_volcano_phases():
+    """pod_group_scheduled consumes Volcano status.phase back into the
+    control loop (round-3 gang feedback; the reference only observes
+    gating from outside in e2e, mpi_job_test.go:341-436)."""
+    cs = Clientset()
+    ctrl = VolcanoCtrl(cs)
+    pg = ctrl.new_pod_group(new_mpi_job(workers=2))
+
+    # Silence (no gang scheduler running) must not flap conditions.
+    assert ctrl.pod_group_scheduled(pg)[0] is None
+
+    pg.status = {"phase": "Pending", "conditions": [
+        {"type": "Unschedulable", "status": "True",
+         "message": "3/3 tasks unschedulable"}]}
+    scheduled, reason, message = ctrl.pod_group_scheduled(pg)
+    assert scheduled is False
+    assert reason == "PodGroupPending"
+    assert message == "3/3 tasks unschedulable"
+
+    # Inqueue is admitted-but-not-placed: still gated.
+    pg.status = {"phase": "Inqueue", "conditions": []}
+    assert ctrl.pod_group_scheduled(pg)[0] is False
+
+    pg.status = {"phase": "Running", "conditions": []}
+    scheduled, reason, _ = ctrl.pod_group_scheduled(pg)
+    assert scheduled is True
+    assert reason == "PodGroupScheduled"
+
+
+def test_pod_group_scheduled_sched_plugins_phases():
+    cs = Clientset()
+    ctrl = SchedulerPluginsCtrl(cs)
+    pg = ctrl.new_pod_group(new_mpi_job(workers=2))
+    assert ctrl.pod_group_scheduled(pg)[0] is None
+    for phase in ("Pending", "PreScheduling", "Scheduling", "Unschedulable"):
+        pg.status = {"phase": phase}
+        assert ctrl.pod_group_scheduled(pg)[0] is False, phase
+    for phase in ("Scheduled", "Running", "Finished"):
+        pg.status = {"phase": phase}
+        assert ctrl.pod_group_scheduled(pg)[0] is True, phase

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-long TPU prober: every ~8 min, fast-probe the tunneled backend
+# (90s bound). The moment it answers, capture the full TPU bench suite
+# (resnet batch ladder, llama, serving) with raw logs so the round-2
+# "builder-only numbers" complaint is answerable with reproducible
+# artifacts. Log every attempt to tools/prober_log.jsonl.
+cd /root/repo
+LOG=tools/prober_log.jsonl
+CAP=tools/tpu_captures
+mkdir -p "$CAP"
+END=$(( $(date +%s) + ${PROBER_DURATION_S:-39600} ))
+while [ "$(date +%s)" -lt "$END" ]; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  OUT=$(env -u PALLAS_AXON_POOL_IPS timeout 95 python tools_tpu_probe.py 2>/dev/null | tail -1)
+  if [ -z "$OUT" ]; then OUT='{"ok": false, "error": "probe timeout 95s"}'; fi
+  echo "{\"ts\": \"$TS\", \"probe\": $OUT}" >> "$LOG"
+  if echo "$OUT" | grep -q '"ok": true'; then
+    STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+    echo "{\"ts\": \"$TS\", \"event\": \"tpu-live; capturing\"}" >> "$LOG"
+    for B in 64 128 256; do
+      BENCH_BATCH=$B timeout 900 python bench.py --worker \
+        > "$CAP/resnet_b${B}_${STAMP}.log" 2>&1
+    done
+    timeout 1200 python bench_llama.py --worker \
+      > "$CAP/llama_${STAMP}.log" 2>&1
+    timeout 1200 python bench_serve.py --worker \
+      > "$CAP/serve_${STAMP}.log" 2>&1
+    echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"capture done ${STAMP}\"}" >> "$LOG"
+    touch tools/TPU_CAPTURED_$STAMP
+    sleep 1200
+  else
+    sleep 480
+  fi
+done
