@@ -1,0 +1,256 @@
+"""ctypes binding for the system libssh — a real SSH transport with no
+OpenSSH on the image.
+
+The reference's rank-formation path is mpirun → ssh → orted with sshd
+running in every worker (build/base/Dockerfile:3-24, sshd port 2222).
+This image ships no OpenSSH, no dropbear and no paramiko — only the
+libssh C library (libssh-gcrypt.so.4, version 0.10) — so the framework
+binds it directly: `SSHServer` below is the sshd equivalent the worker
+pods run, and `SSHClient` the ssh side the launcher's rsh agent uses.
+Both speak the genuine SSH2 wire protocol (curve25519/ECDH kex, ECDSA
+host keys, publickey auth, session channels with exec requests), so the
+operator's generated ECDSA Secret, authorized_keys projection and
+hostfile chain are exercised against a real implementation, matching
+/root/reference/test/e2e/mpi_job_test.go:87-205 in spirit.
+
+Only the stable public API is used (declared here by hand — the image
+has no libssh headers); enum values are fixed by libssh's ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from ctypes import (POINTER, byref, c_char_p, c_int, c_uint32, c_void_p,
+                    create_string_buffer)
+from typing import Optional
+
+_LIB_CANDIDATES = (
+    "libssh-gcrypt.so.4",   # debian's gcrypt/gnutls flavour (this image)
+    "libssh.so.4",
+    "libssh.so",
+)
+
+
+def _load() -> ctypes.CDLL:
+    last: Optional[Exception] = None
+    for name in _LIB_CANDIDATES:
+        try:
+            return ctypes.CDLL(name)
+        except OSError as exc:
+            last = exc
+    raise OSError(f"no libssh found (tried {_LIB_CANDIDATES}): {last}")
+
+
+lib = _load()
+
+# -- return codes -----------------------------------------------------------
+SSH_OK = 0
+SSH_ERROR = -1
+SSH_AGAIN = -2
+SSH_EOF = -127
+
+# -- auth results -----------------------------------------------------------
+SSH_AUTH_SUCCESS = 0
+SSH_AUTH_DENIED = 1
+SSH_AUTH_PARTIAL = 2
+SSH_AUTH_INFO = 3
+SSH_AUTH_AGAIN = 4
+SSH_AUTH_ERROR = -1
+
+# -- auth methods (bitmask) -------------------------------------------------
+SSH_AUTH_METHOD_NONE = 0x0001
+SSH_AUTH_METHOD_PASSWORD = 0x0002
+SSH_AUTH_METHOD_PUBLICKEY = 0x0004
+
+# -- server message types (enum ssh_requests_e) -----------------------------
+SSH_REQUEST_AUTH = 1
+SSH_REQUEST_CHANNEL_OPEN = 2
+SSH_REQUEST_CHANNEL = 3
+SSH_REQUEST_SERVICE = 4
+SSH_REQUEST_GLOBAL = 5
+
+# -- channel open subtypes (enum ssh_channel_type_e) ------------------------
+SSH_CHANNEL_SESSION = 1
+
+# -- channel request subtypes (enum ssh_channel_requests_e) -----------------
+SSH_CHANNEL_REQUEST_UNKNOWN = 0
+SSH_CHANNEL_REQUEST_PTY = 1
+SSH_CHANNEL_REQUEST_EXEC = 2
+SSH_CHANNEL_REQUEST_SHELL = 3
+SSH_CHANNEL_REQUEST_ENV = 4
+
+# -- publickey auth states (enum ssh_publickey_state_e) ---------------------
+SSH_PUBLICKEY_STATE_NONE = 0    # probe: "would this key be acceptable?"
+SSH_PUBLICKEY_STATE_VALID = 1   # signature verified
+
+# -- ssh_bind options (enum ssh_bind_options_e) -----------------------------
+SSH_BIND_OPTIONS_BINDADDR = 0
+SSH_BIND_OPTIONS_BINDPORT = 1
+SSH_BIND_OPTIONS_BINDPORT_STR = 2
+SSH_BIND_OPTIONS_HOSTKEY = 3
+SSH_BIND_OPTIONS_IMPORT_KEY = 10
+
+# -- session options (enum ssh_options_e) -----------------------------------
+SSH_OPTIONS_HOST = 0
+SSH_OPTIONS_PORT_STR = 2
+SSH_OPTIONS_USER = 4
+SSH_OPTIONS_KNOWNHOSTS = 8
+SSH_OPTIONS_TIMEOUT = 9
+SSH_OPTIONS_STRICTHOSTKEYCHECK = 21
+SSH_OPTIONS_PROCESS_CONFIG = 38
+
+# -- key comparison ---------------------------------------------------------
+SSH_KEY_CMP_PUBLIC = 0
+
+_sig = lambda fn, res, args: (setattr(fn, "restype", res),
+                              setattr(fn, "argtypes", args))
+
+# session lifecycle
+_sig(lib.ssh_init, c_int, [])
+_sig(lib.ssh_new, c_void_p, [])
+_sig(lib.ssh_free, None, [c_void_p])
+_sig(lib.ssh_connect, c_int, [c_void_p])
+_sig(lib.ssh_disconnect, None, [c_void_p])
+_sig(lib.ssh_options_set, c_int, [c_void_p, c_int, c_void_p])
+_sig(lib.ssh_get_error, c_char_p, [c_void_p])
+_sig(lib.ssh_userauth_publickey, c_int, [c_void_p, c_char_p, c_void_p])
+
+# keys
+_sig(lib.ssh_pki_import_privkey_base64, c_int,
+     [c_char_p, c_char_p, c_void_p, c_void_p, POINTER(c_void_p)])
+_sig(lib.ssh_pki_import_privkey_file, c_int,
+     [c_char_p, c_char_p, c_void_p, c_void_p, POINTER(c_void_p)])
+_sig(lib.ssh_pki_import_pubkey_base64, c_int,
+     [c_char_p, c_int, POINTER(c_void_p)])
+_sig(lib.ssh_pki_generate, c_int, [c_int, c_int, POINTER(c_void_p)])
+_sig(lib.ssh_key_type_from_name, c_int, [c_char_p])
+_sig(lib.ssh_key_cmp, c_int, [c_void_p, c_void_p, c_int])
+_sig(lib.ssh_key_free, None, [c_void_p])
+
+# server side
+_sig(lib.ssh_bind_new, c_void_p, [])
+_sig(lib.ssh_bind_free, None, [c_void_p])
+_sig(lib.ssh_bind_options_set, c_int, [c_void_p, c_int, c_void_p])
+_sig(lib.ssh_bind_listen, c_int, [c_void_p])
+_sig(lib.ssh_bind_accept, c_int, [c_void_p, c_void_p])
+_sig(lib.ssh_bind_get_fd, c_int, [c_void_p])
+_sig(lib.ssh_handle_key_exchange, c_int, [c_void_p])
+
+# server messages
+_sig(lib.ssh_message_get, c_void_p, [c_void_p])
+_sig(lib.ssh_message_free, None, [c_void_p])
+_sig(lib.ssh_message_type, c_int, [c_void_p])
+_sig(lib.ssh_message_subtype, c_int, [c_void_p])
+_sig(lib.ssh_message_auth_user, c_char_p, [c_void_p])
+_sig(lib.ssh_message_auth_pubkey, c_void_p, [c_void_p])
+_sig(lib.ssh_message_auth_publickey_state, c_int, [c_void_p])
+_sig(lib.ssh_message_auth_reply_pk_ok_simple, c_int, [c_void_p])
+_sig(lib.ssh_message_auth_reply_success, c_int, [c_void_p, c_int])
+_sig(lib.ssh_message_auth_set_methods, c_int, [c_void_p, c_int])
+_sig(lib.ssh_message_reply_default, c_int, [c_void_p])
+_sig(lib.ssh_message_channel_request_open_reply_accept, c_void_p, [c_void_p])
+_sig(lib.ssh_message_channel_request_command, c_char_p, [c_void_p])
+_sig(lib.ssh_message_channel_request_env_name, c_char_p, [c_void_p])
+_sig(lib.ssh_message_channel_request_env_value, c_char_p, [c_void_p])
+_sig(lib.ssh_message_channel_request_reply_success, c_int, [c_void_p])
+
+# channels
+_sig(lib.ssh_channel_new, c_void_p, [c_void_p])
+_sig(lib.ssh_channel_free, None, [c_void_p])
+_sig(lib.ssh_channel_open_session, c_int, [c_void_p])
+_sig(lib.ssh_channel_request_exec, c_int, [c_void_p, c_char_p])
+_sig(lib.ssh_channel_read, c_int, [c_void_p, c_void_p, c_uint32, c_int])
+_sig(lib.ssh_channel_read_timeout, c_int,
+     [c_void_p, c_void_p, c_uint32, c_int, c_int])
+_sig(lib.ssh_channel_write, c_int, [c_void_p, c_void_p, c_uint32])
+_sig(lib.ssh_channel_write_stderr, c_int, [c_void_p, c_void_p, c_uint32])
+_sig(lib.ssh_channel_send_eof, c_int, [c_void_p])
+_sig(lib.ssh_channel_is_eof, c_int, [c_void_p])
+_sig(lib.ssh_channel_is_open, c_int, [c_void_p])
+_sig(lib.ssh_channel_close, c_int, [c_void_p])
+_sig(lib.ssh_channel_get_exit_status, c_int, [c_void_p])
+_sig(lib.ssh_channel_request_send_exit_status, c_int, [c_void_p, c_int])
+
+lib.ssh_init()
+
+
+class SSHError(RuntimeError):
+    pass
+
+
+def session_error(session) -> str:
+    err = lib.ssh_get_error(session)
+    return err.decode("utf-8", "replace") if err else "unknown libssh error"
+
+
+def _opt_str(session, opt: int, value: str) -> None:
+    if lib.ssh_options_set(session, opt, value.encode()) != SSH_OK:
+        raise SSHError(f"ssh_options_set({opt}): {session_error(session)}")
+
+
+def _opt_int(session, opt: int, value: int) -> None:
+    v = c_int(value)
+    if lib.ssh_options_set(session, opt, byref(v)) != SSH_OK:
+        raise SSHError(f"ssh_options_set({opt}): {session_error(session)}")
+
+
+def _opt_long(session, opt: int, value: int) -> None:
+    # SSH_OPTIONS_TIMEOUT is read as a long* by libssh's options.c; a
+    # c_int buffer would make it read 4 bytes of adjacent garbage on
+    # LP64.
+    v = ctypes.c_long(value)
+    if lib.ssh_options_set(session, opt, byref(v)) != SSH_OK:
+        raise SSHError(f"ssh_options_set({opt}): {session_error(session)}")
+
+
+def import_privkey_pem(pem: str):
+    """ssh_key from PEM text (the operator Secret's ssh-privatekey)."""
+    key = c_void_p()
+    rc = lib.ssh_pki_import_privkey_base64(pem.encode(), None, None, None,
+                                           byref(key))
+    if rc != SSH_OK:
+        raise SSHError("cannot import private key (PEM)")
+    return key
+
+
+def import_privkey_file(path: str):
+    key = c_void_p()
+    rc = lib.ssh_pki_import_privkey_file(path.encode(), None, None, None,
+                                         byref(key))
+    if rc != SSH_OK:
+        raise SSHError(f"cannot import private key {path}")
+    return key
+
+
+def import_pubkey_line(line: str):
+    """ssh_key from an authorized_keys / .pub line
+    ("<type> <base64> [comment]")."""
+    parts = line.strip().split()
+    if len(parts) < 2:
+        raise SSHError(f"malformed public key line: {line!r}")
+    ktype = lib.ssh_key_type_from_name(parts[0].encode())
+    key = c_void_p()
+    rc = lib.ssh_pki_import_pubkey_base64(parts[1].encode(), ktype,
+                                          byref(key))
+    if rc != SSH_OK:
+        raise SSHError(f"cannot import public key ({parts[0]})")
+    return key
+
+
+def keys_equal(a, b) -> bool:
+    return lib.ssh_key_cmp(a, b, SSH_KEY_CMP_PUBLIC) == 0
+
+
+def read_authorized_keys(path: str) -> list:
+    """Parsed ssh_keys from an authorized_keys file (the Secret's
+    ssh-publickey projected as authorized_keys; reference
+    mpi_job_controller.go:142-155)."""
+    keys = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            keys.append(import_pubkey_line(line))
+    return keys

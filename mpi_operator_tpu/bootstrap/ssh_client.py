@@ -1,0 +1,167 @@
+"""ssh — a libssh-backed client with OpenSSH's CLI shape.
+
+The launcher's rsh agent default is ``ssh`` (rsh_launcher.py; reference
+mpirun uses `ssh <host> <cmd>` with OMPI_MCA_plm_rsh_args, e.g.
+`-o ConnectionAttempts=10`, mpi_job_controller.go:181-215).  The image
+has no OpenSSH binary, so this module is that agent: same positional
+grammar (``[user@]host command...``), the ``-p/-i/-l/-o/-q`` flags the
+operator's env matrices use, publickey auth with the per-job Secret's
+private key, remote stdout/stderr streamed through, and the remote exit
+status as the local exit code — the contract mpirun's rsh tree and
+rsh_launcher both assume.
+
+    python -m mpi_operator_tpu.bootstrap.ssh_client \
+        -p 2222 -i ~/.ssh/id_rsa -o ConnectionAttempts=10 host cmd...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from ctypes import create_string_buffer
+from typing import Optional
+
+from . import libssh as L
+
+
+def run(host: str, command: str, port: int = 22,
+        identity: Optional[str] = None, user: Optional[str] = None,
+        connection_attempts: int = 1, timeout_s: int = 30,
+        out=None, err=None) -> int:
+    """Execute ``command`` on ``host``; returns the remote exit status.
+    Raises SSHError when the transport itself fails."""
+    out = out or sys.stdout.buffer
+    # Hermetic runtime: cluster-DNS worker names resolve through netsim
+    # to per-pod loopback IPs (the sshd side binds the same IP via
+    # --bind-pod-ip); outside a sandbox the system resolver is used.
+    if os.environ.get("K_SANDBOX_DIR"):
+        try:
+            from ..runtime import netsim
+            host = netsim.resolve(host) or host
+        except Exception:
+            pass
+    if not identity:
+        raise L.SSHError("no identity file provided")
+    key = L.import_privkey_file(identity)  # fail before any connect
+    try:
+        last_error = "connect never attempted"
+        for attempt in range(max(1, connection_attempts)):
+            if attempt:
+                time.sleep(min(1.0 * attempt, 5.0))
+            session = L.lib.ssh_new()
+            try:
+                L._opt_str(session, L.SSH_OPTIONS_HOST, host)
+                L._opt_str(session, L.SSH_OPTIONS_PORT_STR, str(port))
+                if user:
+                    L._opt_str(session, L.SSH_OPTIONS_USER, user)
+                # StrictHostKeyChecking=no + no config files: worker host
+                # keys are ephemeral by design (see sshd.py docstring).
+                L._opt_int(session, L.SSH_OPTIONS_STRICTHOSTKEYCHECK, 0)
+                L._opt_int(session, L.SSH_OPTIONS_PROCESS_CONFIG, 0)
+                L._opt_str(session, L.SSH_OPTIONS_KNOWNHOSTS, "/dev/null")
+                L._opt_long(session, L.SSH_OPTIONS_TIMEOUT, timeout_s)
+                if L.lib.ssh_connect(session) != L.SSH_OK:
+                    last_error = L.session_error(session)
+                    continue
+                try:
+                    rc = L.lib.ssh_userauth_publickey(session, None, key)
+                    if rc != L.SSH_AUTH_SUCCESS:
+                        last_error = (f"publickey auth failed (rc={rc}): "
+                                      f"{L.session_error(session)}")
+                        continue
+                    return _exec(session, command, out, err)
+                finally:
+                    L.lib.ssh_disconnect(session)
+            finally:
+                L.lib.ssh_free(session)
+        raise L.SSHError(f"ssh {host}:{port}: {last_error}")
+    finally:
+        L.lib.ssh_key_free(key)
+
+
+def _exec(session, command: str, out, err=None) -> int:
+    err = err or sys.stderr.buffer
+    channel = L.lib.ssh_channel_new(session)
+    if not channel:
+        raise L.SSHError("cannot allocate channel")
+    try:
+        if L.lib.ssh_channel_open_session(channel) != L.SSH_OK:
+            raise L.SSHError(
+                f"channel open: {L.session_error(session)}")
+        if L.lib.ssh_channel_request_exec(channel, command.encode()) \
+                != L.SSH_OK:
+            raise L.SSHError(f"exec request: {L.session_error(session)}")
+        buf = create_string_buffer(65536)
+        # Drain BOTH streams (a standard sshd keeps stderr separate;
+        # leaving it unread would drop rank diagnostics and stall the
+        # remote on a full window).  Alternate short timed reads until
+        # both report EOF/closed.
+        def drain(is_stderr: int, sink) -> bool:
+            """One timed read; True when this stream is finished."""
+            n = L.lib.ssh_channel_read_timeout(
+                channel, buf, len(buf) - 1, is_stderr, 50)
+            if n > 0:
+                sink.write(buf.raw[:n])
+                sink.flush()
+                return False
+            if n < 0 and n != L.SSH_AGAIN:
+                return True  # error / channel closed
+            # n == 0: EOF or just the timeout with no data.
+            return bool(L.lib.ssh_channel_is_eof(channel))
+
+        done_out = done_err = False
+        while not (done_out and done_err):
+            if not done_out:
+                done_out = drain(0, out)
+            if not done_err:
+                done_err = drain(1, err)
+        L.lib.ssh_channel_send_eof(channel)
+        status = L.lib.ssh_channel_get_exit_status(channel)
+        # -1 means "no exit-status received" (connection torn down).
+        return status if status >= 0 else 255
+    finally:
+        L.lib.ssh_channel_close(channel)
+        L.lib.ssh_channel_free(channel)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ssh", add_help=False)
+    ap.add_argument("-p", "--port", type=int, default=22)
+    ap.add_argument("-i", "--identity", default=None)
+    ap.add_argument("-l", "--login", default=None)
+    ap.add_argument("-o", "--option", action="append", default=[])
+    ap.add_argument("-q", action="store_true")  # compat: quiet
+    ap.add_argument("host")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    host, user = args.host, args.login
+    if "@" in host:
+        user, host = host.split("@", 1)
+
+    attempts = 1
+    for opt in args.option:
+        k, _, v = opt.partition("=")
+        if k.strip().lower() == "connectionattempts" and v.strip().isdigit():
+            attempts = int(v)
+        # StrictHostKeyChecking / UserKnownHostsFile are accepted and
+        # already the built-in behavior; other options are ignored like
+        # unknown-but-harmless config (BatchMode etc.).
+
+    command = " ".join(args.command) if args.command else ""
+    if not command:
+        print("ssh_client: interactive shells unsupported (exec only)",
+              file=sys.stderr)
+        return 2
+    try:
+        return run(host, command, port=args.port, identity=args.identity,
+                   user=user, connection_attempts=attempts)
+    except L.SSHError as exc:
+        print(f"ssh_client: {exc}", file=sys.stderr)
+        return 255
+
+
+if __name__ == "__main__":
+    sys.exit(main())

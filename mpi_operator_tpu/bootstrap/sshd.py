@@ -1,0 +1,307 @@
+"""sshd — an exec-only SSH daemon over libssh for worker pods.
+
+The image ships no OpenSSH server, so this is the worker-side process
+behind the reference's `/usr/sbin/sshd -De` default worker command
+(mpi_job_controller.go:1529-1531; build/base/Dockerfile:3-24): it
+listens on a high port, authenticates clients by public key against the
+operator-generated authorized_keys projection of the per-job SSH
+Secret, and executes the requested command with stdout/stderr streamed
+back and the exit status propagated — everything mpirun's rsh tree
+needs from a remote shell daemon.
+
+    python -m mpi_operator_tpu.bootstrap.sshd \
+        --port 2222 --authorized-keys ~/.ssh/authorized_keys \
+        [--host-key pem] [--bind 127.0.0.1] [-D] [--ready-file f]
+
+Matches build/ssh/sshd_config semantics: pubkey-only auth (no
+passwords), no PTY, no shell — exec requests only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+from ctypes import byref, c_void_p, create_string_buffer
+from typing import Optional
+
+from . import libssh as L
+
+logger = logging.getLogger("mpi_operator_tpu.bootstrap.sshd")
+
+
+class SSHServer:
+    """Threaded exec-only SSH server.
+
+    ``authorized_keys`` — path to the authorized_keys file (re-read per
+    connection, like sshd, so Secret rotation takes effect live).
+    ``host_key_path`` — PEM private key; generated in-memory when None
+    (host identity is per-process then, which clients in this framework
+    accept: the rsh agent pins no known_hosts, exactly like the
+    reference's `StrictHostKeyChecking no` in OMPI rsh args).
+    """
+
+    def __init__(self, port: int, authorized_keys: str,
+                 host_key_path: Optional[str] = None,
+                 bind_addr: str = "127.0.0.1"):
+        self.port = port
+        self.bind_addr = bind_addr
+        self.authorized_keys = authorized_keys
+        self._host_key = (L.import_privkey_file(host_key_path)
+                          if host_key_path else self._generate_host_key())
+        self._bind = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._conn_threads: list = []
+
+    @staticmethod
+    def _generate_host_key():
+        # enum ssh_keytypes_e: ECDSA_P256 = 8 in libssh 0.10's ABI — but
+        # generate via the portable path: type ECDSA(4)+bits works across
+        # builds; fall back to P256 enum if the legacy enum is rejected.
+        key = c_void_p()
+        for ktype, bits in ((4, 256), (8, 256)):  # ECDSA legacy, P256
+            if L.lib.ssh_pki_generate(ktype, bits, byref(key)) == L.SSH_OK:
+                return key
+        raise L.SSHError("cannot generate host key")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "SSHServer":
+        self._bind = L.lib.ssh_bind_new()
+        L.lib.ssh_bind_options_set(self._bind, L.SSH_BIND_OPTIONS_BINDADDR,
+                                   self.bind_addr.encode())
+        L.lib.ssh_bind_options_set(self._bind,
+                                   L.SSH_BIND_OPTIONS_BINDPORT_STR,
+                                   str(self.port).encode())
+        rc = L.lib.ssh_bind_options_set(self._bind,
+                                        L.SSH_BIND_OPTIONS_IMPORT_KEY,
+                                        self._host_key)
+        if rc != L.SSH_OK:
+            raise L.SSHError("cannot set host key on bind")
+        if L.lib.ssh_bind_listen(self._bind) != L.SSH_OK:
+            raise L.SSHError(
+                f"listen {self.bind_addr}:{self.port}: "
+                f"{L.session_error(self._bind)}")
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="sshd-accept")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Unblock ssh_bind_accept with a throwaway connection.
+        try:
+            with socket.create_connection((self.bind_addr, self.port),
+                                          timeout=2):
+                pass
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for t in list(self._conn_threads):
+            t.join(timeout=5)
+        if self._bind is not None:
+            if self._thread is not None and self._thread.is_alive():
+                # Accept thread still inside ssh_bind_accept: freeing the
+                # bind under it would be use-after-free; leak it instead
+                # (process is exiting anyway).
+                logger.warning("accept loop did not stop; leaking bind")
+                return
+            L.lib.ssh_bind_free(self._bind)
+            self._bind = None
+
+    # -- accept + per-connection protocol ----------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            session = L.lib.ssh_new()
+            if L.lib.ssh_bind_accept(self._bind, session) != L.SSH_OK:
+                L.lib.ssh_free(session)
+                if self._stop.is_set():
+                    return
+                continue
+            if self._stop.is_set():
+                L.lib.ssh_free(session)
+                return
+            t = threading.Thread(target=self._serve_session,
+                                 args=(session,), daemon=True)
+            t.start()
+            # Prune finished connections so a long-lived daemon does not
+            # retain one Thread object per connection forever.
+            self._conn_threads = [c for c in self._conn_threads
+                                  if c.is_alive()]
+            self._conn_threads.append(t)
+
+    def _serve_session(self, session) -> None:
+        try:
+            if L.lib.ssh_handle_key_exchange(session) != L.SSH_OK:
+                logger.warning("kex failed: %s", L.session_error(session))
+                return
+            authed = self._authenticate(session)
+            if not authed:
+                return
+            self._serve_channels(session)
+        finally:
+            L.lib.ssh_disconnect(session)
+            L.lib.ssh_free(session)
+
+    def _authenticate(self, session) -> bool:
+        """Publickey-only auth against authorized_keys (two-phase probe
+        then signature, per RFC 4252 §7)."""
+        try:
+            allowed = L.read_authorized_keys(self.authorized_keys)
+        except OSError as exc:
+            logger.error("authorized_keys unreadable: %s", exc)
+            allowed = []
+        try:
+            while True:
+                msg = L.lib.ssh_message_get(session)
+                if not msg:
+                    return False  # client gave up
+                try:
+                    mtype = L.lib.ssh_message_type(msg)
+                    if mtype == L.SSH_REQUEST_AUTH and \
+                            L.lib.ssh_message_subtype(msg) == \
+                            L.SSH_AUTH_METHOD_PUBLICKEY:
+                        offered = L.lib.ssh_message_auth_pubkey(msg)
+                        state = L.lib.ssh_message_auth_publickey_state(msg)
+                        ok = offered and any(
+                            L.keys_equal(offered, k) for k in allowed)
+                        if ok and state == L.SSH_PUBLICKEY_STATE_NONE:
+                            L.lib.ssh_message_auth_reply_pk_ok_simple(msg)
+                            continue
+                        if ok and state == L.SSH_PUBLICKEY_STATE_VALID:
+                            L.lib.ssh_message_auth_reply_success(msg, 0)
+                            return True
+                    # Anything else (incl. password): publickey only.
+                    L.lib.ssh_message_auth_set_methods(
+                        msg, L.SSH_AUTH_METHOD_PUBLICKEY)
+                    L.lib.ssh_message_reply_default(msg)
+                finally:
+                    L.lib.ssh_message_free(msg)
+        finally:
+            for k in allowed:
+                L.lib.ssh_key_free(k)
+
+    def _serve_channels(self, session) -> None:
+        """One session channel, env + exec requests (sshd_config:
+        no PTY, no shell, no forwarding)."""
+        channel = None
+        env: dict = {}
+        while True:
+            msg = L.lib.ssh_message_get(session)
+            if not msg:
+                return
+            command = None
+            try:
+                mtype = L.lib.ssh_message_type(msg)
+                sub = L.lib.ssh_message_subtype(msg)
+                if mtype == L.SSH_REQUEST_CHANNEL_OPEN \
+                        and sub == L.SSH_CHANNEL_SESSION:
+                    channel = \
+                        L.lib.ssh_message_channel_request_open_reply_accept(
+                            msg)
+                elif mtype == L.SSH_REQUEST_CHANNEL and channel:
+                    if sub == L.SSH_CHANNEL_REQUEST_ENV:
+                        name = L.lib.ssh_message_channel_request_env_name(msg)
+                        val = L.lib.ssh_message_channel_request_env_value(msg)
+                        if name:
+                            env[name.decode()] = (val or b"").decode()
+                        L.lib.ssh_message_channel_request_reply_success(msg)
+                    elif sub == L.SSH_CHANNEL_REQUEST_EXEC:
+                        cmd = L.lib.ssh_message_channel_request_command(msg)
+                        L.lib.ssh_message_channel_request_reply_success(msg)
+                        command = (cmd or b"").decode()
+                    else:
+                        L.lib.ssh_message_reply_default(msg)
+                else:
+                    L.lib.ssh_message_reply_default(msg)
+            finally:
+                L.lib.ssh_message_free(msg)
+            if command is not None:
+                self._run_exec(channel, command, env)
+                return
+
+    def _run_exec(self, channel, command: str, extra_env: dict) -> None:
+        """Execute like sshd: through the shell, env merged, stdout and
+        stderr streamed over the channel, exit status sent back."""
+        logger.info("exec: %s", command)
+        env = dict(os.environ)
+        env.update(extra_env)
+        proc = subprocess.Popen(
+            ["/bin/sh", "-c", command], env=env,
+            stdin=subprocess.DEVNULL,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+        # libssh sessions are not thread-safe: the two pumps must never
+        # be inside ssh_channel_write concurrently (cipher/sequence
+        # state would race) — one lock serializes them.
+        write_lock = threading.Lock()
+
+        def pump(stream, is_stderr: int):
+            for chunk in iter(lambda: stream.read(4096), b""):
+                with write_lock:
+                    if is_stderr:
+                        L.lib.ssh_channel_write_stderr(channel, chunk,
+                                                       len(chunk))
+                    else:
+                        L.lib.ssh_channel_write(channel, chunk, len(chunk))
+
+        threads = [threading.Thread(target=pump, args=(proc.stdout, 0)),
+                   threading.Thread(target=pump, args=(proc.stderr, 1))]
+        for t in threads:
+            t.start()
+        rc = proc.wait()
+        for t in threads:
+            t.join()
+        L.lib.ssh_channel_request_send_exit_status(channel, rc)
+        L.lib.ssh_channel_send_eof(channel)
+        L.lib.ssh_channel_close(channel)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="sshd", description=__doc__)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--bind", default="127.0.0.1")
+    ap.add_argument("--authorized-keys", required=True)
+    ap.add_argument("--host-key", default=None,
+                    help="PEM host key (generated when omitted)")
+    ap.add_argument("-D", "--foreground", action="store_true",
+                    help="compat flag (always foreground)")
+    ap.add_argument("-e", "--log-stderr", action="store_true",
+                    help="compat flag (always logs to stderr)")
+    ap.add_argument("--ready-file", default=None,
+                    help="touched once listening (test synchronization)")
+    ap.add_argument("--bind-pod-ip", action="store_true",
+                    help="bind this pod's netsim per-pod IP (hermetic"
+                         " runtime: K_POD_NAMESPACE/K_POD_NAME)")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="sshd[%(process)d]: %(message)s")
+
+    bind = args.bind
+    if args.bind_pod_ip:
+        from ..runtime import netsim
+        bind = netsim.pod_ip(os.environ["K_POD_NAMESPACE"],
+                             os.environ["K_POD_NAME"])
+    server = SSHServer(args.port, args.authorized_keys,
+                       host_key_path=args.host_key, bind_addr=bind)
+    server.start()
+    logger.info("listening on %s:%d", bind, args.port)
+    if args.ready_file:
+        with open(args.ready_file, "w", encoding="utf-8") as fh:
+            fh.write(str(args.port))
+    try:
+        threading.Event().wait()  # -De: serve until killed
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
