@@ -589,3 +589,68 @@ def test_e2e_gang_capacity_is_a_shared_pool():
         done = cluster.wait_for_condition("default", "pool-b",
                                           constants.JOB_SUCCEEDED, timeout=40)
         assert done.status.completion_time is not None
+
+
+def test_e2e_sched_plugins_gang_feedback():
+    """The scheduler-plugins flavor of the gang loop: Unschedulable
+    phase grammar -> WorkersGated, then Scheduled -> completion (the
+    Volcano flavor is covered above; both phase grammars must drive the
+    same condition, podgroup.py pod_group_scheduled)."""
+    with LocalCluster(gang_scheduler="coscheduler",
+                      gang_capacity=1) as cluster:
+        job = jax_job(
+            "spg",
+            launcher_cmd=[sys.executable, "-c", "print('ran')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=2)
+        cluster.submit(job)
+        gated = cluster.wait_for_condition(
+            "default", "spg", constants.JOB_WORKERS_GATED, timeout=30)
+        cond = next(c for c in gated.status.conditions
+                    if c.type == constants.JOB_WORKERS_GATED)
+        assert cond.reason == "PodGroupPending"
+        pg = cluster.client.sched_plugins_pod_groups("default").get("spg")
+        assert pg.status["phase"] == "Unschedulable"
+
+        cluster.gang_sim.set_capacity(4)
+        done = cluster.wait_for_condition("default", "spg",
+                                          constants.JOB_SUCCEEDED,
+                                          timeout=40)
+        pg = cluster.client.sched_plugins_pod_groups("default").get("spg")
+        assert pg.status["phase"] in ("Scheduled", "Running", "Finished")
+        assert done.status.completion_time is not None
+
+
+def test_e2e_suspend_while_gated_tears_down_cleanly():
+    """Kueue preemption story meets gang scheduling: suspending a job
+    whose gang never got placed must delete the PodGroup and the
+    Pending pods and mark the job Suspended (no stuck gates)."""
+    with LocalCluster(gang_scheduler="volcano", gang_capacity=1) as cluster:
+        job = jax_job(
+            "sgate",
+            launcher_cmd=[sys.executable, "-c", "print('ran')"],
+            worker_cmd=[sys.executable, "-c", "import time; time.sleep(30)"],
+            workers=2)
+        cluster.submit(job)
+        cluster.wait_for_condition("default", "sgate",
+                                   constants.JOB_WORKERS_GATED, timeout=30)
+
+        stored = cluster.client.mpi_jobs("default").get("sgate")
+        stored.spec.run_policy.suspend = True
+        cluster.client.mpi_jobs("default").update(stored)
+
+        suspended = cluster.wait_for_condition(
+            "default", "sgate", constants.JOB_SUSPENDED, timeout=30)
+        assert suspended is not None
+
+        def gone():
+            try:
+                cluster.client.volcano_pod_groups("default").get("sgate")
+                return False
+            except Exception:
+                pass
+            return not [
+                p for p in cluster.client.pods("default").list()
+                if p.metadata.name.startswith("sgate-worker")]
+        cluster.wait_until("v1", "Pod", gone, timeout=20,
+                           describe="PodGroup and worker pods deleted")

@@ -448,3 +448,62 @@ def test_watch_timeout_seconds_ends_stream_cleanly(fixture_server):
         body = resp.read()
     assert time.monotonic() - t0 < 5
     assert body == b""
+
+
+def test_gang_feedback_over_kube_transport(fixture_server):
+    """Integration tier (envtest style): the controller with a Volcano
+    PodGroupCtrl rides the kube wire format; the test plays the gang
+    scheduler, patching PodGroup status by hand — the controller must
+    surface WorkersGated=True, then clear it when the gang schedules.
+    Parity: the reference's integration tests drive state machines by
+    manually patching objects (test/integration/main_test.go)."""
+    import sys
+    import time
+
+    client = Clientset(server=KubeApiServer(fixture_server.client_config()))
+    from mpi_operator_tpu.server import LocalCluster
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_e2e_local import jax_job
+
+    # run_pods=False: envtest shape — no kubelet, no gang sim; THIS TEST
+    # is the scheduler.
+    with LocalCluster(client=client, gang_scheduler="volcano",
+                      run_pods=False) as cluster:
+        job = jax_job(
+            "kgang",
+            launcher_cmd=[sys.executable, "-c", "print('x')"],
+            worker_cmd=[sys.executable, "-c", "print('x')"],
+            workers=2)
+        cluster.submit(job)
+
+        def get_pg():
+            try:
+                return cluster.client.volcano_pod_groups("default").get(
+                    "kgang")
+            except Exception:
+                return None
+        deadline = time.monotonic() + 20
+        while get_pg() is None:
+            assert time.monotonic() < deadline, "PodGroup never created"
+            time.sleep(0.1)
+
+        pg = get_pg()
+        pg.status = {"phase": "Pending", "conditions": [
+            {"type": "Unschedulable", "status": "True",
+             "message": "2/3 tasks in gang unschedulable"}]}
+        cluster.client.volcano_pod_groups("default").update_status(pg)
+
+        gated = cluster.wait_for_condition(
+            "default", "kgang", constants.JOB_WORKERS_GATED, timeout=30)
+        cond = next(c for c in gated.status.conditions
+                    if c.type == constants.JOB_WORKERS_GATED)
+        assert "unschedulable" in cond.message
+
+        pg = get_pg()
+        pg.status = {"phase": "Running", "conditions": []}
+        cluster.client.volcano_pod_groups("default").update_status(pg)
+
+        cleared = cluster.wait_for_condition(
+            "default", "kgang", constants.JOB_WORKERS_GATED,
+            status="False", timeout=30)
+        assert cleared is not None

@@ -1,0 +1,393 @@
+#!/usr/bin/env python
+"""One-process TPU capture: run the full measurement ladder while the
+tunneled backend is up, flushing each result the moment it exists.
+
+The round-2/3 outage mode is a tunnel that appears for short windows.
+The prober's per-benchmark subprocesses (bench.py x3 batches, then
+bench_llama, then bench_serve) pay backend init + model compile per
+process — an hour-long chain that a short window never finishes.  This
+script does everything in ONE process against one live backend:
+
+  resnet_b64 / _b64_donate / _b128 / _b256  — headline + MFU ladder,
+      each record carrying roofline data (cost_analysis flops + bytes
+      accessed -> arithmetic intensity vs the machine knee)
+  llama_train                                — tokens/sec + MFU
+  serve                                      — continuous-batching
+      decode tokens/sec + prefix-cache TTFT cold/warm
+  kernel_ab                                  — pallas flash fwd/bwd vs XLA
+
+Each phase appends one JSON line to --out (and stdout) immediately, so
+a tunnel death mid-run keeps everything already measured.  Phases are
+wall-clock-budgeted; a phase that cannot fit in the remaining budget is
+skipped with a record saying so.
+
+Usage (the prober invokes this when a probe succeeds):
+    python tools/tpu_capture.py --out tools/tpu_captures/cap_<ts>.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import (BASELINE_IMAGES_PER_SEC_PER_DEVICE,  # noqa: E402
+                   PEAK_TFLOPS)
+
+HBM_GBPS = {"v4": 1228.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0}
+
+# CAPTURE_SMOKE=1 shrinks every phase to seconds (CPU code-path check:
+# a latent bug here would waste a real TPU window).
+SMOKE = os.environ.get("CAPTURE_SMOKE") == "1"
+
+
+class Capture:
+    def __init__(self, out_path: str, budget_s: float):
+        self.out_path = out_path
+        self.deadline = time.monotonic() + budget_s
+        self.fh = open(out_path, "a", encoding="utf-8")
+
+    def remaining(self) -> float:
+        return self.deadline - time.monotonic()
+
+    def emit(self, rec: dict) -> None:
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               **rec}
+        line = json.dumps(rec)
+        self.fh.write(line + "\n")
+        self.fh.flush()
+        os.fsync(self.fh.fileno())
+        print(line, flush=True)
+
+    def phase(self, name: str, need_s: float, fn) -> None:
+        if SMOKE:
+            need_s = 0.0
+        if self.remaining() < need_s:
+            self.emit({"phase": name, "skipped":
+                       f"needs ~{need_s:.0f}s, {self.remaining():.0f}s left"})
+            return
+        t0 = time.monotonic()
+        try:
+            rec = fn()
+            rec = dict(rec or {})
+            rec["phase"] = name
+            rec["phase_wall_s"] = round(time.monotonic() - t0, 1)
+            self.emit(rec)
+        except Exception as exc:  # keep capturing later phases
+            self.emit({"phase": name, "error": f"{type(exc).__name__}: {exc}",
+                       "trace": traceback.format_exc()[-2000:]})
+
+
+def peak_tflops() -> float:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return float(os.environ.get("BENCH_PEAK_TFLOPS",
+                                PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])))
+
+
+def hbm_gbps() -> float:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return HBM_GBPS.get(gen, HBM_GBPS["v5e"])
+
+
+# ---------------------------------------------------------------------------
+# ResNet-101 ladder
+# ---------------------------------------------------------------------------
+
+class ResNetBench:
+    """Holds params across batch sizes so only the step recompiles."""
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from mpi_operator_tpu.models.resnet import (ResNet,
+                                                    cross_entropy_loss,
+                                                    resnet101_config)
+        self.jax, self.jnp, self.optax = jax, jnp, optax
+        self.model = ResNet(resnet101_config())
+        rng = jax.random.PRNGKey(0)
+        probe = jax.random.normal(rng, (2, 224, 224, 3), jnp.bfloat16)
+        variables = self.model.init(jax.random.PRNGKey(1), probe,
+                                    train=False)
+        self.params = variables["params"]
+        self.batch_stats = variables["batch_stats"]
+        self.tx = optax.sgd(0.01, momentum=0.9)
+        self.loss_fn = cross_entropy_loss
+
+    def run(self, batch: int, donate: bool, warmup=3, steps=10) -> dict:
+        jax, jnp, optax = self.jax, self.jnp, self.optax
+        if SMOKE:
+            batch, warmup, steps = 2, 1, 2
+        rng = jax.random.PRNGKey(2)
+        side = 64 if SMOKE else 224
+        images = jax.random.normal(rng, (batch, side, side, 3), jnp.bfloat16)
+        labels = jax.random.randint(rng, (batch,), 0, 1000)
+        params = jax.tree_util.tree_map(lambda x: x.copy(), self.params)
+        batch_stats = jax.tree_util.tree_map(lambda x: x.copy(),
+                                             self.batch_stats)
+        opt_state = self.tx.init(params)
+        model, tx, loss = self.model, self.tx, self.loss_fn
+
+        def train_step(params, batch_stats, opt_state, images, labels):
+            def f(p):
+                logits, updates = model.apply(
+                    {"params": p, "batch_stats": batch_stats}, images,
+                    train=True, mutable=["batch_stats"])
+                return loss(logits, labels), updates["batch_stats"]
+            (l, new_stats), grads = jax.value_and_grad(f, has_aux=True)(
+                params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_stats, \
+                new_opt, l
+
+        donate_argnums = (0, 1, 2) if donate else ()
+        compiled = jax.jit(train_step, donate_argnums=donate_argnums).lower(
+            params, batch_stats, opt_state, images, labels).compile()
+
+        flops, bytes_accessed = None, None
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = float((cost or {}).get("flops") or 0) or None
+            bytes_accessed = \
+                float((cost or {}).get("bytes accessed") or 0) or None
+        except Exception:
+            pass
+        if flops is None:
+            flops = 3.0 * 7.8e9 * batch
+
+        for _ in range(warmup):
+            params, batch_stats, opt_state, l = compiled(
+                params, batch_stats, opt_state, images, labels)
+        float(l)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, batch_stats, opt_state, l = compiled(
+                params, batch_stats, opt_state, images, labels)
+        float(l)
+        dt = time.perf_counter() - t0
+
+        img_s = batch * steps / dt
+        mfu = (flops * steps / dt) / (peak_tflops() * 1e12)
+        rec = {"metric": "resnet101_train_images_per_sec_per_chip",
+               "value": round(img_s, 2), "batch": batch, "donate": donate,
+               "mfu": round(mfu, 4), "steps": steps,
+               "vs_baseline": round(
+                   img_s / BASELINE_IMAGES_PER_SEC_PER_DEVICE, 3),
+               "flops_per_step": flops}
+        if bytes_accessed:
+            # Roofline: arithmetic intensity vs the machine knee.
+            rec["bytes_accessed_per_step"] = bytes_accessed
+            rec["arithmetic_intensity"] = round(flops / bytes_accessed, 1)
+            rec["machine_knee_intensity"] = round(
+                peak_tflops() * 1e12 / (hbm_gbps() * 1e9), 1)
+            rec["hbm_bound_mfu_ceiling"] = round(
+                min(1.0, (flops / bytes_accessed)
+                    / (peak_tflops() * 1e12 / (hbm_gbps() * 1e9))), 3)
+        return rec
+
+
+def llama_bench() -> dict:
+    import jax
+    import optax
+    from mpi_operator_tpu.models.llama import (LlamaConfig, LlamaModel,
+                                               next_token_loss)
+    from mpi_operator_tpu.parallel.train import build_train_step
+    from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    seq, batch = (128, 2) if SMOKE else (2048, 4)
+    cfg = LlamaConfig(vocab_size=32000, dim=128 if SMOKE else 2048,
+                      n_layers=2 if SMOKE else 16,
+                      n_heads=2 if SMOKE else 16, max_seq_len=seq)
+    model = LlamaModel(cfg)
+    mesh = create_mesh(MeshConfig(dp=1), devices=jax.local_devices()[:1])
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens[:1, :8])
+
+    def loss_fn(p, t):
+        return next_token_loss(model.apply(p, t), t)
+
+    init_fn, step_fn = build_train_step(loss_fn, optax.adamw(3e-4), mesh,
+                                        donate=False, remat=True)
+    state = init_fn(params)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    flops_per_tok = 6.0 * n_params + 6.0 * cfg.n_layers * cfg.dim * seq
+
+    state, m = step_fn(state, tokens)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    steps = 2 if SMOKE else 5
+    for _ in range(steps):
+        state, m = step_fn(state, tokens)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq * steps / dt
+    mfu = flops_per_tok * tok_s / (peak_tflops() * 1e12)
+    return {"metric": "llama1b_train_tokens_per_sec_per_chip",
+            "value": round(tok_s, 1), "mfu": round(mfu, 4),
+            "n_params": int(n_params), "batch": batch, "seq": seq,
+            "loss": round(float(m["loss"]), 4)}
+
+
+def serve_bench() -> dict:
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    dim, n_layers, seq = (128, 2, 256) if SMOKE else (2048, 16, 2048)
+    slots, page = 4 if SMOKE else 8, 16
+    new_tokens, prompt_len = (8, 32) if SMOKE else (64, 128)
+    cfg = LlamaConfig(vocab_size=32000, dim=dim, n_layers=n_layers,
+                      n_heads=max(1, dim // 128),
+                      n_kv_heads=max(1, dim // 512), max_seq_len=seq)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=slots,
+                                page_size=page).start()
+    try:
+        rng = np.random.default_rng(0)
+        prompts = [list(map(int, rng.integers(1, cfg.vocab_size,
+                                              prompt_len)))
+                   for _ in range(2 * slots)]
+        warmup = list(map(int, rng.integers(1, cfg.vocab_size, prompt_len)))
+        batcher.submit(warmup, 2, timeout=900)
+        batcher.submit(warmup, 2, timeout=900)  # suffix-bucket compile
+
+        results = [None] * len(prompts)
+
+        def run(i):
+            results[i] = batcher.submit(prompts[i], new_tokens, timeout=900)
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert all(r is not None and len(r) == new_tokens for r in results)
+
+        ttft = list(map(int, rng.integers(1, cfg.vocab_size, prompt_len)))
+        t0 = time.perf_counter()
+        batcher.submit(ttft, 1, timeout=900)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        batcher.submit(ttft, 1, timeout=900)
+        warm = time.perf_counter() - t0
+        return {"metric": "serve_decode_tokens_per_sec",
+                "value": round(len(prompts) * new_tokens / dt, 1),
+                "slots": slots, "prompt_len": prompt_len,
+                "new_tokens": new_tokens, "page_size": page,
+                "ttft_cold_s": round(cold, 4), "ttft_warm_s": round(warm, 4),
+                "prefix_hit_blocks": batcher.prefix_stats["hit_blocks"]}
+    finally:
+        batcher.stop()
+
+
+def kernel_ab() -> dict:
+    """Pallas flash attention vs XLA attention, fwd + bwd wall time."""
+    import jax
+    import jax.numpy as jnp
+    from mpi_operator_tpu.ops.attention import _xla_attention, \
+        flash_attention
+
+    B, H, S, D = (1, 2, 256, 64) if SMOKE else (4, 8, 2048, 128)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.bfloat16)
+
+    def time_fn(fn, *args, iters=20):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    flash = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=SMOKE))
+    ref = jax.jit(lambda q, k, v: _xla_attention(
+        q, k, v, scale=q.shape[-1] ** -0.5, causal=True)[0])
+    t_flash = time_fn(flash, q, k, v)
+    t_ref = time_fn(ref, q, k, v)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True,
+                               interpret=SMOKE).astype(jnp.float32).sum()
+
+    def loss_ref(q, k, v):
+        return _xla_attention(q, k, v, scale=q.shape[-1] ** -0.5,
+                              causal=True)[0].astype(jnp.float32).sum()
+
+    gflash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    gref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))
+    t_gflash = time_fn(gflash, q, k, v, iters=10)
+    t_gref = time_fn(gref, q, k, v, iters=10)
+
+    return {"metric": "pallas_flash_attention_vs_xla",
+            "config": f"B={B} H={H} S={S} D={D} bf16 causal",
+            "fwd_flash_ms": round(t_flash * 1e3, 3),
+            "fwd_xla_ms": round(t_ref * 1e3, 3),
+            "fwd_speedup": round(t_ref / t_flash, 3),
+            "bwd_flash_ms": round(t_gflash * 1e3, 3),
+            "bwd_xla_ms": round(t_gref * 1e3, 3),
+            "bwd_speedup": round(t_gref / t_gflash, 3)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--budget", type=float, default=3000.0,
+                    help="total wall-clock budget (s)")
+    args = ap.parse_args()
+
+    cap = Capture(args.out, args.budget)
+    import jax
+    platform = jax.devices()[0].platform
+    cap.emit({"phase": "init", "platform": platform,
+              "n_devices": jax.local_device_count(),
+              "peak_tflops": peak_tflops()})
+    if platform == "cpu" and not SMOKE:
+        cap.emit({"phase": "abort", "error": "cpu backend; nothing to "
+                  "capture (probe raced a tunnel flap)"})
+        return 1
+
+    rb_holder = {}
+
+    def resnet_phase(batch, donate):
+        def fn():
+            if "rb" not in rb_holder:
+                rb_holder["rb"] = ResNetBench()
+            return rb_holder["rb"].run(batch, donate)
+        return fn
+
+    # Headline first; the ladder + donation A/B after; llama + kernels
+    # last (separate models — most expensive to set up).
+    cap.phase("resnet_b64", 600, resnet_phase(64, donate=False))
+    cap.phase("resnet_b64_donate", 300, resnet_phase(64, donate=True))
+    cap.phase("resnet_b128", 300, resnet_phase(128, donate=False))
+    cap.phase("resnet_b256", 400, resnet_phase(256, donate=False))
+    cap.phase("llama_train", 600, llama_bench)
+    cap.phase("serve", 500, serve_bench)
+    cap.phase("kernel_ab", 400, kernel_ab)
+    cap.emit({"phase": "done", "remaining_s": round(cap.remaining(), 1)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
