@@ -276,7 +276,13 @@ class InferenceServer:
     def stop(self) -> None:
         if self._batcher is not None:
             self._batcher.stop()
-        self._http.shutdown()
+        if self._thread is not None:
+            # shutdown() blocks on serve_forever's shut-down event; it
+            # would wait forever on a server that was never start()ed
+            # (library use: generate() without the HTTP endpoint).
+            self._http.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
         self._http.server_close()
 
     @property

@@ -952,3 +952,20 @@ def test_single_slice_contract_has_no_megascale_env():
         pod = builders.new_worker(job, index, cluster_domain="cluster.local")
         names = {e.name for e in pod.spec.containers[0].env}
         assert not any(n.startswith("MEGASCALE_") for n in names)
+
+
+def test_host_network_sets_dns_policy():
+    """hostNetwork pods need ClusterFirstWithHostNet or cluster DNS
+    breaks (reference e2e 'hostNetwork' variant,
+    mpi_job_test.go:132-160; builders :1512-1525 parity)."""
+    job = new_mpi_job(workers=1, impl=constants.IMPL_OPENMPI)
+    job.worker_spec.template.spec.host_network = True
+    job.launcher_spec.template.spec.host_network = True
+    worker = builders.new_worker(job, 0)
+    assert worker.spec.dns_policy == "ClusterFirstWithHostNet"
+    launcher = builders.new_launcher_pod_template(job)
+    assert launcher.spec.dns_policy == "ClusterFirstWithHostNet"
+    # non-hostNetwork pods keep the default policy
+    job2 = new_mpi_job(workers=1, impl=constants.IMPL_OPENMPI)
+    assert builders.new_worker(job2, 0).spec.dns_policy != \
+        "ClusterFirstWithHostNet"
