@@ -83,7 +83,8 @@ def initialize_from_env(timeout_seconds: float = 120.0) -> Optional[ProcessEnv]:
 
     deadline = time.monotonic() + timeout_seconds
     last_err: Optional[Exception] = None
-    while time.monotonic() < deadline:
+    delay = 0.1  # quick first retries (the coordinator is usually a
+    while time.monotonic() < deadline:  # fraction of a second behind)
         try:
             jax.distributed.initialize(
                 coordinator_address=env.coordinator_address,
@@ -92,7 +93,8 @@ def initialize_from_env(timeout_seconds: float = 120.0) -> Optional[ProcessEnv]:
             return env
         except Exception as exc:  # coordinator not up yet
             last_err = exc
-            time.sleep(1.0)
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
     raise TimeoutError(
         f"jax.distributed.initialize did not connect to "
         f"{env.coordinator_address} within {timeout_seconds}s: {last_err}")
