@@ -417,9 +417,42 @@ def kustomization() -> dict:
                           "cluster-role-binding.yaml", "deployment.yaml"]}
 
 
+def _overlay(namespace_: str, images=None) -> dict:
+    """One kustomize overlay (reference manifests/overlays/{standalone,
+    kubeflow,dev} parity: rebase onto ../../base, pin the namespace,
+    stamp common labels, and patch the leader-election lock namespace
+    into the Deployment args)."""
+    overlay = {
+        "apiVersion": "kustomize.config.k8s.io/v1beta1",
+        "kind": "Kustomization",
+        "resources": ["../../base"],
+        "namespace": namespace_,
+        "labels": [{
+            "includeSelectors": False,
+            "pairs": {"app": "mpi-operator",
+                      "app.kubernetes.io/component": "mpijob",
+                      "app.kubernetes.io/name": "mpi-operator",
+                      "kustomize.component": "mpi-operator"}}],
+        "patches": [{
+            "path": "./patch.yaml",
+            "target": {"group": "apps", "version": "v1",
+                       "kind": "Deployment", "name": "mpi-operator"}}],
+    }
+    if images:
+        overlay["images"] = images
+    return overlay
+
+
+def _overlay_patch(lock_namespace: str) -> list:
+    return [{"op": "add",
+             "path": "/spec/template/spec/containers/0/args/-",
+             "value": f"--lock-namespace={lock_namespace}"}]
+
+
 def generate_manifests(repo_root: str) -> list:
-    """Write manifests/base/* and deploy/v2beta1/mpi-operator.yaml;
-    returns the list of written paths."""
+    """Write manifests/base/*, manifests/overlays/* and
+    deploy/v2beta1/mpi-operator.yaml; returns the list of written
+    paths."""
     import yaml
 
     base = os.path.join(repo_root, "manifests", "base")
@@ -442,6 +475,30 @@ def generate_manifests(repo_root: str) -> list:
         with open(path, "w") as f:
             yaml.safe_dump(obj, f, sort_keys=False)
         written.append(path)
+
+    # Overlays (reference manifests/overlays parity): standalone pins
+    # everything into mpi-operator; kubeflow joins an existing kubeflow
+    # namespace; dev is the image-override template the e2e build uses.
+    overlays = {
+        "standalone": (_overlay("mpi-operator"),
+                       _overlay_patch("mpi-operator"), "kustomization.yaml"),
+        "kubeflow": (_overlay("kubeflow"),
+                     _overlay_patch("kubeflow"), "kustomization.yaml"),
+        "dev": (_overlay("mpi-operator", images=[
+                    {"name": "mpioperator/mpi-operator-tpu",
+                     "newName": "%IMAGE_NAME%", "newTag": "%IMAGE_TAG%"}]),
+                _overlay_patch("mpi-operator"),
+                "kustomization.yaml.template"),
+    }
+    for name, (kustomization_obj, patch, kfile) in overlays.items():
+        odir = os.path.join(repo_root, "manifests", "overlays", name)
+        os.makedirs(odir, exist_ok=True)
+        for fname, obj in ((kfile, kustomization_obj),
+                           ("patch.yaml", patch)):
+            path = os.path.join(odir, fname)
+            with open(path, "w") as f:
+                yaml.safe_dump(obj, f, sort_keys=False)
+            written.append(path)
 
     # All-in-one (deploy/v2beta1/mpi-operator.yaml parity).
     all_in_one = [files["namespace.yaml"], files["kubeflow.org_mpijobs.yaml"],

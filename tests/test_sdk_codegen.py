@@ -114,6 +114,12 @@ def test_generated_manifests_have_no_drift(tmp_path):
     for rel in ["manifests/base/kubeflow.org_mpijobs.yaml",
                 "manifests/base/deployment.yaml",
                 "manifests/base/cluster-role.yaml",
+                "manifests/overlays/standalone/kustomization.yaml",
+                "manifests/overlays/standalone/patch.yaml",
+                "manifests/overlays/kubeflow/kustomization.yaml",
+                "manifests/overlays/kubeflow/patch.yaml",
+                "manifests/overlays/dev/kustomization.yaml.template",
+                "manifests/overlays/dev/patch.yaml",
                 "deploy/v2beta1/mpi-operator.yaml"]:
         with open(os.path.join(REPO_ROOT, rel)) as f:
             checked_in = f.read()
@@ -265,3 +271,24 @@ def test_strict_schema_rejects_misspelled_node_affinity_key():
     errors = validate_mpijob_dict(doc)
     assert any("requiredDuringSchedulingIgnoreDuringExecution" in e
                for e in errors), errors
+
+
+def test_overlays_generated_and_shaped():
+    """Kustomize overlays parity (reference manifests/overlays/
+    {standalone,kubeflow,dev}): rebase onto ../../base, pin namespace,
+    patch the leader-election lock namespace."""
+    import yaml
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name, ns in (("standalone", "mpi-operator"),
+                     ("kubeflow", "kubeflow")):
+        k = yaml.safe_load(open(os.path.join(
+            root, "manifests", "overlays", name, "kustomization.yaml")))
+        assert k["resources"] == ["../../base"]
+        assert k["namespace"] == ns
+        patch = yaml.safe_load(open(os.path.join(
+            root, "manifests", "overlays", name, "patch.yaml")))
+        assert patch[0]["value"] == f"--lock-namespace={ns}"
+    dev = yaml.safe_load(open(os.path.join(
+        root, "manifests", "overlays", "dev",
+        "kustomization.yaml.template")))
+    assert dev["images"][0]["newName"] == "%IMAGE_NAME%"
