@@ -19,7 +19,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-from .meta import Clock, deep_copy
+from .meta import Clock, deep_copy, get_controller_of
 from .selectors import match_labels
 
 ADDED = "ADDED"
@@ -186,7 +186,26 @@ class ApiServer:
                 obj.status.phase = "Pending"
             bucket[key] = obj
             self._notify(gvk, ADDED, obj)
-            return deep_copy(obj)
+            # The response reflects the object AS CREATED — the reap
+            # below must not leak its delete-bumped RV into the return.
+            created = deep_copy(obj)
+            # Dangling controller ownerReference: a stale-lister client
+            # can recreate children AFTER their owner was deleted (and
+            # already cascaded).  Real kube's garbage collector reaps
+            # such orphans shortly after; mirror that here, eagerly —
+            # otherwise they leak forever in a store whose GC only runs
+            # at owner-delete time.
+            ctrl_ref = get_controller_of(obj)
+            if ctrl_ref is not None and not self._uid_exists(ctrl_ref.uid):
+                dead = bucket.pop(key)
+                dead.metadata.resource_version = self._next_rv()
+                self._notify(gvk, DELETED, dead)
+                self._cascade_delete(dead)
+            return created
+
+    def _uid_exists(self, uid: str) -> bool:
+        return any(o.metadata.uid == uid
+                   for b in self._store.values() for o in b.values())
 
     def get(self, api_version: str, kind: str, namespace: str, name: str):
         with self._lock:

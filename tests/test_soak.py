@@ -1,0 +1,130 @@
+"""Churn soak: a create/suspend/resume/delete storm over the live
+cluster, asserting the system converges and leaks nothing.
+
+The reference gets its concurrency confidence from the informer/
+workqueue architecture plus targeted regression tests (SURVEY.md §5
+race detection); this tier hammers the whole stack — controller, batch
+Job controller, kubelet subprocess pods, netsim address pool — and then
+checks invariants a leak would break: no orphaned pods or runners, no
+leftover launcher Jobs, an idle workqueue, and thread count back near
+baseline.
+"""
+
+import os
+import sys
+import threading
+import time
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.server import LocalCluster
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_e2e_local import jax_job  # noqa: E402
+
+
+def test_churn_soak_converges_and_leaks_nothing():
+    n_jobs = int(os.environ.get("SOAK_JOBS", "12"))
+    with LocalCluster(threadiness=4) as cluster:
+        baseline_threads = threading.active_count()
+
+        # Wave 1: quick jobs that complete on their own.
+        for i in range(0, n_jobs, 3):
+            cluster.submit(jax_job(
+                f"soak-{i}",
+                launcher_cmd=[sys.executable, "-c", "print('ok')"],
+                worker_cmd=[sys.executable, "-c",
+                            "import time; time.sleep(45)"],
+                workers=1,
+                run_policy={"clean_pod_policy": "All"}))
+        # Wave 2: jobs that get suspended mid-flight, resumed, then
+        # completed.
+        for i in range(1, n_jobs, 3):
+            cluster.submit(jax_job(
+                f"soak-{i}",
+                launcher_cmd=[sys.executable, "-c",
+                              "import time; time.sleep(1); print('ok')"],
+                worker_cmd=[sys.executable, "-c",
+                            "import time; time.sleep(45)"],
+                workers=2,
+                run_policy={"clean_pod_policy": "Running"}))
+        # Wave 3: jobs deleted outright while running.
+        for i in range(2, n_jobs, 3):
+            cluster.submit(jax_job(
+                f"soak-{i}",
+                launcher_cmd=[sys.executable, "-c",
+                              "import time; time.sleep(30)"],
+                worker_cmd=[sys.executable, "-c",
+                            "import time; time.sleep(45)"],
+                workers=1))
+
+        time.sleep(1.0)
+        # Suspend wave 2...
+        for i in range(1, n_jobs, 3):
+            stored = cluster.client.mpi_jobs("default").get(f"soak-{i}")
+            stored.spec.run_policy.suspend = True
+            cluster.client.mpi_jobs("default").update(stored)
+        # ...delete wave 3.
+        for i in range(2, n_jobs, 3):
+            cluster.client.mpi_jobs("default").delete(f"soak-{i}")
+        time.sleep(1.0)
+        # Resume wave 2.
+        for i in range(1, n_jobs, 3):
+            stored = cluster.client.mpi_jobs("default").get(f"soak-{i}")
+            stored.spec.run_policy.suspend = False
+            cluster.client.mpi_jobs("default").update(stored)
+
+        # Waves 1 and 2 all reach Succeeded.
+        for i in range(0, n_jobs, 3):
+            cluster.wait_for_condition("default", f"soak-{i}",
+                                       constants.JOB_SUCCEEDED, timeout=60)
+        for i in range(1, n_jobs, 3):
+            cluster.wait_for_condition("default", f"soak-{i}",
+                                       constants.JOB_SUCCEEDED, timeout=60)
+
+        # Deleted jobs are GONE: no MPIJob, no owned objects (GC).
+        def wave3_gone():
+            jobs = {j.metadata.name for j in
+                    cluster.client.mpi_jobs("default").list()}
+            if any(f"soak-{i}" in jobs for i in range(2, n_jobs, 3)):
+                return False
+            for pod in cluster.client.pods("default").list():
+                if pod.metadata.name.startswith(
+                        tuple(f"soak-{i}-" for i in range(2, n_jobs, 3))):
+                    return False
+            return True
+        cluster.wait_until("v1", "Pod", wave3_gone, timeout=30,
+                           describe="deleted jobs fully GC'd")
+
+        # cleanPodPolicy: All (wave 1) removes the worker pods (the
+        # launcher pod stays with its Job for log retrieval, reference
+        # semantics).
+        def wave1_workers_gone():
+            return not [p for p in cluster.client.pods("default").list()
+                        if "-worker-" in p.metadata.name
+                        and p.metadata.name.startswith(
+                            tuple(f"soak-{i}-" for i in range(0, n_jobs, 3)))]
+        cluster.wait_until("v1", "Pod", wave1_workers_gone, timeout=30,
+                           describe="wave-1 worker pods cleaned")
+
+        # Kubelet runner map drains to only live pods; workqueue idles.
+        def runners_settled():
+            live = {(p.metadata.namespace, p.metadata.name)
+                    for p in cluster.client.pods("default").list()}
+            return set(cluster.kubelet._runners).issubset(live)
+        cluster.wait_until("v1", "Pod", runners_settled, timeout=30,
+                           describe="kubelet runners drained")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                len(cluster.controller.queue):
+            time.sleep(0.2)
+        assert len(cluster.controller.queue) == 0
+
+        # No thread leak: all three waves clean their worker pods
+        # (policies All/Running/GC), so thread count returns to near
+        # baseline; the delta absorbs informer/runner teardown jitter.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                threading.active_count() > baseline_threads + 8:
+            time.sleep(0.2)
+        assert threading.active_count() <= baseline_threads + 8, (
+            threading.active_count(), baseline_threads)
