@@ -87,8 +87,19 @@ def worker(donate: bool) -> None:
     tokens = jax.device_put(tokens, batch_sharding(mesh, extra_dims=1))
     params = model.init(jax.random.PRNGKey(1), tokens[:1, :8])
 
-    def loss_fn(p, batch_tokens):
-        return next_token_loss(model.apply(p, batch_tokens), batch_tokens)
+    fused = os.environ.get("BENCH_LLAMA_FUSED_XENT") == "1"
+    if fused:
+        from mpi_operator_tpu.ops.fused_xent import fused_next_token_loss
+
+        def loss_fn(p, batch_tokens):
+            hidden = model.apply(p, batch_tokens, return_hidden=True)
+            kernel = p["params"]["output"]["kernel"].astype(cfg.dtype)
+            return fused_next_token_loss(hidden, kernel, batch_tokens,
+                                         chunk=4000)
+    else:
+        def loss_fn(p, batch_tokens):
+            return next_token_loss(model.apply(p, batch_tokens),
+                                   batch_tokens)
 
     init_fn, step_fn = build_train_step(loss_fn, optax.adamw(3e-4), mesh,
                                         donate=donate, remat=True)
@@ -120,6 +131,7 @@ def worker(donate: bool) -> None:
         "BENCH_PEAK_TFLOPS", PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])))
     mfu = (flops_per_step * steps / elapsed) / n_chips / (peak * 1e12)
     _emit(per_chip, mfu=mfu, extra={
+        "fused_xent": fused,
         "donate": donate, "n_chips": n_chips, "n_params": int(n_params),
         "batch_per_chip": batch // n_chips, "seq_len": seq,
         "platform": jax.devices()[0].platform, "peak_tflops": peak,

@@ -54,6 +54,14 @@ def main() -> int:
                              " loader (mmap + prefetch threads); default:"
                              " synthetic tokens")
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--fused-xent", action="store_true",
+                        help="chunked-vocab fused cross-entropy: the"
+                             " [B,S,V] logits tensor never materializes"
+                             " (ops/fused_xent.py; big HBM win at"
+                             " vocab 32k)")
+    parser.add_argument("--xent-chunk", type=int, default=4000,
+                        help="vocab chunk width for --fused-xent (must"
+                             " divide vocab_size)")
     parser.add_argument("--accum-steps", type=int, default=1,
                         help="gradient-accumulation microbatches per"
                              " optimizer update (divides the batch)")
@@ -111,6 +119,19 @@ def main() -> int:
         def loss_fn(params, batch):
             return pipeline_loss(cfg, params, batch, mesh,
                                  args.microbatches)
+    elif args.fused_xent:
+        from mpi_operator_tpu.ops.fused_xent import fused_next_token_loss
+
+        # A chunk that doesn't divide the vocab falls back to one
+        # full-width chunk (correct, just unfused) — tiny test configs.
+        chunk = args.xent_chunk if cfg.vocab_size % args.xent_chunk == 0 \
+            else cfg.vocab_size
+
+        def loss_fn(params, batch):
+            hidden = model.apply(params, batch, return_hidden=True)
+            kernel = params["params"]["output"]["kernel"].astype(cfg.dtype)
+            return fused_next_token_loss(hidden, kernel, batch,
+                                         chunk=chunk)
     else:
         def loss_fn(params, batch):
             return next_token_loss(model.apply(params, batch), batch)

@@ -394,7 +394,8 @@ class LlamaModel(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, tokens, decode: bool = False):
+    def __call__(self, tokens, decode: bool = False,
+                 return_hidden: bool = False):
         cfg = self.config
         s = tokens.shape[1]
         positions = jnp.arange(s)  # decode mode derives real positions
@@ -411,6 +412,14 @@ class LlamaModel(nn.Module):
                                                           decode)
 
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
+        if return_hidden:
+            # Pre-head hidden states for the fused-xent loss path
+            # (ops/fused_xent.py): the caller applies the output kernel
+            # chunk-by-chunk so [B, S, V] never materializes.  The
+            # Dense below must still be traced once at init so the
+            # "output" param exists; flax init callers never set
+            # return_hidden.
+            return x
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype, name="output")(x)
         return _constrain(logits, self.mesh, BATCH_AXES, "sp", "tp")

@@ -194,7 +194,7 @@ class ResNetBench:
         return rec
 
 
-def llama_bench() -> dict:
+def llama_bench(fused_xent: bool = False) -> dict:
     import jax
     import optax
     from mpi_operator_tpu.models.llama import (LlamaConfig, LlamaModel,
@@ -212,8 +212,16 @@ def llama_bench() -> dict:
                                 cfg.vocab_size)
     params = model.init(jax.random.PRNGKey(1), tokens[:1, :8])
 
-    def loss_fn(p, t):
-        return next_token_loss(model.apply(p, t), t)
+    if fused_xent:
+        from mpi_operator_tpu.ops.fused_xent import fused_next_token_loss
+
+        def loss_fn(p, t):
+            hidden = model.apply(p, t, return_hidden=True)
+            kernel = p["params"]["output"]["kernel"].astype(cfg.dtype)
+            return fused_next_token_loss(hidden, kernel, t, chunk=4000)
+    else:
+        def loss_fn(p, t):
+            return next_token_loss(model.apply(p, t), t)
 
     init_fn, step_fn = build_train_step(loss_fn, optax.adamw(3e-4), mesh,
                                         donate=False, remat=True)
@@ -233,6 +241,7 @@ def llama_bench() -> dict:
     mfu = flops_per_tok * tok_s / (peak_tflops() * 1e12)
     return {"metric": "llama1b_train_tokens_per_sec_per_chip",
             "value": round(tok_s, 1), "mfu": round(mfu, 4),
+            "fused_xent": fused_xent,
             "n_params": int(n_params), "batch": batch, "seq": seq,
             "loss": round(float(m["loss"]), 4)}
 
@@ -383,6 +392,8 @@ def main() -> int:
     cap.phase("resnet_b128", 300, resnet_phase(128, donate=False))
     cap.phase("resnet_b256", 400, resnet_phase(256, donate=False))
     cap.phase("llama_train", 600, llama_bench)
+    cap.phase("llama_train_fused_xent", 400,
+              lambda: llama_bench(fused_xent=True))
     cap.phase("serve", 500, serve_bench)
     cap.phase("kernel_ab", 400, kernel_ab)
     cap.emit({"phase": "done", "remaining_s": round(cap.remaining(), 1)})
