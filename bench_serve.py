@@ -117,8 +117,11 @@ def worker(donate: bool) -> None:  # donate unused; harness symmetry
         batcher.submit(ttft_prompt, 1, timeout=1200)
         warm = time.perf_counter() - t0
 
+        n_params = sum(x.size
+                       for x in jax.tree_util.tree_leaves(variables))
         _emit(tps, extra={
             "platform": jax.devices()[0].platform,
+            "n_params": int(n_params), "dim": dim, "n_layers": n_layers,
             "n_requests": len(prompts), "slots": slots,
             "prompt_len": prompt_len, "new_tokens": new_tokens,
             "page_size": page,

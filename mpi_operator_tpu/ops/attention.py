@@ -6,8 +6,10 @@ q_blocks, kv_blocks), the innermost (sequential on TPU) kv dimension
 accumulates into VMEM scratch under an online softmax, so VMEM use is
 O(block) and the S x S score matrix never exists.  Matmuls hit the MXU
 with f32 accumulation.  Gradients are exact via custom_vjp — the backward
-uses the saved logsumexp (flash-attention-2 formulation) in plain XLA
-ops, which fuses well and keeps round-1 scope sane.
+also runs as Pallas kernels (`_flash_bwd_dq_kernel`, `_flash_bwd_dkv_kernel`)
+that recompute scores blockwise from the saved logsumexp
+(flash-attention-2 formulation); a plain-XLA backward remains as the
+fallback for shapes below the Pallas tile minimum.
 
 No reference counterpart: kubeflow/mpi-operator ships no kernels; this is
 framework surface the TPU-native workload stack needs (SURVEY.md §2.2

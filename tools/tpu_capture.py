@@ -239,7 +239,8 @@ def llama_bench(fused_xent: bool = False) -> dict:
     dt = time.perf_counter() - t0
     tok_s = batch * seq * steps / dt
     mfu = flops_per_tok * tok_s / (peak_tflops() * 1e12)
-    return {"metric": "llama1b_train_tokens_per_sec_per_chip",
+    from bench_llama import _metric_name
+    return {"metric": _metric_name(int(n_params)),
             "value": round(tok_s, 1), "mfu": round(mfu, 4),
             "fused_xent": fused_xent,
             "n_params": int(n_params), "batch": batch, "seq": seq,
