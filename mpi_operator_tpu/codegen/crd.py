@@ -218,6 +218,41 @@ _FIELD_OVERRIDES = {
     ("PodDNSConfig", "searches"): _STRING_LIST,
     ("PodDNSConfig", "options"): _DNS_CONFIG_OPTIONS,
     ("SchedulingPolicy", "min_resources"): _QUANTITY_MAP,
+    ("TopologySpreadConstraint", "label_selector"): _LABEL_SELECTOR,
+    ("PodSpec", "overhead"): _QUANTITY_MAP,
+}
+
+
+# Required fields per dataclass (camelCase JSON names), matching the
+# reference CRD's `required` lists (extracted from
+# /root/reference/manifests/base/kubeflow.org_mpijobs.yaml) so strict
+# validation rejects exactly what a real apiserver would 422.
+_REQUIRED_FIELDS = {
+    "MPIJobSpec": ["mpiReplicaSpecs"],
+    "PodSpec": ["containers"],
+    "Container": ["name"],
+    "EnvVar": ["name"],
+    "ContainerPort": ["containerPort"],
+    "VolumeMount": ["mountPath", "name"],
+    "Volume": ["name"],
+    "KeyToPath": ["key", "path"],
+    "KeySelector": ["key"],
+    "ObjectFieldSelector": ["fieldPath"],
+    "ResourceFieldSelector": ["resource"],
+    "HostPathVolumeSource": ["path"],
+    "PersistentVolumeClaimVolumeSource": ["claimName"],
+    "HTTPGetAction": ["port"],
+    "TCPSocketAction": ["port"],
+    "GRPCAction": ["port"],
+    "HTTPHeader": ["name", "value"],
+    "SleepAction": ["seconds"],
+    "TopologySpreadConstraint": ["maxSkew", "topologyKey",
+                                 "whenUnsatisfiable"],
+    "PodReadinessGate": ["conditionType"],
+    "HostAlias": ["ip"],
+    "VolumeDevice": ["devicePath", "name"],
+    "ContainerResizePolicy": ["resourceName", "restartPolicy"],
+    "PodOS": ["name"],
 }
 
 
@@ -231,6 +266,8 @@ def _schema_for(ftype, owner: str = "", fname: str = "",
         args = [a for a in typing.get_args(ftype) if a is not type(None)]
         if len(args) == 1:
             return _schema_for(args[0], owner, fname, seen)
+        if set(args) == {int, str}:  # core.IntOrString (probe ports etc.)
+            return {"x-kubernetes-int-or-string": True}
         return {"x-kubernetes-preserve-unknown-fields": True}
     if origin in (list, tuple):
         args = typing.get_args(ftype)
@@ -272,6 +309,9 @@ def _dataclass_schema(cls, seen: tuple = ()) -> dict:
                                             cls.__name__, f.name, seen)
     doc = (cls.__doc__ or "").strip().split("\n")[0]
     schema = {"type": "object", "properties": props}
+    required = _REQUIRED_FIELDS.get(cls.__name__)
+    if required:
+        schema["required"] = list(required)
     if doc:
         schema["description"] = doc
     return schema

@@ -89,6 +89,45 @@ def _validate(instance: Any, schema: dict, path: str,
                 _validate(val, items, f"{path}[{i}]", errors)
 
 
+def prune_schema(instance: Any, schema: dict) -> Any:
+    """Emulate apiserver structural-schema pruning: drop object fields not
+    declared in ``properties`` unless the object is open
+    (``x-kubernetes-preserve-unknown-fields`` / additionalProperties).
+
+    Returns a pruned deep copy (no aliasing into the input).  The
+    round-trip test uses this to prove a user manifest survives admission
+    unchanged — the round-3 schema silently dropped
+    livenessProbe/topologySpreadConstraints this way.
+    """
+    import copy
+
+    if schema.get("x-kubernetes-int-or-string") or \
+            schema.get("x-kubernetes-preserve-unknown-fields"):
+        return copy.deepcopy(instance)
+    if isinstance(instance, dict):
+        props = schema.get("properties")
+        additional = schema.get("additionalProperties")
+        out = {}
+        for key, val in instance.items():
+            if props is not None and key in props:
+                out[key] = prune_schema(val, props[key])
+            elif isinstance(additional, dict):
+                out[key] = prune_schema(val, additional)
+            elif additional is True:
+                out[key] = copy.deepcopy(val)
+            # else: undeclared field in a closed object — pruned.  An
+            # object node with neither properties nor additionalProperties
+            # declares no fields at all, so the apiserver prunes
+            # EVERYTHING under it (unlike _validate's lenient stance).
+        return out
+    if isinstance(instance, list):
+        items = schema.get("items")
+        if isinstance(items, dict):
+            return [prune_schema(v, items) for v in instance]
+        return copy.deepcopy(instance)
+    return instance
+
+
 def validate_mpijob_dict(doc: dict) -> List[str]:
     """Validate a decoded MPIJob manifest against the generated CRD."""
     from .crd import mpijob_crd

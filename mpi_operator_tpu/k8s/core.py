@@ -8,9 +8,13 @@ The subset of k8s.io/api/core/v1 the operator constructs and inspects
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Union
 
 from .meta import ObjectMeta
+
+# Kubernetes IntOrString (probe/service ports accept 8080 or "http");
+# codegen maps this union to x-kubernetes-int-or-string.
+IntOrString = Union[int, str]
 
 # Pod phases (k8s.io/api/core/v1 PodPhase)
 POD_PENDING = "Pending"
@@ -143,6 +147,107 @@ class ContainerPort:
     protocol: str = ""
 
 
+# --- probe / lifecycle handlers (corev1.Probe, corev1.Lifecycle) ----------
+# Reference CRD surface: manifests/base/kubeflow.org_mpijobs.yaml
+# (livenessProbe/readinessProbe/startupProbe, lifecycle) — absent from the
+# round-3 schema, so user probe configs were silently pruned on admission.
+
+@dataclass
+class ExecAction:
+    command: List[str] = field(default_factory=list)
+
+
+@dataclass
+class HTTPHeader:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class HTTPGetAction:
+    path: str = ""
+    port: Optional[IntOrString] = None
+    host: str = ""
+    scheme: str = ""
+    http_headers: List[HTTPHeader] = field(default_factory=list)
+
+
+@dataclass
+class TCPSocketAction:
+    port: Optional[IntOrString] = None
+    host: str = ""
+
+
+@dataclass
+class GRPCAction:
+    port: int = 0
+    service: str = ""
+
+
+@dataclass
+class SleepAction:
+    seconds: int = 0
+
+
+@dataclass
+class Probe:
+    exec: Optional[ExecAction] = None
+    http_get: Optional[HTTPGetAction] = None
+    tcp_socket: Optional[TCPSocketAction] = None
+    grpc: Optional[GRPCAction] = None
+    initial_delay_seconds: Optional[int] = None
+    timeout_seconds: Optional[int] = None
+    period_seconds: Optional[int] = None
+    success_threshold: Optional[int] = None
+    failure_threshold: Optional[int] = None
+    termination_grace_period_seconds: Optional[int] = None
+
+
+@dataclass
+class LifecycleHandler:
+    exec: Optional[ExecAction] = None
+    http_get: Optional[HTTPGetAction] = None
+    tcp_socket: Optional[TCPSocketAction] = None
+    sleep: Optional[SleepAction] = None
+
+
+@dataclass
+class Lifecycle:
+    post_start: Optional[LifecycleHandler] = None
+    pre_stop: Optional[LifecycleHandler] = None
+
+
+@dataclass
+class ConfigMapEnvSource:
+    name: str = ""
+    optional: Optional[bool] = None
+
+
+@dataclass
+class SecretEnvSource:
+    name: str = ""
+    optional: Optional[bool] = None
+
+
+@dataclass
+class EnvFromSource:
+    prefix: str = ""
+    config_map_ref: Optional[ConfigMapEnvSource] = None
+    secret_ref: Optional[SecretEnvSource] = None
+
+
+@dataclass
+class VolumeDevice:
+    name: str = ""
+    device_path: str = ""
+
+
+@dataclass
+class ContainerResizePolicy:
+    resource_name: str = ""
+    restart_policy: str = ""
+
+
 @dataclass
 class Container:
     name: str = ""
@@ -151,11 +256,24 @@ class Container:
     args: List[str] = field(default_factory=list)
     working_dir: str = ""
     env: List[EnvVar] = field(default_factory=list)
+    env_from: List[EnvFromSource] = field(default_factory=list)
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     volume_mounts: List[VolumeMount] = field(default_factory=list)
+    volume_devices: List[VolumeDevice] = field(default_factory=list)
     ports: List[ContainerPort] = field(default_factory=list)
     image_pull_policy: str = ""
     security_context: Optional[dict] = None
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
+    startup_probe: Optional[Probe] = None
+    lifecycle: Optional[Lifecycle] = None
+    termination_message_path: str = ""
+    termination_message_policy: str = ""
+    resize_policy: List[ContainerResizePolicy] = field(default_factory=list)
+    restart_policy: str = ""  # sidecar ("Always") for init containers
+    stdin: Optional[bool] = None
+    stdin_once: Optional[bool] = None
+    tty: Optional[bool] = None
 
 
 @dataclass
@@ -179,6 +297,39 @@ class LocalObjectReference:
     name: str = ""
 
 
+# --- pod-level scheduling/runtime surface ----------------------------------
+# Reference CRD: topologySpreadConstraints, runtimeClassName,
+# readinessGates, overhead, preemptionPolicy, hostAliases — absent from
+# the round-3 schema (silent admission-prune hazard).
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = ""
+    label_selector: Optional[dict] = None
+    min_domains: Optional[int] = None
+    match_label_keys: List[str] = field(default_factory=list)
+    node_affinity_policy: str = ""
+    node_taints_policy: str = ""
+
+
+@dataclass
+class PodReadinessGate:
+    condition_type: str = ""
+
+
+@dataclass
+class HostAlias:
+    ip: str = ""
+    hostnames: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PodOS:
+    name: str = ""
+
+
 @dataclass
 class PodSpec:
     containers: List[Container] = field(default_factory=list)
@@ -188,19 +339,36 @@ class PodSpec:
     hostname: str = ""
     subdomain: str = ""
     host_network: bool = False
+    host_pid: Optional[bool] = None
+    host_ipc: Optional[bool] = None
+    share_process_namespace: Optional[bool] = None
     dns_policy: str = ""
     dns_config: Optional[PodDNSConfig] = None
     node_selector: dict = field(default_factory=dict)
+    node_name: str = ""
     tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(
+        default_factory=list)
     scheduling_gates: list = field(default_factory=list)
     scheduler_name: str = ""
+    runtime_class_name: Optional[str] = None
     priority_class_name: str = ""
+    priority: Optional[int] = None
+    preemption_policy: Optional[str] = None
+    readiness_gates: List[PodReadinessGate] = field(default_factory=list)
+    overhead: dict = field(default_factory=dict)
+    host_aliases: List[HostAlias] = field(default_factory=list)
     service_account_name: str = ""
+    automount_service_account_token: Optional[bool] = None
     image_pull_secrets: List[LocalObjectReference] = field(
         default_factory=list)
     affinity: Optional[dict] = None
     security_context: Optional[dict] = None
     termination_grace_period_seconds: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    enable_service_links: Optional[bool] = None
+    set_hostname_as_fqdn: Optional[bool] = None
+    os: Optional[PodOS] = None
 
 
 @dataclass

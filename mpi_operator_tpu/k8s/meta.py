@@ -68,14 +68,23 @@ def _camel(name: str) -> str:
     # Kubernetes JSON uses a handful of irregular names.
     return {"clusterIp": "clusterIP", "podIp": "podIP", "hostIp": "hostIP",
             "uid": "uid", "ttlSecondsAfterFinished": "ttlSecondsAfterFinished",
+            "hostIpc": "hostIPC", "hostPid": "hostPID",
+            "setHostnameAsFqdn": "setHostnameAsFQDN",
             }.get(out, out)
 
 
-_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
+# Two passes so acronym runs collapse to one snake word: "clusterIP" ->
+# "cluster_ip", "hostIPC" -> "host_ipc", "setHostnameAsFQDN" ->
+# "set_hostname_as_fqdn".  (A single lookahead-split produced
+# "cluster_i_p", silently dropping every acronym field on from_dict.)
+_SNAKE_RE1 = re.compile(r"([A-Z]+)([A-Z][a-z])")
+_SNAKE_RE2 = re.compile(r"([a-z0-9])([A-Z])")
 
 
 def _snake(name: str) -> str:
-    return _SNAKE_RE.sub("_", name).lower()
+    s = _SNAKE_RE1.sub(r"\1_\2", name)
+    s = _SNAKE_RE2.sub(r"\1_\2", s)
+    return s.lower()
 
 
 def to_dict(obj: Any) -> Any:
