@@ -468,7 +468,11 @@ def _overlay(namespace_: str, images=None) -> dict:
         "resources": ["../../base"],
         "namespace": namespace_,
         "labels": [{
-            "includeSelectors": False,
+            # Reference parity (manifests/overlays/*/kustomization.yaml
+            # uses commonLabels, whose modern spelling is
+            # includeSelectors: true): labels stamp into Deployment
+            # selectors/pod templates too.
+            "includeSelectors": True,
             "pairs": {"app": "mpi-operator",
                       "app.kubernetes.io/component": "mpijob",
                       "app.kubernetes.io/name": "mpi-operator",

@@ -25,6 +25,10 @@ from .selectors import match_labels
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+# Synthetic client-side event (obj=None): the watch lost replay
+# continuity (410 Expired) and the consumer must relist NOW rather than
+# wait for its periodic resync.  Never sent by the server itself.
+RELIST = "RELIST"
 
 
 class ApiError(Exception):
@@ -114,9 +118,11 @@ class Watch:
 class ApiServer:
     """Thread-safe in-memory object store with k8s API semantics."""
 
-    # Retained watch-event history entries (all kinds pooled); a watch
-    # starting from an RV older than the window gets 410 Expired, the
-    # same contract a real apiserver derives from its etcd cache.
+    # Retained watch-event history entries PER KIND; a watch starting
+    # from an RV older than the kind's window gets 410 Expired, the same
+    # contract a real apiserver derives from its etcd cache.  Per-kind
+    # (like the real watch cache) so a chatty kind's churn (Pods) cannot
+    # expire a quiet kind's resume window and force spurious relists.
     HISTORY_LIMIT = 2048
 
     def __init__(self, clock: Optional[Clock] = None):
@@ -126,11 +132,11 @@ class ApiServer:
         self._store: dict = {}
         self._rv = 0
         self._watches: dict = {}  # (api_version, kind) -> [Watch]
-        # [(event_rv, gvk, WatchEvent)] ordered by rv; every rv bump
-        # emits exactly one event (delete bumps too), so the window
-        # [_purged_rv+1 .. _rv] is fully replayable.
-        self._history: list = []
-        self._purged_rv = 0
+        # gvk -> [(event_rv, WatchEvent)] ordered by rv; every rv bump
+        # emits exactly one event (delete bumps too), so each kind's
+        # window (_purged_rv[gvk]+1 .. _rv] is fully replayable.
+        self._history: dict = {}
+        self._purged_rv: dict = {}
 
     # -- helpers ----------------------------------------------------------
     def _gvk(self, obj) -> tuple:
@@ -149,9 +155,11 @@ class ApiServer:
             ev_rv = int(obj.metadata.resource_version)
         except (TypeError, ValueError):
             ev_rv = self._rv
-        self._history.append((ev_rv, gvk, ev))
-        while len(self._history) > self.HISTORY_LIMIT:
-            self._purged_rv = max(self._purged_rv, self._history.pop(0)[0])
+        hist = self._history.setdefault(gvk, [])
+        hist.append((ev_rv, ev))
+        while len(hist) > self.HISTORY_LIMIT:
+            self._purged_rv[gvk] = max(self._purged_rv.get(gvk, 0),
+                                       hist.pop(0)[0])
         for w in list(self._watches.get(gvk, [])):
             w._send(WatchEvent(ev_type, deep_copy(obj)))
 
@@ -308,10 +316,10 @@ class ApiServer:
             w = Watch(self, gvk)
             if resource_version not in (None, "", "0"):
                 rv = int(resource_version)
-                if rv < self._purged_rv:
+                if rv < self._purged_rv.get(gvk, 0):
                     raise expired(kind, resource_version)
-                for ev_rv, g, ev in self._history:
-                    if g == gvk and ev_rv > rv:
+                for ev_rv, ev in self._history.get(gvk, []):
+                    if ev_rv > rv:
                         w._send(WatchEvent(ev.type, deep_copy(ev.obj)))
             self._watches.setdefault(gvk, []).append(w)
             return w

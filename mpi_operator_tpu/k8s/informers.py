@@ -14,7 +14,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
-from .apiserver import ADDED, DELETED, MODIFIED, ApiServer, Clientset
+from .apiserver import (ADDED, DELETED, MODIFIED, RELIST, ApiServer,
+                        Clientset)
 from .meta import deep_copy
 from .selectors import match_labels
 
@@ -113,6 +114,20 @@ class SharedInformer:
         last_resync = time.monotonic()
         while not self._stopped.is_set():
             ev = self._watch.next(timeout=0.1)
+            if ev is not None and ev.type == RELIST:
+                # The watch lost replay continuity (410 Expired): relist
+                # immediately — events in the gap are otherwise invisible
+                # until the periodic resync (client-go relists at once).
+                try:
+                    self._resync()
+                    last_resync = time.monotonic()
+                except Exception:
+                    # Relist failed (API briefly unreachable — often the
+                    # very condition behind the 410): leave last_resync
+                    # untouched so the periodic resync retries on its
+                    # original schedule rather than a full fresh interval.
+                    pass
+                continue
             # Note: the resync check below must run on EVERY iteration —
             # a `continue` for filtered events would let sustained
             # cross-namespace traffic starve resync.

@@ -37,7 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from . import registry
-from .apiserver import ApiError, ApiServer, WatchEvent
+from .apiserver import RELIST, ApiError, ApiServer, WatchEvent
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -211,6 +211,7 @@ class _KubeWatch:
     def _pump(self) -> None:
         import time
         backoff = 0.2
+        pending_relist = False
         while not self.stopped:
             resp = None
             try:
@@ -223,6 +224,16 @@ class _KubeWatch:
                 # the watch; events from here on flow to this stream.
                 self._connected.set()
                 self._t._auth_failures = 0  # credentials work again
+                if pending_relist:
+                    # A 410 preceded this reconnect.  The sentinel is
+                    # enqueued only now, AFTER the from-now stream is
+                    # live: the consumer's relist then covers everything
+                    # up to a point the new stream also covers, so no
+                    # event can fall between the list and the stream
+                    # (client-go resumes from the list RV for the same
+                    # reason).
+                    pending_relist = False
+                    self._q.put(WatchEvent(RELIST, None))
                 if self.stopped:
                     return
                 backoff = 0.2
@@ -241,9 +252,14 @@ class _KubeWatch:
                     if ev.get("type") == "BOOKMARK":
                         continue
                     if ev.get("type") == "ERROR":
-                        # 410 Gone etc: relist from scratch (the informer's
-                        # periodic resync heals the gap).
+                        # 410 Gone etc: events between expiry and the
+                        # reconnect-from-now are lost.  Flag a RELIST
+                        # sentinel (obj=None), delivered once the next
+                        # stream is live — the informer then relists
+                        # immediately instead of waiting for the
+                        # periodic resync (client-go parity).
                         self._rv = None
+                        pending_relist = True
                         break
                     self._q.put(WatchEvent(
                         ev["type"], _decode_as(obj_data, self._api_version,
@@ -253,9 +269,10 @@ class _KubeWatch:
                     self._t._note_auth_failure(exc)
                 elif exc.code == 410:
                     # Expired RV rejected before streaming began:
-                    # restart from "now"; the informer's resync heals
-                    # the replay gap (same as the in-stream ERROR path).
+                    # restart from "now" and flag the RELIST sentinel
+                    # (same contract as the in-stream ERROR path).
                     self._rv = None
+                    pending_relist = True
             except Exception:
                 pass  # connection lost; fall through to reconnect
             finally:

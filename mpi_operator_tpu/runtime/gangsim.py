@@ -218,6 +218,10 @@ class GangSchedulerSim:
                     return
                 if not is_conflict(exc):
                     raise
+        # The 0.15s reconcile tick retries the bind, but a persistently
+        # conflicting pod should be visible in test output, not silent.
+        logger.warning("bind retry budget exhausted for pod %s/%s",
+                       pod.metadata.namespace, pod.metadata.name)
 
     def _set_status(self, api_version: str, pg, phase: str,
                     unschedulable: str = "") -> None:
@@ -245,3 +249,6 @@ class GangSchedulerSim:
                     return
                 if not is_conflict(exc):
                     raise
+        logger.warning("status retry budget exhausted for podgroup %s/%s"
+                       " (phase %s)", pg.metadata.namespace,
+                       pg.metadata.name, phase)

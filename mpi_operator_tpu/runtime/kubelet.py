@@ -328,10 +328,33 @@ class LocalKubelet:
         shutil.rmtree(self.root_dir, ignore_errors=True)
 
     def _loop(self) -> None:
-        from ..k8s.apiserver import ADDED, DELETED, MODIFIED
+        from ..k8s.apiserver import ADDED, DELETED, MODIFIED, RELIST
         while not self._stop.is_set():
             ev = self._watch.next(timeout=0.1)
             if ev is None:
+                continue
+            if ev.type == RELIST:
+                # Watch lost replay continuity (410): reconcile against a
+                # fresh list so gap events aren't missed (obj is None) —
+                # both creations (start) and deletions (stop orphans).
+                try:
+                    live = self.client.server.list("v1", "Pod",
+                                                   self.namespace)
+                except Exception:
+                    continue  # transient API failure; next event heals
+                live_keys = set()
+                for pod in live:
+                    live_keys.add((pod.metadata.namespace,
+                                   pod.metadata.name))
+                    self._on_pod(pod)
+                with self._lock:
+                    orphans = [(k, r) for k, r in self._runners.items()
+                               if k not in live_keys]
+                    for k, _ in orphans:
+                        self._runners.pop(k, None)
+                for k, runner in orphans:
+                    runner.stop()
+                    self.release_pod_ip(*k)
                 continue
             pod = ev.obj
             if self.namespace is not None and pod.metadata.namespace != self.namespace:

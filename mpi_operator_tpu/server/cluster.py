@@ -98,7 +98,18 @@ class LocalCluster:
                         f"{kind} in {namespace} never satisfied: "
                         f"{describe or predicate}")
                 ev = watch.next(timeout=min(remaining, 1.0))
-                if ev is None or ev.type == "DELETED":
+                if ev is None:
+                    continue
+                if ev.type == "RELIST":
+                    # Watch lost replay continuity (410, obj is None):
+                    # re-evaluate current state so a predicate satisfied
+                    # inside the gap isn't waited on forever.
+                    for obj in self.client.server.list(api_version, kind,
+                                                       namespace):
+                        if predicate(obj):
+                            return obj
+                    continue
+                if ev.type == "DELETED":
                     continue
                 if ev.obj.metadata.namespace == namespace \
                         and predicate(ev.obj):

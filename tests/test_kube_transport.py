@@ -14,7 +14,8 @@ import urllib.request
 import pytest
 
 from mpi_operator_tpu.api import constants
-from mpi_operator_tpu.k8s.apiserver import ApiError, ApiServer, Clientset
+from mpi_operator_tpu.k8s.apiserver import (RELIST, ApiError, ApiServer,
+                                            Clientset)
 from mpi_operator_tpu.k8s.core import Pod, PodSpec, Container
 from mpi_operator_tpu.k8s.kube_transport import (KubeApiServer, KubeConfig,
                                                  KubeFixtureServer, api_path,
@@ -421,19 +422,86 @@ def test_client_watch_recovers_from_410(fixture_server, kube_client):
         import time
         deadline = time.monotonic() + 20
         got = None
+        saw_relist = False
         while time.monotonic() < deadline:
             pods.create(_pod(f"fresh-{int(time.monotonic()*1000)}"))
             ev = watch.next(timeout=2)
             while ev is not None:
-                if ev.obj.metadata.name.startswith("fresh-"):
+                if ev.type == RELIST:
+                    # The 410 surfaces as a RELIST sentinel (obj None)
+                    # so direct consumers know the gap exists.
+                    saw_relist = True
+                elif ev.obj.metadata.name.startswith("fresh-"):
                     got = ev
                     break
                 ev = watch.next(timeout=2)
             if got:
                 break
         assert got is not None, "watch never recovered after 410"
+        assert saw_relist, "410 never surfaced a RELIST sentinel"
     finally:
         watch.stop()
+
+
+def test_informer_relists_immediately_after_410(fixture_server,
+                                                kube_client):
+    """Events lost in the expiry->reconnect gap must reach the informer
+    cache promptly via the RELIST-triggered relist, not only at the next
+    periodic resync (client-go relists immediately on 410).
+
+    The gap is constructed deterministically: watch reconnects are gated
+    shut while the stream is down, the history window is pushed past the
+    informer's RV and the 'gap' pod is created — all unstreamable — then
+    the gate opens and the reconnect gets its 410."""
+    import threading
+    import time
+
+    from mpi_operator_tpu.k8s.informers import InformerFactory
+
+    fixture_server.store.HISTORY_LIMIT = 4
+    pods = kube_client.pods("default")
+    factory = InformerFactory(kube_client)
+    inf = factory.informer("v1", "Pod")
+    inf.resync_interval = 3600  # periodic resync can't mask the fix
+    inf.start()
+    transport = kube_client.server
+    gate = threading.Event()
+    gate.set()
+    orig_open = transport._open
+
+    def gated_open(method, url, body=None, **kw):
+        if kw.get("stream") and not gate.is_set():
+            raise OSError("watch gated (test partition)")
+        return orig_open(method, url, body, **kw)
+
+    transport._open = gated_open
+    try:
+        pods.create(_pod("seed"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                inf.lister.get("default", "seed") is None:
+            time.sleep(0.05)
+        assert inf.lister.get("default", "seed") is not None
+
+        # Partition: no reconnect can succeed while we build the gap.
+        gate.clear()
+        watch = inf._watch
+        watch._break_connection()
+        watch._rv = "1"  # long-gone RV; pump can't overwrite it (gated)
+        for i in range(8):  # purge the Pod history window past rv=1
+            kube_client.pods("other").create(_pod(f"x{i}", ns="other"))
+        pods.create(_pod("gap"))  # lands inside the gap, never streamed
+        gate.set()  # reconnect now -> 410 -> RELIST -> immediate relist
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                inf.lister.get("default", "gap") is None:
+            time.sleep(0.05)
+        assert inf.lister.get("default", "gap") is not None, \
+            "informer never saw the gap event after 410"
+    finally:
+        transport._open = orig_open
+        factory.stop_all()
 
 
 def test_watch_timeout_seconds_ends_stream_cleanly(fixture_server):
