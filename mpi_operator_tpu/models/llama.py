@@ -319,7 +319,7 @@ class LlamaAttention(nn.Module):
                                      impl=cfg.ring_impl)
             else:
                 out = attention(q, k, v, causal=True,
-                                impl=cfg.attention_impl)
+                                impl=cfg.attention_impl, mesh=self.mesh)
 
         out = nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,

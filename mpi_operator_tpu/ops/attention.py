@@ -413,11 +413,19 @@ flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def attention(q, k, v, causal: bool = True, impl: str = "auto",
-              interpret: bool = False):
+              interpret: bool = False, mesh=None):
     """Dispatcher on [B, S, H, D] (model layout).
 
     impl: 'pallas' (TPU kernel), 'xla' (plain ops), 'auto' (pallas on TPU
     backends when the sequence admits sane block sizes, xla elsewhere).
+
+    mesh: when given (and >1 device), the pallas path runs under
+    shard_map with batch over (dp, fsdp) and heads over tp — Mosaic
+    kernels cannot be auto-partitioned by GSPMD, so without this the
+    multi-chip pjit path would fail to lower.  Attention is independent
+    per (batch, head), and this path keeps the sequence unsharded
+    (sp>1 goes through ring_attention), so the per-shard kernel
+    computes exactly its slice of the global result.
     """
     s = q.shape[1]
     if impl == "auto":
@@ -428,13 +436,25 @@ def attention(q, k, v, causal: bool = True, impl: str = "auto",
         on_tpu = jax.default_backend() == "tpu"
         blocks_ok = _pick_block(s, DEFAULT_Q_BLOCK) >= _MIN_PALLAS_BLOCK
         impl = "pallas" if (on_tpu and blocks_ok) else "xla"
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-    if impl == "pallas":
-        out = flash_attention(qt, kt, vt, None, causal, DEFAULT_Q_BLOCK,
-                              DEFAULT_KV_BLOCK, interpret)
-    else:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-        out, _ = _xla_attention(qt, kt, vt, scale, causal)
-    return out.transpose(0, 2, 1, 3)
+
+    def _run(qm, km, vm):
+        qt = qm.transpose(0, 2, 1, 3)
+        kt = km.transpose(0, 2, 1, 3)
+        vt = vm.transpose(0, 2, 1, 3)
+        if impl == "pallas":
+            out = flash_attention(qt, kt, vt, None, causal, DEFAULT_Q_BLOCK,
+                                  DEFAULT_KV_BLOCK, interpret)
+        else:
+            scale = 1.0 / math.sqrt(qm.shape[-1])
+            out, _ = _xla_attention(qt, kt, vt, scale, causal)
+        return out.transpose(0, 2, 1, 3)
+
+    if impl == "pallas" and mesh is not None and mesh.size > 1:
+        from jax.sharding import PartitionSpec as P
+
+        batch = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+        heads = "tp" if "tp" in mesh.shape else None
+        spec = P(batch if batch else None, None, heads, None)
+        return jax.shard_map(_run, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
+    return _run(q, k, v)
