@@ -14,6 +14,7 @@ v5e-32 slice)" config tracked in BASELINE.json.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -54,6 +55,15 @@ class LlamaConfig:
                                               # this block size (decode)
     cache_blocks: int = 0                     # paged pool size; 0 -> auto
                                               # (worst case for the batch)
+
+    def __post_init__(self):
+        # Models (and thus configs) ride in jit static argnums on the
+        # decode path; a dict field would make them unhashable, so
+        # normalize the mapping to a sorted item tuple (converted back
+        # wherever it's read).
+        if isinstance(self.rope_scaling, dict):
+            object.__setattr__(self, "rope_scaling",
+                               tuple(sorted(self.rope_scaling.items())))
 
     @property
     def blocks_per_row(self) -> int:
@@ -119,10 +129,13 @@ def mixtral_8x7b(**overrides) -> LlamaConfig:
                           **overrides})
 
 
-def _scale_rope_freqs(freqs, scaling: dict):
+def _scale_rope_freqs(freqs, scaling):
     """Llama-3.1 rope scaling: long wavelengths divided by `factor`, short
-    kept, smooth interpolation in between (the 'llama3' rope_type)."""
+    kept, smooth interpolation in between (the 'llama3' rope_type).
+    ``scaling`` is a mapping or the config's normalized item tuple."""
     import math as _math
+    if not isinstance(scaling, dict):
+        scaling = dict(scaling)
     factor = scaling["factor"]
     low = scaling.get("low_freq_factor", 1.0)
     high = scaling.get("high_freq_factor", 4.0)
@@ -532,13 +545,62 @@ def canonical_block_table(batch: int, config: LlamaConfig):
     return 1 + jnp.arange(batch * bpr, dtype=jnp.int32).reshape(batch, bpr)
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
+def _prefill_apply(model, params, tokens):
+    return model.apply({"params": params}, tokens, decode=True,
+                       mutable=["cache"])
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _prefill_apply_cached(model, params, cache, tokens):
+    return model.apply({"params": params, "cache": cache}, tokens,
+                       decode=True, mutable=["cache"])
+
+
+def _select_token_traced(logits, temperature, top_p, rng):
+    """Nucleus sampling with TRACED temperature/top_p scalars: one
+    compiled executable serves every sampling config (a server
+    forwarding arbitrary client floats must not grow the jit cache
+    per distinct value)."""
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cumulative < top_p, axis=-1)
+    threshold = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                    axis=-1)
+    # top_p >= 1 disables the mask entirely (float cumsum can cross 1.0
+    # a slot early, which would otherwise clip the tail distribution).
+    threshold = jnp.where(top_p >= 1.0, -jnp.inf, threshold)
+    masked = jnp.where(scaled < threshold, -jnp.inf, scaled)
+    return jax.random.categorical(rng, masked, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def _decode_step(model, params, cache, token, greedy, temperature, top_p,
+                 rng):
+    logits, state = model.apply({"params": params, "cache": cache},
+                                token[:, None], decode=True,
+                                mutable=["cache"])
+    rng, sub = jax.random.split(rng)
+    last = logits[:, -1]
+    tok = (jnp.argmax(last, axis=-1) if greedy
+           else _select_token_traced(last, temperature, top_p, sub))
+    return state["cache"], tok, rng
+
+
 def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
                       temperature: float, top_p: float):
     """Shared decode core for generate()/stream_generate(): prefill the
     prompt and build the jitted one-token step.  Returns
-    (prefill_logits, cache, step_fn)."""
-    import functools
+    (prefill_logits, cache, step_fn).
 
+    The jitted applies are MODULE-LEVEL functions with the model static
+    (flax modules hash by value), so repeated generate() calls on the
+    same model/shapes reuse the compile cache — a fresh closure per call
+    would re-trace every time and decode latency would be dominated by
+    tracing, not compute.
+    """
     params = {"params": variables["params"]}
     if model.config.page_size > 0:
         # Paged cache: a fresh cache's block tables are all scratch —
@@ -554,24 +616,21 @@ def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
             cache0 = cache0.unfreeze()
         cache0 = _set_block_tables(cache0, canonical_block_table(
             prompt_tokens.shape[0], model.config))
-        logits, state = model.apply({**params, "cache": cache0},
-                                    prompt_tokens, decode=True,
-                                    mutable=["cache"])
+        logits, state = _prefill_apply_cached(model, params["params"],
+                                              cache0, prompt_tokens)
     else:
-        logits, state = model.apply(params, prompt_tokens, decode=True,
-                                    mutable=["cache"])
+        logits, state = _prefill_apply(model, params["params"],
+                                       prompt_tokens)
     cache = state["cache"]
     if hasattr(cache, "unfreeze"):  # flax FrozenDict compatibility
         cache = cache.unfreeze()
 
-    @functools.partial(jax.jit)
+    greedy = temperature <= 0.0
+
     def step(cache, token, rng):
-        logits, state = model.apply(
-            {**params, "cache": cache}, token[:, None], decode=True,
-            mutable=["cache"])
-        rng, sub = jax.random.split(rng)
-        return (state["cache"],
-                _select_token(logits[:, -1], temperature, top_p, sub), rng)
+        return _decode_step(model, params["params"], cache, token, greedy,
+                            jnp.float32(temperature), jnp.float32(top_p),
+                            rng)
 
     return logits, cache, step
 

@@ -3,11 +3,20 @@
 A small draft model proposes ``draft_len`` tokens autoregressively; the
 target model scores all of them in ONE forward (the multi-token decode
 branch) and keeps the longest prefix that matches its own greedy
-choices, plus one corrected/bonus token.  With temperature=0 the output
-is EXACTLY ``greedy_generate(target, ...)`` — acceptance only ever
-reproduces the target's argmax — while the number of expensive target
-forwards drops toward max_new_tokens / (draft_len + 1) as draft
-agreement rises.  On TPU the win compounds: the verify forward is a
+choices, plus one corrected/bonus token.  With temperature=0 every
+committed token is the target's own argmax from the verify forward —
+acceptance never emits anything the target wouldn't — while the number
+of expensive target forwards drops toward
+max_new_tokens / (draft_len + 1) as draft agreement rises.
+
+Numerics caveat: "lossless" is argmax-equality, and the verify forward
+(width draft_len+1) and ``greedy_generate``'s width-1 step are
+different XLA programs whose logits can differ in the last ulp.  In
+bf16 with a large vocab a near-tied top-2 can therefore flip, so the
+emitted stream is bitwise-identical to ``greedy_generate`` except at
+float-tie positions (both streams are valid greedy decodes of the same
+model; the original speculative-decoding guarantee is distributional,
+not bitwise).  On TPU the win compounds: the verify forward is a
 batched matmul-heavy step (MXU-friendly) replacing draft_len+1
 bandwidth-bound single-token steps.
 
@@ -26,22 +35,31 @@ stack; this is TPU-native serving surface (SURVEY.md §2.2).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from .llama import LlamaModel, _prefill_and_step, _set_cache_index
 
 
-def _jit_greedy_decode(model, variables):
-    """Jitted greedy decode apply: (cache, tokens [B, w]) ->
-    (cache, argmax tokens [B, w]); jit re-specializes per width."""
-    params = {"params": variables["params"]}
+@functools.partial(jax.jit, static_argnums=(0,))
+def _greedy_decode_apply(model, params, cache, tokens):
+    logits, state = model.apply({"params": params, "cache": cache},
+                                tokens, decode=True, mutable=["cache"])
+    return state["cache"], jnp.argmax(logits, axis=-1)
 
-    @jax.jit
+
+def _jit_greedy_decode(model, variables):
+    """Greedy decode apply: (cache, tokens [B, w]) ->
+    (cache, argmax tokens [B, w]); jit re-specializes per width.  The
+    underlying jit is module-level with the model static (flax modules
+    hash by value) so the compile cache survives across
+    speculative_generate() calls instead of re-tracing per call."""
+    params = variables["params"]
+
     def fn(cache, tokens):
-        logits, state = model.apply({**params, "cache": cache}, tokens,
-                                    decode=True, mutable=["cache"])
-        return state["cache"], jnp.argmax(logits, axis=-1)
+        return _greedy_decode_apply(model, params, cache, tokens)
 
     return fn
 
@@ -72,7 +90,8 @@ def speculative_generate(model: LlamaModel, variables,
     if max_new_tokens <= 0:
         out = jnp.zeros((b, 0), jnp.int32)
         return (out, {"target_forwards": 0, "draft_forwards": 0,
-                      "rounds": 0, "accepted_drafts": 0}) \
+                      "rounds": 0, "accepted_drafts": 0,
+                      "live_drafted": 0}) \
             if return_stats else out
     if draft_len < 1:
         raise ValueError(f"draft_len must be >= 1, got {draft_len}")
@@ -85,7 +104,7 @@ def speculative_generate(model: LlamaModel, variables,
                 f"exceeds {which}.max_seq_len {m.config.max_seq_len}")
 
     stats = {"target_forwards": 1, "draft_forwards": 1, "rounds": 0,
-             "accepted_drafts": 0}
+             "accepted_drafts": 0, "live_drafted": 0}
 
     # Prefill both models (counted above); t_last = target's first token.
     logits, cache, _ = _prefill_and_step(model, variables, prompt_tokens,
@@ -110,6 +129,14 @@ def speculative_generate(model: LlamaModel, variables,
 
     while done.min() < max_new_tokens:
         stats["rounds"] += 1
+        # Drafts that could actually be committed this round: the honest
+        # accept-rate denominator.  Finished rows ride along in the
+        # batched draft/verify calls but can never accept, and a row
+        # needing r < draft_len more tokens can accept at most r (the
+        # accepted side is truncated the same way), so a perfect draft
+        # scores exactly 1.0.
+        stats["live_drafted"] += int(np.minimum(
+            draft_len, np.maximum(max_new_tokens - done, 0)).sum())
         # --- draft proposes draft_len tokens -------------------------
         # Re-feed the last two committed tokens at index m-1 (one
         # identical rewrite) so the draft cache is current through m,
