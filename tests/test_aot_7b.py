@@ -1,0 +1,54 @@
+"""Deviceless TPU-AOT memory-analysis machinery (tools/aot_7b.py).
+
+The 7B north-star proof (BENCH_LLAMA.json '7b_aot') rides on this tool:
+jax.experimental.topologies + the real XLA:TPU compiler, no hardware.
+This exercises the machinery at tiny scale so regressions (sharding
+transplant, abstract TrainState construction, memory-analysis math)
+surface in CI; the 7B run itself is a ~13-minute compile, invoked
+manually/by the capture ladder.
+
+Reference has no counterpart (no compile-level capacity proofs);
+SURVEY.md §6 perf-baseline methodology is the parity anchor.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+
+from aot_7b import analyze  # noqa: E402
+
+
+def _tpu_compiler_available() -> bool:
+    try:
+        from jax.experimental import topologies
+        topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x4")
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _tpu_compiler_available(),
+                    reason="libtpu AOT topology unavailable")
+@pytest.mark.parametrize("pallas", [False, True])
+def test_tiny_aot_memory_analysis(pallas):
+    rec = analyze(dp=2, fsdp=4, batch=8, seq=512, backend="tpu",
+                  tiny=True, pallas=pallas)
+    assert rec["backend"] == "tpu-aot-v5e"
+    assert rec["mesh"] == {"dp": 2, "fsdp": 4, "devices": 8}
+    # ZeRO-3 facts: parameters are physically sharded, and the shard
+    # bytes are a proper fraction of the (f32 params + padding) total.
+    assert rec["n_fsdp_sharded_params"] > 0
+    assert 0 < rec["param_shard_bytes_per_device"] < 4 * rec["n_params"]
+    # Donation aliases the state output onto its argument.
+    assert rec["alias_bytes_per_device"] > 0
+    # The tiny config must comfortably fit; peak must be self-consistent.
+    assert rec["fits_v5e_16gb"]
+    expected_peak = (rec["argument_bytes_per_device"]
+                     + rec["temp_bytes_per_device"]
+                     + rec["output_bytes_per_device"]
+                     - rec["alias_bytes_per_device"])
+    assert rec["peak_bytes_per_device"] == expected_peak
