@@ -654,3 +654,59 @@ def test_e2e_suspend_while_gated_tears_down_cleanly():
                 if p.metadata.name.startswith("sgate-worker")]
         cluster.wait_until("v1", "Pod", gone, timeout=20,
                            describe="PodGroup and worker pods deleted")
+
+
+def test_real_cluster_tier_against_cluster_verb():
+    """Self-validation of the opt-in real-cluster tier: point
+    tests/test_real_cluster.py at a `python -m mpi_operator_tpu cluster`
+    process over real HTTP (an 'existing cluster' from the tier's
+    perspective: separate process, network API, kubelets that can run
+    the pod commands) and require it to go green — so the tier is known
+    to execute the moment any real apiserver is reachable."""
+    import re
+    import socket
+    import subprocess
+    import tempfile
+    import time as _t
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    # Child output goes to a FILE, not a pipe: a pipe would block the
+    # cluster process once it fills (nobody drains it during the inner
+    # pytest run), and -u defeats block-buffering of the banner.
+    log = tempfile.NamedTemporaryFile("w+", suffix=".log", delete=False)
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "mpi_operator_tpu", "cluster",
+         "--port", str(port)],
+        cwd=REPO_ROOT, stdout=log, stderr=subprocess.STDOUT)
+    try:
+        deadline = _t.monotonic() + 60
+        banner = ""
+        while _t.monotonic() < deadline:
+            with open(log.name) as f:
+                banner = f.read()
+            if "cluster up" in banner:
+                break
+            assert proc.poll() is None, f"cluster process died: {banner}"
+            _t.sleep(0.2)
+        m = re.search(r"http://[\d.]+:\d+", banner)
+        assert m, f"no apiserver url in: {banner!r}"
+
+        env = dict(os.environ,
+                   MPI_OPERATOR_E2E_MASTER=m.group(0),
+                   MPI_OPERATOR_E2E_RUN_JOBS="1")
+        run = subprocess.run(
+            [sys.executable, "-m", "pytest", "-m", "real_cluster",
+             "-q", "tests/test_real_cluster.py"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+        assert run.returncode == 0, run.stdout + run.stderr
+        counts = re.search(r"(\d+) passed", run.stdout)
+        assert counts and int(counts.group(1)) >= 2, run.stdout
+        assert "skipped" not in run.stdout, run.stdout
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        os.unlink(log.name)
