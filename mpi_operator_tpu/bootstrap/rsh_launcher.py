@@ -41,6 +41,13 @@ from typing import List, Optional
 
 HOSTFILE_ENV_VARS = ("OMPI_MCA_orte_default_hostfile",
                      "I_MPI_HYDRA_HOST_FILE", "HYDRA_HOST_FILE")
+# Per-family rsh-agent extra args, paired with the hostfile var that
+# selects the family (mpirun: plm_rsh_args; mpiexec.hydra: bootstrap
+# exec args — reference operator injects these, mpi_job_controller.go
+# env matrices).
+AGENT_ARGS_ENV_VARS = ("OMPI_MCA_plm_rsh_args",
+                       "I_MPI_HYDRA_BOOTSTRAP_EXEC_EXTRA_ARGS",
+                       "HYDRA_LAUNCH_EXTRA_ARGS")
 
 
 @dataclass
@@ -49,16 +56,23 @@ class HostSlots:
     slots: int = 1
 
 
+def resolve_hostfile_env(env=None):
+    """(matched hostfile env var, declared path) from the operator env
+    matrices, or (None, None) — the var identifies the MPI family, so
+    the agent-args var can be chosen from the SAME family."""
+    env = env if env is not None else os.environ
+    for var in HOSTFILE_ENV_VARS:
+        if env.get(var):
+            return var, env[var]
+    return None, None
+
+
 def resolve_hostfile_path(env=None) -> Optional[str]:
     """Hostfile path from the operator env matrices; inside the local
     kubelet the declared mount path (/etc/mpi) is translated through the
     K_MOUNT_PATH_*/K_MOUNT_* sandbox mapping."""
     env = env if env is not None else os.environ
-    declared = None
-    for var in HOSTFILE_ENV_VARS:
-        if env.get(var):
-            declared = env[var]
-            break
+    _, declared = resolve_hostfile_env(env)
     if declared is None:
         return None
     if os.path.exists(declared):
@@ -137,6 +151,22 @@ def wait_for_dns(hosts: List[str], timeout: float, required: bool = True,
     return False
 
 
+def _is_ssh_like(agent: List[str]) -> bool:
+    """ssh-shaped agents (OpenSSH, or the framework's ssh_client module)
+    JOIN remote tokens for a remote shell and accept -o style args;
+    exec-style agents (rsh_local) do neither.  Only the program token
+    and a python -m module name are examined — an ssh-ish path in some
+    VALUE (--key-dir /etc/ssh) must not flip the classification."""
+    if not agent:
+        return False
+    candidates = [os.path.basename(agent[0])]
+    if "-m" in agent:
+        i = agent.index("-m")
+        if i + 1 < len(agent):
+            candidates.append(agent[i + 1].rsplit(".", 1)[-1])
+    return any("ssh" in c for c in candidates)
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
@@ -147,10 +177,17 @@ def build_rank_commands(hosts: List[HostSlots], workload: List[str],
                         agent: List[str], agent_args: List[str],
                         coordinator_port: int,
                         np: Optional[int] = None,
-                        coordinator: Optional[str] = None) -> List[List[str]]:
+                        coordinator: Optional[str] = None,
+                        shell_quote: bool = False) -> List[List[str]]:
     """One command per rank: agent + args + host + env assignments +
     workload (the rsh contract: everything after the host is the remote
-    command line)."""
+    command line).
+
+    shell_quote: ssh-style agents JOIN the remote tokens into one string
+    that a remote /bin/sh re-parses, so tokens with spaces/quotes must
+    be shell-quoted here (what mpirun does for its rsh tree); exec-style
+    agents (rsh_local) pass tokens straight to execvp and must NOT get
+    quoting baked in."""
     total = sum(h.slots for h in hosts)
     if np is not None:
         total = min(total, np)
@@ -170,9 +207,14 @@ def build_rank_commands(hosts: List[HostSlots], workload: List[str],
                 f"JAX_NUM_PROCESSES={total}",
                 f"OMPI_COMM_WORLD_RANK={rank}",
                 f"OMPI_COMM_WORLD_SIZE={total}",
+                # hydra-family (Intel/MPICH) rank contract.
+                f"PMI_RANK={rank}",
+                f"PMI_SIZE={total}",
             ]
-            cmds.append(agent + agent_args + [h.host, "env"] + assignments
-                        + workload)
+            remote = ["env"] + assignments + workload
+            if shell_quote:
+                remote = [shlex.quote(tok) for tok in remote]
+            cmds.append(agent + agent_args + [h.host] + remote)
             rank += 1
     return cmds
 
@@ -249,10 +291,25 @@ def main(argv=None) -> int:
         return 2
 
     agent = shlex.split(args.rsh)
+    ssh_like = _is_ssh_like(agent)
     agent_args = []
-    if agent and os.path.basename(agent[0]) == "ssh":
-        agent_args = shlex.split(
-            os.environ.get("OMPI_MCA_plm_rsh_args", ""))
+    if agent and ssh_like:
+        # Extra args come from the SAME family as the hostfile var (a
+        # stray OMPI_MCA_plm_rsh_args in a preconfigured base image must
+        # not override an MPICH job's HYDRA_LAUNCH_EXTRA_ARGS); with a
+        # --hostfile override and no matched family, first-set wins.
+        hostfile_var, _ = resolve_hostfile_env()
+        if hostfile_var is not None:
+            candidates = (AGENT_ARGS_ENV_VARS[
+                HOSTFILE_ENV_VARS.index(hostfile_var)],)
+        else:
+            candidates = AGENT_ARGS_ENV_VARS
+        for var in candidates:
+            if os.environ.get(var):
+                agent_args = shlex.split(os.environ[var])
+                break
+    # Only real OpenSSH hard-requires system DNS; the framework's
+    # ssh_client resolves cluster names through netsim itself.
     wait_for_dns([h.host for h in hosts], args.dns_timeout,
                  required=os.path.basename(agent[0]) == "ssh")
 
@@ -272,7 +329,8 @@ def main(argv=None) -> int:
         coordinator = netsim.resolve(hosts[0].host)
 
     cmds = build_rank_commands(hosts, args.workload, agent, agent_args,
-                               port, np=args.np, coordinator=coordinator)
+                               port, np=args.np, coordinator=coordinator,
+                               shell_quote=ssh_like)
     print(f"rsh_launcher: launching {len(cmds)} ranks across "
           f"{len(hosts)} hosts (agent: {' '.join(agent)})", flush=True)
     return run_gang(cmds)

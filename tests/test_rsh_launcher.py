@@ -272,48 +272,103 @@ def test_e2e_operator_ssh_path_launches_ranks(tmp_path):
     into worker/launcher pods as id_rsa/authorized_keys, workers run a
     REAL SSH daemon (libssh wire protocol) on their per-pod IPs, and the
     launcher's rsh tree dials each worker's cluster-DNS name over SSH
-    with pubkey auth to form 2 pi ranks."""
+    with pubkey auth (retry args from the operator-injected
+    OMPI_MCA_plm_rsh_args) to form 2 pi ranks."""
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.native import build_native
+
+    exe = os.path.join(build_native(), "pi_native")
+    logs = _ssh_family_e2e(
+        constants.IMPL_OPENMPI, "sshpi", [exe, "200000"],
+        ["workers=2"], hostfile_needle=" slots=1\n")
+    pi = float(logs.split("pi=")[1].split()[0])
+    assert abs(pi - 3.14159) < 0.05, logs
+
+
+def _ssh_family_e2e(impl: str, name: str, workload: list,
+                    expect_in_logs: list, hostfile_needle: str):
+    """Shared e2e body for every SSH-transport MPI family — the
+    reference drives OpenMPI with mpirun and Intel/MPICH with
+    mpiexec.hydra, all over sshd (mpi_job_test.go:87-274;
+    openmpi/intel/mpich Dockerfiles).  No mpirun/hydra binary exists in
+    this image, so the framework's launcher plays their role over the
+    SAME wire contract: hostfile discovered from the family's env var
+    (OMPI_MCA_orte_default_hostfile / I_MPI_HYDRA_HOST_FILE /
+    HYDRA_HOST_FILE) in the family's format ("host slots=N" vs
+    "host:N"), ssh extra args consumed from the family's args var
+    (OMPI_MCA_plm_rsh_args / I_MPI_HYDRA_BOOTSTRAP_EXEC_EXTRA_ARGS /
+    HYDRA_LAUNCH_EXTRA_ARGS — NOT passed on the command line: the
+    operator-injected env matrix must be what makes the connection
+    retries work), ranks over the real SSH2 wire with both the mpirun
+    (OMPI_COMM_WORLD_*) and hydra (PMI_*) rank contracts."""
     from mpi_operator_tpu.api import constants
     from mpi_operator_tpu.k8s.core import EnvVar
-    from mpi_operator_tpu.native import build_native
     from mpi_operator_tpu.server import LocalCluster
 
     sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
     from test_e2e_local import jax_job
 
-    exe = os.path.join(build_native(), "pi_native")
-    # Workers: the builder's default command is `/usr/sbin/sshd -De`
-    # (builders.py worker path); this image has no OpenSSH, so the pod
-    # command is the framework's own daemon with the same contract —
-    # authorized_keys from the operator Secret's volume projection.
     worker_cmd = [
         "/bin/sh", "-c",
         f"exec {sys.executable} -m mpi_operator_tpu.bootstrap.sshd"
         f" --port 2222 --bind-pod-ip"
         f" --authorized-keys \"$K_MOUNT_SSH_AUTH/authorized_keys\""]
-    # Launcher: mpirun equivalent over the ssh agent, identity from the
-    # same Secret projection.
     launcher_cmd = [
         "/bin/sh", "-c",
         f"exec {sys.executable} -m mpi_operator_tpu.bootstrap.rsh_launcher"
         f" --rsh \"{sys.executable} -m mpi_operator_tpu.bootstrap.ssh_client"
-        f" -p 2222 -i $K_MOUNT_SSH_AUTH/id_rsa"
-        f" -o ConnectionAttempts=10\""
-        f" --dns-timeout 10 -- {exe} 200000"]
+        f" -p 2222 -i $K_MOUNT_SSH_AUTH/id_rsa\""
+        f" --dns-timeout 10 -- " + " ".join(workload)]
 
     with LocalCluster() as cluster:
-        job = jax_job("sshpi", launcher_cmd=launcher_cmd,
+        job = jax_job(name, launcher_cmd=launcher_cmd,
                       worker_cmd=worker_cmd, workers=2)
-        job.spec.mpi_implementation = constants.IMPL_OPENMPI
+        job.spec.mpi_implementation = impl
         for rt in (constants.REPLICA_TYPE_LAUNCHER,
                    constants.REPLICA_TYPE_WORKER):
             job.spec.mpi_replica_specs[rt].template.spec.containers[0] \
                 .env.append(EnvVar("PYTHONPATH", REPO_ROOT))
         cluster.submit(job)
-        cluster.wait_for_condition("default", "sshpi",
+        cluster.wait_for_condition("default", name,
                                    constants.JOB_SUCCEEDED, timeout=120)
-        logs = cluster.launcher_logs("default", "sshpi")
+        # The family-format hostfile is what the launcher actually read.
+        cm = cluster.client.config_maps("default").get(f"{name}-config")
+        assert hostfile_needle in cm.data["hostfile"], cm.data["hostfile"]
+        logs = cluster.launcher_logs("default", name)
     assert "launching 2 ranks across 2 hosts" in logs, logs
-    assert "workers=2" in logs, logs
-    pi = float(logs.split("pi=")[1].split()[0])
-    assert abs(pi - 3.14159) < 0.05, logs
+    for needle in expect_in_logs:
+        assert needle in logs, logs
+    return logs
+
+
+def test_e2e_intel_env_matrix_drives_launcher(tmp_path):
+    """Intel mode end to end: I_MPI_HYDRA_HOST_FILE selects the hostfile,
+    I_MPI_HYDRA_BOOTSTRAP_EXEC_EXTRA_ARGS supplies the ssh retry args,
+    and every rank sees hydra's PMI_RANK/PMI_SIZE (asserted in-rank)."""
+    from mpi_operator_tpu.api import constants
+
+    probe = tmp_path / "pmi_probe.py"
+    probe.write_text(
+        "import os\n"
+        "r, s = os.environ['PMI_RANK'], os.environ['PMI_SIZE']\n"
+        "assert s == '2', s\n"
+        "assert os.environ['OMPI_COMM_WORLD_RANK'] == r\n"
+        "print(f'pmi rank {r}/{s} ok', flush=True)\n")
+    _ssh_family_e2e(
+        constants.IMPL_INTEL, "intelpmi",
+        [sys.executable, str(probe)],
+        ["pmi rank 0/2 ok", "pmi rank 1/2 ok"],
+        hostfile_needle=":1\n")
+
+
+def test_e2e_mpich_env_matrix_runs_collective(tmp_path):
+    """MPICH mode end to end: HYDRA_HOST_FILE + HYDRA_LAUNCH_EXTRA_ARGS
+    drive the launcher and the ranks form a real tpucoll ring (the
+    2-rank pi reduction) over the SSH2 wire."""
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.native import build_native
+
+    exe = os.path.join(build_native(), "pi_native")
+    _ssh_family_e2e(
+        constants.IMPL_MPICH, "mpichpi", [exe, "200000"],
+        ["workers=2", "pi="], hostfile_needle=":1\n")
