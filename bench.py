@@ -291,8 +291,11 @@ def main() -> None:
         line, diag = _attempt(False, min(attempt_timeout, budget), env=env)
         if line is not None:
             rec = json.loads(line)
-            rec["error"] = ("TPU backend unreachable; CPU fallback "
-                            "measurement, NOT comparable to baseline: "
+            rec["error"] = ("TPU backend unreachable (client-side "
+                            "diagnosis: tools/TPU_TUNNEL_DIAGNOSIS.md — "
+                            "relay accepts TCP then instantly closes); "
+                            "CPU fallback measurement, NOT comparable "
+                            "to baseline: "
                             + " | ".join(errors))[:1000]
             print(json.dumps(rec))
             sys.stdout.flush()

@@ -135,7 +135,7 @@ def speculative_generate(model: LlamaModel, variables,
         # needing r < draft_len more tokens can accept at most r (the
         # accepted side is truncated the same way), so a perfect draft
         # scores exactly 1.0.
-        stats["live_drafted"] += int(np.minimum(
+        stats["live_drafted"] = int(stats["live_drafted"] + np.minimum(
             draft_len, np.maximum(max_new_tokens - done, 0)).sum())
         # --- draft proposes draft_len tokens -------------------------
         # Re-feed the last two committed tokens at index m-1 (one
@@ -172,7 +172,9 @@ def speculative_generate(model: LlamaModel, variables,
                 continue  # finished row: cache index stays parked
             j = int(accepted[row])
             emit = g_np[row, :j + 1]                    # d1..dj, bonus
-            take = min(len(emit), max_new_tokens - done[row])
+            # int(): done is an np array, and np.int64 leaking into the
+            # stats counters makes them np scalars json.dumps rejects.
+            take = int(min(len(emit), max_new_tokens - done[row]))
             # Count only drafts actually committed: a truncated emit
             # (take < len(emit)) drops trailing drafts, and the final
             # position of emit is the bonus token, not a draft.

@@ -656,6 +656,32 @@ def test_e2e_suspend_while_gated_tears_down_cleanly():
                            describe="PodGroup and worker pods deleted")
 
 
+def _run_real_cluster_tier(master_url: str, **tier_env):
+    """Run `pytest -m real_cluster` against the given master and require
+    it fully green (>= 2 passed, zero skips).  The tier's own env knobs
+    are reset to exactly `tier_env` — an exported RUN_JOBS/
+    USE_EXISTING_CLUSTER in the developer's shell must not leak into
+    the child and change what the tier attempts."""
+    import re
+    import subprocess
+
+    env = dict(os.environ, MPI_OPERATOR_E2E_MASTER=master_url)
+    for var in ("MPI_OPERATOR_E2E_RUN_JOBS",
+                "MPI_OPERATOR_E2E_START_OPERATOR",
+                "MPI_OPERATOR_E2E_NAMESPACE", "USE_EXISTING_CLUSTER"):
+        env.pop(var, None)
+    env.update(tier_env)
+    run = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "real_cluster",
+         "-q", "tests/test_real_cluster.py"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=600)
+    assert run.returncode == 0, run.stdout + run.stderr
+    counts = re.search(r"(\d+) passed", run.stdout)
+    assert counts and int(counts.group(1)) >= 2, run.stdout
+    assert "skipped" not in run.stdout, run.stdout
+
+
 def test_real_cluster_tier_against_cluster_verb():
     """Self-validation of the opt-in real-cluster tier: point
     tests/test_real_cluster.py at a `python -m mpi_operator_tpu cluster`
@@ -694,19 +720,27 @@ def test_real_cluster_tier_against_cluster_verb():
         m = re.search(r"http://[\d.]+:\d+", banner)
         assert m, f"no apiserver url in: {banner!r}"
 
-        env = dict(os.environ,
-                   MPI_OPERATOR_E2E_MASTER=m.group(0),
-                   MPI_OPERATOR_E2E_RUN_JOBS="1")
-        run = subprocess.run(
-            [sys.executable, "-m", "pytest", "-m", "real_cluster",
-             "-q", "tests/test_real_cluster.py"],
-            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
-            timeout=600)
-        assert run.returncode == 0, run.stdout + run.stderr
-        counts = re.search(r"(\d+) passed", run.stdout)
-        assert counts and int(counts.group(1)) >= 2, run.stdout
-        assert "skipped" not in run.stdout, run.stdout
+        # The cluster verb has kubelets, so job completion is in scope.
+        _run_real_cluster_tier(m.group(0), MPI_OPERATOR_E2E_RUN_JOBS="1")
     finally:
         proc.terminate()
         proc.wait(timeout=10)
         os.unlink(log.name)
+
+
+def test_real_cluster_tier_against_kube_grammar_fixture():
+    """The tier's OTHER transport branch: a server speaking real kube
+    REST grammar (KubeFixtureServer, the envtest analogue) with no
+    in-cluster operator — probe_is_kube flips the tier onto the
+    KubeApiServer transport and MPI_OPERATOR_E2E_START_OPERATOR=1
+    exercises the bare-apiserver mode where the tier's own OperatorApp
+    reconciles.  No kubelets exist, so job COMPLETION is out of scope
+    (RUN_JOBS stays unset); resource creation is the assertion."""
+    from mpi_operator_tpu.k8s.kube_transport import KubeFixtureServer
+
+    srv = KubeFixtureServer().start()
+    try:
+        _run_real_cluster_tier(srv.url,
+                               MPI_OPERATOR_E2E_START_OPERATOR="1")
+    finally:
+        srv.stop()

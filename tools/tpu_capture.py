@@ -307,6 +307,78 @@ def serve_bench() -> dict:
         batcher.stop()
 
 
+def speculative_bench() -> dict:
+    """Speculative-decoding economics on the real chip: per-forward cost
+    ratio c = draft/target and the measured speedup at the accept-rate
+    ceiling (draft=self -> ~1.0) and floor (untrained tiny draft -> ~0);
+    speedup(a) for a trained draft interpolates as
+    (k+1) / (k*c + 1 + overhead) scaled by acceptance a."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mpi_operator_tpu.models.llama import (LlamaConfig, LlamaModel,
+                                               greedy_generate)
+    from mpi_operator_tpu.models.speculative import speculative_generate
+
+    dim, n_layers, seq = (128, 2, 256) if SMOKE else (2048, 16, 2048)
+    new_tokens, prompt_len = (8, 32) if SMOKE else (64, 128)
+    draft_len, batch = 4, 2
+    cfg = LlamaConfig(vocab_size=32000, dim=dim, n_layers=n_layers,
+                      n_heads=max(1, dim // 128),
+                      n_kv_heads=max(1, dim // 512), max_seq_len=seq)
+    dcfg = LlamaConfig(vocab_size=32000, dim=max(128, dim // 4),
+                       n_layers=max(1, n_layers // 8),
+                       n_heads=max(1, dim // 512), n_kv_heads=1,
+                       max_seq_len=seq)
+    model, draft = LlamaModel(cfg), LlamaModel(dcfg)
+    mvars = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    dvars = draft.init(jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(1)
+    prompts = rng.integers(1, cfg.vocab_size, (batch, prompt_len),
+                           dtype=np.int32)
+
+    # block_until_ready everywhere: generate() is async dispatch, and an
+    # unsynced timed region would measure queueing, not execution (the
+    # speculative path is effectively synced by its host-side acceptance
+    # loop, so asymmetry here would inflate its 'speedup').
+    jax.block_until_ready(greedy_generate(model, mvars, prompts, 4))
+    jax.block_until_ready(greedy_generate(draft, dvars, prompts, 4))
+    for dm, dv in ((model, mvars), (draft, dvars)):
+        jax.block_until_ready(speculative_generate(
+            model, mvars, dm, dv, prompts, 4, draft_len=draft_len))
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        greedy_generate(model, mvars, prompts, new_tokens))
+    plain_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        greedy_generate(draft, dvars, prompts, new_tokens))
+    draft_s = time.perf_counter() - t0
+
+    rec = {"metric": "speculative_decode",
+           "draft_len": draft_len, "new_tokens": new_tokens,
+           "batch": batch,
+           "plain_tokens_per_sec": round(
+               batch * new_tokens / plain_s, 1),
+           "draft_target_cost_ratio": round(draft_s / plain_s, 4)}
+    for name, dm, dv in (("self", model, mvars), ("tiny", draft, dvars)):
+        t0 = time.perf_counter()
+        out, stats = speculative_generate(
+            model, mvars, dm, dv, prompts, new_tokens,
+            draft_len=draft_len, return_stats=True)
+        jax.block_until_ready(out)
+        spec_s = time.perf_counter() - t0
+        # float()/int(): the stats counters pick up numpy scalar types
+        # from the acceptance loop, and json.dumps rejects np.float64.
+        rec[name] = {
+            "accept_rate": round(float(stats["accepted_drafts"])
+                                 / max(1, int(stats["live_drafted"])), 4),
+            "target_forwards": int(stats["target_forwards"]),
+            "speedup": round(plain_s / spec_s, 3)}
+    return rec
+
+
 def kernel_ab() -> dict:
     """Pallas flash attention vs XLA attention, fwd + bwd wall time."""
     import jax
@@ -396,6 +468,7 @@ def main() -> int:
     cap.phase("llama_train_fused_xent", 400,
               lambda: llama_bench(fused_xent=True))
     cap.phase("serve", 500, serve_bench)
+    cap.phase("speculative", 300, speculative_bench)
     cap.phase("kernel_ab", 400, kernel_ab)
     cap.emit({"phase": "done", "remaining_s": round(cap.remaining(), 1)})
     return 0
