@@ -78,7 +78,9 @@ class ContinuousBatcher:
     def __init__(self, model, variables, max_slots: int = 4,
                  device_lock: Optional[threading.Lock] = None,
                  page_size: int = 0, cache_blocks: int = 0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 draft_model=None, draft_variables=None,
+                 draft_len: int = 4):
         import dataclasses
 
         import jax
@@ -170,6 +172,67 @@ class ContinuousBatcher:
         self._prefill_cache = {}
         self._max_seq_len = cfg.max_seq_len
 
+        # Speculative decoding (greedy slots): a small same-vocab draft
+        # proposes draft_len tokens per tick through its OWN per-slot
+        # dense cache; the target verifies all slots in ONE width-(k+1)
+        # decode and commits its own argmax prefix + bonus.  A tick with
+        # any sampling slot falls back to plain width-1 decode (the
+        # acceptance rule is only lossless for argmax).
+        self.draft_len = int(draft_len)
+        self._draft_model = draft_model
+        if (draft_model is None) != (draft_variables is None):
+            raise ValueError("draft_model and draft_variables go together")
+        if draft_model is not None:
+            dcfg = draft_model.config
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError("draft/target vocab_size mismatch")
+            if getattr(dcfg, "page_size", 0) > 0:
+                raise ValueError("draft model must be dense-layout")
+            if dcfg.max_seq_len < cfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {dcfg.max_seq_len} < target "
+                    f"{cfg.max_seq_len}: verify rounds write past it")
+            if self.draft_len < 1:
+                raise ValueError("draft_len must be >= 1")
+            dparams = {"params": draft_variables["params"]}
+            _, dstate = draft_model.apply(
+                dparams, jnp.zeros((max_slots, 1), jnp.int32),
+                decode=True, mutable=["cache"])
+            dcache = dstate["cache"]
+            if hasattr(dcache, "unfreeze"):
+                dcache = dcache.unfreeze()
+            self._draft_cache = self._reset_cache(dcache)
+
+            @jax.jit
+            def draft_step(cache, tokens):
+                logits, state = draft_model.apply(
+                    {**dparams, "cache": cache}, tokens, decode=True,
+                    mutable=["cache"])
+                return (state["cache"],
+                        jnp.argmax(logits[:, -1], axis=-1)
+                        .astype(jnp.int32))
+
+            @jax.jit
+            def verify_step(cache, tokens):
+                logits, state = decode_model.apply(
+                    {**params, "cache": cache}, tokens, decode=True,
+                    mutable=["cache"])
+                return (state["cache"],
+                        jnp.argmax(logits, axis=-1).astype(jnp.int32))
+
+            self._draft_step = draft_step
+            self._verify_step = verify_step
+            self._draft_prefill_cache = {}
+            self._dparams = dparams
+            # slot -> highest committed position whose K/V the draft
+            # cache validly holds.  Plain-tick interludes advance the
+            # committed stream without the draft seeing it; on
+            # spec-resume a lagging slot is re-prefilled, else its
+            # proposals would be argmax over zero K/V forever.
+            self._draft_pos: dict = {}
+        self.spec_stats = {"spec_ticks": 0, "plain_ticks": 0,
+                           "accepted_drafts": 0, "drafted": 0}
+
     # -- cache plumbing ----------------------------------------------------
     def _reset_cache(self, cache):
         return self._jax.tree_util.tree_map(self._jnp.zeros_like, cache)
@@ -199,13 +262,13 @@ class ContinuousBatcher:
                              jnp.int32)
         return fn(padded, len(tokens), *sample_args)
 
-    def _install(self, slot: int, row_cache, length: int):
-        """Copy a batch-1 prefill cache into persistent slot `slot`."""
+    def _install_dense_row(self, cache, slot: int, row_cache,
+                           length: int):
+        """Copy a batch-1 prefill cache into row `slot` of a dense
+        per-slot cache (the target's dense layout AND the draft's)."""
         jnp = self._jnp
         if hasattr(row_cache, "unfreeze"):
             row_cache = row_cache.unfreeze()
-        if self.page_size > 0:
-            return self._install_paged(slot, row_cache, length)
 
         def rec(dst, src):
             if hasattr(dst, "items"):
@@ -214,7 +277,149 @@ class ContinuousBatcher:
                 L = min(dst.shape[1], src.shape[1])
                 return dst.at[slot, :L].set(src[0, :L])
             return dst.at[slot].set(jnp.int32(length))  # cache_index [B]
-        self._cache = rec(self._cache, row_cache)
+        return rec(cache, row_cache)
+
+    def _install(self, slot: int, row_cache, length: int):
+        """Copy a batch-1 prefill cache into persistent slot `slot`."""
+        if self.page_size > 0:
+            if hasattr(row_cache, "unfreeze"):
+                row_cache = row_cache.unfreeze()
+            return self._install_paged(slot, row_cache, length)
+        self._cache = self._install_dense_row(self._cache, slot,
+                                              row_cache, length)
+
+    # -- speculative decoding ----------------------------------------------
+    def _draft_prefill_install(self, slot: int, tokens: List[int]):
+        """Prefill the prompt through the draft model (batch-1 dense)
+        and install the row into the draft slot cache."""
+        jax, jnp = self._jax, self._jnp
+        width = _bucket(len(tokens), self._draft_model.config.max_seq_len)
+        fn = self._draft_prefill_cache.get(width)
+        if fn is None:
+            dparams = self._dparams
+            draft_model = self._draft_model
+
+            @jax.jit
+            def dprefill(padded):
+                _, state = draft_model.apply(dparams, padded, decode=True,
+                                             mutable=["cache"])
+                return state["cache"]
+
+            fn = self._draft_prefill_cache[width] = dprefill
+        padded = jnp.asarray([tokens + [0] * (width - len(tokens))],
+                             jnp.int32)
+        self._draft_cache = self._install_dense_row(
+            self._draft_cache, slot, fn(padded), len(tokens))
+        self._draft_pos[slot] = len(tokens) - 1
+
+    def _speculative_tick(self, slots, next_tokens):
+        """One speculation round across every active (all-greedy) slot:
+        k draft proposals through the draft's per-slot cache, ONE
+        width-(k+1) target verify, per-slot longest-prefix acceptance +
+        bonus, per-row cache_index rollback over rejected positions
+        (stale K/V past the index is masked and overwritten — the same
+        contract the variable-length decode path relies on).  Inactive
+        slots ride along: their dense rows are garbage that admit
+        resets, and their paged tables point at reserved scratch
+        block 0.  Mirrors models/speculative.py at slot granularity."""
+        import numpy as np
+
+        from ..models.llama import _set_cache_index
+
+        jnp = self._jnp
+        k = self.draft_len
+        active = [i for i, r in enumerate(slots) if r is not None]
+        hists = {i: slots[i].tokens + slots[i].output for i in active}
+        m = np.zeros((self.max_slots,), np.int64)
+        for i in active:
+            # Committed-and-cached length: everything but the newest
+            # emitted token is in both caches (plain-tick invariant).
+            m[i] = len(hists[i]) - 1
+
+        # Draft proposes k tokens: re-feed the last two committed tokens
+        # at index m-1 (one identical K/V rewrite) so the draft cache is
+        # current through m, then extend one token at a time.  Device
+        # calls hold the shared lock; host-side acceptance/emission runs
+        # after it is released (the plain tick's contract).
+        feed = np.zeros((self.max_slots, 2), np.int32)
+        for i in active:
+            feed[i] = (hists[i][m[i] - 1], hists[i][m[i]])
+        t_last = np.zeros((self.max_slots,), np.int32)
+        for i in active:
+            t_last[i] = hists[i][m[i]]
+        with self._device_lock:
+            # Spec-resume catch-up: a plain-tick interlude (sampling
+            # neighbor) advances the committed stream without the draft
+            # seeing it; the 2-token re-feed only covers positions
+            # m-1/m, so a slot whose coverage lags further gets a full
+            # re-prefill of its committed prefix.
+            for i in active:
+                if self._draft_pos.get(i, -1) < m[i] - 2:
+                    self._draft_prefill_install(i, hists[i][:m[i] + 1])
+            d_cache = _set_cache_index(
+                self._draft_cache,
+                jnp.asarray(np.maximum(m - 1, 0), jnp.int32))
+            d_cache, g = self._draft_step(d_cache, jnp.asarray(feed))
+            drafts = [g]
+            for _ in range(k - 1):
+                d_cache, g = self._draft_step(d_cache, g[:, None])
+                drafts.append(g)
+            self._draft_cache = d_cache
+            drafted = np.stack([np.asarray(d) for d in drafts], axis=1)
+
+            # Target verifies all slots in one width-(k+1) forward.
+            verify_tokens = np.concatenate([t_last[:, None], drafted],
+                                           axis=1)
+            cache = _set_cache_index(
+                self._cache, jnp.asarray(np.maximum(m, 0), jnp.int32))
+            cache, greedy = self._verify_step(
+                cache, jnp.asarray(verify_tokens, dtype=jnp.int32))
+            # Publish the post-verify cache BEFORE retirements:
+            # _retire_slot rewrites self._cache (block table back to
+            # scratch), and a later overwrite from a stale local would
+            # undo that.
+            self._cache = cache
+            g_np = np.asarray(greedy)                   # [B, k+1]
+
+        # Acceptance + emission per slot (lock released: emit() runs
+        # streaming callbacks).
+        match = drafted == g_np[:, :-1]
+        accepted = np.cumprod(match, axis=1).sum(axis=1)
+        self.spec_stats["spec_ticks"] += 1
+        for i in active:
+            req = slots[i]
+            if req.cancelled.is_set():
+                req.done.set()
+                slots[i] = None
+                self._retire_slot(i)
+                self._draft_pos.pop(i, None)
+                continue
+            remaining = req.max_new_tokens - len(req.output)
+            self.spec_stats["drafted"] += min(k, remaining)
+            j = int(accepted[i])
+            emit = g_np[i, :j + 1]
+            take = int(min(len(emit), remaining))
+            self.spec_stats["accepted_drafts"] += min(j, take)
+            for tok in emit[:take]:
+                req.emit(int(tok))
+            # Draft coverage: positions m+1..m+min(j, take) hold
+            # accepted (committed) drafts; the bonus slot is garbage.
+            self._draft_pos[i] = int(m[i] + min(j, take))
+            m[i] += take
+            if len(req.output) >= req.max_new_tokens:
+                req.done.set()
+                slots[i] = None
+                self._retire_slot(i)
+                self._draft_pos.pop(i, None)
+            else:
+                # Keep the plain-tick invariant for a possible fallback
+                # tick: next_tokens carries the newest emitted token.
+                next_tokens = next_tokens.at[i].set(int(req.output[-1]))
+
+        # Roll every row's write position back over rejected slots.
+        self._cache = _set_cache_index(
+            self._cache, jnp.asarray(np.maximum(m, 0), jnp.int32))
+        return next_tokens
 
     # -- paged-pool plumbing ----------------------------------------------
     def _blocks_needed(self, total_tokens: int) -> int:
@@ -452,15 +657,27 @@ class ContinuousBatcher:
         return first, key1
 
     # -- public API --------------------------------------------------------
+    def _headroom(self, temperature: float) -> int:
+        """Cache positions past prompt + max_new a verify round may
+        touch (the last round can draft past the needed tokens).  Only
+        greedy requests ever speculate, so sampling requests are not
+        charged for it."""
+        if self._draft_model is None or temperature > 0.0:
+            return 0
+        return self.draft_len + 1
+
     def _enqueue(self, tokens, max_new_tokens, temperature, top_p, seed,
                  on_token=None) -> _Request:
-        if len(tokens) + max_new_tokens > self._max_seq_len:
+        headroom = self._headroom(temperature)
+        if len(tokens) + max_new_tokens + headroom > self._max_seq_len:
             raise ValueError(
                 f"prompt ({len(tokens)}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({max_new_tokens}) + speculation headroom "
+                f"({headroom}) exceeds max_seq_len "
                 f"{self._max_seq_len}")
         if self.page_size > 0:
-            need = self._blocks_needed(len(tokens) + max_new_tokens)
+            need = self._blocks_needed(
+                len(tokens) + max_new_tokens + headroom)
             if need > self._total_blocks:
                 raise ValueError(
                     f"request needs {need} cache blocks but the pool "
@@ -578,7 +795,8 @@ class ContinuousBatcher:
                     req.done.set()
                     continue
                 if self.page_size > 0 and not self._alloc_blocks(
-                        i, len(req.tokens) + req.max_new_tokens,
+                        i, len(req.tokens) + req.max_new_tokens
+                        + self._headroom(req.temperature),
                         tokens=req.tokens):
                     deferred = req  # pool exhausted; retry after retires
                     deferred_mark = self._retire_count
@@ -598,6 +816,11 @@ class ContinuousBatcher:
                             row_cache, first, key1 = self._prefill(
                                 req.tokens, sample_args)
                             self._install(i, row_cache, len(req.tokens))
+                        if (self._draft_model is not None
+                                and req.temperature <= 0.0):
+                            # Sampling slots never speculate, so their
+                            # draft rows can stay garbage.
+                            self._draft_prefill_install(i, req.tokens)
                     if self.page_size > 0:
                         self._register_blocks(i, req.tokens)
                     req.emit(int(first))
@@ -626,8 +849,20 @@ class ContinuousBatcher:
                         pass
                 continue
 
+            # Speculation: when a draft model is loaded and every active
+            # slot is greedy, one tick = k draft steps + ONE target
+            # verify committing 1..k+1 tokens per slot.  Any sampling
+            # slot forces plain ticks (acceptance is argmax-only).
+            if self._draft_model is not None and all(
+                    r.temperature <= 0.0 for r in slots if r is not None):
+                # Takes the device lock internally, only around the
+                # draft/verify device calls.
+                next_tokens = self._speculative_tick(slots, next_tokens)
+                continue
+
             # One decode step across every slot (inactive slots decode
             # garbage into their own rows; they are reset on admit).
+            self.spec_stats["plain_ticks"] += 1
             with self._device_lock:
                 self._cache, out, keys = self._decode_step(
                     self._cache, next_tokens, temps, top_ps, keys)

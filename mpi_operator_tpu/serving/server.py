@@ -153,12 +153,17 @@ class InferenceServer:
                 "dense cache")
         if max_batch_slots > 0:
             from .batcher import ContinuousBatcher
+            # The draft rides into the batcher too: greedy batched
+            # requests speculate (k draft steps + one verify per tick)
+            # whenever every active slot is greedy.
             self._batcher = ContinuousBatcher(model, self.variables,
                                               max_slots=max_batch_slots,
                                               device_lock=self._lock,
                                               page_size=kv_page_size,
                                               cache_blocks=kv_cache_blocks,
-                                              prefix_cache=kv_prefix_cache)
+                                              prefix_cache=kv_prefix_cache,
+                                              draft_model=draft_model,
+                                              draft_variables=draft_variables)
 
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,

@@ -525,3 +525,231 @@ def test_cancelled_deferred_request_is_reaped_without_retirement():
         assert req_b.done.is_set() and req_b.error is None
     finally:
         batcher.stop()
+
+
+# -- speculative decoding in the batcher -----------------------------------
+
+import dataclasses
+
+
+@pytest.fixture(scope="module", params=[0, 16],
+                ids=["dense", "paged"])
+def spec_setup(request):
+    """Batcher with a DIFFERENT-weights draft (rejection paths run) over
+    both cache layouts, plus a plain batcher for equivalence checks."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    dcfg = dataclasses.replace(cfg, n_layers=1, dim=32, n_heads=2,
+                               n_kv_heads=2)
+    draft = LlamaModel(dcfg)
+    dvars = draft.init(jax.random.PRNGKey(7), jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=3,
+                                page_size=request.param,
+                                draft_model=draft, draft_variables=dvars,
+                                draft_len=3).start()
+    yield batcher, model, variables
+    batcher.stop()
+
+
+def test_speculative_batcher_matches_plain_greedy(spec_setup):
+    """Concurrent greedy requests through the speculative batcher must
+    be token-identical to greedy_generate, whatever the draft proposes
+    — acceptance only ever commits the target's own verify argmax."""
+    batcher, model, variables = spec_setup
+    prompts = [[5, 3, 8, 1], [7, 6], [1, 2, 3, 4, 5, 6, 7],
+               [9], [4, 4, 4], [2, 7, 1, 8, 2, 8]]
+    results = [None] * len(prompts)
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = batcher.submit(prompts[i], 6)
+        except Exception as exc:
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    for i, p in enumerate(prompts):
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([p], jnp.int32), 6)
+        np.testing.assert_array_equal(np.asarray(results[i]),
+                                      np.asarray(expected[0]),
+                                      err_msg=f"prompt {i}")
+    assert batcher.spec_stats["spec_ticks"] > 0
+    assert batcher.spec_stats["drafted"] > 0
+
+
+def test_perfect_draft_cuts_target_ticks():
+    """draft == target: near-total acceptance, so target forwards
+    (spec_ticks) land near max_new/(k+1) instead of max_new."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                draft_model=model,
+                                draft_variables=variables,
+                                draft_len=3).start()
+    try:
+        prompt = [5, 3, 8, 1, 9, 2]
+        out = batcher.submit(prompt, 12)
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([prompt], jnp.int32), 12)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(expected[0]))
+        st = batcher.spec_stats
+        # 1 token at admit + ceil(11/4) fully-accepted rounds = 3 ticks.
+        assert st["spec_ticks"] <= 4, st
+        assert st["accepted_drafts"] >= 6, st
+    finally:
+        batcher.stop()
+
+
+def test_sampling_request_forces_plain_ticks(spec_setup):
+    """A sampling request in the batch suspends speculation (acceptance
+    is argmax-only) without corrupting either request's stream."""
+    batcher, model, variables = spec_setup
+    before_plain = batcher.spec_stats["plain_ticks"]
+    results = {}
+    errors = []
+
+    def run(name, kwargs):
+        try:
+            results[name] = batcher.submit([5, 3, 8, 1], 6, **kwargs)
+        except Exception as exc:
+            errors.append((name, exc))
+
+    threads = [threading.Thread(
+        target=run, args=("sampled", dict(temperature=0.8, seed=42))),
+        threading.Thread(target=run, args=("greedy", dict()))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert len(results["sampled"]) == 6
+    expected = greedy_generate(model, variables,
+                               jnp.asarray([[5, 3, 8, 1]], jnp.int32), 6)
+    np.testing.assert_array_equal(np.asarray(results["greedy"]),
+                                  np.asarray(expected[0]))
+    assert batcher.spec_stats["plain_ticks"] > before_plain
+
+
+def test_speculative_headroom_enforced(spec_setup):
+    batcher, _, _ = spec_setup
+    max_len = batcher._max_seq_len
+    with pytest.raises(ValueError, match="speculation headroom"):
+        batcher.submit([1] * (max_len - 8), 8)  # fits without headroom
+
+
+def test_http_server_batched_speculative():
+    """The HTTP surface with batching + a draft model: greedy clients
+    ride speculative ticks and still get the exact greedy stream."""
+    import json
+    import urllib.request
+
+    from mpi_operator_tpu.serving import InferenceServer
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 4), jnp.int32))
+    dcfg = dataclasses.replace(cfg, n_layers=1, dim=32, n_heads=2,
+                               n_kv_heads=2)
+    draft = LlamaModel(dcfg)
+    dvars = draft.init(jax.random.PRNGKey(7), jnp.zeros((1, 4), jnp.int32))
+    server = InferenceServer(model, variables, host="127.0.0.1",
+                             max_batch_slots=2, draft_model=draft,
+                             draft_variables=dvars).start()
+    try:
+        prompts = [[3, 1, 4], [1, 5, 9, 2, 6]]
+        results = [None] * len(prompts)
+
+        def post(i):
+            req = urllib.request.Request(
+                server.url + "/generate",
+                data=json.dumps({"tokens": [prompts[i]],
+                                 "max_new_tokens": 5}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                results[i] = json.loads(resp.read())["tokens"][0]
+
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for i, p in enumerate(prompts):
+            expected = greedy_generate(model, variables,
+                                       jnp.asarray([p], jnp.int32), 5)
+            np.testing.assert_array_equal(np.asarray(results[i]),
+                                          np.asarray(expected[0]))
+        assert server._batcher.spec_stats["spec_ticks"] > 0
+    finally:
+        server.stop()
+
+
+def test_sampling_request_not_charged_speculation_headroom(spec_setup):
+    """Sampling slots never speculate, so a request that only fits
+    without the draft headroom must be admitted when sampling."""
+    batcher, _, _ = spec_setup
+    max_len = batcher._max_seq_len
+    out = batcher.submit([1] * (max_len - 8), 8, temperature=0.8, seed=3)
+    assert len(out) == 8
+
+
+def test_draft_cache_catches_up_after_plain_interlude():
+    """A greedy slot that advanced through plain ticks (sampling
+    neighbor active) must re-sync its draft cache when speculation
+    resumes — with draft == target, acceptance after resume proves the
+    draft saw the plain-tick tokens (a desynced draft would propose
+    argmax over zero K/V and accept ~nothing)."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                draft_model=model,
+                                draft_variables=variables,
+                                draft_len=3).start()
+    try:
+        results = {}
+
+        def run(name, kwargs, n):
+            results[name] = batcher.submit([5, 3, 8, 1], n, **kwargs)
+
+        # Sampling request first (forces plain ticks), greedy rides
+        # along for its first ~10 tokens, then speculation resumes for
+        # the greedy tail.
+        ts = [threading.Thread(target=run, args=(
+                  "sampled", dict(temperature=0.9, seed=11), 10)),
+              threading.Thread(target=run, args=("greedy", dict(), 24))]
+        ts[0].start()
+        import time
+        time.sleep(0.3)  # let the sampling request claim its slot first
+        ts[1].start()
+        for t in ts:
+            t.join(timeout=300)
+        assert len(results["sampled"]) == 10
+        expected = greedy_generate(model, variables,
+                                   jnp.asarray([[5, 3, 8, 1]], jnp.int32),
+                                   24)
+        np.testing.assert_array_equal(np.asarray(results["greedy"]),
+                                      np.asarray(expected[0]))
+        st = batcher.spec_stats
+        assert st["plain_ticks"] > 0, st     # interlude actually happened
+        assert st["spec_ticks"] > 0, st      # speculation resumed
+        # Perfect draft: post-resume acceptance must be near-total, not
+        # the ~0 a desynced draft cache would produce.
+        assert st["accepted_drafts"] >= st["drafted"] * 0.8, st
+    finally:
+        batcher.stop()
