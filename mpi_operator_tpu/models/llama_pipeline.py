@@ -59,7 +59,8 @@ def _staged_blocks(config: LlamaConfig, variables: dict, positions, pp: int):
 
 
 def pipeline_forward(config: LlamaConfig, variables: dict, tokens,
-                     mesh, num_microbatches: int = 4):
+                     mesh, num_microbatches: int = 4,
+                     fsdp_shard: bool = False):
     """Pipelined causal-LM forward: tokens [B, S] -> logits [B, S, V].
 
     The mesh must carry a 'pp' axis dividing n_layers; batch B must
@@ -77,7 +78,8 @@ def pipeline_forward(config: LlamaConfig, variables: dict, tokens,
 
     stage_fn, staged = _staged_blocks(config, variables, positions, pp)
     micro = split_microbatches(x, num_microbatches)
-    x = merge_microbatches(pipeline_apply(stage_fn, staged, micro, mesh))
+    x = merge_microbatches(pipeline_apply(stage_fn, staged, micro, mesh,
+                                          fsdp_shard=fsdp_shard))
 
     x = RMSNorm(config.norm_eps, config.param_dtype).apply(
         {"params": params["norm"]}, x)
@@ -86,16 +88,17 @@ def pipeline_forward(config: LlamaConfig, variables: dict, tokens,
 
 
 def pipeline_loss(config: LlamaConfig, variables: dict, tokens, mesh,
-                  num_microbatches: int = 4):
+                  num_microbatches: int = 4, fsdp_shard: bool = False):
     from .llama import next_token_loss
     logits = pipeline_forward(config, variables, tokens, mesh,
-                              num_microbatches)
+                              num_microbatches, fsdp_shard=fsdp_shard)
     return next_token_loss(logits, tokens)
 
 
 def pipeline_loss_and_grads_1f1b(config: LlamaConfig, variables: dict,
                                  tokens, mesh, num_microbatches: int = 4,
-                                 virtual_stages: int = 1):
+                                 virtual_stages: int = 1,
+                                 fsdp_shard: bool = False):
     """Fused 1F1B training step core: (loss, grads) in one pipelined
     pass with the 1F1B schedule (parallel/pipeline.pipeline_1f1b) —
     activation memory bounded by pipeline depth instead of microbatch
@@ -138,13 +141,17 @@ def pipeline_loss_and_grads_1f1b(config: LlamaConfig, variables: dict,
         return next_token_loss(logits, toks)
 
     if virtual_stages > 1:
+        if fsdp_shard:
+            raise NotImplementedError(
+                "fsdp_shard composes with the plain 1F1B schedule; the "
+                "interleaved [V, P, ...] stacks are not wired for it yet")
         loss, stage_grads, head_grads, dx = pipeline_interleaved_1f1b(
             stage_fn, head_fn, staged, head_params, x_micro, mesh,
             virtual_stages, aux=token_micro)
     else:
         loss, stage_grads, head_grads, dx = pipeline_1f1b(
             stage_fn, head_fn, staged, head_params, x_micro, mesh,
-            aux=token_micro)
+            aux=token_micro, fsdp_shard=fsdp_shard)
 
     (d_emb,) = embed_vjp(dx.astype(x_micro.dtype))
     layer_grads = jax.tree_util.tree_map(

@@ -32,26 +32,70 @@ def stack_stage_params(per_stage_params: list):
                                   *per_stage_params)
 
 
-def stage_param_specs(stacked_params):
-    """PartitionSpec tree: leading axis 'pp', other dims replicated."""
+def stage_param_specs(stacked_params, fsdp_dims=None):
+    """PartitionSpec tree: leading axis 'pp'; with ``fsdp_dims`` (see
+    stage_param_fsdp_dims), each leaf whose entry is >= 1 additionally
+    shards that dim over 'fsdp' (PP x FSDP composition)."""
     from jax.sharding import PartitionSpec as P
 
-    def spec(leaf):
-        extra = [None] * (leaf.ndim - 1)
-        return P("pp", *extra)
+    def spec(leaf, d=-1):
+        parts = ["pp"] + [None] * (leaf.ndim - 1)
+        if d >= 1:
+            parts[d] = "fsdp"
+        return P(*parts)
 
-    return jax.tree_util.tree_map(spec, stacked_params)
+    if fsdp_dims is None:
+        return jax.tree_util.tree_map(spec, stacked_params)
+    return jax.tree_util.tree_map(spec, stacked_params, fsdp_dims)
+
+
+def stage_param_fsdp_dims(stacked_params, mesh):
+    """Per-leaf dim index (into the stacked [P, ...] layout) to shard
+    over 'fsdp', or -1.  Picks the first non-stage dim divisible by the
+    axis — for llama stacks [P, layers/stage, d_in, d_out] with one
+    layer per stage that is d_in, for generic [P, d0, ...] stacks d0.
+    Undivisible leaves (scalars/vectors/ragged) stay replicated — ZeRO
+    keeps them cheap anyway."""
+    n = mesh.shape.get("fsdp", 1)
+
+    def dim(leaf):
+        # Shard matrices only (stacked ndim >= 3): 1-D biases/scales are
+        # a few KB per stage, and a dedicated latency-bound all_gather +
+        # psum_scatter per leaf to save that is a net loss.
+        if n <= 1 or leaf.ndim < 3:
+            return -1
+        for d in range(1, leaf.ndim):
+            if leaf.shape[d] >= n and leaf.shape[d] % n == 0:
+                return d
+        return -1
+
+    return jax.tree_util.tree_map(dim, stacked_params)
+
+
+def _gather_fsdp_params(params, fsdp_dims):
+    """Inside shard_map, AFTER the stage dim was indexed away:
+    reassemble full per-stage params from their fsdp shards (transient
+    full copy during compute; persistent storage and optimizer state
+    stay sharded — the FSDP contract under PP)."""
+    return jax.tree_util.tree_map(
+        lambda leaf, d: jax.lax.all_gather(leaf, "fsdp", axis=d - 1,
+                                           tiled=True) if d >= 1 else leaf,
+        params, fsdp_dims)
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
                    mesh, axis_name: str = "pp",
-                   batch_axes=("dp", "fsdp")):
+                   batch_axes=("dp", "fsdp"), fsdp_shard: bool = False):
     """Run x through P pipelined stages.
 
     - stage_fn(params, x) -> y with y.shape == x.shape (homogeneous
       stages, transformer-block style).
     - stacked_params: pytree with leading dim P (stack_stage_params).
     - microbatches: [M, mb, ...] — M microbatches streamed through.
+    - fsdp_shard: PP x FSDP — eligible stage weights live sharded over
+      'fsdp' and are all-gathered per pipeline step inside the body
+      (transient full copy; grads reduce-scatter back through the
+      shard_map transpose automatically).
 
     Returns [M, mb, ...] outputs (replicated over 'pp', batch dims
     sharded over ``batch_axes``).
@@ -66,10 +110,14 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
             raise ValueError(
                 f"stacked stage dim {leaf.shape[0]} != mesh"
                 f" {axis_name}={n_stages}")
+    fsdp_dims = (stage_param_fsdp_dims(stacked_params, mesh)
+                  if fsdp_shard else None)
 
     def body(stacked_local, xs):
         p = jax.lax.axis_index(axis_name)
         params = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        if fsdp_dims is not None:
+            params = _gather_fsdp_params(params, fsdp_dims)
         m = xs.shape[0]
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -104,7 +152,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
     x_spec = P(None, batch_axes, *extra)
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(stage_param_specs(stacked_params), x_spec),
+        in_specs=(stage_param_specs(stacked_params, fsdp_dims), x_spec),
         out_specs=x_spec, check_vma=False)
     return fn(stacked_params, microbatches)
 
@@ -216,7 +264,8 @@ def _phase_bounds(fwd_np, bwd_np, n_ticks: int, head_slots=None):
 
 def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
                   head_params, microbatches, mesh, axis_name: str = "pp",
-                  batch_axes=("dp", "fsdp"), aux=None):
+                  batch_axes=("dp", "fsdp"), aux=None,
+                  fsdp_shard: bool = False):
     """Fused forward+backward pipeline with the 1F1B schedule.
 
     GPipe (`pipeline_apply` + autodiff) keeps one activation per
@@ -258,10 +307,17 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
     bwd_table = jnp.asarray(bwd_np)
     t_warm, t_fend = _phase_bounds(fwd_np, bwd_np, n_ticks,
                                    head_slots=fwd_np[-1] >= 0)
+    fsdp_dims = (stage_param_fsdp_dims(stacked_params, mesh)
+                  if fsdp_shard else None)
 
     def body(stacked_local, head_local, xs, xs_aux):
         p = jax.lax.axis_index(axis_name)
         params = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        if fsdp_dims is not None:
+            # PP x FSDP: reassemble the full stage weights from their
+            # fsdp shards (transient); grad accumulation below runs
+            # full-size and is reduce-scattered back in the collect.
+            params = _gather_fsdp_params(params, fsdp_dims)
         mb_shape = xs.shape[1:]
         last = n_stages - 1
         right_perm = [(i, i + 1) for i in range(n_stages - 1)]
@@ -393,7 +449,7 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
                 carry, _ = jax.lax.scan(stp, carry, jnp.arange(lo, hi))
 
         return _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last,
-                             lambda g: g[None])
+                             lambda g: g[None], fsdp_dims=fsdp_dims)
 
     extra = [None] * (microbatches.ndim - 2)
     x_spec = P(None, batch_axes, *extra)
@@ -403,11 +459,11 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
         aux_spec = P(None, batch_axes, *([None] * (aux.ndim - 2)))
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(stage_param_specs(stacked_params),
+        in_specs=(stage_param_specs(stacked_params, fsdp_dims),
                   jax.tree_util.tree_map(lambda _: rep, head_params),
                   x_spec, aux_spec),
         out_specs=(rep,
-                   stage_param_specs(stacked_params),
+                   stage_param_specs(stacked_params, fsdp_dims),
                    jax.tree_util.tree_map(lambda _: rep, head_params),
                    P(None, batch_axes, *extra)),
         check_vma=False)
@@ -421,7 +477,8 @@ def _head_value_and_grads(head_loss, head_params, y):
     return loss, (dhead, dy)
 
 
-def _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last, expand):
+def _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last, expand,
+                  fsdp_dims=None):
     """Shared 1F1B collect epilogue (plain and interleaved schedules):
     loss/head grads live on the last stage, dx on stage 0, stage grads
     stay per-rank (``expand`` restores the 'pp'-sharded leading axis —
@@ -429,7 +486,12 @@ def _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last, expand):
     batch-axis member saw only its local shard, so loss and param grads
     get the data-parallel mean autodiff would have inserted; dx is
     d(LOCAL shard mean)/dx_local and the global loss is the mean over
-    shards, so each shard's input gradient carries 1/n_dp."""
+    shards, so each shard's input gradient carries 1/n_dp.
+
+    With ``fsdp_dims`` (PP x FSDP), flagged stage grads were
+    accumulated FULL-size per rank from that rank's batch shard; they
+    leave as the fsdp-reduce-scattered mean shard (dp-mean over the
+    remaining axes), matching the sharded parameter layout."""
     on = lambda cond, x: jnp.where(cond, x, jnp.zeros_like(x))  # noqa
     dp_axes = tuple(a for a in batch_axes if a in mesh.shape)
     dp_mean = (lambda v: jax.lax.pmean(v, dp_axes)) if dp_axes \
@@ -442,8 +504,31 @@ def _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last, expand):
     for a in dp_axes:
         n_dp *= mesh.shape[a]
     dx = jax.lax.psum(on(p == 0, carry["dx"]), axis_name) / n_dp
-    stage_grads = jax.tree_util.tree_map(
-        lambda g: expand(dp_mean(g)), carry["grads"])
+    if fsdp_dims is None:
+        stage_grads = jax.tree_util.tree_map(
+            lambda g: expand(dp_mean(g)), carry["grads"])
+    else:
+        n_fsdp = mesh.shape.get("fsdp", 1)
+        other = tuple(a for a in dp_axes if a != "fsdp")
+        other_mean = (lambda v: jax.lax.pmean(v, other)) if other \
+            else (lambda v: v)
+
+        def collect(g, d):
+            if d >= 1:
+                # Scatter FIRST: the fsdp reduce-scatter shrinks the
+                # tensor n_fsdp-fold before the dp pmean moves it (the
+                # collectives act on disjoint axes and are linear, so
+                # the order only changes bytes on the wire); / n_fsdp
+                # turns the fsdp sum into the batch mean.  d indexes the
+                # STACKED layout; the stage dim is gone here.
+                g = jax.lax.psum_scatter(g, "fsdp",
+                                         scatter_dimension=d - 1,
+                                         tiled=True) / n_fsdp
+                return expand(other_mean(g))
+            return expand(dp_mean(g))
+
+        stage_grads = jax.tree_util.tree_map(collect, carry["grads"],
+                                             fsdp_dims)
     return loss, stage_grads, head_grads, dx
 
 
