@@ -141,13 +141,9 @@ def pipeline_loss_and_grads_1f1b(config: LlamaConfig, variables: dict,
         return next_token_loss(logits, toks)
 
     if virtual_stages > 1:
-        if fsdp_shard:
-            raise NotImplementedError(
-                "fsdp_shard composes with the plain 1F1B schedule; the "
-                "interleaved [V, P, ...] stacks are not wired for it yet")
         loss, stage_grads, head_grads, dx = pipeline_interleaved_1f1b(
             stage_fn, head_fn, staged, head_params, x_micro, mesh,
-            virtual_stages, aux=token_micro)
+            virtual_stages, aux=token_micro, fsdp_shard=fsdp_shard)
     else:
         loss, stage_grads, head_grads, dx = pipeline_1f1b(
             stage_fn, head_fn, staged, head_params, x_micro, mesh,

@@ -59,10 +59,15 @@ def stage_param_fsdp_dims(stacked_params, mesh):
     n = mesh.shape.get("fsdp", 1)
 
     def dim(leaf):
-        # Shard matrices only (stacked ndim >= 3): 1-D biases/scales are
-        # a few KB per stage, and a dedicated latency-bound all_gather +
-        # psum_scatter per leaf to save that is a net loss.
-        if n <= 1 or leaf.ndim < 3:
+        # Shard genuine matrices only: a per-stage leaf with < 2
+        # non-trivial dims (biases [P, h], per-layer norm scales
+        # [S, 1, d], ...) is a few KB per stage, and a dedicated
+        # latency-bound all_gather + psum_scatter per such leaf is a
+        # net loss.
+        if n <= 1:
+            return -1
+        non_trivial = [i for i in range(1, leaf.ndim) if leaf.shape[i] > 1]
+        if len(non_trivial) < 2:
             return -1
         for d in range(1, leaf.ndim):
             if leaf.shape[d] >= n and leaf.shape[d] % n == 0:
@@ -74,9 +79,11 @@ def stage_param_fsdp_dims(stacked_params, mesh):
 
 def _gather_fsdp_params(params, fsdp_dims):
     """Inside shard_map, AFTER the stage dim was indexed away:
-    reassemble full per-stage params from their fsdp shards (transient
-    full copy during compute; persistent storage and optimizer state
-    stay sharded — the FSDP contract under PP)."""
+    reassemble full per-stage params from their fsdp shards.  Called
+    once per body invocation, so the full stage copy lives for the
+    whole pipelined pass — what PP x FSDP buys is sharded PERSISTENT
+    state (params at rest + optimizer moments), not lower compute-time
+    residency."""
     return jax.tree_util.tree_map(
         lambda leaf, d: jax.lax.all_gather(leaf, "fsdp", axis=d - 1,
                                            tiled=True) if d >= 1 else leaf,
@@ -93,9 +100,11 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
     - stacked_params: pytree with leading dim P (stack_stage_params).
     - microbatches: [M, mb, ...] — M microbatches streamed through.
     - fsdp_shard: PP x FSDP — eligible stage weights live sharded over
-      'fsdp' and are all-gathered per pipeline step inside the body
-      (transient full copy; grads reduce-scatter back through the
-      shard_map transpose automatically).
+      'fsdp' (persistent storage + optimizer state: ZeRO) and are
+      all-gathered ONCE per body invocation, so the full per-stage
+      copy is resident for the whole pipelined forward/backward; grads
+      reduce-scatter back through the shard_map transpose
+      automatically.
 
     Returns [M, mb, ...] outputs (replicated over 'pp', batch dims
     sharded over ``batch_axes``).
@@ -637,7 +646,8 @@ def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
                               stacked_params, head_params, microbatches,
                               mesh, virtual_stages: int,
                               axis_name: str = "pp",
-                              batch_axes=("dp", "fsdp"), aux=None):
+                              batch_axes=("dp", "fsdp"), aux=None,
+                              fsdp_shard: bool = False):
     """Interleaved (virtual-stage) 1F1B: rank p holds ``virtual_stages``
     chunks (global stage v*P + p), shrinking the pipeline bubble ~1/V
     vs `pipeline_1f1b` at the cost of V x the chunk-boundary ppermute
@@ -668,7 +678,7 @@ def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
         return pipeline_1f1b(stage_fn, head_fn, stacked_params,
                              head_params, microbatches, mesh,
                              axis_name=axis_name, batch_axes=batch_axes,
-                             aux=aux)
+                             aux=aux, fsdp_shard=fsdp_shard)
 
     fwd_np, bwd_np, n_ticks, kf, kb, kx = _simulate_interleaved(
         n_stages, n_virtual, m_count)
@@ -688,11 +698,22 @@ def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
         return leaf.reshape((total,) + leaf.shape[2:])
 
     stacked_vp = jax.tree_util.tree_map(to_vp, stacked_params)
+    # fsdp dims computed on the [S, d0, ...] layout: entry d refers to
+    # physical dim d+1 in the [V, P, d0, ...] layout, dim d in the
+    # per-rank [V, d0, ...] chunks, and dim d-1 in one chunk's params.
+    fsdp_dims = (stage_param_fsdp_dims(stacked_params, mesh)
+                 if fsdp_shard else None)
+    n_fsdp = mesh.shape.get("fsdp", 1)
 
     def vp_specs(tree):
-        def spec(leaf):
-            return P(None, axis_name, *([None] * (leaf.ndim - 2)))
-        return jax.tree_util.tree_map(spec, tree)
+        def spec(leaf, d=-1):
+            parts = [None, axis_name] + [None] * (leaf.ndim - 2)
+            if d >= 1:
+                parts[d + 1] = "fsdp"
+            return P(*parts)
+        if fsdp_dims is None:
+            return jax.tree_util.tree_map(spec, tree)
+        return jax.tree_util.tree_map(spec, tree, fsdp_dims)
 
     def body(stacked_local, head_local, xs, xs_aux):
         p = jax.lax.axis_index(axis_name)
@@ -704,17 +725,26 @@ def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
         ring_l = [(i, (i - 1) % n_stages) for i in range(n_stages)]
 
         def chunk_params(v):
-            return jax.tree_util.tree_map(
+            one = jax.tree_util.tree_map(
                 lambda a: jax.lax.dynamic_index_in_dim(
                     a, v, 0, keepdims=False), chunks)
+            if fsdp_dims is not None:
+                one = _gather_fsdp_params(one, fsdp_dims)
+            return one
 
         zeros_mb = jnp.zeros(mb_shape, xs.dtype)
         carry0 = {
             "fwd_buf": jnp.zeros((n_virtual, kf) + mb_shape, xs.dtype),
             "bwd_buf": jnp.zeros((n_virtual, kb) + mb_shape, jnp.float32),
             "x_buf": jnp.zeros((n_virtual, kx) + mb_shape, xs.dtype),
+            # Grad accumulation runs FULL-size per chunk (vjp of the
+            # gathered params); the collect reduce-scatters it back.
             "grads": jax.tree_util.tree_map(
-                lambda a: jnp.zeros(a.shape, jnp.float32), chunks),
+                lambda a, d=None: jnp.zeros(
+                    tuple(x * n_fsdp if d is not None and d >= 1
+                          and i == d else x
+                          for i, x in enumerate(a.shape)), jnp.float32),
+                chunks, *((fsdp_dims,) if fsdp_dims is not None else ())),
             "head_grads": jax.tree_util.tree_map(
                 lambda a: jnp.zeros(a.shape, jnp.float32), head_local),
             "dx": jnp.zeros((m_count,) + mb_shape, jnp.float32),
@@ -848,8 +878,16 @@ def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
             if hi > lo:
                 carry, _ = jax.lax.scan(stp, carry, jnp.arange(lo, hi))
 
+        # Carry grads have a leading V dim, so the flagged scatter
+        # dim sits one deeper than in the plain schedule: shift the dim
+        # entries by one (collect scatters at entry-1).
+        collect_dims = None
+        if fsdp_dims is not None:
+            collect_dims = jax.tree_util.tree_map(
+                lambda d: d + 1 if d >= 1 else d, fsdp_dims)
         return _collect_1f1b(carry, mesh, axis_name, batch_axes, p, last,
-                             lambda g: g[:, None])
+                             lambda g: g[:, None],
+                             fsdp_dims=collect_dims)
 
     extra = [None] * (microbatches.ndim - 2)
     x_spec = P(None, batch_axes, *extra)
