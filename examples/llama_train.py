@@ -40,6 +40,10 @@ def main() -> int:
                         help="with --pipeline-schedule 1f1b: chunks per"
                              " pipeline rank (interleaved schedule;"
                              " bubble shrinks ~1/V)")
+    parser.add_argument("--pp-fsdp", action="store_true",
+                        help="with --pp > 1 and --fsdp > 1: ZeRO-shard "
+                             "the stage weights over fsdp (gathered "
+                             "per pipeline pass)")
     parser.add_argument("--n-layers", type=int, default=0,
                         help="override the config's layer count (e.g."
                              " to divide by pp * virtual-stages)")
@@ -118,7 +122,8 @@ def main() -> int:
 
         def loss_fn(params, batch):
             return pipeline_loss(cfg, params, batch, mesh,
-                                 args.microbatches)
+                                 args.microbatches,
+                                 fsdp_shard=args.pp_fsdp)
     elif args.fused_xent:
         from mpi_operator_tpu.ops.fused_xent import fused_next_token_loss
 
@@ -142,6 +147,12 @@ def main() -> int:
         mgr = CheckpointManager(args.checkpoint_dir,
                                 every=args.checkpoint_every)
 
+    if args.pp_fsdp and args.pp <= 1:
+        raise SystemExit(
+            "--pp-fsdp shards PIPELINE stage weights; without --pp > 1 "
+            "there are no stages (plain --fsdp already ZeRO-shards the "
+            "non-pipeline path)")
+
     if args.accum_steps > 1 and args.pp > 1:
         raise SystemExit(
             "--accum-steps applies to the non-pipeline path; pipeline "
@@ -162,7 +173,8 @@ def main() -> int:
             def f1_step(variables, opt_state, batch):
                 loss, grads = pipeline_loss_and_grads_1f1b(
                     cfg, variables, batch, mesh, args.microbatches,
-                    virtual_stages=args.virtual_stages)
+                    virtual_stages=args.virtual_stages,
+                    fsdp_shard=args.pp_fsdp)
                 updates, opt_state = tx.update(grads, opt_state,
                                                variables["params"])
                 return ({"params": optax.apply_updates(
@@ -184,7 +196,8 @@ def main() -> int:
                   f" tp={mesh.shape['tp']} sp={mesh.shape['sp']}"
                   f" schedule=1f1b"
                   + (f" virtual_stages={args.virtual_stages}"
-                     if args.virtual_stages > 1 else ""))
+                     if args.virtual_stages > 1 else "")
+                  + (" pp_fsdp" if args.pp_fsdp else ""))
             print(f"tokens/sec: {tokens_per_sec:.0f}"
                   f" loss={final_loss:.4f}")
         return 0
@@ -249,7 +262,8 @@ def main() -> int:
     if jax.process_index() == 0:
         print(f"mesh dp={mesh.shape['dp']} fsdp={mesh.shape['fsdp']}"
               f" pp={mesh.shape['pp']} ep={mesh.shape['ep']}"
-              f" tp={mesh.shape['tp']} sp={mesh.shape['sp']}")
+              f" tp={mesh.shape['tp']} sp={mesh.shape['sp']}"
+              + (" pp_fsdp" if args.pp_fsdp else ""))
         print(f"tokens/sec: {tokens_per_sec:.0f} loss={final_loss:.4f}")
     return 0
 
