@@ -55,6 +55,10 @@ class LlamaConfig:
                                               # this block size (decode)
     cache_blocks: int = 0                     # paged pool size; 0 -> auto
                                               # (worst case for the batch)
+    kv_cache_dtype: str = "auto"              # 'auto' (= dtype) | 'int8':
+                                              # quantized paged pool with
+                                              # per-token-per-head scales
+                                              # (halves KV HBM; paged only)
 
     def __post_init__(self):
         # Models (and thus configs) ride in jit static argnums on the
@@ -64,6 +68,14 @@ class LlamaConfig:
         if isinstance(self.rope_scaling, dict):
             object.__setattr__(self, "rope_scaling",
                                tuple(sorted(self.rope_scaling.items())))
+        if self.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'auto' or 'int8', "
+                f"got {self.kv_cache_dtype!r}")
+        if self.kv_cache_dtype == "int8" and self.page_size <= 0:
+            raise ValueError(
+                "kv_cache_dtype='int8' requires the paged cache "
+                "(page_size > 0); the dense layout is not quantized")
 
     @property
     def blocks_per_row(self) -> int:
@@ -127,6 +139,23 @@ def mixtral_8x7b(**overrides) -> LlamaConfig:
                                  n_heads=32, n_kv_heads=8, hidden_dim=14336,
                                  max_seq_len=4096, n_experts=8, top_k=2),
                           **overrides})
+
+
+def quantize_kv(x):
+    """Per-token-per-head symmetric int8: x [..., KH, D] ->
+    (int8 values, f32 scales [..., KH]) with dequant = q * scale.
+    A zero vector stores scale 0 so it dequantizes to exactly zero."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    safe = jnp.where(amax > 0, amax, 1.0)
+    q = jnp.round(xf / safe[..., None] * 127.0).astype(jnp.int8)
+    return q, jnp.where(amax > 0, safe / 127.0, 0.0)
+
+
+def dequantize_kv(q, scales):
+    """Inverse of quantize_kv: int8 [..., KH, D] + scales [..., KH] ->
+    f32."""
+    return q.astype(jnp.float32) * scales[..., None]
 
 
 def _scale_rope_freqs(freqs, scaling):
@@ -219,14 +248,26 @@ class LlamaAttention(nn.Module):
                 # zeros (inactive slot) reads and writes garbage there
                 # without touching any live row's memory.
                 nb = cfg.pool_blocks(b)
+                int8_kv = cfg.kv_cache_dtype == "int8"
+                pool_dtype = jnp.int8 if int8_kv else cfg.dtype
                 pool_k = self.variable(
                     "cache", "pool_key", jnp.zeros,
                     (nb, cfg.page_size, cfg.kv_heads, cfg.head_dim),
-                    cfg.dtype)
+                    pool_dtype)
                 pool_v = self.variable(
                     "cache", "pool_value", jnp.zeros,
                     (nb, cfg.page_size, cfg.kv_heads, cfg.head_dim),
-                    cfg.dtype)
+                    pool_dtype)
+                if int8_kv:
+                    # Per-token-per-head dequant scales ride in the same
+                    # block layout, so prefix-cache block sharing and
+                    # table indirection apply to them unchanged.
+                    pool_ks = self.variable(
+                        "cache", "pool_key_scale", jnp.zeros,
+                        (nb, cfg.page_size, cfg.kv_heads), jnp.float32)
+                    pool_vs = self.variable(
+                        "cache", "pool_value_scale", jnp.zeros,
+                        (nb, cfg.page_size, cfg.kv_heads), jnp.float32)
                 block_table = self.variable(
                     "cache", "block_table",
                     lambda: jnp.zeros((b, cfg.blocks_per_row), jnp.int32))
@@ -269,12 +310,20 @@ class LlamaAttention(nn.Module):
             dest_off = positions % cfg.page_size
             flat_b = dest_block.reshape(-1)
             flat_o = dest_off.reshape(-1)
-            pool_k.value = pool_k.value.at[flat_b, flat_o].set(
-                k.astype(cfg.dtype).reshape(b * s, cfg.kv_heads,
-                                            cfg.head_dim))
-            pool_v.value = pool_v.value.at[flat_b, flat_o].set(
-                v.astype(cfg.dtype).reshape(b * s, cfg.kv_heads,
-                                            cfg.head_dim))
+            k_rows = k.reshape(b * s, cfg.kv_heads, cfg.head_dim)
+            v_rows = v.reshape(b * s, cfg.kv_heads, cfg.head_dim)
+            if int8_kv:
+                k_q, k_sc = quantize_kv(k_rows)
+                v_q, v_sc = quantize_kv(v_rows)
+                pool_k.value = pool_k.value.at[flat_b, flat_o].set(k_q)
+                pool_v.value = pool_v.value.at[flat_b, flat_o].set(v_q)
+                pool_ks.value = pool_ks.value.at[flat_b, flat_o].set(k_sc)
+                pool_vs.value = pool_vs.value.at[flat_b, flat_o].set(v_sc)
+            else:
+                pool_k.value = pool_k.value.at[flat_b, flat_o].set(
+                    k_rows.astype(cfg.dtype))
+                pool_v.value = pool_v.value.at[flat_b, flat_o].set(
+                    v_rows.astype(cfg.dtype))
             cache_index.value = idx + s
             if s == 1:
                 # Single-token decode (the serving hot path): fused
@@ -285,7 +334,9 @@ class LlamaAttention(nn.Module):
                 out = paged_decode_attention(
                     q[:, 0], pool_k.value, pool_v.value,
                     block_table.value, idx + 1,
-                    impl=cfg.attention_impl)[:, None]
+                    impl=cfg.attention_impl,
+                    k_scale=pool_ks.value if int8_kv else None,
+                    v_scale=pool_vs.value if int8_kv else None)[:, None]
             else:
                 # Multi-token (prefill into a paged cache): gather each
                 # row's blocks in logical order — the view index equals
@@ -293,12 +344,18 @@ class LlamaAttention(nn.Module):
                 # _decode_attention applies unchanged.  The dense-sized
                 # view is acceptable here (prefill happens once per
                 # sequence, and needs intra-step causality).
+                span = cfg.blocks_per_row * cfg.page_size
                 k_all = pool_k.value[block_table.value].reshape(
-                    b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
-                    cfg.head_dim)
+                    b, span, cfg.kv_heads, cfg.head_dim)
                 v_all = pool_v.value[block_table.value].reshape(
-                    b, cfg.blocks_per_row * cfg.page_size, cfg.kv_heads,
-                    cfg.head_dim)
+                    b, span, cfg.kv_heads, cfg.head_dim)
+                if int8_kv:
+                    k_all = dequantize_kv(
+                        k_all, pool_ks.value[block_table.value].reshape(
+                            b, span, cfg.kv_heads)).astype(cfg.dtype)
+                    v_all = dequantize_kv(
+                        v_all, pool_vs.value[block_table.value].reshape(
+                            b, span, cfg.kv_heads)).astype(cfg.dtype)
                 out = _decode_attention(q, k_all, v_all, positions,
                                         cfg.n_heads // cfg.kv_heads)
         elif decode:

@@ -36,15 +36,23 @@ from .attention import _MASK_VALUE, _STATS_LANES
 _SUBLANES = 8
 
 
-def _xla_paged(q, pool_k, pool_v, block_table, lengths, scale):
+def _xla_paged(q, pool_k, pool_v, block_table, lengths, scale,
+               k_scale=None, v_scale=None):
     """Reference path: dense gather + masked softmax.  Numerically the
-    spec the kernel is tested against (and the non-TPU fallback)."""
+    spec the kernel is tested against (and the non-TPU fallback).
+    With k_scale/v_scale ([NB, page, KH], int8 pools) the gathered
+    blocks are dequantized (x = q_int8 * scale)."""
     b, h, d = q.shape
     nb, page, kh, _ = pool_k.shape
     maxb = block_table.shape[1]
     g = h // kh
     k_all = pool_k[block_table].reshape(b, maxb * page, kh, d)
     v_all = pool_v[block_table].reshape(b, maxb * page, kh, d)
+    if k_scale is not None:
+        k_all = k_all.astype(jnp.float32) * k_scale[block_table].reshape(
+            b, maxb * page, kh)[..., None]
+        v_all = v_all.astype(jnp.float32) * v_scale[block_table].reshape(
+            b, maxb * page, kh)[..., None]
     if g > 1:
         k_all = jnp.repeat(k_all, g, axis=2)
         v_all = jnp.repeat(v_all, g, axis=2)
@@ -58,9 +66,14 @@ def _xla_paged(q, pool_k, pool_v, block_table, lengths, scale):
     return out.astype(q.dtype)
 
 
-def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, scale: float, page: int,
-                  kh: int, maxb: int):
+def _paged_kernel_core(table_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                       scale: float, page: int, kh: int, maxb: int):
+    """Shared online-softmax body.  With ks_ref/vs_ref (int8 pools),
+    dequantization folds into per-token vectors AFTER the matmuls —
+    s[:, j] = (q @ k_int8_j) * ks_j and acc += (p * vs) @ v_int8 —
+    exact, and the MXU still sees one dense [Gp, D] x [D, page]
+    product per block."""
     from jax.experimental import pallas as pl
 
     bh = pl.program_id(0)
@@ -81,6 +94,8 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         v = v_ref[0, :, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if ks_ref is not None:
+            s = s * ks_ref[0, :, 0][None, :]
         pos = j * page + jax.lax.iota(jnp.int32, page)
         s = jnp.where((pos < length)[None, :], s, _MASK_VALUE)
 
@@ -90,6 +105,8 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        if vs_ref is not None:
+            p = p * vs_ref[0, :, 0][None, :]
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -108,8 +125,14 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
 
 
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, **kw):
+    _paged_kernel_core(table_ref, len_ref, q_ref, k_ref, v_ref, None,
+                       None, o_ref, acc_ref, m_ref, l_ref, **kw)
+
+
 def _pallas_paged(q, pool_k, pool_v, block_table, lengths, scale,
-                  interpret):
+                  interpret, k_scale=None, v_scale=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -130,21 +153,34 @@ def _pallas_paged(q, pool_k, pool_v, block_table, lengths, scale,
         jj = jnp.minimum(j, last_live)
         return (tbl[row, jj], 0, bh % kh, 0)
 
-    kernel = functools.partial(_paged_kernel, scale=scale, page=page,
-                               kh=kh, maxb=maxb)
+    def scale_index(bh, j, tbl, lens):
+        # Scale pools drop the trailing D dim; same block mapping.
+        return kv_index(bh, j, tbl, lens)[:3]
+
+    q_spec = pl.BlockSpec((1, 1, gp, d),
+                          lambda bh, j, tbl, lens: (bh // kh, bh % kh,
+                                                    0, 0))
+    kv_spec = pl.BlockSpec((1, page, 1, d), kv_index)
+    out_spec = pl.BlockSpec((1, 1, gp, d),
+                            lambda bh, j, tbl, lens: (bh // kh,
+                                                      bh % kh, 0, 0))
+    int8 = k_scale is not None
+    if int8:
+        kernel = functools.partial(_paged_kernel_core, scale=scale,
+                                   page=page, kh=kh, maxb=maxb)
+        sc_spec = pl.BlockSpec((1, page, 1), scale_index)
+        in_specs = [q_spec, kv_spec, kv_spec, sc_spec, sc_spec]
+        operands = (qg, pool_k, pool_v, k_scale, v_scale)
+    else:
+        kernel = functools.partial(_paged_kernel, scale=scale, page=page,
+                                   kh=kh, maxb=maxb)
+        in_specs = [q_spec, kv_spec, kv_spec]
+        operands = (qg, pool_k, pool_v)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b * kh, maxb),
-        in_specs=[
-            pl.BlockSpec((1, 1, gp, d),
-                         lambda bh, j, tbl, lens: (bh // kh, bh % kh,
-                                                   0, 0)),
-            pl.BlockSpec((1, page, 1, d), kv_index),
-            pl.BlockSpec((1, page, 1, d), kv_index),
-        ],
-        out_specs=pl.BlockSpec((1, 1, gp, d),
-                               lambda bh, j, tbl, lens: (bh // kh,
-                                                         bh % kh, 0, 0)),
+        in_specs=in_specs,
+        out_specs=out_spec,
         scratch_shapes=[
             pltpu.VMEM((gp, d), jnp.float32),                # acc
             pltpu.VMEM((gp, _STATS_LANES), jnp.float32),     # m
@@ -159,13 +195,14 @@ def _pallas_paged(q, pool_k, pool_v, block_table, lengths, scale,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      qg, pool_k, pool_v)
+      *operands)
     return out[:, :, :g, :].reshape(b, h, d).astype(q.dtype)
 
 
 def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
                            scale=None, impl: str = "auto",
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           k_scale=None, v_scale=None):
     """One decode step of attention against a paged KV pool.
 
     - q: [B, H, D] — this step's queries (sequence dim already squeezed).
@@ -179,6 +216,10 @@ def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
     impl: 'pallas' | 'xla' | 'auto' (pallas on real 'tpu' backends —
     the tunneled 'axon' platform executes Pallas kernels slower than
     XLA, same gating as ops.attention).
+
+    k_scale / v_scale: [NB, page, KH] f32 — present iff the pools are
+    int8 (LlamaConfig kv_cache_dtype='int8'); dequant is x = q * scale,
+    folded into per-token vectors around the kernel matmuls.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -186,9 +227,13 @@ def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
     kh = pool_k.shape[2]
     if h % kh:
         raise ValueError(f"n_heads {h} not a multiple of kv_heads {kh}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale go together")
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "pallas":
         return _pallas_paged(q, pool_k, pool_v, block_table, lengths,
-                             scale, interpret)
-    return _xla_paged(q, pool_k, pool_v, block_table, lengths, scale)
+                             scale, interpret, k_scale=k_scale,
+                             v_scale=v_scale)
+    return _xla_paged(q, pool_k, pool_v, block_table, lengths, scale,
+                      k_scale=k_scale, v_scale=v_scale)

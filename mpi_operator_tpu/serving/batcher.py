@@ -80,7 +80,7 @@ class ContinuousBatcher:
                  page_size: int = 0, cache_blocks: int = 0,
                  prefix_cache: bool = True,
                  draft_model=None, draft_variables=None,
-                 draft_len: int = 4):
+                 draft_len: int = 4, kv_cache_dtype: str = "auto"):
         import dataclasses
 
         import jax
@@ -121,9 +121,17 @@ class ContinuousBatcher:
         # memory one worst-case slot would pin.  Prefill stays on the
         # dense layout (batch-1 row, scattered into the pool on install).
         self.page_size = page_size
+        if kv_cache_dtype != "auto" and page_size <= 0:
+            # Never silently serve an unquantized cache the caller
+            # believes is int8 (same loud-misconfig convention as
+            # server.py's kv_page_size guard).
+            raise ValueError(
+                f"kv_cache_dtype={kv_cache_dtype!r} requires the paged "
+                f"cache (page_size > 0)")
         if page_size > 0:
             decode_cfg = dataclasses.replace(
-                cfg, page_size=page_size, cache_blocks=cache_blocks)
+                cfg, page_size=page_size, cache_blocks=cache_blocks,
+                kv_cache_dtype=kv_cache_dtype)
             # Keep the model's mesh: dropping it would silently turn the
             # decode path's activation sharding hints into no-ops under
             # tensor-parallel serving.
@@ -571,16 +579,31 @@ class ContinuousBatcher:
 
         def rec(dst, src):
             if "pool_key" in dst:
+                from ..models.llama import quantize_kv
+
                 out = dict(dst)
+                int8 = "pool_key_scale" in dst
                 for pool, dense in (("pool_key", "cached_key"),
                                     ("pool_value", "cached_value")):
                     seq = src[dense][0]          # [L, KH, D]
                     take = min(seq.shape[0], span)
                     chunk = jnp.zeros((span,) + seq.shape[1:], seq.dtype)
                     chunk = chunk.at[:take].set(seq[:take])
-                    out[pool] = dst[pool].at[barr].set(
-                        chunk.reshape(len(blocks), self.page_size,
-                                      *seq.shape[1:]))
+                    if int8:
+                        # Prefill ran on the dense bf16 layout; the
+                        # paged pool stores int8 + per-token scales.
+                        q8, sc = quantize_kv(chunk)
+                        out[pool] = dst[pool].at[barr].set(
+                            q8.reshape(len(blocks), self.page_size,
+                                       *seq.shape[1:]))
+                        out[pool + "_scale"] = \
+                            dst[pool + "_scale"].at[barr].set(
+                                sc.reshape(len(blocks), self.page_size,
+                                           seq.shape[1]))
+                    else:
+                        out[pool] = dst[pool].at[barr].set(
+                            chunk.reshape(len(blocks), self.page_size,
+                                          *seq.shape[1:]))
                 out["block_table"] = dst["block_table"].at[slot].set(
                     table_row)
                 out["cache_index"] = dst["cache_index"].at[slot].set(
@@ -619,8 +642,15 @@ class ContinuousBatcher:
 
                 def back(dst, src):
                     if "pool_key" in dst:
-                        return {**dst, "pool_key": src["pool_key"],
-                                "pool_value": src["pool_value"]}
+                        out = {**dst, "pool_key": src["pool_key"],
+                               "pool_value": src["pool_value"]}
+                        # int8 pools: the suffix apply also wrote the
+                        # per-token dequant scales — dropping them would
+                        # leave stale zeros and silently zero the K/V.
+                        for sc in ("pool_key_scale", "pool_value_scale"):
+                            if sc in src:
+                                out[sc] = src[sc]
+                        return out
                     return {k: back(dst[k], src[k]) for k in dst}
 
                 nxt, key = _select_rows(logits[:, length - 1],

@@ -111,7 +111,7 @@ class InferenceServer:
     def __init__(self, model, variables, host: str = "127.0.0.1",
                  port: int = 0, max_batch_slots: int = 0, mesh=None,
                  kv_page_size: int = 0, kv_cache_blocks: int = 0,
-                 kv_prefix_cache: bool = True,
+                 kv_prefix_cache: bool = True, kv_cache_dtype: str = "auto",
                  draft_model=None, draft_variables=None):
         self.model = model
         self.variables = variables
@@ -151,6 +151,10 @@ class InferenceServer:
                 "kv_page_size requires continuous batching "
                 "(max_batch_slots > 0); the non-batched path uses the "
                 "dense cache")
+        if kv_cache_dtype != "auto" and kv_page_size <= 0:
+            raise ValueError(
+                f"kv_cache_dtype={kv_cache_dtype!r} requires "
+                f"kv_page_size > 0 (only the paged pool is quantized)")
         if max_batch_slots > 0:
             from .batcher import ContinuousBatcher
             # The draft rides into the batcher too: greedy batched
@@ -162,6 +166,7 @@ class InferenceServer:
                                               page_size=kv_page_size,
                                               cache_blocks=kv_cache_blocks,
                                               prefix_cache=kv_prefix_cache,
+                                              kv_cache_dtype=kv_cache_dtype,
                                               draft_model=draft_model,
                                               draft_variables=draft_variables)
 

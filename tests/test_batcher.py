@@ -753,3 +753,79 @@ def test_draft_cache_catches_up_after_plain_interlude():
         assert st["accepted_drafts"] >= st["drafted"] * 0.8, st
     finally:
         batcher.stop()
+
+
+def test_int8_kv_batcher_serves_concurrent_requests():
+    """The serving path with the quantized pool: concurrent requests
+    complete with full-length outputs, the pool is genuinely int8, the
+    prefix cache still shares (int8) blocks, and the first emitted
+    token (computed by the dense prefill) matches the dense batcher
+    exactly."""
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    b8 = ContinuousBatcher(model, variables, max_slots=2, page_size=8,
+                           kv_cache_dtype="int8").start()
+    ref = ContinuousBatcher(model, variables, max_slots=2,
+                            page_size=8).start()
+    try:
+        prompts = [[5, 3, 8, 1], [7, 6, 2], [1, 2, 3, 4, 5]]
+        outs, refs = [None] * 3, [None] * 3
+
+        def run(store, batcher, i):
+            store[i] = batcher.submit(prompts[i], 6)
+
+        threads = [threading.Thread(target=run, args=(outs, b8, i))
+                   for i in range(3)] + \
+                  [threading.Thread(target=run, args=(refs, ref, i))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for i in range(3):
+            assert len(outs[i]) == 6
+            # First token comes from the (unquantized) dense prefill.
+            assert outs[i][0] == refs[i][0], (i, outs[i], refs[i])
+
+        def find(node, name):
+            if hasattr(node, "items"):
+                for kk, vv in node.items():
+                    if kk == name:
+                        return vv
+                    hit = find(vv, name)
+                    if hit is not None:
+                        return hit
+            return None
+
+        assert find(b8._cache, "pool_key").dtype == jnp.int8
+        assert find(b8._cache, "pool_key_scale") is not None
+
+        # Prefix cache across the int8 pool: an identical long prompt
+        # (>= 2 full pages, so blocks actually register) hits shared
+        # int8 blocks on resubmission.
+        long_prompt = list(range(1, 20))
+        before = b8.prefix_stats["hit_blocks"]
+        b8.submit(long_prompt, 2)
+        b8.submit(long_prompt, 2)
+        assert b8.prefix_stats["hit_blocks"] > before
+    finally:
+        b8.stop()
+        ref.stop()
+
+
+def test_int8_without_paging_rejected():
+    """kv_cache_dtype must never be silently ignored (the caller
+    believes KV HBM was halved)."""
+    from mpi_operator_tpu.serving import InferenceServer
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(model, variables, kv_cache_dtype="int8")
+    with pytest.raises(ValueError, match="kv_page_size"):
+        InferenceServer(model, variables, max_batch_slots=2,
+                        kv_cache_dtype="int8")

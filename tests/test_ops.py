@@ -420,3 +420,99 @@ def test_attention_pallas_shard_map_matches_xla():
         g = jax.jit(jax.grad(loss_sharded))(q, k, v)
     g_ref = jax.grad(loss_ref)(q, k, v)
     np.testing.assert_allclose(g, g_ref, atol=5e-4, rtol=5e-4)
+
+
+def test_paged_decode_int8_matches_dequantized_reference():
+    """int8 paged pools (per-token-per-head scales) must compute exactly
+    the attention the dequantized f32 pools would, on both impls — the
+    scaling folds into per-token vectors around the kernel matmuls."""
+    from mpi_operator_tpu.models.llama import dequantize_kv, quantize_kv
+    from mpi_operator_tpu.ops.paged_attention import (_xla_paged,
+                                                      paged_decode_attention)
+
+    rng = np.random.default_rng(0)
+    B, H, KH, D, NB, page, MAXB = 3, 4, 2, 64, 9, 16, 4
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kf = jnp.asarray(rng.standard_normal((NB, page, KH, D)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((NB, page, KH, D)), jnp.float32)
+    table = jnp.asarray(rng.integers(1, NB, (B, MAXB)), jnp.int32)
+    lengths = jnp.asarray([5, 30, 17], jnp.int32)
+
+    kq, ks = quantize_kv(kf)
+    vq, vs = quantize_kv(vf)
+    # Quantization round trip is bounded by amax/254 per element.
+    assert float(jnp.max(jnp.abs(dequantize_kv(kq, ks) - kf))) < 0.02
+
+    ref = _xla_paged(q, dequantize_kv(kq, ks), dequantize_kv(vq, vs),
+                     table, lengths, 1.0 / np.sqrt(D))
+    for impl, kw in (("xla", {}), ("pallas", {"interpret": True})):
+        got = paged_decode_attention(q, kq, vq, table, lengths,
+                                     impl=impl, k_scale=ks, v_scale=vs,
+                                     **kw)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6,
+                                   err_msg=impl)
+
+
+def test_int8_kv_cache_decode_logits_close_to_dense():
+    """A full decode step against the int8 paged cache: next-token
+    logits stay within quantization tolerance of the dense-cache model,
+    and the pool arrays really are int8 (half the KV bytes)."""
+    import dataclasses
+
+    from mpi_operator_tpu.models.llama import (LlamaModel,
+                                               canonical_block_table,
+                                               llama2_tiny)
+
+    cfg = llama2_tiny()
+    dense = LlamaModel(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 12), 0,
+                                cfg.vocab_size)
+    variables = dense.init(jax.random.PRNGKey(1), tokens[:, :4])
+
+    i8cfg = dataclasses.replace(cfg, page_size=8, kv_cache_dtype="int8")
+    i8 = LlamaModel(i8cfg)
+
+    def prefill_and_step(model, mcfg):
+        params = {"params": variables["params"]}
+        kwargs = {}
+        if mcfg.page_size > 0:
+            shapes = jax.eval_shape(
+                lambda t: model.apply(params, t, decode=True,
+                                      mutable=["cache"])[1]["cache"],
+                tokens)
+            cache0 = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+            from mpi_operator_tpu.models.llama import _set_block_tables
+            cache0 = _set_block_tables(
+                cache0, canonical_block_table(tokens.shape[0], mcfg))
+            kwargs = {"cache": cache0}
+        logits, state = model.apply({**params, **kwargs}, tokens,
+                                    decode=True, mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        logits2, state2 = model.apply(
+            {**params, "cache": state["cache"]}, nxt, decode=True,
+            mutable=["cache"])
+        return logits[:, -1], logits2[:, -1], state2["cache"]
+
+    d1, d2, _ = prefill_and_step(dense, cfg)
+    q1, q2, cache = prefill_and_step(i8, i8cfg)
+
+    scale = float(jnp.max(jnp.abs(d1)))
+    assert float(jnp.max(jnp.abs(q1 - d1))) < 0.05 * scale
+    assert float(jnp.max(jnp.abs(q2 - d2))) < 0.05 * scale
+    leaves = {k: v for k, v in cache.items()}
+
+    def find(node, name):
+        if hasattr(node, "items"):
+            for kk, vv in node.items():
+                if kk == name:
+                    return vv
+                hit = find(vv, name)
+                if hit is not None:
+                    return hit
+        return None
+
+    pool = find(leaves, "pool_key")
+    assert pool.dtype == jnp.int8
+    assert find(leaves, "pool_key_scale") is not None
