@@ -247,7 +247,7 @@ def llama_bench(fused_xent: bool = False) -> dict:
             "loss": round(float(m["loss"]), 4)}
 
 
-def serve_bench() -> dict:
+def serve_bench(kv_cache_dtype: str = "auto") -> dict:
     import threading
 
     import jax
@@ -266,7 +266,8 @@ def serve_bench() -> dict:
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, 8), jnp.int32))
     batcher = ContinuousBatcher(model, variables, max_slots=slots,
-                                page_size=page).start()
+                                page_size=page,
+                                kv_cache_dtype=kv_cache_dtype).start()
     try:
         rng = np.random.default_rng(0)
         prompts = [list(map(int, rng.integers(1, cfg.vocab_size,
@@ -301,6 +302,7 @@ def serve_bench() -> dict:
                 "value": round(len(prompts) * new_tokens / dt, 1),
                 "slots": slots, "prompt_len": prompt_len,
                 "new_tokens": new_tokens, "page_size": page,
+                "kv_cache_dtype": kv_cache_dtype,
                 "ttft_cold_s": round(cold, 4), "ttft_warm_s": round(warm, 4),
                 "prefix_hit_blocks": batcher.prefix_stats["hit_blocks"]}
     finally:
@@ -468,6 +470,10 @@ def main() -> int:
     cap.phase("llama_train_fused_xent", 400,
               lambda: llama_bench(fused_xent=True))
     cap.phase("serve", 500, serve_bench)
+    # int8 KV A/B: same workload over the quantized pool (KV HBM
+    # halved); the delta vs the phase above is the quantization cost.
+    cap.phase("serve_int8_kv", 400,
+              lambda: serve_bench(kv_cache_dtype="int8"))
     cap.phase("speculative", 300, speculative_bench)
     cap.phase("kernel_ab", 400, kernel_ab)
     cap.emit({"phase": "done", "remaining_s": round(cap.remaining(), 1)})
