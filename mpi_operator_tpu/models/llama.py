@@ -694,14 +694,21 @@ def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
 
 def generate(model: LlamaModel, variables, prompt_tokens,
              max_new_tokens: int, temperature: float = 0.0,
-             top_p: float = 1.0, rng=None, prompt_lengths=None):
+             top_p: float = 1.0, rng=None, prompt_lengths=None,
+             stop_tokens=()):
     """KV-cache decoding: prefill the prompt, then one token per step.
     temperature=0 is greedy; otherwise nucleus (top-p) sampling.
 
     prompt_tokens [B, S] may be right-padded to a common S; pass
     prompt_lengths [B] with each row's true length and every row decodes
     from its own position (per-row cache index; stale padding slots are
-    masked/overwritten).  Returns [B, max_new_tokens] generated ids."""
+    masked/overwritten).  Returns [B, max_new_tokens] generated ids.
+
+    stop_tokens: EOS/stop ids — decoding ends early once EVERY row has
+    emitted one (a per-step host sync, only paid when the set is
+    non-empty).  Each row's stop token is included in its output; later
+    positions are filled by repeating it, and the returned width is the
+    number of steps actually run (<= max_new_tokens)."""
     if max_new_tokens <= 0:
         return jnp.zeros((prompt_tokens.shape[0], 0), jnp.int32)
     # Bound the cache: dynamic_update_slice CLAMPS an out-of-range start
@@ -728,11 +735,39 @@ def generate(model: LlamaModel, variables, prompt_tokens,
     rng, sub = jax.random.split(rng)
     next_token = _select_token(last_logits, temperature, top_p, sub)
 
+    stop = frozenset(map(int, stop_tokens))
     out = [next_token]
+    done = None
+    if stop:
+        import numpy as np
+        stop_list = list(stop)
+        done = np.isin(np.asarray(next_token), stop_list)
     for _ in range(max_new_tokens - 1):
+        if done is not None and done.all():
+            break
         cache, next_token, rng = step(cache, out[-1], rng)
         out.append(next_token)
-    return jnp.stack(out, axis=1)
+        if done is not None:
+            done |= np.isin(np.asarray(next_token), stop_list)
+    result = jnp.stack(out, axis=1)
+    if stop:
+        result = jnp.asarray(fill_after_stop(np.array(result), stop_list))
+    return result
+
+
+def fill_after_stop(arr, stop_tokens):
+    """Stop-token output convention, in one place: for each row of a
+    [B, T] int array, positions after the FIRST stop token are filled by
+    repeating it (the stop token itself stays in the output).  Mutates
+    and returns ``arr`` (pass a writable copy)."""
+    import numpy as np
+
+    stop_list = list(stop_tokens)
+    for row in range(arr.shape[0]):
+        hits = np.nonzero(np.isin(arr[row], stop_list))[0]
+        if hits.size:
+            arr[row, hits[0] + 1:] = arr[row, hits[0]]
+    return arr
 
 
 def greedy_generate(model: LlamaModel, variables, prompt_tokens,
@@ -744,11 +779,11 @@ def greedy_generate(model: LlamaModel, variables, prompt_tokens,
 
 def stream_generate(model: LlamaModel, variables, prompt_tokens,
                     max_new_tokens: int, temperature: float = 0.0,
-                    top_p: float = 1.0, rng=None):
+                    top_p: float = 1.0, rng=None, stop_tokens=()):
     """Token-by-token generator for ONE sequence ([1, S] or [S] prompt):
     yields each generated id as soon as its decode step completes — the
     serving layer's streaming (SSE) source.  Same selection semantics as
-    generate()."""
+    generate(); a stop/EOS token is yielded, then the stream ends."""
     prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
     if prompt_tokens.ndim == 1:
         prompt_tokens = prompt_tokens[None]
@@ -765,10 +800,17 @@ def stream_generate(model: LlamaModel, variables, prompt_tokens,
 
     logits, cache, step = _prefill_and_step(model, variables, prompt_tokens,
                                             temperature, top_p)
+    stop = frozenset(map(int, stop_tokens))
     rng, sub = jax.random.split(rng)
     next_token = _select_token(logits[:, -1], temperature, top_p, sub)
-    yield int(next_token[0])
+    tok = int(next_token[0])
+    yield tok
+    if tok in stop:
+        return
 
     for _ in range(max_new_tokens - 1):
         cache, next_token, rng = step(cache, next_token, rng)
-        yield int(next_token[0])
+        tok = int(next_token[0])
+        yield tok
+        if tok in stop:
+            return

@@ -51,6 +51,7 @@ class _Request:
     temperature: float = 0.0
     top_p: float = 1.0
     seed: int = 0
+    stop_tokens: frozenset = frozenset()
     done: threading.Event = field(default_factory=threading.Event)
     output: List[int] = field(default_factory=list)
     error: Optional[Exception] = None
@@ -61,6 +62,15 @@ class _Request:
         self.output.append(token)
         if self.on_token is not None:
             self.on_token(token)
+
+    @property
+    def finished(self) -> bool:
+        """Budget exhausted or a stop/EOS token emitted (the stop token
+        itself is included in the output, the standard convention)."""
+        return (len(self.output) >= self.max_new_tokens
+                or (bool(self.stop_tokens)
+                    and self.output
+                    and self.output[-1] in self.stop_tokens))
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -407,6 +417,13 @@ class ContinuousBatcher:
             j = int(accepted[i])
             emit = g_np[i, :j + 1]
             take = int(min(len(emit), remaining))
+            if req.stop_tokens:
+                # Truncate at the first stop token (emitted inclusive):
+                # tokens past it were speculated beyond the sequence end.
+                for pos in range(take):
+                    if int(emit[pos]) in req.stop_tokens:
+                        take = pos + 1
+                        break
             self.spec_stats["accepted_drafts"] += min(j, take)
             for tok in emit[:take]:
                 req.emit(int(tok))
@@ -414,7 +431,7 @@ class ContinuousBatcher:
             # accepted (committed) drafts; the bonus slot is garbage.
             self._draft_pos[i] = int(m[i] + min(j, take))
             m[i] += take
-            if len(req.output) >= req.max_new_tokens:
+            if req.finished:
                 req.done.set()
                 slots[i] = None
                 self._retire_slot(i)
@@ -697,7 +714,7 @@ class ContinuousBatcher:
         return self.draft_len + 1
 
     def _enqueue(self, tokens, max_new_tokens, temperature, top_p, seed,
-                 on_token=None) -> _Request:
+                 on_token=None, stop_tokens=()) -> _Request:
         headroom = self._headroom(temperature)
         if len(tokens) + max_new_tokens + headroom > self._max_seq_len:
             raise ValueError(
@@ -720,17 +737,19 @@ class ContinuousBatcher:
             seed = random.getrandbits(31)
         req = _Request(list(map(int, tokens)), max_new_tokens,
                        temperature=float(temperature), top_p=float(top_p),
-                       seed=int(seed), on_token=on_token)
+                       seed=int(seed), on_token=on_token,
+                       stop_tokens=frozenset(map(int, stop_tokens)))
         self._queue.put(req)
         return req
 
     def submit(self, tokens: List[int], max_new_tokens: int,
                timeout: float = 300.0, temperature: float = 0.0,
-               top_p: float = 1.0, seed: Optional[int] = None) -> List[int]:
+               top_p: float = 1.0, seed: Optional[int] = None,
+               stop_tokens=()) -> List[int]:
         if max_new_tokens <= 0:
             return []  # match generate()'s [B, 0] semantics
         req = self._enqueue(tokens, max_new_tokens, temperature, top_p,
-                            seed)
+                            seed, stop_tokens=stop_tokens)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if req.error is not None:
@@ -739,7 +758,8 @@ class ContinuousBatcher:
 
     def submit_iter(self, tokens: List[int], max_new_tokens: int,
                     timeout: float = 300.0, temperature: float = 0.0,
-                    top_p: float = 1.0, seed: Optional[int] = None):
+                    top_p: float = 1.0, seed: Optional[int] = None,
+                    stop_tokens=()):
         """Streaming submit: yields each generated id as the batcher
         produces it (tokens from this slot's decode ticks)."""
         if max_new_tokens <= 0:
@@ -747,7 +767,8 @@ class ContinuousBatcher:
         sentinel = object()
         out: "queue.Queue" = queue.Queue()
         req = self._enqueue(tokens, max_new_tokens, temperature, top_p,
-                            seed, on_token=out.put)
+                            seed, on_token=out.put,
+                            stop_tokens=stop_tokens)
         threading.Thread(
             target=lambda: (req.done.wait(timeout), out.put(sentinel)),
             daemon=True).start()
@@ -854,7 +875,7 @@ class ContinuousBatcher:
                     if self.page_size > 0:
                         self._register_blocks(i, req.tokens)
                     req.emit(int(first))
-                    if len(req.output) >= req.max_new_tokens:
+                    if req.finished:
                         req.done.set()
                         self._retire_slot(i)
                         continue
@@ -906,7 +927,7 @@ class ContinuousBatcher:
                     self._retire_slot(i)
                     continue
                 req.emit(int(out[i]))
-                if len(req.output) >= req.max_new_tokens:
+                if req.finished:
                     req.done.set()
                     slots[i] = None
                     self._retire_slot(i)

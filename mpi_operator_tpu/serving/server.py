@@ -53,11 +53,15 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", "0"))
             req = json.loads(self.rfile.read(length))
             tokens = req["tokens"]
+            stop = req.get("stop") or []  # null = unset
+            if req.get("eos_token_id") is not None:
+                stop = list(stop) + [req["eos_token_id"]]
             kwargs = dict(
                 max_new_tokens=int(req.get("max_new_tokens", 16)),
                 temperature=float(req.get("temperature", 0.0)),
                 top_p=float(req.get("top_p", 1.0)),
-                seed=req.get("seed"))
+                seed=req.get("seed"),
+                stop_tokens=tuple(map(int, stop)))
             if req.get("stream"):
                 return self._stream(server, tokens, kwargs)
             out = server.generate(tokens, **kwargs)
@@ -173,7 +177,7 @@ class InferenceServer:
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed=None) -> list:
+                 seed=None, stop_tokens=()) -> list:
         import jax
         import jax.numpy as jnp
 
@@ -198,7 +202,7 @@ class InferenceServer:
         if self._batcher is not None and len(rows) == 1:
             return [self._batcher.submit(
                 rows[0], max_new_tokens, temperature=temperature,
-                top_p=top_p, seed=seed)]
+                top_p=top_p, seed=seed, stop_tokens=stop_tokens)]
         lengths = [len(r) for r in rows]
         width = max(lengths)
         prompt = jnp.asarray([r + [0] * (width - len(r)) for r in rows],
@@ -227,11 +231,23 @@ class InferenceServer:
                 out = generate(self.model, self.variables, prompt,
                                max_new_tokens, temperature=temperature,
                                top_p=top_p, rng=rng,
-                               prompt_lengths=prompt_lengths)
-        return [[int(t) for t in row] for row in out]
+                               prompt_lengths=prompt_lengths,
+                               stop_tokens=stop_tokens)
+        result = [[int(t) for t in row] for row in out]
+        if stop_tokens and speculate:
+            # The speculative path decodes the full budget; truncating
+            # at the first stop token is equivalent to stopping there
+            # (same fill convention as generate(), shared helper).
+            import numpy as np
+
+            from ..models.llama import fill_after_stop
+            result = fill_after_stop(np.array(result, dtype=np.int64),
+                                     stop_tokens).tolist()
+        return result
 
     def stream(self, tokens, max_new_tokens: int = 16,
-               temperature: float = 0.0, top_p: float = 1.0, seed=None):
+               temperature: float = 0.0, top_p: float = 1.0, seed=None,
+               stop_tokens=()):
         """Yield generated ids one at a time for ONE sequence (the SSE
         source).  Rides the continuous batcher when enabled; otherwise
         takes the device lock per decode step so slow stream consumers
@@ -252,7 +268,7 @@ class InferenceServer:
         if self._batcher is not None:
             yield from self._batcher.submit_iter(
                 rows, max_new_tokens, temperature=temperature, top_p=top_p,
-                seed=seed)
+                seed=seed, stop_tokens=stop_tokens)
             return
 
         from ..models.llama import stream_generate
@@ -262,7 +278,8 @@ class InferenceServer:
         # the socket drains.
         gen = stream_generate(
             self.model, self.variables, rows, max_new_tokens,
-            temperature=temperature, top_p=top_p, rng=rng)
+            temperature=temperature, top_p=top_p, rng=rng,
+            stop_tokens=stop_tokens)
         try:
             while True:
                 with self._lock:

@@ -153,3 +153,74 @@ def test_tensor_parallel_serving_matches_unsharded():
     # param placement really is sharded over the mesh
     wq = sharded.variables["params"]["layers_0"]["attention"]["wq"]["kernel"]
     assert len(wq.sharding.device_set) == 4
+
+
+def test_stop_tokens_end_generation_early():
+    """EOS/stop handling on every path: generate() truncates+fills at
+    the first stop token, stream ends after yielding it, the batcher
+    retires the slot early (incl. the speculative tick), and the HTTP
+    surface accepts stop/eos_token_id."""
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.models.llama import (LlamaModel, generate,
+                                               greedy_generate,
+                                               llama2_tiny,
+                                               stream_generate)
+    from mpi_operator_tpu.serving import InferenceServer
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    prompt = [5, 3, 8, 1]
+    free = np.asarray(greedy_generate(
+        model, variables, jnp.asarray([prompt], jnp.int32), 10))[0]
+    stop_tok = int(free[3])  # force a stop 4 tokens in
+
+    out = np.asarray(generate(model, variables,
+                              jnp.asarray([prompt], jnp.int32), 10,
+                              stop_tokens=(stop_tok,)))[0]
+    first = int(np.nonzero(out == stop_tok)[0][0])
+    assert first <= 3
+    assert (out[:first + 1] == free[:first + 1]).all()
+    assert (out[first:] == stop_tok).all()  # filled after stop
+
+    streamed = list(stream_generate(model, variables,
+                                    jnp.asarray([prompt], jnp.int32), 10,
+                                    stop_tokens=(stop_tok,)))
+    assert streamed[-1] == stop_tok
+    assert len(streamed) == first + 1
+
+    # Batcher (plain and speculative ticks) retires at the stop token.
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+    for draft in (None, model):
+        b = ContinuousBatcher(
+            model, variables, max_slots=2,
+            draft_model=draft,
+            draft_variables=variables if draft is not None else None,
+        ).start()
+        try:
+            got = b.submit(prompt, 10, stop_tokens=(stop_tok,))
+            assert got == list(map(int, free[:first + 1])), (draft, got)
+        finally:
+            b.stop()
+
+    # HTTP: "stop" list and "eos_token_id" both work.
+    srv = InferenceServer(model, variables).start()
+    try:
+        for payload in ({"stop": [stop_tok]},
+                        {"eos_token_id": stop_tok}):
+            req = urllib.request.Request(
+                srv.url + "/generate",
+                data=json.dumps({"tokens": [prompt],
+                                 "max_new_tokens": 10,
+                                 **payload}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            out = json.loads(urllib.request.urlopen(
+                req, timeout=300).read())["tokens"][0]
+            assert stop_tok in out
+            assert out[out.index(stop_tok):] == \
+                [stop_tok] * (len(out) - out.index(stop_tok))
+    finally:
+        srv.stop()
