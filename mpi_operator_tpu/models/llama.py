@@ -449,10 +449,15 @@ class LlamaBlock(nn.Module):
         normed = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h)
         if cfg.n_experts > 1:
             from ..ops.moe import MoEMLP
+            # decode -> drop-free routing: capacity dropping is a
+            # training tradeoff, and per-step capacities differ from the
+            # prefill's, which would make generation diverge from the
+            # model's own forward pass (ops/moe.py MoEMLP.no_drop).
             mlp_out = MoEMLP(dim=cfg.dim, ffn_dim=cfg.ffn_dim,
                              n_experts=cfg.n_experts, top_k=cfg.top_k,
                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                             mesh=self.mesh, name="feed_forward")(normed)
+                             mesh=self.mesh, no_drop=decode,
+                             name="feed_forward")(normed)
         else:
             mlp_out = LlamaMLP(cfg, self.mesh, name="feed_forward")(normed)
         return h + mlp_out

@@ -20,7 +20,16 @@ import jax.numpy as jnp
 
 
 class MoEMLP(nn.Module):
-    """Top-k routed SwiGLU experts on [B, S, D] activations."""
+    """Top-k routed SwiGLU experts on [B, S, D] activations.
+
+    no_drop: capacity becomes ``tokens`` (each token routes a given
+    expert at most once, so no assignment can overflow) — routing is
+    then exactly the router's top-k with NO capacity drops.  Inference
+    must set this: dropping is a TRAINING throughput/balance tradeoff,
+    and with capacity tied to the token count a 1-token decode step
+    would drop differently than the prefill that cached the same
+    sequence, making generation inconsistent with the model's own
+    forward pass (observed: ~30% of greedy decode tokens diverged)."""
     dim: int
     ffn_dim: int
     n_experts: int
@@ -29,13 +38,19 @@ class MoEMLP(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     mesh: Any = None
+    no_drop: bool = False
+
+    # Token-chunk size for drop-free dispatch: routing is per-token
+    # independent, so chunking is exact; per-chunk capacity = chunk
+    # size keeps the [T, E, C] one-hots linear in T instead of the
+    # quadratic [T, E, T] a whole-prompt no-drop prefill would build.
+    NO_DROP_CHUNK = 256
 
     @nn.compact
     def __call__(self, x):
         b, s, d = x.shape
         tokens = b * s
         e = self.n_experts
-        capacity = max(1, int(self.capacity_factor * tokens * self.top_k / e))
 
         xf = x.reshape(tokens, d)
 
@@ -50,32 +65,6 @@ class MoEMLP(nn.Module):
         gate_vals = gate_vals / jnp.clip(
             jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
-        # Position of each (token, k) within its expert's capacity buffer.
-        expert_onehot = jax.nn.one_hot(expert_idx, e,
-                                       dtype=jnp.int32)      # [T, K, E]
-        position = (jnp.cumsum(expert_onehot.reshape(tokens * self.top_k, e),
-                               axis=0)
-                    .reshape(tokens, self.top_k, e) - 1)
-        position = jnp.sum(position * expert_onehot, axis=-1)  # [T, K]
-        keep = position < capacity                             # overflow drop
-
-        # Dispatch/combine tensors [T, E, C].
-        pos_onehot = jax.nn.one_hot(position, capacity,
-                                    dtype=self.dtype)          # [T, K, C]
-        disp = jnp.einsum("tke,tkc->tec",
-                          expert_onehot.astype(self.dtype)
-                          * keep[..., None].astype(self.dtype),
-                          pos_onehot)
-        combine = jnp.einsum("tk,tke,tkc->tec",
-                             gate_vals.astype(self.dtype),
-                             expert_onehot.astype(self.dtype)
-                             * keep[..., None].astype(self.dtype),
-                             pos_onehot)
-
-        # Expert buffers [E, C, D] — sharded over 'ep' when a mesh exists.
-        expert_in = jnp.einsum("td,tec->ecd", xf.astype(self.dtype), disp)
-        expert_in = self._constrain_expert(expert_in)
-
         # Batched SwiGLU experts: params [E, D, F] / [E, F, D].
         def w(name, shape):
             return self.param(name, nn.initializers.lecun_normal(
@@ -85,12 +74,57 @@ class MoEMLP(nn.Module):
         w1 = w("w1", (e, d, self.ffn_dim)).astype(self.dtype)
         w3 = w("w3", (e, d, self.ffn_dim)).astype(self.dtype)
         w2 = w("w2", (e, self.ffn_dim, d)).astype(self.dtype)
-        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w1)) * \
-            jnp.einsum("ecd,edf->ecf", expert_in, w3)
-        expert_out = jnp.einsum("ecf,efd->ecd", h, w2)
-        expert_out = self._constrain_expert(expert_out)
 
-        out = jnp.einsum("ecd,tec->td", expert_out, combine)
+        def dispatch_block(xf_c, gate_c, idx_c, capacity):
+            """GShard dispatch + expert compute + combine for one token
+            block (T_c tokens) at the given capacity."""
+            t_c = xf_c.shape[0]
+            expert_onehot = jax.nn.one_hot(idx_c, e,
+                                           dtype=jnp.int32)  # [T, K, E]
+            position = (jnp.cumsum(
+                expert_onehot.reshape(t_c * self.top_k, e), axis=0)
+                .reshape(t_c, self.top_k, e) - 1)
+            position = jnp.sum(position * expert_onehot, axis=-1)
+            keep = position < capacity                       # overflow drop
+            pos_onehot = jax.nn.one_hot(position, capacity,
+                                        dtype=self.dtype)    # [T, K, C]
+            masked = (expert_onehot.astype(self.dtype)
+                      * keep[..., None].astype(self.dtype))
+            disp = jnp.einsum("tke,tkc->tec", masked, pos_onehot)
+            combine = jnp.einsum("tk,tke,tkc->tec",
+                                 gate_c.astype(self.dtype), masked,
+                                 pos_onehot)
+            # Expert buffers [E, C, D] — sharded over 'ep' with a mesh.
+            expert_in = self._constrain_expert(
+                jnp.einsum("td,tec->ecd", xf_c.astype(self.dtype), disp))
+            h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w1)) * \
+                jnp.einsum("ecd,edf->ecf", expert_in, w3)
+            expert_out = self._constrain_expert(
+                jnp.einsum("ecf,efd->ecd", h, w2))
+            return jnp.einsum("ecd,tec->td", expert_out, combine)
+
+        chunk = self.NO_DROP_CHUNK
+        if self.no_drop and tokens > chunk:
+            # Drop-free over long inputs: exact per chunk (per-expert
+            # assignments within a chunk never exceed its token count),
+            # linear memory.  Pad to a whole number of chunks; padded
+            # rows route somewhere and are sliced off.
+            n_chunks = -(-tokens // chunk)
+            pad = n_chunks * chunk - tokens
+            xf_p = jnp.pad(xf, ((0, pad), (0, 0)))
+            gate_p = jnp.pad(gate_vals, ((0, pad), (0, 0)))
+            idx_p = jnp.pad(expert_idx, ((0, pad), (0, 0)))
+            out = jax.lax.map(
+                lambda args: dispatch_block(*args, capacity=chunk),
+                (xf_p.reshape(n_chunks, chunk, d),
+                 gate_p.reshape(n_chunks, chunk, self.top_k),
+                 idx_p.reshape(n_chunks, chunk, self.top_k)))
+            out = out.reshape(n_chunks * chunk, d)[:tokens]
+        else:
+            capacity = tokens if self.no_drop else max(
+                1, int(self.capacity_factor * tokens * self.top_k / e))
+            out = dispatch_block(xf, gate_vals, expert_idx, capacity)
+
         # Load-balancing auxiliary loss (Switch: E * mean(frac) . mean(prob)).
         frac_tokens = jnp.mean(
             jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32), axis=0)

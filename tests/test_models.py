@@ -381,3 +381,42 @@ def test_grad_accumulation_rejects_indivisible_batch():
             step_fn(state, jnp.ones((8, 4)))
     with pytest.raises(ValueError, match="accum_steps"):
         build_train_step(loss_fn, optax.sgd(1e-2), mesh, accum_steps=0)
+
+
+def test_moe_decode_consistent_with_forward():
+    """Regression: MoE generation must be self-consistent.  Capacity
+    dropping tied to the token count made a 1-token decode step drop
+    (capacity collapsed to ~1) where the prefill had not — ~30% of
+    greedy tokens diverged from the model's own forward pass.  Decode
+    mode now routes drop-free: every generated token must equal the
+    argmax of a teacher-forced decode-mode forward, and the serving
+    batcher must match generate()."""
+    import numpy as np
+
+    from mpi_operator_tpu.models.llama import (LlamaConfig, LlamaModel,
+                                               greedy_generate)
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    cfg = LlamaConfig(vocab_size=128, dim=64, n_layers=2, n_heads=2,
+                      n_kv_heads=1, max_seq_len=64, n_experts=4, top_k=2)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    variables = {"params": variables["params"]}
+    prompts = jnp.asarray([[5, 3, 8, 1], [7, 6, 2, 9]], jnp.int32)
+    out = np.asarray(greedy_generate(model, variables, prompts, 8))
+
+    seq = jnp.concatenate([prompts, jnp.asarray(out)], axis=1)
+    full, _ = model.apply(variables, seq[:, :-1], decode=True,
+                          mutable=["cache"])
+    for r in range(2):
+        for i in range(8):
+            assert int(jnp.argmax(full[r, 3 + i])) == out[r, i], (r, i)
+
+    batcher = ContinuousBatcher(model, variables, max_slots=2).start()
+    try:
+        for r in range(2):
+            got = batcher.submit([int(t) for t in prompts[r]], 8)
+            assert got == list(map(int, out[r])), (r, got)
+    finally:
+        batcher.stop()

@@ -516,3 +516,26 @@ def test_int8_kv_cache_decode_logits_close_to_dense():
     pool = find(leaves, "pool_key")
     assert pool.dtype == jnp.int8
     assert find(leaves, "pool_key_scale") is not None
+
+
+def test_moe_no_drop_chunked_matches_unchunked():
+    """Drop-free MoE dispatch over long inputs runs chunked (linear
+    memory instead of [T, E, T]); routing is per-token independent, so
+    the chunked result must equal the single-block no-drop dispatch."""
+    import flax.linen as nn_  # noqa: F401
+
+    from mpi_operator_tpu.ops.moe import MoEMLP
+
+    class Unchunked(MoEMLP):
+        NO_DROP_CHUNK = 1 << 30
+
+    b, s, d = 2, 150, 32                     # 300 tokens > chunk of 256
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, s, d), jnp.float32)
+    kwargs = dict(dim=d, ffn_dim=64, n_experts=4, top_k=2,
+                  dtype=jnp.float32, no_drop=True)
+    chunked = MoEMLP(**kwargs)
+    variables = chunked.init(jax.random.PRNGKey(1), x)
+    out_c = chunked.apply(variables, x)
+    out_u = Unchunked(**kwargs).apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_u),
+                               atol=2e-5, rtol=2e-5)
