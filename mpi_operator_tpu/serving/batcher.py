@@ -410,7 +410,6 @@ class ContinuousBatcher:
                 req.done.set()
                 slots[i] = None
                 self._retire_slot(i)
-                self._draft_pos.pop(i, None)
                 continue
             remaining = req.max_new_tokens - len(req.output)
             self.spec_stats["drafted"] += min(k, remaining)
@@ -435,7 +434,6 @@ class ContinuousBatcher:
                 req.done.set()
                 slots[i] = None
                 self._retire_slot(i)
-                self._draft_pos.pop(i, None)
             else:
                 # Keep the plain-tick invariant for a possible fallback
                 # tick: next_tokens carries the newest emitted token.
@@ -561,6 +559,11 @@ class ContinuousBatcher:
         into blocks about to be reallocated.  Registered blocks stay in
         the prefix cache at refcount-1 (evicted only under pressure);
         unregistered ones return to the free list."""
+        if self._draft_model is not None:
+            # Draft coverage is per-slot state too; EVERY retirement
+            # path funnels here (plain tick, spec tick, admission,
+            # cancellation), so this is the one cleanup point.
+            self._draft_pos.pop(slot, None)
         if self.page_size <= 0:
             return
         blocks = self._slot_blocks.pop(slot, None)
