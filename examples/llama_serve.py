@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Serve a Llama/Mixtral-family model over HTTP.
+
+The full serving stack in one command: continuous batching, paged KV
+cache with prefix caching, optional int8 KV quantization, optional
+speculative decoding with a draft model, stop tokens, SSE streaming,
+tensor-parallel decode.
+
+    # random-init tiny model, batched + paged, one demo request:
+    python examples/llama_serve.py --config tiny --slots 4 --demo
+
+    # HF checkpoint (Llama or Mixtral), int8 KV, draft for speculation:
+    python examples/llama_serve.py --hf /path/to/checkpoint \
+        --kv-cache-dtype int8 --draft-hf /path/to/small-checkpoint
+
+    # then:
+    curl -s localhost:8080/generate -d \
+      '{"tokens": [[1,2,3]], "max_new_tokens": 16, "eos_token_id": 2}'
+
+No reference counterpart: kubeflow/mpi-operator is training-only
+orchestration (SURVEY.md §2.2); this rounds out the workload stack's
+train -> checkpoint -> serve lifecycle.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_model(spec: str, config_name: str):
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.models.llama import (LlamaModel, llama2_tiny,
+                                               mixtral_tiny)
+
+    if spec:
+        import torch
+        from transformers import AutoConfig, AutoModelForCausalLM
+
+        from mpi_operator_tpu.models.convert import (config_from_hf,
+                                                     convert_hf_llama,
+                                                     convert_hf_mixtral)
+        hf_config = AutoConfig.from_pretrained(spec)
+        with torch.no_grad():
+            hf_model = AutoModelForCausalLM.from_pretrained(spec)
+        cfg = config_from_hf(hf_config)
+        convert = (convert_hf_mixtral if cfg.n_experts > 1
+                   else convert_hf_llama)
+        variables = convert(hf_model.state_dict(), cfg)
+        model = LlamaModel(cfg)
+        return model, variables
+    cfg = {"tiny": llama2_tiny, "mixtral-tiny": mixtral_tiny}[config_name]()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    variables = {"params": variables["params"]}
+    return model, variables
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="tiny",
+                    choices=["tiny", "mixtral-tiny"],
+                    help="random-init config when no --hf is given")
+    ap.add_argument("--hf", default="",
+                    help="HuggingFace checkpoint dir (Llama or Mixtral)")
+    ap.add_argument("--draft-hf", default="",
+                    help="draft checkpoint for speculative decoding")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--slots", type=int, default=4,
+                    help="continuous-batching slots (0 = single-flight)")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="paged KV block size (with --slots > 0)")
+    ap.add_argument("--kv-cache-dtype", default="auto",
+                    choices=["auto", "int8"])
+    ap.add_argument("--demo", action="store_true",
+                    help="send one demo request, print it, and exit")
+    args = ap.parse_args()
+
+    from mpi_operator_tpu.serving import InferenceServer
+
+    model, variables = load_model(args.hf, args.config)
+    draft_model = draft_vars = None
+    if args.draft_hf:
+        draft_model, draft_vars = load_model(args.draft_hf, "")
+
+    page = args.page_size if args.slots > 0 else 0
+    kv_dtype = args.kv_cache_dtype if args.slots > 0 else "auto"
+    if kv_dtype != args.kv_cache_dtype:
+        raise SystemExit(
+            "--kv-cache-dtype needs continuous batching (--slots > 0); "
+            "the single-flight path uses the dense cache")
+    server = InferenceServer(
+        model, variables, host=args.host, port=args.port,
+        max_batch_slots=args.slots, kv_page_size=page,
+        kv_cache_dtype=kv_dtype,
+        draft_model=draft_model, draft_variables=draft_vars).start()
+    print(f"serving on {server.url}  (slots={args.slots}, "
+          f"page={page}, kv={kv_dtype}, "
+          f"speculative={'on' if draft_model is not None else 'off'})",
+          flush=True)
+
+    try:
+        if args.demo:
+            req = urllib.request.Request(
+                server.url + "/generate",
+                data=json.dumps({"tokens": [[1, 2, 3, 4]],
+                                 "max_new_tokens": 8}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                print("demo:", resp.read().decode(), flush=True)
+            return 0
+        import signal
+        import threading
+        stopped = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stopped.set())
+        try:
+            # Event.wait is race-free against a SIGTERM landing between
+            # the loop check and the wait (unlike signal.pause()).
+            while not stopped.wait(timeout=1.0):
+                pass
+        except KeyboardInterrupt:
+            pass
+        return 0
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

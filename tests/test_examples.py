@@ -164,3 +164,13 @@ def test_llama_train_interleaved_1f1b():
                "--batch-per-dp", "4", timeout=420)
     assert "schedule=1f1b virtual_stages=2" in out
     assert "tokens/sec" in out and "loss=" in out
+
+
+def test_llama_serve_example_demo():
+    """The serving example stands up the full stack (batching + paged
+    int8 KV) and answers a demo request."""
+    out = _run("llama_serve.py", "--config", "tiny", "--slots", "2",
+               "--kv-cache-dtype", "int8", "--port", "0", "--demo",
+               timeout=400)
+    assert "serving on http://127.0.0.1:" in out
+    assert '"tokens": [[' in out
