@@ -548,11 +548,17 @@ def next_token_loss(logits, tokens):
     return jnp.mean(logz - gold)
 
 
-def _select_token(logits, temperature: float, top_p: float, rng):
-    """Greedy (temperature=0) or nucleus sampling from [B, V] logits."""
+def _select_token(logits, temperature: float, top_p: float, rng,
+                  top_k: int = 0):
+    """Greedy (temperature=0), top-k, and/or nucleus sampling from
+    [B, V] logits (HF order: scale -> top-k -> top-p)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits.astype(jnp.float32) / temperature
+    if top_k and top_k > 0:
+        k = min(int(top_k), logits.shape[-1])  # oversized k = disabled
+        thresh = jnp.sort(logits, axis=-1)[:, -k][:, None]
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
     if top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
@@ -619,40 +625,73 @@ def _prefill_apply_cached(model, params, cache, tokens):
                        decode=True, mutable=["cache"])
 
 
-def _select_token_traced(logits, temperature, top_p, rng):
-    """Nucleus sampling with TRACED temperature/top_p scalars: one
-    compiled executable serves every sampling config (a server
-    forwarding arbitrary client floats must not grow the jit cache
-    per distinct value)."""
-    scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+def select_rows(logits, temps, top_ps, keys, top_ks=None):
+    """THE row-wise selection kernel, shared by every sampling path
+    (serving/batcher ticks, the traced decode step): logits [B, V],
+    temps/top_ps [B], keys [B]-shaped PRNG keys (or raw [B, 2]
+    uint32), top_ks [B] int32 (0 = disabled; oversized k clamps to
+    disabled-equivalent).  HF order: scale -> top-k -> top-p; rows with
+    temperature <= 0 are greedy.  All selection params are TRACED so
+    one executable serves every sampling config.  Returns
+    (tokens [B], advanced keys)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
     sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    if top_ks is not None:
+        # k-th largest as a per-row threshold; k=0 disables.
+        v = scaled.shape[-1]
+        k_idx = jnp.clip(top_ks, 1, v) - 1
+        k_thresh = jnp.take_along_axis(sorted_logits, k_idx[:, None],
+                                       axis=-1)
+        scaled = jnp.where(
+            (scaled < k_thresh) & (top_ks[:, None] > 0), -jnp.inf, scaled)
+        sorted_logits = jnp.where(
+            (jnp.arange(v)[None, :] >= jnp.where(top_ks > 0, top_ks,
+                                                 v)[:, None]),
+            -jnp.inf, sorted_logits)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cumulative = jnp.cumsum(probs, axis=-1)
-    cutoff_idx = jnp.sum(cumulative < top_p, axis=-1)
+    cutoff_idx = jnp.sum(cumulative < top_ps[:, None], axis=-1)
     threshold = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
                                     axis=-1)
-    # top_p >= 1 disables the mask entirely (float cumsum can cross 1.0
-    # a slot early, which would otherwise clip the tail distribution).
-    threshold = jnp.where(top_p >= 1.0, -jnp.inf, threshold)
-    masked = jnp.where(scaled < threshold, -jnp.inf, scaled)
-    return jax.random.categorical(rng, masked, axis=-1)
+    nucleus = jnp.where(
+        (scaled < threshold) & (top_ps[:, None] < 1.0), -jnp.inf, scaled)
+    sampled = jax.vmap(lambda l, k: jax.random.categorical(k, l))(
+        nucleus, keys)
+    new_keys = jax.vmap(lambda k: jax.random.split(k, 1)[0])(keys)
+    return jnp.where(temps <= 0.0, greedy, sampled), new_keys
+
+
+def _select_token_traced(logits, temperature, top_p, top_k, rng):
+    """Traced-scalar wrapper over select_rows for the decode step: one
+    compiled executable serves every sampling config (a server
+    forwarding arbitrary client values must not grow the jit cache per
+    distinct value)."""
+    b = logits.shape[0]
+    toks, _ = select_rows(
+        logits, jnp.broadcast_to(temperature, (b,)),
+        jnp.broadcast_to(top_p, (b,)), jax.random.split(rng, b),
+        jnp.broadcast_to(top_k, (b,)))
+    return toks
 
 
 @functools.partial(jax.jit, static_argnums=(0, 4))
 def _decode_step(model, params, cache, token, greedy, temperature, top_p,
-                 rng):
+                 top_k, rng):
     logits, state = model.apply({"params": params, "cache": cache},
                                 token[:, None], decode=True,
                                 mutable=["cache"])
     rng, sub = jax.random.split(rng)
     last = logits[:, -1]
     tok = (jnp.argmax(last, axis=-1) if greedy
-           else _select_token_traced(last, temperature, top_p, sub))
+           else _select_token_traced(last, temperature, top_p, top_k,
+                                     sub))
     return state["cache"], tok, rng
 
 
 def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
-                      temperature: float, top_p: float):
+                      temperature: float, top_p: float,
+                      top_k: int = 0):
     """Shared decode core for generate()/stream_generate(): prefill the
     prompt and build the jitted one-token step.  Returns
     (prefill_logits, cache, step_fn).
@@ -692,7 +731,7 @@ def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
     def step(cache, token, rng):
         return _decode_step(model, params["params"], cache, token, greedy,
                             jnp.float32(temperature), jnp.float32(top_p),
-                            rng)
+                            jnp.int32(top_k), rng)
 
     return logits, cache, step
 
@@ -700,7 +739,7 @@ def _prefill_and_step(model: LlamaModel, variables, prompt_tokens,
 def generate(model: LlamaModel, variables, prompt_tokens,
              max_new_tokens: int, temperature: float = 0.0,
              top_p: float = 1.0, rng=None, prompt_lengths=None,
-             stop_tokens=()):
+             stop_tokens=(), top_k: int = 0):
     """KV-cache decoding: prefill the prompt, then one token per step.
     temperature=0 is greedy; otherwise nucleus (top-p) sampling.
 
@@ -729,7 +768,7 @@ def generate(model: LlamaModel, variables, prompt_tokens,
         rng = jax.random.PRNGKey(0)
 
     logits, cache, step = _prefill_and_step(model, variables, prompt_tokens,
-                                            temperature, top_p)
+                                            temperature, top_p, top_k)
     if prompt_lengths is not None:
         lengths = jnp.asarray(prompt_lengths, jnp.int32)
         cache = _set_cache_index(cache, lengths)
@@ -738,7 +777,8 @@ def generate(model: LlamaModel, variables, prompt_tokens,
     else:
         last_logits = logits[:, -1]
     rng, sub = jax.random.split(rng)
-    next_token = _select_token(last_logits, temperature, top_p, sub)
+    next_token = _select_token(last_logits, temperature, top_p, sub,
+                               top_k)
 
     stop = frozenset(map(int, stop_tokens))
     out = [next_token]
@@ -784,7 +824,8 @@ def greedy_generate(model: LlamaModel, variables, prompt_tokens,
 
 def stream_generate(model: LlamaModel, variables, prompt_tokens,
                     max_new_tokens: int, temperature: float = 0.0,
-                    top_p: float = 1.0, rng=None, stop_tokens=()):
+                    top_p: float = 1.0, rng=None, stop_tokens=(),
+                    top_k: int = 0):
     """Token-by-token generator for ONE sequence ([1, S] or [S] prompt):
     yields each generated id as soon as its decode step completes — the
     serving layer's streaming (SSE) source.  Same selection semantics as
@@ -804,10 +845,11 @@ def stream_generate(model: LlamaModel, variables, prompt_tokens,
         rng = jax.random.PRNGKey(0)
 
     logits, cache, step = _prefill_and_step(model, variables, prompt_tokens,
-                                            temperature, top_p)
+                                            temperature, top_p, top_k)
     stop = frozenset(map(int, stop_tokens))
     rng, sub = jax.random.split(rng)
-    next_token = _select_token(logits[:, -1], temperature, top_p, sub)
+    next_token = _select_token(logits[:, -1], temperature, top_p, sub,
+                               top_k)
     tok = int(next_token[0])
     yield tok
     if tok in stop:

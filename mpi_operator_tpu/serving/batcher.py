@@ -43,6 +43,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..models.llama import select_rows as _select_rows
+
 
 @dataclass
 class _Request:
@@ -50,6 +52,7 @@ class _Request:
     max_new_tokens: int
     temperature: float = 0.0
     top_p: float = 1.0
+    top_k: int = 0
     seed: int = 0
     stop_tokens: frozenset = frozenset()
     done: threading.Event = field(default_factory=threading.Event)
@@ -179,11 +182,12 @@ class ContinuousBatcher:
         self._cache = self._reset_cache(cache)
 
         @jax.jit
-        def decode_step(cache, tokens, temps, top_ps, keys):
+        def decode_step(cache, tokens, temps, top_ps, keys, top_ks):
             logits, state = decode_model.apply(
                 {**params, "cache": cache}, tokens[:, None], decode=True,
                 mutable=["cache"])
-            nxt, keys = _select_rows(logits[:, -1], temps, top_ps, keys)
+            nxt, keys = _select_rows(logits[:, -1], temps, top_ps, keys,
+                                     top_ks)
             return state["cache"], nxt.astype(jnp.int32), keys
 
         self._decode_step = decode_step
@@ -257,8 +261,8 @@ class ContinuousBatcher:
 
     def _prefill(self, tokens: List[int], sample_args):
         """Single-sequence prefill -> (cache_row_tree, next_token, key).
-        sample_args = (temperature, top_p, rng_key) scalars for the new
-        sequence's first sampled token."""
+        sample_args = (temperature, top_p, rng_key, top_k) scalars for
+        the new sequence's first sampled token."""
         jax, jnp = self._jax, self._jnp
         width = _bucket(len(tokens), self._max_seq_len)
         fn = self._prefill_cache.get(width)
@@ -266,13 +270,13 @@ class ContinuousBatcher:
             params = {"params": self.variables["params"]}
 
             @jax.jit
-            def prefill(padded, length, temp, top_p, key):
+            def prefill(padded, length, temp, top_p, key, top_k):
                 logits, state = self.model.apply(
                     params, padded, decode=True, mutable=["cache"])
                 cache = state["cache"]
                 nxt, key = _select_rows(logits[:, length - 1],
                                         temp[None], top_p[None],
-                                        key[None])
+                                        key[None], top_k[None])
                 return cache, nxt[0].astype(jnp.int32), key[0]
 
             fn = self._prefill_cache[width] = prefill
@@ -649,7 +653,7 @@ class ContinuousBatcher:
 
             @jax.jit
             def suffix_prefill(cache, table_row, shared_len, padded,
-                               length, temp, top_p, key):
+                               length, temp, top_p, key, top_k):
                 def to_b1(node):
                     if "pool_key" in node:
                         return {**node, "block_table": table_row[None],
@@ -675,7 +679,7 @@ class ContinuousBatcher:
 
                 nxt, key = _select_rows(logits[:, length - 1],
                                         temp[None], top_p[None],
-                                        key[None])
+                                        key[None], top_k[None])
                 return (back(cache, state["cache"]),
                         nxt[0].astype(jnp.int32), key[0])
 
@@ -694,10 +698,10 @@ class ContinuousBatcher:
         table_row = self._table_row(blocks)
         padded = jnp.asarray([suffix + [0] * (width - len(suffix))],
                              jnp.int32)
-        temp, top_p, key = sample_args
+        temp, top_p, key, top_k = sample_args
         new_cache, first, key1 = self._suffix_fn(width)(
             self._cache, table_row, jnp.int32(shared_len), padded,
-            len(suffix), temp, top_p, key)
+            len(suffix), temp, top_p, key, top_k)
         from ..models.llama import replace_cache_leaf
         new_cache = replace_cache_leaf(
             new_cache, "block_table", lambda t: t.at[slot].set(table_row))
@@ -717,7 +721,7 @@ class ContinuousBatcher:
         return self.draft_len + 1
 
     def _enqueue(self, tokens, max_new_tokens, temperature, top_p, seed,
-                 on_token=None, stop_tokens=()) -> _Request:
+                 on_token=None, stop_tokens=(), top_k=0) -> _Request:
         headroom = self._headroom(temperature)
         if len(tokens) + max_new_tokens + headroom > self._max_seq_len:
             raise ValueError(
@@ -740,7 +744,8 @@ class ContinuousBatcher:
             seed = random.getrandbits(31)
         req = _Request(list(map(int, tokens)), max_new_tokens,
                        temperature=float(temperature), top_p=float(top_p),
-                       seed=int(seed), on_token=on_token,
+                       top_k=int(top_k), seed=int(seed),
+                       on_token=on_token,
                        stop_tokens=frozenset(map(int, stop_tokens)))
         self._queue.put(req)
         return req
@@ -748,11 +753,11 @@ class ContinuousBatcher:
     def submit(self, tokens: List[int], max_new_tokens: int,
                timeout: float = 300.0, temperature: float = 0.0,
                top_p: float = 1.0, seed: Optional[int] = None,
-               stop_tokens=()) -> List[int]:
+               stop_tokens=(), top_k: int = 0) -> List[int]:
         if max_new_tokens <= 0:
             return []  # match generate()'s [B, 0] semantics
         req = self._enqueue(tokens, max_new_tokens, temperature, top_p,
-                            seed, stop_tokens=stop_tokens)
+                            seed, stop_tokens=stop_tokens, top_k=top_k)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if req.error is not None:
@@ -762,7 +767,7 @@ class ContinuousBatcher:
     def submit_iter(self, tokens: List[int], max_new_tokens: int,
                     timeout: float = 300.0, temperature: float = 0.0,
                     top_p: float = 1.0, seed: Optional[int] = None,
-                    stop_tokens=()):
+                    stop_tokens=(), top_k: int = 0):
         """Streaming submit: yields each generated id as the batcher
         produces it (tokens from this slot's decode ticks)."""
         if max_new_tokens <= 0:
@@ -771,7 +776,7 @@ class ContinuousBatcher:
         out: "queue.Queue" = queue.Queue()
         req = self._enqueue(tokens, max_new_tokens, temperature, top_p,
                             seed, on_token=out.put,
-                            stop_tokens=stop_tokens)
+                            stop_tokens=stop_tokens, top_k=top_k)
         threading.Thread(
             target=lambda: (req.done.wait(timeout), out.put(sentinel)),
             daemon=True).start()
@@ -809,6 +814,7 @@ class ContinuousBatcher:
         next_tokens = jnp.zeros((self.max_slots,), jnp.int32)
         temps = jnp.zeros((self.max_slots,), jnp.float32)
         top_ps = jnp.ones((self.max_slots,), jnp.float32)
+        top_ks = jnp.zeros((self.max_slots,), jnp.int32)
         keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
         # A request that could not get cache blocks waits here (FIFO
         # order preserved) until retirements free enough of the pool.
@@ -859,7 +865,8 @@ class ContinuousBatcher:
                     key0 = jax.random.fold_in(
                         jax.random.PRNGKey(req.seed), len(req.tokens))
                     sample_args = (jnp.float32(req.temperature),
-                                   jnp.float32(req.top_p), key0)
+                                   jnp.float32(req.top_p), key0,
+                                   jnp.int32(req.top_k))
                     shared = (self._slot_shared.get(i, 0)
                               if self.page_size > 0 else 0)
                     with self._device_lock:
@@ -886,6 +893,7 @@ class ContinuousBatcher:
                     next_tokens = next_tokens.at[i].set(int(first))
                     temps = temps.at[i].set(req.temperature)
                     top_ps = top_ps.at[i].set(req.top_p)
+                    top_ks = top_ks.at[i].set(req.top_k)
                     keys = keys.at[i].set(key1)
                     admitted = True
                 except Exception as exc:  # surface, don't kill the loop
@@ -919,7 +927,8 @@ class ContinuousBatcher:
             self.spec_stats["plain_ticks"] += 1
             with self._device_lock:
                 self._cache, out, keys = self._decode_step(
-                    self._cache, next_tokens, temps, top_ps, keys)
+                    self._cache, next_tokens, temps, top_ps, keys,
+                    top_ks)
             next_tokens = out
             for i, req in enumerate(slots):
                 if req is None:
@@ -951,27 +960,3 @@ class ContinuousBatcher:
             if req is not None:
                 req.error = RuntimeError("batcher stopped")
                 req.done.set()
-
-
-def _select_rows(logits, temps, top_ps, keys):
-    """Per-row greedy/nucleus selection: logits [B, V], temps/top_ps [B],
-    keys [B, 2].  Row semantics mirror models.llama._select_token
-    (smallest prefix with mass >= top_p); rows with temperature <= 0 are
-    greedy.  Returns (tokens [B], advanced keys [B, 2])."""
-    import jax
-    import jax.numpy as jnp
-
-    greedy = jnp.argmax(logits, axis=-1)
-    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumulative = jnp.cumsum(probs, axis=-1)
-    cutoff_idx = jnp.sum(cumulative < top_ps[:, None], axis=-1)
-    threshold = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
-                                    axis=-1)
-    nucleus = jnp.where(
-        (scaled < threshold) & (top_ps[:, None] < 1.0), -jnp.inf, scaled)
-    sampled = jax.vmap(lambda l, k: jax.random.categorical(k, l))(
-        nucleus, keys)
-    new_keys = jax.vmap(lambda k: jax.random.split(k, 1)[0])(keys)
-    return jnp.where(temps <= 0.0, greedy, sampled), new_keys

@@ -60,6 +60,7 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new_tokens=int(req.get("max_new_tokens", 16)),
                 temperature=float(req.get("temperature", 0.0)),
                 top_p=float(req.get("top_p", 1.0)),
+                top_k=int(req.get("top_k") or 0),
                 seed=req.get("seed"),
                 stop_tokens=tuple(map(int, stop)))
             if req.get("stream"):
@@ -177,7 +178,7 @@ class InferenceServer:
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed=None, stop_tokens=()) -> list:
+                 seed=None, stop_tokens=(), top_k: int = 0) -> list:
         import jax
         import jax.numpy as jnp
 
@@ -202,7 +203,8 @@ class InferenceServer:
         if self._batcher is not None and len(rows) == 1:
             return [self._batcher.submit(
                 rows[0], max_new_tokens, temperature=temperature,
-                top_p=top_p, seed=seed, stop_tokens=stop_tokens)]
+                top_p=top_p, seed=seed, stop_tokens=stop_tokens,
+                top_k=top_k)]
         lengths = [len(r) for r in rows]
         width = max(lengths)
         prompt = jnp.asarray([r + [0] * (width - len(r)) for r in rows],
@@ -232,7 +234,7 @@ class InferenceServer:
                                max_new_tokens, temperature=temperature,
                                top_p=top_p, rng=rng,
                                prompt_lengths=prompt_lengths,
-                               stop_tokens=stop_tokens)
+                               stop_tokens=stop_tokens, top_k=top_k)
         result = [[int(t) for t in row] for row in out]
         if stop_tokens and speculate:
             # The speculative path decodes the full budget; truncating
@@ -247,7 +249,7 @@ class InferenceServer:
 
     def stream(self, tokens, max_new_tokens: int = 16,
                temperature: float = 0.0, top_p: float = 1.0, seed=None,
-               stop_tokens=()):
+               stop_tokens=(), top_k: int = 0):
         """Yield generated ids one at a time for ONE sequence (the SSE
         source).  Rides the continuous batcher when enabled; otherwise
         takes the device lock per decode step so slow stream consumers
@@ -268,7 +270,7 @@ class InferenceServer:
         if self._batcher is not None:
             yield from self._batcher.submit_iter(
                 rows, max_new_tokens, temperature=temperature, top_p=top_p,
-                seed=seed, stop_tokens=stop_tokens)
+                seed=seed, stop_tokens=stop_tokens, top_k=top_k)
             return
 
         from ..models.llama import stream_generate
@@ -279,7 +281,7 @@ class InferenceServer:
         gen = stream_generate(
             self.model, self.variables, rows, max_new_tokens,
             temperature=temperature, top_p=top_p, rng=rng,
-            stop_tokens=stop_tokens)
+            stop_tokens=stop_tokens, top_k=top_k)
         try:
             while True:
                 with self._lock:

@@ -224,3 +224,56 @@ def test_stop_tokens_end_generation_early():
                 [stop_tok] * (len(out) - out.index(stop_tok))
     finally:
         srv.stop()
+
+
+def test_top_k_sampling_paths():
+    """top-k on every path: top_k=1 is exactly greedy regardless of
+    temperature (batcher, generate, HTTP), same-seed top-k sampling is
+    deterministic, and the non-batched speculative server path still
+    errors nowhere."""
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.models.llama import (LlamaModel, generate,
+                                               greedy_generate,
+                                               llama2_tiny)
+    from mpi_operator_tpu.serving import InferenceServer
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    prompt = [5, 3, 8, 1]
+    free = np.asarray(greedy_generate(
+        model, variables, jnp.asarray([prompt], jnp.int32), 8))[0]
+
+    # top_k=1 at high temperature == greedy everywhere.
+    out = np.asarray(generate(model, variables,
+                              jnp.asarray([prompt], jnp.int32), 8,
+                              temperature=1.5, top_k=1))[0]
+    np.testing.assert_array_equal(out, free)
+
+    b = ContinuousBatcher(model, variables, max_slots=2).start()
+    try:
+        got = b.submit(prompt, 8, temperature=1.5, top_k=1, seed=9)
+        assert got == list(map(int, free))
+        # Determinism: same seed + same top_k -> same tokens.
+        a1 = b.submit(prompt, 8, temperature=0.9, top_k=5, seed=42)
+        a2 = b.submit(prompt, 8, temperature=0.9, top_k=5, seed=42)
+        assert a1 == a2 and len(a1) == 8
+    finally:
+        b.stop()
+
+    srv = InferenceServer(model, variables, max_batch_slots=2).start()
+    try:
+        req = urllib.request.Request(
+            srv.url + "/generate",
+            data=json.dumps({"tokens": [prompt], "max_new_tokens": 8,
+                             "temperature": 1.5, "top_k": 1,
+                             "seed": 3}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        out = json.loads(urllib.request.urlopen(
+            req, timeout=300).read())["tokens"][0]
+        assert out == list(map(int, free))
+    finally:
+        srv.stop()
