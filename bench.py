@@ -202,7 +202,17 @@ def tpu_probe(timeout_s: float = 90.0):
                               capture_output=True, text=True,
                               timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return False, f"probe timeout after {timeout_s:.0f}s (init RPC hang)"
+        # Annotate with the relay TCP state so the failure line itself
+        # distinguishes the diagnosed outage mode (accept-then-eof:
+        # listener alive, upstream leg dead — TPU_TUNNEL_DIAGNOSIS.md)
+        # from a dead listener.
+        try:
+            from tools_tpu_probe import relay_state
+            relay = relay_state()
+        except Exception:
+            relay = "unknown"
+        return False, (f"probe timeout after {timeout_s:.0f}s "
+                       f"(init RPC hang; relay={relay})")
     for line in reversed(proc.stdout.strip().splitlines()):
         if line.startswith("{"):
             try:
@@ -211,7 +221,10 @@ def tpu_probe(timeout_s: float = 90.0):
                 continue
             if rec.get("ok"):
                 return True, f"live in {rec.get('elapsed_s')}s"
-            return False, rec.get("error", "probe failed")
+            diag = rec.get("error", "probe failed")
+            if rec.get("relay"):
+                diag += f" (relay={rec['relay']})"
+            return False, diag
     return False, f"probe rc={proc.returncode}"
 
 

@@ -12,7 +12,12 @@ END=$(( $(date +%s) + ${PROBER_DURATION_S:-39600} ))
 while [ "$(date +%s)" -lt "$END" ]; do
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   OUT=$(env -u PALLAS_AXON_POOL_IPS timeout 95 python tools_tpu_probe.py 2>/dev/null | tail -1)
-  if [ -z "$OUT" ]; then OUT='{"ok": false, "error": "probe timeout 95s"}'; fi
+  if [ -z "$OUT" ]; then
+    RELAY=$(python -c 'import sys; sys.path.insert(0, "."); \
+from tools_tpu_probe import relay_state; print(relay_state())' \
+      2>/dev/null || echo unknown)
+    OUT="{\"ok\": false, \"error\": \"probe timeout 95s\", \"relay\": \"$RELAY\"}"
+  fi
   echo "{\"ts\": \"$TS\", \"probe\": $OUT}" >> "$LOG"
   if echo "$OUT" | grep -q '"ok": true'; then
     STAMP=$(date -u +%Y%m%dT%H%M%SZ)

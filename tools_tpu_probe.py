@@ -8,9 +8,39 @@ below strips it) so the sitecustomize skips its own registration.
 """
 import json
 import os
+import socket
 import sys
 import time
 import uuid
+
+
+def relay_state(port: int = 2024) -> str:
+    """One-line relay characterization so probe/bench failure lines are
+    self-diagnosing (tools/TPU_TUNNEL_DIAGNOSIS.md).  Returns exactly
+    one of: 'open-awaiting-protocol' (connection held open — healthy
+    listener), 'responds' (bytes came back), 'accept-then-eof' /
+    'accept-then-rst' (listener alive but upstream leg dead — the
+    diagnosed outage, match on prefix 'accept-then-'), 'refused',
+    'timeout', or 'error:<ExcName>'."""
+    s = socket.socket()
+    s.settimeout(2)
+    try:
+        s.connect(("127.0.0.1", port))
+        try:
+            data = s.recv(64)
+            return "accept-then-eof" if data == b"" else "responds"
+        except socket.timeout:
+            return "open-awaiting-protocol"
+        except ConnectionResetError:
+            return "accept-then-rst"
+    except ConnectionRefusedError:
+        return "refused"
+    except socket.timeout:
+        return "timeout"
+    except OSError as exc:
+        return f"error:{type(exc).__name__}"
+    finally:
+        s.close()
 
 
 def probe(claim_timeout_s: int) -> dict:
@@ -42,6 +72,7 @@ def probe(claim_timeout_s: int) -> dict:
                 "elapsed_s": round(time.monotonic() - t0, 1)}
     except Exception as exc:
         return {"ok": False, "error": f"{type(exc).__name__}: {exc}"[:500],
+                "relay": relay_state(),
                 "elapsed_s": round(time.monotonic() - t0, 1)}
 
 
