@@ -165,82 +165,3 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
         dtype=jnp.float32,
     ), **overrides})
 
-
-def convert_hf_mixtral(state_dict, config: LlamaConfig) -> dict:
-    """``MixtralForCausalLM`` state_dict -> {"params": ...} for the MoE
-    LlamaModel (config.n_experts > 1).
-
-    Routing semantics match exactly: both sides compute
-    softmax(router_logits) -> top-k -> renormalize
-    (modeling_mixtral.MixtralSparseMoeBlock.forward), and our inference
-    path routes drop-free, so logits are comparable to transformers'
-    reference implementation.  Same every-tensor-consumed discipline as
-    convert_hf_llama.
-    """
-    import numpy as _np
-
-    if config.n_experts <= 1:
-        raise ValueError("convert_hf_mixtral needs config.n_experts > 1")
-    param_dtype = _np.dtype(_np.float32 if config.param_dtype is None
-                            else config.param_dtype)
-    consumed = set()
-
-    def get(name) -> np.ndarray:
-        w = state_dict[name]
-        consumed.add(name)
-        if hasattr(w, "detach"):
-            w = w.detach().cpu().float().numpy()
-        return np.asarray(w).astype(param_dtype)
-
-    d = config.dim
-    h, kvh, hd = config.n_heads, config.kv_heads, config.head_dim
-
-    embedding = get("model.embed_tokens.weight")
-    if "lm_head.weight" in state_dict:
-        head = _t(get("lm_head.weight"))
-    else:
-        head = _t(embedding)
-    params: dict = {
-        "tok_embeddings": {"embedding": embedding},
-        "norm": {"scale": get("model.norm.weight")},
-        "output": {"kernel": head},
-    }
-    for i in range(config.n_layers):
-        hf = f"model.layers.{i}"
-        moe = f"{hf}.block_sparse_moe"
-        # Experts stack to [E, D, F] / [E, F, D]; HF stores [F, D] /
-        # [D, F] per expert (w1=gate, w3=up, w2=down, SwiGLU like ours).
-        w1 = np.stack([_t(get(f"{moe}.experts.{e}.w1.weight"))
-                       for e in range(config.n_experts)])
-        w3 = np.stack([_t(get(f"{moe}.experts.{e}.w3.weight"))
-                       for e in range(config.n_experts)])
-        w2 = np.stack([_t(get(f"{moe}.experts.{e}.w2.weight"))
-                       for e in range(config.n_experts)])
-        params[f"layers_{i}"] = {
-            "attention": {
-                "wq": {"kernel": _t(get(f"{hf}.self_attn.q_proj.weight"))
-                       .reshape(d, h, hd)},
-                "wk": {"kernel": _t(get(f"{hf}.self_attn.k_proj.weight"))
-                       .reshape(d, kvh, hd)},
-                "wv": {"kernel": _t(get(f"{hf}.self_attn.v_proj.weight"))
-                       .reshape(d, kvh, hd)},
-                "wo": {"kernel": _t(get(f"{hf}.self_attn.o_proj.weight"))
-                       .reshape(h, hd, d)},
-            },
-            "attention_norm": {
-                "scale": get(f"{hf}.input_layernorm.weight")},
-            "feed_forward": {
-                "router": {"kernel": _t(get(f"{moe}.gate.weight"))},
-                "w1": w1, "w3": w3, "w2": w2,
-            },
-            "ffn_norm": {
-                "scale": get(f"{hf}.post_attention_layernorm.weight")},
-        }
-
-    leftover = [k for k in state_dict
-                if k not in consumed and not k.endswith("inv_freq")]
-    if leftover:
-        raise ValueError(
-            f"unconverted checkpoint tensors (config mismatch or"
-            f" unsupported variant): {sorted(leftover)[:8]}...")
-    return {"params": params}

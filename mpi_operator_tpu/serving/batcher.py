@@ -432,7 +432,11 @@ class ContinuousBatcher:
                 req.emit(int(tok))
             # Draft coverage: positions m+1..m+min(j, take) hold
             # accepted (committed) drafts; the bonus slot is garbage.
-            self._draft_pos[i] = int(m[i] + min(j, take))
+            # Clamp to draft_len-1: on a full-acceptance round the
+            # draft's last proposal is never fed back, so the highest
+            # position it actually wrote is m+draft_len-1.
+            self._draft_pos[i] = int(
+                m[i] + min(j, take, self.draft_len - 1))
             m[i] += take
             if req.finished:
                 req.done.set()
