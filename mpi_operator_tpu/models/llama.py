@@ -463,6 +463,29 @@ class LlamaBlock(nn.Module):
         return h + mlp_out
 
 
+class TokEmbed(nn.Embed):
+    """ZeRO-3-aware ``nn.Embed``: the table is *stored* sharded
+    ``P('tp', 'fsdp')`` (llama_param_specs), but gathering straight from
+    a table whose model dim carries 'fsdp' leaves the lookup output
+    feature-sharded over 'fsdp', and GSPMD cannot move that axis to the
+    batch dim efficiently — it falls back to "[SPMD] Involuntary full
+    rematerialization" (replicate-then-reshard) in both the forward
+    gather and the backward scatter.  ZeRO-3 semantics are gather-at-use:
+    un-shard 'fsdp' on the table right before the take (one table
+    all-gather; the cotangent side becomes the matching reduce-scatter to
+    the grad shards), so the lookup output only ever carries vocab@tp,
+    which SPMD partitions as masked local gathers + psum.  Param
+    name/shape/init are identical to ``nn.Embed`` for checkpoint compat.
+    """
+    mesh: Any = None
+
+    def __call__(self, tokens):
+        table = _constrain(self.embedding, self.mesh, "tp", None)
+        (table,) = self.promote_dtype(table, dtype=self.dtype,
+                                      inexact=False)
+        return jnp.take(table, tokens, axis=0)
+
+
 class LlamaModel(nn.Module):
     """Causal LM: tokens [B, S] -> logits [B, S, vocab]."""
     config: LlamaConfig
@@ -475,8 +498,9 @@ class LlamaModel(nn.Module):
         s = tokens.shape[1]
         positions = jnp.arange(s)  # decode mode derives real positions
                                    # from the cache index per layer
-        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="tok_embeddings")(tokens)
+        x = TokEmbed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, mesh=self.mesh,
+                     name="tok_embeddings")(tokens)
         x = _constrain(x, self.mesh, BATCH_AXES, "sp", None)
 
         block = LlamaBlock

@@ -1,0 +1,95 @@
+"""Guard against silent GSPMD performance regressions.
+
+XLA's SPMD partitioner emits ``[SPMD] Involuntary full rematerialization``
+(spmd_partitioner.cc) when it cannot move a tensor between two shardings
+efficiently and falls back to replicate-then-reshard — on real hardware
+that is a full all-gather of the tensor every step, silently.  The
+warning goes to the C-level stderr (abseil logging), not through Python,
+so catching it requires an fd-level capture.
+
+``forbid_full_remat()`` wraps a compile region: fd 2 is teed through a
+pipe — every byte still reaches the real stderr *live* (driver timeouts /
+SIGKILL lose nothing) while a copy accumulates for the marker scan — and
+the block raises if the warning appeared.  Used by ``__graft_entry__
+.dryrun_multichip`` so the driver gate *fails* on the regression instead
+of tolerating it in its own log, and by tests/test_spmd_guard.py.
+
+Note: XLA caches compilations per process — wrap the *first* compile of
+a computation, or the warning will already have been emitted.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+
+REMAT_MARKER = b"Involuntary full rematerialization"
+
+
+@contextlib.contextmanager
+def capture_stderr_fd():
+    """Tee OS-level fd 2 through a pipe for the duration: bytes flow to
+    the original stderr immediately AND accumulate in a buffer.  Yields a
+    zero-arg callable returning the bytes captured so far."""
+    sys.stderr.flush()
+    saved = os.dup(2)
+    rd, wr = os.pipe()
+    chunks: list = []
+    lock = threading.Lock()
+
+    def pump():
+        while True:
+            try:
+                chunk = os.read(rd, 65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            with lock:
+                chunks.append(chunk)
+            os.write(saved, chunk)
+        os.close(rd)
+
+    pumper = threading.Thread(target=pump, daemon=True)
+    pumper.start()
+    os.dup2(wr, 2)
+    os.close(wr)
+
+    def read() -> bytes:
+        sys.stderr.flush()
+        with lock:
+            return b"".join(chunks)
+
+    try:
+        yield read
+    finally:
+        sys.stderr.flush()
+        # Restoring fd 2 drops the pipe's last write end -> pump sees EOF.
+        os.dup2(saved, 2)
+        pumper.join(timeout=10)
+        os.close(saved)
+
+
+@contextlib.contextmanager
+def forbid_full_remat():
+    """Fail loudly if XLA emits an involuntary-full-rematerialization
+    warning inside the block.  stderr flows through live (teed), so
+    nothing disappears from driver logs even on a mid-run kill."""
+    captured = b""
+    body_raised = True
+    with capture_stderr_fd() as read:
+        try:
+            yield
+            body_raised = False
+        finally:
+            captured = read()
+    if not body_raised and REMAT_MARKER in captured:
+        lines = [ln for ln in captured.decode("utf-8", "replace").splitlines()
+                 if REMAT_MARKER.decode() in ln]
+        raise RuntimeError(
+            "XLA SPMD fell back to involuntary full rematerialization "
+            "(a hidden per-step all-gather of the whole tensor); fix the "
+            "PartitionSpecs or add a with_sharding_constraint.  Warnings:\n"
+            + "\n".join(lines))

@@ -1,0 +1,110 @@
+"""The gate must FAIL, not log, when GSPMD falls back to involuntary
+full rematerialization (round-4 verdict #2).
+
+Reference analogue: the reference's CI treats compile-time regressions as
+failures rather than warnings (Makefile `verify-generate` drift guards);
+here the guarded resource is XLA partitioning quality.
+
+Three layers:
+- the fd-capture machinery sees C-level stderr writes;
+- a positive control — the round-4 pattern (embedding table with 'fsdp'
+  on the model dim, gathered directly) — trips the guard;
+- the fixed LlamaModel path (TokEmbed gather-at-use) compiles clean
+  under the same guard on the same mesh.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
+from mpi_operator_tpu.parallel.spmd_guard import (REMAT_MARKER,
+                                                  capture_stderr_fd,
+                                                  forbid_full_remat)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+def test_capture_sees_os_level_stderr():
+    with capture_stderr_fd() as read:
+        os.write(2, b"raw fd write\n")
+        assert b"raw fd write" in read()
+
+
+def test_forbid_full_remat_passes_clean_block():
+    with forbid_full_remat():
+        os.write(2, b"benign warning\n")
+
+
+def test_forbid_full_remat_does_not_mask_body_exception():
+    with pytest.raises(ValueError, match="body"):
+        with forbid_full_remat():
+            os.write(2, REMAT_MARKER + b"\n")
+            raise ValueError("body")
+
+
+def _zero3_mesh():
+    return create_mesh(MeshConfig(dp=2, fsdp=2, tp=2),
+                       devices=jax.devices()[:8])
+
+
+def test_positive_control_round4_pattern_trips_guard():
+    """Gather from a table with 'fsdp' on the model dim, output
+    constrained to batch sharding: the exact round-4 regression
+    (MULTICHIP_r04.json tail).  The guard must convert XLA's warning
+    into a hard failure."""
+    mesh = _zero3_mesh()
+    table = jax.device_put(
+        np.zeros((256, 128), np.float32),
+        NamedSharding(mesh, P("tp", "fsdp")))
+    tokens = jax.device_put(
+        np.zeros((16, 128), np.int32),
+        NamedSharding(mesh, P(("dp", "fsdp"), None)))
+
+    def bad_lookup(table, tokens):
+        out = jnp.take(table, tokens, axis=0)
+        return jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, P(("dp", "fsdp"), None, None)))
+
+    lowered = jax.jit(bad_lookup).lower(table, tokens)
+    with pytest.raises(RuntimeError, match="full rematerialization"):
+        with forbid_full_remat():
+            lowered.compile()
+
+
+def test_llama_zero3_embedding_compiles_without_remat():
+    """The fixed path: TokEmbed un-shards 'fsdp' from the table at use
+    (ZeRO-3 gather-at-use), so the same mesh + specs compile and run one
+    step warning-free under the guard."""
+    import optax
+
+    from mpi_operator_tpu.models.llama import (LlamaModel, llama_param_specs,
+                                               mixtral_tiny, next_token_loss)
+    from mpi_operator_tpu.parallel.mesh import batch_sharding
+    from mpi_operator_tpu.parallel.train import build_train_step
+
+    mesh = _zero3_mesh()
+    cfg = mixtral_tiny()
+    model = LlamaModel(cfg, mesh=mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (16, 128), 0,
+                                cfg.vocab_size)
+    params = {"params": model.init(jax.random.PRNGKey(1),
+                                   tokens[:, :8])["params"]}
+
+    def loss_fn(p, b):
+        return next_token_loss(model.apply(p, b), b)
+
+    with mesh:
+        init_fn, step_fn = build_train_step(
+            loss_fn, optax.adamw(1e-3), mesh,
+            param_specs=llama_param_specs(cfg))
+        with forbid_full_remat():
+            state = init_fn(params)
+            sh_tokens = jax.device_put(tokens,
+                                       batch_sharding(mesh, extra_dims=1))
+            jax.block_until_ready(step_fn(state, sh_tokens)[1]["loss"])
