@@ -21,6 +21,7 @@ verify-generate: generate
 	git diff --exit-code manifests/ deploy/ || \
 		(echo "generated manifests drifted; commit 'make generate' output" \
 		 && exit 1)
+	$(PYTHON) -m mpi_operator_tpu.codegen.crd_parity
 
 bench:
 	$(PYTHON) bench.py

@@ -85,6 +85,9 @@ class ReplicaStatus:
     succeeded: int = 0
     failed: int = 0
     selector: str = ""
+    # Deprecated in the reference (types.go:271-273, "Use selector
+    # instead") but still admitted by its CRD schema; full LabelSelector.
+    label_selector: Optional[dict] = None
 
 
 @dataclass

@@ -214,12 +214,16 @@ _FIELD_OVERRIDES = {
     ("PodSpec", "affinity"): _AFFINITY,
     ("PodSpec", "security_context"): _POD_SECURITY_CONTEXT,
     ("Container", "security_context"): _CONTAINER_SECURITY_CONTEXT,
+    ("EphemeralContainer", "security_context"): _CONTAINER_SECURITY_CONTEXT,
     ("PodDNSConfig", "nameservers"): _STRING_LIST,
     ("PodDNSConfig", "searches"): _STRING_LIST,
     ("PodDNSConfig", "options"): _DNS_CONFIG_OPTIONS,
     ("SchedulingPolicy", "min_resources"): _QUANTITY_MAP,
     ("TopologySpreadConstraint", "label_selector"): _LABEL_SELECTOR,
     ("PodSpec", "overhead"): _QUANTITY_MAP,
+    ("PersistentVolumeClaimSpec", "selector"): _LABEL_SELECTOR,
+    ("ClusterTrustBundleProjection", "label_selector"): _LABEL_SELECTOR,
+    ("ReplicaStatus", "label_selector"): _LABEL_SELECTOR,
 }
 
 
@@ -231,6 +235,7 @@ _REQUIRED_FIELDS = {
     "MPIJobSpec": ["mpiReplicaSpecs"],
     "PodSpec": ["containers"],
     "Container": ["name"],
+    "EphemeralContainer": ["name"],
     "EnvVar": ["name"],
     "ContainerPort": ["containerPort"],
     "VolumeMount": ["mountPath", "name"],
@@ -253,6 +258,38 @@ _REQUIRED_FIELDS = {
     "VolumeDevice": ["devicePath", "name"],
     "ContainerResizePolicy": ["resourceName", "restartPolicy"],
     "PodOS": ["name"],
+    # volume sources (required lists mirror the reference CRD's)
+    "AWSElasticBlockStoreVolumeSource": ["volumeID"],
+    "AzureDiskVolumeSource": ["diskName", "diskURI"],
+    "AzureFileVolumeSource": ["secretName", "shareName"],
+    "CephFSVolumeSource": ["monitors"],
+    "CinderVolumeSource": ["volumeID"],
+    "CSIVolumeSource": ["driver"],
+    "DownwardAPIVolumeFile": ["path"],
+    "FlexVolumeSource": ["driver"],
+    "GCEPersistentDiskVolumeSource": ["pdName"],
+    "GitRepoVolumeSource": ["repository"],
+    "GlusterfsVolumeSource": ["endpoints", "path"],
+    "ISCSIVolumeSource": ["iqn", "lun", "targetPortal"],
+    "NFSVolumeSource": ["path", "server"],
+    "PhotonPersistentDiskVolumeSource": ["pdID"],
+    "PortworxVolumeSource": ["volumeID"],
+    "QuobyteVolumeSource": ["registry", "volume"],
+    "RBDVolumeSource": ["image", "monitors"],
+    "ScaleIOVolumeSource": ["gateway", "secretRef", "system"],
+    "VsphereVirtualDiskVolumeSource": ["volumePath"],
+    "ClusterTrustBundleProjection": ["path"],
+    "ServiceAccountTokenProjection": ["path"],
+    "TypedLocalObjectReference": ["kind", "name"],
+    "TypedObjectReference": ["kind", "name"],
+    "ResourceClaim": ["name"],
+    "PodResourceClaim": ["name"],
+    "ContainerRestartRule": ["action"],
+    "ContainerRestartRuleOnExitCodes": ["operator"],
+    "FileKeySelector": ["key", "path", "volumeName"],
+    "PodWorkloadRef": ["name", "podGroup"],
+    "PersistentVolumeClaimTemplate": ["spec"],
+    "PodCertificateProjection": ["keyType", "signerName"],
 }
 
 

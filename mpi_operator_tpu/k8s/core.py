@@ -8,7 +8,7 @@ The subset of k8s.io/api/core/v1 the operator constructs and inspects
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional, Union
+from typing import Dict, List, Optional, Union
 
 from .meta import ObjectMeta
 
@@ -63,11 +63,21 @@ class ResourceFieldSelector:
 
 
 @dataclass
+class FileKeySelector:
+    """env valueFrom.fileKeyRef (k8s 1.34 env-from-file)."""
+    key: str = ""
+    path: str = ""
+    volume_name: str = ""
+    optional: Optional[bool] = None
+
+
+@dataclass
 class EnvVarSource:
     field_ref: Optional[ObjectFieldSelector] = None
     resource_field_ref: Optional[ResourceFieldSelector] = None
     config_map_key_ref: Optional[KeySelector] = None
     secret_key_ref: Optional[KeySelector] = None
+    file_key_ref: Optional[FileKeySelector] = None
 
 
 @dataclass
@@ -83,6 +93,9 @@ class VolumeMount:
     mount_path: str = ""
     read_only: Optional[bool] = None
     sub_path: str = ""
+    sub_path_expr: str = ""
+    mount_propagation: Optional[str] = None
+    recursive_read_only: Optional[str] = None
 
 
 @dataclass
@@ -97,6 +110,7 @@ class ConfigMapVolumeSource:
     name: str = ""
     items: List[KeyToPath] = field(default_factory=list)
     default_mode: Optional[int] = None
+    optional: Optional[bool] = None
 
 
 @dataclass
@@ -104,6 +118,7 @@ class SecretVolumeSource:
     secret_name: str = ""
     items: List[KeyToPath] = field(default_factory=list)
     default_mode: Optional[int] = None
+    optional: Optional[bool] = None
 
 
 @dataclass
@@ -124,6 +139,320 @@ class PersistentVolumeClaimVolumeSource:
     read_only: Optional[bool] = None
 
 
+# --- full corev1 volume-source surface -------------------------------------
+# Every volume type the reference CRD admits (controller-gen embeds the
+# whole k8s PodSpec; /root/reference/manifests/base/
+# kubeflow.org_mpijobs.yaml volumes[] schema).  With structural
+# no-preserve-unknown schemas, any source missing here would be silently
+# pruned on admission — codegen/crd_parity.py enforces the full list.
+
+@dataclass
+class AWSElasticBlockStoreVolumeSource:
+    volume_id: str = ""
+    fs_type: str = ""
+    partition: Optional[int] = None
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class AzureDiskVolumeSource:
+    disk_name: str = ""
+    disk_uri: str = ""
+    caching_mode: str = ""
+    fs_type: str = ""
+    kind: str = ""
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class AzureFileVolumeSource:
+    secret_name: str = ""
+    share_name: str = ""
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class CephFSVolumeSource:
+    monitors: List[str] = field(default_factory=list)
+    path: str = ""
+    user: str = ""
+    secret_file: str = ""
+    secret_ref: Optional["LocalObjectReference"] = None
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class CinderVolumeSource:
+    volume_id: str = ""
+    fs_type: str = ""
+    read_only: Optional[bool] = None
+    secret_ref: Optional["LocalObjectReference"] = None
+
+
+@dataclass
+class CSIVolumeSource:
+    driver: str = ""
+    read_only: Optional[bool] = None
+    fs_type: str = ""
+    volume_attributes: Dict[str, str] = field(default_factory=dict)
+    node_publish_secret_ref: Optional["LocalObjectReference"] = None
+
+
+@dataclass
+class DownwardAPIVolumeFile:
+    path: str = ""
+    field_ref: Optional[ObjectFieldSelector] = None
+    resource_field_ref: Optional[ResourceFieldSelector] = None
+    mode: Optional[int] = None
+
+
+@dataclass
+class DownwardAPIVolumeSource:
+    items: List[DownwardAPIVolumeFile] = field(default_factory=list)
+    default_mode: Optional[int] = None
+
+
+@dataclass
+class TypedLocalObjectReference:
+    api_group: Optional[str] = None
+    kind: str = ""
+    name: str = ""
+
+
+@dataclass
+class TypedObjectReference:
+    api_group: Optional[str] = None
+    kind: str = ""
+    name: str = ""
+    namespace: Optional[str] = None
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: List[str] = field(default_factory=list)
+    selector: Optional[dict] = None          # LabelSelector
+    resources: Optional["ResourceRequirements"] = None
+    volume_name: str = ""
+    storage_class_name: Optional[str] = None
+    volume_mode: Optional[str] = None
+    data_source: Optional[TypedLocalObjectReference] = None
+    data_source_ref: Optional[TypedObjectReference] = None
+    volume_attributes_class_name: Optional[str] = None
+
+
+@dataclass
+class PersistentVolumeClaimTemplate:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[PersistentVolumeClaimSpec] = None
+
+
+@dataclass
+class EphemeralVolumeSource:
+    volume_claim_template: Optional[PersistentVolumeClaimTemplate] = None
+
+
+@dataclass
+class FCVolumeSource:
+    target_wwns: List[str] = field(default_factory=list)
+    lun: Optional[int] = None
+    fs_type: str = ""
+    read_only: Optional[bool] = None
+    wwids: List[str] = field(default_factory=list)
+
+
+@dataclass
+class FlexVolumeSource:
+    driver: str = ""
+    fs_type: str = ""
+    secret_ref: Optional["LocalObjectReference"] = None
+    read_only: Optional[bool] = None
+    options: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class FlockerVolumeSource:
+    dataset_name: str = ""
+    dataset_uuid: str = ""
+
+
+@dataclass
+class GCEPersistentDiskVolumeSource:
+    pd_name: str = ""
+    fs_type: str = ""
+    partition: Optional[int] = None
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class GitRepoVolumeSource:
+    repository: str = ""
+    revision: str = ""
+    directory: str = ""
+
+
+@dataclass
+class GlusterfsVolumeSource:
+    endpoints: str = ""
+    path: str = ""
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class ImageVolumeSource:
+    reference: str = ""
+    pull_policy: str = ""
+
+
+@dataclass
+class ISCSIVolumeSource:
+    target_portal: str = ""
+    iqn: str = ""
+    lun: Optional[int] = None
+    iscsi_interface: str = ""
+    fs_type: str = ""
+    read_only: Optional[bool] = None
+    portals: List[str] = field(default_factory=list)
+    chap_auth_discovery: Optional[bool] = None
+    chap_auth_session: Optional[bool] = None
+    secret_ref: Optional["LocalObjectReference"] = None
+    initiator_name: Optional[str] = None
+
+
+@dataclass
+class NFSVolumeSource:
+    server: str = ""
+    path: str = ""
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class PhotonPersistentDiskVolumeSource:
+    pd_id: str = ""
+    fs_type: str = ""
+
+
+@dataclass
+class PortworxVolumeSource:
+    volume_id: str = ""
+    fs_type: str = ""
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class ClusterTrustBundleProjection:
+    name: Optional[str] = None
+    signer_name: Optional[str] = None
+    label_selector: Optional[dict] = None    # LabelSelector
+    optional: Optional[bool] = None
+    path: str = ""
+
+
+@dataclass
+class PodCertificateProjection:
+    signer_name: str = ""
+    key_type: str = ""
+    max_expiration_seconds: Optional[int] = None
+    credential_bundle_path: str = ""
+    key_path: str = ""
+    certificate_chain_path: str = ""
+    user_annotations: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SecretProjection:
+    name: str = ""
+    items: List[KeyToPath] = field(default_factory=list)
+    optional: Optional[bool] = None
+
+
+@dataclass
+class ConfigMapProjection:
+    name: str = ""
+    items: List[KeyToPath] = field(default_factory=list)
+    optional: Optional[bool] = None
+
+
+@dataclass
+class DownwardAPIProjection:
+    items: List[DownwardAPIVolumeFile] = field(default_factory=list)
+
+
+@dataclass
+class ServiceAccountTokenProjection:
+    audience: str = ""
+    expiration_seconds: Optional[int] = None
+    path: str = ""
+
+
+@dataclass
+class VolumeProjection:
+    secret: Optional[SecretProjection] = None
+    config_map: Optional[ConfigMapProjection] = None
+    downward_api: Optional[DownwardAPIProjection] = None
+    service_account_token: Optional[ServiceAccountTokenProjection] = None
+    cluster_trust_bundle: Optional[ClusterTrustBundleProjection] = None
+    pod_certificate: Optional[PodCertificateProjection] = None
+
+
+@dataclass
+class ProjectedVolumeSource:
+    sources: List[VolumeProjection] = field(default_factory=list)
+    default_mode: Optional[int] = None
+
+
+@dataclass
+class QuobyteVolumeSource:
+    registry: str = ""
+    volume: str = ""
+    read_only: Optional[bool] = None
+    user: str = ""
+    group: str = ""
+    tenant: str = ""
+
+
+@dataclass
+class RBDVolumeSource:
+    monitors: List[str] = field(default_factory=list)
+    image: str = ""
+    fs_type: str = ""
+    pool: str = ""
+    user: str = ""
+    keyring: str = ""
+    secret_ref: Optional["LocalObjectReference"] = None
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class ScaleIOVolumeSource:
+    gateway: str = ""
+    system: str = ""
+    secret_ref: Optional["LocalObjectReference"] = None
+    ssl_enabled: Optional[bool] = None
+    protection_domain: str = ""
+    storage_pool: str = ""
+    storage_mode: str = ""
+    volume_name: str = ""
+    fs_type: str = ""
+    read_only: Optional[bool] = None
+
+
+@dataclass
+class StorageOSVolumeSource:
+    volume_name: str = ""
+    volume_namespace: str = ""
+    fs_type: str = ""
+    read_only: Optional[bool] = None
+    secret_ref: Optional["LocalObjectReference"] = None
+
+
+@dataclass
+class VsphereVirtualDiskVolumeSource:
+    volume_path: str = ""
+    fs_type: str = ""
+    storage_policy_name: str = ""
+    storage_policy_id: str = ""
+
+
 @dataclass
 class Volume:
     name: str = ""
@@ -132,12 +461,44 @@ class Volume:
     empty_dir: Optional[EmptyDirVolumeSource] = None
     host_path: Optional[HostPathVolumeSource] = None
     persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    azure_disk: Optional[AzureDiskVolumeSource] = None
+    azure_file: Optional[AzureFileVolumeSource] = None
+    cephfs: Optional[CephFSVolumeSource] = None
+    cinder: Optional[CinderVolumeSource] = None
+    csi: Optional[CSIVolumeSource] = None
+    downward_api: Optional[DownwardAPIVolumeSource] = None
+    ephemeral: Optional[EphemeralVolumeSource] = None
+    fc: Optional[FCVolumeSource] = None
+    flex_volume: Optional[FlexVolumeSource] = None
+    flocker: Optional[FlockerVolumeSource] = None
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    git_repo: Optional[GitRepoVolumeSource] = None
+    glusterfs: Optional[GlusterfsVolumeSource] = None
+    image: Optional[ImageVolumeSource] = None
+    iscsi: Optional[ISCSIVolumeSource] = None
+    nfs: Optional[NFSVolumeSource] = None
+    photon_persistent_disk: Optional[PhotonPersistentDiskVolumeSource] = None
+    portworx_volume: Optional[PortworxVolumeSource] = None
+    projected: Optional[ProjectedVolumeSource] = None
+    quobyte: Optional[QuobyteVolumeSource] = None
+    rbd: Optional[RBDVolumeSource] = None
+    scale_io: Optional[ScaleIOVolumeSource] = None
+    storageos: Optional[StorageOSVolumeSource] = None
+    vsphere_volume: Optional[VsphereVirtualDiskVolumeSource] = None
+
+
+@dataclass
+class ResourceClaim:
+    name: str = ""
+    request: str = ""
 
 
 @dataclass
 class ResourceRequirements:
     limits: dict = field(default_factory=dict)
     requests: dict = field(default_factory=dict)
+    claims: List[ResourceClaim] = field(default_factory=list)
 
 
 @dataclass
@@ -145,6 +506,8 @@ class ContainerPort:
     name: str = ""
     container_port: int = 0
     protocol: str = ""
+    host_ip: str = ""
+    host_port: Optional[int] = None
 
 
 # --- probe / lifecycle handlers (corev1.Probe, corev1.Lifecycle) ----------
@@ -215,6 +578,7 @@ class LifecycleHandler:
 class Lifecycle:
     post_start: Optional[LifecycleHandler] = None
     pre_stop: Optional[LifecycleHandler] = None
+    stop_signal: Optional[str] = None
 
 
 @dataclass
@@ -249,6 +613,18 @@ class ContainerResizePolicy:
 
 
 @dataclass
+class ContainerRestartRuleOnExitCodes:
+    operator: str = ""
+    values: List[int] = field(default_factory=list)
+
+
+@dataclass
+class ContainerRestartRule:
+    action: str = ""
+    exit_codes: Optional[ContainerRestartRuleOnExitCodes] = None
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
@@ -271,9 +647,22 @@ class Container:
     termination_message_policy: str = ""
     resize_policy: List[ContainerResizePolicy] = field(default_factory=list)
     restart_policy: str = ""  # sidecar ("Always") for init containers
+    restart_policy_rules: List[ContainerRestartRule] = field(
+        default_factory=list)
     stdin: Optional[bool] = None
     stdin_once: Optional[bool] = None
     tty: Optional[bool] = None
+
+
+@dataclass
+class EphemeralContainer(Container):
+    """Debug container injected into a running pod (kubectl debug).
+
+    The kube API models this as EphemeralContainerCommon (every Container
+    field) + targetContainerName; dataclass inheritance gives the same
+    shape.  Reference CRD schema:
+    manifests/base/kubeflow.org_mpijobs.yaml:2674 (/root/reference)."""
+    target_container_name: str = ""
 
 
 @dataclass
@@ -331,9 +720,27 @@ class PodOS:
 
 
 @dataclass
+class PodResourceClaim:
+    name: str = ""
+    resource_claim_name: Optional[str] = None
+    resource_claim_template_name: Optional[str] = None
+
+
+@dataclass
+class PodWorkloadRef:
+    """spec.workloadRef (k8s Workload-aware scheduling; reference CRD
+    manifests/base/kubeflow.org_mpijobs.yaml:8632)."""
+    name: str = ""
+    pod_group: str = ""
+    pod_group_replica_key: str = ""
+
+
+@dataclass
 class PodSpec:
     containers: List[Container] = field(default_factory=list)
     init_containers: List[Container] = field(default_factory=list)
+    ephemeral_containers: List[EphemeralContainer] = field(
+        default_factory=list)
     volumes: List[Volume] = field(default_factory=list)
     restart_policy: str = ""
     hostname: str = ""
@@ -369,6 +776,12 @@ class PodSpec:
     enable_service_links: Optional[bool] = None
     set_hostname_as_fqdn: Optional[bool] = None
     os: Optional[PodOS] = None
+    host_users: Optional[bool] = None
+    hostname_override: Optional[str] = None
+    service_account: str = ""  # deprecated alias of service_account_name
+    resource_claims: List[PodResourceClaim] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+    workload_ref: Optional[PodWorkloadRef] = None
 
 
 @dataclass

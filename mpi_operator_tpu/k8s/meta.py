@@ -70,6 +70,12 @@ def _camel(name: str) -> str:
             "uid": "uid", "ttlSecondsAfterFinished": "ttlSecondsAfterFinished",
             "hostIpc": "hostIPC", "hostPid": "hostPID",
             "setHostnameAsFqdn": "setHostnameAsFQDN",
+            # volume-source acronym fields (corev1 JSON names)
+            "volumeId": "volumeID", "diskUri": "diskURI", "pdId": "pdID",
+            "datasetUuid": "datasetUUID", "targetWwns": "targetWWNs",
+            "storagePolicyId": "storagePolicyID",
+            "downwardApi": "downwardAPI",
+            "scaleIo": "scaleIO",
             }.get(out, out)
 
 
@@ -82,6 +88,10 @@ _SNAKE_RE2 = re.compile(r"([a-z0-9])([A-Z])")
 
 
 def _snake(name: str) -> str:
+    # "WWNs" defeats the acronym regexes (WWN + plural s splits as
+    # WW|Ns); corev1 has exactly one such field.
+    if name == "targetWWNs":
+        return "target_wwns"
     s = _SNAKE_RE1.sub(r"\1_\2", name)
     s = _SNAKE_RE2.sub(r"\1_\2", s)
     return s.lower()

@@ -396,3 +396,93 @@ def test_overlays_generated_and_shaped():
         root, "manifests", "overlays", "dev",
         "kustomization.yaml.template")))
     assert dev["images"][0]["newName"] == "%IMAGE_NAME%"
+
+
+def test_crd_parity_vs_reference_zero_missing():
+    """Round-4 verdict #5: every field path the reference CRD accepts
+    must exist in the generated schema (else silently pruned on
+    admission).  The checker runs in `make verify-generate` too; this
+    keeps it in the default suite."""
+    parity = pytest.importorskip("mpi_operator_tpu.codegen.crd_parity")
+    if not os.path.exists(parity.REFERENCE_CRD):
+        pytest.skip("reference CRD not available")
+    gen = os.path.join(REPO_ROOT, "manifests", "base",
+                       "kubeflow.org_mpijobs.yaml")
+    rec = parity.compare(parity.REFERENCE_CRD, gen)
+    assert rec["ok"], rec["missing"][:20]
+    assert rec["missing"] == []
+    assert rec["present"] == rec["reference_paths"]
+
+
+def test_ephemeral_containers_and_exotic_volumes_survive_prune():
+    """ephemeralContainers (the last round-4 known pruned field) plus the
+    newly-closed volume surface (projected sources, generic ephemeral
+    PVC template, csi, nfs, iscsi) strict-validate, survive structural
+    pruning byte-identically, and round-trip the typed object model."""
+    from mpi_operator_tpu.api.types import MPIJob
+    from mpi_operator_tpu.codegen.schema_validate import (
+        prune_schema, validate_mpijob_dict)
+    from mpi_operator_tpu.k8s.meta import from_dict, to_dict
+
+    with open(os.path.join(REPO_ROOT, "examples", "v2beta1",
+                           "jax-pi.yaml")) as f:
+        doc = yaml.safe_load(f)
+    spec = doc["spec"]["mpiReplicaSpecs"]["Worker"]["template"]["spec"]
+    spec["ephemeralContainers"] = [{
+        "name": "debugger", "image": "busybox",
+        "command": ["sh"], "stdin": True, "tty": True,
+        "targetContainerName": "worker",
+        "securityContext": {"capabilities": {"add": ["SYS_PTRACE"]}},
+        "volumeMounts": [{"name": "scratch", "mountPath": "/scratch",
+                          "subPathExpr": "$(POD_NAME)",
+                          "mountPropagation": "HostToContainer"}],
+        "env": [{"name": "K", "valueFrom": {"fileKeyRef": {
+            "key": "k", "path": "p", "volumeName": "scratch"}}}],
+    }]
+    spec["volumes"] = spec.get("volumes", []) + [
+        {"name": "scratch", "emptyDir": {}},
+        {"name": "proj", "projected": {"sources": [
+            {"configMap": {"name": "cm", "optional": True}},
+            {"serviceAccountToken": {"path": "token",
+                                     "expirationSeconds": 3600}},
+            {"downwardAPI": {"items": [{
+                "path": "labels",
+                "fieldRef": {"fieldPath": "metadata.labels"}}]}},
+        ], "defaultMode": 420}},
+        {"name": "eph", "ephemeral": {"volumeClaimTemplate": {
+            "metadata": {"labels": {"app": "x"}},
+            "spec": {"accessModes": ["ReadWriteOnce"],
+                     "storageClassName": "fast",
+                     "resources": {"requests": {"storage": "1Gi"}}}}}},
+        {"name": "nfsv", "nfs": {"server": "srv", "path": "/exp"}},
+        {"name": "csiv", "csi": {"driver": "d.example.com",
+                                 "volumeAttributes": {"a": "b"}}},
+        {"name": "block", "iscsi": {"targetPortal": "1.2.3.4:3260",
+                                    "iqn": "iqn.2020-01.com.example:x",
+                                    "lun": 0}},
+    ]
+    spec["resourceClaims"] = [{"name": "tpu-claim",
+                               "resourceClaimName": "rc"}]
+    spec["hostUsers"] = False
+    spec["containers"][0]["restartPolicyRules"] = [{
+        "action": "Restart",
+        "exitCodes": {"operator": "In", "values": [42]}}]
+
+    assert validate_mpijob_dict(doc) == []
+    schema = mpijob_crd()["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    pruned = prune_schema(doc, schema)
+    assert pruned == doc, "structural pruning dropped declared fields"
+
+    job = from_dict(MPIJob, doc)
+    eph = job.worker_spec.template.spec.ephemeral_containers[0]
+    assert eph.target_container_name == "worker"
+    assert eph.volume_mounts[0].sub_path_expr == "$(POD_NAME)"
+    vols = {v.name: v for v in job.worker_spec.template.spec.volumes}
+    assert vols["eph"].ephemeral.volume_claim_template.spec \
+        .storage_class_name == "fast"
+    assert vols["csiv"].csi.volume_attributes == {"a": "b"}
+    back = to_dict(job)
+    w = back["spec"]["mpiReplicaSpecs"]["Worker"]["template"]["spec"]
+    assert w["ephemeralContainers"][0]["targetContainerName"] == "worker"
+    assert w["containers"][0]["restartPolicyRules"][0]["exitCodes"][
+        "values"] == [42]
