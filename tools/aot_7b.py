@@ -178,6 +178,16 @@ def analyze(dp: int, fsdp: int, batch: int, seq: int,
     compile_s = time.perf_counter() - t0
 
     ma = compiled.memory_analysis()
+    # Compiler cost model: per-device FLOPs and bytes accessed for one
+    # step — the inputs to the roofline projections in aot_projections.py.
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        cost_flops = float((ca or {}).get("flops", 0.0))
+        cost_bytes = float((ca or {}).get("bytes accessed", 0.0))
+    except Exception:
+        cost_flops = cost_bytes = 0.0
     n_params = sum(math.prod(x.shape)
                    for x in jax.tree_util.tree_leaves(params_abs))
 
@@ -209,6 +219,8 @@ def analyze(dp: int, fsdp: int, batch: int, seq: int,
         "alias_bytes_per_device": int(ma.alias_size_in_bytes),
         "temp_bytes_per_device": int(ma.temp_size_in_bytes),
         "peak_bytes_per_device": int(peak),
+        "cost_flops_per_device": cost_flops,
+        "cost_bytes_accessed_per_device": cost_bytes,
         "hbm_usable_bytes": V5E_HBM_BYTES,
         "fits_v5e_16gb": bool(peak <= V5E_HBM_BYTES),
         "lower_s": round(lower_s, 1), "compile_s": round(compile_s, 1),
