@@ -93,7 +93,9 @@ class ContinuousBatcher:
                  page_size: int = 0, cache_blocks: int = 0,
                  prefix_cache: bool = True,
                  draft_model=None, draft_variables=None,
-                 draft_len: int = 4, kv_cache_dtype: str = "auto"):
+                 draft_len: int = 4, kv_cache_dtype: str = "auto",
+                 draft_strategy: Optional[str] = None,
+                 prompt_lookup_ngram: int = 3):
         import dataclasses
 
         import jax
@@ -202,6 +204,22 @@ class ContinuousBatcher:
         # acceptance rule is only lossless for argmax).
         self.draft_len = int(draft_len)
         self._draft_model = draft_model
+        # Training-free drafting (serving/drafts.py): proposals come from
+        # host-side n-gram lookup over the request's own context — no
+        # draft model, cache, or prefill.  Same verify/acceptance path.
+        if draft_strategy is not None:
+            from .drafts import DRAFT_STRATEGIES
+            if draft_strategy not in DRAFT_STRATEGIES:
+                raise ValueError(f"unknown draft_strategy "
+                                 f"{draft_strategy!r}; "
+                                 f"one of {DRAFT_STRATEGIES}")
+            if draft_model is not None:
+                raise ValueError(
+                    "draft_strategy and draft_model are exclusive")
+            if self.draft_len < 1:
+                raise ValueError("draft_len must be >= 1")
+        self._draft_strategy = draft_strategy
+        self._pl_ngram = int(prompt_lookup_ngram)
         if (draft_model is None) != (draft_variables is None):
             raise ValueError("draft_model and draft_variables go together")
         if draft_model is not None:
@@ -234,16 +252,7 @@ class ContinuousBatcher:
                         jnp.argmax(logits[:, -1], axis=-1)
                         .astype(jnp.int32))
 
-            @jax.jit
-            def verify_step(cache, tokens):
-                logits, state = decode_model.apply(
-                    {**params, "cache": cache}, tokens, decode=True,
-                    mutable=["cache"])
-                return (state["cache"],
-                        jnp.argmax(logits, axis=-1).astype(jnp.int32))
-
             self._draft_step = draft_step
-            self._verify_step = verify_step
             self._draft_prefill_cache = {}
             self._dparams = dparams
             # slot -> highest committed position whose K/V the draft
@@ -252,6 +261,18 @@ class ContinuousBatcher:
             # spec-resume a lagging slot is re-prefilled, else its
             # proposals would be argmax over zero K/V forever.
             self._draft_pos: dict = {}
+        if draft_model is not None or draft_strategy is not None:
+            # Shared by both draft kinds: ONE width-(k+1) target forward
+            # scoring all proposals.
+            @jax.jit
+            def verify_step(cache, tokens):
+                logits, state = decode_model.apply(
+                    {**params, "cache": cache}, tokens, decode=True,
+                    mutable=["cache"])
+                return (state["cache"],
+                        jnp.argmax(logits, axis=-1).astype(jnp.int32))
+
+            self._verify_step = verify_step
         self.spec_stats = {"spec_ticks": 0, "plain_ticks": 0,
                            "accepted_drafts": 0, "drafted": 0}
 
@@ -358,37 +379,66 @@ class ContinuousBatcher:
             # emitted token is in both caches (plain-tick invariant).
             m[i] = len(hists[i]) - 1
 
-        # Draft proposes k tokens: re-feed the last two committed tokens
-        # at index m-1 (one identical K/V rewrite) so the draft cache is
-        # current through m, then extend one token at a time.  Device
-        # calls hold the shared lock; host-side acceptance/emission runs
-        # after it is released (the plain tick's contract).
-        feed = np.zeros((self.max_slots, 2), np.int32)
-        for i in active:
-            feed[i] = (hists[i][m[i] - 1], hists[i][m[i]])
         t_last = np.zeros((self.max_slots,), np.int32)
         for i in active:
             t_last[i] = hists[i][m[i]]
-        with self._device_lock:
-            # Spec-resume catch-up: a plain-tick interlude (sampling
-            # neighbor) advances the committed stream without the draft
-            # seeing it; the 2-token re-feed only covers positions
-            # m-1/m, so a slot whose coverage lags further gets a full
-            # re-prefill of its committed prefix.
-            for i in active:
-                if self._draft_pos.get(i, -1) < m[i] - 2:
-                    self._draft_prefill_install(i, hists[i][:m[i] + 1])
-            d_cache = _set_cache_index(
-                self._draft_cache,
-                jnp.asarray(np.maximum(m - 1, 0), jnp.int32))
-            d_cache, g = self._draft_step(d_cache, jnp.asarray(feed))
-            drafts = [g]
-            for _ in range(k - 1):
-                d_cache, g = self._draft_step(d_cache, g[:, None])
-                drafts.append(g)
-            self._draft_cache = d_cache
-            drafted = np.stack([np.asarray(d) for d in drafts], axis=1)
 
+        if self._draft_strategy is not None:
+            # Training-free drafting: host-side n-gram lookup over each
+            # slot's committed stream (prompt + output through position
+            # m).  No draft cache, no device work — microseconds.
+            from .drafts import propose_prompt_lookup
+
+            drafted = np.zeros((self.max_slots, k), np.int32)
+            for i in active:
+                drafted[i] = propose_prompt_lookup(
+                    hists[i][:m[i] + 1], k, self._pl_ngram)
+        else:
+            # Model draft proposes k tokens: re-feed the last two
+            # committed tokens at index m-1 (one identical K/V rewrite)
+            # so the draft cache is current through m, then extend one
+            # token at a time.  Device calls hold the shared lock;
+            # host-side acceptance/emission runs after it is released
+            # (the plain tick's contract).
+            feed = np.zeros((self.max_slots, 2), np.int32)
+            for i in active:
+                feed[i] = (hists[i][m[i] - 1], hists[i][m[i]])
+            with self._device_lock:
+                # Spec-resume catch-up: a plain-tick interlude (sampling
+                # neighbor) advances the committed stream without the
+                # draft seeing it; the 2-token re-feed only covers
+                # positions m-1/m, so a slot whose coverage lags further
+                # gets a full re-prefill of its committed prefix.
+                for i in active:
+                    if self._draft_pos.get(i, -1) < m[i] - 2:
+                        self._draft_prefill_install(i, hists[i][:m[i] + 1])
+                d_cache = _set_cache_index(
+                    self._draft_cache,
+                    jnp.asarray(np.maximum(m - 1, 0), jnp.int32))
+                d_cache, g = self._draft_step(d_cache, jnp.asarray(feed))
+                drafts = [g]
+                for _ in range(k - 1):
+                    d_cache, g = self._draft_step(d_cache, g[:, None])
+                    drafts.append(g)
+                self._draft_cache = d_cache
+                drafted = np.stack([np.asarray(d) for d in drafts], axis=1)
+
+        return self._verify_and_accept(slots, next_tokens, m, t_last,
+                                       drafted)
+
+    def _verify_and_accept(self, slots, next_tokens, m, t_last, drafted):
+        """ONE width-(k+1) target verify of `drafted` across all slots,
+        then longest-prefix acceptance + bonus, emission, and per-row
+        cache_index rollback over rejected positions.  Shared by the
+        model-draft and training-free-draft paths."""
+        import numpy as np
+
+        from ..models.llama import _set_cache_index
+
+        jnp = self._jnp
+        k = self.draft_len
+        active = [i for i, r in enumerate(slots) if r is not None]
+        with self._device_lock:
             # Target verifies all slots in one width-(k+1) forward.
             verify_tokens = np.concatenate([t_last[:, None], drafted],
                                            axis=1)
@@ -430,13 +480,15 @@ class ContinuousBatcher:
             self.spec_stats["accepted_drafts"] += min(j, take)
             for tok in emit[:take]:
                 req.emit(int(tok))
-            # Draft coverage: positions m+1..m+min(j, take) hold
-            # accepted (committed) drafts; the bonus slot is garbage.
-            # Clamp to draft_len-1: on a full-acceptance round the
-            # draft's last proposal is never fed back, so the highest
-            # position it actually wrote is m+draft_len-1.
-            self._draft_pos[i] = int(
-                m[i] + min(j, take, self.draft_len - 1))
+            if self._draft_model is not None:
+                # Draft coverage: positions m+1..m+min(j, take) hold
+                # accepted (committed) drafts; the bonus slot is
+                # garbage.  Clamp to draft_len-1: on a full-acceptance
+                # round the draft's last proposal is never fed back, so
+                # the highest position it actually wrote is
+                # m+draft_len-1.  (Training-free drafts keep no cache.)
+                self._draft_pos[i] = int(
+                    m[i] + min(j, take, self.draft_len - 1))
             m[i] += take
             if req.finished:
                 req.done.set()
@@ -720,7 +772,8 @@ class ContinuousBatcher:
         touch (the last round can draft past the needed tokens).  Only
         greedy requests ever speculate, so sampling requests are not
         charged for it."""
-        if self._draft_model is None or temperature > 0.0:
+        if (self._draft_model is None and self._draft_strategy is None) \
+                or temperature > 0.0:
             return 0
         return self.draft_len + 1
 
@@ -915,12 +968,14 @@ class ContinuousBatcher:
                         pass
                 continue
 
-            # Speculation: when a draft model is loaded and every active
-            # slot is greedy, one tick = k draft steps + ONE target
-            # verify committing 1..k+1 tokens per slot.  Any sampling
-            # slot forces plain ticks (acceptance is argmax-only).
-            if self._draft_model is not None and all(
-                    r.temperature <= 0.0 for r in slots if r is not None):
+            # Speculation: when a draft (model or training-free
+            # strategy) is configured and every active slot is greedy,
+            # one tick = k proposals + ONE target verify committing
+            # 1..k+1 tokens per slot.  Any sampling slot forces plain
+            # ticks (acceptance is argmax-only).
+            if ((self._draft_model is not None
+                 or self._draft_strategy is not None) and all(
+                    r.temperature <= 0.0 for r in slots if r is not None)):
                 # Takes the device lock internally, only around the
                 # draft/verify device calls.
                 next_tokens = self._speculative_tick(slots, next_tokens)

@@ -1,0 +1,68 @@
+"""Training-free draft strategies for speculative decoding.
+
+Prompt-lookup decoding (PLD): propose the continuation of the request's
+OWN context.  Match the longest n-gram suffix of the committed stream
+(prompt + generated) against an earlier occurrence and copy the k tokens
+that followed it.  No draft model, no draft cache, no extra memory —
+drafting is microseconds of host work, so every accepted token is pure
+win: one width-(k+1) target verify replaces up to k+1 width-1 decode
+steps.  Wins exactly where real serving workloads speculate well —
+summarization, code editing, retrieval-grounded generation, and any
+decode that re-quotes its context (on TPU the verify is additionally
+MXU-friendly where width-1 decode is bandwidth-bound).
+
+Same acceptance rule as the model-draft path (argmax longest-prefix +
+bonus), so the emitted stream stays a valid greedy decode of the target
+— speculation changes latency, never content.
+
+No reference counterpart: kubeflow/mpi-operator ships no inference
+stack (SURVEY.md §2.2); technique is public (prompt-lookup /
+n-gram-matching speculative decoding).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+DRAFT_STRATEGIES = ("prompt_lookup",)
+
+
+def propose_prompt_lookup(history: Sequence[int], k: int,
+                          max_ngram: int = 3,
+                          window: int = 4096) -> List[int]:
+    """Propose k tokens by n-gram continuation lookup over ``history``.
+
+    Scans n-gram sizes ``max_ngram..1``; for each, finds the MOST RECENT
+    earlier occurrence of the history's length-n suffix and copies the k
+    tokens after it.  A continuation shorter than k is extended by
+    cycling it (the repetition hypothesis that justified the match).
+    No occurrence at any n: propose k repeats of the last
+    token (cheap guess; rejection costs nothing — the verify forward has
+    the same width either way).
+    """
+    import numpy as np
+
+    if k < 1:
+        return []
+    if len(history) == 0:
+        return [0] * k
+    # Bounded window: matches in ancient context are rarely better than
+    # recent ones, and the scan must stay cheap inside the serial decode
+    # loop (numpy shifted-compare, not Python slices — O(n·window) C ops
+    # per tick per slot).
+    h = np.asarray(history[-window:] if len(history) > window
+                   else history, dtype=np.int64)
+    size = int(h.size)
+    for n in range(min(max_ngram, size - 1), 0, -1):
+        tail = h[size - n:]
+        # Candidate starts 0..size-n-1 (the suffix itself sits at size-n).
+        match = np.ones(size - n, dtype=bool)
+        for j in range(n):
+            match &= h[j:size - n + j] == tail[j]
+        idx = np.nonzero(match)[0]
+        if idx.size:
+            s = int(idx[-1])  # most recent occurrence
+            # s <= size-n-1, so the continuation base is never empty.
+            base = h[s + n:]
+            return [int(base[j % base.size]) for j in range(k)]
+    return [int(h[-1])] * k

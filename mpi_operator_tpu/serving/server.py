@@ -117,7 +117,9 @@ class InferenceServer:
                  port: int = 0, max_batch_slots: int = 0, mesh=None,
                  kv_page_size: int = 0, kv_cache_blocks: int = 0,
                  kv_prefix_cache: bool = True, kv_cache_dtype: str = "auto",
-                 draft_model=None, draft_variables=None):
+                 draft_model=None, draft_variables=None,
+                 draft_strategy: Optional[str] = None,
+                 draft_len: int = 4, prompt_lookup_ngram: int = 3):
         self.model = model
         self.variables = variables
         self.mesh = mesh
@@ -160,6 +162,11 @@ class InferenceServer:
             raise ValueError(
                 f"kv_cache_dtype={kv_cache_dtype!r} requires "
                 f"kv_page_size > 0 (only the paged pool is quantized)")
+        if draft_strategy is not None and max_batch_slots <= 0:
+            raise ValueError(
+                "draft_strategy requires continuous batching "
+                "(max_batch_slots > 0); the non-batched path speculates "
+                "via draft_model only")
         if max_batch_slots > 0:
             from .batcher import ContinuousBatcher
             # The draft rides into the batcher too: greedy batched
@@ -173,7 +180,11 @@ class InferenceServer:
                                               prefix_cache=kv_prefix_cache,
                                               kv_cache_dtype=kv_cache_dtype,
                                               draft_model=draft_model,
-                                              draft_variables=draft_variables)
+                                              draft_variables=draft_variables,
+                                              draft_strategy=draft_strategy,
+                                              draft_len=draft_len,
+                                              prompt_lookup_ngram=(
+                                                  prompt_lookup_ngram))
 
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,
