@@ -3,13 +3,19 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast native generate verify-generate bench dryrun clean
+.PHONY: test test-fast test-real-cluster native generate verify-generate \
+	bench dryrun clean
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
 
 test-fast: native
 	$(PYTHON) -m pytest tests/ -q -x --ignore=tests/test_e2e_local.py
+
+# Opt-in e2e tier EXECUTED against a live `cluster`-verb process
+# (reference: e2e vs kind, .github/workflows/main.yml:43-67).
+test-real-cluster:
+	bash tools/run_real_cluster_tier.sh
 
 native:
 	$(MAKE) -C native
