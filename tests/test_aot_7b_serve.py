@@ -1,0 +1,49 @@
+"""7B serving AOT machinery (tools/aot_7b_serve.py).
+
+BENCH_LLAMA_SERVE.json rides on this tool: deviceless v5e topology +
+the real XLA:TPU compiler applied to the batcher's paged-KV decode and
+dense-prefill programs.  Tiny-scale regression so the sharding specs,
+cache eval_shape, and budget math stay sound.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+sys.path.insert(0, os.path.abspath(REPO))
+
+from tools.aot_7b_serve import analyze_serve  # noqa: E402
+
+
+def _tpu_compiler_available() -> bool:
+    try:
+        from jax.experimental import topologies
+        topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2")
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _tpu_compiler_available(),
+                    reason="libtpu AOT topology unavailable")
+@pytest.mark.parametrize("kv", ["auto", "int8"])
+def test_tiny_serve_aot(kv):
+    rec = analyze_serve(tp=2, slots=2, kv_dtype=kv, seq=128, tiny=True)
+    assert rec["backend"].startswith("tpu-aot-v5e")
+    assert rec["mesh"] == {"tp": 2, "devices": 2}
+    assert rec["kv_cache_dtype"] == ("bf16" if kv == "auto" else "int8")
+    # tp sharding really halves the weight bytes (bf16 params).
+    assert rec["weight_shard_bytes_per_chip"] < 2 * rec["n_params"]
+    assert rec["kv_pool_bytes_per_chip"] > 0
+    # int8 pool (1B + f32 scales) is smaller than the bf16 pool (2B).
+    if kv == "int8":
+        bf16 = analyze_serve(tp=2, slots=2, kv_dtype="auto", seq=128,
+                             tiny=True)
+        assert rec["kv_pool_bytes_per_chip"] \
+            < bf16["kv_pool_bytes_per_chip"]
+    assert rec["fits_v5e_16gb"]
+    assert rec["decode_cost_bytes_per_step"] > 0
+    assert rec["projected_decode_tokens_per_sec"] > 0
+    assert rec["decode_peak_bytes_per_chip"] <= rec["hbm_usable_bytes"]
