@@ -186,6 +186,33 @@ def _attempt(donate: bool, timeout_s: float, env=None):
                             env=env)
 
 
+def _projection_summary():
+    """Hardware-free perf story for fallback records: the committed
+    XLA:TPU cost-model projection (BENCH_PROJECTIONS.json, round-4
+    verdict #1) for this benchmark's workload, so a tunnel-down
+    BENCH_r*.json still carries a driver-checkable TPU number."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_PROJECTIONS.json")
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    try:
+        with open(path) as f:
+            artifact = json.load(f)
+        rec = next(p for p in artifact["projections"]
+                   if p.get("batch_per_chip") == batch)
+        return {
+            "projected_images_per_sec_per_chip":
+                rec["projected_images_per_sec_per_chip"],
+            "projected_vs_baseline": rec["projected_vs_baseline"],
+            "round2_measured_images_per_sec_per_chip":
+                rec.get("round2_measured_images_per_sec_per_chip"),
+            "prediction_within_2x": rec.get("prediction_within_2x"),
+            "method": "deviceless XLA:TPU AOT + cost_analysis roofline "
+                      "(tools/aot_projections.py; floor, hbm-bound)",
+        }
+    except Exception as exc:
+        return {"unavailable": str(exc)[:200]}
+
+
 def tpu_probe(timeout_s: float = 90.0):
     """Cheap TPU liveness check in a subprocess (tools_tpu_probe.py:
     self-registration + one real op).  Returns (ok, diag).  The round-2/3
@@ -310,6 +337,7 @@ def main() -> None:
                             "CPU fallback measurement, NOT comparable "
                             "to baseline: "
                             + " | ".join(errors))[:1000]
+            rec["tpu_projection"] = _projection_summary()
             print(json.dumps(rec))
             sys.stdout.flush()
             sys.exit(1)
