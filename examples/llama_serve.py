@@ -80,6 +80,14 @@ def main() -> int:
                     help="paged KV block size (with --slots > 0)")
     ap.add_argument("--kv-cache-dtype", default="auto",
                     choices=["auto", "int8"])
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked paged prefill width (0 = whole-prompt "
+                         "dense prefill); O(chunk) activation memory "
+                         "for long prompts")
+    ap.add_argument("--draft-strategy", default="",
+                    choices=["", "prompt_lookup"],
+                    help="training-free speculative decoding (no draft "
+                         "model needed)")
     ap.add_argument("--demo", action="store_true",
                     help="send one demo request, print it, and exit")
     args = ap.parse_args()
@@ -101,10 +109,14 @@ def main() -> int:
         model, variables, host=args.host, port=args.port,
         max_batch_slots=args.slots, kv_page_size=page,
         kv_cache_dtype=kv_dtype,
-        draft_model=draft_model, draft_variables=draft_vars).start()
+        draft_model=draft_model, draft_variables=draft_vars,
+        draft_strategy=args.draft_strategy or None,
+        kv_prefill_chunk=args.prefill_chunk).start()
+    spec = ("model" if draft_model is not None
+            else args.draft_strategy or "off")
     print(f"serving on {server.url}  (slots={args.slots}, "
-          f"page={page}, kv={kv_dtype}, "
-          f"speculative={'on' if draft_model is not None else 'off'})",
+          f"page={page}, kv={kv_dtype}, prefill_chunk="
+          f"{args.prefill_chunk}, speculative={spec})",
           flush=True)
 
     try:

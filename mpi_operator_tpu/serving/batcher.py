@@ -95,7 +95,8 @@ class ContinuousBatcher:
                  draft_model=None, draft_variables=None,
                  draft_len: int = 4, kv_cache_dtype: str = "auto",
                  draft_strategy: Optional[str] = None,
-                 prompt_lookup_ngram: int = 3):
+                 prompt_lookup_ngram: int = 3,
+                 prefill_chunk: int = 0):
         import dataclasses
 
         import jax
@@ -136,6 +137,16 @@ class ContinuousBatcher:
         # memory one worst-case slot would pin.  Prefill stays on the
         # dense layout (batch-1 row, scattered into the pool on install).
         self.page_size = page_size
+        # Chunked prefill (paged only): admit long prompts through
+        # fixed-width batch-1 paged applies that share the pool, so peak
+        # activation memory is O(chunk) instead of O(prompt) — what lets
+        # 7B serve a 4k context on one v5e chip (BENCH_LLAMA_SERVE.json:
+        # the dense 4k prefill is the only program that does not fit).
+        self._prefill_chunk = int(prefill_chunk)
+        if self._prefill_chunk > 0 and page_size <= 0:
+            raise ValueError(
+                "prefill_chunk requires the paged cache (page_size > 0); "
+                "the dense layout prefills whole prompts")
         if kv_cache_dtype != "auto" and page_size <= 0:
             # Never silently serve an unquantized cache the caller
             # believes is int8 (same loud-misconfig convention as
@@ -703,11 +714,20 @@ class ContinuousBatcher:
         >= shared_len)."""
         fn = self._suffix_prefill_cache.get(width)
         if fn is None:
+            import functools
+
             jax, jnp = self._jax, self._jnp
             params = {"params": self.variables["params"]}
             decode_model = self._decode_model
 
-            @jax.jit
+            # Donate the cache: the caller always replaces self._cache
+            # with the returned tree, and without donation every
+            # suffix/chunk apply holds a SECOND copy of the whole KV
+            # pool — at 7B tp1 that extra ~2.2 GB is the difference
+            # between the chunked-prefill fits verdict
+            # (BENCH_LLAMA_SERVE.json, compiled WITH donation) holding
+            # on hardware or OOMing.
+            @functools.partial(jax.jit, donate_argnums=(0,))
             def suffix_prefill(cache, table_row, shared_len, padded,
                                length, temp, top_p, key, top_k):
                 def to_b1(node):
@@ -747,9 +767,12 @@ class ContinuousBatcher:
         shared prefix is already resident in the pool), publish the
         slot's table, and sample the first token."""
         jnp = self._jnp
-        blocks = self._slot_blocks[slot]
         shared_len = self._slot_shared[slot] * self.page_size
         suffix = tokens[shared_len:]
+        if 0 < self._prefill_chunk < len(suffix):
+            return self._prefill_chunked(slot, tokens, sample_args,
+                                         start_len=shared_len)
+        blocks = self._slot_blocks[slot]
         width = _bucket(len(suffix), self._max_seq_len)
         table_row = self._table_row(blocks)
         padded = jnp.asarray([suffix + [0] * (width - len(suffix))],
@@ -763,6 +786,45 @@ class ContinuousBatcher:
             new_cache, "block_table", lambda t: t.at[slot].set(table_row))
         self._cache = replace_cache_leaf(
             new_cache, "cache_index",
+            lambda t: t.at[slot].set(jnp.int32(len(tokens))))
+        return first, key1
+
+    def _prefill_chunked(self, slot: int, tokens: List[int], sample_args,
+                         start_len: int = 0):
+        """Chunked paged prefill: drive the prompt (or its uncached
+        suffix, ``start_len`` > 0) through the paged model in fixed-width
+        batch-1 applies sharing the pool — each chunk is one `_suffix_fn`
+        call at width=prefill_chunk, so ONE compiled program serves every
+        prompt length and peak activation memory is O(chunk).
+
+        Tail padding writes junk K/V at positions past the prompt; those
+        positions are masked until the decode loop overwrites them (the
+        same stale-K/V contract every rollback path relies on).  The
+        sampling key is NOT threaded through chunks: only the final
+        chunk's sample is consumed, with the original key — so the first
+        emitted token is bit-identical to the unchunked paths'."""
+        jnp = self._jnp
+        chunk = self._prefill_chunk
+        blocks = self._slot_blocks[slot]
+        table_row = self._table_row(blocks)
+        suffix = tokens[start_len:]
+        temp, top_p, key, top_k = sample_args
+        cache = self._cache
+        pos = start_len
+        first = key1 = None
+        for off in range(0, len(suffix), chunk):
+            piece = suffix[off:off + chunk]
+            padded = jnp.asarray([piece + [0] * (chunk - len(piece))],
+                                 jnp.int32)
+            cache, first, key1 = self._suffix_fn(chunk)(
+                cache, table_row, jnp.int32(pos), padded, len(piece),
+                temp, top_p, key, top_k)
+            pos += len(piece)
+        from ..models.llama import replace_cache_leaf
+        cache = replace_cache_leaf(
+            cache, "block_table", lambda t: t.at[slot].set(table_row))
+        self._cache = replace_cache_leaf(
+            cache, "cache_index",
             lambda t: t.at[slot].set(jnp.int32(len(tokens))))
         return first, key1
 
@@ -929,6 +991,9 @@ class ContinuousBatcher:
                     with self._device_lock:
                         if shared > 0:
                             first, key1 = self._prefill_suffix(
+                                i, req.tokens, sample_args)
+                        elif 0 < self._prefill_chunk < len(req.tokens):
+                            first, key1 = self._prefill_chunked(
                                 i, req.tokens, sample_args)
                         else:
                             row_cache, first, key1 = self._prefill(

@@ -119,7 +119,8 @@ class InferenceServer:
                  kv_prefix_cache: bool = True, kv_cache_dtype: str = "auto",
                  draft_model=None, draft_variables=None,
                  draft_strategy: Optional[str] = None,
-                 draft_len: int = 4, prompt_lookup_ngram: int = 3):
+                 draft_len: int = 4, prompt_lookup_ngram: int = 3,
+                 kv_prefill_chunk: int = 0):
         self.model = model
         self.variables = variables
         self.mesh = mesh
@@ -167,6 +168,11 @@ class InferenceServer:
                 "draft_strategy requires continuous batching "
                 "(max_batch_slots > 0); the non-batched path speculates "
                 "via draft_model only")
+        if kv_prefill_chunk > 0 and max_batch_slots <= 0:
+            raise ValueError(
+                "kv_prefill_chunk requires continuous batching "
+                "(max_batch_slots > 0); the non-batched path prefills "
+                "whole prompts through the dense cache")
         if max_batch_slots > 0:
             from .batcher import ContinuousBatcher
             # The draft rides into the batcher too: greedy batched
@@ -184,7 +190,9 @@ class InferenceServer:
                                               draft_strategy=draft_strategy,
                                               draft_len=draft_len,
                                               prompt_lookup_ngram=(
-                                                  prompt_lookup_ngram))
+                                                  prompt_lookup_ngram),
+                                              prefill_chunk=(
+                                                  kv_prefill_chunk))
 
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,

@@ -928,3 +928,74 @@ def test_draft_strategy_validation():
     with pytest.raises(ValueError, match="exclusive"):
         ContinuousBatcher(model, variables, draft_strategy="prompt_lookup",
                           draft_model=model, draft_variables=variables)
+
+
+# -- chunked prefill --------------------------------------------------------
+
+def test_prefill_chunk_requires_paged():
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatcher(model, variables, prefill_chunk=16)
+
+
+def test_chunked_prefill_matches_dense_prefill():
+    """Chunked admission (fixed-width paged applies sharing the pool)
+    must decode token-identically to the dense-prefill path — greedy AND
+    seeded sampling, prompt lengths off the chunk boundary, both KV
+    dtypes."""
+    cfg = llama2_tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    rng = np.random.default_rng(17)
+    prompts = [list(map(int, rng.integers(1, cfg.vocab_size, n)))
+               for n in (70, 33, 64, 9)]   # off/on boundary + short
+    for kv in ("auto", "int8"):
+        dense = ContinuousBatcher(model, variables, max_slots=2,
+                                  page_size=16, kv_cache_dtype=kv).start()
+        chunked = ContinuousBatcher(model, variables, max_slots=2,
+                                    page_size=16, kv_cache_dtype=kv,
+                                    prefill_chunk=32).start()
+        try:
+            for p in prompts:
+                want = dense.submit(p, 8)
+                assert chunked.submit(p, 8) == want, (kv, len(p))
+            # seeded sampling: the first token's key must line up too
+            for p in prompts[:2]:
+                want = dense.submit(p, 6, temperature=0.8, seed=5)
+                got = chunked.submit(p, 6, temperature=0.8, seed=5)
+                assert got == want, (kv, len(p))
+        finally:
+            dense.stop()
+            chunked.stop()
+
+
+def test_chunked_prefill_with_prefix_cache():
+    """A resubmitted prompt takes the shared-prefix path; its uncached
+    suffix routes through the chunk loop when longer than the chunk."""
+    cfg = llama2_tiny(dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    rng = np.random.default_rng(23)
+    base = list(map(int, rng.integers(1, cfg.vocab_size, 48)))
+    long_tail = list(map(int, rng.integers(1, cfg.vocab_size, 70)))
+    ref = ContinuousBatcher(model, variables, max_slots=2,
+                            page_size=16).start()
+    b = ContinuousBatcher(model, variables, max_slots=2, page_size=16,
+                          prefill_chunk=32).start()
+    try:
+        for batcher in (ref, b):
+            batcher.submit(base, 4)
+        # same 48-token prefix (3 full blocks cached) + 70-token suffix:
+        # suffix > chunk, so the shared-prefix admission chunks it.
+        want = ref.submit(base + long_tail, 8)
+        got = b.submit(base + long_tail, 8)
+        assert got == want
+        assert b.prefix_stats["hit_blocks"] > 0
+    finally:
+        ref.stop()
+        b.stop()

@@ -158,6 +158,38 @@ def analyze_serve(tp: int, slots: int, kv_dtype: str = "auto",
         prefill_exe = jax.jit(prefill).lower(params_abs, pre_tok).compile()
     prefill_compile_s = time.perf_counter() - t0
 
+    # Chunked prefill: the batcher's _prefill_chunked program — a
+    # batch-1 paged apply at width=chunk sharing the full pool (donated),
+    # so peak activation memory is O(chunk) instead of O(seq).  Pool size
+    # pinned to the decode config's so the B=1 trace budgets the same
+    # HBM-resident pool.
+    chunk = min(512, seq // 2) or seq
+    chunk_cfg = dataclasses.replace(decode_cfg,
+                                    cache_blocks=decode_cfg.pool_blocks(
+                                        slots))
+    chunk_model = LlamaModel(chunk_cfg, mesh=mesh)
+    c_cache_abs = jax.eval_shape(
+        lambda p: chunk_model.apply(
+            {"params": p}, jnp.zeros((1, chunk), jnp.int32), decode=True,
+            mutable=["cache"])[1]["cache"], params_abs)
+    c_cache_abs = jax.tree_util.tree_map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                          sharding=NamedSharding(mesh, s)),
+        c_cache_abs, _cache_specs(c_cache_abs, P))
+    c_tok = jax.ShapeDtypeStruct((1, chunk), jnp.int32, sharding=repl)
+
+    def chunk_step(params, cache, tokens):
+        logits, state = chunk_model.apply(
+            {"params": params, "cache": cache}, tokens, decode=True,
+            mutable=["cache"])
+        return state["cache"], logits[:, -1]
+
+    t0 = time.perf_counter()
+    with mesh:
+        chunk_exe = jax.jit(chunk_step, donate_argnums=(1,)).lower(
+            params_abs, c_cache_abs, c_tok).compile()
+    chunk_compile_s = time.perf_counter() - t0
+
     def shard_bytes(tree):
         total = 0
         for leaf in jax.tree_util.tree_leaves(tree):
@@ -182,10 +214,12 @@ def analyze_serve(tp: int, slots: int, kv_dtype: str = "auto",
     weight_bytes = shard_bytes(params_abs)
     kv_bytes = shard_bytes(cache_abs)
     decode_peak, prefill_peak = peak(decode_exe), peak(prefill_exe)
+    chunk_peak = peak(chunk_exe)
     d_flops, d_bytes = cost(decode_exe)
     # Decode is HBM-bound: the step streams the weight shard + live KV.
     decode_step_s = max(d_bytes / HBM_BW, d_flops / PEAK_FLOPS)
     fits = max(decode_peak, prefill_peak) <= V5E_HBM_BYTES
+    fits_chunked = max(decode_peak, chunk_peak) <= V5E_HBM_BYTES
     n_params = sum(math.prod(l.shape)
                    for l in jax.tree_util.tree_leaves(params_abs))
     return {
@@ -198,8 +232,11 @@ def analyze_serve(tp: int, slots: int, kv_dtype: str = "auto",
         "kv_pool_bytes_per_chip": int(kv_bytes),
         "decode_peak_bytes_per_chip": decode_peak,
         "prefill_peak_bytes_per_chip": prefill_peak,
+        "chunked_prefill_chunk": chunk,
+        "chunked_prefill_peak_bytes_per_chip": chunk_peak,
         "hbm_usable_bytes": V5E_HBM_BYTES,
         "fits_v5e_16gb": bool(fits),
+        "fits_v5e_16gb_with_chunked_prefill": bool(fits_chunked),
         "decode_cost_flops_per_step": d_flops,
         "decode_cost_bytes_per_step": d_bytes,
         "projected_decode_tokens_per_sec": round(
@@ -210,6 +247,7 @@ def analyze_serve(tp: int, slots: int, kv_dtype: str = "auto",
                             f"upper bound, per chip group"),
         "decode_compile_s": round(decode_compile_s, 1),
         "prefill_compile_s": round(prefill_compile_s, 1),
+        "chunked_prefill_compile_s": round(chunk_compile_s, 1),
         "backend": "tpu-aot-v5e (deviceless XLA:TPU)",
     }
 
