@@ -247,7 +247,15 @@ def llama_bench(fused_xent: bool = False) -> dict:
             "loss": round(float(m["loss"]), 4)}
 
 
-def serve_bench(kv_cache_dtype: str = "auto") -> dict:
+# The four serve phases share one model + params (the batcher derives
+# the paged/int8/chunked variants itself from the dense-layout model),
+# mirroring the resnet phases' rb_holder — a fresh init per phase would
+# burn minutes of scarce tunnel-window time.
+_serve_holder: dict = {}
+
+
+def serve_bench(kv_cache_dtype: str = "auto",
+                prefill_chunk: int = 0, long_prompts: bool = False) -> dict:
     import threading
 
     import jax
@@ -259,15 +267,26 @@ def serve_bench(kv_cache_dtype: str = "auto") -> dict:
     dim, n_layers, seq = (128, 2, 256) if SMOKE else (2048, 16, 2048)
     slots, page = 4 if SMOKE else 8, 16
     new_tokens, prompt_len = (8, 32) if SMOKE else (64, 128)
-    cfg = LlamaConfig(vocab_size=32000, dim=dim, n_layers=n_layers,
-                      n_heads=max(1, dim // 128),
-                      n_kv_heads=max(1, dim // 512), max_seq_len=seq)
-    model = LlamaModel(cfg)
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 8), jnp.int32))
+    if long_prompts:
+        # Chunked-prefill A/B shape: prompts long enough that whole-
+        # prompt admission dominates (the capacity problem chunking
+        # solves); chunk sized so each prompt spans several chunks.
+        prompt_len = 96 if SMOKE else 1024
+    if "model" not in _serve_holder:
+        cfg = LlamaConfig(vocab_size=32000, dim=dim, n_layers=n_layers,
+                          n_heads=max(1, dim // 128),
+                          n_kv_heads=max(1, dim // 512), max_seq_len=seq)
+        model = LlamaModel(cfg)
+        _serve_holder["model"] = model
+        _serve_holder["variables"] = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    model = _serve_holder["model"]
+    cfg = model.config
+    variables = _serve_holder["variables"]
     batcher = ContinuousBatcher(model, variables, max_slots=slots,
                                 page_size=page,
-                                kv_cache_dtype=kv_cache_dtype).start()
+                                kv_cache_dtype=kv_cache_dtype,
+                                prefill_chunk=prefill_chunk).start()
     try:
         rng = np.random.default_rng(0)
         prompts = [list(map(int, rng.integers(1, cfg.vocab_size,
@@ -303,10 +322,24 @@ def serve_bench(kv_cache_dtype: str = "auto") -> dict:
                 "slots": slots, "prompt_len": prompt_len,
                 "new_tokens": new_tokens, "page_size": page,
                 "kv_cache_dtype": kv_cache_dtype,
+                "prefill_chunk": prefill_chunk,
                 "ttft_cold_s": round(cold, 4), "ttft_warm_s": round(warm, 4),
                 "prefix_hit_blocks": batcher.prefix_stats["hit_blocks"]}
     finally:
         batcher.stop()
+
+
+def prompt_lookup_bench() -> dict:
+    """Training-free speculation on real hardware: the bench_serve
+    prompt-lookup phase (committed induction target, repetitive-context
+    workload) — on TPU the width-(k+1) verify is MXU-friendly where
+    width-1 decode is bandwidth-bound, so the CPU-tier 1.86x should
+    widen."""
+    import jax
+
+    from bench_serve import _prompt_lookup_phase
+
+    return _prompt_lookup_phase(jax, 4 if SMOKE else 8, 16)
 
 
 def speculative_bench() -> dict:
@@ -476,6 +509,16 @@ def main() -> int:
               lambda: serve_bench(kv_cache_dtype="int8"))
     cap.phase("speculative", 300, speculative_bench)
     cap.phase("kernel_ab", 400, kernel_ab)
+    # Round-5 phases LAST so a short tunnel window still yields every
+    # previously-validated capture first.  Chunked-prefill A/B at long
+    # prompts (dense admission pays a fresh 1024-token prefill compile:
+    # need mirrors the 'serve' phase), then training-free speculation.
+    cap.phase("serve_long_prompts_dense", 500,
+              lambda: serve_bench(long_prompts=True))
+    cap.phase("serve_long_prompts_chunked", 400,
+              lambda: serve_bench(long_prompts=True,
+                                  prefill_chunk=32 if SMOKE else 256))
+    cap.phase("speculative_prompt_lookup", 300, prompt_lookup_bench)
     cap.emit({"phase": "done", "remaining_s": round(cap.remaining(), 1)})
     return 0
 
