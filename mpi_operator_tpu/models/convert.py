@@ -137,13 +137,7 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
         overrides = {**dict(
             n_experts=hf_config.num_local_experts,
             top_k=hf_config.num_experts_per_tok), **overrides}
-    if getattr(hf_config, "sliding_window", None) is not None:
-        # Fail loudly (same discipline as the rope_scaling guard below):
-        # converting a sliding-window checkpoint into a full-attention
-        # model would be silently wrong past the window.
-        raise NotImplementedError(
-            f"sliding_window={hf_config.sliding_window} attention is "
-            f"not supported; full causal attention only")
+    sliding_window = getattr(hf_config, "sliding_window", None)
     rope_scaling = getattr(hf_config, "rope_scaling", None)
     if rope_scaling is not None:
         rope_type = rope_scaling.get("rope_type",
@@ -153,6 +147,7 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
                 f"rope_scaling type {rope_type!r} not supported")
     return LlamaConfig(**{**dict(
         rope_scaling=rope_scaling,
+        sliding_window=sliding_window,
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,

@@ -47,6 +47,10 @@ class LlamaConfig:
     top_k: int = 2                            # experts per token
     ring_impl: str = "dense"                  # sp>1 chunk compute:
                                               # 'dense'|'flash'
+    sliding_window: Optional[int] = None      # Mistral SWA: each query
+                                              # attends the last N keys
+                                              # (mask-only; cache stays
+                                              # O(max_seq_len))
     rope_scaling: Optional[dict] = None       # llama3-style NTK scaling:
                                               # {factor, low_freq_factor,
                                               #  high_freq_factor,
@@ -68,6 +72,9 @@ class LlamaConfig:
         if isinstance(self.rope_scaling, dict):
             object.__setattr__(self, "rope_scaling",
                                tuple(sorted(self.rope_scaling.items())))
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1, got {self.sliding_window}")
         if self.kv_cache_dtype not in ("auto", "int8"):
             raise ValueError(
                 f"kv_cache_dtype must be 'auto' or 'int8', "
@@ -336,7 +343,8 @@ class LlamaAttention(nn.Module):
                     block_table.value, idx + 1,
                     impl=cfg.attention_impl,
                     k_scale=pool_ks.value if int8_kv else None,
-                    v_scale=pool_vs.value if int8_kv else None)[:, None]
+                    v_scale=pool_vs.value if int8_kv else None,
+                    window=cfg.sliding_window)[:, None]
             else:
                 # Multi-token (prefill into a paged cache): gather each
                 # row's blocks in logical order — the view index equals
@@ -357,7 +365,8 @@ class LlamaAttention(nn.Module):
                         v_all, pool_vs.value[block_table.value].reshape(
                             b, span, cfg.kv_heads)).astype(cfg.dtype)
                 out = _decode_attention(q, k_all, v_all, positions,
-                                        cfg.n_heads // cfg.kv_heads)
+                                        cfg.n_heads // cfg.kv_heads,
+                                        window=cfg.sliding_window)
         elif decode:
             idx = cache_index.value
             # Per-row insertion at each row's own index.
@@ -370,7 +379,8 @@ class LlamaAttention(nn.Module):
             cached_v.value = v_all
             cache_index.value = idx + s
             out = _decode_attention(q, k_all, v_all, positions,
-                                    cfg.n_heads // cfg.kv_heads)
+                                    cfg.n_heads // cfg.kv_heads,
+                                    window=cfg.sliding_window)
         else:
             if cfg.kv_heads != cfg.n_heads:  # GQA: repeat KV groups
                 repeat = cfg.n_heads // cfg.kv_heads
@@ -385,11 +395,17 @@ class LlamaAttention(nn.Module):
             if self.mesh is not None:
                 sp_size = self.mesh.shape.get("sp", 1)
             if sp_size > 1:
+                if cfg.sliding_window is not None:
+                    raise NotImplementedError(
+                        "sliding_window + sequence-parallel ring "
+                        "attention is not supported; run SWA models "
+                        "with sp=1")
                 out = ring_attention(q, k, v, self.mesh, causal=True,
                                      impl=cfg.ring_impl)
             else:
                 out = attention(q, k, v, causal=True,
-                                impl=cfg.attention_impl, mesh=self.mesh)
+                                impl=cfg.attention_impl, mesh=self.mesh,
+                                window=cfg.sliding_window)
 
         out = nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -397,11 +413,13 @@ class LlamaAttention(nn.Module):
         return _constrain(out, self.mesh, BATCH_AXES, "sp", None)
 
 
-def _decode_attention(q, k_cache, v_cache, positions, gqa_repeat: int):
+def _decode_attention(q, k_cache, v_cache, positions, gqa_repeat: int,
+                      window: Optional[int] = None):
     """Cached attention: q [B,S,H,D] against the full cache [B,L,KH,D];
     keys beyond each query's position are masked (covers the unused cache
     tail, stale padding slots and intra-step causality).  positions is
-    per-row [B,S]."""
+    per-row [B,S].  window: Mistral sliding-window — also mask keys more
+    than window-1 positions behind the query."""
     import math as _math
     if gqa_repeat > 1:
         k_cache = jnp.repeat(k_cache, gqa_repeat, axis=2)
@@ -411,6 +429,8 @@ def _decode_attention(q, k_cache, v_cache, positions, gqa_repeat: int):
                         k_cache.astype(jnp.float32))
     kv_pos = jnp.arange(k_cache.shape[1])
     mask = kv_pos[None, None, :] <= positions[:, :, None]  # [B, S, L]
+    if window is not None:
+        mask &= kv_pos[None, None, :] > positions[:, :, None] - window
     scores = jnp.where(mask[:, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs,

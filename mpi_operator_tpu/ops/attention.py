@@ -339,14 +339,19 @@ def _flash_backward(q, k, v, out, lse, dout, scale: float, causal: bool,
 # Reference XLA path + exact backward
 # ---------------------------------------------------------------------------
 
-def _xla_attention(q, k, v, scale: float, causal: bool):
+def _xla_attention(q, k, v, scale: float, causal: bool, window=None):
     """Plain XLA attention returning (out, lse); numerically the spec the
-    Pallas kernel is tested against."""
+    Pallas kernel is tested against.  window (with causal): each query
+    attends only the last `window` keys (Mistral sliding-window mask,
+    q_pos - k_pos < window)."""
     qf = q.astype(jnp.float32) * scale
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, k.astype(jnp.float32))
     if causal:
         q_pos = jnp.arange(q.shape[2])
         mask = q_pos[:, None] >= jnp.arange(k.shape[2])[None, :]
+        if window is not None:
+            mask &= (q_pos[:, None]
+                     - jnp.arange(k.shape[2])[None, :]) < window
         s = jnp.where(mask[None, None], s, -jnp.inf)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
@@ -413,11 +418,16 @@ flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def attention(q, k, v, causal: bool = True, impl: str = "auto",
-              interpret: bool = False, mesh=None):
+              interpret: bool = False, mesh=None, window=None):
     """Dispatcher on [B, S, H, D] (model layout).
 
     impl: 'pallas' (TPU kernel), 'xla' (plain ops), 'auto' (pallas on TPU
     backends when the sequence admits sane block sizes, xla elsewhere).
+
+    window: sliding-window attention (Mistral): each query attends only
+    the last `window` keys.  Runs on the XLA path (auto falls back; an
+    explicit impl='pallas' is rejected loudly — a banded kernel is
+    future work) and requires causal.
 
     mesh: when given (and >1 device), the pallas path runs under
     shard_map with batch over (dp, fsdp) and heads over tp — Mosaic
@@ -428,6 +438,14 @@ def attention(q, k, v, causal: bool = True, impl: str = "auto",
     computes exactly its slice of the global result.
     """
     s = q.shape[1]
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if impl == "pallas":
+            raise ValueError(
+                "sliding-window attention runs on the XLA path; "
+                "impl='pallas' has no banded kernel yet")
+        impl = "xla"
     if impl == "auto":
         # 'axon' (the tunneled single-chip platform) executes ALL pallas
         # kernels ~6x slower than XLA (measured: 1.2-1.3 TFLOPS for both
@@ -446,7 +464,8 @@ def attention(q, k, v, causal: bool = True, impl: str = "auto",
                                   DEFAULT_KV_BLOCK, interpret)
         else:
             scale = 1.0 / math.sqrt(qm.shape[-1])
-            out, _ = _xla_attention(qt, kt, vt, scale, causal)
+            out, _ = _xla_attention(qt, kt, vt, scale, causal,
+                                    window=window)
         return out.transpose(0, 2, 1, 3)
 
     if impl == "pallas" and mesh is not None and mesh.size > 1:

@@ -37,7 +37,7 @@ _SUBLANES = 8
 
 
 def _xla_paged(q, pool_k, pool_v, block_table, lengths, scale,
-               k_scale=None, v_scale=None):
+               k_scale=None, v_scale=None, window=None):
     """Reference path: dense gather + masked softmax.  Numerically the
     spec the kernel is tested against (and the non-TPU fallback).
     With k_scale/v_scale ([NB, page, KH], int8 pools) the gathered
@@ -60,6 +60,10 @@ def _xla_paged(q, pool_k, pool_v, block_table, lengths, scale,
                    k_all.astype(jnp.float32))
     pos = jnp.arange(maxb * page)
     mask = pos[None, :] < lengths[:, None]                  # [B, L]
+    if window is not None:
+        # Query position is lengths-1; attend keys in
+        # (q_pos - window, q_pos] == [lengths - window, lengths).
+        mask &= pos[None, :] >= lengths[:, None] - window
     s = jnp.where(mask[:, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhk,bkhd->bhd", p, v_all.astype(jnp.float32))
@@ -202,7 +206,7 @@ def _pallas_paged(q, pool_k, pool_v, block_table, lengths, scale,
 def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
                            scale=None, impl: str = "auto",
                            interpret: bool = False,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None, window=None):
     """One decode step of attention against a paged KV pool.
 
     - q: [B, H, D] — this step's queries (sequence dim already squeezed).
@@ -229,6 +233,14 @@ def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
         raise ValueError(f"n_heads {h} not a multiple of kv_heads {kh}")
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale go together")
+    if window is not None:
+        # Sliding window runs on the XLA path (auto falls back; explicit
+        # pallas rejected loudly — no banded paged kernel yet).
+        if impl == "pallas":
+            raise ValueError(
+                "sliding-window paged attention has no Pallas kernel; "
+                "use impl='xla'/'auto'")
+        impl = "xla"
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "pallas":
@@ -236,4 +248,4 @@ def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
                              scale, interpret, k_scale=k_scale,
                              v_scale=v_scale)
     return _xla_paged(q, pool_k, pool_v, block_table, lengths, scale,
-                      k_scale=k_scale, v_scale=v_scale)
+                      k_scale=k_scale, v_scale=v_scale, window=window)

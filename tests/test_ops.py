@@ -539,3 +539,69 @@ def test_moe_no_drop_chunked_matches_unchunked():
     out_u = Unchunked(**kwargs).apply(variables, x)
     np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_u),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_sliding_window_attention_mask():
+    """window binds: position q attends exactly keys (q-window, q]."""
+    rng = np.random.default_rng(0)
+    B, S, H, D, W = 1, 12, 2, 8, 4
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+               for _ in range(3))
+    out = attention(q, k, v, causal=True, impl="xla", window=W)
+    # Reference: per-query softmax over its window only.
+    qt = np.asarray(q).transpose(0, 2, 1, 3)
+    kt = np.asarray(k).transpose(0, 2, 1, 3)
+    vt = np.asarray(v).transpose(0, 2, 1, 3)
+    scale = 1.0 / np.sqrt(D)
+    want = np.zeros_like(qt)
+    for pos in range(S):
+        lo = max(0, pos - W + 1)
+        s = (qt[:, :, pos:pos + 1] * scale) @ kt[:, :, lo:pos + 1] \
+            .transpose(0, 1, 3, 2)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want[:, :, pos] = (p @ vt[:, :, lo:pos + 1])[:, :, 0]
+    np.testing.assert_allclose(np.asarray(out).transpose(0, 2, 1, 3),
+                               want, atol=1e-5, rtol=1e-5)
+    # Loud gating: no banded pallas kernel.
+    with pytest.raises(ValueError, match="pallas"):
+        attention(q, k, v, causal=True, impl="pallas", window=W)
+
+
+def test_sliding_window_paged_matches_dense_decode():
+    """paged_decode_attention(window=) equals _decode_attention(window=)
+    on the same K/V for the single-token decode step."""
+    from mpi_operator_tpu.models.llama import _decode_attention
+    from mpi_operator_tpu.ops.paged_attention import paged_decode_attention
+
+    rng = np.random.default_rng(1)
+    B, L, KH, D, page, W = 2, 16, 2, 8, 4, 5
+    lengths = np.array([9, 14], np.int32)
+    k_cache = jnp.asarray(rng.normal(size=(B, L, KH, D)), jnp.float32)
+    v_cache = jnp.asarray(rng.normal(size=(B, L, KH, D)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(B, 1, KH, D)), jnp.float32)
+    want = _decode_attention(q, k_cache, v_cache,
+                             jnp.asarray(lengths - 1)[:, None], 1,
+                             window=W)
+    # Same K/V through a paged pool with an identity-ish block layout.
+    nb = B * (L // page) + 1
+    pool_k = jnp.zeros((nb, page, KH, D), jnp.float32)
+    pool_v = jnp.zeros((nb, page, KH, D), jnp.float32)
+    table = np.zeros((B, L // page), np.int32)
+    blk = 1
+    for b in range(B):
+        for j in range(L // page):
+            pool_k = pool_k.at[blk].set(k_cache[b, j * page:(j + 1) * page])
+            pool_v = pool_v.at[blk].set(v_cache[b, j * page:(j + 1) * page])
+            table[b, j] = blk
+            blk += 1
+    got = paged_decode_attention(q[:, 0], pool_k, pool_v,
+                                 jnp.asarray(table),
+                                 jnp.asarray(lengths), impl="xla",
+                                 window=W)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want)[:, 0],
+                               atol=1e-5, rtol=1e-5)
+    with pytest.raises(ValueError, match="Pallas kernel"):
+        paged_decode_attention(q[:, 0], pool_k, pool_v,
+                               jnp.asarray(table), jnp.asarray(lengths),
+                               impl="pallas", window=W)
