@@ -80,6 +80,10 @@ def main() -> int:
                     help="paged KV block size (with --slots > 0)")
     ap.add_argument("--kv-cache-dtype", default="auto",
                     choices=["auto", "int8"])
+    ap.add_argument("--weight-dtype", default="auto",
+                    choices=["auto", "int8"],
+                    help="int8: weight-only quantized serving "
+                         "(halves weight HBM)")
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="chunked paged prefill width (0 = whole-prompt "
                          "dense prefill); O(chunk) activation memory "
@@ -111,12 +115,18 @@ def main() -> int:
         kv_cache_dtype=kv_dtype,
         draft_model=draft_model, draft_variables=draft_vars,
         draft_strategy=args.draft_strategy or None,
-        kv_prefill_chunk=args.prefill_chunk).start()
+        kv_prefill_chunk=args.prefill_chunk,
+        weight_dtype=args.weight_dtype).start()
+    if args.weight_dtype == "int8":
+        # Release the full-precision weights: the server holds the int8
+        # copy; keeping this reference would pin BOTH trees in HBM and
+        # defeat the halving (the single-chip 7B fit depends on it).
+        del variables
     spec = ("model" if draft_model is not None
             else args.draft_strategy or "off")
     print(f"serving on {server.url}  (slots={args.slots}, "
-          f"page={page}, kv={kv_dtype}, prefill_chunk="
-          f"{args.prefill_chunk}, speculative={spec})",
+          f"page={page}, kv={kv_dtype}, weights={args.weight_dtype}, "
+          f"prefill_chunk={args.prefill_chunk}, speculative={spec})",
           flush=True)
 
     try:

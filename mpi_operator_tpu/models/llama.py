@@ -47,6 +47,9 @@ class LlamaConfig:
     top_k: int = 2                            # experts per token
     ring_impl: str = "dense"                  # sp>1 chunk compute:
                                               # 'dense'|'flash'
+    weight_dtype: str = "auto"                # 'int8': weight-only
+                                              # quantized matmuls for
+                                              # serving (models/quant.py)
     sliding_window: Optional[int] = None      # Mistral SWA: each query
                                               # attends the last N keys
                                               # (mask-only; cache stays
@@ -72,6 +75,13 @@ class LlamaConfig:
         if isinstance(self.rope_scaling, dict):
             object.__setattr__(self, "rope_scaling",
                                tuple(sorted(self.rope_scaling.items())))
+        if self.weight_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'auto' or 'int8', "
+                f"got {self.weight_dtype!r}")
+        if self.weight_dtype == "int8" and self.n_experts > 1:
+            raise NotImplementedError(
+                "weight-only int8 does not cover MoE expert stacks yet")
         if self.sliding_window is not None and self.sliding_window < 1:
             raise ValueError(
                 f"sliding_window must be >= 1, got {self.sliding_window}")
@@ -228,6 +238,19 @@ def _constrain(x, mesh, *spec_axes):
         x, NamedSharding(mesh, P(*spec_axes)))
 
 
+def _dense_layer(cfg, features, axis, name):
+    """Matmul layer factory: nn.DenseGeneral, or the weight-only-int8
+    QuantDenseGeneral when cfg.weight_dtype == 'int8' (same kernel
+    shape, sibling per-output-channel scale — models/quant.py)."""
+    if cfg.weight_dtype == "int8":
+        from .quant import QuantDenseGeneral
+        return QuantDenseGeneral(features=features, axis=axis,
+                                 dtype=cfg.dtype, name=name)
+    return nn.DenseGeneral(features=features, axis=axis, use_bias=False,
+                           dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           name=name)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
     mesh: Any = None
@@ -236,9 +259,8 @@ class LlamaAttention(nn.Module):
     def __call__(self, x, positions, decode: bool = False):
         cfg = self.config
         b, s, _ = x.shape
-        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
-            features=feats, axis=-1, use_bias=False, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype, name=name)
+        dense = lambda feats, name: _dense_layer(  # noqa: E731
+            cfg, feats, -1, name)
 
         paged = decode and cfg.page_size > 0
         if decode:
@@ -407,9 +429,7 @@ class LlamaAttention(nn.Module):
                                 impl=cfg.attention_impl, mesh=self.mesh,
                                 window=cfg.sliding_window)
 
-        out = nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
-                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                              name="wo")(out)
+        out = _dense_layer(cfg, cfg.dim, (-2, -1), "wo")(out)
         return _constrain(out, self.mesh, BATCH_AXES, "sp", None)
 
 
@@ -445,9 +465,8 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            features=feats, use_bias=False, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype, name=name)
+        dense = lambda feats, name: _dense_layer(  # noqa: E731
+            cfg, feats, -1, name)
         gate = dense(cfg.ffn_dim, "w1")(x)
         up = dense(cfg.ffn_dim, "w3")(x)
         h = nn.silu(gate) * up
@@ -539,8 +558,7 @@ class LlamaModel(nn.Module):
             # "output" param exists; flax init callers never set
             # return_hidden.
             return x
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                          param_dtype=cfg.param_dtype, name="output")(x)
+        logits = _dense_layer(cfg, cfg.vocab_size, -1, "output")(x)
         return _constrain(logits, self.mesh, BATCH_AXES, "sp", "tp")
 
 
@@ -550,11 +568,18 @@ def llama_param_specs(config: LlamaConfig):
     (ZeRO-3), norms replicated."""
     from jax.sharding import PartitionSpec as P
 
+    def q(entry, *scale_spec):
+        """Quantized layers add a per-output-channel 'scale' leaf whose
+        spec mirrors the kernel's output dims."""
+        if config.weight_dtype != "int8":
+            return entry
+        return {**entry, "scale": P(*scale_spec)}
+
     attn = {
-        "wq": {"kernel": P("fsdp", "tp", None)},
-        "wk": {"kernel": P("fsdp", "tp", None)},
-        "wv": {"kernel": P("fsdp", "tp", None)},
-        "wo": {"kernel": P("tp", None, "fsdp")},
+        "wq": q({"kernel": P("fsdp", "tp", None)}, "tp", None),
+        "wk": q({"kernel": P("fsdp", "tp", None)}, "tp", None),
+        "wv": q({"kernel": P("fsdp", "tp", None)}, "tp", None),
+        "wo": q({"kernel": P("tp", None, "fsdp")}, "fsdp"),
     }
     if config.n_experts > 1:
         # MoE experts over 'ep' (ops/moe.py layout [E, D, F]).
@@ -566,9 +591,9 @@ def llama_param_specs(config: LlamaConfig):
         }
     else:
         feed_forward = {
-            "w1": {"kernel": P("fsdp", "tp")},
-            "w3": {"kernel": P("fsdp", "tp")},
-            "w2": {"kernel": P("tp", "fsdp")},
+            "w1": q({"kernel": P("fsdp", "tp")}, "tp"),
+            "w3": q({"kernel": P("fsdp", "tp")}, "tp"),
+            "w2": q({"kernel": P("tp", "fsdp")}, "fsdp"),
         }
     block = {
         "attention": attn,
@@ -579,7 +604,7 @@ def llama_param_specs(config: LlamaConfig):
     params = {f"layers_{i}": block for i in range(config.n_layers)}
     params["tok_embeddings"] = {"embedding": P("tp", "fsdp")}
     params["norm"] = {"scale": P(None)}
-    params["output"] = {"kernel": P("fsdp", "tp")}
+    params["output"] = q({"kernel": P("fsdp", "tp")}, "tp")
     return {"params": params}
 
 

@@ -1,0 +1,97 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is HBM-bandwidth-bound: every step streams the full weight shard
+(BENCH_LLAMA_SERVE.json cost analysis), so halving weight bytes nearly
+halves the decode roofline — and it is what lets single-chip 7B serving
+breathe (12.55 GiB bf16 weights -> ~6.3 GiB int8).
+
+Scheme: symmetric per-output-channel int8.  For a kernel whose leading
+dims contract with the activation, scale[out] = max|w[..., out]| / 127
+over the contracting dims and q = round(w / scale).  Because the scale
+is per-OUTPUT-channel, (x @ dequant(q)) == (x @ q) * scale exactly —
+``QuantDenseGeneral`` therefore matmuls the int8 kernel directly (cast
+fuses into the dot; the HBM-resident buffer stays int8) and applies the
+scale to the f32 accumulator after.  Weight-only: activations stay in
+``cfg.dtype``; K/V quantization is separate (``kv_cache_dtype``).
+
+Inference-oriented: round/clip has zero gradient, so quantized params
+are for serving (the training step keeps full-precision weights).
+
+No reference counterpart: kubeflow/mpi-operator ships no inference
+stack (SURVEY.md §2.2); the technique is public (weight-only INT8 /
+LLM.int8()-style per-channel scales, minus the outlier path).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# params path leaf name -> number of contracting (input) dims of its
+# kernel; everything after them is output dims and carries the scale.
+_QUANT_KERNELS = {
+    "wq": 1, "wk": 1, "wv": 1,   # [D, H, Dh]
+    "wo": 2,                      # [H, Dh, D]
+    "w1": 1, "w3": 1, "w2": 1,    # [D, F] / [F, D]
+    "output": 1,                  # [D, V]
+}
+
+
+class QuantDenseGeneral(nn.Module):
+    """Drop-in for ``nn.DenseGeneral(use_bias=False)`` over int8 weights
+    with per-output-channel f32 scales.  Same kernel shape as the dense
+    layer (so PartitionSpecs carry over); adds a sibling ``scale``
+    param of the output-feature shape."""
+    features: Any                 # int or tuple
+    axis: Any = -1                # int or tuple
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        feats = ((self.features,) if isinstance(self.features, int)
+                 else tuple(self.features))
+        axes = ((self.axis,) if isinstance(self.axis, int)
+                else tuple(self.axis))
+        axes = tuple(a % x.ndim for a in axes)
+        in_dims = tuple(x.shape[a] for a in axes)
+        kernel = self.param("kernel", nn.initializers.zeros,
+                            in_dims + feats, jnp.int8)
+        scale = self.param("scale", nn.initializers.ones, feats,
+                           jnp.float32)
+        out = jax.lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            ((axes, tuple(range(len(axes)))), ((), ())),
+            preferred_element_type=jnp.float32)
+        return (out * scale).astype(self.dtype)
+
+
+def _quantize_kernel(w, n_in: int):
+    red = tuple(range(n_in))
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=red)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127,
+                 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_params(params: dict, config) -> dict:
+    """Full-precision LlamaModel params -> the weight_dtype='int8'
+    model's param tree: every matmul kernel becomes {kernel: int8,
+    scale: f32[out]}; embeddings and norms stay full precision."""
+    if getattr(config, "n_experts", 0) > 1:
+        raise NotImplementedError(
+            "weight-only int8 does not cover MoE expert stacks yet")
+
+    def rec(node, name):
+        if name in _QUANT_KERNELS and isinstance(node, dict) \
+                and set(node) == {"kernel"}:
+            q, s = _quantize_kernel(node["kernel"], _QUANT_KERNELS[name])
+            return {"kernel": q, "scale": s}
+        if isinstance(node, dict):
+            return {k: rec(v, k) for k, v in node.items()}
+        return node
+
+    return rec(params, "")

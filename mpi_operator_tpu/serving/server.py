@@ -120,7 +120,28 @@ class InferenceServer:
                  draft_model=None, draft_variables=None,
                  draft_strategy: Optional[str] = None,
                  draft_len: int = 4, prompt_lookup_ngram: int = 3,
-                 kv_prefill_chunk: int = 0):
+                 kv_prefill_chunk: int = 0, weight_dtype: str = "auto"):
+        if weight_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'auto' or 'int8', "
+                f"got {weight_dtype!r}")
+        if weight_dtype == "int8" and \
+                getattr(model.config, "weight_dtype", "auto") != "int8":
+            # Weight-only int8 serving: swap in the quantized model and
+            # quantize the weights up front (models/quant.py) — halves
+            # weight HBM, which is most of what decode streams per step.
+            # NOTE: the caller must drop its own reference to the
+            # full-precision variables, or both copies stay resident
+            # and the halving never lands (see examples/llama_serve.py).
+            import dataclasses
+
+            from ..models.quant import quantize_params
+
+            qcfg = dataclasses.replace(model.config, weight_dtype="int8")
+            model = type(model)(qcfg, mesh=getattr(model, "mesh", None))
+            variables = {**variables,
+                         "params": quantize_params(variables["params"],
+                                                   qcfg)}
         self.model = model
         self.variables = variables
         self.mesh = mesh

@@ -41,10 +41,14 @@ from tools.aot_7b import V5E_HBM_BYTES, _grid  # noqa: E402
 from tools.aot_projections import HBM_BW, PEAK_FLOPS  # noqa: E402
 
 LAYOUTS = {
-    # name: (tp, slots, kv_cache_dtype)
-    "tp4": (4, 8, "auto"),
-    "tp8": (8, 16, "auto"),
-    "tp1-int8": (1, 2, "int8"),
+    # name: (tp, slots, kv_cache_dtype, weight_dtype)
+    "tp4": (4, 8, "auto", "auto"),
+    "tp8": (8, 16, "auto", "auto"),
+    "tp1-int8": (1, 2, "int8", "auto"),
+    # Weight-only int8 (models/quant.py) + int8 KV: the single-chip
+    # flagship — weights drop 12.55 -> ~6.3 GiB, KV halves, so slots
+    # can grow.
+    "tp1-w8kv8": (1, 4, "int8", "int8"),
 }
 
 
@@ -67,7 +71,8 @@ def _cache_specs(cache, P):
 
 
 def analyze_serve(tp: int, slots: int, kv_dtype: str = "auto",
-                  seq: int = 4096, tiny: bool = False) -> dict:
+                  seq: int = 4096, tiny: bool = False,
+                  weight_dtype: str = "auto") -> dict:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
@@ -105,7 +110,7 @@ def analyze_serve(tp: int, slots: int, kv_dtype: str = "auto",
     # Serving dtypes: bf16 weights AND bf16 compute (the training proof
     # keeps f32 params; serving halves the weight bytes).
     base = cfg_fn(max_seq_len=seq, dtype=jnp.bfloat16,
-                  param_dtype=jnp.bfloat16)
+                  param_dtype=jnp.bfloat16, weight_dtype=weight_dtype)
     page = 16
     decode_cfg = dataclasses.replace(base, page_size=page,
                                      kv_cache_dtype=kv_dtype)
@@ -228,6 +233,7 @@ def analyze_serve(tp: int, slots: int, kv_dtype: str = "auto",
         "mesh": {"tp": tp, "devices": n_devices},
         "slots": slots, "seq": seq, "page_size": page,
         "kv_cache_dtype": "bf16" if kv_dtype == "auto" else kv_dtype,
+        "weight_dtype": "bf16" if weight_dtype == "auto" else weight_dtype,
         "weight_shard_bytes_per_chip": int(weight_bytes),
         "kv_pool_bytes_per_chip": int(kv_bytes),
         "decode_peak_bytes_per_chip": decode_peak,
@@ -254,7 +260,7 @@ def analyze_serve(tp: int, slots: int, kv_dtype: str = "auto",
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--layouts", default="tp4,tp8,tp1-int8")
+    ap.add_argument("--layouts", default="tp4,tp8,tp1-int8,tp1-w8kv8")
     ap.add_argument("--tiny", action="store_true")
     ap.add_argument("--seq", type=int, default=4096)
     ap.add_argument("--out", default=os.path.join(REPO,
@@ -280,13 +286,14 @@ def main() -> None:
             f.write("\n")
 
     for name in args.layouts.split(","):
-        tp, slots, kv = LAYOUTS[name]
+        tp, slots, kv, wdt = LAYOUTS[name]
         if args.tiny:
             tp, slots, seq = min(tp, 2), min(slots, 2), 128
         else:
             seq = args.seq
         try:
-            rec = analyze_serve(tp, slots, kv, seq=seq, tiny=args.tiny)
+            rec = analyze_serve(tp, slots, kv, seq=seq, tiny=args.tiny,
+                                weight_dtype=wdt)
         except Exception as exc:  # record OOM verdicts, don't die
             msg = str(exc)
             rec = {"mesh": {"tp": tp}, "slots": slots,
