@@ -76,20 +76,24 @@ def capture_stderr_fd():
 def forbid_full_remat():
     """Fail loudly if XLA emits an involuntary-full-rematerialization
     warning inside the block.  stderr flows through live (teed), so
-    nothing disappears from driver logs even on a mid-run kill."""
-    captured = b""
+    nothing disappears from driver logs even on a mid-run kill.
+
+    The marker scan happens AFTER the capture context closes: its exit
+    restores fd 2 (EOF to the pump) and joins the pump thread, so the
+    buffer is complete — a mid-capture read would race the tee."""
     body_raised = True
-    with capture_stderr_fd() as read:
-        try:
+    try:
+        with capture_stderr_fd() as read:
             yield
             body_raised = False
-        finally:
-            captured = read()
-    if not body_raised and REMAT_MARKER in captured:
-        lines = [ln for ln in captured.decode("utf-8", "replace").splitlines()
-                 if REMAT_MARKER.decode() in ln]
-        raise RuntimeError(
-            "XLA SPMD fell back to involuntary full rematerialization "
-            "(a hidden per-step all-gather of the whole tensor); fix the "
-            "PartitionSpecs or add a with_sharding_constraint.  Warnings:\n"
-            + "\n".join(lines))
+    finally:
+        captured = read()
+        if not body_raised and REMAT_MARKER in captured:
+            lines = [ln for ln in
+                     captured.decode("utf-8", "replace").splitlines()
+                     if REMAT_MARKER.decode() in ln]
+            raise RuntimeError(
+                "XLA SPMD fell back to involuntary full rematerialization "
+                "(a hidden per-step all-gather of the whole tensor); fix "
+                "the PartitionSpecs or add a with_sharding_constraint.  "
+                "Warnings:\n" + "\n".join(lines))

@@ -31,9 +31,19 @@ pytestmark = pytest.mark.skipif(
 
 
 def test_capture_sees_os_level_stderr():
+    import time
+
     with capture_stderr_fd() as read:
         os.write(2, b"raw fd write\n")
+        # The tee pump is a thread: poll briefly for the mid-capture
+        # view (the guard's own scan happens post-close, race-free).
+        deadline = time.monotonic() + 5
+        while b"raw fd write" not in read() \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert b"raw fd write" in read()
+    # Post-close: complete by construction (pump joined on exit).
+    assert b"raw fd write" in read()
 
 
 def test_forbid_full_remat_passes_clean_block():

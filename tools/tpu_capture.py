@@ -255,7 +255,8 @@ _serve_holder: dict = {}
 
 
 def serve_bench(kv_cache_dtype: str = "auto",
-                prefill_chunk: int = 0, long_prompts: bool = False) -> dict:
+                prefill_chunk: int = 0, long_prompts: bool = False,
+                weight_dtype: str = "auto") -> dict:
     import threading
 
     import jax
@@ -283,6 +284,18 @@ def serve_bench(kv_cache_dtype: str = "auto",
     model = _serve_holder["model"]
     cfg = model.config
     variables = _serve_holder["variables"]
+    if weight_dtype == "int8":
+        # Quantize once off the shared full-precision params.
+        if "qmodel" not in _serve_holder:
+            import dataclasses
+
+            from mpi_operator_tpu.models.quant import quantize_params
+            qcfg = dataclasses.replace(cfg, weight_dtype="int8")
+            _serve_holder["qmodel"] = LlamaModel(qcfg)
+            _serve_holder["qvars"] = {
+                "params": quantize_params(variables["params"], qcfg)}
+        model = _serve_holder["qmodel"]
+        variables = _serve_holder["qvars"]
     batcher = ContinuousBatcher(model, variables, max_slots=slots,
                                 page_size=page,
                                 kv_cache_dtype=kv_cache_dtype,
@@ -322,6 +335,7 @@ def serve_bench(kv_cache_dtype: str = "auto",
                 "slots": slots, "prompt_len": prompt_len,
                 "new_tokens": new_tokens, "page_size": page,
                 "kv_cache_dtype": kv_cache_dtype,
+                "weight_dtype": weight_dtype,
                 "prefill_chunk": prefill_chunk,
                 "ttft_cold_s": round(cold, 4), "ttft_warm_s": round(warm, 4),
                 "prefix_hit_blocks": batcher.prefix_stats["hit_blocks"]}
@@ -519,6 +533,10 @@ def main() -> int:
               lambda: serve_bench(long_prompts=True,
                                   prefill_chunk=32 if SMOKE else 256))
     cap.phase("speculative_prompt_lookup", 300, prompt_lookup_bench)
+    # Weight-only int8 A/B vs the 'serve' phase: the decode-roofline
+    # halving measured on the real chip.
+    cap.phase("serve_weight_int8", 400,
+              lambda: serve_bench(weight_dtype="int8"))
     cap.emit({"phase": "done", "remaining_s": round(cap.remaining(), 1)})
     return 0
 
