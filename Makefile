@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
-	bench dryrun clean
+	bench dryrun clean telemetry-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -16,6 +16,11 @@ test-fast: native
 # (reference: e2e vs kind, .github/workflows/main.yml:43-67).
 test-real-cluster:
 	bash tools/run_real_cluster_tier.sh
+
+# Start the operator app, drive a reconcile, scrape /metrics, and
+# assert the telemetry histogram families are present (docs/OBSERVABILITY.md).
+telemetry-smoke:
+	$(PYTHON) tools/telemetry_smoke.py
 
 native:
 	$(MAKE) -C native

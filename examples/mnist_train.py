@@ -33,6 +33,8 @@ def main() -> int:
     from mpi_operator_tpu.parallel.mesh import MeshConfig, batch_sharding, \
         create_mesh
     from mpi_operator_tpu.parallel.train import build_train_step
+    from mpi_operator_tpu.telemetry.goodput import GoodputTracker
+    from mpi_operator_tpu.telemetry.metrics import default_registry
 
     mesh = create_mesh(MeshConfig(dp=-1))
     n_devices = len(jax.devices())
@@ -48,9 +50,10 @@ def main() -> int:
         imgs, lbls = batch
         return cross_entropy_loss(model.apply(params, imgs), lbls)
 
+    goodput = GoodputTracker(registry=default_registry())
     with mesh:
         init_fn, step_fn = build_train_step(loss_fn, optax.adam(args.lr),
-                                            mesh)
+                                            mesh, goodput=goodput)
         state = init_fn(params)
         sharding = batch_sharding(mesh, extra_dims=3)
         images = jax.device_put(images, sharding)
@@ -60,6 +63,10 @@ def main() -> int:
             if jax.process_index() == 0 and step % 10 == 0:
                 print(f"step={step} loss={float(metrics['loss']):.4f}")
     if jax.process_index() == 0:
+        summary = goodput.summary()
+        print(f"goodput={summary['goodput']:.3f}"
+              f" compile_s={summary['seconds']['compile']:.3f}"
+              f" steps_per_s={summary['steps_per_second']:.1f}")
         print(f"done processes={jax.process_count()} devices={n_devices}"
               f" final_loss={float(metrics['loss']):.4f}")
     return 0

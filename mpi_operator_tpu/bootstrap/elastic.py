@@ -15,7 +15,33 @@ import os
 import time
 from typing import Iterator, List, Optional
 
+from ..telemetry.metrics import default_registry
+
 DISCOVER_SCRIPT = "discover_hosts.sh"
+
+
+def _elastic_metrics(registry=None):
+    """Get-or-create the elastic counters on `registry` (default: the
+    process default registry, so they ride any /metrics endpoint the
+    process serves)."""
+    registry = registry or default_registry()
+    return {
+        "resyncs": registry.counter(
+            "elastic_resyncs_total",
+            "Membership changes observed by watch_hosts (world"
+            " re-forms at a checkpoint boundary)"),
+        "restarts": registry.counter(
+            "elastic_restarts_total",
+            "Workload restarts recorded via record_restart()"),
+        "hosts": registry.gauge(
+            "elastic_hosts", "Current discovered host count"),
+    }
+
+
+def record_restart(registry=None) -> None:
+    """Count a workload restart (call at process start when resuming
+    from a checkpoint after preemption/rescheduling)."""
+    _elastic_metrics(registry)["restarts"].inc()
 
 
 def discover_hosts_path() -> Optional[str]:
@@ -49,14 +75,21 @@ def current_hosts(path: Optional[str] = None) -> List[str]:
 
 
 def watch_hosts(path: Optional[str] = None, poll: float = 1.0,
-                stop=None) -> Iterator[List[str]]:
+                stop=None, registry=None) -> Iterator[List[str]]:
     """Yield the host list whenever membership changes (poll-based, like
-    horovodrun's discovery loop).  Yields the initial membership first."""
+    horovodrun's discovery loop).  Yields the initial membership first.
+    Each change after the initial yield counts as an elastic resync."""
     path = path or discover_hosts_path()
+    metrics = _elastic_metrics(registry)
     last: Optional[List[str]] = None
+    first = True
     while stop is None or not stop.is_set():
         hosts = current_hosts(path)
         if hosts != last:
             last = hosts
+            metrics["hosts"].set(len(hosts))
+            if not first:
+                metrics["resyncs"].inc()
+            first = False
             yield hosts
         time.sleep(poll)

@@ -1,0 +1,38 @@
+"""Small cross-version jax shims.
+
+The stack targets current jax, but hermetic CI images may pin older
+releases; everything version-sensitive funnels through here so call
+sites stay on the modern spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """jax.shard_map with the modern ``check_vma`` kwarg, falling back
+    to jax.experimental.shard_map (where the kwarg is ``check_rep``) on
+    jax < 0.6."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def pcast(x, axis_name, to="varying"):
+    """jax.lax.pcast (jax >= 0.7 varying-manual-axes typing).  Older
+    jax has no vma type system, so values inside shard_map are already
+    effectively varying and the cast is the identity."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x
+
+
+def tpu_compiler_params(pltpu, **kwargs):
+    """pltpu.CompilerParams (jax >= 0.6), née TPUCompilerParams."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)

@@ -277,11 +277,31 @@ def new_job_service(job: MPIJob) -> Service:
 # SSH Secret (MPI implementations only)
 # ---------------------------------------------------------------------------
 
-def new_ssh_auth_secret(job: MPIJob) -> Secret:
-    """newSSHAuthSecret (:1442-1477): fresh ECDSA P-521 keypair, private
-    PEM + OpenSSH public key."""
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
+def _generate_ssh_keypair() -> tuple:
+    """Fresh ECDSA P-521 keypair as (private PEM, OpenSSH public key).
+
+    Prefers the cryptography package; falls back to the system
+    ``ssh-keygen`` binary when the package is absent (some images ship
+    OpenSSH tooling but no Python cryptography wheel)."""
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+    except ImportError:
+        import os
+        import subprocess
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmpdir:
+            keyfile = os.path.join(tmpdir, "id_ecdsa")
+            subprocess.run(
+                ["ssh-keygen", "-q", "-t", "ecdsa", "-b", "521", "-N", "",
+                 "-m", "PEM", "-C", "mpi-operator", "-f", keyfile],
+                check=True, capture_output=True)
+            with open(keyfile, "rb") as f:
+                private_pem = f.read()
+            with open(keyfile + ".pub", "rb") as f:
+                public_ssh = f.read().strip()
+        return private_pem, public_ssh
 
     private_key = ec.generate_private_key(ec.SECP521R1())
     private_pem = private_key.private_bytes(
@@ -290,6 +310,13 @@ def new_ssh_auth_secret(job: MPIJob) -> Secret:
         serialization.NoEncryption())
     public_ssh = private_key.public_key().public_bytes(
         serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
+    return private_pem, public_ssh
+
+
+def new_ssh_auth_secret(job: MPIJob) -> Secret:
+    """newSSHAuthSecret (:1442-1477): fresh ECDSA P-521 keypair, private
+    PEM + OpenSSH public key."""
+    private_pem, public_ssh = _generate_ssh_keypair()
 
     return Secret(
         metadata=ObjectMeta(

@@ -26,7 +26,8 @@ from ..k8s.informers import InformerFactory
 from ..k8s.meta import Clock, deep_copy, get_controller_of
 from ..k8s.selectors import match_label_selector
 from ..k8s.workqueue import RateLimitingQueue
-from . import builders, status as status_pkg
+from ..telemetry.trace import span
+from . import builders, metrics as metrics_pkg, status as status_pkg
 from .events import Recorder
 from .metrics import new_operator_metrics
 from .status import (MPI_JOB_EVICT_REASON, MPI_JOB_FAILED_REASON,
@@ -91,6 +92,10 @@ class MPIJobController:
         self.pod_group_ctrl = pod_group_ctrl
         self.recorder = recorder or Recorder(clientset)
         self.metrics = metrics or new_operator_metrics()
+        # Hand-rolled metrics dicts (tests, embedders) may predate the
+        # telemetry histograms; backfill them so the hot-path
+        # instrumentation below never branches.
+        metrics_pkg.backfill_telemetry_metrics(self.metrics)
 
         factory = informer_factory or InformerFactory(clientset, namespace)
         self.factory = factory
@@ -199,8 +204,11 @@ class MPIJobController:
                 return
             if key is None:
                 continue
+            depth = self.metrics.get("workqueue_depth")
+            if depth is not None:
+                depth.observe(len(self.queue))
             try:
-                self.sync_handler(key)
+                self._timed_sync(key)
                 self.queue.forget(key)
             except Exception as exc:  # requeue with backoff
                 if is_conflict(exc):
@@ -212,6 +220,17 @@ class MPIJobController:
                 self.queue.add_rate_limited(key)
             finally:
                 self.queue.done(key)
+
+    def _timed_sync(self, key: str) -> None:
+        """sync_handler wrapped in the reconcile-latency histogram and a
+        trace span (errors land on the span before the requeue path)."""
+        hist = self.metrics.get("reconcile_seconds")
+        with span("reconcile", job=key):
+            if hist is not None:
+                with hist.time():
+                    self.sync_handler(key)
+            else:
+                self.sync_handler(key)
 
     # ------------------------------------------------------------------
     # The sync
@@ -562,6 +581,9 @@ class MPIJobController:
                f" {exit_code(failed[0])}; restarting the worker gang"
                f" (restart {restarts + 1})")
         self.recorder.event(job, core.EVENT_TYPE_NORMAL, "GangRestart", msg)
+        gang_restarts = self.metrics.get("gang_restarts")
+        if gang_restarts is not None:
+            gang_restarts.inc()
         for pod in pods:
             if is_controlled_by(pod, job):
                 try:

@@ -1,102 +1,31 @@
-"""Prometheus-style metrics registry (no external deps).
+"""Operator metric surface — a shim over the shared telemetry registry.
 
 Parity with the reference's metric surface
 (mpi_job_controller.go:125-141, cmd/mpi-operator/main.go:29-40,
 README.md:227-234): jobs created/successful/failed counters,
 mpi_operator_job_info gauge vector, mpi_operator_is_leader gauge, served
-in Prometheus text exposition format.
+in Prometheus text exposition format.  The metric classes themselves now
+live in :mod:`mpi_operator_tpu.telemetry.metrics` (with Histogram and
+labeled vector variants added for the rest of the stack); the names and
+the ``new_operator_metrics()`` dict shape are unchanged.  All value
+reads go through the locked accessors — the original shim read
+``_value`` unlocked in ``expose()``.
 """
 
 from __future__ import annotations
 
-import threading
+from ..telemetry.metrics import (Counter, Gauge, GaugeVec,  # noqa: F401
+                                 Histogram, HistogramVec, Registry)
 
-
-class Counter:
-    def __init__(self, name: str, help_text: str, registry: "Registry"):
-        self.name = name
-        self.help = help_text
-        self._value = 0.0
-        self._lock = threading.Lock()
-        registry._register(self)
-
-    def inc(self, amount: float = 1.0) -> None:
-        with self._lock:
-            self._value += amount
-
-    @property
-    def value(self) -> float:
-        with self._lock:
-            return self._value
-
-    def expose(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n"
-                f"{self.name} {self._value}\n")
-
-
-class Gauge(Counter):
-    def set(self, value: float) -> None:
-        with self._lock:
-            self._value = value
-
-    def expose(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} gauge\n"
-                f"{self.name} {self._value}\n")
-
-
-class GaugeVec:
-    def __init__(self, name: str, help_text: str, label_names: list,
-                 registry: "Registry"):
-        self.name = name
-        self.help = help_text
-        self.label_names = label_names
-        self._values: dict = {}
-        self._lock = threading.Lock()
-        registry._register(self)
-
-    def with_label_values(self, *values) -> "GaugeVec._Child":
-        return GaugeVec._Child(self, tuple(values))
-
-    class _Child:
-        def __init__(self, parent, key):
-            self._parent = parent
-            self._key = key
-
-        def set(self, value: float) -> None:
-            with self._parent._lock:
-                self._parent._values[self._key] = value
-
-    def get(self, *values) -> float:
-        with self._lock:
-            return self._values.get(tuple(values), 0.0)
-
-    def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} gauge"]
-        with self._lock:
-            for key, val in sorted(self._values.items()):
-                labels = ",".join(f'{n}="{v}"'
-                                  for n, v in zip(self.label_names, key))
-                lines.append(f"{self.name}{{{labels}}} {val}")
-        return "\n".join(lines) + "\n"
-
-
-class Registry:
-    def __init__(self):
-        self._metrics: list = []
-
-    def _register(self, metric) -> None:
-        self._metrics.append(metric)
-
-    def expose(self) -> str:
-        return "".join(m.expose() for m in self._metrics)
+# Workqueue depth histogram buckets: the queue is small-integer valued.
+_DEPTH_BUCKETS = (0, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000)
 
 
 def new_operator_metrics(registry: Registry | None = None):
     """The reference's metric set (mpi_job_controller.go:125-141 +
-    main.go:29-40)."""
+    main.go:29-40), extended with the reconcile-latency and
+    workqueue-depth histograms the telemetry subsystem wires through
+    the controller hot path."""
     registry = registry or Registry()
     metrics = {
         "registry": registry,
@@ -114,4 +43,25 @@ def new_operator_metrics(registry: Registry | None = None):
                            "Is this client the leader of this mpi-operator"
                            " client set?", registry),
     }
+    backfill_telemetry_metrics(metrics)
     return metrics
+
+
+def backfill_telemetry_metrics(metrics: dict) -> None:
+    """Ensure a metrics dict carries the telemetry entries the
+    controller hot path observes.  Hand-rolled dicts (tests, embedders)
+    may predate them; get-or-create on the dict's registry keeps the
+    definitions here as the single source of truth."""
+    registry = metrics.get("registry")
+    if registry is None or not hasattr(registry, "histogram"):
+        return
+    metrics.setdefault("reconcile_seconds", registry.histogram(
+        "mpi_operator_reconcile_seconds",
+        "MPIJob reconcile (sync_handler) latency"))
+    metrics.setdefault("workqueue_depth", registry.histogram(
+        "mpi_operator_workqueue_depth",
+        "Workqueue depth observed at each dequeue",
+        buckets=_DEPTH_BUCKETS))
+    metrics.setdefault("gang_restarts", registry.counter(
+        "mpi_operator_gang_restarts_total",
+        "Worker gang restarts triggered by restartPolicy ExitCode"))

@@ -519,9 +519,12 @@ class TokEmbed(nn.Embed):
     mesh: Any = None
 
     def __call__(self, tokens):
+        from flax.linen.dtypes import promote_dtype
+
         table = _constrain(self.embedding, self.mesh, "tp", None)
-        (table,) = self.promote_dtype(table, dtype=self.dtype,
-                                      inexact=False)
+        # flax < 0.10.2 has no Module.promote_dtype method; the
+        # functional form is present across versions.
+        (table,) = promote_dtype(table, dtype=self.dtype, inexact=False)
         return jnp.take(table, tokens, axis=0)
 
 

@@ -24,6 +24,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..compat import tpu_compiler_params
+
 DEFAULT_Q_BLOCK = 512
 DEFAULT_KV_BLOCK = 512
 _MIN_PALLAS_BLOCK = 16
@@ -72,9 +74,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_start + jax.lax.iota(jnp.int32, q_block)
-            kv_pos = kv_start + jax.lax.iota(jnp.int32, kv_block)
-            mask = q_pos[:, None] >= kv_pos[None, :]
+            # 2-D broadcasted_iota: Mosaic rejects rank-1 iota.
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, kv_block), 0)
+            kv_pos = kv_start + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, kv_block), 1)
+            mask = q_pos >= kv_pos
             s = jnp.where(mask, s, _MASK_VALUE)
 
         m_prev = m_ref[:, 0]
@@ -154,7 +159,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, q_block: int,
             pltpu.VMEM((q_block, _STATS_LANES), jnp.float32),  # m
             pltpu.VMEM((q_block, _STATS_LANES), jnp.float32),  # l
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr)
@@ -193,9 +198,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * q_block + jax.lax.iota(jnp.int32, q_block)
-            kv_pos = kj * kv_block + jax.lax.iota(jnp.int32, kv_block)
-            s = jnp.where(q_pos[:, None] >= kv_pos[None, :], s, _MASK_VALUE)
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, kv_block), 0)
+            kv_pos = kj * kv_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, kv_block), 1)
+            s = jnp.where(q_pos >= kv_pos, s, _MASK_VALUE)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -238,9 +245,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * q_block + jax.lax.iota(jnp.int32, q_block)
-            kv_pos = kj * kv_block + jax.lax.iota(jnp.int32, kv_block)
-            s = jnp.where(q_pos[:, None] >= kv_pos[None, :], s, _MASK_VALUE)
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, kv_block), 0)
+            kv_pos = kj * kv_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, kv_block), 1)
+            s = jnp.where(q_pos >= kv_pos, s, _MASK_VALUE)
         p = jnp.exp(s - lse[:, None])                       # [qb, kvb]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -305,7 +314,7 @@ def _flash_backward(q, k, v, out, lse, dout, scale: float, causal: bool,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((q_block, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr, dor, lser, delta)
@@ -326,7 +335,7 @@ def _flash_backward(q, k, v, out, lse, dout, scale: float, causal: bool,
                    jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((kv_block, d), jnp.float32),
                         pltpu.VMEM((kv_block, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr, dor, lser, delta)
@@ -474,6 +483,7 @@ def attention(q, k, v, causal: bool = True, impl: str = "auto",
         batch = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
         heads = "tp" if "tp" in mesh.shape else None
         spec = P(batch if batch else None, None, heads, None)
-        return jax.shard_map(_run, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, check_vma=False)(q, k, v)
+        from ..compat import shard_map
+        return shard_map(_run, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
     return _run(q, k, v)

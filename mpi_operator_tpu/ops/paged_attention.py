@@ -29,6 +29,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..compat import tpu_compiler_params
+
 from .attention import _MASK_VALUE, _STATS_LANES
 
 # f32 sublane multiple: the q group tile is padded up to this many rows
@@ -195,7 +197,7 @@ def _pallas_paged(q, pool_k, pool_v, block_table, lengths, scale,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, gp, d), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(pltpu,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),

@@ -26,6 +26,7 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..compat import pcast, shard_map
 from .attention import (_MASK_VALUE, _MIN_PALLAS_BLOCK, DEFAULT_KV_BLOCK,
                         DEFAULT_Q_BLOCK, _pick_block,
                         flash_attention_with_lse)
@@ -80,7 +81,7 @@ def _ring_body(q, k, v, axis_name: str, scale: float, causal: bool,
     if all_axes:
         # shard_map type system: loop carries must be device-varying like
         # the loop outputs they join (see shard_map scan-vma docs).
-        o0, lse0 = (jax.lax.pcast(x, all_axes, to="varying")
+        o0, lse0 = (pcast(x, all_axes, to="varying")
                     for x in (o0, lse0))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -148,6 +149,6 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
                              all_axes=tuple(mesh.axis_names))
     # check_vma=False: axes the body never touches (e.g. 'ep') are
     # trivially replicated, but the static checker cannot prove it.
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)

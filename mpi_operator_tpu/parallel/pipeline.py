@@ -24,6 +24,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from ..compat import shard_map
+
 
 def stack_stage_params(per_stage_params: list):
     """Stack a list of per-stage param pytrees into leading-axis arrays
@@ -159,7 +161,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
 
     extra = [None] * (microbatches.ndim - 2)
     x_spec = P(None, batch_axes, *extra)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(stage_param_specs(stacked_params, fsdp_dims), x_spec),
         out_specs=x_spec, check_vma=False)
@@ -466,7 +468,7 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
     aux_spec = None
     if aux is not None:
         aux_spec = P(None, batch_axes, *([None] * (aux.ndim - 2)))
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(stage_param_specs(stacked_params, fsdp_dims),
                   jax.tree_util.tree_map(lambda _: rep, head_params),
@@ -895,7 +897,7 @@ def pipeline_interleaved_1f1b(stage_fn: Callable, head_fn: Callable,
     aux_spec = None
     if aux is not None:
         aux_spec = P(None, batch_axes, *([None] * (aux.ndim - 2)))
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(vp_specs(stacked_vp),
                   jax.tree_util.tree_map(lambda _: rep, head_params),

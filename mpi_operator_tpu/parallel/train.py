@@ -44,7 +44,9 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
                      param_specs=None,
                      donate: bool = True,
                      remat: bool = False,
-                     accum_steps: int = 1):
+                     accum_steps: int = 1,
+                     goodput=None,
+                     telemetry_registry=None):
     """Build (init_fn, step_fn).
 
     - loss_fn(params, batch) -> scalar loss (called under jit/mesh).
@@ -61,6 +63,12 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
       accum_steps x (dp*fsdp), enforced at trace time.  Gradients equal
       the full-batch step's exactly (for the usual mean-reduction
       losses) up to f32 reassociation.
+
+    - goodput / telemetry_registry: when either is set, the returned
+      step_fn is wrapped by telemetry.goodput.instrument_step — each
+      call blocks on its outputs and its wall time is attributed to the
+      compile bucket (first call) or the productive bucket + the
+      train_step_seconds histogram (subsequent calls).
 
     step_fn(state, batch) -> (state, metrics) with donated state buffers.
     """
@@ -152,6 +160,10 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
     # the outputs (and the optimizer state inherits them), so no explicit
     # out_shardings are needed — donation keeps buffers in place.
     step_fn = jax.jit(_step, donate_argnums=(0,) if donate else ())
+    if goodput is not None or telemetry_registry is not None:
+        from ..telemetry.goodput import instrument_step
+        step_fn = instrument_step(step_fn, goodput=goodput,
+                                  registry=telemetry_registry)
     return init_fn, step_fn
 
 

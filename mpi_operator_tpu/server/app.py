@@ -43,7 +43,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._respond(200 if healthy else 500,
                           b"ok" if healthy else b"unhealthy")
         elif self.path == "/metrics":
-            body = app.metrics["registry"].expose().encode()
+            from ..telemetry.metrics import expose_with_defaults
+            body = expose_with_defaults(app.metrics["registry"]).encode()
             self._respond(200, body, "text/plain; version=0.0.4")
         elif self.path == "/version":
             self._respond(200, json.dumps(version.info()).encode(),

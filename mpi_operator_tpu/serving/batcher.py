@@ -40,10 +40,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..models.llama import select_rows as _select_rows
+from ..telemetry.metrics import Registry, new_serving_metrics
 
 
 @dataclass
@@ -60,8 +62,23 @@ class _Request:
     error: Optional[Exception] = None
     on_token: Optional[object] = None  # callable(int), streaming hook
     cancelled: threading.Event = field(default_factory=threading.Event)
+    # Telemetry: set at enqueue; emit() attributes TTFT (first token
+    # after submission) and inter-token latency to the serving
+    # histograms.
+    metrics: Optional[dict] = None
+    submitted_at: float = 0.0
+    _last_emit: float = 0.0
 
     def emit(self, token: int) -> None:
+        if self.metrics is not None:
+            now = time.perf_counter()
+            if not self.output and self.submitted_at:
+                self.metrics["ttft_seconds"].observe(
+                    now - self.submitted_at)
+            elif self.output and self._last_emit:
+                self.metrics["token_latency_seconds"].observe(
+                    now - self._last_emit)
+            self._last_emit = now
         self.output.append(token)
         if self.on_token is not None:
             self.on_token(token)
@@ -96,7 +113,8 @@ class ContinuousBatcher:
                  draft_len: int = 4, kv_cache_dtype: str = "auto",
                  draft_strategy: Optional[str] = None,
                  prompt_lookup_ngram: int = 3,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0,
+                 telemetry_registry: Optional[Registry] = None):
         import dataclasses
 
         import jax
@@ -105,6 +123,8 @@ class ContinuousBatcher:
         self.model = model
         self.variables = variables
         self.max_slots = max_slots
+        self.telemetry = new_serving_metrics(telemetry_registry
+                                             or Registry())
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -865,8 +885,11 @@ class ContinuousBatcher:
                        temperature=float(temperature), top_p=float(top_p),
                        top_k=int(top_k), seed=int(seed),
                        on_token=on_token,
-                       stop_tokens=frozenset(map(int, stop_tokens)))
+                       stop_tokens=frozenset(map(int, stop_tokens)),
+                       metrics=self.telemetry,
+                       submitted_at=time.perf_counter())
         self._queue.put(req)
+        self.telemetry["queue_depth"].set(self._queue.qsize())
         return req
 
     def submit(self, tokens: List[int], max_new_tokens: int,
@@ -1023,7 +1046,13 @@ class ContinuousBatcher:
                     req.done.set()
                     self._retire_slot(i)
 
-            if not any(s is not None for s in slots):
+            active_count = sum(1 for s in slots if s is not None)
+            self.telemetry["queue_depth"].set(self._queue.qsize())
+            self.telemetry["active_slots"].set(active_count)
+            if active_count:
+                self.telemetry["batch_size"].observe(active_count)
+
+            if not active_count:
                 if not admitted:
                     # idle: block briefly for work
                     try:

@@ -9,6 +9,10 @@ Serves a Llama-family model's KV-cache generation
     POST /generate {..., "stream": true}   -> text/event-stream (SSE),
       one data event per token, then {"done": true, "tokens": [...]}
     GET /healthz
+    GET /metrics  -> Prometheus text exposition: queue depth, batch
+      size, TTFT and per-token latency histograms (telemetry subsystem)
+      plus the process default registry (train/checkpoint metrics when
+      the same process also trains)
 
 The accelerator is a serial resource behind a per-step device lock;
 with ``max_batch_slots > 0`` concurrent requests share decode ticks via
@@ -21,8 +25,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+from ..telemetry.metrics import (Registry, expose_with_defaults,
+                                 new_serving_metrics)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -42,6 +50,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/healthz":
             self._respond(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            server: "InferenceServer" = self.server.inference  # type: ignore
+            body = expose_with_defaults(server.telemetry_registry).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._respond(404, {"error": "not found"})
 
@@ -120,7 +136,8 @@ class InferenceServer:
                  draft_model=None, draft_variables=None,
                  draft_strategy: Optional[str] = None,
                  draft_len: int = 4, prompt_lookup_ngram: int = 3,
-                 kv_prefill_chunk: int = 0, weight_dtype: str = "auto"):
+                 kv_prefill_chunk: int = 0, weight_dtype: str = "auto",
+                 telemetry_registry: Optional[Registry] = None):
         if weight_dtype not in ("auto", "int8"):
             raise ValueError(
                 f"weight_dtype must be 'auto' or 'int8', "
@@ -166,6 +183,11 @@ class InferenceServer:
                 "params": shard_params(variables["params"], specs, mesh),
             }
         self._lock = threading.Lock()
+        # Serving telemetry (queue depth, batch size, TTFT, per-token
+        # latency) lives on its own registry, scraped at GET /metrics
+        # alongside the process default registry.
+        self.telemetry_registry = telemetry_registry or Registry()
+        self.telemetry = new_serving_metrics(self.telemetry_registry)
         self._http = ThreadingHTTPServer((host, port), _Handler)
         self._http.inference = self  # type: ignore[attr-defined]
         self.port = self._http.server_address[1]
@@ -213,12 +235,29 @@ class InferenceServer:
                                               prompt_lookup_ngram=(
                                                   prompt_lookup_ngram),
                                               prefill_chunk=(
-                                                  kv_prefill_chunk))
+                                                  kv_prefill_chunk),
+                                              telemetry_registry=(
+                                                  self.telemetry_registry))
 
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_p: float = 1.0,
                  seed=None, stop_tokens=(), top_k: int = 0) -> list:
+        # Counted in finally, like stream(): requests_total covers every
+        # request served, successful or not (see new_serving_metrics help).
+        try:
+            with self.telemetry["request_seconds"].time():
+                return self._generate(tokens,
+                                      max_new_tokens=max_new_tokens,
+                                      temperature=temperature, top_p=top_p,
+                                      seed=seed, stop_tokens=stop_tokens,
+                                      top_k=top_k)
+        finally:
+            self.telemetry["requests_total"].inc()
+
+    def _generate(self, tokens, max_new_tokens: int = 16,
+                  temperature: float = 0.0, top_p: float = 1.0,
+                  seed=None, stop_tokens=(), top_k: int = 0) -> list:
         import jax
         import jax.numpy as jnp
 
@@ -294,6 +333,22 @@ class InferenceServer:
         source).  Rides the continuous batcher when enabled; otherwise
         takes the device lock per decode step so slow stream consumers
         never monopolize the accelerator."""
+        start = time.perf_counter()
+        try:
+            yield from self._stream(tokens, max_new_tokens=max_new_tokens,
+                                    temperature=temperature, top_p=top_p,
+                                    seed=seed, stop_tokens=stop_tokens,
+                                    top_k=top_k)
+        finally:
+            # Streaming requests count toward the request-level metrics
+            # too (duration covers the full stream, including aborts).
+            self.telemetry["request_seconds"].observe(
+                time.perf_counter() - start)
+            self.telemetry["requests_total"].inc()
+
+    def _stream(self, tokens, max_new_tokens: int = 16,
+                temperature: float = 0.0, top_p: float = 1.0, seed=None,
+                stop_tokens=(), top_k: int = 0):
         import jax
 
         if hasattr(tokens, "tolist"):  # numpy/jnp arrays, like generate()
@@ -322,6 +377,8 @@ class InferenceServer:
             self.model, self.variables, rows, max_new_tokens,
             temperature=temperature, top_p=top_p, rng=rng,
             stop_tokens=stop_tokens, top_k=top_k)
+        start = time.perf_counter()
+        last = None
         try:
             while True:
                 with self._lock:
@@ -329,6 +386,13 @@ class InferenceServer:
                         tok = next(gen)
                     except StopIteration:
                         return
+                now = time.perf_counter()
+                if last is None:
+                    self.telemetry["ttft_seconds"].observe(now - start)
+                else:
+                    self.telemetry["token_latency_seconds"].observe(
+                        now - last)
+                last = now
                 yield tok
         finally:
             gen.close()

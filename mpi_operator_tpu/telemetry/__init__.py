@@ -1,0 +1,30 @@
+"""Unified telemetry subsystem: metrics, spans, goodput accounting.
+
+Zero-dependency observability for all three layers of the stack
+(SURVEY.md: operator, workload, serving):
+
+- :mod:`.metrics` — shared Prometheus-style registry with Counter /
+  Gauge / Histogram (+ labeled vector variants) and text exposition.
+  ``controller/metrics.py`` is a thin shim over it.
+- :mod:`.trace` — lightweight span API with thread-local parenting,
+  exported as JSONL events or Chrome trace-event format for
+  xprof/perfetto viewing.
+- :mod:`.goodput` — per-step wall-time attribution for train loops
+  (productive vs compile vs data-wait vs checkpoint vs resync) with a
+  goodput-fraction gauge.
+
+Every process has one :func:`default_registry`; per-app registries
+(operator metrics, serving metrics) are exposed *alongside* it via
+:func:`expose_with_defaults`, so workload-side instrumentation
+(train step, checkpoint, elastic) shows up on whichever ``/metrics``
+endpoint the process serves.
+"""
+
+from .metrics import (Counter, CounterVec, Gauge, GaugeVec,  # noqa: F401
+                      Histogram, HistogramVec, Registry,
+                      default_registry, expose_with_defaults,
+                      new_serving_metrics)
+from .trace import (Tracer, default_tracer, read_jsonl, span,  # noqa: F401
+                    to_chrome_trace)
+from .goodput import (GOODPUT_BUCKETS, GoodputTracker,  # noqa: F401
+                      instrument_step)

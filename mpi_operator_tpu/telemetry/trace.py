@@ -1,0 +1,153 @@
+"""Lightweight span tracing (stdlib only).
+
+Horovod's Timeline (arXiv:1802.05799) showed that per-phase timing is
+the prerequisite for every scaling win; the reference operator only
+mentions it as a roadmap idea.  This module is the timeline: nestable
+``with span("reconcile", job=name):`` blocks with thread-local
+parenting, collected as plain dict events that round-trip through JSONL
+and export to Chrome trace-event format (chrome://tracing, perfetto,
+xprof's trace viewer all read it).
+
+Event schema (one JSON object per line in the JSONL export)::
+
+    {"name": str, "span_id": int, "parent_id": int | null,
+     "ts": float wall-clock seconds at start, "dur": float seconds,
+     "pid": int, "tid": int, "attrs": {str: json}, "error": str?}
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Iterable, List, Optional
+
+
+class Tracer:
+    """Collects finished spans into a bounded in-memory buffer."""
+
+    def __init__(self, max_events: int = 65536):
+        self._events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+
+    # -- recording ---------------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Time the enclosed block as a span.  Yields the (mutable)
+        event dict so callers can attach attrs discovered mid-span."""
+        stack = self._stack()
+        event = {
+            "name": name,
+            "span_id": next(self._ids),
+            "parent_id": stack[-1]["span_id"] if stack else None,
+            "ts": time.time(),
+            "dur": 0.0,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "attrs": dict(attrs),
+        }
+        start = time.perf_counter()
+        stack.append(event)
+        try:
+            yield event
+        except BaseException as exc:
+            event["error"] = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            event["dur"] = time.perf_counter() - start
+            stack.pop()
+            with self._lock:
+                self._events.append(event)
+
+    def current_span(self) -> Optional[dict]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    # -- access / export ---------------------------------------------------
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def export_jsonl(self, path_or_file) -> int:
+        """Write one JSON object per line; returns the event count."""
+        events = self.events()
+        if isinstance(path_or_file, (str, os.PathLike)):
+            with open(path_or_file, "w") as f:
+                return self.export_jsonl(f)
+        for event in events:
+            path_or_file.write(json.dumps(event) + "\n")
+        return len(events)
+
+    def export_chrome_trace(self, path_or_file) -> int:
+        events = self.events()
+        payload = to_chrome_trace(events)
+        if isinstance(path_or_file, (str, os.PathLike)):
+            with open(path_or_file, "w") as f:
+                json.dump(payload, f)
+        else:
+            json.dump(payload, path_or_file)
+        return len(events)
+
+
+def read_jsonl(path_or_file) -> List[dict]:
+    """Parse a JSONL span export back into event dicts (blank lines
+    skipped) — the round-trip partner of ``Tracer.export_jsonl``."""
+    if isinstance(path_or_file, (str, os.PathLike)):
+        with open(path_or_file) as f:
+            return read_jsonl(f)
+    if isinstance(path_or_file, (bytes, bytearray)):
+        path_or_file = io.StringIO(path_or_file.decode())
+    return [json.loads(line) for line in path_or_file if line.strip()]
+
+
+def to_chrome_trace(events: Iterable[dict]) -> dict:
+    """Chrome trace-event ("catapult") JSON: complete events (ph=X) with
+    microsecond timestamps, viewable in perfetto / chrome://tracing /
+    xprof's trace viewer."""
+    trace_events = []
+    for e in events:
+        args = dict(e.get("attrs") or {})
+        if e.get("error"):
+            args["error"] = e["error"]
+        if e.get("parent_id") is not None:
+            args["parent_id"] = e["parent_id"]
+        trace_events.append({
+            "name": e["name"],
+            "ph": "X",
+            "ts": e["ts"] * 1e6,
+            "dur": e["dur"] * 1e6,
+            "pid": e.get("pid", 0),
+            "tid": e.get("tid", 0),
+            "cat": "span",
+            "args": args,
+        })
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+_DEFAULT_TRACER = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _DEFAULT_TRACER
+
+
+def span(name: str, **attrs):
+    """``with span("reconcile", job=name):`` on the default tracer."""
+    return _DEFAULT_TRACER.span(name, **attrs)

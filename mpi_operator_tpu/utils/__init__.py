@@ -9,5 +9,5 @@ jax-profiler hook driven by env.
 """
 
 from .checkpoint import (CheckpointManager, latest_step,  # noqa: F401
-                         restore_checkpoint, save_checkpoint)
+                         latest_steps, restore_checkpoint, save_checkpoint)
 from .profiler import maybe_profile  # noqa: F401

@@ -11,7 +11,21 @@ natively (each host writes its shards).
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Optional
+
+from ..telemetry.metrics import default_registry
+from ..telemetry.trace import span
+
+
+def _checkpoint_metrics(registry=None):
+    registry = registry or default_registry()
+    return {
+        "save": registry.histogram(
+            "checkpoint_save_seconds", "Checkpoint save wall time"),
+        "restore": registry.histogram(
+            "checkpoint_restore_seconds", "Checkpoint restore wall time"),
+    }
 
 
 def _checkpointer():
@@ -29,12 +43,18 @@ def save_checkpoint(directory: str, state: Any, step: int,
     import jax
 
     path = _step_dir(directory, step)
-    _checkpointer().save(path, state, force=True)
+    with span("checkpoint_save", step=step), \
+            _checkpoint_metrics()["save"].time():
+        _checkpointer().save(path, state, force=True)
     # Retention: drop oldest beyond `keep` (process 0 only on multi-host).
-    if jax.process_index() == 0:
-        steps = sorted(latest_steps(directory))
+    # keep <= 0 disables GC entirely, and the step just written is never
+    # a deletion candidate even if the directory listing races with
+    # concurrent writers and miscounts.
+    if jax.process_index() == 0 and keep > 0:
+        steps = latest_steps(directory)
         for old in steps[:-keep]:
-            import shutil
+            if old == step:
+                continue
             shutil.rmtree(_step_dir(directory, old), ignore_errors=True)
     return path
 
@@ -66,9 +86,11 @@ def restore_checkpoint(directory: str, target: Any,
     if step is None:
         return target
     import orbax.checkpoint as ocp
-    return _checkpointer().restore(
-        _step_dir(directory, step), item=target,
-        restore_args=ocp.checkpoint_utils.construct_restore_args(target))
+    with span("checkpoint_restore", step=step), \
+            _checkpoint_metrics()["restore"].time():
+        return _checkpointer().restore(
+            _step_dir(directory, step), item=target,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(target))
 
 
 class CheckpointManager:
@@ -79,10 +101,15 @@ class CheckpointManager:
     >>> for ...: state = ...; mgr.maybe_save(state, step)
     """
 
-    def __init__(self, directory: str, every: int = 100, keep: int = 3):
+    def __init__(self, directory: str, every: int = 100, keep: int = 3,
+                 goodput=None):
         self.directory = directory
         self.every = every
         self.keep = keep
+        # Optional telemetry.goodput.GoodputTracker: save time is then
+        # attributed to the checkpoint bucket of the train loop's
+        # goodput summary.
+        self.goodput = goodput
 
     def restore(self, target: Any) -> Any:
         return restore_checkpoint(self.directory, target)
@@ -92,6 +119,10 @@ class CheckpointManager:
 
     def maybe_save(self, state: Any, step: int) -> bool:
         if self.every and step % self.every == 0 and step > 0:
-            save_checkpoint(self.directory, state, step, self.keep)
+            if self.goodput is not None:
+                with self.goodput.checkpoint_save():
+                    save_checkpoint(self.directory, state, step, self.keep)
+            else:
+                save_checkpoint(self.directory, state, step, self.keep)
             return True
         return False

@@ -12,18 +12,26 @@ from __future__ import annotations
 import contextlib
 import os
 
+from ..telemetry.trace import span
+
 
 @contextlib.contextmanager
 def maybe_profile(name: str = "train", env_var: str = "JAX_PROFILE_DIR"):
-    """Profile the enclosed block iff the env var points at a directory."""
+    """Profile the enclosed block iff the env var points at a directory.
+
+    Either way the block is bracketed by a telemetry span, so the
+    profiled (or skipped) region shows up on the process timeline with
+    the trace output directory attached when profiling is active."""
     directory = os.environ.get(env_var)
     if not directory:
-        yield False
+        with span("profile", profile=name, active=False):
+            yield False
         return
     import jax
 
     out = os.path.join(directory,
                        f"{name}-p{jax.process_index()}")
     os.makedirs(out, exist_ok=True)
-    with jax.profiler.trace(out):
-        yield True
+    with span("profile", profile=name, active=True, out=out):
+        with jax.profiler.trace(out):
+            yield True

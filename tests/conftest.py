@@ -15,6 +15,10 @@ import sys
 # selection — drop it so workload subprocesses get a clean CPU backend.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Hermetic tests must never probe the GCP instance-metadata service:
+# off-GCP, libtpu retries each metadata variable 30x against a 403
+# (minutes of stall at the first AOT topology probe).
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -28,7 +32,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS --xla_force_host_platform_device_count fallback above
+    # provides the 8-device CPU mesh there.
+    pass
 
 
 # --- shared serving test helpers ------------------------------------------

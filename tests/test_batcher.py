@@ -999,3 +999,20 @@ def test_chunked_prefill_with_prefix_cache():
     finally:
         ref.stop()
         b.stop()
+
+
+def test_batcher_telemetry_observes_latencies(setup):
+    """Admitted requests must show up in the TTFT / per-token latency /
+    batch-size histograms on the batcher's telemetry registry."""
+    batcher, model, variables = setup
+    ttft_before = batcher.telemetry["ttft_seconds"].count
+    tok_before = batcher.telemetry["token_latency_seconds"].count
+    out = batcher.submit([2, 4, 6], 4)
+    assert len(out) == 4
+    assert batcher.telemetry["ttft_seconds"].count == ttft_before + 1
+    # 4 emitted tokens -> 3 inter-token gaps.
+    assert batcher.telemetry["token_latency_seconds"].count >= tok_before + 3
+    assert batcher.telemetry["batch_size"].count >= 1
+    out_text = batcher.telemetry["registry"].expose()
+    assert "serving_ttft_seconds_bucket" in out_text
+    assert "serving_token_latency_seconds_bucket" in out_text

@@ -93,6 +93,7 @@ def test_e2e_suspend_before_start_then_resume():
                                    timeout=30)
 
 
+@pytest.mark.slow  # multi-process jax.distributed group; minutes
 def test_e2e_jax_pi_process_group():
     """The flagship e2e: a real jax.distributed process group (launcher as
     process 0 + 2 workers on CPU) computes pi with one global allreduce —

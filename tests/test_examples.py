@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO_ROOT, "examples")
 
@@ -27,6 +29,7 @@ def test_mnist_example_runs():
     assert "final_loss=" in out
 
 
+@pytest.mark.slow
 def test_llama_example_tiny_with_tp_and_checkpoint(tmp_path):
     ckpt = str(tmp_path / "ck")
     out = _run("llama_train.py", "--config", "tiny", "--steps", "3",
@@ -102,6 +105,7 @@ def test_bench_llama_smoke():
     assert rec["value"] > 0 and rec["platform"] == "cpu"
 
 
+@pytest.mark.slow
 def test_elastic_resnet50_reforms_world(tmp_path):
     """BASELINE.md tracked config (Elastic Horovod ResNet-50 autoscale):
     the ResNet-50 elastic path saves, re-meshes and restores across a

@@ -11,6 +11,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 from mpi_operator_tpu.api import constants
 from mpi_operator_tpu.server import LocalCluster
@@ -119,6 +120,7 @@ def test_multislice_mesh_rejects_bad_dp():
 
 # --- 2-slice process group on CPU -----------------------------------------
 
+@pytest.mark.slow  # two jax.distributed slices; minutes
 def test_e2e_two_slice_group_forms_on_cpu(tmp_path):
     """Four worker processes in two virtual slices form ONE
     jax.distributed group and allreduce their slice ids — proving the

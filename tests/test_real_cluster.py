@@ -139,9 +139,22 @@ def test_mpijob_crud_roundtrip(cluster):
         assert created.metadata.resource_version
         got = cs.mpi_jobs(_NS).get(name)
         assert got.metadata.uid == created.metadata.uid
-        got.metadata.labels = dict(got.metadata.labels or {},
-                                   tier="real-cluster")
-        updated = cs.mpi_jobs(_NS).update(got)
+        # Conflict-retried (standard client idiom): a live operator may
+        # write status between our get and update, bumping the resource
+        # version out from under us.
+        from mpi_operator_tpu.k8s.apiserver import is_conflict
+        for _ in range(10):
+            got.metadata.labels = dict(got.metadata.labels or {},
+                                       tier="real-cluster")
+            try:
+                updated = cs.mpi_jobs(_NS).update(got)
+                break
+            except Exception as exc:
+                if not is_conflict(exc):
+                    raise
+                got = cs.mpi_jobs(_NS).get(name)
+        else:
+            pytest.fail("update conflicted 10 times")
         assert updated.metadata.resource_version \
             != created.metadata.resource_version
         assert any(j.metadata.name == name

@@ -277,3 +277,21 @@ def test_top_k_sampling_paths():
         assert out == list(map(int, free))
     finally:
         srv.stop()
+
+
+def test_metrics_endpoint_observes_requests(served):
+    """GET /metrics on the inference server exposes the serving
+    histogram families, with request latency observed by /generate."""
+    server, *_ = served
+    before = server.telemetry["request_seconds"].count
+    status, _ = _post(server.url + "/generate",
+                      {"tokens": [[1, 2, 3]], "max_new_tokens": 2})
+    assert status == 200
+    with urllib.request.urlopen(server.url + "/metrics",
+                                timeout=30) as resp:
+        assert resp.status == 200
+        text = resp.read().decode()
+    assert "# TYPE serving_request_seconds histogram" in text
+    assert "serving_ttft_seconds_bucket" in text
+    assert "serving_token_latency_seconds_bucket" in text
+    assert server.telemetry["request_seconds"].count == before + 1

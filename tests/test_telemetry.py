@@ -1,0 +1,422 @@
+"""Telemetry subsystem tests: metrics registry, spans, goodput, and the
+/metrics endpoints on the operator app and the inference server.
+
+The subsystem itself is stdlib-only; these tests exercise it end to end
+through both HTTP scrape surfaces.
+"""
+
+import io
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from mpi_operator_tpu.telemetry.goodput import (GoodputTracker,
+                                                instrument_step)
+from mpi_operator_tpu.telemetry.metrics import (Counter, Gauge, GaugeVec,
+                                                Histogram, HistogramVec,
+                                                Registry, default_registry,
+                                                expose_with_defaults,
+                                                new_serving_metrics)
+from mpi_operator_tpu.telemetry.trace import (Tracer, read_jsonl,
+                                              to_chrome_trace)
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_counter_and_gauge_expose():
+    reg = Registry()
+    c = Counter("jobs_total", "jobs", reg)
+    g = Gauge("depth", "queue depth", reg)
+    c.inc()
+    c.inc(2)
+    g.set(5)
+    g.dec()
+    out = reg.expose()
+    assert "# TYPE jobs_total counter" in out
+    assert "jobs_total 3.0" in out
+    assert "# TYPE depth gauge" in out
+    assert "depth 4.0" in out
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_histogram_buckets_sum_count():
+    h = Histogram("lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(5.555)
+    assert snap["buckets"] == {0.01: 1, 0.1: 2, 1.0: 3}  # cumulative
+    out = h.expose()
+    assert '# TYPE lat_seconds histogram' in out
+    assert 'lat_seconds_bucket{le="0.01"} 1' in out
+    assert 'lat_seconds_bucket{le="1.0"} 3' in out
+    assert 'lat_seconds_bucket{le="+Inf"} 4' in out
+    assert 'lat_seconds_count 4' in out
+
+
+def test_histogram_timer():
+    h = Histogram("t_seconds", "t", buckets=(10.0,))
+    with h.time():
+        pass
+    assert h.count == 1
+    assert h.sum < 10.0
+
+
+def test_gauge_vec_compat_surface():
+    """The controller/metrics.py GaugeVec surface: with_label_values +
+    get, labels rendered sorted and escaped."""
+    reg = Registry()
+    v = GaugeVec("job_info", "info", ["launcher", "namespace"], reg)
+    v.with_label_values("launch-1", "ns\"x").set(1)
+    assert v.get("launch-1", 'ns"x') == 1
+    assert v.get("missing", "ns") == 0.0
+    out = v.expose()
+    assert 'job_info{launcher="launch-1",namespace="ns\\"x"} 1' in out
+    with pytest.raises(ValueError):
+        v.labels("only-one")
+
+
+def test_histogram_vec():
+    reg = Registry()
+    hv = HistogramVec("phase_seconds", "per-phase", ["phase"], reg,
+                      buckets=(1.0, 10.0))
+    hv.labels("prefill").observe(0.5)
+    hv.labels("decode").observe(5.0)
+    out = hv.expose()
+    assert 'phase_seconds_bucket{phase="prefill",le="1.0"} 1' in out
+    assert 'phase_seconds_bucket{phase="decode",le="10.0"} 1' in out
+    assert 'phase_seconds_count{phase="decode"} 1' in out
+
+
+def test_registry_get_or_create_and_duplicates():
+    reg = Registry()
+    a = reg.counter("x_total", "x")
+    assert reg.counter("x_total") is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # same name, different type
+    with pytest.raises(ValueError):
+        reg.register(Counter("x_total", "again"))
+    assert reg.get("x_total") is a
+    assert reg.get("missing") is None
+
+
+def test_registry_thread_safety():
+    reg = Registry()
+    c = reg.counter("n_total", "n")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+
+
+def test_expose_with_defaults_includes_default_registry():
+    app_reg = Registry()
+    app_reg.counter("app_only_total", "app")
+    default_registry().counter("telemetry_test_default_total", "d")
+    out = expose_with_defaults(app_reg)
+    assert "app_only_total" in out
+    assert "telemetry_test_default_total" in out
+    # Default registry alone is not doubled.
+    solo = expose_with_defaults(default_registry())
+    assert solo.count("telemetry_test_default_total 0.0") == 1
+
+
+# -- trace -----------------------------------------------------------------
+
+def test_span_nesting_and_parenting():
+    tr = Tracer()
+    with tr.span("outer", job="ns/a") as outer:
+        assert tr.current_span() is outer
+        with tr.span("inner"):
+            pass
+    events = tr.events()
+    assert [e["name"] for e in events] == ["inner", "outer"]
+    inner, outer = events
+    assert inner["parent_id"] == outer["span_id"]
+    assert outer["parent_id"] is None
+    assert outer["attrs"] == {"job": "ns/a"}
+    assert outer["dur"] >= inner["dur"] >= 0
+
+
+def test_span_records_errors():
+    tr = Tracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("bad")
+    (event,) = tr.events()
+    assert event["error"] == "RuntimeError: bad"
+
+
+def test_span_threads_get_independent_stacks():
+    tr = Tracer()
+    seen = {}
+
+    def worker():
+        with tr.span("child-thread"):
+            seen["parent"] = tr.events()  # nothing finished yet here
+
+    with tr.span("main-thread"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    by_name = {e["name"]: e for e in tr.events()}
+    # The worker's span must NOT be parented to the main thread's span.
+    assert by_name["child-thread"]["parent_id"] is None
+
+
+def test_jsonl_round_trip(tmp_path):
+    tr = Tracer()
+    with tr.span("reconcile", job="default/test"):
+        with tr.span("build_pods"):
+            pass
+    path = tmp_path / "spans.jsonl"
+    n = tr.export_jsonl(str(path))
+    assert n == 2
+    events = read_jsonl(str(path))
+    assert events == tr.events()
+
+
+def test_chrome_trace_export(tmp_path):
+    tr = Tracer()
+    with tr.span("step", idx=3):
+        pass
+    path = tmp_path / "trace.json"
+    tr.export_chrome_trace(str(path))
+    payload = json.loads(path.read_text())
+    (ev,) = payload["traceEvents"]
+    assert ev["ph"] == "X"
+    assert ev["name"] == "step"
+    assert ev["args"]["idx"] == 3
+    assert ev["dur"] >= 0
+    # ts is wall-clock microseconds
+    assert ev["ts"] > 1e15
+
+
+def test_tracer_bounded_buffer():
+    tr = Tracer(max_events=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    events = tr.events()
+    assert len(events) == 4
+    assert events[-1]["name"] == "s9"
+
+
+# -- goodput ---------------------------------------------------------------
+
+def _fake_clock():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    clock.advance = lambda dt: t.__setitem__(0, t[0] + dt)
+    return clock
+
+
+def test_goodput_summary_attributes_synthetic_run():
+    """A synthetic train run: compile, 8 productive steps, data waits,
+    one checkpoint save, one resync — fractions sum to ~1.0."""
+    clock = _fake_clock()
+    reg = Registry()
+    gp = GoodputTracker(registry=reg, clock=clock)
+    with gp.compile():
+        clock.advance(30.0)
+    for _ in range(8):
+        with gp.data_wait():
+            clock.advance(0.5)
+        with gp.step():
+            clock.advance(2.0)
+    with gp.checkpoint_save():
+        clock.advance(4.0)
+    with gp.resync():
+        clock.advance(6.0)
+
+    s = gp.summary()
+    assert s["steps"] == 8
+    assert s["total_seconds"] == pytest.approx(60.0)
+    assert sum(s["fractions"].values()) == pytest.approx(1.0)
+    assert s["goodput"] == pytest.approx(16.0 / 60.0)
+    assert s["fractions"]["compile"] == pytest.approx(0.5)
+    assert s["fractions"]["data_wait"] == pytest.approx(4.0 / 60.0)
+    assert s["fractions"]["checkpoint"] == pytest.approx(4.0 / 60.0)
+    assert s["fractions"]["resync"] == pytest.approx(0.1)
+    # The registry gauge tracks the productive fraction live.
+    assert reg.get("train_goodput_fraction").value == pytest.approx(
+        s["goodput"])
+    # And the step histogram saw every productive step.
+    assert reg.get("train_step_seconds").count == 8
+
+
+def test_goodput_empty_summary():
+    s = GoodputTracker().summary()
+    assert s["total_seconds"] == 0.0
+    assert s["goodput"] == 0.0
+    assert all(f == 0.0 for f in s["fractions"].values())
+
+
+def test_goodput_rejects_unknown_bucket():
+    with pytest.raises(ValueError):
+        GoodputTracker().add("nonsense", 1.0)
+
+
+def test_instrument_step_compile_then_productive():
+    reg = Registry()
+    gp = GoodputTracker(registry=reg)
+    calls = []
+
+    def step_fn(state, batch):
+        calls.append(batch)
+        return state + 1, {"loss": 0.0}
+
+    wrapped = instrument_step(step_fn, goodput=gp, registry=reg)
+    state = 0
+    for i in range(4):
+        state, _ = wrapped(state, i)
+    assert state == 4
+    assert calls == [0, 1, 2, 3]
+    s = gp.summary()
+    assert s["steps"] == 3  # first call attributed to compile
+    assert s["seconds"]["compile"] > 0
+    assert reg.get("train_step_seconds").count == 3
+
+
+# -- serving metric set ----------------------------------------------------
+
+def test_new_serving_metrics_families():
+    reg = Registry()
+    m = new_serving_metrics(reg)
+    # get-or-create: a second caller (the batcher) shares the same set.
+    again = new_serving_metrics(reg)
+    assert again["ttft_seconds"] is m["ttft_seconds"]
+    m["ttft_seconds"].observe(0.2)
+    m["token_latency_seconds"].observe(0.01)
+    m["batch_size"].observe(3)
+    out = reg.expose()
+    for family in ("serving_queue_depth", "serving_active_slots",
+                   "serving_batch_size_bucket", "serving_ttft_seconds_bucket",
+                   "serving_token_latency_seconds_bucket",
+                   "serving_request_seconds_bucket"):
+        assert family in out, family
+
+
+# -- /metrics endpoints ----------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def test_operator_app_metrics_exposes_reconcile_histogram():
+    """GET /metrics on the operator app serves the reconcile-latency
+    histogram family (observed after the controller syncs a job) plus
+    default-registry families like train_step_seconds."""
+    from test_controller import new_mpi_job
+
+    from mpi_operator_tpu.server.app import OperatorApp
+    from mpi_operator_tpu.server.options import ServerOption
+
+    # Train-step instrumentation in the same process rides the default
+    # registry onto the operator's scrape surface.
+    wrapped = instrument_step(lambda x: x, registry=default_registry())
+    wrapped(1)
+    wrapped(2)
+
+    port = _free_port()
+    app = OperatorApp(ServerOption(healthz_port=port,
+                                   monitoring_port=port)).start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and app.controller is None:
+            time.sleep(0.02)
+        assert app.controller is not None
+        app.client.mpi_jobs("default").create(new_mpi_job(name="telem"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                app.metrics["reconcile_seconds"].count == 0:
+            time.sleep(0.05)
+        status, body = _get(f"http://127.0.0.1:{port}/metrics")
+    finally:
+        app.stop()
+    assert status == 200
+    text = body.decode()
+    assert "# TYPE mpi_operator_reconcile_seconds histogram" in text
+    assert "mpi_operator_reconcile_seconds_bucket" in text
+    assert "mpi_operator_workqueue_depth_bucket" in text
+    assert "mpi_operator_gang_restarts_total" in text
+    assert "train_step_seconds_bucket" in text
+    # The sync actually ran, so the histogram has observations.
+    count_line = [l for l in text.splitlines()
+                  if l.startswith("mpi_operator_reconcile_seconds_count")]
+    assert count_line and float(count_line[0].split()[1]) >= 1
+
+
+def test_inference_server_metrics_endpoint():
+    """GET /metrics on the serving server exposes TTFT / per-token
+    latency histogram families (plus default-registry families) without
+    requiring a model to be loaded."""
+    from mpi_operator_tpu.serving.server import InferenceServer
+
+    server = InferenceServer(object(), {"params": {}},
+                             host="127.0.0.1").start()
+    try:
+        server.telemetry["ttft_seconds"].observe(0.12)
+        server.telemetry["token_latency_seconds"].observe(0.004)
+        status, body = _get(server.url + "/metrics")
+    finally:
+        server.stop()
+    assert status == 200
+    text = body.decode()
+    assert "# TYPE serving_ttft_seconds histogram" in text
+    assert 'serving_ttft_seconds_bucket' in text
+    assert "serving_token_latency_seconds_bucket" in text
+    assert "serving_batch_size" in text
+    assert "train_step_seconds_bucket" in text  # default registry rides along
+    assert "serving_ttft_seconds_count 1" in text
+
+
+# -- elastic counters ------------------------------------------------------
+
+def test_watch_hosts_counts_resyncs(tmp_path):
+    from mpi_operator_tpu.bootstrap import elastic
+
+    reg = Registry()
+    script = tmp_path / "discover_hosts.sh"
+    script.write_text("#!/bin/sh\necho worker-0\necho worker-1\n")
+    it = elastic.watch_hosts(str(script), poll=0.0, registry=reg)
+    assert next(it) == ["worker-0", "worker-1"]
+    assert reg.counter("elastic_resyncs_total").value == 0
+    assert reg.gauge("elastic_hosts").value == 2
+    script.write_text("#!/bin/sh\necho worker-0\n")
+    assert next(it) == ["worker-0"]
+    assert reg.counter("elastic_resyncs_total").value == 1
+    assert reg.gauge("elastic_hosts").value == 1
+    it.close()
+
+
+def test_record_restart_counter():
+    from mpi_operator_tpu.bootstrap import elastic
+
+    reg = Registry()
+    elastic.record_restart(reg)
+    elastic.record_restart(reg)
+    assert reg.counter("elastic_restarts_total").value == 2

@@ -72,6 +72,139 @@ def test_restore_without_checkpoint_is_noop(tmp_path):
     assert restored is state
 
 
+def _stub_checkpointer(monkeypatch):
+    """Replace the orbax checkpointer with a directory-touching stub so
+    retention logic is testable without materializing real state."""
+    from mpi_operator_tpu.utils import checkpoint as ckpt
+
+    class _Stub:
+        def save(self, path, state, force=False):
+            os.makedirs(path, exist_ok=True)
+
+    monkeypatch.setattr(ckpt, "_checkpointer", _Stub)
+
+
+def test_latest_steps_parsing(tmp_path):
+    from mpi_operator_tpu.utils.checkpoint import latest_step, latest_steps
+
+    assert latest_steps(str(tmp_path / "missing")) == []
+    assert latest_step(str(tmp_path / "missing")) is None
+    for name in ("step_00000003", "step_00000010", "step_badnum",
+                 "unrelated", "step_"):
+        (tmp_path / name).mkdir()
+    assert latest_steps(str(tmp_path)) == [3, 10]
+    assert latest_step(str(tmp_path)) == 10
+
+
+def test_retention_keeps_newest(tmp_path, monkeypatch):
+    from mpi_operator_tpu.utils.checkpoint import (latest_steps,
+                                                   save_checkpoint)
+
+    _stub_checkpointer(monkeypatch)
+    directory = str(tmp_path)
+    for step in (1, 2, 3, 4):
+        save_checkpoint(directory, state=None, step=step, keep=2)
+    assert latest_steps(directory) == [3, 4]
+
+
+def test_retention_disabled_for_nonpositive_keep(tmp_path, monkeypatch):
+    """keep <= 0 must disable GC, not delete everything (steps[:-0]
+    would slice the whole list in the naive formulation)."""
+    from mpi_operator_tpu.utils.checkpoint import (latest_steps,
+                                                   save_checkpoint)
+
+    _stub_checkpointer(monkeypatch)
+    directory = str(tmp_path)
+    for keep in (0, -1):
+        for step in (1, 2, 3):
+            save_checkpoint(directory, state=None, step=step, keep=keep)
+        assert latest_steps(directory) == [1, 2, 3]
+
+
+def test_retention_never_deletes_step_just_written(tmp_path, monkeypatch):
+    """A racing writer can make the just-written step land in the
+    deletion window (it is not the newest in the listing); it must
+    survive regardless."""
+    from mpi_operator_tpu.utils.checkpoint import (latest_steps,
+                                                   save_checkpoint)
+
+    _stub_checkpointer(monkeypatch)
+    directory = str(tmp_path)
+    # Steps 5 and 9 already exist (the "9" simulating a concurrent
+    # writer); saving step 7 with keep=1 puts 7 in the GC window.
+    for pre in (5, 9):
+        (tmp_path / f"step_{pre:08d}").mkdir()
+    save_checkpoint(directory, state=None, step=7, keep=1)
+    steps = latest_steps(directory)
+    assert 7 in steps  # just-written step survived
+    assert 5 not in steps  # normal retention still ran
+
+
+def test_checkpoint_save_records_telemetry(tmp_path, monkeypatch):
+    from mpi_operator_tpu.telemetry.metrics import default_registry
+    from mpi_operator_tpu.telemetry.trace import default_tracer
+    from mpi_operator_tpu.utils.checkpoint import save_checkpoint
+
+    _stub_checkpointer(monkeypatch)
+    hist = default_registry().histogram("checkpoint_save_seconds")
+    before = hist.count
+    save_checkpoint(str(tmp_path), state=None, step=1)
+    assert hist.count == before + 1
+    names = [e["name"] for e in default_tracer().events()]
+    assert "checkpoint_save" in names
+
+
+def test_checkpoint_manager_goodput_attribution(tmp_path, monkeypatch):
+    from mpi_operator_tpu.telemetry.goodput import GoodputTracker
+    from mpi_operator_tpu.utils.checkpoint import CheckpointManager
+
+    _stub_checkpointer(monkeypatch)
+    gp = GoodputTracker()
+    mgr = CheckpointManager(str(tmp_path), every=2, keep=2, goodput=gp)
+    assert not mgr.maybe_save(None, 1)
+    assert mgr.maybe_save(None, 2)
+    assert gp.summary()["seconds"]["checkpoint"] > 0
+
+
+def test_maybe_profile_noop_without_env(monkeypatch):
+    """No env var -> no jax import required, no trace, span still
+    recorded on the default tracer."""
+    from mpi_operator_tpu.telemetry.trace import default_tracer
+
+    monkeypatch.delenv("JAX_PROFILE_DIR", raising=False)
+    with maybe_profile("noop-test") as active:
+        assert active is False
+    spans = [e for e in default_tracer().events()
+             if e["name"] == "profile"
+             and e["attrs"].get("profile") == "noop-test"]
+    assert spans and spans[-1]["attrs"]["active"] is False
+
+
+def test_maybe_profile_creates_directory_with_stubbed_trace(tmp_path,
+                                                            monkeypatch):
+    """Directory-creation path with jax.profiler.trace stubbed out: the
+    per-process output dir is created and the stub sees it."""
+    import contextlib
+
+    import jax
+
+    seen = {}
+
+    @contextlib.contextmanager
+    def fake_trace(out):
+        seen["out"] = out
+        yield
+
+    monkeypatch.setenv("JAX_PROFILE_DIR", str(tmp_path / "prof"))
+    monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+    with maybe_profile("unit") as active:
+        assert active is True
+    expected = os.path.join(str(tmp_path / "prof"),
+                            f"unit-p{jax.process_index()}")
+    assert seen["out"] == expected
+    assert os.path.isdir(expected)
+
+
 def test_maybe_profile_disabled_and_enabled(tmp_path, monkeypatch):
     monkeypatch.delenv("JAX_PROFILE_DIR", raising=False)
     with maybe_profile("t") as active:
