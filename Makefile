@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
-	bench dryrun clean telemetry-smoke
+	bench dryrun clean telemetry-smoke chaos-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -21,6 +21,13 @@ test-real-cluster:
 # assert the telemetry histogram families are present (docs/OBSERVABILITY.md).
 telemetry-smoke:
 	$(PYTHON) tools/telemetry_smoke.py
+
+# Deterministic multi-fault chaos plan (pod kill + watch 410 + apiserver
+# error burst + preemption notice) against the full local cluster, run
+# twice: converges with all invariants green and reproduces an identical
+# fault/event log (docs/RESILIENCE.md).
+chaos-smoke:
+	$(PYTHON) tools/chaos_smoke.py
 
 native:
 	$(MAKE) -C native

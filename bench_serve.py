@@ -179,7 +179,13 @@ def _prompt_lookup_phase(jax, slots: int, page: int) -> dict:
                            "(run tools/train_induction.py)"}
     cfg = induction_config()
     model = LlamaModel(cfg)
-    variables = {"params": load_params(ckpt)}
+    # read_provenance verifies the sidecar (sha256 + training git hash)
+    # and raises on drift — the bench's "honest induction-capable
+    # target" claim is anchored to a recorded training run, not to
+    # whatever bytes happen to be on disk.
+    from tools.train_induction import read_provenance
+    provenance = read_provenance(ckpt)
+    variables = {"params": load_params(ckpt, verify=False)}
 
     new_tokens = int(os.environ.get("BENCH_SERVE_PL_NEW_TOKENS", "48"))
     draft_len = int(os.environ.get("BENCH_SERVE_PL_DRAFT_LEN", "8"))
@@ -218,6 +224,10 @@ def _prompt_lookup_phase(jax, slots: int, page: int) -> dict:
         "strategy": "prompt_lookup",
         "target": "induction model (tools/train_induction.py, "
                   "98k params, fp32)",
+        "target_provenance": {
+            "sha256": provenance.get("sha256", "")[:16],
+            "git_hash": provenance.get("git_hash", "")[:12],
+            "eval": provenance.get("eval", {}).get("value")},
         "workload": f"{len(prompts)} repetitive-context requests "
                     f"(tiled period-4..8 patterns), {new_tokens} tokens",
         "draft_len": draft_len,

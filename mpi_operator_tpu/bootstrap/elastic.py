@@ -35,6 +35,11 @@ def _elastic_metrics(registry=None):
             "Workload restarts recorded via record_restart()"),
         "hosts": registry.gauge(
             "elastic_hosts", "Current discovered host count"),
+        "read_errors": registry.counter(
+            "elastic_read_errors_total",
+            "discover_hosts.sh reads that failed (partition /"
+            " volume refresh in flight); membership is held, not"
+            " flapped to empty"),
     }
 
 
@@ -57,12 +62,15 @@ def discover_hosts_path() -> Optional[str]:
     return legacy if os.path.exists(legacy) else None
 
 
-def current_hosts(path: Optional[str] = None) -> List[str]:
-    """Parse the script's `echo <fqdn>` lines into a host list."""
-    path = path or discover_hosts_path()
+def _read_hosts(path: Optional[str]) -> Optional[List[str]]:
+    """Parse the script, or None when it cannot be read at all — the
+    distinction watch_hosts needs: an *empty* script is a legitimate
+    zero-member world (the controller wrote it), an *unreadable* one is
+    a partition / mid-refresh volume and says nothing about
+    membership."""
     if path is None:
-        return []
-    hosts = []
+        return None
+    hosts: List[str] = []
     try:
         with open(path) as f:
             for line in f:
@@ -70,22 +78,47 @@ def current_hosts(path: Optional[str] = None) -> List[str]:
                 if line.startswith("echo "):
                     hosts.append(line[len("echo "):].strip())
     except OSError:
-        return []
+        return None
     return hosts
+
+
+def current_hosts(path: Optional[str] = None) -> List[str]:
+    """Parse the script's `echo <fqdn>` lines into a host list."""
+    return _read_hosts(path or discover_hosts_path()) or []
 
 
 def watch_hosts(path: Optional[str] = None, poll: float = 1.0,
                 stop=None, registry=None) -> Iterator[List[str]]:
     """Yield the host list whenever membership changes (poll-based, like
     horovodrun's discovery loop).  Yields the initial membership first.
-    Each change after the initial yield counts as an elastic resync."""
-    path = path or discover_hosts_path()
+    Each change after the initial yield counts as an elastic resync.
+
+    Partition-tolerant: a failed read (script unreadable — control
+    plane partitioned, ConfigMap volume mid-refresh) HOLDS the last
+    known membership instead of yielding [].  Flapping to empty would
+    tear the world down at the next checkpoint boundary and re-form it
+    when the partition heals — two full gang restarts for a fault that
+    changed nothing (counted in elastic_read_errors_total instead)."""
+    explicit_path = path
     metrics = _elastic_metrics(registry)
     last: Optional[List[str]] = None
     first = True
     while stop is None or not stop.is_set():
-        hosts = current_hosts(path)
-        if hosts != last:
+        # Re-resolve each poll when not pinned: the mount may appear
+        # after startup (kubelet materializes volumes asynchronously).
+        current = explicit_path or discover_hosts_path()
+        if current is None:
+            # No channel at all (no mount, no explicit path): a
+            # legitimate empty world, not a read failure.
+            hosts: Optional[List[str]] = []
+        else:
+            hosts = _read_hosts(current)
+            if hosts is None:
+                # Unreadable channel = partition, even on the FIRST
+                # poll (a worker restarting mid-partition must wait for
+                # a successful read, not boot into an empty world).
+                metrics["read_errors"].inc()
+        if hosts is not None and hosts != last:
             last = hosts
             metrics["hosts"].set(len(hosts))
             if not first:

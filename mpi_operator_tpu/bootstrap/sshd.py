@@ -26,12 +26,33 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 from ctypes import byref, c_void_p, create_string_buffer
 from typing import Optional
 
 from . import libssh as L
 
 logger = logging.getLogger("mpi_operator_tpu.bootstrap.sshd")
+
+
+def parse_chaos_spec(spec: str) -> tuple:
+    """Parse the CHAOS_SSHD env knob into (drop_first_n, delay_s).
+
+    ``drop:N`` refuses the first N connections (flaky daemon mid-
+    restart), ``slow:S`` sleeps S seconds before serving each session
+    (overloaded node); comma-combine: ``drop:2,slow:0.5``.  Invalid
+    parts are ignored — chaos must never break a production start."""
+    drop, delay = 0, 0.0
+    for part in (spec or "").split(","):
+        key, _, val = part.strip().partition(":")
+        try:
+            if key == "drop":
+                drop = int(val)
+            elif key == "slow":
+                delay = float(val)
+        except ValueError:
+            continue
+    return drop, delay
 
 
 class SSHServer:
@@ -57,6 +78,14 @@ class SSHServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._conn_threads: list = []
+        # Chaos knobs (docs/RESILIENCE.md): the rsh tree must tolerate a
+        # daemon that is briefly flaky (drops early connections) or slow
+        # (delayed key exchange) — mpirun retries rsh; the agent's
+        # connect loop owns the backoff.
+        self._chaos_drop, self._chaos_delay = parse_chaos_spec(
+            os.environ.get("CHAOS_SSHD", ""))
+        self._chaos_seen = 0
+        self._chaos_lock = threading.Lock()
 
     @staticmethod
     def _generate_host_key():
@@ -137,6 +166,15 @@ class SSHServer:
 
     def _serve_session(self, session) -> None:
         try:
+            with self._chaos_lock:
+                self._chaos_seen += 1
+                seen = self._chaos_seen
+            if seen <= self._chaos_drop:
+                logger.info("chaos: dropping connection %d/%d", seen,
+                            self._chaos_drop)
+                return  # finally disconnects; the client retries
+            if self._chaos_delay > 0:
+                time.sleep(self._chaos_delay)
             if L.lib.ssh_handle_key_exchange(session) != L.SSH_OK:
                 logger.warning("kex failed: %s", L.session_error(session))
                 return

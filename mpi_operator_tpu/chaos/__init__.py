@@ -1,0 +1,36 @@
+"""Deterministic fault-injection for the hermetic simulation stack.
+
+The reference operator's entire value proposition is surviving cluster
+entropy — pod failure, watch-stream 410s, partial gangs, apiserver
+brown-outs — yet incidental unit tests only ever exercise the faults
+someone thought to hand-write.  This package drives the in-process
+simulation stack (runtime/kubelet, k8s/apiserver + kube_transport,
+controller, bootstrap) through *scripted and seeded-random* fault plans
+while invariant checkers assert the system converges.
+
+Three parts:
+
+- ``plan``: the fault-plan spec (`Fault`, `FaultPlan`) with JSON
+  round-trip (a recorded fault log replays as a plan) and deterministic
+  seeded randomized-plan generation.
+- ``injectors``: the injector registry — pod kill, preemption notice,
+  watch-stream 410/relist, apiserver error/latency bursts, full
+  control-plane partition — implemented against chaos hooks on the sim
+  layers (`ApiServer.fault_injector`, `LocalKubelet.kill_pod` /
+  `inject_preemption`, `ApiServer.relist_watches`).
+- ``engine``: `ChaosEngine` / `run()` — executes a plan against a
+  `LocalCluster`-shaped system with a seeded RNG, emits a JSONL
+  fault/event log (wired into telemetry spans), waits for convergence
+  and evaluates invariants (`invariants` module).
+
+See docs/RESILIENCE.md for the fault taxonomy, the invariants, and the
+seed-replay workflow.
+"""
+
+from .engine import ChaosEngine, ChaosReport, run  # noqa: F401
+from .injectors import INJECTORS, register_injector  # noqa: F401
+from .invariants import (DEFAULT_INVARIANTS, checkpoint_intact,  # noqa: F401
+                         gang_restarts_bounded, jobs_converged,
+                         no_leaked_pod_ips, no_orphaned_pods,
+                         no_orphaned_runners, workqueue_idle)
+from .plan import Fault, FaultPlan, randomized_plan  # noqa: F401

@@ -1,0 +1,258 @@
+"""Scenario runner: execute a FaultPlan against a live system, log every
+fault deterministically, wait for convergence, assert invariants.
+
+Determinism contract: the *canonical* fault/event log (seq, kind,
+resolved target, params, result — no wall timestamps) of a scripted
+plan is identical across runs, and a randomized plan derives entirely
+from its seed — so any failing soak replays as
+``ChaosEngine(system, FaultPlan.from_events(report.events))``.
+
+Telemetry: the run and each injection are traced as spans on the
+default tracer (`chaos_run` / `chaos_fault`), so chaos activity lands
+in the same JSONL/Chrome exports as reconcile and train-step spans
+(docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from ..telemetry.trace import span
+from .injectors import INJECTORS, ApiFaultBank
+from .invariants import DEFAULT_INVARIANTS
+from .plan import FaultPlan
+
+# Event-log fields that must reproduce across runs of the same plan;
+# wall-clock fields (ts) are excluded by construction.
+CANONICAL_FIELDS = ("seq", "event", "at", "kind", "target",
+                    "resolved_target", "duration", "params", "result")
+
+
+@dataclass
+class ChaosReport:
+    plan_name: str
+    seed: Optional[int]
+    events: List[dict] = field(default_factory=list)
+    violations: List[str] = field(default_factory=list)
+    converged: bool = True
+    elapsed: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.converged and not self.violations
+
+    def canonical_log(self) -> List[dict]:
+        """The reproducible view of the event log (no timestamps)."""
+        return [{k: ev[k] for k in CANONICAL_FIELDS if k in ev}
+                for ev in self.events]
+
+    def export_jsonl(self, path_or_file) -> int:
+        """One JSON object per line: a header, then every event, then
+        the verdict — the artifact a failing seed is replayed from."""
+        if isinstance(path_or_file, (str, os.PathLike)):
+            with open(path_or_file, "w") as f:
+                return self.export_jsonl(f)
+        header = {"event": "plan", "name": self.plan_name,
+                  "seed": self.seed}
+        path_or_file.write(json.dumps(header) + "\n")
+        for ev in self.events:
+            path_or_file.write(json.dumps(ev) + "\n")
+        path_or_file.write(json.dumps(
+            {"event": "verdict", "converged": self.converged,
+             "violations": self.violations,
+             "elapsed": round(self.elapsed, 3)}) + "\n")
+        return len(self.events) + 2
+
+
+class ChaosEngine:
+    """Drives one plan against one system (LocalCluster-shaped).
+
+    The engine installs an `ApiFaultBank` as the apiserver's fault
+    injector for the scenario's lifetime; its own thread (and any
+    thread registered via `exempt_thread`) bypasses injected faults so
+    target resolution and invariant checks observe the true state.
+    """
+
+    def __init__(self, system, plan: FaultPlan,
+                 seed: Optional[int] = None):
+        self.system = system
+        self.plan = plan
+        self.seed = plan.seed if seed is None else seed
+        self.rng = random.Random(self.seed)
+        # The bank rolls probabilities from arbitrary client threads;
+        # giving it its own stream keeps the engine's target picks
+        # deterministic regardless of API-call interleaving.
+        self.bank = ApiFaultBank(random.Random(
+            0 if self.seed is None else self.seed ^ 0x5EED))
+        self.events: List[dict] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._pending_result: Optional[dict] = None
+        self._heals: dict = {}
+
+    # -- event log ---------------------------------------------------------
+    def _log(self, event: dict) -> dict:
+        with self._lock:
+            event["seq"] = self._seq
+            self._seq += 1
+            event["ts"] = round(time.time(), 6)
+            self.events.append(event)
+            return event
+
+    def log_result(self, fault, resolved_target: str = "",
+                   result: str = "") -> None:
+        """Called by injectors to attach the resolved target and outcome
+        to the inject event being logged."""
+        if self._pending_result is not None:
+            self._pending_result["resolved_target"] = resolved_target
+            self._pending_result["result"] = result
+
+    # -- execution ---------------------------------------------------------
+    @property
+    def server(self):
+        return self.system.client.server
+
+    def exempt_thread(self) -> None:
+        self.bank.exempt_current_thread()
+
+    def run(self, converge: Optional[Callable[[], bool]] = None,
+            timeout: float = 30.0,
+            invariants: Sequence[Callable] = DEFAULT_INVARIANTS,
+            settle: float = 10.0) -> ChaosReport:
+        report = ChaosReport(plan_name=self.plan.name, seed=self.seed)
+        self.bank.exempt_current_thread()
+        prior_injector = getattr(self.server, "fault_injector", None)
+        supports_bank = hasattr(self.server, "fault_injector")
+        if supports_bank:
+            self.server.fault_injector = self.bank
+        start = time.monotonic()
+        try:
+            with span("chaos_run", plan=self.plan.name, seed=self.seed):
+                self._execute_timeline(start)
+                report.converged = self._wait_converged(
+                    converge, start, timeout)
+                report.violations = self._check_invariants(
+                    invariants, settle)
+        finally:
+            self.bank.clear()
+            if supports_bank:
+                self.server.fault_injector = prior_injector
+            report.events = self.events
+            report.elapsed = time.monotonic() - start
+        return report
+
+    def _execute_timeline(self, start: float) -> None:
+        # (offset, order, action): inject steps carry order 0, heals 1,
+        # so a zero-duration burst still injects before it heals.
+        timeline = []
+        for fault in self.plan.sorted_faults():
+            timeline.append((fault.at, 0, "inject", fault))
+            if fault.duration > 0:
+                timeline.append((fault.at + fault.duration, 1, "heal",
+                                 fault))
+        timeline.sort(key=lambda t: (t[0], t[1]))
+        for offset, _, action, fault in timeline:
+            delay = start + offset - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            if action == "inject":
+                self._apply(fault)
+                continue
+            heal = self._heals.pop(id(fault), None)
+            if heal is not None:
+                heal()
+            self._log({"event": "heal", "at": fault.at + fault.duration,
+                       "kind": fault.kind,
+                       "target": fault.target})
+        # Durable faults whose plan never scheduled a heal (duration
+        # left at 0) heal at timeline end: convergence and invariants
+        # judge the healed system, and the heal is in the log — a rule
+        # silently leaking into teardown would time out convergence for
+        # what the plan spec calls an instantaneous fault.
+        for fault in self.plan.sorted_faults():
+            heal = self._heals.pop(id(fault), None)
+            if heal is None:
+                continue
+            heal()
+            self._log({"event": "heal", "at": fault.at,
+                       "kind": fault.kind, "target": fault.target})
+
+    def _apply(self, fault) -> None:
+        injector = INJECTORS.get(fault.kind)
+        event = {"event": "inject", "at": fault.at, "kind": fault.kind,
+                 "target": fault.target, "duration": fault.duration,
+                 "params": dict(fault.params)}
+        if injector is None:
+            event["result"] = "unknown-kind"
+            self._log(event)
+            return
+        self._pending_result = event
+        try:
+            with span("chaos_fault", kind=fault.kind,
+                      target=fault.target):
+                heal = injector(self, fault)
+        except Exception as exc:
+            event["result"] = f"injector-error: {exc}"
+            heal = None
+        finally:
+            self._pending_result = None
+        self._log(event)
+        if heal is not None:
+            self._heals[id(fault)] = heal
+
+    def _wait_converged(self, converge, start: float,
+                        timeout: float) -> bool:
+        if converge is None:
+            return True
+        deadline = start + timeout
+        while time.monotonic() < deadline:
+            try:
+                if converge():
+                    self._log({"event": "converged", "at": None,
+                               "kind": "", "target": "",
+                               "result": "ok"})
+                    return True
+            except Exception:
+                pass  # predicate raced a transient state; retry
+            time.sleep(0.1)
+        self._log({"event": "converged", "at": None, "kind": "",
+                   "target": "", "result": "timeout"})
+        return False
+
+    def _check_invariants(self, invariants, settle: float) -> List[str]:
+        """Poll failing invariants for the settle window (most are
+        eventual); whatever still fails is a violation."""
+        deadline = time.monotonic() + settle
+        per: dict = {}
+        while True:
+            per = {}
+            for check in invariants:
+                try:
+                    per[check.__name__] = check(self.system)
+                except Exception as exc:
+                    per[check.__name__] = [
+                        f"invariant {check.__name__} errored: {exc}"]
+            if not any(per.values()) or time.monotonic() >= deadline:
+                break
+            time.sleep(0.2)
+        for check in invariants:
+            self._log({"event": "invariant", "at": None,
+                       "kind": check.__name__, "target": "",
+                       "result": "violated" if per.get(check.__name__)
+                       else "ok"})
+        return [f for v in per.values() for f in v]
+
+
+def run(plan: FaultPlan, system, converge=None, timeout: float = 30.0,
+        invariants: Sequence[Callable] = DEFAULT_INVARIANTS,
+        settle: float = 10.0, seed: Optional[int] = None) -> ChaosReport:
+    """One-call form: ``chaos.run(plan, system)``."""
+    return ChaosEngine(system, plan, seed=seed).run(
+        converge=converge, timeout=timeout, invariants=invariants,
+        settle=settle)

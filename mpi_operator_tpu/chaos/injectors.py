@@ -1,0 +1,263 @@
+"""Fault injectors — the registry mapping `Fault.kind` to an action
+against the simulation stack's chaos hooks.
+
+An injector is ``fn(ctx, fault) -> Optional[heal]``: it applies the
+fault through `ctx` (engine context: the system under test, the seeded
+RNG, the apiserver fault bank, the event log) and returns a heal
+callable when the fault is durable (the engine calls it at
+``fault.at + fault.duration``).  Injectors RESOLVE loose targets (an
+empty ``target`` means "pick one with the scenario RNG, from sorted
+candidates") and record the resolution in the event log, so a recorded
+run replays exactly (`FaultPlan.from_events`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..k8s.apiserver import ApiError
+
+INJECTORS: Dict[str, Callable] = {}
+
+
+def register_injector(name: str):
+    def deco(fn):
+        INJECTORS[name] = fn
+        return fn
+    return deco
+
+
+# Verbs an error burst hits by default.  ``watch`` is deliberately
+# excluded: in-process consumers open their streams once at startup and
+# never re-dial, so failing the verb would wedge rather than exercise
+# anything — stream loss is modelled by `relist_watches` instead.
+DEFAULT_FAULT_VERBS = ("create", "get", "list", "update", "delete")
+
+
+class ApiFaultBank:
+    """The single `ApiServer.fault_injector` slot, multiplexed.
+
+    Rules (error probability, latency) are added/removed by injectors;
+    every apiserver verb consults the active set.  Calls from exempt
+    threads (the chaos engine itself, invariant checkers) bypass the
+    bank so the scenario's own observations are never faulted.
+    """
+
+    def __init__(self, rng):
+        self._rules: dict = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._rng = rng
+        self._exempt: set = set()
+
+    def exempt_current_thread(self) -> None:
+        self._exempt.add(threading.get_ident())
+
+    def add_rule(self, verbs=DEFAULT_FAULT_VERBS, kinds=None,
+                 code: Optional[str] = None, probability: float = 1.0,
+                 latency: float = 0.0) -> int:
+        with self._lock:
+            rule_id = self._next_id
+            self._next_id += 1
+            self._rules[rule_id] = {
+                "verbs": tuple(verbs), "kinds": tuple(kinds or ()),
+                "code": code, "probability": float(probability),
+                "latency": float(latency)}
+            return rule_id
+
+    def remove_rule(self, rule_id: int) -> None:
+        with self._lock:
+            self._rules.pop(rule_id, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def __call__(self, verb: str, api_version: str, kind: str,
+                 namespace: str, name: str) -> None:
+        if threading.get_ident() in self._exempt:
+            return
+        with self._lock:
+            rules = list(self._rules.values())
+        for rule in rules:
+            if verb not in rule["verbs"]:
+                continue
+            if rule["kinds"] and kind not in rule["kinds"]:
+                continue
+            if rule["probability"] < 1.0:
+                with self._lock:
+                    roll = self._rng.random()
+                if roll >= rule["probability"]:
+                    continue
+            if rule["latency"] > 0:
+                time.sleep(rule["latency"])
+            if rule["code"]:
+                raise ApiError(rule["code"],
+                               f"chaos: injected {rule['code']} on "
+                               f"{verb} {kind} {namespace}/{name}")
+
+
+def _resolve_pod(ctx, fault, running_only: bool = True) -> Optional[tuple]:
+    """(namespace, name) for a pod fault: an explicit "ns/name" target,
+    or an RNG pick over the sorted live candidates (workers preferred —
+    they are the gang-repair surface; launchers only when nothing else
+    runs)."""
+    if fault.target:
+        ns, _, name = fault.target.partition("/")
+        return (ns, name) if name else ("default", ns)
+    from ..k8s import core
+    pods = [p for p in ctx.server.list("v1", "Pod")
+            if not running_only or p.status.phase == core.POD_RUNNING]
+    workers = [p for p in pods
+               if p.metadata.labels.get(
+                   "training.kubeflow.org/job-role") == "worker"]
+    candidates = sorted(workers or pods,
+                        key=lambda p: (p.metadata.namespace,
+                                       p.metadata.name))
+    if not candidates:
+        return None
+    pick = ctx.rng.choice(candidates)
+    return (pick.metadata.namespace, pick.metadata.name)
+
+
+def _wait_live_process(ctx, target, timeout: float) -> bool:
+    """Block (bounded) until the target pod has a live container
+    process.  Scripted plans use ``params["wait"]`` so a fault aimed at
+    a pod that is being recreated (mid gang-restart) lands
+    deterministically instead of racing the kubelet — the race would
+    make the fault log's result field differ across runs."""
+    deadline = time.monotonic() + timeout
+    kubelet = ctx.system.kubelet
+    while time.monotonic() < deadline:
+        with kubelet._lock:
+            runner = kubelet._runners.get(tuple(target))
+        proc = runner.proc if runner is not None else None
+        if proc is not None and proc.poll() is None:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@register_injector("pod_kill")
+def inject_pod_kill(ctx, fault):
+    """Kill the container process (node crash / OOM): the kubelet
+    reflects a signal death (128+signum) and restart/gang policy takes
+    over."""
+    target = _resolve_pod(ctx, fault)
+    if target is None:
+        ctx.log_result(fault, resolved_target="", result="no-candidate")
+        return None
+    wait = float(fault.params.get("wait", 0))
+    if wait > 0:
+        _wait_live_process(ctx, target, wait)
+    sig = int(fault.params.get("signal", 9))
+    ok = ctx.system.kubelet.kill_pod(*target, sig=sig)
+    ctx.log_result(fault, resolved_target="/".join(target),
+                   result="killed" if ok else "no-process")
+    return None
+
+
+@register_injector("pod_delete")
+def inject_pod_delete(ctx, fault):
+    """Delete the pod object through the API (eviction/drain analogue):
+    exercises the controller's recreate path and the kubelet's DELETED
+    handling."""
+    target = _resolve_pod(ctx, fault)
+    if target is None:
+        ctx.log_result(fault, resolved_target="", result="no-candidate")
+        return None
+    try:
+        ctx.system.client.pods(target[0]).delete(target[1])
+        result = "deleted"
+    except Exception as exc:
+        result = f"error: {exc}"
+    ctx.log_result(fault, resolved_target="/".join(target), result=result)
+    return None
+
+
+@register_injector("preempt")
+def inject_preempt(ctx, fault):
+    """Spot/preemption notice with a grace window: touch the pod's
+    K_PREEMPTION_NOTICE_FILE, SIGTERM after ``grace`` seconds.
+    Preemption-aware workloads checkpoint-then-exit inside the window
+    (parallel/train.run_train_loop)."""
+    target = _resolve_pod(ctx, fault)
+    if target is None:
+        ctx.log_result(fault, resolved_target="", result="no-candidate")
+        return None
+    wait = float(fault.params.get("wait", 0))
+    if wait > 0:
+        _wait_live_process(ctx, target, wait)
+    grace = float(fault.params.get("grace", 1.0))
+    ok = ctx.system.kubelet.inject_preemption(*target, grace=grace)
+    ctx.log_result(fault, resolved_target="/".join(target),
+                   result="noticed" if ok else "no-runner")
+    return None
+
+
+@register_injector("watch_relist")
+def inject_watch_relist(ctx, fault):
+    """Watch-stream continuity loss (disconnect + 410 Expired resume):
+    every live stream on the kind receives the RELIST sentinel and must
+    reconcile against a fresh list."""
+    api_version = kind = None
+    if fault.target:
+        api_version, _, kind = fault.target.partition(" ")
+    n = ctx.server.relist_watches(api_version or None, kind or None)
+    # resolved_target mirrors the selector verbatim (empty = every
+    # stream): FaultPlan.from_events copies it back into target, so a
+    # replayed log must hit the same streams, not a '*' placeholder
+    # that would parse as a (nonexistent) group-version.
+    ctx.log_result(fault, resolved_target=fault.target,
+                   result=f"signalled {n} streams")
+    return None
+
+
+@register_injector("api_error_burst")
+def inject_api_error_burst(ctx, fault):
+    """Apiserver brown-out: verbs fail with an ApiError (default
+    Unavailable) at ``probability`` until healed.  Controllers must
+    requeue with backoff and converge after the heal."""
+    rule = ctx.bank.add_rule(
+        verbs=tuple(fault.params.get("verbs", DEFAULT_FAULT_VERBS)),
+        kinds=tuple(fault.params.get("kinds", ())),
+        code=fault.params.get("code", "Unavailable"),
+        probability=float(fault.params.get("probability", 1.0)))
+    ctx.log_result(fault, resolved_target="apiserver", result="burst-on")
+
+    def heal():
+        ctx.bank.remove_rule(rule)
+    return heal
+
+
+@register_injector("api_latency")
+def inject_api_latency(ctx, fault):
+    """Apiserver latency: every matching verb sleeps ``latency``
+    seconds before serving (outside the store lock — only the caller
+    stalls)."""
+    rule = ctx.bank.add_rule(
+        verbs=tuple(fault.params.get("verbs", DEFAULT_FAULT_VERBS)),
+        kinds=tuple(fault.params.get("kinds", ())),
+        code=None,
+        latency=float(fault.params.get("latency", 0.05)))
+    ctx.log_result(fault, resolved_target="apiserver", result="latency-on")
+
+    def heal():
+        ctx.bank.remove_rule(rule)
+    return heal
+
+
+@register_injector("api_partition")
+def inject_api_partition(ctx, fault):
+    """Full control-plane partition: every verb from every component
+    fails until healed.  The system must hold state (no flapping to
+    empty membership, no abandoned status writes) and reconverge."""
+    rule = ctx.bank.add_rule(
+        verbs=DEFAULT_FAULT_VERBS, code="Unavailable", probability=1.0)
+    ctx.log_result(fault, resolved_target="apiserver", result="partitioned")
+
+    def heal():
+        ctx.bank.remove_rule(rule)
+    return heal

@@ -1,0 +1,123 @@
+"""Invariant checkers — what must stay true no matter which faults fire.
+
+Each checker is ``fn(system) -> list[str]`` (empty = holds).  ``system``
+is LocalCluster-shaped: ``.client`` (Clientset), ``.kubelet``
+(LocalKubelet or None), ``.controller`` (MPIJobController).  The engine
+polls failing checkers for a settle window before declaring a violation
+— most invariants are *eventual* (a deleted pod's runner takes a beat
+to stop).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from ..api import constants
+from ..k8s import core
+
+
+def no_orphaned_runners(system) -> List[str]:
+    """Every kubelet runner (subprocess) belongs to a live pod object;
+    a runner without a pod is a leaked process."""
+    if system.kubelet is None:
+        return []
+    live = {(p.metadata.namespace, p.metadata.name)
+            for p in system.client.server.list("v1", "Pod")}
+    with system.kubelet._lock:
+        runners = list(system.kubelet._runners)
+    return [f"kubelet runner {ns}/{name} has no live pod object"
+            for (ns, name) in runners if (ns, name) not in live]
+
+
+def no_leaked_pod_ips(system) -> List[str]:
+    """netsim address claims are released when pods go away."""
+    if system.kubelet is None:
+        return []
+    live = {(p.metadata.namespace, p.metadata.name)
+            for p in system.client.server.list("v1", "Pod")}
+    with system.kubelet._lock:
+        claims = dict(system.kubelet._pod_ips)
+    return [f"netsim address {ip} still claimed by dead pod {owner}"
+            for ip, owner in claims.items() if owner not in live]
+
+
+def no_orphaned_pods(system) -> List[str]:
+    """Every controller-owned pod's owner still exists (GC keeps up);
+    an orphan survives its owner only transiently."""
+    out = []
+    jobs = {j.metadata.uid for j in
+            system.client.server.list("batch/v1", "Job")}
+    mpi_jobs = {j.metadata.uid
+                for j in system.client.server.list(
+                    "kubeflow.org/v2beta1", "MPIJob")}
+    known = jobs | mpi_jobs
+    for pod in system.client.server.list("v1", "Pod"):
+        for ref in pod.metadata.owner_references:
+            if ref.controller and ref.kind in ("Job", "MPIJob") \
+                    and ref.uid not in known:
+                out.append(
+                    f"pod {pod.metadata.namespace}/{pod.metadata.name} "
+                    f"orphaned: owner {ref.kind} uid {ref.uid} gone")
+    return out
+
+
+def gang_restarts_bounded(system) -> List[str]:
+    """Gang restarts never exceed runPolicy.backoffLimit (the annotation
+    counter the controller maintains for restartPolicy=ExitCode)."""
+    out = []
+    for job in system.client.server.list("kubeflow.org/v2beta1", "MPIJob"):
+        limit = job.spec.run_policy.backoff_limit
+        if limit is None:
+            continue
+        restarts = int((job.metadata.annotations or {}).get(
+            constants.GANG_RESTART_COUNT_ANNOTATION, "0"))
+        if restarts > limit:
+            out.append(f"MPIJob {job.metadata.name}: {restarts} gang "
+                       f"restarts > backoffLimit {limit}")
+    return out
+
+
+def jobs_converged(system) -> List[str]:
+    """Every MPIJob reaches a terminal state (Succeeded/Failed) or is
+    (back) Running — never wedged in between."""
+    out = []
+    settled = (constants.JOB_SUCCEEDED, constants.JOB_FAILED,
+               constants.JOB_RUNNING, constants.JOB_SUSPENDED)
+    for job in system.client.server.list("kubeflow.org/v2beta1", "MPIJob"):
+        conds = {c.type: c.status for c in job.status.conditions}
+        if not any(conds.get(t) == core.CONDITION_TRUE for t in settled):
+            out.append(f"MPIJob {job.metadata.name} neither terminal nor "
+                       f"running (conditions: {conds})")
+    return out
+
+
+def workqueue_idle(system) -> List[str]:
+    """The controller workqueue drains once the cluster is quiet."""
+    depth = len(system.controller.queue)
+    return [f"controller workqueue still holds {depth} keys"] \
+        if depth else []
+
+
+DEFAULT_INVARIANTS = (no_orphaned_runners, no_leaked_pod_ips,
+                      no_orphaned_pods, gang_restarts_bounded,
+                      jobs_converged, workqueue_idle)
+
+
+def checkpoint_intact(directory: str) -> List[str]:
+    """Standalone checker for scenarios with checkpointing workloads:
+    every retained step directory is non-empty (a torn save must never
+    be left looking restorable — orbax writes are atomic-by-rename, so
+    an empty or file-less step dir means corruption)."""
+    from ..utils import checkpoint as ckpt
+
+    out = []
+    steps = ckpt.latest_steps(directory)
+    if not steps:
+        return [f"no checkpoint steps under {directory}"]
+    for step in steps:
+        step_dir = os.path.join(directory, f"step_{step:08d}")
+        has_files = any(files for _, _, files in os.walk(step_dir))
+        if not has_files:
+            out.append(f"checkpoint step {step} is empty ({step_dir})")
+    return out

@@ -1,0 +1,138 @@
+"""Fault-plan spec: what to break, when, and for how long.
+
+A plan is a list of `Fault`s ordered by their offset from scenario
+start.  Plans are plain data — JSON round-trip is exact, so a recorded
+fault log (`ChaosReport.export_jsonl`) can be turned back into a plan
+and replayed (`FaultPlan.from_events`), which is how a failing
+randomized soak becomes a deterministic regression test.
+
+`randomized_plan(seed, ...)` derives a plan from a seed alone
+(`random.Random(seed)`, no wall-clock anywhere), so the same seed always
+yields the same plan on any machine.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Fault:
+    """One scheduled fault.
+
+    - ``at``: seconds after scenario start.
+    - ``kind``: injector name (see `injectors.INJECTORS`).
+    - ``target``: injector-specific selector — a "namespace/name" pod
+      for pod faults, an "apiVersion Kind" for watch faults, empty for
+      cluster-wide faults (the injector may then pick a target with the
+      scenario RNG and record the choice in the event log).
+    - ``duration``: seconds the fault stays active; the engine heals
+      durable faults at ``at + duration``.  0 means instantaneous —
+      for durable kinds (api_*) a 0-duration fault is healed at
+      timeline end, before convergence is judged.
+    - ``params``: injector-specific knobs (error code, probability,
+      latency, signal, grace period...).
+    """
+
+    at: float
+    kind: str
+    target: str = ""
+    duration: float = 0.0
+    params: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Fault":
+        return cls(at=float(data["at"]), kind=data["kind"],
+                   target=data.get("target", ""),
+                   duration=float(data.get("duration", 0.0)),
+                   params=dict(data.get("params", {})))
+
+
+@dataclass
+class FaultPlan:
+    name: str
+    faults: List[Fault] = field(default_factory=list)
+    seed: Optional[int] = None
+
+    def sorted_faults(self) -> List[Fault]:
+        """Stable order the engine executes in: by offset, then by the
+        plan's own ordering (stable sort) so ties are deterministic."""
+        return sorted(self.faults, key=lambda f: f.at)
+
+    # -- JSON round-trip ---------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "name": self.name, "seed": self.seed,
+            "faults": [f.to_dict() for f in self.faults]}, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        data = json.loads(text)
+        return cls(name=data["name"], seed=data.get("seed"),
+                   faults=[Fault.from_dict(f) for f in data["faults"]])
+
+    @classmethod
+    def from_events(cls, events: List[dict], name: str = "replay",
+                    seed: Optional[int] = None) -> "FaultPlan":
+        """Rebuild a plan from a recorded fault/event log (the JSONL a
+        `ChaosReport` exports): every ``inject`` event becomes a fault
+        at its recorded plan offset, with the *resolved* target (so a
+        random pick replays against the exact pod it hit)."""
+        faults = []
+        for ev in events:
+            if ev.get("event") != "inject":
+                continue
+            faults.append(Fault(
+                at=float(ev.get("at", 0.0)), kind=ev["kind"],
+                target=ev.get("resolved_target") or ev.get("target", ""),
+                duration=float(ev.get("duration", 0.0)),
+                params=dict(ev.get("params", {}))))
+        return cls(name=name, seed=seed, faults=faults)
+
+
+# Kinds eligible for randomized soaks (instantaneous or self-healing;
+# params chosen inside safe ranges by `randomized_plan`).
+RANDOMIZABLE_KINDS = ("pod_kill", "pod_delete", "preempt", "watch_relist",
+                      "api_error_burst", "api_latency", "api_partition")
+
+
+def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
+                    kinds=RANDOMIZABLE_KINDS,
+                    name: Optional[str] = None) -> FaultPlan:
+    """Derive a fault plan from a seed — same seed, same plan, always.
+
+    Targets are left empty: the injectors resolve them against live
+    cluster state with the scenario RNG and record the resolution in
+    the event log, so a failing run replays via `FaultPlan.from_events`.
+    """
+    rng = random.Random(seed)
+    faults = []
+    for _ in range(n_faults):
+        kind = rng.choice(list(kinds))
+        at = round(rng.uniform(0.2, horizon), 3)
+        fault = Fault(at=at, kind=kind)
+        if kind == "pod_kill":
+            fault.params = {"signal": rng.choice([9, 15])}
+        elif kind == "preempt":
+            fault.params = {"grace": round(rng.uniform(0.2, 1.0), 3)}
+        elif kind == "api_error_burst":
+            fault.duration = round(rng.uniform(0.3, 1.5), 3)
+            fault.params = {"code": rng.choice(["Unavailable", "Timeout"]),
+                            "probability": round(rng.uniform(0.3, 1.0), 3)}
+        elif kind == "api_latency":
+            fault.duration = round(rng.uniform(0.3, 1.0), 3)
+            fault.params = {"latency": round(rng.uniform(0.01, 0.1), 3)}
+        elif kind == "api_partition":
+            fault.duration = round(rng.uniform(0.2, 0.8), 3)
+        elif kind == "watch_relist":
+            fault.target = rng.choice(["v1 Pod", "batch/v1 Job",
+                                       "kubeflow.org/v2beta1 MPIJob"])
+        faults.append(fault)
+    return FaultPlan(name=name or f"randomized-{seed}", seed=seed,
+                     faults=faults)

@@ -141,9 +141,17 @@ class MPIJobController:
         self.enqueue(obj)
 
     def enqueue(self, job) -> None:
-        """enqueueMPIJob (:1247-1255)."""
-        self.queue.add_rate_limited(
-            f"{job.metadata.namespace}/{job.metadata.name}")
+        """enqueueMPIJob (:1247-1255).
+
+        Diverges from the reference deliberately: the reference calls
+        AddRateLimited here, which counts every watch event as a
+        *failure* in the per-item exponential limiter — during an
+        apiserver error burst the event storm (status churn, pod
+        flapping) inflates the backoff toward its 1000s cap even though
+        no sync failed, so recovery after the burst heals is delayed by
+        minutes.  Event-driven adds go through the plain dedup'd queue;
+        only actual sync errors (_run_worker) pay the failure backoff."""
+        self.queue.add(f"{job.metadata.namespace}/{job.metadata.name}")
 
     def handle_object(self, obj) -> None:
         """handleObject (:1262-1312): find the owning MPIJob and enqueue

@@ -137,6 +137,37 @@ class ApiServer:
         # window (_purged_rv[gvk]+1 .. _rv] is fully replayable.
         self._history: dict = {}
         self._purged_rv: dict = {}
+        # Chaos hook (chaos/injectors.py): called before every verb with
+        # (verb, api_version, kind, namespace, name); may raise ApiError
+        # (error burst) or sleep (latency).  Called OUTSIDE the store
+        # lock so an injected delay stalls only the calling client, not
+        # the whole apiserver.  None = production no-op.
+        self.fault_injector = None
+
+    def _inject(self, verb: str, api_version: str, kind: str,
+                namespace: str = "", name: str = "") -> None:
+        hook = self.fault_injector
+        if hook is not None:
+            hook(verb, api_version, kind, namespace, name)
+
+    def relist_watches(self, api_version: Optional[str] = None,
+                       kind: Optional[str] = None) -> int:
+        """Chaos hook: simulate every live watch stream on the kind (or
+        all kinds) losing replay continuity — each consumer receives the
+        RELIST sentinel (the client-side contract after a 410 Expired)
+        and must reconcile against a fresh list.  Returns the number of
+        streams signalled."""
+        with self._lock:
+            hit = []
+            for (gv, k), watches in self._watches.items():
+                if api_version is not None and gv != api_version:
+                    continue
+                if kind is not None and k != kind:
+                    continue
+                hit.extend(watches)
+        for w in hit:
+            w._send(WatchEvent(RELIST, None))
+        return len(hit)
 
     # -- helpers ----------------------------------------------------------
     def _gvk(self, obj) -> tuple:
@@ -175,6 +206,8 @@ class ApiServer:
 
     # -- verbs ------------------------------------------------------------
     def create(self, obj):
+        self._inject("create", obj.api_version, obj.kind,
+                     obj.metadata.namespace, obj.metadata.name)
         with self._lock:
             gvk = self._gvk(obj)
             obj = deep_copy(obj)
@@ -216,6 +249,7 @@ class ApiServer:
                    for b in self._store.values() for o in b.values())
 
     def get(self, api_version: str, kind: str, namespace: str, name: str):
+        self._inject("get", api_version, kind, namespace, name)
         with self._lock:
             bucket = self._bucket((api_version, kind))
             obj = bucket.get((namespace, name))
@@ -225,6 +259,7 @@ class ApiServer:
 
     def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
              label_selector: Optional[dict] = None) -> list:
+        self._inject("list", api_version, kind, namespace or "")
         with self._lock:
             out = []
             for (ns, _), obj in sorted(self._bucket((api_version, kind)).items()):
@@ -235,6 +270,8 @@ class ApiServer:
             return out
 
     def update(self, obj, subresource: str = ""):
+        self._inject("update", obj.api_version, obj.kind,
+                     obj.metadata.namespace, obj.metadata.name)
         with self._lock:
             gvk = self._gvk(obj)
             obj = deep_copy(obj)
@@ -269,6 +306,7 @@ class ApiServer:
             return deep_copy(obj)
 
     def delete(self, api_version: str, kind: str, namespace: str, name: str):
+        self._inject("delete", api_version, kind, namespace, name)
         with self._lock:
             bucket = self._bucket((api_version, kind))
             obj = bucket.pop((namespace, name), None)

@@ -144,6 +144,12 @@ class _Handler(BaseHTTPRequestHandler):
                 ev = watch.next(timeout=0.5)
                 if ev is None:
                     chunk = b": keepalive\n"
+                elif ev.obj is None:
+                    # RELIST sentinel (chaos relist_watches: the stream
+                    # lost continuity); forwarded verbatim — the client
+                    # must reconcile against a fresh list.
+                    chunk = (json.dumps(
+                        {"type": ev.type, "object": None}) + "\n").encode()
                 else:
                     chunk = (json.dumps(
                         {"type": ev.type,
@@ -218,8 +224,10 @@ class _RemoteWatch:
                     if not line or line.startswith(b":"):
                         continue
                     data = json.loads(line)
-                    self._q.put(WatchEvent(data["type"],
-                                           registry.decode(data["object"])))
+                    obj = data.get("object")
+                    self._q.put(WatchEvent(
+                        data["type"],
+                        registry.decode(obj) if obj is not None else None))
             except Exception:
                 pass  # connection lost/timed out; fall through to reconnect
             finally:

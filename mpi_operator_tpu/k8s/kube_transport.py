@@ -689,6 +689,22 @@ class _FixtureHandler(BaseHTTPRequestHandler):
         self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
         self.wfile.flush()
 
+    def _write_410_and_end(self, message: str) -> None:
+        """The kube wire contract for a lost watch: ONE ERROR event
+        carrying a 410 Status, then a clean stream end — the client
+        must relist (single-sourced for both the expired-RV and the
+        chaos-RELIST paths)."""
+        try:
+            self._write_chunk((json.dumps({
+                "type": "ERROR",
+                "object": {"kind": "Status", "apiVersion": "v1",
+                           "metadata": {}, "status": "Failure",
+                           "message": message, "reason": "Expired",
+                           "code": 410}}) + "\n").encode())
+            self._write_chunk(b"")  # terminal chunk: clean end
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+
     def _stream_watch(self, route: _Route, query) -> None:
         import time as _time
         self.server.watch_requests += 1  # type: ignore[attr-defined]
@@ -708,16 +724,7 @@ class _FixtureHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
-            try:
-                self._write_chunk((json.dumps({
-                    "type": "ERROR",
-                    "object": {"kind": "Status", "apiVersion": "v1",
-                               "metadata": {}, "status": "Failure",
-                               "message": exc.message, "reason": "Expired",
-                               "code": 410}}) + "\n").encode())
-                self._write_chunk(b"")  # terminal chunk: clean end
-            except (BrokenPipeError, ConnectionResetError, OSError):
-                pass
+            self._write_410_and_end(exc.message)
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -742,6 +749,14 @@ class _FixtureHandler(BaseHTTPRequestHandler):
                         self._write_chunk(b": keepalive\n")
                         last_write = _time.monotonic()
                     continue
+                if ev.type == "RELIST":
+                    # Chaos (ApiServer.relist_watches): the store stream
+                    # lost continuity.  Over the wire that is a 410
+                    # ERROR event + stream end — the real client then
+                    # runs its genuine relist path (_KubeWatch ERROR
+                    # branch), not a simulated shortcut.
+                    self._write_410_and_end("watch history expired")
+                    break
                 if route.namespace and \
                         ev.obj.metadata.namespace != route.namespace:
                     continue

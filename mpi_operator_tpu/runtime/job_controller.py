@@ -79,7 +79,15 @@ class JobController:
     # -- reconcile ---------------------------------------------------------
     def sync_all(self) -> None:
         for job in self.client.server.list("batch/v1", "Job", self.namespace):
-            self.sync_job(job)
+            try:
+                self.sync_job(job)
+            except Exception as exc:
+                # Isolate per-job failures (apiserver error bursts,
+                # conflicts): one job's bad sync must not starve every
+                # job behind it in the list until the next resync.
+                logger.warning("sync of job %s/%s failed: %s",
+                               job.metadata.namespace, job.metadata.name,
+                               exc)
 
     def _job_pods(self, job: batch.Job) -> list:
         pods = self.client.server.list("v1", "Pod", job.metadata.namespace)

@@ -57,6 +57,8 @@ class _PodRunner:
             prefix=f"pod-{self.namespace}-{self.pod_name}-",
             dir=kubelet.root_dir)
         self.log_path = os.path.join(self.sandbox, "container.log")
+        self.preemption_notice_path = os.path.join(self.sandbox,
+                                                   "preemption.notice")
         self.proc: Optional[subprocess.Popen] = None
         self.restart_count = 0
         self.stopped = threading.Event()
@@ -138,6 +140,11 @@ class _PodRunner:
         env["K_POD_NAME"] = self.pod_name
         env["K_POD_NAMESPACE"] = self.namespace
         env["K_SANDBOX_DIR"] = self.sandbox
+        # Preemption notice channel (the local stand-in for the GCE
+        # metadata preemption event / SIGTERM grace window): chaos (or a
+        # node drainer) touches this file; preemption-aware workloads
+        # (parallel/train.run_train_loop) checkpoint-then-exit on it.
+        env["K_PREEMPTION_NOTICE_FILE"] = self.preemption_notice_path
 
         for ev in container.env:
             env[ev.name] = self.kubelet.resolve_env_value(ev.value)
@@ -187,6 +194,14 @@ class _PodRunner:
         env = self._build_env(volume_dirs)
 
         while not self.stopped.is_set():
+            # A preemption notice is per-incarnation: an in-place
+            # restart (Always/OnFailure) must start clean, or the
+            # replacement would see the stale notice and exit again —
+            # an infinite checkpoint/exit/restart loop.
+            try:
+                os.unlink(self.preemption_notice_path)
+            except OSError:
+                pass
             with open(self.log_path, "ab") as log:
                 self.proc = subprocess.Popen(
                     command, env=env, stdout=log, stderr=subprocess.STDOUT,
@@ -414,18 +429,86 @@ class LocalKubelet:
             self._runners[key] = runner
         runner.start()
 
+    # -- chaos hooks -------------------------------------------------------
+    def kill_pod(self, namespace: str, name: str, sig: int = 9) -> bool:
+        """Kill the pod's container process with ``sig`` (default
+        SIGKILL) WITHOUT touching the pod object — the node-crash /
+        OOM-kill fault.  The runner's own wait() then reflects the
+        signal death (exit 128+signum) and restart policy takes over.
+        Returns False when no live process matches."""
+        with self._lock:
+            runner = self._runners.get((namespace, name))
+        proc = runner.proc if runner is not None else None
+        if proc is None or proc.poll() is not None:
+            return False
+        try:
+            proc.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            return False
+        return True
+
+    def inject_preemption(self, namespace: str, name: str,
+                          grace: float = 1.0) -> bool:
+        """Deliver a preemption notice to the pod (touch its notice
+        file, the K_PREEMPTION_NOTICE_FILE channel) and enforce the
+        grace window: after ``grace`` seconds, SIGTERM the container if
+        it has not exited on its own.  Mirrors a cloud provider's
+        spot/preemption flow (notice -> grace -> termination)."""
+        with self._lock:
+            runner = self._runners.get((namespace, name))
+        if runner is None:
+            return False
+        try:
+            with open(runner.preemption_notice_path, "w") as f:
+                f.write("preempted\n")
+        except OSError:
+            return False
+        # Bind the grace enforcement to THIS incarnation: reading
+        # runner.proc at fire time could SIGTERM an innocent
+        # replacement process after an in-place restart.
+        noticed_proc = runner.proc
+
+        def _enforce():
+            if noticed_proc is not None and noticed_proc.poll() is None:
+                try:
+                    noticed_proc.terminate()
+                except (ProcessLookupError, OSError):
+                    pass
+
+        timer = threading.Timer(grace, _enforce)
+        timer.daemon = True
+        timer.start()
+        return True
+
     # -- status reflection -------------------------------------------------
     def _set_phase(self, namespace: str, name: str, phase: str,
                    ready: bool = False, reason: str = "", message: str = "",
                    restart_count: int = 0,
                    exit_code: Optional[int] = None) -> None:
-        for _ in range(5):
+        # Conflicts retry immediately (informer-staleness normal case);
+        # transient API failures (error bursts, partitions) retry with
+        # backoff instead of abandoning the write — a dropped terminal
+        # phase would leave the pod Running in the API forever while
+        # the process is long gone.  The budget (~60s) must outlast any
+        # realistic brown-out; on exhaustion give up with a logged
+        # error rather than raising — this runs on the daemon runner
+        # thread, and an unwound thread drops the write just the same
+        # but silently.
+        transient_left = 600
+        conflicts = 0
+        while True:
             try:
                 pod = self.client.pods(namespace).get(name)
             except Exception as exc:
                 if is_not_found(exc):
                     return
-                raise
+                transient_left -= 1
+                if transient_left <= 0 or self._stop.is_set():
+                    logger.error("giving up reflecting %s/%s -> %s: %s",
+                                 namespace, name, phase, exc)
+                    return
+                time.sleep(0.1)
+                continue
             pod.status.phase = phase
             pod.status.reason = reason
             pod.status.message = message
@@ -456,9 +539,22 @@ class LocalKubelet:
                 self.client.pods(namespace).update_status(pod)
                 return
             except Exception as exc:
+                if is_not_found(exc):
+                    return
                 if is_conflict(exc):
+                    conflicts += 1
+                    if conflicts >= 20:
+                        logger.error("giving up reflecting %s/%s -> %s:"
+                                     " conflicts exhausted",
+                                     namespace, name, phase)
+                        return
                     continue
-                raise
+                transient_left -= 1
+                if transient_left <= 0 or self._stop.is_set():
+                    logger.error("giving up reflecting %s/%s -> %s: %s",
+                                 namespace, name, phase, exc)
+                    return
+                time.sleep(0.1)
 
     def logs(self, namespace: str, name: str) -> str:
         with self._lock:

@@ -127,6 +127,11 @@ class ContinuousBatcher:
                                              or Registry())
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
+        # Set when the scheduler loop dies unrecoverably (an exception
+        # inside a donated prefill leaves self._cache referencing
+        # donated buffers — see _loop).  Once set, every submit fails
+        # loudly instead of queueing against a dead KV cache.
+        self.fatal_error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         # Shared with other users of the same device (e.g. the server's
         # non-batched generate path) so at most one model computation is
@@ -877,7 +882,7 @@ class ContinuousBatcher:
                     f"only has {self._total_blocks} (cache_blocks too "
                     f"small)")
         if self._stop.is_set():
-            raise RuntimeError("batcher stopped")
+            raise self._shutdown_error()
         if seed is None:
             import random
             seed = random.getrandbits(31)
@@ -889,6 +894,15 @@ class ContinuousBatcher:
                        metrics=self.telemetry,
                        submitted_at=time.perf_counter())
         self._queue.put(req)
+        # The fatal/stop path is asynchronous: the scheduler may have
+        # stopped and drained between the _stop check above and this
+        # put, leaving req stranded (the client would block its full
+        # timeout).  Re-check and fail it here; racing the drain is
+        # harmless (both set the same terminal state).
+        if self._stop.is_set():
+            req.error = self._shutdown_error()
+            req.done.set()
+            raise req.error
         self.telemetry["queue_depth"].set(self._queue.qsize())
         return req
 
@@ -937,6 +951,13 @@ class ContinuousBatcher:
             raise req.error
         if not req.done.is_set():
             raise TimeoutError("generation timed out")
+
+    def _shutdown_error(self) -> RuntimeError:
+        if self.fatal_error is not None:
+            return RuntimeError(
+                "batcher failed fatally (exception inside a donated "
+                f"prefill invalidated the KV cache): {self.fatal_error!r}")
+        return RuntimeError("batcher stopped")
 
     def start(self) -> "ContinuousBatcher":
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -1003,6 +1024,7 @@ class ContinuousBatcher:
                     deferred = req  # pool exhausted; retry after retires
                     deferred_mark = self._retire_count
                     break
+                donated = False
                 try:
                     key0 = jax.random.fold_in(
                         jax.random.PRNGKey(req.seed), len(req.tokens))
@@ -1013,9 +1035,13 @@ class ContinuousBatcher:
                               if self.page_size > 0 else 0)
                     with self._device_lock:
                         if shared > 0:
+                            # _suffix_fn donates self._cache; from here
+                            # a failure is NOT slot-local (see below).
+                            donated = True
                             first, key1 = self._prefill_suffix(
                                 i, req.tokens, sample_args)
                         elif 0 < self._prefill_chunk < len(req.tokens):
+                            donated = True
                             first, key1 = self._prefill_chunked(
                                 i, req.tokens, sample_args)
                         else:
@@ -1041,10 +1067,27 @@ class ContinuousBatcher:
                     top_ks = top_ks.at[i].set(req.top_k)
                     keys = keys.at[i].set(key1)
                     admitted = True
-                except Exception as exc:  # surface, don't kill the loop
+                except Exception as exc:
                     req.error = exc
                     req.done.set()
+                    if donated:
+                        # The failed call may have consumed (donated)
+                        # the KV-cache buffers: self._cache is no longer
+                        # trustworthy, and every active slot decodes
+                        # from it.  Retiring just this slot and
+                        # continuing would leave the batcher bricked
+                        # but apparently alive — accepting work it can
+                        # only fail (or worse, serve from garbage).
+                        # Fail the whole batcher loudly instead.
+                        self.fatal_error = exc
+                        self._stop.set()
+                        break
+                    # Dense prefill does not donate: the failure is
+                    # slot-local — surface it, don't kill the loop.
                     self._retire_slot(i)
+
+            if self._stop.is_set():
+                break  # fatal admission failure or external stop: drain
 
             active_count = sum(1 for s in slots if s is not None)
             self.telemetry["queue_depth"].set(self._queue.qsize())
@@ -1098,18 +1141,20 @@ class ContinuousBatcher:
                     self._retire_slot(i)
 
         # drain on shutdown (submit() rejects once _stop is set, so this
-        # converges; get_nowait is the only safe concurrent drain)
+        # converges; get_nowait is the only safe concurrent drain).  On
+        # a fatal prefill failure the error names the cause, so pending
+        # and in-flight requests fail loudly, not with a bare "stopped".
         if deferred is not None:
-            deferred.error = RuntimeError("batcher stopped")
+            deferred.error = self._shutdown_error()
             deferred.done.set()
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            req.error = RuntimeError("batcher stopped")
+            req.error = self._shutdown_error()
             req.done.set()
         for req in slots:
             if req is not None:
-                req.error = RuntimeError("batcher stopped")
+                req.error = self._shutdown_error()
                 req.done.set()

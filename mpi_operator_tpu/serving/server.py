@@ -32,6 +32,29 @@ from typing import Optional
 from ..telemetry.metrics import (Registry, expose_with_defaults,
                                  new_serving_metrics)
 
+# Sliding-window attention forces the materialized-score XLA path
+# (ops/attention.py window branch), so an S-token prefill allocates an
+# O(S^2) f32 score matrix; past this prompt length that footprint
+# dominates unless chunked prefill bounds it (ADVICE round-5).
+_SWA_PROMPT_THRESHOLD = 2048
+_swa_chunk_warned = False
+
+
+def _warn_swa_unchunked(cfg) -> None:
+    global _swa_chunk_warned
+    if _swa_chunk_warned:
+        return
+    _swa_chunk_warned = True
+    import warnings
+    warnings.warn(
+        f"sliding_window={cfg.sliding_window} with "
+        f"max_seq_len={cfg.max_seq_len} and kv_prefill_chunk=0: SWA "
+        f"uses the materialized-score attention path, so a long-prompt "
+        f"prefill allocates O(S^2) activation memory. Set "
+        f"kv_prefill_chunk (e.g. 512) to bound it — see "
+        f"docs/RESILIENCE.md#swa-long-prompt-footgun.",
+        RuntimeWarning, stacklevel=3)
+
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -49,7 +72,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._respond(200, {"status": "ok"})
+            server: "InferenceServer" = self.server.inference  # type: ignore
+            fatal = getattr(server._batcher, "fatal_error", None)
+            if fatal is not None:
+                # A bricked batcher must fail its health check, not sit
+                # behind a green /healthz accepting doomed requests.
+                self._respond(503, {"status": "failed",
+                                    "error": str(fatal)})
+            else:
+                self._respond(200, {"status": "ok"})
         elif self.path == "/metrics":
             server: "InferenceServer" = self.server.inference  # type: ignore
             body = expose_with_defaults(server.telemetry_registry).encode()
@@ -162,6 +193,14 @@ class InferenceServer:
         self.model = model
         self.variables = variables
         self.mesh = mesh
+        # Config-less models are legal on the metrics-only path
+        # (tests serve /metrics without loading a model).
+        cfg = getattr(model, "config", None)
+        if (cfg is not None
+                and getattr(cfg, "sliding_window", None) is not None
+                and getattr(cfg, "max_seq_len", 0) > _SWA_PROMPT_THRESHOLD
+                and kv_prefill_chunk <= 0):
+            _warn_swa_unchunked(cfg)
         # Optional speculative decoding (greedy requests on the
         # non-batched path): a small same-vocab draft model proposes,
         # the target verifies — output is exactly the greedy decode.

@@ -119,10 +119,15 @@ class CheckpointManager:
 
     def maybe_save(self, state: Any, step: int) -> bool:
         if self.every and step % self.every == 0 and step > 0:
-            if self.goodput is not None:
-                with self.goodput.checkpoint_save():
-                    save_checkpoint(self.directory, state, step, self.keep)
-            else:
-                save_checkpoint(self.directory, state, step, self.keep)
+            self.save(state, step)
             return True
         return False
+
+    def save(self, state: Any, step: int) -> str:
+        """Unconditional save — the preemption path (a notice arrived;
+        checkpoint NOW, off the periodic schedule, then exit)."""
+        if self.goodput is not None:
+            with self.goodput.checkpoint_save():
+                return save_checkpoint(self.directory, state, step,
+                                       self.keep)
+        return save_checkpoint(self.directory, state, step, self.keep)

@@ -1,0 +1,453 @@
+"""Chaos subsystem tests: deterministic plans, injector/engine behavior
+against the live local cluster, the resilience fixes the injected
+faults force (batcher fatal prefill, elastic partition tolerance,
+preemption-aware train loop, controller backoff), and seed replay.
+
+Fast tier-1 by default; the multi-fault randomized soak is
+@pytest.mark.slow.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu import chaos
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.k8s import core
+from mpi_operator_tpu.k8s.apiserver import ApiServer, Clientset
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+from mpi_operator_tpu.server import LocalCluster
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.chaos_smoke import run_once, smoke_job, smoke_plan  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Plan determinism + round-trip
+# ---------------------------------------------------------------------------
+
+def test_randomized_plan_is_seed_deterministic():
+    a = chaos.randomized_plan(123, n_faults=12)
+    b = chaos.randomized_plan(123, n_faults=12)
+    assert a.to_json() == b.to_json()
+    c = chaos.randomized_plan(124, n_faults=12)
+    assert a.to_json() != c.to_json()
+
+
+def test_plan_json_roundtrip():
+    plan = smoke_plan()
+    again = chaos.FaultPlan.from_json(plan.to_json())
+    assert again.to_json() == plan.to_json()
+    assert [f.kind for f in again.sorted_faults()] == \
+        [f.kind for f in plan.sorted_faults()]
+
+
+def test_plan_from_recorded_events():
+    events = [
+        {"event": "inject", "at": 1.0, "kind": "pod_kill",
+         "target": "", "resolved_target": "default/j-worker-0",
+         "duration": 0.0, "params": {"signal": 9}, "result": "killed"},
+        {"event": "heal", "at": 2.0, "kind": "api_error_burst"},
+        {"event": "inject", "at": 1.5, "kind": "api_error_burst",
+         "target": "", "duration": 0.5, "params": {"code": "Timeout"}},
+    ]
+    plan = chaos.FaultPlan.from_events(events, name="replay", seed=9)
+    assert len(plan.faults) == 2  # heals are not faults
+    kill = plan.sorted_faults()[0]
+    assert kill.kind == "pod_kill"
+    # Replays hit the RESOLVED target, not the original loose selector.
+    assert kill.target == "default/j-worker-0"
+    assert plan.sorted_faults()[1].params == {"code": "Timeout"}
+
+
+# ---------------------------------------------------------------------------
+# Injection hooks (unit level)
+# ---------------------------------------------------------------------------
+
+def test_apiserver_fault_injector_hook():
+    server = ApiServer()
+    calls = []
+
+    def hook(verb, api_version, kind, namespace, name):
+        calls.append((verb, kind))
+        if verb == "delete":
+            from mpi_operator_tpu.k8s.apiserver import ApiError
+            raise ApiError("Unavailable", "chaos")
+
+    server.fault_injector = hook
+    pod = core.Pod(metadata=ObjectMeta(name="p", namespace="default"))
+    server.create(pod)
+    server.get("v1", "Pod", "default", "p")
+    server.list("v1", "Pod")
+    with pytest.raises(Exception, match="Unavailable"):
+        server.delete("v1", "Pod", "default", "p")
+    server.fault_injector = None
+    server.delete("v1", "Pod", "default", "p")  # hook removed: works
+    assert ("create", "Pod") in calls and ("delete", "Pod") in calls
+
+
+def test_relist_watches_sends_sentinel():
+    from mpi_operator_tpu.k8s.apiserver import RELIST
+
+    server = ApiServer()
+    w = server.watch("v1", "Pod")
+    other = server.watch("batch/v1", "Job")
+    assert server.relist_watches("v1", "Pod") == 1
+    ev = w.next(timeout=1)
+    assert ev is not None and ev.type == RELIST and ev.obj is None
+    assert other.next(timeout=0.05) is None  # other kinds untouched
+    assert server.relist_watches() == 2  # no filter: every stream
+    w.stop()
+    other.stop()
+
+
+def test_enqueue_does_not_inflate_failure_backoff():
+    """Watch-event storms must not grow the per-key exponential backoff
+    (that is reserved for actual sync failures) — the fix that keeps
+    post-burst recovery fast."""
+    from mpi_operator_tpu.controller.controller import MPIJobController
+
+    controller = MPIJobController(Clientset())
+    job = smoke_job(name="backoff-probe")
+    for _ in range(50):
+        controller.enqueue(job)
+    key = "default/backoff-probe"
+    assert controller.queue.num_requeues(key) == 0
+    # Real failures still pay backoff.
+    controller.queue.add_rate_limited(key)
+    assert controller.queue.num_requeues(key) == 1
+
+
+# ---------------------------------------------------------------------------
+# Resilience fixes forced by the faults
+# ---------------------------------------------------------------------------
+
+def test_elastic_watch_hosts_holds_membership_under_partition(tmp_path):
+    from mpi_operator_tpu.bootstrap import elastic
+    from mpi_operator_tpu.telemetry.metrics import Registry
+
+    registry = Registry()
+    script = tmp_path / "discover_hosts.sh"
+    script.write_text("#!/bin/sh\necho a.svc\necho b.svc\n")
+    hidden = tmp_path / "hidden.sh"
+
+    stop = threading.Event()
+    seen = []
+
+    def consume():
+        for hosts in elastic.watch_hosts(str(script), poll=0.02,
+                                         stop=stop, registry=registry):
+            seen.append(hosts)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while not seen and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen == [["a.svc", "b.svc"]]
+
+    # Partition: the script vanishes (volume mid-refresh / control
+    # plane gone).  Membership must HOLD, not flap to [].
+    script.rename(hidden)
+    time.sleep(0.3)
+    assert seen == [["a.svc", "b.svc"]]
+    assert registry.get("elastic_read_errors_total").value > 0
+    assert registry.get("elastic_resyncs_total").value == 0
+
+    # Heal with identical content: still no spurious resync.
+    hidden.rename(script)
+    time.sleep(0.3)
+    assert seen == [["a.svc", "b.svc"]]
+    assert registry.get("elastic_resyncs_total").value == 0
+
+    # A REAL membership change after the heal is still observed.
+    script.write_text("#!/bin/sh\necho a.svc\n")
+    deadline = time.monotonic() + 5
+    while len(seen) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen[-1] == ["a.svc"]
+    assert registry.get("elastic_resyncs_total").value == 1
+    stop.set()
+    t.join(timeout=2)
+
+
+def test_run_train_loop_checkpoints_then_exits_on_preemption(tmp_path):
+    from mpi_operator_tpu.parallel.train import (PREEMPTION_EXIT_CODE,
+                                                 run_train_loop)
+
+    notice = tmp_path / "preemption.notice"
+    saves = []
+
+    class FakeManager:
+        def maybe_save(self, state, step):
+            return False
+
+        def save(self, state, step):
+            saves.append((state, step))
+
+    def step_fn(state, batch):
+        if state == 2:  # the notice lands mid-training
+            notice.write_text("preempted\n")
+        return state + 1, {}
+
+    def batches():
+        while True:
+            yield None
+
+    with pytest.raises(SystemExit) as exc:
+        run_train_loop(0, step_fn, batches(),
+                       checkpoint_manager=FakeManager(),
+                       preemption_file=str(notice))
+    assert exc.value.code == PREEMPTION_EXIT_CODE
+    # Checkpointed AT the preempted step — zero lost work.
+    assert saves == [(3, 3)]
+
+    # Embedder mode: return instead of exiting.  The notice already
+    # exists, so the pre-step check fires before ANY step runs — a
+    # notice must not burn grace-window time on doomed work.
+    notice.write_text("preempted\n")
+    state, step = run_train_loop(
+        0, lambda s, b: (s + 1, {}), batches(),
+        preemption_file=str(notice), exit_on_preemption=False)
+    assert (state, step) == (0, 0)
+
+
+def test_run_train_loop_plain_completion(tmp_path):
+    from mpi_operator_tpu.parallel.train import run_train_loop
+
+    state, step = run_train_loop(
+        0, lambda s, b: (s + 1, {}), iter(range(5)), max_steps=3,
+        preemption_file=str(tmp_path / "never"))
+    assert (state, step) == (3, 3)
+
+
+def test_sshd_chaos_spec_parsing():
+    from mpi_operator_tpu.bootstrap.sshd import parse_chaos_spec
+
+    assert parse_chaos_spec("") == (0, 0.0)
+    assert parse_chaos_spec("drop:3") == (3, 0.0)
+    assert parse_chaos_spec("slow:0.5") == (0, 0.5)
+    assert parse_chaos_spec("drop:2,slow:1.5") == (2, 1.5)
+    # Malformed knobs never break a production daemon start.
+    assert parse_chaos_spec("drop:x,bogus,slow:") == (0, 0.0)
+
+
+def test_batcher_donated_prefill_fault_is_fatal_and_loud():
+    """The ADVICE round-5 brick: an exception inside the donated
+    chunked/suffix prefill must fail the batcher and its pending
+    requests loudly — on the old code the slot was retired and the
+    batcher kept accepting work against a dead KV cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.models.llama import LlamaModel, llama2_tiny
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                page_size=8, prefill_chunk=4).start()
+    try:
+        # Healthy first: the paged + chunked path works.
+        out = batcher.submit(list(range(1, 10)), 3)
+        assert len(out) == 3
+
+        def boom(width):
+            raise RuntimeError("chaos: injected prefill fault")
+
+        batcher._suffix_fn = boom
+        # The faulted request surfaces the injected error...
+        with pytest.raises(RuntimeError, match="injected prefill fault"):
+            batcher.submit(list(range(1, 10)), 3)
+        # ...and the batcher is now DOWN, loudly: no zombie acceptance.
+        assert batcher.fatal_error is not None
+        with pytest.raises(RuntimeError, match="fatally"):
+            batcher.submit([1, 2, 3], 2)
+    finally:
+        batcher.stop()
+
+
+def test_inference_server_healthz_reflects_batcher_death():
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.models.llama import LlamaModel, llama2_tiny
+    from mpi_operator_tpu.serving.server import InferenceServer
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    server = InferenceServer(model, variables, max_batch_slots=2).start()
+    try:
+        with urllib.request.urlopen(server.url + "/healthz",
+                                    timeout=10) as resp:
+            assert json.loads(resp.read())["status"] == "ok"
+        server._batcher.fatal_error = RuntimeError("chaos: bricked")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(server.url + "/healthz", timeout=10)
+        assert exc.value.code == 503
+        assert "bricked" in json.loads(exc.value.read())["error"]
+    finally:
+        server._batcher.fatal_error = None
+        server.stop()
+
+
+def test_swa_long_prompt_warns_once_without_chunked_prefill():
+    import dataclasses
+    import types
+    import warnings as warnings_mod
+
+    from mpi_operator_tpu.models.llama import llama2_tiny
+    from mpi_operator_tpu.serving import server as server_mod
+
+    cfg = dataclasses.replace(llama2_tiny(), sliding_window=64,
+                              max_seq_len=4096)
+    fake_model = types.SimpleNamespace(config=cfg)
+    server_mod._swa_chunk_warned = False
+    try:
+        with pytest.warns(RuntimeWarning, match="kv_prefill_chunk"):
+            server_mod.InferenceServer(fake_model, {"params": {}})
+        # Once only.
+        with warnings_mod.catch_warnings():
+            warnings_mod.simplefilter("error", RuntimeWarning)
+            server_mod.InferenceServer(fake_model, {"params": {}})
+        # Chunked prefill silences it — but needs batching; config
+        # check order means the warning is evaluated first, so reset
+        # and assert no warning fires with kv_prefill_chunk set.
+        server_mod._swa_chunk_warned = False
+        with warnings_mod.catch_warnings():
+            warnings_mod.simplefilter("error", RuntimeWarning)
+            with pytest.raises(ValueError, match="kv_prefill_chunk"):
+                # max_batch_slots=0 + chunk>0 raises AFTER the (now
+                # silent) warning check — proving no warning fired.
+                server_mod.InferenceServer(fake_model, {"params": {}},
+                                           kv_prefill_chunk=64)
+    finally:
+        server_mod._swa_chunk_warned = False
+
+
+def test_induction_model_provenance_guard(tmp_path):
+    import numpy as np
+
+    from tools.train_induction import load_params, sidecar_path
+
+    # The committed artifact verifies.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt = os.path.join(repo, "tools", "induction_model.npz")
+    params = load_params(ckpt)
+    assert params
+
+    # A drifted artifact fails loudly.
+    drifted = tmp_path / "induction_model.npz"
+    np.savez_compressed(drifted, **{"layer/w": np.zeros(3)})
+    with open(sidecar_path(str(drifted)), "w") as f:
+        json.dump({"sha256": "not-the-hash"}, f)
+    with pytest.raises(RuntimeError, match="drifted"):
+        load_params(str(drifted))
+    # A missing sidecar fails loudly too.
+    os.remove(sidecar_path(str(drifted)))
+    with pytest.raises(RuntimeError, match="sidecar"):
+        load_params(str(drifted))
+
+
+# ---------------------------------------------------------------------------
+# Full-cluster scenarios
+# ---------------------------------------------------------------------------
+
+def test_smoke_plan_converges_and_replays_identically():
+    """The acceptance scenario: pod kill + watch 410 + apiserver error
+    burst + preemption notice against a live cluster — converges with
+    invariants green, and the same plan reproduces the identical
+    canonical fault/event log."""
+    first = run_once()
+    assert first.converged, first.events
+    assert first.ok, first.violations
+    kinds = [e["kind"] for e in first.canonical_log()
+             if e["event"] == "inject"]
+    assert kinds == ["pod_kill", "watch_relist", "api_error_burst",
+                     "preempt"]
+    second = run_once()
+    assert second.ok, second.violations
+    assert first.canonical_log() == second.canonical_log()
+
+
+def test_recorded_fault_log_replays_as_regression(tmp_path):
+    """A recorded run's JSONL replays as a plan (the failing-seed
+    regression workflow): same injected faults, same results."""
+    report = run_once()
+    assert report.ok, report.violations
+    log_path = tmp_path / "fault_log.jsonl"
+    report.export_jsonl(str(log_path))
+    with open(log_path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    assert events[0]["event"] == "plan"
+    assert events[-1]["event"] == "verdict"
+
+    replay_plan = chaos.FaultPlan.from_events(events, name="replay",
+                                              seed=report.seed)
+    assert [f.kind for f in replay_plan.sorted_faults()] == \
+        ["pod_kill", "watch_relist", "api_error_burst", "preempt"]
+
+    with LocalCluster() as cluster:
+        job = smoke_job()
+        cluster.submit(job)
+        cluster.wait_for_condition("default", job.metadata.name,
+                                   constants.JOB_RUNNING, timeout=30)
+
+        def converged():
+            stored = cluster.client.mpi_jobs("default").get(
+                job.metadata.name)
+            return any(c.type == constants.JOB_SUCCEEDED
+                       and c.status == core.CONDITION_TRUE
+                       for c in stored.status.conditions)
+
+        replay = chaos.run(replay_plan, cluster, converge=converged,
+                           timeout=60)
+    assert replay.ok, replay.violations
+    original_injects = [e for e in report.canonical_log()
+                        if e["event"] == "inject"]
+    replay_injects = [e for e in replay.canonical_log()
+                      if e["event"] == "inject"]
+    assert [(e["kind"], e["resolved_target"], e["result"])
+            for e in original_injects] == \
+        [(e["kind"], e["resolved_target"], e["result"])
+         for e in replay_injects]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [
+    int(s) for s in os.environ.get("CHAOS_SEED", "1337,2024,9001").split(",")])
+def test_randomized_soak_converges(seed):
+    """Seeded randomized soak (minutes across the seed set): faults
+    drawn from the full taxonomy against a multi-job cluster; the
+    system must converge with every invariant green.  On failure, the
+    printed seed + exported fault log reproduce the run exactly
+    (docs/RESILIENCE.md); explore further with CHAOS_SEED=<n>[,<n>...]."""
+    plan = chaos.randomized_plan(seed, n_faults=14, horizon=18.0)
+    with LocalCluster() as cluster:
+        for i in range(3):
+            cluster.submit(smoke_job(name=f"soak-{i}"))
+        for i in range(3):
+            cluster.wait_for_condition("default", f"soak-{i}",
+                                       constants.JOB_RUNNING, timeout=60)
+        report = chaos.run(plan, cluster, timeout=120, settle=30)
+        # Convergence for the soak: every job terminal or re-Running
+        # (the jobs_converged invariant), plus the leak invariants.
+        if not report.ok:
+            report.export_jsonl(f"/tmp/chaos_soak_seed{seed}.jsonl")
+        assert report.ok, (
+            f"seed {seed} violations {report.violations}; fault log at "
+            f"/tmp/chaos_soak_seed{seed}.jsonl replays via "
+            f"FaultPlan.from_events")

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Chaos smoke: a deterministic multi-fault plan against the full local
+cluster, run to convergence with every invariant green — and run TWICE
+to prove the fault/event log reproduces bit-identically.
+
+The plan (docs/RESILIENCE.md walks through it):
+
+    t=1.0  pod_kill    worker-0 (SIGKILL -> exit 137, retryable)
+    t=1.5  watch_relist v1 Pod  (stream loss + 410-relist contract)
+    t=2.0  api_error_burst (1s of 50% Unavailable on all verbs)
+    t=4.0  preempt     worker-1 (notice file, 0.4s grace -> SIGTERM)
+
+against an MPIJob whose workers are preemption-aware (exit 143 on the
+K_PREEMPTION_NOTICE_FILE channel) with restartPolicy: ExitCode, so both
+faults route through the controller's gang-restart repair, bounded by
+backoffLimit.  Convergence = the job completes (launcher finishes);
+invariants = chaos.DEFAULT_INVARIANTS (no orphaned runners/pods/IPs,
+gang restarts bounded, workqueue drained).
+
+Usage: python tools/chaos_smoke.py [--once] [--out report.jsonl]
+Exit 0 = both runs green and logs identical.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+WORKER_SCRIPT = textwrap.dedent("""\
+    import os, sys, time
+    notice = os.environ.get("K_PREEMPTION_NOTICE_FILE")
+    for _ in range(1200):
+        if notice and os.path.exists(notice):
+            sys.exit(143)  # preemption: retryable, gang repairs
+        time.sleep(0.05)
+""")
+
+LAUNCHER_SCRIPT = "import time; time.sleep(8); print('launcher done')"
+
+
+def smoke_job(name: str = "chaos-smoke", workers: int = 2,
+              backoff_limit: int = 4):
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec, ReplicaSpec,
+                                            RunPolicy)
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+    return MPIJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(backoff_limit=backoff_limit),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="launcher", image="local",
+                                  command=[sys.executable, "-c",
+                                           LAUNCHER_SCRIPT])]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    restart_policy=constants.RESTART_POLICY_EXIT_CODE,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="worker", image="local",
+                                  command=[sys.executable, "-c",
+                                           WORKER_SCRIPT])]))),
+            }))
+
+
+def smoke_plan():
+    from mpi_operator_tpu import chaos
+
+    return chaos.FaultPlan(name="chaos-smoke", seed=7, faults=[
+        chaos.Fault(at=1.0, kind="pod_kill",
+                    target="default/chaos-smoke-worker-0",
+                    params={"signal": 9, "wait": 10}),
+        chaos.Fault(at=1.5, kind="watch_relist", target="v1 Pod"),
+        chaos.Fault(at=2.0, kind="api_error_burst", duration=1.0,
+                    params={"code": "Unavailable", "probability": 0.5}),
+        chaos.Fault(at=4.0, kind="preempt",
+                    target="default/chaos-smoke-worker-1",
+                    params={"grace": 0.4, "wait": 15}),
+    ])
+
+
+def run_once(timeout: float = 60.0):
+    """One full scenario on a fresh LocalCluster; returns the report."""
+    from mpi_operator_tpu import chaos
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.k8s import core
+    from mpi_operator_tpu.server import LocalCluster
+
+    with LocalCluster() as cluster:
+        job = smoke_job()
+        cluster.submit(job)
+        # Deterministic starting state: the gang is fully Running before
+        # the first fault fires (otherwise fault results race startup
+        # and the two runs' logs diverge).
+        cluster.wait_for_condition("default", job.metadata.name,
+                                   constants.JOB_RUNNING, timeout=30)
+
+        def converged():
+            stored = cluster.client.mpi_jobs("default").get(
+                job.metadata.name)
+            conds = {c.type: c.status for c in stored.status.conditions}
+            return conds.get(constants.JOB_SUCCEEDED) == \
+                core.CONDITION_TRUE
+
+        report = chaos.run(smoke_plan(), cluster, converge=converged,
+                           timeout=timeout)
+        # The smoke's extra teeth: both injected failures actually
+        # routed through gang repair (the annotation counter moved).
+        stored = cluster.client.mpi_jobs("default").get(job.metadata.name)
+        restarts = int((stored.metadata.annotations or {}).get(
+            constants.GANG_RESTART_COUNT_ANNOTATION, "0"))
+        if restarts < 1:
+            report.violations.append(
+                f"expected >=1 gang restart from injected faults, "
+                f"saw {restarts}")
+        return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--once", action="store_true",
+                    help="single run (skip the reproducibility check)")
+    ap.add_argument("--out", default=None,
+                    help="write the fault/event log JSONL here")
+    args = ap.parse_args(argv)
+
+    print("chaos-smoke: run 1...", flush=True)
+    first = run_once()
+    if args.out:
+        first.export_jsonl(args.out)
+        print(f"chaos-smoke: fault/event log -> {args.out}")
+    for ev in first.canonical_log():
+        print(f"  {ev}")
+    if not first.ok:
+        print(f"chaos-smoke: FAIL (converged={first.converged}, "
+              f"violations={first.violations})")
+        return 1
+    if args.once:
+        print("chaos-smoke: PASS (single run)")
+        return 0
+
+    print("chaos-smoke: run 2 (reproducibility)...", flush=True)
+    second = run_once()
+    if not second.ok:
+        print(f"chaos-smoke: FAIL on rerun (converged="
+              f"{second.converged}, violations={second.violations})")
+        return 1
+    if first.canonical_log() != second.canonical_log():
+        print("chaos-smoke: FAIL — fault/event logs differ across runs:")
+        print(json.dumps(first.canonical_log(), indent=2))
+        print(json.dumps(second.canonical_log(), indent=2))
+        return 1
+    print(f"chaos-smoke: PASS — {len(first.canonical_log())} events, "
+          f"identical across runs, all invariants green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

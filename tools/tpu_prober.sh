@@ -19,6 +19,12 @@ from tools_tpu_probe import relay_state; print(relay_state())' \
     OUT="{\"ok\": false, \"error\": \"probe timeout 95s\", \"relay\": \"$RELAY\"}"
   fi
   echo "{\"ts\": \"$TS\", \"probe\": $OUT}" >> "$LOG"
+  # One-line committed summary (the live JSONL log is gitignored).
+  TOTAL=$(wc -l < "$LOG")
+  FIRST_TS=$(head -1 "$LOG" | sed -n 's/.*"ts": "\([^"]*\)".*/\1/p')
+  if echo "$OUT" | grep -q '"ok": true'; then STATE=OK; else STATE=FAILING; fi
+  echo "tpu-prober: $STATE — last probe $TS ($OUT); $TOTAL log entries since $FIRST_TS; see tools/TPU_TUNNEL_DIAGNOSIS.md. Live log: tools/prober_log.jsonl (gitignored, machine-generated)." \
+    > tools/prober_status.txt
   if echo "$OUT" | grep -q '"ok": true'; then
     STAMP=$(date -u +%Y%m%dT%H%M%SZ)
     echo "{\"ts\": \"$TS\", \"event\": \"tpu-live; capturing\"}" >> "$LOG"

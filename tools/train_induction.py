@@ -54,10 +54,76 @@ def save_params(params, path: str) -> None:
     np.savez_compressed(path, **flat)
 
 
-def load_params(path: str):
+def sidecar_path(path: str) -> str:
+    return os.path.splitext(path)[0] + ".json"
+
+
+def _sha256(path: str) -> str:
+    import hashlib
+
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def write_provenance(path: str, eval_info: dict) -> str:
+    """Record what produced the artifact (training-script git hash +
+    final eval metric) next to it, keyed to its content hash — the
+    committed binary and the committed script can no longer drift
+    silently (ADVICE round-5)."""
+    import subprocess
+
+    try:
+        git_hash = subprocess.check_output(
+            ["git", "rev-parse", "HEAD"], cwd=REPO,
+            stderr=subprocess.DEVNULL).decode().strip()
+    except Exception:
+        git_hash = "unknown"
+    side = sidecar_path(path)
+    with open(side, "w") as f:
+        json.dump({
+            "artifact": os.path.basename(path),
+            "sha256": _sha256(path),
+            "trained_by": "tools/train_induction.py",
+            "git_hash": git_hash,
+            "eval": eval_info,
+        }, f, indent=2)
+        f.write("\n")
+    return side
+
+
+def read_provenance(path: str) -> dict:
+    """Read and VERIFY the artifact's provenance sidecar: it must exist
+    and its recorded sha256 must match the artifact's content, so a
+    drifted or hand-edited artifact fails loudly instead of silently
+    skewing the bench it anchors."""
+    side = sidecar_path(path)
+    if not os.path.exists(side):
+        raise RuntimeError(
+            f"{path} has no provenance sidecar ({side}); re-run "
+            f"tools/train_induction.py to regenerate both")
+    with open(side) as f:
+        meta = json.load(f)
+    actual = _sha256(path)
+    if actual != meta.get("sha256"):
+        raise RuntimeError(
+            f"{path} drifted from its provenance sidecar: sha256 "
+            f"{actual} != recorded {meta.get('sha256')} (trained at "
+            f"{meta.get('git_hash', '?')}); re-run "
+            f"tools/train_induction.py")
+    return meta
+
+
+def load_params(path: str, verify: bool = True):
+    """Load the artifact; with ``verify`` (default) the provenance
+    sidecar is required and checked (read_provenance)."""
     import numpy as np
     from flax.traverse_util import unflatten_dict
 
+    if verify:
+        read_provenance(path)
     with np.load(path) as z:
         return unflatten_dict({tuple(k.split("/")): z[k] for k in z.files})
 
@@ -140,6 +206,13 @@ def main() -> None:
 
     save_params(params, args.out)
     final = induction_score()
+    write_provenance(args.out, {
+        "metric": "worst-period induction match (periods 4..8, 48 new "
+                  "tokens past a 64-token prompt)",
+        "value": f"{final}/48",
+        "final_loss": round(float(loss), 4),
+        "steps": i + 1,
+    })
     print(json.dumps({
         "out": args.out, "steps": i + 1, "final_loss": round(float(loss), 4),
         "induction_score": f"worst-period {final}/48",
