@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
-	bench dryrun clean telemetry-smoke chaos-smoke
+	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -28,6 +28,14 @@ telemetry-smoke:
 # fault/event log (docs/RESILIENCE.md).
 chaos-smoke:
 	$(PYTHON) tools/chaos_smoke.py
+
+# Flight-recorder smoke: kill a training gang via a seeded chaos plan,
+# assert the black-box bundle (ring JSONL + merged Chrome trace with
+# one lane per layer + /metrics snapshot + job state) appears and that
+# its canonical event section is byte-identical across two runs; also
+# checks the docs/OBSERVABILITY.md metric catalog against the code.
+obs-smoke:
+	$(PYTHON) tools/obs_smoke.py
 
 native:
 	$(MAKE) -C native

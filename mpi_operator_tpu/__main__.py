@@ -5,6 +5,9 @@
     python -m mpi_operator_tpu cluster --port 8001     # all-in-one
     python -m mpi_operator_tpu submit -f job.yaml --master ...
     python -m mpi_operator_tpu get [-n ns] [--master ...]
+    python -m mpi_operator_tpu events [-n ns] [--watch] [--master ...]
+    python -m mpi_operator_tpu top [-n ns] [--once] [--master ...]
+    python -m mpi_operator_tpu debug-bundle NAME [-o dir] [--master ...]
     python -m mpi_operator_tpu suspend/resume/delete NAME [--master ...]
     python -m mpi_operator_tpu version
 
@@ -46,7 +49,13 @@ def cmd_apiserver(args) -> int:
 
 def cmd_operator(args, extra) -> int:
     from .server.app import run
+    from .telemetry import flight
     app = run(extra)
+    # Late-bound registry: the controller (and its metrics) only exist
+    # once this replica wins leadership.
+    flight.install_crash_handler(
+        registry=lambda: app.controller.metrics.get("registry")
+        if app.controller is not None else None)
     print("operator running (leader election + controller)")
     _wait_for_signal()
     app.stop()
@@ -56,8 +65,11 @@ def cmd_operator(args, extra) -> int:
 def cmd_cluster(args) -> int:
     from .k8s.http_api import ApiHttpServer
     from .server.cluster import LocalCluster
+    from .telemetry import flight
 
     cluster = LocalCluster()
+    flight.install_crash_handler(
+        registry=cluster.controller.metrics.get("registry"))
     cluster.start()
     server = ApiHttpServer(store=cluster.client.server,
                            port=args.port).start()
@@ -121,24 +133,61 @@ def _condition_summary(job) -> str:
     return "Pending"
 
 
+def _age(when) -> str:
+    """kubectl-style compact age ("42s", "3m", "2h") from a datetime."""
+    if when is None:
+        return ""
+    import datetime
+    secs = int((datetime.datetime.now(datetime.timezone.utc)
+                - when).total_seconds())
+    if secs < 0:
+        secs = 0
+    if secs < 120:
+        return f"{secs}s"
+    if secs < 7200:
+        return f"{secs // 60}m"
+    return f"{secs // 3600}h"
+
+
+def _last_transition(job):
+    """Most recent condition transition time (None when no conditions)."""
+    times = [c.last_transition_time for c in job.status.conditions
+             if c.last_transition_time is not None]
+    return max(times) if times else None
+
+
 def cmd_get(args) -> int:
     client = _client(args.master)
     jobs = client.mpi_jobs(args.namespace).list()
-    print(f"{'NAME':24} {'STATUS':12} {'WORKERS':8} AGE")
+    print(f"{'NAME':24} {'STATUS':12} {'WORKERS':8} {'AGE':8} LAST-CHANGE")
     for job in jobs:
         workers = 0
         spec = job.spec.mpi_replica_specs.get("Worker")
         if spec is not None and spec.replicas:
             workers = spec.replicas
-        age = ""
-        if job.metadata.creation_timestamp is not None:
-            import datetime
-            delta = (datetime.datetime.now(datetime.timezone.utc)
-                     - job.metadata.creation_timestamp)
-            age = f"{int(delta.total_seconds())}s"
+        age = _age(job.metadata.creation_timestamp)
         print(f"{job.metadata.name:24} {_condition_summary(job):12}"
-              f" {workers:<8} {age}")
+              f" {workers:<8} {age:8} {_age(_last_transition(job))}")
     return 0
+
+
+def _event_last_seen(event):
+    """The sort key for event tails: aggregated repeats carry
+    last_timestamp; singletons fall back to creation time."""
+    import datetime
+    return (event.last_timestamp or event.metadata.creation_timestamp
+            or datetime.datetime(1970, 1, 1,
+                                 tzinfo=datetime.timezone.utc))
+
+
+def _format_event_line(event, with_object: bool = False) -> str:
+    count = f"x{event.count}" if (event.count or 1) > 1 else ""
+    line = (f"{_age(_event_last_seen(event)):>8} {event.type:8} "
+            f"{event.reason:22} {count:>5} ")
+    if with_object:
+        ref = event.involved_object
+        line += f"{ref.namespace}/{ref.name:24} "
+    return line + event.message
 
 
 def cmd_describe(args) -> int:
@@ -155,9 +204,231 @@ def cmd_describe(args) -> int:
     events = [e for e in client.events(args.namespace).list()
               if e.involved_object.name == args.name]
     if events:
+        # Aggregated tail: most recent last, repeats as one xN line.
+        events.sort(key=_event_last_seen)
         print("Events:")
+        print(f"  {'LAST-SEEN':>8} {'TYPE':8} {'REASON':22} {'COUNT':>5} "
+              f"MESSAGE")
         for e in events:
-            print(f"  {e.type:8} {e.reason:22} {e.message}")
+            print(f"  {_format_event_line(e)}")
+    return 0
+
+
+def _watch_events(server, namespace, emit, stop=None,
+                  poll_timeout: float = 0.2) -> None:
+    """The resume-safe core of ``events --watch``.
+
+    Lists current events first (recording the highest resourceVersion),
+    then streams the Event watch.  A RELIST sentinel (the client-side
+    contract after a 410 Expired) reconciles against a fresh list, so
+    events created inside the gap are emitted exactly once instead of
+    lost.  Runs until ``stop`` (a threading.Event) is set.
+    """
+    import threading as _threading
+
+    from .k8s.apiserver import ApiError
+
+    stop = stop or _threading.Event()
+    seen_rv = 0
+
+    def _emit_listed() -> None:
+        nonlocal seen_rv
+        events = sorted(server.list("v1", "Event", namespace),
+                        key=_event_last_seen)
+        # Compare against the watermark as of the list, not one moving
+        # mid-loop: the display sort (last-seen) need not match rv order.
+        prior = seen_rv
+        for e in events:
+            try:
+                rv = int(e.metadata.resource_version or 0)
+            except ValueError:
+                rv = 0
+            if prior == 0 or rv > prior:
+                emit(e)
+            seen_rv = max(seen_rv, rv)
+
+    _emit_listed()
+    while not stop.is_set():
+        try:
+            try:
+                watch = server.watch("v1", "Event",
+                                     str(seen_rv) if seen_rv else None)
+            except TypeError:
+                # Transport without resume support: start from now.
+                watch = server.watch("v1", "Event")
+        except ApiError as exc:
+            if exc.code == "Expired":
+                # Our resume RV fell out of the retained window: the
+                # 410 relist path — reconcile from a fresh list.
+                _emit_listed()
+                continue
+            raise
+        try:
+            while not stop.is_set():
+                ev = watch.next(timeout=poll_timeout)
+                if ev is None:
+                    continue
+                if ev.type == "RELIST" or ev.obj is None:
+                    _emit_listed()
+                    continue
+                if ev.type == "DELETED":
+                    continue  # retention pruning is not news
+                obj = ev.obj
+                if obj.kind != "Event":
+                    continue
+                if namespace is not None \
+                        and obj.metadata.namespace != namespace:
+                    continue
+                try:
+                    rv = int(obj.metadata.resource_version or 0)
+                except ValueError:
+                    rv = 0
+                if rv <= seen_rv:
+                    continue  # replayed duplicate
+                seen_rv = rv
+                emit(obj)
+        finally:
+            watch.stop()
+        return  # stream consumed to stop
+
+
+def cmd_events(args) -> int:
+    client = _client(args.master)
+    header = (f"{'LAST-SEEN':>8} {'TYPE':8} {'REASON':22} {'COUNT':>5} "
+              f"OBJECT / MESSAGE")
+    print(header)
+
+    def emit(e):
+        print(_format_event_line(e, with_object=True), flush=True)
+
+    if not args.watch:
+        for e in sorted(client.events(args.namespace).list(),
+                        key=_event_last_seen):
+            emit(e)
+        return 0
+    try:
+        _watch_events(client.server, args.namespace, emit)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _parse_metrics_text(text: str) -> dict:
+    """Prometheus text exposition -> {family_or_series: float} (labeled
+    series keep their label string; the bare family name maps to the
+    last sample seen)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value = line.rpartition(" ")
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        out[name_part] = val
+        family = name_part.partition("{")[0]
+        out[family] = val
+    return out
+
+
+def _top_snapshot(client, namespace, metrics: dict) -> str:
+    """One frame of `top`: jobs, pod phase census, queue/goodput."""
+    from .api import constants as api_constants
+
+    lines = []
+    jobs = client.mpi_jobs(namespace).list()
+    pods = client.pods(namespace).list()
+    phase_count: dict = {}
+    for p in pods:
+        phase_count[p.status.phase or "Unknown"] = \
+            phase_count.get(p.status.phase or "Unknown", 0) + 1
+    lines.append(f"{'JOB':24} {'STATUS':12} {'ACTIVE':>6} {'FAILED':>6} "
+                 f"{'RESTARTS':>8} {'AGE':>6}")
+    for job in jobs:
+        worker = job.status.replica_statuses.get(
+            api_constants.REPLICA_TYPE_WORKER)
+        active = worker.active if worker else 0
+        failed = worker.failed if worker else 0
+        restarts = (job.metadata.annotations or {}).get(
+            api_constants.GANG_RESTART_COUNT_ANNOTATION, "0")
+        lines.append(
+            f"{job.metadata.name:24} {_condition_summary(job):12} "
+            f"{active:>6} {failed:>6} {restarts:>8} "
+            f"{_age(job.metadata.creation_timestamp):>6}")
+    census = ", ".join(f"{phase}={n}"
+                       for phase, n in sorted(phase_count.items()))
+    lines.append(f"pods: {len(pods)} ({census})" if pods else "pods: 0")
+    if metrics:
+        picks = []
+        for label, family in (
+                ("workqueue", "mpi_operator_workqueue_depth_count"),
+                ("reconciles", "mpi_operator_reconcile_seconds_count"),
+                ("gang-restarts", "mpi_operator_gang_restarts_total"),
+                ("serve-queue", "serving_queue_depth"),
+                ("goodput", "train_goodput_fraction"),
+                ("steps", "train_step_seconds_count")):
+            if family in metrics:
+                picks.append(f"{label}={metrics[family]:g}")
+        if picks:
+            lines.append("metrics: " + "  ".join(picks))
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    client = _client(args.master)
+
+    def fetch_metrics() -> dict:
+        if not args.metrics_url:
+            return {}
+        import urllib.request
+        try:
+            with urllib.request.urlopen(args.metrics_url,
+                                        timeout=5) as resp:
+                return _parse_metrics_text(resp.read().decode())
+        except Exception:
+            return {}
+
+    if args.once:
+        print(_top_snapshot(client, args.namespace, fetch_metrics()))
+        return 0
+    try:
+        while True:
+            frame = _top_snapshot(client, args.namespace, fetch_metrics())
+            # ANSI clear + home, like `watch`/`top`.
+            print(f"\x1b[2J\x1b[Hmpi-operator-tpu top  "
+                  f"(interval {args.interval}s, Ctrl-C to quit)\n"
+                  f"{frame}", flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_debug_bundle(args) -> int:
+    from .telemetry import flight
+
+    client = _client(args.master)
+    # Fail fast (NotFound) before writing anything.
+    client.mpi_jobs(args.namespace).get(args.name)
+    payload = flight.job_snapshot(client, args.namespace, args.name)
+    metrics_text = None
+    if args.metrics_url:
+        import urllib.request
+        try:
+            with urllib.request.urlopen(args.metrics_url,
+                                        timeout=5) as resp:
+                metrics_text = resp.read().decode()
+        except Exception as exc:
+            print(f"warning: could not scrape {args.metrics_url}: {exc}",
+                  file=sys.stderr)
+    path = flight.dump_bundle(f"cli-{args.name}", directory=args.out,
+                              job_payload=payload,
+                              metrics_text=metrics_text)
+    if path is None:
+        print("error: bundle dump failed", file=sys.stderr)
+        return 1
+    print(f"debug bundle written: {path}")
     return 0
 
 
@@ -225,6 +496,33 @@ def main(argv=None) -> int:
     p.add_argument("-n", "--namespace", default="default")
     p.add_argument("--master", default="http://127.0.0.1:8001")
 
+    p = sub.add_parser("events",
+                       help="list cluster events (kubectl get events)")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--master", default="http://127.0.0.1:8001")
+    p.add_argument("-w", "--watch", action="store_true",
+                   help="stream new events (resourceVersion resume)")
+
+    p = sub.add_parser("top",
+                       help="live jobs/pods/queue/goodput table")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--master", default="http://127.0.0.1:8001")
+    p.add_argument("--metrics-url", default="",
+                   help="a /metrics endpoint to fold into the table")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+
+    p = sub.add_parser("debug-bundle",
+                       help="write an on-demand black-box bundle for a job")
+    p.add_argument("name")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--master", default="http://127.0.0.1:8001")
+    p.add_argument("--metrics-url", default="",
+                   help="a /metrics endpoint to snapshot into the bundle")
+    p.add_argument("-o", "--out", default=None,
+                   help="bundle parent dir (default: debug dir)")
+
     for action in ("suspend", "resume", "delete"):
         p = sub.add_parser(action, help=f"{action} an MPIJob")
         p.add_argument("name")
@@ -249,6 +547,12 @@ def main(argv=None) -> int:
             return cmd_get(args)
         if args.command == "describe":
             return cmd_describe(args)
+        if args.command == "events":
+            return cmd_events(args)
+        if args.command == "top":
+            return cmd_top(args)
+        if args.command == "debug-bundle":
+            return cmd_debug_bundle(args)
         if args.command in ("suspend", "resume", "delete"):
             return cmd_lifecycle(args, args.command)
         if args.command == "version":

@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
+from ..telemetry import flight
 from ..telemetry.trace import span
 from .injectors import INJECTORS, ApiFaultBank
 from .invariants import DEFAULT_INVARIANTS
@@ -42,6 +43,9 @@ class ChaosReport:
     violations: List[str] = field(default_factory=list)
     converged: bool = True
     elapsed: float = 0.0
+    # Debug-bundle path attached by ChaosEngine.run on invariant
+    # violation (or when bundle="always"); None when no bundle was cut.
+    bundle_dir: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -103,7 +107,13 @@ class ChaosEngine:
             self._seq += 1
             event["ts"] = round(time.time(), 6)
             self.events.append(event)
-            return event
+        # Mirror onto the flight ring (canonical fields only) so chaos
+        # activity appears in every layer's black-box bundle, in the
+        # same deterministic per-run order as the engine's own log.
+        flight.record("chaos", event.get("event", "event"),
+                      **{k: event[k] for k in CANONICAL_FIELDS
+                         if k in event})
+        return event
 
     def log_result(self, fault, resolved_target: str = "",
                    result: str = "") -> None:
@@ -124,7 +134,15 @@ class ChaosEngine:
     def run(self, converge: Optional[Callable[[], bool]] = None,
             timeout: float = 30.0,
             invariants: Sequence[Callable] = DEFAULT_INVARIANTS,
-            settle: float = 10.0) -> ChaosReport:
+            settle: float = 10.0,
+            bundle: Optional[str] = "violation") -> ChaosReport:
+        """``bundle`` controls black-box attachment: "violation"
+        (default) dumps a debug bundle when any invariant fails or
+        convergence times out, "always" dumps unconditionally (smoke
+        runs want the artifact even when green), None/False never
+        dumps.  The bundle's canonical event section is this report's
+        ``canonical_log()`` — byte-identical across identical seeded
+        runs."""
         report = ChaosReport(plan_name=self.plan.name, seed=self.seed)
         self.bank.exempt_current_thread()
         prior_injector = getattr(self.server, "fault_injector", None)
@@ -145,6 +163,15 @@ class ChaosEngine:
                 self.server.fault_injector = prior_injector
             report.events = self.events
             report.elapsed = time.monotonic() - start
+            if bundle == "always" or (bundle == "violation"
+                                      and not report.ok):
+                controller = getattr(self.system, "controller", None)
+                metrics = getattr(controller, "metrics", None) or {}
+                report.bundle_dir = flight.dump_bundle(
+                    f"chaos-{self.plan.name}",
+                    registry=metrics.get("registry"),
+                    clientset=getattr(self.system, "client", None),
+                    canonical_events=report.canonical_log())
         return report
 
     def _execute_timeline(self, start: float) -> None:
@@ -251,8 +278,9 @@ class ChaosEngine:
 
 def run(plan: FaultPlan, system, converge=None, timeout: float = 30.0,
         invariants: Sequence[Callable] = DEFAULT_INVARIANTS,
-        settle: float = 10.0, seed: Optional[int] = None) -> ChaosReport:
+        settle: float = 10.0, seed: Optional[int] = None,
+        bundle: Optional[str] = "violation") -> ChaosReport:
     """One-call form: ``chaos.run(plan, system)``."""
     return ChaosEngine(system, plan, seed=seed).run(
         converge=converge, timeout=timeout, invariants=invariants,
-        settle=settle)
+        settle=settle, bundle=bundle)

@@ -21,11 +21,12 @@ from ..api.defaults import set_defaults_mpijob
 from ..api.types import MPIJob, worker_replicas
 from ..api.validation import validate_mpijob
 from ..k8s import batch, core
-from ..k8s.apiserver import Clientset, is_conflict, is_not_found
+from ..k8s.apiserver import ApiError, Clientset, is_conflict, is_not_found
 from ..k8s.informers import InformerFactory
 from ..k8s.meta import Clock, deep_copy, get_controller_of
 from ..k8s.selectors import match_label_selector
 from ..k8s.workqueue import RateLimitingQueue
+from ..telemetry import flight
 from ..telemetry.trace import span
 from . import builders, metrics as metrics_pkg, status as status_pkg
 from .events import Recorder
@@ -90,12 +91,13 @@ class MPIJobController:
         self.cluster_domain = cluster_domain
         self.namespace = namespace
         self.pod_group_ctrl = pod_group_ctrl
-        self.recorder = recorder or Recorder(clientset)
         self.metrics = metrics or new_operator_metrics()
         # Hand-rolled metrics dicts (tests, embedders) may predate the
         # telemetry histograms; backfill them so the hot-path
         # instrumentation below never branches.
         metrics_pkg.backfill_telemetry_metrics(self.metrics)
+        self.recorder = recorder or Recorder(
+            clientset, registry=self.metrics.get("registry"))
 
         factory = informer_factory or InformerFactory(clientset, namespace)
         self.factory = factory
@@ -225,6 +227,20 @@ class MPIJobController:
                     logger.debug("conflict syncing %s, requeueing", key)
                 else:
                     logger.warning("error syncing %s: %s", key, exc)
+                    flight.record("controller", "sync_error", job=key,
+                                  error=f"{type(exc).__name__}: {exc}")
+                    if not isinstance(exc, ApiError):
+                        # A non-API failure is the panic analogue: a
+                        # controller bug, not cluster weather.  Black-box
+                        # it (once per exception type per process — a
+                        # crash-looping sync must not fill the disk).
+                        ns, _, name = key.partition("/")
+                        flight.dump_bundle(
+                            f"sync-panic-{type(exc).__name__}",
+                            registry=self.metrics.get("registry"),
+                            clientset=self.client, namespace=ns,
+                            job_name=name,
+                            once_key=f"sync-panic-{type(exc).__name__}")
                 self.queue.add_rate_limited(key)
             finally:
                 self.queue.done(key)
@@ -569,6 +585,7 @@ class MPIJobController:
                                   MPI_JOB_FAILED_REASON, msg, self.clock)
             self.recorder.event(job, core.EVENT_TYPE_WARNING,
                                 MPI_JOB_FAILED_REASON, msg)
+            self._black_box_failure(job, MPI_JOB_FAILED_REASON)
             return
 
         restarts = int(job.metadata.annotations.get(
@@ -583,6 +600,7 @@ class MPIJobController:
                                   self.clock)
             self.recorder.event(job, core.EVENT_TYPE_WARNING,
                                 JOB_BACKOFF_LIMIT_EXCEEDED_REASON, msg)
+            self._black_box_failure(job, JOB_BACKOFF_LIMIT_EXCEEDED_REASON)
             return
 
         msg = (f"worker {failed[0].metadata.name} exited with retryable code"
@@ -813,6 +831,7 @@ class MPIJobController:
                                   msg, self.clock)
             self.recorder.event(job, core.EVENT_TYPE_WARNING,
                                 MPI_JOB_EVICT_REASON, msg)
+            self._black_box_failure(job, MPI_JOB_EVICT_REASON)
 
         if self._suspended(job):
             msg = (f"MPIJob {job.metadata.namespace}/{job.metadata.name}"
@@ -876,6 +895,21 @@ class MPIJobController:
         update_job_conditions(job, constants.JOB_FAILED, core.CONDITION_TRUE,
                               reason, msg, self.clock)
         self.metrics["jobs_failed"].inc()
+        self._black_box_failure(job, reason)
+
+    def _black_box_failure(self, job: MPIJob, reason: str) -> None:
+        """A dead gang is exactly when the scattered evidence (events,
+        pod phases, chaos faults, worker sidecars) must be frozen into
+        one artifact: black-box the failure, once per job uid."""
+        flight.record("controller", "job_failed",
+                      job=f"{job.metadata.namespace}/{job.metadata.name}",
+                      reason=reason)
+        flight.dump_bundle(
+            f"job-failed-{job.metadata.name}",
+            registry=self.metrics.get("registry"),
+            clientset=self.client, namespace=job.metadata.namespace,
+            job_name=job.metadata.name,
+            once_key=f"job-failed-{job.metadata.uid or job.metadata.name}")
 
     def _update_status(self, job: MPIJob) -> None:
         """doUpdateJobStatus (:1327-1330).  Deliberately does NOT stamp a

@@ -3,37 +3,158 @@
 Equivalent of client-go tools/record as used by the reference
 (recorder creation at mpi_job_controller.go:303-308; FakeRecorder in the
 unit fixture).  Events land in the API server as v1 Event objects.
+
+Two hardenings over the plain recorder:
+
+- **Aggregation** (client-go EventAggregator semantics): repeats of the
+  same ``(involved object, type, reason, message)`` bump ``count`` /
+  ``last_timestamp`` on ONE Event instead of minting a fresh
+  uuid-named object per call — an event storm (chaos
+  ``api_error_burst``, a crash-looping gang) no longer floods the
+  apiserver registry.  Retained events are capped per namespace;
+  the oldest (by last-seen) are pruned past the cap.
+- **Narrowed failure handling**: only apiserver/transport errors are
+  best-effort-swallowed (counted in
+  ``mpi_operator_events_dropped_total``); programming errors (a
+  malformed job object from a sim path) propagate to the caller
+  instead of vanishing in a bare ``except``.
 """
 
 from __future__ import annotations
 
+import datetime
 import threading
+import urllib.error
 import uuid
 
-from ..k8s.apiserver import Clientset
+from ..k8s.apiserver import (ApiError, Clientset, is_conflict,
+                             is_not_found)
 from ..k8s.core import Event, ObjectReference
 from ..k8s.meta import ObjectMeta
+from ..telemetry.flight import record as flight_record
+from ..telemetry.metrics import Counter
+
+# Transport-shaped failures events are allowed to swallow: anything the
+# apiserver or the wire can throw at a correct client.  Everything else
+# (AttributeError from a half-built object, TypeError, ...) is a bug
+# and must surface.
+TRANSPORT_ERRORS = (ApiError, urllib.error.URLError, ConnectionError,
+                    TimeoutError, OSError)
+
+# client-go's default spam cap is 25 events/object burst + token
+# refill; here a simple per-namespace retention cap keeps the sim
+# registry bounded under storms.
+DEFAULT_NAMESPACE_EVENT_CAP = 256
 
 
 class Recorder:
-    def __init__(self, clientset: Clientset, component: str = "mpi-job-controller"):
+    def __init__(self, clientset: Clientset,
+                 component: str = "mpi-job-controller",
+                 registry=None,
+                 namespace_event_cap: int = DEFAULT_NAMESPACE_EVENT_CAP):
         self._cs = clientset
         self.component = component
+        self.namespace_event_cap = namespace_event_cap
+        self._lock = threading.Lock()
+        # (ns, kind, name, type, reason, message) -> aggregated Event name
+        self._agg: dict = {}
+        if registry is not None and hasattr(registry, "counter"):
+            self.dropped = registry.counter(
+                "mpi_operator_events_dropped_total",
+                "Events dropped on apiserver/transport errors")
+            self.aggregated = registry.counter(
+                "mpi_operator_events_aggregated_total",
+                "Event emissions folded into an existing Event's count")
+        else:
+            self.dropped = Counter(
+                "mpi_operator_events_dropped_total",
+                "Events dropped on apiserver/transport errors")
+            self.aggregated = Counter(
+                "mpi_operator_events_aggregated_total",
+                "Event emissions folded into an existing Event's count")
+
+    @staticmethod
+    def _now() -> datetime.datetime:
+        return datetime.datetime.now(datetime.timezone.utc)
 
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        namespace = obj.metadata.namespace or "default"
+        flight_record("controller", "event", object=f"{namespace}/"
+                      f"{obj.metadata.name}", type=event_type,
+                      reason=reason, message=message)
+        key = (namespace, obj.kind, obj.metadata.name, event_type, reason,
+               message)
+        with self._lock:
+            existing_name = self._agg.get(key)
+        now = self._now()
+        if existing_name is not None and self._bump(namespace,
+                                                    existing_name, now):
+            self.aggregated.inc()
+            return
         ev = Event(
             metadata=ObjectMeta(
                 name=f"{obj.metadata.name}.{uuid.uuid4().hex[:10]}",
-                namespace=obj.metadata.namespace or "default"),
+                namespace=namespace),
             involved_object=ObjectReference(
                 api_version=obj.api_version, kind=obj.kind,
                 name=obj.metadata.name, namespace=obj.metadata.namespace,
-                uid=obj.metadata.uid),
-            type=event_type, reason=reason, message=message)
+                uid=obj.metadata.uid or ""),
+            type=event_type, reason=reason, message=message,
+            count=1, first_timestamp=now, last_timestamp=now)
         try:
-            self._cs.events(ev.metadata.namespace).create(ev)
-        except Exception:
-            pass  # events are best-effort, like the real recorder
+            created = self._cs.events(namespace).create(ev)
+        except TRANSPORT_ERRORS:
+            self.dropped.inc()  # best-effort, like the real recorder
+            return
+        with self._lock:
+            self._agg[key] = created.metadata.name
+            # The aggregation index must not outgrow the registry it
+            # indexes: evict oldest keys past 8x the namespace cap.
+            while len(self._agg) > 8 * self.namespace_event_cap:
+                self._agg.pop(next(iter(self._agg)))
+        self._prune(namespace)
+
+    def _bump(self, namespace: str, name: str,
+              now: datetime.datetime) -> bool:
+        """Fold a repeat into the existing Event; returns False when the
+        aggregate target is gone (pruned/deleted) so the caller creates
+        a fresh one."""
+        for _ in range(3):  # conflict-retry: status writers race us
+            try:
+                stored = self._cs.events(namespace).get(name)
+                stored.count += 1
+                stored.last_timestamp = now
+                self._cs.events(namespace).update(stored)
+                return True
+            except TRANSPORT_ERRORS as exc:
+                if is_not_found(exc):
+                    return False
+                if is_conflict(exc):
+                    continue
+                self.dropped.inc()
+                return True  # transport failure: drop the repeat quietly
+        self.dropped.inc()
+        return True
+
+    def _prune(self, namespace: str) -> None:
+        """Cap retained events per namespace: oldest-by-last-seen go."""
+        try:
+            events = self._cs.events(namespace).list()
+            excess = len(events) - self.namespace_event_cap
+            if excess <= 0:
+                return
+            epoch = datetime.datetime(1970, 1, 1,
+                                      tzinfo=datetime.timezone.utc)
+            events.sort(key=lambda e: (e.last_timestamp
+                                       or e.metadata.creation_timestamp
+                                       or epoch))
+            for ev in events[:excess]:
+                try:
+                    self._cs.events(namespace).delete(ev.metadata.name)
+                except TRANSPORT_ERRORS:
+                    pass
+        except TRANSPORT_ERRORS:
+            pass  # retention is best-effort; next create retries
 
     def eventf(self, obj, event_type: str, reason: str, fmt: str, *args) -> None:
         self.event(obj, event_type, reason, fmt % args if args else fmt)

@@ -7,6 +7,7 @@ The subset of k8s.io/api/core/v1 the operator constructs and inspects
 
 from __future__ import annotations
 
+import datetime
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Union
 
@@ -899,6 +900,11 @@ class Event:
     reason: str = ""
     message: str = ""
     count: int = 1
+    # Aggregation window (client-go EventAggregator semantics): repeats
+    # of the same (object, type, reason, message) bump count and
+    # last_timestamp on one Event instead of creating N objects.
+    first_timestamp: Optional[datetime.datetime] = None
+    last_timestamp: Optional[datetime.datetime] = None
 
 
 def pod_running_and_ready(pod: Pod) -> bool:

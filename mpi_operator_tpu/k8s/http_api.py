@@ -29,7 +29,7 @@ from . import registry
 from .apiserver import ApiError, ApiServer, WatchEvent
 
 _ERROR_STATUS = {"NotFound": 404, "AlreadyExists": 409, "Conflict": 409,
-                 "Invalid": 422, "Forbidden": 403}
+                 "Invalid": 422, "Forbidden": 403, "Expired": 410}
 
 
 def _parse_selector(raw: Optional[str]) -> Optional[dict]:
@@ -91,7 +91,8 @@ class _Handler(BaseHTTPRequestHandler):
         parts, query, api_version = self._route()
         try:
             if parts and parts[0] == "watch" and len(parts) == 2:
-                return self._stream_watch(api_version, parts[1])
+                rv = query.get("resourceVersion", [None])[0]
+                return self._stream_watch(api_version, parts[1], rv)
             if len(parts) == 4 and parts[0] == "objects":
                 obj = self.store.get(api_version, parts[2], parts[1],
                                      parts[3])
@@ -133,8 +134,11 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(exc)
         self._json(404, {"code": "NotFound", "message": "no route"})
 
-    def _stream_watch(self, api_version: str, kind: str) -> None:
-        watch = self.store.watch(api_version, kind)
+    def _stream_watch(self, api_version: str, kind: str,
+                      resource_version: Optional[str] = None) -> None:
+        # A resume RV older than the kind's retained window raises 410
+        # Expired (before any stream bytes) — the client's relist cue.
+        watch = self.store.watch(api_version, kind, resource_version)
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
@@ -192,15 +196,29 @@ class ApiHttpServer:
 
 
 class _RemoteWatch:
-    """Client side of the ndjson watch stream (Watch-compatible)."""
+    """Client side of the ndjson watch stream (Watch-compatible).
 
-    def __init__(self, url: str):
+    Tracks the last delivered resourceVersion and resumes from it on
+    reconnect, so events during a connection gap replay instead of
+    being silently missed.  A 410 Expired resume (the RV fell out of
+    the server's retained window) surfaces as a RELIST sentinel — the
+    same contract the in-memory watch uses — and the next reconnect
+    starts from "now"."""
+
+    def __init__(self, url: str, resource_version: Optional[str] = None):
         self._q: "queue.Queue[WatchEvent]" = queue.Queue()
         self.stopped = False
         self._resp = None
+        self._rv = resource_version
         self._thread = threading.Thread(target=self._pump, args=(url,),
                                         daemon=True, name="remote-watch")
         self._thread.start()
+
+    def _url(self, base: str) -> str:
+        if not self._rv:
+            return base
+        sep = "&" if "?" in base else "?"
+        return f"{base}{sep}resourceVersion={self._rv}"
 
     def _pump(self, url: str) -> None:
         import time
@@ -212,7 +230,7 @@ class _RemoteWatch:
                 # peer (partition, power loss — no FIN) surfaces as a
                 # timeout and triggers reconnection instead of blocking
                 # forever.
-                resp = urllib.request.urlopen(url, timeout=5)
+                resp = urllib.request.urlopen(self._url(url), timeout=5)
                 self._resp = resp
                 if self.stopped:  # stop() may have raced the dial
                     return
@@ -225,9 +243,18 @@ class _RemoteWatch:
                         continue
                     data = json.loads(line)
                     obj = data.get("object")
-                    self._q.put(WatchEvent(
-                        data["type"],
-                        registry.decode(obj) if obj is not None else None))
+                    if obj is not None:
+                        obj = registry.decode(obj)
+                        rv = obj.metadata.resource_version
+                        if rv:
+                            self._rv = rv
+                    self._q.put(WatchEvent(data["type"], obj))
+            except urllib.error.HTTPError as exc:
+                if exc.code == 410:
+                    # Resume RV expired: tell the consumer to relist
+                    # (RELIST sentinel) and restart the stream from now.
+                    self._rv = None
+                    self._q.put(WatchEvent("RELIST", None))
             except Exception:
                 pass  # connection lost/timed out; fall through to reconnect
             finally:
@@ -238,8 +265,8 @@ class _RemoteWatch:
                         pass
             if self.stopped:
                 return
-            # Reconnect with backoff.  Events during the gap are missed;
-            # the informer's periodic resync reconciles them.
+            # Reconnect with backoff, resuming from the last delivered
+            # RV so gap events replay from the server's watch history.
             time.sleep(backoff)
             backoff = min(backoff * 2, 5.0)
 
@@ -327,6 +354,8 @@ class RemoteApiServer:
             "DELETE", f"/objects/{namespace}/{kind}/{name}"
             + self._qs(api_version)))
 
-    def watch(self, api_version: str, kind: str) -> _RemoteWatch:
+    def watch(self, api_version: str, kind: str,
+              resource_version: Optional[str] = None) -> _RemoteWatch:
         return _RemoteWatch(
-            self.base + f"/watch/{kind}" + self._qs(api_version))
+            self.base + f"/watch/{kind}" + self._qs(api_version),
+            resource_version=resource_version)

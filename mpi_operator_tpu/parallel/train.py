@@ -235,6 +235,16 @@ def run_train_loop(state, step_fn, batches, checkpoint_manager=None,
     def handle_preemption(saved_this_step: bool):
         if checkpoint_manager is not None and not saved_this_step:
             checkpoint_manager.save(state, step)
+        # Black-box the exit: record the preemption on the flight ring,
+        # export it as a sidecar (so the controller's bundle gets a
+        # train lane), and dump this process's own bundle — SystemExit
+        # never reaches sys.excepthook, so this is the only shot.
+        from ..telemetry import flight
+        flight.record("train", "preemption", step=step,
+                      checkpointed=checkpoint_manager is not None,
+                      exit_code=PREEMPTION_EXIT_CODE)
+        flight.export_sidecar()
+        flight.dump_bundle("train-preemption")
         if exit_on_preemption:
             raise SystemExit(PREEMPTION_EXIT_CODE)
 

@@ -36,6 +36,7 @@ from typing import Optional
 from ..api import constants as api_constants
 from ..k8s import core
 from ..k8s.apiserver import ApiServer, Clientset, is_conflict, is_not_found
+from ..telemetry import flight
 from . import gangsim, netsim
 
 logger = logging.getLogger("mpi_operator_tpu.runtime.kubelet")
@@ -537,6 +538,10 @@ class LocalKubelet:
                 ready=ready, restart_count=restart_count, state=state)]
             try:
                 self.client.pods(namespace).update_status(pod)
+                flight.record("kubelet", "pod_phase",
+                              pod=f"{namespace}/{name}", phase=phase,
+                              reason=reason, restart_count=restart_count,
+                              exit_code=exit_code)
                 return
             except Exception as exc:
                 if is_not_found(exc):

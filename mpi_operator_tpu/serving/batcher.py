@@ -1069,7 +1069,6 @@ class ContinuousBatcher:
                     admitted = True
                 except Exception as exc:
                     req.error = exc
-                    req.done.set()
                     if donated:
                         # The failed call may have consumed (donated)
                         # the KV-cache buffers: self._cache is no longer
@@ -1081,9 +1080,25 @@ class ContinuousBatcher:
                         # Fail the whole batcher loudly instead.
                         self.fatal_error = exc
                         self._stop.set()
+                        # Black-box the death BEFORE unblocking the
+                        # requester: when submit() raises, the bundle
+                        # (queue state, metrics, the tripping request)
+                        # is already on disk.
+                        from ..telemetry import flight
+                        flight.record(
+                            "serving", "fatal_error",
+                            error=f"{type(exc).__name__}: {exc}",
+                            queue_depth=self._queue.qsize(),
+                            prompt_tokens=len(req.tokens))
+                        flight.dump_bundle(
+                            "batcher-fatal",
+                            registry=self.telemetry["registry"],
+                            once_key=f"batcher-fatal-{id(self)}")
+                        req.done.set()
                         break
                     # Dense prefill does not donate: the failure is
                     # slot-local — surface it, don't kill the loop.
+                    req.done.set()
                     self._retire_slot(i)
 
             if self._stop.is_set():

@@ -89,6 +89,17 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/debug-bundle":
+            # On-demand black box: freeze the flight ring + metrics for
+            # a live-but-misbehaving server without killing it.
+            server: "InferenceServer" = self.server.inference  # type: ignore
+            from ..telemetry import flight
+            path = flight.dump_bundle(
+                "serving-on-demand", registry=server.telemetry_registry)
+            if path is None:
+                self._respond(500, {"error": "bundle dump failed"})
+            else:
+                self._respond(200, {"bundle": path})
         else:
             self._respond(404, {"error": "not found"})
 

@@ -12,6 +12,10 @@ Zero-dependency observability for all three layers of the stack
 - :mod:`.goodput` — per-step wall-time attribution for train loops
   (productive vs compile vs data-wait vs checkpoint vs resync) with a
   goodput-fraction gauge.
+- :mod:`.flight` — the crash-surviving black box: a bounded ring
+  buffer every layer feeds, dumped as a debug bundle (ring JSONL +
+  canonical event log + merged per-layer Chrome trace + /metrics
+  snapshot + involved-job state) on fatal paths.
 
 Every process has one :func:`default_registry`; per-app registries
 (operator metrics, serving metrics) are exposed *alongside* it via
@@ -28,3 +32,6 @@ from .trace import (Tracer, default_tracer, read_jsonl, span,  # noqa: F401
                     to_chrome_trace)
 from .goodput import (GOODPUT_BUCKETS, GoodputTracker,  # noqa: F401
                       instrument_step)
+from .flight import (FlightRecorder, default_recorder,  # noqa: F401
+                     dump_bundle, export_sidecar, flag_fatal,
+                     install_crash_handler, merged_chrome_trace, record)

@@ -1,0 +1,528 @@
+"""Flight recorder — the crash-surviving black box for the whole stack.
+
+MLPerf-scale TPU postmortems (arXiv:2011.03641, arXiv:1909.09756) all
+start from one correlated timeline: which fault fired, what the
+controller did about it, how the gang restarted, and where the train
+loop's wall time went.  This module is that timeline.  Every layer
+feeds one bounded, thread-safe ring buffer:
+
+- **controller** — Recorder events and sync errors,
+- **kubelet** — pod phase transitions,
+- **train** — goodput phase transitions and preemption notices,
+- **serving** — batcher `fatal_error`,
+- **chaos** — fault injections / heals / invariant verdicts,
+
+each entry a monotonic-sequenced record with a stable
+``(layer, kind)`` schema::
+
+    {"seq": int, "ts": float, "layer": str, "kind": str, "data": {...}}
+
+On fatal paths (controller job failure, batcher ``fatal_error``,
+``run_train_loop`` preemption, chaos invariant violation, unhandled
+exception via :func:`install_crash_handler`) :func:`dump_bundle`
+writes a **black-box bundle** to the debug dir:
+
+    bundle-<reason>-<pid>-<n>/
+      flight.jsonl    the full ring (wall timestamps, all layers)
+      events.jsonl    the canonical event section — timestamp-free,
+                      chaos/engine.py CANONICAL_FIELDS ordering, so two
+                      identical seeded runs produce byte-identical files
+      trace.json      merged Chrome trace: spans + flight records in
+                      stable per-layer lanes (perfetto/chrome://tracing)
+      metrics.prom    a /metrics exposition snapshot
+      job.json        the involved job(s): conditions + last events
+      MANIFEST.json   reason + artifact inventory
+
+Worker subprocesses export their ring as a *sidecar* JSONL
+(:func:`export_sidecar`, ``$MPI_OPERATOR_FLIGHT_DIR``); the dumper
+merges sidecars into the trace so the training layer appears in the
+control plane's bundle — one timeline across processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Iterable, List, Optional
+
+from .metrics import expose_with_defaults
+from .trace import default_tracer
+
+DEBUG_DIR_ENV = "MPI_OPERATOR_DEBUG_DIR"
+FLIGHT_DIR_ENV = "MPI_OPERATOR_FLIGHT_DIR"
+
+# Stable lane order for the merged Chrome trace (pid = index + 1).
+LAYERS = ("controller", "kubelet", "train", "serving", "chaos",
+          "apiserver", "other")
+
+# Span-name prefix -> layer lane for tracer events in the merged trace.
+_SPAN_LAYERS = (("reconcile", "controller"), ("chaos", "chaos"),
+                ("checkpoint", "train"), ("train", "train"),
+                ("profile", "train"), ("serv", "serving"),
+                ("prefill", "serving"), ("decode", "serving"))
+
+# Canonical view field order — mirrors chaos.engine.CANONICAL_FIELDS'
+# contract: no wall-clock fields, stable key order, so canonical
+# exports of identical seeded runs diff (and hash) clean.
+CANONICAL_FIELDS = ("layer", "kind", "data")
+
+
+def debug_dir() -> str:
+    """Where bundles land: $MPI_OPERATOR_DEBUG_DIR, else a stable
+    tempdir subpath (never the CWD — fatal paths run in arbitrary
+    working directories)."""
+    return os.environ.get(DEBUG_DIR_ENV) or os.path.join(
+        tempfile.gettempdir(), "mpi-operator-tpu-debug")
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring buffer of structured records.
+
+    Overwrite semantics: the ring keeps the most recent ``max_records``
+    entries; ``seq`` keeps counting, so ``dropped`` (= seq - len) says
+    how much history the crash outlived.
+    """
+
+    def __init__(self, max_records: int = 4096):
+        self.max_records = max_records
+        self._records: deque = deque(maxlen=max_records)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, layer: str, kind: str, /, **data) -> dict:
+        # layer/kind are positional-only: payloads legitimately carry
+        # their own "kind"/"layer" keys (chaos fault fields).
+        if layer not in LAYERS:
+            layer = "other"
+        with self._lock:
+            rec = {"seq": self._seq, "ts": round(time.time(), 6),
+                   "layer": layer, "kind": kind, "data": data}
+            self._seq += 1
+            self._records.append(rec)
+            return rec
+
+    # -- access ------------------------------------------------------------
+    def records(self, layer: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._records)
+        if layer is not None:
+            out = [r for r in out if r["layer"] == layer]
+        return out
+
+    @property
+    def seq(self) -> int:
+        """Total records ever written (survivors + overwritten)."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._seq - len(self._records)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+    # -- export ------------------------------------------------------------
+    def export_jsonl(self, path_or_file) -> int:
+        records = self.records()
+        if isinstance(path_or_file, (str, os.PathLike)):
+            with open(path_or_file, "w") as f:
+                return self.export_jsonl(f)
+        for rec in records:
+            path_or_file.write(json.dumps(rec) + "\n")
+        return len(records)
+
+    def canonical_records(self, layers: Iterable[str] = ("chaos",)
+                          ) -> List[dict]:
+        """The reproducible view: no seq (global interleaving is
+        scheduler-dependent), no ts — only layers whose feed order is
+        deterministic under a seeded plan (chaos by default)."""
+        wanted = set(layers)
+        return [{k: rec[k] for k in CANONICAL_FIELDS}
+                for rec in self.records() if rec["layer"] in wanted]
+
+
+_DEFAULT = FlightRecorder()
+_tracer_wired = False
+_wire_lock = threading.Lock()
+
+
+def default_recorder() -> FlightRecorder:
+    """The process-wide ring; first use wires span completions from the
+    default tracer into it (kind="span", layer by span-name prefix)."""
+    global _tracer_wired
+    if not _tracer_wired:
+        with _wire_lock:
+            if not _tracer_wired:
+                default_tracer().add_listener(_span_listener)
+                _tracer_wired = True
+    return _DEFAULT
+
+
+def _span_layer(name: str) -> str:
+    for prefix, layer in _SPAN_LAYERS:
+        if name.startswith(prefix):
+            return layer
+    return "other"
+
+
+def _span_listener(event: dict) -> None:
+    data = {"name": event["name"], "dur": event["dur"]}
+    if event.get("error"):
+        data["error"] = event["error"]
+    if event.get("attrs"):
+        data["attrs"] = event["attrs"]
+    _DEFAULT.record(_span_layer(event["name"]), "span", **data)
+
+
+def record(layer: str, kind: str, /, **data) -> dict:
+    """``flight.record("kubelet", "pod_phase", pod=..., phase=...)`` on
+    the default ring."""
+    return default_recorder().record(layer, kind, **data)
+
+
+# ---------------------------------------------------------------------------
+# Merged Chrome trace
+# ---------------------------------------------------------------------------
+
+def merged_chrome_trace(span_events: Iterable[dict],
+                        flight_records: Iterable[dict],
+                        extra_records: Iterable[dict] = ()) -> dict:
+    """One Chrome trace with a stable lane (pid) per layer.
+
+    Spans render as complete (ph=X) events in the lane their name maps
+    to; flight records render as instant (ph=i) events — except records
+    carrying a ``seconds``/``dur`` payload, which render as X so phase
+    durations are visible.  Chaos records carrying a plan offset
+    (``at``) are placed at that deterministic offset instead of wall
+    time, reusing chaos/engine.py's timestamp-free ordering so chaos
+    lanes diff cleanly across identical seeded runs.
+    """
+    lane = {layer: i + 1 for i, layer in enumerate(LAYERS)}
+    trace_events = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": layer}}
+        for layer, pid in sorted(lane.items(), key=lambda kv: kv[1])]
+
+    for e in span_events:
+        args = dict(e.get("attrs") or {})
+        if e.get("error"):
+            args["error"] = e["error"]
+        trace_events.append({
+            "name": e["name"], "ph": "X", "cat": "span",
+            "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
+            "pid": lane[_span_layer(e["name"])],
+            "tid": e.get("tid", 0), "args": args})
+
+    def _add_record(rec, local: bool) -> None:
+        if rec.get("kind") == "span":
+            if local:
+                return  # local spans are already in the tracer events
+            # A sidecar (remote-process) span has no local tracer event;
+            # render it here or worker spans vanish from the timeline.
+            data = dict(rec.get("data") or {})
+            name = data.pop("name", "span")
+            dur = float(data.pop("dur", 0.0) or 0.0)
+            trace_events.append({
+                "name": name, "ph": "X", "cat": "span",
+                "ts": rec.get("ts", 0.0) * 1e6, "dur": dur * 1e6,
+                "pid": lane[_span_layer(name)], "tid": 0,
+                "args": dict(data.get("attrs") or {})})
+            return
+        data = dict(rec.get("data") or {})
+        layer = rec.get("layer", "other")
+        ts = rec.get("ts", 0.0) * 1e6
+        if layer == "chaos" and isinstance(data.get("at"), (int, float)):
+            ts = float(data["at"]) * 1e6  # plan-relative: deterministic
+        dur = data.get("seconds", data.get("dur"))
+        ev = {"name": rec.get("kind", "record"), "ph": "i", "cat": "flight",
+              "ts": ts, "pid": lane.get(layer, lane["other"]), "tid": 0,
+              "s": "t", "args": {"layer": layer, **data}}
+        if isinstance(dur, (int, float)):
+            ev.update(ph="X", dur=float(dur) * 1e6)
+            ev.pop("s")
+        trace_events.append(ev)
+
+    for rec in flight_records:
+        _add_record(rec, local=True)
+    for rec in extra_records:
+        _add_record(rec, local=False)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Sidecars: cross-process timeline merge
+# ---------------------------------------------------------------------------
+
+def export_sidecar(recorder: Optional[FlightRecorder] = None,
+                   directory: Optional[str] = None) -> Optional[str]:
+    """Write this process's ring as ``flight-<pid>.jsonl`` into the
+    shared flight dir so another process's bundle can merge it (workers
+    call this on preemption/exit; no-op when no dir is configured)."""
+    directory = directory or os.environ.get(FLIGHT_DIR_ENV)
+    if not directory:
+        return None
+    recorder = recorder or default_recorder()
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"flight-{os.getpid()}.jsonl")
+        recorder.export_jsonl(path)
+        return path
+    except OSError:
+        return None
+
+
+def _read_sidecars(directory: Optional[str],
+                   max_age: float = 3600.0) -> List[dict]:
+    directory = directory or os.environ.get(FLIGHT_DIR_ENV)
+    if not directory or not os.path.isdir(directory):
+        return []
+    out: List[dict] = []
+    own = f"flight-{os.getpid()}.jsonl"
+    now = time.time()
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("flight-") and name.endswith(".jsonl")):
+            continue
+        if name == own:
+            continue  # the dumper's ring is already in the bundle
+        path = os.path.join(directory, name)
+        try:
+            if now - os.path.getmtime(path) > max_age:
+                continue  # leftover from an earlier run (pid recycled)
+            with open(path) as f:
+                for line in f:
+                    if line.strip():
+                        out.append(json.loads(line))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Black-box bundles
+# ---------------------------------------------------------------------------
+
+_bundle_lock = threading.Lock()
+_bundle_count = 0
+_bundle_once_keys: set = set()
+_in_dump = threading.local()
+
+
+def job_snapshot(clientset, namespace: Optional[str] = None,
+                 name: Optional[str] = None) -> dict:
+    """Conditions + last events for the involved job(s) — the
+    ``kubectl describe`` evidence, frozen into the bundle."""
+    jobs = []
+    try:
+        if name is not None:
+            listed = [clientset.mpi_jobs(namespace or "default").get(name)]
+        else:
+            listed = clientset.server.list("kubeflow.org/v2beta1", "MPIJob",
+                                           namespace)
+        all_events = clientset.server.list("v1", "Event", namespace)
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}", "jobs": []}
+    for job in listed:
+        jobs.append({
+            "name": job.metadata.name,
+            "namespace": job.metadata.namespace,
+            "uid": job.metadata.uid,
+            "conditions": [
+                {"type": c.type, "status": c.status, "reason": c.reason,
+                 "message": c.message} for c in job.status.conditions],
+            "events": [
+                {"type": e.type, "reason": e.reason, "message": e.message,
+                 "count": e.count}
+                for e in all_events
+                if e.involved_object.name == job.metadata.name],
+        })
+    return {"jobs": jobs}
+
+
+def dump_bundle(reason: str,
+                directory: Optional[str] = None,
+                recorder: Optional[FlightRecorder] = None,
+                tracer=None,
+                registry=None,
+                job_payload: Optional[dict] = None,
+                clientset=None,
+                namespace: Optional[str] = None,
+                job_name: Optional[str] = None,
+                canonical_events: Optional[List[dict]] = None,
+                include_sidecars: bool = True,
+                metrics_text: Optional[str] = None,
+                once_key: Optional[str] = None) -> Optional[str]:
+    """Write a black-box bundle; returns its path (None when skipped).
+
+    ``once_key`` dedups per process (a crash loop must not fill the
+    disk with identical bundles).  ``canonical_events`` overrides the
+    canonical section (chaos bundles pass the report's canonical log);
+    otherwise the ring's chaos layer is used.  Never raises: the black
+    box must not add a second failure to the first.
+    """
+    if getattr(_in_dump, "active", False):
+        return None  # a failure inside the dump must not recurse
+    _in_dump.active = True
+    try:
+        return _dump_bundle_inner(
+            reason, directory, recorder, tracer, registry, job_payload,
+            clientset, namespace, job_name, canonical_events,
+            include_sidecars, metrics_text, once_key)
+    except Exception as exc:  # pragma: no cover - last-resort guard
+        print(f"flight: bundle dump failed: {exc}", file=sys.stderr)
+        return None
+    finally:
+        _in_dump.active = False
+
+
+def _slug(text: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "-"
+                   for c in text)[:64].strip("-") or "bundle"
+
+
+def _dump_bundle_inner(reason, directory, recorder, tracer, registry,
+                       job_payload, clientset, namespace, job_name,
+                       canonical_events, include_sidecars, metrics_text,
+                       once_key) -> Optional[str]:
+    global _bundle_count
+    with _bundle_lock:
+        if once_key is not None:
+            if once_key in _bundle_once_keys:
+                return None
+            _bundle_once_keys.add(once_key)
+        _bundle_count += 1
+        count = _bundle_count
+    recorder = recorder or default_recorder()
+    tracer = tracer or default_tracer()
+    base = directory or debug_dir()
+    path = os.path.join(
+        base, f"bundle-{_slug(reason)}-{os.getpid()}-{count}")
+    os.makedirs(path, exist_ok=True)
+
+    recorder.record("other", "bundle", reason=reason, path=path)
+
+    # 1. flight.jsonl — the full ring.
+    recorder.export_jsonl(os.path.join(path, "flight.jsonl"))
+
+    # 2. events.jsonl — the canonical (timestamp-free) event section.
+    if canonical_events is None:
+        canonical_events = recorder.canonical_records()
+    with open(os.path.join(path, "events.jsonl"), "w") as f:
+        for ev in canonical_events:
+            f.write(json.dumps(ev) + "\n")
+
+    # 3. trace.json — merged per-layer timeline (+ worker sidecars).
+    sidecars = _read_sidecars(None) if include_sidecars else []
+    trace = merged_chrome_trace(tracer.events(), recorder.records(),
+                                sidecars)
+    with open(os.path.join(path, "trace.json"), "w") as f:
+        json.dump(trace, f)
+
+    # 4. metrics.prom — /metrics snapshot (an already-fetched remote
+    # exposition wins over the local process registries).
+    exposition = (metrics_text if metrics_text is not None
+                  else expose_with_defaults(registry))
+    with open(os.path.join(path, "metrics.prom"), "w") as f:
+        f.write(exposition or "# (no metric families registered)\n")
+
+    # 5. job.json — involved job(s): conditions + last events.
+    if job_payload is None and clientset is not None:
+        job_payload = job_snapshot(clientset, namespace, job_name)
+    with open(os.path.join(path, "job.json"), "w") as f:
+        json.dump(job_payload if job_payload is not None
+                  else {"jobs": []}, f, indent=2, default=str)
+
+    manifest = {
+        "reason": reason,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "pid": os.getpid(),
+        "ring": {"records": len(recorder.records()),
+                 "total": recorder.seq,
+                 "dropped": recorder.dropped},
+        "sidecar_records": len(sidecars),
+        "artifacts": ["flight.jsonl", "events.jsonl", "trace.json",
+                      "metrics.prom", "job.json"],
+    }
+    with open(os.path.join(path, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Crash handler: unhandled exception / atexit
+# ---------------------------------------------------------------------------
+
+_crash_installed = False
+
+
+def install_crash_handler(directory: Optional[str] = None,
+                          registry=None) -> None:
+    """Chain into ``sys.excepthook`` / ``threading.excepthook`` so an
+    unhandled exception dumps a bundle before the process dies, and
+    register an atexit hook that dumps when a layer flagged a fatal
+    (:func:`flag_fatal`) that never surfaced as an exception.
+
+    ``registry`` may be a Registry or a zero-arg callable resolved at
+    dump time — the operator app creates its metrics registry lazily
+    (on winning leadership), after the handler must already be armed.
+    """
+    global _crash_installed
+    if _crash_installed:
+        return
+    _crash_installed = True
+    prev_hook = sys.excepthook
+    prev_thread_hook = threading.excepthook
+
+    def _registry():
+        try:
+            return registry() if callable(registry) else registry
+        except Exception:
+            return None
+
+    def _hook(exc_type, exc, tb):
+        if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+            record("other", "unhandled_exception",
+                   type=exc_type.__name__, error=str(exc))
+            dump_bundle(f"crash-{exc_type.__name__}", directory=directory,
+                        registry=_registry(), once_key="crash")
+        prev_hook(exc_type, exc, tb)
+
+    def _thread_hook(args):
+        if args.exc_type is not None and not issubclass(
+                args.exc_type, SystemExit):
+            record("other", "unhandled_exception",
+                   type=args.exc_type.__name__, error=str(args.exc_value),
+                   thread=getattr(args.thread, "name", ""))
+            dump_bundle(f"crash-{args.exc_type.__name__}",
+                        directory=directory, registry=_registry(),
+                        once_key=f"thread-crash-{args.exc_type.__name__}")
+        prev_thread_hook(args)
+
+    sys.excepthook = _hook
+    threading.excepthook = _thread_hook
+
+    import atexit
+
+    def _atexit_dump():
+        if _fatal_flags and "crash" not in _bundle_once_keys:
+            dump_bundle(f"atexit-{_fatal_flags[0]}", directory=directory,
+                        registry=_registry(), once_key="atexit")
+
+    atexit.register(_atexit_dump)
+
+
+_fatal_flags: List[str] = []
+
+
+def flag_fatal(reason: str, **data) -> None:
+    """Mark the process as dying for ``reason``: records it and arms
+    the atexit dump (for fatal paths that exit without an exception)."""
+    record("other", "fatal", reason=reason, **data)
+    _fatal_flags.append(_slug(reason))

@@ -54,6 +54,9 @@ class GoodputTracker:
         self._steps = 0
         self._gauge = None
         self._step_hist = None
+        # Flight feed state: only *transitions* between buckets are
+        # recorded (per-step productive adds would be pure ring noise).
+        self._last_bucket: Optional[str] = None
         if registry is not None:
             self._gauge = registry.gauge(
                 f"{gauge_prefix}_goodput_fraction",
@@ -76,6 +79,12 @@ class GoodputTracker:
                     self._step_hist.observe(seconds)
             if self._gauge is not None:
                 self._gauge.set(self._fraction_locked(PRODUCTIVE))
+            transitioned = bucket != self._last_bucket
+            self._last_bucket = bucket
+        if transitioned:
+            from .flight import record as flight_record
+            flight_record("train", "goodput_phase", bucket=bucket,
+                          seconds=round(seconds, 6))
 
     @contextlib.contextmanager
     def account(self, bucket: str):

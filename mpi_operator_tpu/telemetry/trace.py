@@ -36,6 +36,21 @@ class Tracer:
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._local = threading.local()
+        # Completion listeners (flight recorder feed); see add_listener.
+        self._listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(event_dict)`` to run on every span completion
+        (after the event lands in the buffer).  Listener errors are
+        swallowed — observability must never fail the observed code."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     # -- recording ---------------------------------------------------------
     def _stack(self) -> list:
@@ -71,6 +86,12 @@ class Tracer:
             stack.pop()
             with self._lock:
                 self._events.append(event)
+                listeners = list(self._listeners)
+            for fn in listeners:
+                try:
+                    fn(event)
+                except Exception:
+                    pass  # listeners must never fail the traced code
 
     def current_span(self) -> Optional[dict]:
         stack = self._stack()

@@ -157,6 +157,30 @@ spec:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "Succeeded" in proc.stdout
         assert "MPIJobCreated" in proc.stdout  # events section
+        assert "LAST-SEEN" in proc.stdout  # aggregated event tail header
+
+        # The observability verbs against the same live cluster.
+        proc = run_cli("events", "--master", master)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "MPIJobCreated" in proc.stdout
+        assert "desc-me" in proc.stdout  # OBJECT column
+
+        proc = run_cli("top", "--once", "--master", master)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "desc-me" in proc.stdout
+        assert "pods:" in proc.stdout
+
+        bundle_dir = tmp_path / "bundles"
+        proc = run_cli("debug-bundle", "desc-me", "--master", master,
+                       "-o", str(bundle_dir))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "debug bundle written" in proc.stdout
+        import json as json_mod
+        (bundle,) = bundle_dir.iterdir()
+        job_payload = json_mod.load(open(bundle / "job.json"))
+        assert job_payload["jobs"][0]["name"] == "desc-me"
+        assert any(c["type"] == "Succeeded"
+                   for c in job_payload["jobs"][0]["conditions"])
     finally:
         cluster.terminate()
         try:

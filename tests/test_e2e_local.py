@@ -39,6 +39,22 @@ def jax_job(name, launcher_cmd, worker_cmd, workers=2, **spec_kwargs):
             **spec_kwargs))
 
 
+def set_suspend(cluster, name, suspend=True, namespace="default"):
+    """get -> mutate -> update with conflict retry: the controller's
+    concurrent status writes bump the RV between our get and update
+    (expected optimistic-concurrency behavior, not a failure)."""
+    from mpi_operator_tpu.k8s.apiserver import is_conflict
+    for _ in range(10):
+        stored = cluster.client.mpi_jobs(namespace).get(name)
+        stored.spec.run_policy.suspend = suspend
+        try:
+            return cluster.client.mpi_jobs(namespace).update(stored)
+        except Exception as exc:
+            if not is_conflict(exc):
+                raise
+    raise AssertionError(f"suspend update on {name}: conflicts exhausted")
+
+
 def test_e2e_trivial_job_succeeds():
     """TestMPIJobSuccess analogue: everything runs, job reaches Succeeded."""
     with LocalCluster() as cluster:
@@ -86,9 +102,7 @@ def test_e2e_suspend_before_start_then_resume():
         assert cluster.client.pods("default").list(
             {"training.kubeflow.org/job-role": "worker"}) == []
 
-        stored = cluster.client.mpi_jobs("default").get("susp")
-        stored.spec.run_policy.suspend = False
-        cluster.client.mpi_jobs("default").update(stored)
+        set_suspend(cluster, "susp", suspend=False)
         cluster.wait_for_condition("default", "susp", constants.JOB_SUCCEEDED,
                                    timeout=30)
 
@@ -395,9 +409,7 @@ def test_e2e_gang_scheduling_podgroup_lifecycle():
             "scheduling.k8s.io/group-name"] == "gang"
 
         # Suspend -> PodGroup (and workers) torn down.
-        stored = cluster.client.mpi_jobs("default").get("gang")
-        stored.spec.run_policy.suspend = True
-        cluster.client.mpi_jobs("default").update(stored)
+        set_suspend(cluster, "gang")
         def pg_gone():
             try:
                 cluster.client.volcano_pod_groups("default").get("gang")
@@ -636,9 +648,7 @@ def test_e2e_suspend_while_gated_tears_down_cleanly():
         cluster.wait_for_condition("default", "sgate",
                                    constants.JOB_WORKERS_GATED, timeout=30)
 
-        stored = cluster.client.mpi_jobs("default").get("sgate")
-        stored.spec.run_policy.suspend = True
-        cluster.client.mpi_jobs("default").update(stored)
+        set_suspend(cluster, "sgate")
 
         suspended = cluster.wait_for_condition(
             "default", "sgate", constants.JOB_SUSPENDED, timeout=30)

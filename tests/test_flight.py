@@ -1,0 +1,457 @@
+"""Flight-recorder tests: ring-buffer semantics, black-box bundles on
+the fatal paths (batcher fatal_error, chaos invariant violation, train
+preemption), canonical byte-identical event sections across identical
+seeded runs, event aggregation + the narrowed Recorder error handling,
+and `events --watch` 410-relist resume.
+"""
+
+import json
+import os
+import threading
+import time
+import types
+
+import pytest
+
+from mpi_operator_tpu import chaos
+from mpi_operator_tpu.api.types import MPIJob
+from mpi_operator_tpu.controller.events import Recorder
+from mpi_operator_tpu.k8s.apiserver import ApiError, ApiServer, Clientset
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+from mpi_operator_tpu.telemetry import flight
+from mpi_operator_tpu.telemetry.metrics import Registry
+from mpi_operator_tpu.telemetry.trace import Tracer
+
+
+# ---------------------------------------------------------------------------
+# Ring buffer
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_overwrite_under_concurrent_writers():
+    rec = flight.FlightRecorder(max_records=100)
+    writers, per_writer = 4, 200
+
+    def write(layer_i):
+        for i in range(per_writer):
+            rec.record("kubelet", "pod_phase", writer=layer_i, i=i)
+
+    threads = [threading.Thread(target=write, args=(w,))
+               for w in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    records = rec.records()
+    assert len(records) == 100  # bounded: only the newest survive
+    assert rec.seq == writers * per_writer
+    assert rec.dropped == writers * per_writer - 100
+    seqs = [r["seq"] for r in records]
+    assert seqs == sorted(seqs)  # monotonic, no duplicates
+    assert len(set(seqs)) == len(seqs)
+    # The survivors are exactly the newest window.
+    assert min(seqs) == writers * per_writer - 100
+
+
+def test_record_schema_and_canonical_view():
+    rec = flight.FlightRecorder()
+    rec.record("chaos", "inject", kind="pod_kill", at=1.0, seq=0)
+    rec.record("controller", "event", reason="Created")
+    (chaos_rec, ctrl_rec) = rec.records()
+    for r in (chaos_rec, ctrl_rec):
+        assert set(r) == {"seq", "ts", "layer", "kind", "data"}
+    canon = rec.canonical_records()  # chaos layer only by default
+    assert canon == [{"layer": "chaos", "kind": "inject",
+                      "data": {"kind": "pod_kill", "at": 1.0, "seq": 0}}]
+    assert "ts" not in canon[0] and "seq" not in canon[0]
+
+
+def test_span_completions_feed_default_ring():
+    from mpi_operator_tpu.telemetry.trace import span
+    rec = flight.default_recorder()
+    before = rec.seq
+    with span("reconcile", job="ns/j"):
+        pass
+    spans = [r for r in rec.records("controller")
+             if r["kind"] == "span" and r["seq"] >= before]
+    assert spans and spans[-1]["data"]["name"] == "reconcile"
+
+
+def test_merged_chrome_trace_stable_lanes():
+    tr = Tracer()
+    with tr.span("reconcile", job="a/b"):
+        pass
+    rec = flight.FlightRecorder()
+    rec.record("kubelet", "pod_phase", pod="a/p", phase="Running")
+    rec.record("chaos", "inject", kind="pod_kill", at=2.5)
+    rec.record("train", "goodput_phase", bucket="productive",
+               seconds=0.25)
+    trace = flight.merged_chrome_trace(tr.events(), rec.records())
+    lanes = {e["args"]["name"]: e["pid"] for e in trace["traceEvents"]
+             if e.get("ph") == "M"}
+    assert [lanes[layer] for layer in ("controller", "kubelet", "train",
+                                       "serving", "chaos")] == \
+        [1, 2, 3, 4, 5]  # stable lane numbering
+    by_pid = {}
+    for e in trace["traceEvents"]:
+        if e.get("ph") != "M":
+            by_pid.setdefault(e["pid"], []).append(e)
+    assert lanes["controller"] in by_pid  # span landed in its lane
+    assert lanes["kubelet"] in by_pid
+    # Chaos events sit at their deterministic plan offset, not wall time.
+    (chaos_ev,) = by_pid[lanes["chaos"]]
+    assert chaos_ev["ts"] == pytest.approx(2.5e6)
+    # Duration-carrying records render as complete events.
+    (train_ev,) = by_pid[lanes["train"]]
+    assert train_ev["ph"] == "X" and train_ev["dur"] == pytest.approx(0.25e6)
+
+
+# ---------------------------------------------------------------------------
+# Bundles
+# ---------------------------------------------------------------------------
+
+BUNDLE_ARTIFACTS = ("flight.jsonl", "events.jsonl", "trace.json",
+                    "metrics.prom", "job.json", "MANIFEST.json")
+
+
+def _bundles(root):
+    return sorted(str(p) for p in os.listdir(root)
+                  if p.startswith("bundle-"))
+
+
+def test_dump_bundle_writes_all_artifacts(tmp_path):
+    rec = flight.FlightRecorder()
+    rec.record("chaos", "inject", kind="pod_kill", at=0.0, seq=0)
+    path = flight.dump_bundle("unit-test", directory=str(tmp_path),
+                              recorder=rec, registry=Registry(),
+                              include_sidecars=False)
+    assert path is not None and os.path.isdir(path)
+    for name in BUNDLE_ARTIFACTS:
+        assert os.path.isfile(os.path.join(path, name)), name
+    manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert manifest["reason"] == "unit-test"
+    assert manifest["ring"]["records"] >= 1
+    # job.json degrades gracefully without a clientset.
+    assert json.load(open(os.path.join(path, "job.json"))) == {"jobs": []}
+
+
+def test_dump_bundle_once_key_dedups(tmp_path):
+    rec = flight.FlightRecorder()
+    first = flight.dump_bundle("dup", directory=str(tmp_path),
+                               recorder=rec, once_key="dup-test-key")
+    second = flight.dump_bundle("dup", directory=str(tmp_path),
+                                recorder=rec, once_key="dup-test-key")
+    assert first is not None and second is None
+
+
+def test_bundle_on_batcher_fatal_error(tmp_path, monkeypatch):
+    """The PR-2 fatal path now black-boxes: a donated-prefill death
+    must leave a bundle in the debug dir, not just a fatal_error flag."""
+    monkeypatch.setenv(flight.DEBUG_DIR_ENV, str(tmp_path))
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.models.llama import LlamaModel, llama2_tiny
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    cfg = llama2_tiny()
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    batcher = ContinuousBatcher(model, variables, max_slots=2,
+                                page_size=8, prefill_chunk=4).start()
+    try:
+        def boom(width):
+            raise RuntimeError("chaos: injected prefill fault")
+
+        batcher._suffix_fn = boom
+        with pytest.raises(RuntimeError, match="injected prefill fault"):
+            batcher.submit(list(range(1, 10)), 3)
+        assert batcher.fatal_error is not None
+        bundles = [d for d in _bundles(tmp_path) if "batcher-fatal" in d]
+        assert bundles, "no batcher-fatal bundle dumped"
+        ring = [json.loads(line) for line in
+                open(tmp_path / bundles[-1] / "flight.jsonl")]
+        fatal = [r for r in ring if r["layer"] == "serving"
+                 and r["kind"] == "fatal_error"]
+        assert fatal and "injected prefill fault" in fatal[0]["data"]["error"]
+    finally:
+        batcher.stop()
+
+
+def _violation_engine(tmp_path, seed=5):
+    """A cheap seeded scenario that always violates an invariant: no
+    cluster needed — unknown-kind faults log deterministically."""
+    monkey_env = dict(os.environ)
+    os.environ[flight.DEBUG_DIR_ENV] = str(tmp_path)
+    try:
+        plan = chaos.FaultPlan(name="flight-test", seed=seed, faults=[
+            chaos.Fault(at=0.0, kind="not-a-real-injector", target="x"),
+            chaos.Fault(at=0.0, kind="also-not-real", target="y",
+                        params={"p": 1}),
+        ])
+        system = types.SimpleNamespace(
+            client=Clientset(), kubelet=None, controller=None)
+
+        def always_fails(s):
+            return ["synthetic violation"]
+
+        engine = chaos.ChaosEngine(system, plan)
+        return engine.run(invariants=[always_fails], settle=0.0)
+    finally:
+        os.environ.clear()
+        os.environ.update(monkey_env)
+
+
+def test_bundle_on_chaos_invariant_violation(tmp_path):
+    report = _violation_engine(tmp_path)
+    assert report.violations == ["synthetic violation"]
+    assert report.bundle_dir is not None and \
+        os.path.isdir(report.bundle_dir)
+    for name in BUNDLE_ARTIFACTS:
+        assert os.path.isfile(os.path.join(report.bundle_dir, name)), name
+
+
+def test_bundle_event_sections_byte_identical_across_seeded_runs(tmp_path):
+    """The canonical (timestamp-free) event section of two identical
+    seeded runs must be byte-identical — the diff-clean contract."""
+    r1 = _violation_engine(tmp_path / "a")
+    r2 = _violation_engine(tmp_path / "b")
+    ev1 = open(os.path.join(r1.bundle_dir, "events.jsonl"), "rb").read()
+    ev2 = open(os.path.join(r2.bundle_dir, "events.jsonl"), "rb").read()
+    assert ev1 and ev1 == ev2
+    # And it is genuinely canonical: no wall-clock fields.
+    for line in ev1.decode().splitlines():
+        assert "ts" not in json.loads(line)
+
+
+def test_sidecar_spans_render_and_own_sidecar_is_excluded(tmp_path):
+    """A worker's sidecar spans must appear in the merged trace (they
+    exist in no local tracer), and the dumper's own just-exported
+    sidecar must not be merged back (its ring is already in the
+    bundle)."""
+    sidecar_span = {"seq": 0, "ts": 1.0, "layer": "train",
+                    "kind": "span",
+                    "data": {"name": "checkpoint_save", "dur": 0.5,
+                             "attrs": {"step": 7}}}
+    trace = flight.merged_chrome_trace([], [], [sidecar_span])
+    spans = [e for e in trace["traceEvents"]
+             if e.get("cat") == "span"]
+    assert spans and spans[0]["name"] == "checkpoint_save"
+    assert spans[0]["dur"] == pytest.approx(0.5e6)
+    assert spans[0]["pid"] == flight.LAYERS.index("train") + 1
+
+    # Own-pid sidecar excluded; a foreign, fresh sidecar is read.
+    (tmp_path / f"flight-{os.getpid()}.jsonl").write_text(
+        json.dumps(sidecar_span) + "\n")
+    assert flight._read_sidecars(str(tmp_path)) == []
+    (tmp_path / "flight-999999.jsonl").write_text(
+        json.dumps(sidecar_span) + "\n")
+    assert len(flight._read_sidecars(str(tmp_path))) == 1
+
+
+def test_run_train_loop_preemption_dumps_bundle_and_sidecar(
+        tmp_path, monkeypatch):
+    from mpi_operator_tpu.parallel.train import run_train_loop
+
+    debug = tmp_path / "debug"
+    side = tmp_path / "side"
+    monkeypatch.setenv(flight.DEBUG_DIR_ENV, str(debug))
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(side))
+    notice = tmp_path / "preempt.notice"
+    notice.write_text("preempted\n")
+
+    state, step = run_train_loop(
+        state=0, step_fn=lambda s, b: (s + 1, {}),
+        batches=iter(range(10)), preemption_file=str(notice),
+        exit_on_preemption=False)
+    assert step == 0  # pre-step notice: no work burned
+    assert any("train-preemption" in d for d in _bundles(debug))
+    sidecars = [f for f in os.listdir(side) if f.startswith("flight-")]
+    assert sidecars, "no sidecar exported on preemption"
+    records = [json.loads(line) for line in open(side / sidecars[0])]
+    assert any(r["layer"] == "train" and r["kind"] == "preemption"
+               for r in records)
+
+
+# ---------------------------------------------------------------------------
+# Recorder aggregation + narrowed error handling
+# ---------------------------------------------------------------------------
+
+def _job(name="j", uid="u1"):
+    return MPIJob(metadata=ObjectMeta(name=name, namespace="default",
+                                      uid=uid))
+
+
+def test_recorder_aggregates_repeats_into_count():
+    cs = Clientset()
+    rec = Recorder(cs, registry=Registry())
+    job = _job()
+    for _ in range(5):
+        rec.event(job, "Warning", "Boom", "same storm message")
+    rec.event(job, "Warning", "Boom", "different message")
+    events = cs.events("default").list()
+    assert len(events) == 2  # one aggregated + one distinct
+    agg = next(e for e in events if e.message == "same storm message")
+    assert agg.count == 5
+    assert agg.first_timestamp is not None
+    assert agg.last_timestamp >= agg.first_timestamp
+    assert rec.aggregated.value == 4
+
+
+def test_recorder_caps_retained_events_per_namespace():
+    cs = Clientset()
+    rec = Recorder(cs, registry=Registry(), namespace_event_cap=4)
+    job = _job()
+    for i in range(10):
+        rec.event(job, "Normal", f"Reason{i}", f"message {i}")
+    events = cs.events("default").list()
+    assert len(events) <= 4
+    # The newest survive the prune.
+    assert any(e.reason == "Reason9" for e in events)
+
+
+def test_recorder_counts_transport_drops_but_raises_bugs():
+    cs = Clientset()
+    reg = Registry()
+    rec = Recorder(cs, registry=reg)
+
+    def unavailable(action):
+        return True, ApiError("Unavailable", "chaos brown-out")
+
+    cs.prepend_reactor("create", "Event", unavailable)
+    rec.event(_job(), "Normal", "Dropped", "m")  # swallowed + counted
+    assert rec.dropped.value == 1
+    assert reg.get("mpi_operator_events_dropped_total").value == 1
+
+    # A programming error (malformed object) must PROPAGATE, not vanish
+    # in a bare except.
+    with pytest.raises(AttributeError):
+        rec.event(None, "Normal", "Bug", "m")
+
+
+# ---------------------------------------------------------------------------
+# events --watch: resourceVersion resume + 410 relist
+# ---------------------------------------------------------------------------
+
+def _pump_events(server, namespace="default"):
+    """Run the CLI watch loop in a thread; returns (reasons, stop)."""
+    from mpi_operator_tpu.__main__ import _watch_events
+    seen = []
+    stop = threading.Event()
+    t = threading.Thread(
+        target=_watch_events,
+        args=(server, namespace, lambda e: seen.append(e.reason), stop),
+        daemon=True)
+    t.start()
+    return seen, stop, t
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_events_watch_resumes_after_410_relist():
+    server = ApiServer()
+    cs = Clientset(server=server)
+    rec = Recorder(cs)
+    job = _job()
+    rec.event(job, "Normal", "Before", "pre-existing")
+    seen, stop, t = _pump_events(server)
+    try:
+        assert _wait_for(lambda: "Before" in seen)
+        rec.event(job, "Normal", "Live", "streamed")
+        assert _wait_for(lambda: "Live" in seen)
+        # Simulated 410: every Event watch stream loses continuity.
+        server.relist_watches("v1", "Event")
+        rec.event(job, "Normal", "AfterRelist", "must not be lost")
+        assert _wait_for(lambda: "AfterRelist" in seen), seen
+        # Resume did not re-emit what was already delivered.
+        assert seen.count("Before") == 1 and seen.count("Live") == 1
+    finally:
+        stop.set()
+        t.join(timeout=3)
+
+
+def test_remote_watch_resource_version_resume_and_410():
+    """HTTP transport: a watch opened at an old-but-retained RV replays
+    the gap; an expired RV surfaces the RELIST sentinel."""
+    from mpi_operator_tpu.k8s.core import Event
+    from mpi_operator_tpu.k8s.http_api import ApiHttpServer, RemoteApiServer
+
+    store = ApiServer()
+    store.HISTORY_LIMIT = 8  # small retained window to force a 410
+    http = ApiHttpServer(store=store).start()
+    try:
+        remote = RemoteApiServer(http.url)
+        first = store.create(Event(metadata=ObjectMeta(
+            name="ev-0", namespace="default")))
+        rv0 = first.metadata.resource_version
+        for i in range(1, 4):
+            store.create(Event(metadata=ObjectMeta(
+                name=f"ev-{i}", namespace="default")))
+        # Resume from rv0: the three later creates replay.
+        w = remote.watch("v1", "Event", resource_version=rv0)
+        got = []
+        for _ in range(3):
+            ev = w.next(timeout=5)
+            assert ev is not None
+            got.append(ev.obj.metadata.name)
+        assert got == ["ev-1", "ev-2", "ev-3"]
+        w.stop()
+        # Expire the window, then resume from the ancient RV -> RELIST.
+        for i in range(4, 20):
+            store.create(Event(metadata=ObjectMeta(
+                name=f"ev-{i}", namespace="default")))
+        w = remote.watch("v1", "Event", resource_version=rv0)
+        ev = w.next(timeout=5)
+        assert ev is not None and ev.type == "RELIST" and ev.obj is None
+        w.stop()
+    finally:
+        http.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI helpers: top / metrics parsing / event formatting
+# ---------------------------------------------------------------------------
+
+def test_parse_metrics_text():
+    from mpi_operator_tpu.__main__ import _parse_metrics_text
+    text = (
+        "# HELP serving_queue_depth x\n"
+        "# TYPE serving_queue_depth gauge\n"
+        "serving_queue_depth 3.0\n"
+        'mpi_operator_job_info{launcher="l",namespace="d"} 1\n'
+        "train_goodput_fraction 0.875\n")
+    parsed = _parse_metrics_text(text)
+    assert parsed["serving_queue_depth"] == 3.0
+    assert parsed["train_goodput_fraction"] == 0.875
+    assert parsed["mpi_operator_job_info"] == 1.0
+
+
+def test_top_snapshot_lists_jobs_and_metrics():
+    from mpi_operator_tpu.__main__ import _top_snapshot
+    cs = Clientset()
+    job = _job(name="topjob")
+    cs.mpi_jobs("default").create(job)
+    out = _top_snapshot(cs, "default",
+                        {"train_goodput_fraction": 0.9,
+                         "serving_queue_depth": 2.0})
+    assert "topjob" in out
+    assert "goodput=0.9" in out and "serve-queue=2" in out
+
+
+def test_event_line_shows_aggregation_count():
+    from mpi_operator_tpu.__main__ import _format_event_line
+    cs = Clientset()
+    rec = Recorder(cs)
+    job = _job()
+    for _ in range(3):
+        rec.event(job, "Warning", "Storm", "same")
+    (event,) = cs.events("default").list()
+    line = _format_event_line(event)
+    assert "x3" in line and "Storm" in line

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Observability smoke: kill a training gang via a chaos plan and
+assert the flight recorder's black-box bundle comes out whole.
+
+The scenario: a 2-worker MPIJob (restartPolicy: ExitCode,
+backoffLimit: 1) whose workers are preemption-aware and feed the
+flight recorder's train layer.  A seeded chaos plan preempts worker-0
+twice — the first preemption routes through gang repair, the second
+exceeds backoffLimit and fails the job.  That fatal path must produce
+a debug bundle whose merged Chrome trace carries one lane per layer
+(controller, kubelet, train, chaos) — and the run is executed TWICE to
+prove the bundle's canonical event section is byte-identical across
+identical seeded runs.
+
+Also performs the metric-catalog drift check: every metric family
+registered anywhere in mpi_operator_tpu/ must appear in the
+docs/OBSERVABILITY.md catalog table.
+
+Usage: python tools/obs_smoke.py [--once] [--keep DIR]
+Exit 0 = bundle complete, lanes present, runs identical, catalog in sync.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# The worker is a tiny preemption-aware "train loop": it records train
+# events on its own flight ring and, on the kubelet's preemption
+# notice, exports the ring as a sidecar (so the control plane's bundle
+# gets a train lane) and exits with the retryable code 143.
+WORKER_SCRIPT = textwrap.dedent("""\
+    import os, sys, time
+    from mpi_operator_tpu.telemetry import flight
+    flight.record("train", "goodput_phase", bucket="compile",
+                  seconds=0.01)
+    flight.record("train", "goodput_phase", bucket="productive",
+                  seconds=0.05)
+    notice = os.environ.get("K_PREEMPTION_NOTICE_FILE")
+    for _ in range(1200):
+        if notice and os.path.exists(notice):
+            flight.record("train", "preemption", step=1, exit_code=143)
+            flight.export_sidecar()
+            sys.exit(143)
+        time.sleep(0.05)
+""")
+
+LAUNCHER_SCRIPT = "import time; time.sleep(60)"
+
+REQUIRED_ARTIFACTS = ("flight.jsonl", "trace.json", "metrics.prom",
+                      "job.json")
+REQUIRED_LANES = ("controller", "kubelet", "train", "chaos")
+
+
+def smoke_job(name: str = "obs-smoke", workers: int = 2,
+              backoff_limit: int = 1):
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec, ReplicaSpec,
+                                            RunPolicy)
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+    return MPIJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(backoff_limit=backoff_limit),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="launcher", image="local",
+                                  command=[sys.executable, "-c",
+                                           LAUNCHER_SCRIPT])]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    restart_policy=constants.RESTART_POLICY_EXIT_CODE,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="worker", image="local",
+                                  command=[sys.executable, "-c",
+                                           WORKER_SCRIPT])]))),
+            }))
+
+
+def smoke_plan():
+    from mpi_operator_tpu import chaos
+
+    # Two preemptions of the same worker: repair once, then blow
+    # through backoffLimit=1 -> job Failed (the fatal path under test).
+    return chaos.FaultPlan(name="obs-smoke", seed=11, faults=[
+        chaos.Fault(at=1.0, kind="preempt",
+                    target="default/obs-smoke-worker-0",
+                    params={"grace": 0.5, "wait": 15}),
+        chaos.Fault(at=4.0, kind="preempt",
+                    target="default/obs-smoke-worker-0",
+                    params={"grace": 0.5, "wait": 15}),
+    ])
+
+
+def run_once(workdir: str, timeout: float = 60.0):
+    """One scenario on a fresh LocalCluster; returns (report, bundles)."""
+    from mpi_operator_tpu import chaos
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.k8s import core
+    from mpi_operator_tpu.server import LocalCluster
+
+    os.makedirs(workdir, exist_ok=True)
+    os.environ["MPI_OPERATOR_DEBUG_DIR"] = workdir
+    os.environ["MPI_OPERATOR_FLIGHT_DIR"] = workdir
+    # Worker subprocesses must import mpi_operator_tpu for the flight
+    # sidecar export.
+    os.environ["PYTHONPATH"] = REPO + os.pathsep + \
+        os.environ.get("PYTHONPATH", "")
+
+    with LocalCluster() as cluster:
+        job = smoke_job()
+        cluster.submit(job)
+        cluster.wait_for_condition("default", job.metadata.name,
+                                   constants.JOB_RUNNING, timeout=30)
+
+        def converged():
+            stored = cluster.client.mpi_jobs("default").get(
+                job.metadata.name)
+            conds = {c.type: c.status for c in stored.status.conditions}
+            return conds.get(constants.JOB_FAILED) == core.CONDITION_TRUE
+
+        report = chaos.run(smoke_plan(), cluster, converge=converged,
+                           timeout=timeout, bundle="always")
+    bundles = sorted(
+        os.path.join(workdir, d) for d in os.listdir(workdir)
+        if d.startswith("bundle-") and
+        os.path.isdir(os.path.join(workdir, d)))
+    return report, bundles
+
+
+def check_bundle(bundle: str) -> list:
+    """All four artifacts present + one trace lane per layer."""
+    problems = []
+    for name in REQUIRED_ARTIFACTS:
+        path = os.path.join(bundle, name)
+        if not os.path.isfile(path) or os.path.getsize(path) == 0:
+            problems.append(f"{bundle}: missing/empty artifact {name}")
+    trace_path = os.path.join(bundle, "trace.json")
+    if os.path.isfile(trace_path):
+        with open(trace_path) as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", [])
+        lanes = {e["args"]["name"]: e["pid"] for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        populated = {e["pid"] for e in events if e.get("ph") != "M"}
+        for layer in REQUIRED_LANES:
+            if layer not in lanes:
+                problems.append(f"{bundle}: no {layer} lane in trace")
+            elif lanes[layer] not in populated:
+                problems.append(
+                    f"{bundle}: {layer} lane has no trace events")
+    return problems
+
+
+def _find_engine_bundle(report, bundles):
+    if report.bundle_dir and os.path.isdir(report.bundle_dir):
+        return report.bundle_dir
+    chaos_bundles = [b for b in bundles
+                     if os.path.basename(b).startswith("bundle-chaos-")]
+    return chaos_bundles[-1] if chaos_bundles else None
+
+
+# ---------------------------------------------------------------------------
+# Metric-catalog drift check
+# ---------------------------------------------------------------------------
+
+# Metric family names built with dynamic prefixes (f-strings the literal
+# scan below cannot see); keep in sync with telemetry/goodput.py.
+DYNAMIC_FAMILIES = ("train_goodput_fraction", "train_step_seconds")
+
+_METRIC_CALL = re.compile(
+    r"(?:\.(?:counter|gauge|histogram)(?:_vec)?"
+    r"|\b(?:Counter|Gauge|GaugeVec|CounterVec|Histogram|HistogramVec))"
+    r"\(\s*\n?\s*\"([a-z][a-z0-9_]+)\"", re.MULTILINE)
+
+
+def registered_metric_families() -> set:
+    families = set(DYNAMIC_FAMILIES)
+    pkg = os.path.join(REPO, "mpi_operator_tpu")
+    for root, _, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                src = f.read()
+            families.update(_METRIC_CALL.findall(src))
+    return families
+
+
+def check_metric_catalog() -> list:
+    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as f:
+        docs = f.read()
+    return [f"metric family {name!r} registered in code but missing from"
+            f" docs/OBSERVABILITY.md catalog"
+            for name in sorted(registered_metric_families())
+            if name not in docs]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--once", action="store_true",
+                    help="single run (skip the reproducibility check)")
+    ap.add_argument("--keep", default=None,
+                    help="keep bundles under this dir (default: tempdir,"
+                         " removed on success)")
+    args = ap.parse_args(argv)
+
+    drift = check_metric_catalog()
+    if drift:
+        print("obs-smoke: FAIL — metric catalog drift:")
+        for d in drift:
+            print(f"  {d}")
+        return 1
+    print(f"obs-smoke: metric catalog in sync "
+          f"({len(registered_metric_families())} families)")
+
+    base = args.keep or tempfile.mkdtemp(prefix="obs-smoke-")
+    problems = []
+
+    print("obs-smoke: run 1 (gang kill via chaos plan)...", flush=True)
+    report1, bundles1 = run_once(os.path.join(base, "run1"))
+    if not report1.converged:
+        problems.append("run 1 never converged to JobFailed")
+    if not bundles1:
+        problems.append("run 1 produced no debug bundle")
+    engine1 = _find_engine_bundle(report1, bundles1)
+    if engine1 is None:
+        problems.append("run 1: chaos engine bundle missing")
+    else:
+        problems += check_bundle(engine1)
+    # The controller's own job-failed bundle must exist too.
+    if not any("job-failed" in os.path.basename(b) for b in bundles1):
+        problems.append("run 1: controller job-failed bundle missing")
+
+    if problems:
+        print("obs-smoke: FAIL")
+        for p in problems:
+            print(f"  {p}")
+        print(f"  (bundles kept under {base})")
+        return 1
+    if args.once:
+        print(f"obs-smoke: PASS (single run; bundle {engine1})")
+        if not args.keep:
+            shutil.rmtree(base, ignore_errors=True)
+        return 0
+
+    print("obs-smoke: run 2 (canonical reproducibility)...", flush=True)
+    report2, bundles2 = run_once(os.path.join(base, "run2"))
+    engine2 = _find_engine_bundle(report2, bundles2)
+    if engine2 is None:
+        print("obs-smoke: FAIL — run 2 chaos engine bundle missing")
+        return 1
+    problems += check_bundle(engine2)
+    with open(os.path.join(engine1, "events.jsonl"), "rb") as f:
+        ev1 = f.read()
+    with open(os.path.join(engine2, "events.jsonl"), "rb") as f:
+        ev2 = f.read()
+    if ev1 != ev2:
+        problems.append(
+            "canonical event sections differ across identical seeded "
+            f"runs:\n--- run1 ---\n{ev1.decode()}\n--- run2 ---\n"
+            f"{ev2.decode()}")
+    if problems:
+        print("obs-smoke: FAIL")
+        for p in problems:
+            print(f"  {p}")
+        print(f"  (bundles kept under {base})")
+        return 1
+    print(f"obs-smoke: PASS — bundle complete, lanes "
+          f"{', '.join(REQUIRED_LANES)} present, canonical event section "
+          f"byte-identical across runs ({len(ev1)} bytes)")
+    if not args.keep:
+        shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
