@@ -4,7 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
-	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke
+	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
+	controller-bench-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -36,6 +37,12 @@ chaos-smoke:
 # checks the docs/OBSERVABILITY.md metric catalog against the code.
 obs-smoke:
 	$(PYTHON) tools/obs_smoke.py
+
+# Reduced-N reconcile-throughput run (< 60s, CPU) with the cache
+# mutation detector armed: throughput floor, zero steady-state list
+# scans, zero shared-snapshot mutations (docs/PERF.md).
+controller-bench-smoke:
+	$(PYTHON) tools/controller_bench_smoke.py
 
 native:
 	$(MAKE) -C native

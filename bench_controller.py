@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""Benchmark: control-plane reconcile throughput (no data plane).
+
+Churns N MPIJobs x M pods (M = workers + 1 launcher Job) through the
+in-memory sim stack — create -> workers Running/Ready -> launcher
+Complete -> MPIJob Succeeded, with a MODIFIED-event storm on the pods in
+between — against a live MPIJobController (real informers, real
+workqueue, real watch streams).  The driver plays the kubelet: it flips
+pod phases and launcher Job conditions through the apiserver, exactly
+the write pattern the controller sees at scale.
+
+Reported (ONE JSON line + BENCH_CONTROLLER.json):
+
+- reconciles_per_sec_busy: reconcile count / summed sync latency — the
+  per-worker-thread reconcile capacity (1 / mean sync cost).
+- reconciles_per_sec_wall: reconcile count / wall time of the churn.
+- p50/p99 sync latency (upper bucket bounds of the existing
+  mpi_operator_reconcile_seconds histogram).
+- lister traffic: list() calls, objects returned, full-scans and
+  deep-copies (the latter two from the indexed-lister counters when the
+  running tree has them; null on the pre-index baseline).
+
+Usage:
+    python bench_controller.py [--jobs 200] [--workers 7] [--threads 4]
+                               [--baseline path.json] [--out path.json]
+
+--baseline embeds a previously captured record and computes
+vs_baseline = current.reconciles_per_sec_busy / baseline's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+NAMESPACE = "bench"
+
+
+def bench_job(name: str, workers: int):
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec, ReplicaSpec,
+                                            RunPolicy)
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+    return MPIJob(
+        metadata=ObjectMeta(name=name, namespace=NAMESPACE),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="launcher", image="bench")]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="worker", image="bench")]))),
+            }))
+
+
+def _wrap_listers(controller) -> dict:
+    """Count list() calls / objects returned on every informer lister —
+    works on both the pre-index and indexed lister."""
+    stats = {"list_calls": 0, "objects_returned": 0}
+
+    def wrap(lister):
+        orig = lister.list
+
+        def counted(*args, **kwargs):
+            out = orig(*args, **kwargs)
+            stats["list_calls"] += 1
+            stats["objects_returned"] += len(out)
+            return out
+
+        lister.list = counted
+
+    for informer in controller.factory._informers.values():
+        wrap(informer.lister)
+    return stats
+
+
+def _quantile(snapshot: dict, q: float):
+    """Upper bucket bound holding the q-quantile of a histogram snapshot."""
+    total = snapshot["count"]
+    if not total:
+        return None
+    target = q * total
+    for bound, cum in snapshot["buckets"].items():
+        if cum >= target:
+            return bound
+    return float("inf")
+
+
+def _indexed_counters(registry) -> dict:
+    """Indexed-lister telemetry, null-valued when the running tree
+    predates the indexer (the baseline capture).  Informer counters live
+    on the process default registry; operator counters on the
+    controller's registry — probe both."""
+    registries = [registry]
+    try:
+        from mpi_operator_tpu.telemetry.metrics import default_registry
+        registries.append(default_registry())
+    except ImportError:
+        pass
+    out = {}
+    for short, name in [
+            ("full_scans", "mpi_operator_lister_full_scans_total"),
+            ("deepcopies", "mpi_operator_lister_deepcopies_total"),
+            ("mutation_violations",
+             "mpi_operator_cache_mutation_violations_total"),
+            ("status_writes_suppressed",
+             "mpi_operator_status_writes_suppressed_total"),
+            ("resync_suppressed",
+             "mpi_operator_resync_dispatches_suppressed_total")]:
+        metric = None
+        for reg in registries:
+            metric = reg.get(name) if reg is not None else None
+            if metric is not None:
+                break
+        out[short] = metric.value if metric is not None else None
+    return out
+
+
+def run_bench(n_jobs: int, workers: int, threads: int, storm: int,
+              timeout: float) -> dict:
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.controller.controller import MPIJobController
+    from mpi_operator_tpu.k8s import batch, core
+    from mpi_operator_tpu.k8s.apiserver import ApiError, Clientset, is_conflict
+    from mpi_operator_tpu.controller.status import is_finished
+
+    cs = Clientset()
+    controller = MPIJobController(cs, namespace=NAMESPACE)
+    lister_stats = _wrap_listers(controller)
+    controller.run(threadiness=threads)
+
+    def pods():
+        return cs.server.list("v1", "Pod", NAMESPACE)
+
+    def set_pod_running(pod):
+        pod.status.phase = core.POD_RUNNING
+        pod.status.conditions = [core.PodCondition(type="Ready",
+                                                   status="True")]
+        try:
+            cs.pods(NAMESPACE).update_status(pod)
+            return True
+        except ApiError as exc:
+            if is_conflict(exc):
+                return False
+            raise
+
+    start = time.perf_counter()
+    for i in range(n_jobs):
+        cs.mpi_jobs(NAMESPACE).create(bench_job(f"bj-{i}", workers))
+
+    deadline = time.monotonic() + timeout
+    # Phase 1: every worker pod the controller creates goes Running.
+    expected = n_jobs * workers
+    while time.monotonic() < deadline:
+        pending = [p for p in pods() if p.status.phase != core.POD_RUNNING]
+        seen = len(pods())
+        for p in pending:
+            set_pod_running(p)
+        if seen >= expected and not pending:
+            break
+        time.sleep(0.02)
+    else:
+        raise TimeoutError(f"workers never all Running ({expected} expected)")
+
+    # Phase 2: MODIFIED-event storm — repeated no-information status
+    # bumps on every pod, the watch traffic a flapping fleet generates.
+    for round_idx in range(storm):
+        for p in pods():
+            p.status.message = f"storm-{round_idx}"
+            try:
+                cs.pods(NAMESPACE).update_status(p)
+            except ApiError as exc:
+                if not is_conflict(exc):
+                    raise
+
+    # Steady-state pass (mid-life: workers Running, launcher present,
+    # nothing to change): one enqueued sync per job, isolating the
+    # read-path cost the indexer is supposed to erase.
+    registry = controller.metrics.get("registry")
+    hist = controller.metrics.get("reconcile_seconds")
+    steady_before = _indexed_counters(registry)
+    steady_list_calls = lister_stats["list_calls"]
+    target = hist.count + n_jobs
+    for i in range(n_jobs):
+        controller.enqueue(cs.mpi_jobs(NAMESPACE).get(f"bj-{i}"))
+    while time.monotonic() < deadline and hist.count < target:
+        time.sleep(0.02)
+    steady_after = _indexed_counters(registry)
+    steady_list_delta = lister_stats["list_calls"] - steady_list_calls
+
+    # Phase 3: launchers complete -> jobs converge to Succeeded.
+    now = controller.clock.now()
+    for i in range(n_jobs):
+        for _ in range(5):
+            try:
+                launcher = cs.jobs(NAMESPACE).get(f"bj-{i}-launcher")
+            except ApiError:
+                time.sleep(0.02)
+                continue
+            launcher.status.succeeded = 1
+            launcher.status.completion_time = now
+            launcher.status.conditions = [batch.JobCondition(
+                type=batch.JOB_COMPLETE, status=core.CONDITION_TRUE)]
+            try:
+                cs.jobs(NAMESPACE).update_status(launcher)
+                break
+            except ApiError as exc:
+                if not is_conflict(exc):
+                    raise
+
+    while time.monotonic() < deadline:
+        jobs = cs.server.list(constants.GROUP_VERSION, constants.KIND,
+                              NAMESPACE)
+        if len(jobs) == n_jobs and all(is_finished(j.status) for j in jobs):
+            break
+        time.sleep(0.05)
+    else:
+        raise TimeoutError("jobs never all finished")
+
+    wall = time.perf_counter() - start
+    controller.stop()
+
+    snap = hist.snapshot()
+    record = {
+        "jobs": n_jobs, "workers": workers,
+        "pods": n_jobs * workers, "threads": threads,
+        "reconciles": snap["count"],
+        "reconcile_busy_seconds": round(snap["sum"], 3),
+        "wall_seconds": round(wall, 3),
+        "reconciles_per_sec_busy": round(snap["count"] / snap["sum"], 1)
+        if snap["sum"] else None,
+        "reconciles_per_sec_wall": round(snap["count"] / wall, 1),
+        "reconcile_p50_le_seconds": _quantile(snap, 0.50),
+        "reconcile_p99_le_seconds": _quantile(snap, 0.99),
+        "lister_list_calls": lister_stats["list_calls"],
+        "lister_objects_returned": lister_stats["objects_returned"],
+        "indexed_lister": _indexed_counters(registry),
+        "steady_state": {
+            "list_calls": steady_list_delta,
+            "full_scans": (
+                None if steady_after["full_scans"] is None
+                else steady_after["full_scans"]
+                - (steady_before["full_scans"] or 0)),
+            "syncs": n_jobs,
+        },
+    }
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--jobs", type=int, default=200)
+    ap.add_argument("--workers", type=int, default=7,
+                    help="worker pods per job (pods/job = workers + 1"
+                         " launcher Job)")
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--storm", type=int, default=2,
+                    help="MODIFIED-event storm rounds over every pod")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--baseline", default=None,
+                    help="previously captured JSON to embed + compare")
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT,
+                                                  "BENCH_CONTROLLER.json"))
+    args = ap.parse_args(argv)
+
+    record = {"metric": "controller_reconcile_throughput",
+              "config": {"jobs": args.jobs, "workers": args.workers,
+                         "threads": args.threads, "storm": args.storm}}
+    try:
+        record["current"] = run_bench(args.jobs, args.workers, args.threads,
+                                      args.storm, args.timeout)
+    except Exception as exc:
+        record["error"] = f"{type(exc).__name__}: {exc}"[:500]
+
+    record["vs_baseline"] = None
+    if args.baseline and os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        record["baseline"] = baseline.get("current", baseline)
+        cur = record.get("current", {}).get("reconciles_per_sec_busy")
+        base = record["baseline"].get("reconciles_per_sec_busy")
+        if cur and base:
+            record["vs_baseline"] = round(cur / base, 2)
+
+    print(json.dumps(record))
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    return 0 if "error" not in record else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,7 +24,7 @@ from ..k8s import batch, core
 from ..k8s.apiserver import ApiError, Clientset, is_conflict, is_not_found
 from ..k8s.informers import InformerFactory
 from ..k8s.meta import Clock, deep_copy, get_controller_of
-from ..k8s.selectors import match_label_selector
+from ..k8s.selectors import match_label_selector, match_labels
 from ..k8s.workqueue import RateLimitingQueue
 from ..telemetry import flight
 from ..telemetry.trace import span
@@ -115,6 +115,11 @@ class MPIJobController:
         self.queue = RateLimitingQueue()
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
+        # OrphanPod warnings already emitted, keyed (launcher uid, pod
+        # identity): one aggregated event per collision instead of one
+        # per sync (the Recorder would otherwise absorb a steady
+        # re-emission every reconcile).
+        self._orphan_warned: set = set()
 
         # Event handlers (:392-457): MPIJob changes enqueue directly; owned
         # objects route through handle_object.
@@ -418,12 +423,21 @@ class MPIJobController:
             return self.client.services(job.metadata.namespace).update(svc)
         return svc
 
+    def _worker_pods(self, job: MPIJob) -> list:
+        """Worker pods of this job, served from the pod informer's
+        owner-uid index (hash lookup) instead of a namespace scan; the
+        selector filter keeps out other owned pod flavors (e.g.
+        launcher-as-worker naming collisions).  Returned objects are
+        SHARED cache snapshots — never mutate."""
+        selector = builders.worker_selector(job.metadata.name)
+        return [p for p in self.pod_informer.lister.by_owner(
+                    job.metadata.uid)
+                if match_labels(selector, p.metadata.labels)]
+
     def _get_running_worker_pods(self, job: MPIJob) -> list:
         """getRunningWorkerPods (:840-858)."""
-        pods = self.pod_informer.lister.list(
-            job.metadata.namespace,
-            builders.worker_selector(job.metadata.name))
-        return [p for p in pods if p.status.phase == core.POD_RUNNING]
+        return [p for p in self._worker_pods(job)
+                if p.status.phase == core.POD_RUNNING]
 
     def _get_or_create_config_map(self, job: MPIJob):
         """getOrCreateConfigMap (:875-911)."""
@@ -538,9 +552,7 @@ class MPIJobController:
             return
         if is_finished(job.status):
             return  # terminal: no repair, no re-emitted failure events
-        pods = self.pod_informer.lister.list(
-            job.metadata.namespace,
-            builders.worker_selector(job.metadata.name))
+        pods = self._worker_pods(job)
         failed = [p for p in pods
                   if p.status.phase == core.POD_FAILED
                   and is_controlled_by(p, job)
@@ -660,8 +672,7 @@ class MPIJobController:
         # — the reference compares the padded label directly and deletes a
         # still-valid worker; we fix that here.
         pad = 1 if job.spec.run_launcher_as_worker else 0
-        pods = self.pod_informer.lister.list(
-            job.metadata.namespace, builders.worker_selector(job.metadata.name))
+        pods = self._worker_pods(job)
         if len(pods) > replicas:
             for pod in pods:
                 index_str = pod.metadata.labels.get(constants.REPLICA_INDEX_LABEL)
@@ -740,17 +751,27 @@ class MPIJobController:
         """jobPods (:1694-1710): selector-matching pods controlled by the
         launcher Job, strictly by ownership (metav1.IsControlledBy).  An
         orphaned selector-matching pod is NOT adopted — it is excluded and
-        a warning event is emitted so the collision is visible, matching
-        the reference's ownership strictness."""
-        pods = self.pod_informer.lister.list(launcher.metadata.namespace)
+        a warning event is emitted (once per (launcher, pod), not per
+        sync) so the collision is visible without the Recorder absorbing
+        a re-emission storm.
+
+        Both lookups are index buckets: owned pods by owner-uid, orphan
+        candidates from the (rare) ownerless bucket — the namespace-wide
+        scan + per-pod deepcopy the original did every sync is gone."""
+        out = self.pod_informer.lister.by_owner(launcher.metadata.uid)
         selector = launcher.spec.selector
-        out = []
-        for p in pods:
-            ref = get_controller_of(p)
-            if ref is not None and ref.uid == launcher.metadata.uid:
-                out.append(p)
-            elif selector is not None and match_label_selector(
-                    selector, p.metadata.labels) and ref is None:
+        if selector is not None:
+            for p in self.pod_informer.lister.ownerless(
+                    launcher.metadata.namespace):
+                if not match_label_selector(selector, p.metadata.labels):
+                    continue
+                key = (launcher.metadata.uid, p.metadata.uid
+                       or f"{p.metadata.namespace}/{p.metadata.name}")
+                if key in self._orphan_warned:
+                    continue
+                if len(self._orphan_warned) > 4096:
+                    self._orphan_warned.clear()  # bounded; re-warn is fine
+                self._orphan_warned.add(key)
                 self.recorder.event(
                     launcher, core.EVENT_TYPE_WARNING, "OrphanPod",
                     f"pod {p.metadata.namespace}/{p.metadata.name} matches "
@@ -914,5 +935,18 @@ class MPIJobController:
     def _update_status(self, job: MPIJob) -> None:
         """doUpdateJobStatus (:1327-1330).  Deliberately does NOT stamp a
         per-sync timestamp: a finished job must converge to a no-op write
-        or the MODIFIED watch event would re-enqueue it forever."""
+        or the MODIFIED watch event would re-enqueue it forever.
+
+        No-op writes are suppressed CLIENT-side: the desired status is
+        diffed against the informer-cached snapshot and an unchanged
+        status skips the UPDATE call entirely (the apiserver would
+        absorb it, but the round-trip, action log and fault-injection
+        surface are not free at N-hundred-jobs scale)."""
+        cached = self.mpi_job_informer.lister.get(job.metadata.namespace,
+                                                  job.metadata.name)
+        if cached is not None and cached.status == job.status:
+            suppressed = self.metrics.get("status_writes_suppressed")
+            if suppressed is not None:
+                suppressed.inc()
+            return
         self.client.mpi_jobs(job.metadata.namespace).update_status(job)

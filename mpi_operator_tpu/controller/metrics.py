@@ -65,3 +65,7 @@ def backfill_telemetry_metrics(metrics: dict) -> None:
     metrics.setdefault("gang_restarts", registry.counter(
         "mpi_operator_gang_restarts_total",
         "Worker gang restarts triggered by restartPolicy ExitCode"))
+    metrics.setdefault("status_writes_suppressed", registry.counter(
+        "mpi_operator_status_writes_suppressed_total",
+        "MPIJob status UPDATEs skipped because the desired status"
+        " matched the informer-cached snapshot"))

@@ -130,6 +130,10 @@ class ApiServer:
         self._lock = threading.RLock()
         # (api_version, kind) -> {(namespace, name) -> obj}
         self._store: dict = {}
+        # Namespace pre-filter: (api_version, kind) -> {ns -> {key: True}}
+        # so namespace-scoped List (the informer/resync hot path) walks
+        # one bucket instead of every object of the kind.
+        self._ns_keys: dict = {}
         self._rv = 0
         self._watches: dict = {}  # (api_version, kind) -> [Watch]
         # gvk -> [(event_rv, WatchEvent)] ordered by rv; every rv bump
@@ -175,6 +179,14 @@ class ApiServer:
 
     def _bucket(self, gvk) -> dict:
         return self._store.setdefault(gvk, {})
+
+    def _index_key(self, gvk, key) -> None:
+        self._ns_keys.setdefault(gvk, {}).setdefault(key[0], {})[key] = True
+
+    def _unindex_key(self, gvk, key) -> None:
+        bucket = self._ns_keys.get(gvk, {}).get(key[0])
+        if bucket is not None:
+            bucket.pop(key, None)
 
     def _next_rv(self) -> str:
         self._rv += 1
@@ -226,6 +238,7 @@ class ApiServer:
                 # for Job controllers, not as missing.
                 obj.status.phase = "Pending"
             bucket[key] = obj
+            self._index_key(gvk, key)
             self._notify(gvk, ADDED, obj)
             # The response reflects the object AS CREATED — the reap
             # below must not leak its delete-bumped RV into the return.
@@ -239,6 +252,7 @@ class ApiServer:
             ctrl_ref = get_controller_of(obj)
             if ctrl_ref is not None and not self._uid_exists(ctrl_ref.uid):
                 dead = bucket.pop(key)
+                self._unindex_key(gvk, key)
                 dead.metadata.resource_version = self._next_rv()
                 self._notify(gvk, DELETED, dead)
                 self._cascade_delete(dead)
@@ -261,11 +275,23 @@ class ApiServer:
              label_selector: Optional[dict] = None) -> list:
         self._inject("list", api_version, kind, namespace or "")
         with self._lock:
+            gvk = (api_version, kind)
+            bucket = self._bucket(gvk)
+            if namespace is None:
+                keys = sorted(bucket.keys())
+            else:
+                # Namespace pre-filter: only this namespace's keys are
+                # visited — a chatty foreign namespace costs nothing.
+                keys = sorted(self._ns_keys.get(gvk, {}).get(namespace, ()))
             out = []
-            for (ns, _), obj in sorted(self._bucket((api_version, kind)).items()):
-                if namespace is not None and ns != namespace:
-                    continue
-                if match_labels(label_selector, obj.metadata.labels):
+            for key in keys:
+                obj = bucket.get(key)
+                # bucket.get (not []): a stale index key (a future
+                # store-removal site forgetting _unindex_key) degrades
+                # to a missing entry instead of 500ing every
+                # namespace-scoped list of the kind.
+                if obj is not None and match_labels(label_selector,
+                                                    obj.metadata.labels):
                     out.append(deep_copy(obj))
             return out
 
@@ -312,6 +338,7 @@ class ApiServer:
             obj = bucket.pop((namespace, name), None)
             if obj is None:
                 raise not_found(kind, f"{namespace}/{name}")
+            self._unindex_key((api_version, kind), (namespace, name))
             # A real apiserver bumps the RV on delete; the DELETED event
             # carries the new version (required for exact watch replay).
             obj.metadata.resource_version = self._next_rv()
@@ -330,6 +357,7 @@ class ApiServer:
                         if any(ref.uid == owner_uid and ref.controller
                                for ref in o.metadata.owner_references)]:
                 dead = bucket.pop(key)
+                self._unindex_key(gvk, key)
                 # Same RV bump as a direct delete: every DELETED event
                 # must carry a fresh RV or watch-history replay (and a
                 # live client's resume RV) would rewind to the object's

@@ -1,53 +1,372 @@
-"""Shared informers + listers over the API server watch streams.
+"""Shared informers + indexed listers over the API server watch streams.
 
 Equivalent of client-go SharedIndexInformer/Lister as used by the
 reference (informer factories at cmd/mpi-operator/app/server.go:135-142,
-event handlers at pkg/controller/mpi_job_controller.go:392-457).  A cache
-(store) of deep-copied objects is kept in sync by a watch thread; event
-handlers fire on add/update/delete.  Tests may instead load the store
-directly and call `sync_once()` semantics via `Lister` (the reference
-fixture hand-loads indexers, mpi_job_controller_test.go:214-260).
+event handlers at pkg/controller/mpi_job_controller.go:392-457), with
+the two properties that keep client-go cheap at scale:
+
+- **Indexed reads**: the cache is an :class:`Indexer` with built-in
+  by-namespace, by-controller-owner-uid and "ownerless" indexes (plus
+  pluggable index functions).  ``Lister.list`` serves namespace-scoped
+  queries from the namespace bucket; ``by_owner``/``by_index`` are
+  O(bucket) hash lookups.  Full store scans only happen for
+  all-namespaces lists and are counted
+  (``mpi_operator_lister_full_scans_total``).
+- **Shared immutable snapshots (copy-on-write)**: writes install a
+  fresh object under the lock; readers receive the SAME object with
+  zero deep-copy.  The client-go contract applies: cache objects must
+  NEVER be mutated (reference: mpi_job_controller.go:591-594) — copy
+  before changing, or pass ``copy=True`` for an owned deep copy.  A
+  debug mutation detector (``MPI_OPERATOR_CACHE_MUTATION_DETECT=1`` or
+  :func:`set_mutation_detection`) fingerprints every installed snapshot
+  and raises :class:`CacheMutationError` on the first read of a
+  tampered object; tier-1 runs with it on (tests/conftest.py).
+
+Tests may instead load the store directly and call ``sync_once``
+semantics via ``Lister`` (the reference fixture hand-loads indexers,
+mpi_job_controller_test.go:214-260).
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 import threading
 from typing import Callable, Optional
 
 from .apiserver import (ADDED, DELETED, MODIFIED, RELIST, ApiServer,
                         Clientset)
-from .meta import deep_copy
+from .meta import deep_copy, get_controller_of
 from .selectors import match_labels
 
 
-class Lister:
-    """Read-only view of an informer cache, namespace-scoped queries."""
+def _registry():
+    from ..telemetry.metrics import default_registry
+    return default_registry()
 
-    def __init__(self, store: dict, lock: threading.RLock):
+
+# Cache-traffic counters (process default registry; per-Lister deltas
+# live on `Lister.stats` for isolated assertions).
+def _counters() -> dict:
+    reg = _registry()
+    return {
+        "list_calls": reg.counter(
+            "mpi_operator_lister_list_calls_total",
+            "Lister.list() invocations across all informers"),
+        "full_scans": reg.counter(
+            "mpi_operator_lister_full_scans_total",
+            "Lister.list() calls that scanned the whole store"
+            " (all-namespaces query; indexed queries never scan)"),
+        "deepcopies": reg.counter(
+            "mpi_operator_lister_deepcopies_total",
+            "Cache objects deep-copied for copy=True readers"),
+        "mutation_violations": reg.counter(
+            "mpi_operator_cache_mutation_violations_total",
+            "Cached snapshots found mutated by a reader (debug"
+            " mutation detector)"),
+        "resync_suppressed": reg.counter(
+            "mpi_operator_resync_dispatches_suppressed_total",
+            "Resync relist entries whose resourceVersion matched the"
+            " cache: handler dispatch suppressed"),
+    }
+
+
+_COUNTERS = _counters()
+
+
+class CacheMutationError(AssertionError):
+    """A shared informer-cache snapshot was mutated in place.
+
+    Readers of the zero-copy lister share the cached object; mutating
+    it corrupts every other consumer (and the next status diff).  Fix
+    the caller: ``deep_copy`` before writing, or read with
+    ``copy=True``."""
+
+
+_MUTATION_DETECT = os.environ.get(
+    "MPI_OPERATOR_CACHE_MUTATION_DETECT", "").lower() not in ("", "0",
+                                                              "false")
+
+
+def set_mutation_detection(enabled: bool) -> None:
+    """Toggle the debug mutation detector process-wide (tier-1 turns it
+    on via conftest; production leaves it off — fingerprinting costs a
+    serialization per install/read)."""
+    global _MUTATION_DETECT
+    _MUTATION_DETECT = bool(enabled)
+
+
+def mutation_detection_enabled() -> bool:
+    return _MUTATION_DETECT
+
+
+def _fingerprint(obj) -> bytes:
+    import pickle
+    try:
+        raw = pickle.dumps(obj, protocol=-1)
+    except Exception:  # exotic object: fall back to the dict rendering
+        from .meta import to_dict
+        raw = repr(to_dict(obj)).encode()
+    return hashlib.blake2b(raw, digest_size=16).digest()
+
+
+# ---------------------------------------------------------------------------
+# Indexer — client-go cache.Indexer analogue
+# ---------------------------------------------------------------------------
+
+def namespace_index(obj) -> list:
+    return [obj.metadata.namespace]
+
+
+def owner_uid_index(obj) -> list:
+    """Controller ownerReference uid (metav1.GetControllerOf)."""
+    ref = get_controller_of(obj)
+    return [ref.uid] if ref is not None and ref.uid else []
+
+
+def ownerless_index(obj) -> list:
+    """Namespace bucket of objects with NO controller owner — the orphan
+    candidates ownership-strict controllers must warn about without
+    scanning every owned object."""
+    return [] if get_controller_of(obj) is not None \
+        else [obj.metadata.namespace]
+
+
+DEFAULT_INDEX_FUNCS = {
+    "namespace": namespace_index,
+    "owner-uid": owner_uid_index,
+    "ownerless": ownerless_index,
+}
+
+
+class Indexer(dict):
+    """``{(namespace, name) -> obj}`` store with hash-bucket indexes.
+
+    A dict subclass so existing direct-store manipulation (test
+    fixtures clear and reload it) keeps the indexes consistent for
+    free.  Not itself locked — the owning informer's lock serializes
+    access, exactly like client-go's ThreadSafeStore wraps its
+    indices."""
+
+    def __init__(self, index_funcs: Optional[dict] = None):
+        super().__init__()
+        self._index_funcs: dict = dict(DEFAULT_INDEX_FUNCS)
+        if index_funcs:
+            self._index_funcs.update(index_funcs)
+        # index name -> {index key -> {store key: True}} (dict-as-set:
+        # deterministic iteration order).
+        self._indexes: dict = {name: {} for name in self._index_funcs}
+        # store key -> [(index name, index key), ...] as APPLIED —
+        # unindexing replays this record instead of re-calling index
+        # fns, so removal can never raise (exception-safety below).
+        self._entries: dict = {}
+        self._fingerprints: dict = {}
+
+    # -- index plumbing ----------------------------------------------------
+    def add_index_func(self, name: str, fn: Callable) -> None:
+        """Register a pluggable index; existing objects are reindexed.
+        The fn is evaluated over the whole store BEFORE any state
+        changes — a raising fn leaves the indexer untouched."""
+        computed = [(key, value)
+                    for key, obj in self.items() for value in fn(obj)]
+        self._index_funcs[name] = fn
+        bucket: dict = {}
+        self._indexes[name] = bucket
+        for key, value in computed:
+            bucket.setdefault(value, {})[key] = True
+            self._entries.setdefault(key, []).append((name, value))
+
+    def _compute_entries(self, obj) -> list:
+        """Evaluate every index fn (the only step that can raise) —
+        called BEFORE any mutation so __setitem__ is install-or-nothing
+        (the watch/resync retry paths rely on that)."""
+        return [(name, value)
+                for name, fn in self._index_funcs.items()
+                for value in fn(obj)]
+
+    def _apply_entries(self, key, entries: list) -> None:
+        for name, value in entries:
+            self._indexes[name].setdefault(value, {})[key] = True
+        self._entries[key] = entries
+
+    def _unindex_obj(self, key) -> None:
+        for name, value in self._entries.pop(key, ()):
+            buckets = self._indexes.get(name)
+            if buckets is None:
+                continue  # index replaced since this entry was applied
+            bucket = buckets.get(value)
+            if bucket is not None:
+                bucket.pop(key, None)
+                if not bucket:
+                    buckets.pop(value, None)
+
+    def index_keys(self, index_name: str, value) -> list:
+        """Store keys under one index bucket (sorted: deterministic)."""
+        return sorted(self._indexes[index_name].get(value, ()))
+
+    def by_index(self, index_name: str, value) -> list:
+        """Objects under one index bucket, key-sorted."""
+        return [self[k] for k in self.index_keys(index_name, value)]
+
+    # -- mutation detection ------------------------------------------------
+    def _tampered(self, key, obj) -> bool:
+        if not _MUTATION_DETECT:
+            return False
+        recorded = self._fingerprints.get(key)
+        if recorded is None or recorded == _fingerprint(obj):
+            return False
+        _COUNTERS["mutation_violations"].inc()
+        # Re-fingerprint so one violation raises once per reader round
+        # instead of wedging every future read.
+        self._fingerprints[key] = _fingerprint(obj)
+        return True
+
+    def verify(self, key, obj) -> None:
+        """Reader-side check: raise on the first read of a tampered
+        snapshot (the reader gets the diagnostic; writers only count —
+        a raise inside the watch thread would kill the informer)."""
+        if self._tampered(key, obj):
+            ns, name = key
+            raise CacheMutationError(
+                f"informer cache object {ns}/{name} was mutated in"
+                f" place; cache snapshots are shared — deep_copy"
+                f" before modifying (or read with copy=True)")
+
+    # -- dict surface (keeps indexes + fingerprints in lockstep) ----------
+    def __setitem__(self, key, obj) -> None:
+        # Index fns run first: if one raises, NOTHING has changed (no
+        # half-installed object with a server-matching RV that the
+        # resync suppression would then hide forever).
+        entries = self._compute_entries(obj)
+        old = super().get(key)
+        if old is not None:
+            # Count (don't raise): the writer replacing a tampered
+            # snapshot is innocent — often the watch thread, whose
+            # death would freeze the cache.  The fresh install heals
+            # the corruption; the violation counter still records it.
+            self._tampered(key, old)
+            self._unindex_obj(key)
+        super().__setitem__(key, obj)
+        self._apply_entries(key, entries)
+        if _MUTATION_DETECT:
+            self._fingerprints[key] = _fingerprint(obj)
+        else:
+            self._fingerprints.pop(key, None)
+
+    def __delitem__(self, key) -> None:
+        self._unindex_obj(key)
+        self._fingerprints.pop(key, None)
+        super().__delitem__(key)
+
+    def pop(self, key, *default):
+        if key in self:
+            self._unindex_obj(key)
+            self._fingerprints.pop(key, None)
+            return super().pop(key)
+        if default:
+            return default[0]
+        raise KeyError(key)
+
+    def clear(self) -> None:
+        super().clear()
+        for bucket in self._indexes.values():
+            bucket.clear()
+        self._entries.clear()
+        self._fingerprints.clear()
+
+    def update(self, *args, **kwargs):  # pragma: no cover - route setitem
+        for k, v in dict(*args, **kwargs).items():
+            self[k] = v
+
+    def setdefault(self, key, default=None):  # pragma: no cover
+        if key not in self:
+            self[key] = default
+        return super().get(key)
+
+
+# ---------------------------------------------------------------------------
+# Lister — zero-copy indexed reads
+# ---------------------------------------------------------------------------
+
+class Lister:
+    """Read-only view of an informer cache.
+
+    Returns SHARED immutable snapshots — never mutate them (pass
+    ``copy=True`` for an owned deep copy).  Namespace-scoped ``list``
+    and the ``by_owner``/``by_index`` lookups serve from index buckets;
+    only an all-namespaces ``list`` walks the store."""
+
+    def __init__(self, store: Indexer, lock: threading.RLock):
         self._store = store
         self._lock = lock
+        self.stats = {"list_calls": 0, "full_scans": 0, "deepcopies": 0,
+                      "index_queries": 0}
 
-    def get(self, namespace: str, name: str):
+    def _out(self, obj, copy: bool):
+        if copy:
+            self.stats["deepcopies"] += 1
+            _COUNTERS["deepcopies"].inc()
+            return deep_copy(obj)
+        return obj
+
+    def get(self, namespace: str, name: str, copy: bool = False):
         with self._lock:
             obj = self._store.get((namespace, name))
-            return deep_copy(obj) if obj is not None else None
+            if obj is None:
+                return None
+            self._store.verify((namespace, name), obj)
+            return self._out(obj, copy)
 
     def list(self, namespace: Optional[str] = None,
-             label_selector: Optional[dict] = None) -> list:
+             label_selector: Optional[dict] = None,
+             copy: bool = False) -> list:
+        self.stats["list_calls"] += 1
+        _COUNTERS["list_calls"].inc()
+        with self._lock:
+            if namespace is None:
+                self.stats["full_scans"] += 1
+                _COUNTERS["full_scans"].inc()
+                keys = sorted(self._store.keys())
+            else:
+                keys = self._store.index_keys("namespace", namespace)
+            out = []
+            for key in keys:
+                obj = self._store[key]
+                # Verify BEFORE the selector match: a mutation that
+                # rewrites labels would otherwise hide the object from
+                # selector queries without ever tripping the detector.
+                self._store.verify(key, obj)
+                if match_labels(label_selector, obj.metadata.labels):
+                    out.append(self._out(obj, copy))
+            return out
+
+    def by_index(self, index_name: str, value, copy: bool = False) -> list:
+        """Objects in one index bucket (hash lookup, no scan)."""
+        self.stats["index_queries"] += 1
         with self._lock:
             out = []
-            for (ns, _), obj in sorted(self._store.items()):
-                if namespace is not None and ns != namespace:
-                    continue
-                if match_labels(label_selector, obj.metadata.labels):
-                    out.append(deep_copy(obj))
+            for key in self._store.index_keys(index_name, value):
+                obj = self._store[key]
+                self._store.verify(key, obj)
+                out.append(self._out(obj, copy))
             return out
+
+    def by_owner(self, uid: str, copy: bool = False) -> list:
+        """Objects whose controller ownerReference uid is ``uid``."""
+        return self.by_index("owner-uid", uid, copy=copy)
+
+    def ownerless(self, namespace: str, copy: bool = False) -> list:
+        """Objects in ``namespace`` with no controller owner (orphan
+        candidates)."""
+        return self.by_index("ownerless", namespace, copy=copy)
 
 
 class SharedInformer:
     # Periodic relist+diff: heals missed watch events (stream gaps,
-    # reconnects) the way client-go's resync does; level-triggered
-    # consumers re-observe every object each interval.
+    # reconnects) the way client-go's resync does.  The relist is
+    # diffed against the cache by resourceVersion — only real changes
+    # dispatch (suppressions counted in
+    # mpi_operator_resync_dispatches_suppressed_total).
     RESYNC_INTERVAL = 30.0
 
     def __init__(self, clientset: Clientset, api_version: str, kind: str,
@@ -60,18 +379,27 @@ class SharedInformer:
         self.resync_interval = (resync_interval if resync_interval is not None
                                 else self.RESYNC_INTERVAL)
         self._lock = threading.RLock()
-        self._store: dict = {}
+        self._store: Indexer = Indexer()
         self.lister = Lister(self._store, self._lock)
         self._handlers: list = []
         self._thread: Optional[threading.Thread] = None
         self._watch = None
         self._stopped = threading.Event()
         self.synced = False
+        self.resync_suppressed = 0
+
+    def add_index_func(self, name: str, fn: Callable) -> None:
+        """Register a pluggable index function (client-go AddIndexers)."""
+        with self._lock:
+            self._store.add_index_func(name, fn)
 
     # -- cache manipulation (tests load directly; watch thread in prod) ----
     def add_to_cache(self, obj) -> None:
+        # Deep copy on install: the caller keeps ownership of its
+        # object; the cache owns the frozen snapshot.
         with self._lock:
-            self._store[(obj.metadata.namespace, obj.metadata.name)] = deep_copy(obj)
+            self._store[(obj.metadata.namespace, obj.metadata.name)] = \
+                deep_copy(obj)
 
     def delete_from_cache(self, namespace: str, name: str) -> None:
         with self._lock:
@@ -101,6 +429,8 @@ class SharedInformer:
                                        self.namespace)
         with self._lock:
             for obj in initial:
+                # The list response is a server-side copy: install it
+                # directly as the shared snapshot.
                 self._store[(obj.metadata.namespace, obj.metadata.name)] = obj
         self.synced = True
         for obj in initial:
@@ -136,12 +466,22 @@ class SharedInformer:
                                    == self.namespace):
                 obj = ev.obj
                 key = (obj.metadata.namespace, obj.metadata.name)
-                with self._lock:
-                    old = self._store.get(key)
-                    if ev.type == DELETED:
-                        self._store.pop(key, None)
-                    else:
-                        self._store[key] = deep_copy(obj)
+                try:
+                    with self._lock:
+                        old = self._store.get(key)
+                        if ev.type == DELETED:
+                            self._store.pop(key, None)
+                        else:
+                            # The watch event object is this stream's
+                            # private copy (apiserver deep-copies per
+                            # watch): install it as the shared
+                            # snapshot, no further copy.
+                            self._store[key] = obj
+                except Exception:
+                    # A per-object install failure (index fn bug) must
+                    # not kill the watch thread and freeze the cache;
+                    # the stale RV lets the periodic resync retry.
+                    continue
                 self._dispatch(ev.type, old, obj)
             if self.resync_interval and \
                     time.monotonic() - last_resync >= self.resync_interval:
@@ -152,19 +492,40 @@ class SharedInformer:
                     pass  # transient API failure; next interval retries
 
     def _resync(self) -> None:
-        """Relist and reconcile the cache with the store, dispatching the
-        implied events (heals watch-stream gaps)."""
+        """Relist and reconcile the cache with the store, dispatching
+        ONLY the implied real events (heals watch-stream gaps).
+
+        Entries whose resourceVersion matches the cached snapshot are
+        left untouched — the shared snapshot keeps its identity, no
+        handler fires, and the suppression is counted.  The original
+        implementation re-dispatched every object on every 30s resync,
+        turning a quiet 1000-pod cluster into a permanent event storm."""
         current = {(o.metadata.namespace, o.metadata.name): o
                    for o in self._cs.server.list(self.api_version, self.kind,
                                                  self.namespace)}
+        suppressed = 0
         with self._lock:
             stale_keys = [k for k in self._store if k not in current]
             updates = []
             for key, obj in current.items():
                 old = self._store.get(key)
-                self._store[key] = deep_copy(obj)
+                if old is not None and old.metadata.resource_version \
+                        == obj.metadata.resource_version:
+                    suppressed += 1
+                    continue
+                try:
+                    self._store[key] = obj
+                except Exception:
+                    # Per-key isolation (e.g. a pluggable index fn
+                    # choking on one object): leave the old snapshot —
+                    # its stale RV makes the next resync retry the key
+                    # instead of the suppression path hiding it forever.
+                    continue
                 updates.append((old, obj))
             removed = [self._store.pop(k) for k in stale_keys]
+            self.resync_suppressed += suppressed
+        if suppressed:
+            _COUNTERS["resync_suppressed"].inc(suppressed)
         for old, obj in updates:
             self._dispatch(ADDED if old is None else MODIFIED, old, obj)
         for obj in removed:

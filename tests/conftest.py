@@ -19,6 +19,11 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # off-GCP, libtpu retries each metadata variable 30x against a 403
 # (minutes of stall at the first AOT topology probe).
 os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
+# Informer caches hand out SHARED zero-copy snapshots; the whole suite
+# runs with the debug mutation detector armed so any code path that
+# mutates a cached object in place fails loudly here instead of
+# corrupting sibling readers in production (k8s/informers.py).
+os.environ.setdefault("MPI_OPERATOR_CACHE_MUTATION_DETECT", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

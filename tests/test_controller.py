@@ -661,6 +661,69 @@ def test_launcher_pods_exclude_orphans_with_warning():
     assert not any("foreign" in e for e in f.recorder.events)
 
 
+def test_orphan_pod_warning_deduped_across_syncs():
+    """Regression (ISSUE 4): one orphan must yield ONE aggregated
+    OrphanPod event across 10 syncs, not one per sync — the Recorder's
+    aggregation was doing all the work."""
+    from mpi_operator_tpu.k8s import batch
+
+    f = Fixture()
+    launcher = batch.Job(
+        metadata=ObjectMeta(name="test-launcher", namespace="default",
+                            uid="launcher-uid"),
+        spec=batch.JobSpec(
+            selector=batch.LabelSelector(match_labels={"job-name": "test"})))
+    orphan = core.Pod(metadata=ObjectMeta(
+        name="orphan", namespace="default", uid="orphan-uid",
+        labels={"job-name": "test"}))
+    f.factory.pods().add_to_cache(orphan)
+
+    for _ in range(10):
+        f.controller._launcher_pods(launcher)
+    assert sum("OrphanPod" in e for e in f.recorder.events) == 1
+
+    # A DIFFERENT orphan still warns (dedupe is per (launcher, pod)).
+    other = core.Pod(metadata=ObjectMeta(
+        name="orphan2", namespace="default", uid="orphan2-uid",
+        labels={"job-name": "test"}))
+    f.factory.pods().add_to_cache(other)
+    f.controller._launcher_pods(launcher)
+    assert sum("OrphanPod" in e for e in f.recorder.events) == 2
+
+
+def test_status_write_suppression_counter_and_no_api_calls():
+    """Regression (ISSUE 4): repeated syncs of a converged job skip the
+    status UPDATE client-side (counted), instead of leaning on the
+    apiserver's no-op absorption."""
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    # cleanPodPolicy: All keeps the finished-job sync on the cleanup +
+    # status-write path (the default policy returns before any write).
+    job.spec.run_policy.clean_pod_policy = constants.CLEAN_POD_POLICY_ALL
+    f.register_job(job)
+    run_job_to_running(f, job)
+    launcher = f.client.jobs("default").get("test-launcher")
+    launcher.status.conditions.append(batch.JobCondition(
+        type=batch.JOB_COMPLETE, status="True"))
+    launcher.status.completion_time = f.clock.now()
+    f.client.jobs("default").update_status(launcher)
+    f.refresh_caches()
+    f.sync(job)     # -> Succeeded
+    f.refresh_caches()
+    f.sync(job)     # cleanup pass
+    f.refresh_caches()
+
+    suppressed = f.controller.metrics["status_writes_suppressed"]
+    before = suppressed.value
+    f.client.clear_actions()
+    for _ in range(5):
+        f.sync(job)
+        f.refresh_caches()
+    assert suppressed.value >= before + 5
+    assert not any(a.verb == "update" and a.kind == "MPIJob"
+                   for a in f.client.actions)
+
+
 # ---------------------------------------------------------------------------
 # Gang restart (RestartPolicy=ExitCode slice repair; reference declares the
 # ExitCode surface but maps it to Never, :1722-1728)

@@ -1,0 +1,371 @@
+"""Indexed informer cache tests — index correctness under churn, shared
+zero-copy snapshot semantics, the debug mutation detector, resync
+dispatch suppression, and the perf-shape guard that keeps the
+controller's per-sync read cost O(1) in cluster size (ISSUE 4)."""
+
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu.k8s import informers as informers_mod
+from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.k8s.core import Pod
+from mpi_operator_tpu.k8s.informers import (CacheMutationError, Indexer,
+                                            InformerFactory,
+                                            set_mutation_detection)
+from mpi_operator_tpu.k8s.meta import (ObjectMeta, OwnerReference, deep_copy,
+                                       new_controller_ref)
+
+
+def pod(name, ns="ns", owner_uid=None, labels=None):
+    refs = []
+    if owner_uid is not None:
+        refs = [OwnerReference(api_version="batch/v1", kind="Job",
+                               name="owner", uid=owner_uid, controller=True)]
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                   labels=dict(labels or {}),
+                                   owner_references=refs))
+
+
+@pytest.fixture(autouse=True)
+def _detector_on():
+    """These tests assume the tier-1 default: detector armed."""
+    set_mutation_detection(True)
+    yield
+    set_mutation_detection(True)
+
+
+# --- Indexer unit behavior -------------------------------------------------
+
+def test_indexer_buckets_under_add_update_delete():
+    idx = Indexer()
+    a = pod("a", ns="n1", owner_uid="u1")
+    b = pod("b", ns="n2", owner_uid="u1")
+    c = pod("c", ns="n1")
+    for p in (a, b, c):
+        idx[(p.metadata.namespace, p.metadata.name)] = p
+
+    assert idx.index_keys("namespace", "n1") == [("n1", "a"), ("n1", "c")]
+    assert [p.metadata.name for p in idx.by_index("owner-uid", "u1")] \
+        == ["a", "b"]
+    assert [p.metadata.name for p in idx.by_index("ownerless", "n1")] == ["c"]
+
+    # Update moves the object between buckets (owner added to c).
+    c2 = pod("c", ns="n1", owner_uid="u2")
+    idx[("n1", "c")] = c2
+    assert idx.by_index("ownerless", "n1") == []
+    assert [p.metadata.name for p in idx.by_index("owner-uid", "u2")] == ["c"]
+
+    # Delete drains every bucket it was in.
+    del idx[("n1", "a")]
+    idx.pop(("n2", "b"))
+    assert [p.metadata.name for p in idx.by_index("owner-uid", "u1")] == []
+    assert idx.index_keys("namespace", "n2") == []
+
+    idx.clear()
+    assert idx.by_index("owner-uid", "u2") == []
+    assert len(idx) == 0
+
+
+def test_indexer_pluggable_index_func_reindexes_existing():
+    idx = Indexer()
+    idx[("ns", "x")] = pod("x", labels={"phase": "hot"})
+    idx[("ns", "y")] = pod("y", labels={"phase": "cold"})
+    idx.add_index_func("phase",
+                       lambda o: [o.metadata.labels.get("phase", "")])
+    assert [p.metadata.name for p in idx.by_index("phase", "hot")] == ["x"]
+    idx[("ns", "y")] = pod("y", labels={"phase": "hot"})
+    assert [p.metadata.name for p in idx.by_index("phase", "hot")] \
+        == ["x", "y"]
+
+
+def test_indexer_setitem_is_install_or_nothing_on_raising_index_fn():
+    """A pluggable index fn that raises must leave the store untouched
+    (no half-installed object with a server-matching resourceVersion
+    that resync suppression would hide forever), and removal of
+    already-indexed objects must never call index fns again."""
+    idx = Indexer()
+    ok = pod("ok", labels={"v": "1"})
+    idx[("ns", "ok")] = ok
+
+    def picky(obj):
+        if obj.metadata.labels.get("poison"):
+            raise ValueError("malformed object")
+        return [obj.metadata.labels.get("v", "")]
+
+    idx.add_index_func("picky", picky)
+    assert [p.metadata.name for p in idx.by_index("picky", "1")] == ["ok"]
+
+    bad = pod("ok", labels={"v": "2", "poison": "yes"})
+    with pytest.raises(ValueError):
+        idx[("ns", "ok")] = bad
+    # Old snapshot fully intact: store, every bucket, fingerprint.
+    assert idx[("ns", "ok")] is ok
+    assert [p.metadata.name for p in idx.by_index("picky", "1")] == ["ok"]
+    assert idx.by_index("picky", "2") == []
+    idx.verify(("ns", "ok"), idx[("ns", "ok")])  # no false tamper alarm
+
+    # Retry with a healed object succeeds and re-buckets.
+    idx[("ns", "ok")] = pod("ok", labels={"v": "2"})
+    assert [p.metadata.name for p in idx.by_index("picky", "2")] == ["ok"]
+
+    # Removal replays recorded entries — works even for objects the fn
+    # would now choke on (entries were recorded at install time).
+    idx.pop(("ns", "ok"))
+    assert idx.by_index("picky", "2") == []
+    assert idx.index_keys("namespace", "ns") == []
+
+
+# --- live informer: indexes follow the watch stream + relist --------------
+
+def test_informer_indexes_follow_watch_and_relist():
+    cs = Clientset()
+    factory = InformerFactory(cs)
+    inf = factory.pods()
+    factory.start_all()
+    assert factory.wait_for_cache_sync()
+
+    owner = cs.jobs("ns").create(
+        __import__("mpi_operator_tpu.k8s.batch", fromlist=["Job"]).Job(
+            metadata=ObjectMeta(name="owner", namespace="ns")))
+    cs.pods("ns").create(
+        Pod(metadata=ObjectMeta(
+            name="owned", namespace="ns",
+            owner_references=[new_controller_ref(owner, "batch/v1", "Job")])))
+    cs.pods("ns").create(pod("stray", ns="ns"))
+
+    def wait(cond, timeout=3.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.01)
+        return False
+
+    uid = owner.metadata.uid
+    assert wait(lambda: len(inf.lister.by_owner(uid)) == 1)
+    assert wait(lambda: [p.metadata.name
+                         for p in inf.lister.ownerless("ns")] == ["stray"])
+
+    # Orphan handling through the owner index: deleting the owner
+    # cascades the owned pod out of its bucket.
+    cs.jobs("ns").delete("owner")
+    assert wait(lambda: inf.lister.by_owner(uid) == [])
+    assert wait(lambda: [p.metadata.name
+                         for p in inf.lister.ownerless("ns")] == ["stray"])
+
+    # 410/RELIST path: indexes stay consistent after a forced relist.
+    cs.pods("ns").create(pod("post-relist", ns="ns"))
+    cs.server.relist_watches("v1", "Pod")
+    assert wait(lambda: len(inf.lister.ownerless("ns")) == 2)
+    assert inf.lister.list("ns") == inf.lister.by_index("namespace", "ns")
+    factory.stop_all()
+
+
+def test_concurrent_readers_during_writer_churn():
+    """Thread-hammer: watch-driven writer churn while readers pound the
+    indexed lister — no exceptions, no torn index state."""
+    cs = Clientset()
+    factory = InformerFactory(cs)
+    inf = factory.pods()
+    factory.start_all()
+    assert factory.wait_for_cache_sync()
+
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                for p in inf.lister.list("ns"):
+                    assert p.metadata.name  # shared snapshot, read-only
+                inf.lister.by_owner("u-0")
+                inf.lister.ownerless("ns")
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for t in readers:
+        t.start()
+    try:
+        for i in range(60):
+            name = f"churn-{i % 12}"
+            try:
+                cs.pods("ns").create(pod(name, ns="ns",
+                                         owner_uid=f"u-{i % 3}"))
+            except Exception:
+                cs.pods("ns").delete(name)
+    finally:
+        stop.set()
+        for t in readers:
+            t.join(timeout=5)
+        factory.stop_all()
+    assert not errors, errors
+
+    # Post-churn: every index agrees with the ground-truth store.
+    with inf._lock:
+        names = sorted(k[1] for k in inf._store)
+        by_ns = sorted(k[1] for k in inf._store.index_keys("namespace", "ns"))
+    assert names == by_ns
+
+
+# --- zero-copy snapshots + mutation detector ------------------------------
+
+def test_lister_returns_shared_snapshot_and_copy_escape_hatch():
+    cs = Clientset()
+    inf = InformerFactory(cs).pods()
+    inf.add_to_cache(pod("p", labels={"a": "1"}))
+
+    first = inf.lister.get("ns", "p")
+    second = inf.lister.get("ns", "p")
+    assert first is second  # zero-copy: the SAME shared snapshot
+    assert inf.lister.list("ns")[0] is first
+
+    copies_before = inf.lister.stats["deepcopies"]
+    owned = inf.lister.get("ns", "p", copy=True)
+    assert owned is not first and owned == first
+    assert inf.lister.stats["deepcopies"] == copies_before + 1
+    owned.metadata.labels["a"] = "mine"  # legal: it's an owned copy
+    assert inf.lister.get("ns", "p").metadata.labels["a"] == "1"
+
+
+def test_mutation_detector_raises_on_cache_tampering():
+    cs = Clientset()
+    inf = InformerFactory(cs).pods()
+    inf.add_to_cache(pod("p", labels={"a": "1"}))
+
+    violations = informers_mod._COUNTERS["mutation_violations"]
+    before = violations.value
+    shared = inf.lister.get("ns", "p")
+    shared.metadata.labels["a"] = "TAMPERED"  # the client-go cardinal sin
+    with pytest.raises(CacheMutationError):
+        inf.lister.get("ns", "p")
+    assert violations.value == before + 1
+
+
+def test_mutation_violation_does_not_kill_watch_thread():
+    """Writer-side detection counts but never raises: a tampered
+    snapshot being replaced by a legitimate watch update must heal the
+    cache, not kill the informer thread (which would freeze the cache
+    with the corrupted object)."""
+    cs = Clientset()
+    factory = InformerFactory(cs)
+    inf = factory.pods()
+    factory.start_all()
+    assert factory.wait_for_cache_sync()
+    created = cs.pods("ns").create(pod("p", ns="ns", labels={"a": "1"}))
+
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and inf.lister.get("ns", "p") is None:
+        time.sleep(0.01)
+    violations = informers_mod._COUNTERS["mutation_violations"]
+    before = violations.value
+    inf.lister.get("ns", "p").metadata.labels["a"] = "TAMPERED"
+
+    # Legitimate API write -> watch MODIFIED replaces the snapshot.
+    created.metadata.labels["a"] = "2"
+    cs.pods("ns").update(created)
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline:
+        try:
+            if inf.lister.get("ns", "p").metadata.labels["a"] == "2":
+                break
+        except CacheMutationError:
+            pass  # reader raced the healing install; retry
+        time.sleep(0.01)
+    assert inf._thread.is_alive()
+    assert inf.lister.get("ns", "p").metadata.labels["a"] == "2"  # healed
+    assert violations.value == before + 1
+    factory.stop_all()
+
+
+def test_mutation_detector_off_tolerates_mutation():
+    set_mutation_detection(False)
+    try:
+        cs = Clientset()
+        inf = InformerFactory(cs).pods()
+        inf.add_to_cache(pod("p", labels={"a": "1"}))
+        inf.lister.get("ns", "p").metadata.labels["a"] = "TAMPERED"
+        assert inf.lister.get("ns", "p").metadata.labels["a"] == "TAMPERED"
+    finally:
+        set_mutation_detection(True)
+
+
+# --- resync suppression ----------------------------------------------------
+
+def test_resync_suppresses_unchanged_dispatches():
+    cs = Clientset()
+    factory = InformerFactory(cs)
+    inf = factory.pods()
+    inf.resync_interval = 0  # no periodic resync; we drive it by hand
+    events = []
+    inf.add_event_handler(
+        on_add=lambda o: events.append(("add", o.metadata.name)),
+        on_update=lambda old, new: events.append(("upd", new.metadata.name)),
+        on_delete=lambda o: events.append(("del", o.metadata.name)))
+    factory.start_all()
+    assert factory.wait_for_cache_sync()
+    for i in range(3):
+        cs.pods("ns").create(pod(f"p{i}", ns="ns"))
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and len(events) < 3:
+        time.sleep(0.01)
+    inf._watch.stop()  # freeze the stream: resync is the only input
+
+    events.clear()
+    suppressed_before = inf.resync_suppressed
+    inf._resync()  # nothing changed: every dispatch suppressed
+    assert events == []
+    assert inf.resync_suppressed == suppressed_before + 3
+
+    # One real change: exactly one dispatch, two suppressions.
+    p0 = cs.pods("ns").get("p0")
+    p0.metadata.labels["touched"] = "1"
+    cs.pods("ns").update(p0)
+    events.clear()
+    inf._resync()
+    assert events == [("upd", "p0")]
+    assert inf.resync_suppressed == suppressed_before + 5
+    factory.stop_all()
+
+
+# --- perf-shape guard: O(1) reads per sync --------------------------------
+
+def _mid_life_fixture(n_unrelated: int):
+    """A controller fixture with one mid-life job (launcher exists,
+    workers Running) plus N unrelated pods crowding the same namespace."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_controller import Fixture, new_mpi_job, run_job_to_running
+
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    f.register_job(job)
+    run_job_to_running(f, job)
+    for i in range(n_unrelated):
+        f.client.pods("default").create(pod(f"noise-{i}", ns="default",
+                                            owner_uid=f"noise-owner-{i % 7}"))
+    f.refresh_caches()
+    return f, job
+
+
+def _sync_read_cost(n_unrelated: int):
+    f, job = _mid_life_fixture(n_unrelated)
+    pods_lister = f.factory.pods().lister
+    stats_before = dict(pods_lister.stats)
+    f.sync(f.get_job())
+    return {k: pods_lister.stats[k] - stats_before[k]
+            for k in ("list_calls", "full_scans")}
+
+
+def test_sync_read_cost_is_o1_in_cluster_size():
+    """The steady-state sync must not scan the pod cache: list() calls
+    stay constant (and full scans zero) whether the namespace holds 0
+    or 300 unrelated pods."""
+    small = _sync_read_cost(0)
+    large = _sync_read_cost(300)
+    assert small["full_scans"] == 0
+    assert large["full_scans"] == 0
+    assert large["list_calls"] == small["list_calls"]
+    assert large["list_calls"] == 0  # owner-index serves everything
