@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
-	controller-bench-smoke
+	controller-bench-smoke serve-bench-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -43,6 +43,13 @@ obs-smoke:
 # scans, zero shared-snapshot mutations (docs/PERF.md).
 controller-bench-smoke:
 	$(PYTHON) tools/controller_bench_smoke.py
+
+# Serving decode hot path (< 60s, CPU): pipelined vs reference loops
+# emit byte-identical mixed greedy/sampled streams (dense + paged),
+# exactly one device->host transfer per steady-state tick
+# (counter-asserted), and a ticks/sec floor holds (docs/PERF.md).
+serve-bench-smoke:
+	$(PYTHON) tools/serve_bench_smoke.py
 
 native:
 	$(MAKE) -C native

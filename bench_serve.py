@@ -496,6 +496,205 @@ def _speculative_phase(jax, cfg, model, variables, prompt_len: int) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Host-overhead ("hotpath") mode — ISSUE 5
+# ---------------------------------------------------------------------------
+
+def _hotpath_config():
+    """Host-overhead-dominated workload: a model so small that the
+    decode step's device compute is microseconds, so ticks/sec is set
+    almost entirely by per-tick Python, dispatch and D2H latency — the
+    cost the pipelined tick loop exists to hide."""
+    return dict(
+        dim=int(os.environ.get("BENCH_SERVE_HP_DIM", "32")),
+        n_layers=int(os.environ.get("BENCH_SERVE_HP_LAYERS", "1")),
+        seq=int(os.environ.get("BENCH_SERVE_HP_SEQ", "192")),
+        slots=int(os.environ.get("BENCH_SERVE_HP_SLOTS", "32")),
+        prompt_len=int(os.environ.get("BENCH_SERVE_HP_PROMPT", "8")),
+        new_tokens=int(os.environ.get("BENCH_SERVE_HP_NEW_TOKENS", "160")),
+        repeats=int(os.environ.get("BENCH_SERVE_HP_REPEATS", "3")),
+    )
+
+
+def _hotpath_run(model, variables, cfg, prompts, *, pipelined: bool,
+                 per_slot_fetch: bool, label: str) -> dict:
+    """One measured pass through a fresh batcher; returns tick/transfer
+    economics plus the emitted streams (for the zero-divergence check).
+
+    Ticks/sec is measured over the STEADY-STATE window — from tick
+    ``lo`` to tick ``hi`` of the pass, sampled off the batcher's tick
+    counter — so the one-time admission prefills (identical in every
+    variant) don't dilute the before/after contrast of the tick loop
+    itself.  Transfers-per-tick comes from the same window, which is
+    exactly the "1 D2H per steady-state tick" invariant."""
+    import threading as _threading
+
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    b = ContinuousBatcher(model, variables, max_slots=cfg["slots"],
+                          pipelined=pipelined)
+    b._per_slot_fetch = per_slot_fetch
+    b.start()
+    window = {}
+
+    def sample_window(ticks0: int):
+        """Poll the tick counter; snapshot (time, ticks, transfers) at
+        the window edges while the pass runs.  Deadline-bounded so a
+        failed pass can't strand the sampler."""
+        deadline = time.perf_counter() + 300
+        lo = ticks0 + 16
+        hi = ticks0 + cfg["new_tokens"] - 16
+        while b.ticks_fetched < lo and b.fatal_error is None \
+                and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        window["t1"] = time.perf_counter()
+        window["ticks1"] = b.ticks_fetched
+        window["transfers1"] = b.telemetry["transfers_total"].value
+        window["dispatches1"] = b.telemetry["dispatches_total"].value
+        while b.ticks_fetched < hi and b.fatal_error is None \
+                and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        window["t2"] = time.perf_counter()
+        window["ticks2"] = b.ticks_fetched
+        window["transfers2"] = b.telemetry["transfers_total"].value
+        window["dispatches2"] = b.telemetry["dispatches_total"].value
+
+    if cfg["new_tokens"] < 48:
+        raise SystemExit(
+            f"BENCH_SERVE_HP_NEW_TOKENS={cfg['new_tokens']} too small: "
+            f"the steady-state window samples ticks 16..new_tokens-16, "
+            f"so at least 48 tokens are needed")
+    try:
+        # Warm the prefill bucket + decode executable outside the timing.
+        b.submit([3] * cfg["prompt_len"], 2, timeout=600)
+        # Short Python-dominated passes are scheduler-noise-sensitive:
+        # repeat and keep the best pass (the standard min-noise
+        # estimator), holding the counter deltas from the same pass.
+        best = None
+        outs = None
+        for _ in range(max(1, cfg["repeats"])):
+            window.clear()
+            sampler = _threading.Thread(target=sample_window,
+                                        args=(b.ticks_fetched,))
+            sampler.start()
+            run_outs, dt = _run_concurrent(b, prompts, cfg["new_tokens"])
+            sampler.join(timeout=60)
+            if "ticks2" not in window:
+                raise SystemExit(
+                    "hotpath sampler never saw the steady-state window "
+                    "(pass too short or batcher stalled); raise "
+                    "BENCH_SERVE_HP_NEW_TOKENS")
+            assert outs is None or outs == run_outs, \
+                "non-deterministic streams across repeat passes"
+            outs = run_outs
+            ticks = window["ticks2"] - window["ticks1"]
+            secs = window["t2"] - window["t1"]
+            rec = (secs, ticks,
+                   window["transfers2"] - window["transfers1"],
+                   window["dispatches2"] - window["dispatches1"], dt)
+            if best is None or rec[1] / rec[0] > best[1] / best[0]:
+                best = rec
+        secs, ticks, transfers, dispatches, dt = best
+    finally:
+        b.stop()
+    return {
+        "label": label,
+        "pipelined": pipelined,
+        "per_slot_fetch": per_slot_fetch,
+        "window_seconds": round(secs, 4),
+        "window_ticks": int(ticks),
+        "ticks_per_sec": round(ticks / secs, 1),
+        "pass_seconds": round(dt, 3),
+        "tokens_per_sec": round(len(prompts) * cfg["new_tokens"] / dt, 1),
+        "dispatches": int(dispatches),
+        "d2h_transfers": int(transfers),
+        "transfers_per_tick": round(transfers / max(1, ticks), 3),
+        "streams": outs,
+    }
+
+
+def hotpath_main(out_path: str) -> int:
+    """Before/after capture of the serving tick loop's host overhead:
+    'before' reproduces the pre-pipelining cost shape (serialized
+    dispatch, one blocking D2H per slot per tick); 'after' is the
+    shipped loop (pipelined dispatch, ONE D2H per tick).  Also verifies
+    the three variants emit byte-identical streams.  Writes
+    BENCH_SERVE_HOTPATH.json and prints its record as one JSON line."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+
+    hp = _hotpath_config()
+    cfg = LlamaConfig(vocab_size=256, dim=hp["dim"],
+                      n_layers=hp["n_layers"],
+                      n_heads=max(1, hp["dim"] // 32),
+                      n_kv_heads=max(1, hp["dim"] // 64),
+                      max_seq_len=hp["seq"])
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(9)
+    # Exactly one request per slot: every admission happens in the
+    # first ticks and the rest of the pass is pure steady-state decode,
+    # so the before/after delta measures the tick loop itself, not
+    # prefill churn.  Greedy workload (the throughput shape); the mixed
+    # greedy/sampled/speculative equivalence matrix lives in
+    # tests/test_batcher_pipeline.py and tools/serve_bench_smoke.py.
+    prompts = [list(map(int, rng.integers(1, cfg.vocab_size,
+                                          hp["prompt_len"])))
+               for _ in range(hp["slots"])]
+
+    before = _hotpath_run(model, variables, hp, prompts,
+                          pipelined=False, per_slot_fetch=True,
+                          label="before (serialized, per-slot fetch)")
+    single = _hotpath_run(model, variables, hp, prompts,
+                          pipelined=False, per_slot_fetch=False,
+                          label="single-transfer only (serialized)")
+    after = _hotpath_run(model, variables, hp, prompts,
+                         pipelined=True, per_slot_fetch=False,
+                         label="after (pipelined, single transfer)")
+
+    divergence = sum(
+        1 for a, b in zip(after["streams"], before["streams"]) if a != b
+    ) + sum(1 for a, b in zip(single["streams"], before["streams"])
+            if a != b)
+    for rec in (before, single, after):
+        rec.pop("streams")
+
+    speedup = after["ticks_per_sec"] / max(1e-9, before["ticks_per_sec"])
+    record = {
+        "metric": "serve_hotpath_ticks_per_sec",
+        "value": after["ticks_per_sec"],
+        "unit": "ticks/sec",
+        "vs_baseline": None,
+        "platform": jax.devices()[0].platform,
+        "config": {k: hp[k] for k in sorted(hp)},
+        "n_requests": len(prompts),
+        "before": before,
+        "single_transfer": single,
+        "after": after,
+        "speedup_ticks_per_sec": round(speedup, 2),
+        "stream_divergence": divergence,
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    if divergence:
+        print(f"hotpath: FAIL — {divergence} diverged streams",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> None:
     attempt_timeout = float(
         os.environ.get("BENCH_SERVE_ATTEMPT_TIMEOUT", "1800"))
@@ -509,7 +708,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--worker" in sys.argv:
+    if "--hotpath" in sys.argv:
+        sys.exit(hotpath_main(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_SERVE_HOTPATH.json")))
+    elif "--worker" in sys.argv:
         worker(donate="--no-donate" not in sys.argv)
     else:
         main()

@@ -23,6 +23,22 @@ oversubscribes: many short requests share the memory one worst-case
 slot would pin, and admission simply waits for blocks when the pool
 runs dry.
 
+The steady-state tick is **pipelined** (``pipelined=True``, the
+default): step k+1 is dispatched from step k's still-on-device token
+array before any of step k's tokens are fetched, so the device computes
+step k+1 while the host runs stop-checks, emission, retirement and
+admission for step k (JAX async dispatch).  A slot that retires or is
+replaced between dispatch and fetch simply has its overrun token
+discarded at fetch time, so emitted streams are byte-identical to the
+serialized loop's — greedy and sampled (regression-tested; see
+tools/serve_bench_smoke.py).  Each tick fetches the whole
+``[max_slots]`` token array in ONE device→host transfer instead of one
+blocking transfer per slot; the transfer/dispatch budget is counted in
+telemetry (``serving_d2h_transfers_total`` et al.) so the invariant is
+asserted, not assumed.  Speculative batchers keep the serialized loop:
+acceptance needs the committed host-side streams before each round, and
+a verify round already amortizes its round-trip over k+1 tokens.
+
 Paged mode also prefix-caches (``prefix_cache=True``): full prompt
 blocks are content-addressed by their token prefix, so a request whose
 prompt begins with a previously-seen prefix points its block table at
@@ -38,14 +54,57 @@ positions at or past the owning slot's prompt suffix.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..models.llama import select_rows as _select_rows
 from ..telemetry.metrics import Registry, new_serving_metrics
+
+PIPELINE_ENV = "MPI_OPERATOR_SERVE_PIPELINE"
+
+
+class _WaitQueue:
+    """FIFO of requests with a *non-dequeuing* idle wait.
+
+    ``queue.Queue.get(timeout) + put(...)`` — the old idle-wait idiom —
+    re-enqueues the peeked request BEHIND anything submitted in
+    between, breaking admission FIFO exactly when the batcher is busy
+    waking up.  This queue exposes :meth:`wait_nonempty` instead: the
+    scheduler blocks on the condition without ever taking the head, so
+    submission order is admission order unconditionally.
+    """
+
+    def __init__(self):
+        self._items: deque = deque()
+        self._cond = threading.Condition()
+
+    def put(self, item) -> None:
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify_all()
+
+    def get_nowait(self):
+        with self._cond:
+            if not self._items:
+                raise queue.Empty
+            return self._items.popleft()
+
+    def qsize(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        """Block until an item is present (without removing it) or the
+        timeout elapses; returns whether the queue is non-empty."""
+        with self._cond:
+            if not self._items:
+                self._cond.wait(timeout)
+            return bool(self._items)
 
 
 @dataclass
@@ -68,6 +127,9 @@ class _Request:
     metrics: Optional[dict] = None
     submitted_at: float = 0.0
     _last_emit: float = 0.0
+    # Set when the request sat out a pool-exhaustion deferral, so its
+    # admission wait lands in the path="deferred" histogram variant.
+    was_deferred: bool = False
 
     def emit(self, token: int) -> None:
         if self.metrics is not None:
@@ -114,6 +176,7 @@ class ContinuousBatcher:
                  draft_strategy: Optional[str] = None,
                  prompt_lookup_ngram: int = 3,
                  prefill_chunk: int = 0,
+                 pipelined: Optional[bool] = None,
                  telemetry_registry: Optional[Registry] = None):
         import dataclasses
 
@@ -125,13 +188,35 @@ class ContinuousBatcher:
         self.max_slots = max_slots
         self.telemetry = new_serving_metrics(telemetry_registry
                                              or Registry())
-        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # Pipelined steady-state ticks (see module docstring): default
+        # on, overridable per-batcher or fleet-wide via the env knob;
+        # forced off below when a draft is configured (speculation needs
+        # the committed host-side streams before every round).
+        if pipelined is None:
+            pipelined = os.environ.get(
+                PIPELINE_ENV, "1").lower() not in ("0", "false", "no")
+        self.pipelined = bool(pipelined)
+        # Tick accounting, written only by the scheduler thread: the
+        # flight-recorder breadcrumb that says whether a dead batcher
+        # was mid-dispatch or mid-fetch, and the source for the
+        # serving_pipeline_depth gauge.
+        self.ticks_dispatched = 0
+        self.ticks_fetched = 0
+        # Bench-only escape hatch (bench_serve.py --hotpath "before"
+        # capture): fetch each live slot's token with its own blocking
+        # device->host transfer, reproducing the pre-pipelining loop's
+        # per-slot `int(out[i])` cost shape.  Never set in production.
+        self._per_slot_fetch = False
+        self._queue: "_WaitQueue" = _WaitQueue()
         self._stop = threading.Event()
         # Set when the scheduler loop dies unrecoverably (an exception
         # inside a donated prefill leaves self._cache referencing
-        # donated buffers — see _loop).  Once set, every submit fails
-        # loudly instead of queueing against a dead KV cache.
+        # donated buffers; a device error mid-dispatch or mid-fetch
+        # poisons the tick pipeline — see _tick_fatal).  Once set,
+        # every submit fails loudly instead of queueing against a dead
+        # KV cache; _fatal_phase says which tick phase died.
         self.fatal_error: Optional[BaseException] = None
+        self._fatal_phase: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
         # Shared with other users of the same device (e.g. the server's
         # non-batched generate path) so at most one model computation is
@@ -311,8 +396,32 @@ class ContinuousBatcher:
             self._verify_step = verify_step
         self.spec_stats = {"spec_ticks": 0, "plain_ticks": 0,
                            "accepted_drafts": 0, "drafted": 0}
+        if draft_model is not None or draft_strategy is not None:
+            # Speculative batchers keep the serialized tick: acceptance
+            # needs every committed token on the host before the next
+            # round, and a verify round already amortizes its one
+            # round-trip over k+1 tokens.  Plain-tick interludes
+            # (sampling neighbors) stay serialized too, so the emitted
+            # streams are trivially identical to the reference loop's.
+            self.pipelined = False
 
     # -- cache plumbing ----------------------------------------------------
+    def _padded_scatter(self, arr, idxs: List[int], vals):
+        """``arr.at[idxs].set(vals)`` with idxs/vals PADDED to
+        max_slots by repeating their FIRST entry (index and value must
+        pad together: duplicate writes are order-independent only
+        because every duplicate carries the same value).  Keeps XLA at
+        exactly ONE compiled scatter shape per array instead of one per
+        observed wave size (profiling found per-wave-size recompiles)."""
+        jnp = self._jnp
+        pad = self.max_slots - len(idxs)
+        idx = jnp.asarray(idxs + [idxs[0]] * pad, jnp.int32)
+        if isinstance(vals[0], int):
+            padded = jnp.asarray(vals + [vals[0]] * pad, jnp.int32)
+        else:  # device arrays (rng keys)
+            padded = jnp.stack(list(vals) + [vals[0]] * pad)
+        return arr.at[idx].set(padded)
+
     def _reset_cache(self, cache):
         return self._jax.tree_util.tree_map(self._jnp.zeros_like, cache)
 
@@ -457,7 +566,11 @@ class ContinuousBatcher:
                     d_cache, g = self._draft_step(d_cache, g[:, None])
                     drafts.append(g)
                 self._draft_cache = d_cache
-                drafted = np.stack([np.asarray(d) for d in drafts], axis=1)
+                self.telemetry["dispatches_total"].inc(k)
+                # ONE [B, k] transfer for the whole proposal matrix
+                # instead of k [B] transfers (stack on device first).
+                drafted = np.asarray(jnp.stack(drafts, axis=1))
+                self.telemetry["transfers_total"].inc()
 
         return self._verify_and_accept(slots, next_tokens, m, t_last,
                                        drafted)
@@ -487,13 +600,18 @@ class ContinuousBatcher:
             # scratch), and a later overwrite from a stale local would
             # undo that.
             self._cache = cache
+            self.telemetry["dispatches_total"].inc()
             g_np = np.asarray(greedy)                   # [B, k+1]
+            self.telemetry["transfers_total"].inc()
+            self.telemetry["ticks_total"].inc()
 
         # Acceptance + emission per slot (lock released: emit() runs
         # streaming callbacks).
         match = drafted == g_np[:, :-1]
         accepted = np.cumprod(match, axis=1).sum(axis=1)
         self.spec_stats["spec_ticks"] += 1
+        carry_idx: List[int] = []
+        carry_tok: List[int] = []
         for i in active:
             req = slots[i]
             if req.cancelled.is_set():
@@ -533,7 +651,13 @@ class ContinuousBatcher:
             else:
                 # Keep the plain-tick invariant for a possible fallback
                 # tick: next_tokens carries the newest emitted token.
-                next_tokens = next_tokens.at[i].set(int(req.output[-1]))
+                # Staged host-side and scattered once below — one
+                # dispatch per round instead of one per surviving slot.
+                carry_idx.append(i)
+                carry_tok.append(int(req.output[-1]))
+        if carry_idx:
+            next_tokens = self._padded_scatter(next_tokens, carry_idx,
+                                               carry_tok)
 
         # Roll every row's write position back over rejected slots.
         self._cache = _set_cache_index(
@@ -955,8 +1079,9 @@ class ContinuousBatcher:
     def _shutdown_error(self) -> RuntimeError:
         if self.fatal_error is not None:
             return RuntimeError(
-                "batcher failed fatally (exception inside a donated "
-                f"prefill invalidated the KV cache): {self.fatal_error!r}")
+                f"batcher failed fatally during "
+                f"{self._fatal_phase or 'admission'} (see the "
+                f"batcher-fatal debug bundle): {self.fatal_error!r}")
         return RuntimeError("batcher stopped")
 
     def start(self) -> "ContinuousBatcher":
@@ -971,22 +1096,145 @@ class ContinuousBatcher:
             self._thread.join(timeout=5)
 
     # -- scheduler loop ----------------------------------------------------
+    def _tick_fatal(self, exc: BaseException, phase: str, **extra) -> None:
+        """The scheduler cannot continue (donated prefill consumed the
+        KV cache, a device error mid-dispatch, a poisoned fetch, or a
+        streaming callback blowing up mid-emission): fail the whole
+        batcher loudly — black-box bundle FIRST, so when submit()
+        raises, the evidence (phase, pipeline depth, last dispatched /
+        fetched tick) is already on disk."""
+        self.fatal_error = exc
+        self._fatal_phase = phase
+        self._stop.set()
+        from ..telemetry import flight
+        flight.record(
+            "serving", "fatal_error", phase=phase,
+            error=f"{type(exc).__name__}: {exc}",
+            queue_depth=self._queue.qsize(),
+            pipeline_depth=self.ticks_dispatched - self.ticks_fetched,
+            last_dispatched_tick=self.ticks_dispatched,
+            last_fetched_tick=self.ticks_fetched, **extra)
+        flight.dump_bundle(
+            "batcher-fatal",
+            registry=self.telemetry["registry"],
+            once_key=f"batcher-fatal-{id(self)}")
+
     def _loop(self) -> None:
+        import numpy as np
+
         jax, jnp = self._jax, self._jnp
+        tm = self.telemetry
         slots: List[Optional[_Request]] = [None] * self.max_slots
-        next_tokens = jnp.zeros((self.max_slots,), jnp.int32)
-        temps = jnp.zeros((self.max_slots,), jnp.float32)
-        top_ps = jnp.ones((self.max_slots,), jnp.float32)
-        top_ks = jnp.zeros((self.max_slots,), jnp.int32)
+        # Per-slot sampling state lives in host-side numpy mirrors;
+        # admissions write the mirrors and each wave uploads them ONCE
+        # (one H2D per array) instead of chaining five per-request
+        # .at[i].set dispatches.
+        h_temps = np.zeros((self.max_slots,), np.float32)
+        h_top_ps = np.ones((self.max_slots,), np.float32)
+        h_top_ks = np.zeros((self.max_slots,), np.int32)
+        temps = jnp.asarray(h_temps)
+        top_ps = jnp.asarray(h_top_ps)
+        top_ks = jnp.asarray(h_top_ks)
         keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
+        # Tokens feeding the NEXT dispatched step (device-resident; the
+        # previous step's output with admission firsts scattered in).
+        next_tokens = jnp.zeros((self.max_slots,), jnp.int32)
+        # The in-flight decode step: (on-device token array, snapshot of
+        # slots at dispatch time).  At most one step is outstanding.
+        pending: Optional[tuple] = None
         # A request that could not get cache blocks waits here (FIFO
         # order preserved) until retirements free enough of the pool.
         deferred: Optional[_Request] = None
         deferred_mark = -1
 
+        def dispatch_step():
+            """Dispatch one decode step across every slot (JAX async:
+            returns immediately with on-device futures).  Inactive
+            slots decode garbage into their own rows; admit resets
+            them.  Returns the (out, slots-snapshot) pipeline record."""
+            nonlocal next_tokens, keys
+            with self._device_lock:
+                self._cache, out, keys = self._decode_step(
+                    self._cache, next_tokens, temps, top_ps, keys,
+                    top_ks)
+            next_tokens = out
+            self.ticks_dispatched += 1
+            tm["dispatches_total"].inc()
+            tm["pipeline_depth"].set(
+                self.ticks_dispatched - self.ticks_fetched)
+            return out, list(slots)
+
+        def process_step(step) -> None:
+            """Fetch the step's whole token array in ONE device→host
+            transfer, then emit / stop-check / retire.  Lanes whose
+            request retired or was replaced after the dispatch hold
+            overrun tokens — discarded here, which is what keeps
+            pipelined streams byte-identical to the serialized loop's."""
+            out, snap = step
+            live = [i for i, req in enumerate(snap)
+                    if req is not None and req is slots[i]]
+            self.ticks_fetched += 1
+            tm["pipeline_depth"].set(
+                self.ticks_dispatched - self.ticks_fetched)
+            if not live:
+                return  # pure-overrun step (everything retired since
+                        # dispatch): drop it without paying a transfer
+            if self._per_slot_fetch:
+                # Reference cost shape (bench before-capture only): one
+                # blocking transfer per live slot.
+                out_np = {i: int(out[i]) for i in live}
+                tm["transfers_total"].inc(len(live))
+            else:
+                out_np = np.asarray(out)
+                tm["transfers_total"].inc()
+            tm["ticks_total"].inc()
+            # Counted at processing, not dispatch: dropped overrun
+            # steps emit nothing and must not skew spec/plain ratios.
+            self.spec_stats["plain_ticks"] += 1
+            for i in live:
+                req = snap[i]
+                if req.cancelled.is_set():
+                    # Covers cancellation landing between dispatch and
+                    # fetch: the token is dropped, the slot freed.
+                    req.done.set()
+                    slots[i] = None
+                    self._retire_slot(i)
+                    continue
+                req.emit(int(out_np[i]))
+                if req.finished:
+                    req.done.set()
+                    slots[i] = None
+                    self._retire_slot(i)
+
         while not self._stop.is_set():
-            # Admit new requests into free slots.
+            # Pipelined dispatch-ahead: enqueue step k+1 from step k's
+            # still-on-device tokens BEFORE fetching step k, so the
+            # device computes k+1 while the host runs step k's
+            # emission/retirement and the next admission wave.  Any
+            # lane those host decisions invalidate is an overrun token
+            # process_step() discards next iteration.
+            try:
+                ahead = None
+                if (pending is not None and self.pipelined
+                        and any(s is not None for s in slots)):
+                    ahead = dispatch_step()
+            except Exception as exc:
+                self._tick_fatal(exc, "dispatch")
+                break
+            try:
+                if pending is not None:
+                    process_step(pending)
+                pending = ahead
+            except Exception as exc:
+                self._tick_fatal(exc, "fetch")
+                break
+
+            # Admit new requests into free slots; per-slot state is
+            # staged host-side and uploaded once after the wave.
             admitted = False
+            wave_idx: List[int] = []
+            wave_first: List[int] = []
+            wave_keys: list = []
             for i in range(self.max_slots):
                 if slots[i] is not None:
                     continue
@@ -1023,7 +1271,11 @@ class ContinuousBatcher:
                         tokens=req.tokens):
                     deferred = req  # pool exhausted; retry after retires
                     deferred_mark = self._retire_count
+                    req.was_deferred = True
                     break
+                tm["queue_wait_seconds"].labels(
+                    "deferred" if req.was_deferred else "direct").observe(
+                        time.perf_counter() - req.submitted_at)
                 donated = False
                 try:
                     key0 = jax.random.fold_in(
@@ -1055,17 +1307,19 @@ class ContinuousBatcher:
                             self._draft_prefill_install(i, req.tokens)
                     if self.page_size > 0:
                         self._register_blocks(i, req.tokens)
-                    req.emit(int(first))
+                    first_i = int(first)
+                    req.emit(first_i)
                     if req.finished:
                         req.done.set()
                         self._retire_slot(i)
                         continue
                     slots[i] = req
-                    next_tokens = next_tokens.at[i].set(int(first))
-                    temps = temps.at[i].set(req.temperature)
-                    top_ps = top_ps.at[i].set(req.top_p)
-                    top_ks = top_ks.at[i].set(req.top_k)
-                    keys = keys.at[i].set(key1)
+                    h_temps[i] = req.temperature
+                    h_top_ps[i] = req.top_p
+                    h_top_ks[i] = req.top_k
+                    wave_idx.append(i)
+                    wave_first.append(first_i)
+                    wave_keys.append(key1)
                     admitted = True
                 except Exception as exc:
                     req.error = exc
@@ -1077,23 +1331,10 @@ class ContinuousBatcher:
                         # continuing would leave the batcher bricked
                         # but apparently alive — accepting work it can
                         # only fail (or worse, serve from garbage).
-                        # Fail the whole batcher loudly instead.
-                        self.fatal_error = exc
-                        self._stop.set()
-                        # Black-box the death BEFORE unblocking the
-                        # requester: when submit() raises, the bundle
-                        # (queue state, metrics, the tripping request)
-                        # is already on disk.
-                        from ..telemetry import flight
-                        flight.record(
-                            "serving", "fatal_error",
-                            error=f"{type(exc).__name__}: {exc}",
-                            queue_depth=self._queue.qsize(),
-                            prompt_tokens=len(req.tokens))
-                        flight.dump_bundle(
-                            "batcher-fatal",
-                            registry=self.telemetry["registry"],
-                            once_key=f"batcher-fatal-{id(self)}")
+                        # Fail the whole batcher loudly instead (the
+                        # bundle lands BEFORE req unblocks).
+                        self._tick_fatal(exc, "admission-prefill",
+                                         prompt_tokens=len(req.tokens))
                         req.done.set()
                         break
                     # Dense prefill does not donate: the failure is
@@ -1104,56 +1345,71 @@ class ContinuousBatcher:
             if self._stop.is_set():
                 break  # fatal admission failure or external stop: drain
 
+            if wave_idx:
+                # One padded scatter per array for the whole admission
+                # wave (_padded_scatter: one compiled shape): first
+                # tokens and sampling keys land on the in-flight step's
+                # outputs (inputs of the step after it), and the staged
+                # sampling params upload as three fresh arrays.
+                try:
+                    next_tokens = self._padded_scatter(
+                        next_tokens, wave_idx, wave_first)
+                    keys = self._padded_scatter(keys, wave_idx,
+                                                wave_keys)
+                    temps = jnp.asarray(h_temps)
+                    top_ps = jnp.asarray(h_top_ps)
+                    top_ks = jnp.asarray(h_top_ks)
+                except Exception as exc:
+                    # A failed wave scatter leaves admitted slots with
+                    # un-published tokens/keys: same
+                    # dead-loop-with-queued-victims hazard as a failed
+                    # dispatch — fail loudly, not silently.
+                    self._tick_fatal(exc, "admission-scatter")
+                    break
+
             active_count = sum(1 for s in slots if s is not None)
-            self.telemetry["queue_depth"].set(self._queue.qsize())
-            self.telemetry["active_slots"].set(active_count)
+            tm["queue_depth"].set(self._queue.qsize())
+            tm["active_slots"].set(active_count)
             if active_count:
-                self.telemetry["batch_size"].observe(active_count)
+                tm["batch_size"].observe(active_count)
 
             if not active_count:
-                if not admitted:
-                    # idle: block briefly for work
-                    try:
-                        req = self._queue.get(timeout=0.05)
-                        self._queue.put(req)
-                    except queue.Empty:
-                        pass
+                if not admitted and pending is None:
+                    # Idle: wait for work WITHOUT dequeuing — the old
+                    # get(timeout)+put idiom re-enqueued the peeked
+                    # request behind anything submitted in between,
+                    # breaking admission FIFO.
+                    self._queue.wait_nonempty(0.05)
                 continue
 
             # Speculation: when a draft (model or training-free
             # strategy) is configured and every active slot is greedy,
             # one tick = k proposals + ONE target verify committing
             # 1..k+1 tokens per slot.  Any sampling slot forces plain
-            # ticks (acceptance is argmax-only).
+            # ticks (acceptance is argmax-only).  `pending` is always
+            # None here: speculative batchers never dispatch ahead, and
+            # a serialized plain tick was consumed at the loop top.
             if ((self._draft_model is not None
                  or self._draft_strategy is not None) and all(
                     r.temperature <= 0.0 for r in slots if r is not None)):
                 # Takes the device lock internally, only around the
                 # draft/verify device calls.
-                next_tokens = self._speculative_tick(slots, next_tokens)
+                try:
+                    next_tokens = self._speculative_tick(slots,
+                                                         next_tokens)
+                except Exception as exc:
+                    self._tick_fatal(exc, "speculative-tick")
+                    break
                 continue
 
-            # One decode step across every slot (inactive slots decode
-            # garbage into their own rows; they are reset on admit).
-            self.spec_stats["plain_ticks"] += 1
-            with self._device_lock:
-                self._cache, out, keys = self._decode_step(
-                    self._cache, next_tokens, temps, top_ps, keys,
-                    top_ks)
-            next_tokens = out
-            for i, req in enumerate(slots):
-                if req is None:
-                    continue
-                if req.cancelled.is_set():
-                    req.done.set()
-                    slots[i] = None
-                    self._retire_slot(i)
-                    continue
-                req.emit(int(out[i]))
-                if req.finished:
-                    req.done.set()
-                    slots[i] = None
-                    self._retire_slot(i)
+            # Plain tick: dispatch (pipeline bootstrap, or every tick in
+            # serialized mode); fetched at the next loop top.
+            if pending is None:
+                try:
+                    pending = dispatch_step()
+                except Exception as exc:
+                    self._tick_fatal(exc, "dispatch")
+                    break
 
         # drain on shutdown (submit() rejects once _stop is set, so this
         # converges; get_nowait is the only safe concurrent drain).  On

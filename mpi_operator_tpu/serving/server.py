@@ -10,9 +10,16 @@ Serves a Llama-family model's KV-cache generation
       one data event per token, then {"done": true, "tokens": [...]}
     GET /healthz
     GET /metrics  -> Prometheus text exposition: queue depth, batch
-      size, TTFT and per-token latency histograms (telemetry subsystem)
-      plus the process default registry (train/checkpoint metrics when
-      the same process also trains)
+      size, TTFT and per-token latency histograms, queue-wait
+      (submit -> admission, with a deferred variant for pool-exhaustion
+      stalls) and the decode hot-path tick/dispatch/transfer counters
+      (telemetry subsystem) plus the process default registry
+      (train/checkpoint metrics when the same process also trains)
+
+With continuous batching the steady-state decode tick is pipelined
+(``pipelined=None`` -> batcher default: on; see serving/batcher.py):
+the device never waits on host-side token processing, and each tick
+fetches all slots' tokens in one device->host transfer.
 
 The accelerator is a serial resource behind a per-step device lock;
 with ``max_batch_slots > 0`` concurrent requests share decode ticks via
@@ -179,6 +186,7 @@ class InferenceServer:
                  draft_strategy: Optional[str] = None,
                  draft_len: int = 4, prompt_lookup_ngram: int = 3,
                  kv_prefill_chunk: int = 0, weight_dtype: str = "auto",
+                 pipelined: Optional[bool] = None,
                  telemetry_registry: Optional[Registry] = None):
         if weight_dtype not in ("auto", "int8"):
             raise ValueError(
@@ -286,6 +294,7 @@ class InferenceServer:
                                                   prompt_lookup_ngram),
                                               prefill_chunk=(
                                                   kv_prefill_chunk),
+                                              pipelined=pipelined,
                                               telemetry_registry=(
                                                   self.telemetry_registry))
 

@@ -380,4 +380,27 @@ def new_serving_metrics(registry: Registry) -> dict:
             "serving_requests_total",
             "Generation requests served (streamed and non-streamed,"
             " including errored/aborted)"),
+        # Decode hot-path economics (ISSUE 5): the tick loop's device
+        # round-trip budget is a tested invariant — ticks, device
+        # dispatches, and device->host token fetches are counted so
+        # `serve-bench-smoke` can assert exactly ONE transfer per
+        # steady-state tick instead of trusting a one-off bench number.
+        "ticks_total": registry.counter(
+            "serving_ticks_total",
+            "Decode ticks processed (plain and speculative)"),
+        "dispatches_total": registry.counter(
+            "serving_decode_dispatches_total",
+            "Device computations dispatched by the tick loop"
+            " (decode/draft/verify steps)"),
+        "transfers_total": registry.counter(
+            "serving_d2h_transfers_total",
+            "Device-to-host token fetches performed by the tick loop"),
+        "pipeline_depth": registry.gauge(
+            "serving_pipeline_depth",
+            "Decode steps dispatched but not yet fetched"),
+        "queue_wait_seconds": registry.histogram_vec(
+            "mpi_operator_serve_queue_wait_seconds",
+            "Wait from submit to batcher admission; path=deferred for"
+            " requests that waited out a pool-exhaustion deferral",
+            label_names=("path",)),
     }

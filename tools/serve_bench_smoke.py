@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Serving-hot-path smoke: tier-1-safe (CPU, < 60s) guard for the
+pipelined decode tick loop (ISSUE 5, docs/PERF.md "Serving data-plane
+hot path").
+
+Asserts three invariants on a tiny host-overhead-dominated model:
+
+- **zero stream divergence**: a seeded mixed greedy/sampled workload
+  (dense AND paged/oversubscribed-pool) emits byte-identical token
+  streams through the pipelined loop and the serialized reference loop
+  (``pipelined=False``);
+- **exactly one device→host transfer per steady-state tick**: sampled
+  from the ``serving_d2h_transfers_total`` / ``serving_ticks_total``
+  counters over a mid-decode window (no admissions in flight), so the
+  single-transfer fetch is a counted invariant, not a bench anecdote;
+- **a ticks/sec floor** over the same window (the serialized per-slot
+  fetch loop manages ~½–⅓ of it; the floor is set ~10x under the idle
+  pipelined rate to stay green on loaded CI machines).
+
+Also checks the supporting telemetry: the pipeline-depth gauge drains
+back to 0 and every admission landed in the
+``mpi_operator_serve_queue_wait_seconds`` histogram.
+
+Usage: python tools/serve_bench_smoke.py [--floor 50]
+Exit 0 = all assertions green.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build(jax, jnp, dtype=None):
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+
+    kw = {"dtype": dtype} if dtype is not None else {}
+    cfg = LlamaConfig(vocab_size=256, dim=32, n_layers=1, n_heads=1,
+                      n_kv_heads=1, max_seq_len=160, **kw)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def _mixed_workload(cfg, n: int):
+    """Seeded greedy/sampled/top-k/stop-token mix — the
+    equivalence-sensitive request shapes."""
+    import numpy as np
+
+    rng = np.random.default_rng(17)
+    reqs = []
+    for i in range(n):
+        prompt = list(map(int, rng.integers(1, cfg.vocab_size,
+                                            int(rng.integers(4, 24)))))
+        kind = i % 3
+        kwargs = {}
+        if kind == 1:
+            kwargs = dict(temperature=0.8, top_p=0.9, seed=100 + i)
+        elif kind == 2:
+            kwargs = dict(temperature=0.9, top_k=8, seed=200 + i)
+        if i % 4 == 3:
+            kwargs["stop_tokens"] = (7,)
+        reqs.append((prompt, 24, kwargs))
+    return reqs
+
+
+def _run_workload(batcher, reqs):
+    outs = [None] * len(reqs)
+    errs = []
+
+    def run(i):
+        prompt, n, kwargs = reqs[i]
+        try:
+            outs[i] = batcher.submit(prompt, n, timeout=600, **kwargs)
+        except Exception as exc:  # surfaced by the caller
+            errs.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    return outs, errs
+
+
+def check_equivalence(jax, jnp, problems: list) -> None:
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    cfg, model, variables = _build(jax, jnp, dtype=jnp.float32)
+    reqs = _mixed_workload(cfg, 9)
+    for name, kw in (("dense", {}),
+                     # Oversubscribed paged pool: admission deferral and
+                     # block recycling interleave with the pipeline.
+                     ("paged", dict(page_size=16, cache_blocks=13))):
+        ref = ContinuousBatcher(model, variables, max_slots=3,
+                                pipelined=False, **kw).start()
+        pipe = ContinuousBatcher(model, variables, max_slots=3,
+                                 pipelined=True, **kw).start()
+        try:
+            want, errs_w = _run_workload(ref, reqs)
+            got, errs_g = _run_workload(pipe, reqs)
+            if errs_w or errs_g:
+                problems.append(f"{name}: workload errors "
+                                f"{errs_w + errs_g}")
+            elif got != want:
+                bad = [i for i, (a, b) in enumerate(zip(got, want))
+                       if a != b]
+                problems.append(
+                    f"{name}: pipelined vs reference streams diverge "
+                    f"at requests {bad}")
+            else:
+                print(f"serve-bench-smoke: {name} mixed workload "
+                      f"byte-identical across loops "
+                      f"({len(reqs)} requests)")
+            if not pipe.pipelined:
+                problems.append(f"{name}: pipelined batcher reports "
+                                f"pipelined=False")
+        finally:
+            ref.stop()
+            pipe.stop()
+
+
+def check_tick_economics(jax, jnp, floor: float, problems: list) -> None:
+    from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+    cfg, model, variables = _build(jax, jnp)
+    slots, new_tokens = 8, 96
+    import numpy as np
+    rng = np.random.default_rng(5)
+    prompts = [list(map(int, rng.integers(1, cfg.vocab_size, 8)))
+               for _ in range(slots)]
+    b = ContinuousBatcher(model, variables, max_slots=slots,
+                          pipelined=True).start()
+    window = {}
+
+    def sample():
+        tm = b.telemetry
+        deadline = time.perf_counter() + 120
+        while b.ticks_fetched < 12 and b.fatal_error is None \
+                and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        window["t1"] = time.perf_counter()
+        window["ticks1"] = tm["ticks_total"].value
+        window["transfers1"] = tm["transfers_total"].value
+        while b.ticks_fetched < new_tokens - 12 \
+                and b.fatal_error is None \
+                and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        window["t2"] = time.perf_counter()
+        window["ticks2"] = tm["ticks_total"].value
+        window["transfers2"] = tm["transfers_total"].value
+
+    try:
+        b.submit([3] * 8, 2, timeout=600)  # compile outside the window
+        sampler = threading.Thread(target=sample)
+        sampler.start()
+        outs, errs = _run_workload(
+            b, [(p, new_tokens, {}) for p in prompts])
+        sampler.join(timeout=60)
+        if errs or any(o is None or len(o) != new_tokens for o in outs):
+            problems.append(f"tick-economics workload failed: {errs}")
+            return
+        ticks = window["ticks2"] - window["ticks1"]
+        transfers = window["transfers2"] - window["transfers1"]
+        secs = window["t2"] - window["t1"]
+        tps = ticks / secs
+        # Counter reads at the window edges are two non-atomic loads; a
+        # tick can land between them, so allow ±1 on the equality.
+        if abs(transfers - ticks) > 1:
+            problems.append(
+                f"steady-state D2H transfers != ticks: {transfers} "
+                f"transfers over {ticks} ticks "
+                f"({transfers / max(1, ticks):.3f}/tick; want 1)")
+        else:
+            print(f"serve-bench-smoke: {transfers} transfers over "
+                  f"{ticks} steady-state ticks (1 D2H per tick)")
+        if tps < floor:
+            problems.append(
+                f"steady-state ticks/sec {tps:.1f} under floor {floor}")
+        else:
+            print(f"serve-bench-smoke: {tps:.1f} ticks/sec "
+                  f"(floor {floor})")
+        # The final dispatched-ahead overrun step drains shortly after
+        # the last request completes; poll rather than race the loop.
+        deadline = time.perf_counter() + 10
+        while b.telemetry["pipeline_depth"].value \
+                and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        depth = b.telemetry["pipeline_depth"].value
+        if depth != 0:
+            problems.append(f"pipeline_depth gauge stuck at {depth}")
+        waits = b.telemetry["queue_wait_seconds"].labels("direct").count
+        if waits < slots:
+            problems.append(
+                f"queue-wait histogram saw {waits} admissions, "
+                f"expected >= {slots}")
+    finally:
+        b.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--floor", type=float, default=50.0,
+                    help="steady-state ticks/sec floor (default 50)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    problems: list = []
+    check_equivalence(jax, jnp, problems)
+    check_tick_economics(jax, jnp, args.floor, problems)
+
+    if problems:
+        print("serve-bench-smoke: FAIL")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("serve-bench-smoke: PASS — streams identical, one D2H per "
+          "steady-state tick, throughput floor held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
