@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
-	controller-bench-smoke serve-bench-smoke
+	controller-bench-smoke serve-bench-smoke train-bench-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -50,6 +50,15 @@ controller-bench-smoke:
 # (counter-asserted), and a ticks/sec floor holds (docs/PERF.md).
 serve-bench-smoke:
 	$(PYTHON) tools/serve_bench_smoke.py
+
+# Train hot path (< 60s, CPU): overlapped loop (async dispatch +
+# prefetch + async checkpointing) holds a steps/s floor with ZERO
+# steady-state host blocks and ZERO train-loop checkpoint-write
+# seconds (counter-asserted), async checkpoints restore bit-identical
+# to sync saves, and goodput % beats the serialized baseline knob
+# (docs/PERF.md).
+train-bench-smoke:
+	$(PYTHON) tools/train_bench_smoke.py
 
 native:
 	$(MAKE) -C native

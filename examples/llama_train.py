@@ -255,6 +255,8 @@ def main() -> int:
             final_loss = float(metrics["loss"])
             elapsed = time.perf_counter() - start
     finally:
+        if mgr is not None:
+            mgr.drain()  # finish the in-flight async checkpoint write
         if loader is not None:
             loader.close()
 

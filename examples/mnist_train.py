@@ -62,6 +62,9 @@ def main() -> int:
             state, metrics = step_fn(state, (images, labels))
             if jax.process_index() == 0 and step % 10 == 0:
                 print(f"step={step} loss={float(metrics['loss']):.4f}")
+        # Async dispatch: flush the open goodput window so the summary
+        # below accounts every step.
+        step_fn.sync()
     if jax.process_index() == 0:
         summary = goodput.summary()
         print(f"goodput={summary['goodput']:.3f}"

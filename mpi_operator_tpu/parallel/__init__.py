@@ -10,4 +10,5 @@ from sharding annotations, replacing NCCL/MPI calls entirely.
 """
 
 from .mesh import MeshConfig, create_mesh, batch_sharding  # noqa: F401
-from .train import TrainState, build_train_step  # noqa: F401
+from .train import (TrainState, build_train_step,  # noqa: F401
+                    run_train_loop)

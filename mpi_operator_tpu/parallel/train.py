@@ -11,8 +11,8 @@ holding the pen.
 
 from __future__ import annotations
 
-import functools
 import os
+import sys
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
@@ -46,8 +46,10 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
                      donate: bool = True,
                      remat: bool = False,
                      accum_steps: int = 1,
+                     shard_update: bool = False,
                      goodput=None,
-                     telemetry_registry=None):
+                     telemetry_registry=None,
+                     sync_every: Optional[int] = None):
     """Build (init_fn, step_fn).
 
     - loss_fn(params, batch) -> scalar loss (called under jit/mesh).
@@ -65,14 +67,30 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
       the full-batch step's exactly (for the usual mean-reduction
       losses) up to f32 reassociation.
 
+    - shard_update: ZeRO-style cross-replica sharding of the weight
+      update (arXiv:2004.13336).  Optimizer state and the update
+      computation are partitioned over the ``dp`` axis via
+      PartitionSpec annotations: each dp replica applies the update for
+      its 1/dp slice of the params (XLA lowers the annotations to a
+      reduce-scatter of the gradients and an all-gather of the updated
+      shards), cutting optimizer-state HBM by ~dp x.  Elementwise
+      optimizer math is unchanged per parameter, so results are
+      numerically equivalent to the replicated update.  Per-leaf: the
+      first spec-free dimension divisible by dp is sharded; leaves with
+      no such dimension (odd shapes, scalars like adam's count) stay on
+      their base sharding.  A 1-sized dp axis degenerates to the plain
+      replicated update.
+
     - goodput / telemetry_registry: when either is set, the returned
-      step_fn is wrapped by telemetry.goodput.instrument_step — each
-      call blocks on its outputs and its wall time is attributed to the
-      compile bucket (first call) or the productive bucket + the
-      train_step_seconds histogram (subsequent calls).
+      step_fn is wrapped by telemetry.goodput.instrument_step — async
+      dispatch with a sliding goodput sync every ``sync_every`` steps
+      (``sync_every=1`` restores blocking per-step timing; see
+      telemetry/goodput.py).
 
     step_fn(state, batch) -> (state, metrics) with donated state buffers.
     """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     _batch_shards = 1
@@ -81,8 +99,73 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
 
+    dp_size = mesh.shape.get("dp", 1)
+    zero = shard_update and dp_size > 1
+
+    def _spec_axes(entry):
+        if entry is None:
+            return ()
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    def _zero_spec(shape, base_spec):
+        """Base spec with 'dp' grafted onto the first free dimension
+        divisible by dp (the ZeRO shard axis); base unchanged when no
+        dimension qualifies or dp already appears."""
+        base = tuple(base_spec) if base_spec is not None else ()
+        base = base + (None,) * (len(shape) - len(base))
+        used = {n for e in base for n in _spec_axes(e)}
+        if "dp" not in used:
+            for d, size in enumerate(shape):
+                if base[d] is None and size > 0 and size % dp_size == 0:
+                    base = base[:d] + ("dp",) + base[d + 1:]
+                    break
+        return P(*base)
+
+    def _zero_plan(params):
+        """(param zero specs, base specs, shape->zero spec map for
+        optimizer-state leaves).  Computed from shapes only, so it works
+        identically on concrete arrays (init) and tracers (step)."""
+        if param_specs is not None:
+            base_specs = param_specs
+        else:
+            base_specs = jax.tree_util.tree_map(lambda p: P(), params)
+        zspecs = jax.tree_util.tree_map(
+            lambda p, s: _zero_spec(p.shape, s), params, base_specs)
+        # Optimizer-state leaves are matched to their param's zero spec
+        # by SHAPE (optax state trees don't share the params' treedef).
+        # Two same-shape params with different base specs would make
+        # that ambiguous — pinning one param's moments to the other's
+        # spec forces a reshard every step — so conflicting shapes are
+        # dropped from the map: those moments are left unconstrained
+        # and XLA propagates a consistent sharding from the (correctly
+        # per-param constrained) grads/params operands instead.
+        seen, conflicts = {}, set()
+        for leaf, spec in zip(jax.tree_util.tree_leaves(params),
+                              jax.tree_util.tree_leaves(zspecs)):
+            if seen.setdefault(leaf.shape, spec) != spec:
+                conflicts.add(leaf.shape)
+        shape_spec = {
+            shape: spec for shape, spec in seen.items()
+            if shape not in conflicts
+            and "dp" in {n for e in spec for n in _spec_axes(e)}}
+        return zspecs, base_specs, shape_spec
+
+    def _constrain(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, s)),
+            tree, specs)
+
+    def _constrain_opt(opt_state, shape_spec):
+        def f(x):
+            spec = shape_spec.get(getattr(x, "shape", None))
+            if spec is None:
+                return x
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+        return jax.tree_util.tree_map(f, opt_state)
+
     def init_fn(params):
-        from jax.sharding import NamedSharding, PartitionSpec as P
         if param_specs is not None:
             params = shard_params(params, param_specs, mesh)
         opt_state = optimizer.init(params)
@@ -100,6 +183,18 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
             return jax.device_put(x, replicated)
 
         opt_state = jax.tree_util.tree_map(_pin, opt_state)
+        if zero:
+            # ZeRO: each dp replica holds only its 1/dp shard of the
+            # param-shaped optimizer-state leaves from step 0 on.
+            _, _, shape_spec = _zero_plan(params)
+
+            def _place(x):
+                spec = shape_spec.get(getattr(x, "shape", None))
+                if spec is None:
+                    return x
+                return jax.device_put(x, NamedSharding(mesh, spec))
+
+            opt_state = jax.tree_util.tree_map(_place, opt_state)
         step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
         return TrainState(step=step, params=params, opt_state=opt_state)
 
@@ -147,10 +242,26 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
             loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         else:
             loss, grads = _accumulate(state.params, batch)
-        updates, new_opt_state = optimizer.update(grads, state.opt_state,
-                                                  state.params)
-        new_params = jax.tree_util.tree_map(
-            lambda p, u: (p + u).astype(p.dtype), state.params, updates)
+        if zero:
+            # ZeRO-style sharded update: reduce-scatter the (already
+            # dp-reduced) grads and the params onto their dp shards,
+            # apply the optimizer on 1/dp of every leaf per replica,
+            # then all-gather only the updated param shards.  The
+            # optimizer state never materializes unsharded.
+            zspecs, base_specs, shape_spec = _zero_plan(state.params)
+            g_c = _constrain(grads, zspecs)
+            p_c = _constrain(state.params, zspecs)
+            o_c = _constrain_opt(state.opt_state, shape_spec)
+            updates, new_opt_state = optimizer.update(g_c, o_c, p_c)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: (p + u).astype(p.dtype), p_c, updates)
+            new_params = _constrain(new_params, base_specs)
+            new_opt_state = _constrain_opt(new_opt_state, shape_spec)
+        else:
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: (p + u).astype(p.dtype), state.params, updates)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt_state)
         metrics = {"loss": loss.astype(jnp.float32),
@@ -164,7 +275,8 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
     if goodput is not None or telemetry_registry is not None:
         from ..telemetry.goodput import instrument_step
         step_fn = instrument_step(step_fn, goodput=goodput,
-                                  registry=telemetry_registry)
+                                  registry=telemetry_registry,
+                                  sync_every=sync_every)
     return init_fn, step_fn
 
 
@@ -208,64 +320,183 @@ def preemption_requested(path: Optional[str] = None) -> bool:
     return bool(path) and os.path.exists(path)
 
 
+class _NoticePoller:
+    """Cached preemption-notice poll: at most one ``os.path.exists``
+    stat per train step (plus forced re-polls right after an async save
+    completes), and none at all once the notice has been seen or when
+    no channel is configured."""
+
+    def __init__(self, path: Optional[str]):
+        self._path = path
+        self._seen = False
+        self.stats = 0
+
+    def poll(self) -> bool:
+        if self._seen:
+            return True
+        if not self._path:
+            return False
+        self.stats += 1
+        self._seen = os.path.exists(self._path)
+        return self._seen
+
+
 def run_train_loop(state, step_fn, batches, checkpoint_manager=None,
                    max_steps: Optional[int] = None, start_step: int = 0,
                    preemption_file: Optional[str] = None,
                    exit_on_preemption: bool = True,
-                   on_metrics: Optional[Callable] = None):
+                   on_metrics: Optional[Callable] = None,
+                   prefetch: int = 2):
     """Drive ``step_fn`` over ``batches`` with checkpointing and
     preemption-aware checkpoint-then-exit.
 
-    Each step: run, bump the step counter, let the checkpoint manager
-    save on its schedule, then poll the preemption notice (the
-    kubelet's K_PREEMPTION_NOTICE_FILE channel).  On a notice the loop
-    checkpoints IMMEDIATELY (off-schedule, inside the grace window) and
-    exits with the retryable code 143 so RestartPolicy=ExitCode
-    restarts the gang and the job resumes from this exact step — the
-    alternative is dying at SIGTERM with up to ``every - 1`` steps of
-    lost work.  ``exit_on_preemption=False`` returns instead of raising
-    SystemExit (embedders that manage their own exit).
+    Each step: run the step, let the checkpoint manager save on its
+    schedule, then poll the preemption notice ONCE (the kubelet's
+    K_PREEMPTION_NOTICE_FILE channel — cached helper, so the per-step
+    cost is a single stat, placed post-step so a notice that landed
+    mid-step is handled before fetching the next batch), re-polling
+    immediately after any async checkpoint write completes (a notice
+    that landed during a long write must not wait a further step); one
+    extra stat before the first step stops a pre-existing notice from
+    burning grace-window time on doomed work.  On a notice the loop
+    checkpoints IMMEDIATELY
+    (off-schedule, inside the grace window), drains any in-flight async
+    write, and exits with the retryable code 143 so
+    RestartPolicy=ExitCode restarts the gang and the job resumes from
+    this exact step — the alternative is dying at SIGTERM with up to
+    ``every - 1`` steps of lost work.  ``exit_on_preemption=False``
+    returns instead of raising SystemExit (embedders that manage their
+    own exit).
+
+    ``prefetch`` (default 2, 0 disables) pulls batches ahead of the
+    consumer on a background thread (utils.data.DevicePrefetcher), so
+    host batch assembly + device_put overlap the in-flight device step.
+    Note the prefetcher consumes up to ``prefetch`` batches beyond the
+    last executed step; a data source that tracks its own cursor for
+    resume must be re-created from the checkpointed step on restart
+    (the repo's batch iterators are step-indexed and are), or pass
+    ``prefetch=0`` to keep the one-batch-per-step consumption of the
+    serialized loop.
+    When ``step_fn`` was built with async dispatch
+    (telemetry.goodput.instrument_step), its open goodput window is
+    flushed via ``step_fn.sync()`` on every exit path.
 
     Returns ``(state, step)`` when batches are exhausted, ``max_steps``
     is reached, or a preemption was handled without exiting.
     """
     step = start_step
-    notice = preemption_file or preemption_notice_path()
+    poller = _NoticePoller(preemption_file or preemption_notice_path())
+
+    def drain_checkpoints():
+        drain = getattr(checkpoint_manager, "drain", None)
+        if drain is not None:
+            drain()
 
     def handle_preemption(saved_this_step: bool):
+        # A checkpoint failure here must NOT abort the exit protocol:
+        # leaving via any exception other than SystemExit(143) turns a
+        # retryable preemption into a permanent job failure under
+        # RestartPolicy=ExitCode.  Exiting 143 without the final save
+        # merely resumes from the last committed step — strictly better.
+        ckpt_error = None
         if checkpoint_manager is not None and not saved_this_step:
-            checkpoint_manager.save(state, step)
+            try:
+                checkpoint_manager.save(state, step)
+            except Exception:
+                # Most likely a STORED async-writer error re-raised at
+                # the save point (already made loud by the writer's own
+                # flight bundle); raising cleared it, so one retry
+                # genuinely re-attempts the final-state save.
+                try:
+                    checkpoint_manager.save(state, step)
+                except Exception as exc:
+                    ckpt_error = exc
+        # The grace window must cover the WRITE, not just the snapshot:
+        # exiting with the async writer mid-flight would tear the very
+        # checkpoint the restart resumes from.
+        if ckpt_error is None:
+            try:
+                drain_checkpoints()
+            except Exception as exc:
+                ckpt_error = exc
         # Black-box the exit: record the preemption on the flight ring,
         # export it as a sidecar (so the controller's bundle gets a
         # train lane), and dump this process's own bundle — SystemExit
         # never reaches sys.excepthook, so this is the only shot.
         from ..telemetry import flight
         flight.record("train", "preemption", step=step,
-                      checkpointed=checkpoint_manager is not None,
+                      checkpointed=(checkpoint_manager is not None
+                                    and ckpt_error is None),
+                      checkpoint_error=(repr(ckpt_error)
+                                        if ckpt_error is not None else None),
                       exit_code=PREEMPTION_EXIT_CODE)
         flight.export_sidecar()
         flight.dump_bundle("train-preemption")
         if exit_on_preemption:
             raise SystemExit(PREEMPTION_EXIT_CODE)
 
-    for batch in batches:
-        if max_steps is not None and step >= max_steps:
-            break
-        # Pre-step check: a notice that landed while blocked fetching
-        # the batch must not burn a whole step of the grace window.
-        if preemption_requested(notice):
+    source = batches
+    prefetcher = None
+    if prefetch and prefetch > 0:
+        from ..utils.data import DevicePrefetcher
+        source = prefetcher = DevicePrefetcher(batches, depth=prefetch)
+
+    save_completed = None
+    if checkpoint_manager is not None:
+        save_completed = getattr(checkpoint_manager,
+                                 "completed_since_last_poll", None)
+    try:
+        # Startup check: a notice that already exists must not burn
+        # grace-window time dispatching doomed work.
+        if poller.poll():
             handle_preemption(saved_this_step=False)
             return state, step
-        state, metrics = step_fn(state, batch)
-        step += 1
-        if on_metrics is not None:
-            on_metrics(step, metrics)
-        saved = False
-        if checkpoint_manager is not None:
-            saved = checkpoint_manager.maybe_save(state, step)
-        if preemption_requested(notice):
-            # A scheduled save this step already captured this state;
-            # don't spend the grace window writing it twice.
-            handle_preemption(saved_this_step=saved)
-            return state, step
+        for batch in source:
+            if max_steps is not None and step >= max_steps:
+                break
+            state, metrics = step_fn(state, batch)
+            step += 1
+            if on_metrics is not None:
+                on_metrics(step, metrics)
+            saved = False
+            if checkpoint_manager is not None:
+                saved = checkpoint_manager.maybe_save(state, step)
+                if save_completed is not None and save_completed():
+                    # An async write just finished: force a re-poll so
+                    # a notice that arrived mid-write is handled now.
+                    poller.poll()
+            # Post-step check (the one stat per step): a notice that
+            # landed during the step is handled before fetching the
+            # next batch — a slow data source must not eat the grace
+            # window, and a notice during the FINAL step still exits
+            # 143 instead of completing silently.
+            if poller.poll():
+                handle_preemption(saved_this_step=saved)
+                return state, step
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
+        # Normal exit must be as durable as the preemption path: flush
+        # the open goodput window and wait for the in-flight async
+        # write (the last scheduled save would otherwise die with the
+        # daemon writer thread), surfacing any stored writer error —
+        # unless another exception is already unwinding, which takes
+        # precedence (a sync() on a poisoned runtime raising its own
+        # XlaRuntimeError must not mask the original failure).
+        unwinding = sys.exc_info()[0] is not None
+        sync_error = None
+        sync = getattr(step_fn, "sync", None)
+        if sync is not None:
+            try:
+                sync()
+            except BaseException as exc:
+                if not unwinding:
+                    sync_error = exc
+        try:
+            drain_checkpoints()
+        except BaseException:
+            if not unwinding and sync_error is None:
+                raise
+        if sync_error is not None:
+            raise sync_error
     return state, step

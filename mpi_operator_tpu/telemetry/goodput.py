@@ -24,6 +24,7 @@ let it attribute compile-vs-productive automatically.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -67,16 +68,26 @@ class GoodputTracker:
                 "Productive train step wall time")
 
     # -- accounting --------------------------------------------------------
-    def add(self, bucket: str, seconds: float) -> None:
+    def add(self, bucket: str, seconds: float, steps: int = 1) -> None:
+        """Attribute ``seconds`` to ``bucket``.
+
+        ``steps`` (PRODUCTIVE only) says how many train steps the window
+        covers — async dispatch attributes a whole K-step sync window in
+        one call; the per-step histogram then observes the window's
+        per-step average once per step so histogram count keeps meaning
+        "productive steps".
+        """
         if bucket not in self._seconds:
             raise ValueError(f"unknown goodput bucket {bucket!r}; one of"
                              f" {GOODPUT_BUCKETS}")
         with self._lock:
             self._seconds[bucket] += seconds
             if bucket == PRODUCTIVE:
-                self._steps += 1
+                self._steps += max(1, steps)
                 if self._step_hist is not None:
-                    self._step_hist.observe(seconds)
+                    per_step = seconds / max(1, steps)
+                    for _ in range(max(1, steps)):
+                        self._step_hist.observe(per_step)
             if self._gauge is not None:
                 self._gauge.set(self._fraction_locked(PRODUCTIVE))
             transitioned = bucket != self._last_bucket
@@ -134,47 +145,138 @@ class GoodputTracker:
         }
 
 
+# Default sliding-sync period for async step dispatch: how many steps
+# are dispatched between host blocks.  1 restores the legacy exact
+# per-step timing (block every step); 0 disables periodic syncs
+# entirely (attribution happens only at explicit ``wrapped.sync()``).
+SYNC_EVERY_ENV = "MPI_OPERATOR_TRAIN_SYNC_EVERY"
+DEFAULT_SYNC_EVERY = 32
+
+
+def _resolve_sync_every(sync_every: Optional[int]) -> int:
+    if sync_every is None:
+        sync_every = int(os.environ.get(SYNC_EVERY_ENV,
+                                        DEFAULT_SYNC_EVERY))
+    if sync_every < 0:
+        raise ValueError(f"sync_every must be >= 0, got {sync_every}")
+    return sync_every
+
+
 def instrument_step(step_fn: Callable, goodput: Optional[GoodputTracker]
                     = None, registry=None,
-                    histogram_name: str = "train_step_seconds") -> Callable:
+                    histogram_name: str = "train_step_seconds",
+                    sync_every: Optional[int] = None) -> Callable:
     """Wrap a train step function with wall-time attribution.
 
-    The first invocation is attributed to the ``compile`` bucket (jit
-    tracing + XLA compilation dominate it); subsequent invocations are
-    ``productive`` steps observed into a ``train_step_seconds``
-    histogram.  Outputs are blocked on (when jax is importable) so the
-    measured time covers execution, not just async dispatch.
+    The first invocation blocks on its outputs and is attributed to the
+    ``compile`` bucket (jit tracing + XLA compilation dominate it).
+    Subsequent invocations are dispatched WITHOUT blocking — the device
+    pipeline never drains between steps — and goodput attribution moves
+    to a sliding sync every ``sync_every`` steps: on the Kth dispatch
+    the wrapper blocks on that step's outputs and attributes the whole
+    window's host wall time (per-call dispatch time + the sync block,
+    never the host time spent between calls, which belongs to other
+    buckets) to ``productive`` as K steps.  ``sync_every=1`` restores
+    the legacy exact per-step timing; ``sync_every=0`` never blocks
+    until an explicit ``wrapped.sync()``.  Metric host-reads (``loss``,
+    ``grad_norm``) are left as still-in-flight arrays: a consumer that
+    converts them pays the fetch, nobody else does.
+
+    Counted invariants on the registry (``registry`` or the default):
+
+    - ``train_steps_dispatched_total`` — every wrapped call;
+    - ``train_host_blocks_total`` — every post-compile block (periodic
+      sync or explicit ``wrapped.sync()``).  Steady-state overlap means
+      this stays flat between sync boundaries.
+
+    The wrapper exposes ``wrapped.sync()`` (flush the open window:
+    block on the last outputs, attribute, return them) and
+    ``wrapped.goodput``.
     """
     if goodput is None:
         goodput = GoodputTracker()
+    sync_every = _resolve_sync_every(sync_every)
     # A tracker built with a registry already observes productive steps
     # into its own step histogram; don't double-observe.
     hist = None
     if registry is not None and goodput._step_hist is None:
         hist = registry.histogram(
             histogram_name, "Train step wall time (post-compile)")
-    state = {"compiled": False}
+    from .metrics import default_registry
+    reg = registry if registry is not None else default_registry()
+    dispatched_total = reg.counter(
+        "train_steps_dispatched_total",
+        "Train steps dispatched through the instrumented step wrapper")
+    host_blocks_total = reg.counter(
+        "train_host_blocks_total",
+        "Post-compile host blocks on in-flight train steps (sliding"
+        " goodput syncs + explicit sync() calls)")
+    state = {"compiled": False, "pending_seconds": 0.0, "pending_steps": 0,
+             "last_out": None}
     lock = threading.Lock()
+
+    def _observe(seconds: float, steps: int) -> None:
+        goodput.add(PRODUCTIVE, seconds, steps=steps)
+        if hist is not None:
+            per_step = seconds / max(1, steps)
+            for _ in range(max(1, steps)):
+                hist.observe(per_step)
+
+    def _block(out):
+        try:
+            import jax
+            return jax.block_until_ready(out)
+        except ImportError:
+            return out
+
+    def _flush_locked() -> None:
+        """Attribute the open window.  Caller holds ``lock`` and has
+        already folded the sync-block time into pending_seconds."""
+        if state["pending_steps"]:
+            _observe(state["pending_seconds"], state["pending_steps"])
+        state["pending_seconds"] = 0.0
+        state["pending_steps"] = 0
+        state["last_out"] = None
 
     def wrapped(*args, **kwargs):
         start = goodput._clock()
         out = step_fn(*args, **kwargs)
-        try:
-            import jax
-            out = jax.block_until_ready(out)
-        except ImportError:
-            pass
-        elapsed = goodput._clock() - start
+        dispatched_total.inc()
         with lock:
             first = not state["compiled"]
             state["compiled"] = True
-        if first:
-            goodput.add(COMPILE, elapsed)
-        else:
-            goodput.add(PRODUCTIVE, elapsed)
-            if hist is not None:
-                hist.observe(elapsed)
+            if first:
+                out = _block(out)
+                goodput.add(COMPILE, goodput._clock() - start)
+                return out
+            state["pending_steps"] += 1
+            state["last_out"] = out
+            boundary = (sync_every >= 1
+                        and state["pending_steps"] >= sync_every)
+            if boundary:
+                out = _block(out)
+                host_blocks_total.inc()
+            state["pending_seconds"] += goodput._clock() - start
+            if boundary:
+                _flush_locked()
+        return out
+
+    def sync():
+        """Block on the last in-flight step and flush the open window.
+        Returns the (now-ready) last outputs, or None when the window
+        is empty."""
+        with lock:
+            out = state["last_out"]
+            if state["pending_steps"] == 0:
+                return out
+            start = goodput._clock()
+            out = _block(out)
+            host_blocks_total.inc()
+            state["pending_seconds"] += goodput._clock() - start
+            _flush_locked()
         return out
 
     wrapped.goodput = goodput
+    wrapped.sync = sync
+    wrapped.sync_every = sync_every
     return wrapped

@@ -8,6 +8,8 @@ suspend/resume so a preempted job restarts from step N), and a
 jax-profiler hook driven by env.
 """
 
-from .checkpoint import (CheckpointManager, latest_step,  # noqa: F401
-                         latest_steps, restore_checkpoint, save_checkpoint)
+from .checkpoint import (CheckpointManager, is_committed,  # noqa: F401
+                         latest_step, latest_steps, restore_checkpoint,
+                         save_checkpoint)
+from .data import DevicePrefetcher  # noqa: F401
 from .profiler import maybe_profile  # noqa: F401

@@ -6,16 +6,48 @@ sharded TrainState periodically and restore on restart, so a
 suspended/preempted/rescheduled MPIJob resumes from the last step.
 Orbax handles multi-host coordination and sharded array layouts
 natively (each host writes its shards).
+
+Two durability/latency properties on top of plain orbax:
+
+- **Atomic commit**: every save writes into ``step_NNNNNNNN.tmp-*``,
+  drops a ``_COMMITTED`` marker, then renames to ``step_NNNNNNNN`` —
+  :func:`latest_steps` / :func:`restore_checkpoint` only ever see
+  fully-written checkpoints, so a crash mid-write (sync or async) can
+  never be restored as a torn checkpoint.  Retention GC also sweeps
+  stale tmp dirs left by crashed writers.
+- **Async saves** (:class:`CheckpointManager`, the default): ``save()``
+  snapshots the sharded state to host memory (``jax.device_get``
+  per-shard) and hands the write to a single background writer thread.
+  The train loop only blocks if a new save is requested while the
+  previous write is still in flight (``checkpoint_save_blocked_seconds``
+  counts exactly that time); goodput's checkpoint bucket records only
+  the snapshot, proving the write latency left the step path.  Writer
+  failures are fatal-loud: the thread dumps a flight-recorder bundle,
+  and the stored exception re-raises on the train loop at the next save
+  point (or ``drain()``) instead of leaving a silently dead writer.
 """
 
 from __future__ import annotations
 
 import os
 import shutil
+import threading
+import time
 from typing import Any, Optional
 
 from ..telemetry.metrics import default_registry
 from ..telemetry.trace import span
+
+# A checkpoint directory is only restorable once this marker exists
+# inside it.  The marker is written into the tmp dir BEFORE the atomic
+# rename, so every final-named dir carries it by construction.
+COMMIT_MARKER = "_COMMITTED"
+
+# Stale-tmp sweep age: tmp dirs older than this are crash leftovers
+# (a live writer renames within one save); younger ones may belong to a
+# concurrent writer on a shared filesystem and are left alone.
+TMP_SWEEP_AGE_ENV = "MPI_OPERATOR_CKPT_TMP_SWEEP_AGE_S"
+DEFAULT_TMP_SWEEP_AGE_S = 3600.0
 
 
 def _checkpoint_metrics(registry=None):
@@ -28,6 +60,22 @@ def _checkpoint_metrics(registry=None):
     }
 
 
+def _async_metrics(registry=None):
+    registry = registry or default_registry()
+    return {
+        "async_saves": registry.counter(
+            "checkpoint_async_saves_total",
+            "Checkpoint saves handed to the background writer thread"),
+        "blocked_seconds": registry.counter(
+            "checkpoint_save_blocked_seconds",
+            "Train-loop seconds spent blocked waiting for an in-flight"
+            " async checkpoint write"),
+        "snapshot": registry.histogram(
+            "checkpoint_snapshot_seconds",
+            "Device-to-host state snapshot wall time (async save)"),
+    }
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
     return ocp.PyTreeCheckpointer()
@@ -37,15 +85,77 @@ def _step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:08d}")
 
 
+def _tmp_dir(directory: str, step: int) -> str:
+    # Deterministic suffix: multi-host orbax needs every process to
+    # agree on the write path, and a crashed same-step attempt is
+    # force-overwritten anyway.
+    return _step_dir(directory, step) + ".tmp-w"
+
+
+def _dir_restorable(path: str) -> bool:
+    """A final-named checkpoint dir is restorable when it has any
+    content at all.  The atomicity guarantee lives in the tmp+rename
+    protocol: this writer only ever produces final-named dirs whole
+    (with the ``_COMMITTED`` marker already inside), so the torn shapes
+    it can leave behind are ``.tmp-*`` dirs (never listed) and empty
+    final dirs — both rejected here.  Marker-less non-empty dirs are
+    pre-marker legacy saves and must stay restorable (requiring the
+    marker would silently restart upgraded jobs from step 0), which is
+    why the marker itself is forensic, not load-bearing."""
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return False
+    return bool(entries)
+
+
+def is_committed(directory: str, step: int) -> bool:
+    return _dir_restorable(_step_dir(directory, step))
+
+
+def _sweep_stale_tmp(directory: str) -> None:
+    age = float(os.environ.get(TMP_SWEEP_AGE_ENV, DEFAULT_TMP_SWEEP_AGE_S))
+    now = time.time()
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for name in names:
+        if not (name.startswith("step_") and ".tmp-" in name):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            stale = now - os.path.getmtime(path) >= age
+        except OSError:
+            continue
+        if stale:
+            shutil.rmtree(path, ignore_errors=True)
+
+
 def save_checkpoint(directory: str, state: Any, step: int,
                     keep: int = 3) -> str:
-    """Save `state` (any pytree, incl. sharded arrays) at `step`."""
+    """Save `state` (any pytree, incl. sharded arrays) at `step`.
+
+    Atomic: the write lands in ``step_NNNNNNNN.tmp-*`` and is renamed
+    into place only after the data and the ``_COMMITTED`` marker are
+    down — readers never observe a partial checkpoint.
+    """
     import jax
 
     path = _step_dir(directory, step)
+    tmp = _tmp_dir(directory, step)
     with span("checkpoint_save", step=step), \
             _checkpoint_metrics()["save"].time():
-        _checkpointer().save(path, state, force=True)
+        if os.path.isdir(tmp):
+            # Crash leftover from a previous attempt at this exact step.
+            shutil.rmtree(tmp, ignore_errors=True)
+        _checkpointer().save(tmp, state, force=True)
+        if jax.process_index() == 0:
+            with open(os.path.join(tmp, COMMIT_MARKER), "w") as f:
+                f.write(f"step={step}\n")
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            os.rename(tmp, path)
     # Retention: drop oldest beyond `keep` (process 0 only on multi-host).
     # keep <= 0 disables GC entirely, and the step just written is never
     # a deletion candidate even if the directory listing races with
@@ -56,19 +166,27 @@ def save_checkpoint(directory: str, state: Any, step: int,
             if old == step:
                 continue
             shutil.rmtree(_step_dir(directory, old), ignore_errors=True)
+        _sweep_stale_tmp(directory)
     return path
 
 
 def latest_steps(directory: str) -> list:
+    """Sorted committed checkpoint steps.  Tmp dirs (in-flight or crash
+    leftovers) and empty final-named dirs are never listed — a torn
+    write can not be restored.  Marker-less but non-empty dirs are
+    legacy (pre-marker) checkpoints and stay restorable."""
     if not os.path.isdir(directory):
         return []
     steps = []
     for name in os.listdir(directory):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name.split("_")[1]))
-            except (IndexError, ValueError):
-                continue
+        if not name.startswith("step_") or ".tmp-" in name:
+            continue
+        try:
+            step = int(name.split("_")[1])
+        except (IndexError, ValueError):
+            continue
+        if _dir_restorable(os.path.join(directory, name)):
+            steps.append(step)
     return sorted(steps)
 
 
@@ -80,11 +198,18 @@ def latest_step(directory: str) -> Optional[int]:
 def restore_checkpoint(directory: str, target: Any,
                        step: Optional[int] = None) -> Any:
     """Restore into the structure/shardings of `target`; returns the
-    restored pytree, or `target` unchanged if no checkpoint exists."""
+    restored pytree, or `target` unchanged if no committed checkpoint
+    exists.  An explicitly requested uncommitted step raises rather
+    than restoring a torn write."""
     if step is None:
         step = latest_step(directory)
     if step is None:
         return target
+    if not is_committed(directory, step):
+        raise ValueError(
+            f"checkpoint step {step} in {directory} is uncommitted "
+            f"(absent, empty, or still under a .tmp dir); refusing to "
+            f"restore a torn write")
     import orbax.checkpoint as ocp
     with span("checkpoint_restore", step=step), \
             _checkpoint_metrics()["restore"].time():
@@ -94,27 +219,108 @@ def restore_checkpoint(directory: str, target: Any,
 
 
 class CheckpointManager:
-    """Tiny convenience wrapper for train loops.
+    """Convenience wrapper for train loops, async by default.
 
     >>> mgr = CheckpointManager(dir, every=100)
     >>> state = mgr.restore(state)           # resume if possible
     >>> for ...: state = ...; mgr.maybe_save(state, step)
+    >>> mgr.drain()                          # flush the in-flight write
+
+    ``async_save=True`` (default): ``save()`` blocks only for the
+    device-to-host snapshot (plus any wait for a previous still-running
+    write); the orbax write itself runs on a background writer thread.
+    ``async_save=False`` restores the fully synchronous legacy path.
+    Read APIs (``restore``/``resume_step``) drain the writer first so
+    they always observe the newest save.
     """
 
     def __init__(self, directory: str, every: int = 100, keep: int = 3,
-                 goodput=None):
+                 goodput=None, async_save: bool = True, registry=None):
         self.directory = directory
         self.every = every
         self.keep = keep
-        # Optional telemetry.goodput.GoodputTracker: save time is then
-        # attributed to the checkpoint bucket of the train loop's
-        # goodput summary.
+        # Optional telemetry.goodput.GoodputTracker: snapshot (async) or
+        # save (sync) time is then attributed to the checkpoint bucket
+        # of the train loop's goodput summary.
         self.goodput = goodput
+        self.async_save = async_save
+        self._metrics = _async_metrics(registry)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._writer_error: Optional[BaseException] = None
+        self._completed_since_poll = False
+        self.last_written_step: Optional[int] = None
 
+    # -- async writer machinery -------------------------------------------
+    def _join_inflight(self, count_blocked: bool) -> None:
+        thread = self._thread
+        if thread is None or not thread.is_alive():
+            if thread is not None:
+                thread.join()
+            return
+        start = time.perf_counter()
+        thread.join()
+        if count_blocked:
+            self._metrics["blocked_seconds"].inc(
+                time.perf_counter() - start)
+
+    def _raise_writer_error(self) -> None:
+        with self._lock:
+            err, self._writer_error = self._writer_error, None
+        if err is not None:
+            raise err
+
+    def _write(self, host_state: Any, step: int) -> None:
+        try:
+            save_checkpoint(self.directory, host_state, step, self.keep)
+            with self._lock:
+                self._completed_since_poll = True
+                self.last_written_step = step
+        except BaseException as exc:  # fatal-loud, re-raised on the loop
+            try:
+                from ..telemetry import flight
+                nbytes = sum(
+                    int(getattr(x, "nbytes", 0))
+                    for x in _tree_leaves(host_state))
+                flight.record("train", "checkpoint_writer_error",
+                              step=step, in_flight_bytes=nbytes,
+                              error=repr(exc))
+                flight.dump_bundle("checkpoint-writer-error")
+            except Exception:
+                pass
+            with self._lock:
+                self._completed_since_poll = True
+                self._writer_error = exc
+
+    def drain(self) -> None:
+        """Block until the in-flight async write (if any) has finished;
+        re-raises a writer failure on the caller.  Not counted into
+        ``checkpoint_save_blocked_seconds`` — that counter measures the
+        STEP PATH only (a save waiting on the previous write); drain
+        runs off it (end of training, preemption grace window)."""
+        self._join_inflight(count_blocked=False)
+        self._raise_writer_error()
+
+    def completed_since_last_poll(self) -> bool:
+        """True exactly once after each async write finishes — the train
+        loop re-polls the preemption notice on that edge."""
+        with self._lock:
+            done, self._completed_since_poll = \
+                self._completed_since_poll, False
+        return done
+
+    @property
+    def in_flight(self) -> bool:
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    # -- save/restore ------------------------------------------------------
     def restore(self, target: Any) -> Any:
+        self.drain()
         return restore_checkpoint(self.directory, target)
 
     def resume_step(self) -> int:
+        self.drain()
         return latest_step(self.directory) or 0
 
     def maybe_save(self, state: Any, step: int) -> bool:
@@ -123,11 +329,66 @@ class CheckpointManager:
             return True
         return False
 
+    def _async_snapshot_possible(self, state: Any) -> bool:
+        """Async saves snapshot the FULL state to this host's memory,
+        which is only possible (and only correct) when every array is
+        fully addressable from this process.  Multi-process jobs fall
+        back to the sync path, where orbax has each host write its own
+        shards — jax.device_get on a cross-host sharded array raises."""
+        try:
+            import jax
+            if jax.process_count() > 1:
+                return False
+            return all(getattr(x, "is_fully_addressable", True)
+                       for x in _tree_leaves(state))
+        except ImportError:
+            return True
+
     def save(self, state: Any, step: int) -> str:
-        """Unconditional save — the preemption path (a notice arrived;
-        checkpoint NOW, off the periodic schedule, then exit)."""
+        """Unconditional save — also the preemption path (a notice
+        arrived; checkpoint NOW, off the periodic schedule, then exit).
+        Async mode returns as soon as the host snapshot is taken and the
+        write is handed to the writer thread."""
+        # The next save point is where a dead writer must get loud: a
+        # failure that only ever surfaced in drain() could hide for the
+        # whole run under every-N scheduling.
+        self._raise_writer_error()
+        if not self.async_save or not self._async_snapshot_possible(state):
+            # Never overlap a sync write with a still-running async one
+            # (possible when addressability forces a mid-run fallback).
+            self._join_inflight(count_blocked=True)
+            self._raise_writer_error()
+            if self.goodput is not None:
+                with self.goodput.checkpoint_save():
+                    return save_checkpoint(self.directory, state, step,
+                                           self.keep)
+            return save_checkpoint(self.directory, state, step, self.keep)
+
+        # Only block if the previous write is still in flight.
+        self._join_inflight(count_blocked=True)
+        self._raise_writer_error()
+
+        def _snapshot():
+            import jax
+            with self._metrics["snapshot"].time():
+                return jax.device_get(state)
+
         if self.goodput is not None:
             with self.goodput.checkpoint_save():
-                return save_checkpoint(self.directory, state, step,
-                                       self.keep)
-        return save_checkpoint(self.directory, state, step, self.keep)
+                host_state = _snapshot()
+        else:
+            host_state = _snapshot()
+        self._metrics["async_saves"].inc()
+        self._thread = threading.Thread(
+            target=self._write, args=(host_state, step),
+            name=f"ckpt-writer-{step}", daemon=True)
+        self._thread.start()
+        return _step_dir(self.directory, step)
+
+
+def _tree_leaves(tree):
+    try:
+        import jax
+        return jax.tree_util.tree_leaves(tree)
+    except ImportError:
+        return []

@@ -9,6 +9,8 @@ framework ships the plumbing.
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -40,6 +42,91 @@ def global_batch_iterator(local_batch_fn: Callable[[int], Sequence],
                 jax.make_array_from_process_local_data(s, np.asarray(arr))
                 for arr, s in zip(local, shardings))
         step += 1
+
+
+class _PrefetchDone:
+    pass
+
+
+class _PrefetchError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DevicePrefetcher:
+    """Double-buffered background batch prefetch.
+
+    Pulls up to ``depth`` batches ahead of the consumer on a daemon
+    thread, so host-side batch assembly (and the ``device_put`` the
+    source iterator or the optional ``shardings`` perform) overlaps the
+    in-flight device step instead of serializing behind it.  Source
+    exceptions propagate to the consumer at the position they occurred.
+
+    >>> for batch in DevicePrefetcher(batches, depth=2): ...
+
+    ``close()`` stops the background thread without draining the source
+    (the train loop calls it on every exit path; the thread parks on a
+    bounded queue otherwise).
+    """
+
+    def __init__(self, source, depth: int = 2, shardings=None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = iter(source)
+        self._shardings = shardings
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._run, name="batch-prefetch", daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        try:
+            for item in self._source:
+                if self._shardings is not None:
+                    import jax
+                    item = tuple(jax.device_put(arr, s) for arr, s
+                                 in zip(item, self._shardings))
+                if not self._put(item):
+                    return
+            self._put(_PrefetchDone())
+        except BaseException as exc:  # noqa: BLE001 — relayed to consumer
+            self._put(_PrefetchError(exc))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done or self._stop.is_set():
+            raise StopIteration
+        item = self._queue.get()
+        if isinstance(item, _PrefetchDone):
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _PrefetchError):
+            self._done = True
+            raise item.exc
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # Unblock a producer parked on a full queue.
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5)
 
 
 def synthetic_image_batches(batch_per_process: int, image_size: int = 224,

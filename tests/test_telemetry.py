@@ -274,6 +274,7 @@ def test_goodput_rejects_unknown_bucket():
 
 
 def test_instrument_step_compile_then_productive():
+    """sync_every=1 restores the legacy exact per-step attribution."""
     reg = Registry()
     gp = GoodputTracker(registry=reg)
     calls = []
@@ -282,7 +283,8 @@ def test_instrument_step_compile_then_productive():
         calls.append(batch)
         return state + 1, {"loss": 0.0}
 
-    wrapped = instrument_step(step_fn, goodput=gp, registry=reg)
+    wrapped = instrument_step(step_fn, goodput=gp, registry=reg,
+                              sync_every=1)
     state = 0
     for i in range(4):
         state, _ = wrapped(state, i)
@@ -292,6 +294,53 @@ def test_instrument_step_compile_then_productive():
     assert s["steps"] == 3  # first call attributed to compile
     assert s["seconds"]["compile"] > 0
     assert reg.get("train_step_seconds").count == 3
+    assert reg.get("train_steps_dispatched_total").value == 4
+    # Per-step sync: every post-compile call blocked on the host.
+    assert reg.get("train_host_blocks_total").value == 3
+
+
+def test_instrument_step_async_dispatch_sliding_sync():
+    """Async default: no host block until the K-step sync boundary,
+    where the whole window is attributed as K productive steps."""
+    reg = Registry()
+    gp = GoodputTracker(registry=reg)
+    wrapped = instrument_step(lambda s, b: (s + 1, {}), goodput=gp,
+                              registry=reg, sync_every=3)
+    state = 0
+    state, _ = wrapped(state, 0)  # compile (blocks, not counted)
+    assert reg.get("train_host_blocks_total").value == 0
+    for i in range(1, 3):
+        state, _ = wrapped(state, i)
+    # Window open: dispatched but nothing attributed, no blocks.
+    assert reg.get("train_host_blocks_total").value == 0
+    assert gp.summary()["steps"] == 0
+    state, _ = wrapped(state, 3)  # 3rd post-compile call: sync boundary
+    assert reg.get("train_host_blocks_total").value == 1
+    s = gp.summary()
+    assert s["steps"] == 3
+    assert s["seconds"]["productive"] > 0
+    assert reg.get("train_step_seconds").count == 3  # one avg per step
+    assert reg.get("train_steps_dispatched_total").value == 4
+
+
+def test_instrument_step_explicit_sync_flushes_window():
+    reg = Registry()
+    gp = GoodputTracker(registry=reg)
+    wrapped = instrument_step(lambda s, b: (s + 1, {}), goodput=gp,
+                              registry=reg, sync_every=0)
+    state = 0
+    for i in range(5):
+        state, _ = wrapped(state, i)
+    # sync_every=0: never blocks on its own.
+    assert reg.get("train_host_blocks_total").value == 0
+    assert gp.summary()["steps"] == 0
+    out = wrapped.sync()
+    assert out is not None  # the last (state, metrics)
+    assert reg.get("train_host_blocks_total").value == 1
+    assert gp.summary()["steps"] == 4
+    # Empty window: sync is a no-op, no extra block.
+    assert wrapped.sync() is None
+    assert reg.get("train_host_blocks_total").value == 1
 
 
 # -- serving metric set ----------------------------------------------------

@@ -1,0 +1,590 @@
+"""Train hot-path tests (ISSUE 6): overlapped step loop, async +
+atomic checkpointing, ZeRO-style sharded weight update."""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mpi_operator_tpu.parallel.mesh import (MeshConfig, batch_sharding,
+                                            create_mesh)
+from mpi_operator_tpu.parallel.train import (PREEMPTION_EXIT_CODE,
+                                             build_train_step,
+                                             run_train_loop)
+from mpi_operator_tpu.telemetry.goodput import GoodputTracker
+from mpi_operator_tpu.telemetry.metrics import Registry
+from mpi_operator_tpu.utils import (CheckpointManager, DevicePrefetcher,
+                                    latest_steps, restore_checkpoint,
+                                    save_checkpoint)
+from mpi_operator_tpu.utils.checkpoint import COMMIT_MARKER
+
+
+def _params():
+    return {"w": jnp.arange(16 * 4, dtype=jnp.float32).reshape(16, 4) / 64,
+            "b": jnp.zeros((4,)),
+            "odd": jnp.full((3,), 0.5)}  # no dim divides dp: stays whole
+
+
+def _loss_fn(p, batch):
+    x, = batch
+    return jnp.mean((x @ p["w"] + p["b"]) ** 2) + jnp.sum(p["odd"] ** 2)
+
+
+def _batch(rows=32):
+    x = np.random.RandomState(0).randn(rows, 16).astype(np.float32)
+    return x
+
+
+def _spec_axes(spec):
+    return [n for e in spec if e is not None
+            for n in (e if isinstance(e, tuple) else (e,))]
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-style sharded weight update
+# ---------------------------------------------------------------------------
+
+def test_shard_update_equivalent_to_replicated_and_sharded_specs():
+    """Same seed, dp=8 CPU mesh, accum_steps=2, 3 steps: shard_update
+    must be numerically equivalent to the replicated update AND the
+    param-shaped optimizer-state leaves must actually carry a 'dp'
+    partition (HBM footprint claim asserted on the sharding spec, not
+    just numerics)."""
+    mesh = create_mesh(MeshConfig(dp=8))
+    params = _params()
+    x = _batch(32)
+    states = {}
+    with mesh:
+        sharded_x = jax.device_put(x, batch_sharding(mesh, extra_dims=1))
+        for flag in (False, True):
+            init_fn, step_fn = build_train_step(
+                _loss_fn, optax.adam(1e-2), mesh, donate=False,
+                accum_steps=2, shard_update=flag)
+            state = init_fn(params)
+            for _ in range(3):
+                state, metrics = step_fn(state, (sharded_x,))
+            states[flag] = (state, float(metrics["loss"]))
+
+    assert np.isclose(states[False][1], states[True][1], rtol=1e-6)
+    for tree in ("params", "opt_state"):
+        ref = jax.tree_util.tree_leaves(getattr(states[False][0], tree))
+        got = jax.tree_util.tree_leaves(getattr(states[True][0], tree))
+        for a, b in zip(ref, got):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=1e-6, atol=1e-7)
+
+    # Sharding-spec assertions: every optimizer-state leaf whose shape
+    # admits a dp shard is partitioned; the rest stay replicated.
+    sharded = unsharded = 0
+    for leaf in jax.tree_util.tree_leaves(states[True][0].opt_state):
+        spec = leaf.sharding.spec
+        if leaf.ndim >= 1 and any(s % 8 == 0 and s > 0
+                                  for s in leaf.shape):
+            assert "dp" in _spec_axes(spec), (leaf.shape, spec)
+            shard = leaf.sharding.shard_shape(leaf.shape)
+            assert any(s < g for s, g in zip(shard, leaf.shape)), \
+                (leaf.shape, shard)
+            sharded += 1
+        else:
+            assert "dp" not in _spec_axes(spec), (leaf.shape, spec)
+            unsharded += 1
+    assert sharded > 0 and unsharded > 0
+    # Replicated reference keeps replicated optimizer state.
+    for leaf in jax.tree_util.tree_leaves(states[False][0].opt_state):
+        assert "dp" not in _spec_axes(leaf.sharding.spec)
+
+
+def test_shard_update_same_shape_conflicting_base_specs():
+    """Two same-shape params with different base specs: the optimizer
+    state spec-by-shape map must drop the ambiguous shape (no wrong
+    pinning) while the update stays numerically equivalent."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = create_mesh(MeshConfig(dp=4, tp=2))
+    params = {"a": jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8)
+              / 128,
+              "b": jnp.ones((16, 8)) * 0.01}
+    specs = {"a": P(None, "tp"), "b": P()}
+
+    def loss_fn(p, batch):
+        x, = batch
+        return jnp.mean(((x @ p["a"]) * (x @ p["b"])) ** 2)
+
+    x = np.random.RandomState(1).randn(16, 16).astype(np.float32)
+    states = {}
+    with mesh:
+        xs = jax.device_put(x, batch_sharding(mesh, extra_dims=1))
+        for flag in (False, True):
+            init_fn, step_fn = build_train_step(
+                loss_fn, optax.adam(1e-2), mesh, param_specs=specs,
+                donate=False, shard_update=flag)
+            state = init_fn(params)
+            for _ in range(2):
+                state, _ = step_fn(state, (xs,))
+            states[flag] = state
+    for a, b in zip(jax.tree_util.tree_leaves(states[False].params),
+                    jax.tree_util.tree_leaves(states[True].params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_shard_update_noop_on_dp1_mesh():
+    mesh = create_mesh(MeshConfig(dp=1, fsdp=8))
+    params = {"w": jnp.ones((16, 4))}
+
+    def loss_fn(p, batch):
+        x, = batch
+        return jnp.mean((x @ p["w"]) ** 2)
+
+    with mesh:
+        init_fn, step_fn = build_train_step(loss_fn, optax.sgd(1e-2), mesh,
+                                            donate=False, shard_update=True)
+        state = init_fn(params)
+        x = jax.device_put(_batch(32), batch_sharding(mesh, extra_dims=1))
+        state, metrics = step_fn(state, (x,))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# Async + atomic checkpointing
+# ---------------------------------------------------------------------------
+
+def _tiny_state(mesh):
+    params = _params()
+    init_fn, step_fn = build_train_step(_loss_fn, optax.adam(1e-2), mesh,
+                                        donate=False)
+    with mesh:
+        state = init_fn(params)
+        x = jax.device_put(_batch(32), batch_sharding(mesh, extra_dims=1))
+        state, _ = step_fn(state, (x,))
+    return state, step_fn, x
+
+
+def test_async_save_commits_and_restores_bit_identical_to_sync(tmp_path):
+    mesh = create_mesh(MeshConfig(dp=8))
+    state, _, _ = _tiny_state(mesh)
+    reg = Registry()
+    async_dir, sync_dir = str(tmp_path / "async"), str(tmp_path / "sync")
+    mgr = CheckpointManager(async_dir, every=1, keep=3, registry=reg)
+    assert mgr.async_save
+    mgr.save(state, 1)
+    mgr.drain()
+    save_checkpoint(sync_dir, state, 1)
+
+    assert latest_steps(async_dir) == [1]
+    assert os.path.exists(os.path.join(async_dir, "step_00000001",
+                                       COMMIT_MARKER))
+    assert reg.get("checkpoint_async_saves_total").value == 1
+
+    with mesh:
+        from_async = restore_checkpoint(async_dir, state)
+        from_sync = restore_checkpoint(sync_dir, state)
+    for a, b in zip(jax.tree_util.tree_leaves(from_async),
+                    jax.tree_util.tree_leaves(from_sync)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_async_save_blocks_only_when_previous_write_in_flight(tmp_path,
+                                                              monkeypatch):
+    from mpi_operator_tpu.utils import checkpoint as ckpt
+
+    gate = threading.Event()
+
+    class _SlowStub:
+        def save(self, path, state, force=False):
+            gate.wait(timeout=10)
+            os.makedirs(path, exist_ok=True)
+
+    monkeypatch.setattr(ckpt, "_checkpointer", _SlowStub)
+    reg = Registry()
+    mgr = CheckpointManager(str(tmp_path), every=1, keep=3, registry=reg)
+    mgr.save(None, 1)  # returns immediately: write parked on the gate
+    assert mgr.in_flight
+    assert reg.get("checkpoint_save_blocked_seconds").value == 0.0
+
+    def _open_gate():
+        time.sleep(0.2)
+        gate.set()
+
+    t = threading.Thread(target=_open_gate)
+    t.start()
+    mgr.save(None, 2)  # must block until save-1's write finishes
+    t.join()
+    mgr.drain()
+    assert reg.get("checkpoint_save_blocked_seconds").value > 0.0
+    assert latest_steps(str(tmp_path)) == [1, 2]
+
+
+def test_async_writer_failure_is_fatal_loud(tmp_path, monkeypatch):
+    """A writer-thread crash must bundle to the flight recorder and
+    re-raise on the train loop at the next save point — never a
+    silently dead writer."""
+    from mpi_operator_tpu.telemetry import flight
+    from mpi_operator_tpu.utils import checkpoint as ckpt
+
+    monkeypatch.setenv(flight.DEBUG_DIR_ENV, str(tmp_path / "debug"))
+
+    class _BoomStub:
+        def save(self, path, state, force=False):
+            raise RuntimeError("disk on fire")
+
+    monkeypatch.setattr(ckpt, "_checkpointer", _BoomStub)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), every=1, keep=3,
+                            registry=Registry())
+    mgr.save(np.zeros((4,)), 1)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        mgr.save(np.zeros((4,)), 2)  # next save point re-raises
+    records = [r for r in flight.default_recorder().records("train")
+               if r["kind"] == "checkpoint_writer_error"]
+    assert records and records[-1]["data"]["step"] == 1
+    assert records[-1]["data"]["in_flight_bytes"] > 0
+    bundles = os.listdir(str(tmp_path / "debug"))
+    assert any("checkpoint-writer-error" in b for b in bundles)
+
+
+def test_retention_sweeps_stale_tmp_dirs(tmp_path, monkeypatch):
+    from mpi_operator_tpu.utils.checkpoint import TMP_SWEEP_AGE_ENV
+
+    class _Stub:
+        def save(self, path, state, force=False):
+            os.makedirs(path, exist_ok=True)
+
+    from mpi_operator_tpu.utils import checkpoint as ckpt
+    monkeypatch.setattr(ckpt, "_checkpointer", _Stub)
+    monkeypatch.setenv(TMP_SWEEP_AGE_ENV, "0")
+    stale = tmp_path / "step_00000099.tmp-w"
+    stale.mkdir()
+    save_checkpoint(str(tmp_path), state=None, step=1, keep=2)
+    assert not stale.exists()
+    assert latest_steps(str(tmp_path)) == [1]
+
+
+# ---------------------------------------------------------------------------
+# Preemption x async save (satellite regression)
+# ---------------------------------------------------------------------------
+
+def test_notice_during_inflight_async_save_checkpoints_final_state(
+        tmp_path, monkeypatch):
+    """A preemption notice landing while an async save is still writing
+    must still end in checkpoint-then-exit-143 with the FINAL state:
+    the loop re-polls right after the write completes, drains, and the
+    off-schedule save wins."""
+    from mpi_operator_tpu.utils import checkpoint as ckpt
+
+    release = threading.Event()
+    notice = tmp_path / "preempt.notice"
+
+    class _GatedStub:
+        def save(self, path, state, force=False):
+            # Block the step-2 scheduled write until the notice landed.
+            if path.endswith("step_00000002.tmp-w"):
+                release.wait(timeout=10)
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "state.txt"), "w") as f:
+                f.write(repr(state))
+
+    monkeypatch.setattr(ckpt, "_checkpointer", _GatedStub)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), every=2, keep=5,
+                            registry=Registry())
+
+    def step_fn(state, batch):
+        if state == 3:
+            # In-flight write for step 2 is parked; the notice lands
+            # mid-write, then the write is released.
+            assert mgr.in_flight
+            notice.write_text("preempted\n")
+            release.set()
+            mgr._thread.join()  # deterministically finish the write
+        return state + 1, {}
+
+    def batches():
+        while True:
+            yield None
+
+    with pytest.raises(SystemExit) as exc:
+        run_train_loop(0, step_fn, batches(), checkpoint_manager=mgr,
+                       preemption_file=str(notice), prefetch=0)
+    assert exc.value.code == PREEMPTION_EXIT_CODE
+    # The post-write re-poll caught the notice at step 4 (not a later
+    # scheduled save), and the final state reached disk committed.
+    steps = latest_steps(str(tmp_path / "ckpt"))
+    assert steps == [2, 4]
+    final = (tmp_path / "ckpt" / "step_00000004" / "state.txt").read_text()
+    assert final == "4"
+
+
+def test_run_train_loop_drains_async_writer_on_normal_exit(tmp_path,
+                                                           monkeypatch):
+    """Normal completion must be as durable as the preemption path:
+    the loop waits for the in-flight async write (a daemon writer
+    would die with the process) and surfaces a stored writer error
+    instead of swallowing it."""
+    from mpi_operator_tpu.utils import checkpoint as ckpt
+
+    slow = threading.Event()
+
+    class _SlowStub:
+        def save(self, path, state, force=False):
+            slow.wait(timeout=0.3)  # outlive the loop's last step
+            os.makedirs(path, exist_ok=True)
+
+    monkeypatch.setattr(ckpt, "_checkpointer", _SlowStub)
+    mgr = CheckpointManager(str(tmp_path / "ok"), every=4, keep=3,
+                            registry=Registry())
+    state, step = run_train_loop(0, lambda s, b: (s + 1, {}),
+                                 iter(range(4)), checkpoint_manager=mgr,
+                                 prefetch=0)
+    assert step == 4
+    # drain happened inside the loop: the write is already committed.
+    assert latest_steps(str(tmp_path / "ok")) == [4]
+
+    class _BoomStub:
+        def save(self, path, state, force=False):
+            raise RuntimeError("disk full")
+
+    monkeypatch.setattr(ckpt, "_checkpointer", _BoomStub)
+    monkeypatch.setenv("MPI_OPERATOR_DEBUG_DIR", str(tmp_path / "dbg"))
+    mgr2 = CheckpointManager(str(tmp_path / "boom"), every=4, keep=3,
+                             registry=Registry())
+    with pytest.raises(RuntimeError, match="disk full"):
+        run_train_loop(0, lambda s, b: (s + 1, {}), iter(range(4)),
+                       checkpoint_manager=mgr2, prefetch=0)
+
+
+def test_notice_during_final_step_still_exits_143(tmp_path):
+    """A notice landing during the last available batch's step must
+    checkpoint-then-exit 143, not complete silently."""
+    notice = tmp_path / "n"
+    saves = []
+
+    class FakeManager:
+        def maybe_save(self, state, step):
+            return False
+
+        def save(self, state, step):
+            saves.append((state, step))
+
+    def step_fn(state, batch):
+        if state == 2:  # final batch of the 3-item iterator
+            notice.write_text("x\n")
+        return state + 1, {}
+
+    with pytest.raises(SystemExit) as exc:
+        run_train_loop(0, step_fn, iter(range(3)),
+                       checkpoint_manager=FakeManager(),
+                       preemption_file=str(notice), prefetch=0)
+    assert exc.value.code == PREEMPTION_EXIT_CODE
+    assert saves == [(3, 3)]
+
+
+def test_preemption_exits_143_despite_stored_writer_error(tmp_path,
+                                                          monkeypatch):
+    """A stored async-writer failure must not turn the preemption exit
+    into a non-retryable crash: the grace-window save retries once
+    (raising cleared the stored error), and even a permanently broken
+    checkpointer still ends in SystemExit(143)."""
+    from mpi_operator_tpu.utils import checkpoint as ckpt
+
+    monkeypatch.setenv("MPI_OPERATOR_DEBUG_DIR", str(tmp_path / "dbg"))
+    calls = []
+
+    class _FlakyStub:
+        def save(self, path, state, force=False):
+            calls.append(path)
+            if len(calls) == 1:
+                raise RuntimeError("transient fs error")
+            os.makedirs(path, exist_ok=True)
+
+    monkeypatch.setattr(ckpt, "_checkpointer", _FlakyStub)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), every=100, keep=3,
+                            registry=Registry())
+    mgr.save(0, 1)       # async write fails; error stored on the writer
+    mgr._thread.join()   # deterministically finish the failing write
+    notice = tmp_path / "n"
+    notice.write_text("x\n")
+    with pytest.raises(SystemExit) as exc:
+        run_train_loop(5, lambda s, b: (s + 1, {}), iter(range(3)),
+                       checkpoint_manager=mgr, start_step=5,
+                       preemption_file=str(notice), prefetch=0)
+    assert exc.value.code == PREEMPTION_EXIT_CODE
+    # First grace-window save raised the stored error; the retry landed
+    # the final state committed.
+    assert latest_steps(str(tmp_path / "ckpt")) == [5]
+
+    class _BoomStub:
+        def save(self, path, state, force=False):
+            raise RuntimeError("disk on fire")
+
+    monkeypatch.setattr(ckpt, "_checkpointer", _BoomStub)
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt2"), every=100, keep=3,
+                             registry=Registry())
+    with pytest.raises(SystemExit) as exc:
+        run_train_loop(5, lambda s, b: (s + 1, {}), iter(range(3)),
+                       checkpoint_manager=mgr2,
+                       preemption_file=str(notice), prefetch=0)
+    assert exc.value.code == PREEMPTION_EXIT_CODE
+    assert latest_steps(str(tmp_path / "ckpt2")) == []
+
+
+def test_sync_failure_does_not_mask_loop_exception():
+    """step_fn.sync() raising on the exit path must not replace an
+    exception already unwinding out of the loop (a poisoned runtime's
+    secondary error hides the informative one) — but it must still
+    propagate when the loop exits cleanly."""
+    def sync():
+        raise RuntimeError("poisoned runtime")
+
+    def bad_step(state, batch):
+        raise ValueError("bad batch")
+    bad_step.sync = sync
+
+    with pytest.raises(ValueError, match="bad batch"):
+        run_train_loop(0, bad_step, iter(range(2)), prefetch=0)
+
+    def ok_step(state, batch):
+        return state + 1, {}
+    ok_step.sync = sync
+
+    with pytest.raises(RuntimeError, match="poisoned runtime"):
+        run_train_loop(0, ok_step, iter(range(2)), prefetch=0)
+
+
+def test_notice_poll_is_cached_once_per_step(tmp_path):
+    """The loop stats the notice file at most once per step (plus the
+    forced post-async-save re-poll), not once per helper call."""
+    from mpi_operator_tpu.parallel.train import _NoticePoller
+
+    notice = tmp_path / "n"
+    poller = _NoticePoller(str(notice))
+    for _ in range(5):
+        assert not poller.poll()
+    assert poller.stats == 5  # one stat per poll() call...
+    notice.write_text("x\n")
+    assert poller.poll()
+    stats = poller.stats
+    for _ in range(5):
+        assert poller.poll()
+    assert poller.stats == stats  # ...and none once seen
+
+    # No channel configured: zero stats ever.
+    silent = _NoticePoller(None)
+    assert not silent.poll()
+    assert silent.stats == 0
+
+
+def test_run_train_loop_polls_notice_once_per_step(tmp_path, monkeypatch):
+    import mpi_operator_tpu.parallel.train as train_mod
+
+    calls = {"n": 0}
+    real_exists = os.path.exists
+
+    def counting_exists(path):
+        if str(path).endswith("never.notice"):
+            calls["n"] += 1
+        return real_exists(path)
+
+    monkeypatch.setattr(train_mod.os.path, "exists", counting_exists)
+    state, step = run_train_loop(
+        0, lambda s, b: (s + 1, {}), iter(range(10)), max_steps=6,
+        preemption_file=str(tmp_path / "never.notice"), prefetch=0)
+    assert step == 6
+    # One post-step stat per executed step plus the single startup
+    # check — never the old two-polls-per-step.
+    assert calls["n"] == 7
+
+
+# ---------------------------------------------------------------------------
+# Prefetch
+# ---------------------------------------------------------------------------
+
+def test_device_prefetcher_preserves_order_and_exhausts():
+    pf = DevicePrefetcher(iter(range(20)), depth=3)
+    assert list(pf) == list(range(20))
+    pf.close()
+
+
+def test_device_prefetcher_propagates_source_errors():
+    def source():
+        yield 1
+        yield 2
+        raise RuntimeError("loader died")
+
+    pf = DevicePrefetcher(source(), depth=2)
+    assert next(pf) == 1
+    assert next(pf) == 2
+    with pytest.raises(RuntimeError, match="loader died"):
+        next(pf)
+    pf.close()
+
+
+def test_device_prefetcher_close_unblocks_producer():
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    pf = DevicePrefetcher(endless(), depth=1)
+    assert next(pf) == 0
+    pf.close()  # producer parked on the full queue must exit
+    assert not pf._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_run_train_loop_prefetch_matches_serial_results(tmp_path):
+    """Prefetch on vs off must train through identical batch sequences
+    to identical states."""
+    mesh = create_mesh(MeshConfig(dp=8))
+    params = _params()
+    results = {}
+    with mesh:
+        for depth in (0, 2):
+            init_fn, step_fn = build_train_step(
+                _loss_fn, optax.adam(1e-2), mesh, donate=False)
+            state = init_fn(params)
+            rng = np.random.RandomState(7)
+
+            def batches():
+                for _ in range(6):
+                    x = rng.randn(32, 16).astype(np.float32)
+                    yield (jax.device_put(
+                        x, batch_sharding(mesh, extra_dims=1)),)
+
+            state, step = run_train_loop(state, step_fn, batches(),
+                                         prefetch=depth)
+            assert step == 6
+            results[depth] = state
+    for a, b in zip(jax.tree_util.tree_leaves(results[0].params),
+                    jax.tree_util.tree_leaves(results[2].params)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_run_train_loop_flushes_async_goodput_window():
+    """With async dispatch (sync_every=0) the loop's exit path must
+    flush the open window so goodput still reports every step."""
+    mesh = create_mesh(MeshConfig(dp=8))
+    reg = Registry()
+    gp = GoodputTracker(registry=reg)
+    with mesh:
+        init_fn, step_fn = build_train_step(
+            _loss_fn, optax.adam(1e-2), mesh, donate=False,
+            goodput=gp, telemetry_registry=reg, sync_every=0)
+        state = init_fn(_params())
+        x = jax.device_put(_batch(32), batch_sharding(mesh, extra_dims=1))
+        state, step = run_train_loop(state, step_fn,
+                                     iter([(x,)] * 5), prefetch=2)
+    assert step == 5
+    s = gp.summary()
+    assert s["steps"] == 4  # first call = compile bucket
+    assert s["seconds"]["productive"] > 0
+    assert reg.get("train_steps_dispatched_total").value == 5
+    # Exactly one steady-state host block: the final window flush.
+    assert reg.get("train_host_blocks_total").value == 1

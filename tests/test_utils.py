@@ -5,6 +5,7 @@ import os
 import jax
 import jax.numpy as jnp
 import optax
+import pytest
 
 from mpi_operator_tpu.models.mnist import MnistCNN
 from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
@@ -84,16 +85,40 @@ def _stub_checkpointer(monkeypatch):
     monkeypatch.setattr(ckpt, "_checkpointer", _Stub)
 
 
+def _mk_committed(tmp_path, name):
+    from mpi_operator_tpu.utils.checkpoint import COMMIT_MARKER
+
+    (tmp_path / name).mkdir()
+    (tmp_path / name / COMMIT_MARKER).write_text("x\n")
+
+
 def test_latest_steps_parsing(tmp_path):
     from mpi_operator_tpu.utils.checkpoint import latest_step, latest_steps
 
     assert latest_steps(str(tmp_path / "missing")) == []
     assert latest_step(str(tmp_path / "missing")) is None
-    for name in ("step_00000003", "step_00000010", "step_badnum",
-                 "unrelated", "step_"):
+    for name in ("step_00000003", "step_00000010"):
+        _mk_committed(tmp_path, name)
+    for name in ("step_badnum", "unrelated", "step_"):
         (tmp_path / name).mkdir()
-    assert latest_steps(str(tmp_path)) == [3, 10]
+    # Uncommitted: an empty final-named dir (nothing was written) and an
+    # in-flight/crashed async write (tmp name) are never listed.
+    (tmp_path / "step_00000007").mkdir()
+    (tmp_path / "step_00000009.tmp-w").mkdir()
+    # Legacy grace: a pre-marker checkpoint (content, no _COMMITTED)
+    # must stay restorable — upgraded jobs must not restart from 0.
+    (tmp_path / "step_00000005").mkdir()
+    (tmp_path / "step_00000005" / "_METADATA").write_text("{}")
+    assert latest_steps(str(tmp_path)) == [3, 5, 10]
     assert latest_step(str(tmp_path)) == 10
+
+
+def test_restore_refuses_uncommitted_explicit_step(tmp_path):
+    from mpi_operator_tpu.utils.checkpoint import restore_checkpoint
+
+    (tmp_path / "step_00000005").mkdir()  # torn write: no marker
+    with pytest.raises(ValueError, match="uncommitted"):
+        restore_checkpoint(str(tmp_path), target=None, step=5)
 
 
 def test_retention_keeps_newest(tmp_path, monkeypatch):
@@ -133,7 +158,7 @@ def test_retention_never_deletes_step_just_written(tmp_path, monkeypatch):
     # Steps 5 and 9 already exist (the "9" simulating a concurrent
     # writer); saving step 7 with keep=1 puts 7 in the GC window.
     for pre in (5, 9):
-        (tmp_path / f"step_{pre:08d}").mkdir()
+        _mk_committed(tmp_path, f"step_{pre:08d}")
     save_checkpoint(directory, state=None, step=7, keep=1)
     steps = latest_steps(directory)
     assert 7 in steps  # just-written step survived
