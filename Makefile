@@ -5,7 +5,8 @@ PYTHON ?= python
 
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
-	controller-bench-smoke serve-bench-smoke train-bench-smoke
+	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
+	train-bench-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -43,6 +44,14 @@ obs-smoke:
 # scans, zero shared-snapshot mutations (docs/PERF.md).
 controller-bench-smoke:
 	$(PYTHON) tools/controller_bench_smoke.py
+
+# Sharded control plane (< 60s, CPU): N-shard fair controller vs the
+# 1-shard unfair-FIFO baseline on the same churn burst — throughput
+# floor, every rolling 1-pod job synced with bounded p99, ZERO
+# cross-shard violations (counter-asserted), every shard synced, hot
+# adds coalesced (docs/PERF.md "Sharded control plane").
+controller-shard-smoke:
+	$(PYTHON) tools/controller_shard_smoke.py
 
 # Serving decode hot path (< 60s, CPU): pipelined vs reference loops
 # emit byte-identical mixed greedy/sampled streams (dense + paged),

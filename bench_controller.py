@@ -9,6 +9,20 @@ workqueue, real watch streams).  The driver plays the kubelet: it flips
 pod phases and launcher Job conditions through the apiserver, exactly
 the write pattern the controller sees at scale.
 
+Churn-storm mode (``--storm``, docs/PERF.md "Sharded control plane"):
+a 10k-job / 100k-pod cluster — a few 10k-pod gangs churning status
+events, a large static fleet, and a rolling stream of 1-pod jobs
+created live — with per-verb apiserver RTT injected for controller
+threads during the measured window (the sim substrate is otherwise
+zero-latency, which would hide exactly the serialization the sharded
+queue removes; client-go runs N workers for the same reason).  Reports
+aggregate reconcile throughput, 1-pod-job p50/p99 reconcile latency
+(enqueue -> sync complete) under the gang churn, per-shard sync
+counters and the cross-shard violation counter (must be 0).
+``--storm`` runs the single-shard unfair-FIFO baseline and the sharded
+fair config back to back (each in a fresh subprocess) and writes the
+comparison into BENCH_CONTROLLER.json under "storm".
+
 Reported (ONE JSON line + BENCH_CONTROLLER.json):
 
 - reconciles_per_sec_busy: reconcile count / summed sync latency — the
@@ -261,6 +275,342 @@ def run_bench(n_jobs: int, workers: int, threads: int, storm: int,
     return record
 
 
+# ---------------------------------------------------------------------------
+# Churn-storm mode (10k jobs / 100k pods)
+# ---------------------------------------------------------------------------
+
+STORM_DEFAULTS = {
+    "shards": 8, "fair": True, "coalesce": True,
+    "gangs": 2, "gang_workers": 10000,
+    "static_jobs": 8000, "static_workers": 10,
+    "rolling_jobs": 2000, "storm_seconds": 50.0,
+    "churn_qps": 1500.0, "api_latency": 0.005,
+    # Informer periodic relist+diff cadence during the bench.  30s (the
+    # tier-1 default) at 100k pods means a 100k-object server-side list
+    # every 30s per informer — resync dominates the single host core
+    # long before the queue does.  Production operators run multi-minute
+    # resyncs; 120s keeps the path exercised without drowning the
+    # measurement.
+    "resync_interval": 120.0,
+    # Setup/drain are untimed but CPU-bound: standing up 10k jobs /
+    # 100k pods through a ONE-worker controller (the baseline config)
+    # takes tens of minutes on one core.
+    "setup_timeout": 2400.0, "drain_timeout": 1200.0,
+}
+
+
+class _RttInjector:
+    """Per-verb apiserver latency for NON-exempt threads (controller
+    sync workers, informer relists).  The bench's own driver threads —
+    kubelet stand-in, churner, roller — register as exempt: they model
+    other actors with their own connections, and their cost must not
+    pollute the controller measurement."""
+
+    def __init__(self, latency: float):
+        import threading
+        self.latency = latency
+        self.enabled = False
+        self._exempt = set()
+        self._threading = threading
+
+    def exempt_current_thread(self):
+        self._exempt.add(self._threading.get_ident())
+
+    def __call__(self, verb, api_version, kind, namespace="", name=""):
+        if not self.enabled or \
+                self._threading.get_ident() in self._exempt:
+            return
+        time.sleep(self.latency)
+
+
+def _quantiles(samples, qs=(0.50, 0.99)):
+    if not samples:
+        return {f"p{int(q * 100)}": None for q in qs}
+    ordered = sorted(samples)
+    return {f"p{int(q * 100)}":
+            round(ordered[min(len(ordered) - 1,
+                              int(q * len(ordered)))], 4)
+            for q in qs}
+
+
+def run_storm_bench(cfg: dict) -> dict:
+    import threading
+
+    from mpi_operator_tpu.controller.controller import MPIJobController
+    from mpi_operator_tpu.k8s import core
+    from mpi_operator_tpu.k8s.apiserver import (RELIST, ApiError,
+                                                Clientset)
+
+    cfg = {**STORM_DEFAULTS, **cfg}
+    cs = Clientset()
+    rtt = _RttInjector(cfg["api_latency"])
+    rtt.exempt_current_thread()
+    cs.server.fault_injector = rtt
+    controller = MPIJobController(cs, namespace=NAMESPACE,
+                                  shards=cfg["shards"],
+                                  fair_queueing=cfg["fair"])
+    if not cfg["coalesce"]:
+        controller.queue.coalescer = None  # unfair-FIFO baseline
+    for informer in controller.factory._informers.values():
+        informer.resync_interval = cfg["resync_interval"]
+
+    # -- per-job reconcile latency: first-enqueue -> sync complete ------
+    enqueue_ts: dict = {}
+    latencies = {"rolling": [], "gang": [], "static": []}
+    record_latency = threading.Event()  # armed only during the window
+    orig_add = controller.queue.add
+
+    def stamped_add(item, priority=None, coalesce=True):
+        if record_latency.is_set():
+            enqueue_ts.setdefault(item, time.perf_counter())
+        orig_add(item, priority=priority, coalesce=coalesce)
+
+    controller.queue.add = stamped_add
+    orig_timed_sync = controller._timed_sync
+
+    def timed_sync(key):
+        t0 = enqueue_ts.pop(key, None)
+        try:
+            orig_timed_sync(key)
+        finally:
+            if t0 is not None and record_latency.is_set():
+                name = key.partition("/")[2]
+                bucket = ("rolling" if name.startswith("rj-")
+                          else "gang" if name.startswith("gang-")
+                          else "static")
+                latencies[bucket].append(time.perf_counter() - t0)
+
+    controller._timed_sync = timed_sync
+    controller.run()
+
+    # -- driver: the kubelet stand-in flips every new pod to Running ----
+    stop = threading.Event()       # ends the storm (churner/roller)
+    flip_stop = threading.Event()  # ends the flipper (after drain)
+    flipped = [0]
+    ready = [core.PodCondition(type="Ready", status="True")]
+
+    def flipper():
+        rtt.exempt_current_thread()
+        watch = cs.server.watch("v1", "Pod")
+        pending = []
+        while not flip_stop.is_set():
+            ev = watch.next(timeout=0.1)
+            if ev is None:
+                continue
+            if ev.type == RELIST:
+                # Overflowed our bounded fan-out buffer: relist and
+                # flip whatever we missed (the overflow contract).
+                pending = [p for p in cs.server.list("v1", "Pod",
+                                                     NAMESPACE)
+                           if p.status.phase != core.POD_RUNNING]
+            elif ev.type == "ADDED":
+                pending.append(ev.obj)
+            for pod in pending:
+                try:
+                    cs.pods(NAMESPACE).patch_status(
+                        pod.metadata.name, phase=core.POD_RUNNING,
+                        conditions=ready)
+                    flipped[0] += 1
+                except ApiError:
+                    pass  # pod deleted mid-flip
+            pending = []
+        watch.stop()
+
+    flip_thread = threading.Thread(target=flipper, daemon=True,
+                                   name="storm-flipper")
+    flip_thread.start()
+
+    # -- setup (untimed, zero latency): gangs + static fleet ------------
+    t_setup = time.perf_counter()
+    gang_names = [f"gang-{i}" for i in range(cfg["gangs"])]
+    for name in gang_names:
+        cs.mpi_jobs(NAMESPACE).create(bench_job(name, cfg["gang_workers"]))
+    for i in range(cfg["static_jobs"]):
+        cs.mpi_jobs(NAMESPACE).create(
+            bench_job(f"st-{i}", cfg["static_workers"]))
+    expected_pods = (cfg["gangs"] * cfg["gang_workers"]
+                     + cfg["static_jobs"] * cfg["static_workers"])
+    deadline = time.monotonic() + cfg["setup_timeout"]
+    while time.monotonic() < deadline:
+        if flipped[0] >= expected_pods and len(controller.queue) == 0:
+            break
+        time.sleep(0.25)
+    else:
+        raise TimeoutError(
+            f"setup never settled: {flipped[0]}/{expected_pods} pods"
+            f" flipped, queue depth {len(controller.queue)}")
+    setup_seconds = time.perf_counter() - t_setup
+
+    # -- measured storm window ------------------------------------------
+    hist = controller.metrics.get("reconcile_seconds")
+    shard_syncs = controller.metrics.get("shard_syncs")
+
+    def shard_counts():
+        return [int(shard_syncs.get(str(i)))
+                for i in range(controller.queue.num_shards)]
+
+    reconciles_before = hist.count
+    busy_before = hist.sum
+    shards_before = shard_counts()
+    overflows_before = cs.server.watch_overflows
+    record_latency.set()
+    rtt.enabled = True
+
+    def churner():
+        """Gang churn: round-robin no-information status bumps over the
+        gang pods at ~churn_qps (the watch storm a flapping 10k-pod
+        fleet generates)."""
+        rtt.exempt_current_thread()
+        names = [f"{g}-worker-{i}" for g in gang_names
+                 for i in range(cfg["gang_workers"])]
+        i = n = 0
+        t0 = time.monotonic()
+        while not stop.is_set():
+            pod = names[i % len(names)]
+            try:
+                cs.pods(NAMESPACE).patch_status(
+                    pod, message=f"storm-{n}")
+            except ApiError:
+                pass
+            i += 1
+            n += 1
+            ahead = n / cfg["churn_qps"] - (time.monotonic() - t0)
+            if ahead > 0.005:
+                time.sleep(ahead)
+
+    rolled = [0]
+
+    def roller():
+        """Rolling 1-pod jobs created live through the window — the
+        small-job traffic whose p99 the fairness layer protects.  On a
+        saturated host core the creates can fall behind the nominal
+        pace and the window can close first; ``rolled`` records how
+        many actually landed so drain and the report stay truthful."""
+        rtt.exempt_current_thread()
+        interval = cfg["storm_seconds"] / max(1, cfg["rolling_jobs"])
+        t0 = time.monotonic()
+        for i in range(cfg["rolling_jobs"]):
+            if stop.is_set():
+                break
+            cs.mpi_jobs(NAMESPACE).create(bench_job(f"rj-{i}", 1))
+            rolled[0] += 1
+            ahead = (i + 1) * interval - (time.monotonic() - t0)
+            if ahead > 0.005:
+                time.sleep(ahead)
+
+    churn_thread = threading.Thread(target=churner, daemon=True,
+                                    name="storm-churner")
+    roll_thread = threading.Thread(target=roller, daemon=True,
+                                   name="storm-roller")
+    churn_thread.start()
+    roll_thread.start()
+    time.sleep(cfg["storm_seconds"])
+
+    window_reconciles = hist.count - reconciles_before
+    window_busy = hist.sum - busy_before
+    record_latency.clear()
+    stop.set()
+    rtt.enabled = False
+    churn_thread.join(timeout=5)
+    roll_thread.join(timeout=5)
+
+    # -- drain + verdict -------------------------------------------------
+    deadline = time.monotonic() + cfg["drain_timeout"]
+    while time.monotonic() < deadline:
+        if flipped[0] >= expected_pods + rolled[0] \
+                and len(controller.queue) == 0:
+            break
+        time.sleep(0.25)
+    else:
+        raise TimeoutError(
+            f"drain never settled: {flipped[0]} pods flipped"
+            f" (want {expected_pods + rolled[0]}),"
+            f" queue depth {len(controller.queue)}")
+    flip_stop.set()
+    flip_thread.join(timeout=5)
+
+    violations = controller.metrics.get("shard_violations")
+    shards_after = shard_counts()
+    registry = controller.metrics.get("registry")
+    from mpi_operator_tpu.telemetry.metrics import default_registry
+    coalesced = default_registry().get(
+        "mpi_operator_workqueue_adds_coalesced_total")
+    controller.stop()
+
+    total_jobs = (cfg["gangs"] + cfg["static_jobs"] + rolled[0])
+    record = {
+        "config": {k: cfg[k] for k in ("shards", "fair", "coalesce",
+                                       "gangs", "gang_workers",
+                                       "static_jobs", "static_workers",
+                                       "rolling_jobs", "storm_seconds",
+                                       "churn_qps", "api_latency")},
+        "jobs_total": total_jobs,
+        "rolling_jobs_created": rolled[0],
+        "pods_total": expected_pods + rolled[0],
+        "setup_seconds": round(setup_seconds, 1),
+        "window": {
+            "reconciles": window_reconciles,
+            "reconciles_per_sec": round(
+                window_reconciles / cfg["storm_seconds"], 1),
+            "busy_seconds": round(window_busy, 1),
+            "one_pod_job_latency": _quantiles(latencies["rolling"]),
+            "one_pod_job_syncs": len(latencies["rolling"]),
+            "gang_latency": _quantiles(latencies["gang"]),
+            "gang_syncs": len(latencies["gang"]),
+        },
+        "shard_syncs": [a - b for a, b in zip(shards_after,
+                                              shards_before)],
+        "cross_shard_violations": int(violations.value)
+        if violations is not None else None,
+        "adds_coalesced": int(coalesced.value)
+        if coalesced is not None else 0,
+        "watch_overflows": cs.server.watch_overflows - overflows_before,
+        "status_writes_suppressed": _indexed_counters(registry)[
+            "status_writes_suppressed"],
+    }
+    return record
+
+
+def run_storm_compare(args) -> dict:
+    """Baseline (1 shard, unfair FIFO, no coalescing) vs sharded fair
+    config on the same storm — each in a fresh subprocess (clean heap,
+    clean process-global registries)."""
+    import subprocess
+
+    def one(cfg: dict) -> dict:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--storm-run",
+             json.dumps(cfg)],
+            capture_output=True, text=True,
+            timeout=cfg.get("setup_timeout", 900) * 2 + 600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"storm run failed (cfg={cfg}):\n{proc.stdout[-2000:]}"
+                f"\n{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    shape = {k: getattr(args, k) for k in (
+        "gangs", "gang_workers", "static_jobs", "static_workers",
+        "rolling_jobs", "storm_seconds", "churn_qps", "api_latency",
+        "resync_interval", "setup_timeout", "drain_timeout")}
+    baseline = one({**shape, "shards": 1, "fair": False,
+                    "coalesce": False})
+    sharded = one({**shape, "shards": args.shards, "fair": True,
+                   "coalesce": True})
+    base_rps = baseline["window"]["reconciles_per_sec"] or 0
+    shard_rps = sharded["window"]["reconciles_per_sec"] or 0
+    base_p99 = baseline["window"]["one_pod_job_latency"]["p99"]
+    shard_p99 = sharded["window"]["one_pod_job_latency"]["p99"]
+    return {
+        "baseline_1shard_fifo": baseline,
+        "sharded_fair": sharded,
+        "throughput_x": round(shard_rps / base_rps, 2)
+        if base_rps else None,
+        "one_pod_p99_improvement_x": round(base_p99 / shard_p99, 1)
+        if base_p99 and shard_p99 else None,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--jobs", type=int, default=200)
@@ -275,7 +625,57 @@ def main(argv=None) -> int:
                     help="previously captured JSON to embed + compare")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT,
                                                   "BENCH_CONTROLLER.json"))
+    # Churn-storm mode (10k jobs / 100k pods; see module docstring).
+    ap.add_argument("--storm-compare", action="store_true", dest="storm_mode",
+                    help="run the 10k-job/100k-pod churn storm: 1-shard"
+                         " FIFO baseline vs sharded fair, merge into"
+                         " BENCH_CONTROLLER.json under 'storm'")
+    ap.add_argument("--storm-run", default=None, metavar="CFG_JSON",
+                    help="internal: run ONE storm config, print JSON")
+    ap.add_argument("--shards", type=int,
+                    default=STORM_DEFAULTS["shards"])
+    ap.add_argument("--gangs", type=int, default=STORM_DEFAULTS["gangs"])
+    ap.add_argument("--gang-workers", type=int,
+                    default=STORM_DEFAULTS["gang_workers"])
+    ap.add_argument("--static-jobs", type=int,
+                    default=STORM_DEFAULTS["static_jobs"])
+    ap.add_argument("--static-workers", type=int,
+                    default=STORM_DEFAULTS["static_workers"])
+    ap.add_argument("--rolling-jobs", type=int,
+                    default=STORM_DEFAULTS["rolling_jobs"])
+    ap.add_argument("--storm-seconds", type=float,
+                    default=STORM_DEFAULTS["storm_seconds"])
+    ap.add_argument("--churn-qps", type=float,
+                    default=STORM_DEFAULTS["churn_qps"])
+    ap.add_argument("--api-latency", type=float,
+                    default=STORM_DEFAULTS["api_latency"])
+    ap.add_argument("--resync-interval", type=float,
+                    default=STORM_DEFAULTS["resync_interval"])
+    ap.add_argument("--setup-timeout", type=float,
+                    default=STORM_DEFAULTS["setup_timeout"])
+    ap.add_argument("--drain-timeout", type=float,
+                    default=STORM_DEFAULTS["drain_timeout"])
     args = ap.parse_args(argv)
+
+    if args.storm_run is not None:
+        print(json.dumps(run_storm_bench(json.loads(args.storm_run))))
+        return 0
+
+    if args.storm_mode:
+        existing = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                existing = json.load(f)
+        try:
+            existing["storm"] = run_storm_compare(args)
+        except Exception as exc:
+            existing["storm"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:800]}
+        print(json.dumps(existing.get("storm"), indent=1))
+        with open(args.out, "w") as f:
+            json.dump(existing, f, indent=1)
+            f.write("\n")
+        return 0 if "error" not in existing["storm"] else 1
 
     record = {"metric": "controller_reconcile_throughput",
               "config": {"jobs": args.jobs, "workers": args.workers,
@@ -296,6 +696,16 @@ def main(argv=None) -> int:
         if cur and base:
             record["vs_baseline"] = round(cur / base, 2)
 
+    # Preserve a previously captured storm section: the legacy churn
+    # record and the storm comparison live side by side in the file.
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            if "storm" in prior:
+                record["storm"] = prior["storm"]
+        except (OSError, ValueError):
+            pass
     print(json.dumps(record))
     with open(args.out, "w") as f:
         json.dump(record, f, indent=1)

@@ -316,7 +316,8 @@ def cmd_events(args) -> int:
 def _parse_metrics_text(text: str) -> dict:
     """Prometheus text exposition -> {family_or_series: float} (labeled
     series keep their label string; the bare family name maps to the
-    last sample seen)."""
+    SUM of its labeled series — e.g. per-shard workqueue counts roll up
+    to the cluster total — or to the sample itself when unlabeled)."""
     out = {}
     for line in text.splitlines():
         line = line.strip()
@@ -328,8 +329,11 @@ def _parse_metrics_text(text: str) -> dict:
         except ValueError:
             continue
         out[name_part] = val
-        family = name_part.partition("{")[0]
-        out[family] = val
+        family, brace, _ = name_part.partition("{")
+        if brace:
+            out[family] = out.get(family, 0.0) + val
+        else:
+            out[family] = val
     return out
 
 

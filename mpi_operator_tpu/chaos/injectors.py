@@ -215,6 +215,51 @@ def inject_watch_relist(ctx, fault):
     return None
 
 
+@register_injector("event_storm")
+def inject_event_storm(ctx, fault):
+    """Shard-skew event storm: aim a burst of no-information MODIFIED
+    events (status.message bumps) at ONE job's pods.  Because the
+    controller routes keys by stable namespace/name hash, the whole
+    storm lands on the single workqueue shard that owns the job — the
+    skew case the priority/fairness layer must absorb without starving
+    that shard's other jobs or tripping any invariant."""
+    target_ns = target_name = None
+    if fault.target:
+        target_ns, _, target_name = fault.target.partition("/")
+    else:
+        jobs = sorted(ctx.server.list("kubeflow.org/v2beta1", "MPIJob"),
+                      key=lambda j: (j.metadata.namespace, j.metadata.name))
+        if not jobs:
+            ctx.log_result(fault, resolved_target="", result="no-candidate")
+            return None
+        pick = ctx.rng.choice(jobs)
+        target_ns = pick.metadata.namespace
+        target_name = pick.metadata.name
+    rounds = int(fault.params.get("rounds", 2))
+    pods = [p for p in ctx.server.list("v1", "Pod", target_ns)
+            if p.metadata.labels.get("training.kubeflow.org/job-name")
+            == target_name]
+    client = ctx.system.client.pods(target_ns)
+    bump = getattr(client, "patch_status", None)
+    for r in range(rounds):
+        for p in sorted(pods, key=lambda p: p.metadata.name):
+            try:
+                if bump is not None:
+                    bump(p.metadata.name,
+                         message=f"chaos-storm-{fault.at}-{r}")
+                else:  # transport without PATCH: read-modify-write
+                    live = client.get(p.metadata.name)
+                    live.status.message = f"chaos-storm-{fault.at}-{r}"
+                    client.update_status(live)
+            except Exception:
+                continue  # pod churned away mid-storm: storm on
+    # Result stays count-free: pod membership during the storm races
+    # gang repair, and the canonical log must replay byte-identically.
+    ctx.log_result(fault, resolved_target=f"{target_ns}/{target_name}",
+                   result=f"storm rounds={rounds}")
+    return None
+
+
 @register_injector("api_error_burst")
 def inject_api_error_burst(ctx, fault):
     """Apiserver brown-out: verbs fail with an ApiError (default

@@ -99,7 +99,8 @@ class FaultPlan:
 # Kinds eligible for randomized soaks (instantaneous or self-healing;
 # params chosen inside safe ranges by `randomized_plan`).
 RANDOMIZABLE_KINDS = ("pod_kill", "pod_delete", "preempt", "watch_relist",
-                      "api_error_burst", "api_latency", "api_partition")
+                      "api_error_burst", "api_latency", "api_partition",
+                      "event_storm")
 
 
 def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
@@ -133,6 +134,10 @@ def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
         elif kind == "watch_relist":
             fault.target = rng.choice(["v1 Pod", "batch/v1 Job",
                                        "kubeflow.org/v2beta1 MPIJob"])
+        elif kind == "event_storm":
+            # Shard-skew: a MODIFIED burst aimed at one job (target
+            # resolved at inject time -> one workqueue shard).
+            fault.params = {"rounds": rng.randint(1, 3)}
         faults.append(fault)
     return FaultPlan(name=name or f"randomized-{seed}", seed=seed,
                      faults=faults)

@@ -13,6 +13,7 @@ like the reference never touches the data plane.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Optional
 
@@ -25,7 +26,8 @@ from ..k8s.apiserver import ApiError, Clientset, is_conflict, is_not_found
 from ..k8s.informers import InformerFactory
 from ..k8s.meta import Clock, deep_copy, get_controller_of
 from ..k8s.selectors import match_label_selector, match_labels
-from ..k8s.workqueue import RateLimitingQueue
+from ..k8s.workqueue import (PRIORITY_HIGH, PRIORITY_LOW,
+                             ShardedRateLimitingQueue)
 from ..telemetry import flight
 from ..telemetry.trace import span
 from . import builders, metrics as metrics_pkg, status as status_pkg
@@ -85,7 +87,9 @@ class MPIJobController:
                  clock: Optional[Clock] = None,
                  cluster_domain: str = "",
                  namespace: Optional[str] = None,
-                 metrics: Optional[dict] = None):
+                 metrics: Optional[dict] = None,
+                 shards: Optional[int] = None,
+                 fair_queueing: Optional[bool] = None):
         self.client = clientset
         self.clock = clock or Clock()
         self.cluster_domain = cluster_domain
@@ -112,9 +116,28 @@ class MPIJobController:
         else:
             self.pod_group_informer = None
 
-        self.queue = RateLimitingQueue()
+        # Sharded workqueue: keys route by stable namespace/name hash to
+        # N independent shards, one sync worker each — no two shards can
+        # ever sync the same job concurrently (docs/PERF.md "Sharded
+        # control plane").  Priority + fairness dispatch inside each
+        # shard keeps 1-pod jobs from starving behind a 10k-pod gang.
+        if shards is None:
+            shards = int(os.environ.get("MPI_OPERATOR_SHARDS", "4") or 4)
+        if fair_queueing is None:
+            fair_queueing = os.environ.get(
+                "MPI_OPERATOR_FAIR_QUEUE", "1").lower() not in ("0", "false")
+        self.queue = ShardedRateLimitingQueue(shards, fair=fair_queueing)
+        # Jobs at or under this worker-pod count enqueue in the
+        # high-priority class (served ahead of gangs, round-robin).
+        self.small_job_pods = int(os.environ.get(
+            "MPI_OPERATOR_SMALL_JOB_PODS", "64") or 64)
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
+        # Shard-routing invariant: keys currently in flight, key -> shard
+        # index.  A key seen in flight on two shards (impossible unless
+        # routing breaks) counts into shard_violations.
+        self._inflight: dict = {}
+        self._inflight_lock = threading.Lock()
         # OrphanPod warnings already emitted, keyed (launcher uid, pod
         # identity): one aggregated event per collision instead of one
         # per sync (the Recorder would otherwise absorb a steady
@@ -156,9 +179,33 @@ class MPIJobController:
         apiserver error burst the event storm (status churn, pod
         flapping) inflates the backoff toward its 1000s cap even though
         no sync failed, so recovery after the burst heals is delayed by
-        minutes.  Event-driven adds go through the plain dedup'd queue;
-        only actual sync errors (_run_worker) pay the failure backoff."""
-        self.queue.add(f"{job.metadata.namespace}/{job.metadata.name}")
+        minutes.  Event-driven adds go through the dedup'd sharded
+        queue (with hot-key coalescing); only actual sync errors
+        (_run_worker) pay the failure backoff."""
+        self.queue.add(f"{job.metadata.namespace}/{job.metadata.name}",
+                       priority=self._priority_of(job))
+
+    def _priority_of(self, job) -> int:
+        """Fairness class by job size: small jobs dispatch ahead of
+        gangs, so a 1-pod job's reconcile latency is bounded by one
+        in-flight sync rather than every queued gang sync."""
+        try:
+            pods = worker_replicas(job) or 0
+        except Exception:
+            return PRIORITY_HIGH
+        return PRIORITY_HIGH if pods <= self.small_job_pods \
+            else PRIORITY_LOW
+
+    def _priority_of_key(self, key: str) -> Optional[int]:
+        """Priority for a bare queue key (failure requeues): the queue
+        retires an item's priority class once it fully drains, so a
+        rate-limited re-add must re-state it or a failing gang would
+        re-enter in the high class, ahead of the small jobs the
+        fairness layer protects.  None (job gone from the cache) lets
+        the queue default apply."""
+        ns, _, name = key.partition("/")
+        job = self.mpi_job_informer.lister.get(ns, name)
+        return self._priority_of(job) if job is not None else None
 
     def handle_object(self, obj) -> None:
         """handleObject (:1262-1312): find the owning MPIJob and enqueue
@@ -193,14 +240,20 @@ class MPIJobController:
     # ------------------------------------------------------------------
     # Run loop
     # ------------------------------------------------------------------
-    def run(self, threadiness: int = 2) -> None:
-        """Run (:465-503): start informers, wait for sync, spawn workers."""
+    def run(self, threadiness: Optional[int] = None) -> None:
+        """Run (:465-503): start informers, wait for sync, spawn ONE
+        sync worker per workqueue shard.  ``threadiness`` (legacy name)
+        sets the shard count — the queue reshards before any worker
+        starts, so the one-worker-per-shard invariant always holds."""
+        if threadiness is not None \
+                and threadiness != self.queue.num_shards:
+            self.queue.reshard(threadiness)
         self.factory.start_all()
         if not self.factory.wait_for_cache_sync():
             raise RuntimeError("failed to wait for caches to sync")
-        for i in range(threadiness):
-            t = threading.Thread(target=self._run_worker, daemon=True,
-                                 name=f"mpijob-worker-{i}")
+        for i in range(self.queue.num_shards):
+            t = threading.Thread(target=self._run_worker, args=(i,),
+                                 daemon=True, name=f"mpijob-shard-{i}")
             t.start()
             self._workers.append(t)
 
@@ -211,20 +264,36 @@ class MPIJobController:
             t.join(timeout=2)
         self.factory.stop_all()
 
-    def _run_worker(self) -> None:
-        """runWorker/processNextWorkItem (:505-561)."""
+    def _run_worker(self, shard: int = 0) -> None:
+        """runWorker/processNextWorkItem (:505-561), bound to one queue
+        shard.  Per-shard sync counters plus the in-flight map prove
+        the routing invariant: the same job never syncs concurrently on
+        two shards."""
+        q = self.queue.shards[shard]
+        label = str(shard)
+        depth = self.metrics.get("workqueue_depth")
+        shard_syncs = self.metrics.get("shard_syncs")
+        violations = self.metrics.get("shard_violations")
         while not self._stop.is_set():
-            key, shutdown = self.queue.get(timeout=0.2)
+            key, shutdown = q.get(timeout=0.2)
             if shutdown:
                 return
             if key is None:
                 continue
-            depth = self.metrics.get("workqueue_depth")
             if depth is not None:
-                depth.observe(len(self.queue))
+                depth.labels(label).observe(len(q))
+            owner = self.queue.shard_for(key)
+            with self._inflight_lock:
+                other = self._inflight.get(key)
+                self._inflight[key] = shard
+            if (other is not None or owner != shard) \
+                    and violations is not None:
+                violations.inc()
+                flight.record("controller", "shard_violation", job=key,
+                              shard=shard, owner=owner, also_on=other)
             try:
                 self._timed_sync(key)
-                self.queue.forget(key)
+                q.forget(key)
             except Exception as exc:  # requeue with backoff
                 if is_conflict(exc):
                     # Expected under informer staleness: the next sync on a
@@ -246,9 +315,14 @@ class MPIJobController:
                             clientset=self.client, namespace=ns,
                             job_name=name,
                             once_key=f"sync-panic-{type(exc).__name__}")
-                self.queue.add_rate_limited(key)
+                q.add_rate_limited(key, priority=self._priority_of_key(key))
             finally:
-                self.queue.done(key)
+                with self._inflight_lock:
+                    if self._inflight.get(key) == shard:
+                        self._inflight.pop(key, None)
+                q.done(key)
+                if shard_syncs is not None:
+                    shard_syncs.labels(label).inc()
 
     def _timed_sync(self, key: str) -> None:
         """sync_handler wrapped in the reconcile-latency histogram and a

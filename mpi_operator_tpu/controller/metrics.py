@@ -58,10 +58,19 @@ def backfill_telemetry_metrics(metrics: dict) -> None:
     metrics.setdefault("reconcile_seconds", registry.histogram(
         "mpi_operator_reconcile_seconds",
         "MPIJob reconcile (sync_handler) latency"))
-    metrics.setdefault("workqueue_depth", registry.histogram(
+    metrics.setdefault("workqueue_depth", registry.histogram_vec(
         "mpi_operator_workqueue_depth",
-        "Workqueue depth observed at each dequeue",
-        buckets=_DEPTH_BUCKETS))
+        "Workqueue depth observed at each dequeue, per shard",
+        ["shard"], buckets=_DEPTH_BUCKETS))
+    metrics.setdefault("shard_syncs", registry.counter_vec(
+        "mpi_operator_shard_sync_total",
+        "Reconciles executed per workqueue shard",
+        ["shard"]))
+    metrics.setdefault("shard_violations", registry.counter(
+        "mpi_operator_shard_cross_sync_violations_total",
+        "Shard-routing invariant violations: a key observed in flight"
+        " on two shards, or dequeued on a shard that does not own it"
+        " (must stay 0)"))
     metrics.setdefault("gang_restarts", registry.counter(
         "mpi_operator_gang_restarts_total",
         "Worker gang restarts triggered by restartPolicy ExitCode"))

@@ -7,6 +7,28 @@ cascade deletion, and client-go-fake-style action recording + reactor
 injection (the reference's unit fixture leans on k8sfake.NewSimpleClientset
 reactors, pkg/controller/mpi_job_controller_test.go:70-213).
 
+Scale architecture (docs/PERF.md "Sharded control plane"):
+
+- **Sharded per-GVK stores**: every (apiVersion, kind) owns a
+  :class:`_KindStore` with its OWN lock, object map, namespace key
+  index, watch list and bounded event history.  Pod churn never
+  contends with MPIJob reads; the old process-wide RLock is gone.
+- **O(1) relationship indexes**: a global uid refcount map and an
+  owner-uid -> children index replace the full-store scans the
+  dangling-owner reap and cascade deletion used to pay per write
+  (O(total objects) per pod create — fatal at 100k pods).
+- **Bounded per-watch fan-out buffers**: each watch stream holds at
+  most ``WATCH_BUFFER`` undelivered events.  A slow consumer overflows
+  ITS OWN buffer — the buffer is dropped and replaced by a single
+  RELIST sentinel (the consumer must relist, exactly the 410 contract)
+  — and event delivery to every other watcher is never blocked.
+- **Single frozen copy per event**: ``_notify`` deep-copies the object
+  ONCE and shares that frozen snapshot between the history ring and
+  every watcher.  Watch events are therefore SHARED immutable
+  snapshots (the informer cache installs them directly); consumers
+  must never mutate them — the tier-1 cache mutation detector enforces
+  this.
+
 In a real deployment the same `Clientset` interface can be backed by an
 HTTP client to kube-apiserver; everything above this module is
 substrate-agnostic.
@@ -14,9 +36,10 @@ substrate-agnostic.
 
 from __future__ import annotations
 
+import queue as _queue
 import threading
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from .meta import Clock, deep_copy, get_controller_of
@@ -26,8 +49,8 @@ ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 # Synthetic client-side event (obj=None): the watch lost replay
-# continuity (410 Expired) and the consumer must relist NOW rather than
-# wait for its periodic resync.  Never sent by the server itself.
+# continuity (410 Expired / buffer overflow) and the consumer must
+# relist NOW rather than wait for its periodic resync.
 RELIST = "RELIST"
 
 
@@ -90,29 +113,89 @@ class WatchEvent:
 
 
 class Watch:
-    """A single watch stream; iterate or poll events."""
+    """A single watch stream with a BOUNDED fan-out buffer.
 
-    def __init__(self, server: "ApiServer", key):
-        import queue
-        self._q: "queue.Queue[WatchEvent]" = queue.Queue()
+    Events arriving while the buffer holds ``maxsize`` undelivered
+    entries overflow THIS stream only: the pending buffer is discarded
+    and replaced by one RELIST sentinel — the consumer must reconcile
+    against a fresh list (client-go's 410 contract).  Until the
+    sentinel is consumed, further events are dropped (the relist covers
+    them).  Event objects are SHARED immutable snapshots — never mutate
+    them."""
+
+    def __init__(self, server: "ApiServer", key,
+                 maxsize: Optional[int] = None):
+        self._q: "_queue.Queue[WatchEvent]" = _queue.Queue()
         self._server = server
         self._key = key
+        self._max = server.WATCH_BUFFER if maxsize is None else maxsize
+        self._olock = threading.Lock()
+        self._overflowed = False
+        self.overflows = 0
+        self.dropped_events = 0
         self.stopped = False
 
     def _send(self, ev: WatchEvent):
-        if not self.stopped:
+        if self.stopped:
+            return
+        with self._olock:
+            if self._overflowed:
+                self.dropped_events += 1
+                return
+            if self._max and ev.type != RELIST \
+                    and self._q.qsize() >= self._max:
+                self._overflowed = True
+                self.overflows += 1
+                self._server.watch_overflows += 1
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except _queue.Empty:
+                    pass
+                self._q.put(WatchEvent(RELIST, None))
+                return
             self._q.put(ev)
 
     def next(self, timeout: float | None = None) -> Optional[WatchEvent]:
-        import queue
         try:
-            return self._q.get(timeout=timeout)
-        except queue.Empty:
+            ev = self._q.get(timeout=timeout)
+        except _queue.Empty:
             return None
+        if ev.type == RELIST:
+            # The consumer is about to relist: resume normal delivery.
+            with self._olock:
+                self._overflowed = False
+        return ev
 
     def stop(self):
         self.stopped = True
         self._server._remove_watch(self._key, self)
+
+
+class _KindStore:
+    """Per-GVK storage shard: its own lock, object map, namespace key
+    index, watch list and bounded event history.  All mutation happens
+    under ``lock``; cross-kind operations (cascade delete, uid lookup)
+    never hold two kind locks at once."""
+
+    __slots__ = ("lock", "objs", "ns_keys", "watches", "history",
+                 "purged_rv")
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.objs: dict = {}      # (namespace, name) -> obj
+        self.ns_keys: dict = {}   # namespace -> {key: True}
+        self.watches: list = []
+        self.history: list = []   # [(event_rv, WatchEvent)] rv-ordered
+        self.purged_rv = 0
+
+    def index_key(self, key) -> None:
+        self.ns_keys.setdefault(key[0], {})[key] = True
+
+    def unindex_key(self, key) -> None:
+        bucket = self.ns_keys.get(key[0])
+        if bucket is not None:
+            bucket.pop(key, None)
 
 
 class ApiServer:
@@ -124,26 +207,25 @@ class ApiServer:
     # (like the real watch cache) so a chatty kind's churn (Pods) cannot
     # expire a quiet kind's resume window and force spurious relists.
     HISTORY_LIMIT = 2048
+    # Max undelivered events per watch stream before the stream
+    # overflows into a RELIST (slow-consumer isolation).
+    WATCH_BUFFER = 8192
 
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()
-        self._lock = threading.RLock()
-        # (api_version, kind) -> {(namespace, name) -> obj}
-        self._store: dict = {}
-        # Namespace pre-filter: (api_version, kind) -> {ns -> {key: True}}
-        # so namespace-scoped List (the informer/resync hot path) walks
-        # one bucket instead of every object of the kind.
-        self._ns_keys: dict = {}
+        self._kinds: dict = {}  # (api_version, kind) -> _KindStore
+        self._kinds_lock = threading.Lock()
         self._rv = 0
-        self._watches: dict = {}  # (api_version, kind) -> [Watch]
-        # gvk -> [(event_rv, WatchEvent)] ordered by rv; every rv bump
-        # emits exactly one event (delete bumps too), so each kind's
-        # window (_purged_rv[gvk]+1 .. _rv] is fully replayable.
-        self._history: dict = {}
-        self._purged_rv: dict = {}
+        self._rv_lock = threading.Lock()
+        # Relationship indexes (guarded by _rel_lock, a leaf lock):
+        # uid -> live-object refcount, owner uid -> {(gvk, key): True}.
+        self._uid_refs: dict = {}
+        self._children: dict = {}
+        self._rel_lock = threading.Lock()
+        self.watch_overflows = 0
         # Chaos hook (chaos/injectors.py): called before every verb with
         # (verb, api_version, kind, namespace, name); may raise ApiError
-        # (error burst) or sleep (latency).  Called OUTSIDE the store
+        # (error burst) or sleep (latency).  Called OUTSIDE any store
         # lock so an injected delay stalls only the calling client, not
         # the whole apiserver.  None = production no-op.
         self.fault_injector = None
@@ -154,6 +236,97 @@ class ApiServer:
         if hook is not None:
             hook(verb, api_version, kind, namespace, name)
 
+    # -- helpers ----------------------------------------------------------
+    def _gvk(self, obj) -> tuple:
+        return (obj.api_version, obj.kind)
+
+    def _kind(self, gvk) -> _KindStore:
+        with self._kinds_lock:
+            ks = self._kinds.get(gvk)
+            if ks is None:
+                ks = self._kinds[gvk] = _KindStore()
+            return ks
+
+    def _kind_items(self) -> list:
+        with self._kinds_lock:
+            return list(self._kinds.items())
+
+    def _next_rv(self) -> str:
+        with self._rv_lock:
+            self._rv += 1
+            return str(self._rv)
+
+    def current_rv(self) -> str:
+        """The store-wide resourceVersion a List response carries."""
+        with self._rv_lock:
+            return str(self._rv)
+
+    # -- relationship indexes ---------------------------------------------
+    def _track(self, gvk, key, obj) -> None:
+        with self._rel_lock:
+            self._track_locked(gvk, key, obj)
+
+    def _untrack(self, gvk, key, obj) -> None:
+        with self._rel_lock:
+            self._untrack_locked(gvk, key, obj)
+
+    def _retrack(self, gvk, key, old, new) -> None:
+        """Swap index entries old -> new ATOMICALLY: an update must never
+        expose a transient refcount of 0 for a live uid, or a concurrent
+        create of an owned object would observe its owner as dangling
+        and spuriously reap the child (`_uid_exists` runs outside the
+        kind locks)."""
+        with self._rel_lock:
+            self._untrack_locked(gvk, key, old)
+            self._track_locked(gvk, key, new)
+
+    def _track_locked(self, gvk, key, obj) -> None:
+        uid = obj.metadata.uid
+        if uid:
+            self._uid_refs[uid] = self._uid_refs.get(uid, 0) + 1
+        ref = get_controller_of(obj)
+        if ref is not None and ref.uid:
+            self._children.setdefault(ref.uid, {})[(gvk, key)] = True
+
+    def _untrack_locked(self, gvk, key, obj) -> None:
+        uid = obj.metadata.uid
+        if uid:
+            n = self._uid_refs.get(uid, 0) - 1
+            if n > 0:
+                self._uid_refs[uid] = n
+            else:
+                self._uid_refs.pop(uid, None)
+        ref = get_controller_of(obj)
+        if ref is not None and ref.uid:
+            bucket = self._children.get(ref.uid)
+            if bucket is not None:
+                bucket.pop((gvk, key), None)
+                if not bucket:
+                    self._children.pop(ref.uid, None)
+
+    def _uid_exists(self, uid: str) -> bool:
+        with self._rel_lock:
+            return self._uid_refs.get(uid, 0) > 0
+
+    # -- watch fan-out -----------------------------------------------------
+    def _notify(self, ks: _KindStore, ev_type: str, obj) -> WatchEvent:
+        """One frozen deep copy per event, shared between the history
+        ring and every watcher (and returned for callers that hand it
+        out).  Caller must hold ``ks.lock``."""
+        frozen = deep_copy(obj)
+        ev = WatchEvent(ev_type, frozen)
+        try:
+            ev_rv = int(obj.metadata.resource_version)
+        except (TypeError, ValueError):
+            with self._rv_lock:
+                ev_rv = self._rv
+        ks.history.append((ev_rv, ev))
+        while len(ks.history) > self.HISTORY_LIMIT:
+            ks.purged_rv = max(ks.purged_rv, ks.history.pop(0)[0])
+        for w in list(ks.watches):
+            w._send(ev)
+        return ev
+
     def relist_watches(self, api_version: Optional[str] = None,
                        kind: Optional[str] = None) -> int:
         """Chaos hook: simulate every live watch stream on the kind (or
@@ -161,71 +334,34 @@ class ApiServer:
         RELIST sentinel (the client-side contract after a 410 Expired)
         and must reconcile against a fresh list.  Returns the number of
         streams signalled."""
-        with self._lock:
-            hit = []
-            for (gv, k), watches in self._watches.items():
-                if api_version is not None and gv != api_version:
-                    continue
-                if kind is not None and k != kind:
-                    continue
-                hit.extend(watches)
+        hit = []
+        for (gv, k), ks in self._kind_items():
+            if api_version is not None and gv != api_version:
+                continue
+            if kind is not None and k != kind:
+                continue
+            with ks.lock:
+                hit.extend(ks.watches)
         for w in hit:
             w._send(WatchEvent(RELIST, None))
         return len(hit)
 
-    # -- helpers ----------------------------------------------------------
-    def _gvk(self, obj) -> tuple:
-        return (obj.api_version, obj.kind)
-
-    def _bucket(self, gvk) -> dict:
-        return self._store.setdefault(gvk, {})
-
-    def _index_key(self, gvk, key) -> None:
-        self._ns_keys.setdefault(gvk, {}).setdefault(key[0], {})[key] = True
-
-    def _unindex_key(self, gvk, key) -> None:
-        bucket = self._ns_keys.get(gvk, {}).get(key[0])
-        if bucket is not None:
-            bucket.pop(key, None)
-
-    def _next_rv(self) -> str:
-        self._rv += 1
-        return str(self._rv)
-
-    def _notify(self, gvk, ev_type: str, obj) -> None:
-        ev = WatchEvent(ev_type, deep_copy(obj))
-        try:
-            ev_rv = int(obj.metadata.resource_version)
-        except (TypeError, ValueError):
-            ev_rv = self._rv
-        hist = self._history.setdefault(gvk, [])
-        hist.append((ev_rv, ev))
-        while len(hist) > self.HISTORY_LIMIT:
-            self._purged_rv[gvk] = max(self._purged_rv.get(gvk, 0),
-                                       hist.pop(0)[0])
-        for w in list(self._watches.get(gvk, [])):
-            w._send(WatchEvent(ev_type, deep_copy(obj)))
-
-    def current_rv(self) -> str:
-        """The store-wide resourceVersion a List response carries."""
-        with self._lock:
-            return str(self._rv)
-
     def _remove_watch(self, gvk, w) -> None:
-        with self._lock:
-            if w in self._watches.get(gvk, []):
-                self._watches[gvk].remove(w)
+        ks = self._kind(gvk)
+        with ks.lock:
+            if w in ks.watches:
+                ks.watches.remove(w)
 
     # -- verbs ------------------------------------------------------------
     def create(self, obj):
         self._inject("create", obj.api_version, obj.kind,
                      obj.metadata.namespace, obj.metadata.name)
-        with self._lock:
-            gvk = self._gvk(obj)
+        gvk = self._gvk(obj)
+        ks = self._kind(gvk)
+        with ks.lock:
             obj = deep_copy(obj)
             key = (obj.metadata.namespace, obj.metadata.name)
-            bucket = self._bucket(gvk)
-            if key in bucket:
+            if key in ks.objs:
                 raise already_exists(obj.kind, obj.metadata.name)
             if not obj.metadata.uid:
                 obj.metadata.uid = str(uuid.uuid4())
@@ -237,36 +373,43 @@ class ApiServer:
                 # unscheduled (e.g. gang-gated) pod must count as active
                 # for Job controllers, not as missing.
                 obj.status.phase = "Pending"
-            bucket[key] = obj
-            self._index_key(gvk, key)
-            self._notify(gvk, ADDED, obj)
+            ks.objs[key] = obj
+            ks.index_key(key)
+            self._track(gvk, key, obj)
+            self._notify(ks, ADDED, obj)
             # The response reflects the object AS CREATED — the reap
             # below must not leak its delete-bumped RV into the return.
             created = deep_copy(obj)
-            # Dangling controller ownerReference: a stale-lister client
-            # can recreate children AFTER their owner was deleted (and
-            # already cascaded).  Real kube's garbage collector reaps
-            # such orphans shortly after; mirror that here, eagerly —
-            # otherwise they leak forever in a store whose GC only runs
-            # at owner-delete time.
             ctrl_ref = get_controller_of(obj)
-            if ctrl_ref is not None and not self._uid_exists(ctrl_ref.uid):
-                dead = bucket.pop(key)
-                self._unindex_key(gvk, key)
-                dead.metadata.resource_version = self._next_rv()
-                self._notify(gvk, DELETED, dead)
-                self._cascade_delete(dead)
-            return created
+        # Dangling controller ownerReference: a stale-lister client can
+        # recreate children AFTER their owner was deleted (and already
+        # cascaded).  Real kube's garbage collector reaps such orphans
+        # shortly after; mirror that here, eagerly — otherwise they leak
+        # forever in a store whose GC only runs at owner-delete time.
+        # (O(1) via the uid index; the old implementation scanned every
+        # object of every kind on every owned create.)
+        if ctrl_ref is not None and not self._uid_exists(ctrl_ref.uid):
+            self._reap(gvk, key, obj)
+        return created
 
-    def _uid_exists(self, uid: str) -> bool:
-        return any(o.metadata.uid == uid
-                   for b in self._store.values() for o in b.values())
+    def _reap(self, gvk, key, inserted) -> None:
+        ks = self._kind(gvk)
+        with ks.lock:
+            cur = ks.objs.get(key)
+            if cur is not inserted:
+                return  # replaced or deleted since the insert
+            ks.objs.pop(key)
+            ks.unindex_key(key)
+            self._untrack(gvk, key, cur)
+            cur.metadata.resource_version = self._next_rv()
+            self._notify(ks, DELETED, cur)
+        self._cascade_delete(cur)
 
     def get(self, api_version: str, kind: str, namespace: str, name: str):
         self._inject("get", api_version, kind, namespace, name)
-        with self._lock:
-            bucket = self._bucket((api_version, kind))
-            obj = bucket.get((namespace, name))
+        ks = self._kind((api_version, kind))
+        with ks.lock:
+            obj = ks.objs.get((namespace, name))
             if obj is None:
                 raise not_found(kind, f"{namespace}/{name}")
             return deep_copy(obj)
@@ -274,20 +417,19 @@ class ApiServer:
     def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
              label_selector: Optional[dict] = None) -> list:
         self._inject("list", api_version, kind, namespace or "")
-        with self._lock:
-            gvk = (api_version, kind)
-            bucket = self._bucket(gvk)
+        ks = self._kind((api_version, kind))
+        with ks.lock:
             if namespace is None:
-                keys = sorted(bucket.keys())
+                keys = sorted(ks.objs.keys())
             else:
                 # Namespace pre-filter: only this namespace's keys are
                 # visited — a chatty foreign namespace costs nothing.
-                keys = sorted(self._ns_keys.get(gvk, {}).get(namespace, ()))
+                keys = sorted(ks.ns_keys.get(namespace, ()))
             out = []
             for key in keys:
-                obj = bucket.get(key)
-                # bucket.get (not []): a stale index key (a future
-                # store-removal site forgetting _unindex_key) degrades
+                obj = ks.objs.get(key)
+                # .get (not []): a stale index key (a future
+                # store-removal site forgetting unindex_key) degrades
                 # to a missing entry instead of 500ing every
                 # namespace-scoped list of the kind.
                 if obj is not None and match_labels(label_selector,
@@ -295,15 +437,28 @@ class ApiServer:
                     out.append(deep_copy(obj))
             return out
 
+    def count(self, api_version: str, kind: str,
+              namespace: Optional[str] = None) -> int:
+        """Object count for a kind (namespace-scoped via the key
+        index) WITHOUT copying anything — the O(1)-ish metadata query
+        retention/pruning paths need (a full ``list`` deep-copies every
+        object: thousands of copies just to learn a length)."""
+        self._inject("count", api_version, kind, namespace or "")
+        ks = self._kind((api_version, kind))
+        with ks.lock:
+            if namespace is None:
+                return len(ks.objs)
+            return len(ks.ns_keys.get(namespace, ()))
+
     def update(self, obj, subresource: str = ""):
         self._inject("update", obj.api_version, obj.kind,
                      obj.metadata.namespace, obj.metadata.name)
-        with self._lock:
-            gvk = self._gvk(obj)
+        gvk = self._gvk(obj)
+        ks = self._kind(gvk)
+        with ks.lock:
             obj = deep_copy(obj)
             key = (obj.metadata.namespace, obj.metadata.name)
-            bucket = self._bucket(gvk)
-            current = bucket.get(key)
+            current = ks.objs.get(key)
             if current is None:
                 raise not_found(obj.kind, obj.metadata.name)
             if (obj.metadata.resource_version
@@ -327,47 +482,90 @@ class ApiServer:
             if obj == current:
                 return deep_copy(current)
             obj.metadata.resource_version = self._next_rv()
-            bucket[key] = obj
-            self._notify(gvk, MODIFIED, obj)
+            ks.objs[key] = obj
+            # Owner references may legally change on a spec update:
+            # keep the relationship indexes in lockstep (atomic swap —
+            # no transient zero refcount for the unchanged uid).
+            self._retrack(gvk, key, current, obj)
+            self._notify(ks, MODIFIED, obj)
             return deep_copy(obj)
+
+    def patch_status(self, api_version: str, kind: str, namespace: str,
+                     name: str, fields: dict):
+        """PATCH on the status subresource: apply ``fields`` to the
+        stored object's ``.status`` (no optimistic-concurrency check —
+        patch semantics), bumping the RV and notifying watchers only
+        when something actually changed.  Returns the event's frozen
+        snapshot — SHARED and immutable, like a watch event."""
+        self._inject("patch", api_version, kind, namespace, name)
+        ks = self._kind((api_version, kind))
+        with ks.lock:
+            key = (namespace, name)
+            current = ks.objs.get(key)
+            if current is None:
+                raise not_found(kind, f"{namespace}/{name}")
+            new = deep_copy(current)
+            for field_name, value in fields.items():
+                setattr(new.status, field_name, deep_copy(value))
+            if new == current:
+                return deep_copy(current)
+            new.metadata.resource_version = self._next_rv()
+            ks.objs[key] = new
+            return self._notify(ks, MODIFIED, new).obj
 
     def delete(self, api_version: str, kind: str, namespace: str, name: str):
         self._inject("delete", api_version, kind, namespace, name)
-        with self._lock:
-            bucket = self._bucket((api_version, kind))
-            obj = bucket.pop((namespace, name), None)
+        gvk = (api_version, kind)
+        ks = self._kind(gvk)
+        with ks.lock:
+            obj = ks.objs.pop((namespace, name), None)
             if obj is None:
                 raise not_found(kind, f"{namespace}/{name}")
-            self._unindex_key((api_version, kind), (namespace, name))
+            ks.unindex_key((namespace, name))
+            self._untrack(gvk, (namespace, name), obj)
             # A real apiserver bumps the RV on delete; the DELETED event
             # carries the new version (required for exact watch replay).
             obj.metadata.resource_version = self._next_rv()
-            self._notify((api_version, kind), DELETED, obj)
-            self._cascade_delete(obj)
-            return deep_copy(obj)
+            self._notify(ks, DELETED, obj)
+        self._cascade_delete(obj)
+        return deep_copy(obj)
 
     def _cascade_delete(self, owner) -> None:
         """Owner-reference garbage collection: deleting an owner removes
-        objects whose controller ownerReference uid matches (standard k8s GC;
-        the reference relies on it for Service/ConfigMap/Secret cleanup)."""
+        objects whose controller ownerReference uid matches (standard k8s
+        GC; the reference relies on it for Service/ConfigMap/Secret
+        cleanup).  Children come from the owner-uid index — O(children),
+        never a store scan — and no two kind locks are ever held at
+        once."""
         owner_uid = owner.metadata.uid
-        for gvk in list(self._store.keys()):
-            bucket = self._store[gvk]
-            for key in [k for k, o in bucket.items()
-                        if any(ref.uid == owner_uid and ref.controller
-                               for ref in o.metadata.owner_references)]:
-                dead = bucket.pop(key)
-                self._unindex_key(gvk, key)
+        with self._rel_lock:
+            children = list(self._children.get(owner_uid, ()))
+        dead_list = []
+        for gvk, key in children:
+            ks = self._kind(gvk)
+            with ks.lock:
+                o = ks.objs.get(key)
+                if o is None:
+                    continue
+                ref = get_controller_of(o)
+                if ref is None or ref.uid != owner_uid or not ref.controller:
+                    continue
+                ks.objs.pop(key)
+                ks.unindex_key(key)
+                self._untrack(gvk, key, o)
                 # Same RV bump as a direct delete: every DELETED event
                 # must carry a fresh RV or watch-history replay (and a
                 # live client's resume RV) would rewind to the object's
                 # stale last-write version.
-                dead.metadata.resource_version = self._next_rv()
-                self._notify(gvk, DELETED, dead)
-                self._cascade_delete(dead)
+                o.metadata.resource_version = self._next_rv()
+                self._notify(ks, DELETED, o)
+                dead_list.append(o)
+        for dead in dead_list:
+            self._cascade_delete(dead)
 
     def watch(self, api_version: str, kind: str,
-              resource_version: Optional[str] = None) -> Watch:
+              resource_version: Optional[str] = None,
+              buffer: Optional[int] = None) -> Watch:
         """Open a watch stream.
 
         ``resource_version`` None/""/"0" starts from now (events only
@@ -376,18 +574,21 @@ class ApiServer:
         dropped in between), matching apiserver watch-cache semantics;
         an RV older than the retained window raises 410 Expired
         (``ApiError("Expired")``) so clients exercise their relist path.
+        ``buffer`` overrides the per-stream fan-out bound
+        (``WATCH_BUFFER``); 0 disables it.
         """
-        with self._lock:
-            gvk = (api_version, kind)
-            w = Watch(self, gvk)
+        gvk = (api_version, kind)
+        ks = self._kind(gvk)
+        with ks.lock:
+            w = Watch(self, gvk, maxsize=buffer)
             if resource_version not in (None, "", "0"):
                 rv = int(resource_version)
-                if rv < self._purged_rv.get(gvk, 0):
+                if rv < ks.purged_rv:
                     raise expired(kind, resource_version)
-                for ev_rv, ev in self._history.get(gvk, []):
+                for ev_rv, ev in ks.history:
                     if ev_rv > rv:
-                        w._send(WatchEvent(ev.type, deep_copy(ev.obj)))
-            self._watches.setdefault(gvk, []).append(w)
+                        w._send(ev)
+            ks.watches.append(w)
             return w
 
 
@@ -431,6 +632,15 @@ class ResourceClient:
                         obj.metadata.name, obj, subresource="status")
         return self._invoke(action,
                             lambda: self._cs.server.update(obj, "status"))
+
+    def patch_status(self, name: str, **fields):
+        """Apply status-field updates without a read-modify-write round
+        trip (PATCH semantics: no resourceVersion conflict).  Returns a
+        SHARED frozen snapshot — treat as immutable."""
+        action = Action("patch", self.kind, self.namespace, name, fields,
+                        subresource="status")
+        return self._invoke(action, lambda: self._cs.server.patch_status(
+            self.api_version, self.kind, self.namespace, name, fields))
 
     def delete(self, name: str):
         action = Action("delete", self.kind, self.namespace, name)

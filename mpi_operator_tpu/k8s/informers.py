@@ -34,8 +34,8 @@ import os
 import threading
 from typing import Callable, Optional
 
-from .apiserver import (ADDED, DELETED, MODIFIED, RELIST, ApiServer,
-                        Clientset)
+from .apiserver import (ADDED, DELETED, MODIFIED, RELIST, ApiError,
+                        ApiServer, Clientset)
 from .meta import deep_copy, get_controller_of
 from .selectors import match_labels
 
@@ -361,23 +361,58 @@ class Lister:
         return self.by_index("ownerless", namespace, copy=copy)
 
 
+def _rv_newer(new_rv, old_rv) -> bool:
+    """True when ``new_rv`` supersedes ``old_rv`` (numeric compare with
+    a != fallback for non-numeric RVs)."""
+    try:
+        return int(new_rv) > int(old_rv)
+    except (TypeError, ValueError):
+        return new_rv != old_rv
+
+
+def _rv_at_most(rv, max_rv) -> bool:
+    """True when ``rv`` is within the relist snapshot's horizon
+    (``max_rv`` None = horizon unknown: treat everything as covered,
+    the pre-incremental behavior)."""
+    if max_rv is None:
+        return True
+    try:
+        return int(rv) <= max_rv
+    except (TypeError, ValueError):
+        return True
+
+
 class SharedInformer:
     # Periodic relist+diff: heals missed watch events (stream gaps,
     # reconnects) the way client-go's resync does.  The relist is
     # diffed against the cache by resourceVersion — only real changes
     # dispatch (suppressions counted in
     # mpi_operator_resync_dispatches_suppressed_total).
+    #
+    # The diff is BOUNDED AND INCREMENTAL: the run loop processes at
+    # most RESYNC_BATCH relist entries per iteration, interleaved with
+    # watch events, instead of a stop-the-world pass over the whole
+    # cache (at 100k pods one full diff under the lock starves every
+    # reader for seconds).  RV guards keep interleaved watch events
+    # safe: a key is only installed from the relist snapshot when the
+    # snapshot's RV supersedes the cached one, and a cache entry absent
+    # from the snapshot is only removed when its RV predates the
+    # snapshot (anything newer arrived via watch after the list).
     RESYNC_INTERVAL = 30.0
+    RESYNC_BATCH = 512
 
     def __init__(self, clientset: Clientset, api_version: str, kind: str,
                  namespace: Optional[str] = None,
-                 resync_interval: Optional[float] = None):
+                 resync_interval: Optional[float] = None,
+                 resync_batch: Optional[int] = None):
         self._cs = clientset
         self.api_version = api_version
         self.kind = kind
         self.namespace = namespace
         self.resync_interval = (resync_interval if resync_interval is not None
                                 else self.RESYNC_INTERVAL)
+        self.resync_batch = (resync_batch if resync_batch is not None
+                             else self.RESYNC_BATCH)
         self._lock = threading.RLock()
         self._store: Indexer = Indexer()
         self.lister = Lister(self._store, self._lock)
@@ -387,6 +422,7 @@ class SharedInformer:
         self._stopped = threading.Event()
         self.synced = False
         self.resync_suppressed = 0
+        self._resync_session: Optional[dict] = None
 
     def add_index_func(self, name: str, fn: Callable) -> None:
         """Register a pluggable index function (client-go AddIndexers)."""
@@ -443,13 +479,17 @@ class SharedInformer:
         import time
         last_resync = time.monotonic()
         while not self._stopped.is_set():
-            ev = self._watch.next(timeout=0.1)
+            # When a resync session is draining, poll (don't park) so
+            # the session keeps making progress on a quiet stream.
+            timeout = 0.005 if self._resync_session is not None else 0.1
+            ev = self._watch.next(timeout=timeout)
             if ev is not None and ev.type == RELIST:
-                # The watch lost replay continuity (410 Expired): relist
-                # immediately — events in the gap are otherwise invisible
-                # until the periodic resync (client-go relists at once).
+                # The watch lost replay continuity (410 Expired /
+                # fan-out buffer overflow): start a fresh relist session
+                # NOW — events in the gap are otherwise invisible until
+                # the periodic resync (client-go relists at once).
                 try:
-                    self._resync()
+                    self._begin_resync()
                     last_resync = time.monotonic()
                 except Exception:
                     # Relist failed (API briefly unreachable — often the
@@ -466,16 +506,27 @@ class SharedInformer:
                                    == self.namespace):
                 obj = ev.obj
                 key = (obj.metadata.namespace, obj.metadata.name)
+                # An active resync session must see live watch traffic:
+                # a key deleted mid-session may still sit in the pending
+                # relist deque (re-installing it would resurrect a ghost
+                # until the NEXT resync), and a key installed mid-session
+                # is live no matter what the stale sweep's horizon says.
+                # The run loop is the only thread touching the session,
+                # so plain set mutation is safe.
+                session = self._resync_session
+                if session is not None:
+                    session["deleted" if ev.type == DELETED
+                            else "installed"].add(key)
                 try:
                     with self._lock:
                         old = self._store.get(key)
                         if ev.type == DELETED:
                             self._store.pop(key, None)
                         else:
-                            # The watch event object is this stream's
-                            # private copy (apiserver deep-copies per
-                            # watch): install it as the shared
-                            # snapshot, no further copy.
+                            # The watch event object is a frozen shared
+                            # snapshot (the apiserver copies once per
+                            # event): install it as the cache snapshot,
+                            # no further copy.
                             self._store[key] = obj
                 except Exception:
                     # A per-object install failure (index fn bug) must
@@ -483,34 +534,100 @@ class SharedInformer:
                     # the stale RV lets the periodic resync retry.
                     continue
                 self._dispatch(ev.type, old, obj)
-            if self.resync_interval and \
+            if self._resync_session is not None:
+                try:
+                    self._resync_step(self.resync_batch)
+                except Exception:
+                    # A raising handler must not kill the watch thread;
+                    # drop the session — the next periodic resync
+                    # retries from a fresh relist.
+                    self._resync_session = None
+            elif self.resync_interval and \
                     time.monotonic() - last_resync >= self.resync_interval:
                 last_resync = time.monotonic()
                 try:
-                    self._resync()
+                    self._begin_resync()
                 except Exception:
                     pass  # transient API failure; next interval retries
 
     def _resync(self) -> None:
-        """Relist and reconcile the cache with the store, dispatching
-        ONLY the implied real events (heals watch-stream gaps).
+        """Full relist+diff, run to completion (RELIST recovery in
+        not-yet-started informers, tests, and callers that need the
+        cache settled NOW).  The run loop instead drains the same
+        session incrementally via :meth:`_resync_step`."""
+        self._begin_resync()
+        while self._resync_step(None):
+            pass
+
+    def _begin_resync(self) -> None:
+        """Open a resync session: one relist, whose diff against the
+        cache is then consumed in bounded batches.
 
         Entries whose resourceVersion matches the cached snapshot are
         left untouched — the shared snapshot keeps its identity, no
         handler fires, and the suppression is counted.  The original
         implementation re-dispatched every object on every 30s resync,
         turning a quiet 1000-pod cluster into a permanent event storm."""
+        from collections import deque
+        server = self._cs.server
+        # The snapshot horizon is the server's resourceVersion (NOT the
+        # max listed object RV — deletions bump the store RV without
+        # leaving an object behind).  Read BEFORE the list so the
+        # horizon can only understate it: a cache entry newer than the
+        # horizon arrived via watch after the list and must survive
+        # this session's stale sweep.  Transports without current_rv
+        # get horizon None: every absent key is removable, the
+        # pre-incremental behavior.
+        max_rv = None
+        current_rv = getattr(server, "current_rv", None)
+        if current_rv is not None:
+            try:
+                max_rv = int(current_rv())
+            except (TypeError, ValueError, ApiError):
+                max_rv = None
         current = {(o.metadata.namespace, o.metadata.name): o
-                   for o in self._cs.server.list(self.api_version, self.kind,
-                                                 self.namespace)}
+                   for o in server.list(self.api_version, self.kind,
+                                        self.namespace)}
+        self._resync_session = {
+            "keys": set(current),
+            "pending": deque(current.items()),
+            "max_rv": max_rv,
+            # Watch traffic observed while the session drains (fed by
+            # the run loop): keys deleted mid-session must not be
+            # re-installed from their stale relist entry, and keys
+            # installed mid-session are live regardless of the sweep
+            # horizon (the only safety net when max_rv is unknown).
+            "deleted": set(),
+            "installed": set(),
+        }
+
+    def _resync_step(self, batch: Optional[int]) -> bool:
+        """Process up to ``batch`` relist entries (None = all); on the
+        final step, remove cache entries the relist no longer contains.
+        Returns True while the session still has work."""
+        session = self._resync_session
+        if session is None:
+            return False
+        pending = session["pending"]
+        n = len(pending) if batch is None else min(batch, len(pending))
         suppressed = 0
+        updates = []
+        removed = []
         with self._lock:
-            stale_keys = [k for k in self._store if k not in current]
-            updates = []
-            for key, obj in current.items():
+            for _ in range(n):
+                key, obj = pending.popleft()
+                if key in session["deleted"]:
+                    # Deleted via watch after the relist snapshot:
+                    # installing the stale entry would resurrect a
+                    # ghost object until the NEXT resync.
+                    suppressed += 1
+                    continue
                 old = self._store.get(key)
-                if old is not None and old.metadata.resource_version \
-                        == obj.metadata.resource_version:
+                if old is not None and not _rv_newer(
+                        obj.metadata.resource_version,
+                        old.metadata.resource_version):
+                    # Cache already at (or past — a fresher watch event
+                    # landed mid-session) the snapshot's version.
                     suppressed += 1
                     continue
                 try:
@@ -522,7 +639,22 @@ class SharedInformer:
                     # instead of the suppression path hiding it forever.
                     continue
                 updates.append((old, obj))
-            removed = [self._store.pop(k) for k in stale_keys]
+            if not pending:
+                # Stale keys: cached but absent from the relist — and
+                # old enough that the relist MUST have seen them (a
+                # higher RV means the object was created via watch
+                # after the list; the next resync will judge it).
+                # Keys installed via watch mid-session are live by
+                # definition — the only guard on transports without a
+                # current_rv horizon.
+                for key in [k for k in self._store
+                            if k not in session["keys"]
+                            and k not in session["installed"]]:
+                    if _rv_at_most(
+                            self._store[key].metadata.resource_version,
+                            session["max_rv"]):
+                        removed.append(self._store.pop(key))
+                self._resync_session = None
             self.resync_suppressed += suppressed
         if suppressed:
             _COUNTERS["resync_suppressed"].inc(suppressed)
@@ -530,6 +662,7 @@ class SharedInformer:
             self._dispatch(ADDED if old is None else MODIFIED, old, obj)
         for obj in removed:
             self._dispatch(DELETED, None, obj)
+        return self._resync_session is not None
 
     def stop(self) -> None:
         self._stopped.set()

@@ -480,22 +480,29 @@ def test_e2e_gang_restart_recovers_job(tmp_path):
     """RestartPolicy=ExitCode slice repair, live: one worker dies with a
     retryable code (SIGTERM-style 143), the controller restarts the WHOLE
     worker gang, and the job still completes."""
+    # Markers are PER POD (K_POD_NAME): with a shared marker, whichever
+    # worker starts a few ms late finds it already present and plays its
+    # "second life" on its FIRST run — the launcher then completes the
+    # job with no gang restart ever happening (flaky on a loaded host).
+    # Per-pod markers make every worker's first life deterministically
+    # exit 143, so a second-life file can only mean that pod ran twice.
     marker = str(tmp_path / "already-failed")
     second_life = str(tmp_path / "second-life")
     worker_script = (
         "import os, sys, time\n"
-        "if not os.path.exists(%r):\n"
-        "    open(%r, 'w').close()\n"
+        "me = os.environ['K_POD_NAME']\n"
+        "if not os.path.exists(%r + '-' + me):\n"
+        "    open(%r + '-' + me, 'w').close()\n"
         "    sys.exit(143)\n"   # first life: retryable failure
-        "open(%r, 'w').close()\n"  # second life: the restarted gang
+        "open(%r + '-' + me, 'w').close()\n"  # second life: restarted gang
         "time.sleep(60)\n" % (marker, marker, second_life))
-    # The launcher gates job completion on the SECOND generation running,
+    # The launcher gates job completion on a SECOND generation running,
     # so by success the gang restart has demonstrably happened.
     launcher_script = (
-        "import os, time\n"
+        "import glob, time\n"
         "deadline = time.monotonic() + 60\n"
         "while time.monotonic() < deadline:\n"
-        "    if os.path.exists(%r):\n"
+        "    if glob.glob(%r + '-*'):\n"
         "        print('LAUNCHER-SAW-RESTART'); raise SystemExit(0)\n"
         "    time.sleep(0.2)\n"
         "raise SystemExit(1)\n" % second_life)
@@ -517,7 +524,8 @@ def test_e2e_gang_restart_recovers_job(tmp_path):
     # the restarted (second-generation) gang demonstrably ran: its marker
     # exists, and job success was gated on it (pods themselves may already
     # be reaped by cleanPodPolicy after success)
-    assert os.path.exists(second_life)
+    import glob
+    assert glob.glob(second_life + "-*")
 
 
 def test_e2e_unsatisfiable_gang_surfaces_workers_gated():
