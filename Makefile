@@ -6,7 +6,7 @@ PYTHON ?= python
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
-	train-bench-smoke
+	train-bench-smoke serve-fleet-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -59,6 +59,14 @@ controller-shard-smoke:
 # (counter-asserted), and a ticks/sec floor holds (docs/PERF.md).
 serve-bench-smoke:
 	$(PYTHON) tools/serve_bench_smoke.py
+
+# Serving fleet (< 60s, CPU): 3-replica ServeJob behind the prefix-aware
+# router under mixed load — routed streams byte-identical to direct
+# serving, fleet prefix-hit-rate floor held, zero lost requests
+# (counter-asserted), and a queue-driven autoscaler up-then-down
+# transition observed (docs/PERF.md "Serving fleet").
+serve-fleet-smoke:
+	$(PYTHON) tools/serve_fleet_smoke.py
 
 # Train hot path (< 60s, CPU): overlapped loop (async dispatch +
 # prefetch + async checkpointing) holds a steps/s floor with ZERO

@@ -1,0 +1,371 @@
+#!/usr/bin/env python
+"""Serving-fleet bench: prefix-aware routing vs round-robin over the
+same ServeJob fleet (ISSUE 8, docs/PERF.md "Serving fleet").
+
+Workload model — the fleet-scale version of the "shared system prompt"
+pattern: T tenants, each with its own multi-page system prompt; every
+request is one tenant's prompt plus a short unique user suffix.  The
+fleet's aggregate prefix-cache capacity can hold all T prompts
+PARTITIONED across replicas (~T/N each), but no single replica can hold
+all T.  Prefix-aware routing keeps each tenant on the replica that
+caches its prompt (prefilling only the suffix); round-robin sprays
+tenants everywhere, so every replica churns the full tenant set through
+an undersized cache — eviction thrash plus full-prompt prefills.
+
+Load is mixed open/closed-loop: C closed-loop streaming clients (next
+request after the previous completes) plus a seeded open-loop arrival
+process at R req/s — the open-loop side is what exposes queueing
+collapse (p99 TTFT) when placement wastes prefill capacity.
+
+Replicas run REAL batchers (tiny llama, paged KV, prefix cache) with
+injected per-token prefill latency and per-tick decode latency held
+under the device lock — on the single-core bench host this makes
+placement/cache effects dominate instead of GIL contention
+(serving/batcher.py DECODE_LATENCY_ENV/PREFILL_TOKEN_LATENCY_ENV; the
+knobs model accelerator occupancy, and time.sleep overlaps across
+replica threads where tiny-model XLA compute would serialize).
+
+Routed token streams are byte-checked against a standalone replica
+(same model, greedy), and the fleet prefix-hit tokens are
+counter-asserted from ``mpi_operator_serve_prefix_*``.
+
+Usage:
+  python bench_serve_fleet.py --smoke          # < 60s sanity run
+  python bench_serve_fleet.py                  # full sweep -> JSON
+  knobs: --replicas --tenants --prefix-tokens --max-new --closed
+         --open-rate --duration --warmup --out
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PAGE = 16
+
+
+def build_model(jax, jnp, max_seq_len):
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = LlamaConfig(vocab_size=512, dim=32, n_layers=1, n_heads=1,
+                      n_kv_heads=1, max_seq_len=max_seq_len)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def make_servejob(replicas):
+    from mpi_operator_tpu.api.types import ServeJob, ServeJobSpec
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    return ServeJob(
+        metadata=ObjectMeta(name="bench", namespace="default"),
+        spec=ServeJobSpec(
+            replicas=replicas,
+            template=PodTemplateSpec(spec=PodSpec(containers=[
+                Container(name="replica", image="local")]))))
+
+
+def stream_request(url, payload, timeout=600):
+    """One streaming request; returns (t_submit, ttft, n_tokens,
+    t_done, tokens) or raises."""
+    hostport = url.split("//")[1]
+    host, _, port = hostport.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    t0 = time.perf_counter()
+    conn.request("POST", "/generate",
+                 body=json.dumps(dict(payload, stream=True)).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    ttft = None
+    toks = []
+    err = None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if line.startswith(b"data: "):
+            ev = json.loads(line[6:])
+            if "token" in ev:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                toks.append(ev["token"])
+            elif "error" in ev:
+                err = ev["error"]
+                break
+            elif ev.get("done"):
+                break
+    conn.close()
+    if err is not None:
+        raise RuntimeError(err)
+    return t0, ttft, len(toks), time.perf_counter(), toks
+
+
+class Workload:
+    """Seeded shared-system-prompt request generator."""
+
+    def __init__(self, cfg, tenants, prefix_tokens, max_new, seed=41):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        self.max_new = max_new
+        self.prefixes = [
+            list(map(int, rng.integers(1, cfg.vocab_size, prefix_tokens)))
+            for _ in range(tenants)]
+        self._rng = np.random.default_rng(seed + 1)
+        self._lock = threading.Lock()
+
+    def next_payload(self):
+        with self._lock:
+            t = int(self._rng.integers(0, len(self.prefixes)))
+            suffix = list(map(int, self._rng.integers(
+                1, 500, int(self._rng.integers(2, 8)))))
+        return {"tokens": [self.prefixes[t] + suffix],
+                "max_new_tokens": self.max_new, "session": f"tenant{t}"}
+
+
+def run_policy(policy, args, jax, jnp):
+    from mpi_operator_tpu.serving import InferenceServer, LocalServeFleet
+    max_seq = ((args.prefix_tokens + 8 + args.max_new + PAGE - 1)
+               // PAGE + 1) * PAGE
+    cfg, model, variables = build_model(jax, jnp, max_seq)
+    prefix_blocks = args.prefix_tokens // PAGE
+    budget_blocks = -(-(args.prefix_tokens + 8 + args.max_new) // PAGE)
+    # Fleet-wide capacity holds the tenant set PARTITIONED (~T/N
+    # prompts per replica) but one replica cannot hold all T: the
+    # regime where placement decides whether the cache works at all.
+    cache_blocks = (args.slots * budget_blocks
+                    + (args.tenants * prefix_blocks) // args.replicas
+                    + prefix_blocks)
+    os.environ[
+        "MPI_OPERATOR_SERVE_DECODE_LATENCY"] = str(args.decode_latency)
+    os.environ["MPI_OPERATOR_SERVE_PREFILL_TOKEN_LATENCY"] = \
+        str(args.prefill_token_latency)
+
+    def factory(pod):
+        return InferenceServer(model, variables,
+                               max_batch_slots=args.slots,
+                               kv_page_size=PAGE,
+                               kv_cache_blocks=cache_blocks)
+
+    workload = Workload(cfg, args.tenants, args.prefix_tokens,
+                        args.max_new)
+    completions = []   # (t_submit, ttft, n_tokens, t_done)
+    comp_lock = threading.Lock()
+    errors = []
+    stop = threading.Event()
+
+    def record(rec):
+        with comp_lock:
+            completions.append(rec[:4])
+
+    with LocalServeFleet(make_servejob(args.replicas),
+                         server_factory=factory,
+                         policy=policy) as fleet:
+        fleet.wait_ready(args.replicas, timeout=120)
+        # Warmup/compile: one request per tenant (primes placement).
+        for t in range(args.tenants):
+            p = {"tokens": [workload.prefixes[t] + [9, 9]],
+                 "max_new_tokens": 2, "session": f"tenant{t}"}
+            stream_request(fleet.router.url, p)
+
+        def closed_loop():
+            while not stop.is_set():
+                try:
+                    record(stream_request(fleet.router.url,
+                                          workload.next_payload()))
+                except Exception as exc:
+                    if not stop.is_set():
+                        errors.append(repr(exc))
+
+        def open_loop():
+            """Seeded arrival process at --open-rate req/s; outstanding
+            bounded so a collapsing config queues rather than forking
+            unbounded threads."""
+            import numpy as np
+            rng = np.random.default_rng(97)
+            sem = threading.Semaphore(args.open_outstanding)
+
+            def fire():
+                try:
+                    record(stream_request(fleet.router.url,
+                                          workload.next_payload()))
+                except Exception as exc:
+                    if not stop.is_set():
+                        errors.append(repr(exc))
+                finally:
+                    sem.release()
+
+            while not stop.is_set():
+                time.sleep(float(rng.exponential(1.0 / args.open_rate)))
+                if stop.is_set():
+                    break
+                if sem.acquire(blocking=False):
+                    threading.Thread(target=fire, daemon=True).start()
+
+        threads = [threading.Thread(target=closed_loop)
+                   for _ in range(args.closed)]
+        threads.append(threading.Thread(target=open_loop))
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(args.warmup + args.duration)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        t_end = time.perf_counter()
+
+        # Byte-identity: replay a fixed sample directly.
+        sample = [{"tokens": [workload.prefixes[t] + [7, t + 1]],
+                   "max_new_tokens": args.max_new}
+                  for t in range(min(4, args.tenants))]
+        routed_out = [stream_request(fleet.router.url, dict(p))[-1]
+                      for p in sample]
+        direct_srv = InferenceServer(
+            model, variables, max_batch_slots=args.slots,
+            kv_page_size=PAGE, kv_cache_blocks=cache_blocks).start()
+        try:
+            direct_out = [stream_request(direct_srv.url, dict(p))[-1]
+                          for p in sample]
+        finally:
+            direct_srv.stop()
+        identical = routed_out == direct_out
+
+        stats = fleet.fleet_prefix_stats()
+        tm = fleet.router.telemetry
+        paths = {k[0]: v.value for k, v in
+                 tm["routed_total"]._children.items()}
+        lost = tm["requests_lost_total"].value
+
+    # Steady-state window: [t_start + warmup, stop].
+    import numpy as np
+    w0 = t_start + args.warmup
+    w1 = t_end
+    window = [c for c in completions if c[0] >= w0 and c[3] <= w1]
+    ttfts = np.array([c[1] for c in window if c[1] is not None])
+    tokens = sum(c[2] for c in window)
+    secs = w1 - w0
+    offered_prefix_tokens = stats["lookups"] * (
+        args.prefix_tokens // PAGE) * PAGE
+    return {
+        "policy": policy,
+        "requests_completed": len(window),
+        "tokens_per_s": round(tokens / secs, 2),
+        "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4)
+        if len(ttfts) else None,
+        "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 4)
+        if len(ttfts) else None,
+        "fleet_prefix_hit_tokens": stats["hit_tokens"],
+        "fleet_prefix_hit_rate": round(
+            stats["hit_tokens"] / max(1, offered_prefix_tokens), 3),
+        "prefix_evictions": stats["evicted"],
+        "routed_paths": paths,
+        "router_retries": tm["retries_total"].value,
+        "router_lost": lost,
+        "streams_byte_identical_to_direct": identical,
+        "errors": len(errors),
+        "cache_blocks_per_replica": cache_blocks,
+        "window_seconds": round(secs, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--replicas", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=4,
+                    help="batcher slots per replica")
+    ap.add_argument("--tenants", type=int, default=16)
+    ap.add_argument("--prefix-tokens", type=int, default=128)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--closed", type=int, default=8,
+                    help="closed-loop client threads")
+    ap.add_argument("--open-rate", type=float, default=20.0,
+                    help="open-loop arrivals per second")
+    ap.add_argument("--open-outstanding", type=int, default=48)
+    ap.add_argument("--duration", type=float, default=90.0)
+    ap.add_argument("--warmup", type=float, default=10.0)
+    ap.add_argument("--decode-latency", type=float, default=0.002,
+                    help="injected per-tick decode occupancy (s)")
+    ap.add_argument("--prefill-token-latency", type=float,
+                    default=0.0005,
+                    help="injected per-prefilled-token occupancy (s)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced-size sanity run (< 60s)")
+    ap.add_argument("--out", default="BENCH_SERVE_FLEET.json")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.replicas, args.tenants = 3, 9
+        args.prefix_tokens, args.max_new = 64, 8
+        args.closed, args.open_rate = 4, 8.0
+        args.duration, args.warmup = 8.0, 3.0
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    results = {}
+    for policy in ("round_robin", "prefix"):
+        print(f"bench_serve_fleet: running policy={policy} "
+              f"({args.replicas} replicas, {args.tenants} tenants, "
+              f"{args.duration}s window)...", flush=True)
+        results[policy] = run_policy(policy, args, jax, jnp)
+        print(json.dumps(results[policy], indent=2), flush=True)
+
+    rr, pf = results["round_robin"], results["prefix"]
+    speedup = pf["tokens_per_s"] / max(0.01, rr["tokens_per_s"])
+    p99_ratio = (rr["ttft_p99_s"] / pf["ttft_p99_s"]
+                 if rr["ttft_p99_s"] and pf["ttft_p99_s"] else None)
+    report = {
+        "bench": "serve_fleet",
+        "host": "single-core CPU sim (injected-latency replicas)",
+        "workload": {
+            "replicas": args.replicas, "slots": args.slots,
+            "tenants": args.tenants,
+            "prefix_tokens": args.prefix_tokens,
+            "max_new_tokens": args.max_new,
+            "closed_loop_clients": args.closed,
+            "open_loop_rate_per_s": args.open_rate,
+            "duration_s": args.duration,
+            "decode_latency_s": args.decode_latency,
+            "prefill_token_latency_s": args.prefill_token_latency,
+            "page_size": PAGE,
+        },
+        "round_robin": rr,
+        "prefix_aware": pf,
+        "speedup_tokens_per_s": round(speedup, 2),
+        "p99_ttft_improvement": round(p99_ratio, 2) if p99_ratio else None,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"bench_serve_fleet: tokens/s {rr['tokens_per_s']} -> "
+          f"{pf['tokens_per_s']} ({speedup:.2f}x), p99 TTFT "
+          f"{rr['ttft_p99_s']}s -> {pf['ttft_p99_s']}s "
+          f"({p99_ratio and round(p99_ratio, 2)}x better); "
+          f"hit rate {rr['fleet_prefix_hit_rate']} -> "
+          f"{pf['fleet_prefix_hit_rate']}; wrote {args.out}")
+    ok = (pf["streams_byte_identical_to_direct"]
+          and rr["streams_byte_identical_to_direct"]
+          and pf["router_lost"] == 0 and rr["router_lost"] == 0)
+    if not ok:
+        print("bench_serve_fleet: FAIL (identity or lost-request check)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,29 @@ MEGASCALE_NUM_SLICES_ENV = "MEGASCALE_NUM_SLICES"
 MEGASCALE_SLICE_ID_ENV = "MEGASCALE_SLICE_ID"
 DEFAULT_MEGASCALE_PORT = 8477
 
+# --- ServeJob (inference fleet) -----------------------------------------
+# No reference counterpart (the reference is training-only): a ServeJob
+# is reconciled into N InferenceServer replica pods behind the fleet
+# router (serving/router.py) — see docs/PERF.md "Serving fleet".
+SERVE_KIND = "ServeJob"
+SERVE_GROUP_VERSION = GROUP_VERSION  # kubeflow.org/v2beta1, like MPIJob
+
+REPLICA_TYPE_SERVE = "Serve"
+
+# Serve-replica pod labels: job-name/replica-index reuse the training
+# label keys; the template hash drives rolling replica replacement.
+SERVE_TEMPLATE_HASH_LABEL = "serving.kubeflow.org/template-hash"
+# Replica runners publish the live HTTP endpoint here once the server
+# binds; the router discovers endpoints from Ready pods' annotations.
+SERVE_URL_ANNOTATION = "serving.kubeflow.org/url"
+
+# ServeJob condition types (Deployment-flavored: the replica set is a
+# rolling surface, not a run-to-completion gang).
+SERVE_AVAILABLE = "Available"
+SERVE_PROGRESSING = "Progressing"
+
+DEFAULT_SERVE_REPLICAS = 1
+
 # GKE TPU scheduling surface (workers request chips instead of GPUs).
 TPU_RESOURCE = "google.com/tpu"
 GKE_TPU_TOPOLOGY_NODE_SELECTOR = "cloud.google.com/gke-tpu-topology"

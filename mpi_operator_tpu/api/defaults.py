@@ -10,7 +10,7 @@ Never.
 from __future__ import annotations
 
 from . import constants
-from .types import MPIJob, ReplicaSpec
+from .types import MPIJob, ReplicaSpec, ServeJob
 
 
 def _set_defaults_launcher(spec: ReplicaSpec | None) -> None:
@@ -47,4 +47,14 @@ def set_defaults_mpijob(job: MPIJob) -> MPIJob:
         job.spec.launcher_creation_policy = constants.LAUNCHER_CREATION_AT_STARTUP
     _set_defaults_launcher(job.spec.mpi_replica_specs.get(constants.REPLICA_TYPE_LAUNCHER))
     _set_defaults_worker(job.spec.mpi_replica_specs.get(constants.REPLICA_TYPE_WORKER))
+    return job
+
+
+def set_defaults_servejob(job: ServeJob) -> ServeJob:
+    """ServeJob defaulting (mutates and returns `job`): one replica.
+    Inverted autoscale bounds are NOT repaired here — that is
+    validation's job (validate_servejob), and silently raising
+    max_replicas would let a fleet scale past the user's declared cap."""
+    if job.spec.replicas is None:
+        job.spec.replicas = constants.DEFAULT_SERVE_REPLICAS
     return job

@@ -117,6 +117,79 @@ class MPIJob:
         return self.spec.mpi_replica_specs.get(constants.REPLICA_TYPE_WORKER)
 
 
+# ---------------------------------------------------------------------------
+# ServeJob — inference as a first-class operator workload (no reference
+# counterpart; the reference is training-only).  A ServeJob is reconciled
+# into N long-running InferenceServer replica pods with readiness gating
+# and rolling replacement; the fleet router (serving/router.py) load
+# balances across Ready replicas and the autoscaler steers the replica
+# count through ``status.desired_replicas`` so the controller owns all
+# actuation (docs/PERF.md "Serving fleet").
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ServeAutoscaleSpec:
+    """Queue-driven autoscaling bounds + targets.  The autoscaler
+    (serving/autoscaler.py) observes queue-depth/TTFT telemetry and
+    writes ``status.desired_replicas``; the controller clamps it into
+    [min_replicas, max_replicas] before acting."""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # Mean queued requests per replica above which the fleet scales up,
+    # and at/below which (sustained) it scales down.
+    target_queue_depth: float = 4.0
+    scale_down_queue_depth: float = 0.5
+    # Optional TTFT SLO (seconds): a p99 above this also scales up.
+    ttft_p99_slo_seconds: Optional[float] = None
+
+
+@dataclass
+class ServeJobSpec:
+    replicas: Optional[int] = None
+    template: "PodTemplateSpec" = field(default_factory=PodTemplateSpec)
+    autoscale: Optional[ServeAutoscaleSpec] = None
+
+
+@dataclass
+class ServeJobStatus:
+    conditions: List[JobCondition] = field(default_factory=list)
+    # Observed counts over pods of the CURRENT template hash plus any
+    # stale survivors (replicas), Ready pods (ready_replicas) and
+    # current-hash pods (updated_replicas) — Deployment-style.
+    replicas: int = 0
+    ready_replicas: int = 0
+    updated_replicas: int = 0
+    # Autoscaler-steered target; None = follow spec.replicas.  Written
+    # via the status subresource so scaling is auditable and the
+    # controller remains the single actuator.
+    desired_replicas: Optional[int] = None
+    scaling_reason: str = ""
+    template_hash: str = ""
+
+
+@dataclass
+class ServeJob:
+    api_version: str = constants.SERVE_GROUP_VERSION
+    kind: str = constants.SERVE_KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServeJobSpec = field(default_factory=ServeJobSpec)
+    status: ServeJobStatus = field(default_factory=ServeJobStatus)
+
+
+def serve_effective_replicas(job: ServeJob) -> int:
+    """The replica count the controller acts on: the autoscaler's
+    ``status.desired_replicas`` clamped into the autoscale bounds, else
+    ``spec.replicas``.  Without an autoscale block the status field is
+    ignored — nothing but the spec may scale a fixed fleet."""
+    base = job.spec.replicas or 0
+    auto = job.spec.autoscale
+    if auto is None or job.status.desired_replicas is None:
+        return base
+    return max(auto.min_replicas,
+               min(auto.max_replicas, job.status.desired_replicas))
+
+
 def worker_replicas(job: MPIJob) -> int:
     spec = job.worker_spec
     if spec is not None and spec.replicas is not None:

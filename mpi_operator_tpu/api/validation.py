@@ -12,7 +12,7 @@ import re
 from dataclasses import dataclass
 
 from . import constants
-from .types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy
+from .types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy, ServeJob
 
 _DNS1035_RE = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
 _DNS1035_MAX_LEN = 63
@@ -102,6 +102,46 @@ def _validate_spec(spec: MPIJobSpec, path: str) -> list[FieldError]:
                 f"{path}.slices",
                 f"worker replicas ({workers}) must be divisible by slices"
                 f" ({spec.slices})"))
+    return errs
+
+
+def validate_servejob(job: ServeJob) -> list[FieldError]:
+    """ServeJob validation: worst-case replica pod name must be a valid
+    DNS-1035 label (same guarantee the MPIJob name check gives worker
+    hostnames), replica counts sane, autoscale bounds ordered."""
+    errs: list[FieldError] = []
+    replicas = max(job.spec.replicas or 1,
+                   (job.spec.autoscale.max_replicas
+                    if job.spec.autoscale is not None else 1))
+    max_hostname = f"{job.metadata.name}-serve-{replicas - 1}"
+    name_errs = is_dns1035_label(max_hostname)
+    if name_errs:
+        errs.append(FieldError(
+            "metadata.name",
+            f"will not be able to create replica pod with invalid DNS "
+            f"label {max_hostname!r}: " + ", ".join(name_errs)))
+    if job.spec.replicas is not None and job.spec.replicas < 0:
+        errs.append(FieldError("spec.replicas",
+                               "must be greater than or equal to 0"))
+    if not job.spec.template.spec.containers:
+        errs.append(FieldError("spec.template.spec.containers",
+                               "must define at least one container"))
+    auto = job.spec.autoscale
+    if auto is not None:
+        if auto.min_replicas < 0:
+            errs.append(FieldError("spec.autoscale.minReplicas",
+                                   "must be greater than or equal to 0"))
+        if auto.max_replicas < auto.min_replicas:
+            errs.append(FieldError(
+                "spec.autoscale.maxReplicas",
+                f"must be >= minReplicas ({auto.min_replicas})"))
+        if auto.target_queue_depth <= 0:
+            errs.append(FieldError("spec.autoscale.targetQueueDepth",
+                                   "must be greater than 0"))
+        if auto.scale_down_queue_depth >= auto.target_queue_depth:
+            errs.append(FieldError(
+                "spec.autoscale.scaleDownQueueDepth",
+                "must be below targetQueueDepth (hysteresis band)"))
     return errs
 
 

@@ -32,5 +32,7 @@ from .injectors import INJECTORS, register_injector  # noqa: F401
 from .invariants import (DEFAULT_INVARIANTS, checkpoint_intact,  # noqa: F401
                          gang_restarts_bounded, jobs_converged,
                          no_leaked_pod_ips, no_orphaned_pods,
-                         no_orphaned_runners, workqueue_idle)
-from .plan import Fault, FaultPlan, randomized_plan  # noqa: F401
+                         no_orphaned_runners, serve_requests_intact,
+                         workqueue_idle)
+from .plan import (Fault, FaultPlan, FLEET_RANDOMIZABLE_KINDS,  # noqa: F401
+                   randomized_plan)

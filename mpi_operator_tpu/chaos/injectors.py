@@ -159,6 +159,42 @@ def inject_pod_kill(ctx, fault):
     return None
 
 
+@register_injector("replica_kill")
+def inject_replica_kill(ctx, fault):
+    """Kill a serving-fleet replica (abrupt process death): the
+    replica's batcher is poisoned so in-flight requests fail loudly,
+    /healthz flips 503, the pod goes Failed — the fleet router must
+    complete every in-flight request via EXACTLY one retry on a healthy
+    replica (zero lost, zero duplicated streams; the
+    serve_requests_intact invariant counter-asserts it) while the
+    ServeJob controller replaces the replica."""
+    fleet = getattr(ctx.system, "runner", None)
+    if fleet is None or not hasattr(ctx.system, "kill_replica"):
+        ctx.log_result(fault, resolved_target="", result="no-fleet")
+        return None
+    if fault.target:
+        ns, _, name = fault.target.partition("/")
+        target = (ns, name) if name else ("default", ns)
+    else:
+        from ..api import constants
+        serve = [p for p in ctx.server.list("v1", "Pod")
+                 if p.metadata.labels.get(constants.REPLICA_TYPE_LABEL)
+                 == constants.REPLICA_TYPE_SERVE.lower()
+                 and p.status.phase == "Running"]
+        candidates = sorted(serve, key=lambda p: (p.metadata.namespace,
+                                                  p.metadata.name))
+        if not candidates:
+            ctx.log_result(fault, resolved_target="",
+                           result="no-candidate")
+            return None
+        pick = ctx.rng.choice(candidates)
+        target = (pick.metadata.namespace, pick.metadata.name)
+    ok = ctx.system.kill_replica(*target)
+    ctx.log_result(fault, resolved_target="/".join(target),
+                   result="killed" if ok else "no-replica")
+    return None
+
+
 @register_injector("pod_delete")
 def inject_pod_delete(ctx, fault):
     """Delete the pod object through the API (eviction/drain analogue):

@@ -99,9 +99,24 @@ def workqueue_idle(system) -> List[str]:
         if depth else []
 
 
+def serve_requests_intact(system) -> List[str]:
+    """Serving-fleet delivery invariant (replica_kill scenarios): no
+    request is ever lost — an in-flight request on a killed replica
+    completes via exactly one retry on a healthy one, so the router's
+    lost counter must stay 0 (retries are expected and separately
+    counted)."""
+    router = getattr(system, "router", None)
+    if router is None:
+        return []
+    lost = router.telemetry["requests_lost_total"].value
+    return [f"fleet router lost {int(lost)} request(s) "
+            f"(retry contract broken)"] if lost else []
+
+
 DEFAULT_INVARIANTS = (no_orphaned_runners, no_leaked_pod_ips,
                       no_orphaned_pods, gang_restarts_bounded,
-                      jobs_converged, workqueue_idle)
+                      jobs_converged, workqueue_idle,
+                      serve_requests_intact)
 
 
 def checkpoint_intact(directory: str) -> List[str]:

@@ -102,6 +102,11 @@ RANDOMIZABLE_KINDS = ("pod_kill", "pod_delete", "preempt", "watch_relist",
                       "api_error_burst", "api_latency", "api_partition",
                       "event_storm")
 
+# Serving-fleet soaks add replica_kill (the injector no-ops with a
+# logged "no-fleet" against systems without a fleet).  Kept out of the
+# default tuple so existing seeds keep deriving the same plans.
+FLEET_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + ("replica_kill",)
+
 
 def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
                     kinds=RANDOMIZABLE_KINDS,
@@ -138,6 +143,10 @@ def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
             # Shard-skew: a MODIFIED burst aimed at one job (target
             # resolved at inject time -> one workqueue shard).
             fault.params = {"rounds": rng.randint(1, 3)}
+        elif kind == "replica_kill":
+            # Target resolved at inject time against the live fleet's
+            # Running serve replicas (empty target = RNG pick).
+            fault.params = {}
         faults.append(fault)
     return FaultPlan(name=name or f"randomized-{seed}", seed=seed,
                      faults=faults)

@@ -2,3 +2,4 @@
 /root/reference/pkg/controller)."""
 
 from .controller import MPIJobController  # noqa: F401
+from .servejob import ServeJobController  # noqa: F401

@@ -143,6 +143,12 @@ class MPIJobController:
         # per sync (the Recorder would otherwise absorb a steady
         # re-emission every reconcile).
         self._orphan_warned: set = set()
+        # Foreign-kind sync handlers sharing this controller's sharded
+        # queue (serve + train jobs coexist on one fair control plane):
+        # keys of the form "<prefix>:<ns>/<name>" dispatch to the
+        # registered handler instead of sync_handler.  MPIJob keys never
+        # contain ":", so the namespaces cannot collide.
+        self._kind_handlers: dict = {}
 
         # Event handlers (:392-457): MPIJob changes enqueue directly; owned
         # objects route through handle_object.
@@ -203,6 +209,12 @@ class MPIJobController:
         re-enter in the high class, ahead of the small jobs the
         fairness layer protects.  None (job gone from the cache) lets
         the queue default apply."""
+        prefix, sep, _ = key.partition(":")
+        if sep and prefix in self._kind_handlers:
+            # Registered foreign kinds (ServeJobs) are small and
+            # latency-sensitive; their controllers enqueue HIGH, and a
+            # failure requeue must not demote them behind gang syncs.
+            return PRIORITY_HIGH
         ns, _, name = key.partition("/")
         job = self.mpi_job_informer.lister.get(ns, name)
         return self._priority_of(job) if job is not None else None
@@ -324,16 +336,30 @@ class MPIJobController:
                 if shard_syncs is not None:
                     shard_syncs.labels(label).inc()
 
+    def register_kind_handler(self, prefix: str, handler) -> None:
+        """Let another controller (e.g. ServeJobController) ride this
+        controller's sharded workqueue: its keys enqueue as
+        "<prefix>:<ns>/<name>" and sync through `handler`."""
+        if ":" in prefix or "/" in prefix:
+            raise ValueError(f"invalid kind prefix {prefix!r}")
+        self._kind_handlers[prefix] = handler
+
     def _timed_sync(self, key: str) -> None:
         """sync_handler wrapped in the reconcile-latency histogram and a
-        trace span (errors land on the span before the requeue path)."""
+        trace span (errors land on the span before the requeue path).
+        Prefixed keys dispatch to their registered foreign-kind handler
+        (register_kind_handler)."""
         hist = self.metrics.get("reconcile_seconds")
+        handler = self.sync_handler
+        prefix, sep, rest = key.partition(":")
+        if sep and prefix in self._kind_handlers:
+            handler, key = self._kind_handlers[prefix], rest
         with span("reconcile", job=key):
             if hist is not None:
                 with hist.time():
-                    self.sync_handler(key)
+                    handler(key)
             else:
-                self.sync_handler(key)
+                handler(key)
 
     # ------------------------------------------------------------------
     # The sync

@@ -1,0 +1,372 @@
+"""ServeJobController — reconcile a ServeJob into N serving replica pods.
+
+Inference as a first-class operator workload (no reference counterpart;
+the reference is training-only): a ServeJob's template is stamped into
+``<name>-serve-<i>`` replica pods with
+
+- **readiness gating**: the Available condition tracks Ready replicas,
+  so the router only ever discovers replicas whose server actually
+  binds (the replica runner flips Ready after the HTTP endpoint is up);
+- **rolling replacement**: a template change computes a new template
+  hash; stale-hash pods are replaced ONE at a time, and only while every
+  other in-range replica is Ready (maxUnavailable=1), so a config roll
+  never drops the fleet below N-1 serving replicas;
+- **failure replacement**: Failed replicas are deleted and recreated
+  (serving replicas always restart — there is no run-to-completion);
+- **autoscaler actuation**: the queue-driven autoscaler
+  (serving/autoscaler.py) writes ``status.desired_replicas`` through the
+  status subresource; this controller clamps it into the spec's
+  autoscale bounds and owns every pod create/delete — scaling is a
+  status write, never a side channel.
+
+The controller can run standalone (own sharded workqueue + workers) or
+ride an MPIJobController's queue via ``mpi_controller=`` (keys enqueue
+as ``serve:<ns>/<name>`` through `register_kind_handler`), so serve and
+train jobs coexist on one fair, sharded control plane (docs/PERF.md
+"Sharded control plane").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+from typing import Optional
+
+from ..api import constants
+from ..api.defaults import set_defaults_servejob
+from ..api.types import ServeJob, serve_effective_replicas
+from ..api.validation import validate_servejob
+from ..k8s import core
+from ..k8s.apiserver import (Clientset, is_already_exists, is_conflict,
+                             is_not_found)
+from ..k8s.core import Pod, pod_running_and_ready
+from ..k8s.informers import InformerFactory
+from ..k8s.meta import Clock, ObjectMeta, deep_copy, new_controller_ref, to_dict
+from ..k8s.selectors import match_labels
+from ..k8s.workqueue import PRIORITY_HIGH, ShardedRateLimitingQueue
+from ..telemetry import flight
+from .controller import VALIDATION_ERROR, truncate_message
+from .events import Recorder
+from .status import set_condition, new_condition
+
+logger = logging.getLogger("mpi_operator_tpu.controller.servejob")
+
+SERVE_KEY_PREFIX = "serve"
+
+SERVE_AVAILABLE_REASON = "ReplicasReady"
+SERVE_PROGRESSING_REASON = "ReplicaSetProgressing"
+SERVE_SCALED_REASON = "FleetScaled"
+
+
+def serve_template_hash(job: ServeJob) -> str:
+    """Stable content hash of the pod template; drives rolling replica
+    replacement (a changed template changes the hash, stale-hash pods
+    are rolled)."""
+    wire = json.dumps(to_dict(job.spec.template), sort_keys=True,
+                      default=str)
+    return hashlib.blake2b(wire.encode(), digest_size=5).hexdigest()
+
+
+def replica_name(job: ServeJob, index: int) -> str:
+    return f"{job.metadata.name}-serve-{index}"
+
+
+def serve_selector(job_name: str) -> dict:
+    return {constants.JOB_NAME_LABEL: job_name,
+            constants.REPLICA_TYPE_LABEL:
+                constants.REPLICA_TYPE_SERVE.lower()}
+
+
+def new_replica_pod(job: ServeJob, index: int, template_hash: str) -> Pod:
+    template = job.spec.template
+    labels = dict(template.metadata.labels or {})
+    labels.update(serve_selector(job.metadata.name))
+    labels[constants.REPLICA_INDEX_LABEL] = str(index)
+    labels[constants.SERVE_TEMPLATE_HASH_LABEL] = template_hash
+    labels[constants.OPERATOR_NAME_LABEL] = constants.OPERATOR_NAME
+    return Pod(
+        metadata=ObjectMeta(
+            name=replica_name(job, index),
+            namespace=job.metadata.namespace,
+            labels=labels,
+            annotations=dict(template.metadata.annotations or {}),
+            owner_references=[new_controller_ref(
+                job, constants.SERVE_GROUP_VERSION, constants.SERVE_KIND)]),
+        spec=deep_copy(template.spec))
+
+
+class ServeJobController:
+    def __init__(self, clientset: Clientset,
+                 informer_factory: Optional[InformerFactory] = None,
+                 recorder=None, clock: Optional[Clock] = None,
+                 namespace: Optional[str] = None,
+                 metrics_registry=None,
+                 shards: Optional[int] = None,
+                 mpi_controller=None):
+        self.client = clientset
+        self.clock = clock or Clock()
+        self.namespace = namespace
+        from ..telemetry.metrics import Registry
+        self.registry = metrics_registry or Registry()
+        self.metrics = {
+            "registry": self.registry,
+            "syncs": self.registry.counter(
+                "mpi_operator_servejob_syncs_total",
+                "ServeJob reconcile passes"),
+            "replicas_desired": self.registry.gauge(
+                "mpi_operator_servejob_replicas_desired",
+                "Effective replica target of the last reconcile"
+                " (autoscaler-steered, bound-clamped)"),
+            "replicas_ready": self.registry.gauge(
+                "mpi_operator_servejob_replicas_ready",
+                "Ready serving replicas at the last reconcile"),
+            "rolled_replicas": self.registry.counter(
+                "mpi_operator_servejob_replicas_rolled_total",
+                "Stale-template replicas replaced by the rolling"
+                " update path"),
+        }
+        self.recorder = recorder or Recorder(clientset,
+                                             registry=self.registry)
+        factory = informer_factory or InformerFactory(clientset, namespace)
+        self.factory = factory
+        self.serve_job_informer = factory.serve_jobs()
+        self.pod_informer = factory.pods()
+
+        # Queue: shared (ride the MPIJob controller's sharded fair
+        # queue; serve keys carry the "serve:" prefix) or standalone.
+        self._mpi_controller = mpi_controller
+        if mpi_controller is not None:
+            mpi_controller.register_kind_handler(SERVE_KEY_PREFIX,
+                                                 self.sync_handler)
+            self.queue = mpi_controller.queue
+        else:
+            if shards is None:
+                shards = int(os.environ.get("MPI_OPERATOR_SHARDS", "2")
+                             or 2)
+            self.queue = ShardedRateLimitingQueue(shards)
+        self._workers: list = []
+        self._stop = threading.Event()
+
+        self.serve_job_informer.add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=lambda obj: None)
+        self.pod_informer.add_event_handler(
+            on_add=self._handle_pod,
+            on_update=lambda old, new: self._handle_pod(new),
+            on_delete=self._handle_pod)
+
+    # -- queue plumbing ----------------------------------------------------
+    def _key(self, namespace: str, name: str) -> str:
+        return (f"{SERVE_KEY_PREFIX}:{namespace}/{name}"
+                if self._mpi_controller is not None
+                else f"{namespace}/{name}")
+
+    def enqueue(self, job) -> None:
+        self.queue.add(
+            self._key(job.metadata.namespace, job.metadata.name),
+            priority=PRIORITY_HIGH)
+
+    def _handle_pod(self, pod) -> None:
+        for ref in pod.metadata.owner_references:
+            if ref.controller and ref.kind == constants.SERVE_KIND:
+                job = self.serve_job_informer.lister.get(
+                    pod.metadata.namespace, ref.name)
+                if job is not None:
+                    self.enqueue(job)
+                return
+
+    # -- run loop ----------------------------------------------------------
+    def run(self) -> None:
+        self.factory.start_all()
+        if not self.factory.wait_for_cache_sync():
+            raise RuntimeError("failed to wait for caches to sync")
+        if self._mpi_controller is not None:
+            return  # the MPIJob controller's shard workers drive us
+        for i in range(self.queue.num_shards):
+            t = threading.Thread(target=self._run_worker, args=(i,),
+                                 daemon=True, name=f"servejob-shard-{i}")
+            t.start()
+            self._workers.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._mpi_controller is None:
+            self.queue.shutdown()
+        for t in self._workers:
+            t.join(timeout=2)
+        self.factory.stop_all()
+
+    def _run_worker(self, shard: int) -> None:
+        q = self.queue.shards[shard]
+        while not self._stop.is_set():
+            key, shutdown = q.get(timeout=0.2)
+            if shutdown:
+                return
+            if key is None:
+                continue
+            try:
+                self.sync_handler(key)
+                q.forget(key)
+            except Exception as exc:
+                if is_conflict(exc):
+                    logger.debug("conflict syncing %s, requeueing", key)
+                else:
+                    logger.warning("error syncing ServeJob %s: %s",
+                                   key, exc)
+                    flight.record("controller", "sync_error", job=key,
+                                  error=f"{type(exc).__name__}: {exc}")
+                q.add_rate_limited(key)
+            finally:
+                q.done(key)
+
+    # -- the sync ----------------------------------------------------------
+    def _replica_pods(self, job: ServeJob) -> list:
+        """Owned serving pods (shared cache snapshots — never mutate),
+        from the owner-uid index bucket."""
+        selector = serve_selector(job.metadata.name)
+        return [p for p in self.pod_informer.lister.by_owner(
+                    job.metadata.uid)
+                if match_labels(selector, p.metadata.labels)]
+
+    @staticmethod
+    def _index_of(pod) -> Optional[int]:
+        try:
+            return int(pod.metadata.labels.get(
+                constants.REPLICA_INDEX_LABEL, ""))
+        except ValueError:
+            return None
+
+    def sync_handler(self, key: str) -> None:
+        namespace, _, name = key.partition("/")
+        shared = self.serve_job_informer.lister.get(namespace, name)
+        if shared is None:
+            logger.debug("ServeJob has been deleted: %s", key)
+            return
+        self.metrics["syncs"].inc()
+        job = deep_copy(shared)
+        set_defaults_servejob(job)
+        pristine_status = deep_copy(job.status)
+        if job.metadata.deletion_timestamp is not None:
+            return
+        errs = validate_servejob(job)
+        if errs:
+            self.recorder.event(
+                job, core.EVENT_TYPE_WARNING, VALIDATION_ERROR,
+                truncate_message("Found validation errors: "
+                                 + "; ".join(map(str, errs))))
+            return  # do not requeue
+
+        desired = serve_effective_replicas(job)
+        template_hash = serve_template_hash(job)
+        self.metrics["replicas_desired"].set(desired)
+
+        pods = self._replica_pods(job)
+        in_range: dict = {}
+        for pod in pods:
+            idx = self._index_of(pod)
+            if idx is None or idx >= desired:
+                # Scale-down (or an unparseable index: not ours to keep).
+                self._delete_pod(pod)
+                continue
+            in_range[idx] = pod
+
+        # Failed replicas restart unconditionally: delete, then the
+        # create loop below recreates the index in this same sync (the
+        # in-process DELETE is synchronous, so the create gets a fresh
+        # uid — which the uid-keyed replica runner relies on to swap
+        # servers).
+        for idx, pod in list(in_range.items()):
+            if pod.status.phase == core.POD_FAILED:
+                self._delete_pod(pod)
+                self.recorder.eventf(
+                    job, core.EVENT_TYPE_NORMAL, "ReplicaRestart",
+                    "replica %s failed; recreating", pod.metadata.name)
+                del in_range[idx]
+
+        # Rolling replacement, maxUnavailable=1: replace ONE stale-hash
+        # pod per sync, and only while every other in-range replica is
+        # Ready — a template roll never takes the fleet below N-1.
+        stale = sorted(
+            idx for idx, pod in in_range.items()
+            if pod.metadata.labels.get(constants.SERVE_TEMPLATE_HASH_LABEL)
+            != template_hash)
+        if stale and len(in_range) == desired:
+            victim = stale[0]
+            others_ready = all(pod_running_and_ready(pod)
+                               for idx, pod in in_range.items()
+                               if idx != victim)
+            if others_ready:
+                self._delete_pod(in_range[victim])
+                del in_range[victim]
+                self.metrics["rolled_replicas"].inc()
+                self.recorder.eventf(
+                    job, core.EVENT_TYPE_NORMAL, "ReplicaRollout",
+                    "rolling replica %d to template %s", victim,
+                    template_hash)
+
+        for idx in range(desired):
+            if idx not in in_range:
+                try:
+                    in_range[idx] = self.client.pods(namespace).create(
+                        new_replica_pod(job, idx, template_hash))
+                except Exception as exc:
+                    if not is_already_exists(exc):
+                        raise
+                    # Informer staleness: a prior sync's create has not
+                    # landed in the cache yet; the watch event re-syncs.
+                    continue
+
+        ready = sum(1 for pod in in_range.values()
+                    if pod_running_and_ready(pod))
+        updated = sum(
+            1 for pod in in_range.values()
+            if pod.metadata.labels.get(constants.SERVE_TEMPLATE_HASH_LABEL)
+            == template_hash)
+        self.metrics["replicas_ready"].set(ready)
+
+        job.status.replicas = len(in_range)
+        job.status.updated_replicas = updated
+        job.status.template_hash = template_hash
+        if job.status.ready_replicas != ready and desired > 0:
+            self.recorder.eventf(
+                job, core.EVENT_TYPE_NORMAL, SERVE_SCALED_REASON,
+                "%d/%d replicas ready", ready, desired)
+        job.status.ready_replicas = ready
+        available = desired > 0 and ready >= desired
+        set_condition(job.status, new_condition(
+            constants.SERVE_AVAILABLE,
+            core.CONDITION_TRUE if available else core.CONDITION_FALSE,
+            SERVE_AVAILABLE_REASON,
+            f"{ready}/{desired} replicas ready", self.clock))
+        progressing = ready < desired or updated < desired \
+            or len(in_range) != desired
+        set_condition(job.status, new_condition(
+            constants.SERVE_PROGRESSING,
+            core.CONDITION_TRUE if progressing else core.CONDITION_FALSE,
+            SERVE_PROGRESSING_REASON,
+            f"{updated}/{desired} replicas at template {template_hash}",
+            self.clock))
+
+        if job.status != pristine_status:
+            self._update_status(job)
+
+    def _delete_pod(self, pod) -> None:
+        try:
+            self.client.pods(pod.metadata.namespace).delete(
+                pod.metadata.name)
+        except Exception as exc:
+            if not is_not_found(exc):
+                raise
+
+    def _update_status(self, job: ServeJob) -> None:
+        """Client-side no-op suppression, like the MPIJob controller's
+        _update_status: unchanged status skips the round-trip."""
+        cached = self.serve_job_informer.lister.get(
+            job.metadata.namespace, job.metadata.name)
+        if cached is not None and cached.status == job.status:
+            return
+        self.client.serve_jobs(job.metadata.namespace).update_status(job)

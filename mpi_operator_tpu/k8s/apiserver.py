@@ -711,6 +711,9 @@ class Clientset:
     def mpi_jobs(self, ns: str) -> ResourceClient:
         return ResourceClient(self, "kubeflow.org/v2beta1", "MPIJob", ns)
 
+    def serve_jobs(self, ns: str) -> ResourceClient:
+        return ResourceClient(self, "kubeflow.org/v2beta1", "ServeJob", ns)
+
     def volcano_pod_groups(self, ns: str) -> ResourceClient:
         from .scheduling import VOLCANO_API_VERSION
         return ResourceClient(self, VOLCANO_API_VERSION, "PodGroup", ns)

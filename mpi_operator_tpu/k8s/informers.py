@@ -706,6 +706,9 @@ class InformerFactory:
     def mpi_jobs(self) -> SharedInformer:
         return self.informer("kubeflow.org/v2beta1", "MPIJob")
 
+    def serve_jobs(self) -> SharedInformer:
+        return self.informer("kubeflow.org/v2beta1", "ServeJob")
+
     def volcano_pod_groups(self) -> SharedInformer:
         from .scheduling import VOLCANO_API_VERSION
         return self.informer(VOLCANO_API_VERSION, "PodGroup")

@@ -50,6 +50,7 @@ _RESOURCES = {
     ("v1", "Event"): "events",
     ("batch/v1", "Job"): "jobs",
     ("kubeflow.org/v2beta1", "MPIJob"): "mpijobs",
+    ("kubeflow.org/v2beta1", "ServeJob"): "servejobs",
     ("scheduling.volcano.sh/v1beta1", "PodGroup"): "podgroups",
     ("scheduling.x-k8s.io/v1alpha1", "PodGroup"): "podgroups",
     ("coordination.k8s.io/v1", "Lease"): "leases",

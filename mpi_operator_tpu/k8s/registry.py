@@ -13,7 +13,7 @@ from .meta import from_dict, to_dict
 
 
 def _kinds() -> dict:
-    from ..api.types import MPIJob
+    from ..api.types import MPIJob, ServeJob
     from . import batch, core, scheduling
     from ..server.leader_election import Lease
 
@@ -25,6 +25,7 @@ def _kinds() -> dict:
         ("v1", "Event"): core.Event,
         ("batch/v1", "Job"): batch.Job,
         ("kubeflow.org/v2beta1", "MPIJob"): MPIJob,
+        ("kubeflow.org/v2beta1", "ServeJob"): ServeJob,
         (scheduling.VOLCANO_API_VERSION, "PodGroup"):
             scheduling.VolcanoPodGroup,
         (scheduling.SCHED_PLUGINS_API_VERSION, "PodGroup"):

@@ -1,4 +1,8 @@
-"""Model serving over the KV-cache decode path."""
+"""Model serving over the KV-cache decode path, and the fleet layer
+(router + autoscaler + replica runner) that scales it horizontally."""
 
 from .batcher import ContinuousBatcher  # noqa: F401
 from .server import InferenceServer  # noqa: F401
+from .router import FleetRouter  # noqa: F401
+from .autoscaler import ServeAutoscaler  # noqa: F401
+from .fleet import LocalServeFleet, ServeReplicaRunner  # noqa: F401

@@ -1,0 +1,187 @@
+"""Queue-driven fleet autoscaler with hysteresis.
+
+Observes the router's per-replica load (queued + in-flight requests,
+the same queue-depth signal `serving_queue_depth` exports) and the
+router TTFT histogram, and steers the ServeJob's replica count by
+writing ``status.desired_replicas`` through the status subresource —
+the ServeJobController owns ALL actuation (pod create/delete), so a
+scaling decision is an auditable status write, never a side channel.
+
+Hysteresis, so the fleet neither flaps nor reacts to one bursty poll:
+
+- **up**: mean queued-per-replica above ``target_queue_depth`` (or TTFT
+  p99 over the optional SLO) for ``up_stable`` consecutive polls adds
+  one replica;
+- **down**: mean queued-per-replica at/below ``scale_down_queue_depth``
+  for ``down_stable`` consecutive polls removes one replica — the down
+  window is the longer one, since a too-eager scale-down immediately
+  re-pays a replica cold start.
+
+Bounds come from the ServeJob's ``spec.autoscale``
+(min_replicas/max_replicas); the controller clamps again on its side,
+so even a buggy or stale status write cannot scale past the spec.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..k8s.apiserver import Clientset
+
+
+def histogram_quantile(snapshot: dict, q: float) -> float:
+    """Quantile from a cumulative-bucket histogram snapshot
+    (telemetry.metrics.Histogram.snapshot form): the upper bound of the
+    first bucket whose cumulative count covers the quantile."""
+    total = snapshot.get("count", 0)
+    if total <= 0:
+        return 0.0
+    need = q * total
+    for bound, cum in snapshot["buckets"].items():
+        if cum >= need:
+            return float(bound)
+    return float(max(snapshot["buckets"]))
+
+
+class ServeAutoscaler:
+    """Polls ``router.replica_stats()`` and writes the ServeJob's
+    ``status.desired_replicas``."""
+
+    def __init__(self, clientset: Clientset, namespace: str, name: str,
+                 router, poll_interval: float = 0.5,
+                 up_stable: int = 2, down_stable: int = 4):
+        self.client = clientset
+        self.namespace = namespace
+        self.name = name
+        self.router = router
+        self.poll_interval = float(poll_interval)
+        self.up_stable = int(up_stable)
+        self.down_stable = int(down_stable)
+        self._up_hits = 0
+        self._down_hits = 0
+        self._ttft_count_seen = 0
+        self._req_count_seen = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Observable trail for tests/smokes: every applied transition
+        # as (old_desired, new_desired, reason).
+        self.transitions: list = []
+
+    # -- decision ----------------------------------------------------------
+    def _ttft_p99_since_last_poll(self) -> Optional[float]:
+        hist = self.router.telemetry["ttft_seconds"]
+        snap = hist.snapshot()
+        if snap["count"] <= self._ttft_count_seen:
+            return None
+        # Approximate windowing: quantile over the cumulative histogram
+        # (good enough for an SLO trigger; the counter watermark just
+        # prevents acting on a silent, idle histogram).
+        self._ttft_count_seen = snap["count"]
+        return histogram_quantile(snap, 0.99)
+
+    def evaluate_once(self) -> Optional[int]:
+        """One poll: returns the new desired count when a transition
+        was applied, else None."""
+        try:
+            job = self.client.serve_jobs(self.namespace).get(self.name)
+        except Exception:
+            return None
+        auto = job.spec.autoscale
+        if auto is None:
+            return None
+        current = job.status.desired_replicas
+        if current is None:
+            current = job.spec.replicas or auto.min_replicas
+        current = max(auto.min_replicas,
+                      min(auto.max_replicas, current))
+
+        stats = self.router.replica_stats()
+        arrivals = self.router.telemetry["requests_total"].value \
+            - self._req_count_seen
+        self._req_count_seen += arrivals
+        if stats["replicas"] == 0:
+            self._down_hits = 0
+            if current > 0:
+                # Full-replica outage: zero alive replicas reads as
+                # zero queue — absence of signal, not of demand.  Hold
+                # rather than shrink the fleet exactly when it needs
+                # capacity back.
+                return None
+            if arrivals <= 0:
+                return None
+            # Scaled to zero but traffic is arriving (the router is
+            # 503ing it): demand is the request stream itself — wake
+            # the fleet rather than deadlock at zero forever.
+            desired = max(1, auto.min_replicas)
+            try:
+                self.client.serve_jobs(self.namespace).patch_status(
+                    self.name, desired_replicas=desired,
+                    scaling_reason="up: traffic while scaled to zero")
+            except Exception:
+                return None
+            self.transitions.append(
+                (current, desired, "up: traffic while scaled to zero"))
+            return desired
+        replicas = stats["replicas"]
+        per_replica = stats["queue_depth_total"] / replicas
+        ttft_p99 = self._ttft_p99_since_last_poll()
+
+        over = per_replica > auto.target_queue_depth
+        reason = f"queue depth {per_replica:.2f}/replica"
+        if not over and auto.ttft_p99_slo_seconds is not None \
+                and ttft_p99 is not None \
+                and ttft_p99 > auto.ttft_p99_slo_seconds:
+            over = True
+            reason = f"ttft p99 {ttft_p99:.3f}s over SLO"
+        under = per_replica <= auto.scale_down_queue_depth
+
+        if over:
+            self._up_hits += 1
+            self._down_hits = 0
+        elif under:
+            self._down_hits += 1
+            self._up_hits = 0
+        else:
+            self._up_hits = self._down_hits = 0
+
+        desired = current
+        if self._up_hits >= self.up_stable \
+                and current < auto.max_replicas:
+            desired = current + 1
+            self._up_hits = 0
+        elif self._down_hits >= self.down_stable \
+                and current > auto.min_replicas:
+            desired = current - 1
+            self._down_hits = 0
+        if desired == current and job.status.desired_replicas is not None:
+            return None
+        direction = ("up" if desired > current
+                     else "down" if desired < current else "hold")
+        reason = f"{direction}: {reason}"
+        try:
+            self.client.serve_jobs(self.namespace).patch_status(
+                self.name, desired_replicas=desired,
+                scaling_reason=reason)
+        except Exception:
+            return None  # apiserver weather: next poll re-asserts
+        if desired != current:
+            self.transitions.append((current, desired, reason))
+        return desired
+
+    # -- lifecycle ---------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            self.evaluate_once()
+
+    def start(self) -> "ServeAutoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

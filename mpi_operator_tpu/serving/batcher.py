@@ -66,6 +66,42 @@ from ..models.llama import select_rows as _select_rows
 from ..telemetry.metrics import Registry, new_serving_metrics
 
 PIPELINE_ENV = "MPI_OPERATOR_SERVE_PIPELINE"
+# Injected data-plane latency (simulation/bench knobs): a per-tick decode
+# sleep and a per-prefilled-token sleep, both held under the device lock
+# so they model accelerator occupancy.  On the single-core bench host
+# these make routing/cache effects measurable where real tiny-model
+# compute would be GIL-serialized noise (bench_serve_fleet.py).  Never
+# set in production.
+DECODE_LATENCY_ENV = "MPI_OPERATOR_SERVE_DECODE_LATENCY"
+PREFILL_TOKEN_LATENCY_ENV = "MPI_OPERATOR_SERVE_PREFILL_TOKEN_LATENCY"
+
+
+def _page_digest(parent_hex: str, page) -> str:
+    """Content digest of one prompt page CHAINED through its parent's
+    digest, so a digest identifies the whole token prefix up to and
+    including this page — position-independent, unlike the in-batcher
+    registry key (which chains through pool block ids)."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent_hex.encode())
+    h.update(",".join(str(int(t)) for t in page).encode())
+    return h.hexdigest()
+
+
+def prefix_page_digests(tokens, page_size: int) -> List[str]:
+    """Chain digests of a prompt's full pages eligible for prefix-cache
+    reuse (at least one token is always left to prefill — the same cap
+    as ContinuousBatcher._match_prefix).  Digest j covers tokens
+    [0, (j+1)*page_size); the fleet router computes these for an
+    incoming prompt and matches them against each replica's advertised
+    ``prefix_digest()`` to find the longest cached run."""
+    out: List[str] = []
+    parent = ""
+    for j in range((len(tokens) - 1) // page_size):
+        parent = _page_digest(parent,
+                              tokens[j * page_size:(j + 1) * page_size])
+        out.append(parent)
+    return out
 
 
 class _WaitQueue:
@@ -177,7 +213,9 @@ class ContinuousBatcher:
                  prompt_lookup_ngram: int = 3,
                  prefill_chunk: int = 0,
                  pipelined: Optional[bool] = None,
-                 telemetry_registry: Optional[Registry] = None):
+                 telemetry_registry: Optional[Registry] = None,
+                 decode_latency: Optional[float] = None,
+                 prefill_token_latency: Optional[float] = None):
         import dataclasses
 
         import jax
@@ -196,6 +234,17 @@ class ContinuousBatcher:
             pipelined = os.environ.get(
                 PIPELINE_ENV, "1").lower() not in ("0", "false", "no")
         self.pipelined = bool(pipelined)
+        # Injected accelerator-occupancy latency (see module constants):
+        # slept under the device lock so concurrent replicas on a
+        # GIL-bound host still overlap realistically.
+        if decode_latency is None:
+            decode_latency = float(os.environ.get(DECODE_LATENCY_ENV,
+                                                  "0") or 0)
+        if prefill_token_latency is None:
+            prefill_token_latency = float(os.environ.get(
+                PREFILL_TOKEN_LATENCY_ENV, "0") or 0)
+        self._decode_latency = float(decode_latency)
+        self._prefill_token_latency = float(prefill_token_latency)
         # Tick accounting, written only by the scheduler thread: the
         # flight-recorder breadcrumb that says whether a dead batcher
         # was mid-dispatch or mid-fetch, and the source for the
@@ -286,6 +335,11 @@ class ContinuousBatcher:
             self._prefix_cache = bool(prefix_cache)
             self._registry: dict = {}
             self._block_meta: dict = {}
+            # block id -> chain digest of the token prefix it completes
+            # (prefix_page_digests form); the compact hit-index the
+            # replica advertises to the fleet router (server.py
+            # /fleet-state).
+            self._block_digest: dict = {}
             self._prefix_clock = 0
             self._retire_count = 0
             self.prefix_stats = {"lookups": 0, "hit_blocks": 0,
@@ -688,6 +742,7 @@ class ContinuousBatcher:
         parent: Optional[int] = None
         max_full = (len(tokens) - 1) // self.page_size
         self.prefix_stats["lookups"] += 1
+        self.telemetry["prefix_lookups"].inc()
         for j in range(max_full):
             blk = self._registry.get(self._chain_key(parent, tokens, j))
             if blk is None:
@@ -728,12 +783,14 @@ class ContinuousBatcher:
                 return False
             meta = self._block_meta.pop(victim)
             del self._registry[meta["key"]]
+            self._block_digest.pop(victim, None)
             if meta["parent"] is not None:
                 parent_meta = self._block_meta.get(meta["parent"])
                 if parent_meta is not None:
                     parent_meta["children"].discard(victim)
             self._free_blocks.append(victim)
             self.prefix_stats["evicted"] += 1
+            self.telemetry["prefix_evicted"].inc()
         self._prefix_clock += 1
         for blk in shared:
             meta = self._block_meta[blk]
@@ -741,6 +798,10 @@ class ContinuousBatcher:
             meta["last"] = self._prefix_clock
         self.prefix_stats["hit_blocks"] += len(shared)
         self.prefix_stats["hit_tokens"] += len(shared) * self.page_size
+        if shared:
+            self.telemetry["prefix_hit_blocks"].inc(len(shared))
+            self.telemetry["prefix_hit_tokens"].inc(
+                len(shared) * self.page_size)
         priv = [self._free_blocks.pop() for _ in range(need)]
         self._slot_blocks[slot] = shared + priv
         self._slot_shared[slot] = len(shared)
@@ -769,9 +830,27 @@ class ContinuousBatcher:
             self._block_meta[blk] = {"key": key, "refs": 1,
                                      "last": self._prefix_clock,
                                      "parent": parent, "children": set()}
+            self._block_digest[blk] = _page_digest(
+                "" if parent is None
+                else self._block_digest.get(parent, ""),
+                tokens[j * self.page_size:(j + 1) * self.page_size])
             if parent is not None and parent in self._block_meta:
                 self._block_meta[parent]["children"].add(blk)
             parent = blk
+
+    def prefix_digest(self) -> List[str]:
+        """The replica's advertised prefix-cache hit index: the chain
+        digests (prefix_page_digests form) of every registered prompt
+        block.  Read from HTTP threads while the scheduler mutates the
+        underlying dict — retry the snapshot on a concurrent resize."""
+        if self.page_size <= 0 or not self._prefix_cache:
+            return []
+        for _ in range(8):
+            try:
+                return sorted(self._block_digest.values())
+            except RuntimeError:
+                continue
+        return []
 
     def _retire_slot(self, slot: int) -> None:
         """Drop the slot's block references and point its table back at
@@ -1154,6 +1233,8 @@ class ContinuousBatcher:
             them.  Returns the (out, slots-snapshot) pipeline record."""
             nonlocal next_tokens, keys
             with self._device_lock:
+                if self._decode_latency:
+                    time.sleep(self._decode_latency)
                 self._cache, out, keys = self._decode_step(
                     self._cache, next_tokens, temps, top_ps, keys,
                     top_ks)
@@ -1286,6 +1367,14 @@ class ContinuousBatcher:
                     shared = (self._slot_shared.get(i, 0)
                               if self.page_size > 0 else 0)
                     with self._device_lock:
+                        if self._prefill_token_latency:
+                            # Injected prefill occupancy scales with the
+                            # tokens actually prefilled — a prefix hit
+                            # pays only for its suffix, which is what
+                            # fleet routing must be able to observe.
+                            time.sleep(self._prefill_token_latency
+                                       * max(0, len(req.tokens)
+                                             - shared * self.page_size))
                         if shared > 0:
                             # _suffix_fn donates self._cache; from here
                             # a failure is NOT slot-local (see below).

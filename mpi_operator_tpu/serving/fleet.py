@@ -1,0 +1,324 @@
+"""Serving fleet in one process: the data-plane side of a ServeJob.
+
+`ServeReplicaRunner` is the serving kubelet analogue: it watches the
+ServeJob controller's replica pods and, for each, runs a REAL
+`InferenceServer` in-process — flipping the pod Ready only once the
+HTTP endpoint is bound (readiness gating is real, not declared) and
+publishing the live URL on the pod's ``serving.kubeflow.org/url``
+annotation, which is how the router discovers endpoints.
+
+`LocalServeFleet` wires the whole loop — apiserver + ServeJobController
++ replica runner + fleet router (+ autoscaler when the ServeJob has an
+autoscale block) — the serving counterpart of server/cluster.py's
+LocalCluster, used by `make serve-fleet-smoke`, bench_serve_fleet.py
+and the chaos `replica_kill` scenarios.  It is LocalCluster-shaped
+(``.client``/``.controller``/``.kubelet``) so the chaos engine and the
+default invariants run against it unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..api import constants
+from ..api.types import ServeJob
+from ..controller.servejob import ServeJobController, serve_selector
+from ..k8s import core
+from ..k8s.apiserver import Clientset, is_conflict, is_not_found
+from ..k8s.selectors import match_labels
+from ..telemetry import flight
+from .autoscaler import ServeAutoscaler
+from .router import FleetRouter
+
+
+class ServeReplicaRunner:
+    """Runs one InferenceServer per serving replica pod (see module
+    docstring).  ``server_factory(pod) -> InferenceServer`` builds an
+    UNstarted server for a pod; the runner starts it, reflects pod
+    status, and keeps the router's membership in sync."""
+
+    def __init__(self, clientset: Clientset,
+                 server_factory: Callable,
+                 namespace: str = "default",
+                 router: Optional[FleetRouter] = None,
+                 poll_interval: float = 0.05,
+                 job_name: Optional[str] = None):
+        self.client = clientset
+        self.server_factory = server_factory
+        self.namespace = namespace
+        # Scope to ONE ServeJob's replicas when given: two fleets
+        # sharing a namespace must not adopt (and route to) each
+        # other's pods.
+        self.job_name = job_name
+        self.router = router
+        self.poll_interval = float(poll_interval)
+        # (ns, name) -> (pod uid, InferenceServer).  The uid matters:
+        # a rolling replacement deletes and recreates the pod under the
+        # SAME name (often within one controller sync), so name alone
+        # would leave the old-template server running forever while the
+        # recreated pod waits Pending.
+        self._servers: Dict[tuple, tuple] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- pod reflection ----------------------------------------------------
+    def _serve_pods(self) -> dict:
+        pods = {}
+        for p in self.client.server.list("v1", "Pod", self.namespace):
+            if p.metadata.labels.get(constants.REPLICA_TYPE_LABEL) \
+                    != constants.REPLICA_TYPE_SERVE.lower():
+                continue
+            if self.job_name is not None and p.metadata.labels.get(
+                    constants.JOB_NAME_LABEL) != self.job_name:
+                continue
+            pods[(p.metadata.namespace, p.metadata.name)] = p
+        return pods
+
+    def _reflect(self, namespace: str, name: str, phase: str,
+                 ready: bool, url: str = "", reason: str = "") -> None:
+        """Annotate the URL (metadata update) then reflect phase/Ready
+        (status update), both conflict-retried."""
+        for _ in range(20):
+            try:
+                pod = self.client.pods(namespace).get(name)
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                time.sleep(0.05)
+                continue
+            if url and pod.metadata.annotations.get(
+                    constants.SERVE_URL_ANNOTATION) != url:
+                try:
+                    pod.metadata.annotations[
+                        constants.SERVE_URL_ANNOTATION] = url
+                    pod = self.client.pods(namespace).update(pod)
+                except Exception as exc:
+                    if is_conflict(exc):
+                        continue
+                    time.sleep(0.05)
+                    continue
+            pod.status.phase = phase
+            pod.status.reason = reason
+            pod.status.conditions = [c for c in pod.status.conditions
+                                     if c.type != "Ready"]
+            pod.status.conditions.append(core.PodCondition(
+                type="Ready",
+                status=core.CONDITION_TRUE if ready
+                else core.CONDITION_FALSE))
+            try:
+                self.client.pods(namespace).update_status(pod)
+                return
+            except Exception as exc:
+                if is_conflict(exc) or not is_not_found(exc):
+                    time.sleep(0.05)
+                    continue
+                return
+
+    def _start_replica(self, pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        try:
+            srv = self.server_factory(pod)
+            srv.start()
+        except Exception as exc:
+            flight.record("serving", "replica_start_failed",
+                          pod=f"{key[0]}/{key[1]}", error=str(exc))
+            self._reflect(*key, phase=core.POD_FAILED, ready=False,
+                          reason="StartError")
+            return
+        with self._lock:
+            self._servers[key] = (pod.metadata.uid, srv)
+        self._reflect(*key, phase=core.POD_RUNNING, ready=True,
+                      url=srv.url)
+        flight.record("serving", "replica_up", pod=f"{key[0]}/{key[1]}",
+                      url=srv.url)
+        if self.router is not None:
+            self.router.add_replica(key[1], srv.url)
+
+    def _stop_replica(self, key: tuple, graceful: bool = True) -> None:
+        with self._lock:
+            entry = self._servers.pop(key, None)
+        if entry is None:
+            return
+        _, srv = entry
+        if self.router is not None:
+            self.router.remove_replica(key[1])
+        try:
+            srv.stop()
+        except Exception:
+            pass
+        flight.record("serving", "replica_down",
+                      pod=f"{key[0]}/{key[1]}", graceful=graceful)
+
+    def kill(self, namespace: str, name: str) -> bool:
+        """Abrupt replica death (chaos `replica_kill`): poison the
+        batcher FIRST so in-flight requests fail loudly and /healthz
+        flips 503 (what tells the router to retry them elsewhere), then
+        mark the pod Failed so the controller replaces it."""
+        key = (namespace, name)
+        with self._lock:
+            entry = self._servers.get(key)
+        if entry is None:
+            return False
+        srv = entry[1]
+        batcher = getattr(srv, "_batcher", None)
+        if batcher is not None:
+            # The batcher's own fatal path: sets fatal_error/_stop so
+            # /healthz flips 503 and queued requests fail loudly, and
+            # cuts the batcher-fatal black-box bundle (phase names the
+            # chaos kill) — same semantics as any other fatal tick.
+            batcher._tick_fatal(RuntimeError("replica killed (chaos)"),
+                                "replica-kill")
+        if self.router is not None:
+            self.router.mark_dead(name)
+        self._reflect(namespace, name, phase=core.POD_FAILED,
+                      ready=False, reason="Killed")
+        self._stop_replica(key, graceful=False)
+        flight.record("serving", "replica_killed", pod=f"{namespace}/{name}")
+        return True
+
+    # -- control loop ------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                pods = self._serve_pods()
+            except Exception:
+                # API weather (chaos bursts): hold membership, retry.
+                self._stop.wait(self.poll_interval)
+                continue
+            with self._lock:
+                running = dict(self._servers)
+            for key, (uid, _) in running.items():
+                pod = pods.get(key)
+                if pod is None:
+                    self._stop_replica(key)  # pod deleted: wind down
+                elif pod.metadata.uid != uid:
+                    # Same name, new pod object (rolling replacement
+                    # recreates in place): the server belongs to the
+                    # DEAD pod — stop it so the fresh pod starts below.
+                    self._stop_replica(key)
+            with self._lock:
+                running_keys = set(self._servers)
+            for key, pod in pods.items():
+                if key not in running_keys and pod.status.phase in (
+                        "", core.POD_PENDING):
+                    self._start_replica(pod)
+            self._stop.wait(self.poll_interval)
+
+    def start(self) -> "ServeReplicaRunner":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-replica-runner")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            keys = list(self._servers)
+        for key in keys:
+            self._stop_replica(key)
+
+
+class LocalServeFleet:
+    """ServeJob end-to-end in one process (see module docstring)."""
+
+    def __init__(self, job: ServeJob, server_factory: Callable,
+                 client: Optional[Clientset] = None,
+                 policy: str = "prefix",
+                 router_refresh: float = 0.1,
+                 autoscaler_poll: float = 0.5,
+                 mpi_controller=None,
+                 router_seed: int = 0):
+        self.client = client or Clientset()
+        self.job = job
+        self.namespace = job.metadata.namespace or "default"
+        job.metadata.namespace = self.namespace
+        self.controller = ServeJobController(
+            self.client, mpi_controller=mpi_controller)
+        self.router = FleetRouter(policy=policy,
+                                  refresh_interval=router_refresh,
+                                  seed=router_seed)
+        self.runner = ServeReplicaRunner(self.client, server_factory,
+                                         namespace=self.namespace,
+                                         router=self.router,
+                                         job_name=job.metadata.name)
+        self.autoscaler = None
+        if job.spec.autoscale is not None:
+            self.autoscaler = ServeAutoscaler(
+                self.client, self.namespace, job.metadata.name,
+                self.router, poll_interval=autoscaler_poll)
+        # LocalCluster-shape for the chaos engine + default invariants.
+        self.kubelet = None
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "LocalServeFleet":
+        self.controller.run()
+        self.router.start()
+        self.runner.start()
+        self.client.serve_jobs(self.namespace).create(self.job)
+        if self.autoscaler is not None:
+            self.autoscaler.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.runner.stop()
+        self.router.stop()
+        self.controller.stop()
+        self._started = False
+
+    def __enter__(self) -> "LocalServeFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- conveniences ------------------------------------------------------
+    def wait_ready(self, replicas: Optional[int] = None,
+                   timeout: float = 60.0) -> None:
+        """Block until `replicas` (default: the spec count) replicas are
+        healthy in the router's routing set."""
+        want = replicas if replicas is not None \
+            else (self.job.spec.replicas or 1)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.router.healthy_replicas()) >= want:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"fleet never reached {want} healthy replicas "
+            f"({len(self.router.healthy_replicas())} up)")
+
+    def kill_replica(self, namespace: str, name: str) -> bool:
+        return self.runner.kill(namespace, name)
+
+    def serve_pods(self) -> list:
+        selector = serve_selector(self.job.metadata.name)
+        return [p for p in self.client.server.list("v1", "Pod",
+                                                   self.namespace)
+                if match_labels(selector, p.metadata.labels)]
+
+    def fleet_prefix_stats(self) -> dict:
+        """Aggregate prefix-cache counters across live replicas (the
+        fleet-wide hit-rate number the bench publishes)."""
+        agg = {"lookups": 0, "hit_blocks": 0, "hit_tokens": 0,
+               "evicted": 0}
+        with self.runner._lock:
+            servers = [srv for _, srv in self.runner._servers.values()]
+        for srv in servers:
+            batcher = getattr(srv, "_batcher", None)
+            stats = getattr(batcher, "prefix_stats", None)
+            if stats:
+                for k in agg:
+                    agg[k] += stats[k]
+        return agg

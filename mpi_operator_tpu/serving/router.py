@@ -1,0 +1,584 @@
+"""Fleet router — the HTTP front door over N InferenceServer replicas.
+
+One replica's content-addressed prefix cache (serving/batcher.py) only
+pays off fleet-wide if requests sharing a prefix land on the replica
+that already holds it.  The router places each request by, in order:
+
+1. **Session affinity** — a request carrying a ``"session"`` key sticks
+   to the replica that served the session before (its private suffix
+   blocks and any registered prompt pages are resident there).
+2. **Prefix-aware placement** — the prompt's full pages are chain-
+   digested (`batcher.prefix_page_digests`) and matched against each
+   replica's advertised hit index (GET /fleet-state, backed by
+   `ContinuousBatcher.prefix_digest`); the replica with the longest
+   cached run wins (ties broken by load).  The winner's index is
+   optimistically extended with the request's own digests so a burst of
+   same-prefix requests converges on one replica before the next poll.
+3. **Power-of-two-choices** — cold prefixes sample two replicas and
+   take the less loaded one (router-local in-flight count + last-polled
+   queue depth): near-optimal load spread at O(1) state, no global
+   scan.
+
+``policy="round_robin"`` disables 1–3 (the bench baseline: same fleet,
+placement-blind).
+
+A request in flight on a replica that dies (transport failure, or an
+upstream error whose replica then fails its health check) is retried on
+a healthy replica EXACTLY once.  Generation is deterministic given the
+request's sampling seed (the router injects one when the client
+sampled without a seed), so the retry replays the same stream; for SSE
+relays the retry skips the tokens already forwarded — zero lost, zero
+duplicated tokens, counter-asserted via
+``mpi_operator_router_{retries,requests_lost}_total``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..telemetry.metrics import (Registry, expose_with_defaults,
+                                 new_router_metrics)
+from .batcher import prefix_page_digests
+
+
+class _ClientGone(ConnectionError):
+    """The DOWNSTREAM client went away mid-relay.  Distinct from
+    upstream (replica) failure: it must never mark a replica dead,
+    burn the retry, or count a lost request."""
+
+
+# Bound on the session-affinity map: oldest pins evict FIFO past this,
+# so a long-lived router under unbounded distinct sessions stays O(1)
+# memory (a re-seen evicted session just re-pins via prefix/P2C).
+MAX_SESSIONS = 65536
+
+
+class _Replica:
+    """Router-side view of one fleet member."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url  # http://host:port
+        self.alive = True
+        self.outstanding = 0          # router-local in-flight requests
+        self.queue_depth = 0          # last-polled batcher queue depth
+        self.active_slots = 0
+        self.slots = 0
+        self.page_size = 0
+        self.digests: set = set()     # advertised prefix-cache index
+
+    @property
+    def load(self) -> float:
+        return self.outstanding + self.queue_depth + self.active_slots
+
+    def host_port(self) -> tuple:
+        hostport = self.url.split("//", 1)[-1]
+        host, _, port = hostport.partition(":")
+        return host, int(port or 80)
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def _respond(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        router: "FleetRouter" = self.server.router  # type: ignore
+        if self.path == "/healthz":
+            n = len(router.healthy_replicas())
+            self._respond(200 if n else 503,
+                          {"status": "ok" if n else "no-replicas",
+                           "replicas": n})
+        elif self.path == "/metrics":
+            body = expose_with_defaults(router.telemetry_registry).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._respond(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path != "/generate":
+            return self._respond(404, {"error": "not found"})
+        router: "FleetRouter" = self.server.router  # type: ignore
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length))
+        except Exception as exc:
+            return self._respond(400, {"error": str(exc)})
+        try:
+            if payload.get("stream"):
+                router.relay_stream(payload, self)
+            else:
+                code, body = router.relay(payload)
+                self._respond(code, body)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            self.close_connection = True
+
+
+class FleetRouter:
+    """See module docstring.  ``policy``: "prefix" (affinity → prefix →
+    P2C, the default) or "round_robin" (placement-blind baseline)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 policy: str = "prefix", refresh_interval: float = 0.25,
+                 upstream_timeout: float = 300.0, seed: int = 0,
+                 telemetry_registry: Optional[Registry] = None):
+        if policy not in ("prefix", "round_robin"):
+            raise ValueError(f"unknown router policy {policy!r}")
+        self.policy = policy
+        self.refresh_interval = float(refresh_interval)
+        self.upstream_timeout = float(upstream_timeout)
+        self.telemetry_registry = telemetry_registry or Registry()
+        self.telemetry = new_router_metrics(self.telemetry_registry)
+        self._replicas: Dict[str, _Replica] = {}
+        self._sessions: Dict[str, str] = {}  # session -> replica name
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self._rr_counter = 0
+        self._page_size = 0
+        self._stop = threading.Event()
+        self._refresher: Optional[threading.Thread] = None
+        self._http = ThreadingHTTPServer((host, port), _RouterHandler)
+        self._http.router = self  # type: ignore[attr-defined]
+        self.port = self._http.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- membership --------------------------------------------------------
+    def add_replica(self, name: str, url: str) -> None:
+        with self._lock:
+            self._replicas[name] = _Replica(name, url)
+        self.refresh_replica(name)
+        self._update_replica_gauge()
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._sessions = {s: r for s, r in self._sessions.items()
+                              if r != name}
+        self._update_replica_gauge()
+
+    def healthy_replicas(self) -> List[_Replica]:
+        with self._lock:
+            return [r for r in self._replicas.values() if r.alive]
+
+    def _update_replica_gauge(self) -> None:
+        self.telemetry["replicas"].set(len(self.healthy_replicas()))
+
+    def mark_dead(self, name: str) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                r.alive = False
+        self._update_replica_gauge()
+
+    def replica_stats(self) -> dict:
+        """Autoscaler-facing snapshot: per-replica load plus fleet
+        aggregates (serving/autoscaler.py)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        per = [{"name": r.name, "alive": r.alive,
+                "queue_depth": r.queue_depth,
+                "outstanding": r.outstanding,
+                "active_slots": r.active_slots, "slots": r.slots}
+               for r in reps]
+        alive = [p for p in per if p["alive"]]
+        return {
+            "replicas": len(alive),
+            "queue_depth_total": sum(p["queue_depth"] + p["outstanding"]
+                                     for p in alive),
+            "per_replica": per,
+        }
+
+    # -- replica state refresh --------------------------------------------
+    def refresh_replica(self, name: str) -> bool:
+        with self._lock:
+            r = self._replicas.get(name)
+        if r is None:
+            return False
+        import http.client
+        try:
+            host, port = r.host_port()
+            conn = http.client.HTTPConnection(host, port, timeout=2.0)
+            try:
+                conn.request("GET", "/fleet-state")
+                resp = conn.getresponse()
+                state = json.loads(resp.read())
+            finally:
+                conn.close()
+        except Exception:
+            if r.alive:
+                self.mark_dead(name)
+            return False
+        with self._lock:
+            r.queue_depth = int(state.get("queue_depth", 0))
+            r.active_slots = int(state.get("active_slots", 0))
+            r.slots = int(state.get("slots", 0))
+            r.page_size = int(state.get("page_size", 0))
+            # Authoritative replace: evictions on the replica must
+            # retire optimistic entries, or routing chases ghosts.
+            r.digests = set(state.get("prefix_digests", ()))
+            r.alive = bool(state.get("healthy", True))
+            if r.page_size:
+                self._page_size = r.page_size
+        self._update_replica_gauge()
+        return True
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self.refresh_interval):
+            # Concurrent per-replica polls: one hung replica (2s
+            # timeout) must not hold every other member's queue-depth/
+            # digest state stale for the whole cycle.
+            polls = [threading.Thread(target=self.refresh_replica,
+                                      args=(name,), daemon=True)
+                     for name in list(self._replicas)]
+            for t in polls:
+                t.start()
+            for t in polls:
+                t.join(timeout=2.5)
+
+    # -- placement ---------------------------------------------------------
+    @staticmethod
+    def _prompt_row(payload: dict) -> List[int]:
+        tokens = payload.get("tokens") or []
+        if tokens and isinstance(tokens[0], (list, tuple)):
+            tokens = tokens[0] if tokens else []
+        return [int(t) for t in tokens]
+
+    def _pick(self, payload: dict, exclude=()) -> _Replica:
+        """Choose a replica for this request (see module docstring for
+        the policy ladder) and account the placement path."""
+        # Digest the prompt BEFORE taking the router lock: the hash is
+        # a pure function of payload + page_size, and hashing long
+        # prompts under the lock would serialize every placement and
+        # in-flight-counter update behind it.
+        digests: List[str] = []
+        page = self._page_size
+        if self.policy != "round_robin" and page > 0:
+            digests = prefix_page_digests(self._prompt_row(payload),
+                                          page)
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.alive and r.name not in exclude]
+            if not candidates:
+                raise RuntimeError("no healthy replicas")
+            if self.policy == "round_robin":
+                self._rr_counter += 1
+                pick = candidates[self._rr_counter % len(candidates)]
+                self.telemetry["routed_total"].labels("rr").inc()
+                return pick
+            session = payload.get("session")
+            if session is not None:
+                pinned = self._replicas.get(
+                    self._sessions.get(str(session), ""))
+                if pinned is not None and pinned.alive \
+                        and pinned.name not in exclude:
+                    self.telemetry["routed_total"].labels("affinity").inc()
+                    return pinned
+            pick = path = None
+            if digests:
+                best_hits = 0
+                best: List[_Replica] = []
+                for r in candidates:
+                    hits = 0
+                    for d in digests:
+                        if d not in r.digests:
+                            break
+                        hits += 1
+                    if hits > best_hits:
+                        best_hits, best = hits, [r]
+                    elif hits and hits == best_hits:
+                        best.append(r)
+                if best:
+                    pick = min(best, key=lambda r: r.load)
+                    path = "prefix"
+            if pick is None:
+                two = (self._rng.sample(candidates, 2)
+                       if len(candidates) >= 2 else candidates)
+                pick = min(two, key=lambda r: r.load)
+                path = "p2c"
+            # Optimistic index extension: the pick will register these
+            # pages at admission; advertise them now so the next
+            # same-prefix request follows without waiting for a poll.
+            pick.digests.update(digests)
+            if session is not None:
+                self._sessions[str(session)] = pick.name
+                while len(self._sessions) > MAX_SESSIONS:
+                    self._sessions.pop(next(iter(self._sessions)))
+            self.telemetry["routed_total"].labels(path).inc()
+            return pick
+
+    # -- upstream plumbing -------------------------------------------------
+    def _prepare(self, payload: dict) -> dict:
+        # A sampled request without a seed would re-sample differently
+        # on a retry replica; pin one so the replay is byte-identical.
+        if float(payload.get("temperature", 0.0) or 0.0) > 0.0 \
+                and payload.get("seed") is None:
+            with self._lock:
+                payload["seed"] = self._rng.getrandbits(31)
+        return payload
+
+    def _open(self, replica: _Replica, payload: dict):
+        """POST /generate on the replica; returns (conn, response)."""
+        import http.client
+        host, port = replica.host_port()
+        conn = http.client.HTTPConnection(host, port,
+                                          timeout=self.upstream_timeout)
+        body = json.dumps(payload).encode()
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        return conn, conn.getresponse()
+
+    def _replica_dead(self, replica: _Replica) -> bool:
+        """Health-check a replica that returned an application error:
+        only a dead replica's errors are retried (a live replica's
+        error is deterministic and must be relayed, not replayed)."""
+        import http.client
+        try:
+            host, port = replica.host_port()
+            conn = http.client.HTTPConnection(host, port, timeout=2.0)
+            try:
+                conn.request("GET", "/healthz")
+                ok = conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except Exception:
+            ok = False
+        if not ok:
+            self.mark_dead(replica.name)
+        return not ok
+
+    # -- request relay -----------------------------------------------------
+    def relay(self, payload: dict) -> tuple:
+        """Non-streaming relay with the exactly-once retry contract.
+        Returns (status, body-dict) for the front-door handler."""
+        self.telemetry["requests_total"].inc()
+        payload = self._prepare(payload)
+        start = time.perf_counter()
+        exclude: List[str] = []
+        for attempt in range(2):
+            try:
+                replica = self._pick(payload, exclude=exclude)
+            except RuntimeError as exc:
+                # Lost means an ACCEPTED request died past its retry;
+                # a pre-dispatch 503 (no healthy replicas, nothing
+                # attempted yet) is clean load-shedding, not a broken
+                # retry contract.
+                if attempt:
+                    self.telemetry["requests_lost_total"].inc()
+                return 503, {"error": str(exc)}
+            with self._lock:
+                replica.outstanding += 1
+            failed = False
+            try:
+                conn, resp = self._open(replica, payload)
+                try:
+                    body = json.loads(resp.read())
+                    status = resp.status
+                finally:
+                    conn.close()
+            except Exception:
+                failed = True
+            finally:
+                with self._lock:
+                    replica.outstanding -= 1
+            # Any response from a LIVE replica is the request's
+            # outcome (errors included, 5xx or otherwise) — only a
+            # dead replica's response or a transport failure retries,
+            # mirroring relay_stream's non-200 path.
+            if not failed and \
+                    (status == 200 or not self._replica_dead(replica)):
+                if status == 200:
+                    # Non-streaming: the client sees nothing before the
+                    # whole response, so completion IS first-token
+                    # visibility — keeps the autoscaler's TTFT-SLO
+                    # trigger live for plain-JSON clients.
+                    self.telemetry["ttft_seconds"].observe(
+                        time.perf_counter() - start)
+                return status, body
+            # Transport failure or a dead replica's error: retry once.
+            if failed:
+                self.mark_dead(replica.name)
+            if attempt == 0:
+                self.telemetry["retries_total"].inc()
+                exclude.append(replica.name)
+                continue
+        self.telemetry["requests_lost_total"].inc()
+        return 502, {"error": f"replica {replica.name} died and the "
+                              f"single retry failed"}
+
+    def relay_stream(self, payload: dict, handler) -> None:
+        """SSE relay: forward upstream token events; on replica death
+        mid-stream, replay on a healthy replica once, skipping the
+        tokens already forwarded (deterministic generation given the
+        pinned seed makes the replay exact)."""
+        self.telemetry["requests_total"].inc()
+        payload = self._prepare(payload)
+        start = time.perf_counter()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def emit(event: dict) -> None:
+            # A failed client write is the CLIENT's death, not the
+            # replica's: re-raise typed so the relay loop below aborts
+            # without marking the upstream dead or burning the retry.
+            try:
+                chunk = f"data: {json.dumps(event)}\n\n".encode()
+                handler.wfile.write(f"{len(chunk):x}\r\n".encode()
+                                    + chunk + b"\r\n")
+                handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError) as exc:
+                raise _ClientGone(str(exc)) from exc
+
+        def finish() -> None:
+            try:
+                handler.wfile.write(b"0\r\n\r\n")
+                handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError) as exc:
+                raise _ClientGone(str(exc)) from exc
+
+        sent = 0          # tokens already forwarded to the client
+        first_at = None
+        exclude: List[str] = []
+        for attempt in range(2):
+            try:
+                replica = self._pick(payload, exclude=exclude)
+            except RuntimeError as exc:
+                if attempt:  # see relay(): pre-dispatch 503 != lost
+                    self.telemetry["requests_lost_total"].inc()
+                emit({"error": str(exc)})
+                return finish()
+            with self._lock:
+                replica.outstanding += 1
+            died = False
+            try:
+                try:
+                    conn, resp = self._open(replica, payload)
+                except Exception:
+                    died = True
+                    conn = None
+                if not died and resp.status != 200:
+                    # Plain-JSON rejection instead of an SSE stream: a
+                    # LIVE replica's error is the request's outcome —
+                    # relay it without marking the replica dead or
+                    # burning the retry (only a dead replica's error
+                    # re-dispatches, mirroring relay()).
+                    try:
+                        msg = json.loads(resp.read()).get(
+                            "error", f"upstream status {resp.status}")
+                    except Exception:
+                        msg = f"upstream status {resp.status}"
+                    conn.close()
+                    if not self._replica_dead(replica):
+                        emit({"error": msg})
+                        return finish()
+                    died = True
+                if not died:
+                    try:
+                        skip = sent
+                        for event in self._sse_events(resp):
+                            if "token" in event:
+                                if skip > 0:
+                                    skip -= 1
+                                    continue
+                                if first_at is None:
+                                    first_at = time.perf_counter()
+                                    self.telemetry["ttft_seconds"]\
+                                        .observe(first_at - start)
+                                sent += 1
+                                emit(event)
+                            elif "error" in event:
+                                # A live replica's error is the
+                                # request's real outcome; a dead one's
+                                # is retried below.
+                                if not self._replica_dead(replica):
+                                    emit(event)
+                                    return finish()
+                                died = True
+                                break
+                            elif event.get("done"):
+                                emit(event)
+                                return finish()
+                        else:
+                            # Upstream closed without done/error.
+                            died = True
+                    except _ClientGone:
+                        # Downstream client went away: abort the relay
+                        # quietly — the replica is fine (closing the
+                        # upstream connection cancels its slot), no
+                        # retry, no lost-request accounting.
+                        raise
+                    except Exception:
+                        died = True
+                    finally:
+                        conn.close()
+            finally:
+                with self._lock:
+                    replica.outstanding -= 1
+            if died:
+                self.mark_dead(replica.name)
+                if attempt == 0:
+                    self.telemetry["retries_total"].inc()
+                    exclude.append(replica.name)
+                    continue
+        self.telemetry["requests_lost_total"].inc()
+        emit({"error": f"replica {replica.name} died and the single "
+                       f"retry failed"})
+        finish()
+
+    @staticmethod
+    def _sse_events(resp):
+        """Parse `data: {...}` events off an upstream SSE response
+        (http.client undoes the chunked framing)."""
+        while True:
+            line = resp.readline()
+            if not line:
+                return
+            line = line.strip()
+            if line.startswith(b"data: "):
+                yield json.loads(line[6:])
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        self._thread = threading.Thread(target=self._http.serve_forever,
+                                        daemon=True, name="fleet-router")
+        self._thread.start()
+        self._refresher = threading.Thread(target=self._refresh_loop,
+                                           daemon=True,
+                                           name="fleet-router-refresh")
+        self._refresher.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._refresher is not None:
+            self._refresher.join(timeout=5)
+            self._refresher = None
+        if self._thread is not None:
+            self._http.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._http.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"

@@ -96,6 +96,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/fleet-state":
+            # Metrics-adjacent fleet surface: the compact state the
+            # router polls — queue depth for power-of-two-choices and
+            # the prefix-cache digest index for prefix-aware placement
+            # (docs/PERF.md "Serving fleet").
+            server: "InferenceServer" = self.server.inference  # type: ignore
+            self._respond(200, server.fleet_state())
         elif self.path == "/debug-bundle":
             # On-demand black box: freeze the flight ring + metrics for
             # a live-but-misbehaving server without killing it.
@@ -455,6 +462,23 @@ class InferenceServer:
                 yield tok
         finally:
             gen.close()
+
+    def fleet_state(self) -> dict:
+        """The GET /fleet-state payload (see _Handler): live queue
+        depth + slot occupancy for load balancing, and the batcher's
+        advertised prefix-cache digests for prefix-aware routing."""
+        b = self._batcher
+        if b is None:
+            return {"healthy": True, "queue_depth": 0, "active_slots": 0,
+                    "slots": 0, "page_size": 0, "prefix_digests": []}
+        return {
+            "healthy": b.fatal_error is None,
+            "queue_depth": b._queue.qsize(),
+            "active_slots": int(b.telemetry["active_slots"].value),
+            "slots": b.max_slots,
+            "page_size": b.page_size,
+            "prefix_digests": b.prefix_digest(),
+        }
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "InferenceServer":

@@ -403,4 +403,56 @@ def new_serving_metrics(registry: Registry) -> dict:
             "Wait from submit to batcher admission; path=deferred for"
             " requests that waited out a pool-exhaustion deferral",
             label_names=("path",)),
+        # Prefix-cache economics (ISSUE 8): the content-addressed paged
+        # block cache's hit/eviction accounting, exported as real
+        # counters so fleet-wide prefix reuse is counter-asserted on
+        # /metrics (the in-object prefix_stats dict remains for direct
+        # inspection).
+        "prefix_lookups": registry.counter(
+            "mpi_operator_serve_prefix_lookups_total",
+            "Prompt-prefix cache lookups at paged admission"),
+        "prefix_hit_blocks": registry.counter(
+            "mpi_operator_serve_prefix_hit_blocks_total",
+            "Cached full prompt blocks reused instead of prefilled"),
+        "prefix_hit_tokens": registry.counter(
+            "mpi_operator_serve_prefix_hit_tokens_total",
+            "Prompt tokens whose K/V came from the prefix cache"),
+        "prefix_evicted": registry.counter(
+            "mpi_operator_serve_prefix_evicted_total",
+            "Refcount-0 cached prefix blocks evicted under pool"
+            " pressure"),
+    }
+
+
+def new_router_metrics(registry: Registry) -> dict:
+    """The fleet-router metric set (serving/router.py): request/retry
+    accounting the fleet invariants are asserted from, plus placement
+    attribution (docs/PERF.md \"Serving fleet\")."""
+    return {
+        "registry": registry,
+        "requests_total": registry.counter(
+            "mpi_operator_router_requests_total",
+            "Requests accepted by the fleet router"),
+        "retries_total": registry.counter(
+            "mpi_operator_router_retries_total",
+            "Requests re-dispatched (exactly once each) after their"
+            " replica died mid-flight"),
+        "requests_lost_total": registry.counter(
+            "mpi_operator_router_requests_lost_total",
+            "Requests that failed after the single retry was spent"
+            " (fleet invariant: stays 0 while any replica is healthy)"),
+        "routed_total": registry.counter_vec(
+            "mpi_operator_router_routed_total",
+            "Placement decisions by path: affinity (session pin),"
+            " prefix (advertised prefix-digest hit), p2c"
+            " (power-of-two-choices on queue depth), rr (round-robin"
+            " baseline policy)",
+            label_names=("path",)),
+        "replicas": registry.gauge(
+            "mpi_operator_router_replicas",
+            "Healthy replicas currently in the routing set"),
+        "ttft_seconds": registry.histogram(
+            "mpi_operator_router_ttft_seconds",
+            "Router-observed time from request accept to first"
+            " upstream token (the autoscaler's TTFT signal)"),
     }

@@ -1,0 +1,159 @@
+"""Seeded regression: prefix-cache block refcounting under churn.
+
+The paged batcher's content-addressed prefix cache refcounts pool
+blocks (serving/batcher.py `_alloc_blocks`/`_register_blocks`/
+`_retire_slot`).  A refcount bug is silent until pool pressure turns it
+into either a leak (blocks never reclaimable -> admission wedges) or a
+use-after-free (a shared block evicted while a slot's table still maps
+it -> corrupt K/V).  This churns admissions + cancellations from a
+seeded RNG over a small oversubscribed pool and asserts the block
+accounting invariants between waves:
+
+- conservation: every pool block is exactly one of {free, held by a
+  slot and/or registered}; the free list never contains a block any
+  live slot references (shared blocks are never freed under a live
+  reference);
+- refcounts: a registered block's refs equals the number of live slots
+  whose block lists contain it;
+- reclaimability: once idle (refs all 0), a worst-case request that
+  needs more than the free list must still admit — refs-0 cached
+  blocks are evictable, leaf-first.
+"""
+
+import queue
+import random
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+from mpi_operator_tpu.serving.batcher import ContinuousBatcher
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig(vocab_size=128, dim=32, n_layers=1, n_heads=1,
+                      n_kv_heads=1, max_seq_len=128)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def _wait_idle(b: ContinuousBatcher, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not b._slot_blocks and b._queue.qsize() == 0:
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"batcher never idled: slots={b._slot_blocks}, "
+                       f"queue={b._queue.qsize()}")
+
+
+def _check_accounting(b: ContinuousBatcher, idle: bool) -> None:
+    free = list(b._free_blocks)
+    assert len(free) == len(set(free)), "free list holds duplicates"
+    free_set = set(free)
+    slot_held = [blk for blocks in b._slot_blocks.values()
+                 for blk in blocks]
+    registered = set(b._block_meta)
+    # Shared blocks are never freed while a slot references them.
+    for blk in slot_held:
+        assert blk not in free_set, \
+            f"block {blk} on the free list while a slot maps it"
+    assert not (free_set & registered), \
+        "registered (cached) block also on the free list"
+    # Conservation: every block is free, slot-held, or cached.
+    all_blocks = set(range(1, b._total_blocks + 1))
+    assert free_set | set(slot_held) | registered == all_blocks, \
+        "pool blocks leaked (neither free, held, nor cached)"
+    # Refcount == number of live slots mapping the block.
+    per_block: dict = {}
+    for blocks in b._slot_blocks.values():
+        for blk in blocks:
+            per_block[blk] = per_block.get(blk, 0) + 1
+    for blk, meta in b._block_meta.items():
+        assert meta["refs"] == per_block.get(blk, 0), \
+            (f"block {blk} refs={meta['refs']} but "
+             f"{per_block.get(blk, 0)} slots map it")
+    # Registry and meta stay mirrored, digests only for registered.
+    assert set(b._registry.values()) == registered
+    assert set(b._block_digest) <= registered
+    if idle:
+        assert not slot_held
+        assert all(m["refs"] == 0 for m in b._block_meta.values())
+
+
+def test_prefix_refcount_churn_seeded(tiny):
+    cfg, model, variables = tiny
+    rng = random.Random(1234)
+    # Oversubscribed pool: worst case would need ~3 slots * 10 blocks.
+    b = ContinuousBatcher(model, variables, max_slots=3, page_size=PAGE,
+                          cache_blocks=22, prefix_cache=True).start()
+    prefixes = [[rng.randrange(1, cfg.vocab_size)
+                 for _ in range(rng.choice([PAGE, 2 * PAGE, 3 * PAGE]))]
+                for _ in range(5)]
+    try:
+        for wave in range(6):
+            threads = []
+            for i in range(rng.randrange(4, 8)):
+                prompt = (rng.choice(prefixes)
+                          + [rng.randrange(1, cfg.vocab_size)
+                             for _ in range(rng.randrange(1, 6))])
+                action = rng.random()
+                if action < 0.25:
+                    # Cancel mid-stream: close the iterator after one
+                    # token (frees the slot; blocks must come back).
+                    def cancel_mid(prompt=prompt):
+                        it = b.submit_iter(prompt, 12, timeout=60)
+                        next(it)
+                        it.close()
+                    t = threading.Thread(target=cancel_mid)
+                elif action < 0.4:
+                    # Cancel while (possibly) still queued/deferred.
+                    def cancel_early(prompt=prompt):
+                        req = b._enqueue(prompt, 8, 0.0, 1.0, 0)
+                        time.sleep(rng.random() * 0.01)
+                        req.cancelled.set()
+                        req.done.wait(60)
+                    t = threading.Thread(target=cancel_early)
+                else:
+                    n = rng.randrange(1, 10)
+                    t = threading.Thread(
+                        target=lambda p=prompt, n=n: b.submit(
+                            p, n, timeout=60))
+                threads.append(t)
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads)
+            _wait_idle(b)
+            assert b.fatal_error is None
+            _check_accounting(b, idle=True)
+
+        stats = b.prefix_stats
+        assert stats["hit_tokens"] == stats["hit_blocks"] * PAGE
+        assert b.telemetry["prefix_hit_tokens"].value \
+            == stats["hit_tokens"]
+        assert b.telemetry["prefix_lookups"].value == stats["lookups"]
+
+        # Reclaimability: the cache now holds refs-0 blocks; a request
+        # whose budget exceeds the bare free list must admit by
+        # evicting them (leaf-first), not wedge.
+        assert len(b._free_blocks) < b._total_blocks
+        big_prompt = [rng.randrange(1, cfg.vocab_size)
+                      for _ in range(PAGE * 10)]
+        out = b.submit(big_prompt, PAGE * 4, timeout=120)
+        assert len(out) == PAGE * 4
+        _wait_idle(b)
+        _check_accounting(b, idle=True)
+        assert b.prefix_stats["evicted"] > 0
+        assert b.telemetry["prefix_evicted"].value \
+            == b.prefix_stats["evicted"]
+    finally:
+        b.stop()

@@ -1,0 +1,602 @@
+"""Serving fleet tests: ServeJob API + controller, the prefix-aware
+router, queue-driven autoscaling, and the replica_kill chaos contract
+(ISSUE 8, docs/PERF.md "Serving fleet")."""
+
+import http.client
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.defaults import set_defaults_servejob
+from mpi_operator_tpu.api.types import (ServeAutoscaleSpec, ServeJob,
+                                        ServeJobSpec,
+                                        serve_effective_replicas)
+from mpi_operator_tpu.api.validation import validate_servejob
+from mpi_operator_tpu.controller.servejob import (ServeJobController,
+                                                  serve_template_hash)
+from mpi_operator_tpu.k8s import core
+from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.k8s.core import (Container, PodCondition, PodSpec,
+                                       PodTemplateSpec)
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+from mpi_operator_tpu.serving.autoscaler import (ServeAutoscaler,
+                                                 histogram_quantile)
+from mpi_operator_tpu.serving.router import FleetRouter, _Replica
+
+
+def make_servejob(name="fleet", replicas=2, autoscale=None, env=None):
+    container = Container(name="replica", image="local")
+    if env:
+        from mpi_operator_tpu.k8s.core import EnvVar
+        container.env = [EnvVar(name=k, value=v) for k, v in env.items()]
+    return ServeJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=ServeJobSpec(
+            replicas=replicas, autoscale=autoscale,
+            template=PodTemplateSpec(spec=PodSpec(
+                containers=[container]))))
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"never satisfied: {msg}")
+
+
+# ---------------------------------------------------------------------------
+# API surface
+# ---------------------------------------------------------------------------
+
+def test_servejob_defaults_and_validation():
+    job = make_servejob(replicas=None)
+    set_defaults_servejob(job)
+    assert job.spec.replicas == constants.DEFAULT_SERVE_REPLICAS
+    assert validate_servejob(job) == []
+
+    bad = make_servejob(name="Bad_Name")
+    assert any("metadata.name" == e.field for e in validate_servejob(bad))
+    empty = make_servejob()
+    empty.spec.template.spec.containers = []
+    assert any("containers" in e.field for e in validate_servejob(empty))
+    inverted = make_servejob(autoscale=ServeAutoscaleSpec(
+        min_replicas=3, max_replicas=1))
+    assert any("maxReplicas" in e.field for e in validate_servejob(inverted))
+    band = make_servejob(autoscale=ServeAutoscaleSpec(
+        min_replicas=1, max_replicas=2, target_queue_depth=1.0,
+        scale_down_queue_depth=2.0))
+    assert any("scaleDownQueueDepth" in e.field
+               for e in validate_servejob(band))
+
+
+def test_serve_effective_replicas_clamps_into_autoscale_bounds():
+    job = make_servejob(replicas=2)
+    assert serve_effective_replicas(job) == 2
+    job.status.desired_replicas = 7
+    # No autoscale block: status cannot scale a fixed fleet.
+    assert serve_effective_replicas(job) == 2
+    job.spec.autoscale = ServeAutoscaleSpec(min_replicas=1, max_replicas=4)
+    assert serve_effective_replicas(job) == 4  # clamped from 7
+    job.status.desired_replicas = 0
+    assert serve_effective_replicas(job) == 1  # min floor
+
+
+# ---------------------------------------------------------------------------
+# Router placement (unit: injected replica state, no HTTP)
+# ---------------------------------------------------------------------------
+
+def _inject(router, name, digests=(), queue_depth=0):
+    r = _Replica(name, "http://127.0.0.1:1")
+    r.digests = set(digests)
+    r.queue_depth = queue_depth
+    router._replicas[name] = r
+    return r
+
+
+def test_router_prefix_affinity_and_p2c_placement():
+    from mpi_operator_tpu.serving.batcher import prefix_page_digests
+    router = FleetRouter(policy="prefix", seed=3)
+    try:
+        router._page_size = 8
+        prompt = list(range(1, 25))  # 3 pages, 2 eligible full pages
+        digests = prefix_page_digests(prompt, 8)
+        assert len(digests) == 2
+        _inject(router, "a", digests=digests)
+        _inject(router, "b", queue_depth=0)
+        # Prefix hit beats load: "a" owns the prefix.
+        payload = {"tokens": [prompt], "session": "s1"}
+        assert router._pick(payload).name == "a"
+        # Session affinity pins even a cold prompt.
+        assert router._pick({"tokens": [[99, 98]],
+                             "session": "s1"}).name == "a"
+        # Cold prefix, no session: P2C prefers the less-loaded replica.
+        router._replicas["a"].queue_depth = 50
+        picks = {router._pick({"tokens": [[70 + i]]}).name
+                 for i in range(8)}
+        assert picks == {"b"}
+        # Optimistic index extension: the cold pick's pages were added
+        # to b's advertised set, so the same prefix now prefix-routes.
+        cold = list(range(30, 47))
+        router._pick({"tokens": [cold]})
+        assert router._pick({"tokens": [cold]}).name == "b"
+        paths = {k[0]: v.value
+                 for k, v in router.telemetry["routed_total"]
+                 ._children.items()}
+        assert paths.get("prefix") and paths.get("affinity") \
+            and paths.get("p2c")
+        # Dead replicas leave the candidate set.
+        router.mark_dead("a")
+        assert router._pick(payload).name == "b"
+        with pytest.raises(RuntimeError):
+            router._pick(payload, exclude=["b"])
+    finally:
+        router._http.server_close()
+
+
+def test_router_round_robin_policy_ignores_prefix():
+    router = FleetRouter(policy="round_robin")
+    try:
+        router._page_size = 8
+        _inject(router, "a", digests={"deadbeef"})
+        _inject(router, "b")
+        picks = [router._pick({"tokens": [list(range(1, 20))]}).name
+                 for _ in range(4)]
+        assert sorted(picks) == ["a", "a", "b", "b"]
+        assert router.telemetry["routed_total"].get("rr") == 4
+    finally:
+        router._http.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler hysteresis (unit: fake router stats)
+# ---------------------------------------------------------------------------
+
+class _FakeRouter:
+    def __init__(self):
+        from mpi_operator_tpu.telemetry.metrics import (Registry,
+                                                        new_router_metrics)
+        self.telemetry = new_router_metrics(Registry())
+        self.depth = 0.0
+        self.n = 1
+
+    def replica_stats(self):
+        return {"replicas": self.n, "queue_depth_total": self.depth,
+                "per_replica": []}
+
+
+def test_autoscaler_hysteresis_and_status_writes():
+    client = Clientset()
+    job = make_servejob(name="auto", replicas=1,
+                        autoscale=ServeAutoscaleSpec(
+                            min_replicas=1, max_replicas=3,
+                            target_queue_depth=2.0,
+                            scale_down_queue_depth=0.5))
+    client.serve_jobs("default").create(job)
+    router = _FakeRouter()
+    scaler = ServeAutoscaler(client, "default", "auto", router,
+                             up_stable=2, down_stable=3)
+    router.depth = 10.0
+    scaler.evaluate_once()  # up hit 1: stable window not met
+    stored = client.serve_jobs("default").get("auto")
+    assert (stored.status.desired_replicas or 1) == 1
+    scaler.evaluate_once()  # up hit 2 -> scale to 2
+    assert client.serve_jobs("default").get(
+        "auto").status.desired_replicas == 2
+    router.depth = 1.0  # inside the hysteresis band: no movement
+    for _ in range(6):
+        scaler.evaluate_once()
+    assert client.serve_jobs("default").get(
+        "auto").status.desired_replicas == 2
+    router.depth = 0.0  # down window is the longer one
+    scaler.evaluate_once()
+    scaler.evaluate_once()
+    assert client.serve_jobs("default").get(
+        "auto").status.desired_replicas == 2
+    scaler.evaluate_once()
+    assert client.serve_jobs("default").get(
+        "auto").status.desired_replicas == 1
+    assert [(a, b) for a, b, _ in scaler.transitions] == [(1, 2), (2, 1)]
+
+
+def test_histogram_quantile():
+    snap = {"buckets": {0.01: 50, 0.1: 90, 1.0: 100}, "count": 100,
+            "sum": 5.0}
+    assert histogram_quantile(snap, 0.5) == 0.01
+    assert histogram_quantile(snap, 0.99) == 1.0
+    assert histogram_quantile({"buckets": {}, "count": 0}, 0.99) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Controller reconcile (inert pods; readiness driven by the test)
+# ---------------------------------------------------------------------------
+
+def _set_ready(client, name, ready=True, ns="default"):
+    pod = client.pods(ns).get(name)
+    pod.status.phase = core.POD_RUNNING
+    pod.status.conditions = [PodCondition(
+        type="Ready", status=core.CONDITION_TRUE if ready
+        else core.CONDITION_FALSE)]
+    client.pods(ns).update_status(pod)
+
+
+def _pods_of(client, job_name, ns="default"):
+    return sorted(
+        (p for p in client.server.list("v1", "Pod", ns)
+         if p.metadata.labels.get(constants.JOB_NAME_LABEL) == job_name),
+        key=lambda p: p.metadata.name)
+
+
+def test_controller_reconcile_readiness_rolling_and_scale():
+    client = Clientset()
+    ctrl = ServeJobController(client, shards=2)
+    ctrl.run()
+    try:
+        job = make_servejob(name="web", replicas=3)
+        client.serve_jobs("default").create(job)
+        wait_until(lambda: len(_pods_of(client, "web")) == 3,
+                   msg="3 replica pods")
+        pods = _pods_of(client, "web")
+        assert [p.metadata.name for p in pods] == [
+            "web-serve-0", "web-serve-1", "web-serve-2"]
+        hash0 = pods[0].metadata.labels[
+            constants.SERVE_TEMPLATE_HASH_LABEL]
+        assert all(p.metadata.owner_references[0].kind == "ServeJob"
+                   for p in pods)
+
+        # Readiness gating: Available only once every replica is Ready.
+        def conds():
+            stored = client.serve_jobs("default").get("web")
+            return {c.type: c.status for c in stored.status.conditions}
+        wait_until(lambda: conds().get(constants.SERVE_AVAILABLE)
+                   == core.CONDITION_FALSE, msg="Available=False")
+        for p in pods:
+            _set_ready(client, p.metadata.name)
+        wait_until(lambda: conds().get(constants.SERVE_AVAILABLE)
+                   == core.CONDITION_TRUE, msg="Available=True")
+        assert client.serve_jobs("default").get(
+            "web").status.ready_replicas == 3
+
+        # Rolling replacement: template change rolls ONE replica at a
+        # time, gated on the others being Ready.
+        stored = client.serve_jobs("default").get("web")
+        stored.spec.template.spec.containers[0].image = "local:v2"
+        client.serve_jobs("default").update(stored)
+        new_hash = serve_template_hash(stored)
+        assert new_hash != hash0
+        wait_until(lambda: sum(
+            1 for p in _pods_of(client, "web")
+            if p.metadata.labels[constants.SERVE_TEMPLATE_HASH_LABEL]
+            == new_hash) == 1, msg="first replica rolled")
+        # The fresh replica is not Ready yet -> the roll must STALL
+        # with exactly one updated pod (maxUnavailable=1).
+        time.sleep(0.4)
+        by_hash = [p.metadata.labels[constants.SERVE_TEMPLATE_HASH_LABEL]
+                   for p in _pods_of(client, "web")]
+        assert by_hash.count(new_hash) == 1
+        assert len(by_hash) == 3
+        # Ready it -> the next stale replica rolls.
+        for p in _pods_of(client, "web"):
+            if p.metadata.labels[constants.SERVE_TEMPLATE_HASH_LABEL] \
+                    == new_hash and not core.pod_running_and_ready(p):
+                _set_ready(client, p.metadata.name)
+        wait_until(lambda: sum(
+            1 for p in _pods_of(client, "web")
+            if p.metadata.labels[constants.SERVE_TEMPLATE_HASH_LABEL]
+            == new_hash) >= 2, msg="second replica rolled")
+
+        # Failed replica is replaced.
+        victim = _pods_of(client, "web")[0]
+        pod = client.pods("default").get(victim.metadata.name)
+        pod.status.phase = core.POD_FAILED
+        client.pods("default").update_status(pod)
+        old_uid = pod.metadata.uid
+        wait_until(lambda: any(
+            p.metadata.name == victim.metadata.name
+            and p.metadata.uid != old_uid
+            for p in _pods_of(client, "web")), msg="failed replaced")
+
+        # Scale down through the spec.
+        stored = client.serve_jobs("default").get("web")
+        stored.spec.replicas = 1
+        client.serve_jobs("default").update(stored)
+        wait_until(lambda: len(_pods_of(client, "web")) == 1,
+                   msg="scaled to 1")
+    finally:
+        ctrl.stop()
+
+
+def test_controller_rides_mpijob_sharded_queue_and_status_actuation():
+    """Serve + train jobs coexist on ONE sharded queue: the ServeJob
+    controller registers a kind handler with the MPIJob controller and
+    enqueues prefixed keys; the autoscaler's status write (not any pod
+    API call) changes the replica count, clamped to the spec bounds."""
+    from mpi_operator_tpu.controller import MPIJobController
+    client = Clientset()
+    mpi = MPIJobController(client, shards=2)
+    serve = ServeJobController(client, informer_factory=mpi.factory,
+                               mpi_controller=mpi)
+    assert serve.queue is mpi.queue
+    mpi.run()
+    try:
+        job = make_servejob(name="coexist", replicas=1,
+                            autoscale=ServeAutoscaleSpec(
+                                min_replicas=1, max_replicas=2))
+        client.serve_jobs("default").create(job)
+        wait_until(lambda: len(_pods_of(client, "coexist")) == 1,
+                   msg="1 replica")
+        # Autoscaler actuation path: a bare status write scales.
+        client.serve_jobs("default").patch_status(
+            "coexist", desired_replicas=5, scaling_reason="test")
+        wait_until(lambda: len(_pods_of(client, "coexist")) == 2,
+                   msg="clamped to max_replicas=2")
+        client.serve_jobs("default").patch_status(
+            "coexist", desired_replicas=1, scaling_reason="test-down")
+        wait_until(lambda: len(_pods_of(client, "coexist")) == 1,
+                   msg="scaled back down")
+    finally:
+        mpi.stop()
+        serve.factory.stop_all()
+
+
+def test_randomized_plan_fleet_kinds_deterministic():
+    from mpi_operator_tpu import chaos
+    kinds = {f.kind for seed in range(30)
+             for f in chaos.randomized_plan(
+                 seed, n_faults=8,
+                 kinds=chaos.FLEET_RANDOMIZABLE_KINDS).faults}
+    assert "replica_kill" in kinds
+    a = chaos.randomized_plan(7, n_faults=10,
+                              kinds=chaos.FLEET_RANDOMIZABLE_KINDS)
+    b = chaos.randomized_plan(7, n_faults=10,
+                              kinds=chaos.FLEET_RANDOMIZABLE_KINDS)
+    assert a.to_json() == b.to_json()
+    # The default tuple is unchanged: existing seeds replay identically.
+    assert "replica_kill" not in chaos.plan.RANDOMIZABLE_KINDS
+
+
+# ---------------------------------------------------------------------------
+# Fleet end-to-end (real replicas, tiny model)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = LlamaConfig(vocab_size=128, dim=32, n_layers=1, n_heads=1,
+                      n_kv_heads=1, max_seq_len=128)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _stream(url, payload, timeout=120):
+    hostport = url.split("//")[1]
+    host, _, port = hostport.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("POST", "/generate",
+                 body=json.dumps(dict(payload, stream=True)).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    toks, final, err = [], None, None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if line.startswith(b"data: "):
+            ev = json.loads(line[6:])
+            if "token" in ev:
+                toks.append(ev["token"])
+            elif "error" in ev:
+                err = ev["error"]
+                break
+            elif ev.get("done"):
+                final = ev["tokens"]
+                break
+    conn.close()
+    return toks, final, err
+
+
+def _fleet(tiny_model, name, replicas, monkeypatch, decode_latency=None,
+           **fleet_kwargs):
+    from mpi_operator_tpu.serving import InferenceServer, LocalServeFleet
+    cfg, model, variables = tiny_model
+    if decode_latency is not None:
+        monkeypatch.setenv("MPI_OPERATOR_SERVE_DECODE_LATENCY",
+                           str(decode_latency))
+
+    def factory(pod):
+        return InferenceServer(model, variables, max_batch_slots=3,
+                               kv_page_size=8, kv_cache_blocks=60)
+
+    return LocalServeFleet(make_servejob(name=name, replicas=replicas),
+                           server_factory=factory, **fleet_kwargs)
+
+
+def test_fleet_routed_streams_byte_identical_with_prefix_reuse(
+        tiny_model, monkeypatch):
+    from mpi_operator_tpu.serving import InferenceServer
+    from mpi_operator_tpu.serving.batcher import prefix_page_digests
+    cfg, model, variables = tiny_model
+    with _fleet(tiny_model, "ident", 2, monkeypatch) as fleet:
+        fleet.wait_ready(2, timeout=60)
+        system_prompt = list(range(1, 25))  # 3 full pages at page=8
+        reqs = [
+            {"tokens": [system_prompt + [40 + i]], "max_new_tokens": 6,
+             "session": f"s{i % 2}"}
+            for i in range(6)
+        ]
+        routed = []
+        for payload in reqs:
+            status, body = _post(fleet.router.url, payload)
+            assert status == 200
+            routed.append(body["tokens"])
+        # Byte-identity: same requests direct against a fresh replica.
+        direct_srv = InferenceServer(model, variables, max_batch_slots=3,
+                                     kv_page_size=8,
+                                     kv_cache_blocks=60).start()
+        try:
+            for payload, want in zip(reqs, routed):
+                _, body = _post(direct_srv.url,
+                                {k: v for k, v in payload.items()
+                                 if k != "session"})
+                assert body["tokens"] == want
+        finally:
+            direct_srv.stop()
+        # The shared system prompt reprefilled at most once per replica:
+        # fleet-wide hit counters prove reuse (counter-asserted, not
+        # assumed).
+        stats = fleet.fleet_prefix_stats()
+        assert stats["hit_blocks"] >= 4
+        assert stats["hit_tokens"] == stats["hit_blocks"] * 8
+        # /fleet-state advertises the digests the router matches on.
+        # Prefix routing converges the shared prompt onto ONE replica,
+        # so exactly one member must advertise its page digests.
+        want_digests = set(prefix_page_digests(system_prompt, 8))
+        advertised = []
+        for replica in fleet.router.healthy_replicas():
+            host, port = replica.host_port()
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", "/fleet-state")
+            state = json.loads(conn.getresponse().read())
+            conn.close()
+            assert state["page_size"] == 8
+            advertised.append(set(state["prefix_digests"]))
+        assert sum(1 for d in advertised if want_digests <= d) == 1
+        # Streaming through the router matches non-streaming output.
+        toks, final, err = _stream(fleet.router.url, reqs[0])
+        assert err is None and final == toks == routed[0][0]
+        assert fleet.router.telemetry["requests_lost_total"].value == 0
+
+        # A replica's plain-JSON 4xx on a streaming request is the
+        # request's outcome, not replica death: the error relays as an
+        # SSE event, no replica is marked dead, no retry is burned.
+        toks, final, err = _stream(
+            fleet.router.url,
+            {"tokens": [[1, 2, 3]], "max_new_tokens": 10_000})
+        assert err is not None and "max_seq_len" in err
+        assert toks == [] and final is None
+        assert len(fleet.router.healthy_replicas()) == 2
+        assert fleet.router.telemetry["retries_total"].value == 0
+        assert fleet.router.telemetry["requests_lost_total"].value == 0
+
+        # A client disconnecting mid-stream is NOT a replica failure:
+        # no replica may be marked dead, no retry burned, no request
+        # counted lost (regression for the catch-all that blamed the
+        # upstream for downstream socket deaths).
+        retries_before = fleet.router.telemetry["retries_total"].value
+        hostport = fleet.router.url.split("//")[1]
+        host, _, port = hostport.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        conn.request(
+            "POST", "/generate",
+            body=json.dumps(dict(reqs[0], stream=True,
+                                 max_new_tokens=30)).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        while True:
+            line = resp.readline().strip()
+            if line.startswith(b"data: ") and \
+                    "token" in json.loads(line[6:]):
+                break
+        conn.close()  # client walks away mid-stream
+        time.sleep(0.4)
+        assert len(fleet.router.healthy_replicas()) == 2
+        tm = fleet.router.telemetry
+        assert tm["retries_total"].value == retries_before
+        assert tm["requests_lost_total"].value == 0
+
+        # Rolling template replacement END-TO-END: the controller
+        # recreates pods under the same name, so the replica runner
+        # must notice the uid change, stop the old-template server and
+        # start (and Ready) a fresh one — regression for the
+        # name-keyed-runner deadlock where the roll stalled forever.
+        old_uids = {p.metadata.name: p.metadata.uid
+                    for p in fleet.serve_pods()}
+        stored = fleet.client.serve_jobs("default").get("ident")
+        stored.spec.template.spec.containers[0].image = "local:v2"
+        stored.spec.replicas = 1  # scale-down rides along (cheaper roll)
+        fleet.client.serve_jobs("default").update(stored)
+        new_hash = serve_template_hash(stored)
+
+        def rolled():
+            pods = fleet.serve_pods()
+            return (len(pods) == 1 and all(
+                p.metadata.labels[constants.SERVE_TEMPLATE_HASH_LABEL]
+                == new_hash and p.metadata.uid
+                != old_uids.get(p.metadata.name)
+                and core.pod_running_and_ready(p) for p in pods))
+        wait_until(rolled, timeout=60, msg="rolling replacement")
+        fleet.wait_ready(1, timeout=30)
+        status, body = _post(fleet.router.url, reqs[0])
+        assert status == 200 and body["tokens"] == routed[0]
+
+
+def test_fleet_replica_kill_chaos_exactly_once_retry(tiny_model,
+                                                     monkeypatch):
+    """The satellite-3 contract, chaos-driven: a seeded plan kills a
+    replica while streams are in flight; every stream completes via
+    exactly one retry (zero lost, zero duplicated tokens), the
+    serve_requests_intact invariant stays green, and the controller
+    heals the fleet."""
+    from mpi_operator_tpu import chaos
+    with _fleet(tiny_model, "chaosfleet", 2, monkeypatch,
+                decode_latency=0.02, router_seed=11) as fleet:
+        fleet.wait_ready(2, timeout=60)
+        # Warm both replicas (compile outside the measured scenario).
+        for i in range(2):
+            _post(fleet.router.url,
+                  {"tokens": [[1, 2, 3]], "max_new_tokens": 2,
+                   "session": f"warm{i}"})
+        results = {}
+
+        def client(i):
+            results[i] = _stream(
+                fleet.router.url,
+                {"tokens": [[5 + i, 6, 7, 8]], "max_new_tokens": 30,
+                 "session": f"sess{i}"})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        plan = chaos.FaultPlan(name="replica-kill", seed=21, faults=[
+            chaos.Fault(at=0.3, kind="replica_kill")])
+        for t in threads:
+            t.start()
+
+        def converged():
+            return (len(fleet.router.healthy_replicas()) >= 2
+                    and all(not t.is_alive() for t in threads))
+
+        report = chaos.run(plan, fleet, converge=converged, timeout=60,
+                           settle=3)
+        for t in threads:
+            t.join(timeout=60)
+        assert report.converged, report.events
+        assert report.ok, report.violations
+        kill_events = [e for e in report.events
+                       if e.get("kind") == "replica_kill"
+                       and e.get("event") == "inject"]
+        assert kill_events and kill_events[0]["result"] == "killed"
+        tm = fleet.router.telemetry
+        assert tm["requests_lost_total"].value == 0
+        assert tm["retries_total"].value >= 1
+        for i, (toks, final, err) in results.items():
+            assert err is None, f"client {i} errored: {err}"
+            assert final == toks and len(toks) == 30, \
+                f"client {i}: lost/duplicated tokens"

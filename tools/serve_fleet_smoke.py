@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""Serving-fleet smoke: tier-1-safe (CPU, < 60s) guard for the ServeJob
+fleet stack (ISSUE 8, docs/PERF.md "Serving fleet").
+
+Phase A — 3-replica fleet under mixed load (greedy / sampled / stop
+tokens / streaming, 6 tenants sharing system prompts):
+
+- **byte-identical streams**: every routed response equals the same
+  request served directly by a standalone replica;
+- **fleet prefix-hit floor**: the shared system prompts must actually
+  reuse cached pages fleet-wide (counter-asserted from the
+  ``mpi_operator_serve_prefix_*`` counters, not assumed);
+- **zero lost requests**: ``mpi_operator_router_requests_lost_total``
+  stays 0.
+
+Phase B — queue-driven autoscaling (min 1 / max 3): a closed-loop burst
+must scale the fleet UP (replica count observed through the router's
+routing set, actuated by the ServeJob controller off the autoscaler's
+status write), and going idle must scale it back DOWN.
+
+Usage: python tools/serve_fleet_smoke.py [--hit-floor 0.5]
+Exit 0 = all assertions green.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build(jax, jnp):
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = LlamaConfig(vocab_size=256, dim=32, n_layers=1, n_heads=1,
+                      n_kv_heads=1, max_seq_len=160)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def make_servejob(name, replicas, autoscale=None):
+    from mpi_operator_tpu.api.types import ServeJob, ServeJobSpec
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    return ServeJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=ServeJobSpec(
+            replicas=replicas, autoscale=autoscale,
+            template=PodTemplateSpec(spec=PodSpec(containers=[
+                Container(name="replica", image="local")]))))
+
+
+def post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def stream(url, payload, timeout=120):
+    hostport = url.split("//")[1]
+    host, _, port = hostport.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("POST", "/generate",
+                 body=json.dumps(dict(payload, stream=True)).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    toks, final, err = [], None, None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if line.startswith(b"data: "):
+            ev = json.loads(line[6:])
+            if "token" in ev:
+                toks.append(ev["token"])
+            elif "error" in ev:
+                err = ev["error"]
+                break
+            elif ev.get("done"):
+                final = ev["tokens"]
+                break
+    conn.close()
+    return toks, final, err
+
+
+def mixed_workload(cfg, tenants=6, per_tenant=3):
+    """Seeded shared-system-prompt workload: each tenant's requests
+    share a multi-page prompt prefix and differ in a short suffix."""
+    import numpy as np
+    rng = np.random.default_rng(23)
+    prompts = [list(map(int, rng.integers(1, cfg.vocab_size, 24)))
+               for _ in range(tenants)]
+    reqs = []
+    for t, prefix in enumerate(prompts):
+        for i in range(per_tenant):
+            suffix = list(map(int, rng.integers(1, cfg.vocab_size,
+                                                int(rng.integers(1, 5)))))
+            payload = {"tokens": [prefix + suffix], "max_new_tokens": 8,
+                       "session": f"tenant{t}"}
+            kind = (t * per_tenant + i) % 3
+            if kind == 1:
+                payload.update(temperature=0.8, top_p=0.9, seed=100 + i)
+            elif kind == 2:
+                payload.update(temperature=0.9, top_k=8, seed=200 + i)
+            if i % 3 == 2:
+                payload["stop"] = [7]
+            reqs.append(payload)
+    return reqs
+
+
+def phase_a(jax, jnp, hit_floor, problems):
+    from mpi_operator_tpu.serving import InferenceServer, LocalServeFleet
+    cfg, model, variables = _build(jax, jnp)
+
+    def factory(pod):
+        return InferenceServer(model, variables, max_batch_slots=3,
+                               kv_page_size=8, kv_cache_blocks=80)
+
+    with LocalServeFleet(make_servejob("smoke", 3),
+                         server_factory=factory) as fleet:
+        fleet.wait_ready(3, timeout=60)
+        print("serve-fleet-smoke: 3 replicas Ready (readiness-gated)")
+        reqs = mixed_workload(cfg)
+        routed = [None] * len(reqs)
+        errors = []
+
+        def run(i):
+            try:
+                if i % 4 == 0:
+                    toks, final, err = stream(fleet.router.url, reqs[i])
+                    if err is not None or final != toks:
+                        raise RuntimeError(
+                            f"stream {i}: err={err} final!=toks")
+                    routed[i] = [toks]
+                else:
+                    routed[i] = post(fleet.router.url, reqs[i])["tokens"]
+            except Exception as exc:
+                errors.append((i, repr(exc)))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        if errors:
+            problems.append(f"phase A workload errors: {errors[:4]}")
+            return
+        # Byte-identity vs a standalone replica.
+        direct = InferenceServer(model, variables, max_batch_slots=3,
+                                 kv_page_size=8,
+                                 kv_cache_blocks=80).start()
+        try:
+            bad = []
+            for i, payload in enumerate(reqs):
+                body = post(direct.url,
+                            {k: v for k, v in payload.items()
+                             if k != "session"})
+                if body["tokens"] != routed[i]:
+                    bad.append(i)
+            if bad:
+                problems.append(
+                    f"routed streams diverge from direct at {bad}")
+            else:
+                print(f"serve-fleet-smoke: {len(reqs)} routed responses "
+                      f"byte-identical to direct serving")
+        finally:
+            direct.stop()
+        stats = fleet.fleet_prefix_stats()
+        # Each tenant's 24-token prefix (3 pages eligible per lookup at
+        # page 8, minus one page when the suffix is short) should hit
+        # on every request after the tenant's first.
+        prefix_tokens_offered = sum(
+            (len(r["tokens"][0]) - 1) // 8 * 8 for r in reqs)
+        hit_rate = stats["hit_tokens"] / max(1, prefix_tokens_offered)
+        if hit_rate < hit_floor:
+            problems.append(
+                f"fleet prefix-hit rate {hit_rate:.2f} under floor "
+                f"{hit_floor} (stats: {stats})")
+        else:
+            print(f"serve-fleet-smoke: fleet prefix-hit rate "
+                  f"{hit_rate:.2f} (floor {hit_floor}; "
+                  f"{stats['hit_tokens']} tokens from cache)")
+        tm = fleet.router.telemetry
+        lost = tm["requests_lost_total"].value
+        if lost:
+            problems.append(f"router lost {lost} requests")
+        else:
+            print(f"serve-fleet-smoke: 0 lost requests "
+                  f"(counter-asserted; "
+                  f"{int(tm['requests_total'].value)} served)")
+
+
+def phase_b(jax, jnp, problems):
+    from mpi_operator_tpu.api.types import ServeAutoscaleSpec
+    from mpi_operator_tpu.serving import InferenceServer, LocalServeFleet
+    cfg, model, variables = _build(jax, jnp)
+    os.environ["MPI_OPERATOR_SERVE_DECODE_LATENCY"] = "0.01"
+    try:
+        def factory(pod):
+            return InferenceServer(model, variables, max_batch_slots=2,
+                                   kv_page_size=8, kv_cache_blocks=60)
+
+        job = make_servejob("autosmoke", 1, autoscale=ServeAutoscaleSpec(
+            min_replicas=1, max_replicas=3, target_queue_depth=2.0,
+            scale_down_queue_depth=0.25))
+        with LocalServeFleet(job, server_factory=factory,
+                             autoscaler_poll=0.25) as fleet:
+            fleet.wait_ready(1, timeout=60)
+            post(fleet.router.url,
+                 {"tokens": [[1, 2, 3]], "max_new_tokens": 2})
+            stop = threading.Event()
+
+            def client(i):
+                while not stop.is_set():
+                    try:
+                        post(fleet.router.url,
+                             {"tokens": [[i + 1, 2, 3, 4]],
+                              "max_new_tokens": 12})
+                    except Exception:
+                        pass
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline \
+                    and len(fleet.router.healthy_replicas()) < 2:
+                time.sleep(0.1)
+            up = len(fleet.router.healthy_replicas())
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            if up < 2:
+                problems.append(
+                    f"autoscaler never scaled up ({up} replicas; "
+                    f"transitions {fleet.autoscaler.transitions})")
+                return
+            print(f"serve-fleet-smoke: scaled up to {up} replicas "
+                  f"under burst ({fleet.autoscaler.transitions[0][2]})")
+            deadline = time.monotonic() + 30
+            scaled_down = False
+            while time.monotonic() < deadline:
+                sj = fleet.client.serve_jobs("default").get("autosmoke")
+                if (sj.status.desired_replicas or 9) <= up - 1:
+                    scaled_down = True
+                    break
+                time.sleep(0.2)
+            if not scaled_down:
+                problems.append(
+                    f"autoscaler never scaled down (transitions "
+                    f"{fleet.autoscaler.transitions})")
+                return
+            downs = [t for t in fleet.autoscaler.transitions
+                     if t[1] < t[0]]
+            print(f"serve-fleet-smoke: scaled back down "
+                  f"({downs[0][2] if downs else 'status observed'}); "
+                  f"transition trail {[(a, b) for a, b, _ in fleet.autoscaler.transitions]}")
+    finally:
+        os.environ.pop("MPI_OPERATOR_SERVE_DECODE_LATENCY", None)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--hit-floor", type=float, default=0.5,
+                    help="fleet prefix-hit-token rate floor "
+                         "(default 0.5)")
+    args = ap.parse_args(argv)
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    problems: list = []
+    phase_a(jax, jnp, args.hit_floor, problems)
+    phase_b(jax, jnp, problems)
+
+    if problems:
+        print("serve-fleet-smoke: FAIL")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("serve-fleet-smoke: PASS — routed streams byte-identical, "
+          "prefix-hit floor held, zero lost requests, autoscaler "
+          "up-then-down observed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
