@@ -6,7 +6,7 @@ PYTHON ?= python
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
-	train-bench-smoke serve-fleet-smoke
+	train-bench-smoke serve-fleet-smoke sched-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -67,6 +67,16 @@ serve-bench-smoke:
 # transition observed (docs/PERF.md "Serving fleet").
 serve-fleet-smoke:
 	$(PYTHON) tools/serve_fleet_smoke.py
+
+# Gang scheduler (< 60s, CPU): two queues over one TPU slice — small
+# job admitted and running, 9-chip gang honestly Queued with zero pods,
+# priority job preempts the small job with the checkpoint-then-evict
+# protocol observed end-to-end (notice -> checkpoint -> exit 143 ->
+# evict -> requeue), victim resumes FROM its pre-eviction checkpoint
+# step; scheduler counters and every chaos invariant asserted
+# (docs/SCHEDULING.md).
+sched-smoke:
+	$(PYTHON) tools/sched_smoke.py
 
 # Train hot path (< 60s, CPU): overlapped loop (async dispatch +
 # prefetch + async checkpointing) holds a steps/s floor with ZERO

@@ -1,0 +1,374 @@
+#!/usr/bin/env python
+"""Gang-scheduler contention soak -> BENCH_SCHED.json.
+
+The question the bench answers (ISSUE 9 / docs/SCHEDULING.md): under a
+10k-pod gang parked at the head of the queue, what happens to the p99
+admission latency of small interactive jobs — FIFO admission (strict
+arrival order, head-of-line blocking: the reference-style "one queue,
+first come first served") vs this repo's fair-share + backfill
+scheduler?
+
+The seeded workload (identical for both configs):
+
+- capacity: 40 x 256-chip TPU slices (4 spot) = 10,240 chips
+- t=0      60 "warm" small jobs (8 workers + launcher = 9 chips), each
+           holding its gang for HOLD seconds after admission
+- t=0.5    THE GANG: 10,199 workers + launcher = 10,200 pods/chips —
+           more than the free pool, so it queues
+- t=0.5..  a seeded open-loop stream of small jobs (STREAM_RATE/s)
+
+A completer marks each job Succeeded HOLD seconds after its Admitted
+condition lands (control-plane soak: no kubelet; the controller still
+creates every admitted gang's pods through the admission gate,
+including the 10k-pod gang's).  Measured per job: submit -> Admitted
+wall time.  Reported: small-job p50/p99 split pre/post gang arrival,
+the gang's own wait, makespan to all-Succeeded, scheduler counters,
+and the chaos invariants (no partial gangs, restarts <= backoffLimit,
+converged, queues idle) — all must hold with ZERO violations.
+
+The `preempt_resume` section re-runs tools/sched_smoke.py's live-pod
+scenario (real worker processes): a preempted gang checkpoints inside
+the grace window, is evicted, and provably resumes from its
+pre-eviction checkpoint step.
+
+Usage: python bench_sched.py [--quick] [-o BENCH_SCHED.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import heapq
+import json
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mpi_operator_tpu.api import constants  # noqa: E402
+from mpi_operator_tpu.api.types import (JobCondition, MPIJob, MPIJobSpec,  # noqa: E402
+                                        ReplicaSpec, RunPolicy)
+from mpi_operator_tpu.controller.controller import MPIJobController  # noqa: E402
+from mpi_operator_tpu.controller.status import get_condition  # noqa: E402
+from mpi_operator_tpu.k8s.apiserver import Clientset, is_conflict  # noqa: E402
+from mpi_operator_tpu.k8s.core import (Container, PodSpec,  # noqa: E402
+                                       PodTemplateSpec)
+from mpi_operator_tpu.k8s.meta import ObjectMeta  # noqa: E402
+from mpi_operator_tpu.sched import (ClusterQueue, GangScheduler,  # noqa: E402
+                                    LocalQueue, SlicePool, TpuSlice)
+
+NAMESPACE = "default"
+
+
+def mk_job(name, workers, queue):
+    return MPIJob(
+        metadata=ObjectMeta(
+            name=name, namespace=NAMESPACE,
+            labels={constants.QUEUE_NAME_LABEL: queue}),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    replicas=1, template=PodTemplateSpec(spec=PodSpec(
+                        containers=[Container(name="l", image="img",
+                                              command=["true"])]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers, template=PodTemplateSpec(spec=PodSpec(
+                        containers=[Container(name="w", image="img",
+                                              command=["true"])]))),
+            }))
+
+
+def percentile(values, q):
+    if not values:
+        return None
+    ordered = sorted(values)
+    index = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[index]
+
+
+def run_config(fair_share: bool, backfill: bool, workload: dict) -> dict:
+    """One soak against a fresh stack; returns the measured section."""
+    client = Clientset()
+    controller = MPIJobController(client, shards=4)
+    slices = [TpuSlice(f"slice-{i:02d}", workload["slice_chips"],
+                       spot=(i < workload["spot_slices"]))
+              for i in range(workload["slices"])]
+    scheduler = GangScheduler(
+        client, SlicePool(slices), fair_share=fair_share,
+        backfill=backfill, preemption=False, tick=0.05,
+        registry=controller.metrics.get("registry"))
+
+    for cq_name, lq_name, weight in (("cq-batch", "batch", 1.0),
+                                     ("cq-interactive", "interactive", 4.0)):
+        cq = ClusterQueue()
+        cq.metadata.name = cq_name
+        cq.spec.quotas = {}  # capacity-bound soak; quota math covered in tests
+        cq.spec.cohort = "pool"
+        cq.spec.weight = weight
+        client.cluster_queues(NAMESPACE).create(cq)
+        lq = LocalQueue()
+        lq.metadata.name = lq_name
+        lq.metadata.namespace = NAMESPACE
+        lq.spec.cluster_queue = cq_name
+        client.local_queues(NAMESPACE).create(lq)
+
+    controller.run()
+    scheduler.start()
+
+    hold = workload["hold_s"]
+    submit_t: dict = {}
+    admit_t: dict = {}
+    done: set = set()
+    completions: list = []  # heapq of (due, name)
+
+    watch = client.server.watch(constants.GROUP_VERSION, constants.KIND)
+
+    def submit(name, workers, queue, now):
+        client.mpi_jobs(NAMESPACE).create(mk_job(name, workers, queue))
+        submit_t[name] = now
+
+    def complete(name):
+        for _ in range(20):
+            try:
+                job = client.mpi_jobs(NAMESPACE).get(name)
+                job.status.conditions.append(JobCondition(
+                    type=constants.JOB_SUCCEEDED, status="True",
+                    reason="BenchCompleted", message="hold elapsed"))
+                job.status.completion_time = datetime.datetime.now(
+                    datetime.timezone.utc)
+                client.mpi_jobs(NAMESPACE).update_status(job)
+                return
+            except Exception as exc:
+                if is_conflict(exc):
+                    continue
+                raise
+
+    # Seeded submission schedule: (offset, name, workers, queue).
+    schedule = []
+    for i in range(workload["warm_jobs"]):
+        schedule.append((0.0, f"warm-{i:03d}", workload["small_workers"],
+                         "interactive"))
+    schedule.append((workload["gang_at"], "gang",
+                     workload["gang_pods"] - 1, "batch"))
+    import random
+    rng = random.Random(workload["seed"])
+    offset = workload["gang_at"]
+    for i in range(workload["stream_jobs"]):
+        offset += rng.expovariate(workload["stream_rate"])
+        schedule.append((round(offset, 3), f"stream-{i:03d}",
+                         workload["small_workers"], "interactive"))
+    schedule.sort(key=lambda s: s[0])
+    total_jobs = len(schedule)
+
+    t0 = time.monotonic()
+    pending_submissions = list(schedule)
+    try:
+        deadline = t0 + workload["timeout_s"]
+        while len(done) < total_jobs:
+            now = time.monotonic()
+            if now > deadline:
+                raise RuntimeError(
+                    f"soak timed out: {len(done)}/{total_jobs} done;"
+                    f" admitted={len(admit_t)}")
+            while pending_submissions \
+                    and pending_submissions[0][0] <= now - t0:
+                _, name, workers, queue = pending_submissions.pop(0)
+                submit(name, workers, queue, now)
+            # Admission transitions (watch-driven, exact wall times).
+            while True:
+                ev = watch.next(timeout=0)
+                if ev is None:
+                    break
+                if ev.type == "RELIST" or ev.obj is None:
+                    continue
+                job = ev.obj
+                name = job.metadata.name
+                if name in admit_t or name not in submit_t:
+                    continue
+                cond = get_condition(job.status, constants.JOB_ADMITTED)
+                if cond is not None and cond.status == "True":
+                    admit_t[name] = time.monotonic()
+                    heapq.heappush(completions,
+                                   (admit_t[name] + hold, name))
+            while completions and completions[0][0] <= now:
+                _, name = heapq.heappop(completions)
+                if name not in done:
+                    complete(name)
+                    done.add(name)
+            time.sleep(0.01)
+        makespan = time.monotonic() - t0
+
+        # Drain the controller before judging invariants or tearing
+        # down: the 10k-pod gang's post-admission pod creation is ONE
+        # long in-flight sync on a single host core — ending the config
+        # mid-sync would leave a zombie creation loop stealing CPU from
+        # the next config and the workqueue legitimately non-idle.
+        drain_deadline = time.monotonic() + workload["drain_timeout_s"]
+        idle_since = None
+        while time.monotonic() < drain_deadline:
+            with controller._inflight_lock:
+                inflight = bool(controller._inflight)
+            if not inflight and len(controller.queue) == 0:
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since >= 2.0:
+                    break
+            else:
+                idle_since = None
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("controller never drained after the soak")
+        drain = time.monotonic() - t0 - makespan
+
+        waits = {name: admit_t[name] - submit_t[name] for name in admit_t}
+        small_pre = [waits[n] for n in waits if n.startswith("warm-")]
+        small_post = [waits[n] for n in waits if n.startswith("stream-")]
+        smalls = small_pre + small_post
+
+        # Invariants must hold once the dust settles.
+        from mpi_operator_tpu.chaos.invariants import DEFAULT_INVARIANTS
+        import types as _types
+        system = _types.SimpleNamespace(client=client, kubelet=None,
+                                        controller=controller)
+        settle_deadline = time.monotonic() + 30
+        failures = {}
+        while time.monotonic() < settle_deadline:
+            failures = {check.__name__: check(system)
+                        for check in DEFAULT_INVARIANTS}
+            if not any(failures.values()):
+                break
+            time.sleep(0.5)
+        violations = [f for v in failures.values() for f in v]
+
+        m = scheduler.metrics
+        return {
+            "fair_share": fair_share,
+            "backfill": backfill,
+            "jobs": total_jobs,
+            "makespan_s": round(makespan, 2),
+            "controller_drain_s": round(drain, 2),
+            "gang_admission_wait_s": round(waits["gang"], 2),
+            "small_admission_wait_s": {
+                "p50": round(percentile(smalls, 0.50), 3),
+                "p99": round(percentile(smalls, 0.99), 3),
+                "max": round(max(smalls), 3),
+            },
+            "post_gang_small_wait_s": {
+                "p50": round(percentile(small_post, 0.50), 3),
+                "p99": round(percentile(small_post, 0.99), 3),
+            },
+            "admissions": {
+                path: int(m["admissions"].get(path))
+                for path in ("front", "backfill", "adopted")},
+            "backfill_denied": int(m["backfill_denied"].value),
+            "pods_created": len(client.server.list("v1", "Pod", NAMESPACE)),
+            "invariant_violations": violations,
+            "pool_free_at_end": scheduler.pool.free_chips,
+            "reservation_at_end": scheduler.reserved_chips(),
+        }
+    finally:
+        watch.stop()
+        scheduler.stop()
+        controller.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--out", default="BENCH_SCHED.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="reduced workload (CI-sized)")
+    ap.add_argument("--skip-resume-proof", action="store_true")
+    args = ap.parse_args()
+
+    workload = {
+        "seed": 20260804,
+        "slices": 40, "slice_chips": 256, "spot_slices": 4,
+        "warm_jobs": 60, "small_workers": 8,
+        "gang_pods": 10200, "gang_at": 0.5,
+        "stream_jobs": 100, "stream_rate": 10.0,
+        "hold_s": 2.0, "timeout_s": 300.0, "drain_timeout_s": 600.0,
+    }
+    if args.quick:
+        workload.update({"slices": 10, "warm_jobs": 12,
+                         "gang_pods": 2540, "stream_jobs": 20,
+                         "timeout_s": 120.0, "drain_timeout_s": 300.0})
+
+    results = {}
+    for label, fair, bf in (("fifo", False, False),
+                            ("fair_backfill", True, True)):
+        print(f"bench_sched: running {label} "
+              f"(fair_share={fair}, backfill={bf})...", flush=True)
+        results[label] = run_config(fair, bf, workload)
+        r = results[label]
+        print(f"  makespan {r['makespan_s']}s | gang wait "
+              f"{r['gang_admission_wait_s']}s | small p99 "
+              f"{r['small_admission_wait_s']['p99']}s | post-gang p99 "
+              f"{r['post_gang_small_wait_s']['p99']}s | violations "
+              f"{len(r['invariant_violations'])}", flush=True)
+
+    proof = None
+    if not args.skip_resume_proof:
+        print("bench_sched: preempt-resume proof (live pods)...",
+              flush=True)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import sched_smoke
+        proof = sched_smoke.run_scenario()
+        print(f"  checkpointed step {proof['checkpoint_step']} -> resumed"
+              f" {proof['resume_step']}", flush=True)
+
+    # Primary metric: the POST-gang stream — the small jobs that
+    # actually queue while the 10k-pod gang is pending (the acceptance
+    # population).  The t=0 warm burst admits before the gang exists;
+    # its tail is single-core scheduling noise, reported as secondary.
+    fifo_p99 = results["fifo"]["small_admission_wait_s"]["p99"]
+    fair_p99 = results["fair_backfill"]["small_admission_wait_s"]["p99"]
+    fifo_post = results["fifo"]["post_gang_small_wait_s"]["p99"]
+    fair_post = results["fair_backfill"]["post_gang_small_wait_s"]["p99"]
+    report = {
+        "bench": "sched_contention_soak",
+        "host": {"platform": platform.platform(),
+                 "python": platform.python_version(),
+                 "cpus": os.cpu_count()},
+        "workload": workload,
+        "results": results,
+        "improvement": {
+            "small_p99_speedup_x": round(fifo_p99 / max(fair_p99, 1e-9), 1),
+            "post_gang_p99_speedup_x": round(
+                fifo_post / max(fair_post, 1e-9), 1),
+        },
+        "preempt_resume": proof,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"bench_sched: wrote {args.out}")
+
+    violations = (results["fifo"]["invariant_violations"]
+                  + results["fair_backfill"]["invariant_violations"])
+    if violations:
+        print(f"bench_sched: FAIL — invariant violations: {violations}")
+        return 1
+    if proof is not None and not proof["resumed_from_checkpoint"]:
+        print("bench_sched: FAIL — preempted gang did not resume from"
+              " its checkpoint")
+        return 1
+    if fair_post >= fifo_post:
+        print("bench_sched: FAIL — fair+backfill did not improve the"
+              " under-a-pending-gang small-job p99 admission latency")
+        return 1
+    print(f"bench_sched: PASS — under-gang small p99 {fifo_post}s ->"
+          f" {fair_post}s"
+          f" ({report['improvement']['post_gang_p99_speedup_x']}x);"
+          f" all-smalls p99 {fifo_p99}s -> {fair_p99}s; 0 invariant"
+          f" violations, checkpoint resume proven")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,7 @@
     python -m mpi_operator_tpu get [-n ns] [--master ...]
     python -m mpi_operator_tpu events [-n ns] [--watch] [--master ...]
     python -m mpi_operator_tpu top [-n ns] [--once] [--master ...]
+    python -m mpi_operator_tpu queues [-n ns] [--master ...]
     python -m mpi_operator_tpu debug-bundle NAME [-o dir] [--master ...]
     python -m mpi_operator_tpu suspend/resume/delete NAME [--master ...]
     python -m mpi_operator_tpu version
@@ -62,12 +63,41 @@ def cmd_operator(args, extra) -> int:
     return 0
 
 
+def _parse_slices(spec: str):
+    """'--slices 2x256,1x64:spot' -> TpuSlice list (N slices of C chips;
+    ':spot' marks the group preemptible/reclaimable)."""
+    from .sched import TpuSlice
+    slices = []
+    for group_index, group in enumerate(s for s in spec.split(",") if s):
+        body, _, flag = group.partition(":")
+        count, sep, chips = body.partition("x")
+        spot = flag.strip().lower() == "spot"
+        try:
+            if not sep:
+                raise ValueError("missing 'x'")
+            if flag and not spot:
+                raise ValueError(f"unknown flag {flag!r}")
+            n, c = int(count), int(chips)
+            if n <= 0 or c <= 0:
+                raise ValueError("N and CHIPS must be positive")
+        except ValueError:
+            raise ValueError(
+                f"invalid --slices group {group!r}: expected N x CHIPS"
+                f" like '2x256' or '1x64:spot'") from None
+        for i in range(n):
+            prefix = "spot" if spot else "slice"
+            slices.append(TpuSlice(name=f"{prefix}-{group_index}-{i}",
+                                   chips=c, spot=spot))
+    return slices
+
+
 def cmd_cluster(args) -> int:
     from .k8s.http_api import ApiHttpServer
     from .server.cluster import LocalCluster
     from .telemetry import flight
 
-    cluster = LocalCluster()
+    cluster = LocalCluster(
+        sched_slices=_parse_slices(args.slices) if args.slices else None)
     flight.install_crash_handler(
         registry=cluster.controller.metrics.get("registry"))
     cluster.start()
@@ -126,7 +156,8 @@ def cmd_submit(args) -> int:
 
 
 def _condition_summary(job) -> str:
-    for ctype in ("Failed", "Succeeded", "Suspended", "Running", "Created"):
+    for ctype in ("Failed", "Succeeded", "Suspended", "Running",
+                  "Admitted", "Queued", "Created"):
         for c in job.status.conditions:
             if c.type == ctype and c.status == "True":
                 return ctype
@@ -409,6 +440,64 @@ def cmd_top(args) -> int:
         return 0
 
 
+def _fmt_resources(quantities: dict) -> str:
+    """Compact resource rendering: 'tpu=512,pods=600' (the GKE resource
+    prefix is dropped for width)."""
+    if not quantities:
+        return "-"
+    parts = []
+    for name, quantity in sorted(quantities.items()):
+        short = name.rsplit("/", 1)[-1]
+        parts.append(f"{short}={quantity}")
+    return ",".join(parts)
+
+
+def cmd_queues(args) -> int:
+    """ClusterQueue usage table (the scheduler-side `top`): quota vs
+    used from queue status, pending/admitted counted live from the
+    namespace's queue-labeled MPIJobs — so the table is honest even
+    when no scheduler is running (everything then shows as pending)."""
+    from .api import constants as api_constants
+    from .sched.api import (CLUSTER_QUEUE_KIND, LOCAL_QUEUE_KIND,
+                            SCHED_GROUP_VERSION, job_queue_name)
+
+    client = _client(args.master)
+    server = client.server
+    cqs = sorted(server.list(SCHED_GROUP_VERSION, CLUSTER_QUEUE_KIND),
+                 key=lambda q: q.metadata.name)
+    lqs = server.list(SCHED_GROUP_VERSION, LOCAL_QUEUE_KIND, args.namespace)
+    lq_to_cq = {(lq.metadata.namespace, lq.metadata.name):
+                lq.spec.cluster_queue for lq in lqs}
+    pending: dict = {}
+    admitted: dict = {}
+    for job in client.mpi_jobs(args.namespace).list():
+        queue = job_queue_name(job)
+        if not queue:
+            continue
+        cq_name = lq_to_cq.get((job.metadata.namespace, queue))
+        if cq_name is None:
+            continue
+        summary = _condition_summary(job)
+        if summary in ("Succeeded", "Failed"):
+            continue
+        is_admitted = any(
+            c.type == api_constants.JOB_ADMITTED and c.status == "True"
+            for c in job.status.conditions)
+        bucket = admitted if is_admitted else pending
+        bucket[cq_name] = bucket.get(cq_name, 0) + 1
+    print(f"{'NAME':20} {'COHORT':12} {'WEIGHT':>6} {'QUOTA':24} "
+          f"{'USED':24} {'PENDING':>7} {'ADMITTED':>8} {'AGE':>6}")
+    for cq in cqs:
+        weight = cq.spec.weight if cq.spec.weight is not None else 1.0
+        print(f"{cq.metadata.name:20} {cq.spec.cohort or '-':12} "
+              f"{weight:>6g} {_fmt_resources(cq.spec.quotas):24} "
+              f"{_fmt_resources(cq.status.used):24} "
+              f"{pending.get(cq.metadata.name, 0):>7} "
+              f"{admitted.get(cq.metadata.name, 0):>8} "
+              f"{_age(cq.metadata.creation_timestamp):>6}")
+    return 0
+
+
 def cmd_debug_bundle(args) -> int:
     from .telemetry import flight
 
@@ -479,6 +568,9 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("cluster", help="all-in-one local cluster")
     p.add_argument("--port", type=int, default=8001)
+    p.add_argument("--slices", default="",
+                   help="TPU slice capacity enabling the gang scheduler,"
+                        " e.g. '2x256,1x64:spot' (docs/SCHEDULING.md)")
 
     p = sub.add_parser("validate",
                        help="strict-validate an MPIJob yaml against the CRD")
@@ -506,6 +598,11 @@ def main(argv=None) -> int:
     p.add_argument("--master", default="http://127.0.0.1:8001")
     p.add_argument("-w", "--watch", action="store_true",
                    help="stream new events (resourceVersion resume)")
+
+    p = sub.add_parser("queues",
+                       help="ClusterQueue usage/pending/admitted table")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--master", default="http://127.0.0.1:8001")
 
     p = sub.add_parser("top",
                        help="live jobs/pods/queue/goodput table")
@@ -553,6 +650,8 @@ def main(argv=None) -> int:
             return cmd_describe(args)
         if args.command == "events":
             return cmd_events(args)
+        if args.command == "queues":
+            return cmd_queues(args)
         if args.command == "top":
             return cmd_top(args)
         if args.command == "debug-bundle":

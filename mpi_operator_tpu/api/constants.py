@@ -146,3 +146,21 @@ DEFAULT_SERVE_REPLICAS = 1
 TPU_RESOURCE = "google.com/tpu"
 GKE_TPU_TOPOLOGY_NODE_SELECTOR = "cloud.google.com/gke-tpu-topology"
 GKE_TPU_ACCELERATOR_NODE_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+
+# --- Gang scheduler (sched/) --------------------------------------------
+# Queue-managed admission (docs/SCHEDULING.md): an MPIJob carrying this
+# label (Kueue's queue-name contract) names a LocalQueue and is GATED —
+# the controller creates no pods until the gang scheduler admits it.
+QUEUE_NAME_LABEL = "scheduling.kubeflow.org/queue-name"
+# Numeric job priority for preemption ordering (higher preempts lower;
+# default 0).  An annotation, not a PriorityClass object, so a seeded
+# plan fully determines preemption order without a class lister.
+SCHED_PRIORITY_ANNOTATION = "scheduling.kubeflow.org/priority"
+# Written by the scheduler on admission: the slice placement
+# ("slice-a:256,slice-b:128") and whether the job jumped a blocked gang.
+SCHED_SLICES_ANNOTATION = "scheduling.kubeflow.org/slices"
+SCHED_BACKFILL_ANNOTATION = "scheduling.kubeflow.org/backfilled"
+
+# Admission condition types (Queued -> Admitted; eviction flips back).
+JOB_QUEUED = "Queued"
+JOB_ADMITTED = "Admitted"

@@ -74,6 +74,24 @@ def _validate_spec(spec: MPIJobSpec, path: str) -> list[FieldError]:
         errs.append(FieldError(f"{path}.slotsPerWorker",
                                "must be greater than or equal to 0"))
     errs += _validate_run_policy(spec.run_policy, f"{path}.runPolicy")
+    policy = spec.run_policy.scheduling_policy
+    if policy is not None and policy.min_available is not None:
+        # Admission-time sanity for the gang size: a non-positive
+        # minAvailable, or one no gang of workerReplicas (+ launcher)
+        # members can ever satisfy, would deadlock the gang silently —
+        # every member Pending forever while the scheduler waits for a
+        # quorum that cannot exist.
+        worker = spec.mpi_replica_specs.get(constants.REPLICA_TYPE_WORKER)
+        workers = (worker.replicas or 0) if worker is not None else 0
+        ma_path = f"{path}.runPolicy.schedulingPolicy.minAvailable"
+        if policy.min_available <= 0:
+            errs.append(FieldError(ma_path, "must be greater than 0"))
+        elif policy.min_available > workers + 1:
+            errs.append(FieldError(
+                ma_path,
+                f"must not exceed workerReplicas + 1 ({workers + 1}): a"
+                f" gang of {policy.min_available} can never assemble and"
+                f" would deadlock"))
     if not spec.ssh_auth_mount_path:
         errs.append(FieldError(f"{path}.sshAuthMountPath",
                                "must have a mount path for SSH credentials"))

@@ -195,6 +195,40 @@ def inject_replica_kill(ctx, fault):
     return None
 
 
+@register_injector("spot_reclaim")
+def inject_spot_reclaim(ctx, fault):
+    """Yank a whole spot TPU slice from the gang scheduler's capacity
+    pool: every gang holding chips on it gets the preemption notice,
+    the checkpoint grace window, then eviction + requeue
+    (sched/scheduler.py reclaim_slice).  A duration > 0 heals the
+    slice back online at ``at + duration`` — spot capacity returning.
+    No-ops (logged) against systems without a GangScheduler."""
+    scheduler = getattr(ctx.system, "scheduler", None)
+    if scheduler is None:
+        ctx.log_result(fault, resolved_target="", result="no-scheduler")
+        return None
+    if fault.target:
+        name = fault.target
+    else:
+        online = set(scheduler.pool.spot_slices()) \
+            - set(scheduler.pool.offline_slices())
+        candidates = sorted(online)
+        if not candidates:
+            ctx.log_result(fault, resolved_target="",
+                           result="no-spot-slice")
+            return None
+        name = ctx.rng.choice(candidates)
+    grace = fault.params.get("grace")
+    victims = scheduler.reclaim_slice(
+        name, grace=float(grace) if grace is not None else None)
+    ctx.log_result(fault, resolved_target=name,
+                   result=f"reclaimed victims={len(victims)}")
+
+    def heal():
+        scheduler.restore_slice(name)
+    return heal
+
+
 @register_injector("pod_delete")
 def inject_pod_delete(ctx, fault):
     """Delete the pod object through the API (eviction/drain analogue):

@@ -82,8 +82,11 @@ def jobs_converged(system) -> List[str]:
     """Every MPIJob reaches a terminal state (Succeeded/Failed) or is
     (back) Running — never wedged in between."""
     out = []
+    # Queued (admission pending behind quota/capacity) is a legitimate
+    # steady state for queue-managed jobs, not a wedge.
     settled = (constants.JOB_SUCCEEDED, constants.JOB_FAILED,
-               constants.JOB_RUNNING, constants.JOB_SUSPENDED)
+               constants.JOB_RUNNING, constants.JOB_SUSPENDED,
+               constants.JOB_QUEUED)
     for job in system.client.server.list("kubeflow.org/v2beta1", "MPIJob"):
         conds = {c.type: c.status for c in job.status.conditions}
         if not any(conds.get(t) == core.CONDITION_TRUE for t in settled):
@@ -113,10 +116,46 @@ def serve_requests_intact(system) -> List[str]:
             f"(retry contract broken)"] if lost else []
 
 
+def sched_no_partial_gangs(system) -> List[str]:
+    """Gang-scheduler admission invariant: a queue-managed MPIJob that
+    is NOT admitted must hold no running worker pods — gangs place
+    all-or-nothing, and an evicted/queued gang's members must be gone,
+    never half-running.  No-ops for jobs without the queue label (and
+    therefore for every system without a scheduler)."""
+    from ..controller.builders import worker_selector
+    from ..controller.status import get_condition
+    from ..k8s.selectors import match_labels
+    from ..sched.api import job_queue_name
+
+    out = []
+    gated = []
+    for job in system.client.server.list("kubeflow.org/v2beta1", "MPIJob"):
+        if not job_queue_name(job):
+            continue
+        cond = get_condition(job.status, constants.JOB_ADMITTED)
+        if cond is None or cond.status != core.CONDITION_TRUE:
+            gated.append(job)
+    if not gated:
+        return out
+    pods = system.client.server.list("v1", "Pod")
+    for job in gated:
+        selector = worker_selector(job.metadata.name)
+        running = [p for p in pods
+                   if p.metadata.namespace == job.metadata.namespace
+                   and match_labels(selector, p.metadata.labels)
+                   and p.status.phase == core.POD_RUNNING]
+        if running:
+            out.append(
+                f"MPIJob {job.metadata.namespace}/{job.metadata.name} is"
+                f" not admitted but {len(running)} worker pod(s) run —"
+                f" partial gang")
+    return out
+
+
 DEFAULT_INVARIANTS = (no_orphaned_runners, no_leaked_pod_ips,
                       no_orphaned_pods, gang_restarts_bounded,
                       jobs_converged, workqueue_idle,
-                      serve_requests_intact)
+                      serve_requests_intact, sched_no_partial_gangs)
 
 
 def checkpoint_intact(directory: str) -> List[str]:

@@ -107,6 +107,12 @@ RANDOMIZABLE_KINDS = ("pod_kill", "pod_delete", "preempt", "watch_relist",
 # default tuple so existing seeds keep deriving the same plans.
 FLEET_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + ("replica_kill",)
 
+# Gang-scheduler soaks add spot_reclaim (yank a whole spot TPU slice;
+# the injector no-ops with a logged "no-scheduler" against systems
+# without a GangScheduler).  Same opt-in shape as the fleet tuple: the
+# default tuple is untouched, so existing seeds replay identically.
+SCHED_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + ("spot_reclaim",)
+
 
 def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
                     kinds=RANDOMIZABLE_KINDS,
@@ -147,6 +153,12 @@ def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
             # Target resolved at inject time against the live fleet's
             # Running serve replicas (empty target = RNG pick).
             fault.params = {}
+        elif kind == "spot_reclaim":
+            # Target resolved at inject time against the scheduler's
+            # spot slices (empty target = RNG pick); duration > 0 heals
+            # the slice back online, modelling spot capacity returning.
+            fault.duration = round(rng.uniform(0.5, 2.0), 3)
+            fault.params = {"grace": round(rng.uniform(0.2, 0.8), 3)}
         faults.append(fault)
     return FaultPlan(name=name or f"randomized-{seed}", seed=seed,
                      faults=faults)

@@ -412,6 +412,26 @@ class MPIJobController:
                 self._update_status(mpi_job)
             return
 
+        # Queue-gated admission (sched/, docs/SCHEDULING.md): a job
+        # naming a LocalQueue creates NOTHING — no pods, no launcher,
+        # no Service — until the gang scheduler admits it (all-or-
+        # nothing placement; never a partial gang).  Eviction flips the
+        # gate shut again, so a preempted gang's pods are not recreated
+        # behind the scheduler's back.  Jobs without the queue label
+        # are untouched by any of this.
+        if self._admission_gated(mpi_job):
+            from .status import MPI_JOB_QUEUED_REASON
+            msg = (f"MPIJob {namespace}/{name} is queued: waiting for"
+                   f" gang admission")
+            if update_job_conditions(mpi_job, constants.JOB_QUEUED,
+                                     core.CONDITION_TRUE,
+                                     MPI_JOB_QUEUED_REASON, msg,
+                                     self.clock):
+                self.recorder.event(mpi_job, core.EVENT_TYPE_NORMAL,
+                                    "MPIJobQueued", msg)
+            self._update_status(mpi_job)
+            return
+
         if mpi_job.status.start_time is None and not self._suspended(mpi_job):
             mpi_job.status.start_time = self.clock.now()
 
@@ -486,6 +506,15 @@ class MPIJobController:
     # ------------------------------------------------------------------
     def _suspended(self, job: MPIJob) -> bool:
         return bool(job.spec.run_policy.suspend)
+
+    def _admission_gated(self, job: MPIJob) -> bool:
+        """True when the job is queue-managed (QUEUE_NAME_LABEL) and
+        the gang scheduler has not (or no longer) admitted it."""
+        from ..sched.api import job_queue_name
+        if not job_queue_name(job):
+            return False
+        cond = get_condition(job.status, constants.JOB_ADMITTED)
+        return cond is None or cond.status != core.CONDITION_TRUE
 
     def _resource_exists_error(self, job: MPIJob, name: str, kind: str):
         msg = MESSAGE_RESOURCE_EXISTS % (name, kind)

@@ -25,6 +25,12 @@ MPI_JOB_RESUMED_REASON = "MPIJobResumed"
 MPI_JOB_FAILED_REASON = "MPIJobFailed"
 MPI_JOB_EVICT_REASON = "MPIJobEvicted"
 
+# Gang-scheduler admission reasons (sched/, docs/SCHEDULING.md).
+MPI_JOB_QUEUED_REASON = "MPIJobQueued"
+MPI_JOB_ADMITTED_REASON = "MPIJobAdmitted"
+MPI_JOB_PREEMPTED_REASON = "MPIJobPreempted"
+MPI_JOB_SPOT_RECLAIMED_REASON = "MPIJobSpotReclaimed"
+
 
 def initialize_replica_statuses(job: MPIJob, rtype: str) -> None:
     """initializeMPIJobStatuses (:42-48)."""

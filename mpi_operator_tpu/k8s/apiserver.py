@@ -714,6 +714,14 @@ class Clientset:
     def serve_jobs(self, ns: str) -> ResourceClient:
         return ResourceClient(self, "kubeflow.org/v2beta1", "ServeJob", ns)
 
+    def cluster_queues(self, ns: str) -> ResourceClient:
+        from ..sched.api import SCHED_GROUP_VERSION
+        return ResourceClient(self, SCHED_GROUP_VERSION, "ClusterQueue", ns)
+
+    def local_queues(self, ns: str) -> ResourceClient:
+        from ..sched.api import SCHED_GROUP_VERSION
+        return ResourceClient(self, SCHED_GROUP_VERSION, "LocalQueue", ns)
+
     def volcano_pod_groups(self, ns: str) -> ResourceClient:
         from .scheduling import VOLCANO_API_VERSION
         return ResourceClient(self, VOLCANO_API_VERSION, "PodGroup", ns)

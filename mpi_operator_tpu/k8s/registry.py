@@ -15,9 +15,12 @@ from .meta import from_dict, to_dict
 def _kinds() -> dict:
     from ..api.types import MPIJob, ServeJob
     from . import batch, core, scheduling
+    from ..sched.api import (SCHED_GROUP_VERSION, ClusterQueue, LocalQueue)
     from ..server.leader_election import Lease
 
     return {
+        (SCHED_GROUP_VERSION, "ClusterQueue"): ClusterQueue,
+        (SCHED_GROUP_VERSION, "LocalQueue"): LocalQueue,
         ("v1", "Pod"): core.Pod,
         ("v1", "Service"): core.Service,
         ("v1", "ConfigMap"): core.ConfigMap,

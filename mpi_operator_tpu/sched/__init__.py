@@ -1,0 +1,27 @@
+"""Gang scheduler subsystem — quota/fair-share admission, priority
+preemption with checkpoint-then-evict, backfill, and simulated spot TPU
+slices with reclamation (docs/SCHEDULING.md).
+
+The reference operator *delegates* gang scheduling to Volcano /
+scheduler-plugins via PodGroupControl (controller/podgroup.py); this
+package owns admission and placement instead: MPIJobs naming a
+LocalQueue are gated by the controller until the :class:`GangScheduler`
+admits them against ClusterQueue quotas and the :class:`SlicePool` TPU
+capacity model — all-or-nothing, never a partial gang.
+"""
+
+from .api import (SCHED_GROUP_VERSION, ClusterQueue, ClusterQueueSpec,
+                  ClusterQueueStatus, LocalQueue, LocalQueueSpec,
+                  LocalQueueStatus, job_priority, job_queue_name,
+                  set_defaults_clusterqueue, set_defaults_localqueue,
+                  validate_clusterqueue, validate_localqueue)
+from .capacity import SlicePool, TpuSlice
+from .scheduler import GangScheduler, job_demand
+
+__all__ = [
+    "SCHED_GROUP_VERSION", "ClusterQueue", "ClusterQueueSpec",
+    "ClusterQueueStatus", "LocalQueue", "LocalQueueSpec", "LocalQueueStatus",
+    "GangScheduler", "SlicePool", "TpuSlice", "job_demand", "job_priority",
+    "job_queue_name", "set_defaults_clusterqueue", "set_defaults_localqueue",
+    "validate_clusterqueue", "validate_localqueue",
+]

@@ -1,0 +1,171 @@
+"""Queue API types — Kueue-style ClusterQueue / LocalQueue.
+
+The Kueue shape (cluster-level quota pools fed by namespaced local
+queues) without the Kueue machinery: a ``ClusterQueue`` declares
+resource quotas (TPU chips, gang pods), an optional ``cohort`` it may
+borrow unused quota from, and a fair-share ``weight``; a ``LocalQueue``
+is the namespaced handle jobs name via the
+``scheduling.kubeflow.org/queue-name`` label (api/constants.py
+QUEUE_NAME_LABEL).  Both kinds live in the ordinary object store
+(k8s/registry.py registers them; Clientset.cluster_queues /
+local_queues are the typed clients), so they flow over the HTTP
+transport and into debug bundles like every other kind.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import constants
+from ..api.types import JobCondition
+from ..k8s.meta import ObjectMeta
+from ..k8s.quantity import parse_quantity
+
+SCHED_API_GROUP = "scheduling.kubeflow.org"
+SCHED_API_VERSION = "v1alpha1"
+SCHED_GROUP_VERSION = f"{SCHED_API_GROUP}/{SCHED_API_VERSION}"
+CLUSTER_QUEUE_KIND = "ClusterQueue"
+LOCAL_QUEUE_KIND = "LocalQueue"
+
+# Resource names quotas are declared over.  PODS_RESOURCE counts gang
+# members (minAvailable); chips use the GKE TPU resource name.
+PODS_RESOURCE = "pods"
+DEFAULT_QUEUE_WEIGHT = 1.0
+
+
+@dataclass
+class ClusterQueueSpec:
+    """Quota pool: ``quotas`` maps resource name -> quantity string
+    (e.g. ``{"google.com/tpu": "512", "pods": "600"}``); a resource not
+    named is unlimited.  ``cohort`` groups queues that may lend each
+    other unused quota (``borrowing`` opts this queue into taking);
+    ``weight`` steers fair-share admission order (higher = larger
+    share); ``preemption`` lets pending higher-priority jobs of this
+    queue evict lower-priority admitted jobs in the same cohort."""
+    quotas: Dict[str, str] = field(default_factory=dict)
+    cohort: str = ""
+    weight: Optional[float] = None
+    borrowing: bool = True
+    preemption: bool = True
+
+
+@dataclass
+class ClusterQueueStatus:
+    used: Dict[str, str] = field(default_factory=dict)
+    pending_jobs: int = 0
+    admitted_jobs: int = 0
+    conditions: List[JobCondition] = field(default_factory=list)
+
+
+@dataclass
+class ClusterQueue:
+    api_version: str = SCHED_GROUP_VERSION
+    kind: str = CLUSTER_QUEUE_KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ClusterQueueSpec = field(default_factory=ClusterQueueSpec)
+    status: ClusterQueueStatus = field(default_factory=ClusterQueueStatus)
+
+
+@dataclass
+class LocalQueueSpec:
+    cluster_queue: str = ""
+
+
+@dataclass
+class LocalQueueStatus:
+    pending_jobs: int = 0
+    admitted_jobs: int = 0
+
+
+@dataclass
+class LocalQueue:
+    api_version: str = SCHED_GROUP_VERSION
+    kind: str = LOCAL_QUEUE_KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LocalQueueSpec = field(default_factory=LocalQueueSpec)
+    status: LocalQueueStatus = field(default_factory=LocalQueueStatus)
+
+
+# ---------------------------------------------------------------------------
+# Defaults + validation (the api/defaults.py / api/validation.py pattern
+# for the queue kinds; the scheduler applies both to every queue it
+# consumes so a hand-created object and an API-created one behave the
+# same).
+# ---------------------------------------------------------------------------
+
+
+def set_defaults_clusterqueue(cq: ClusterQueue) -> ClusterQueue:
+    if cq.spec.weight is None:
+        cq.spec.weight = DEFAULT_QUEUE_WEIGHT
+    return cq
+
+
+def set_defaults_localqueue(lq: LocalQueue) -> LocalQueue:
+    return lq
+
+
+def _field_errors():
+    from ..api.validation import FieldError
+    return FieldError
+
+
+def validate_clusterqueue(cq: ClusterQueue) -> list:
+    FieldError = _field_errors()
+    errs = []
+    if not cq.metadata.name:
+        errs.append(FieldError("metadata.name", "must be set"))
+    for resource, quantity in (cq.spec.quotas or {}).items():
+        try:
+            value = parse_quantity(quantity)
+        except Exception:
+            errs.append(FieldError(
+                f"spec.quotas[{resource}]",
+                f"invalid quantity {quantity!r}"))
+            continue
+        if value < 0:
+            errs.append(FieldError(
+                f"spec.quotas[{resource}]",
+                "must be greater than or equal to 0"))
+    if cq.spec.weight is not None and cq.spec.weight <= 0:
+        errs.append(FieldError("spec.weight", "must be greater than 0"))
+    return errs
+
+
+def validate_localqueue(lq: LocalQueue) -> list:
+    FieldError = _field_errors()
+    errs = []
+    if not lq.metadata.name:
+        errs.append(FieldError("metadata.name", "must be set"))
+    if not lq.spec.cluster_queue:
+        errs.append(FieldError("spec.clusterQueue",
+                               "must name a ClusterQueue"))
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# Job-side helpers
+# ---------------------------------------------------------------------------
+
+
+def job_queue_name(job) -> str:
+    """The LocalQueue an MPIJob is submitted to (the admission-gating
+    signal): the ``scheduling.kubeflow.org/queue-name`` label, with the
+    same-name annotation accepted as a fallback.  Empty = not queue
+    managed — the controller creates pods immediately, exactly as
+    before the scheduler existed."""
+    return ((job.metadata.labels or {}).get(constants.QUEUE_NAME_LABEL)
+            or (job.metadata.annotations or {}).get(
+                constants.QUEUE_NAME_LABEL) or "")
+
+
+def job_priority(job) -> int:
+    """Numeric job priority (``scheduling.kubeflow.org/priority``
+    annotation; higher preempts lower).  Malformed values read as 0 —
+    admission must not wedge on a typo."""
+    raw = (job.metadata.annotations or {}).get(
+        constants.SCHED_PRIORITY_ANNOTATION, "0")
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return 0

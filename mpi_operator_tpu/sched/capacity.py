@@ -1,0 +1,154 @@
+"""TPU slice capacity model.
+
+A cluster is a pool of :class:`TpuSlice`\\ s (a pod-slice of ``chips``
+chips, optionally ``spot``).  Placement is ALL-OR-NOTHING: a gang's
+chip demand either fits across the online slices (greedy, most-free
+first — jobs span slices exactly the way multislice training spans
+DCN) and the whole placement is recorded, or nothing is placed.  There
+is no partial state to leak, which is what makes the
+``sched_no_partial_gangs`` chaos invariant checkable.
+
+Spot reclamation drains a slice: ``set_offline`` removes its capacity
+from future placement (the scheduler then evicts the placements still
+holding chips on it), ``set_online`` heals it.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class TpuSlice:
+    name: str
+    chips: int
+    spot: bool = False
+
+
+class SlicePool:
+    def __init__(self, slices: List[TpuSlice]):
+        if len({s.name for s in slices}) != len(slices):
+            raise ValueError("duplicate slice names")
+        self._slices: Dict[str, TpuSlice] = {s.name: s for s in slices}
+        self._free: Dict[str, int] = {s.name: s.chips for s in slices}
+        # job key -> {slice name: chips held}
+        self._placements: Dict[str, Dict[str, int]] = {}
+        self._offline: set = set()
+        self._lock = threading.Lock()
+
+    # -- capacity accounting ----------------------------------------------
+    @property
+    def total_chips(self) -> int:
+        with self._lock:
+            return sum(s.chips for n, s in self._slices.items()
+                       if n not in self._offline)
+
+    @property
+    def free_chips(self) -> int:
+        with self._lock:
+            return sum(f for n, f in self._free.items()
+                       if n not in self._offline)
+
+    @property
+    def used_chips(self) -> int:
+        return self.total_chips - self.free_chips
+
+    def spot_slices(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, s in self._slices.items() if s.spot)
+
+    def offline_slices(self) -> List[str]:
+        with self._lock:
+            return sorted(self._offline)
+
+    def placement_of(self, key: str) -> Optional[Dict[str, int]]:
+        with self._lock:
+            placed = self._placements.get(key)
+            return dict(placed) if placed is not None else None
+
+    def online_chips_of(self, key: str) -> int:
+        """Chips of a placement that would return to the USABLE pool on
+        release (offline-slice chips excluded) — the honest value for
+        anything estimating future free capacity."""
+        with self._lock:
+            placed = self._placements.get(key)
+            if placed is None:
+                return 0
+            return sum(take for name, take in placed.items()
+                       if name in self._slices
+                       and name not in self._offline)
+
+    def placed_keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._placements)
+
+    # -- placement ---------------------------------------------------------
+    def place(self, key: str, chips: int) -> Optional[Dict[str, int]]:
+        """All-or-nothing: claim ``chips`` across online slices (greedy,
+        most free chips first, name tie-break for determinism) or claim
+        NOTHING and return None.  Zero-chip demands still record an
+        (empty) placement so release stays symmetric."""
+        if chips < 0:
+            raise ValueError("negative chip demand")
+        with self._lock:
+            if key in self._placements:
+                raise ValueError(f"job {key!r} already placed")
+            online = [(n, f) for n, f in self._free.items()
+                      if n not in self._offline]
+            if sum(f for _, f in online) < chips:
+                return None
+            online.sort(key=lambda item: (-item[1], item[0]))
+            assignment: Dict[str, int] = {}
+            remaining = chips
+            for name, free in online:
+                if remaining <= 0:
+                    break
+                take = min(free, remaining)
+                if take > 0:
+                    assignment[name] = take
+                    remaining -= take
+            for name, take in assignment.items():
+                self._free[name] -= take
+            self._placements[key] = assignment
+            return dict(assignment)
+
+    def release(self, key: str) -> int:
+        """Release a placement; returns the chips that came back to the
+        ONLINE free pool.  Chips on an offline (reclaimed) slice are
+        book-kept against the slice (so healing restores them) but are
+        not usable until it heals — and must not count as freed
+        capacity to callers (the scheduler's reservation fence accrues
+        this return value)."""
+        with self._lock:
+            placed = self._placements.pop(key, None)
+            if placed is None:
+                return 0
+            returned = 0
+            for name, take in placed.items():
+                if name in self._slices:
+                    self._free[name] += take
+                    if name not in self._offline:
+                        returned += take
+            return returned
+
+    # -- spot reclamation --------------------------------------------------
+    def jobs_on(self, slice_name: str) -> List[str]:
+        with self._lock:
+            return sorted(k for k, placed in self._placements.items()
+                          if placed.get(slice_name, 0) > 0)
+
+    def set_offline(self, slice_name: str) -> bool:
+        with self._lock:
+            if slice_name not in self._slices:
+                return False
+            self._offline.add(slice_name)
+            return True
+
+    def set_online(self, slice_name: str) -> bool:
+        with self._lock:
+            if slice_name not in self._slices:
+                return False
+            self._offline.discard(slice_name)
+            return True

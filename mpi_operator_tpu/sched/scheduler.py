@@ -1,0 +1,997 @@
+"""GangScheduler — quota/fair-share admission, preemption, backfill,
+spot reclamation.
+
+Owns what the reference delegates to Volcano/scheduler-plugins: MPIJobs
+naming a LocalQueue (``scheduling.kubeflow.org/queue-name`` label) are
+*gated* by the MPIJobController — no pods, no launcher — until this
+scheduler admits them.  Admission is gang-atomic: the job's whole chip
+demand (podgroup.py minAvailable math) is placed on the
+:class:`~.capacity.SlicePool` all-or-nothing and debited against its
+ClusterQueue quota (with cohort borrowing), or nothing happens.
+
+Policies (docs/SCHEDULING.md):
+
+- **Fair share**: cluster queues are served in ascending
+  used-chips/weight order, so a heavy queue cannot starve a light one.
+- **Backfill with a reservation fence**: when the front job (highest
+  priority, oldest) is capacity-blocked, later jobs that fit may jump
+  it — but while the fence is armed every released chip accrues to a
+  reservation backfill cannot touch, so the blocked gang's admission
+  is never delayed once capacity frees (monotonic progress toward the
+  gang's demand; no backfill starvation, even under sustained small-job
+  arrivals).
+- **Priority preemption, checkpoint-then-evict**: a pending
+  higher-priority job preempts lower-priority admitted jobs in its
+  cohort.  Victims first receive the kubelet preemption notice
+  (K_PREEMPTION_NOTICE_FILE — the PR 2 checkpoint-then-exit(143) path),
+  keep their chips through the checkpoint grace window, then are
+  evicted (pods + launcher deleted) and requeued with their checkpoint
+  intact.
+- **Spot reclamation**: ``reclaim_slice`` yanks a whole (spot) slice —
+  capacity goes offline immediately, every gang holding chips on it
+  goes through the same notice → grace → evict → requeue protocol.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import constants
+from ..api.defaults import set_defaults_mpijob
+from ..api.validation import validate_mpijob
+from ..controller.events import Recorder
+from ..controller.podgroup import cal_pg_min_resource, calculate_min_available
+from ..controller.status import (MPI_JOB_ADMITTED_REASON,
+                                 MPI_JOB_PREEMPTED_REASON,
+                                 MPI_JOB_QUEUED_REASON,
+                                 MPI_JOB_SPOT_RECLAIMED_REASON, get_condition,
+                                 is_finished, update_job_conditions)
+from ..k8s import core
+from ..k8s.apiserver import Clientset, is_conflict, is_not_found
+from ..k8s.meta import Clock, deep_copy
+from ..k8s.quantity import parse_quantity
+from ..k8s.selectors import match_labels
+from ..telemetry import flight
+from ..telemetry.metrics import Registry
+from .api import (LOCAL_QUEUE_KIND, CLUSTER_QUEUE_KIND, PODS_RESOURCE,
+                  SCHED_GROUP_VERSION, job_priority, job_queue_name,
+                  set_defaults_clusterqueue, validate_clusterqueue,
+                  validate_localqueue)
+from .capacity import SlicePool
+
+logger = logging.getLogger("mpi_operator_tpu.sched")
+
+MPIJOB_GV = constants.GROUP_VERSION
+
+# Eviction reasons (the evictions_total counter label values).
+EVICT_PREEMPTED = "preempted"
+EVICT_SPOT_RECLAIM = "spot_reclaim"
+EVICT_REQUEUED = "requeued"
+
+
+def new_sched_metrics(registry: Optional[Registry] = None) -> dict:
+    registry = registry or Registry()
+    return {
+        "registry": registry,
+        "pending": registry.gauge_vec(
+            "mpi_operator_sched_pending_jobs",
+            "Queued (not admitted) jobs per cluster queue", ["queue"]),
+        "admitted": registry.gauge_vec(
+            "mpi_operator_sched_admitted_jobs",
+            "Admitted jobs per cluster queue", ["queue"]),
+        "used_chips": registry.gauge_vec(
+            "mpi_operator_sched_used_chips",
+            "TPU chips held by admitted jobs per cluster queue", ["queue"]),
+        "free_chips": registry.gauge(
+            "mpi_operator_sched_free_chips",
+            "Unplaced TPU chips across online slices"),
+        "admission_wait": registry.histogram(
+            "mpi_operator_sched_admission_wait_seconds",
+            "Job submit (creationTimestamp) to Admitted condition"),
+        "admissions": registry.counter_vec(
+            "mpi_operator_sched_admissions_total",
+            "Gang admissions by path: front (in-order), backfill"
+            " (jumped a capacity-blocked gang), adopted (re-placed an"
+            " already-Admitted job after scheduler restart)", ["path"]),
+        "preemption_notices": registry.counter(
+            "mpi_operator_sched_preemption_notices_total",
+            "Victim gangs handed a preemption notice (checkpoint grace"
+            " window opened)"),
+        "evictions": registry.counter_vec(
+            "mpi_operator_sched_evictions_total",
+            "Admitted gangs evicted and requeued, by reason",
+            ["reason"]),
+        "spot_reclaims": registry.counter(
+            "mpi_operator_sched_spot_reclaims_total",
+            "Spot TPU slices reclaimed (capacity yanked)"),
+        "backfill_denied": registry.counter(
+            "mpi_operator_sched_backfill_denied_total",
+            "Backfill candidates refused because only the blocked"
+            " gang's reservation could have held them"),
+    }
+
+
+def job_demand(job) -> Dict[str, int]:
+    """Gang resource demand: ``pods`` is the podgroup minAvailable
+    (all-or-nothing member count), chips come from the priority-ordered
+    ``calPGMinResource`` sum of ``google.com/tpu`` requests.  A gang
+    that declares no TPU resources counts one chip per member, so the
+    capacity model stays meaningful for plain-CPU jobs."""
+    min_member = calculate_min_available(job)
+    resources = cal_pg_min_resource(min_member, job) or {}
+    chips = int(parse_quantity(resources.get(constants.TPU_RESOURCE, "0")))
+    if chips <= 0:
+        chips = min_member
+    return {PODS_RESOURCE: min_member, constants.TPU_RESOURCE: chips}
+
+
+class GangScheduler:
+    """One reconcile loop over (ClusterQueues, LocalQueues, MPIJobs).
+
+    ``fair_share=False, backfill=False`` is the FIFO-admission baseline
+    the bench compares against: strict arrival order with head-of-line
+    blocking.  ``kubelet`` (optional) delivers preemption notices to
+    victim pods; without it (pure control-plane benches) the grace
+    window still elapses before eviction.
+    """
+
+    def __init__(self, clientset: Clientset, pool: SlicePool,
+                 kubelet=None, namespace: Optional[str] = None,
+                 fair_share: bool = True, backfill: bool = True,
+                 preemption: bool = True, checkpoint_grace: float = 1.0,
+                 clock: Optional[Clock] = None, recorder=None,
+                 registry: Optional[Registry] = None,
+                 tick: float = 0.1):
+        self.client = clientset
+        self.pool = pool
+        self.kubelet = kubelet
+        self.namespace = namespace
+        self.fair_share = fair_share
+        self.backfill = backfill
+        self.preemption = preemption
+        self.checkpoint_grace = checkpoint_grace
+        self.clock = clock or Clock()
+        self.recorder = recorder or Recorder(clientset)
+        self.metrics = new_sched_metrics(registry)
+        self._tick = tick
+        # job key -> {"cq", "demand", "chips", "epoch", "ns", "name"}
+        self._admitted: Dict[str, dict] = {}
+        # job key -> {"deadline", "reason"} (notice delivered, grace
+        # window running; capacity still held until eviction).
+        self._preempting: Dict[str, dict] = {}
+        # Blocked-front reservation fence: capacity released by
+        # pre-block admissions accrues here and is invisible to
+        # backfill.
+        self._blocked: Optional[dict] = None  # {"key","epoch","reserved","chips"}
+        self._epoch = 0
+        self._invalid_warned: set = set()
+        # (key -> (resourceVersion, demand, valid)): validation +
+        # demand math memoized per object version — the admission walk
+        # re-examines every pending job after each admission, and
+        # recomputing validate_mpijob/cal_pg_min_resource per walk is
+        # quadratic in the backlog (visible at a 100-job burst).
+        self._job_cache: Dict[str, tuple] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watches: list = []
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "GangScheduler":
+        for api_version, kind in ((MPIJOB_GV, constants.KIND),
+                                  (SCHED_GROUP_VERSION, CLUSTER_QUEUE_KIND),
+                                  (SCHED_GROUP_VERSION, LOCAL_QUEUE_KIND)):
+            self._watches.append(self.client.server.watch(api_version, kind))
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="gang-scheduler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        for w in self._watches:
+            w.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        # gangsim-style: cheap idempotent relist reconcile per tick; the
+        # watches only bound latency (drained, not interpreted).
+        while not self._stop.is_set():
+            for w in self._watches:
+                while w.next(timeout=0) is not None:
+                    pass
+            self._kick.clear()
+            try:
+                self.reconcile_once()
+            except Exception:
+                logger.exception("gang scheduler reconcile failed")
+            self._kick.wait(timeout=self._tick)
+
+    def kick(self) -> None:
+        self._kick.set()
+
+    # ------------------------------------------------------------------
+    # Introspection (tests, invariants, smoke)
+    # ------------------------------------------------------------------
+    def admitted_keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._admitted)
+
+    def reserved_chips(self) -> int:
+        with self._lock:
+            return self._blocked["reserved"] if self._blocked else 0
+
+    # ------------------------------------------------------------------
+    # Spot reclamation (chaos surface)
+    # ------------------------------------------------------------------
+    def reclaim_slice(self, slice_name: str,
+                      grace: Optional[float] = None) -> List[str]:
+        """Yank a slice: capacity offline NOW (nothing new places on
+        it), every gang holding chips on it enters the notice → grace →
+        evict → requeue protocol.  Returns the victim job keys."""
+        with self._lock:
+            if not self.pool.set_offline(slice_name):
+                return []
+            self.metrics["spot_reclaims"].inc()
+            victims = self.pool.jobs_on(slice_name)
+            flight.record("sched", "spot_reclaim", slice=slice_name,
+                          victims=len(victims))
+            for key in victims:
+                self._begin_eviction(key, EVICT_SPOT_RECLAIM,
+                                     grace=grace,
+                                     message=f"spot slice {slice_name}"
+                                             f" reclaimed")
+        self.kick()
+        return victims
+
+    def restore_slice(self, slice_name: str) -> bool:
+        ok = self.pool.set_online(slice_name)
+        if ok:
+            flight.record("sched", "slice_restored", slice=slice_name)
+            self.kick()
+        return ok
+
+    # ------------------------------------------------------------------
+    # The reconcile
+    # ------------------------------------------------------------------
+    def reconcile_once(self) -> int:
+        """One full pass; returns the number of admissions it made."""
+        with self._lock:
+            cqs, lqs = self._load_queues()
+            jobs = {self._key(j): j for j in self.client.server.list(
+                MPIJOB_GV, constants.KIND, self.namespace)}
+            for stale in [k for k in self._job_cache if k not in jobs]:
+                del self._job_cache[stale]
+            self._release_departed(jobs)
+            self._finish_due_evictions(jobs)
+            self._adopt_admitted(jobs, lqs, cqs)
+            admissions = self._admission_passes(jobs, lqs, cqs)
+            self._maybe_preempt(jobs, lqs, cqs)
+            self._publish(jobs, lqs, cqs)
+            return admissions
+
+    # -- helpers -----------------------------------------------------------
+    def _key(self, job) -> str:
+        return f"{job.metadata.namespace}/{job.metadata.name}"
+
+    def _job_facts(self, key: str, job) -> tuple:
+        """(demand, valid) memoized by resourceVersion."""
+        rv = job.metadata.resource_version
+        cached = self._job_cache.get(key)
+        if cached is not None and cached[0] == rv:
+            return cached[1], cached[2]
+        try:
+            errs = validate_mpijob(set_defaults_mpijob(deep_copy(job)))
+            demand = job_demand(job) if not errs else None
+        except Exception as exc:
+            # Validation does not cover everything the demand math
+            # consumes (e.g. an unparsable resource quantity): a single
+            # malformed stored job must degrade to "invalid", never
+            # wedge the whole reconcile loop.
+            errs, demand = [f"demand computation failed: {exc}"], None
+        valid = not errs
+        if not valid:
+            self._warn_invalid(f"job-invalid/{key}", "MPIJob", key, errs)
+        self._job_cache[key] = (rv, demand, valid)
+        return demand, valid
+
+    def _load_queues(self):
+        cqs: Dict[str, object] = {}
+        # ClusterQueue NAMES are cluster-scoped (LocalQueue.spec.
+        # cluster_queue is a bare name), even though the store keys
+        # objects per namespace: same-named objects in different
+        # namespaces would otherwise collide last-listed-wins.  Keep
+        # the (namespace, name)-first one deterministically, warn once
+        # about the rest.
+        listed = sorted(self.client.server.list(SCHED_GROUP_VERSION,
+                                                CLUSTER_QUEUE_KIND),
+                        key=lambda q: (q.metadata.namespace,
+                                       q.metadata.name))
+        for cq in listed:
+            cq = set_defaults_clusterqueue(cq)
+            errs = validate_clusterqueue(cq)
+            if errs:
+                self._warn_invalid(f"cq/{cq.metadata.name}",
+                                   "ClusterQueue", cq.metadata.name, errs)
+                continue
+            if cq.metadata.name in cqs:
+                self._warn_invalid(
+                    f"cq-dup/{cq.metadata.namespace}/{cq.metadata.name}",
+                    "ClusterQueue", cq.metadata.name,
+                    [f"duplicate cluster-scoped name (kept the one in"
+                     f" namespace"
+                     f" {cqs[cq.metadata.name].metadata.namespace!r})"])
+                continue
+            cqs[cq.metadata.name] = cq
+        lqs: Dict[tuple, object] = {}
+        for lq in self.client.server.list(SCHED_GROUP_VERSION,
+                                          LOCAL_QUEUE_KIND, self.namespace):
+            errs = validate_localqueue(lq)
+            if errs:
+                self._warn_invalid(
+                    f"lq/{lq.metadata.namespace}/{lq.metadata.name}",
+                    "LocalQueue", lq.metadata.name, errs)
+                continue
+            lqs[(lq.metadata.namespace, lq.metadata.name)] = lq
+        return cqs, lqs
+
+    def _warn_invalid(self, dedup_key: str, kind: str, name: str,
+                      errs: list) -> None:
+        if dedup_key in self._invalid_warned:
+            return
+        if len(self._invalid_warned) > 4096:
+            self._invalid_warned.clear()
+        self._invalid_warned.add(dedup_key)
+        logger.warning("ignoring invalid %s %s: %s", kind, name,
+                       "; ".join(map(str, errs)))
+
+    def _cq_of(self, job, lqs, cqs):
+        queue = job_queue_name(job)
+        if not queue:
+            return None
+        lq = lqs.get((job.metadata.namespace, queue))
+        if lq is None:
+            return None
+        return cqs.get(lq.spec.cluster_queue)
+
+    def _nominal(self, cq) -> Dict[str, float]:
+        return {res: float(parse_quantity(quantity))
+                for res, quantity in (cq.spec.quotas or {}).items()}
+
+    def _usage(self) -> Dict[str, Dict[str, float]]:
+        used: Dict[str, Dict[str, float]] = {}
+        for rec in self._admitted.values():
+            bucket = used.setdefault(rec["cq"], {})
+            for res, amount in rec["demand"].items():
+                bucket[res] = bucket.get(res, 0.0) + amount
+        return used
+
+    def _quota_allows(self, cq, demand, cqs,
+                      usage: Dict[str, Dict[str, float]]) -> bool:
+        nominal = self._nominal(cq)
+        cq_used = usage.get(cq.metadata.name, {})
+        over = [res for res in nominal
+                if cq_used.get(res, 0.0) + demand.get(res, 0)
+                > nominal[res]]
+        if not over:
+            return True
+        if not cq.spec.cohort or not cq.spec.borrowing:
+            return False
+        # Borrow: the whole cohort's pooled nominal quota must still
+        # cover the cohort's pooled usage plus this demand.
+        members = [c for c in cqs.values()
+                   if c.spec.cohort == cq.spec.cohort]
+        for res in over:
+            pooled_nominal = sum(self._nominal(c).get(res, 0.0)
+                                 for c in members
+                                 if res in self._nominal(c))
+            pooled_used = sum(usage.get(c.metadata.name, {}).get(res, 0.0)
+                              for c in members)
+            if pooled_used + demand.get(res, 0) > pooled_nominal:
+                return False
+        return True
+
+    # -- release / adoption ------------------------------------------------
+    def _release_departed(self, jobs) -> None:
+        for key in list(self._admitted):
+            job = jobs.get(key)
+            if job is not None and not is_finished(job.status):
+                if job.spec.run_policy.suspend:
+                    # A suspended admitted gang must not hold chips:
+                    # evict (the controller's own suspend cleanup sits
+                    # behind the admission gate, which this flip shuts)
+                    # and requeue — resume re-admits it like any other
+                    # pending job.
+                    rec = self._admitted[key]
+                    self._set_conditions(
+                        rec["ns"], rec["name"], admitted=False,
+                        reason=MPI_JOB_QUEUED_REASON,
+                        message="suspended: capacity released; the job"
+                                " requeues on resume")
+                    self._evict_now(job, EVICT_REQUEUED)
+                    self._release(key)
+                    self._preempting.pop(key, None)
+                continue
+            self._release(key)
+            self._preempting.pop(key, None)
+            flight.record("sched", "released", job=key,
+                          gone=job is None)
+
+    def _release(self, key: str) -> None:
+        rec = self._admitted.pop(key, None)
+        if rec is None:
+            return
+        freed = self.pool.release(key)
+        blocked = self._blocked
+        if blocked is not None:
+            # While a gang is fenced, EVERY release accrues to its
+            # reservation (capped at its demand) — backfill cannot
+            # re-take freed capacity.  A backfilled job's own release
+            # grows free and reserved equally, so steady-state backfill
+            # concurrency is preserved while the reservation climbs
+            # monotonically toward the gang's demand: admission is
+            # bounded even under a sustained small-job arrival stream.
+            blocked["reserved"] = min(blocked["reserved"] + freed,
+                                      blocked["chips"])
+
+    def _adopt_admitted(self, jobs, lqs, cqs) -> None:
+        """Re-place jobs already carrying Admitted=True that this
+        scheduler instance does not know (restart resilience).  A job
+        that no longer fits is evicted and requeued immediately."""
+        for key, job in sorted(jobs.items()):
+            if key in self._admitted or is_finished(job.status) \
+                    or job.spec.run_policy.suspend:
+                continue
+            cond = get_condition(job.status, constants.JOB_ADMITTED)
+            if cond is None or cond.status != core.CONDITION_TRUE:
+                continue
+            cq = self._cq_of(job, lqs, cqs)
+            demand, valid = self._job_facts(key, job)
+            chips = demand[constants.TPU_RESOURCE] if valid else 0
+            if cq is not None and valid \
+                    and self.pool.place(key, chips) is not None:
+                self._epoch += 1
+                self._admitted[key] = {
+                    "cq": cq.metadata.name, "demand": demand,
+                    "chips": chips, "epoch": self._epoch,
+                    "ns": job.metadata.namespace,
+                    "name": job.metadata.name}
+                self.metrics["admissions"].labels("adopted").inc()
+            else:
+                self._set_conditions(
+                    job.metadata.namespace, job.metadata.name,
+                    admitted=False, reason=MPI_JOB_QUEUED_REASON,
+                    message="re-queued: admitted placement no longer"
+                            " fits (scheduler restart)")
+                self._evict_now(job, EVICT_REQUEUED)
+
+    # -- eviction protocol -------------------------------------------------
+    def _begin_eviction(self, key: str, reason: str,
+                        grace: Optional[float] = None,
+                        message: str = "") -> None:
+        """Open the checkpoint grace window for an admitted gang: flip
+        it back to Queued (the controller gate stops recreating pods),
+        deliver the kubelet preemption notice to its running worker
+        pods, and schedule the eviction.  Chips stay held until the
+        window closes — the gang is still on the hardware."""
+        if key in self._preempting or key not in self._admitted:
+            return
+        grace = self.checkpoint_grace if grace is None else grace
+        rec = self._admitted[key]
+        cond_reason = (MPI_JOB_SPOT_RECLAIMED_REASON
+                       if reason == EVICT_SPOT_RECLAIM
+                       else MPI_JOB_PREEMPTED_REASON)
+        self._set_conditions(
+            rec["ns"], rec["name"], admitted=False, reason=cond_reason,
+            message=message or "preempted: checkpoint grace window open")
+        noticed = self._notify_pods(rec["ns"], rec["name"], grace)
+        self.metrics["preemption_notices"].inc()
+        self._preempting[key] = {
+            "deadline": time.monotonic() + grace, "reason": reason}
+        flight.record("sched", "preemption_notice", job=key,
+                      reason=reason, grace=grace, pods_noticed=noticed)
+
+    def _notify_pods(self, namespace: str, name: str, grace: float) -> int:
+        if self.kubelet is None:
+            return 0
+        from ..controller import builders
+        selector = builders.worker_selector(name)
+        noticed = 0
+        try:
+            pods = self.client.server.list("v1", "Pod", namespace)
+        except Exception:
+            return 0
+        for pod in pods:
+            if not match_labels(selector, pod.metadata.labels):
+                continue
+            if pod.status.phase != core.POD_RUNNING:
+                continue
+            try:
+                if self.kubelet.inject_preemption(
+                        namespace, pod.metadata.name, grace=grace):
+                    noticed += 1
+            except Exception:
+                continue
+        return noticed
+
+    def _finish_due_evictions(self, jobs) -> None:
+        now = time.monotonic()
+        for key in sorted(self._preempting):
+            state = self._preempting[key]
+            if now < state["deadline"]:
+                continue
+            self._preempting.pop(key)
+            job = jobs.get(key)
+            if job is not None:
+                self._evict_now(job, state["reason"])
+            self._release(key)
+
+    def _evict_now(self, job, reason: str) -> None:
+        """Delete the gang's pods and launcher Job.  The checkpoint on
+        disk is untouched — the requeued job resumes from it on
+        re-admission."""
+        from ..controller import builders
+        ns = job.metadata.namespace
+        selector = builders.worker_selector(job.metadata.name)
+        try:
+            pods = self.client.server.list("v1", "Pod", ns)
+        except Exception:
+            pods = []
+        for pod in pods:
+            if not match_labels(selector, pod.metadata.labels):
+                continue
+            try:
+                self.client.pods(ns).delete(pod.metadata.name)
+            except Exception as exc:
+                if not is_not_found(exc):
+                    logger.warning("evicting pod %s/%s: %s", ns,
+                                   pod.metadata.name, exc)
+        try:
+            self.client.jobs(ns).delete(builders.launcher_name(job))
+        except Exception as exc:
+            if not is_not_found(exc):
+                logger.warning("evicting launcher of %s/%s: %s", ns,
+                               job.metadata.name, exc)
+        self.metrics["evictions"].labels(reason).inc()
+        self.recorder.event(
+            job, core.EVENT_TYPE_WARNING, "GangEvicted",
+            f"gang evicted ({reason}); requeued with checkpoint intact")
+        flight.record("sched", "evicted", job=self._key(job),
+                      reason=reason)
+
+    # -- admission ---------------------------------------------------------
+    def _pending(self, jobs, lqs, cqs) -> List[tuple]:
+        """(cq, job) pending candidates: queue-labeled, not admitted,
+        not finished, not suspended, valid."""
+        out = []
+        for key, job in jobs.items():
+            if key in self._admitted or key in self._preempting:
+                continue
+            if is_finished(job.status) or job.spec.run_policy.suspend:
+                continue
+            if not job_queue_name(job):
+                continue
+            cq = self._cq_of(job, lqs, cqs)
+            if cq is None:
+                self._warn_invalid(f"job-queue/{key}", "MPIJob queue",
+                                   key, ["unknown LocalQueue/ClusterQueue "
+                                         f"{job_queue_name(job)!r}"])
+                continue
+            _, valid = self._job_facts(key, job)
+            if not valid:
+                continue
+            out.append((cq, job))
+        return out
+
+    def _order(self, pending: List[tuple],
+               usage: Dict[str, Dict[str, float]]) -> List[tuple]:
+        """Admission walk order.  Both modes sort a queue's jobs by
+        (priority desc, age, name); fair-share mode interleaves queues
+        by ascending used-chips/weight (dominant share), FIFO mode
+        concatenates everything in global arrival order."""
+        def job_sort_key(item):
+            _, job = item
+            return (-job_priority(job),
+                    str(job.metadata.creation_timestamp or ""),
+                    job.metadata.name)
+
+        if not self.fair_share:
+            return sorted(pending, key=job_sort_key)
+        by_cq: Dict[str, List[tuple]] = {}
+        for cq, job in pending:
+            by_cq.setdefault(cq.metadata.name, []).append((cq, job))
+        for items in by_cq.values():
+            items.sort(key=job_sort_key)
+        shares = {
+            name: usage.get(name, {}).get(constants.TPU_RESOURCE, 0.0)
+            / (by_cq[name][0][0].spec.weight or 1.0)
+            for name in by_cq}
+        out: List[tuple] = []
+        # Round-robin queues in ascending share; within a round each
+        # queue contributes its current front job.
+        while by_cq:
+            for name in sorted(by_cq, key=lambda n: (shares[n], n)):
+                out.append(by_cq[name].pop(0))
+                if not by_cq[name]:
+                    del by_cq[name]
+        return out
+
+    def _backfillable_free(self) -> int:
+        free = self.pool.free_chips
+        if self._blocked is None:
+            return free
+        return max(0, free - self._blocked["reserved"])
+
+    def _admission_passes(self, jobs, lqs, cqs) -> int:
+        admissions = 0
+        while True:
+            usage = self._usage()
+            pending = self._pending(jobs, lqs, cqs)
+            order = self._order(pending, usage)
+            if not order:
+                if self._blocked is not None:
+                    self._blocked = None
+                return admissions
+            # The reservation protects ONE gang; release the fence once
+            # that gang stops being pending (admitted or gone).
+            # Strictly HIGHER-priority jobs are never fence-gated (see
+            # is_backfill below) — they outrank the fenced gang
+            # everywhere else (admission order, preemption), so the
+            # fence only holds back peers and lower classes.
+            pending_keys = {self._key(job) for _, job in order}
+            if self._blocked is not None \
+                    and self._blocked["key"] not in pending_keys:
+                self._blocked = None
+            admitted_this_walk = False
+            # Queues whose front (oldest eligible) job failed QUOTA this
+            # walk: younger same-queue jobs may only pass it as
+            # backfill — counted, annotated, and refused entirely when
+            # backfill is off (per-queue head-of-line).  Quota headroom
+            # freed later is re-offered to the older job first (it
+            # walks earlier), so the jump is a visible policy, not a
+            # silent starvation (docs/SCHEDULING.md).
+            quota_blocked_queues: set = set()
+            for position, (cq, job) in enumerate(order):
+                key = self._key(job)
+                demand, _ = self._job_facts(key, job)
+                chips = demand[constants.TPU_RESOURCE]
+                if not self._quota_allows(cq, demand, cqs, usage):
+                    if not self.backfill and not self.fair_share:
+                        break  # strict FIFO: head-of-line blocks on quota too
+                    quota_blocked_queues.add(cq.metadata.name)
+                    continue
+                # Fence-gated = a DIFFERENT job of priority <= the
+                # fenced gang's; a strictly higher-priority job uses
+                # the full free pool (the fence must not priority-
+                # invert) and, if capacity-blocked itself, TAKES the
+                # fence over below.
+                outranks_fence = self._blocked is not None \
+                    and job_priority(job) > self._blocked["priority"]
+                is_backfill = (self._blocked is not None
+                               and self._blocked["key"] != key
+                               and not outranks_fence) \
+                    or cq.metadata.name in quota_blocked_queues
+                if is_backfill:
+                    if not self.backfill:
+                        if cq.metadata.name in quota_blocked_queues:
+                            continue  # this queue is blocked; others may go
+                        break
+                    if self._blocked is not None \
+                            and chips > self._backfillable_free():
+                        self.metrics["backfill_denied"].inc()
+                        continue
+                placement = self.pool.place(key, chips)
+                if placement is None:
+                    # Capacity-blocked front (or a job outranking the
+                    # current fence owner): arm — or take over — the
+                    # fence.  EXCEPT when the gang exceeds the pool
+                    # outright: a demand no amount of freeing can
+                    # satisfy must not reserve capacity away from
+                    # everyone else forever.
+                    if (self._blocked is None or outranks_fence) \
+                            and chips <= self.pool.total_chips:
+                        self._blocked = {"key": key,
+                                         "reserved": 0,
+                                         "chips": chips,
+                                         "priority": job_priority(job)}
+                    if not self.backfill:
+                        break  # head-of-line blocking (FIFO baseline)
+                    continue
+                self._admit(job, cq, demand, chips, placement,
+                            "backfill" if is_backfill else "front")
+                if self._blocked is not None \
+                        and self._blocked["key"] == key:
+                    self._blocked = None
+                admissions += 1
+                admitted_this_walk = True
+                break  # usage changed: recompute the walk
+            if not admitted_this_walk:
+                return admissions
+
+    def _admit(self, job, cq, demand, chips, placement,
+               path: str) -> None:
+        key = self._key(job)
+        self._epoch += 1
+        self._admitted[key] = {
+            "cq": cq.metadata.name, "demand": demand, "chips": chips,
+            "epoch": self._epoch, "ns": job.metadata.namespace,
+            "name": job.metadata.name}
+        slices = ",".join(f"{name}:{take}"
+                          for name, take in sorted(placement.items()))
+        self._set_conditions(
+            job.metadata.namespace, job.metadata.name, admitted=True,
+            reason=MPI_JOB_ADMITTED_REASON,
+            message=f"gang admitted by queue {job_queue_name(job)}"
+                    f" ({chips} chips on {slices or 'zero slices'})",
+            slices=slices, backfilled=(path == "backfill"))
+        created = job.metadata.creation_timestamp
+        if created is not None:
+            wait = (self.clock.now() - created).total_seconds()
+            if wait >= 0:
+                self.metrics["admission_wait"].observe(wait)
+        self.metrics["admissions"].labels(path).inc()
+        self.recorder.event(
+            job, core.EVENT_TYPE_NORMAL, "GangAdmitted",
+            f"admitted via {path}: {chips} chips on [{slices}]")
+        flight.record("sched", "admitted", job=key, path=path,
+                      chips=chips, slices=slices)
+
+    # -- preemption --------------------------------------------------------
+    def _maybe_preempt(self, jobs, lqs, cqs) -> None:
+        if not self.preemption:
+            return
+        usage = self._usage()
+        pending = self._pending(jobs, lqs, cqs)
+        if not pending:
+            return
+        # Preemption is a PRIORITY right, independent of the fair-share
+        # walk order: consider pending jobs in global (priority desc,
+        # age) order and act for the FIRST one that is entitled to and
+        # helped by eviction.  A front in a preemption-disabled queue
+        # (or one even full eviction could not fit) must not block the
+        # next candidate's claim — at most one victim set per pass.
+        ranked = sorted(pending, key=lambda item: (
+            -job_priority(item[1]),
+            str(item[1].metadata.creation_timestamp or ""),
+            item[1].metadata.name))
+        for cq, front in ranked:
+            if not cq.spec.preemption:
+                continue
+            if self._try_preempt_for(cq, front, jobs, cqs, usage):
+                return
+
+    def _try_preempt_for(self, cq, front, jobs, cqs, usage) -> bool:
+        """Evaluate one pending job's preemption claim; returns True
+        when a victim set was selected (notices delivered) OR the job
+        needs no eviction (pending evictions already cover it) — both
+        mean no lower-ranked job should preempt this pass."""
+        priority = job_priority(front)
+        demand, _ = self._job_facts(self._key(front), front)
+        chips = demand[constants.TPU_RESOURCE]
+        # Victims already inside an open grace window release their
+        # chips and quota when it closes: count that as pending-free,
+        # or every reconcile tick during the window would select a
+        # fresh (unnecessary) victim set.
+        # Online chips only: a reclaim victim's chips on the yanked
+        # slice never come back, and counting them would defer real
+        # victim selection by a full grace window.
+        pending_free = sum(self.pool.online_chips_of(k)
+                           for k in self._preempting
+                           if k in self._admitted)
+        hypo_usage = {name: dict(used) for name, used in usage.items()}
+        for key in self._preempting:
+            rec = self._admitted.get(key)
+            if rec is None:
+                continue
+            bucket = hypo_usage.setdefault(rec["cq"], {})
+            for res, amount in rec["demand"].items():
+                bucket[res] = bucket.get(res, 0.0) - amount
+        if chips <= self.pool.free_chips + pending_free \
+                and self._quota_allows(cq, demand, cqs, hypo_usage):
+            return True  # fits (or will, once pending evictions land)
+        # Victims: strictly lower-priority admitted jobs in the same
+        # cohort (or same queue when no cohort), cheapest first to
+        # evict: lowest priority, then most recently admitted.  A
+        # victim's release frees BOTH its chips and its quota, so the
+        # quota check runs against the hypothetical post-eviction usage.
+        cohort = cq.spec.cohort
+        candidates = []
+        for key, rec in self._admitted.items():
+            if key in self._preempting:
+                continue
+            victim_cq = cqs.get(rec["cq"])
+            if victim_cq is None:
+                continue
+            same_pool = (victim_cq.metadata.name == cq.metadata.name
+                         or (cohort and victim_cq.spec.cohort == cohort))
+            if not same_pool:
+                continue
+            victim_job = jobs.get(key)
+            if victim_job is None:
+                continue
+            victim_priority = job_priority(victim_job)
+            if victim_priority >= priority:
+                continue
+            candidates.append((victim_priority, -rec["epoch"], key, rec))
+        candidates.sort(key=lambda c: c[:3])
+        freed = pending_free
+        victims = []
+        for _, _, key, rec in candidates:
+            if chips <= self.pool.free_chips + freed \
+                    and self._quota_allows(cq, demand, cqs, hypo_usage):
+                break
+            victims.append(key)
+            freed += rec["chips"]
+            bucket = hypo_usage.setdefault(rec["cq"], {})
+            for res, amount in rec["demand"].items():
+                bucket[res] = bucket.get(res, 0.0) - amount
+        if chips > self.pool.free_chips + freed \
+                or not self._quota_allows(cq, demand, cqs, hypo_usage):
+            # Even evicting every candidate would not fit: this claim
+            # is unservable — let the next-ranked candidate try.
+            return False
+        for key in victims:
+            self._begin_eviction(
+                key, EVICT_PREEMPTED,
+                message=f"preempted by higher-priority "
+                        f"{self._key(front)} (priority {priority})")
+        return True
+
+    # -- status / conditions ----------------------------------------------
+    def _set_conditions(self, namespace: str, name: str, admitted: bool,
+                        reason: str, message: str, slices: str = "",
+                        backfilled: bool = False) -> None:
+        for _ in range(5):
+            try:
+                job = self.client.mpi_jobs(namespace).get(name)
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                raise
+            changed = update_job_conditions(
+                job, constants.JOB_ADMITTED,
+                core.CONDITION_TRUE if admitted else core.CONDITION_FALSE,
+                reason, message, self.clock)
+            changed |= update_job_conditions(
+                job, constants.JOB_QUEUED,
+                core.CONDITION_FALSE if admitted else core.CONDITION_TRUE,
+                reason, message, self.clock)
+            annotations = dict(job.metadata.annotations or {})
+            if admitted:
+                annotations[constants.SCHED_SLICES_ANNOTATION] = slices
+                if backfilled:
+                    annotations[constants.SCHED_BACKFILL_ANNOTATION] = "true"
+                else:
+                    # A re-admission via the front path must not keep a
+                    # stale backfill marker from an earlier life.
+                    annotations.pop(constants.SCHED_BACKFILL_ANNOTATION,
+                                    None)
+            else:
+                annotations.pop(constants.SCHED_SLICES_ANNOTATION, None)
+                annotations.pop(constants.SCHED_BACKFILL_ANNOTATION, None)
+            meta_changed = annotations != (job.metadata.annotations or {})
+            if not changed and not meta_changed:
+                return
+            try:
+                if meta_changed:
+                    job.metadata.annotations = annotations
+                    job = self.client.mpi_jobs(namespace).update(job)
+                    # update() preserves stored status; re-apply ours.
+                    changed = update_job_conditions(
+                        job, constants.JOB_ADMITTED,
+                        core.CONDITION_TRUE if admitted
+                        else core.CONDITION_FALSE,
+                        reason, message, self.clock)
+                    changed |= update_job_conditions(
+                        job, constants.JOB_QUEUED,
+                        core.CONDITION_FALSE if admitted
+                        else core.CONDITION_TRUE,
+                        reason, message, self.clock)
+                if changed:
+                    self.client.mpi_jobs(namespace).update_status(job)
+                return
+            except Exception as exc:
+                if is_conflict(exc):
+                    continue
+                raise
+        logger.warning("condition write retry budget exhausted for %s/%s",
+                       namespace, name)
+
+    def _publish(self, jobs, lqs, cqs) -> None:
+        """Per-queue gauges + ClusterQueue/LocalQueue status."""
+        usage = self._usage()
+        pending_cq: Dict[str, int] = {}
+        pending_lq: Dict[tuple, int] = {}
+        admitted_lq: Dict[tuple, int] = {}
+        admitted_cq: Dict[str, int] = {}
+        for key, rec in self._admitted.items():
+            admitted_cq[rec["cq"]] = admitted_cq.get(rec["cq"], 0) + 1
+        for cq, job in self._pending(jobs, lqs, cqs):
+            pending_cq[cq.metadata.name] = \
+                pending_cq.get(cq.metadata.name, 0) + 1
+            # Make the wait visible on the job itself (the controller
+            # also writes Queued when it syncs a gated job; this covers
+            # quota/capacity-blocked jobs between controller syncs).
+            queued = get_condition(job.status, constants.JOB_QUEUED)
+            if queued is None or queued.status != core.CONDITION_TRUE:
+                self._set_conditions(
+                    job.metadata.namespace, job.metadata.name,
+                    admitted=False, reason=MPI_JOB_QUEUED_REASON,
+                    message=f"queued in {job_queue_name(job)}: waiting"
+                            f" for quota/capacity")
+        for key, job in jobs.items():
+            queue = job_queue_name(job)
+            if not queue:
+                continue
+            lq_key = (job.metadata.namespace, queue)
+            if key in self._admitted:
+                admitted_lq[lq_key] = admitted_lq.get(lq_key, 0) + 1
+            elif not is_finished(job.status):
+                pending_lq[lq_key] = pending_lq.get(lq_key, 0) + 1
+        self.metrics["free_chips"].set(self.pool.free_chips)
+        for name, cq in cqs.items():
+            self.metrics["pending"].labels(name).set(
+                pending_cq.get(name, 0))
+            self.metrics["admitted"].labels(name).set(
+                admitted_cq.get(name, 0))
+            self.metrics["used_chips"].labels(name).set(
+                usage.get(name, {}).get(constants.TPU_RESOURCE, 0))
+            self._update_cq_status(cq, usage.get(name, {}),
+                                   pending_cq.get(name, 0),
+                                   admitted_cq.get(name, 0))
+        for (ns, name), lq in lqs.items():
+            self._update_lq_status(lq, pending_lq.get((ns, name), 0),
+                                   admitted_lq.get((ns, name), 0))
+
+    def _update_cq_status(self, cq, used: Dict[str, float],
+                          pending: int, admitted: int) -> None:
+        desired = {res: str(int(amount)) for res, amount
+                   in sorted(used.items())}
+        if (cq.status.used == desired
+                and cq.status.pending_jobs == pending
+                and cq.status.admitted_jobs == admitted):
+            return
+        for _ in range(3):
+            try:
+                fresh = self.client.cluster_queues(
+                    cq.metadata.namespace).get(cq.metadata.name)
+                fresh.status.used = desired
+                fresh.status.pending_jobs = pending
+                fresh.status.admitted_jobs = admitted
+                self.client.cluster_queues(
+                    cq.metadata.namespace).update_status(fresh)
+                return
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                if not is_conflict(exc):
+                    logger.debug("cq status write failed: %s", exc)
+                    return
+
+    def _update_lq_status(self, lq, pending: int, admitted: int) -> None:
+        if (lq.status.pending_jobs == pending
+                and lq.status.admitted_jobs == admitted):
+            return
+        for _ in range(3):
+            try:
+                fresh = self.client.local_queues(
+                    lq.metadata.namespace).get(lq.metadata.name)
+                fresh.status.pending_jobs = pending
+                fresh.status.admitted_jobs = admitted
+                self.client.local_queues(
+                    lq.metadata.namespace).update_status(fresh)
+                return
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                if not is_conflict(exc):
+                    logger.debug("lq status write failed: %s", exc)
+                    return

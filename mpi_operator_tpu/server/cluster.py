@@ -26,7 +26,9 @@ class LocalCluster:
                  threadiness: int = 2,
                  run_pods: bool = True,
                  gang_capacity: Optional[int] = None,
-                 client: Optional[Clientset] = None):
+                 client: Optional[Clientset] = None,
+                 sched_slices=None,
+                 sched_options: Optional[dict] = None):
         # An injected client lets the identical stack run over a remote
         # transport (e.g. KubeApiServer against kube path grammar).
         self.client = client or Clientset()
@@ -44,6 +46,18 @@ class LocalCluster:
         self.gang_sim = GangSchedulerSim(
             self.client, capacity=gang_capacity, namespace=namespace) \
             if gang_scheduler and run_pods else None
+        # The in-house gang scheduler (sched/, docs/SCHEDULING.md):
+        # `sched_slices` (a list of TpuSlice) turns on quota/fair-share
+        # admission over that capacity; queue-labeled MPIJobs then gate
+        # on its Queued -> Admitted conditions.
+        self.scheduler = None
+        if sched_slices:
+            from ..sched import GangScheduler, SlicePool
+            self.scheduler = GangScheduler(
+                self.client, SlicePool(list(sched_slices)),
+                kubelet=self.kubelet, namespace=namespace,
+                registry=self.controller.metrics.get("registry"),
+                **(sched_options or {}))
         self._threadiness = threadiness
         self._started = False
 
@@ -54,12 +68,16 @@ class LocalCluster:
             self.kubelet.start()
         if self.gang_sim is not None:
             self.gang_sim.start()
+        if self.scheduler is not None:
+            self.scheduler.start()
         self._started = True
         return self
 
     def stop(self) -> None:
         if not self._started:
             return
+        if self.scheduler is not None:
+            self.scheduler.stop()
         if self.gang_sim is not None:
             self.gang_sim.stop()
         if self.kubelet is not None:

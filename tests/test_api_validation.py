@@ -188,3 +188,32 @@ def test_exit_code_restart_policy_worker_only():
     errs = validate_mpijob(job)
     assert any("Launcher" in e.field and "restartPolicy" in e.field
                for e in errs)
+
+
+def test_min_available_must_be_positive():
+    from mpi_operator_tpu.api.types import SchedulingPolicy
+
+    for bad in (0, -3):
+        job = valid_job(workers=4)
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+            min_available=bad)
+        errs = validate_mpijob(job)
+        assert any("minAvailable" in e.field and "greater than 0"
+                   in e.message for e in errs), bad
+
+
+def test_min_available_beyond_gang_size_rejected():
+    from mpi_operator_tpu.api.types import SchedulingPolicy
+
+    # A gang of workerReplicas + 1 members can never assemble more:
+    # admission-time rejection instead of a silent deadlock.
+    job = valid_job(workers=4)
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(min_available=6)
+    errs = validate_mpijob(job)
+    assert any("minAvailable" in e.field and "deadlock" in e.message
+               for e in errs)
+    # The boundary (workers + launcher) is legal, as is any smaller gang.
+    for ok in (5, 1):
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+            min_available=ok)
+        assert validate_mpijob(job) == [], ok

@@ -187,3 +187,91 @@ spec:
             cluster.wait(timeout=10)
         except subprocess.TimeoutExpired:
             cluster.kill()
+
+
+def test_cli_queues_verb(tmp_path):
+    """`queues` against a live cluster running the gang scheduler
+    (--slices): a small queue-labeled job is admitted and runs, a big
+    gang stays queued, and the table reports both."""
+    port = free_port()
+    master = f"http://127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    cluster = subprocess.Popen(
+        [sys.executable, "-m", "mpi_operator_tpu", "cluster", "--port",
+         str(port), "--slices", "1x8"], env=env, cwd=REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=1):
+                    break
+            except OSError:
+                time.sleep(0.2)
+
+        from mpi_operator_tpu.api import constants
+        from mpi_operator_tpu.k8s.apiserver import Clientset
+        from mpi_operator_tpu.k8s.http_api import RemoteApiServer
+        from mpi_operator_tpu.sched import ClusterQueue, LocalQueue
+
+        client = Clientset(server=RemoteApiServer(master))
+        cq = ClusterQueue()
+        cq.metadata.name = "cq-main"
+        cq.metadata.namespace = "default"
+        cq.spec.quotas = {constants.TPU_RESOURCE: "8"}
+        client.cluster_queues("default").create(cq)
+        lq = LocalQueue()
+        lq.metadata.name = "main"
+        lq.metadata.namespace = "default"
+        lq.spec.cluster_queue = "cq-main"
+        client.local_queues("default").create(lq)
+
+        # Empty-queue table renders (exercise the no-jobs path first).
+        proc = run_cli("queues", "--master", master)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "cq-main" in proc.stdout and "tpu=8" in proc.stdout
+
+        from test_controller import new_mpi_job
+        small = new_mpi_job(name="queued-small", workers=1,
+                            impl=constants.IMPL_JAX)
+        small.metadata.labels[constants.QUEUE_NAME_LABEL] = "main"
+        for rtype in small.spec.mpi_replica_specs.values():
+            c = rtype.template.spec.containers[0]
+            c.command = [sys.executable, "-c", "import time; time.sleep(30)"]
+        small.spec.run_launcher_as_worker = True
+        client.mpi_jobs("default").create(small)
+        gang = new_mpi_job(name="queued-gang", workers=63,
+                           impl=constants.IMPL_JAX)
+        gang.metadata.labels[constants.QUEUE_NAME_LABEL] = "main"
+        client.mpi_jobs("default").create(gang)
+
+        def table():
+            proc = run_cli("queues", "--master", master)
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+            return proc.stdout
+
+        deadline = time.monotonic() + 30
+        row = ""
+        while time.monotonic() < deadline:
+            out = table()
+            row = next(line for line in out.splitlines()
+                       if line.startswith("cq-main"))
+            fields = row.split()
+            if fields[5] == "1" and fields[6] == "1":  # pending, admitted
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"queues never converged; last: {row!r}")
+        assert "tpu=2" in row  # scheduler-published usage (1 worker + launcher)
+
+        # `get` surfaces the admission conditions too.
+        proc = run_cli("get", "--master", master)
+        assert "queued-gang" in proc.stdout and "Queued" in proc.stdout
+    finally:
+        cluster.terminate()
+        try:
+            cluster.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            cluster.kill()

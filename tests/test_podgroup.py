@@ -215,3 +215,51 @@ def test_pod_group_scheduled_sched_plugins_phases():
     for phase in ("Scheduled", "Running", "Finished"):
         pg.status = {"phase": phase}
         assert ctrl.pod_group_scheduled(pg)[0] is True, phase
+
+
+def test_min_resource_requests_win_over_limits():
+    # addResources precedence: requests win; limits only fill gaps.
+    job = new_mpi_job(workers=2)
+    job.worker_spec.template.spec.containers[0].resources = \
+        ResourceRequirements(requests={"cpu": "2"},
+                             limits={"cpu": "8", "memory": "1Gi"})
+    res = cal_pg_min_resource(3, job)
+    assert res["cpu"] == "4"                 # 2 workers x request 2, not limit 8
+    assert res["memory"] == "2147483648"     # limit fills the missing request
+
+
+def test_min_resource_priority_order_trims_lower_class():
+    # With distinct priorities, the LOWER-priority replica type is
+    # trimmed to minMember - 1 (calPGMinResource :337-388) — here the
+    # launcher outranks the workers, so workers are cut.
+    job = job_with_resources(workers=4, launcher_req={"cpu": "1"},
+                             worker_req={"cpu": "2"})
+    job.launcher_spec.template.spec.priority_class_name = "high"
+    job.worker_spec.template.spec.priority_class_name = "low"
+    classes = {"high": 100, "low": 1}
+    res = cal_pg_min_resource(3, job, priority_class_lister=classes.get)
+    assert res["cpu"] == "5"  # launcher 1 + (3-1) workers x 2
+
+
+def test_min_resource_policy_min_resources_short_circuits():
+    # calculatePGMinResources: an explicit schedulingPolicy.minResources
+    # wins over the computed sum.
+    cs = Clientset()
+    ctrl = VolcanoCtrl(cs)
+    job = job_with_resources(workers=2, worker_req={"cpu": "4"})
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+        min_resources={"cpu": "1"})
+    assert ctrl.calculate_pg_min_resources(3, job) == {"cpu": "1"}
+
+
+def test_min_available_feeds_sched_demand():
+    # The sched/ subsystem admits on exactly this math: minAvailable
+    # members, priority-ordered TPU-chip sum (docs/SCHEDULING.md).
+    from mpi_operator_tpu.api.types import SchedulingPolicy as SP
+    from mpi_operator_tpu.sched import job_demand
+
+    job = job_with_resources(workers=4,
+                             worker_req={"google.com/tpu": "8"})
+    assert job_demand(job) == {"pods": 5, "google.com/tpu": 32}
+    job.spec.run_policy.scheduling_policy = SP(min_available=3)
+    assert job_demand(job)["pods"] == 3

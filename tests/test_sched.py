@@ -1,0 +1,820 @@
+"""Gang scheduler subsystem tests (mpi_operator_tpu/sched/,
+docs/SCHEDULING.md): queue API surface, slice capacity model,
+quota/fair-share/backfill admission, checkpoint-then-evict preemption,
+spot reclamation, the controller admission gate, and the chaos
+opt-in/invariant wiring."""
+
+import datetime
+import time
+
+import pytest
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.types import (JobCondition, MPIJob, MPIJobSpec,
+                                        ReplicaSpec, RunPolicy)
+from mpi_operator_tpu.chaos.invariants import sched_no_partial_gangs
+from mpi_operator_tpu.chaos.plan import (RANDOMIZABLE_KINDS,
+                                         SCHED_RANDOMIZABLE_KINDS,
+                                         randomized_plan)
+from mpi_operator_tpu.controller.status import get_condition
+from mpi_operator_tpu.k8s import registry
+from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.k8s.core import (Container, PodSpec, PodTemplateSpec,
+                                       ResourceRequirements)
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+from mpi_operator_tpu.sched import (ClusterQueue, GangScheduler, LocalQueue,
+                                    SlicePool, TpuSlice, job_demand,
+                                    job_priority, job_queue_name,
+                                    set_defaults_clusterqueue,
+                                    validate_clusterqueue,
+                                    validate_localqueue)
+from mpi_operator_tpu.sched.api import SCHED_GROUP_VERSION
+
+
+def mk_job(name, workers, queue="q", prio=None, namespace="default",
+           tpu_per_worker=None):
+    meta = ObjectMeta(name=name, namespace=namespace)
+    if queue:
+        meta.labels = {constants.QUEUE_NAME_LABEL: queue}
+    if prio is not None:
+        meta.annotations = {constants.SCHED_PRIORITY_ANNOTATION: str(prio)}
+    worker_container = Container(name="w", image="img")
+    if tpu_per_worker is not None:
+        worker_container.resources = ResourceRequirements(
+            requests={constants.TPU_RESOURCE: str(tpu_per_worker)})
+    return MPIJob(metadata=meta, spec=MPIJobSpec(
+        slots_per_worker=1, ssh_auth_mount_path="/root/.ssh",
+        mpi_implementation=constants.IMPL_JAX,
+        run_policy=RunPolicy(clean_pod_policy="None"),
+        mpi_replica_specs={
+            constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                replicas=1, restart_policy="OnFailure",
+                template=PodTemplateSpec(spec=PodSpec(
+                    containers=[Container(name="l", image="img")]))),
+            constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                replicas=workers, restart_policy="Never",
+                template=PodTemplateSpec(spec=PodSpec(
+                    containers=[worker_container]))),
+        }))
+
+
+def mk_queues(cs, quotas=None, cq_name="cq", lq_name="q",
+              namespace="default", cohort="", weight=None,
+              borrowing=True, preemption=True):
+    cq = ClusterQueue()
+    cq.metadata.name = cq_name
+    cq.spec.quotas = dict(quotas or {})
+    cq.spec.cohort = cohort
+    cq.spec.weight = weight
+    cq.spec.borrowing = borrowing
+    cq.spec.preemption = preemption
+    cs.cluster_queues(namespace).create(cq)
+    lq = LocalQueue()
+    lq.metadata.name = lq_name
+    lq.metadata.namespace = namespace
+    lq.spec.cluster_queue = cq_name
+    cs.local_queues(namespace).create(lq)
+    return cq, lq
+
+
+def finish(cs, name, namespace="default"):
+    job = cs.mpi_jobs(namespace).get(name)
+    job.status.conditions.append(JobCondition(
+        type=constants.JOB_SUCCEEDED, status="True"))
+    job.status.completion_time = datetime.datetime.now(
+        datetime.timezone.utc)
+    cs.mpi_jobs(namespace).update_status(job)
+
+
+def admitted_status(cs, name, namespace="default"):
+    cond = get_condition(cs.mpi_jobs(namespace).get(name).status,
+                         constants.JOB_ADMITTED)
+    return cond.status if cond is not None else None
+
+
+# ---------------------------------------------------------------------------
+# API surface
+# ---------------------------------------------------------------------------
+
+def test_queue_kinds_registered_and_round_trip():
+    cq = ClusterQueue()
+    cq.metadata.name = "cq-a"
+    cq.metadata.namespace = "default"
+    cq.spec.quotas = {"google.com/tpu": "512", "pods": "600"}
+    cq.spec.cohort = "pool"
+    wire = registry.encode(cq)
+    back = registry.decode(wire)
+    assert isinstance(back, ClusterQueue)
+    assert back.spec.quotas == cq.spec.quotas
+    assert registry.lookup(SCHED_GROUP_VERSION, "LocalQueue") is LocalQueue
+
+    cs = Clientset()
+    created = cs.cluster_queues("default").create(cq)
+    assert created.metadata.uid
+    lq = LocalQueue()
+    lq.metadata.name = "q"
+    lq.spec.cluster_queue = "cq-a"
+    cs.local_queues("default").create(lq)
+    assert cs.local_queues("default").get("q").spec.cluster_queue == "cq-a"
+
+
+def test_queue_defaults_and_validation():
+    cq = ClusterQueue()
+    cq.metadata.name = "cq"
+    set_defaults_clusterqueue(cq)
+    assert cq.spec.weight == 1.0
+    assert validate_clusterqueue(cq) == []
+
+    cq.spec.weight = 0
+    assert any("weight" in str(e) for e in validate_clusterqueue(cq))
+    cq.spec.weight = 2.0
+    cq.spec.quotas = {"google.com/tpu": "not-a-number"}
+    assert any("quotas" in str(e) for e in validate_clusterqueue(cq))
+
+    lq = LocalQueue()
+    lq.metadata.name = "q"
+    assert any("clusterQueue" in str(e) for e in validate_localqueue(lq))
+    lq.spec.cluster_queue = "cq"
+    assert validate_localqueue(lq) == []
+
+
+def test_job_queue_name_and_priority_helpers():
+    job = mk_job("a", 1, queue="research")
+    assert job_queue_name(job) == "research"
+    assert job_priority(job) == 0
+    job.metadata.annotations = {constants.SCHED_PRIORITY_ANNOTATION: "7"}
+    assert job_priority(job) == 7
+    job.metadata.annotations = {constants.SCHED_PRIORITY_ANNOTATION: "zap"}
+    assert job_priority(job) == 0  # malformed reads as 0, never raises
+    assert job_queue_name(mk_job("b", 1, queue="")) == ""
+
+
+def test_job_demand_uses_podgroup_math():
+    # Declared TPU requests: minAvailable members' priority-ordered sum.
+    job = mk_job("a", 4, tpu_per_worker=8)
+    demand = job_demand(job)
+    assert demand["pods"] == 5  # workers + launcher
+    assert demand[constants.TPU_RESOURCE] == 32  # 4 workers x 8 chips
+    # No TPU requests: one chip per gang member keeps capacity honest.
+    assert job_demand(mk_job("b", 3))[constants.TPU_RESOURCE] == 4
+    # schedulingPolicy.minAvailable caps the gang (and so the demand).
+    from mpi_operator_tpu.api.types import SchedulingPolicy
+    job = mk_job("c", 4, tpu_per_worker=8)
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(min_available=3)
+    assert job_demand(job)["pods"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Slice pool
+# ---------------------------------------------------------------------------
+
+def test_slice_pool_all_or_nothing():
+    pool = SlicePool([TpuSlice("a", 4), TpuSlice("b", 4)])
+    assert pool.place("j1", 6) == {"a": 4, "b": 2}  # spans slices
+    assert pool.free_chips == 2
+    assert pool.place("j2", 3) is None  # does not fit: NOTHING placed
+    assert pool.free_chips == 2
+    assert pool.placement_of("j2") is None
+    assert pool.release("j1") == 6
+    assert pool.free_chips == 8
+    assert pool.release("j1") == 0  # idempotent
+
+
+def test_slice_pool_reclaim_offline_semantics():
+    pool = SlicePool([TpuSlice("a", 4), TpuSlice("s", 4, spot=True)])
+    assert pool.spot_slices() == ["s"]
+    pool.place("j1", 8)
+    assert pool.jobs_on("s") == ["j1"]
+    assert pool.set_offline("s")
+    assert pool.total_chips == 4
+    # Chips on the offline slice are NOT freed by release.
+    pool.release("j1")
+    assert pool.free_chips == 4
+    pool.set_online("s")
+    assert pool.free_chips == 8
+    assert not pool.set_offline("nope")
+
+
+# ---------------------------------------------------------------------------
+# Admission
+# ---------------------------------------------------------------------------
+
+def test_admission_all_or_nothing_and_conditions():
+    cs = Clientset()
+    mk_queues(cs, quotas={constants.TPU_RESOURCE: "8"})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 8)]))
+    cs.mpi_jobs("default").create(mk_job("fits", 3))       # 4 chips
+    cs.mpi_jobs("default").create(mk_job("too-big", 15))   # 16 chips
+    assert sched.reconcile_once() == 1
+    assert admitted_status(cs, "fits") == "True"
+    fits = cs.mpi_jobs("default").get("fits")
+    assert fits.metadata.annotations[constants.SCHED_SLICES_ANNOTATION] \
+        == "s0:4"
+    assert admitted_status(cs, "too-big") == "False"
+    queued = get_condition(cs.mpi_jobs("default").get("too-big").status,
+                           constants.JOB_QUEUED)
+    assert queued.status == "True"
+    # Nothing of the big gang is placed: all-or-nothing.
+    assert sched.pool.placement_of("default/too-big") is None
+    # Release on completion frees quota + chips.
+    finish(cs, "fits")
+    sched.reconcile_once()
+    assert sched.admitted_keys() == []
+    assert sched.pool.free_chips == 8
+
+
+def test_admission_quota_and_cohort_borrowing():
+    cs = Clientset()
+    mk_queues(cs, quotas={constants.TPU_RESOURCE: "4"}, cq_name="cq-a",
+              lq_name="qa", cohort="pool")
+    mk_queues(cs, quotas={constants.TPU_RESOURCE: "8"}, cq_name="cq-b",
+              lq_name="qb", cohort="pool")
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 16)]))
+    # 6 chips > cq-a's nominal 4, but the cohort has 12 total and only
+    # this job uses it -> borrowing admits.
+    cs.mpi_jobs("default").create(mk_job("borrower", 5, queue="qa"))
+    assert sched.reconcile_once() == 1
+    assert admitted_status(cs, "borrower") == "True"
+    # A second 6-chip job in qa now exceeds the cohort's pooled quota
+    # (6 used + 6 > 12 only false... 12 >= 12 fits) -> fill it exactly,
+    # then the next is refused.
+    cs.mpi_jobs("default").create(mk_job("borrower2", 5, queue="qa"))
+    assert sched.reconcile_once() == 1
+    cs.mpi_jobs("default").create(mk_job("borrower3", 5, queue="qa"))
+    assert sched.reconcile_once() == 0
+    assert admitted_status(cs, "borrower3") == "False"
+    # borrowing=False refuses anything over nominal.
+    cs2 = Clientset()
+    mk_queues(cs2, quotas={constants.TPU_RESOURCE: "4"}, cohort="pool",
+              borrowing=False)
+    mk_queues(cs2, quotas={constants.TPU_RESOURCE: "8"}, cq_name="cq-b",
+              lq_name="qb", cohort="pool")
+    sched2 = GangScheduler(cs2, SlicePool([TpuSlice("s0", 16)]))
+    cs2.mpi_jobs("default").create(mk_job("strict", 5, queue="q"))
+    assert sched2.reconcile_once() == 0
+
+
+def test_fair_share_orders_queues_by_weighted_usage():
+    cs = Clientset()
+    mk_queues(cs, quotas={}, cq_name="cq-heavy", lq_name="heavy",
+              weight=1.0)
+    mk_queues(cs, quotas={}, cq_name="cq-light", lq_name="light",
+              weight=1.0)
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 8)]))
+    # heavy already holds 6 chips; both queues then have one 2-chip
+    # candidate but only 2 chips remain -> the light queue (share 0)
+    # must win the walk.
+    cs.mpi_jobs("default").create(mk_job("h0", 5, queue="heavy"))
+    sched.reconcile_once()
+    cs.mpi_jobs("default").create(mk_job("h1", 1, queue="heavy"))
+    time.sleep(0.01)  # later arrival: FIFO would pick h1 first
+    cs.mpi_jobs("default").create(mk_job("l1", 1, queue="light"))
+    sched.reconcile_once()
+    assert admitted_status(cs, "l1") == "True"
+    assert admitted_status(cs, "h1") == "False"
+
+
+def test_backfill_reservation_never_delays_blocked_gang():
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 10)]))
+    cs.mpi_jobs("default").create(mk_job("old", 5))        # 6 chips
+    sched.reconcile_once()
+    time.sleep(0.01)
+    cs.mpi_jobs("default").create(mk_job("gang", 9))       # 10 chips, blocked
+    time.sleep(0.01)
+    cs.mpi_jobs("default").create(mk_job("bf", 1))         # 2 chips
+    sched.reconcile_once()
+    # The small job jumped the blocked gang (backfill) into the 4 free
+    # chips; the gang is fenced, not forgotten.
+    bf = cs.mpi_jobs("default").get("bf")
+    assert admitted_status(cs, "bf") == "True"
+    assert bf.metadata.annotations.get(
+        constants.SCHED_BACKFILL_ANNOTATION) == "true"
+    assert sched.metrics["admissions"].get("backfill") == 1
+    # Capacity released by the PRE-block job accrues to the gang's
+    # reservation: a new backfill candidate that only fits by eating it
+    # is refused.
+    finish(cs, "old")
+    sched.reconcile_once()
+    assert sched.reserved_chips() == 6
+    cs.mpi_jobs("default").create(mk_job("bf2", 3))        # 4 chips > 8-6
+    sched.reconcile_once()
+    assert admitted_status(cs, "bf2") == "False"
+    assert sched.metrics["backfill_denied"].value >= 1
+    # Once total free covers the gang it admits FIRST; the fence drops.
+    finish(cs, "bf")
+    assert sched.reconcile_once() >= 1
+    assert admitted_status(cs, "gang") == "True"
+    assert sched.reserved_chips() == 0
+
+
+def test_fifo_baseline_head_of_line_blocks():
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]),
+                          fair_share=False, backfill=False)
+    cs.mpi_jobs("default").create(mk_job("gang", 9))   # 10 chips: blocked
+    time.sleep(0.01)
+    cs.mpi_jobs("default").create(mk_job("small", 1))  # would fit
+    assert sched.reconcile_once() == 0
+    assert admitted_status(cs, "small") == "False"  # FIFO starves it
+
+
+def test_preemption_checkpoint_then_evict_then_requeue():
+    class FakeKubelet:
+        def __init__(self):
+            self.notices = []
+
+        def inject_preemption(self, namespace, name, grace=1.0):
+            self.notices.append((namespace, name, grace))
+            return True
+
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    kubelet = FakeKubelet()
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]),
+                          kubelet=kubelet, checkpoint_grace=0.15)
+    victim = cs.mpi_jobs("default").create(mk_job("victim", 3))  # 4 chips
+    sched.reconcile_once()
+    assert admitted_status(cs, "victim") == "True"
+    # Fake the victim's running worker pods so notices have targets.
+    from mpi_operator_tpu.controller import builders
+    from mpi_operator_tpu.k8s import core
+    for i in range(3):
+        pod = core.Pod(metadata=ObjectMeta(
+            name=f"victim-worker-{i}", namespace="default",
+            labels=builders.worker_selector("victim")))
+        pod.status.phase = core.POD_RUNNING
+        cs.pods("default").create(pod)
+
+    cs.mpi_jobs("default").create(mk_job("urgent", 3, prio=5))
+    sched.reconcile_once()
+    # Notice phase: victim flipped to Queued (gate shut), chips STILL
+    # held through the grace window, workers noticed.
+    assert admitted_status(cs, "victim") == "False"
+    cond = get_condition(cs.mpi_jobs("default").get("victim").status,
+                         constants.JOB_ADMITTED)
+    assert cond.reason == "MPIJobPreempted"
+    assert len(kubelet.notices) == 3
+    assert sched.pool.free_chips == 0
+    assert admitted_status(cs, "urgent") == "False"  # not yet: chips held
+    time.sleep(0.2)
+    sched.reconcile_once()
+    # Evicted: pods gone, chips released, preemptor admitted.
+    assert cs.pods("default").list() == []
+    assert admitted_status(cs, "urgent") == "True"
+    assert sched.metrics["evictions"].get("preempted") == 1
+    assert sched.metrics["preemption_notices"].value == 1
+    # The victim is requeued (pending), not failed.
+    queued = get_condition(cs.mpi_jobs("default").get("victim").status,
+                           constants.JOB_QUEUED)
+    assert queued.status == "True"
+    # Preemptor finishes -> victim re-admitted (resume-from-checkpoint
+    # is the workload's contract; e2e-proven in tools/sched_smoke.py).
+    finish(cs, "urgent")
+    sched.reconcile_once()
+    assert admitted_status(cs, "victim") == "True"
+
+
+def test_equal_priority_never_preempts():
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]),
+                          checkpoint_grace=0.05)
+    cs.mpi_jobs("default").create(mk_job("first", 3))
+    sched.reconcile_once()
+    cs.mpi_jobs("default").create(mk_job("second", 3))  # same priority 0
+    sched.reconcile_once()
+    assert sched._preempting == {}
+    assert admitted_status(cs, "first") == "True"
+    assert admitted_status(cs, "second") == "False"
+
+
+def test_spot_reclaim_evicts_and_requeues_then_heals():
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool(
+        [TpuSlice("fixed", 2), TpuSlice("spot-0", 4, spot=True)]),
+        checkpoint_grace=0.1)
+    cs.mpi_jobs("default").create(mk_job("gang", 5))  # 6 chips: spans both
+    sched.reconcile_once()
+    assert admitted_status(cs, "gang") == "True"
+    victims = sched.reclaim_slice("spot-0", grace=0.1)
+    assert victims == ["default/gang"]
+    assert sched.metrics["spot_reclaims"].value == 1
+    cond = get_condition(cs.mpi_jobs("default").get("gang").status,
+                         constants.JOB_ADMITTED)
+    assert cond.status == "False" and cond.reason == "MPIJobSpotReclaimed"
+    time.sleep(0.15)
+    sched.reconcile_once()
+    # Evicted + requeued; the shrunken pool (2 chips) cannot re-admit.
+    assert sched.admitted_keys() == []
+    assert admitted_status(cs, "gang") == "False"
+    assert sched.pool.free_chips == 2
+    # Slice heals -> the gang comes straight back.
+    sched.restore_slice("spot-0")
+    sched.reconcile_once()
+    assert admitted_status(cs, "gang") == "True"
+    assert sched.metrics["evictions"].get("spot_reclaim") == 1
+
+
+def test_queue_status_published():
+    cs = Clientset()
+    mk_queues(cs, quotas={constants.TPU_RESOURCE: "8"})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]))
+    cs.mpi_jobs("default").create(mk_job("a", 2))        # 3 chips
+    cs.mpi_jobs("default").create(mk_job("b", 9))        # 10: pending
+    sched.reconcile_once()
+    cq = cs.cluster_queues("default").get("cq")
+    assert cq.status.admitted_jobs == 1
+    assert cq.status.pending_jobs == 1
+    assert cq.status.used[constants.TPU_RESOURCE] == "3"
+    lq = cs.local_queues("default").get("q")
+    assert (lq.status.admitted_jobs, lq.status.pending_jobs) == (1, 1)
+
+
+def test_unknown_queue_left_pending_not_crashed():
+    cs = Clientset()
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]))
+    cs.mpi_jobs("default").create(mk_job("lost", 1, queue="no-such"))
+    assert sched.reconcile_once() == 0
+    assert admitted_status(cs, "lost") is None  # untouched, gated
+
+
+# ---------------------------------------------------------------------------
+# Controller admission gate
+# ---------------------------------------------------------------------------
+
+def test_controller_gates_queue_labeled_jobs():
+    from test_controller import Fixture
+
+    f = Fixture()
+    job = mk_job("gated", 2)
+    f.register_job(job)
+    f.sync(job)
+    # Nothing created: no workers, no launcher, no Service.
+    assert f.client.server.list("v1", "Pod") == []
+    assert f.client.server.list("batch/v1", "Job") == []
+    assert f.client.server.list("v1", "Service") == []
+    stored = f.get_job("gated")
+    queued = get_condition(stored.status, constants.JOB_QUEUED)
+    assert queued is not None and queued.status == "True"
+    # startTime must NOT run while queued (admission wait is not
+    # runtime).
+    assert stored.status.start_time is None
+
+    # Admission opens the gate: next sync creates the gang.
+    stored.status.conditions = [c for c in stored.status.conditions]
+    from mpi_operator_tpu.k8s.meta import FakeClock
+    from mpi_operator_tpu.controller.status import update_job_conditions
+    update_job_conditions(stored, constants.JOB_ADMITTED, "True",
+                          "MPIJobAdmitted", "admitted", FakeClock())
+    f.client.mpi_jobs("default").update_status(stored)
+    f.refresh_caches()
+    f.sync(stored)
+    assert len([p for p in f.client.server.list("v1", "Pod")]) == 2
+
+
+def test_controller_ignores_unlabeled_jobs():
+    from test_controller import Fixture, new_mpi_job
+
+    f = Fixture()
+    job = new_mpi_job(workers=2)
+    f.register_job(job)
+    f.sync(job)
+    # No queue label: exactly the pre-scheduler behavior.
+    assert len(f.client.server.list("v1", "Pod")) == 2
+    assert get_condition(f.get_job().status, constants.JOB_QUEUED) is None
+
+
+# ---------------------------------------------------------------------------
+# Chaos wiring
+# ---------------------------------------------------------------------------
+
+def test_spot_reclaim_opt_in_keeps_default_seeds_stable():
+    from mpi_operator_tpu.chaos.injectors import INJECTORS
+
+    assert "spot_reclaim" in INJECTORS
+    assert "spot_reclaim" not in RANDOMIZABLE_KINDS
+    assert "spot_reclaim" in SCHED_RANDOMIZABLE_KINDS
+    # Default-kind plans derive identically with the injector present.
+    a = randomized_plan(1234)
+    b = randomized_plan(1234)
+    assert a.to_json() == b.to_json()
+    assert all(f.kind in RANDOMIZABLE_KINDS for f in a.faults)
+    # Opted-in plans can draw it, deterministically.
+    seeds = [randomized_plan(s, kinds=SCHED_RANDOMIZABLE_KINDS,
+                             n_faults=16) for s in range(8)]
+    assert any(f.kind == "spot_reclaim" for p in seeds for f in p.faults)
+    assert randomized_plan(3, kinds=SCHED_RANDOMIZABLE_KINDS).to_json() \
+        == randomized_plan(3, kinds=SCHED_RANDOMIZABLE_KINDS).to_json()
+
+
+def test_spot_reclaim_injector_noops_without_scheduler():
+    from mpi_operator_tpu.chaos.engine import ChaosEngine
+    from mpi_operator_tpu.chaos.plan import Fault, FaultPlan
+
+    class System:
+        def __init__(self):
+            self.client = Clientset()
+            self.kubelet = None
+
+    plan = FaultPlan(name="t", faults=[Fault(at=0.0, kind="spot_reclaim")])
+    report = ChaosEngine(System(), plan, seed=1).run(invariants=())
+    inject = [e for e in report.events if e.get("event") == "inject"][0]
+    assert inject["result"] == "no-scheduler"
+
+
+def test_sched_no_partial_gangs_invariant():
+    from mpi_operator_tpu.controller import builders
+    from mpi_operator_tpu.k8s import core
+
+    class System:
+        def __init__(self):
+            self.client = Clientset()
+
+    system = System()
+    # No queue-labeled jobs: the invariant no-ops.
+    assert sched_no_partial_gangs(system) == []
+    job = mk_job("gated", 2)
+    system.client.mpi_jobs("default").create(job)
+    assert sched_no_partial_gangs(system) == []
+    # A running worker pod under a NOT-admitted queue-labeled job is a
+    # partial gang.
+    pod = core.Pod(metadata=ObjectMeta(
+        name="gated-worker-0", namespace="default",
+        labels=builders.worker_selector("gated")))
+    pod.status.phase = core.POD_RUNNING
+    system.client.pods("default").create(pod)
+    violations = sched_no_partial_gangs(system)
+    assert violations and "partial gang" in violations[0]
+
+
+def test_suspended_admitted_job_releases_capacity():
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]))
+    cs.mpi_jobs("default").create(mk_job("pausable", 3))  # 4 chips
+    sched.reconcile_once()
+    assert admitted_status(cs, "pausable") == "True"
+    job = cs.mpi_jobs("default").get("pausable")
+    job.spec.run_policy.suspend = True
+    cs.mpi_jobs("default").update(job)
+    sched.reconcile_once()
+    # Chips released, gang requeued — a suspended job must not hold the
+    # slice (and must not be re-adopted off its stale Admitted=True).
+    assert sched.admitted_keys() == []
+    assert sched.pool.free_chips == 4
+    assert admitted_status(cs, "pausable") == "False"
+    # While suspended it is not admissible...
+    assert sched.reconcile_once() == 0
+    # ...and resume re-admits it like any pending job.
+    job = cs.mpi_jobs("default").get("pausable")
+    job.spec.run_policy.suspend = False
+    cs.mpi_jobs("default").update(job)
+    sched.reconcile_once()
+    assert admitted_status(cs, "pausable") == "True"
+
+
+def test_preemption_does_not_over_evict_during_grace_window():
+    # Three 4-chip victims, a 4-chip priority job: exactly ONE victim
+    # may be selected, no matter how many reconcile passes run while
+    # the grace window is open (pending evictions count as
+    # pending-free capacity).
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 12)]),
+                          checkpoint_grace=5.0)
+    for i in range(3):
+        cs.mpi_jobs("default").create(mk_job(f"victim-{i}", 3))
+    sched.reconcile_once()
+    assert len(sched.admitted_keys()) == 3
+    cs.mpi_jobs("default").create(mk_job("urgent", 3, prio=5))
+    for _ in range(5):  # many passes inside the open grace window
+        sched.reconcile_once()
+    assert len(sched._preempting) == 1
+    assert sched.metrics["preemption_notices"].value == 1
+
+
+def test_preemption_evaluates_global_priority_front():
+    # Fair-share ordering puts the low-share queue's zero-priority gang
+    # at order[0]; the priority-10 job in the other queue must still
+    # exercise its preemption right.
+    cs = Clientset()
+    mk_queues(cs, quotas={}, cq_name="cq-a", lq_name="qa", cohort="pool")
+    mk_queues(cs, quotas={}, cq_name="cq-b", lq_name="qb", cohort="pool")
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]),
+                          checkpoint_grace=0.05)
+    cs.mpi_jobs("default").create(mk_job("victim", 3, queue="qb"))
+    sched.reconcile_once()
+    # cq-a now holds a pending unsatisfiable zero-priority gang (its
+    # share is 0, so the fair walk orders it first)...
+    cs.mpi_jobs("default").create(mk_job("blocked-gang", 9, queue="qa"))
+    sched.reconcile_once()
+    # ...and a priority-10 job lands in cq-b.
+    cs.mpi_jobs("default").create(mk_job("urgent", 3, queue="qb", prio=10))
+    sched.reconcile_once()
+    assert "default/victim" in sched._preempting
+    time.sleep(0.1)
+    sched.reconcile_once()
+    assert admitted_status(cs, "urgent") == "True"
+
+
+def test_release_on_offline_slice_does_not_feed_reservation():
+    # SlicePool.release reports only chips returned to the ONLINE pool;
+    # a reclaim victim's chips on the yanked slice are not free and
+    # must not inflate a blocked gang's reservation.
+    pool = SlicePool([TpuSlice("a", 4), TpuSlice("s", 6, spot=True)])
+    pool.place("j1", 10)  # spans both
+    pool.set_offline("s")
+    assert pool.release("j1") == 4  # only slice a's chips are usable
+    assert pool.free_chips == 4
+    pool.set_online("s")
+    assert pool.free_chips == 10  # healing restores the rest
+
+
+def test_malformed_resource_quantity_degrades_to_invalid():
+    # A garbage TPU quantity passes structural validation but breaks
+    # the demand math — the job must read as invalid (skipped), never
+    # wedge the reconcile loop; this covers the adoption path too.
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 8)]))
+    bad = mk_job("bad", 2)
+    bad.spec.mpi_replica_specs[
+        constants.REPLICA_TYPE_WORKER].template.spec.containers[0] \
+        .resources = ResourceRequirements(
+            requests={constants.TPU_RESOURCE: "garbage"})
+    cs.mpi_jobs("default").create(bad)
+    cs.mpi_jobs("default").create(mk_job("good", 1))
+    assert sched.reconcile_once() == 1  # good admitted, bad skipped
+    assert admitted_status(cs, "good") == "True"
+    # Adoption path: a stored Admitted=True job with the same garbage.
+    job = cs.mpi_jobs("default").get("bad")
+    from mpi_operator_tpu.k8s.meta import FakeClock
+    from mpi_operator_tpu.controller.status import update_job_conditions
+    update_job_conditions(job, constants.JOB_ADMITTED, "True",
+                          "MPIJobAdmitted", "stale", FakeClock())
+    cs.mpi_jobs("default").update_status(job)
+    sched.reconcile_once()  # must not raise; job requeued, not adopted
+    assert "default/bad" not in sched.admitted_keys()
+
+
+def test_quota_jump_is_classed_as_backfill():
+    # A younger same-queue job passing an older quota-blocked gang is a
+    # BACKFILL (annotated), and with backfill=False it is refused
+    # entirely (per-queue head-of-line) while other queues proceed.
+    cs = Clientset()
+    mk_queues(cs, quotas={constants.TPU_RESOURCE: "8"})
+    mk_queues(cs, quotas={}, cq_name="cq-other", lq_name="other")
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 64)]))
+    cs.mpi_jobs("default").create(mk_job("quota-gang", 15))  # 16 > 8
+    time.sleep(0.01)
+    cs.mpi_jobs("default").create(mk_job("jumper", 1))       # 2 <= 8
+    sched.reconcile_once()
+    jumper = cs.mpi_jobs("default").get("jumper")
+    assert admitted_status(cs, "jumper") == "True"
+    assert jumper.metadata.annotations.get(
+        constants.SCHED_BACKFILL_ANNOTATION) == "true"
+    # backfill=False: the jump is refused, but an unrelated queue's job
+    # still admits (the block is per-queue, not global).
+    cs2 = Clientset()
+    mk_queues(cs2, quotas={constants.TPU_RESOURCE: "8"})
+    mk_queues(cs2, quotas={}, cq_name="cq-other", lq_name="other")
+    sched2 = GangScheduler(cs2, SlicePool([TpuSlice("s0", 64)]),
+                           backfill=False)
+    cs2.mpi_jobs("default").create(mk_job("quota-gang", 15))
+    time.sleep(0.01)
+    cs2.mpi_jobs("default").create(mk_job("jumper", 1))
+    cs2.mpi_jobs("default").create(mk_job("free-rider", 1, queue="other"))
+    sched2.reconcile_once()
+    assert admitted_status(cs2, "jumper") == "False"
+    assert admitted_status(cs2, "free-rider") == "True"
+
+
+def test_preemption_disabled_queue_does_not_block_others():
+    # The globally-highest-priority pending job sits in a
+    # preemption-DISABLED queue; the next-ranked job in an enabled
+    # queue must still exercise its preemption right.
+    cs = Clientset()
+    mk_queues(cs, quotas={}, cq_name="cq-calm", lq_name="calm",
+              cohort="pool", preemption=False)
+    mk_queues(cs, quotas={}, cq_name="cq-sharp", lq_name="sharp",
+              cohort="pool", preemption=True)
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]),
+                          checkpoint_grace=0.05)
+    cs.mpi_jobs("default").create(mk_job("victim", 3, queue="sharp"))
+    sched.reconcile_once()
+    cs.mpi_jobs("default").create(
+        mk_job("calm-top", 3, queue="calm", prio=100))
+    cs.mpi_jobs("default").create(
+        mk_job("sharp-next", 3, queue="sharp", prio=50))
+    sched.reconcile_once()
+    assert "default/victim" in sched._preempting
+    time.sleep(0.1)
+    sched.reconcile_once()
+    # Priority still rules admission of the freed chips: calm-top wins
+    # them, but the preemption RIGHT belonged to sharp-next.
+    assert admitted_status(cs, "calm-top") == "True"
+
+
+def test_duplicate_clusterqueue_names_resolve_deterministically():
+    cs = Clientset()
+    for ns, quota in (("aaa", "2"), ("zzz", "512")):
+        cq = ClusterQueue()
+        cq.metadata.name = "shared"
+        cq.metadata.namespace = ns
+        cq.spec.quotas = {constants.TPU_RESOURCE: quota}
+        cs.cluster_queues(ns).create(cq)
+    lq = LocalQueue()
+    lq.metadata.name = "q"
+    lq.metadata.namespace = "default"
+    lq.spec.cluster_queue = "shared"
+    cs.local_queues("default").create(lq)
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 64)]))
+    # The (namespace, name)-first object wins: quota 2, so a 4-chip job
+    # must NOT be admitted against the shadowed 512-chip quota.
+    cs.mpi_jobs("default").create(mk_job("probe", 3))
+    assert sched.reconcile_once() == 0
+    assert admitted_status(cs, "probe") == "False"
+
+
+def test_cli_parse_slices():
+    from mpi_operator_tpu.__main__ import _parse_slices
+
+    slices = _parse_slices("2x4,1x8:spot")
+    assert [(s.chips, s.spot) for s in slices] == \
+        [(4, False), (4, False), (8, True)]
+    for bad in ("8", "2x", "axb"):
+        with pytest.raises(ValueError, match="NxC|N x CHIPS"):
+            _parse_slices(bad)
+
+
+def test_higher_priority_job_is_never_fence_gated():
+    # A fenced low-priority gang's reservation must not priority-invert:
+    # a strictly higher-priority arrival uses the full free pool, and if
+    # it is itself capacity-blocked it takes the fence over.
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 10)]),
+                          preemption=False)
+    cs.mpi_jobs("default").create(mk_job("old", 5))      # 6 chips
+    sched.reconcile_once()
+    time.sleep(0.01)
+    cs.mpi_jobs("default").create(mk_job("gang", 9))     # 10: fenced
+    time.sleep(0.01)
+    cs.mpi_jobs("default").create(mk_job("holder", 1))   # 2: backfills
+    sched.reconcile_once()
+    finish(cs, "old")
+    sched.reconcile_once()
+    # Fence armed and fed (free 8, reserved 6 -> backfillable 2).
+    assert sched.reserved_chips() == 6
+    # Equal-priority backfill of the reserved chips is denied...
+    cs.mpi_jobs("default").create(mk_job("peer", 5))     # 6 > 2
+    sched.reconcile_once()
+    assert admitted_status(cs, "peer") == "False"
+    # ...but a HIGHER-priority job of the same size admits right through.
+    cs.mpi_jobs("default").create(mk_job("vip", 5, prio=5))  # 6 <= free 8
+    sched.reconcile_once()
+    assert admitted_status(cs, "vip") == "True"
+    # And a capacity-blocked higher-priority job takes the fence over.
+    cs.mpi_jobs("default").create(mk_job("vip-gang", 7, prio=7))  # 8 > 2
+    sched.reconcile_once()
+    assert sched._blocked is not None
+    assert sched._blocked["key"] == "default/vip-gang"
+
+
+def test_preemption_not_deferred_by_offline_pending_free():
+    # A reclaim victim's chips on the yanked slice never return: they
+    # must not count as pending-free, or real victim selection would be
+    # deferred a full grace window.
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool(
+        [TpuSlice("a", 4), TpuSlice("b", 4, spot=True)]),
+        checkpoint_grace=5.0)
+    cs.mpi_jobs("default").create(mk_job("victim-a", 3))  # 4 chips
+    sched.reconcile_once()
+    cs.mpi_jobs("default").create(mk_job("victim-b", 3))  # 4 chips
+    sched.reconcile_once()
+    # One victim sits (entirely) on the spot slice; find and yank it.
+    spot_victims = sched.pool.jobs_on("b")
+    assert len(spot_victims) == 1
+    sched.reclaim_slice("b", grace=5.0)
+    # A high-priority job needing 4 chips: the reclaim victim's 4
+    # offline chips are NOT pending-free, so the OTHER admitted gang
+    # must be selected as a preemption victim immediately.
+    cs.mpi_jobs("default").create(mk_job("urgent", 3, prio=10))
+    sched.reconcile_once()
+    other = ({"default/victim-a", "default/victim-b"}
+             - set(spot_victims)).pop()
+    assert other in sched._preempting
+
+
+def test_cli_parse_slices_strict():
+    from mpi_operator_tpu.__main__ import _parse_slices
+
+    for bad in ("1x64:spott", "0x8", "1x-8", "2x0"):
+        with pytest.raises(ValueError, match="N x CHIPS"):
+            _parse_slices(bad)

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Gang-scheduler smoke (< 60s): two queues over one TPU slice.
+
+The scenario (docs/SCHEDULING.md):
+
+1. A checkpoint-aware small job (research queue) is admitted onto the
+   single 4-chip slice and RUNS (real worker process via the
+   LocalKubelet).
+2. An 8-worker gang (9 chips) is submitted to the same queue — it can
+   never fit and must sit honestly Queued with ZERO pods (no partial
+   gang, ever).
+3. A higher-priority prod job arrives needing more chips than remain:
+   the scheduler preempts the small job — preemption NOTICE first
+   (K_PREEMPTION_NOTICE_FILE), the worker checkpoints and exits 143
+   inside the grace window, THEN the gang is evicted and requeued.
+4. The prod job runs to completion; the victim is re-admitted and its
+   worker provably RESUMES from the pre-eviction checkpoint step.
+
+Asserted: the full condition protocol (Queued -> Admitted -> Preempted
+-> Queued -> Admitted), the checkpoint-then-evict ordering, the resume
+step, scheduler counters (admissions, preemption notices, evictions),
+queue status, and every chaos invariant (incl. sched_no_partial_gangs)
+green at the end.
+
+Usage: python tools/sched_smoke.py
+Exit 0 = all assertions held.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# The checkpoint-aware worker: bumps a step counter, persists it
+# atomically every iteration, and on the kubelet's preemption notice
+# writes a final marker and exits 143 (the PR 2 checkpoint-then-exit
+# contract).  A restarted incarnation reads the persisted step and
+# logs the resume — the proof the eviction kept the checkpoint intact.
+WORKER_SCRIPT = textwrap.dedent("""\
+    import os, sys, time
+    d = os.environ["SMOKE_CKPT_DIR"]
+    notice = os.environ.get("K_PREEMPTION_NOTICE_FILE")
+    step_file = os.path.join(d, "step")
+    log_path = os.path.join(d, "events.log")
+    def log(line):
+        with open(log_path, "a") as f:
+            f.write(line + "\\n")
+    step = 0
+    if os.path.exists(step_file):
+        step = int(open(step_file).read().strip() or 0)
+        log(f"resumed-from {step}")
+    else:
+        log("fresh-start")
+    while True:
+        step += 1
+        with open(step_file + ".tmp", "w") as f:
+            f.write(str(step))
+        os.replace(step_file + ".tmp", step_file)
+        if notice and os.path.exists(notice):
+            log(f"checkpoint-exit {step}")
+            sys.exit(143)
+        time.sleep(0.05)
+""")
+
+
+def mk_job(name, workers, queue, worker_cmd, launcher_cmd, prio=None,
+           env=None):
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec, ReplicaSpec,
+                                            RunPolicy)
+    from mpi_operator_tpu.k8s.core import (Container, EnvVar, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+    env_vars = [EnvVar(k, v) for k, v in (env or {}).items()]
+
+    def tpl(cname, command):
+        return PodTemplateSpec(spec=PodSpec(containers=[Container(
+            name=cname, image="local", command=command, env=list(env_vars))]))
+
+    meta = ObjectMeta(name=name, namespace="default",
+                      labels={constants.QUEUE_NAME_LABEL: queue})
+    if prio is not None:
+        meta.annotations = {constants.SCHED_PRIORITY_ANNOTATION: str(prio)}
+    return MPIJob(metadata=meta, spec=MPIJobSpec(
+        mpi_implementation=constants.IMPL_JAX,
+        run_policy=RunPolicy(),
+        mpi_replica_specs={
+            constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                replicas=1, template=tpl("l", launcher_cmd)),
+            constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                replicas=workers, template=tpl("w", worker_cmd)),
+        }))
+
+
+def wait_for(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def run_scenario() -> dict:
+    """Execute the scenario; returns the proof dict (also consumed by
+    bench_sched.py as the BENCH_SCHED.json `preempt_resume` section).
+    Raises AssertionError on any protocol violation."""
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.chaos.invariants import DEFAULT_INVARIANTS
+    from mpi_operator_tpu.controller.status import get_condition
+    from mpi_operator_tpu.sched import ClusterQueue, LocalQueue, TpuSlice
+    from mpi_operator_tpu.server.cluster import LocalCluster
+
+    t0 = time.monotonic()
+    ckpt_dir = tempfile.mkdtemp(prefix="sched-smoke-")
+    script_path = os.path.join(ckpt_dir, "worker.py")
+    with open(script_path, "w") as f:
+        f.write(WORKER_SCRIPT)
+    log_path = os.path.join(ckpt_dir, "events.log")
+    step_file = os.path.join(ckpt_dir, "step")
+
+    cluster = LocalCluster(
+        sched_slices=[TpuSlice("slice-0", 4)],
+        sched_options={"checkpoint_grace": 1.0, "tick": 0.05})
+    cluster.start()
+    client = cluster.client
+    sched = cluster.scheduler
+    try:
+        # Two queues, one cohort (cross-queue preemption is in-cohort).
+        for cq_name, weight in (("cq-research", 1.0), ("cq-prod", 4.0)):
+            cq = ClusterQueue()
+            cq.metadata.name = cq_name
+            cq.spec.quotas = {constants.TPU_RESOURCE: "8"}
+            cq.spec.cohort = "pool"
+            cq.spec.weight = weight
+            client.cluster_queues("default").create(cq)
+        for lq_name, cq_name in (("research", "cq-research"),
+                                 ("prod", "cq-prod")):
+            lq = LocalQueue()
+            lq.metadata.name = lq_name
+            lq.metadata.namespace = "default"
+            lq.spec.cluster_queue = cq_name
+            client.local_queues("default").create(lq)
+
+        def cond(name, ctype):
+            job = client.mpi_jobs("default").get(name)
+            return get_condition(job.status, ctype)
+
+        def is_true(name, ctype):
+            c = cond(name, ctype)
+            return c is not None and c.status == "True"
+
+        # 1. Checkpointing small job admitted + running.
+        victim = mk_job(
+            "ckpt-small", 1, "research",
+            worker_cmd=[sys.executable, script_path],
+            launcher_cmd=[sys.executable, "-c",
+                          "import time; time.sleep(300)"],
+            env={"SMOKE_CKPT_DIR": ckpt_dir})
+        client.mpi_jobs("default").create(victim)
+        wait_for(lambda: is_true("ckpt-small", constants.JOB_ADMITTED),
+                 15, "victim admission")
+        wait_for(lambda: os.path.exists(step_file)
+                 and int(open(step_file).read() or 0) >= 3,
+                 20, "victim making checkpointed progress")
+        print(f"sched-smoke: victim admitted and checkpointing "
+              f"(step {open(step_file).read().strip()})")
+
+        # 2. The big gang queues honestly: 9 chips > the 4-chip slice.
+        gang = mk_job(
+            "gang-big", 8, "research",
+            worker_cmd=[sys.executable, "-c",
+                        "import time; time.sleep(300)"],
+            launcher_cmd=[sys.executable, "-c",
+                          "import time; time.sleep(300)"])
+        client.mpi_jobs("default").create(gang)
+        wait_for(lambda: is_true("gang-big", constants.JOB_QUEUED),
+                 10, "big gang Queued condition")
+        assert not is_true("gang-big", constants.JOB_ADMITTED)
+
+        # 3. Priority job preempts: notice -> checkpoint -> evict.
+        urgent = mk_job(
+            "prod-urgent", 2, "prod", prio=10,
+            worker_cmd=[sys.executable, "-c",
+                        "import time; time.sleep(1.0)"],
+            launcher_cmd=[sys.executable, "-c",
+                          "import time; time.sleep(1.5)"])
+        client.mpi_jobs("default").create(urgent)
+        wait_for(lambda: (cond("ckpt-small", constants.JOB_ADMITTED) or
+                          type("c", (), {"status": "?", "reason": ""})())
+                 .reason == "MPIJobPreempted",
+                 15, "victim preemption notice")
+        wait_for(lambda: os.path.exists(log_path)
+                 and "checkpoint-exit" in open(log_path).read(),
+                 15, "victim checkpoint-then-exit inside grace window")
+        log_text = open(log_path).read()
+        ckpt_step = int([line for line in log_text.splitlines()
+                         if line.startswith("checkpoint-exit")][0].split()[1])
+        assert ckpt_step >= 3, f"checkpoint step {ckpt_step} too early"
+        print(f"sched-smoke: victim checkpointed at step {ckpt_step} and"
+              f" exited 143 inside the grace window")
+        wait_for(lambda: is_true("prod-urgent", constants.JOB_ADMITTED),
+                 15, "preemptor admission after eviction")
+        wait_for(lambda: is_true("prod-urgent", constants.JOB_SUCCEEDED),
+                 30, "preemptor completion")
+
+        # 4. Victim re-admitted; resumes FROM the checkpoint.
+        wait_for(lambda: is_true("ckpt-small", constants.JOB_ADMITTED),
+                 20, "victim re-admission")
+        wait_for(lambda: "resumed-from" in open(log_path).read(),
+                 20, "victim resuming from checkpoint")
+        resumed = int([line for line in open(log_path).read().splitlines()
+                       if line.startswith("resumed-from")][0].split()[1])
+        assert resumed >= ckpt_step, \
+            f"resumed at {resumed} < checkpoint step {ckpt_step}"
+        print(f"sched-smoke: victim resumed from step {resumed}"
+              f" (checkpointed {ckpt_step})")
+
+        # 5. Counters, queue state, invariants.
+        m = sched.metrics
+        assert m["preemption_notices"].value >= 1
+        assert m["evictions"].get("preempted") == 1
+        front = m["admissions"].get("front")
+        assert front >= 3, f"expected >=3 front admissions, saw {front}"
+        assert is_true("gang-big", constants.JOB_QUEUED)
+        gang_pods = [p for p in client.server.list("v1", "Pod", "default")
+                     if p.metadata.labels.get(constants.JOB_NAME_LABEL)
+                     == "gang-big"]
+        assert gang_pods == [], "queued gang must hold zero pods"
+        cq = client.cluster_queues("default").get("cq-research")
+        assert cq.status.pending_jobs >= 1  # the big gang
+        # Let the control plane settle, then hold every invariant.
+        deadline = time.monotonic() + 20
+        failures = {}
+        while time.monotonic() < deadline:
+            failures = {check.__name__: check(cluster)
+                        for check in DEFAULT_INVARIANTS}
+            if not any(failures.values()):
+                break
+            time.sleep(0.3)
+        bad = {k: v for k, v in failures.items() if v}
+        assert not bad, f"invariants violated: {bad}"
+        elapsed = time.monotonic() - t0
+        return {
+            "elapsed_s": round(elapsed, 2),
+            "checkpoint_step": ckpt_step,
+            "resume_step": resumed,
+            "resumed_from_checkpoint": resumed >= ckpt_step > 0,
+            "preemption_notices": int(m["preemption_notices"].value),
+            "evictions_preempted": int(m["evictions"].get("preempted")),
+            "front_admissions": int(front),
+            "invariant_violations": 0,
+        }
+    finally:
+        cluster.stop()
+
+
+def main() -> int:
+    proof = run_scenario()
+    print(f"sched-smoke: PASS in {proof['elapsed_s']}s — preempt notice"
+          f" -> checkpoint(step {proof['checkpoint_step']}) -> evict ->"
+          f" resume({proof['resume_step']}); invariants green; big gang"
+          f" queued with 0 pods")
+    assert proof["elapsed_s"] < 60, \
+        f"smoke took {proof['elapsed_s']}s (budget 60s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
