@@ -6,7 +6,7 @@ PYTHON ?= python
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
-	train-bench-smoke serve-fleet-smoke sched-smoke
+	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -77,6 +77,16 @@ serve-fleet-smoke:
 # (docs/SCHEDULING.md).
 sched-smoke:
 	$(PYTHON) tools/sched_smoke.py
+
+# Macro-soak (< 60s, CPU): the whole stack at minimum scale — one
+# training gang through a ClusterQueue + a 2-replica serving fleet
+# under live traffic — surviving one controller_restart and one
+# scheduler_restart: every SLO scorecard field populated, zero
+# invariant violations, zero lost requests, recovery measured, one
+# flight-recorder lane per layer, and the canonical event log
+# byte-identical across two runs (docs/RESILIENCE.md).
+soak-smoke:
+	$(PYTHON) tools/soak_smoke.py
 
 # Train hot path (< 60s, CPU): overlapped loop (async dispatch +
 # prefetch + async checkpointing) holds a steps/s floor with ZERO

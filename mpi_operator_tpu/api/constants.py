@@ -160,6 +160,12 @@ SCHED_PRIORITY_ANNOTATION = "scheduling.kubeflow.org/priority"
 # ("slice-a:256,slice-b:128") and whether the job jumped a blocked gang.
 SCHED_SLICES_ANNOTATION = "scheduling.kubeflow.org/slices"
 SCHED_BACKFILL_ANNOTATION = "scheduling.kubeflow.org/backfilled"
+# Written on a capacity-blocked gang while the backfill reservation
+# fence is armed for it: the chips accrued to its reservation so far.
+# A restarted scheduler rebuilds the fence from this (the apiserver is
+# the single source of truth for scheduler state — docs/RESILIENCE.md
+# "Macro-soak & crash recovery").
+SCHED_RESERVATION_ANNOTATION = "scheduling.kubeflow.org/reservation"
 
 # Admission condition types (Queued -> Admitted; eviction flips back).
 JOB_QUEUED = "Queued"

@@ -32,7 +32,9 @@ from .injectors import INJECTORS, register_injector  # noqa: F401
 from .invariants import (DEFAULT_INVARIANTS, checkpoint_intact,  # noqa: F401
                          gang_restarts_bounded, jobs_converged,
                          no_leaked_pod_ips, no_orphaned_pods,
-                         no_orphaned_runners, serve_requests_intact,
+                         no_orphaned_runners, no_surplus_worker_pods,
+                         sched_capacity_conserved, serve_requests_intact,
                          workqueue_idle)
 from .plan import (Fault, FaultPlan, FLEET_RANDOMIZABLE_KINDS,  # noqa: F401
+                   FULL_RANDOMIZABLE_KINDS, PLAN_PROFILES,
                    randomized_plan)

@@ -229,6 +229,57 @@ def inject_spot_reclaim(ctx, fault):
     return heal
 
 
+@register_injector("controller_restart")
+def inject_controller_restart(ctx, fault):
+    """Control-plane crash: kill the reconcile loops (MPIJob controller
+    + batch Job controller) mid-flight and respawn them at heal time —
+    ``duration`` is the control-plane outage.  A duration of 0 respawns
+    at timeline end (before convergence is judged), like every durable
+    fault.  The respawned controller has EMPTY in-memory state and must
+    re-adopt pods/launchers from the apiserver without duplicate
+    creates (server/cluster.py crash_controller/respawn_controller;
+    no-ops, logged, against systems without the surface)."""
+    crash = getattr(ctx.system, "crash_controller", None)
+    respawn = getattr(ctx.system, "respawn_controller", None)
+    if crash is None or respawn is None:
+        ctx.log_result(fault, resolved_target="",
+                       result="no-restartable-controller")
+        return None
+    crashed = crash()
+    # crash() returns False when the controller is already down
+    # (overlapping restart faults): log honestly — the scorecard
+    # counts result=="crashed" as restarts actually applied.
+    ctx.log_result(fault, resolved_target="controller",
+                   result="crashed" if crashed else "already-down")
+
+    def heal():
+        respawn()
+    return heal
+
+
+@register_injector("scheduler_restart")
+def inject_scheduler_restart(ctx, fault):
+    """Gang-scheduler crash: admitted-set, quota usage, slice
+    placements and the backfill reservation fence die with the process;
+    the heal respawns a scheduler that must rebuild all of it from API
+    object conditions/annotations (no double admission, no leaked
+    chips, no partial gangs — sched/scheduler.py adoption/sweep paths).
+    No-ops, logged, against systems without a GangScheduler."""
+    crash = getattr(ctx.system, "crash_scheduler", None)
+    respawn = getattr(ctx.system, "respawn_scheduler", None)
+    if crash is None or respawn is None \
+            or getattr(ctx.system, "scheduler", None) is None:
+        ctx.log_result(fault, resolved_target="", result="no-scheduler")
+        return None
+    crashed = crash()
+    ctx.log_result(fault, resolved_target="scheduler",
+                   result="crashed" if crashed else "already-down")
+
+    def heal():
+        respawn()
+    return heal
+
+
 @register_injector("pod_delete")
 def inject_pod_delete(ctx, fault):
     """Delete the pod object through the API (eviction/drain analogue):

@@ -152,10 +152,98 @@ def sched_no_partial_gangs(system) -> List[str]:
     return out
 
 
+def sched_capacity_conserved(system) -> List[str]:
+    """Gang-scheduler restart-recovery invariant: the scheduler's
+    placement bookkeeping and the apiserver's Admitted conditions agree
+    — a placement without an admitted record is leaked chips, an
+    admitted record without a placement is a ghost gang, and an
+    Admitted=True job the scheduler does not know was never adopted
+    (double-admission risk: a second admission pass would place it
+    again).  No-ops for systems without a scheduler."""
+    scheduler = getattr(system, "scheduler", None)
+    if scheduler is None:
+        return []
+    from ..controller.status import get_condition, is_finished
+    from ..sched.api import job_queue_name
+
+    out = []
+    placed = set(scheduler.pool.placed_keys())
+    admitted = set(scheduler.admitted_keys())
+    for key in sorted(placed - admitted):
+        out.append(f"chips leaked: slice placement for {key} has no"
+                   f" admitted record")
+    for key in sorted(admitted - placed):
+        out.append(f"ghost gang: {key} admitted with no slice placement")
+    for job in system.client.server.list("kubeflow.org/v2beta1", "MPIJob"):
+        if not job_queue_name(job) or is_finished(job.status) \
+                or job.spec.run_policy.suspend:
+            continue
+        cond = get_condition(job.status, constants.JOB_ADMITTED)
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        if cond is not None and cond.status == core.CONDITION_TRUE \
+                and key not in admitted:
+            out.append(f"MPIJob {key} is Admitted=True but unknown to"
+                       f" the scheduler (not adopted — double-admission"
+                       f" risk)")
+    return out
+
+
+def no_surplus_worker_pods(system) -> List[str]:
+    """Duplicate-create invariant (controller restart recovery): a job
+    never accumulates more worker pods than its replica count, and
+    never more than one launcher Job — the respawned controller must
+    adopt its predecessor's objects, not re-create them."""
+    from ..api.types import worker_replicas
+    from ..controller.builders import launcher_name, worker_selector
+    from ..k8s.selectors import match_labels
+
+    out = []
+    jobs = list(system.client.server.list("kubeflow.org/v2beta1", "MPIJob"))
+    if not jobs:
+        return out
+    # One pass over the cluster-wide pod/launcher lists, bucketed by
+    # (namespace, job-name label) — the per-job loop then only matches
+    # selectors inside its own bucket (this runs in DEFAULT_INVARIANTS
+    # on every settle poll; O(jobs x pods) would bite at bench scale).
+    pods_by_job: dict = {}
+    for p in system.client.server.list("v1", "Pod"):
+        job_name = p.metadata.labels.get(constants.JOB_NAME_LABEL)
+        if job_name:
+            pods_by_job.setdefault(
+                (p.metadata.namespace, job_name), []).append(p)
+    launcher_count: dict = {}
+    for j in system.client.server.list("batch/v1", "Job"):
+        key = (j.metadata.namespace, j.metadata.name)
+        launcher_count[key] = launcher_count.get(key, 0) + 1
+    for job in jobs:
+        try:
+            replicas = worker_replicas(job) or 0
+        except Exception:
+            continue
+        selector = worker_selector(job.metadata.name)
+        bucket = pods_by_job.get(
+            (job.metadata.namespace, job.metadata.name), ())
+        owned = [p for p in bucket
+                 if match_labels(selector, p.metadata.labels)]
+        if len(owned) > replicas:
+            out.append(
+                f"MPIJob {job.metadata.namespace}/{job.metadata.name}:"
+                f" {len(owned)} worker pods exceed {replicas} replicas"
+                f" (duplicate creates)")
+        launchers = launcher_count.get(
+            (job.metadata.namespace, launcher_name(job)), 0)
+        if launchers > 1:
+            out.append(
+                f"MPIJob {job.metadata.namespace}/{job.metadata.name}:"
+                f" {launchers} launcher Jobs")
+    return out
+
+
 DEFAULT_INVARIANTS = (no_orphaned_runners, no_leaked_pod_ips,
                       no_orphaned_pods, gang_restarts_bounded,
                       jobs_converged, workqueue_idle,
-                      serve_requests_intact, sched_no_partial_gangs)
+                      serve_requests_intact, sched_no_partial_gangs,
+                      sched_capacity_conserved, no_surplus_worker_pods)
 
 
 def checkpoint_intact(directory: str) -> List[str]:

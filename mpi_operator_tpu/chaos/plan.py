@@ -113,16 +113,42 @@ FLEET_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + ("replica_kill",)
 # default tuple is untouched, so existing seeds replay identically.
 SCHED_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + ("spot_reclaim",)
 
+# The macro-soak's everything-on tuple (docs/RESILIENCE.md "Macro-soak
+# & crash recovery"): every opt-in kind plus the control-plane restart
+# injectors.  Only full-stack systems (soak harness: training gangs
+# through queues + serving fleet + restartable control plane) exercise
+# every member; the rest no-op with a logged reason.  The DEFAULT tuple
+# stays untouched — recorded seeds keep deriving byte-identical plans
+# (regression-tested in tests/test_soak.py).
+FULL_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + (
+    "replica_kill", "spot_reclaim", "controller_restart",
+    "scheduler_restart")
+
+# Named presets for `randomized_plan(profile=...)`.
+PLAN_PROFILES = {
+    "default": RANDOMIZABLE_KINDS,
+    "fleet": FLEET_RANDOMIZABLE_KINDS,
+    "sched": SCHED_RANDOMIZABLE_KINDS,
+    "full": FULL_RANDOMIZABLE_KINDS,
+}
+
 
 def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
                     kinds=RANDOMIZABLE_KINDS,
-                    name: Optional[str] = None) -> FaultPlan:
+                    name: Optional[str] = None,
+                    profile: Optional[str] = None) -> FaultPlan:
     """Derive a fault plan from a seed — same seed, same plan, always.
 
     Targets are left empty: the injectors resolve them against live
     cluster state with the scenario RNG and record the resolution in
     the event log, so a failing run replays via `FaultPlan.from_events`.
+
+    ``profile`` names a kind preset (PLAN_PROFILES: "default", "fleet",
+    "sched", "full") and overrides ``kinds`` when given — "full" is the
+    macro-soak's documented everything-on tuple.
     """
+    if profile is not None:
+        kinds = PLAN_PROFILES[profile]
     rng = random.Random(seed)
     faults = []
     for _ in range(n_faults):
@@ -159,6 +185,10 @@ def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
             # the slice back online, modelling spot capacity returning.
             fault.duration = round(rng.uniform(0.5, 2.0), 3)
             fault.params = {"grace": round(rng.uniform(0.2, 0.8), 3)}
+        elif kind in ("controller_restart", "scheduler_restart"):
+            # duration = the control-plane outage before the respawn;
+            # the restarted loop rebuilds its state from the apiserver.
+            fault.duration = round(rng.uniform(0.4, 1.5), 3)
         faults.append(fault)
     return FaultPlan(name=name or f"randomized-{seed}", seed=seed,
                      faults=faults)

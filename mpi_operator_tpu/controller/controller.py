@@ -22,7 +22,8 @@ from ..api.defaults import set_defaults_mpijob
 from ..api.types import MPIJob, worker_replicas
 from ..api.validation import validate_mpijob
 from ..k8s import batch, core
-from ..k8s.apiserver import ApiError, Clientset, is_conflict, is_not_found
+from ..k8s.apiserver import (ApiError, Clientset, is_already_exists,
+                             is_conflict, is_not_found)
 from ..k8s.informers import InformerFactory
 from ..k8s.meta import Clock, deep_copy, get_controller_of
 from ..k8s.selectors import match_label_selector, match_labels
@@ -460,10 +461,17 @@ class MPIJobController:
                               == constants.LAUNCHER_CREATION_AT_STARTUP)
                 if at_startup or self._count_ready_workers(workers) == len(workers):
                     try:
-                        launcher = self.client.jobs(namespace).create(
-                            builders.new_launcher_job(
-                                mpi_job, self.pod_group_ctrl, self.recorder,
-                                self.cluster_domain))
+                        launcher = self._create_or_adopt(
+                            "Job",
+                            lambda: self.client.jobs(namespace).create(
+                                builders.new_launcher_job(
+                                    mpi_job, self.pod_group_ctrl,
+                                    self.recorder, self.cluster_domain)),
+                            lambda: self.client.jobs(namespace).get(
+                                builders.launcher_name(mpi_job)))
+                        if not is_controlled_by(launcher, mpi_job):
+                            raise self._resource_exists_error(
+                                mpi_job, launcher.metadata.name, "Job")
                     except Exception as exc:
                         self.recorder.eventf(
                             mpi_job, core.EVENT_TYPE_WARNING,
@@ -516,6 +524,30 @@ class MPIJobController:
         cond = get_condition(job.status, constants.JOB_ADMITTED)
         return cond is None or cond.status != core.CONDITION_TRUE
 
+    def _create_or_adopt(self, kind: str, create_fn, get_fn):
+        """Create an owned object, adopting the live one on
+        AlreadyExists instead of failing the sync.  This is the
+        controller-restart recovery contract (docs/RESILIENCE.md): a
+        respawned controller's informer caches may lag the objects its
+        previous incarnation just wrote, and the level-triggered sync
+        must converge on the apiserver's truth — never create a
+        duplicate, never error-loop on its own prior work.  The caller
+        still ownership-checks the returned object (a foreign
+        same-named object stays a hard ErrResourceExists)."""
+        try:
+            return create_fn()
+        except Exception as exc:
+            if not is_already_exists(exc):
+                raise
+            live = get_fn()
+            adoptions = self.metrics.get("restart_adoptions")
+            if adoptions is not None:
+                adoptions.inc()
+            meta = getattr(live, "metadata", None)
+            flight.record("controller", "adopted_existing", kind=kind,
+                          name=getattr(meta, "name", ""))
+            return live
+
     def _resource_exists_error(self, job: MPIJob, name: str, kind: str):
         msg = MESSAGE_RESOURCE_EXISTS % (name, kind)
         self.recorder.event(job, core.EVENT_TYPE_WARNING,
@@ -538,7 +570,12 @@ class MPIJobController:
         svc = self.service_informer.lister.get(job.metadata.namespace,
                                                new_svc.metadata.name)
         if svc is None:
-            return self.client.services(job.metadata.namespace).create(new_svc)
+            svc = self._create_or_adopt(
+                "Service",
+                lambda: self.client.services(
+                    job.metadata.namespace).create(new_svc),
+                lambda: self.client.services(
+                    job.metadata.namespace).get(new_svc.metadata.name))
         if not is_controlled_by(svc, job):
             raise self._resource_exists_error(job, svc.metadata.name,
                                               "Service")
@@ -578,7 +615,12 @@ class MPIJobController:
         cm = self.config_map_informer.lister.get(
             job.metadata.namespace, job.metadata.name + builders.CONFIG_SUFFIX)
         if cm is None:
-            return self.client.config_maps(job.metadata.namespace).create(new_cm)
+            cm = self._create_or_adopt(
+                "ConfigMap",
+                lambda: self.client.config_maps(
+                    job.metadata.namespace).create(new_cm),
+                lambda: self.client.config_maps(
+                    job.metadata.namespace).get(new_cm.metadata.name))
         if not is_controlled_by(cm, job):
             raise self._resource_exists_error(job, cm.metadata.name,
                                               "ConfigMap")
@@ -595,8 +637,13 @@ class MPIJobController:
             job.metadata.namespace,
             job.metadata.name + builders.SSH_AUTH_SECRET_SUFFIX)
         if secret is None:
-            return self.client.secrets(job.metadata.namespace).create(
-                builders.new_ssh_auth_secret(job))
+            built = builders.new_ssh_auth_secret(job)
+            secret = self._create_or_adopt(
+                "Secret",
+                lambda: self.client.secrets(
+                    job.metadata.namespace).create(built),
+                lambda: self.client.secrets(
+                    job.metadata.namespace).get(built.metadata.name))
         if not is_controlled_by(secret, job):
             raise self._resource_exists_error(job, secret.metadata.name,
                                               "Secret")
@@ -820,9 +867,16 @@ class MPIJobController:
                                                builders.worker_name(job, i))
             if pod is None:
                 try:
-                    pod = self.client.pods(job.metadata.namespace).create(
-                        builders.new_worker(job, i, self.pod_group_ctrl,
-                                            self.cluster_domain))
+                    pod = self._create_or_adopt(
+                        "Pod",
+                        lambda i=i: self.client.pods(
+                            job.metadata.namespace).create(
+                                builders.new_worker(
+                                    job, i, self.pod_group_ctrl,
+                                    self.cluster_domain)),
+                        lambda i=i: self.client.pods(
+                            job.metadata.namespace).get(
+                                builders.worker_name(job, i)))
                 except Exception as exc:
                     self.recorder.eventf(job, core.EVENT_TYPE_WARNING,
                                          MPI_JOB_FAILED_REASON,

@@ -78,3 +78,8 @@ def backfill_telemetry_metrics(metrics: dict) -> None:
         "mpi_operator_status_writes_suppressed_total",
         "MPIJob status UPDATEs skipped because the desired status"
         " matched the informer-cached snapshot"))
+    metrics.setdefault("restart_adoptions", registry.counter(
+        "mpi_operator_restart_adoptions_total",
+        "Owned objects adopted on AlreadyExists instead of created"
+        " (controller-restart recovery: informer caches lagging the"
+        " previous incarnation's writes)"))

@@ -100,6 +100,7 @@ class JobController:
 
     def sync_job(self, job: batch.Job) -> None:
         pods = self._job_pods(job)
+        self._reseed_pod_serial(pods)
         active = [p for p in pods if p.status.phase in (core.POD_PENDING,
                                                         core.POD_RUNNING)]
         succeeded = sum(1 for p in pods if p.status.phase == core.POD_SUCCEEDED)
@@ -212,6 +213,22 @@ class JobController:
         changed.status.succeeded = succeeded
         changed.status.failed = failed
         self._update_status_if_changed(job, changed)
+
+    def _reseed_pod_serial(self, pods: list) -> None:
+        """Restart recovery: the pod-name serial is in-memory, so a
+        respawned controller would restart at 0 and collide with pods
+        its previous incarnation created — a finished pod's name then
+        blocks every subsequent create (AlreadyExists forever, the job
+        wedges).  Advance the serial past every name already in the
+        apiserver before creating."""
+        for p in pods:
+            suffix = p.metadata.name.rsplit("-", 1)[-1]
+            try:
+                seen = int(suffix, 16)
+            except ValueError:
+                continue
+            if seen > self._pod_serial:
+                self._pod_serial = seen
 
     def _new_pod(self, job: batch.Job):
         self._pod_serial += 1

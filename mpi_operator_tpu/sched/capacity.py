@@ -114,6 +114,42 @@ class SlicePool:
             self._placements[key] = assignment
             return dict(assignment)
 
+    def place_exact(self, key: str,
+                    assignment: Dict[str, int]) -> Optional[Dict[str, int]]:
+        """All-or-nothing claim of an EXACT per-slice assignment — the
+        scheduler-restart adoption path, which must re-place a gang on
+        the slices its pods actually occupy (recorded in the job's
+        slices annotation) instead of greedily re-deciding.  Returns
+        None (claiming nothing) when any named slice is unknown,
+        offline, or lacks the free chips."""
+        with self._lock:
+            if key in self._placements:
+                raise ValueError(f"job {key!r} already placed")
+            for name, take in assignment.items():
+                if take < 0:
+                    return None
+                if name not in self._slices or name in self._offline:
+                    return None
+                if self._free[name] < take:
+                    return None
+            claimed = {name: take for name, take in assignment.items()
+                       if take > 0}
+            for name, take in claimed.items():
+                self._free[name] -= take
+            self._placements[key] = claimed
+            return dict(claimed)
+
+    def clear_placements(self) -> None:
+        """Drop every placement, freeing all chips, while keeping slice
+        topology and offline state.  Models a scheduler restart: the
+        placements were the dead scheduler's in-memory view; the pool
+        (the hardware) keeps which slices exist and which are
+        reclaimed, and the new scheduler re-learns placements from the
+        apiserver."""
+        with self._lock:
+            self._placements.clear()
+            self._free = {s.name: s.chips for s in self._slices.values()}
+
     def release(self, key: str) -> int:
         """Release a placement; returns the chips that came back to the
         ONLINE free pool.  Chips on an offline (reclaimed) slice are

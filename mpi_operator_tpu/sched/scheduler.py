@@ -174,6 +174,13 @@ class GangScheduler:
         # recomputing validate_mpijob/cal_pg_min_resource per walk is
         # quadratic in the backlog (visible at a 100-job burst).
         self._job_cache: Dict[str, tuple] = {}
+        # One-shot crash-recovery sweep (first reconcile): a scheduler
+        # that died mid-eviction leaves a non-admitted gang's pods
+        # running — the restarted instance must finish the eviction or
+        # the no-partial-gangs invariant stays violated.  Steady state
+        # never recreates the condition, so the (O(pods)) sweep runs
+        # exactly once per scheduler lifetime.
+        self._swept = False
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._kick = threading.Event()
@@ -273,6 +280,7 @@ class GangScheduler:
             self._release_departed(jobs)
             self._finish_due_evictions(jobs)
             self._adopt_admitted(jobs, lqs, cqs)
+            self._sweep_partial_gangs(jobs)
             admissions = self._admission_passes(jobs, lqs, cqs)
             self._maybe_preempt(jobs, lqs, cqs)
             self._publish(jobs, lqs, cqs)
@@ -441,11 +449,52 @@ class GangScheduler:
             # bounded even under a sustained small-job arrival stream.
             blocked["reserved"] = min(blocked["reserved"] + freed,
                                       blocked["chips"])
+            # Persist the accrual on the fenced gang itself so a
+            # restarted scheduler rebuilds the fence instead of
+            # resetting the gang's earned progress to zero (the
+            # apiserver is the source of truth; docs/RESILIENCE.md).
+            if freed > 0:
+                self._persist_reservation(blocked["key"],
+                                          blocked["reserved"])
+
+    @staticmethod
+    def _recorded_placement(job) -> Optional[Dict[str, int]]:
+        """The slice assignment the admitting scheduler wrote on the
+        job (``scheduling.kubeflow.org/slices``: "a:256,b:128"), or
+        None when absent/malformed."""
+        raw = (job.metadata.annotations or {}).get(
+            constants.SCHED_SLICES_ANNOTATION)
+        if raw is None:
+            return None
+        if raw == "":
+            return {}  # zero-chip gang: a real (empty) placement
+        out: Dict[str, int] = {}
+        for part in raw.split(","):
+            name, sep, take = part.partition(":")
+            if not sep or not name:
+                return None
+            try:
+                chips = int(take)
+            except ValueError:
+                return None
+            if chips <= 0:
+                return None
+            out[name] = chips
+        return out
 
     def _adopt_admitted(self, jobs, lqs, cqs) -> None:
         """Re-place jobs already carrying Admitted=True that this
-        scheduler instance does not know (restart resilience).  A job
-        that no longer fits is evicted and requeued immediately."""
+        scheduler instance does not know (restart resilience).
+
+        The slices annotation the admitting incarnation wrote is the
+        source of truth: the gang is re-placed on EXACTLY the recorded
+        slices (its pods physically occupy those chips — a greedy
+        re-decision could double-book chips another adopted gang holds
+        while leaking the ones this gang really uses).  Only when the
+        record is missing/unsatisfiable (slice reclaimed, annotation
+        lost) does adoption fall back to a fresh greedy placement, and
+        a job that no longer fits at all is evicted and requeued
+        immediately."""
         for key, job in sorted(jobs.items()):
             if key in self._admitted or is_finished(job.status) \
                     or job.spec.run_policy.suspend:
@@ -456,8 +505,15 @@ class GangScheduler:
             cq = self._cq_of(job, lqs, cqs)
             demand, valid = self._job_facts(key, job)
             chips = demand[constants.TPU_RESOURCE] if valid else 0
-            if cq is not None and valid \
-                    and self.pool.place(key, chips) is not None:
+            placement = None
+            if cq is not None and valid:
+                recorded = self._recorded_placement(job)
+                if recorded is not None \
+                        and sum(recorded.values()) == chips:
+                    placement = self.pool.place_exact(key, recorded)
+                if placement is None:
+                    placement = self.pool.place(key, chips)
+            if placement is not None:
                 self._epoch += 1
                 self._admitted[key] = {
                     "cq": cq.metadata.name, "demand": demand,
@@ -465,12 +521,54 @@ class GangScheduler:
                     "ns": job.metadata.namespace,
                     "name": job.metadata.name}
                 self.metrics["admissions"].labels("adopted").inc()
+                flight.record("sched", "adopted", job=key, chips=chips,
+                              slices=",".join(
+                                  f"{n}:{t}" for n, t
+                                  in sorted(placement.items())))
             else:
                 self._set_conditions(
                     job.metadata.namespace, job.metadata.name,
                     admitted=False, reason=MPI_JOB_QUEUED_REASON,
                     message="re-queued: admitted placement no longer"
                             " fits (scheduler restart)")
+                self._evict_now(job, EVICT_REQUEUED)
+
+    def _sweep_partial_gangs(self, jobs) -> None:
+        """One-shot crash recovery: a scheduler that died inside an
+        eviction grace window (conditions already flipped off Admitted,
+        pods still running) or mid-eviction leaves a partial gang no
+        steady-state path will clean up — the controller's gate is shut
+        (it creates nothing, deletes nothing) and the new scheduler has
+        no record of the eviction.  Finish it here: every queue-managed
+        job that is NOT admitted yet still has worker pods is evicted
+        (pods + launcher deleted) and requeues cleanly."""
+        if self._swept:
+            return
+        candidates = []
+        for key, job in sorted(jobs.items()):
+            if key in self._admitted or key in self._preempting:
+                continue
+            if is_finished(job.status) or not job_queue_name(job):
+                continue
+            cond = get_condition(job.status, constants.JOB_ADMITTED)
+            if cond is not None and cond.status == core.CONDITION_TRUE:
+                continue  # adoption path owns admitted jobs
+            candidates.append((key, job))
+        if not candidates:
+            self._swept = True
+            return
+        try:
+            pods = self.client.server.list("v1", "Pod", self.namespace)
+        except Exception:
+            return  # API weather: retry next tick
+        self._swept = True
+        from ..controller import builders
+        for key, job in candidates:
+            selector = builders.worker_selector(job.metadata.name)
+            if any(p.metadata.namespace == job.metadata.namespace
+                   and match_labels(selector, p.metadata.labels)
+                   for p in pods):
+                flight.record("sched", "partial_gang_swept", job=key)
                 self._evict_now(job, EVICT_REQUEUED)
 
     # -- eviction protocol -------------------------------------------------
@@ -638,6 +736,7 @@ class GangScheduler:
             order = self._order(pending, usage)
             if not order:
                 if self._blocked is not None:
+                    self._clear_reservation(self._blocked["key"])
                     self._blocked = None
                 return admissions
             # The reservation protects ONE gang; release the fence once
@@ -649,6 +748,16 @@ class GangScheduler:
             pending_keys = {self._key(job) for _, job in order}
             if self._blocked is not None \
                     and self._blocked["key"] not in pending_keys:
+                # The gang stopped being pending without admitting
+                # (finished, deleted, suspended): its earned
+                # reservation is void — clear the persisted record so
+                # a LATER queued episode (resume, resubmit) starts
+                # from zero instead of re-claiming chips that were
+                # already consumed.  (Admission clears it separately
+                # in _set_conditions; a scheduler restart keeps the
+                # gang continuously pending, so the record survives
+                # exactly the episodes it should.)
+                self._clear_reservation(self._blocked["key"])
                 self._blocked = None
             admitted_this_walk = False
             # Queues whose front (oldest eligible) job failed QUOTA this
@@ -698,8 +807,23 @@ class GangScheduler:
                     # everyone else forever.
                     if (self._blocked is None or outranks_fence) \
                             and chips <= self.pool.total_chips:
+                        # Restore previously-earned reservation (the
+                        # annotation a prior incarnation persisted):
+                        # after a scheduler restart the fence resumes
+                        # from where it was, not from zero.
+                        restored = 0
+                        raw = (job.metadata.annotations or {}).get(
+                            constants.SCHED_RESERVATION_ANNOTATION)
+                        if raw:
+                            try:
+                                restored = max(0, min(int(raw), chips))
+                            except ValueError:
+                                restored = 0
+                        if restored:
+                            flight.record("sched", "fence_restored",
+                                          job=key, reserved=restored)
                         self._blocked = {"key": key,
-                                         "reserved": 0,
+                                         "reserved": restored,
                                          "chips": chips,
                                          "priority": job_priority(job)}
                     if not self.backfill:
@@ -846,6 +970,58 @@ class GangScheduler:
         return True
 
     # -- status / conditions ----------------------------------------------
+    def _persist_reservation(self, key: str, reserved: int) -> None:
+        """Best-effort write of the fence's accrued reservation onto
+        the blocked gang (conflict-retried; a lost write only means a
+        restarted scheduler under-restores, which is safe — the fence
+        re-earns the difference, it never over-admits)."""
+        namespace, _, name = key.partition("/")
+        for _ in range(3):
+            try:
+                job = self.client.mpi_jobs(namespace).get(name)
+                annotations = dict(job.metadata.annotations or {})
+                if annotations.get(
+                        constants.SCHED_RESERVATION_ANNOTATION) \
+                        == str(reserved):
+                    return
+                annotations[constants.SCHED_RESERVATION_ANNOTATION] = \
+                    str(reserved)
+                job.metadata.annotations = annotations
+                self.client.mpi_jobs(namespace).update(job)
+                return
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                if is_conflict(exc):
+                    continue
+                logger.debug("reservation write for %s failed: %s",
+                             key, exc)
+                return
+
+    def _clear_reservation(self, key: str) -> None:
+        """Best-effort removal of the persisted fence record when the
+        fenced gang leaves the pending set without admitting."""
+        namespace, _, name = key.partition("/")
+        for _ in range(3):
+            try:
+                job = self.client.mpi_jobs(namespace).get(name)
+                annotations = dict(job.metadata.annotations or {})
+                if constants.SCHED_RESERVATION_ANNOTATION \
+                        not in annotations:
+                    return
+                annotations.pop(constants.SCHED_RESERVATION_ANNOTATION)
+                job.metadata.annotations = annotations
+                self.client.mpi_jobs(namespace).update(job)
+                return
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                if is_conflict(exc):
+                    continue
+                logger.debug("reservation clear for %s failed: %s",
+                             key, exc)
+                return
+
     def _set_conditions(self, namespace: str, name: str, admitted: bool,
                         reason: str, message: str, slices: str = "",
                         backfilled: bool = False) -> None:
@@ -867,6 +1043,10 @@ class GangScheduler:
             annotations = dict(job.metadata.annotations or {})
             if admitted:
                 annotations[constants.SCHED_SLICES_ANNOTATION] = slices
+                # Admission consumes the fence: the earned reservation
+                # record must not survive into a later queued episode.
+                annotations.pop(constants.SCHED_RESERVATION_ANNOTATION,
+                                None)
                 if backfilled:
                     annotations[constants.SCHED_BACKFILL_ANNOTATION] = "true"
                 else:

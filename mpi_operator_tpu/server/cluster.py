@@ -32,7 +32,14 @@ class LocalCluster:
         # An injected client lets the identical stack run over a remote
         # transport (e.g. KubeApiServer against kube path grammar).
         self.client = client or Clientset()
+        # Respawn config (crash_controller/respawn_controller — the
+        # chaos controller_restart surface, docs/RESILIENCE.md): what a
+        # fresh controller process would read from its flags.
+        self._cluster_domain = cluster_domain
+        self._namespace = namespace
+        self._sched_options = dict(sched_options or {})
         pod_group_ctrl = new_pod_group_ctrl(gang_scheduler, self.client)
+        self._pod_group_ctrl = pod_group_ctrl
         self.controller = MPIJobController(
             self.client, pod_group_ctrl=pod_group_ctrl,
             cluster_domain=cluster_domain, namespace=namespace)
@@ -91,6 +98,91 @@ class LocalCluster:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- control-plane crash/respawn (chaos restart surface) ---------------
+    # The data plane (kubelet pods, serving replicas) and the apiserver
+    # survive; only the reconcile/scheduler loops die and come back with
+    # EMPTY in-memory state — recovery must rebuild everything from the
+    # apiserver (docs/RESILIENCE.md "Macro-soak & crash recovery").
+
+    def crash_controller(self) -> bool:
+        """Kill the MPIJob controller and the batch Job controller
+        mid-flight.  In-memory state (informer caches, workqueues,
+        in-flight maps, the Job controller's pod-name serial) is gone;
+        whatever half-finished writes the dying sync made stay in the
+        apiserver for the next incarnation to reconcile.  Idempotent:
+        a randomized plan may draw overlapping restart faults, and
+        crashing an already-dead controller must not take out the one
+        the first fault's heal just respawned.  Returns False for that
+        no-op case (the chaos log and restart accounting must not
+        count a crash that never happened)."""
+        if getattr(self, "_controller_down", False):
+            return False
+        self._controller_down = True
+        self.controller.stop()
+        self.job_controller.stop()
+        return True
+
+    def respawn_controller(self) -> "MPIJobController":
+        """Start a fresh controller against the same apiserver.  The
+        metrics dict carries over (the monitoring system outlives the
+        process; histograms/counters keep accumulating across the
+        restart) and registered foreign-kind handlers re-attach, but
+        caches, queues and adoption state all rebuild from a cold list:
+        level-triggered sync + AlreadyExists-adoption must converge
+        without duplicate creates."""
+        if not getattr(self, "_controller_down", False):
+            return self.controller  # already live (overlapping heals)
+        self._controller_down = False
+        old = self.controller
+        self.controller = MPIJobController(
+            self.client, pod_group_ctrl=self._pod_group_ctrl,
+            cluster_domain=self._cluster_domain,
+            namespace=self._namespace, metrics=old.metrics)
+        for prefix, handler in old._kind_handlers.items():
+            self.controller.register_kind_handler(prefix, handler)
+        self.job_controller = JobController(self.client,
+                                            namespace=self._namespace)
+        self.controller.run(self._threadiness)
+        self.job_controller.start()
+        return self.controller
+
+    def crash_scheduler(self) -> bool:
+        """Kill the gang scheduler mid-flight: admitted-set, quota
+        usage, slice placements, open grace windows and the backfill
+        reservation fence all evaporate with the process.  Idempotent,
+        like crash_controller; False = nothing to crash."""
+        if self.scheduler is None or getattr(self, "_scheduler_down",
+                                             False):
+            return False
+        self._scheduler_down = True
+        self.scheduler.stop()
+        return True
+
+    def respawn_scheduler(self):
+        """Start a fresh GangScheduler over the SAME SlicePool — the
+        pool is the hardware (slice topology + spot offline state
+        persist across a control-plane restart) while its placements
+        were the dead scheduler's in-memory view, so they are wiped and
+        rebuilt from API object conditions/annotations: Admitted=True
+        jobs re-place on their recorded slices, the reservation
+        annotation re-arms the fence, and orphaned partial gangs are
+        swept."""
+        if self.scheduler is None:
+            return None
+        if not getattr(self, "_scheduler_down", False):
+            return self.scheduler  # already live (overlapping heals)
+        self._scheduler_down = False
+        from ..sched import GangScheduler
+        pool = self.scheduler.pool
+        pool.clear_placements()
+        self.scheduler = GangScheduler(
+            self.client, pool, kubelet=self.kubelet,
+            namespace=self._namespace,
+            registry=self.controller.metrics.get("registry"),
+            **self._sched_options)
+        self.scheduler.start()
+        return self.scheduler
 
     # -- conveniences ------------------------------------------------------
     def submit(self, mpi_job):

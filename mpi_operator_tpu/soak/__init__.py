@@ -1,0 +1,21 @@
+"""Cluster-in-a-box macro-soak: the full stack under load and chaos,
+scored on end-to-end SLOs (docs/RESILIENCE.md "Macro-soak & crash
+recovery").
+
+- ``slo``: the scorecard math (exact + histogram quantiles, goodput,
+  `SloScorecard`) and the soak metric families.
+- ``traffic``: seeded serve load (mixed open/closed-loop) and the
+  small-job arrival stream.
+- ``harness``: `SoakHarness` — LocalCluster + gang scheduler + ServeJob
+  fleet + chaos plan (incl. controller/scheduler restart faults) in one
+  process, producing a scorecard and one unified flight-recorder bundle
+  per run.
+"""
+
+from .harness import (GANG_PREFIX, SMALL_PREFIX, SoakConfig,  # noqa: F401
+                      SoakHarness, SoakResult, gang_job, small_job)
+from .replicas import tiny_llama_server_factory  # noqa: F401
+from .slo import (SloScorecard, goodput_pct, histogram_quantile,  # noqa: F401
+                  new_soak_metrics, quantile)
+from .traffic import (ServeTraffic, ServeWorkload,  # noqa: F401
+                      SmallJobStream, stream_request)

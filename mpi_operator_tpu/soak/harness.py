@@ -1,0 +1,641 @@
+"""Cluster-in-a-box macro-soak harness.
+
+One process stands up the WHOLE stack — LocalCluster (apiserver +
+MPIJob controller + batch Job controller + kubelet) with the gang
+scheduler admitting N training gangs through ClusterQueues, a ServeJob
+fleet behind the prefix-aware router under mixed open/closed-loop
+traffic — and drives a seeded chaos plan against it, including the
+control-plane restart faults (``controller_restart`` /
+``scheduler_restart``), then scores the run on the end-to-end SLO
+scorecard (soak/slo.py): train goodput %, serve p99 TTFT, reconcile
+p99, small-job admission p99, zero invariant violations, zero lost
+requests.  Every run cuts ONE unified flight-recorder bundle (the
+chaos engine's ``bundle="always"`` path) with a lane per layer.
+
+The harness is LocalCluster-shaped for the chaos engine and the
+default invariants (``.client``/``.controller``/``.kubelet``/
+``.scheduler``/``.router``), and adds the restart surface the new
+injectors call (``crash_controller``/``respawn_controller``/
+``crash_scheduler``/``respawn_scheduler``), with recovery measured
+into ``mpi_operator_soak_restart_recovery_seconds``.
+
+Used by bench_soak.py (the minutes-long scored run -> BENCH_SOAK.json)
+and tools/soak_smoke.py (`make soak-smoke`, < 60s).  See
+docs/RESILIENCE.md "Macro-soak & crash recovery".
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import constants
+from ..api.types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy
+from ..chaos import DEFAULT_INVARIANTS, ChaosEngine, FaultPlan
+from ..k8s import core
+from ..k8s.apiserver import Clientset
+from ..k8s.core import Container, PodSpec, PodTemplateSpec
+from ..k8s.meta import ObjectMeta
+from ..sched.api import (ClusterQueue, ClusterQueueSpec, LocalQueue,
+                         LocalQueueSpec)
+from ..sched.capacity import TpuSlice
+from ..server import LocalCluster
+from ..telemetry import flight
+from .slo import (SloScorecard, goodput_pct, histogram_quantile,
+                  new_soak_metrics, quantile)
+from .traffic import ServeTraffic, ServeWorkload, SmallJobStream
+
+logger = logging.getLogger("mpi_operator_tpu.soak")
+
+GANG_PREFIX = "gang-"
+SMALL_PREFIX = "small-"
+SERVE_NAMESPACE = "serve"
+
+
+def _fault_applied(ev: dict) -> bool:
+    """True when an inject event actually changed the system — no-op
+    results (missing surface, already-down component, unknown kind) are
+    excluded the SAME way in the live faults counter and the scorecard,
+    so /metrics and BENCH_SOAK.json agree."""
+    result = str(ev.get("result", ""))
+    return not (result.startswith("no-")
+                or result.startswith("already-")
+                or result == "unknown-kind")
+
+
+@dataclass
+class SoakConfig:
+    seed: int = 42
+    duration: float = 60.0          # chaos-plan horizon / traffic window
+    # Training side (namespace "default", admitted through queues).
+    gangs: int = 2
+    gang_workers: int = 2
+    small_rate: float = 0.3         # small-job arrivals per second
+    small_limit: Optional[int] = None
+    slices: List[TpuSlice] = field(default_factory=lambda: [
+        TpuSlice("slice-0", 8), TpuSlice("slice-1", 8, spot=True)])
+    gang_quota: Optional[int] = None    # default: all chips
+    small_quota: Optional[int] = None   # default: half the chips
+    checkpoint_grace: float = 0.5
+    # Serving side (namespace "serve", its own controller + router).
+    serve_replicas: int = 2
+    tenants: int = 6
+    prefix_tokens: int = 32
+    max_new_tokens: int = 8
+    closed_clients: int = 3
+    open_rate: float = 4.0
+    # Chaos.
+    plan: Optional[FaultPlan] = None  # None -> randomized_plan(full)
+    n_faults: int = 10
+    converge_timeout: float = 60.0
+    settle: float = 10.0
+    threadiness: int = 4
+
+
+@dataclass
+class SoakResult:
+    scorecard: SloScorecard
+    report: object                   # chaos.ChaosReport
+    bundle_dir: Optional[str]
+
+    def to_dict(self) -> dict:
+        return {
+            "scorecard": self.scorecard.to_dict(),
+            "chaos": {
+                "plan": self.report.plan_name,
+                "seed": self.report.seed,
+                "converged": self.report.converged,
+                "violations": self.report.violations,
+                "events": len(self.report.events),
+            },
+            "bundle_dir": self.bundle_dir,
+        }
+
+
+class _JobMonitor:
+    """Watch-driven MPIJob timeline accounting (no sleep-polling): per
+    job, the admission wait (first ADDED -> Admitted=True) and the
+    goodput split (Running time vs disrupted time after first Running),
+    all on the monotonic clock.  Also mirrors the chaos engine's inject
+    events into the live soak fault counters so /metrics moves during
+    the run, not after it."""
+
+    def __init__(self, client: Clientset, soak_metrics: dict,
+                 namespace: str = "default"):
+        self.client = client
+        self.metrics = soak_metrics
+        self.namespace = namespace
+        self.state: Dict[str, dict] = {}
+        self.engine: Optional[ChaosEngine] = None
+        self._faults_seen = 0
+        self._stop = threading.Event()
+        self._watch = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- condition handling ------------------------------------------------
+    def _entry(self, key: str, now: float) -> dict:
+        return self.state.setdefault(key, {
+            "created": now, "admitted": None, "first_run": None,
+            "running_since": None, "disrupted_since": None,
+            "productive": 0.0, "disrupted": 0.0, "finished": False})
+
+    def _apply(self, job, now: float) -> None:
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        st = self._entry(key, now)
+        if st["finished"]:
+            return
+        conds = {c.type: c.status for c in job.status.conditions}
+        true = core.CONDITION_TRUE
+        if st["admitted"] is None \
+                and conds.get(constants.JOB_ADMITTED) == true:
+            st["admitted"] = now
+        # "Productive" demands the FULL gang: the Running condition is
+        # level-held through gang repairs (a killed worker does not
+        # flip it), so goodput must also watch the worker replica
+        # status — a degraded gang (active < desired) is disruption,
+        # exactly the wall time a real data-parallel job would lose to
+        # the restart (checkpoint rewind + re-form).
+        running = conds.get(constants.JOB_RUNNING) == true
+        if running:
+            from ..api.types import worker_replicas
+            try:
+                desired = worker_replicas(job) or 0
+            except Exception:
+                desired = 0
+            ws = job.status.replica_statuses.get(
+                constants.REPLICA_TYPE_WORKER)
+            if desired and (ws is None or ws.active < desired):
+                running = False
+        finished = (conds.get(constants.JOB_SUCCEEDED) == true
+                    or conds.get(constants.JOB_FAILED) == true)
+        if running and st["running_since"] is None:
+            st["running_since"] = now
+            if st["first_run"] is None:
+                st["first_run"] = now
+            if st["disrupted_since"] is not None:
+                st["disrupted"] += now - st["disrupted_since"]
+                st["disrupted_since"] = None
+        elif not running and st["running_since"] is not None:
+            st["productive"] += now - st["running_since"]
+            st["running_since"] = None
+            if not finished:
+                st["disrupted_since"] = now
+        if finished:
+            self._close(st, now)
+
+    def _close(self, st: dict, now: float) -> None:
+        if st["running_since"] is not None:
+            st["productive"] += now - st["running_since"]
+            st["running_since"] = None
+        if st["disrupted_since"] is not None:
+            st["disrupted"] += now - st["disrupted_since"]
+            st["disrupted_since"] = None
+        st["finished"] = True
+
+    def _drain_engine_events(self) -> None:
+        engine = self.engine
+        if engine is None:
+            return
+        events = engine.events[self._faults_seen:]
+        for ev in events:
+            self._faults_seen += 1
+            if ev.get("event") == "inject" \
+                    and _fault_applied(ev):
+                self.metrics["faults"].labels(ev.get("kind", "?")).inc()
+
+    # -- loop ----------------------------------------------------------------
+    def _loop(self) -> None:
+        from ..k8s.apiserver import DELETED, RELIST
+        while not self._stop.is_set():
+            ev = self._watch.next(timeout=0.2)
+            now = time.monotonic()
+            self._drain_engine_events()
+            if ev is None:
+                continue
+            if ev.type == RELIST:
+                for job in self.client.server.list(
+                        constants.GROUP_VERSION, constants.KIND,
+                        self.namespace):
+                    self._apply(job, now)
+                continue
+            if ev.obj.metadata.namespace != self.namespace:
+                continue
+            if ev.type == DELETED:
+                key = (f"{ev.obj.metadata.namespace}/"
+                       f"{ev.obj.metadata.name}")
+                st = self.state.get(key)
+                if st is not None:
+                    self._close(st, now)
+                continue
+            self._apply(ev.obj, now)
+
+    def start(self) -> "_JobMonitor":
+        self._watch = self.client.server.watch(constants.GROUP_VERSION,
+                                               constants.KIND)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="soak-job-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent: called from the soak's finally AND from harness
+        teardown — the timeline must only be finalized once."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        now = time.monotonic()
+        for st in self.state.values():
+            if not st["finished"]:
+                self._close(st, now)
+        self._drain_engine_events()
+
+    # -- scoring views -------------------------------------------------------
+    def admission_waits(self, prefix: str) -> List[float]:
+        return [st["admitted"] - st["created"]
+                for key, st in sorted(self.state.items())
+                if key.split("/", 1)[1].startswith(prefix)
+                and st["admitted"] is not None]
+
+    def goodput_totals(self, prefix: str) -> tuple:
+        productive = disrupted = 0.0
+        for key, st in self.state.items():
+            if not key.split("/", 1)[1].startswith(prefix):
+                continue
+            productive += st["productive"]
+            disrupted += st["disrupted"]
+        return productive, disrupted
+
+
+def _sleep_container(name: str, seconds: float) -> Container:
+    import sys
+    return Container(name=name, image="local",
+                     command=[sys.executable, "-c",
+                              f"import time; time.sleep({seconds})"])
+
+
+def gang_job(name: str, workers: int, queue: str, run_seconds: float,
+             priority: int = 0) -> MPIJob:
+    """A long-running training gang admitted through ``queue``:
+    restartPolicy ExitCode so chaos kills trigger gang restarts (slice
+    repair) instead of failing the job, with a backoff budget sized for
+    a chaos soak."""
+    return MPIJob(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            labels={constants.QUEUE_NAME_LABEL: queue},
+            annotations={constants.SCHED_PRIORITY_ANNOTATION:
+                         str(priority)}),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(backoff_limit=100,
+                                 clean_pod_policy="Running"),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        _sleep_container("launcher", run_seconds)]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    restart_policy=constants.RESTART_POLICY_EXIT_CODE,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        _sleep_container("worker",
+                                         run_seconds + 30)]))),
+            }))
+
+
+def small_job(name: str, queue: str, work_seconds: float = 1.0) -> MPIJob:
+    """The admission-latency probe: a 1-worker queue-managed job that
+    finishes on its own and cleans up."""
+    return MPIJob(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            labels={constants.QUEUE_NAME_LABEL: queue}),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(clean_pod_policy="All"),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        _sleep_container("launcher", work_seconds)]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        _sleep_container("worker",
+                                         work_seconds + 20)]))),
+            }))
+
+
+class SoakHarness:
+    """See module docstring.  ``server_factory(pod) -> InferenceServer``
+    builds one serving replica; bench/smoke provide it (a tiny llama
+    with injected-latency occupancy on the 1-core host)."""
+
+    def __init__(self, config: SoakConfig, server_factory):
+        self.config = config
+        self.client = Clientset()
+        self.cluster = LocalCluster(
+            threadiness=config.threadiness,
+            namespace="default",
+            client=self.client,
+            sched_slices=list(config.slices),
+            sched_options={"checkpoint_grace": config.checkpoint_grace})
+        self.registry = self.cluster.controller.metrics["registry"]
+        self.soak_metrics = new_soak_metrics(self.registry)
+        from ..api.types import ServeJob, ServeJobSpec
+        from ..serving.fleet import LocalServeFleet
+        serve_job = ServeJob(
+            metadata=ObjectMeta(name="soak", namespace=SERVE_NAMESPACE),
+            spec=ServeJobSpec(
+                replicas=config.serve_replicas,
+                template=PodTemplateSpec(spec=PodSpec(containers=[
+                    Container(name="replica", image="local")]))))
+        self.fleet = LocalServeFleet(serve_job, server_factory,
+                                     client=self.client, policy="prefix")
+        self.monitor = _JobMonitor(self.client, self.soak_metrics)
+        self._recoveries: List[tuple] = []  # (component, seconds)
+        self._started = False
+
+    # -- LocalCluster shape (chaos engine + invariants) --------------------
+    @property
+    def controller(self):
+        return self.cluster.controller
+
+    @property
+    def kubelet(self):
+        return self.cluster.kubelet
+
+    @property
+    def scheduler(self):
+        return self.cluster.scheduler
+
+    @property
+    def router(self):
+        return self.fleet.router
+
+    @property
+    def runner(self):
+        return self.fleet.runner
+
+    def kill_replica(self, namespace: str, name: str) -> bool:
+        return self.fleet.kill_replica(namespace, name)
+
+    # -- restart surface (controller_restart / scheduler_restart) ----------
+    def crash_controller(self) -> bool:
+        crashed = self.cluster.crash_controller()
+        if crashed:
+            flight.record("controller", "crash", component="controller")
+        return crashed
+
+    def respawn_controller(self):
+        if not getattr(self.cluster, "_controller_down", False):
+            # Overlapping restart faults: an earlier heal already
+            # respawned — no recovery happened here, record none.
+            return self.cluster.respawn_controller()
+        t0 = time.monotonic()
+        ctrl = self.cluster.respawn_controller()
+        # run() blocks on informer cache sync: by return, the fresh
+        # controller has re-listed the world and enqueued every job.
+        self._recovered("controller", time.monotonic() - t0)
+        return ctrl
+
+    def crash_scheduler(self) -> bool:
+        crashed = self.cluster.crash_scheduler()
+        if crashed:
+            flight.record("sched", "crash", component="scheduler")
+        return crashed
+
+    def respawn_scheduler(self):
+        if not getattr(self.cluster, "_scheduler_down", False):
+            return self.cluster.respawn_scheduler()  # no-op: see above
+        t0 = time.monotonic()
+        sched = self.cluster.respawn_scheduler()
+        if sched is None:
+            return None
+        # Recovered = every Admitted=True job re-adopted (admitted-set,
+        # quota usage and slice placements rebuilt from the apiserver).
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            want = self._admitted_condition_keys()
+            if want <= set(sched.admitted_keys()):
+                break
+            time.sleep(0.05)
+        self._recovered("scheduler", time.monotonic() - t0)
+        return sched
+
+    def _admitted_condition_keys(self) -> set:
+        from ..controller.status import get_condition, is_finished
+        out = set()
+        for job in self.client.server.list(constants.GROUP_VERSION,
+                                           constants.KIND, "default"):
+            if is_finished(job.status) or job.spec.run_policy.suspend:
+                continue
+            cond = get_condition(job.status, constants.JOB_ADMITTED)
+            if cond is not None and cond.status == core.CONDITION_TRUE:
+                out.add(f"{job.metadata.namespace}/{job.metadata.name}")
+        return out
+
+    def _recovered(self, component: str, seconds: float) -> None:
+        self._recoveries.append((component, seconds))
+        self.soak_metrics["recoveries"].labels(component).inc()
+        self.soak_metrics["recovery_seconds"].observe(seconds)
+        flight.record("other", "restart_recovered", component=component,
+                      seconds=round(seconds, 4))
+
+    # -- setup --------------------------------------------------------------
+    def _create_queues(self) -> None:
+        total = sum(s.chips for s in self.config.slices)
+        gang_quota = self.config.gang_quota or total
+        small_quota = self.config.small_quota or max(2, total // 2)
+        for cq_name, chips in (("cq-gang", gang_quota),
+                               ("cq-small", small_quota)):
+            self.client.cluster_queues("default").create(ClusterQueue(
+                metadata=ObjectMeta(name=cq_name, namespace="default"),
+                spec=ClusterQueueSpec(
+                    quotas={constants.TPU_RESOURCE: str(chips)},
+                    cohort="soak")))
+        for lq_name, cq_name in (("q-gang", "cq-gang"),
+                                 ("q-small", "cq-small")):
+            self.client.local_queues("default").create(LocalQueue(
+                metadata=ObjectMeta(name=lq_name, namespace="default"),
+                spec=LocalQueueSpec(cluster_queue=cq_name)))
+
+    def start(self) -> "SoakHarness":
+        self.cluster.start()
+        self._create_queues()
+        self.monitor.start()
+        run_seconds = self.config.duration + self.config.converge_timeout
+        for i in range(self.config.gangs):
+            self.cluster.submit(gang_job(
+                f"{GANG_PREFIX}{i}", self.config.gang_workers, "q-gang",
+                run_seconds))
+        self.fleet.start()
+        self.fleet.wait_ready(self.config.serve_replicas, timeout=120)
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self.monitor.stop()
+        self.fleet.stop()
+        self.cluster.stop()
+        self._started = False
+
+    def __enter__(self) -> "SoakHarness":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the soak ------------------------------------------------------------
+    def _build_plan(self) -> FaultPlan:
+        if self.config.plan is not None:
+            return self.config.plan
+        from ..chaos.plan import Fault, randomized_plan
+        plan = randomized_plan(self.config.seed,
+                               n_faults=self.config.n_faults,
+                               horizon=self.config.duration,
+                               profile="full",
+                               name=f"soak-{self.config.seed}")
+        # The soak's contract includes surviving control-plane crashes:
+        # guarantee at least one of each restart kind, at seeded
+        # offsets, when the draw happened to produce none.
+        import random
+        rng = random.Random(self.config.seed ^ 0x50AC)
+        kinds = {f.kind for f in plan.faults}
+        for kind in ("controller_restart", "scheduler_restart"):
+            if kind not in kinds:
+                plan.faults.append(Fault(
+                    at=round(rng.uniform(0.3, 0.9)
+                             * self.config.duration, 3),
+                    kind=kind,
+                    duration=round(rng.uniform(0.4, 1.5), 3)))
+        return plan
+
+    def _converged(self) -> bool:
+        from ..chaos.invariants import jobs_converged
+        if len(self.controller.queue):
+            return False
+        if jobs_converged(self):
+            return False
+        return len(self.router.healthy_replicas()) >= 1
+
+    def run(self) -> SoakResult:
+        plan = self._build_plan()
+        traffic_seed = self.config.seed ^ 0x7AFF1C
+        workload = ServeWorkload(512, self.config.tenants,
+                                 self.config.prefix_tokens,
+                                 self.config.max_new_tokens,
+                                 seed=traffic_seed)
+        traffic = ServeTraffic(lambda: self.fleet.router.url, workload,
+                               closed=self.config.closed_clients,
+                               open_rate=self.config.open_rate,
+                               seed=traffic_seed + 1)
+        smalls = SmallJobStream(
+            lambda i: self.cluster.submit(small_job(
+                f"{SMALL_PREFIX}{i}", "q-small")),
+            rate=self.config.small_rate, seed=traffic_seed + 2,
+            limit=self.config.small_limit)
+        engine = ChaosEngine(self, plan, seed=self.config.seed)
+        self.monitor.engine = engine
+        flight.record("other", "soak_start", plan=plan.name,
+                      seed=self.config.seed,
+                      gangs=self.config.gangs,
+                      serve_replicas=self.config.serve_replicas)
+        traffic.start()
+        smalls.start()
+        try:
+            report = engine.run(converge=self._converged,
+                                timeout=self.config.converge_timeout,
+                                invariants=DEFAULT_INVARIANTS,
+                                settle=self.config.settle,
+                                bundle="always")
+        finally:
+            smalls.stop()
+            traffic.stop()
+            self.monitor.stop()
+        scorecard = self._score(report, traffic, smalls)
+        flight.record("other", "soak_done", ok=scorecard.ok,
+                      violations=len(scorecard.violations()))
+        return SoakResult(scorecard=scorecard, report=report,
+                          bundle_dir=report.bundle_dir)
+
+    # -- scoring -------------------------------------------------------------
+    def _score(self, report, traffic: ServeTraffic,
+               smalls: SmallJobStream) -> SloScorecard:
+        ttfts = [c[1] for c in traffic.completions if c[1] is not None]
+        productive, disrupted = self.monitor.goodput_totals(GANG_PREFIX)
+        small_waits = self.monitor.admission_waits(SMALL_PREFIX)
+        gang_waits = self.monitor.admission_waits(GANG_PREFIX)
+        reconcile = self.controller.metrics["reconcile_seconds"]
+        router_tm = self.router.telemetry
+        applied = [ev for ev in report.events
+                   if ev.get("event") == "inject" and _fault_applied(ev)]
+
+        def restarts(kind: str) -> int:
+            return sum(1 for ev in applied if ev.get("kind") == kind
+                       and ev.get("result") == "crashed")
+
+        card = SloScorecard(
+            train_goodput_pct=goodput_pct(productive, disrupted),
+            serve_ttft_p50_s=quantile(ttfts, 0.50),
+            serve_ttft_p99_s=quantile(ttfts, 0.99),
+            reconcile_p99_s=histogram_quantile(reconcile.snapshot(),
+                                               0.99),
+            admission_p99_s=quantile(small_waits, 0.99),
+            requests_total=int(router_tm["requests_total"].value),
+            requests_lost=int(router_tm["requests_lost_total"].value),
+            invariant_violations=len(report.violations),
+            faults_applied=len(applied),
+            controller_restarts=restarts("controller_restart"),
+            scheduler_restarts=restarts("scheduler_restart"),
+            recoveries=len(self._recoveries),
+            recovery_p99_s=quantile([s for _, s in self._recoveries],
+                                    0.99),
+            converged=report.converged,
+            detail={
+                "serve_completions": len(traffic.completions),
+                "serve_errors": len(traffic.errors),
+                "small_jobs_submitted": smalls.submitted,
+                "small_jobs_admitted": len(small_waits),
+                "small_submit_failures": smalls.failed,
+                "gang_admission_waits_s": [round(w, 3)
+                                           for w in gang_waits],
+                "train_productive_s": round(productive, 2),
+                "train_disrupted_s": round(disrupted, 2),
+                "faults_by_kind": self._by_kind(applied),
+                "router_retries": int(
+                    router_tm["retries_total"].value),
+                "recoveries_s": [(c, round(s, 3))
+                                 for c, s in self._recoveries],
+                "chaos_violations": list(report.violations),
+            })
+        self._publish(card)
+        return card
+
+    @staticmethod
+    def _by_kind(events: List[dict]) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ev in events:
+            out[ev.get("kind", "?")] = out.get(ev.get("kind", "?"), 0) + 1
+        return out
+
+    def _publish(self, card: SloScorecard) -> None:
+        gauges = {
+            "train_goodput_pct": card.train_goodput_pct,
+            "serve_ttft_p50_s": card.serve_ttft_p50_s,
+            "serve_ttft_p99_s": card.serve_ttft_p99_s,
+            "reconcile_p99_s": card.reconcile_p99_s,
+            "admission_p99_s": card.admission_p99_s,
+            "requests_lost": card.requests_lost,
+            "invariant_violations": card.invariant_violations,
+        }
+        for name, value in gauges.items():
+            if value is not None:
+                self.soak_metrics["slo"].labels(name).set(float(value))

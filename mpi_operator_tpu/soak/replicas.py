@@ -1,0 +1,62 @@
+"""Shared serving-replica factory for the soak bench/smoke.
+
+One definition of the injected-latency tiny-llama replica (the
+single-core-host occupancy model bench_serve_fleet.py introduced) so
+bench_soak.py and tools/soak_smoke.py cannot drift apart in cache
+sizing or latency plumbing.  jax imports are lazy: the soak package is
+imported by tier-1 tests that never build a replica.
+"""
+
+from __future__ import annotations
+
+import os
+
+PAGE = 16
+
+
+def tiny_llama_server_factory(replicas: int, slots: int = 4,
+                              tenants: int = 4,
+                              prefix_tokens: int = 32,
+                              max_new: int = 8,
+                              decode_latency: float = 0.002,
+                              prefill_token_latency: float = 0.0005):
+    """Build `factory(pod) -> InferenceServer` for a fleet of
+    ``replicas``: paged KV with a prefix cache sized so the fleet holds
+    the tenant prompt set PARTITIONED (~tenants/replicas per replica),
+    and per-token-prefill / per-tick-decode occupancy injected under
+    the device lock (MPI_OPERATOR_SERVE_* env knobs) so placement and
+    cache effects dominate on the 1-core host instead of GIL
+    contention."""
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ..models.llama import LlamaConfig, LlamaModel
+    from ..serving import InferenceServer
+
+    max_seq = ((prefix_tokens + 8 + max_new + PAGE - 1)
+               // PAGE + 1) * PAGE
+    cfg = LlamaConfig(vocab_size=512, dim=32, n_layers=1, n_heads=1,
+                      n_kv_heads=1, max_seq_len=max_seq)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    prefix_blocks = prefix_tokens // PAGE
+    budget_blocks = -(-(prefix_tokens + 8 + max_new) // PAGE)
+    cache_blocks = (slots * budget_blocks
+                    + (tenants * prefix_blocks) // max(1, replicas)
+                    + prefix_blocks)
+    os.environ["MPI_OPERATOR_SERVE_DECODE_LATENCY"] = \
+        str(decode_latency)
+    os.environ["MPI_OPERATOR_SERVE_PREFILL_TOKEN_LATENCY"] = \
+        str(prefill_token_latency)
+
+    def factory(pod):
+        return InferenceServer(model, variables, max_batch_slots=slots,
+                               kv_page_size=PAGE,
+                               kv_cache_blocks=cache_blocks)
+
+    return factory

@@ -1,0 +1,204 @@
+"""SLO scorecard math for the cluster-in-a-box macro-soak.
+
+The soak (docs/RESILIENCE.md "Macro-soak & crash recovery") is scored
+on end-to-end SLOs, not per-subsystem benches — the full-pod number,
+not the microbench (MLPerf on TPU pods, arXiv:1909.09756).  This module
+is the *math*: exact quantiles over recorded samples, Prometheus-style
+histogram quantiles over bucket snapshots, goodput attribution, and the
+`SloScorecard` verdict — kept free of harness machinery so the gate's
+arithmetic is unit-testable on its own (tests/test_soak.py; a
+degenerate run must read as UNPOPULATED, never silently pass).
+
+Soak counters live in the shared telemetry registry
+(:func:`new_soak_metrics`), not harness-local dicts, so ``top`` and
+``/metrics`` see chaos faults, recoveries and the final SLO gauges
+live (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..telemetry.metrics import Registry
+
+
+def quantile(values: Sequence[float], q: float) -> Optional[float]:
+    """Exact linear-interpolation quantile over recorded samples.
+
+    Edges are explicit: an empty series returns None (a scorecard field
+    fed from it stays unpopulated — the gate must notice a run that
+    produced no data, not score it perfect); ``q`` is clamped to
+    [0, 1]; a single sample is every quantile of itself.
+    """
+    if not values:
+        return None
+    q = min(1.0, max(0.0, q))
+    ordered = sorted(values)
+    if len(ordered) == 1:
+        return float(ordered[0])
+    pos = q * (len(ordered) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ordered) - 1)
+    frac = pos - lo
+    return float(ordered[lo] * (1.0 - frac) + ordered[hi] * frac)
+
+
+def histogram_quantile(snapshot: dict, q: float) -> Optional[float]:
+    """Prometheus-style quantile from a Histogram.snapshot() dict
+    (cumulative bucket counts keyed by upper bound, plus count).
+
+    Linear interpolation inside the winning bucket from its lower
+    bound; observations above the last finite bucket report that bound
+    (the standard histogram_quantile saturation).  count == 0 -> None.
+    """
+    count = snapshot.get("count", 0)
+    if not count:
+        return None
+    q = min(1.0, max(0.0, q))
+    rank = q * count
+    prev_bound = 0.0
+    prev_cum = 0
+    bounds = sorted(snapshot.get("buckets", {}).items())
+    for bound, cum in bounds:
+        if cum >= rank:
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return float(bound)
+            frac = (rank - prev_cum) / in_bucket
+            return float(prev_bound + (bound - prev_bound) * frac)
+        prev_bound, prev_cum = bound, cum
+    return float(bounds[-1][0]) if bounds else None
+
+
+def goodput_pct(productive_s: float, disrupted_s: float) -> Optional[float]:
+    """Train goodput: productive wall time as a percentage of
+    (productive + disrupted).  An empty window (no gang ever ran)
+    returns None — the degenerate case must surface as an unpopulated
+    scorecard field, not as 100%."""
+    total = productive_s + disrupted_s
+    if total <= 0:
+        return None
+    return 100.0 * productive_s / total
+
+
+@dataclass
+class SloScorecard:
+    """The soak verdict.  ``None`` in a required field means the run
+    never produced the data to score it — `missing()` reports those and
+    `ok` is False, so a degenerate run (no traffic, no gangs, no
+    reconciles) cannot silently pass the gate."""
+
+    # Latency/goodput SLOs (None = unpopulated).
+    train_goodput_pct: Optional[float] = None
+    serve_ttft_p50_s: Optional[float] = None
+    serve_ttft_p99_s: Optional[float] = None
+    reconcile_p99_s: Optional[float] = None
+    admission_p99_s: Optional[float] = None
+    # Hard zero-tolerance counters.
+    requests_total: int = 0
+    requests_lost: int = 0
+    invariant_violations: int = 0
+    # Chaos/recovery accounting.
+    faults_applied: int = 0
+    controller_restarts: int = 0
+    scheduler_restarts: int = 0
+    recoveries: int = 0
+    recovery_p99_s: Optional[float] = None
+    converged: bool = True
+    # Free-form context the bench attaches (windows, per-gang detail).
+    detail: Dict[str, object] = field(default_factory=dict)
+
+    REQUIRED = ("train_goodput_pct", "serve_ttft_p99_s",
+                "reconcile_p99_s", "admission_p99_s")
+
+    def missing(self) -> List[str]:
+        return [name for name in self.REQUIRED
+                if getattr(self, name) is None]
+
+    def violations(self) -> List[str]:
+        """Hard failures: zero-tolerance counters, convergence, and
+        unpopulated required fields.  Latency/goodput numbers are
+        published, not gated here — `evaluate()` scores them against
+        explicit targets."""
+        out = []
+        for name in self.missing():
+            out.append(f"SLO field {name} unpopulated (degenerate run)")
+        if self.requests_lost:
+            out.append(f"{self.requests_lost} serve request(s) lost")
+        if self.invariant_violations:
+            out.append(f"{self.invariant_violations} invariant"
+                       f" violation(s)")
+        if not self.converged:
+            out.append("system never converged after the fault timeline")
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations()
+
+    def evaluate(self, targets: Dict[str, float]) -> Dict[str, dict]:
+        """Score populated fields against explicit targets.  Targets
+        map field name -> bound; goodput is a lower bound, everything
+        else an upper bound.  Unpopulated fields score met=False (they
+        already fail `violations()` when required)."""
+        out = {}
+        for name, target in sorted(targets.items()):
+            value = getattr(self, name, None)
+            if value is None:
+                met = False
+            elif name == "train_goodput_pct":
+                met = value >= target
+            else:
+                met = value <= target
+            out[name] = {"value": value, "target": target, "met": met}
+        return out
+
+    def to_dict(self) -> dict:
+        def r(v):
+            return round(v, 4) if isinstance(v, float) else v
+        return {
+            "train_goodput_pct": r(self.train_goodput_pct),
+            "serve_ttft_p50_s": r(self.serve_ttft_p50_s),
+            "serve_ttft_p99_s": r(self.serve_ttft_p99_s),
+            "reconcile_p99_s": r(self.reconcile_p99_s),
+            "admission_p99_s": r(self.admission_p99_s),
+            "requests_total": self.requests_total,
+            "requests_lost": self.requests_lost,
+            "invariant_violations": self.invariant_violations,
+            "faults_applied": self.faults_applied,
+            "controller_restarts": self.controller_restarts,
+            "scheduler_restarts": self.scheduler_restarts,
+            "recoveries": self.recoveries,
+            "recovery_p99_s": r(self.recovery_p99_s),
+            "converged": self.converged,
+            "ok": self.ok,
+            "violations": self.violations(),
+            "detail": self.detail,
+        }
+
+
+def new_soak_metrics(registry: Optional[Registry] = None) -> dict:
+    """Soak counters in the shared telemetry registry (get-or-create:
+    safe across controller respawns, visible on /metrics and `top`)."""
+    registry = registry or Registry()
+    return {
+        "registry": registry,
+        "slo": registry.gauge_vec(
+            "mpi_operator_soak_slo",
+            "Macro-soak SLO scorecard values by field (train goodput %,"
+            " serve/reconcile/admission latency seconds, hard counters;"
+            " set at scoring time)", ["slo"]),
+        "faults": registry.counter_vec(
+            "mpi_operator_soak_faults_total",
+            "Chaos faults applied during the soak, by injector kind",
+            ["kind"]),
+        "recoveries": registry.counter_vec(
+            "mpi_operator_soak_recoveries_total",
+            "Control-plane restart recoveries completed, by component"
+            " (controller, scheduler)", ["component"]),
+        "recovery_seconds": registry.histogram(
+            "mpi_operator_soak_restart_recovery_seconds",
+            "Crash-to-recovered duration of a control-plane restart"
+            " (respawn + state rebuild from the apiserver)"),
+    }

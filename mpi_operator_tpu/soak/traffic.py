@@ -1,0 +1,205 @@
+"""Soak traffic drivers: serve load (mixed open/closed-loop) and the
+small-job arrival stream.
+
+Same load model as bench_serve_fleet.py, packaged for the macro-soak:
+closed-loop streaming clients (next request after the previous
+completes) expose per-request latency, the seeded open-loop arrival
+process exposes queueing collapse, and every completion is recorded as
+``(t_submit, ttft, n_tokens, t_done)`` for exact quantile scoring
+(soak/slo.py).  All randomness is seeded — two soaks with the same seed
+offer the same load.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+def stream_request(url: str, payload: dict, timeout: float = 600.0):
+    """One streaming /generate request against the router; returns
+    (t_submit, ttft, n_tokens, t_done, tokens) or raises on an SSE
+    error event / transport failure."""
+    hostport = url.split("//")[1]
+    host, _, port = hostport.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    t0 = time.perf_counter()
+    try:
+        conn.request("POST", "/generate",
+                     body=json.dumps(dict(payload, stream=True)).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        ttft = None
+        toks: List[int] = []
+        err = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if line.startswith(b"data: "):
+                ev = json.loads(line[6:])
+                if "token" in ev:
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    toks.append(ev["token"])
+                elif "error" in ev:
+                    err = ev["error"]
+                    break
+                elif ev.get("done"):
+                    break
+    finally:
+        conn.close()
+    if err is not None:
+        raise RuntimeError(err)
+    return t0, ttft, len(toks), time.perf_counter(), toks
+
+
+class ServeWorkload:
+    """Seeded shared-system-prompt generator: T tenants, each request
+    one tenant's prefix plus a short unique suffix, pinned to the
+    tenant's router session (the prefix-aware placement surface)."""
+
+    def __init__(self, vocab_size: int, tenants: int, prefix_tokens: int,
+                 max_new: int, seed: int):
+        rng = random.Random(seed)
+        self.max_new = max_new
+        self.prefixes = [
+            [rng.randrange(1, vocab_size) for _ in range(prefix_tokens)]
+            for _ in range(tenants)]
+        self._rng = random.Random(seed + 1)
+        self._lock = threading.Lock()
+
+    def next_payload(self) -> dict:
+        with self._lock:
+            t = self._rng.randrange(len(self.prefixes))
+            suffix = [self._rng.randrange(1, 500)
+                      for _ in range(self._rng.randint(2, 7))]
+        return {"tokens": [self.prefixes[t] + suffix],
+                "max_new_tokens": self.max_new, "session": f"tenant{t}"}
+
+
+class ServeTraffic:
+    """Closed-loop client threads + one seeded open-loop arrival thread
+    against a router URL.  Completions and errors are recorded for
+    scoring; `stop()` joins everything."""
+
+    def __init__(self, url_fn: Callable[[], str], workload: ServeWorkload,
+                 closed: int, open_rate: float, seed: int,
+                 open_outstanding: int = 32):
+        self._url_fn = url_fn
+        self._workload = workload
+        self._closed = closed
+        self._open_rate = open_rate
+        self._open_outstanding = open_outstanding
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self.completions: List[tuple] = []  # (t_submit, ttft, n, t_done)
+        self.errors: List[str] = []
+
+    def _record(self, rec) -> None:
+        with self._lock:
+            self.completions.append(rec[:4])
+
+    def _one(self) -> None:
+        try:
+            self._record(stream_request(self._url_fn(),
+                                        self._workload.next_payload()))
+        except Exception as exc:
+            if not self._stop.is_set():
+                with self._lock:
+                    self.errors.append(repr(exc))
+
+    def _closed_loop(self) -> None:
+        while not self._stop.is_set():
+            self._one()
+
+    def _open_loop(self) -> None:
+        sem = threading.Semaphore(self._open_outstanding)
+
+        def fire():
+            try:
+                self._one()
+            finally:
+                sem.release()
+
+        while not self._stop.is_set():
+            delay = self._rng.expovariate(self._open_rate) \
+                if self._open_rate > 0 else 0.5
+            if self._stop.wait(delay):
+                break
+            if sem.acquire(blocking=False):
+                threading.Thread(target=fire, daemon=True).start()
+
+    def start(self) -> "ServeTraffic":
+        self._threads = [threading.Thread(target=self._closed_loop,
+                                          daemon=True,
+                                          name=f"soak-closed-{i}")
+                         for i in range(self._closed)]
+        if self._open_rate > 0:
+            self._threads.append(threading.Thread(
+                target=self._open_loop, daemon=True, name="soak-open"))
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self, timeout: float = 120.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+
+class SmallJobStream:
+    """Seeded arrival stream of 1-worker queue-managed jobs — the
+    admission-latency probe riding next to the big gangs.  Create
+    failures during apiserver chaos retry once and are otherwise
+    counted, never raised (cluster weather is the point of the soak)."""
+
+    def __init__(self, submit_fn: Callable[[int], object], rate: float,
+                 seed: int, limit: Optional[int] = None):
+        self._submit_fn = submit_fn
+        self._rate = rate
+        self._limit = limit
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.submitted = 0
+        self.failed = 0
+
+    def _loop(self) -> None:
+        i = 0
+        while not self._stop.is_set():
+            if self._limit is not None and i >= self._limit:
+                return
+            delay = self._rng.expovariate(self._rate) \
+                if self._rate > 0 else 1.0
+            if self._stop.wait(delay):
+                return
+            for attempt in (0, 1):
+                try:
+                    self._submit_fn(i)
+                    self.submitted += 1
+                    break
+                except Exception:
+                    if attempt == 1:
+                        self.failed += 1
+                    else:
+                        time.sleep(0.1)
+            i += 1
+
+    def start(self) -> "SmallJobStream":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="soak-small-jobs")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

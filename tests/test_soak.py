@@ -10,13 +10,18 @@ leftover launcher Jobs, an idle workqueue, and thread count back near
 baseline.
 """
 
+import hashlib
 import os
 import sys
 import threading
 import time
 
+import pytest
+
 from mpi_operator_tpu.api import constants
 from mpi_operator_tpu.server import LocalCluster
+from mpi_operator_tpu.soak import (SloScorecard, goodput_pct,
+                                   histogram_quantile, quantile)
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from test_e2e_local import jax_job  # noqa: E402
@@ -57,19 +62,33 @@ def test_churn_soak_converges_and_leaks_nothing():
                             "import time; time.sleep(45)"],
                 workers=1))
 
-        time.sleep(1.0)
-        # Suspend wave 2...
+        # Suspend wave 2 MID-FLIGHT: wait (watch-driven, not a fixed
+        # sleep — a loaded 1-core host can take longer than any guess)
+        # until each job actually ran before suspending it.
         for i in range(1, n_jobs, 3):
+            cluster.wait_for_condition("default", f"soak-{i}",
+                                       constants.JOB_RUNNING, timeout=60)
             stored = cluster.client.mpi_jobs("default").get(f"soak-{i}")
             stored.spec.run_policy.suspend = True
             cluster.client.mpi_jobs("default").update(stored)
         # ...delete wave 3.
         for i in range(2, n_jobs, 3):
             cluster.client.mpi_jobs("default").delete(f"soak-{i}")
-        time.sleep(1.0)
-        # Resume wave 2.
+        # Resume wave 2 only after the controller OBSERVED each suspend
+        # (Suspended=True) — resuming before that is a no-op update the
+        # old fixed sleep raced on; a job that finished before the
+        # suspend landed is equally settled (Succeeded).
         for i in range(1, n_jobs, 3):
-            stored = cluster.client.mpi_jobs("default").get(f"soak-{i}")
+            name = f"soak-{i}"
+            cluster.wait_for(
+                "kubeflow.org/v2beta1", "MPIJob", "default",
+                lambda job, name=name: job.metadata.name == name and any(
+                    c.type in (constants.JOB_SUSPENDED,
+                               constants.JOB_SUCCEEDED)
+                    and c.status == "True"
+                    for c in job.status.conditions),
+                timeout=60, describe=f"{name} suspended or finished")
+            stored = cluster.client.mpi_jobs("default").get(name)
             stored.spec.run_policy.suspend = False
             cluster.client.mpi_jobs("default").update(stored)
 
@@ -213,3 +232,371 @@ def test_serving_soak_mixed_workload_leaks_nothing():
             len(batcher._free_blocks), len(batcher._block_meta))
     finally:
         batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO scorecard math (soak/slo.py): the macro-soak gate's arithmetic.
+# A degenerate run (no samples) must read as UNPOPULATED, never pass.
+# ---------------------------------------------------------------------------
+
+def test_slo_quantile_edges():
+    assert quantile([], 0.99) is None          # empty -> unpopulated
+    assert quantile([3.0], 0.0) == 3.0         # single sample is every q
+    assert quantile([3.0], 1.0) == 3.0
+    assert quantile([1.0, 2.0, 3.0, 4.0], 0.0) == 1.0
+    assert quantile([1.0, 2.0, 3.0, 4.0], 1.0) == 4.0
+    assert quantile([1.0, 3.0], 0.5) == 2.0    # linear interpolation
+    assert quantile([4.0, 1.0, 3.0, 2.0], 0.5) == 2.5  # order-free
+    assert quantile([1.0, 10.0], 7.0) == 10.0  # q clamped to [0, 1]
+    assert quantile([1.0, 10.0], -1.0) == 1.0
+
+
+def test_slo_histogram_quantile():
+    from mpi_operator_tpu.telemetry.metrics import Histogram
+    h = Histogram("soak_test_hist", "", buckets=(0.1, 1.0, 10.0))
+    assert histogram_quantile(h.snapshot(), 0.99) is None  # count == 0
+    for _ in range(50):
+        h.observe(0.05)
+    for _ in range(49):
+        h.observe(0.5)
+    h.observe(100.0)  # beyond the last finite bucket
+    snap = h.snapshot()
+    assert abs(histogram_quantile(snap, 0.50) - 0.1) < 1e-9
+    p99 = histogram_quantile(snap, 0.99)
+    assert 0.1 < p99 <= 1.0
+    # Above the last finite bucket: saturates at that bound, the
+    # standard histogram_quantile behavior.
+    assert histogram_quantile(snap, 1.0) == 10.0
+
+
+def test_slo_goodput_empty_window():
+    assert goodput_pct(0.0, 0.0) is None  # no gang ever ran
+    assert goodput_pct(90.0, 10.0) == 90.0
+    assert goodput_pct(10.0, 0.0) == 100.0
+    card = SloScorecard()  # nothing populated
+    violations = card.violations()
+    assert len([v for v in violations if "unpopulated" in v]) == len(
+        SloScorecard.REQUIRED)
+    assert not card.ok
+
+
+def test_slo_scorecard_violation_counting():
+    card = SloScorecard(
+        train_goodput_pct=88.0, serve_ttft_p50_s=0.02,
+        serve_ttft_p99_s=0.8, reconcile_p99_s=0.05,
+        admission_p99_s=1.2, requests_total=100)
+    assert card.ok and card.violations() == []
+    card.requests_lost = 2
+    card.invariant_violations = 3
+    card.converged = False
+    violations = card.violations()
+    assert any("2 serve request(s) lost" in v for v in violations)
+    assert any("3 invariant violation(s)" in v for v in violations)
+    assert any("never converged" in v for v in violations)
+    assert len(violations) == 3 and not card.ok
+
+
+def test_slo_scorecard_targets():
+    card = SloScorecard(
+        train_goodput_pct=80.0, serve_ttft_p99_s=2.0,
+        reconcile_p99_s=0.5, admission_p99_s=None)
+    scored = card.evaluate({"train_goodput_pct": 70.0,   # lower bound
+                            "serve_ttft_p99_s": 1.0,     # upper bound
+                            "admission_p99_s": 5.0})
+    assert scored["train_goodput_pct"]["met"]        # 80 >= 70
+    assert not scored["serve_ttft_p99_s"]["met"]     # 2.0 > 1.0
+    assert not scored["admission_p99_s"]["met"]      # unpopulated
+
+
+# ---------------------------------------------------------------------------
+# Chaos plan presets: the default tuple (and the older opt-in tuples)
+# must keep deriving byte-identical plans so recorded seeds replay;
+# profile="full" is deterministic and adds the restart kinds.
+# ---------------------------------------------------------------------------
+
+def _plan_sha(plan) -> str:
+    return hashlib.sha256(plan.to_json().encode()).hexdigest()
+
+
+def test_randomized_plan_presets_byte_stable():
+    from mpi_operator_tpu.chaos.plan import (FLEET_RANDOMIZABLE_KINDS,
+                                             FULL_RANDOMIZABLE_KINDS,
+                                             PLAN_PROFILES,
+                                             RANDOMIZABLE_KINDS,
+                                             SCHED_RANDOMIZABLE_KINDS,
+                                             randomized_plan)
+    # Goldens recorded before the "full" profile existed (PR 10): any
+    # drift here breaks replay of every previously recorded seed.
+    assert _plan_sha(randomized_plan(7)) == (
+        "65923a09656af203d3373742bf4b9a1c4476fee0d23e7d52c4b47d7325cad572")
+    assert _plan_sha(randomized_plan(123)) == (
+        "3c1f2de27ed6af6517e750903946fb0c5692381ad9563d2b4f95535fd4174317")
+    assert _plan_sha(randomized_plan(7, kinds=SCHED_RANDOMIZABLE_KINDS)) == (
+        "460ecf9fed51376504de071183a57fcb9d63200db6e5f708962953a62102f4a2")
+    assert _plan_sha(randomized_plan(7, kinds=FLEET_RANDOMIZABLE_KINDS)) == (
+        "03981949f1dbaa53b5b28e7068f4049faad1919c575fa7b8f0a37773da0c9d61")
+    assert PLAN_PROFILES["default"] is RANDOMIZABLE_KINDS
+    assert PLAN_PROFILES["full"] is FULL_RANDOMIZABLE_KINDS
+    assert "controller_restart" not in RANDOMIZABLE_KINDS
+    assert "scheduler_restart" not in RANDOMIZABLE_KINDS
+
+
+def test_randomized_plan_full_profile():
+    from mpi_operator_tpu.chaos.plan import randomized_plan
+    p1 = randomized_plan(7, n_faults=60, profile="full")
+    p2 = randomized_plan(7, n_faults=60, profile="full")
+    assert p1.to_json() == p2.to_json()  # seed-deterministic
+    kinds = {f.kind for f in p1.faults}
+    assert {"controller_restart", "scheduler_restart",
+            "replica_kill", "spot_reclaim"} <= kinds
+    for f in p1.faults:
+        if f.kind in ("controller_restart", "scheduler_restart"):
+            assert f.duration > 0  # outage before the respawn
+    with pytest.raises(KeyError):
+        randomized_plan(7, profile="nope")
+
+
+# ---------------------------------------------------------------------------
+# Scheduler restart: state reconstruction from the apiserver
+# (docs/RESILIENCE.md "Macro-soak & crash recovery").
+# ---------------------------------------------------------------------------
+
+def _sched_fixtures():
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    from test_sched import mk_job, mk_queues  # noqa: F401
+    return Clientset, mk_job, mk_queues
+
+
+def test_pool_place_exact_and_clear():
+    from mpi_operator_tpu.sched import SlicePool, TpuSlice
+    pool = SlicePool([TpuSlice("a", 4), TpuSlice("b", 4)])
+    assert pool.place_exact("j", {"a": 2, "b": 1}) == {"a": 2, "b": 1}
+    assert pool.free_chips == 5
+    # All-or-nothing: an unsatisfiable assignment claims NOTHING.
+    assert pool.place_exact("k", {"a": 3}) is None
+    assert pool.free_chips == 5
+    assert pool.place_exact("k", {"nope": 1}) is None
+    pool.set_offline("b")
+    assert pool.place_exact("k", {"b": 1}) is None  # offline slice
+    # clear_placements wipes the scheduler's view, keeps the hardware:
+    # chips free again, offline state intact.
+    pool.clear_placements()
+    assert pool.placed_keys() == []
+    assert pool.offline_slices() == ["b"]
+    assert pool.free_chips == 4  # only the online slice counts
+
+
+def test_scheduler_restart_rebuilds_exact_placements():
+    from mpi_operator_tpu.sched import GangScheduler, SlicePool, TpuSlice
+    Clientset, mk_job, mk_queues = _sched_fixtures()
+    cs = Clientset()
+    mk_queues(cs, {constants.TPU_RESOURCE: "64"})
+    pool = SlicePool([TpuSlice("s0", 8), TpuSlice("s1", 8),
+                      TpuSlice("s2", 8)])
+    s1 = GangScheduler(cs, pool)
+    cs.mpi_jobs("default").create(mk_job("big", 3))    # 4 chips
+    cs.mpi_jobs("default").create(mk_job("small", 3))  # 4 chips
+    s1.reconcile_once()
+    assert set(s1.admitted_keys()) == {"default/big", "default/small"}
+    placed_before = {k: pool.placement_of(k) for k in pool.placed_keys()}
+
+    # Tamper with one recorded placement so exact-restore is provably
+    # annotation-driven, not greedy re-derivation: move "small" to a
+    # slice that has room but is NOT what the greedy most-free walk
+    # would pick after re-adopting "big".
+    greedy_pick = set(placed_before["default/small"])
+    moved = sorted({"s0", "s1", "s2"}
+                   - greedy_pick - set(placed_before["default/big"]))[-1]
+    stored = cs.mpi_jobs("default").get("small")
+    stored.metadata.annotations[constants.SCHED_SLICES_ANNOTATION] = \
+        f"{moved}:4"
+    cs.mpi_jobs("default").update(stored)
+
+    # Crash: placements are in-memory; a restarted scheduler rebuilds
+    # them from the conditions/annotations alone.
+    pool.clear_placements()
+    s2 = GangScheduler(cs, pool)
+    s2.reconcile_once()
+    assert set(s2.admitted_keys()) == {"default/big", "default/small"}
+    assert pool.placement_of("default/big") == \
+        placed_before["default/big"]
+    assert pool.placement_of("default/small") == {moved: 4}
+    assert s2.metrics["admissions"].get("adopted") == 2
+    # No eviction happened: both jobs still Admitted=True.
+    from test_sched import admitted_status
+    assert admitted_status(cs, "big") == "True"
+    assert admitted_status(cs, "small") == "True"
+
+
+def test_scheduler_restart_rebuilds_reservation_fence():
+    from mpi_operator_tpu.sched import GangScheduler, SlicePool, TpuSlice
+    from test_sched import finish
+    Clientset, mk_job, mk_queues = _sched_fixtures()
+    cs = Clientset()
+    mk_queues(cs, {constants.TPU_RESOURCE: "64"})
+    pool = SlicePool([TpuSlice("s0", 8)])
+    s1 = GangScheduler(cs, pool)
+    cs.mpi_jobs("default").create(mk_job("hold-a", 3))  # 4 chips
+    cs.mpi_jobs("default").create(mk_job("hold-b", 3))  # 4 chips
+    s1.reconcile_once()
+    cs.mpi_jobs("default").create(mk_job("gang", 7))    # 8 chips: blocked
+    s1.reconcile_once()
+    assert s1.reserved_chips() == 0
+    finish(cs, "hold-a")
+    s1.reconcile_once()  # release accrues to the fence + annotation
+    assert s1.reserved_chips() == 4
+    stored = cs.mpi_jobs("default").get("gang")
+    assert stored.metadata.annotations[
+        constants.SCHED_RESERVATION_ANNOTATION] == "4"
+
+    # Crash mid-fence.  The restarted scheduler re-adopts hold-b, then
+    # re-arms the fence FROM THE ANNOTATION: reserved resumes at 4, so
+    # backfill cannot re-take the gang's earned chips.
+    pool.clear_placements()
+    s2 = GangScheduler(cs, pool)
+    s2.reconcile_once()
+    assert set(s2.admitted_keys()) == {"default/hold-b"}
+    assert s2.reserved_chips() == 4
+    # A 4-chip backfill candidate fits free capacity (4) but not the
+    # unreserved pool (0): denied by the rebuilt fence.
+    cs.mpi_jobs("default").create(mk_job("jumper", 3))
+    s2.reconcile_once()
+    from test_sched import admitted_status
+    assert admitted_status(cs, "jumper") != "True"
+    assert s2.metrics["backfill_denied"].value >= 1
+    # The blocked gang still admits first once capacity frees.
+    finish(cs, "hold-b")
+    s2.reconcile_once()
+    assert admitted_status(cs, "gang") == "True"
+    # Admission consumed the persisted reservation record.
+    assert constants.SCHED_RESERVATION_ANNOTATION not in \
+        cs.mpi_jobs("default").get("gang").metadata.annotations
+
+
+def test_scheduler_restart_sweeps_partial_gang():
+    from mpi_operator_tpu.controller import builders
+    from mpi_operator_tpu.k8s import batch, core
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    from mpi_operator_tpu.sched import GangScheduler, SlicePool, TpuSlice
+    from mpi_operator_tpu.chaos.invariants import sched_no_partial_gangs
+    Clientset, mk_job, mk_queues = _sched_fixtures()
+    cs = Clientset()
+    mk_queues(cs, {constants.TPU_RESOURCE: "64"})
+    # A gang whose eviction the dying scheduler never finished: NOT
+    # admitted (condition flipped before the crash) yet its worker pod
+    # still runs, plus a leftover launcher Job.
+    cs.mpi_jobs("default").create(mk_job("ghost", 2))
+    pod = core.Pod(metadata=ObjectMeta(
+        name="ghost-worker-0", namespace="default",
+        labels=builders.worker_selector("ghost")))
+    pod.status.phase = core.POD_RUNNING
+    cs.pods("default").create(pod)
+    cs.jobs("default").create(batch.Job(metadata=ObjectMeta(
+        name="ghost-launcher", namespace="default")))
+
+    class _System:
+        client = cs
+    assert sched_no_partial_gangs(_System())  # violated before recovery
+
+    pool = SlicePool([TpuSlice("s0", 8)])
+    sched = GangScheduler(cs, pool)
+    sched.reconcile_once()  # first pass runs the one-shot sweep
+    assert not [p for p in cs.pods("default").list()
+                if p.metadata.name == "ghost-worker-0"]
+    assert not [j for j in cs.jobs("default").list()
+                if j.metadata.name == "ghost-launcher"]
+    assert sched_no_partial_gangs(_System()) == []
+    assert sched.metrics["evictions"].get("requeued") >= 1
+    # ...and the gang then re-admits cleanly (fresh pods will follow
+    # from the controller once Admitted=True).
+    sched.reconcile_once()
+    from test_sched import admitted_status
+    assert admitted_status(cs, "ghost") == "True"
+
+
+# ---------------------------------------------------------------------------
+# Controller restart: re-adoption without duplicate creates.
+# ---------------------------------------------------------------------------
+
+def test_create_or_adopt_on_already_exists():
+    from mpi_operator_tpu.controller.controller import MPIJobController
+    from mpi_operator_tpu.k8s.apiserver import (ApiError, Clientset,
+                                                already_exists)
+    ctrl = MPIJobController(Clientset())
+    live = object()
+
+    def create_fn():
+        raise already_exists("Pod", "w-0")
+
+    adopted = ctrl._create_or_adopt("Pod", create_fn, lambda: live)
+    assert adopted is live
+    assert ctrl.metrics["restart_adoptions"].value == 1
+    # Anything that is not AlreadyExists propagates untouched.
+    with pytest.raises(ApiError):
+        ctrl._create_or_adopt(
+            "Pod",
+            lambda: (_ for _ in ()).throw(ApiError("Unavailable", "x")),
+            lambda: live)
+    assert ctrl.metrics["restart_adoptions"].value == 1
+
+
+def test_job_controller_pod_serial_reseed():
+    from mpi_operator_tpu.k8s import batch
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    from mpi_operator_tpu.runtime.job_controller import JobController
+    jc = JobController(Clientset())
+
+    class _P:
+        def __init__(self, name):
+            self.metadata = ObjectMeta(name=name)
+
+    # Names end in the hex serial; junk suffixes are skipped.
+    jc._reseed_pod_serial([_P("j-00005"), _P("j-0000a"), _P("j-junk")])
+    assert jc._pod_serial == 0xA
+    job = batch.Job(metadata=ObjectMeta(name="j", namespace="default"))
+    pod = jc._new_pod(job)
+    assert int(pod.metadata.name.rsplit("-", 1)[1], 16) > 0xA
+    # Reseeding never goes backwards.
+    jc._reseed_pod_serial([_P("j-00002")])
+    assert jc._pod_serial >= 0xB
+
+
+def test_controller_crash_respawn_no_duplicate_creates():
+    """The macro-soak's controller_restart contract at unit scale: kill
+    the control plane mid-job, respawn it, and the job finishes with
+    the ORIGINAL pods (adopted, not re-created) and no surplus
+    objects."""
+    from mpi_operator_tpu.chaos.invariants import no_surplus_worker_pods
+    with LocalCluster() as cluster:
+        cluster.submit(jax_job(
+            "rc",
+            launcher_cmd=[sys.executable, "-c",
+                          "import time; time.sleep(4); print('ok')"],
+            worker_cmd=[sys.executable, "-c",
+                        "import time; time.sleep(60)"],
+            workers=2,
+            run_policy={"clean_pod_policy": "Running"}))
+        cluster.wait_for_condition("default", "rc",
+                                   constants.JOB_RUNNING, timeout=30)
+        uids_before = {p.metadata.name: p.metadata.uid
+                       for p in cluster.client.pods("default").list()
+                       if "-worker-" in p.metadata.name}
+        assert len(uids_before) == 2
+
+        cluster.crash_controller()
+        respawned = cluster.respawn_controller()
+        assert respawned is cluster.controller
+
+        # The respawned controller drives the job to completion...
+        cluster.wait_for_condition("default", "rc",
+                                   constants.JOB_SUCCEEDED, timeout=60)
+        # ...with the original worker pods adopted, not duplicated.
+        uids_after = {p.metadata.name: p.metadata.uid
+                      for p in cluster.client.pods("default").list()
+                      if "-worker-" in p.metadata.name}
+        assert uids_after == uids_before
+        assert no_surplus_worker_pods(cluster) == []
+        # Metrics carried across the restart (a fresh dict would read
+        # 0 on the respawned controller).
+        assert cluster.controller.metrics["jobs_created"].value >= 1
