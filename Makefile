@@ -6,7 +6,8 @@ PYTHON ?= python
 .PHONY: test test-fast test-real-cluster native generate verify-generate \
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
-	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke
+	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke \
+	trace-smoke
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -87,6 +88,15 @@ sched-smoke:
 # byte-identical across two runs (docs/RESILIENCE.md).
 soak-smoke:
 	$(PYTHON) tools/soak_smoke.py
+
+# Causal tracing (< 60s, CPU): one queue-gated LocalCluster job and one
+# routed serve request, each asserted as a COMPLETE causal chain —
+# every bootstrap/TTFT milestone present, zero orphan spans, the
+# critical-path decomposition summing exactly to measured wall time —
+# with the canonical timestamp-free trace byte-identical across two
+# identical runs (docs/OBSERVABILITY.md "Causal tracing").
+trace-smoke:
+	$(PYTHON) tools/trace_smoke.py
 
 # Train hot path (< 60s, CPU): overlapped loop (async dispatch +
 # prefetch + async checkpointing) holds a steps/s floor with ZERO

@@ -52,6 +52,12 @@ SLO_TARGETS = {
     "serve_ttft_p99_s": 10.0,
     "reconcile_p99_s": 5.0,
     "admission_p99_s": 30.0,
+    # Causal-trace SLOs (ISSUE 11): job create -> first full-gang
+    # Running, and router-accept -> first-token as measured by request
+    # traces.  Unpopulated fields score met=False, so a run whose trace
+    # propagation broke fails the gate outright.
+    "ttfs_p99_s": 45.0,
+    "traced_ttft_p99_s": 10.0,
 }
 
 
@@ -164,6 +170,8 @@ def main(argv=None) -> int:
     print(json.dumps(report["scorecard"], indent=2), flush=True)
     print(f"bench_soak: goodput={card.train_goodput_pct and round(card.train_goodput_pct, 1)}% "
           f"ttft_p99={card.serve_ttft_p99_s and round(card.serve_ttft_p99_s, 3)}s "
+          f"ttfs_p99={card.ttfs_p99_s and round(card.ttfs_p99_s, 2)}s "
+          f"traced_ttft_p99={card.traced_ttft_p99_s and round(card.traced_ttft_p99_s, 3)}s "
           f"reconcile_p99={card.reconcile_p99_s and round(card.reconcile_p99_s, 4)}s "
           f"admission_p99={card.admission_p99_s and round(card.admission_p99_s, 2)}s "
           f"lost={card.requests_lost} violations={card.invariant_violations} "

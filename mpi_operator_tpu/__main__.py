@@ -9,6 +9,7 @@
     python -m mpi_operator_tpu top [-n ns] [--once] [--master ...]
     python -m mpi_operator_tpu queues [-n ns] [--master ...]
     python -m mpi_operator_tpu debug-bundle NAME [-o dir] [--master ...]
+    python -m mpi_operator_tpu trace TARGET [-n ns] [--spans FILE]
     python -m mpi_operator_tpu suspend/resume/delete NAME [--master ...]
     python -m mpi_operator_tpu version
 
@@ -525,6 +526,40 @@ def cmd_debug_bundle(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Critical-path decomposition of one job or serve request
+    (docs/OBSERVABILITY.md "Causal tracing & critical path").
+
+    Span sources: the in-process tracer (embedders, tests), worker
+    sidecar rings under $MPI_OPERATOR_FLIGHT_DIR, and any span/sidecar
+    JSONL files given via --spans (a bundle's flight.jsonl works).
+    """
+    from .telemetry import critical_path as cp
+
+    events = cp.collect_events(extra_files=args.spans)
+    by_id = cp.traces(events)
+    trace_id = cp.find_trace(by_id, args.target, args.namespace)
+    if trace_id is None:
+        known = sorted(by_id)
+        print(f"error: no trace found for {args.target!r}"
+              + (f"; known traces: {', '.join(known[:10])}" if known
+                 else " (no traces recorded — pass --spans FILE?)"),
+              file=sys.stderr)
+        return 1
+    spans = by_id[trace_id]
+    decomp = cp.decompose(spans)
+    if decomp is None:
+        print(f"error: trace {trace_id} has no recognizable root span",
+              file=sys.stderr)
+        return 1
+    print(cp.render(decomp))
+    orphans = cp.orphan_spans(spans)
+    if orphans:
+        print(f"warning: {len(orphans)} orphan span(s) — parents"
+              f" missing from the collected set", file=sys.stderr)
+    return 0
+
+
 def cmd_lifecycle(args, action: str) -> int:
     from .sdk import MPIJobClient
     sdk = MPIJobClient(_client(args.master), namespace=args.namespace)
@@ -624,6 +659,18 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--out", default=None,
                    help="bundle parent dir (default: debug dir)")
 
+    p = sub.add_parser("trace",
+                       help="critical-path decomposition of a job or"
+                            " serve request (causal tracing)")
+    p.add_argument("target",
+                   help="job name, request trace id (req-...), or a"
+                        " full trace id")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--spans", action="append", default=[],
+                   help="span JSONL / flight sidecar files to fold in"
+                        " (default: in-process tracer +"
+                        " $MPI_OPERATOR_FLIGHT_DIR sidecars)")
+
     for action in ("suspend", "resume", "delete"):
         p = sub.add_parser(action, help=f"{action} an MPIJob")
         p.add_argument("name")
@@ -656,6 +703,8 @@ def main(argv=None) -> int:
             return cmd_top(args)
         if args.command == "debug-bundle":
             return cmd_debug_bundle(args)
+        if args.command == "trace":
+            return cmd_trace(args)
         if args.command in ("suspend", "resume", "delete"):
             return cmd_lifecycle(args, args.command)
         if args.command == "version":

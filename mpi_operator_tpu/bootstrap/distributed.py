@@ -75,26 +75,37 @@ def launch_latency_seconds() -> Optional[float]:
 def initialize_from_env(timeout_seconds: float = 120.0) -> Optional[ProcessEnv]:
     """Initialize jax.distributed from the injected env (no-op outside an
     MPIJob or for single-process jobs).  Retries while the coordinator's
-    DNS/socket comes up — the analogue of entrypoint.sh's nslookup loop."""
+    DNS/socket comes up — the analogue of entrypoint.sh's nslookup loop.
+
+    The wait is a causal-trace span (``distributed_init``, parented to
+    the job context in ``MPI_OPERATOR_TRACE_CONTEXT``): the DNS-wait /
+    group-formation seconds show up named in the job's critical-path
+    decomposition instead of vanishing into "pod was slow"."""
     env = process_env()
     if env is None or env.num_processes <= 1:
         return env
     import jax
 
+    from ..telemetry.trace import env_context, span
+
     deadline = time.monotonic() + timeout_seconds
     last_err: Optional[Exception] = None
     delay = 0.1  # quick first retries (the coordinator is usually a
-    while time.monotonic() < deadline:  # fraction of a second behind)
-        try:
-            jax.distributed.initialize(
-                coordinator_address=env.coordinator_address,
-                num_processes=env.num_processes,
-                process_id=env.process_id)
-            return env
-        except Exception as exc:  # coordinator not up yet
-            last_err = exc
-            time.sleep(delay)
-            delay = min(delay * 2, 1.0)
-    raise TimeoutError(
-        f"jax.distributed.initialize did not connect to "
-        f"{env.coordinator_address} within {timeout_seconds}s: {last_err}")
+    with span("distributed_init", ctx=env_context(),
+              process_id=env.process_id,
+              num_processes=env.num_processes):
+        while time.monotonic() < deadline:  # fraction of a second behind
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=env.coordinator_address,
+                    num_processes=env.num_processes,
+                    process_id=env.process_id)
+                return env
+            except Exception as exc:  # coordinator not up yet
+                last_err = exc
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        raise TimeoutError(
+            f"jax.distributed.initialize did not connect to "
+            f"{env.coordinator_address} within {timeout_seconds}s: "
+            f"{last_err}")

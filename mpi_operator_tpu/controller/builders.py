@@ -25,6 +25,8 @@ from ..k8s.core import (ConfigMap, ConfigMapVolumeSource, Container, EnvVar,
                         SecretVolumeSource, Service, ServiceSpec, Volume,
                         VolumeMount)
 from ..k8s.meta import deep_copy, new_controller_ref, ObjectMeta
+from ..telemetry.trace import (TRACE_CONTEXT_ANNOTATION,
+                               TRACE_CONTEXT_ENV)
 
 # Naming / mount constants (mpi_job_controller.go:74-96)
 CONFIG_SUFFIX = "-config"
@@ -119,6 +121,28 @@ def _domain_format(cluster_domain: str) -> str:
 def _host_fqdn(host: str, job: MPIJob, cluster_domain: str) -> str:
     return _domain_format(cluster_domain).format(
         host=host, svc=job.metadata.name, ns=job.metadata.namespace)
+
+
+def job_trace_context(job: MPIJob) -> str:
+    """The encoded causal-trace context carried on the job (stamped at
+    create by the apiserver), or "" when absent (foreign transports)."""
+    return (job.metadata.annotations or {}).get(
+        TRACE_CONTEXT_ANNOTATION, "")
+
+
+def propagate_trace_context(job: MPIJob, annotations: dict,
+                            container) -> None:
+    """Carry the job's trace context one hop down: onto the pod's
+    annotations (the kubelet parents its ``pod_start`` span from it)
+    and into the container env (the in-pod train loop parents its
+    distributed-init/compile/first-step spans from it) — the explicit
+    carrier chain of docs/OBSERVABILITY.md "Causal tracing"."""
+    raw = job_trace_context(job)
+    if not raw:
+        return
+    annotations.setdefault(TRACE_CONTEXT_ANNOTATION, raw)
+    if all(e.name != TRACE_CONTEXT_ENV for e in container.env):
+        container.env.append(EnvVar(TRACE_CONTEXT_ENV, raw))
 
 
 def is_jax(job: MPIJob) -> bool:
@@ -403,12 +427,15 @@ def new_worker(job: MPIJob, index: int, pod_group_ctrl=None,
     if pod_group_ctrl is not None:
         pod_group_ctrl.decorate_pod_template(template, job.metadata.name)
 
+    annotations = dict(template.metadata.annotations)
+    propagate_trace_context(job, annotations, container)
+
     return Pod(
         metadata=ObjectMeta(
             name=name,
             namespace=job.metadata.namespace,
             labels=template.metadata.labels,
-            annotations=dict(template.metadata.annotations),
+            annotations=annotations,
             owner_references=[_owner_ref(job)]),
         spec=template.spec)
 
@@ -514,9 +541,12 @@ def new_launcher_pod_template(job: MPIJob, pod_group_ctrl=None,
     container.volume_mounts.append(VolumeMount(
         name=CONFIG_VOLUME_NAME, mount_path=CONFIG_MOUNT_PATH))
 
+    launcher_annotations = dict(template.metadata.annotations)
+    propagate_trace_context(job, launcher_annotations, container)
+
     return PodTemplateSpec(
         metadata=ObjectMeta(labels=template.metadata.labels,
-                            annotations=dict(template.metadata.annotations),
+                            annotations=launcher_annotations,
                             owner_references=[_owner_ref(job)]),
         spec=template.spec)
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Optional
 
 from ..api import constants
@@ -30,7 +31,8 @@ from ..k8s.selectors import match_label_selector, match_labels
 from ..k8s.workqueue import (PRIORITY_HIGH, PRIORITY_LOW,
                              ShardedRateLimitingQueue)
 from ..telemetry import flight
-from ..telemetry.trace import span
+from ..telemetry.metrics import record_build_info
+from ..telemetry.trace import annotation_context, default_tracer, span
 from . import builders, metrics as metrics_pkg, status as status_pkg
 from .events import Recorder
 from .metrics import new_operator_metrics
@@ -128,6 +130,11 @@ class MPIJobController:
             fair_queueing = os.environ.get(
                 "MPI_OPERATOR_FAIR_QUEUE", "1").lower() not in ("0", "false")
         self.queue = ShardedRateLimitingQueue(shards, fair=fair_queueing)
+        record_build_info(shards=self.queue.num_shards)
+        # First-enqueue wall time per pending key: the causal trace's
+        # workqueue-wait segment (emitted at dequeue in _timed_sync).
+        # First add wins — the queue dedups pending keys the same way.
+        self._enqueue_wall: dict = {}
         # Jobs at or under this worker-pod count enqueue in the
         # high-priority class (served ahead of gangs, round-robin).
         self.small_job_pods = int(os.environ.get(
@@ -189,8 +196,11 @@ class MPIJobController:
         minutes.  Event-driven adds go through the dedup'd sharded
         queue (with hot-key coalescing); only actual sync errors
         (_run_worker) pay the failure backoff."""
-        self.queue.add(f"{job.metadata.namespace}/{job.metadata.name}",
-                       priority=self._priority_of(job))
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        if len(self._enqueue_wall) > 65536:
+            self._enqueue_wall.clear()  # bounded; a lost wait is one span
+        self._enqueue_wall.setdefault(key, time.time())
+        self.queue.add(key, priority=self._priority_of(job))
 
     def _priority_of(self, job) -> int:
         """Fairness class by job size: small jobs dispatch ahead of
@@ -349,13 +359,30 @@ class MPIJobController:
         """sync_handler wrapped in the reconcile-latency histogram and a
         trace span (errors land on the span before the requeue path).
         Prefixed keys dispatch to their registered foreign-kind handler
-        (register_kind_handler)."""
+        (register_kind_handler).
+
+        Causal tracing: the dequeue closes the workqueue-wait interval
+        opened in enqueue(); both the ``queue_wait`` span and the
+        ``reconcile`` span parent EXPLICITLY to the job's carried
+        context (the watch-event → workqueue hop severs thread-local
+        parenting — docs/OBSERVABILITY.md "Causal tracing")."""
         hist = self.metrics.get("reconcile_seconds")
         handler = self.sync_handler
+        enqueued = self._enqueue_wall.pop(key, None)
+        ctx = None
         prefix, sep, rest = key.partition(":")
         if sep and prefix in self._kind_handlers:
             handler, key = self._kind_handlers[prefix], rest
-        with span("reconcile", job=key):
+        else:
+            ns, _, name = key.partition("/")
+            cached = self.mpi_job_informer.lister.get(ns, name)
+            if cached is not None:
+                ctx = annotation_context(cached)
+        if ctx is not None and enqueued is not None:
+            now = time.time()
+            default_tracer().emit("queue_wait", ts=enqueued,
+                                  dur=now - enqueued, ctx=ctx, job=key)
+        with span("reconcile", ctx=ctx, job=key):
             if hist is not None:
                 with hist.time():
                     handler(key)
@@ -1059,15 +1086,41 @@ class MPIJobController:
                 and running == len(workers):
             msg = (f"MPIJob {job.metadata.namespace}/{job.metadata.name}"
                    f" is running.")
-            update_job_conditions(job, constants.JOB_RUNNING,
-                                  core.CONDITION_TRUE,
-                                  MPI_JOB_RUNNING_REASON, msg, self.clock)
+            first_run = (get_condition(old_status, constants.JOB_RUNNING)
+                         is None)
+            changed = update_job_conditions(job, constants.JOB_RUNNING,
+                                            core.CONDITION_TRUE,
+                                            MPI_JOB_RUNNING_REASON, msg,
+                                            self.clock)
             self.recorder.eventf(job, core.EVENT_TYPE_NORMAL, "MPIJobRunning",
                                  "MPIJob %s/%s is running",
                                  job.metadata.namespace, job.metadata.name)
+            if changed and first_run:
+                self._observe_first_step(job)
 
         if old_status != job.status:
             self._update_status(job)
+
+    def _observe_first_step(self, job: MPIJob) -> None:
+        """Time-to-first-step at the control plane's resolution: job
+        create → first FULL-gang Running flip (workload-side traces
+        refine this with real distributed-init/compile/first-step spans
+        when the pod exports them).  One summary span per job lifecycle
+        + the ``mpi_operator_trace_ttfs_seconds`` histogram — the soak
+        scorecard's ttfs_p99 source (docs/OBSERVABILITY.md)."""
+        created = job.metadata.creation_timestamp
+        if created is None:
+            return
+        ttfs = (self.clock.now() - created).total_seconds()
+        if ttfs < 0:
+            return
+        hist = self.metrics.get("trace_ttfs")
+        if hist is not None:
+            hist.observe(ttfs)
+        default_tracer().emit(
+            "time_to_first_step", ts=created.timestamp(), dur=ttfs,
+            ctx=annotation_context(job),
+            job=f"{job.metadata.namespace}/{job.metadata.name}")
 
     def _update_failed_status(self, job: MPIJob, launcher, launcher_pods) -> None:
         """updateMPIJobFailedStatus (:1202-1233)."""

@@ -78,6 +78,11 @@ def backfill_telemetry_metrics(metrics: dict) -> None:
         "mpi_operator_status_writes_suppressed_total",
         "MPIJob status UPDATEs skipped because the desired status"
         " matched the informer-cached snapshot"))
+    metrics.setdefault("trace_ttfs", registry.histogram(
+        "mpi_operator_trace_ttfs_seconds",
+        "Time to first step: MPIJob create to the first full-gang"
+        " Running flip (the causal trace's bootstrap-path total;"
+        " docs/OBSERVABILITY.md \"Causal tracing & critical path\")"))
     metrics.setdefault("restart_adoptions", registry.counter(
         "mpi_operator_restart_adoptions_total",
         "Owned objects adopted on AlreadyExists instead of created"

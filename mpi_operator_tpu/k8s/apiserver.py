@@ -352,6 +352,31 @@ class ApiServer:
             if w in ks.watches:
                 ks.watches.remove(w)
 
+    @staticmethod
+    def _stamp_trace_context(obj) -> None:
+        """Root the causal trace at the API write that starts the job:
+        a fresh MPIJob without a carried context gets a ``job_submit``
+        root span and the encoded context stamped into its annotations,
+        so every later layer (informer → workqueue → reconcile → gang
+        admission → pod → kubelet → train loop) parents to it
+        explicitly (docs/OBSERVABILITY.md "Causal tracing")."""
+        from ..telemetry import trace as _trace
+        annotations = obj.metadata.annotations
+        if annotations is None:
+            annotations = obj.metadata.annotations = {}
+        if _trace.TRACE_CONTEXT_ANNOTATION in annotations:
+            return  # resubmitted/cloned object: keep the carried chain
+        created = obj.metadata.creation_timestamp
+        trace_id = _trace.job_trace_id(obj.metadata.namespace or "",
+                                       obj.metadata.name or "",
+                                       obj.metadata.uid or "")
+        root = _trace.default_tracer().emit(
+            "job_submit", ts=created.timestamp(), dur=0.0,
+            trace_id=trace_id,
+            job=f"{obj.metadata.namespace}/{obj.metadata.name}")
+        annotations[_trace.TRACE_CONTEXT_ANNOTATION] = \
+            _trace.context_of(root).encode()
+
     # -- verbs ------------------------------------------------------------
     def create(self, obj):
         self._inject("create", obj.api_version, obj.kind,
@@ -368,6 +393,8 @@ class ApiServer:
             obj.metadata.resource_version = self._next_rv()
             if obj.metadata.creation_timestamp is None:
                 obj.metadata.creation_timestamp = self.clock.now()
+            if obj.kind == "MPIJob":
+                self._stamp_trace_context(obj)
             if gvk == ("v1", "Pod") and not obj.status.phase:
                 # kube defaults pod phase to Pending at admission; an
                 # unscheduled (e.g. gang-gated) pod must count as active

@@ -454,8 +454,25 @@ def run_train_loop(state, step_fn, batches, checkpoint_manager=None,
         for batch in source:
             if max_steps is not None and step >= max_steps:
                 break
+            first = step == start_step
+            if first:
+                import time as _time
+                first_t0 = _time.time()
             state, metrics = step_fn(state, batch)
             step += 1
+            if first:
+                # Causal-trace terminal milestone: the first productive
+                # step of this incarnation, parented to the job context
+                # injected into the pod env — closes the create →
+                # first-step chain the `trace` verb decomposes.
+                from ..telemetry.trace import (default_tracer,
+                                               env_context)
+                ctx = env_context()
+                if ctx is not None:
+                    import time as _time
+                    default_tracer().emit(
+                        "first_step", ts=first_t0,
+                        dur=_time.time() - first_t0, ctx=ctx, step=step)
             if on_metrics is not None:
                 on_metrics(step, metrics)
             saved = False

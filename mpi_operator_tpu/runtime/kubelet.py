@@ -542,6 +542,8 @@ class LocalKubelet:
                               pod=f"{namespace}/{name}", phase=phase,
                               reason=reason, restart_count=restart_count,
                               exit_code=exit_code)
+                if phase == core.POD_RUNNING and restart_count == 0:
+                    self._trace_pod_start(namespace, name, pod)
                 return
             except Exception as exc:
                 if is_not_found(exc):
@@ -560,6 +562,22 @@ class LocalKubelet:
                                  namespace, name, phase, exc)
                     return
                 time.sleep(0.1)
+
+    @staticmethod
+    def _trace_pod_start(namespace: str, name: str, pod) -> None:
+        """Causal-trace milestone: pod object create → first Running —
+        the kubelet hop of the bootstrap path, parented explicitly to
+        the job context the controller stamped on the pod (the
+        scheduler-decision → kubelet handoff has no shared thread)."""
+        from ..telemetry.trace import annotation_context, default_tracer
+        ctx = annotation_context(pod)
+        created = pod.metadata.creation_timestamp
+        if ctx is None or created is None:
+            return
+        t0 = created.timestamp()
+        default_tracer().emit("pod_start", ts=t0,
+                              dur=max(0.0, time.time() - t0), ctx=ctx,
+                              pod=f"{namespace}/{name}")
 
     def logs(self, namespace: str, name: str) -> str:
         with self._lock:

@@ -55,7 +55,8 @@ from ..k8s.meta import Clock, deep_copy
 from ..k8s.quantity import parse_quantity
 from ..k8s.selectors import match_labels
 from ..telemetry import flight
-from ..telemetry.metrics import Registry
+from ..telemetry.metrics import Registry, record_build_info
+from ..telemetry.trace import annotation_context, default_tracer
 from .api import (LOCAL_QUEUE_KIND, CLUSTER_QUEUE_KIND, PODS_RESOURCE,
                   SCHED_GROUP_VERSION, job_priority, job_queue_name,
                   set_defaults_clusterqueue, validate_clusterqueue,
@@ -156,6 +157,7 @@ class GangScheduler:
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(clientset)
         self.metrics = new_sched_metrics(registry)
+        record_build_info()
         self._tick = tick
         # job key -> {"cq", "demand", "chips", "epoch", "ns", "name"}
         self._admitted: Dict[str, dict] = {}
@@ -797,7 +799,19 @@ class GangScheduler:
                             and chips > self._backfillable_free():
                         self.metrics["backfill_denied"].inc()
                         continue
+                place_t0 = time.time()
                 placement = self.pool.place(key, chips)
+                if placement is not None:
+                    # Causal-trace milestone: the placement decision
+                    # itself (usually microseconds — its weight in the
+                    # decomposition table proves placement is NOT where
+                    # admission latency hides).
+                    ctx = annotation_context(job)
+                    if ctx is not None:
+                        default_tracer().emit(
+                            "placement", ts=place_t0,
+                            dur=time.time() - place_t0, ctx=ctx,
+                            job=key, chips=chips)
                 if placement is None:
                     # Capacity-blocked front (or a job outranking the
                     # current fence owner): arm — or take over — the
@@ -861,6 +875,14 @@ class GangScheduler:
             wait = (self.clock.now() - created).total_seconds()
             if wait >= 0:
                 self.metrics["admission_wait"].observe(wait)
+                # Causal-trace milestone: submit → gang admitted (gate
+                # open).  Retroactive emit — the interval's start is the
+                # job's creationTimestamp, observed only now.
+                ctx = annotation_context(job)
+                if ctx is not None:
+                    default_tracer().emit(
+                        "admission", ts=created.timestamp(), dur=wait,
+                        ctx=ctx, job=key, path=path, chips=chips)
         self.metrics["admissions"].labels(path).inc()
         self.recorder.event(
             job, core.EVENT_TYPE_NORMAL, "GangAdmitted",

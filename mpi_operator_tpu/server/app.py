@@ -115,6 +115,10 @@ class OperatorApp:
                 if _wants_remote(opt) else Clientset()
         self.client = clientset
         self.metrics = new_operator_metrics()
+        # Build identity on /metrics from process start — the shard
+        # count is recalled by the controller once leadership is won.
+        from ..telemetry.metrics import record_build_info
+        record_build_info()
         self.controller: Optional[MPIJobController] = None
         self._http: Optional[http.server.ThreadingHTTPServer] = None
         self._metrics_http: Optional[http.server.ThreadingHTTPServer] = None

@@ -166,6 +166,12 @@ class _Request:
     # Set when the request sat out a pool-exhaustion deferral, so its
     # admission wait lands in the path="deferred" histogram variant.
     was_deferred: bool = False
+    # Causal tracing (router-carried context): wall-clock submit time
+    # and the perf_counter admission mark, so the first token emits the
+    # replica-side queue-wait and prefill spans retroactively.
+    trace_ctx: Optional[object] = None
+    submitted_wall: float = 0.0
+    admitted_at: float = 0.0
 
     def emit(self, token: int) -> None:
         if self.metrics is not None:
@@ -173,6 +179,8 @@ class _Request:
             if not self.output and self.submitted_at:
                 self.metrics["ttft_seconds"].observe(
                     now - self.submitted_at)
+                if self.trace_ctx is not None:
+                    self._trace_first_token(now)
             elif self.output and self._last_emit:
                 self.metrics["token_latency_seconds"].observe(
                     now - self._last_emit)
@@ -180,6 +188,22 @@ class _Request:
         self.output.append(token)
         if self.on_token is not None:
             self.on_token(token)
+
+    def _trace_first_token(self, now: float) -> None:
+        """Replica-side spans of the request's causal trace, emitted
+        once at first token: submit → admission (``serve_queue_wait``)
+        and admission → first token (``prefill`` — prefill dominates
+        it), both parented to the router's request span."""
+        from ..telemetry.trace import default_tracer
+        admitted = self.admitted_at or self.submitted_at
+        queue_wait = max(0.0, admitted - self.submitted_at)
+        tracer = default_tracer()
+        tracer.emit("serve_queue_wait", ts=self.submitted_wall,
+                    dur=queue_wait, ctx=self.trace_ctx,
+                    deferred=self.was_deferred)
+        tracer.emit("prefill", ts=self.submitted_wall + queue_wait,
+                    dur=max(0.0, now - admitted), ctx=self.trace_ctx,
+                    prompt_tokens=len(self.tokens))
 
     @property
     def finished(self) -> bool:
@@ -1068,7 +1092,8 @@ class ContinuousBatcher:
         return self.draft_len + 1
 
     def _enqueue(self, tokens, max_new_tokens, temperature, top_p, seed,
-                 on_token=None, stop_tokens=(), top_k=0) -> _Request:
+                 on_token=None, stop_tokens=(), top_k=0,
+                 trace_ctx=None) -> _Request:
         headroom = self._headroom(temperature)
         if len(tokens) + max_new_tokens + headroom > self._max_seq_len:
             raise ValueError(
@@ -1095,7 +1120,9 @@ class ContinuousBatcher:
                        on_token=on_token,
                        stop_tokens=frozenset(map(int, stop_tokens)),
                        metrics=self.telemetry,
-                       submitted_at=time.perf_counter())
+                       submitted_at=time.perf_counter(),
+                       trace_ctx=trace_ctx,
+                       submitted_wall=time.time())
         self._queue.put(req)
         # The fatal/stop path is asynchronous: the scheduler may have
         # stopped and drained between the _stop check above and this
@@ -1112,11 +1139,13 @@ class ContinuousBatcher:
     def submit(self, tokens: List[int], max_new_tokens: int,
                timeout: float = 300.0, temperature: float = 0.0,
                top_p: float = 1.0, seed: Optional[int] = None,
-               stop_tokens=(), top_k: int = 0) -> List[int]:
+               stop_tokens=(), top_k: int = 0,
+               trace_ctx=None) -> List[int]:
         if max_new_tokens <= 0:
             return []  # match generate()'s [B, 0] semantics
         req = self._enqueue(tokens, max_new_tokens, temperature, top_p,
-                            seed, stop_tokens=stop_tokens, top_k=top_k)
+                            seed, stop_tokens=stop_tokens, top_k=top_k,
+                            trace_ctx=trace_ctx)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if req.error is not None:
@@ -1126,7 +1155,7 @@ class ContinuousBatcher:
     def submit_iter(self, tokens: List[int], max_new_tokens: int,
                     timeout: float = 300.0, temperature: float = 0.0,
                     top_p: float = 1.0, seed: Optional[int] = None,
-                    stop_tokens=(), top_k: int = 0):
+                    stop_tokens=(), top_k: int = 0, trace_ctx=None):
         """Streaming submit: yields each generated id as the batcher
         produces it (tokens from this slot's decode ticks)."""
         if max_new_tokens <= 0:
@@ -1135,7 +1164,8 @@ class ContinuousBatcher:
         out: "queue.Queue" = queue.Queue()
         req = self._enqueue(tokens, max_new_tokens, temperature, top_p,
                             seed, on_token=out.put,
-                            stop_tokens=stop_tokens, top_k=top_k)
+                            stop_tokens=stop_tokens, top_k=top_k,
+                            trace_ctx=trace_ctx)
         threading.Thread(
             target=lambda: (req.done.wait(timeout), out.put(sentinel)),
             daemon=True).start()
@@ -1354,9 +1384,10 @@ class ContinuousBatcher:
                     deferred_mark = self._retire_count
                     req.was_deferred = True
                     break
+                req.admitted_at = time.perf_counter()
                 tm["queue_wait_seconds"].labels(
                     "deferred" if req.was_deferred else "direct").observe(
-                        time.perf_counter() - req.submitted_at)
+                        req.admitted_at - req.submitted_at)
                 donated = False
                 try:
                     key0 = jax.random.fold_in(

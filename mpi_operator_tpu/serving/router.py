@@ -34,7 +34,9 @@ duplicated tokens, counter-asserted via
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import random
 import threading
 import time
@@ -42,7 +44,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from ..telemetry.metrics import (Registry, expose_with_defaults,
-                                 new_router_metrics)
+                                 new_router_metrics, record_build_info)
+from ..telemetry.trace import TraceContext, default_tracer
 from .batcher import prefix_page_digests
 
 
@@ -51,6 +54,12 @@ class _ClientGone(ConnectionError):
     upstream (replica) failure: it must never mark a replica dead,
     burn the retry, or count a lost request."""
 
+
+# Per-process router generation counter: request trace ids must be
+# unique across router INSTANCES too — a later router handed the same
+# ephemeral port by the OS must not restart req-<port>-1 and merge two
+# different requests' spans under one trace id.
+_ROUTER_GENERATIONS = itertools.count(1)
 
 # Bound on the session-affinity map: oldest pins evict FIFO past this,
 # so a long-lived router under unbounded distinct sessions stays O(1)
@@ -159,6 +168,13 @@ class FleetRouter:
         self._http.router = self  # type: ignore[attr-defined]
         self.port = self._http.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._req_counter = 0
+        # pid + per-process generation uniquify request trace ids
+        # across router instances and across processes (replica-side
+        # spans of two deployments must never alias one trace).
+        self._trace_prefix = (f"req-{os.getpid() & 0xFFFFFF:x}"
+                              f"-{next(_ROUTER_GENERATIONS)}")
+        record_build_info()
 
     # -- membership --------------------------------------------------------
     def add_replica(self, name: str, url: str) -> None:
@@ -324,6 +340,36 @@ class FleetRouter:
             self.telemetry["routed_total"].labels(path).inc()
             return pick
 
+    # -- causal tracing ----------------------------------------------------
+    def _begin_trace(self, payload: dict) -> TraceContext:
+        """Root one request's causal trace and inject the context into
+        the upstream payload, so the replica's queue-wait/prefill spans
+        parent to this router's ``request`` span across the HTTP hop.
+        The root span itself is emitted at request end (_end_trace)
+        with the id reserved here."""
+        with self._lock:
+            self._req_counter += 1
+            n = self._req_counter
+        trace_id = f"{self._trace_prefix}-{n}"
+        root_id = default_tracer().allocate_id()
+        ctx = TraceContext(trace_id=trace_id, span_id=root_id)
+        payload["trace_context"] = ctx.encode()
+        return ctx
+
+    def _end_trace(self, ctx: TraceContext, start_wall: float,
+                   dur: float, **attrs) -> None:
+        default_tracer().emit("request", ts=start_wall, dur=dur,
+                              trace_id=ctx.trace_id,
+                              span_id=ctx.span_id, **attrs)
+
+    def _trace_ttft(self, ctx: TraceContext, start_wall: float,
+                    ttft: float) -> None:
+        """The traced-TTFT milestone: router accept → first upstream
+        token visible downstream — the request decomposition's terminal
+        segment and the soak scorecard's traced_ttft_p99 source."""
+        default_tracer().emit("request_ttft", ts=start_wall, dur=ttft,
+                              ctx=ctx)
+
     # -- upstream plumbing -------------------------------------------------
     def _prepare(self, payload: dict) -> dict:
         # A sampled request without a seed would re-sample differently
@@ -370,11 +416,22 @@ class FleetRouter:
         Returns (status, body-dict) for the front-door handler."""
         self.telemetry["requests_total"].inc()
         payload = self._prepare(payload)
+        ctx = self._begin_trace(payload)
         start = time.perf_counter()
+        start_wall = time.time()
+        try:
+            return self._relay_attempts(payload, ctx, start, start_wall)
+        finally:
+            self._end_trace(ctx, start_wall, time.perf_counter() - start)
+
+    def _relay_attempts(self, payload: dict, ctx: TraceContext,
+                        start: float, start_wall: float) -> tuple:
         exclude: List[str] = []
         for attempt in range(2):
             try:
-                replica = self._pick(payload, exclude=exclude)
+                with default_tracer().span("route", ctx=ctx,
+                                           attempt=attempt):
+                    replica = self._pick(payload, exclude=exclude)
             except RuntimeError as exc:
                 # Lost means an ACCEPTED request died past its retry;
                 # a pre-dispatch 503 (no healthy replicas, nothing
@@ -409,8 +466,9 @@ class FleetRouter:
                     # whole response, so completion IS first-token
                     # visibility — keeps the autoscaler's TTFT-SLO
                     # trigger live for plain-JSON clients.
-                    self.telemetry["ttft_seconds"].observe(
-                        time.perf_counter() - start)
+                    ttft = time.perf_counter() - start
+                    self.telemetry["ttft_seconds"].observe(ttft)
+                    self._trace_ttft(ctx, start_wall, ttft)
                 return status, body
             # Transport failure or a dead replica's error: retry once.
             if failed:
@@ -430,7 +488,9 @@ class FleetRouter:
         pinned seed makes the replay exact)."""
         self.telemetry["requests_total"].inc()
         payload = self._prepare(payload)
+        ctx = self._begin_trace(payload)
         start = time.perf_counter()
+        start_wall = time.time()
         handler.send_response(200)
         handler.send_header("Content-Type", "text/event-stream")
         handler.send_header("Cache-Control", "no-cache")
@@ -456,12 +516,24 @@ class FleetRouter:
             except (BrokenPipeError, ConnectionResetError, OSError) as exc:
                 raise _ClientGone(str(exc)) from exc
 
+        try:
+            self._relay_stream_attempts(payload, ctx, start, start_wall,
+                                        emit, finish)
+        finally:
+            self._end_trace(ctx, start_wall,
+                            time.perf_counter() - start, stream=True)
+
+    def _relay_stream_attempts(self, payload: dict, ctx: TraceContext,
+                               start: float, start_wall: float,
+                               emit, finish) -> None:
         sent = 0          # tokens already forwarded to the client
         first_at = None
         exclude: List[str] = []
         for attempt in range(2):
             try:
-                replica = self._pick(payload, exclude=exclude)
+                with default_tracer().span("route", ctx=ctx,
+                                           attempt=attempt):
+                    replica = self._pick(payload, exclude=exclude)
             except RuntimeError as exc:
                 if attempt:  # see relay(): pre-dispatch 503 != lost
                     self.telemetry["requests_lost_total"].inc()
@@ -504,6 +576,8 @@ class FleetRouter:
                                     first_at = time.perf_counter()
                                     self.telemetry["ttft_seconds"]\
                                         .observe(first_at - start)
+                                    self._trace_ttft(ctx, start_wall,
+                                                     first_at - start)
                                 sent += 1
                                 emit(event)
                             elif "error" in event:

@@ -37,7 +37,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..telemetry.metrics import (Registry, expose_with_defaults,
-                                 new_serving_metrics)
+                                 new_serving_metrics, record_build_info)
+from ..telemetry.trace import TraceContext
 
 # Sliding-window attention forces the materialized-score XLA path
 # (ops/attention.py window branch), so an S-token prefill allocates an
@@ -134,7 +135,10 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=float(req.get("top_p", 1.0)),
                 top_k=int(req.get("top_k") or 0),
                 seed=req.get("seed"),
-                stop_tokens=tuple(map(int, stop)))
+                stop_tokens=tuple(map(int, stop)),
+                # Causal-trace carrier from the fleet router: replica-
+                # side queue-wait/prefill spans parent to its request.
+                trace_ctx=TraceContext.decode(req.get("trace_context")))
             if req.get("stream"):
                 return self._stream(server, tokens, kwargs)
             out = server.generate(tokens, **kwargs)
@@ -253,6 +257,7 @@ class InferenceServer:
         # alongside the process default registry.
         self.telemetry_registry = telemetry_registry or Registry()
         self.telemetry = new_serving_metrics(self.telemetry_registry)
+        record_build_info()
         self._http = ThreadingHTTPServer((host, port), _Handler)
         self._http.inference = self  # type: ignore[attr-defined]
         self.port = self._http.server_address[1]
@@ -308,7 +313,8 @@ class InferenceServer:
     # -- inference ---------------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed=None, stop_tokens=(), top_k: int = 0) -> list:
+                 seed=None, stop_tokens=(), top_k: int = 0,
+                 trace_ctx=None) -> list:
         # Counted in finally, like stream(): requests_total covers every
         # request served, successful or not (see new_serving_metrics help).
         try:
@@ -317,13 +323,14 @@ class InferenceServer:
                                       max_new_tokens=max_new_tokens,
                                       temperature=temperature, top_p=top_p,
                                       seed=seed, stop_tokens=stop_tokens,
-                                      top_k=top_k)
+                                      top_k=top_k, trace_ctx=trace_ctx)
         finally:
             self.telemetry["requests_total"].inc()
 
     def _generate(self, tokens, max_new_tokens: int = 16,
                   temperature: float = 0.0, top_p: float = 1.0,
-                  seed=None, stop_tokens=(), top_k: int = 0) -> list:
+                  seed=None, stop_tokens=(), top_k: int = 0,
+                  trace_ctx=None) -> list:
         import jax
         import jax.numpy as jnp
 
@@ -349,7 +356,7 @@ class InferenceServer:
             return [self._batcher.submit(
                 rows[0], max_new_tokens, temperature=temperature,
                 top_p=top_p, seed=seed, stop_tokens=stop_tokens,
-                top_k=top_k)]
+                top_k=top_k, trace_ctx=trace_ctx)]
         lengths = [len(r) for r in rows]
         width = max(lengths)
         prompt = jnp.asarray([r + [0] * (width - len(r)) for r in rows],
@@ -394,7 +401,7 @@ class InferenceServer:
 
     def stream(self, tokens, max_new_tokens: int = 16,
                temperature: float = 0.0, top_p: float = 1.0, seed=None,
-               stop_tokens=(), top_k: int = 0):
+               stop_tokens=(), top_k: int = 0, trace_ctx=None):
         """Yield generated ids one at a time for ONE sequence (the SSE
         source).  Rides the continuous batcher when enabled; otherwise
         takes the device lock per decode step so slow stream consumers
@@ -404,7 +411,7 @@ class InferenceServer:
             yield from self._stream(tokens, max_new_tokens=max_new_tokens,
                                     temperature=temperature, top_p=top_p,
                                     seed=seed, stop_tokens=stop_tokens,
-                                    top_k=top_k)
+                                    top_k=top_k, trace_ctx=trace_ctx)
         finally:
             # Streaming requests count toward the request-level metrics
             # too (duration covers the full stream, including aborts).
@@ -414,7 +421,7 @@ class InferenceServer:
 
     def _stream(self, tokens, max_new_tokens: int = 16,
                 temperature: float = 0.0, top_p: float = 1.0, seed=None,
-                stop_tokens=(), top_k: int = 0):
+                stop_tokens=(), top_k: int = 0, trace_ctx=None):
         import jax
 
         if hasattr(tokens, "tolist"):  # numpy/jnp arrays, like generate()
@@ -431,7 +438,8 @@ class InferenceServer:
         if self._batcher is not None:
             yield from self._batcher.submit_iter(
                 rows, max_new_tokens, temperature=temperature, top_p=top_p,
-                seed=seed, stop_tokens=stop_tokens, top_k=top_k)
+                seed=seed, stop_tokens=stop_tokens, top_k=top_k,
+                trace_ctx=trace_ctx)
             return
 
         from ..models.llama import stream_generate

@@ -360,6 +360,29 @@ class SoakHarness:
         self.monitor = _JobMonitor(self.client, self.soak_metrics)
         self._recoveries: List[tuple] = []  # (component, seconds)
         self._started = False
+        # Causal-trace scoring: the tracer's ring is bounded (65536)
+        # and a long soak wraps it — scoring from tracer.events() at
+        # the end would silently lose the earliest time_to_first_step
+        # spans and bias (or unpopulate) the ttfs gate.  Accumulate
+        # via a completion listener instead: exact dur samples for the
+        # two SLO span names, plus a bounded traced-span feed for the
+        # per-segment attribution (attribution degrades gracefully
+        # past the cap; the SLO samples never do).
+        self._trace_samples: Dict[str, List[float]] = {
+            "time_to_first_step": [], "request_ttft": []}
+        self._traced_events: List[dict] = []
+        self._traced_cap = 120_000
+
+        def _on_span(event: dict) -> None:
+            if not event.get("trace_id"):
+                return
+            bucket = self._trace_samples.get(event["name"])
+            if bucket is not None:
+                bucket.append(event["dur"])
+            if len(self._traced_events) < self._traced_cap:
+                self._traced_events.append(event)
+
+        self._span_listener = _on_span
 
     # -- LocalCluster shape (chaos engine + invariants) --------------------
     @property
@@ -466,6 +489,8 @@ class SoakHarness:
                 spec=LocalQueueSpec(cluster_queue=cq_name)))
 
     def start(self) -> "SoakHarness":
+        from ..telemetry.trace import default_tracer
+        default_tracer().add_listener(self._span_listener)
         self.cluster.start()
         self._create_queues()
         self.monitor.start()
@@ -482,6 +507,8 @@ class SoakHarness:
     def stop(self) -> None:
         if not self._started:
             return
+        from ..telemetry.trace import default_tracer
+        default_tracer().remove_listener(self._span_listener)
         self.monitor.stop()
         self.fleet.stop()
         self.cluster.stop()
@@ -566,6 +593,33 @@ class SoakHarness:
         return SoakResult(scorecard=scorecard, report=report,
                           bundle_dir=report.bundle_dir)
 
+    # -- causal-trace scoring ------------------------------------------------
+    def _trace_slos(self) -> tuple:
+        """(ttfs samples, traced-ttft samples, per-segment attribution)
+        from this run's causal traces: ttfs is every job's create →
+        first full-gang Running span, traced ttft every routed
+        request's accept → first-token span; attribution averages the
+        critical-path decomposition segments per trace kind so a p99
+        regression names its guilty layer (docs/OBSERVABILITY.md).
+        All fed by the harness's own span listener — immune to tracer
+        ring eviction on long soaks."""
+        from ..telemetry import critical_path as cp
+        ttfs = list(self._trace_samples["time_to_first_step"])
+        ttft = list(self._trace_samples["request_ttft"])
+        segments: Dict[str, Dict[str, list]] = {}
+        for spans in cp.traces(self._traced_events).values():
+            decomp = cp.decompose(spans)
+            if decomp is None:
+                continue
+            bucket = segments.setdefault(decomp["kind"], {})
+            for seg in decomp["segments"]:
+                bucket.setdefault(seg["name"], []).append(seg["seconds"])
+        attribution = {
+            kind: {name: round(sum(vals) / len(vals), 4)
+                   for name, vals in sorted(buckets.items())}
+            for kind, buckets in sorted(segments.items())}
+        return ttfs, ttft, attribution
+
     # -- scoring -------------------------------------------------------------
     def _score(self, report, traffic: ServeTraffic,
                smalls: SmallJobStream) -> SloScorecard:
@@ -582,6 +636,7 @@ class SoakHarness:
             return sum(1 for ev in applied if ev.get("kind") == kind
                        and ev.get("result") == "crashed")
 
+        trace_ttfs, trace_ttft, trace_segments = self._trace_slos()
         card = SloScorecard(
             train_goodput_pct=goodput_pct(productive, disrupted),
             serve_ttft_p50_s=quantile(ttfts, 0.50),
@@ -589,6 +644,8 @@ class SoakHarness:
             reconcile_p99_s=histogram_quantile(reconcile.snapshot(),
                                                0.99),
             admission_p99_s=quantile(small_waits, 0.99),
+            ttfs_p99_s=quantile(trace_ttfs, 0.99),
+            traced_ttft_p99_s=quantile(trace_ttft, 0.99),
             requests_total=int(router_tm["requests_total"].value),
             requests_lost=int(router_tm["requests_lost_total"].value),
             invariant_violations=len(report.violations),
@@ -600,6 +657,9 @@ class SoakHarness:
                                     0.99),
             converged=report.converged,
             detail={
+                "trace_segments": trace_segments,
+                "traced_jobs": len(trace_ttfs),
+                "traced_requests": len(trace_ttft),
                 "serve_completions": len(traffic.completions),
                 "serve_errors": len(traffic.errors),
                 "small_jobs_submitted": smalls.submitted,
@@ -633,6 +693,8 @@ class SoakHarness:
             "serve_ttft_p99_s": card.serve_ttft_p99_s,
             "reconcile_p99_s": card.reconcile_p99_s,
             "admission_p99_s": card.admission_p99_s,
+            "ttfs_p99_s": card.ttfs_p99_s,
+            "traced_ttft_p99_s": card.traced_ttft_p99_s,
             "requests_lost": card.requests_lost,
             "invariant_violations": card.invariant_violations,
         }

@@ -95,6 +95,13 @@ class SloScorecard:
     serve_ttft_p99_s: Optional[float] = None
     reconcile_p99_s: Optional[float] = None
     admission_p99_s: Optional[float] = None
+    # Causal-trace SLOs (docs/OBSERVABILITY.md "Causal tracing &
+    # critical path"): job create → first productive step, and the
+    # router-observed TTFT as measured by request traces — both carry
+    # per-segment attribution in detail["trace_segments"], so a
+    # regression names its guilty layer.
+    ttfs_p99_s: Optional[float] = None
+    traced_ttft_p99_s: Optional[float] = None
     # Hard zero-tolerance counters.
     requests_total: int = 0
     requests_lost: int = 0
@@ -163,6 +170,8 @@ class SloScorecard:
             "serve_ttft_p99_s": r(self.serve_ttft_p99_s),
             "reconcile_p99_s": r(self.reconcile_p99_s),
             "admission_p99_s": r(self.admission_p99_s),
+            "ttfs_p99_s": r(self.ttfs_p99_s),
+            "traced_ttft_p99_s": r(self.traced_ttft_p99_s),
             "requests_total": self.requests_total,
             "requests_lost": self.requests_lost,
             "invariant_violations": self.invariant_violations,

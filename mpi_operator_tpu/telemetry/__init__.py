@@ -27,9 +27,10 @@ endpoint the process serves.
 from .metrics import (Counter, CounterVec, Gauge, GaugeVec,  # noqa: F401
                       Histogram, HistogramVec, Registry,
                       default_registry, expose_with_defaults,
-                      new_serving_metrics)
-from .trace import (Tracer, default_tracer, read_jsonl, span,  # noqa: F401
-                    to_chrome_trace)
+                      new_serving_metrics, record_build_info)
+from .trace import (TraceContext, Tracer, annotation_context,  # noqa: F401
+                    default_tracer, env_context, job_trace_id,
+                    read_jsonl, span, to_chrome_trace)
 from .goodput import (GOODPUT_BUCKETS, GoodputTracker,  # noqa: F401
                       instrument_step)
 from .flight import (FlightRecorder, default_recorder,  # noqa: F401

@@ -57,14 +57,26 @@ DEBUG_DIR_ENV = "MPI_OPERATOR_DEBUG_DIR"
 FLIGHT_DIR_ENV = "MPI_OPERATOR_FLIGHT_DIR"
 
 # Stable lane order for the merged Chrome trace (pid = index + 1).
+# New lanes append BEFORE "other": earlier indices are a compatibility
+# surface (tests pin controller..chaos to pids 1-5).
 LAYERS = ("controller", "kubelet", "train", "serving", "chaos",
-          "apiserver", "other")
+          "apiserver", "sched", "other")
 
 # Span-name prefix -> layer lane for tracer events in the merged trace.
 _SPAN_LAYERS = (("reconcile", "controller"), ("chaos", "chaos"),
                 ("checkpoint", "train"), ("train", "train"),
-                ("profile", "train"), ("serv", "serving"),
-                ("prefill", "serving"), ("decode", "serving"))
+                ("profile", "train"),
+                ("serve_queue_wait", "serving"), ("serv", "serving"),
+                ("prefill", "serving"), ("decode", "serving"),
+                ("request", "serving"), ("route", "serving"),
+                # Causal-trace bootstrap-path spans (critical_path.py).
+                ("job_submit", "apiserver"),
+                ("queue_wait", "controller"),
+                ("time_to_first_step", "controller"),
+                ("admission", "sched"), ("placement", "sched"),
+                ("pod_start", "kubelet"),
+                ("distributed_init", "train"),
+                ("compile", "train"), ("first_step", "train"))
 
 # Canonical view field order — mirrors chaos.engine.CANONICAL_FIELDS'
 # contract: no wall-clock fields, stable key order, so canonical
@@ -100,11 +112,17 @@ class FlightRecorder:
         if layer not in LAYERS:
             layer = "other"
         with self._lock:
+            dropped = len(self._records) == self.max_records
             rec = {"seq": self._seq, "ts": round(time.time(), 6),
                    "layer": layer, "kind": kind, "data": data}
             self._seq += 1
             self._records.append(rec)
-            return rec
+        if dropped:
+            # The ring silently overwrites on wrap; a truncated bundle
+            # must be DETECTABLE — counted here, echoed in the
+            # flight.jsonl header (see export_jsonl).
+            _count_dropped()
+        return rec
 
     # -- access ------------------------------------------------------------
     def records(self, layer: Optional[str] = None) -> List[dict]:
@@ -130,14 +148,34 @@ class FlightRecorder:
             self._records.clear()
 
     # -- export ------------------------------------------------------------
-    def export_jsonl(self, path_or_file) -> int:
-        records = self.records()
+    def export_jsonl(self, path_or_file, extra_records=()) -> int:
+        """Header line + ring records (+ caller-supplied extras, e.g.
+        export_sidecar's pre-listener tracer spans).  The header's drop
+        accounting lets a reader tell a truncated (wrapped) ring from a
+        complete one without summing seq gaps; snapshot and counter
+        come from ONE lock acquisition so records landing concurrently
+        are never misreported as drops, and ``retained`` counts every
+        line actually written below it."""
+        extra_records = list(extra_records)
         if isinstance(path_or_file, (str, os.PathLike)):
             with open(path_or_file, "w") as f:
-                return self.export_jsonl(f)
+                return self.export_jsonl(f, extra_records=extra_records)
+        with self._lock:
+            records = list(self._records)
+            total = self._seq
+        header = {"seq": -1, "ts": 0.0, "layer": "other",
+                  "kind": "flight_header",
+                  "data": {"total": total + len(extra_records),
+                           "retained": len(records) + len(extra_records),
+                           "dropped": total - len(records),
+                           "extra_records": len(extra_records),
+                           "max_records": self.max_records}}
+        path_or_file.write(json.dumps(header) + "\n")
         for rec in records:
             path_or_file.write(json.dumps(rec) + "\n")
-        return len(records)
+        for rec in extra_records:
+            path_or_file.write(json.dumps(rec) + "\n")
+        return len(records) + len(extra_records)
 
     def canonical_records(self, layers: Iterable[str] = ("chaos",)
                           ) -> List[dict]:
@@ -152,6 +190,20 @@ class FlightRecorder:
 _DEFAULT = FlightRecorder()
 _tracer_wired = False
 _wire_lock = threading.Lock()
+_dropped_counter = None
+
+
+def _count_dropped() -> None:
+    """mpi_operator_flight_records_dropped_total in the process default
+    registry (lazy: the metrics import must not run per record)."""
+    global _dropped_counter
+    if _dropped_counter is None:
+        from .metrics import default_registry
+        _dropped_counter = default_registry().counter(
+            "mpi_operator_flight_records_dropped_total",
+            "Flight-ring records overwritten on wrap (history a bundle"
+            " cut now would be missing)")
+    _dropped_counter.inc()
 
 
 def default_recorder() -> FlightRecorder:
@@ -179,6 +231,15 @@ def _span_listener(event: dict) -> None:
         data["error"] = event["error"]
     if event.get("attrs"):
         data["attrs"] = event["attrs"]
+    if event.get("trace_id"):
+        # Causal-trace spans keep their identity through the ring: the
+        # sidecar export is how a worker pod's spans reach the control
+        # plane's critical-path analysis (critical_path.py).
+        data["trace_id"] = event["trace_id"]
+        data["span_id"] = event["span_id"]
+        data["parent_id"] = event.get("parent_id")
+        data["ts"] = event["ts"]
+        data["pid"] = event.get("pid", 0)
     _DEFAULT.record(_span_layer(event["name"]), "span", **data)
 
 
@@ -204,22 +265,37 @@ def merged_chrome_trace(span_events: Iterable[dict],
     (``at``) are placed at that deterministic offset instead of wall
     time, reusing chaos/engine.py's timestamp-free ordering so chaos
     lanes diff cleanly across identical seeded runs.
+
+    Causal-trace spans (carrying a trace id) additionally get **linked
+    flows**: a flow arrow (ph=s/f pairs) from each parent span's end to
+    its child's start, so one job's create → admit → pod-start →
+    first-step chain reads as a connected path across lanes in
+    perfetto instead of disconnected rectangles.
     """
     lane = {layer: i + 1 for i, layer in enumerate(LAYERS)}
     trace_events = [
         {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
          "args": {"name": layer}}
         for layer, pid in sorted(lane.items(), key=lambda kv: kv[1])]
+    # span_id -> (lane pid, tid, start us, end us, parent_id) for the
+    # flow pass below; only causal-trace spans participate.
+    traced: dict = {}
 
     for e in span_events:
         args = dict(e.get("attrs") or {})
         if e.get("error"):
             args["error"] = e["error"]
+        if e.get("trace_id"):
+            args["trace_id"] = e["trace_id"]
+        pid = lane[_span_layer(e["name"])]
+        ts_us, dur_us = e["ts"] * 1e6, e["dur"] * 1e6
         trace_events.append({
             "name": e["name"], "ph": "X", "cat": "span",
-            "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
-            "pid": lane[_span_layer(e["name"])],
-            "tid": e.get("tid", 0), "args": args})
+            "ts": ts_us, "dur": dur_us,
+            "pid": pid, "tid": e.get("tid", 0), "args": args})
+        if e.get("trace_id") and e.get("span_id") is not None:
+            traced[e["span_id"]] = (pid, e.get("tid", 0), ts_us,
+                                    ts_us + dur_us, e.get("parent_id"))
 
     def _add_record(rec, local: bool) -> None:
         if rec.get("kind") == "span":
@@ -230,11 +306,17 @@ def merged_chrome_trace(span_events: Iterable[dict],
             data = dict(rec.get("data") or {})
             name = data.pop("name", "span")
             dur = float(data.pop("dur", 0.0) or 0.0)
+            ts = float(data.get("ts", rec.get("ts", 0.0)) or 0.0)
+            pid = lane[_span_layer(name)]
             trace_events.append({
                 "name": name, "ph": "X", "cat": "span",
-                "ts": rec.get("ts", 0.0) * 1e6, "dur": dur * 1e6,
-                "pid": lane[_span_layer(name)], "tid": 0,
+                "ts": ts * 1e6, "dur": dur * 1e6,
+                "pid": pid, "tid": 0,
                 "args": dict(data.get("attrs") or {})})
+            if data.get("trace_id") and data.get("span_id") is not None:
+                traced[data["span_id"]] = (pid, 0, ts * 1e6,
+                                           (ts + dur) * 1e6,
+                                           data.get("parent_id"))
             return
         data = dict(rec.get("data") or {})
         layer = rec.get("layer", "other")
@@ -254,6 +336,20 @@ def merged_chrome_trace(span_events: Iterable[dict],
         _add_record(rec, local=True)
     for rec in extra_records:
         _add_record(rec, local=False)
+
+    # Linked flows: parent end -> child start, one arrow per causal
+    # edge whose both endpoints are in this trace.  The child span id
+    # (globally unique, see Tracer._ids) is the flow id.
+    for sid, (pid, tid, ts_us, _end, parent) in sorted(traced.items()):
+        if parent is None or parent not in traced:
+            continue
+        p_pid, p_tid, _p_ts, p_end, _ = traced[parent]
+        trace_events.append({
+            "name": "causal", "ph": "s", "cat": "trace", "id": sid,
+            "pid": p_pid, "tid": p_tid, "ts": p_end})
+        trace_events.append({
+            "name": "causal", "ph": "f", "bp": "e", "cat": "trace",
+            "id": sid, "pid": pid, "tid": tid, "ts": ts_us})
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 
@@ -265,7 +361,13 @@ def export_sidecar(recorder: Optional[FlightRecorder] = None,
                    directory: Optional[str] = None) -> Optional[str]:
     """Write this process's ring as ``flight-<pid>.jsonl`` into the
     shared flight dir so another process's bundle can merge it (workers
-    call this on preemption/exit; no-op when no dir is configured)."""
+    call this on preemption/exit; no-op when no dir is configured).
+
+    Causal-trace spans recorded BEFORE the ring's tracer listener was
+    wired (the wiring is lazy on first default_recorder() use) are
+    appended from the tracer directly — a worker whose very first
+    flight call is this export must not lose its distributed-init/
+    compile/first-step milestones."""
     directory = directory or os.environ.get(FLIGHT_DIR_ENV)
     if not directory:
         return None
@@ -273,7 +375,23 @@ def export_sidecar(recorder: Optional[FlightRecorder] = None,
     try:
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"flight-{os.getpid()}.jsonl")
-        recorder.export_jsonl(path)
+        extra = []
+        if recorder is _DEFAULT:
+            in_ring = {r["data"].get("span_id")
+                       for r in recorder.records()
+                       if r["kind"] == "span"}
+            extra = [
+                {"seq": -2, "ts": e["ts"],
+                 "layer": _span_layer(e["name"]), "kind": "span",
+                 "data": {"name": e["name"], "dur": e["dur"],
+                          "attrs": e.get("attrs") or {},
+                          "trace_id": e["trace_id"],
+                          "span_id": e["span_id"],
+                          "parent_id": e.get("parent_id"),
+                          "ts": e["ts"], "pid": e.get("pid", 0)}}
+                for e in default_tracer().events()
+                if e.get("trace_id") and e["span_id"] not in in_ring]
+        recorder.export_jsonl(path, extra_records=extra)
         return path
     except OSError:
         return None
@@ -298,8 +416,12 @@ def _read_sidecars(directory: Optional[str],
                 continue  # leftover from an earlier run (pid recycled)
             with open(path) as f:
                 for line in f:
-                    if line.strip():
-                        out.append(json.loads(line))
+                    if not line.strip():
+                        continue
+                    rec = json.loads(line)
+                    if rec.get("kind") == "flight_header":
+                        continue  # export metadata, not a record
+                    out.append(rec)
         except (OSError, ValueError):
             continue
     return out
@@ -425,14 +547,34 @@ def _dump_bundle_inner(reason, directory, recorder, tracer, registry,
     with open(os.path.join(path, "trace.json"), "w") as f:
         json.dump(trace, f)
 
-    # 4. metrics.prom — /metrics snapshot (an already-fetched remote
+    # 4. critical_path.json — per-trace bootstrap/TTFT decomposition
+    # (telemetry/critical_path.py): the bundle answers "which seconds"
+    # without re-running the analyzer.  Sidecar span records are folded
+    # in so worker-side milestones (distributed init, compile, first
+    # step) appear in the control plane's decomposition.
+    from . import critical_path as _cp
+    cp_events = list(tracer.events())
+    cp_events += _cp.spans_from_flight_records(recorder.records())
+    cp_events += _cp.spans_from_flight_records(sidecars)
+    seen_spans: set = set()
+    cp_unique = []
+    for ev in cp_events:
+        key = (ev.get("trace_id"), ev.get("span_id"))
+        if ev.get("span_id") is not None and key in seen_spans:
+            continue
+        seen_spans.add(key)
+        cp_unique.append(ev)
+    with open(os.path.join(path, "critical_path.json"), "w") as f:
+        json.dump(_cp.bundle_payload(cp_unique), f, indent=2)
+
+    # 5. metrics.prom — /metrics snapshot (an already-fetched remote
     # exposition wins over the local process registries).
     exposition = (metrics_text if metrics_text is not None
                   else expose_with_defaults(registry))
     with open(os.path.join(path, "metrics.prom"), "w") as f:
         f.write(exposition or "# (no metric families registered)\n")
 
-    # 5. job.json — involved job(s): conditions + last events.
+    # 6. job.json — involved job(s): conditions + last events.
     if job_payload is None and clientset is not None:
         job_payload = job_snapshot(clientset, namespace, job_name)
     with open(os.path.join(path, "job.json"), "w") as f:
@@ -448,7 +590,7 @@ def _dump_bundle_inner(reason, directory, recorder, tracer, registry,
                  "dropped": recorder.dropped},
         "sidecar_records": len(sidecars),
         "artifacts": ["flight.jsonl", "events.jsonl", "trace.json",
-                      "metrics.prom", "job.json"],
+                      "critical_path.json", "metrics.prom", "job.json"],
     }
     with open(os.path.join(path, "MANIFEST.json"), "w") as f:
         json.dump(manifest, f, indent=2)

@@ -247,7 +247,19 @@ def instrument_step(step_fn: Callable, goodput: Optional[GoodputTracker]
             state["compiled"] = True
             if first:
                 out = _block(out)
-                goodput.add(COMPILE, goodput._clock() - start)
+                elapsed = goodput._clock() - start
+                goodput.add(COMPILE, elapsed)
+                # Causal-trace milestone: the first (tracing+compile)
+                # invocation, parented to the carried job context so
+                # compile seconds appear named in the bootstrap-path
+                # decomposition (telemetry/critical_path.py).
+                from .trace import default_tracer, env_context
+                ctx = env_context()
+                if ctx is not None:
+                    import time as _time
+                    default_tracer().emit("compile",
+                                          ts=_time.time() - elapsed,
+                                          dur=elapsed, ctx=ctx)
                 return out
             state["pending_steps"] += 1
             state["last_out"] = out

@@ -331,6 +331,72 @@ class Registry:
 
 _DEFAULT_REGISTRY = Registry()
 
+# Prometheus-convention process start (epoch seconds at interpreter
+# import of this module — close enough to exec for uptime math).
+_PROCESS_START_TIME = time.time()
+_build_info_lock = threading.Lock()
+_build_info_labels: Optional[tuple] = None
+_build_info_cache: Optional[dict] = None
+
+
+def _build_info_values() -> dict:
+    """version/git/jax identity, computed once per process (the git
+    subprocess probe must not run per component construction)."""
+    global _build_info_cache
+    if _build_info_cache is None:
+        try:
+            from .. import version
+            info = version.info()
+        except Exception:
+            info = {"version": "unknown", "gitSHA": "unknown"}
+        try:
+            import importlib.metadata
+            jax_version = importlib.metadata.version("jax")
+        except Exception:
+            jax_version = "unknown"
+        _build_info_cache = {"version": info.get("version", "unknown"),
+                             "git_sha": info.get("gitSHA", "unknown"),
+                             "jax": jax_version}
+    return _build_info_cache
+
+
+def record_build_info(shards: Optional[int] = None,
+                      registry: Optional[Registry] = None) -> None:
+    """Publish ``mpi_operator_build_info`` (version, git sha, jax
+    version, controller shard count) and
+    ``mpi_operator_process_start_time_seconds`` into the process
+    default registry — which :func:`expose_with_defaults` appends to
+    EVERY ``/metrics`` endpoint (operator, scheduler, inference server,
+    router), so one scrape identifies what is running where.
+
+    Components call this at construction; a later call with a concrete
+    ``shards`` (the controller learns it after the queue is built)
+    replaces the previous label set, keeping exactly one live series.
+    """
+    global _build_info_labels
+    reg = registry or _DEFAULT_REGISTRY
+    reg.gauge(
+        "mpi_operator_process_start_time_seconds",
+        "Epoch seconds this process started (Prometheus convention)"
+    ).set(_PROCESS_START_TIME)
+    vec = reg.gauge_vec(
+        "mpi_operator_build_info",
+        "Build identity of this process: operator version, git sha,"
+        " jax version, controller shard count (0 = no controller);"
+        " value is always 1",
+        ("version", "git_sha", "jax", "shards"))
+    info = _build_info_values()
+    with _build_info_lock:
+        prev = _build_info_labels
+        if shards is None and prev is not None:
+            shards = int(prev[3])  # keep the known shard count
+        labels = (info["version"], info["git_sha"], info["jax"],
+                  str(shards if shards is not None else 0))
+        if prev is not None and prev != labels:
+            vec.remove(*prev)
+        _build_info_labels = labels
+    vec.labels(*labels).set(1)
+
 
 def default_registry() -> Registry:
     """The process-wide registry for workload-side instrumentation

@@ -1,0 +1,570 @@
+"""Causal tracing + critical-path analyzer (ISSUE 11).
+
+Covers the propagation invariants end-to-end: context carriers
+(annotation/env/payload), explicit span parenting across async hops,
+the analyzer's DAG validation (zero orphans, no cycles) and telescoping
+decomposition, canonical-form determinism, the flight-ring drop
+accounting, and the build-info gauges.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+from mpi_operator_tpu.telemetry import critical_path as cp
+from mpi_operator_tpu.telemetry import flight
+from mpi_operator_tpu.telemetry.metrics import (Registry,
+                                                record_build_info)
+from mpi_operator_tpu.telemetry.trace import (TRACE_CONTEXT_ANNOTATION,
+                                              TRACE_CONTEXT_ENV,
+                                              TraceContext, Tracer,
+                                              default_tracer)
+
+
+# ---------------------------------------------------------------------------
+# TraceContext carrier
+# ---------------------------------------------------------------------------
+
+def test_trace_context_roundtrip():
+    ctx = TraceContext("job-default-x-abc123", 42)
+    assert TraceContext.decode(ctx.encode()) == ctx
+
+
+@pytest.mark.parametrize("raw", [None, "", "garbage", ":5", "id:",
+                                 "id:notanint", 7])
+def test_trace_context_decode_garbage_is_none(raw):
+    assert TraceContext.decode(raw) is None
+
+
+# ---------------------------------------------------------------------------
+# Tracer: explicit ctx + emit
+# ---------------------------------------------------------------------------
+
+def test_span_explicit_ctx_overrides_thread_local():
+    tr = Tracer()
+    ctx = TraceContext("t-1", 999)
+    with tr.span("outer"):
+        with tr.span("hop", ctx=ctx) as hop:
+            pass
+    assert hop["parent_id"] == 999
+    assert hop["trace_id"] == "t-1"
+
+
+def test_nested_span_inherits_trace_id():
+    tr = Tracer()
+    ctx = TraceContext("t-2", 7)
+    with tr.span("parent", ctx=ctx) as parent:
+        with tr.span("child") as child:
+            pass
+    assert child["trace_id"] == "t-2"
+    assert child["parent_id"] == parent["span_id"]
+
+
+def test_emit_retroactive_span():
+    tr = Tracer()
+    ctx = TraceContext("t-3", 1)
+    ev = tr.emit("queue_wait", ts=100.0, dur=0.5, ctx=ctx, job="a/b")
+    assert ev["ts"] == 100.0 and ev["dur"] == 0.5
+    assert ev["parent_id"] == 1 and ev["trace_id"] == "t-3"
+    assert tr.events()[-1] is ev
+
+
+def test_emit_with_reserved_id():
+    tr = Tracer()
+    rid = tr.allocate_id()
+    child = tr.emit("route", ts=1.0, dur=0.1,
+                    ctx=TraceContext("t", rid))
+    root = tr.emit("request", ts=1.0, dur=1.0, trace_id="t",
+                   span_id=rid)
+    spans = [e for e in tr.events() if e.get("trace_id") == "t"]
+    assert not cp.orphan_spans(spans)
+    assert child["parent_id"] == root["span_id"]
+
+
+# ---------------------------------------------------------------------------
+# Analyzer: DAG validation + decomposition
+# ---------------------------------------------------------------------------
+
+def _job_events(tid="job-default-j-xyz"):
+    """A synthetic full bootstrap-path trace."""
+    mk = lambda name, sid, parent, ts, dur: {  # noqa: E731
+        "name": name, "span_id": sid, "parent_id": parent,
+        "ts": ts, "dur": dur, "pid": 1, "tid": 1, "attrs": {},
+        "trace_id": tid}
+    return [
+        mk("job_submit", 1, None, 10.0, 0.0),
+        mk("queue_wait", 2, 1, 10.0, 0.1),
+        mk("reconcile", 3, 1, 10.1, 0.05),
+        mk("placement", 4, 1, 10.2, 0.01),
+        mk("admission", 5, 1, 10.0, 0.5),
+        mk("pod_start", 6, 1, 10.5, 0.7),
+        mk("distributed_init", 7, 1, 11.2, 0.4),
+        mk("compile", 8, 1, 11.6, 1.0),
+        mk("first_step", 9, 1, 12.6, 0.2),
+    ]
+
+
+def test_orphans_and_cycles():
+    events = _job_events()
+    assert cp.orphan_spans(events) == []
+    assert not cp.has_cycle(events)
+    events.append({"name": "stray", "span_id": 99, "parent_id": 1234,
+                   "ts": 0, "dur": 0, "attrs": {},
+                   "trace_id": events[0]["trace_id"]})
+    assert [s["span_id"] for s in cp.orphan_spans(events)] == [99]
+    loop = [{"name": "a", "span_id": 1, "parent_id": 2, "ts": 0,
+             "dur": 0}, {"name": "b", "span_id": 2, "parent_id": 1,
+                         "ts": 0, "dur": 0}]
+    assert cp.has_cycle(loop)
+
+
+def test_decomposition_telescopes_exactly():
+    events = _job_events()
+    d = cp.decompose(events)
+    assert d["kind"] == "job"
+    names = [s["name"] for s in d["segments"]]
+    assert names == ["queue_wait", "placement", "admission",
+                     "pod_start", "distributed_init", "compile",
+                     "first_step"]
+    ssum = sum(s["seconds"] for s in d["segments"])
+    assert ssum == pytest.approx(d["total_s"], abs=1e-12)
+    # Wall time = root start (10.0) -> first_step end (12.8).
+    assert d["total_s"] == pytest.approx(2.8)
+    assert d["critical_path"][0] == "job_submit"
+    assert d["critical_path"][-1] == "first_step"
+
+
+def test_decomposition_fallback_without_worker_spans():
+    events = [e for e in _job_events()
+              if e["name"] not in ("distributed_init", "compile",
+                                   "first_step")]
+    events.append({"name": "time_to_first_step", "span_id": 20,
+                   "parent_id": 1, "ts": 10.0, "dur": 1.5, "attrs": {},
+                   "trace_id": events[0]["trace_id"]})
+    d = cp.decompose(events)
+    assert [s["name"] for s in d["segments"]][-1] == "running"
+    assert d["total_s"] == pytest.approx(1.5)
+    assert "first_step" in d["missing_milestones"]
+
+
+def test_restart_episode_spans_do_not_contaminate_decomposition():
+    """A gang restart creates replacement pods (and second-incarnation
+    compile/first_step spans) long after the job's first step; those
+    later-episode spans must not drag a milestone past the terminal —
+    segments stay non-negative and the total stays first-episode."""
+    events = _job_events()
+    tid = events[0]["trace_id"]
+    # Replacement pod started 60s later + its second-life milestones.
+    for i, (name, ts, dur) in enumerate((("pod_start", 70.0, 1.0),
+                                         ("compile", 72.0, 0.5),
+                                         ("first_step", 72.5, 0.1))):
+        events.append({"name": name, "span_id": 100 + i, "parent_id": 1,
+                       "ts": ts, "dur": dur, "attrs": {},
+                       "trace_id": tid})
+    d = cp.decompose(events)
+    assert d["total_s"] == pytest.approx(2.8)  # first episode only
+    assert all(seg["seconds"] >= 0 for seg in d["segments"])
+
+
+def test_canonical_invariant_under_ids_and_repeats():
+    events = _job_events()
+    base = cp.canonical_bytes(events)
+    # Renumber every span id and repeat a hop: structure unchanged.
+    shifted = []
+    for e in events:
+        e2 = dict(e)
+        e2["span_id"] += 1000
+        if e2["parent_id"] is not None:
+            e2["parent_id"] += 1000
+        e2["ts"] += 55.5
+        shifted.append(e2)
+    extra = dict(shifted[1])  # second queue_wait (another reconcile)
+    extra["span_id"] += 1
+    shifted.append(extra)
+    assert cp.canonical_bytes(shifted) == base
+
+
+def test_find_trace_by_job_name_prefers_newest():
+    old = _job_events("job-default-j-aaaa")
+    new = [dict(e, ts=e["ts"] + 100,
+                span_id=e["span_id"] + 50,
+                parent_id=None if e["parent_id"] is None
+                else e["parent_id"] + 50,
+                trace_id="job-default-j-bbbb") for e in old]
+    assert cp.find_trace(old + new, "j") == "job-default-j-bbbb"
+    assert cp.find_trace(old + new, "nope") is None
+    # Pre-grouped dict input is accepted (the CLI's one-pass path).
+    assert cp.find_trace(cp.traces(old + new), "j") == \
+        "job-default-j-bbbb"
+
+
+def test_find_trace_never_matches_suffixed_sibling_job():
+    """Querying job "train" must not resolve to job "train-2"'s trace
+    even when train-2 is newer — the uid token is exactly one '-'-free
+    suffix."""
+    train = _job_events("job-default-train-aaaa1111")
+    sibling = [dict(e, ts=e["ts"] + 100, span_id=e["span_id"] + 50,
+                    parent_id=None if e["parent_id"] is None
+                    else e["parent_id"] + 50,
+                    trace_id="job-default-train-2-bbbb2222")
+               for e in train]
+    assert cp.find_trace(train + sibling, "train") == \
+        "job-default-train-aaaa1111"
+    assert cp.find_trace(train + sibling, "train-2") == \
+        "job-default-train-2-bbbb2222"
+
+
+# ---------------------------------------------------------------------------
+# Carrier chain units: apiserver stamp -> builders -> env
+# ---------------------------------------------------------------------------
+
+def _job(name="t"):
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.defaults import set_defaults_mpijob
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec,
+                                            ReplicaSpec)
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+    return set_defaults_mpijob(MPIJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="l", image="local")]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="w", image="local")]))),
+            })))
+
+
+def test_apiserver_stamps_context_and_emits_root():
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+
+    client = Clientset()
+    before = len(default_tracer().events())
+    created = client.mpi_jobs("default").create(_job("stamped"))
+    raw = created.metadata.annotations[TRACE_CONTEXT_ANNOTATION]
+    ctx = TraceContext.decode(raw)
+    assert ctx is not None
+    assert ctx.trace_id.startswith("job-default-stamped-")
+    roots = [e for e in default_tracer().events()[before:]
+             if e["name"] == "job_submit"
+             and e.get("trace_id") == ctx.trace_id]
+    assert len(roots) == 1 and roots[0]["span_id"] == ctx.span_id
+
+
+def test_builders_propagate_context_to_pods():
+    from mpi_operator_tpu.controller import builders
+
+    job = _job("prop")
+    ctx = "job-default-prop-abc:123"
+    job.metadata.annotations[TRACE_CONTEXT_ANNOTATION] = ctx
+    pod = builders.new_worker(job, 0)
+    assert pod.metadata.annotations[TRACE_CONTEXT_ANNOTATION] == ctx
+    env = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert env[TRACE_CONTEXT_ENV] == ctx
+    launcher = builders.new_launcher_pod_template(job)
+    assert launcher.metadata.annotations[TRACE_CONTEXT_ANNOTATION] == ctx
+    lenv = {e.name: e.value
+            for e in launcher.spec.containers[0].env}
+    assert lenv[TRACE_CONTEXT_ENV] == ctx
+    # Without a carried context, nothing is injected.
+    bare = builders.new_worker(_job("bare"), 0)
+    assert TRACE_CONTEXT_ANNOTATION not in bare.metadata.annotations
+    assert all(e.name != TRACE_CONTEXT_ENV
+               for e in bare.spec.containers[0].env)
+
+
+def test_env_context_reads_environment(monkeypatch):
+    from mpi_operator_tpu.telemetry.trace import env_context
+
+    monkeypatch.delenv(TRACE_CONTEXT_ENV, raising=False)
+    assert env_context() is None
+    monkeypatch.setenv(TRACE_CONTEXT_ENV, "tid-x:77")
+    assert env_context() == TraceContext("tid-x", 77)
+
+
+# ---------------------------------------------------------------------------
+# Replica-side spans (batcher _Request) + router injection
+# ---------------------------------------------------------------------------
+
+def test_request_first_token_emits_replica_spans():
+    from mpi_operator_tpu.serving.batcher import _Request
+    from mpi_operator_tpu.telemetry.metrics import new_serving_metrics
+
+    tm = new_serving_metrics(Registry())
+    ctx = TraceContext("req-1-1", 5)
+    before = len(default_tracer().events())
+    req = _Request([1, 2, 3], 4, metrics=tm,
+                   submitted_at=time.perf_counter() - 0.2,
+                   trace_ctx=ctx, submitted_wall=time.time() - 0.2)
+    req.admitted_at = time.perf_counter() - 0.1
+    req.emit(42)
+    req.emit(43)  # only the FIRST token emits trace spans
+    new = [e for e in default_tracer().events()[before:]
+           if e.get("trace_id") == "req-1-1"]
+    names = sorted(e["name"] for e in new)
+    assert names == ["prefill", "serve_queue_wait"]
+    assert all(e["parent_id"] == 5 for e in new)
+    qw = next(e for e in new if e["name"] == "serve_queue_wait")
+    pf = next(e for e in new if e["name"] == "prefill")
+    assert qw["dur"] == pytest.approx(0.1, abs=0.05)
+    assert pf["ts"] == pytest.approx(qw["ts"] + qw["dur"], abs=1e-6)
+
+
+def test_router_traces_request_against_stub_replica():
+    """A stub HTTP replica (no jax): the router must inject the
+    trace_context into the upstream payload and emit a complete,
+    orphan-free request trace."""
+    import http.client
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from mpi_operator_tpu.serving.router import FleetRouter
+
+    seen = {}
+
+    class Stub(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/fleet-state":
+                self._send({"healthy": True, "queue_depth": 0,
+                            "active_slots": 0, "slots": 2,
+                            "page_size": 0, "prefix_digests": []})
+            else:
+                self._send({"status": "ok"})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            seen["trace_context"] = req.get("trace_context")
+            self._send({"tokens": [[1, 2]]})
+
+    stub = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    port = stub.server_address[1]
+    threading.Thread(target=stub.serve_forever, daemon=True).start()
+    router = FleetRouter(policy="round_robin").start()
+    try:
+        router.add_replica("stub", f"http://127.0.0.1:{port}")
+        before = len(default_tracer().events())
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=10)
+        conn.request("POST", "/generate",
+                     body=json.dumps({"tokens": [[1, 2, 3]]}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        json.loads(resp.read())
+        conn.close()
+    finally:
+        router.stop()
+        stub.shutdown()
+        stub.server_close()
+    ctx = TraceContext.decode(seen["trace_context"])
+    assert ctx is not None and ctx.trace_id.startswith("req-")
+    spans = [e for e in default_tracer().events()[before:]
+             if e.get("trace_id") == ctx.trace_id]
+    names = sorted(e["name"] for e in spans)
+    assert names == ["request", "request_ttft", "route"]
+    assert not cp.orphan_spans(spans)
+    d = cp.decompose(spans)
+    ssum = sum(s["seconds"] for s in d["segments"])
+    assert ssum == pytest.approx(d["total_s"], abs=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Seeded one-job e2e: zero orphans, no cycles, telescoping sum
+# ---------------------------------------------------------------------------
+
+WORKER_SCRIPT = textwrap.dedent("""\
+    import os, sys, time
+    from mpi_operator_tpu.telemetry import flight
+    from mpi_operator_tpu.telemetry.trace import default_tracer, env_context
+    ctx = env_context()
+    if ctx is None:
+        sys.exit(7)
+    tracer = default_tracer()
+    t0 = time.time(); time.sleep(0.02)
+    tracer.emit("distributed_init", ts=t0, dur=time.time() - t0, ctx=ctx)
+    t1 = time.time(); time.sleep(0.02)
+    tracer.emit("compile", ts=t1, dur=time.time() - t1, ctx=ctx)
+    t2 = time.time(); time.sleep(0.01)
+    tracer.emit("first_step", ts=t2, dur=time.time() - t2, ctx=ctx)
+    flight.export_sidecar()
+    time.sleep(4)
+""")
+
+
+def test_one_job_causal_chain_end_to_end(tmp_path, monkeypatch):
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec,
+                                            ReplicaSpec, RunPolicy)
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    from mpi_operator_tpu.server import LocalCluster
+
+    monkeypatch.setenv("MPI_OPERATOR_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("MPI_OPERATOR_DEBUG_DIR", str(tmp_path))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("PYTHONPATH", repo + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+    t_start = time.time()
+
+    job = MPIJob(
+        metadata=ObjectMeta(name="tracee2e", namespace="default"),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(clean_pod_policy="Running"),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="l", image="local",
+                                  command=[sys.executable, "-c",
+                                           "import time;"
+                                           " time.sleep(1.5)"])]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="w", image="local",
+                                  command=[sys.executable, "-c",
+                                           WORKER_SCRIPT])]))),
+            }))
+    with LocalCluster() as cluster:
+        cluster.submit(job)
+        cluster.wait_for_condition("default", "tracee2e",
+                                   constants.JOB_SUCCEEDED, timeout=45)
+        time.sleep(0.3)
+
+    events = [e for e in cp.collect_events(sidecar_dir=str(tmp_path))
+              if e.get("ts", 0.0) >= t_start]
+    tid = cp.find_trace(events, "tracee2e")
+    assert tid is not None
+    spans = cp.traces(events)[tid]
+    assert cp.orphan_spans(spans) == []
+    assert not cp.has_cycle(spans)
+    names = {s["name"] for s in spans}
+    for required in ("job_submit", "queue_wait", "pod_start",
+                     "distributed_init", "compile", "first_step",
+                     "time_to_first_step"):
+        assert required in names, (required, sorted(names))
+    d = cp.decompose(spans)
+    ssum = sum(s["seconds"] for s in d["segments"])
+    assert ssum == pytest.approx(d["total_s"], abs=1e-9)
+    # Independent wall recomputation: root start -> first_step end.
+    wall = max(s["ts"] + s["dur"] for s in spans
+               if s["name"] == "first_step") - d["t0"]
+    assert abs(ssum - wall) <= 0.05 * wall
+
+    # The CLI verb renders it from the same sources.
+    from mpi_operator_tpu.__main__ import main as cli_main
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert cli_main(["trace", "tracee2e"]) == 0
+    assert "first_step" in buf.getvalue()
+    assert "SEGMENT" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Flight ring drop accounting + bundle artifact
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_wrap_counts_drops(tmp_path):
+    from mpi_operator_tpu.telemetry.metrics import default_registry
+
+    rec = flight.FlightRecorder(max_records=4)
+    for i in range(4):
+        rec.record("other", "fill", i=i)
+    counter = default_registry().get(
+        "mpi_operator_flight_records_dropped_total")
+    before = counter.value if counter is not None else 0.0
+    for i in range(3):
+        rec.record("other", "overflow", i=i)
+    counter = default_registry().get(
+        "mpi_operator_flight_records_dropped_total")
+    assert counter is not None
+    assert counter.value == before + 3
+    assert rec.dropped == 3
+    # Export header carries the same accounting.
+    path = tmp_path / "flight.jsonl"
+    rec.export_jsonl(str(path))
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[0]["kind"] == "flight_header"
+    assert lines[0]["data"]["dropped"] == 3
+    assert lines[0]["data"]["total"] == 7
+    assert lines[0]["data"]["retained"] == 4
+
+
+def test_bundle_contains_critical_path(tmp_path):
+    tracer = default_tracer()
+    before = len(tracer.events())
+    for e in _job_events("job-default-bundlejob-feed1"):
+        tracer.emit(e["name"], ts=e["ts"], dur=e["dur"],
+                    trace_id=e["trace_id"], span_id=e["span_id"],
+                    parent_id=e["parent_id"])
+    del before
+    path = flight.dump_bundle("cp-unit", directory=str(tmp_path),
+                              recorder=flight.FlightRecorder(),
+                              registry=Registry(),
+                              include_sidecars=False)
+    payload = json.load(open(os.path.join(path, "critical_path.json")))
+    assert "job-default-bundlejob-feed1" in payload
+    d = payload["job-default-bundlejob-feed1"]
+    assert [s["name"] for s in d["segments"]][-1] == "first_step"
+    manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert "critical_path.json" in manifest["artifacts"]
+
+
+def test_merged_trace_links_causal_flows():
+    tr = Tracer()
+    root = tr.emit("job_submit", ts=1.0, dur=0.0, trace_id="t-flow")
+    tr.emit("pod_start", ts=1.5, dur=0.5,
+            ctx=TraceContext("t-flow", root["span_id"]))
+    trace = flight.merged_chrome_trace(tr.events(), [])
+    flows = [e for e in trace["traceEvents"] if e.get("cat") == "trace"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert len(flows) == 2
+
+
+# ---------------------------------------------------------------------------
+# Build info
+# ---------------------------------------------------------------------------
+
+def test_build_info_on_default_exposition():
+    from mpi_operator_tpu.telemetry.metrics import expose_with_defaults
+
+    record_build_info(shards=4)
+    text = expose_with_defaults(None)
+    assert "mpi_operator_build_info{" in text
+    assert 'shards="4"' in text
+    assert "mpi_operator_process_start_time_seconds" in text
+    # A later call with a different shard count replaces the series.
+    record_build_info(shards=8)
+    text = expose_with_defaults(None)
+    assert 'shards="8"' in text
+    assert 'shards="4"' not in text

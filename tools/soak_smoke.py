@@ -99,6 +99,20 @@ def check_card(card, label: str) -> list:
     missing = card.missing()
     if missing:
         problems.append(f"{label}: unpopulated SLO fields {missing}")
+    # Causal-trace SLOs (ISSUE 11): the bootstrap path and the request
+    # path must both have produced traces — an unpopulated field means
+    # context propagation broke somewhere in the carrier chain.
+    if card.ttfs_p99_s is None:
+        problems.append(f"{label}: ttfs_p99_s unpopulated (no"
+                        f" time_to_first_step traces)")
+    if card.traced_ttft_p99_s is None:
+        problems.append(f"{label}: traced_ttft_p99_s unpopulated (no"
+                        f" request traces)")
+    segs = (card.detail.get("trace_segments") or {})
+    if "job" not in segs:
+        problems.append(f"{label}: no job-trace segment attribution")
+    if "request" not in segs:
+        problems.append(f"{label}: no request-trace segment attribution")
     if card.invariant_violations:
         problems.append(f"{label}: {card.invariant_violations} invariant"
                         f" violations")
@@ -160,7 +174,9 @@ def main() -> int:
           f" (goodput={card1.train_goodput_pct:.1f}%,"
           f" ttft_p99={card1.serve_ttft_p99_s:.3f}s,"
           f" reconcile_p99={card1.reconcile_p99_s:.4f}s,"
-          f" admission_p99={card1.admission_p99_s:.2f}s),"
+          f" admission_p99={card1.admission_p99_s:.2f}s,"
+          f" ttfs_p99={card1.ttfs_p99_s:.2f}s,"
+          f" traced_ttft_p99={card1.traced_ttft_p99_s:.3f}s),"
           f" 0 violations, 0 lost, 1+1 restarts recovered,"
           f" bundle lanes complete, canonical log byte-identical")
     return 0

@@ -1,0 +1,313 @@
+#!/usr/bin/env python
+"""Causal-tracing smoke (`make trace-smoke`, < 60s): the full carrier
+chain asserted end-to-end, twice, byte-stably.
+
+Scenario A (job): a LocalCluster with the gang scheduler admits a
+1-worker MPIJob through a ClusterQueue.  The worker pod reads the trace
+context the controller injected into its env, emits the in-pod
+milestones (distributed_init, compile, first_step) and exports its
+flight sidecar — exactly the contract parallel/train.run_train_loop and
+bootstrap/distributed.initialize_from_env implement for real
+workloads.  Asserts: the trace carries EVERY bootstrap milestone
+(queue_wait, placement, admission, pod_start, distributed_init,
+compile, first_step), zero orphan spans, no cycles, and the
+critical-path decomposition's segments sum to the measured
+create→first-step wall time within 5% (they telescope, so the sum is
+exact by construction — the 5% check runs against an INDEPENDENT
+recomputation from the raw span events).
+
+Scenario B (request): one `POST /generate` through the fleet router to
+a tiny-llama replica.  Asserts the request trace (route →
+serve_queue_wait → prefill → request_ttft) with the same invariants.
+
+Both scenarios run TWICE; the canonical timestamp-free trace
+(telemetry/critical_path.canonical_bytes: structural edges + segment
+order, ids/timestamps stripped) must be byte-identical across runs —
+the same determinism bar as obs-smoke/chaos-smoke.
+
+Exit 0 = chains complete, invariants green, canonical traces stable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+JOB_NAME = "trace-smoke"
+JOB_MILESTONES = ("queue_wait", "placement", "admission", "pod_start",
+                  "distributed_init", "compile", "first_step")
+REQUEST_MILESTONES = ("route", "serve_queue_wait", "prefill",
+                      "request_ttft")
+
+# The worker is the in-pod end of the carrier chain: context from
+# $MPI_OPERATOR_TRACE_CONTEXT, milestones emitted with the same span
+# names the real train loop uses, ring exported as a flight sidecar.
+WORKER_SCRIPT = textwrap.dedent("""\
+    import os, sys, time
+    from mpi_operator_tpu.telemetry import flight
+    from mpi_operator_tpu.telemetry.trace import default_tracer, env_context
+    ctx = env_context()
+    if ctx is None:
+        sys.exit(7)  # no carried context: the chain is broken
+    tracer = default_tracer()
+    t0 = time.time(); time.sleep(0.05)
+    tracer.emit("distributed_init", ts=t0, dur=time.time() - t0, ctx=ctx)
+    t1 = time.time(); time.sleep(0.08)
+    tracer.emit("compile", ts=t1, dur=time.time() - t1, ctx=ctx)
+    t2 = time.time(); time.sleep(0.02)
+    tracer.emit("first_step", ts=t2, dur=time.time() - t2, ctx=ctx,
+                step=1)
+    flight.export_sidecar()
+    time.sleep(5)
+""")
+
+
+def smoke_job():
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec,
+                                            ReplicaSpec, RunPolicy)
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+    return MPIJob(
+        metadata=ObjectMeta(
+            name=JOB_NAME, namespace="default",
+            labels={constants.QUEUE_NAME_LABEL: "q-smoke"}),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(clean_pod_policy="Running"),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="launcher", image="local",
+                                  command=[sys.executable, "-c",
+                                           "import time; time.sleep(2)"]
+                                  )]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="worker", image="local",
+                                  command=[sys.executable, "-c",
+                                           WORKER_SCRIPT])]))),
+            }))
+
+
+def run_job_scenario(workdir: str) -> list:
+    """One job through the queue-gated cluster; returns this run's
+    trace spans."""
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    from mpi_operator_tpu.sched.api import (ClusterQueue,
+                                            ClusterQueueSpec, LocalQueue,
+                                            LocalQueueSpec)
+    from mpi_operator_tpu.sched.capacity import TpuSlice
+    from mpi_operator_tpu.server import LocalCluster
+    from mpi_operator_tpu.telemetry import critical_path as cp
+
+    os.makedirs(workdir, exist_ok=True)
+    os.environ["MPI_OPERATOR_DEBUG_DIR"] = workdir
+    os.environ["MPI_OPERATOR_FLIGHT_DIR"] = workdir
+    os.environ["PYTHONPATH"] = REPO + os.pathsep + \
+        os.environ.get("PYTHONPATH", "")
+    t_start = time.time()
+
+    with LocalCluster(sched_slices=[TpuSlice("slice-0", 8)]) as cluster:
+        cluster.client.cluster_queues("default").create(ClusterQueue(
+            metadata=ObjectMeta(name="cq-smoke", namespace="default"),
+            spec=ClusterQueueSpec(
+                quotas={constants.TPU_RESOURCE: "8"})))
+        cluster.client.local_queues("default").create(LocalQueue(
+            metadata=ObjectMeta(name="q-smoke", namespace="default"),
+            spec=LocalQueueSpec(cluster_queue="cq-smoke")))
+        cluster.submit(smoke_job())
+        cluster.wait_for_condition("default", JOB_NAME,
+                                   constants.JOB_SUCCEEDED, timeout=45)
+        time.sleep(0.5)  # let the last status syncs land
+
+    events = [e for e in cp.collect_events(sidecar_dir=workdir)
+              if e.get("ts", 0.0) >= t_start]
+    trace_id = cp.find_trace(events, JOB_NAME)
+    if trace_id is None:
+        raise AssertionError("job trace not found")
+    return cp.traces(events)[trace_id]
+
+
+def run_request_scenario(factory) -> list:
+    """One routed /generate against a tiny-llama replica; returns the
+    request's trace spans."""
+    import http.client
+
+    from mpi_operator_tpu.serving.router import FleetRouter
+    from mpi_operator_tpu.telemetry import critical_path as cp
+
+    t_start = time.time()
+    server = factory(None).start()
+    router = FleetRouter(policy="prefix").start()
+    try:
+        router.add_replica("r0", server.url)
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=60)
+        body = json.dumps({"tokens": [list(range(1, 40))],
+                           "max_new_tokens": 6}).encode()
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        if resp.status != 200 or len(out["tokens"][0]) != 6:
+            raise AssertionError(f"generate failed: {resp.status} {out}")
+        time.sleep(0.3)
+    finally:
+        router.stop()
+        server.stop()
+    events = [e for e in cp.collect_events(sidecar_dir="/nonexistent")
+              if e.get("ts", 0.0) >= t_start]
+    req_ids = sorted(t for t in cp.traces(events)
+                     if t.startswith("req-"))
+    if not req_ids:
+        raise AssertionError("request trace not found")
+    return cp.traces(events)[req_ids[-1]]
+
+
+def check_trace(spans: list, kind: str, milestones: tuple) -> list:
+    from mpi_operator_tpu.telemetry import critical_path as cp
+
+    problems = []
+    names = {s["name"] for s in spans}
+    for name in milestones:
+        if name not in names:
+            problems.append(f"{kind}: milestone span {name!r} missing"
+                            f" (have {sorted(names)})")
+    orphans = cp.orphan_spans(spans)
+    if orphans:
+        problems.append(f"{kind}: {len(orphans)} orphan span(s):"
+                        f" {[s['name'] for s in orphans]}")
+    if cp.has_cycle(spans):
+        problems.append(f"{kind}: span DAG has a cycle")
+    decomp = cp.decompose(spans)
+    if decomp is None:
+        return problems + [f"{kind}: no recognizable root span"]
+    ssum = sum(seg["seconds"] for seg in decomp["segments"])
+    if abs(ssum - decomp["total_s"]) > 1e-9:
+        problems.append(f"{kind}: segments sum {ssum} != total"
+                        f" {decomp['total_s']}")
+    # Independent wall-time recomputation straight from the raw span
+    # events (root start -> terminal milestone end), the 5% acceptance
+    # bound of ISSUE 11.
+    root = cp.JOB_ROOT if kind == "job" else cp.REQUEST_ROOT
+    terminal = "first_step" if kind == "job" else "request_ttft"
+    t0 = min(s["ts"] for s in spans if s["name"] == root)
+    t_end = max(s["ts"] + s["dur"] for s in spans
+                if s["name"] == terminal)
+    wall = t_end - t0
+    if wall > 0 and abs(ssum - wall) / wall > 0.05:
+        problems.append(f"{kind}: decomposition {ssum:.4f}s vs measured"
+                        f" wall {wall:.4f}s (> 5% off)")
+    return problems
+
+
+def check_cli(spans_unused) -> list:
+    """The `trace` verb renders the job decomposition from the
+    in-process tracer."""
+    from mpi_operator_tpu.__main__ import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["trace", JOB_NAME])
+    out = buf.getvalue()
+    problems = []
+    if rc != 0:
+        problems.append(f"trace verb exited {rc}")
+    for needle in ("SEGMENT", "first_step", "sum"):
+        if needle not in out:
+            problems.append(f"trace verb output missing {needle!r}")
+    return problems
+
+
+def check_bundle_artifact(workdir: str) -> list:
+    """A bundle cut now must carry critical_path.json with the job's
+    decomposition."""
+    from mpi_operator_tpu.telemetry import flight
+
+    path = flight.dump_bundle("trace-smoke", directory=workdir)
+    if path is None:
+        return ["bundle dump failed"]
+    cp_path = os.path.join(path, "critical_path.json")
+    if not os.path.isfile(cp_path):
+        return ["bundle missing critical_path.json"]
+    payload = json.load(open(cp_path))
+    jobs = [tid for tid in payload
+            if tid.startswith(f"job-default-{JOB_NAME}")]
+    if not jobs:
+        return [f"critical_path.json has no {JOB_NAME} trace"
+                f" (traces: {sorted(payload)[:6]})"]
+    segs = [s["name"] for s in payload[jobs[-1]]["segments"]]
+    if "first_step" not in segs:
+        return [f"bundle decomposition missing first_step: {segs}"]
+    return []
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    from mpi_operator_tpu.soak.replicas import tiny_llama_server_factory
+    from mpi_operator_tpu.telemetry import critical_path as cp
+
+    base = tempfile.mkdtemp(prefix="trace-smoke-")
+    factory = tiny_llama_server_factory(replicas=1, slots=2, tenants=2,
+                                        prefix_tokens=32, max_new=8)
+    problems = []
+
+    print("trace-smoke: run 1 (job + request causal chains)...",
+          flush=True)
+    job1 = run_job_scenario(os.path.join(base, "run1"))
+    req1 = run_request_scenario(factory)
+    problems += check_trace(job1, "job", JOB_MILESTONES)
+    problems += check_trace(req1, "request", REQUEST_MILESTONES)
+    problems += check_cli(job1)
+    problems += check_bundle_artifact(os.path.join(base, "run1"))
+
+    print("trace-smoke: run 2 (canonical byte-stability)...", flush=True)
+    job2 = run_job_scenario(os.path.join(base, "run2"))
+    req2 = run_request_scenario(factory)
+    problems += check_trace(job2, "job", JOB_MILESTONES)
+    problems += check_trace(req2, "request", REQUEST_MILESTONES)
+
+    for kind, a, b in (("job", job1, job2), ("request", req1, req2)):
+        ca, cb = cp.canonical_bytes(a), cp.canonical_bytes(b)
+        if ca != cb:
+            problems.append(
+                f"{kind}: canonical traces differ across identical"
+                f" runs:\n  run1: {ca.decode()}\n  run2: {cb.decode()}")
+
+    elapsed = time.perf_counter() - t0
+    if problems:
+        print(f"trace-smoke: FAIL ({elapsed:.1f}s)")
+        for p in problems:
+            print(f"  - {p}")
+        print(f"  (artifacts kept under {base})")
+        return 1
+    d = cp.decompose(job1)
+    print(f"trace-smoke: PASS in {elapsed:.1f}s — full causal chain"
+          f" ({' -> '.join(seg['name'] for seg in d['segments'])}),"
+          f" 0 orphans, decomposition sums exactly to"
+          f" {d['total_s']:.3f}s wall, canonical traces byte-identical"
+          f" across runs")
+    shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
