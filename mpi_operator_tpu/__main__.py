@@ -65,31 +65,12 @@ def cmd_operator(args, extra) -> int:
 
 
 def _parse_slices(spec: str):
-    """'--slices 2x256,1x64:spot' -> TpuSlice list (N slices of C chips;
-    ':spot' marks the group preemptible/reclaimable)."""
-    from .sched import TpuSlice
-    slices = []
-    for group_index, group in enumerate(s for s in spec.split(",") if s):
-        body, _, flag = group.partition(":")
-        count, sep, chips = body.partition("x")
-        spot = flag.strip().lower() == "spot"
-        try:
-            if not sep:
-                raise ValueError("missing 'x'")
-            if flag and not spot:
-                raise ValueError(f"unknown flag {flag!r}")
-            n, c = int(count), int(chips)
-            if n <= 0 or c <= 0:
-                raise ValueError("N and CHIPS must be positive")
-        except ValueError:
-            raise ValueError(
-                f"invalid --slices group {group!r}: expected N x CHIPS"
-                f" like '2x256' or '1x64:spot'") from None
-        for i in range(n):
-            prefix = "spot" if spot else "slice"
-            slices.append(TpuSlice(name=f"{prefix}-{group_index}-{i}",
-                                   chips=c, spot=spot))
-    return slices
+    """'--slices 2x256,1x8x8:spot' -> TpuSlice list: 'NxCHIPS' (derived
+    near-square torus) or 'NxD1xD2[xD3]' (explicit torus shape);
+    ':spot' marks the group preemptible/reclaimable
+    (sched.api.parse_slices_spec, docs/SCHEDULING.md)."""
+    from .sched.api import parse_slices_spec
+    return parse_slices_spec(spec)
 
 
 def cmd_cluster(args) -> int:
@@ -496,7 +477,60 @@ def cmd_queues(args) -> int:
               f"{pending.get(cq.metadata.name, 0):>7} "
               f"{admitted.get(cq.metadata.name, 0):>8} "
               f"{_age(cq.metadata.creation_timestamp):>6}")
+    _print_gang_placements(client, args.namespace)
     return 0
+
+
+def _print_gang_placements(client, namespace) -> None:
+    """Per-gang placement table under `queues`: the torus shape each
+    admitted gang landed on and the scheduler's predicted per-step
+    collective cost — read straight from the placement/cost annotations
+    (docs/SCHEDULING.md "Topology-aware placement")."""
+    import json
+    from .api import constants as api_constants
+    from .sched.api import job_queue_name
+    from .sched.topology import decode_placement, placement_shape_summary
+
+    rows = []
+    for job in client.mpi_jobs(namespace).list():
+        if not job_queue_name(job):
+            continue
+        annotations = job.metadata.annotations or {}
+        slices = annotations.get(api_constants.SCHED_SLICES_ANNOTATION)
+        if slices is None:
+            continue
+        shape = "-"
+        blocks = decode_placement(annotations.get(
+            api_constants.SCHED_PLACEMENT_ANNOTATION, ""))
+        if blocks:
+            shape = placement_shape_summary(blocks)
+        # Annotations are user-tamperable input: anything malformed
+        # renders as-is instead of crashing the verb.
+        cost = "-"
+        raw_cost = annotations.get(api_constants.SCHED_COST_ANNOTATION)
+        if raw_cost:
+            try:
+                costs = json.loads(raw_cost)
+                cost = f"{costs.get('hier_us', 0.0):.0f}us"
+                if costs.get("flat_us") and costs.get("hier_us"):
+                    cost += f" (flat {costs['flat_us']:.0f}us)"
+            except (ValueError, TypeError, AttributeError):
+                cost = raw_cost
+        chips = 0
+        for part in slices.split(","):
+            try:
+                chips += int(part.partition(":")[2] or 0)
+            except ValueError:
+                continue
+        rows.append((job.metadata.name, chips,
+                     len([p for p in slices.split(",") if p]),
+                     shape, cost))
+    if not rows:
+        return
+    print(f"\n{'GANG':24} {'CHIPS':>6} {'SLICES':>6} {'SHAPE':16} "
+          f"PREDICTED-COST")
+    for name, chips, nslices, shape, cost in sorted(rows):
+        print(f"{name:24} {chips:>6} {nslices:>6} {shape:16} {cost}")
 
 
 def cmd_debug_bundle(args) -> int:
@@ -553,6 +587,27 @@ def cmd_trace(args) -> int:
               file=sys.stderr)
         return 1
     print(cp.render(decomp))
+    # Placement quality detail: the placement span carries the torus
+    # shape the gang landed on and its predicted per-step collective
+    # cost (docs/SCHEDULING.md "Topology-aware placement").  A
+    # preempted-and-re-admitted gang emits one placement span per
+    # admission — the LAST one is the current placement.
+    placement_attrs = None
+    for span in spans:
+        if span.get("name") != "placement":
+            continue
+        attrs = span.get("attrs") or {}
+        if attrs.get("shape") or attrs.get("cost_us") is not None:
+            placement_attrs = attrs
+    if placement_attrs is not None:
+        detail = f"placement: shape {placement_attrs.get('shape', '-')}"
+        if placement_attrs.get("cost_us") is not None:
+            detail += (f", predicted cost"
+                       f" {placement_attrs['cost_us']:.0f}us"
+                       f"/step (hierarchical)")
+        if placement_attrs.get("flat_cost_us") is not None:
+            detail += f", flat {placement_attrs['flat_cost_us']:.0f}us"
+        print(detail)
     orphans = cp.orphan_spans(spans)
     if orphans:
         print(f"warning: {len(orphans)} orphan span(s) — parents"
@@ -604,8 +659,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("cluster", help="all-in-one local cluster")
     p.add_argument("--port", type=int, default=8001)
     p.add_argument("--slices", default="",
-                   help="TPU slice capacity enabling the gang scheduler,"
-                        " e.g. '2x256,1x64:spot' (docs/SCHEDULING.md)")
+                   help="TPU slice capacity enabling the gang scheduler:"
+                        " NxCHIPS ('2x256') or torus shapes NxD1xD2[xD3]"
+                        " ('2x4x4', '1x8x8:spot') — docs/SCHEDULING.md")
 
     p = sub.add_parser("validate",
                        help="strict-validate an MPIJob yaml against the CRD")

@@ -160,6 +160,23 @@ SCHED_PRIORITY_ANNOTATION = "scheduling.kubeflow.org/priority"
 # ("slice-a:256,slice-b:128") and whether the job jumped a blocked gang.
 SCHED_SLICES_ANNOTATION = "scheduling.kubeflow.org/slices"
 SCHED_BACKFILL_ANNOTATION = "scheduling.kubeflow.org/backfilled"
+# Topology refinement of the slices annotation, written together with
+# it: the exact torus-coordinate blocks each slice contributed
+# ("slice-a=0.0/16x16;slice-b=0.0/8x8" — sched/topology.py wire
+# format) and the predicted per-step collective cost of that placement
+# ('{"flat_us": ..., "hier_us": ...}').  A restarted scheduler restores
+# the IDENTICAL chip coordinates (and therefore the identical predicted
+# cost) from these via SlicePool.place_exact (docs/SCHEDULING.md
+# "Topology-aware placement").
+SCHED_PLACEMENT_ANNOTATION = "scheduling.kubeflow.org/placement"
+SCHED_COST_ANNOTATION = "scheduling.kubeflow.org/placement-cost"
+# Worker-pod topology surface (controller/builders.py injects these so
+# the in-pod workload can build a slice-aware mesh — reduce-scatter
+# over ICI within its slice, cross-slice collectives over DCN).
+PLACEMENT_ENV = "MPI_OPERATOR_PLACEMENT"
+SLICE_NAME_ENV = "MPI_OPERATOR_SLICE"
+CHIP_COORDS_ENV = "MPI_OPERATOR_CHIP_COORDS"
+NUM_SLICES_ENV = "MPI_OPERATOR_NUM_SLICES"
 # Written on a capacity-blocked gang while the backfill reservation
 # fence is armed for it: the chips accrued to its reservation so far.
 # A restarted scheduler rebuilds the fence from this (the apiserver is

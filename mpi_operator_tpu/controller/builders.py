@@ -145,6 +145,40 @@ def propagate_trace_context(job: MPIJob, annotations: dict,
         container.env.append(EnvVar(TRACE_CONTEXT_ENV, raw))
 
 
+def propagate_placement(job: MPIJob, annotations: dict, container,
+                        worker_index: int) -> None:
+    """Surface the gang scheduler's torus placement to the worker pod:
+    the placement annotations ride onto the pod, and the container env
+    gets the full placement plus THIS worker's slice + chip coordinate
+    (worker i owns chips [i*slots, (i+1)*slots) of the placement in
+    canonical order).  The in-pod workload uses these to build a
+    slice-aware mesh — intra-slice axes over ICI, cross-slice over DCN
+    (parallel/mesh.py, docs/SCHEDULING.md "Topology-aware placement").
+    No-op for jobs the scheduler did not place."""
+    raw = (job.metadata.annotations or {}).get(
+        constants.SCHED_PLACEMENT_ANNOTATION)
+    if not raw:
+        return
+    from ..sched.topology import chip_of_index, decode_placement
+    placement = decode_placement(raw)
+    if not placement:
+        return
+    annotations.setdefault(constants.SCHED_PLACEMENT_ANNOTATION, raw)
+    existing = {e.name for e in container.env}
+    slots = job.spec.slots_per_worker or 1
+    located = chip_of_index(placement, worker_index * slots)
+    pairs = [(constants.PLACEMENT_ENV, raw),
+             (constants.NUM_SLICES_ENV, str(len(placement)))]
+    if located is not None:
+        slice_name, coord = located
+        pairs += [(constants.SLICE_NAME_ENV, slice_name),
+                  (constants.CHIP_COORDS_ENV,
+                   ".".join(str(c) for c in coord))]
+    for name, value in pairs:
+        if name not in existing:
+            container.env.append(EnvVar(name, value))
+
+
 def is_jax(job: MPIJob) -> bool:
     return job.spec.mpi_implementation == constants.IMPL_JAX
 
@@ -429,6 +463,7 @@ def new_worker(job: MPIJob, index: int, pod_group_ctrl=None,
 
     annotations = dict(template.metadata.annotations)
     propagate_trace_context(job, annotations, container)
+    propagate_placement(job, annotations, container, index)
 
     return Pod(
         metadata=ObjectMeta(

@@ -107,6 +107,48 @@ def create_multislice_mesh(config: Optional[MeshConfig] = None,
     return Mesh(arr.reshape(shape), AXIS_NAMES)
 
 
+def placement_from_env():
+    """The gang scheduler's topology surface, as injected into worker
+    pods by controller/builders.propagate_placement: returns
+    ``{"placement": {slice: [Block, ...]}, "num_slices": int,
+    "slice": str|None, "coords": tuple|None}`` or None when this
+    process runs outside a scheduler-placed gang.  ``num_slices`` is
+    the natural argument for :func:`create_multislice_mesh` (and > 1
+    means ``build_train_step(hierarchical_allreduce=True)`` has a DCN
+    tier to win on)."""
+    import os
+
+    from ..api import constants
+    from ..sched.topology import decode_placement
+
+    raw = os.environ.get(constants.PLACEMENT_ENV)
+    if not raw:
+        return None
+    placement = decode_placement(raw)
+    if not placement:
+        return None
+    coords_raw = os.environ.get(constants.CHIP_COORDS_ENV, "")
+    coords = None
+    if coords_raw:
+        try:
+            coords = tuple(int(v) for v in coords_raw.split("."))
+        except ValueError:
+            coords = None
+    # NUM_SLICES_ENV is the authoritative injected value (also the
+    # surface non-Python workloads read without a placement decoder);
+    # the decoded placement is the fallback.
+    try:
+        num_slices = int(os.environ.get(constants.NUM_SLICES_ENV, ""))
+    except ValueError:
+        num_slices = len(placement)
+    return {
+        "placement": placement,
+        "num_slices": num_slices,
+        "slice": os.environ.get(constants.SLICE_NAME_ENV) or None,
+        "coords": coords,
+    }
+
+
 def batch_sharding(mesh, extra_dims: int = 1):
     """NamedSharding for [batch, ...]: batch over (dp, fsdp), rest
     replicated (activations within a layer get their own constraints)."""

@@ -47,6 +47,8 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
                      remat: bool = False,
                      accum_steps: int = 1,
                      shard_update: bool = False,
+                     hierarchical_allreduce: bool = False,
+                     ici_axis: str = "fsdp",
                      goodput=None,
                      telemetry_registry=None,
                      sync_every: Optional[int] = None):
@@ -81,6 +83,24 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
       their base sharding.  A 1-sized dp axis degenerates to the plain
       replicated update.
 
+    - hierarchical_allreduce: the MLPerf TPU-pod gradient schedule for
+      bandwidth-asymmetric hierarchies (arXiv:1909.09756,
+      arXiv:1802.05799; docs/PERF.md "Hierarchical collectives").  The
+      mesh convention puts ``dp`` across slices (DCN) and ``ici_axis``
+      (default ``fsdp``) within a slice (ICI) — parallel/mesh.py.
+      Instead of allreducing the full gradient across both tiers, the
+      gradients are constrained onto an ``ici_axis``-sharded layout
+      FIRST: XLA lowers the cross-replica reduction as a reduce-scatter
+      over the fast intra-slice tier, an allreduce of only the
+      1/ici-sized shard across slices over DCN, and an allgather back
+      over ICI — the slow tier is crossed exactly once with 1/n of the
+      bytes.  Composes with ``shard_update`` (the ZeRO update then
+      consumes the ICI-sharded gradients directly) and is numerically
+      equivalent to the flat schedule up to f32 reassociation
+      (allclose-asserted in tests; the step-time win is priced by the
+      sched/topology.py cost model and proven in bench_topo.py).  A
+      1-sized ``ici_axis`` degenerates to the flat schedule.
+
     - goodput / telemetry_registry: when either is set, the returned
       step_fn is wrapped by telemetry.goodput.instrument_step — async
       dispatch with a sliding goodput sync every ``sync_every`` steps
@@ -101,34 +121,49 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
 
     dp_size = mesh.shape.get("dp", 1)
     zero = shard_update and dp_size > 1
+    ici_size = mesh.shape.get(ici_axis, 1)
+    hier = hierarchical_allreduce and ici_size > 1
 
     def _spec_axes(entry):
         if entry is None:
             return ()
         return entry if isinstance(entry, tuple) else (entry,)
 
+    def _base_specs(params):
+        if param_specs is not None:
+            return param_specs
+        return jax.tree_util.tree_map(lambda p: P(), params)
+
+    def _graft_spec(shape, base_spec, axis, size):
+        """Base spec with ``axis`` grafted onto the first free
+        dimension divisible by ``size``; base unchanged when no
+        dimension qualifies or the axis already appears."""
+        base = tuple(base_spec) if base_spec is not None else ()
+        base = base + (None,) * (len(shape) - len(base))
+        used = {n for e in base for n in _spec_axes(e)}
+        if axis not in used:
+            for d, dim in enumerate(shape):
+                if base[d] is None and dim > 0 and dim % size == 0:
+                    base = base[:d] + (axis,) + base[d + 1:]
+                    break
+        return P(*base)
+
     def _zero_spec(shape, base_spec):
         """Base spec with 'dp' grafted onto the first free dimension
         divisible by dp (the ZeRO shard axis); base unchanged when no
         dimension qualifies or dp already appears."""
-        base = tuple(base_spec) if base_spec is not None else ()
-        base = base + (None,) * (len(shape) - len(base))
-        used = {n for e in base for n in _spec_axes(e)}
-        if "dp" not in used:
-            for d, size in enumerate(shape):
-                if base[d] is None and size > 0 and size % dp_size == 0:
-                    base = base[:d] + ("dp",) + base[d + 1:]
-                    break
-        return P(*base)
+        return _graft_spec(shape, base_spec, "dp", dp_size)
+
+    def _hier_spec(shape, base_spec):
+        """Base spec with the intra-slice axis grafted (the
+        hierarchical reduce-scatter layout)."""
+        return _graft_spec(shape, base_spec, ici_axis, ici_size)
 
     def _zero_plan(params):
         """(param zero specs, base specs, shape->zero spec map for
         optimizer-state leaves).  Computed from shapes only, so it works
         identically on concrete arrays (init) and tracers (step)."""
-        if param_specs is not None:
-            base_specs = param_specs
-        else:
-            base_specs = jax.tree_util.tree_map(lambda p: P(), params)
+        base_specs = _base_specs(params)
         zspecs = jax.tree_util.tree_map(
             lambda p, s: _zero_spec(p.shape, s), params, base_specs)
         # Optimizer-state leaves are matched to their param's zero spec
@@ -242,6 +277,21 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
             loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         else:
             loss, grads = _accumulate(state.params, batch)
+        if hier:
+            # Hierarchical allreduce: land the cross-replica gradient
+            # reduction on an ICI-sharded layout, so the partitioner
+            # emits reduce-scatter(ICI) + allreduce(DCN, 1/ici shard)
+            # instead of a flat allreduce whose full payload crosses
+            # the slow tier.  Non-ZeRO steps gather the shards back to
+            # the base layout for the replicated update; the ZeRO path
+            # re-shards onto dp below and keeps the update sharded.
+            base_specs = _base_specs(state.params)
+            hspecs = jax.tree_util.tree_map(
+                lambda p, s: _hier_spec(p.shape, s),
+                state.params, base_specs)
+            grads = _constrain(grads, hspecs)
+            if not zero:
+                grads = _constrain(grads, base_specs)
         if zero:
             # ZeRO-style sharded update: reduce-scatter the (already
             # dp-reduced) grads and the params onto their dp shards,

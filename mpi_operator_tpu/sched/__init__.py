@@ -13,15 +13,24 @@ capacity model — all-or-nothing, never a partial gang.
 from .api import (SCHED_GROUP_VERSION, ClusterQueue, ClusterQueueSpec,
                   ClusterQueueStatus, LocalQueue, LocalQueueSpec,
                   LocalQueueStatus, job_priority, job_queue_name,
-                  set_defaults_clusterqueue, set_defaults_localqueue,
-                  validate_clusterqueue, validate_localqueue)
+                  parse_slices_spec, set_defaults_clusterqueue,
+                  set_defaults_localqueue, validate_clusterqueue,
+                  validate_localqueue)
 from .capacity import SlicePool, TpuSlice
 from .scheduler import GangScheduler, job_demand
+from .topology import (Block, CostModel, TorusView, decode_placement,
+                       default_topology, encode_placement,
+                       format_topology, parse_topology,
+                       placement_shape_summary)
 
 __all__ = [
-    "SCHED_GROUP_VERSION", "ClusterQueue", "ClusterQueueSpec",
-    "ClusterQueueStatus", "LocalQueue", "LocalQueueSpec", "LocalQueueStatus",
-    "GangScheduler", "SlicePool", "TpuSlice", "job_demand", "job_priority",
-    "job_queue_name", "set_defaults_clusterqueue", "set_defaults_localqueue",
-    "validate_clusterqueue", "validate_localqueue",
+    "SCHED_GROUP_VERSION", "Block", "ClusterQueue", "ClusterQueueSpec",
+    "ClusterQueueStatus", "CostModel", "GangScheduler", "LocalQueue",
+    "LocalQueueSpec", "LocalQueueStatus", "SlicePool", "TorusView",
+    "TpuSlice", "decode_placement", "default_topology",
+    "encode_placement", "format_topology", "job_demand", "job_priority",
+    "job_queue_name", "parse_slices_spec", "parse_topology",
+    "placement_shape_summary", "set_defaults_clusterqueue",
+    "set_defaults_localqueue", "validate_clusterqueue",
+    "validate_localqueue",
 ]

@@ -144,6 +144,66 @@ def validate_localqueue(lq: LocalQueue) -> list:
 
 
 # ---------------------------------------------------------------------------
+# Slice-capacity grammar (the `cluster --slices` surface)
+# ---------------------------------------------------------------------------
+
+
+def parse_slices_spec(spec: str) -> list:
+    """Parse a slice-capacity spec into a TpuSlice list.
+
+    Comma-separated groups, each either the chip-count form
+    ``NxCHIPS`` (N slices of CHIPS chips, near-square 2D torus derived)
+    or the topology form ``NxD1xD2[xD3]`` (N slices shaped as a
+    D1 x D2 [x D3] torus); ``:spot`` marks the group
+    preemptible/reclaimable.  Examples: ``2x256``, ``2x4x4``,
+    ``1x8x8:spot``, ``2x256,1x64:spot``.  Strict: anything else raises
+    a ValueError naming the grammar.
+    """
+    from .topology import format_topology, parse_topology
+    from .capacity import TpuSlice
+
+    def bad(group, why):
+        return ValueError(
+            f"invalid --slices group {group!r}: {why}; expected"
+            f" N x CHIPS like '2x256', N x D1 x D2 [x D3] like"
+            f" '2x4x4', optionally ':spot' like '1x64:spot'")
+
+    slices = []
+    for group_index, group in enumerate(s for s in spec.split(",") if s):
+        body, _, flag = group.partition(":")
+        spot = flag.strip().lower() == "spot"
+        if flag and not spot:
+            raise bad(group, f"unknown flag {flag!r}")
+        parts = body.split("x")
+        if len(parts) < 2:
+            raise bad(group, "missing 'x'")
+        if len(parts) > 4:
+            raise bad(group, "too many dims (2D/3D tori only)")
+        try:
+            numbers = [int(p) for p in parts]
+        except ValueError:
+            raise bad(group, "non-integer field") from None
+        if any(n <= 0 for n in numbers):
+            raise bad(group, "N, CHIPS and dims must be positive")
+        count = numbers[0]
+        if len(numbers) == 2:
+            chips, topology = numbers[1], ""
+        else:
+            dims = tuple(numbers[1:])
+            topology = format_topology(dims)
+            parse_topology(topology)  # normalizes/validates
+            chips = 1
+            for d in dims:
+                chips *= d
+        prefix = "spot" if spot else "slice"
+        for i in range(count):
+            slices.append(TpuSlice(name=f"{prefix}-{group_index}-{i}",
+                                   chips=chips, spot=spot,
+                                   topology=topology))
+    return slices
+
+
+# ---------------------------------------------------------------------------
 # Job-side helpers
 # ---------------------------------------------------------------------------
 

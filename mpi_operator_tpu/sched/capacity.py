@@ -1,12 +1,22 @@
 """TPU slice capacity model.
 
-A cluster is a pool of :class:`TpuSlice`\\ s (a pod-slice of ``chips``
-chips, optionally ``spot``).  Placement is ALL-OR-NOTHING: a gang's
-chip demand either fits across the online slices (greedy, most-free
-first — jobs span slices exactly the way multislice training spans
-DCN) and the whole placement is recorded, or nothing is placed.  There
-is no partial state to leak, which is what makes the
+A cluster is a pool of :class:`TpuSlice`\\ s — each a 2D/3D **torus** of
+``chips`` chips (``topology`` "16x16", "4x4x4"; derived near-square 2D
+when not declared), optionally ``spot``.  Placement is ALL-OR-NOTHING:
+a gang's chip demand either fits across the online slices and the whole
+placement (down to per-chip torus coordinates) is recorded, or nothing
+is placed.  There is no partial state to leak, which is what makes the
 ``sched_no_partial_gangs`` chaos invariant checkable.
+
+Placement is topology-aware by default (``policy="topo"``): candidate
+plans — aligned sub-torus on each single slice that fits, an aligned
+spanning plan, and the topology-blind greedy scan plan — are priced by
+the ICI/DCN collective cost model (sched/topology.py) and the cheapest
+wins, with deterministic tie-breaking (predicted cost, fewest slices,
+best-fit/fullest slices, names).  Because the greedy plan is always a
+candidate, the placer never produces a higher-cost placement than
+``policy="greedy"`` (the most-free-first baseline benches compare
+against) on the same pool state.
 
 Spot reclamation drains a slice: ``set_offline`` removes its capacity
 from future placement (the scheduler then evicts the placements still
@@ -19,22 +29,53 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from .topology import (Block, CostModel, DEFAULT_COST_MODEL, Shape,
+                       TorusView, default_topology, format_topology,
+                       fragmentation, parse_topology)
+
 
 @dataclass(frozen=True)
 class TpuSlice:
     name: str
     chips: int
     spot: bool = False
+    # Torus shape ("16x16", "4x4x4"); "" derives a near-square 2D shape
+    # from ``chips`` (back-compat with pre-topology constructions).
+    topology: str = ""
+
+    def shape(self) -> Shape:
+        if self.topology:
+            return parse_topology(self.topology)
+        return default_topology(self.chips)
 
 
 class SlicePool:
-    def __init__(self, slices: List[TpuSlice]):
+    def __init__(self, slices: List[TpuSlice], policy: str = "topo",
+                 cost_model: Optional[CostModel] = None):
         if len({s.name for s in slices}) != len(slices):
             raise ValueError("duplicate slice names")
+        if policy not in ("topo", "greedy"):
+            raise ValueError(f"unknown placement policy {policy!r}"
+                             " (want 'topo' or 'greedy')")
+        for s in slices:
+            shape = s.shape()
+            declared = 1
+            for d in shape:
+                declared *= d
+            if declared != s.chips:
+                raise ValueError(
+                    f"slice {s.name!r}: topology"
+                    f" {format_topology(shape)} has {declared} chips,"
+                    f" not {s.chips}")
+        self.policy = policy
+        self.cost_model = cost_model or DEFAULT_COST_MODEL
         self._slices: Dict[str, TpuSlice] = {s.name: s for s in slices}
-        self._free: Dict[str, int] = {s.name: s.chips for s in slices}
-        # job key -> {slice name: chips held}
+        self._views: Dict[str, TorusView] = {
+            s.name: TorusView(s.shape()) for s in slices}
+        # job key -> {slice name: chips held} and the chip-coordinate
+        # blocks behind those counts.
         self._placements: Dict[str, Dict[str, int]] = {}
+        self._blocks: Dict[str, Dict[str, List[Block]]] = {}
         self._offline: set = set()
         self._lock = threading.Lock()
 
@@ -48,7 +89,7 @@ class SlicePool:
     @property
     def free_chips(self) -> int:
         with self._lock:
-            return sum(f for n, f in self._free.items()
+            return sum(v.free for n, v in self._views.items()
                        if n not in self._offline)
 
     @property
@@ -63,10 +104,47 @@ class SlicePool:
         with self._lock:
             return sorted(self._offline)
 
+    def slice_shapes(self) -> Dict[str, Shape]:
+        with self._lock:
+            return {n: v.shape for n, v in self._views.items()}
+
     def placement_of(self, key: str) -> Optional[Dict[str, int]]:
         with self._lock:
             placed = self._placements.get(key)
             return dict(placed) if placed is not None else None
+
+    def placement_blocks(self, key: str) \
+            -> Optional[Dict[str, List[Block]]]:
+        """The per-chip torus coordinates behind a placement
+        ({slice: [Block, ...]}), or None when the key is unplaced."""
+        with self._lock:
+            blocks = self._blocks.get(key)
+            if blocks is None:
+                return None
+            return {n: list(bs) for n, bs in blocks.items()}
+
+    def predicted_cost_us(self, key: str, hierarchical: bool = True,
+                          payload_bytes: Optional[int] = None) \
+            -> Optional[float]:
+        """One-allreduce cost (us) of a placement under the pool's cost
+        model — hierarchical (the shipped schedule) or flat."""
+        with self._lock:
+            blocks = self._blocks.get(key)
+            if blocks is None:
+                return None
+            shapes = {n: v.shape for n, v in self._views.items()}
+            return self.cost_model.collective_cost_us(
+                blocks, shapes, hierarchical=hierarchical,
+                payload_bytes=payload_bytes)
+
+    def predicted_costs(self, key: str) -> Optional[Dict[str, float]]:
+        """{"hier_us", "flat_us"} for a placement (annotation/flight
+        payload), or None when unplaced."""
+        hier = self.predicted_cost_us(key, hierarchical=True)
+        if hier is None:
+            return None
+        flat = self.predicted_cost_us(key, hierarchical=False)
+        return {"hier_us": round(hier, 1), "flat_us": round(flat, 1)}
 
     def online_chips_of(self, key: str) -> int:
         """Chips of a placement that would return to the USABLE pool on
@@ -84,44 +162,147 @@ class SlicePool:
         with self._lock:
             return sorted(self._placements)
 
+    # -- fragmentation observability --------------------------------------
+    def largest_free_block(self) -> int:
+        """Largest placeable contiguous gang: the biggest free aligned
+        sub-torus across online slices, in chips."""
+        with self._lock:
+            return max((v.largest_free_block()
+                        for n, v in self._views.items()
+                        if n not in self._offline), default=0)
+
+    def fragmentation(self) -> float:
+        """1 - largest-free-aligned-block / the largest block the same
+        per-slice free counts could hold unfragmented, over online
+        slices (0.0 = the biggest gang the free counts promise really
+        fits as one aligned sub-torus; ->1.0 = free chips exist but
+        alignment is gone)."""
+        with self._lock:
+            online = [v for n, v in self._views.items()
+                      if n not in self._offline]
+            largest = max((v.largest_free_block() for v in online),
+                          default=0)
+            ideal = max((v.ideal_largest_block() for v in online),
+                        default=0)
+            return fragmentation(largest, ideal)
+
     # -- placement ---------------------------------------------------------
+    def _plan_cost(self, plan: Dict[str, List[Block]]) -> float:
+        shapes = {n: v.shape for n, v in self._views.items()}
+        return self.cost_model.collective_cost_us(plan, shapes,
+                                                  hierarchical=True)
+
+    def _greedy_plan(self, chips: int) \
+            -> Optional[Dict[str, List[Block]]]:
+        """Most-free-first spanning plan with topology-blind scan-order
+        chips inside each slice — the baseline placement."""
+        online = [(n, self._views[n].free) for n in self._slices
+                  if n not in self._offline]
+        if sum(f for _, f in online) < chips:
+            return None
+        online.sort(key=lambda item: (-item[1], item[0]))
+        plan: Dict[str, List[Block]] = {}
+        remaining = chips
+        for name, free in online:
+            if remaining <= 0:
+                break
+            take = min(free, remaining)
+            if take > 0:
+                blocks = self._views[name].plan_scan(take)
+                if blocks is None:
+                    return None
+                plan[name] = blocks
+                remaining -= take
+        return plan if remaining == 0 else None
+
+    def _topo_candidates(self, chips: int) \
+            -> List[Dict[str, List[Block]]]:
+        candidates: List[Dict[str, List[Block]]] = []
+        online = [(n, self._views[n].free) for n in self._slices
+                  if n not in self._offline]
+        # Aligned single-slice plans for every slice that fits.
+        for name, free in sorted(online):
+            if free >= chips:
+                blocks = self._views[name].plan(chips)
+                if blocks is not None:
+                    candidates.append({name: blocks})
+        # Aligned spanning plan over the greedy slice set.
+        ordered = sorted(online, key=lambda item: (-item[1], item[0]))
+        if sum(f for _, f in ordered) >= chips:
+            plan: Dict[str, List[Block]] = {}
+            remaining = chips
+            for name, free in ordered:
+                if remaining <= 0:
+                    break
+                take = min(free, remaining)
+                if take > 0:
+                    blocks = self._views[name].plan(take)
+                    if blocks is None:
+                        plan = {}
+                        break
+                    plan[name] = blocks
+                    remaining -= take
+            if plan and remaining == 0:
+                candidates.append(plan)
+        return candidates
+
     def place(self, key: str, chips: int) -> Optional[Dict[str, int]]:
-        """All-or-nothing: claim ``chips`` across online slices (greedy,
-        most free chips first, name tie-break for determinism) or claim
-        NOTHING and return None.  Zero-chip demands still record an
-        (empty) placement so release stays symmetric."""
+        """All-or-nothing: claim ``chips`` across online slices or
+        claim NOTHING and return None.  ``policy="topo"`` prices every
+        candidate plan with the collective cost model and commits the
+        cheapest (ties: fewest slices, fullest/best-fit slices, names);
+        ``policy="greedy"`` commits the most-free-first scan plan
+        directly.  Zero-chip demands still record an (empty) placement
+        so release stays symmetric."""
         if chips < 0:
             raise ValueError("negative chip demand")
         with self._lock:
             if key in self._placements:
                 raise ValueError(f"job {key!r} already placed")
-            online = [(n, f) for n, f in self._free.items()
-                      if n not in self._offline]
-            if sum(f for _, f in online) < chips:
+            greedy = self._greedy_plan(chips)
+            if greedy is None:
                 return None
-            online.sort(key=lambda item: (-item[1], item[0]))
-            assignment: Dict[str, int] = {}
-            remaining = chips
-            for name, free in online:
-                if remaining <= 0:
-                    break
-                take = min(free, remaining)
-                if take > 0:
-                    assignment[name] = take
-                    remaining -= take
-            for name, take in assignment.items():
-                self._free[name] -= take
-            self._placements[key] = assignment
-            return dict(assignment)
+            chosen = greedy
+            if self.policy == "topo" and chips > 0:
+                candidates = self._topo_candidates(chips) + [greedy]
 
-    def place_exact(self, key: str,
-                    assignment: Dict[str, int]) -> Optional[Dict[str, int]]:
+                def rank(plan):
+                    names = tuple(sorted(plan))
+                    chosen_free = sum(self._views[n].free for n in names)
+                    return (round(self._plan_cost(plan), 6), len(names),
+                            chosen_free, names)
+
+                chosen = min(candidates, key=rank)
+            return self._commit(key, chosen)
+
+    def _commit(self, key: str,
+                plan: Dict[str, List[Block]]) -> Dict[str, int]:
+        assignment: Dict[str, int] = {}
+        for name, blocks in plan.items():
+            take = sum(b.chips for b in blocks)
+            if take > 0:
+                self._views[name].commit(blocks)
+                assignment[name] = take
+        self._placements[key] = assignment
+        self._blocks[key] = {n: list(bs) for n, bs in plan.items()
+                             if bs}
+        return dict(assignment)
+
+    def place_exact(self, key: str, assignment: Dict[str, int],
+                    blocks: Optional[Dict[str, List[Block]]] = None) \
+            -> Optional[Dict[str, int]]:
         """All-or-nothing claim of an EXACT per-slice assignment — the
         scheduler-restart adoption path, which must re-place a gang on
         the slices its pods actually occupy (recorded in the job's
-        slices annotation) instead of greedily re-deciding.  Returns
-        None (claiming nothing) when any named slice is unknown,
-        offline, or lacks the free chips."""
+        slices annotation) instead of greedily re-deciding.  When
+        ``blocks`` (the placement annotation's torus coordinates) is
+        given and consistent with ``assignment``, the EXACT chip
+        coordinates are restored too, so the rebuilt placement carries
+        the identical predicted collective cost; inconsistent or
+        occupied coordinates fall back to a deterministic aligned
+        re-plan of the same per-slice counts.  Returns None (claiming
+        nothing) when any named slice is unknown, offline, or lacks the
+        free chips."""
         with self._lock:
             if key in self._placements:
                 raise ValueError(f"job {key!r} already placed")
@@ -130,14 +311,41 @@ class SlicePool:
                     return None
                 if name not in self._slices or name in self._offline:
                     return None
-                if self._free[name] < take:
+                if self._views[name].free < take:
                     return None
-            claimed = {name: take for name, take in assignment.items()
-                       if take > 0}
-            for name, take in claimed.items():
-                self._free[name] -= take
-            self._placements[key] = claimed
-            return dict(claimed)
+            plan: Dict[str, List[Block]] = {}
+            for name, take in assignment.items():
+                if take <= 0:
+                    continue
+                view = self._views[name]
+                exact = (blocks or {}).get(name)
+                if exact is not None and self._blocks_valid(
+                        view, exact, take):
+                    plan[name] = list(exact)
+                    continue
+                replanned = view.plan(take)
+                if replanned is None:
+                    return None
+                plan[name] = replanned
+            return self._commit(key, plan)
+
+    @staticmethod
+    def _blocks_valid(view: TorusView, blocks: List[Block],
+                      take: int) -> bool:
+        if sum(b.chips for b in blocks) != take:
+            return False
+        seen: set = set()
+        for b in blocks:
+            if len(b.origin) != len(view.shape):
+                return False
+            if any(o + s > dim for o, s, dim
+                   in zip(b.origin, b.shape, view.shape)):
+                return False
+            for c in b.coords():
+                if c in seen:
+                    return False
+                seen.add(c)
+        return all(view.is_free(b) for b in blocks)
 
     def clear_placements(self) -> None:
         """Drop every placement, freeing all chips, while keeping slice
@@ -148,7 +356,9 @@ class SlicePool:
         apiserver."""
         with self._lock:
             self._placements.clear()
-            self._free = {s.name: s.chips for s in self._slices.values()}
+            self._blocks.clear()
+            for view in self._views.values():
+                view.reset()
 
     def release(self, key: str) -> int:
         """Release a placement; returns the chips that came back to the
@@ -159,12 +369,13 @@ class SlicePool:
         this return value)."""
         with self._lock:
             placed = self._placements.pop(key, None)
+            blocks = self._blocks.pop(key, None)
             if placed is None:
                 return 0
             returned = 0
             for name, take in placed.items():
                 if name in self._slices:
-                    self._free[name] += take
+                    self._views[name].release((blocks or {}).get(name, []))
                     if name not in self._offline:
                         returned += take
             return returned

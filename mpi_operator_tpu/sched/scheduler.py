@@ -112,6 +112,16 @@ def new_sched_metrics(registry: Optional[Registry] = None) -> dict:
             "mpi_operator_sched_backfill_denied_total",
             "Backfill candidates refused because only the blocked"
             " gang's reservation could have held them"),
+        "fragmentation": registry.gauge(
+            "mpi_operator_sched_fragmentation",
+            "Pool fragmentation: 1 - largest free aligned sub-torus /"
+            " largest block the per-slice free counts could hold"
+            " (0 = the biggest promised gang really fits contiguously)"),
+        "placement_cost": registry.histogram(
+            "mpi_operator_sched_placement_cost",
+            "Predicted per-step collective cost (seconds, hierarchical"
+            " schedule) of each admitted gang's placement under the"
+            " ICI/DCN latency model"),
     }
 
 
@@ -484,6 +494,19 @@ class GangScheduler:
             out[name] = chips
         return out
 
+    @staticmethod
+    def _recorded_blocks(job):
+        """The torus-coordinate blocks the admitting scheduler wrote
+        (``scheduling.kubeflow.org/placement``), or None when
+        absent/malformed — place_exact then re-plans coordinates from
+        the per-slice counts alone."""
+        from .topology import decode_placement
+        raw = (job.metadata.annotations or {}).get(
+            constants.SCHED_PLACEMENT_ANNOTATION)
+        if raw is None:
+            return None
+        return decode_placement(raw)
+
     def _adopt_admitted(self, jobs, lqs, cqs) -> None:
         """Re-place jobs already carrying Admitted=True that this
         scheduler instance does not know (restart resilience).
@@ -512,7 +535,9 @@ class GangScheduler:
                 recorded = self._recorded_placement(job)
                 if recorded is not None \
                         and sum(recorded.values()) == chips:
-                    placement = self.pool.place_exact(key, recorded)
+                    placement = self.pool.place_exact(
+                        key, recorded,
+                        blocks=self._recorded_blocks(job))
                 if placement is None:
                     placement = self.pool.place(key, chips)
             if placement is not None:
@@ -805,13 +830,21 @@ class GangScheduler:
                     # Causal-trace milestone: the placement decision
                     # itself (usually microseconds — its weight in the
                     # decomposition table proves placement is NOT where
-                    # admission latency hides).
+                    # admission latency hides).  The span carries the
+                    # decision's QUALITY too: the torus shape it chose
+                    # and the predicted per-step collective cost.
                     ctx = annotation_context(job)
                     if ctx is not None:
+                        from .topology import placement_shape_summary
+                        blocks = self.pool.placement_blocks(key) or {}
+                        costs = self.pool.predicted_costs(key) or {}
                         default_tracer().emit(
                             "placement", ts=place_t0,
                             dur=time.time() - place_t0, ctx=ctx,
-                            job=key, chips=chips)
+                            job=key, chips=chips,
+                            shape=placement_shape_summary(blocks),
+                            cost_us=costs.get("hier_us"),
+                            flat_cost_us=costs.get("flat_us"))
                 if placement is None:
                     # Capacity-blocked front (or a job outranking the
                     # current fence owner): arm — or take over — the
@@ -856,6 +889,9 @@ class GangScheduler:
 
     def _admit(self, job, cq, demand, chips, placement,
                path: str) -> None:
+        import json as _json
+
+        from .topology import encode_placement, placement_shape_summary
         key = self._key(job)
         self._epoch += 1
         self._admitted[key] = {
@@ -864,12 +900,21 @@ class GangScheduler:
             "name": job.metadata.name}
         slices = ",".join(f"{name}:{take}"
                           for name, take in sorted(placement.items()))
+        blocks = self.pool.placement_blocks(key) or {}
+        costs = self.pool.predicted_costs(key) or {}
+        shape = placement_shape_summary(blocks)
+        if costs.get("hier_us") is not None:
+            self.metrics["placement_cost"].observe(
+                costs["hier_us"] / 1e6)
         self._set_conditions(
             job.metadata.namespace, job.metadata.name, admitted=True,
             reason=MPI_JOB_ADMITTED_REASON,
             message=f"gang admitted by queue {job_queue_name(job)}"
-                    f" ({chips} chips on {slices or 'zero slices'})",
-            slices=slices, backfilled=(path == "backfill"))
+                    f" ({chips} chips on {slices or 'zero slices'},"
+                    f" shape {shape})",
+            slices=slices, backfilled=(path == "backfill"),
+            placement=encode_placement(blocks),
+            cost=_json.dumps(costs, sort_keys=True) if costs else "")
         created = job.metadata.creation_timestamp
         if created is not None:
             wait = (self.clock.now() - created).total_seconds()
@@ -886,9 +931,12 @@ class GangScheduler:
         self.metrics["admissions"].labels(path).inc()
         self.recorder.event(
             job, core.EVENT_TYPE_NORMAL, "GangAdmitted",
-            f"admitted via {path}: {chips} chips on [{slices}]")
+            f"admitted via {path}: {chips} chips on [{slices}]"
+            f" shape {shape}")
         flight.record("sched", "admitted", job=key, path=path,
-                      chips=chips, slices=slices)
+                      chips=chips, slices=slices, shape=shape,
+                      cost_us=costs.get("hier_us"),
+                      flat_cost_us=costs.get("flat_us"))
 
     # -- preemption --------------------------------------------------------
     def _maybe_preempt(self, jobs, lqs, cqs) -> None:
@@ -1046,7 +1094,8 @@ class GangScheduler:
 
     def _set_conditions(self, namespace: str, name: str, admitted: bool,
                         reason: str, message: str, slices: str = "",
-                        backfilled: bool = False) -> None:
+                        backfilled: bool = False, placement: str = "",
+                        cost: str = "") -> None:
         for _ in range(5):
             try:
                 job = self.client.mpi_jobs(namespace).get(name)
@@ -1065,6 +1114,16 @@ class GangScheduler:
             annotations = dict(job.metadata.annotations or {})
             if admitted:
                 annotations[constants.SCHED_SLICES_ANNOTATION] = slices
+                # The coordinate-level refinement + predicted cost ride
+                # along (empty values mean "no topology detail" and are
+                # simply not written).
+                for anno, value in (
+                        (constants.SCHED_PLACEMENT_ANNOTATION, placement),
+                        (constants.SCHED_COST_ANNOTATION, cost)):
+                    if value:
+                        annotations[anno] = value
+                    else:
+                        annotations.pop(anno, None)
                 # Admission consumes the fence: the earned reservation
                 # record must not survive into a later queued episode.
                 annotations.pop(constants.SCHED_RESERVATION_ANNOTATION,
@@ -1078,6 +1137,8 @@ class GangScheduler:
                                     None)
             else:
                 annotations.pop(constants.SCHED_SLICES_ANNOTATION, None)
+                annotations.pop(constants.SCHED_PLACEMENT_ANNOTATION, None)
+                annotations.pop(constants.SCHED_COST_ANNOTATION, None)
                 annotations.pop(constants.SCHED_BACKFILL_ANNOTATION, None)
             meta_changed = annotations != (job.metadata.annotations or {})
             if not changed and not meta_changed:
@@ -1139,6 +1200,7 @@ class GangScheduler:
             elif not is_finished(job.status):
                 pending_lq[lq_key] = pending_lq.get(lq_key, 0) + 1
         self.metrics["free_chips"].set(self.pool.free_chips)
+        self.metrics["fragmentation"].set(self.pool.fragmentation())
         for name, cq in cqs.items():
             self.metrics["pending"].labels(name).set(
                 pending_cq.get(name, 0))

@@ -1,0 +1,466 @@
+"""Topology-aware placement + hierarchical collectives tests
+(mpi_operator_tpu/sched/topology.py, capacity.py placer,
+parallel/train.py hierarchical_allreduce; docs/SCHEDULING.md
+"Topology-aware placement", docs/PERF.md "Hierarchical collectives"):
+torus shapes and the --slices grammar, aligned sub-torus allocation,
+the ICI/DCN cost model, placer quality (never worse than greedy,
+anti-fragmentation, byte-stable), coordinate-exact restart restore,
+the fragmentation/cost observability, worker-pod topology surfacing,
+and hierarchical-vs-flat allreduce numerics."""
+
+import json
+import random
+
+import pytest
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.sched import (Block, CostModel, GangScheduler,
+                                    SlicePool, TorusView, TpuSlice,
+                                    decode_placement, default_topology,
+                                    encode_placement, parse_slices_spec,
+                                    parse_topology,
+                                    placement_shape_summary)
+from mpi_operator_tpu.sched.topology import (chip_of_index,
+                                             intra_slice_hops)
+
+from test_sched import admitted_status, mk_job, mk_queues  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# Shapes + grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_topology_and_defaults():
+    assert parse_topology("4x4") == (4, 4)
+    assert parse_topology("2x4x4") == (2, 4, 4)
+    for bad in ("4", "4x4x4x4", "axb", "0x4", "4x-1"):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+    assert default_topology(256) == (16, 16)
+    assert default_topology(8) == (2, 4)
+    assert default_topology(7) == (1, 7)  # prime -> degenerate ring
+
+
+def test_slices_grammar_topology_and_back_compat():
+    # Back-compat NxCHIPS: derived near-square torus.
+    slices = parse_slices_spec("2x256,1x64:spot")
+    assert [(s.chips, s.spot, s.shape()) for s in slices] == [
+        (256, False, (16, 16)), (256, False, (16, 16)),
+        (64, True, (8, 8))]
+    # Topology form NxD1xD2[xD3].
+    slices = parse_slices_spec("2x4x4,1x8x8:spot,1x2x4x4")
+    assert [(s.chips, s.spot, s.topology) for s in slices] == [
+        (16, False, "4x4"), (16, False, "4x4"),
+        (64, True, "8x8"), (32, False, "2x4x4")]
+    # Strict errors name the grammar.
+    for bad in ("1x64:spott", "0x8", "1x-8", "2x0", "1x2x3x4x5",
+                "2x0x4", "8", "axb"):
+        with pytest.raises(ValueError, match="N x CHIPS"):
+            parse_slices_spec(bad)
+
+
+def test_slicepool_rejects_topology_chip_mismatch():
+    with pytest.raises(ValueError, match="topology"):
+        SlicePool([TpuSlice("a", 9, topology="4x4")])
+
+
+# ---------------------------------------------------------------------------
+# Torus allocation
+# ---------------------------------------------------------------------------
+
+def test_aligned_plan_prefers_compact_blocks():
+    view = TorusView((4, 4))
+    plan = view.plan(4)
+    assert [b.shape for b in plan] == [(2, 2)]
+    view.commit(plan)
+    # 9 has no aligned shape on 4x4 -> decomposes 8 + 1.
+    plan9 = view.plan(9)
+    assert sum(b.chips for b in plan9) == 9
+    assert [b.chips for b in plan9] == [8, 1]
+    # Over free claims nothing.
+    assert view.plan(13) is None
+
+
+def test_plan_scan_is_row_major_and_coalesces():
+    view = TorusView((4, 4))
+    # Fully-free slice: the whole scan region is ONE block, not a
+    # stack of stitched 1-wide rings.
+    assert view.plan_scan(16) == [Block((0, 0), (4, 4))]
+    assert view.plan_scan(8) == [Block((0, 0), (2, 4))]
+    # A hole breaks the run where it sits.
+    view.commit([Block((0, 1), (1, 1))])
+    plan = view.plan_scan(5)
+    assert plan[0] == Block((0, 0), (1, 1))
+    assert sum(b.chips for b in plan) == 5
+
+
+def test_largest_free_block_and_fragmentation():
+    pool = SlicePool([TpuSlice("a", 16, topology="4x4")])
+    assert pool.largest_free_block() == 16
+    assert pool.fragmentation() == 0.0
+    # Occupy one chip of every 2x2 quadrant: 12 chips free, every 2x2
+    # quadrant broken — the best aligned block left is a 1x4 row, not
+    # the 8-block the free count promises.
+    view = pool._views["a"]
+    view.commit([Block((0, 0), (1, 1)), Block((0, 2), (1, 1)),
+                 Block((2, 0), (1, 1)), Block((2, 2), (1, 1))])
+    assert pool.largest_free_block() == 4
+    assert pool.fragmentation() == 0.5  # 1 - 4/8
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+# ---------------------------------------------------------------------------
+
+def test_cost_model_hierarchical_beats_flat_multislice():
+    model = CostModel()
+    shapes = {"a": (4, 4), "b": (4, 4)}
+    multi = {"a": [Block((0, 0), (4, 4))], "b": [Block((0, 0), (4, 4))]}
+    flat = model.collective_cost_us(multi, shapes, hierarchical=False)
+    hier = model.collective_cost_us(multi, shapes, hierarchical=True)
+    assert flat / hier > 1.2  # the acceptance floor, by a wide margin
+    # Single slice: the tiers coincide.
+    single = {"a": [Block((0, 0), (4, 4))]}
+    assert model.collective_cost_us(single, shapes, True) \
+        == model.collective_cost_us(single, shapes, False)
+    # Degenerate gangs cost nothing.
+    one = {"a": [Block((0, 0), (1, 1))]}
+    assert model.collective_cost_us(one, shapes, True) == 0.0
+
+
+def test_cost_model_penalizes_fragmentation():
+    model = CostModel()
+    shapes = {"a": (8, 8)}
+    compact = {"a": [Block((0, 0), (2, 2))]}
+    scattered = {"a": [Block((0, 0), (1, 1)), Block((4, 4), (1, 1)),
+                       Block((7, 0), (1, 2))]}
+    assert model.collective_cost_us(scattered, shapes) \
+        > model.collective_cost_us(compact, shapes)
+    # The hop model behind it: stitching penalty per extra block.
+    assert intra_slice_hops((8, 8), scattered["a"]) \
+        > intra_slice_hops((8, 8), compact["a"])
+
+
+# ---------------------------------------------------------------------------
+# Placer quality
+# ---------------------------------------------------------------------------
+
+def test_placer_never_worse_than_greedy_seeded():
+    """Property: on ANY reachable pool state, the topo placer's chosen
+    plan costs no more than the greedy plan for the same demand (the
+    greedy plan is always a candidate)."""
+    rng = random.Random(20260805)
+    pool = SlicePool([TpuSlice(f"s{i}", 16, topology="4x4")
+                      for i in range(4)])
+    live = []
+    for op in range(200):
+        if live and rng.random() < 0.4:
+            pool.release(live.pop(rng.randrange(len(live))))
+            continue
+        chips = rng.choice([1, 2, 3, 4, 5, 8, 12, 16, 24, 32])
+        key = f"j{op}"
+        with pool._lock:
+            greedy_plan = pool._greedy_plan(chips)
+            greedy_cost = (pool._plan_cost(greedy_plan)
+                           if greedy_plan is not None else None)
+        placement = pool.place(key, chips)
+        if placement is None:
+            assert greedy_plan is None
+            continue
+        live.append(key)
+        topo_cost = pool.predicted_cost_us(key)
+        assert topo_cost <= greedy_cost + 1e-6, \
+            f"op {op}: topo {topo_cost} > greedy {greedy_cost}"
+
+
+def test_anti_fragmentation_regression():
+    """Interleaved admit/release: the worst-fit greedy walk splits the
+    pool so no whole-slice aligned sub-torus survives; the topo
+    placer's best-fit tie-break keeps one slice whole."""
+    def churn(pool):
+        for j in ("j1", "j2", "j3", "j4"):
+            pool.place(j, 4)
+        pool.release("j2")
+        pool.release("j3")
+
+    greedy = SlicePool([TpuSlice("a", 16, topology="4x4"),
+                        TpuSlice("b", 16, topology="4x4")],
+                       policy="greedy")
+    topo = SlicePool([TpuSlice("a", 16, topology="4x4"),
+                      TpuSlice("b", 16, topology="4x4")])
+    churn(greedy)
+    churn(topo)
+    # Topo packed everything onto one slice; greedy alternated
+    # most-free and fragmented both.
+    assert topo.largest_free_block() == 16
+    assert greedy.largest_free_block() < 16
+    # The aligned whole-slice gang still fits ON ONE SLICE under topo.
+    placed = topo.place("gang", 16)
+    assert placed is not None and len(placed) == 1
+    assert [b.shape for b in
+            topo.placement_blocks("gang")[next(iter(placed))]] \
+        == [(4, 4)]
+    # Greedy must span slices for the same gang (paying DCN).
+    placed_greedy = greedy.place("gang", 16)
+    assert placed_greedy is not None and len(placed_greedy) > 1
+
+
+def test_placement_deterministic_and_golden():
+    """Identical seeds -> byte-identical placements, and one pinned
+    golden so an accidental ordering change cannot hide."""
+    def run():
+        pool = SlicePool([TpuSlice("a", 16, topology="4x4"),
+                          TpuSlice("b", 16, topology="4x4")])
+        rng = random.Random(7)
+        out = []
+        live = []
+        for op in range(40):
+            if live and rng.random() < 0.5:
+                pool.release(live.pop(0))
+                continue
+            key = f"j{op}"
+            if pool.place(key, rng.choice([2, 4, 6, 8, 16])) is not None:
+                live.append(key)
+                out.append((key,
+                            encode_placement(
+                                pool.placement_blocks(key))))
+        return out
+
+    first, second = run(), run()
+    assert first == second
+    # Golden: the first placement of this seed is pinned.
+    pool = SlicePool([TpuSlice("a", 16, topology="4x4"),
+                      TpuSlice("b", 16, topology="4x4")])
+    pool.place("g", 6)
+    assert encode_placement(pool.placement_blocks("g")) \
+        == "a=0.0/2x2+0.2/1x2"
+
+
+# ---------------------------------------------------------------------------
+# Wire format + rank mapping
+# ---------------------------------------------------------------------------
+
+def test_encode_decode_placement_roundtrip_and_malformed():
+    placement = {"a": [Block((0, 0), (4, 4))],
+                 "b": [Block((2, 0), (2, 2)), Block((0, 0), (1, 2))]}
+    text = encode_placement(placement)
+    assert decode_placement(text) == placement
+    assert decode_placement("") == {}
+    for bad in ("a", "a=", "a=0.0", "a=0/2x2;", "a=x/2x2",
+                "a=0.0/2x0", "a=0.0.0/2x2", "a=-1.0/2x2"):
+        assert decode_placement(bad) is None, bad
+
+
+def test_placement_shape_summary_and_chip_of_index():
+    placement = {"a": [Block((0, 0), (4, 4))],
+                 "b": [Block((0, 0), (4, 4))]}
+    assert placement_shape_summary(placement) == "2x(4x4)"
+    assert placement_shape_summary(
+        {"a": [Block((0, 0), (2, 2)), Block((2, 2), (1, 2))]}) \
+        == "2x2+1x2"
+    # Rank -> chip mapping walks sorted slices, blocks, row-major.
+    assert chip_of_index(placement, 0) == ("a", (0, 0))
+    assert chip_of_index(placement, 15) == ("a", (3, 3))
+    assert chip_of_index(placement, 16) == ("b", (0, 0))
+    assert chip_of_index(placement, 32) is None
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration: annotations, metrics, restart exactness
+# ---------------------------------------------------------------------------
+
+def _torus_sched(cs, slices=2):
+    mk_queues(cs, quotas={})
+    pool = SlicePool([TpuSlice(f"s{i}", 16, topology="4x4")
+                      for i in range(slices)])
+    return GangScheduler(cs, pool), pool
+
+
+def test_admission_writes_placement_and_cost_annotations():
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    cs = Clientset()
+    sched, pool = _torus_sched(cs)
+    cs.mpi_jobs("default").create(mk_job("spanner", 23))  # 24 chips
+    assert sched.reconcile_once() == 1
+    job = cs.mpi_jobs("default").get("spanner")
+    annotations = job.metadata.annotations
+    blocks = decode_placement(
+        annotations[constants.SCHED_PLACEMENT_ANNOTATION])
+    assert blocks == pool.placement_blocks("default/spanner")
+    costs = json.loads(annotations[constants.SCHED_COST_ANNOTATION])
+    assert 0 < costs["hier_us"] < costs["flat_us"]
+    # Observability: gauge + histogram populated by the admission pass.
+    assert sched.metrics["placement_cost"].count == 1
+    assert sched.metrics["fragmentation"].value is not None
+    # Eviction-side hygiene: un-admission clears the topology detail.
+    sched._set_conditions("default", "spanner", admitted=False,
+                          reason="MPIJobQueued", message="test")
+    job = cs.mpi_jobs("default").get("spanner")
+    assert constants.SCHED_PLACEMENT_ANNOTATION \
+        not in job.metadata.annotations
+    assert constants.SCHED_COST_ANNOTATION \
+        not in job.metadata.annotations
+
+
+def test_restart_restores_exact_coordinates_and_cost():
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    cs = Clientset()
+    sched, pool = _torus_sched(cs)
+    cs.mpi_jobs("default").create(mk_job("gang", 7))  # 8 chips
+    sched.reconcile_once()
+    blocks = pool.placement_blocks("default/gang")
+    costs = pool.predicted_costs("default/gang")
+    pool.clear_placements()
+    sched2 = GangScheduler(cs, pool)
+    sched2.reconcile_once()
+    assert pool.placement_blocks("default/gang") == blocks
+    assert pool.predicted_costs("default/gang") == costs
+    assert sched2.metrics["admissions"].get("adopted") == 1
+
+
+def test_restart_tampered_placement_annotation_wins():
+    """The coordinate annotation is the source of truth: a restarted
+    scheduler re-places on EXACTLY the recorded (tampered) coordinates
+    — not what its own planner would re-derive — and the predicted
+    cost follows the annotation's placement."""
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    cs = Clientset()
+    sched, pool = _torus_sched(cs)
+    cs.mpi_jobs("default").create(mk_job("gang", 3))  # 4 chips
+    sched.reconcile_once()
+    planner_blocks = pool.placement_blocks("default/gang")
+    # Tamper: scatter the 4 chips across corners of slice s1 (valid,
+    # free, but NOT what any planner would choose).
+    tampered = {"s1": [Block((0, 0), (1, 1)), Block((0, 3), (1, 1)),
+                       Block((3, 0), (1, 1)), Block((3, 3), (1, 1))]}
+    stored = cs.mpi_jobs("default").get("gang")
+    stored.metadata.annotations[constants.SCHED_SLICES_ANNOTATION] = \
+        "s1:4"
+    stored.metadata.annotations[
+        constants.SCHED_PLACEMENT_ANNOTATION] = \
+        encode_placement(tampered)
+    cs.mpi_jobs("default").update(stored)
+
+    pool.clear_placements()
+    sched2 = GangScheduler(cs, pool)
+    sched2.reconcile_once()
+    assert pool.placement_blocks("default/gang") == tampered
+    assert pool.placement_blocks("default/gang") != planner_blocks
+    # The scattered placement predicts a strictly higher cost than the
+    # planner's aligned block — the cost follows the coordinates.
+    scattered_cost = pool.predicted_cost_us("default/gang")
+    pool.clear_placements()
+    pool.place_exact("default/gang", {"s1": 4})  # aligned re-plan
+    assert scattered_cost > pool.predicted_cost_us("default/gang")
+
+
+def test_restart_malformed_placement_annotation_falls_back():
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    cs = Clientset()
+    sched, pool = _torus_sched(cs)
+    cs.mpi_jobs("default").create(mk_job("gang", 3))
+    sched.reconcile_once()
+    placed = pool.placement_of("default/gang")
+    stored = cs.mpi_jobs("default").get("gang")
+    stored.metadata.annotations[
+        constants.SCHED_PLACEMENT_ANNOTATION] = "garbage=="
+    cs.mpi_jobs("default").update(stored)
+    pool.clear_placements()
+    sched2 = GangScheduler(cs, pool)
+    sched2.reconcile_once()
+    # Counts (the slices annotation) still restore exactly; the
+    # coordinates re-plan deterministically.
+    assert pool.placement_of("default/gang") == placed
+    assert admitted_status(cs, "gang") == "True"
+
+
+# ---------------------------------------------------------------------------
+# Worker-pod topology surface
+# ---------------------------------------------------------------------------
+
+def test_worker_pods_carry_topology_env():
+    from mpi_operator_tpu.controller import builders
+    job = mk_job("gang", 3)
+    placement = {"s0": [Block((0, 0), (2, 2))]}
+    job.metadata.annotations = dict(
+        job.metadata.annotations or {},
+        **{constants.SCHED_PLACEMENT_ANNOTATION:
+           encode_placement(placement)})
+    pod0 = builders.new_worker(job, 0)
+    pod2 = builders.new_worker(job, 2)
+    env0 = {e.name: e.value for e in pod0.spec.containers[0].env}
+    env2 = {e.name: e.value for e in pod2.spec.containers[0].env}
+    assert env0[constants.PLACEMENT_ENV] == encode_placement(placement)
+    assert env0[constants.NUM_SLICES_ENV] == "1"
+    assert env0[constants.SLICE_NAME_ENV] == "s0"
+    assert env0[constants.CHIP_COORDS_ENV] == "0.0"
+    assert env2[constants.CHIP_COORDS_ENV] == "1.0"  # row-major chip 2
+    assert pod0.metadata.annotations[
+        constants.SCHED_PLACEMENT_ANNOTATION] \
+        == encode_placement(placement)
+    # No placement -> no topology env (unmanaged jobs untouched).
+    plain = builders.new_worker(mk_job("plain", 1), 0)
+    assert constants.PLACEMENT_ENV not in {
+        e.name for e in plain.spec.containers[0].env}
+
+
+def test_placement_from_env(monkeypatch):
+    from mpi_operator_tpu.parallel.mesh import placement_from_env
+    placement = {"s0": [Block((0, 0), (2, 2))],
+                 "s1": [Block((0, 0), (2, 2))]}
+    monkeypatch.setenv(constants.PLACEMENT_ENV,
+                       encode_placement(placement))
+    monkeypatch.setenv(constants.SLICE_NAME_ENV, "s1")
+    monkeypatch.setenv(constants.CHIP_COORDS_ENV, "1.1")
+    got = placement_from_env()
+    assert got["num_slices"] == 2
+    assert got["slice"] == "s1"
+    assert got["coords"] == (1, 1)
+    assert got["placement"] == placement
+    monkeypatch.delenv(constants.PLACEMENT_ENV)
+    assert placement_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical allreduce numerics
+# ---------------------------------------------------------------------------
+
+def test_hierarchical_allreduce_allclose_to_flat():
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from mpi_operator_tpu.parallel.mesh import (MeshConfig,
+                                                create_multislice_mesh)
+    from mpi_operator_tpu.parallel.train import build_train_step
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    mesh = create_multislice_mesh(MeshConfig(dp=2, fsdp=4),
+                                  num_slices=2)
+    opt = optax.adam(1e-2)
+    rng = np.random.RandomState(0)
+    params0 = {"w": jnp.asarray(rng.randn(16, 8), jnp.float32),
+               "b": jnp.asarray(rng.randn(8), jnp.float32)}
+
+    def run(hier, zero):
+        init_fn, step_fn = build_train_step(
+            loss_fn, opt, mesh, hierarchical_allreduce=hier,
+            shard_update=zero, donate=False)
+        state = init_fn(dict(params0))
+        r = np.random.RandomState(1)
+        for _ in range(3):
+            batch = {"x": jnp.asarray(r.randn(16, 16), jnp.float32),
+                     "y": jnp.asarray(r.randn(16, 8), jnp.float32)}
+            state, _ = step_fn(state, batch)
+        return state
+
+    flat = run(False, False)
+    for hier, zero in ((True, False), (True, True)):
+        got = run(hier, zero)
+        for k in params0:
+            np.testing.assert_allclose(
+                np.asarray(flat.params[k]), np.asarray(got.params[k]),
+                rtol=1e-5, atol=1e-6)
