@@ -7,9 +7,22 @@ PYTHON ?= python
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
 	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke \
-	trace-smoke topo-smoke
+	trace-smoke topo-smoke analyze
 
-test: native
+# Every smoke runs with the runtime lock-order detector armed
+# (docs/ANALYSIS.md): repo-created locks are tracked, lock-order cycles
+# are fatal (each smoke's main calls lockcheck.check_fatal() on exit).
+SMOKE_ENV = MPI_OPERATOR_LOCKCHECK=1
+
+# Correctness gate (docs/ANALYSIS.md): project lint over the tree (zero
+# non-baselined findings, no stale baseline entries) + the analyzer
+# self-test (one seeded violation per rule + a deliberate lock
+# inversion, each must be caught).  Part of the default verify path.
+analyze:
+	$(PYTHON) -m mpi_operator_tpu analyze
+	$(PYTHON) -m mpi_operator_tpu analyze --self-test
+
+test: native analyze
 	$(PYTHON) -m pytest tests/ -q
 
 test-fast: native
@@ -23,14 +36,14 @@ test-real-cluster:
 # Start the operator app, drive a reconcile, scrape /metrics, and
 # assert the telemetry histogram families are present (docs/OBSERVABILITY.md).
 telemetry-smoke:
-	$(PYTHON) tools/telemetry_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/telemetry_smoke.py
 
 # Deterministic multi-fault chaos plan (pod kill + watch 410 + apiserver
 # error burst + preemption notice) against the full local cluster, run
 # twice: converges with all invariants green and reproduces an identical
 # fault/event log (docs/RESILIENCE.md).
 chaos-smoke:
-	$(PYTHON) tools/chaos_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/chaos_smoke.py
 
 # Flight-recorder smoke: kill a training gang via a seeded chaos plan,
 # assert the black-box bundle (ring JSONL + merged Chrome trace with
@@ -38,13 +51,13 @@ chaos-smoke:
 # its canonical event section is byte-identical across two runs; also
 # checks the docs/OBSERVABILITY.md metric catalog against the code.
 obs-smoke:
-	$(PYTHON) tools/obs_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/obs_smoke.py
 
 # Reduced-N reconcile-throughput run (< 60s, CPU) with the cache
 # mutation detector armed: throughput floor, zero steady-state list
 # scans, zero shared-snapshot mutations (docs/PERF.md).
 controller-bench-smoke:
-	$(PYTHON) tools/controller_bench_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/controller_bench_smoke.py
 
 # Sharded control plane (< 60s, CPU): N-shard fair controller vs the
 # 1-shard unfair-FIFO baseline on the same churn burst — throughput
@@ -52,14 +65,14 @@ controller-bench-smoke:
 # cross-shard violations (counter-asserted), every shard synced, hot
 # adds coalesced (docs/PERF.md "Sharded control plane").
 controller-shard-smoke:
-	$(PYTHON) tools/controller_shard_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/controller_shard_smoke.py
 
 # Serving decode hot path (< 60s, CPU): pipelined vs reference loops
 # emit byte-identical mixed greedy/sampled streams (dense + paged),
 # exactly one device->host transfer per steady-state tick
 # (counter-asserted), and a ticks/sec floor holds (docs/PERF.md).
 serve-bench-smoke:
-	$(PYTHON) tools/serve_bench_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/serve_bench_smoke.py
 
 # Serving fleet (< 60s, CPU): 3-replica ServeJob behind the prefix-aware
 # router under mixed load — routed streams byte-identical to direct
@@ -67,7 +80,7 @@ serve-bench-smoke:
 # (counter-asserted), and a queue-driven autoscaler up-then-down
 # transition observed (docs/PERF.md "Serving fleet").
 serve-fleet-smoke:
-	$(PYTHON) tools/serve_fleet_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/serve_fleet_smoke.py
 
 # Gang scheduler (< 60s, CPU): two queues over one TPU slice — small
 # job admitted and running, 9-chip gang honestly Queued with zero pods,
@@ -77,7 +90,7 @@ serve-fleet-smoke:
 # step; scheduler counters and every chaos invariant asserted
 # (docs/SCHEDULING.md).
 sched-smoke:
-	$(PYTHON) tools/sched_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/sched_smoke.py
 
 # Macro-soak (< 60s, CPU): the whole stack at minimum scale — one
 # training gang through a ClusterQueue + a 2-replica serving fleet
@@ -87,7 +100,7 @@ sched-smoke:
 # flight-recorder lane per layer, and the canonical event log
 # byte-identical across two runs (docs/RESILIENCE.md).
 soak-smoke:
-	$(PYTHON) tools/soak_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/soak_smoke.py
 
 # Causal tracing (< 60s, CPU): one queue-gated LocalCluster job and one
 # routed serve request, each asserted as a COMPLETE causal chain —
@@ -96,7 +109,7 @@ soak-smoke:
 # with the canonical timestamp-free trace byte-identical across two
 # identical runs (docs/OBSERVABILITY.md "Causal tracing").
 trace-smoke:
-	$(PYTHON) tools/trace_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/trace_smoke.py
 
 # Topology-aware placement + hierarchical collectives (< 60s, CPU):
 # seeded contention sim on a small torus pool — topology-aware
@@ -108,7 +121,7 @@ trace-smoke:
 # fragmentation gauge, and restores coordinate+cost-exact placements
 # across a restart (docs/SCHEDULING.md "Topology-aware placement").
 topo-smoke:
-	$(PYTHON) tools/topo_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/topo_smoke.py
 
 # Train hot path (< 60s, CPU): overlapped loop (async dispatch +
 # prefetch + async checkpointing) holds a steps/s floor with ZERO
@@ -117,7 +130,7 @@ topo-smoke:
 # to sync saves, and goodput % beats the serialized baseline knob
 # (docs/PERF.md).
 train-bench-smoke:
-	$(PYTHON) tools/train_bench_smoke.py
+	$(SMOKE_ENV) $(PYTHON) tools/train_bench_smoke.py
 
 native:
 	$(MAKE) -C native

@@ -21,3 +21,16 @@ A brand-new implementation of the capabilities of kubeflow/mpi-operator
 """
 
 __version__ = "0.1.0"
+
+# Opt-in runtime concurrency detector (docs/ANALYSIS.md): when
+# MPI_OPERATOR_LOCKCHECK=1 is set (tests/conftest.py arms it for all of
+# tier-1; the Makefile arms every *-smoke), wrap threading.Lock/RLock
+# creation BEFORE any subsystem module is imported so every
+# control-plane lock is tracked from birth.
+import os as _os
+
+if _os.environ.get("MPI_OPERATOR_LOCKCHECK", "") not in ("", "0",
+                                                         "false"):
+    from .analysis import lockcheck as _lockcheck
+
+    _lockcheck.install()

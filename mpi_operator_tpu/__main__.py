@@ -23,6 +23,7 @@ kubectl apply" from the reference's workflow (README.md quick start).
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import time
@@ -399,12 +400,13 @@ def cmd_top(args) -> int:
     def fetch_metrics() -> dict:
         if not args.metrics_url:
             return {}
+        import http.client
         import urllib.request
         try:
             with urllib.request.urlopen(args.metrics_url,
                                         timeout=5) as resp:
                 return _parse_metrics_text(resp.read().decode())
-        except Exception:
+        except (OSError, ValueError, http.client.HTTPException):
             return {}
 
     if args.once:
@@ -630,6 +632,44 @@ def cmd_lifecycle(args, action: str) -> int:
     return 0
 
 
+def cmd_analyze(args) -> int:
+    """Static lint + analyzer self-test (docs/ANALYSIS.md)."""
+    from .analysis import lint
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    if args.self_test:
+        from .analysis import selftest
+        ok, lines = selftest.run_self_test()
+        print("\n".join(lines))
+        return 0 if ok else 1
+
+    baseline = args.baseline or os.path.join(root, lint.DEFAULT_BASELINE)
+    if args.write_baseline:
+        res = lint.run_lint(root, baseline_path=os.devnull)
+        lint.write_baseline(baseline, root, res.findings)
+        print(f"wrote {len(res.findings)} baseline entr"
+              f"{'y' if len(res.findings) == 1 else 'ies'} to {baseline}")
+        return 0
+
+    res = lint.run_lint(root, baseline_path=baseline)
+    for f in sorted(res.findings, key=lambda f: (f.path, f.line)):
+        print(f.render())
+    for entry in res.stale_baseline:
+        print(f"stale baseline entry (matches nothing — remove it): "
+              f"{entry}")
+    suppressed = ""
+    if res.baselined or res.pragma_suppressed:
+        suppressed = (f" ({len(res.baselined)} baselined,"
+                      f" {len(res.pragma_suppressed)} pragma-allowed)")
+    print(f"analyze: {res.files_scanned} files, "
+          f"{len(res.findings)} finding(s), "
+          f"{len(res.stale_baseline)} stale baseline entr"
+          f"{'y' if len(res.stale_baseline) == 1 else 'ies'}"
+          + suppressed)
+    return 0 if res.ok else 1
+
+
 def cmd_version(args) -> int:
     from . import version
     info = version.info()
@@ -733,6 +773,20 @@ def main(argv=None) -> int:
         p.add_argument("-n", "--namespace", default="default")
         p.add_argument("--master", default="http://127.0.0.1:8001")
 
+    p = sub.add_parser("analyze",
+                       help="project lint: AST rules + baseline +"
+                            " self-test (docs/ANALYSIS.md)")
+    p.add_argument("--root", default=None,
+                   help="tree to analyze (default: this checkout)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default:"
+                        " tools/analysis_baseline.txt)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from current findings")
+    p.add_argument("--self-test", action="store_true",
+                   help="seed one synthetic violation per rule (+ a lock"
+                        " inversion) and assert each is caught")
+
     sub.add_parser("version", help="print version")
 
     args, extra = parser.parse_known_args(argv)
@@ -763,6 +817,8 @@ def main(argv=None) -> int:
             return cmd_trace(args)
         if args.command in ("suspend", "resume", "delete"):
             return cmd_lifecycle(args, args.command)
+        if args.command == "analyze":
+            return cmd_analyze(args)
         if args.command == "version":
             return cmd_version(args)
     except Exception as exc:  # clean one-line errors, kubectl-style

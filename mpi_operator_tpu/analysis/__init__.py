@@ -1,0 +1,22 @@
+"""Correctness tooling: static lint engine + runtime concurrency detector.
+
+Two engines (docs/ANALYSIS.md):
+
+- ``analysis.lint`` — an AST-walking rule framework with project-specific
+  rules (raw annotation-key literals, silent broad excepts, sleep-polling
+  tests, wall-clock in the sim substrate, metrics-catalog drift), a
+  checked-in baseline for grandfathered findings, and inline
+  ``# lint: allow[rule-id]`` pragmas.  Surfaced as
+  ``python -m mpi_operator_tpu analyze`` and ``make analyze``.
+
+- ``analysis.lockcheck`` — an opt-in (``MPI_OPERATOR_LOCKCHECK=1``)
+  instrumentation layer that wraps ``threading.Lock``/``RLock`` creation
+  in repo code, builds the global lock-order graph, and reports
+  lock-order cycles (with both witness stacks) and blocking calls
+  executed while holding a named hot lock.  Armed for all of tier-1 via
+  ``tests/conftest.py`` and for every ``make *-smoke``; fatal on cycle.
+
+Both engines self-test: ``analyze --self-test`` seeds one synthetic
+violation per rule plus a deliberate A->B/B->A lock inversion and
+asserts each is caught.
+"""

@@ -1,0 +1,575 @@
+"""Static lint engine: project-specific AST rules over the repo tree.
+
+The bug classes these rules encode were all found *by hand* in recent
+PRs' review-hardening passes; the engine catches them mechanically
+(docs/ANALYSIS.md has the full catalog with rationale and examples):
+
+- ``raw-annotation-key``   retyped ``*.kubeflow.org/...`` annotation/label
+  keys outside ``api/constants.py`` (the PR 9-12 tamper/restart bug class
+  rode on retyped keys).
+- ``silent-except``        bare/overbroad ``except`` whose body swallows
+  silently (the PR 3/5 silent-death class).
+- ``sleep-poll``           hand-rolled ``time.sleep`` polling loops in
+  tests/smokes (the PR 10 deflake class — waits must be watch- or
+  condition-driven, via ``utils.waiters.wait_until``).
+- ``wallclock-sim``        wall-clock / unseeded randomness inside the
+  deterministic sim/chaos/topology substrate (byte-stable-replay killers).
+- ``metrics-catalog``      metric families registered in code but missing
+  from the docs/OBSERVABILITY.md catalog, and vice versa (the obs-smoke
+  drift check, promoted to static so it runs without standing up a
+  cluster).
+
+Suppression, in burn-down order of preference: fix the finding; else an
+inline ``# lint: allow[rule-id] — reason`` pragma on the offending line
+(or the line above); else a baseline entry (``tools/analysis_baseline.txt``)
+so existing findings are grandfathered while NEW violations still fail.
+Baseline entries that no longer match anything are STALE and fail the run
+(the baseline only burns down).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Findings, pragmas, fingerprints
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+
+    def render(self) -> str:
+        # path:line rule-id message — clickable in editors/CI logs.
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+_PRAGMA = re.compile(r"#\s*lint:\s*allow\[([a-z0-9-]+(?:,[a-z0-9-]+)*)\]")
+
+
+def _pragma_rules(line: str) -> frozenset:
+    m = _PRAGMA.search(line)
+    if not m:
+        return frozenset()
+    return frozenset(r.strip() for r in m.group(1).split(","))
+
+
+def fingerprint(finding: Finding, line_text: str, occurrence: int) -> str:
+    """Stable id for a finding: survives unrelated line-number churn
+    (keyed on the line's text, not its number); identical lines in one
+    file disambiguate by occurrence index."""
+    h = hashlib.blake2b(digest_size=6)
+    h.update(finding.rule.encode())
+    h.update(finding.path.encode())
+    h.update(line_text.strip().encode())
+    h.update(str(occurrence).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Rule framework
+
+
+@dataclass
+class Rule:
+    """One lint rule: per-file AST check plus an optional project-level
+    finalize pass (for cross-file rules like catalog drift)."""
+    id: str
+    severity: str
+    doc: str
+    scope: Callable[[str], bool]
+    check: Optional[Callable[["FileContext"], List[Finding]]] = None
+    finalize: Optional[Callable[["ProjectContext"], List[Finding]]] = None
+
+
+@dataclass
+class FileContext:
+    root: str
+    relpath: str
+    tree: ast.AST
+    lines: List[str]
+    project: "ProjectContext"
+
+
+@dataclass
+class ProjectContext:
+    root: str
+    # metrics-catalog collect phase: name -> first (relpath, line) seen
+    metric_sites: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+
+
+RULES: List[Rule] = []
+
+
+def rule(id: str, severity: str, doc: str, scope):
+    def deco(fn):
+        RULES.append(Rule(id=id, severity=severity, doc=doc, scope=scope,
+                          check=fn))
+        return fn
+    return deco
+
+
+def _in_pkg(relpath: str) -> bool:
+    return relpath.startswith("mpi_operator_tpu/")
+
+
+def _docstring_linenos(tree: ast.AST) -> set:
+    """Line numbers spanned by module/class/function docstrings (their
+    prose legitimately names annotation keys)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                c = body[0].value
+                out.update(range(c.lineno, (c.end_lineno or c.lineno) + 1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# raw-annotation-key
+
+_ANNOTATION_KEY = re.compile(
+    r"(?:[a-z0-9-]+\.)*kubeflow\.org/[A-Za-z0-9][A-Za-z0-9._-]*")
+
+
+def _is_api_version(key: str) -> bool:
+    # "kubeflow.org/v2beta1" is an apiVersion (GVK idiom), not a
+    # retypable annotation/label key.
+    suffix = key.rsplit("/", 1)[1]
+    return bool(re.match(r"^v\d", suffix))
+
+
+@rule("raw-annotation-key", "error",
+      "kubeflow.org-domain annotation/label key retyped as a string "
+      "literal outside api/constants.py; route it through the constant "
+      "(retyped keys are the PR 9-12 tamper/restart bug class)",
+      scope=lambda p: p != "mpi_operator_tpu/api/constants.py")
+def check_raw_annotation_key(ctx: FileContext) -> List[Finding]:
+    findings = []
+    doc_lines = _docstring_linenos(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Constant) and
+                isinstance(node.value, str)):
+            continue
+        if node.lineno in doc_lines:
+            continue
+        for key in _ANNOTATION_KEY.findall(node.value):
+            if _is_api_version(key):
+                continue
+            findings.append(Finding(
+                "raw-annotation-key", ctx.relpath, node.lineno,
+                f"raw annotation/label key {key!r} — use the "
+                f"api/constants.py constant"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# silent-except
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for n in ([t] if not isinstance(t, ast.Tuple) else t.elts):
+        if isinstance(n, ast.Name):
+            names.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.append(n.attr)
+    return any(n in _BROAD for n in names)
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+    """Silent = no call, no raise, no state recorded: nothing but
+    pass/continue/break/return-constant.  A counter increment, log line,
+    re-raise, or flag assignment all count as 'not silent'."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Call, ast.Assign,
+                                 ast.AugAssign, ast.AnnAssign)):
+                return False
+    return True
+
+
+@rule("silent-except", "error",
+      "bare/overbroad except that swallows silently in a control-plane "
+      "package; narrow to typed exceptions and record the drop (counter, "
+      "log, or re-raise) — the PR 3/5 silent-death class",
+      scope=_in_pkg)
+def check_silent_except(ctx: FileContext) -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and \
+                _handler_is_broad(node) and _body_is_silent(node):
+            what = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            findings.append(Finding(
+                "silent-except", ctx.relpath, node.lineno,
+                f"{what} swallows silently — narrow the type and count/"
+                f"log the drop, or re-raise"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# sleep-poll
+
+
+def _sleep_poll_scope(relpath: str) -> bool:
+    return relpath.startswith("tests/") or (
+        relpath.startswith("tools/") and relpath.endswith("_smoke.py"))
+
+
+@rule("sleep-poll", "error",
+      "time.sleep inside a loop in a test/smoke — hand-rolled polling "
+      "is the PR 10 deflake class; use a watch-driven wait or "
+      "utils.waiters.wait_until (pacing sleeps take a pragma)",
+      scope=_sleep_poll_scope)
+def check_sleep_poll(ctx: FileContext) -> List[Finding]:
+    findings = []
+
+    def visit(node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            child_in_loop = in_loop or isinstance(child,
+                                                  (ast.While, ast.For))
+            if isinstance(child, ast.Call) and in_loop:
+                f = child.func
+                if (isinstance(f, ast.Attribute) and f.attr == "sleep" and
+                        isinstance(f.value, ast.Name) and
+                        f.value.id == "time"):
+                    findings.append(Finding(
+                        "sleep-poll", ctx.relpath, child.lineno,
+                        "time.sleep in a loop — use wait_until/a watch "
+                        "instead of hand-rolled polling"))
+            # A nested def resets loop context (the loop runs the def,
+            # not the sleep).
+            reset = isinstance(child, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda))
+            visit(child, False if reset else child_in_loop)
+
+    visit(ctx.tree, False)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# wallclock-sim
+
+# The deterministic substrate: seeded-replay byte-stability depends on
+# these files never reading the wall clock or the process-global RNG.
+SIM_SCOPE = frozenset((
+    "mpi_operator_tpu/chaos/plan.py",
+    "mpi_operator_tpu/sched/topology.py",
+    "mpi_operator_tpu/sched/capacity.py",
+    "mpi_operator_tpu/runtime/netsim.py",
+))
+
+_WALLCLOCK_FNS = {("time", "time"), ("time", "time_ns"),
+                  ("time", "monotonic"), ("time", "monotonic_ns"),
+                  ("datetime", "now"), ("datetime", "utcnow")}
+
+
+@rule("wallclock-sim", "error",
+      "wall-clock read or unseeded randomness inside the deterministic "
+      "sim/chaos/topology substrate — byte-stable seeded replay breaks",
+      scope=lambda p: p in SIM_SCOPE)
+def check_wallclock_sim(ctx: FileContext) -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            pair = (f.value.id, f.attr)
+            if pair in _WALLCLOCK_FNS:
+                findings.append(Finding(
+                    "wallclock-sim", ctx.relpath, node.lineno,
+                    f"{pair[0]}.{pair[1]}() in the sim substrate — "
+                    f"thread logical time through instead"))
+            elif f.value.id == "random":
+                if f.attr == "Random":
+                    if not node.args and not node.keywords:
+                        findings.append(Finding(
+                            "wallclock-sim", ctx.relpath, node.lineno,
+                            "random.Random() without a seed — pass the "
+                            "plan seed"))
+                else:
+                    findings.append(Finding(
+                        "wallclock-sim", ctx.relpath, node.lineno,
+                        f"process-global random.{f.attr}() — use a "
+                        f"seeded random.Random instance"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# metrics-catalog (project-level: collect per file, compare vs docs)
+
+# Family names built with dynamic prefixes (f-strings the literal walk
+# cannot see); keep in sync with telemetry/goodput.py.
+DYNAMIC_METRIC_FAMILIES = ("train_goodput_fraction", "train_step_seconds")
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram",
+                     "counter_vec", "gauge_vec", "histogram_vec"}
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram",
+                   "CounterVec", "GaugeVec", "HistogramVec"}
+
+_DOC_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_]+)(?:\{[^}]*\})?`")
+
+
+def _collect_metrics(ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and node.args and
+                isinstance(node.args[0], ast.Constant) and
+                isinstance(node.args[0].value, str)):
+            continue
+        f = node.func
+        name = None
+        if isinstance(f, ast.Attribute) and f.attr in _METRIC_FACTORIES:
+            name = node.args[0].value
+        elif isinstance(f, ast.Name) and f.id in _METRIC_CLASSES:
+            name = node.args[0].value
+        elif isinstance(f, ast.Attribute) and f.attr in _METRIC_CLASSES:
+            name = node.args[0].value
+        if name and re.match(r"^[a-z][a-z0-9_]+$", name):
+            ctx.project.metric_sites.setdefault(
+                name, (ctx.relpath, node.lineno))
+
+
+def _finalize_metrics(project: ProjectContext) -> List[Finding]:
+    doc_path = os.path.join(project.root, "docs", "OBSERVABILITY.md")
+    if not os.path.exists(doc_path):
+        if not project.metric_sites:
+            return []  # nothing registered, nothing to document
+        return [Finding("metrics-catalog", "docs/OBSERVABILITY.md", 1,
+                        "metric catalog file missing")]
+    documented: Dict[str, int] = {}
+    with open(doc_path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            m = _DOC_ROW.match(line.strip())
+            if m:
+                documented.setdefault(m.group(1), lineno)
+    registered = dict(project.metric_sites)
+    goodput = "mpi_operator_tpu/telemetry/goodput.py"
+    if os.path.exists(os.path.join(project.root, goodput)):
+        for fam in DYNAMIC_METRIC_FAMILIES:
+            registered.setdefault(fam, (goodput, 1))
+    findings = []
+    for name, (relpath, lineno) in sorted(registered.items()):
+        if name not in documented:
+            findings.append(Finding(
+                "metrics-catalog", relpath, lineno,
+                f"metric family {name!r} registered in code but missing "
+                f"from the docs/OBSERVABILITY.md catalog"))
+    for name, lineno in sorted(documented.items()):
+        # Single-word backticked cells (layer names in the lanes table)
+        # are not metric families; every real family has an underscore.
+        if name not in registered and "_" in name:
+            findings.append(Finding(
+                "metrics-catalog", "docs/OBSERVABILITY.md", lineno,
+                f"metric family {name!r} documented in the catalog but "
+                f"registered nowhere in mpi_operator_tpu/"))
+    return findings
+
+
+RULES.append(Rule(
+    id="metrics-catalog", severity="error",
+    doc="metric families registered in code and the docs/OBSERVABILITY.md "
+        "catalog must match exactly, both directions (the obs-smoke drift "
+        "check, promoted to static)",
+    scope=_in_pkg, check=None, finalize=_finalize_metrics))
+
+
+# ---------------------------------------------------------------------------
+# Engine
+
+WALK_ROOTS = ("mpi_operator_tpu", "tests", "tools", "examples")
+
+# The analyzer's own corpus: these files deliberately spell violations
+# (seeded snippets, rule unit tests) and are exempt from scanning —
+# linting the lint corpus would force obfuscating every example.
+CORPUS_FILES = frozenset((
+    "mpi_operator_tpu/analysis/selftest.py",
+    "tests/test_analysis.py",
+))
+
+
+def iter_py_files(root: str) -> List[str]:
+    out = []
+    for top in WALK_ROOTS:
+        base = os.path.join(root, top)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root).replace(os.sep,
+                                                                 "/"))
+    for fn in sorted(os.listdir(root)):
+        if fn.endswith(".py"):
+            out.append(fn)
+    return sorted(set(out) - CORPUS_FILES)
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]            # NOT suppressed: fail the run
+    baselined: List[Finding]           # suppressed by baseline entries
+    pragma_suppressed: List[Finding]
+    stale_baseline: List[str]          # entries that matched nothing
+    files_scanned: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+
+def _run_rules(root: str, relpaths: Sequence[str]) -> List[Finding]:
+    project = ProjectContext(root=root)
+    findings: List[Finding] = []
+    for relpath in relpaths:
+        path = os.path.join(root, relpath)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=relpath)
+        except (OSError, SyntaxError) as exc:
+            findings.append(Finding("parse-error", relpath, 1,
+                                    f"cannot lint: {exc}"))
+            continue
+        ctx = FileContext(root=root, relpath=relpath, tree=tree,
+                          lines=src.splitlines(), project=project)
+        if _in_pkg(relpath):
+            _collect_metrics(ctx)
+        for r in RULES:
+            if r.check is not None and r.scope(relpath):
+                findings.extend(r.check(ctx))
+    for r in RULES:
+        if r.finalize is not None:
+            findings.extend(r.finalize(project))
+    return findings
+
+
+def _apply_pragmas(root: str, findings: List[Finding]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    kept, suppressed = [], []
+    cache: Dict[str, List[str]] = {}
+    for f in findings:
+        if f.path not in cache:
+            try:
+                with open(os.path.join(root, f.path),
+                          encoding="utf-8") as fh:
+                    cache[f.path] = fh.read().splitlines()
+            except OSError:
+                cache[f.path] = []
+        lines = cache[f.path]
+        allowed = frozenset()
+        if 0 < f.line <= len(lines):
+            allowed = _pragma_rules(lines[f.line - 1])
+            if f.line >= 2:
+                allowed = allowed | _pragma_rules(lines[f.line - 2])
+        (suppressed if f.rule in allowed else kept).append(f)
+    return kept, suppressed
+
+
+def _finding_fingerprints(root: str, findings: List[Finding]
+                          ) -> List[Tuple[Finding, str]]:
+    cache: Dict[str, List[str]] = {}
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in findings:
+        if f.path not in cache:
+            try:
+                with open(os.path.join(root, f.path),
+                          encoding="utf-8") as fh:
+                    cache[f.path] = fh.read().splitlines()
+            except OSError:
+                cache[f.path] = []
+        lines = cache[f.path]
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        key = (f.rule, f.path, text.strip())
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        out.append((f, fingerprint(f, text, occ)))
+    return out
+
+
+DEFAULT_BASELINE = "tools/analysis_baseline.txt"
+
+
+def load_baseline(path: str) -> List[Tuple[str, str, str, str]]:
+    """Entries: (rule, path, fingerprint, comment)."""
+    entries = []
+    if not os.path.exists(path):
+        return entries
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, comment = line.partition("#")
+            parts = [p.strip() for p in body.strip().split("|")]
+            if len(parts) != 3:
+                raise ValueError(
+                    f"malformed baseline entry {line!r} — expected "
+                    f"'rule-id|path|fingerprint  # reason'")
+            entries.append((parts[0], parts[1], parts[2], comment.strip()))
+    return entries
+
+
+def write_baseline(path: str, root: str, findings: List[Finding]) -> None:
+    with open(path, "w") as fh:
+        fh.write(
+            "# Analysis baseline: grandfathered lint findings "
+            "(docs/ANALYSIS.md).\n"
+            "# Format: rule-id|path|fingerprint  # justification\n"
+            "# New violations fail `make analyze`; entries here burn "
+            "down — a stale\n"
+            "# entry (matching nothing) also fails, so this file only "
+            "shrinks.\n")
+        for f, fp in _finding_fingerprints(root, findings):
+            fh.write(f"{f.rule}|{f.path}|{fp}  # {f.message}\n")
+
+
+def run_lint(root: str, baseline_path: Optional[str] = None) -> LintResult:
+    relpaths = iter_py_files(root)
+    raw = _run_rules(root, relpaths)
+    raw, pragma_suppressed = _apply_pragmas(root, raw)
+    baseline_path = baseline_path or os.path.join(root, DEFAULT_BASELINE)
+    entries = load_baseline(baseline_path)
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for rule_id, path, fp, _comment in entries:
+        key = (rule_id, path, fp)
+        budget[key] = budget.get(key, 0) + 1
+    kept, baselined = [], []
+    for f, fp in _finding_fingerprints(root, raw):
+        key = (f.rule, f.path, fp)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            baselined.append(f)
+        else:
+            kept.append(f)
+    stale = [f"{rule_id}|{path}|{fp}"
+             for (rule_id, path, fp), n in sorted(budget.items())
+             if n > 0 for _ in range(n)]
+    return LintResult(findings=kept, baselined=baselined,
+                      pragma_suppressed=pragma_suppressed,
+                      stale_baseline=stale, files_scanned=len(relpaths))
+
+
+def rule_catalog() -> List[Rule]:
+    return list(RULES)

@@ -1,0 +1,510 @@
+"""Runtime concurrency detector: lock-order cycles + blocking-under-lock.
+
+Opt-in instrumentation (``MPI_OPERATOR_LOCKCHECK=1``, the same arming
+pattern as ``MPI_OPERATOR_CACHE_MUTATION_DETECT``): ``install()`` wraps
+``threading.Lock``/``RLock`` *creation* so every lock created by repo
+code (and only repo code — stdlib/third-party callers get real locks,
+keeping overhead off foreign hot paths) is a tracked proxy that records
+per-thread acquisition order.
+
+From the recorded order the detector maintains the global lock-order
+graph, keyed by lock *creation site* (file:line, or a registered name
+for the hot locks), and reports:
+
+- **lock-order cycles** — site A's lock taken under site B's AND vice
+  versa (potential deadlock), with both witness stacks (captured once,
+  at the first observation of each edge).  Same-site lock pairs (e.g.
+  per-shard stores) only count as a cycle when the SAME two instances
+  are seen in both orders — a globally-ordered walk over siblings stays
+  clean.
+- **blocking calls under a named hot lock** — acquiring a second lock,
+  ``queue.Queue.get``/``threading.Condition.wait`` (blocking form), or
+  any site routed through :func:`note_blocking`, while the thread holds
+  a lock registered via :func:`name_lock` (apiserver ``_KindStore``,
+  flight ring, batcher device lock, router state).  Counted in
+  ``mpi_operator_lockcheck_blocking_under_lock_total`` and summarized
+  in the report; unlike cycles these are advisory, not fatal.
+
+Armed for all of tier-1 via ``tests/conftest.py`` and for every
+``make *-smoke`` (the smoke mains call :func:`check_fatal` before
+exiting); a cycle fails the run.  ``analyze --self-test`` seeds a
+deliberate A->B/B->A inversion plus a queue.get-under-hot-lock and
+asserts both are caught (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+ENV_FLAG = "MPI_OPERATOR_LOCKCHECK"
+
+# Real primitives, captured before any monkeypatching.
+raw_lock = threading.Lock
+raw_rlock = threading.RLock
+_raw_queue_get = queue.Queue.get
+_raw_condition_wait = threading.Condition.wait
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class LockOrderError(RuntimeError):
+    """Raised by check_fatal() when the lock-order graph has a cycle."""
+
+
+def _external_frame():
+    """First stack frame outside this module (the real call site)."""
+    frame = sys._getframe(1)
+    while frame is not None and frame.f_code.co_filename == __file__:
+        frame = frame.f_back
+    return frame
+
+
+class _TrackedLock:
+    """Proxy around a real Lock/RLock recording acquisition order."""
+
+    __slots__ = ("_lock", "_site", "_name", "_hot", "_det", "_reentrant",
+                 "_owner", "_depth", "_serial")
+
+    _serials = itertools.count(1)  # id() recycles after GC; this never
+
+    def __init__(self, lock, site: str, det: "LockCheck",
+                 reentrant: bool):
+        self._serial = next(self._serials)
+        self._lock = lock
+        self._site = site
+        self._name: Optional[str] = None
+        self._hot = False
+        self._det = det
+        self._reentrant = reentrant
+        self._owner: Optional[int] = None   # owning thread id (RLock)
+        self._depth = 0
+
+    @property
+    def label(self) -> str:
+        return self._name or self._site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = threading.get_ident()
+        if self._reentrant and self._owner == me:
+            # Reentrant re-acquire: no ordering information.
+            got = self._lock.acquire(blocking, timeout)
+            if got:
+                self._depth += 1
+            return got
+        self._det._record_attempt(self)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            if self._reentrant:
+                self._owner = me
+                self._depth = 1
+            self._det._push_held(self)
+        return got
+
+    def release(self):
+        if self._reentrant and self._owner == threading.get_ident():
+            self._depth -= 1
+            if self._depth > 0:
+                self._lock.release()
+                return
+            self._owner = None
+        self._det._pop_held(self)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<TrackedLock {self.label} wrapping {self._lock!r}>"
+
+    def __getattr__(self, item):
+        # Pass through the rest of the primitive's surface (_is_owned,
+        # _release_save, ... — Condition compatibility).
+        return getattr(object.__getattribute__(self, "_lock"), item)
+
+
+class LockCheck:
+    """The detector core.  The global armed instance is created by
+    install(); tests drive private instances via wrap()."""
+
+    def __init__(self):
+        # A real (untracked) lock guards the graph structures.
+        self._mu = raw_lock()
+        self._tl = threading.local()
+        # (site_a, site_b) -> witness stack captured when the edge first
+        # appeared (the stack shows BOTH acquires: b under a).
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._graph: Dict[str, set] = {}
+        # Same-site instance pairs: (site, frozenset{serial,serial}) ->
+        # observed (first,second) acquisition orders (proxy serials are
+        # monotonic and never recycled, unlike id()).
+        self._pairs: Dict[Tuple[str, frozenset], Dict[tuple, str]] = {}
+        self._cycles: List[dict] = []
+        # (hot label, kind, call site) -> count; stacks kept per key.
+        self._blocking: Dict[Tuple[str, str, str], dict] = {}
+        self._counter = None  # lazy telemetry counter
+
+    # -- wrapping ----------------------------------------------------------
+
+    def wrap(self, lock, site: Optional[str] = None,
+             reentrant: bool = False, name: Optional[str] = None
+             ) -> _TrackedLock:
+        if site is None:
+            caller = sys._getframe(1)
+            site = (f"{os.path.basename(caller.f_code.co_filename)}:"
+                    f"{caller.f_lineno}")
+        proxy = _TrackedLock(lock, site, self, reentrant)
+        if name:
+            proxy._name = name
+            proxy._hot = True
+        return proxy
+
+    # -- per-thread held list ----------------------------------------------
+
+    def _held(self) -> list:
+        held = getattr(self._tl, "held", None)
+        if held is None:
+            held = self._tl.held = []
+        return held
+
+    def _push_held(self, proxy: _TrackedLock):
+        self._held().append(proxy)
+
+    def _pop_held(self, proxy: _TrackedLock):
+        held = self._held()
+        # Non-LIFO release is legal (e.g. Condition._release_save);
+        # remove by identity from the right.
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is proxy:
+                del held[i]
+                return
+
+    # -- edge recording ----------------------------------------------------
+
+    def _record_attempt(self, proxy: _TrackedLock):
+        # Re-entrancy guard: recording itself may acquire tracked locks
+        # (the telemetry counter's) — never recurse into recording.
+        if getattr(self._tl, "busy", False):
+            return
+        held = self._held()
+        if not held:
+            return
+        for h in held:
+            if h is proxy:
+                return  # reentrant (RLock) — no ordering info
+        self._tl.busy = True
+        try:
+            self._record_attempt_inner(proxy, held)
+        finally:
+            self._tl.busy = False
+
+    def _record_attempt_inner(self, proxy: _TrackedLock, held: list):
+        hot = [h for h in held if h._hot]
+        if hot:
+            self._note_blocking_locked(
+                hot[-1].label, "lock.acquire",
+                f"acquire of {proxy.label}")
+        stack = None
+        with self._mu:
+            for h in held:
+                if h._site == proxy._site:
+                    key = (h._site,
+                           frozenset((h._serial, proxy._serial)))
+                    orders = self._pairs.setdefault(key, {})
+                    order = (h._serial, proxy._serial)
+                    if order not in orders:
+                        if stack is None:
+                            stack = "".join(traceback.format_stack(
+                                _external_frame()))
+                        orders[order] = stack
+                        rev = (proxy._serial, h._serial)
+                        if rev in orders:
+                            self._cycles.append({
+                                "sites": [h._site, proxy._site],
+                                "labels": [h.label, proxy.label],
+                                "kind": "same-site instance inversion",
+                                "witness": [orders[rev], stack],
+                            })
+                    continue
+                edge = (h._site, proxy._site)
+                if edge in self._edges:
+                    continue
+                if stack is None:
+                    stack = "".join(traceback.format_stack(
+                        _external_frame()))
+                self._edges[edge] = stack
+                self._graph.setdefault(h._site, set()).add(proxy._site)
+                cycle_path = self._find_path(proxy._site, h._site)
+                if cycle_path is not None:
+                    sites = [h._site] + cycle_path
+                    self._cycles.append({
+                        "sites": sites,
+                        "labels": [h.label, proxy.label],
+                        "kind": "lock-order cycle",
+                        "witness": [stack] + [
+                            self._edges.get((a, b), "")
+                            for a, b in zip(cycle_path, cycle_path[1:])],
+                    })
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """Path src -> ... -> dst in the edge graph (caller holds _mu)."""
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._graph.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- blocking-under-hot-lock -------------------------------------------
+
+    def note_blocking(self, kind: str, detail: str = ""):
+        """Record a potentially-blocking call if the calling thread holds
+        a named hot lock.  Cheap no-op otherwise."""
+        if getattr(self._tl, "busy", False):
+            return
+        held = getattr(self._tl, "held", None)
+        if not held:
+            return
+        hot = [h for h in held if h._hot]
+        if not hot:
+            return
+        self._tl.busy = True
+        try:
+            self._note_blocking_locked(hot[-1].label, kind, detail)
+        finally:
+            self._tl.busy = False
+
+    def _note_blocking_locked(self, hot_label: str, kind: str,
+                              detail: str):
+        frame = _external_frame()
+        site = "?"
+        if frame is not None:
+            site = (f"{os.path.basename(frame.f_code.co_filename)}:"
+                    f"{frame.f_lineno}")
+        key = (hot_label, kind, site)
+        with self._mu:
+            rec = self._blocking.get(key)
+            if rec is None:
+                rec = self._blocking[key] = {
+                    "hot_lock": hot_label, "kind": kind, "site": site,
+                    "detail": detail, "count": 0,
+                    "stack": "".join(traceback.format_stack(frame)),
+                }
+            rec["count"] += 1
+            counter = self._counter
+        if counter is None:
+            counter = self._ensure_counter()
+        if counter is not None:
+            counter.inc()
+
+    def _ensure_counter(self):
+        try:
+            from ..telemetry import metrics as telemetry_metrics
+            with self._mu:
+                if self._counter is None:
+                    self._counter = telemetry_metrics.default_registry(
+                    ).counter(
+                        "mpi_operator_lockcheck_blocking_under_lock_total",
+                        "Blocking calls (second-lock acquire, queue.get, "
+                        "Condition.wait) executed while holding a named "
+                        "hot lock")
+                return self._counter
+        except ImportError:
+            return None
+
+    # -- reporting ---------------------------------------------------------
+
+    def cycles(self) -> List[dict]:
+        with self._mu:
+            return list(self._cycles)
+
+    def blocking_findings(self) -> List[dict]:
+        with self._mu:
+            return sorted(self._blocking.values(),
+                          key=lambda r: -r["count"])
+
+    def report(self) -> dict:
+        with self._mu:
+            return {
+                "edges": len(self._edges),
+                "cycles": list(self._cycles),
+                "blocking_under_hot_lock": sorted(
+                    ({k: v for k, v in rec.items() if k != "stack"}
+                     for rec in self._blocking.values()),
+                    key=lambda r: -r["count"]),
+            }
+
+    def render_report(self, max_blocking: int = 10) -> str:
+        rep = self.report()
+        lines = [f"lockcheck: {rep['edges']} lock-order edges, "
+                 f"{len(rep['cycles'])} cycles, "
+                 f"{len(rep['blocking_under_hot_lock'])} distinct "
+                 f"blocking-under-hot-lock sites"]
+        for cyc in rep["cycles"]:
+            lines.append(f"  CYCLE ({cyc['kind']}): "
+                         + " -> ".join(cyc["sites"]))
+            for i, stack in enumerate(cyc.get("witness", ())):
+                if stack:
+                    lines.append(f"  witness stack {i + 1}:")
+                    lines.extend("    " + ln
+                                 for ln in stack.rstrip().splitlines())
+        for rec in rep["blocking_under_hot_lock"][:max_blocking]:
+            lines.append(
+                f"  blocking under {rec['hot_lock']}: {rec['kind']} at "
+                f"{rec['site']} x{rec['count']}"
+                + (f" ({rec['detail']})" if rec["detail"] else ""))
+        return "\n".join(lines)
+
+    def check_fatal(self):
+        """Raise LockOrderError if any lock-order cycle was observed."""
+        cycles = self.cycles()
+        if cycles:
+            raise LockOrderError(
+                f"{len(cycles)} lock-order cycle(s) detected:\n"
+                + self.render_report())
+
+
+# ---------------------------------------------------------------------------
+# Global install
+
+_detector: Optional[LockCheck] = None
+_install_mu = raw_lock()
+
+
+def detector() -> Optional[LockCheck]:
+    return _detector
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0", "false")
+
+
+def _from_repo(frame) -> bool:
+    fn = frame.f_code.co_filename
+    return fn.startswith(_REPO_ROOT) and f"{os.sep}analysis{os.sep}" \
+        not in fn
+
+
+def _lock_factory():
+    det = _detector
+    if det is None:
+        return raw_lock()
+    caller = sys._getframe(1)
+    if not _from_repo(caller):
+        return raw_lock()
+    site = (f"{os.path.basename(caller.f_code.co_filename)}:"
+            f"{caller.f_lineno}")
+    return det.wrap(raw_lock(), site=site, reentrant=False)
+
+
+def _rlock_factory():
+    det = _detector
+    if det is None:
+        return raw_rlock()
+    caller = sys._getframe(1)
+    if not _from_repo(caller):
+        return raw_rlock()
+    site = (f"{os.path.basename(caller.f_code.co_filename)}:"
+            f"{caller.f_lineno}")
+    return det.wrap(raw_rlock(), site=site, reentrant=True)
+
+
+def _queue_get(self, block=True, timeout=None):
+    det = _detector
+    if det is not None and block:
+        det.note_blocking("queue.get",
+                          f"timeout={timeout!r}")
+    return _raw_queue_get(self, block, timeout)
+
+
+def _condition_wait(self, timeout=None):
+    det = _detector
+    if det is not None:
+        det.note_blocking("Condition.wait", f"timeout={timeout!r}")
+    return _raw_condition_wait(self, timeout)
+
+
+def install() -> LockCheck:
+    """Arm the global detector (idempotent).  Wraps threading.Lock/RLock
+    creation for repo code and patches queue.get/Condition.wait for
+    blocking-under-hot-lock accounting."""
+    global _detector
+    with _install_mu:
+        if _detector is not None:
+            return _detector
+        _detector = LockCheck()
+        threading.Lock = _lock_factory
+        threading.RLock = _rlock_factory
+        queue.Queue.get = _queue_get
+        threading.Condition.wait = _condition_wait
+        return _detector
+
+
+def uninstall():
+    """Disarm and restore the real primitives (already-created proxies
+    keep working — they hold real locks inside)."""
+    global _detector
+    with _install_mu:
+        threading.Lock = raw_lock
+        threading.RLock = raw_rlock
+        queue.Queue.get = _raw_queue_get
+        threading.Condition.wait = _raw_condition_wait
+        _detector = None
+
+
+def install_from_env() -> Optional[LockCheck]:
+    if enabled_by_env():
+        return install()
+    return None
+
+
+def name_lock(lock, name: str):
+    """Register a hot lock by name (apiserver._KindStore, flight.ring,
+    batcher.device_lock, router.state).  No-op when the detector is
+    disarmed (the lock is then a plain primitive)."""
+    if isinstance(lock, _TrackedLock):
+        lock._name = name
+        lock._hot = True
+    return lock
+
+
+def check_fatal():
+    """Fatal gate for smokes/CI: raise if the armed detector saw a
+    lock-order cycle; print the summary line either way."""
+    det = _detector
+    if det is None:
+        return
+    print(det.render_report(max_blocking=5))
+    det.check_fatal()
+
+
+def gate(rc: int) -> int:
+    """Smoke-exit gate (docs/ANALYSIS.md): when the Makefile armed
+    MPI_OPERATOR_LOCKCHECK, a lock-order cycle observed anywhere in the
+    run fails the smoke even if the scenario itself passed.  Usage:
+    ``sys.exit(lockcheck.gate(main()))``."""
+    try:
+        check_fatal()
+    except LockOrderError as exc:
+        print(f"FAIL: {exc}")
+        return rc or 4
+    return rc

@@ -1,0 +1,202 @@
+"""Analyzer self-test: seed one synthetic violation per rule + a
+deliberate lock inversion, assert each is caught.
+
+``python -m mpi_operator_tpu analyze --self-test`` (and the `make
+analyze` gate) runs this so a refactor that silently disables a rule —
+a scope regression, a broken regex, a detached detector — fails CI the
+same way a real violation would.  The synthetic tree lives in a
+tempdir shaped like the repo (package/tests/docs layout), so rule
+scoping is exercised for real; the lockcheck checks run on a PRIVATE
+detector so a globally armed one (tier-1) is never polluted with the
+deliberate inversion.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import tempfile
+import textwrap
+import threading
+from contextlib import contextmanager
+from typing import List, Tuple
+
+from . import lint, lockcheck
+
+# (rule, path-suffix, count) expected from the synthetic tree.
+EXPECTED_STATIC = (
+    ("raw-annotation-key", "mpi_operator_tpu/seeded_annotation.py", 1),
+    ("silent-except", "mpi_operator_tpu/seeded_except.py", 2),
+    ("sleep-poll", "tests/test_seeded_poll.py", 1),
+    ("wallclock-sim", "mpi_operator_tpu/chaos/plan.py", 2),
+    ("metrics-catalog", "mpi_operator_tpu/seeded_metrics.py", 1),
+    ("metrics-catalog", "docs/OBSERVABILITY.md", 1),
+)
+
+_SEEDED_FILES = {
+    # (This module is in lint.CORPUS_FILES — the seed corpus retypes
+    # keys and sleeps in loops by design.)
+    "mpi_operator_tpu/seeded_annotation.py": """\
+        WORKER_ROLE_LABEL = "training.kubeflow.org/job-role"
+    """,
+    "mpi_operator_tpu/seeded_except.py": """\
+        def swallow_bare():
+            try:
+                risky()
+            except:
+                pass
+
+        def swallow_broad(items):
+            for item in items:
+                try:
+                    risky(item)
+                except Exception:
+                    continue
+    """,
+    "tests/test_seeded_poll.py": """\
+        import time
+
+        def test_poll():
+            while not done():
+                time.sleep(0.1)
+    """,
+    "mpi_operator_tpu/chaos/plan.py": """\
+        import random
+        import time
+
+        def seeded_plan():
+            started = time.time()
+            return started + random.random()
+    """,
+    "mpi_operator_tpu/seeded_metrics.py": """\
+        def new_metrics(registry):
+            return registry.counter(
+                "mpi_operator_selftest_undocumented_total",
+                "registered but missing from the catalog")
+    """,
+    "docs/OBSERVABILITY.md": """\
+        | metric | type | layer | meaning |
+        |---|---|---|---|
+        | `mpi_operator_selftest_ghost_total` | counter | x | documented but registered nowhere |
+    """,
+}
+
+
+def _build_tree(root: str) -> None:
+    for relpath, body in _SEEDED_FILES.items():
+        path = os.path.join(root, relpath)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(textwrap.dedent(body))
+
+
+def run_static_selftest() -> List[Tuple[str, bool, str]]:
+    results = []
+    with tempfile.TemporaryDirectory(prefix="analyze-selftest-") as root:
+        _build_tree(root)
+        res = lint.run_lint(root, baseline_path=os.path.join(
+            root, "no_baseline.txt"))
+        for rule_id, suffix, want in EXPECTED_STATIC:
+            got = [f for f in res.findings
+                   if f.rule == rule_id and f.path == suffix]
+            ok = len(got) == want
+            detail = (f"{len(got)}/{want} finding(s) in {suffix}"
+                      + ("" if ok else
+                         f" — got {[f.render() for f in res.findings]}"))
+            results.append((f"lint:{rule_id}@{suffix}", ok, detail))
+        # The seeded tree must produce NOTHING beyond the seeds (rule
+        # precision): every finding maps to an expectation.
+        expected_pairs = {(r, p) for r, p, _ in EXPECTED_STATIC}
+        extras = [f.render() for f in res.findings
+                  if (f.rule, f.path) not in expected_pairs]
+        results.append(("lint:no-extra-findings", not extras,
+                        f"unexpected: {extras}" if extras else "clean"))
+    return results
+
+
+@contextmanager
+def _swapped_detector(det: lockcheck.LockCheck):
+    """Route the module-level blocking patches at a private detector for
+    the duration (restores the armed global one, if any, on exit)."""
+    old_det = lockcheck._detector
+    old_get = queue.Queue.get
+    old_wait = threading.Condition.wait
+    lockcheck._detector = det
+    queue.Queue.get = lockcheck._queue_get
+    threading.Condition.wait = lockcheck._condition_wait
+    try:
+        yield
+    finally:
+        lockcheck._detector = old_det
+        queue.Queue.get = old_get
+        threading.Condition.wait = old_wait
+
+
+def run_lockcheck_selftest() -> List[Tuple[str, bool, str]]:
+    results = []
+    det = lockcheck.LockCheck()
+
+    # Deliberate A->B / B->A inversion (sequential, so it records the
+    # order without actually deadlocking).
+    lock_a = det.wrap(lockcheck.raw_lock(), site="selftest.py:A")
+    lock_b = det.wrap(lockcheck.raw_lock(), site="selftest.py:B")
+    with lock_a:
+        with lock_b:
+            pass
+    with lock_b:
+        with lock_a:
+            pass
+    cycles = det.cycles()
+    ok = any(c["kind"] == "lock-order cycle" for c in cycles)
+    witness_ok = ok and all(
+        len([w for w in c["witness"] if w]) >= 2 for c in cycles
+        if c["kind"] == "lock-order cycle")
+    results.append(("lockcheck:cycle", ok,
+                    f"{len(cycles)} cycle(s) from the seeded inversion"))
+    results.append(("lockcheck:witness-stacks", bool(witness_ok),
+                    "both witness stacks captured" if witness_ok
+                    else "missing witness stacks"))
+    fatal_raised = False
+    try:
+        det.check_fatal()
+    except lockcheck.LockOrderError:
+        fatal_raised = True
+    results.append(("lockcheck:fatal-on-cycle", fatal_raised,
+                    "check_fatal raised LockOrderError"))
+
+    # Blocking call (queue.get) under a named hot lock, through the
+    # real monkeypatched path.
+    det2 = lockcheck.LockCheck()
+    hot = det2.wrap(lockcheck.raw_lock(), site="selftest.py:hot",
+                    name="selftest.hot")
+    with _swapped_detector(det2):
+        with hot:
+            try:
+                queue.Queue().get(timeout=0.01)
+            except queue.Empty:
+                pass
+    blocking = det2.blocking_findings()
+    ok = any(b["kind"] == "queue.get" and b["hot_lock"] == "selftest.hot"
+             for b in blocking)
+    results.append(("lockcheck:blocking-under-hot-lock", ok,
+                    f"{len(blocking)} blocking finding(s)"))
+    return results
+
+
+def run_self_test() -> Tuple[bool, List[str]]:
+    """Returns (all_caught, report_lines)."""
+    results = run_static_selftest() + run_lockcheck_selftest()
+    lines = []
+    seeded = 0
+    for name, ok, detail in results:
+        status = "CAUGHT" if ok else "MISSED"
+        if name.startswith(("lint:no-extra", "lockcheck:witness",
+                            "lockcheck:fatal")):
+            status = "OK" if ok else "FAIL"
+        else:
+            seeded += 1
+        lines.append(f"  {status:6s} {name}: {detail}")
+    all_ok = all(ok for _, ok, _ in results)
+    lines.append(f"self-test: {seeded} seeded violation classes, "
+                 f"{'all caught' if all_ok else 'FAILURES ABOVE'}")
+    return all_ok, lines

@@ -187,3 +187,10 @@ SCHED_RESERVATION_ANNOTATION = "scheduling.kubeflow.org/reservation"
 # Admission condition types (Queued -> Admitted; eviction flips back).
 JOB_QUEUED = "Queued"
 JOB_ADMITTED = "Admitted"
+
+# --- Causal tracing (telemetry/trace.py) --------------------------------
+# Cross-layer trace-context carrier: stamped by the apiserver on MPIJob
+# create, copied onto worker/launcher pods by controller/builders.py,
+# and read in-pod via MPI_OPERATOR_TRACE_CONTEXT
+# (docs/OBSERVABILITY.md "Causal tracing & critical path").
+TRACE_CONTEXT_ANNOTATION = "trace.kubeflow.org/context"

@@ -40,8 +40,8 @@ def run(host: str, command: str, port: int = 22,
         try:
             from ..runtime import netsim
             host = netsim.resolve(host) or host
-        except Exception:
-            pass
+        except (ImportError, OSError):
+            pass  # no netsim/socket weather: use the name as-is
     if not identity:
         raise L.SSHError("no identity file provided")
     key = L.import_privkey_file(identity)  # fail before any connect

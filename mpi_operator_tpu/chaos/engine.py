@@ -95,6 +95,11 @@ class ChaosEngine:
         self.bank = ApiFaultBank(random.Random(
             0 if self.seed is None else self.seed ^ 0x5EED))
         self.events: List[dict] = []
+        # Convergence predicates raced a transient state and raised;
+        # counted (NOT logged — the canonical log must stay byte-stable
+        # across identical seeded runs) so a flapping predicate is
+        # visible to the harness.
+        self.predicate_errors = 0
         self._seq = 0
         self._lock = threading.Lock()
         self._pending_result: Optional[dict] = None
@@ -246,7 +251,9 @@ class ChaosEngine:
                                "result": "ok"})
                     return True
             except Exception:
-                pass  # predicate raced a transient state; retry
+                # Predicate raced a transient state; retry.  Counted,
+                # never canonical-logged (byte-stable replay).
+                self.predicate_errors += 1
             time.sleep(0.1)
         self._log({"event": "converged", "at": None, "kind": "",
                    "target": "", "result": "timeout"})

@@ -17,7 +17,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-from ..k8s.apiserver import ApiError
+from ..api.constants import JOB_NAME_LABEL, JOB_ROLE_LABEL
+from ..k8s.apiserver import TRANSPORT_ERRORS, ApiError
 
 INJECTORS: Dict[str, Callable] = {}
 
@@ -111,8 +112,7 @@ def _resolve_pod(ctx, fault, running_only: bool = True) -> Optional[tuple]:
     pods = [p for p in ctx.server.list("v1", "Pod")
             if not running_only or p.status.phase == core.POD_RUNNING]
     workers = [p for p in pods
-               if p.metadata.labels.get(
-                   "training.kubeflow.org/job-role") == "worker"]
+               if p.metadata.labels.get(JOB_ROLE_LABEL) == "worker"]
     candidates = sorted(workers or pods,
                         key=lambda p: (p.metadata.namespace,
                                        p.metadata.name))
@@ -358,8 +358,7 @@ def inject_event_storm(ctx, fault):
         target_name = pick.metadata.name
     rounds = int(fault.params.get("rounds", 2))
     pods = [p for p in ctx.server.list("v1", "Pod", target_ns)
-            if p.metadata.labels.get("training.kubeflow.org/job-name")
-            == target_name]
+            if p.metadata.labels.get(JOB_NAME_LABEL) == target_name]
     client = ctx.system.client.pods(target_ns)
     bump = getattr(client, "patch_status", None)
     for r in range(rounds):
@@ -372,7 +371,7 @@ def inject_event_storm(ctx, fault):
                     live = client.get(p.metadata.name)
                     live.status.message = f"chaos-storm-{fault.at}-{r}"
                     client.update_status(live)
-            except Exception:
+            except TRANSPORT_ERRORS:
                 continue  # pod churned away mid-storm: storm on
     # Result stays count-free: pod membership during the storm races
     # gang repair, and the canonical log must replay byte-identically.

@@ -218,8 +218,8 @@ def no_surplus_worker_pods(system) -> List[str]:
     for job in jobs:
         try:
             replicas = worker_replicas(job) or 0
-        except Exception:
-            continue
+        except (AttributeError, KeyError, TypeError, ValueError):
+            continue  # malformed spec: demand math undefined, skip
         selector = worker_selector(job.metadata.name)
         bucket = pods_by_job.get(
             (job.metadata.namespace, job.metadata.name), ())

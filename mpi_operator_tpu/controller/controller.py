@@ -208,7 +208,9 @@ class MPIJobController:
         in-flight sync rather than every queued gang sync."""
         try:
             pods = worker_replicas(job) or 0
-        except Exception:
+        except (AttributeError, KeyError, TypeError, ValueError):
+            # Malformed spec: sync_handler surfaces the real error;
+            # classification just needs a lane.
             return PRIORITY_HIGH
         return PRIORITY_HIGH if pods <= self.small_job_pods \
             else PRIORITY_LOW

@@ -24,22 +24,17 @@ from __future__ import annotations
 
 import datetime
 import threading
-import urllib.error
 import uuid
 
-from ..k8s.apiserver import (ApiError, Clientset, is_conflict,
-                             is_not_found)
+from ..k8s.apiserver import (TRANSPORT_ERRORS, ApiError, Clientset,
+                             is_conflict, is_not_found)
 from ..k8s.core import Event, ObjectReference
 from ..k8s.meta import ObjectMeta
 from ..telemetry.flight import record as flight_record
 from ..telemetry.metrics import Counter
 
-# Transport-shaped failures events are allowed to swallow: anything the
-# apiserver or the wire can throw at a correct client.  Everything else
-# (AttributeError from a half-built object, TypeError, ...) is a bug
-# and must surface.
-TRANSPORT_ERRORS = (ApiError, urllib.error.URLError, ConnectionError,
-                    TimeoutError, OSError)
+# Transport-shaped failures events are allowed to swallow
+# (k8s.apiserver.TRANSPORT_ERRORS, the shared project-wide tuple).
 
 # client-go's default spam cap is 25 events/object burst + token
 # refill; here a simple per-namespace retention cap keeps the sim

@@ -36,12 +36,15 @@ substrate-agnostic.
 
 from __future__ import annotations
 
+import http.client as _http_client
 import queue as _queue
 import threading
+import urllib.error as _urllib_error
 import uuid
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from ..analysis.lockcheck import name_lock
 from .meta import Clock, deep_copy, get_controller_of
 from .selectors import match_labels
 
@@ -59,6 +62,25 @@ class ApiError(Exception):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.message = message
+
+
+# Transport-shaped failures a correct client may see from the apiserver
+# or the wire (the PR 3 Recorder precedent, shared project-wide): safe
+# to swallow-and-retry at call sites that tolerate API weather.
+# Everything else (AttributeError from a half-built object, TypeError,
+# ...) is a bug and must surface.
+TRANSPORT_ERRORS = (ApiError, _urllib_error.URLError, ConnectionError,
+                    TimeoutError, OSError, _http_client.HTTPException)
+
+# What a watch-stream pump may swallow-and-reconnect on: the transport
+# tuple plus ValueError (a torn/garbage JSON line mid-stream), KeyError
+# (a parseable line that is not a watch event — e.g. a proxy's JSON
+# error body without "type"/"object" fields), and AttributeError
+# (http.client's torn-stream signature: a read racing a concurrent
+# close() dereferences the already-None response fp).  A pump thread
+# must reconnect on all of these, never die.
+STREAM_ERRORS = TRANSPORT_ERRORS + (ValueError, KeyError,
+                                    AttributeError)
 
 
 def not_found(kind: str, name: str) -> ApiError:
@@ -182,7 +204,9 @@ class _KindStore:
                  "purged_rv")
 
     def __init__(self):
-        self.lock = threading.RLock()
+        # Named hot lock: lockcheck reports blocking calls made while
+        # holding a store lock (docs/ANALYSIS.md).
+        self.lock = name_lock(threading.RLock(), "apiserver._KindStore")
         self.objs: dict = {}      # (namespace, name) -> obj
         self.ns_keys: dict = {}   # namespace -> {key: True}
         self.watches: list = []

@@ -26,7 +26,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from . import registry
-from .apiserver import ApiError, ApiServer, WatchEvent
+from .apiserver import (STREAM_ERRORS, TRANSPORT_ERRORS, ApiError,
+                        ApiServer, WatchEvent)
 
 _ERROR_STATUS = {"NotFound": 404, "AlreadyExists": 409, "Conflict": 409,
                  "Invalid": 422, "Forbidden": 403, "Expired": 410}
@@ -255,14 +256,14 @@ class _RemoteWatch:
                     # (RELIST sentinel) and restart the stream from now.
                     self._rv = None
                     self._q.put(WatchEvent("RELIST", None))
-            except Exception:
-                pass  # connection lost/timed out; fall through to reconnect
+            except STREAM_ERRORS:
+                pass  # connection lost/torn line; fall through to reconnect
             finally:
                 if resp is not None:
                     try:
                         resp.close()
-                    except Exception:
-                        pass
+                    except TRANSPORT_ERRORS:
+                        pass  # already-dead stream
             if self.stopped:
                 return
             # Reconnect with backoff, resuming from the last delivered
@@ -281,8 +282,8 @@ class _RemoteWatch:
         try:
             if self._resp is not None:
                 self._resp.close()
-        except Exception:
-            pass
+        except TRANSPORT_ERRORS:
+            pass  # already-dead stream
 
 
 class RemoteApiServer:

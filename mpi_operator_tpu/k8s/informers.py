@@ -68,6 +68,11 @@ def _counters() -> dict:
             "mpi_operator_resync_dispatches_suppressed_total",
             "Resync relist entries whose resourceVersion matched the"
             " cache: handler dispatch suppressed"),
+        "isolated_errors": reg.counter(
+            "mpi_operator_informer_isolated_errors_total",
+            "Failures isolated inside informer watch/resync loops"
+            " (per-object install faults, relist API weather) instead"
+            " of killing the watch thread"),
     }
 
 
@@ -496,7 +501,7 @@ class SharedInformer:
                     # very condition behind the 410): leave last_resync
                     # untouched so the periodic resync retries on its
                     # original schedule rather than a full fresh interval.
-                    pass
+                    _COUNTERS["isolated_errors"].inc()
                 continue
             # Note: the resync check below must run on EVERY iteration —
             # a `continue` for filtered events would let sustained
@@ -532,6 +537,7 @@ class SharedInformer:
                     # A per-object install failure (index fn bug) must
                     # not kill the watch thread and freeze the cache;
                     # the stale RV lets the periodic resync retry.
+                    _COUNTERS["isolated_errors"].inc()
                     continue
                 self._dispatch(ev.type, old, obj)
             if self._resync_session is not None:
@@ -548,7 +554,8 @@ class SharedInformer:
                 try:
                     self._begin_resync()
                 except Exception:
-                    pass  # transient API failure; next interval retries
+                    # Transient API failure; next interval retries.
+                    _COUNTERS["isolated_errors"].inc()
 
     def _resync(self) -> None:
         """Full relist+diff, run to completion (RELIST recovery in
@@ -637,6 +644,7 @@ class SharedInformer:
                     # choking on one object): leave the old snapshot —
                     # its stale RV makes the next resync retry the key
                     # instead of the suppression path hiding it forever.
+                    _COUNTERS["isolated_errors"].inc()
                     continue
                 updates.append((old, obj))
             if not pending:

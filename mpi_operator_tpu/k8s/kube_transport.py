@@ -37,7 +37,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from . import registry
-from .apiserver import RELIST, ApiError, ApiServer, WatchEvent
+from .apiserver import (RELIST, STREAM_ERRORS, TRANSPORT_ERRORS,
+                        ApiError, ApiServer, WatchEvent)
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -274,14 +275,14 @@ class _KubeWatch:
                     # (same contract as the in-stream ERROR path).
                     self._rv = None
                     pending_relist = True
-            except Exception:
-                pass  # connection lost; fall through to reconnect
+            except STREAM_ERRORS:
+                pass  # connection lost/torn line; fall through to reconnect
             finally:
                 if resp is not None:
                     try:
                         resp.close()
-                    except Exception:
-                        pass
+                    except TRANSPORT_ERRORS:
+                        pass  # already-dead stream
             if self.stopped:
                 return
             time.sleep(backoff)
@@ -303,8 +304,8 @@ class _KubeWatch:
             sock = resp.fp.raw._sock  # type: ignore[union-attr]
             import socket as _socket
             sock.shutdown(_socket.SHUT_RDWR)
-        except Exception:
-            pass
+        except (AttributeError, OSError):
+            pass  # transport without a reachable socket, or already down
 
     def stop(self) -> None:
         self.stopped = True
@@ -318,8 +319,8 @@ class _KubeWatch:
         self._break_connection()
         try:
             resp.close()
-        except Exception:
-            pass
+        except TRANSPORT_ERRORS:
+            pass  # already-dead stream
 
 
 class KubeApiServer:
@@ -480,7 +481,7 @@ def probe_is_kube(master_url: str, timeout: float = 5.0) -> bool:
         with urllib.request.urlopen(req, timeout=timeout,
                                     context=ctx) as resp:
             return json.loads(resp.read()).get("kind") == "APIGroupList"
-    except Exception:
+    except STREAM_ERRORS:
         return False
 
 

@@ -35,7 +35,8 @@ from typing import Optional
 
 from ..api import constants as api_constants
 from ..k8s import core
-from ..k8s.apiserver import ApiServer, Clientset, is_conflict, is_not_found
+from ..k8s.apiserver import (TRANSPORT_ERRORS, ApiServer, Clientset,
+                             is_conflict, is_not_found)
 from ..telemetry import flight
 from . import gangsim, netsim
 
@@ -90,8 +91,8 @@ class _PodRunner:
                 try:
                     cm = self.kubelet.client.config_maps(self.namespace).get(
                         vol.config_map.name)
-                except Exception:
-                    continue
+                except TRANSPORT_ERRORS:
+                    continue  # not created yet / API weather: skip volume
                 items = vol.config_map.items or [
                     core.KeyToPath(k, k) for k in cm.data]
                 for item in items:
@@ -107,8 +108,8 @@ class _PodRunner:
                 try:
                     secret = self.kubelet.client.secrets(self.namespace).get(
                         vol.secret.secret_name)
-                except Exception:
-                    continue
+                except TRANSPORT_ERRORS:
+                    continue  # not created yet / API weather: skip volume
                 items = vol.secret.items or [
                     core.KeyToPath(k, k) for k in secret.data]
                 for item in items:
@@ -356,7 +357,7 @@ class LocalKubelet:
                 try:
                     live = self.client.server.list("v1", "Pod",
                                                    self.namespace)
-                except Exception:
+                except TRANSPORT_ERRORS:
                     continue  # transient API failure; next event heals
                 live_keys = set()
                 for pod in live:

@@ -50,7 +50,8 @@ from ..controller.status import (MPI_JOB_ADMITTED_REASON,
                                  MPI_JOB_SPOT_RECLAIMED_REASON, get_condition,
                                  is_finished, update_job_conditions)
 from ..k8s import core
-from ..k8s.apiserver import Clientset, is_conflict, is_not_found
+from ..k8s.apiserver import (TRANSPORT_ERRORS, Clientset, is_conflict,
+                             is_not_found)
 from ..k8s.meta import Clock, deep_copy
 from ..k8s.quantity import parse_quantity
 from ..k8s.selectors import match_labels
@@ -586,7 +587,7 @@ class GangScheduler:
             return
         try:
             pods = self.client.server.list("v1", "Pod", self.namespace)
-        except Exception:
+        except TRANSPORT_ERRORS:
             return  # API weather: retry next tick
         self._swept = True
         from ..controller import builders
@@ -632,8 +633,8 @@ class GangScheduler:
         noticed = 0
         try:
             pods = self.client.server.list("v1", "Pod", namespace)
-        except Exception:
-            return 0
+        except TRANSPORT_ERRORS:
+            return 0  # API weather: eviction sweep retries the notice
         for pod in pods:
             if not match_labels(selector, pod.metadata.labels):
                 continue
@@ -643,8 +644,8 @@ class GangScheduler:
                 if self.kubelet.inject_preemption(
                         namespace, pod.metadata.name, grace=grace):
                     noticed += 1
-            except Exception:
-                continue
+            except TRANSPORT_ERRORS + (KeyError,):
+                continue  # pod churned away under the notice: next pod
         return noticed
 
     def _finish_due_evictions(self, jobs) -> None:

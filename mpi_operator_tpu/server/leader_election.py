@@ -13,7 +13,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..k8s.apiserver import Clientset, is_conflict, is_not_found
+from ..k8s.apiserver import (TRANSPORT_ERRORS, Clientset, is_conflict,
+                             is_not_found)
 from ..k8s.meta import Clock, ObjectMeta
 
 LEASE_NAME = "mpi-operator"
@@ -72,8 +73,8 @@ class LeaderElector:
             try:
                 leases.create(lease)
                 return True
-            except Exception:
-                return False
+            except TRANSPORT_ERRORS:
+                return False  # lost the create race / API weather
 
         holder = lease.spec.get("holderIdentity")
         renew = lease.spec.get("renewTime")
@@ -110,8 +111,8 @@ class LeaderElector:
                 # instead of waiting out the lease duration.
                 lease.spec.pop("renewTime", None)
                 self.client.leases(self.namespace).update(lease)
-        except Exception:
-            pass
+        except TRANSPORT_ERRORS:
+            pass  # best-effort release; the lease expires on its own
         self.is_leader = False
 
     # -- run loop ------------------------------------------------------------

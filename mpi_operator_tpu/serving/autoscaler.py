@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from ..k8s.apiserver import Clientset
+from ..k8s.apiserver import TRANSPORT_ERRORS, Clientset
 
 
 def histogram_quantile(snapshot: dict, q: float) -> float:
@@ -85,8 +85,8 @@ class ServeAutoscaler:
         was applied, else None."""
         try:
             job = self.client.serve_jobs(self.namespace).get(self.name)
-        except Exception:
-            return None
+        except TRANSPORT_ERRORS:
+            return None  # ServeJob gone / API weather: next poll
         auto = job.spec.autoscale
         if auto is None:
             return None
@@ -118,8 +118,8 @@ class ServeAutoscaler:
                 self.client.serve_jobs(self.namespace).patch_status(
                     self.name, desired_replicas=desired,
                     scaling_reason="up: traffic while scaled to zero")
-            except Exception:
-                return None
+            except TRANSPORT_ERRORS:
+                return None  # apiserver weather: next poll re-asserts
             self.transitions.append(
                 (current, desired, "up: traffic while scaled to zero"))
             return desired
@@ -163,7 +163,7 @@ class ServeAutoscaler:
             self.client.serve_jobs(self.namespace).patch_status(
                 self.name, desired_replicas=desired,
                 scaling_reason=reason)
-        except Exception:
+        except TRANSPORT_ERRORS:
             return None  # apiserver weather: next poll re-asserts
         if desired != current:
             self.transitions.append((current, desired, reason))

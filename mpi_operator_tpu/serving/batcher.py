@@ -62,6 +62,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..analysis.lockcheck import name_lock
 from ..models.llama import select_rows as _select_rows
 from ..telemetry.metrics import Registry, new_serving_metrics
 
@@ -295,7 +296,10 @@ class ContinuousBatcher:
         # non-batched generate path) so at most one model computation is
         # in flight at a time; taken per decode tick / prefill, not for
         # whole generations.
-        self._device_lock = device_lock or threading.Lock()
+        # Named hot lock: blocking here stalls every decode tick
+        # (docs/ANALYSIS.md, lockcheck).
+        self._device_lock = name_lock(device_lock or threading.Lock(),
+                                      "batcher.device_lock")
 
         cfg = model.config
         if getattr(cfg, "page_size", 0) > 0:

@@ -147,8 +147,11 @@ class ServeReplicaRunner:
             self.router.remove_replica(key[1])
         try:
             srv.stop()
-        except Exception:
-            pass
+        except Exception as exc:
+            # A wedged server must not block teardown of the rest of
+            # the fleet; the flight ring keeps the evidence.
+            flight.record("serving", "replica_stop_error",
+                          pod=f"{key[0]}/{key[1]}", error=repr(exc))
         flight.record("serving", "replica_down",
                       pod=f"{key[0]}/{key[1]}", graceful=graceful)
 

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 from ..telemetry.metrics import (Registry, expose_with_defaults,
                                  new_router_metrics, record_build_info)
+from ..analysis.lockcheck import name_lock
 from ..telemetry.trace import TraceContext, default_tracer
 from .batcher import prefix_page_digests
 
@@ -158,7 +159,9 @@ class FleetRouter:
         self.telemetry = new_router_metrics(self.telemetry_registry)
         self._replicas: Dict[str, _Replica] = {}
         self._sessions: Dict[str, str] = {}  # session -> replica name
-        self._lock = threading.Lock()
+        # Named hot lock: blocking here serializes every placement
+        # (docs/ANALYSIS.md, lockcheck).
+        self._lock = name_lock(threading.Lock(), "router.state")
         self._rng = random.Random(seed)
         self._rr_counter = 0
         self._page_size = 0

@@ -50,6 +50,7 @@ import time
 from collections import deque
 from typing import Iterable, List, Optional
 
+from ..analysis.lockcheck import name_lock
 from .metrics import expose_with_defaults
 from .trace import default_tracer
 
@@ -103,7 +104,9 @@ class FlightRecorder:
     def __init__(self, max_records: int = 4096):
         self.max_records = max_records
         self._records: deque = deque(maxlen=max_records)
-        self._lock = threading.Lock()
+        # Named hot lock: every layer records through the ring; blocking
+        # while holding it stalls them all (docs/ANALYSIS.md).
+        self._lock = name_lock(threading.Lock(), "flight.ring")
         self._seq = 0
 
     def record(self, layer: str, kind: str, /, **data) -> dict:
@@ -625,7 +628,9 @@ def install_crash_handler(directory: Optional[str] = None,
     def _registry():
         try:
             return registry() if callable(registry) else registry
-        except Exception:
+        # Crash path: a failing late-bound registry thunk must never
+        # mask the real crash being dumped.
+        except Exception:  # lint: allow[silent-except]
             return None
 
     def _hook(exc_type, exc, tb):

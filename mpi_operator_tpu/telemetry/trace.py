@@ -42,8 +42,10 @@ from typing import Iterable, List, Optional
 # Cross-layer context carriers: the annotation rides MPIJob -> Pod
 # objects through the API, the env var rides the pod spec into the
 # workload process (controller/builders.py injects it; runtime/kubelet
-# passes it through).
-TRACE_CONTEXT_ANNOTATION = "trace.kubeflow.org/context"
+# passes it through).  The annotation key lives in api/constants.py
+# with every other wire-format key; re-exported here for callers.
+from ..api.constants import TRACE_CONTEXT_ANNOTATION  # noqa: E402,F401
+
 TRACE_CONTEXT_ENV = "MPI_OPERATOR_TRACE_CONTEXT"
 
 
@@ -110,6 +112,9 @@ class Tracer:
         self._local = threading.local()
         # Completion listeners (flight recorder feed); see add_listener.
         self._listeners: list = []
+        # Listener callbacks that raised (they must never fail the
+        # traced code, but the drops must be visible — PR 3 precedent).
+        self.listener_errors = 0
 
     def add_listener(self, fn) -> None:
         """Register ``fn(event_dict)`` to run on every span completion
@@ -178,7 +183,8 @@ class Tracer:
                 try:
                     fn(event)
                 except Exception:
-                    pass  # listeners must never fail the traced code
+                    # Listeners must never fail the traced code.
+                    self.listener_errors += 1
 
     def emit(self, name: str, ts: float, dur: float,
              ctx: Optional[TraceContext] = None,
@@ -211,7 +217,8 @@ class Tracer:
             try:
                 fn(event)
             except Exception:
-                pass  # listeners must never fail the traced code
+                # Listeners must never fail the traced code.
+                self.listener_errors += 1
         return event
 
     def current_span(self) -> Optional[dict]:

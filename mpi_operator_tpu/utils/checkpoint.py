@@ -286,7 +286,9 @@ class CheckpointManager:
                               step=step, in_flight_bytes=nbytes,
                               error=repr(exc))
                 flight.dump_bundle("checkpoint-writer-error")
-            except Exception:
+            # Failure path: best-effort telemetry must never mask the
+            # stored writer error (re-raised at the next save point).
+            except Exception:  # lint: allow[silent-except]
                 pass
             with self._lock:
                 self._completed_since_poll = True

@@ -1,0 +1,53 @@
+"""Condition-driven waits for tests and smokes.
+
+The PR 10 deflake class: a test that hand-rolls ``while ...:
+time.sleep(0.2)`` either flakes (deadline too tight for a loaded
+1-core host) or wastes wall clock (interval too coarse — the condition
+turned true 190ms ago).  The ``sleep-poll`` lint rule
+(docs/ANALYSIS.md) bans the hand-rolled form in tests/ and
+tools/*_smoke.py; this module is the sanctioned replacement: one
+deadline-bounded primitive with a tight default interval, a uniform
+TimeoutError that names the condition, and the final predicate value
+returned so call sites assert on data instead of re-reading state.
+
+Prefer a real watch (``cluster.wait_for``, informer handlers,
+``threading.Event``) when the subsystem offers one; ``wait_until`` is
+for conditions only observable by probing (HTTP endpoints, metric
+counters, file existence, subprocess state).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def wait_until(predicate: Callable[[], T], timeout: float = 30.0,
+               interval: float = 0.02, desc: str = "condition",
+               on_timeout: Optional[Callable[[], str]] = None) -> T:
+    """Poll ``predicate`` until it returns a truthy value (returned), or
+    raise TimeoutError after ``timeout`` seconds.
+
+    ``desc`` names the condition in the timeout error; ``on_timeout``
+    (optional) contributes late diagnostics (e.g. the state actually
+    observed) to the message.  The predicate is always evaluated one
+    final time at the deadline, so a condition that turns true in the
+    last interval still passes.
+    """
+    deadline = time.monotonic() + timeout
+    while True:
+        value = predicate()
+        if value:
+            return value
+        if time.monotonic() >= deadline:
+            detail = ""
+            if on_timeout is not None:
+                try:
+                    detail = f" ({on_timeout()})"
+                except Exception as exc:  # diagnostics must not mask
+                    detail = f" (diagnostic failed: {exc!r})"
+            raise TimeoutError(
+                f"timed out after {timeout}s waiting for {desc}{detail}")
+        time.sleep(interval)

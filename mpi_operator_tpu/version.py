@@ -22,7 +22,7 @@ def _git_sha() -> str:
             cwd=os.path.dirname(os.path.abspath(__file__)),
             capture_output=True, text=True, timeout=5,
         ).stdout.strip() or "unknown"
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
         return "unknown"
 
 

@@ -24,12 +24,26 @@ os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
 # mutates a cached object in place fails loudly here instead of
 # corrupting sibling readers in production (k8s/informers.py).
 os.environ.setdefault("MPI_OPERATOR_CACHE_MUTATION_DETECT", "1")
+# Runtime lock-order detector armed for ALL of tier-1
+# (analysis/lockcheck.py, docs/ANALYSIS.md): every threading.Lock/RLock
+# created by repo code records per-thread acquisition order; a
+# lock-order cycle (potential deadlock) observed anywhere in the suite
+# fails the session at exit (pytest_sessionfinish below).  Must be set
+# before the first mpi_operator_tpu import — the package installs the
+# wrapper at import time.
+os.environ.setdefault("MPI_OPERATOR_LOCKCHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Arm the lock-order detector NOW (package import installs the
+# threading.Lock/RLock wrapper) so even the first test's locks are
+# tracked — importing lazily would leave everything created before the
+# first mpi_operator_tpu import invisible.
+import mpi_operator_tpu  # noqa: E402,F401  (installs via env gate above)
 
 # The sitecustomize hook imports jax at interpreter startup (before this
 # file runs), so env vars alone can arrive too late for the in-process
@@ -44,6 +58,25 @@ except AttributeError:
     # XLA_FLAGS --xla_force_host_platform_device_count fallback above
     # provides the 8-device CPU mesh there.
     pass
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fatal-on-cycle gate: the whole suite ran with lockcheck armed;
+    any observed lock-order cycle fails the run even if every test
+    passed (the cycle is a latent deadlock, not a test failure)."""
+    from mpi_operator_tpu.analysis import lockcheck
+
+    det = lockcheck.detector()
+    if det is None:
+        return
+    rep = det.report()
+    print(f"\nlockcheck: {rep['edges']} lock-order edges, "
+          f"{len(rep['cycles'])} cycles, "
+          f"{len(rep['blocking_under_hot_lock'])} distinct "
+          f"blocking-under-hot-lock sites")
+    if rep["cycles"]:
+        print(det.render_report())
+        session.exitstatus = 3
 
 
 # --- shared serving test helpers ------------------------------------------

@@ -1,0 +1,489 @@
+"""Correctness tooling tests: lint engine + runtime lock-order detector
+(mpi_operator_tpu/analysis/, docs/ANALYSIS.md).
+
+Covers: per-rule positives AND negatives on inline snippets, pragma
+suppression, baseline add/suppress/expiry semantics, lock-order cycle
+detection with witness stacks (plus the same-site instance-pair rule),
+blocking-under-hot-lock through the real monkeypatched paths, the
+seeded self-test end-to-end, and the standing gate: a zero-finding run
+over the real tree with the shipped (empty) baseline.
+"""
+
+import os
+import queue
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from mpi_operator_tpu.analysis import lint, lockcheck, selftest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# lint: rule unit tests on inline snippets
+
+
+def _lint_tree(tmp_path, files):
+    for relpath, body in files.items():
+        p = tmp_path / relpath
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return lint.run_lint(str(tmp_path),
+                         baseline_path=str(tmp_path / "no_baseline"))
+
+
+def _rules_hit(res):
+    return {(f.rule, f.path) for f in res.findings}
+
+
+def test_raw_annotation_key_positive_and_negative(tmp_path):
+    res = _lint_tree(tmp_path, {
+        "mpi_operator_tpu/bad.py": '''
+            KEY = "scheduling.kubeflow.org/queue-name"
+        ''',
+        "mpi_operator_tpu/good.py": '''
+            """Docstrings may name scheduling.kubeflow.org/queue-name."""
+            GV = "kubeflow.org/v2beta1"   # apiVersion, not a key
+            from .api.constants import QUEUE_NAME_LABEL
+        ''',
+        "mpi_operator_tpu/api/constants.py": '''
+            QUEUE_NAME_LABEL = "scheduling.kubeflow.org/queue-name"
+        ''',
+    })
+    assert _rules_hit(res) == {("raw-annotation-key",
+                                "mpi_operator_tpu/bad.py")}
+    assert len(res.findings) == 1
+
+
+def test_silent_except_positive_and_negative(tmp_path):
+    res = _lint_tree(tmp_path, {
+        "mpi_operator_tpu/bad.py": '''
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        ''',
+        "mpi_operator_tpu/good.py": '''
+            def narrow():
+                try:
+                    g()
+                except (OSError, ValueError):
+                    pass  # typed: not flagged
+
+            def counted():
+                try:
+                    g()
+                except Exception:
+                    DROPS.inc()  # broad but recorded: not flagged
+
+            def reraised():
+                try:
+                    g()
+                except Exception:
+                    raise
+
+            def flagged_state(ok):
+                try:
+                    g()
+                except Exception:
+                    ok = False  # records into state: not flagged
+                return ok
+        ''',
+        "tests/test_outside_pkg.py": '''
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass  # outside control-plane scope: not flagged
+        ''',
+    })
+    assert _rules_hit(res) == {("silent-except", "mpi_operator_tpu/bad.py")}
+
+
+def test_sleep_poll_positive_and_negative(tmp_path):
+    res = _lint_tree(tmp_path, {
+        "tests/test_bad.py": '''
+            import time
+
+            def test_poll():
+                while not done():
+                    time.sleep(0.1)
+        ''',
+        "tests/test_good.py": '''
+            import time
+
+            def test_single_sleep():
+                time.sleep(0.1)  # not in a loop: not flagged
+
+            def test_loop_spawns_sleeper():
+                for _ in range(3):
+                    spawn("import time; time.sleep(30)")  # string payload
+
+            def test_nested_def_resets_loop():
+                for _ in range(3):
+                    def later():
+                        time.sleep(0.1)  # runs outside the loop
+                    register(later)
+        ''',
+        "mpi_operator_tpu/pkg_code.py": '''
+            import time
+
+            def run():
+                while True:
+                    time.sleep(0.1)  # package scope: rule is test-only
+        ''',
+        "tools/helper.py": '''
+            import time
+
+            def run():
+                while True:
+                    time.sleep(0.1)  # tools/ but not *_smoke.py
+        ''',
+        "tools/x_smoke.py": '''
+            import time
+
+            def run():
+                while True:
+                    time.sleep(0.1)  # smoke scope: flagged
+        ''',
+    })
+    assert _rules_hit(res) == {("sleep-poll", "tests/test_bad.py"),
+                               ("sleep-poll", "tools/x_smoke.py")}
+
+
+def test_wallclock_sim_positive_and_negative(tmp_path):
+    res = _lint_tree(tmp_path, {
+        "mpi_operator_tpu/sched/topology.py": '''
+            import random
+            import time
+
+            def bad():
+                return time.time() + random.random() + \\
+                    random.Random().random()
+        ''',
+        "mpi_operator_tpu/chaos/plan.py": '''
+            import random
+            import time
+
+            def good(seed):
+                rng = random.Random(seed)     # seeded: fine
+                return rng.random() + time.perf_counter()  # perf ok
+        ''',
+        "mpi_operator_tpu/sched/scheduler.py": '''
+            import time
+
+            def live():
+                return time.time()  # outside the sim substrate
+        ''',
+    })
+    bad = [f for f in res.findings
+           if f.path == "mpi_operator_tpu/sched/topology.py"]
+    assert len(bad) == 3  # time.time, random.random, unseeded Random()
+    assert _rules_hit(res) == {("wallclock-sim",
+                                "mpi_operator_tpu/sched/topology.py")}
+
+
+def test_metrics_catalog_both_directions(tmp_path):
+    res = _lint_tree(tmp_path, {
+        "mpi_operator_tpu/m.py": '''
+            def new_metrics(reg):
+                return {
+                    "documented": reg.counter(
+                        "mpi_operator_docd_total", "in the catalog"),
+                    "undocumented": reg.counter(
+                        "mpi_operator_undocd_total", "missing"),
+                }
+        ''',
+        "docs/OBSERVABILITY.md": '''
+            | `mpi_operator_docd_total` | counter | x | documented |
+            | `mpi_operator_ghost_total` | counter | x | nowhere in code |
+            | `serving_ghost_total` | counter | x | any family with an underscore counts |
+            | `serving` | gauge | x | layer name (no underscore): ignored |
+        ''',
+    })
+    hits = {(f.rule, f.path, f.message.split("'")[1])
+            for f in res.findings}
+    assert hits == {
+        ("metrics-catalog", "mpi_operator_tpu/m.py",
+         "mpi_operator_undocd_total"),
+        ("metrics-catalog", "docs/OBSERVABILITY.md",
+         "mpi_operator_ghost_total"),
+        ("metrics-catalog", "docs/OBSERVABILITY.md",
+         "serving_ghost_total"),
+    }
+
+
+def test_pragma_suppresses_on_line_and_line_above(tmp_path):
+    res = _lint_tree(tmp_path, {
+        "mpi_operator_tpu/p.py": '''
+            A = "serving.kubeflow.org/url"  # lint: allow[raw-annotation-key] x
+            # lint: allow[raw-annotation-key] — seeded corpus
+            B = "serving.kubeflow.org/url"
+            C = "serving.kubeflow.org/url"  # no pragma: flagged
+        ''',
+    })
+    assert len(res.findings) == 1
+    assert res.findings[0].line == 5
+    assert len(res.pragma_suppressed) == 2
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+
+
+def test_baseline_add_suppress_expiry(tmp_path):
+    files = {
+        "mpi_operator_tpu/b.py": '''
+            K1 = "serving.kubeflow.org/url"
+            K2 = "scheduling.kubeflow.org/priority"
+        ''',
+    }
+    for relpath, body in files.items():
+        p = tmp_path / relpath
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    baseline = tmp_path / "baseline.txt"
+
+    # No baseline: both findings fail the run.
+    res = lint.run_lint(str(tmp_path), baseline_path=str(baseline))
+    assert len(res.findings) == 2 and not res.ok
+
+    # --write-baseline (add): everything grandfathered, run is clean.
+    lint.write_baseline(str(baseline), str(tmp_path), res.findings)
+    res = lint.run_lint(str(tmp_path), baseline_path=str(baseline))
+    assert res.ok and len(res.baselined) == 2 and not res.findings
+
+    # A NEW violation still fails while old ones stay suppressed.
+    p = tmp_path / "mpi_operator_tpu/b.py"
+    p.write_text(p.read_text()
+                 + 'K3 = "trace.kubeflow.org/context"\n')
+    res = lint.run_lint(str(tmp_path), baseline_path=str(baseline))
+    assert len(res.findings) == 1 and "trace.kubeflow.org" in \
+        res.findings[0].message
+    assert len(res.baselined) == 2
+
+    # Burn-down (expiry): fixing a grandfathered finding makes its
+    # entry STALE, which fails the run until the entry is removed.
+    p.write_text('K2 = "scheduling.kubeflow.org/priority"\n')
+    res = lint.run_lint(str(tmp_path), baseline_path=str(baseline))
+    assert not res.findings  # K2 still baselined, K1/K3 gone
+    assert len(res.stale_baseline) == 1 and not res.ok
+
+    # Malformed entries are a hard error, not silently skipped.
+    baseline.write_text("not-a-valid-entry\n")
+    with pytest.raises(ValueError):
+        lint.run_lint(str(tmp_path), baseline_path=str(baseline))
+
+
+def test_baseline_fingerprint_survives_line_motion(tmp_path):
+    p = tmp_path / "mpi_operator_tpu/b.py"
+    p.parent.mkdir(parents=True)
+    p.write_text('K = "serving.kubeflow.org/url"\n')
+    baseline = tmp_path / "baseline.txt"
+    res = lint.run_lint(str(tmp_path), baseline_path=str(baseline))
+    lint.write_baseline(str(baseline), str(tmp_path), res.findings)
+    # Unrelated lines added above: the fingerprint (line text, not
+    # number) still matches, so the entry neither fails nor staleates.
+    p.write_text('import os\n\nX = 1\nK = "serving.kubeflow.org/url"\n')
+    res = lint.run_lint(str(tmp_path), baseline_path=str(baseline))
+    assert res.ok and len(res.baselined) == 1
+
+
+# ---------------------------------------------------------------------------
+# lockcheck
+
+
+def test_lock_order_cycle_with_witness_stacks():
+    det = lockcheck.LockCheck()
+    a = det.wrap(lockcheck.raw_lock(), site="a.py:1")
+    b = det.wrap(lockcheck.raw_lock(), site="b.py:1")
+
+    def order(first, second):
+        with first:
+            with second:
+                pass
+
+    t = threading.Thread(target=order, args=(a, b))
+    t.start()
+    t.join()
+    assert det.cycles() == []  # one order alone is fine
+    t = threading.Thread(target=order, args=(b, a))
+    t.start()
+    t.join()
+    cycles = det.cycles()
+    assert len(cycles) == 1 and cycles[0]["kind"] == "lock-order cycle"
+    assert set(cycles[0]["sites"]) == {"a.py:1", "b.py:1"}
+    witnesses = [w for w in cycles[0]["witness"] if w]
+    assert len(witnesses) >= 2  # both acquisition stacks captured
+    assert all("order" in w for w in witnesses)  # test frames visible
+    with pytest.raises(lockcheck.LockOrderError):
+        det.check_fatal()
+
+
+def test_consistent_order_and_rlock_reentry_are_clean():
+    det = lockcheck.LockCheck()
+    a = det.wrap(lockcheck.raw_lock(), site="a.py:1")
+    b = det.wrap(lockcheck.raw_lock(), site="b.py:1")
+    r = det.wrap(lockcheck.raw_rlock(), site="r.py:1", reentrant=True)
+    for _ in range(3):
+        with a:
+            with b:
+                with r:
+                    with r:  # reentrant re-acquire: no self-edge
+                        pass
+    assert det.cycles() == []
+    det.check_fatal()  # does not raise
+    assert det.report()["edges"] >= 2
+
+
+def test_same_site_instance_pair_inversion():
+    det = lockcheck.LockCheck()
+    # Two locks born at the SAME site (per-shard siblings).
+    s1 = det.wrap(lockcheck.raw_lock(), site="store.py:42")
+    s2 = det.wrap(lockcheck.raw_lock(), site="store.py:42")
+    s3 = det.wrap(lockcheck.raw_lock(), site="store.py:42")
+    # A globally-ordered walk (s1->s2, s2->s3) must stay clean...
+    with s1:
+        with s2:
+            pass
+    with s2:
+        with s3:
+            pass
+    assert det.cycles() == []
+    # ...but BOTH orders of the SAME pair is a real inversion.
+    with s2:
+        with s1:
+            pass
+    cycles = det.cycles()
+    assert len(cycles) == 1
+    assert cycles[0]["kind"] == "same-site instance inversion"
+    assert len([w for w in cycles[0]["witness"] if w]) == 2
+
+
+def test_blocking_under_hot_lock_via_patched_paths():
+    det = lockcheck.LockCheck()
+    hot = det.wrap(lockcheck.raw_lock(), site="hot.py:1",
+                   name="test.hot")
+    cold = det.wrap(lockcheck.raw_lock(), site="cold.py:1")
+    with selftest._swapped_detector(det):
+        with hot:
+            try:
+                queue.Queue().get(timeout=0.01)   # patched queue.get
+            except queue.Empty:
+                pass
+            with cold:                            # second-lock acquire
+                pass
+        with cold:
+            pass  # no hot lock held: nothing recorded
+    kinds = {(b["kind"], b["hot_lock"]) for b in det.blocking_findings()}
+    assert ("queue.get", "test.hot") in kinds
+    assert ("lock.acquire", "test.hot") in kinds
+    # Cold-only section contributed nothing.
+    assert all(b["hot_lock"] == "test.hot"
+               for b in det.blocking_findings())
+    # The counter observed the events on the default registry.
+    from mpi_operator_tpu.telemetry.metrics import default_registry
+    ctr = default_registry().get(
+        "mpi_operator_lockcheck_blocking_under_lock_total")
+    assert ctr is not None and ctr.value >= 2
+
+
+def test_condition_wait_under_hot_lock_detected():
+    det = lockcheck.LockCheck()
+    hot = det.wrap(lockcheck.raw_lock(), site="hot.py:2",
+                   name="test.hot2")
+    cond = threading.Condition()
+    with selftest._swapped_detector(det):
+        with hot:
+            with cond:
+                cond.wait(timeout=0.01)
+    assert any(b["kind"] == "Condition.wait"
+               for b in det.blocking_findings())
+
+
+def test_tracked_proxy_behaves_like_a_lock():
+    det = lockcheck.LockCheck()
+    lock = det.wrap(lockcheck.raw_lock())
+    assert lock.acquire(blocking=False)
+    assert lock.locked()
+    assert not lock.acquire(blocking=False)  # contended non-blocking
+    lock.release()
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()
+    rl = det.wrap(lockcheck.raw_rlock(), reentrant=True)
+    with rl:
+        with rl:
+            pass
+    assert det.cycles() == []
+
+
+def test_global_install_tracks_repo_locks_only():
+    # Tier-1 runs armed via conftest; the global detector must exist
+    # and repo-created locks must come back as tracked proxies while
+    # stdlib-created locks stay raw.
+    det = lockcheck.detector()
+    assert det is not None, "conftest should have armed lockcheck"
+    probe = threading.Lock()  # this file is repo code -> proxy
+    try:
+        assert isinstance(probe, lockcheck._TrackedLock)
+    finally:
+        pass
+    q = queue.Queue()  # queue.py creates its own locks -> raw
+    assert not isinstance(q.mutex, lockcheck._TrackedLock)
+    # Condition() allocates its RLock inside threading.py -> raw.
+    assert not isinstance(threading.Condition()._lock,
+                          lockcheck._TrackedLock)
+
+
+# ---------------------------------------------------------------------------
+# self-test + the standing gates
+
+
+def test_self_test_catches_every_seeded_violation():
+    ok, lines = selftest.run_self_test()
+    assert ok, "\n".join(lines)
+    caught = [ln for ln in lines if ln.lstrip().startswith("CAUGHT")]
+    # >= 8 distinct seeded violation classes (>=1 per rule + the lock
+    # inversion + blocking-under-hot-lock).
+    assert len(caught) >= 8
+
+
+def test_real_tree_is_clean_with_shipped_baseline():
+    """The CI gate, inside tier-1: zero non-baselined findings and zero
+    stale entries over the actual repo with the checked-in baseline."""
+    res = lint.run_lint(REPO)
+    assert res.files_scanned > 100
+    rendered = "\n".join(f.render() for f in res.findings)
+    assert not res.findings, f"lint findings:\n{rendered}"
+    assert not res.stale_baseline, res.stale_baseline
+
+
+def test_analyze_cli_self_test_subprocess():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu", "analyze",
+         "--self-test"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "MPI_OPERATOR_LOCKCHECK": "0"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all caught" in proc.stdout
+
+
+def test_analyze_cli_clickable_output_on_violation(tmp_path):
+    bad = tmp_path / "mpi_operator_tpu" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text('K = "serving.kubeflow.org/url"\n')
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu", "analyze",
+         "--root", str(tmp_path),
+         "--baseline", str(tmp_path / "nonexistent")],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "MPI_OPERATOR_LOCKCHECK": "0"})
+    assert proc.returncode == 1
+    # path:line rule-id message — the clickable contract.
+    assert "mpi_operator_tpu/bad.py:1 raw-annotation-key" in proc.stdout

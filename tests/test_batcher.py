@@ -10,6 +10,7 @@ from mpi_operator_tpu.models.llama import (LlamaModel, greedy_generate,
 from mpi_operator_tpu.serving.batcher import ContinuousBatcher
 
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 
 
 @pytest.fixture(scope="module")
@@ -511,10 +512,8 @@ def test_cancelled_deferred_request_is_reaped_without_retirement():
         req_a = batcher._enqueue(list(range(1, 41)), 216, 0.0, 1.0, 0)
         # B needs 2 blocks > 1 free -> deferred; then its client dies.
         req_b = batcher._enqueue(list(range(1, 17)), 8, 0.0, 1.0, 0)
-        import time
-        deadline = time.monotonic() + 10
-        while not req_a.output and time.monotonic() < deadline:
-            time.sleep(0.01)  # A admitted (prefill emitted its token)
+        wait_until(lambda: req_a.output, timeout=10, interval=0.005,
+                   desc="req_a admission (first prefill token)")
         req_b.cancelled.set()
         # C fits in the free block; admission must reach it while A is
         # still decoding (no retirement has bumped _retire_count).

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mpi_operator_tpu.utils.waiters import wait_until
 from mpi_operator_tpu.models.llama import (LlamaModel, greedy_generate,
                                            llama2_tiny)
 from mpi_operator_tpu.serving.batcher import (ContinuousBatcher,
@@ -160,10 +161,8 @@ def test_cancel_between_dispatch_and_fetch():
                           pipelined=True).start()
     try:
         req = b._enqueue([4, 2, 7], 200, 0.0, 1.0, 0)
-        deadline = time.monotonic() + 30
-        while len(req.output) < 3 and time.monotonic() < deadline:
-            time.sleep(0.001)
-        assert len(req.output) >= 3
+        wait_until(lambda: len(req.output) >= 3, timeout=30,
+                   interval=0.001, desc="three streamed tokens")
         # In pipelined steady state there is always a dispatched,
         # unfetched step; this cancel lands inside that window.
         req.cancelled.set()
@@ -191,9 +190,8 @@ def test_cancel_while_deferred_under_pipeline():
     try:
         req_a = b._enqueue(list(range(1, 41)), 216, 0.0, 1.0, 0)
         req_b = b._enqueue(list(range(1, 17)), 8, 0.0, 1.0, 0)
-        deadline = time.monotonic() + 10
-        while not req_a.output and time.monotonic() < deadline:
-            time.sleep(0.01)
+        wait_until(lambda: req_a.output, timeout=10, interval=0.005,
+                   desc="req_a first token")
         req_b.cancelled.set()
         out_c = b.submit([5, 6, 7, 8], 4, timeout=30)
         assert len(out_c) == 4
@@ -221,9 +219,8 @@ def test_one_transfer_and_dispatch_per_steady_tick():
         assert transfers == ticks
         # The final dispatched-ahead overrun step drains shortly after
         # submit() returns; poll rather than race the scheduler.
-        deadline = time.monotonic() + 10
-        while tm["pipeline_depth"].value and time.monotonic() < deadline:
-            time.sleep(0.005)
+        wait_until(lambda: not tm["pipeline_depth"].value, timeout=10,
+                   interval=0.005, desc="pipeline depth to drain to 0")
         assert tm["pipeline_depth"].value == 0
         # Dispatches may exceed fetched ticks by dropped overrun steps,
         # never the other way around.
@@ -293,9 +290,8 @@ def test_queue_wait_histogram_direct_and_deferred():
         # A pins 16 of 17 usable blocks -> B (2 blocks) defers until A
         # retires, then admits through the deferred path.
         req_a = b._enqueue(list(range(1, 41)), 216, 0.0, 1.0, 0)
-        deadline = time.monotonic() + 10
-        while not req_a.output and time.monotonic() < deadline:
-            time.sleep(0.01)
+        wait_until(lambda: req_a.output, timeout=10, interval=0.005,
+                   desc="req_a first token")
         out_b = b.submit(list(range(1, 17)), 4, timeout=60)
         assert req_a.done.wait(60) and len(out_b) == 4
         assert direct.count >= d0 + 1      # A admitted directly

@@ -14,6 +14,7 @@ import threading
 import time
 
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 
 from mpi_operator_tpu import chaos
 from mpi_operator_tpu.api import constants
@@ -147,9 +148,8 @@ def test_elastic_watch_hosts_holds_membership_under_partition(tmp_path):
 
     t = threading.Thread(target=consume, daemon=True)
     t.start()
-    deadline = time.monotonic() + 5
-    while not seen and time.monotonic() < deadline:
-        time.sleep(0.01)
+    wait_until(lambda: seen, timeout=5, interval=0.01,
+               desc="initial membership read")
     assert seen == [["a.svc", "b.svc"]]
 
     # Partition: the script vanishes (volume mid-refresh / control
@@ -168,9 +168,8 @@ def test_elastic_watch_hosts_holds_membership_under_partition(tmp_path):
 
     # A REAL membership change after the heal is still observed.
     script.write_text("#!/bin/sh\necho a.svc\n")
-    deadline = time.monotonic() + 5
-    while len(seen) < 2 and time.monotonic() < deadline:
-        time.sleep(0.01)
+    wait_until(lambda: len(seen) >= 2, timeout=5, interval=0.01,
+               desc="membership change to be observed")
     assert seen[-1] == ["a.svc"]
     assert registry.get("elastic_resyncs_total").value == 1
     stop.set()

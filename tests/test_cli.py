@@ -10,6 +10,8 @@ import time
 
 import pytest
 
+from mpi_operator_tpu.utils.waiters import wait_until
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -44,16 +46,16 @@ def test_cli_cluster_submit_get_lifecycle(tmp_path):
          str(port)], env=env, cwd=REPO_ROOT,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     try:
-        deadline = time.monotonic() + 20
-        up = False
-        while time.monotonic() < deadline and not up:
+        def port_open():
             try:
                 with socket.create_connection(("127.0.0.1", port),
                                               timeout=1):
-                    up = True
+                    return True
             except OSError:
-                time.sleep(0.2)
-        assert up, "cluster apiserver never came up"
+                return False
+
+        wait_until(port_open, timeout=20, interval=0.1,
+                   desc="cluster apiserver to come up")
 
         job_yaml = tmp_path / "job.yaml"
         job_yaml.write_text(f"""
@@ -115,13 +117,16 @@ def test_cli_describe(tmp_path):
          str(port)], env=env, cwd=REPO_ROOT,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     try:
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline:
+        def port_open():
             try:
-                with socket.create_connection(("127.0.0.1", port), timeout=1):
-                    break
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=1):
+                    return True
             except OSError:
-                time.sleep(0.2)
+                return False
+
+        wait_until(port_open, timeout=20, interval=0.1,
+                   desc="cluster apiserver to come up")
         job_yaml = tmp_path / "d.yaml"
         job_yaml.write_text(f"""
 apiVersion: kubeflow.org/v2beta1
@@ -202,14 +207,16 @@ def test_cli_queues_verb(tmp_path):
          str(port), "--slices", "1x8"], env=env, cwd=REPO_ROOT,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     try:
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline:
+        def port_open():
             try:
                 with socket.create_connection(("127.0.0.1", port),
                                               timeout=1):
-                    break
+                    return True
             except OSError:
-                time.sleep(0.2)
+                return False
+
+        wait_until(port_open, timeout=20, interval=0.1,
+                   desc="cluster apiserver to come up")
 
         from mpi_operator_tpu.api import constants
         from mpi_operator_tpu.k8s.apiserver import Clientset
@@ -252,18 +259,19 @@ def test_cli_queues_verb(tmp_path):
             assert proc.returncode == 0, proc.stdout + proc.stderr
             return proc.stdout
 
-        deadline = time.monotonic() + 30
-        row = ""
-        while time.monotonic() < deadline:
+        state = {"row": ""}
+
+        def queues_converged():
             out = table()
-            row = next(line for line in out.splitlines()
-                       if line.startswith("cq-main"))
-            fields = row.split()
-            if fields[5] == "1" and fields[6] == "1":  # pending, admitted
-                break
-            time.sleep(0.5)
-        else:
-            raise AssertionError(f"queues never converged; last: {row!r}")
+            state["row"] = next(line for line in out.splitlines()
+                                if line.startswith("cq-main"))
+            fields = state["row"].split()
+            return fields[5] == "1" and fields[6] == "1"  # pending, admitted
+
+        wait_until(queues_converged, timeout=30, interval=0.2,
+                   desc="queues table to converge",
+                   on_timeout=lambda: f"last row {state['row']!r}")
+        row = state["row"]
         assert "tpu=2" in row  # scheduler-published usage (1 worker + launcher)
 
         # `get` surfaces the admission conditions too.

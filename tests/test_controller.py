@@ -759,7 +759,7 @@ def test_gang_restart_on_retryable_worker_exit():
 
     # whole gang deleted, counter bumped, event emitted
     assert f.client.pods("default").list(
-        {"training.kubeflow.org/job-role": "worker"}) == []
+        {constants.JOB_ROLE_LABEL: "worker"}) == []
     stored = f.get_job()
     assert stored.metadata.annotations[
         constants.GANG_RESTART_COUNT_ANNOTATION] == "1"
@@ -771,7 +771,7 @@ def test_gang_restart_on_retryable_worker_exit():
     f.refresh_caches()
     f.sync(f.get_job())
     names = sorted(p.metadata.name for p in f.client.pods("default").list(
-        {"training.kubeflow.org/job-role": "worker"}))
+        {constants.JOB_ROLE_LABEL: "worker"}))
     assert names == ["test-worker-0", "test-worker-1"]
 
 
@@ -791,7 +791,7 @@ def test_gang_restart_permanent_exit_fails_job():
     assert conds[constants.JOB_FAILED] == "True"
     # no gang deletion: the healthy worker survives
     names = [p.metadata.name for p in f.client.pods("default").list(
-        {"training.kubeflow.org/job-role": "worker"})]
+        {constants.JOB_ROLE_LABEL: "worker"})]
     assert "test-worker-1" in names
     assert not any("GangRestart" in e for e in f.recorder.events)
 

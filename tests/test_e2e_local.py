@@ -100,7 +100,7 @@ def test_e2e_suspend_before_start_then_resume():
         cluster.wait_for_condition("default", "susp", constants.JOB_SUSPENDED,
                                    timeout=10)
         assert cluster.client.pods("default").list(
-            {"training.kubeflow.org/job-role": "worker"}) == []
+            {constants.JOB_ROLE_LABEL: "worker"}) == []
 
         set_suspend(cluster, "susp", suspend=False)
         cluster.wait_for_condition("default", "susp", constants.JOB_SUCCEEDED,
@@ -147,7 +147,7 @@ def test_e2e_elastic_scale_down_and_up():
 
         def running_workers():
             return [p.metadata.name for p in cluster.client.pods(
-                "default").list({"training.kubeflow.org/job-role": "worker"})
+                "default").list({constants.JOB_ROLE_LABEL: "worker"})
                 if p.status.phase == "Running"]
 
         def discover_echoes():
@@ -354,7 +354,7 @@ def test_e2e_wait_for_workers_ready_policy():
         cluster.wait_until(
             "v1", "Pod",
             lambda: len(cluster.client.pods("default").list(
-                {"training.kubeflow.org/job-role": "worker"})) == 2,
+                {constants.JOB_ROLE_LABEL: "worker"})) == 2,
             timeout=10, describe="both gated workers created")
         time.sleep(1.0)  # several sync rounds (negative assertion below)
         with pytest.raises(Exception):
@@ -362,7 +362,7 @@ def test_e2e_wait_for_workers_ready_policy():
 
         # Ungate -> workers run -> launcher created -> Succeeded.
         for pod in cluster.client.pods("default").list(
-                {"training.kubeflow.org/job-role": "worker"}):
+                {constants.JOB_ROLE_LABEL: "worker"}):
             pod.spec.scheduling_gates = []
             cluster.client.pods("default").update(pod)
         done = cluster.wait_for_condition("default", "wfw",

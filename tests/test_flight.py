@@ -12,6 +12,7 @@ import time
 import types
 
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 
 from mpi_operator_tpu import chaos
 from mpi_operator_tpu.api.types import MPIJob
@@ -347,12 +348,12 @@ def _pump_events(server, namespace="default"):
 
 
 def _wait_for(pred, timeout=5.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(0.05)
-    return False
+    try:
+        wait_until(pred, timeout=timeout, interval=0.02,
+                   desc="flight state")
+        return True
+    except TimeoutError:
+        return False
 
 
 def test_events_watch_resumes_after_410_relist():

@@ -12,6 +12,7 @@ from mpi_operator_tpu.k8s.apiserver import ApiError, ApiServer, Clientset
 from mpi_operator_tpu.k8s.core import ConfigMap, Pod, Secret
 from mpi_operator_tpu.k8s.http_api import ApiHttpServer, RemoteApiServer
 from mpi_operator_tpu.k8s.meta import ObjectMeta
+from mpi_operator_tpu.utils.waiters import wait_until
 
 
 @pytest.fixture()
@@ -101,15 +102,16 @@ def test_operator_over_http_end_to_end():
             sys.executable, "-c", "import time; time.sleep(30)"]
         cs.mpi_jobs("default").create(job)
 
-        deadline = time.monotonic() + 30
-        succeeded = False
-        while time.monotonic() < deadline and not succeeded:
+        def succeeded():
             got = cs.mpi_jobs("default").get("test")
-            succeeded = any(c.type == "Succeeded" and c.status == "True"
-                            for c in got.status.conditions)
-            time.sleep(0.1)
-        assert succeeded, [(c.type, c.status)
-                           for c in got.status.conditions]
+            return any(c.type == "Succeeded" and c.status == "True"
+                       for c in got.status.conditions)
+
+        wait_until(succeeded, timeout=30, interval=0.05,
+                   desc="MPIJob 'test' to succeed",
+                   on_timeout=lambda: str(
+                       [(c.type, c.status) for c in cs.mpi_jobs(
+                           "default").get("test").status.conditions]))
     finally:
         kubelet.stop()
         jc.stop()
@@ -128,21 +130,19 @@ def test_operator_app_with_master_flag():
     app = OperatorApp(ServerOption(master_url=api.url, healthz_port=0))
     app.start()
     try:
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline and app.controller is None:
-            time.sleep(0.05)
-        assert app.controller is not None
+        wait_until(lambda: app.controller is not None, timeout=5,
+                   desc="leadership -> controller running")
         # jobs submitted straight to the API server get reconciled
         submit = Clientset(server=RemoteApiServer(api.url))
         submit.mpi_jobs("default").create(new_mpi_job(workers=1))
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
+        def launcher():
             try:
-                submit.jobs("default").get("test-launcher")
-                break
+                return submit.jobs("default").get("test-launcher")
             except ApiError:
-                time.sleep(0.1)
-        assert submit.jobs("default").get("test-launcher")
+                return None
+
+        assert wait_until(launcher, timeout=10, interval=0.05,
+                          desc="launcher Job to be created")
     finally:
         app.stop()
         api.stop()
@@ -213,31 +213,29 @@ def test_job_survives_apiserver_restart_mid_flight():
         cs.mpi_jobs("default").create(job)
 
         # Wait until the launcher pod is actually running...
-        deadline = time.monotonic() + 30
-        running = False
-        while time.monotonic() < deadline and not running:
-            running = any(
-                p.status.phase == "Running"
-                and "launcher" in p.metadata.name
-                for p in store.list("v1", "Pod", "default"))
-            time.sleep(0.1)
-        assert running, "launcher never started"
+        wait_until(lambda: any(p.status.phase == "Running"
+                               and "launcher" in p.metadata.name
+                               for p in store.list("v1", "Pod", "default")),
+                   timeout=30, interval=0.05,
+                   desc="launcher pod to start running")
 
         # ...then kill the apiserver under the whole stack.
         api.stop()
         time.sleep(1.5)
         api2 = ApiHttpServer(store=store, port=port).start()
 
-        deadline = time.monotonic() + 45
-        succeeded = False
-        while time.monotonic() < deadline and not succeeded:
+        def job_succeeded():
             got = store.get("kubeflow.org/v2beta1", "MPIJob", "default",
                             "test")
-            succeeded = any(c.type == "Succeeded" and c.status == "True"
-                            for c in got.status.conditions)
-            time.sleep(0.2)
-        assert succeeded, [(c.type, c.status)
-                           for c in got.status.conditions]
+            return any(c.type == "Succeeded" and c.status == "True"
+                       for c in got.status.conditions)
+
+        wait_until(job_succeeded, timeout=45, interval=0.1,
+                   desc="MPIJob to succeed across the apiserver restart",
+                   on_timeout=lambda: str(
+                       [(c.type, c.status) for c in store.get(
+                           "kubeflow.org/v2beta1", "MPIJob", "default",
+                           "test").status.conditions]))
     finally:
         kubelet.stop()
         jc.stop()

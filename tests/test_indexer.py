@@ -14,6 +14,7 @@ from mpi_operator_tpu.k8s.core import Pod
 from mpi_operator_tpu.k8s.informers import (CacheMutationError, Indexer,
                                             InformerFactory,
                                             set_mutation_detection)
+from mpi_operator_tpu.utils.waiters import wait_until
 from mpi_operator_tpu.k8s.meta import (ObjectMeta, OwnerReference, deep_copy,
                                        new_controller_ref)
 
@@ -136,12 +137,8 @@ def test_informer_indexes_follow_watch_and_relist():
     cs.pods("ns").create(pod("stray", ns="ns"))
 
     def wait(cond, timeout=3.0):
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if cond():
-                return True
-            time.sleep(0.01)
-        return False
+        return wait_until(cond, timeout=timeout, interval=0.01,
+                          desc="index state")
 
     uid = owner.metadata.uid
     assert wait(lambda: len(inf.lister.by_owner(uid)) == 1)
@@ -256,9 +253,8 @@ def test_mutation_violation_does_not_kill_watch_thread():
     assert factory.wait_for_cache_sync()
     created = cs.pods("ns").create(pod("p", ns="ns", labels={"a": "1"}))
 
-    deadline = time.monotonic() + 3
-    while time.monotonic() < deadline and inf.lister.get("ns", "p") is None:
-        time.sleep(0.01)
+    wait_until(lambda: inf.lister.get("ns", "p") is not None,
+               timeout=3, interval=0.01, desc="pod to land in the cache")
     violations = informers_mod._COUNTERS["mutation_violations"]
     before = violations.value
     inf.lister.get("ns", "p").metadata.labels["a"] = "TAMPERED"
@@ -266,14 +262,14 @@ def test_mutation_violation_does_not_kill_watch_thread():
     # Legitimate API write -> watch MODIFIED replaces the snapshot.
     created.metadata.labels["a"] = "2"
     cs.pods("ns").update(created)
-    deadline = time.monotonic() + 3
-    while time.monotonic() < deadline:
+    def healed():
         try:
-            if inf.lister.get("ns", "p").metadata.labels["a"] == "2":
-                break
+            return inf.lister.get("ns", "p").metadata.labels["a"] == "2"
         except CacheMutationError:
-            pass  # reader raced the healing install; retry
-        time.sleep(0.01)
+            return False  # reader raced the healing install; retry
+
+    wait_until(healed, timeout=3, interval=0.01,
+               desc="MODIFIED event to heal the tampered snapshot")
     assert inf._thread.is_alive()
     assert inf.lister.get("ns", "p").metadata.labels["a"] == "2"  # healed
     assert violations.value == before + 1
@@ -308,9 +304,8 @@ def test_resync_suppresses_unchanged_dispatches():
     assert factory.wait_for_cache_sync()
     for i in range(3):
         cs.pods("ns").create(pod(f"p{i}", ns="ns"))
-    deadline = time.monotonic() + 3
-    while time.monotonic() < deadline and len(events) < 3:
-        time.sleep(0.01)
+    wait_until(lambda: len(events) >= 3, timeout=3, interval=0.01,
+               desc="all three pod events")
     inf._watch.stop()  # freeze the stream: resync is the only input
 
     events.clear()

@@ -14,6 +14,7 @@ from mpi_operator_tpu.k8s.informers import InformerFactory
 from mpi_operator_tpu.k8s.meta import (ObjectMeta, OwnerReference, deep_copy,
                                        new_controller_ref)
 from mpi_operator_tpu.k8s.quantity import (add_resource_lists, parse_quantity)
+from mpi_operator_tpu.utils.waiters import wait_until
 from mpi_operator_tpu.k8s.workqueue import (RateLimitingQueue,
                                             default_controller_rate_limiter)
 
@@ -143,15 +144,13 @@ def test_informer_list_watch_sync():
     factory.start_all()
     assert factory.wait_for_cache_sync()
     cs.pods("ns").create(Pod(metadata=ObjectMeta(name="post", namespace="ns")))
-    deadline = time.monotonic() + 2
-    while time.monotonic() < deadline and len(added) < 2:
-        time.sleep(0.01)
+    wait_until(lambda: len(added) >= 2, timeout=2, interval=0.01,
+               desc="both pod ADDs to dispatch")
     assert sorted(added) == ["post", "pre"]
     assert inf.lister.get("ns", "post") is not None
     cs.pods("ns").delete("post")
-    deadline = time.monotonic() + 2
-    while time.monotonic() < deadline and inf.lister.get("ns", "post"):
-        time.sleep(0.01)
+    wait_until(lambda: inf.lister.get("ns", "post") is None,
+               timeout=2, interval=0.01, desc="DELETE to reach the cache")
     assert inf.lister.get("ns", "post") is None
     factory.stop_all()
 
@@ -311,16 +310,14 @@ def test_informer_resync_heals_watch_gap():
     inf._watch.stop()  # simulate a dead stream (no more events delivered)
     cs.pods("ns").create(Pod(metadata=ObjectMeta(name="missed", namespace="ns")))
 
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline and inf.lister.get("ns", "missed") is None:
-        time.sleep(0.05)
+    wait_until(lambda: inf.lister.get("ns", "missed") is not None,
+               timeout=5, desc="resync to pick up the missed ADD")
     assert inf.lister.get("ns", "missed") is not None
     assert ("add", "missed") in seen
 
     cs.pods("ns").delete("missed")
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline and inf.lister.get("ns", "missed"):
-        time.sleep(0.05)
+    wait_until(lambda: inf.lister.get("ns", "missed") is None,
+               timeout=5, desc="resync to pick up the missed DELETE")
     assert inf.lister.get("ns", "missed") is None
     assert ("del", "missed") in seen
     factory.stop_all()

@@ -12,6 +12,7 @@ import urllib.error
 import urllib.request
 
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 
 from mpi_operator_tpu.api import constants
 from mpi_operator_tpu.k8s.apiserver import (RELIST, ApiError, ApiServer,
@@ -480,11 +481,8 @@ def test_informer_relists_immediately_after_410(fixture_server,
     transport._open = gated_open
     try:
         pods.create(_pod("seed"))
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and \
-                inf.lister.get("default", "seed") is None:
-            time.sleep(0.05)
-        assert inf.lister.get("default", "seed") is not None
+        wait_until(lambda: inf.lister.get("default", "seed") is not None,
+                   timeout=10, desc="seed pod to reach the cache")
 
         # Partition: no reconnect can succeed while we build the gap.
         gate.clear()
@@ -496,12 +494,9 @@ def test_informer_relists_immediately_after_410(fixture_server,
         pods.create(_pod("gap"))  # lands inside the gap, never streamed
         gate.set()  # reconnect now -> 410 -> RELIST -> immediate relist
 
-        deadline = time.monotonic() + 15
-        while time.monotonic() < deadline and \
-                inf.lister.get("default", "gap") is None:
-            time.sleep(0.05)
-        assert inf.lister.get("default", "gap") is not None, \
-            "informer never saw the gap event after 410"
+        wait_until(lambda: inf.lister.get("default", "gap") is not None,
+                   timeout=15,
+                   desc="informer to see the gap event after 410")
     finally:
         transport._open = orig_open
         factory.stop_all()
@@ -553,10 +548,8 @@ def test_gang_feedback_over_kube_transport(fixture_server):
                     "kgang")
             except Exception:
                 return None
-        deadline = time.monotonic() + 20
-        while get_pg() is None:
-            assert time.monotonic() < deadline, "PodGroup never created"
-            time.sleep(0.1)
+        wait_until(get_pg, timeout=20, interval=0.05,
+                   desc="PodGroup to be created")
 
         pg = get_pg()
         pg.status = {"phase": "Pending", "conditions": [

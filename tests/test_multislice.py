@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 
 from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.utils.waiters import wait_until
 from mpi_operator_tpu.server import LocalCluster
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -32,15 +33,11 @@ def test_controller_injects_megascale_env():
         cluster.submit(job)
 
         import time
-        deadline = time.monotonic() + 20
-        pods = []
-        while time.monotonic() < deadline:
-            pods = cluster.client.pods("default").list(
-                {"training.kubeflow.org/job-role": "worker"})
-            if len(pods) == 4:
-                break
-            time.sleep(0.1)
-        assert len(pods) == 4
+        pods = wait_until(
+            lambda: (lambda ps: ps if len(ps) == 4 else None)(
+                cluster.client.pods("default").list(
+                    {constants.JOB_ROLE_LABEL: "worker"})),
+            timeout=20, interval=0.05, desc="4 worker pods")
 
         by_name = {}
         for pod in pods:
@@ -61,15 +58,11 @@ def test_single_slice_jobs_get_no_megascale_env():
         sleep = [sys.executable, "-c", "import time; time.sleep(30)"]
         job = jax_job("ss", launcher_cmd=sleep, worker_cmd=sleep, workers=2)
         cluster.submit(job)
-        import time
-        deadline = time.monotonic() + 20
-        pods = []
-        while time.monotonic() < deadline:
-            pods = cluster.client.pods("default").list(
-                {"training.kubeflow.org/job-role": "worker"})
-            if len(pods) == 2:
-                break
-            time.sleep(0.1)
+        pods = wait_until(
+            lambda: (lambda ps: ps if len(ps) == 2 else None)(
+                cluster.client.pods("default").list(
+                    {constants.JOB_ROLE_LABEL: "worker"})),
+            timeout=20, interval=0.05, desc="2 worker pods")
         env = {e.name for e in pods[0].spec.containers[0].env}
         assert constants.MEGASCALE_SLICE_ID_ENV not in env
 

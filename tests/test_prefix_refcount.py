@@ -28,6 +28,7 @@ import time
 import jax
 import jax.numpy as jnp
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 
 from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
 from mpi_operator_tpu.serving.batcher import ContinuousBatcher
@@ -46,13 +47,10 @@ def tiny():
 
 
 def _wait_idle(b: ContinuousBatcher, timeout: float = 60.0) -> None:
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if not b._slot_blocks and b._queue.qsize() == 0:
-            return
-        time.sleep(0.01)
-    raise TimeoutError(f"batcher never idled: slots={b._slot_blocks}, "
-                       f"queue={b._queue.qsize()}")
+    wait_until(lambda: not b._slot_blocks and b._queue.qsize() == 0,
+               timeout=timeout, interval=0.01, desc="batcher to idle",
+               on_timeout=lambda: f"slots={b._slot_blocks}, "
+                                  f"queue={b._queue.qsize()}")
 
 
 def _check_accounting(b: ContinuousBatcher, idle: bool) -> None:

@@ -43,6 +43,8 @@ import time
 
 import pytest
 
+from mpi_operator_tpu.utils.waiters import wait_until
+
 sys.path.insert(0, os.path.dirname(__file__))
 
 pytestmark = pytest.mark.real_cluster
@@ -118,13 +120,18 @@ def _cleanup(cs, name, wait_s: float = 15.0):
         cs.mpi_jobs(_NS).delete(name)
     except Exception:
         pass
-    deadline = time.monotonic() + wait_s
-    while time.monotonic() < deadline:
+    def gone():
         try:
             cs.mpi_jobs(_NS).get(name)
         except Exception:
-            return
-        time.sleep(0.2)
+            return True
+        return False
+
+    try:
+        wait_until(gone, timeout=wait_s, interval=0.2,
+                   desc=f"{name} finalization")
+    except TimeoutError:
+        pass  # best-effort cleanup; the next create surfaces leftovers
 
 
 def test_mpijob_crud_roundtrip(cluster):
@@ -161,14 +168,10 @@ def test_mpijob_crud_roundtrip(cluster):
                    for j in cs.mpi_jobs(_NS).list())
     finally:
         _cleanup(cs, name)
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        if not any(j.metadata.name == name
-                   for j in cs.mpi_jobs(_NS).list()):
-            break
-        time.sleep(0.2)
-    else:
-        pytest.fail("deleted MPIJob still listed after 10s")
+    wait_until(lambda: not any(j.metadata.name == name
+                               for j in cs.mpi_jobs(_NS).list()),
+               timeout=10, interval=0.2,
+               desc="deleted MPIJob to leave the list")
 
 
 def test_operator_reconciles_against_live_cluster(cluster):
@@ -190,45 +193,42 @@ def test_operator_reconciles_against_live_cluster(cluster):
         app.start()
     try:
         if app is not None:
-            deadline = time.monotonic() + 10
-            while time.monotonic() < deadline and app.controller is None:
-                time.sleep(0.05)
-            assert app.controller is not None, \
-                "operator never became leader"
+            wait_until(lambda: app.controller is not None, timeout=10,
+                       desc="operator to become leader")
 
         cs.mpi_jobs(_NS).create(_new_job(name, workers=2))
 
         want_pods = {f"{name}-worker-0", f"{name}-worker-1"}
-        deadline = time.monotonic() + 30
-        seen = set()
-        launcher = None
-        while time.monotonic() < deadline:
-            seen = {p.metadata.name for p in cs.pods(_NS).list()
-                    if p.metadata.name.startswith(name)}
+        state = {"seen": set(), "launcher": None}
+
+        def gang_created():
+            state["seen"] = {p.metadata.name for p in cs.pods(_NS).list()
+                             if p.metadata.name.startswith(name)}
             try:
-                launcher = cs.jobs(_NS).get(f"{name}-launcher")
+                state["launcher"] = cs.jobs(_NS).get(f"{name}-launcher")
             except Exception:
-                launcher = None
-            if want_pods <= seen and launcher is not None:
-                break
-            time.sleep(0.2)
-        assert want_pods <= seen, f"worker pods missing: {seen}"
-        assert launcher is not None, "launcher Job never created"
+                state["launcher"] = None
+            return want_pods <= state["seen"] and \
+                state["launcher"] is not None
+
+        wait_until(gang_created, timeout=30, interval=0.2,
+                   desc="worker pods + launcher Job",
+                   on_timeout=lambda: f"saw pods {state['seen']}")
         assert cs.config_maps(_NS).get(f"{name}-config")
         # (JAX-impl jobs bootstrap via the coordinator env, not SSH, so
         # no -ssh Secret exists for them — builders.uses_ssh.)
 
         if os.environ.get("MPI_OPERATOR_E2E_RUN_JOBS") == "1":
-            deadline = time.monotonic() + 60
-            succeeded = False
-            while time.monotonic() < deadline and not succeeded:
+            def succeeded():
                 got = cs.mpi_jobs(_NS).get(name)
-                succeeded = any(
-                    c.type == "Succeeded" and c.status == "True"
-                    for c in got.status.conditions)
-                time.sleep(0.2)
-            assert succeeded, [(c.type, c.status)
-                               for c in got.status.conditions]
+                return any(c.type == "Succeeded" and c.status == "True"
+                           for c in got.status.conditions)
+
+            wait_until(succeeded, timeout=60, interval=0.2,
+                       desc=f"{name} to succeed",
+                       on_timeout=lambda: str(
+                           [(c.type, c.status) for c in
+                            cs.mpi_jobs(_NS).get(name).status.conditions]))
     finally:
         _cleanup(cs, name)
         if app is not None:

@@ -13,6 +13,7 @@ import subprocess
 import sys
 
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 
 from mpi_operator_tpu.bootstrap.rsh_launcher import (HostSlots,
                                                      build_rank_commands,
@@ -189,11 +190,11 @@ def _start_sshd(tmp_path, ssh_dir):
          "-De"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True)
-    deadline = time.monotonic() + 20
-    while not ready.exists():
+    def ready_or_dead():
         assert proc.poll() is None, proc.stdout.read()
-        assert time.monotonic() < deadline, "sshd never became ready"
-        time.sleep(0.05)
+        return ready.exists()
+
+    wait_until(ready_or_dead, timeout=20, desc="sshd readiness file")
     return proc, port
 
 

@@ -9,6 +9,7 @@ from mpi_operator_tpu.k8s.apiserver import Clientset
 from mpi_operator_tpu.k8s.core import Container, PodSpec, PodTemplateSpec
 from mpi_operator_tpu.k8s.meta import ObjectMeta
 from mpi_operator_tpu.runtime import JobController, LocalKubelet
+from mpi_operator_tpu.utils.waiters import wait_until
 
 
 def _job(name, command, **spec_kwargs):
@@ -22,12 +23,12 @@ def _job(name, command, **spec_kwargs):
 
 
 def _wait(fn, timeout=15):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return True
-        time.sleep(0.05)
-    return False
+    try:
+        wait_until(fn, timeout=timeout, interval=0.02,
+                   desc="runtime state")
+        return True
+    except TimeoutError:
+        return False
 
 
 def test_active_deadline_fails_job():
@@ -127,15 +128,12 @@ def test_kubelet_maps_signal_deaths_to_runtime_exit_codes():
                     "import os, signal; os.kill(os.getpid(),"
                     " signal.SIGTERM)"])]))
         client.pods("default").create(pod)
-        deadline = time.monotonic() + 20
-        phase = ""
-        while time.monotonic() < deadline:
-            p = client.pods("default").get("sig")
-            phase = p.status.phase
-            if phase in ("Succeeded", "Failed"):
-                break
-            time.sleep(0.1)
-        assert phase == "Failed"
+        p = wait_until(
+            lambda: (lambda pod: pod if pod.status.phase in
+                     ("Succeeded", "Failed") else None)(
+                         client.pods("default").get("sig")),
+            timeout=20, interval=0.05, desc="signal pod to terminate")
+        assert p.status.phase == "Failed"
         term = p.status.container_statuses[0].state.terminated
         assert term.exit_code == 128 + 15  # SIGTERM -> 143
     finally:

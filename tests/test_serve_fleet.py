@@ -9,6 +9,7 @@ import time
 import urllib.request
 
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until as _wait_until
 
 from mpi_operator_tpu.api import constants
 from mpi_operator_tpu.api.defaults import set_defaults_servejob
@@ -42,12 +43,7 @@ def make_servejob(name="fleet", replicas=2, autoscale=None, env=None):
 
 
 def wait_until(fn, timeout=30.0, msg="condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return
-        time.sleep(0.02)
-    raise TimeoutError(f"never satisfied: {msg}")
+    _wait_until(fn, timeout=timeout, interval=0.02, desc=msg)
 
 
 # ---------------------------------------------------------------------------

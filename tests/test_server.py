@@ -7,7 +7,8 @@ import time
 import urllib.request
 
 from mpi_operator_tpu import version
-from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.k8s.apiserver import ApiError, Clientset
+from mpi_operator_tpu.utils.waiters import wait_until
 from mpi_operator_tpu.server.app import OperatorApp
 from mpi_operator_tpu.server.leader_election import LeaderElector
 from mpi_operator_tpu.server.options import ServerOption, parse_options
@@ -53,10 +54,8 @@ def test_leader_election_single_winner_and_failover():
     ]
     for e in electors:
         e.run()
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline and not any(
-            e.is_leader for e in electors):
-        time.sleep(0.02)
+    wait_until(lambda: any(e.is_leader for e in electors), timeout=5,
+               desc="a leader to emerge")
     leaders = [e for e in electors if e.is_leader]
     assert len(leaders) == 1
     leader = leaders[0]
@@ -64,10 +63,8 @@ def test_leader_election_single_winner_and_failover():
 
     # Leader releases -> the other takes over within a lease duration.
     leader.stop()
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline and not other.is_leader:
-        time.sleep(0.02)
-    assert other.is_leader
+    wait_until(lambda: other.is_leader, timeout=5,
+               desc="standby to take over the lease")
     other.stop()
 
 
@@ -90,10 +87,8 @@ def test_operator_app_endpoints_and_controller_gating():
                        gang_scheduling_name="")
     app = OperatorApp(opt).start()
     try:
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline and app.controller is None:
-            time.sleep(0.02)
-        assert app.controller is not None  # leader -> controller running
+        wait_until(lambda: app.controller is not None, timeout=5,
+                   desc="leadership -> controller running")
 
         status, body = _get(f"http://127.0.0.1:{port}/healthz")
         assert status == 200 and body == b"ok"
@@ -120,19 +115,18 @@ def test_operator_app_processes_jobs_end_to_end():
         port = s.getsockname()[1]
     app = OperatorApp(ServerOption(healthz_port=port)).start()
     try:
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline and app.controller is None:
-            time.sleep(0.02)
+        wait_until(lambda: app.controller is not None, timeout=5,
+                   desc="leadership -> controller running")
         job = new_mpi_job(workers=2)
         app.client.mpi_jobs("default").create(job)
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
+        def launcher():
             try:
-                app.client.jobs("default").get("test-launcher")
-                break
-            except Exception:
-                time.sleep(0.05)
-        assert app.client.jobs("default").get("test-launcher")
+                return app.client.jobs("default").get("test-launcher")
+            except ApiError:
+                return None
+
+        assert wait_until(launcher, timeout=10,
+                          desc="launcher Job to be created")
         assert len(app.client.pods("default").list()) == 2
     finally:
         app.stop()
@@ -161,10 +155,8 @@ def test_leader_election_survives_api_errors():
                             on_started_leading=lambda: ups.append(1),
                             on_stopped_leading=lambda: downs.append(1))
     elector.run()
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline and not elector.is_leader:
-        time.sleep(0.02)
-    assert elector.is_leader
+    wait_until(lambda: elector.is_leader, timeout=5,
+               desc="initial leadership")
 
     from mpi_operator_tpu.k8s.apiserver import ApiError
     fail = {"on": True}
@@ -176,17 +168,15 @@ def test_leader_election_survives_api_errors():
 
     cs.prepend_reactor("update", "Lease", boom)
     cs.prepend_reactor("get", "Lease", boom)
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline and elector.is_leader:
-        time.sleep(0.02)
-    assert not elector.is_leader and downs  # stepped down, thread alive
+    wait_until(lambda: not elector.is_leader, timeout=5,
+               desc="step-down under injected API outage")
+    assert downs  # stepped down, thread alive
     assert elector._thread.is_alive()
 
     fail["on"] = False  # API recovers -> leadership re-acquired
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline and not elector.is_leader:
-        time.sleep(0.02)
-    assert elector.is_leader and len(ups) == 2
+    wait_until(lambda: elector.is_leader, timeout=5,
+               desc="leadership re-acquired after recovery")
+    assert len(ups) == 2
     elector.stop()
 
 
@@ -220,14 +210,12 @@ def test_operator_ha_failover_end_to_end():
         jc.start()
         kubelet.start()
 
-        deadline = time.monotonic() + 10
-        leader = None
-        while time.monotonic() < deadline and leader is None:
+        def single_leader():
             leaders = [a for a in apps if a.controller is not None]
-            if len(leaders) == 1:
-                leader = leaders[0]
-            time.sleep(0.05)
-        assert leader is not None, "no single leader emerged"
+            return leaders[0] if len(leaders) == 1 else None
+
+        leader = wait_until(single_leader, timeout=10,
+                            desc="a single leader to emerge")
         standby = next(a for a in apps if a is not leader)
 
         def run_job(name):
@@ -237,24 +225,22 @@ def test_operator_ha_failover_end_to_end():
             job.worker_spec.template.spec.containers[0].command = [
                 sys.executable, "-c", "import time; time.sleep(30)"]
             cs.mpi_jobs("default").create(job)
-            deadline = time.monotonic() + 30
-            while time.monotonic() < deadline:
+
+            def succeeded():
                 got = cs.mpi_jobs("default").get(name)
-                if any(c.type == "Succeeded" and c.status == "True"
-                       for c in got.status.conditions):
-                    return
-                time.sleep(0.1)
-            raise AssertionError(f"{name} never succeeded")
+                return any(c.type == "Succeeded" and c.status == "True"
+                           for c in got.status.conditions)
+
+            wait_until(succeeded, timeout=30, interval=0.05,
+                       desc=f"{name} to succeed")
 
         run_job("ha-before")
 
         # The leader dies (hard stop, no graceful lease handoff needed —
         # expiry covers it).
         leader.stop()
-        deadline = time.monotonic() + 15
-        while time.monotonic() < deadline and standby.controller is None:
-            time.sleep(0.05)
-        assert standby.controller is not None, "standby never took over"
+        wait_until(lambda: standby.controller is not None, timeout=15,
+                   desc="standby to take over after leader death")
 
         run_job("ha-after")
     finally:

@@ -11,6 +11,7 @@ import time
 import pytest
 
 from mpi_operator_tpu.k8s.apiserver import RELIST, ApiServer, Clientset
+from mpi_operator_tpu.utils.waiters import wait_until
 from mpi_operator_tpu.k8s.core import Pod
 from mpi_operator_tpu.k8s.meta import ObjectMeta
 from mpi_operator_tpu.k8s.workqueue import (PRIORITY_HIGH, PRIORITY_LOW,
@@ -56,7 +57,7 @@ def test_same_job_never_in_flight_on_two_shards_hammer():
                 if key in inflight:
                     violations.append((key, inflight[key], shard))
                 inflight[key] = shard
-            time.sleep(0.001)
+            time.sleep(0.001)  # lint: allow[sleep-poll] — simulated sync work
             with lock:
                 inflight.pop(key, None)
                 synced[0] += 1
@@ -80,9 +81,7 @@ def test_same_job_never_in_flight_on_two_shards_hammer():
         t.start()
     for t in adders:
         t.join(timeout=30)
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline and len(q):
-        time.sleep(0.02)
+    wait_until(lambda: not len(q), timeout=10, desc="queue to drain")
     stop.set()
     q.shutdown()
     for t in workers:
@@ -158,12 +157,13 @@ def test_fairness_small_job_wait_bounded_under_gang_churn():
                 continue
             t0 = time.monotonic()
             if item.startswith("ns/gang"):
-                time.sleep(0.05)  # expensive 10k-pod sync
+                # lint: allow[sleep-poll] — simulated 10k-pod sync cost
+                time.sleep(0.05)
                 q.add(item, priority=PRIORITY_LOW)  # churn re-dirty
             else:
                 with lock:
                     small_waits.append(q.last_wait)
-                time.sleep(0.001)
+                time.sleep(0.001)  # lint: allow[sleep-poll] — simulated sync work
             q.forget(item)
             q.done(item)
 
@@ -172,13 +172,13 @@ def test_fairness_small_job_wait_bounded_under_gang_churn():
     t.start()
     for i in range(30):
         q.add(f"ns/small-{i}", priority=PRIORITY_HIGH)
-        time.sleep(0.01)
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
+        time.sleep(0.01)  # lint: allow[sleep-poll] — paced arrival stream
+    def all_smalls_synced():
         with lock:
-            if len(small_waits) >= 30:
-                break
-        time.sleep(0.02)
+            return len(small_waits) >= 30
+
+    wait_until(all_smalls_synced, timeout=10,
+               desc="all small jobs to sync")
     stop.set()
     q.shutdown()
     t.join(timeout=2)
@@ -276,10 +276,8 @@ def test_overflowed_informer_relists_and_heals():
     with inf._lock:
         for i in range(50):
             cs.pods("ns").create(_mk_pod(f"q{i}"))
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline and \
-            len(inf.lister.list("ns")) < 50:
-        time.sleep(0.02)
+    wait_until(lambda: len(inf.lister.list("ns")) >= 50, timeout=10,
+               desc="informer to heal past the overflow")
     assert len(inf.lister.list("ns")) == 50
     assert inf._watch.overflows >= 1
     factory.stop_all()
@@ -366,10 +364,8 @@ def test_controller_shard_counters_and_zero_violations():
             cs.mpi_jobs("default").create(new_mpi_job(name=f"sjob-{i}",
                                                       workers=1))
         hist = controller.metrics["reconcile_seconds"]
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and \
-                (hist.count < 12 or len(controller.queue)):
-            time.sleep(0.05)
+        wait_until(lambda: hist.count >= 12 and not len(controller.queue),
+                   timeout=20, desc="12 reconciles + drained queue")
         shard_syncs = controller.metrics["shard_syncs"]
         per_shard = [int(shard_syncs.get(str(i))) for i in range(4)]
         assert sum(per_shard) >= 12
@@ -404,10 +400,8 @@ def test_event_storm_fault_targets_one_shard_and_invariants_hold():
     try:
         cs.mpi_jobs("default").create(new_mpi_job(name="storm-target",
                                                   workers=2))
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and not \
-                cs.server.list("v1", "Pod", "default"):
-            time.sleep(0.05)
+        wait_until(lambda: cs.server.list("v1", "Pod", "default"),
+                   timeout=10, desc="storm-target pods to appear")
         plan = chaos.FaultPlan(name="shard-skew", faults=[
             chaos.Fault(at=0.1, kind="event_storm",
                         target="default/storm-target",

@@ -17,6 +17,7 @@ import threading
 import time
 
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 
 from mpi_operator_tpu.api import constants
 from mpi_operator_tpu.server import LocalCluster
@@ -132,21 +133,17 @@ def test_churn_soak_converges_and_leaks_nothing():
             return set(cluster.kubelet._runners).issubset(live)
         cluster.wait_until("v1", "Pod", runners_settled, timeout=30,
                            describe="kubelet runners drained")
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and \
-                len(cluster.controller.queue):
-            time.sleep(0.2)
-        assert len(cluster.controller.queue) == 0
+        wait_until(lambda: not len(cluster.controller.queue),
+                   timeout=20, desc="controller queue to drain")
 
         # No thread leak: all three waves clean their worker pods
         # (policies All/Running/GC), so thread count returns to near
         # baseline; the delta absorbs informer/runner teardown jitter.
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and \
-                threading.active_count() > baseline_threads + 8:
-            time.sleep(0.2)
-        assert threading.active_count() <= baseline_threads + 8, (
-            threading.active_count(), baseline_threads)
+        wait_until(
+            lambda: threading.active_count() <= baseline_threads + 8,
+            timeout=20, desc="thread count to return to baseline",
+            on_timeout=lambda: f"{threading.active_count()} threads vs "
+                               f"baseline {baseline_threads}")
 
 
 def test_serving_soak_mixed_workload_leaks_nothing():
@@ -221,11 +218,10 @@ def test_serving_soak_mixed_workload_leaks_nothing():
                     and not batcher._slot_blocks
                     and not batcher._draft_pos)
 
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and not idle():
-            time.sleep(0.05)
-        assert idle(), (batcher._block_meta, batcher._slot_blocks,
-                        batcher._draft_pos)
+        wait_until(idle, timeout=10, desc="batcher KV state to go idle",
+                   on_timeout=lambda: str((batcher._block_meta,
+                                           batcher._slot_blocks,
+                                           batcher._draft_pos)))
         free_plus_cached = len(batcher._free_blocks) + len(
             batcher._block_meta)
         assert free_plus_cached == batcher._total_blocks, (

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
@@ -37,11 +38,8 @@ def test_capture_sees_os_level_stderr():
         os.write(2, b"raw fd write\n")
         # The tee pump is a thread: poll briefly for the mid-capture
         # view (the guard's own scan happens post-close, race-free).
-        deadline = time.monotonic() + 5
-        while b"raw fd write" not in read() \
-                and time.monotonic() < deadline:
-            time.sleep(0.01)
-        assert b"raw fd write" in read()
+        wait_until(lambda: b"raw fd write" in read(), timeout=5,
+                   interval=0.01, desc="raw fd write to be captured")
     # Post-close: complete by construction (pump joined on exit).
     assert b"raw fd write" in read()
 

@@ -13,6 +13,7 @@ import time
 import urllib.request
 
 import pytest
+from mpi_operator_tpu.utils.waiters import wait_until
 
 from mpi_operator_tpu.telemetry.goodput import (GoodputTracker,
                                                 instrument_step)
@@ -394,15 +395,11 @@ def test_operator_app_metrics_exposes_reconcile_histogram():
     app = OperatorApp(ServerOption(healthz_port=port,
                                    monitoring_port=port)).start()
     try:
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline and app.controller is None:
-            time.sleep(0.02)
-        assert app.controller is not None
+        wait_until(lambda: app.controller is not None, timeout=5,
+                   desc="leadership -> controller running")
         app.client.mpi_jobs("default").create(new_mpi_job(name="telem"))
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and \
-                app.metrics["reconcile_seconds"].count == 0:
-            time.sleep(0.05)
+        wait_until(lambda: app.metrics["reconcile_seconds"].count,
+                   timeout=10, desc="first reconcile to be observed")
         status, body = _get(f"http://127.0.0.1:{port}/metrics")
     finally:
         app.stop()

@@ -25,7 +25,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import shutil
 import sys
 import tempfile
@@ -180,36 +179,39 @@ def _find_engine_bundle(report, bundles):
 # Metric-catalog drift check
 # ---------------------------------------------------------------------------
 
-# Metric family names built with dynamic prefixes (f-strings the literal
-# scan below cannot see); keep in sync with telemetry/goodput.py.
-DYNAMIC_FAMILIES = ("train_goodput_fraction", "train_step_seconds")
-
-_METRIC_CALL = re.compile(
-    r"(?:\.(?:counter|gauge|histogram)(?:_vec)?"
-    r"|\b(?:Counter|Gauge|GaugeVec|CounterVec|Histogram|HistogramVec))"
-    r"\(\s*\n?\s*\"([a-z][a-z0-9_]+)\"", re.MULTILINE)
+# The drift check is the static analyzer's `metrics-catalog` rule
+# (analysis/lint.py, docs/ANALYSIS.md): AST-extracted metric
+# registrations vs the docs/OBSERVABILITY.md catalog rows, BOTH
+# directions.  This smoke keeps invoking it so the obs gate stays
+# self-contained, but the single source of truth (including the
+# dynamic-prefix allowance for telemetry/goodput.py) lives in the rule.
 
 
 def registered_metric_families() -> set:
-    families = set(DYNAMIC_FAMILIES)
-    pkg = os.path.join(REPO, "mpi_operator_tpu")
-    for root, _, files in os.walk(pkg):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(root, fn)) as f:
-                src = f.read()
-            families.update(_METRIC_CALL.findall(src))
-    return families
+    import ast
+
+    from mpi_operator_tpu.analysis import lint
+    project = lint.ProjectContext(root=REPO)
+    for relpath in lint.iter_py_files(REPO):
+        if not relpath.startswith("mpi_operator_tpu/"):
+            continue
+        try:
+            with open(os.path.join(REPO, relpath)) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        lint._collect_metrics(lint.FileContext(
+            root=REPO, relpath=relpath, tree=tree, lines=[],
+            project=project))
+    return set(project.metric_sites) | set(lint.DYNAMIC_METRIC_FAMILIES)
 
 
 def check_metric_catalog() -> list:
-    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as f:
-        docs = f.read()
-    return [f"metric family {name!r} registered in code but missing from"
-            f" docs/OBSERVABILITY.md catalog"
-            for name in sorted(registered_metric_families())
-            if name not in docs]
+    from mpi_operator_tpu.analysis import lint
+    findings = [f for f in lint.run_lint(
+        REPO, baseline_path=os.devnull).findings
+        if f.rule == "metrics-catalog"]
+    return [f.render() for f in findings]
 
 
 def main(argv=None) -> int:
@@ -291,4 +293,5 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))

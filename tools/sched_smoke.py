@@ -37,6 +37,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from mpi_operator_tpu.utils.waiters import wait_until  # noqa: E402
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 # The checkpoint-aware worker: bumps a step counter, persists it
@@ -102,12 +104,10 @@ def mk_job(name, workers, queue, worker_cmd, launcher_cmd, prio=None,
 
 
 def wait_for(predicate, timeout, what):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if predicate():
-            return
-        time.sleep(0.1)
-    raise AssertionError(f"timed out waiting for {what}")
+    try:
+        wait_until(predicate, timeout=timeout, interval=0.05, desc=what)
+    except TimeoutError as exc:
+        raise AssertionError(str(exc)) from None
 
 
 def run_scenario() -> dict:
@@ -239,14 +239,20 @@ def run_scenario() -> dict:
         cq = client.cluster_queues("default").get("cq-research")
         assert cq.status.pending_jobs >= 1  # the big gang
         # Let the control plane settle, then hold every invariant.
-        deadline = time.monotonic() + 20
+        inv_timeout = 20
         failures = {}
-        while time.monotonic() < deadline:
-            failures = {check.__name__: check(cluster)
-                        for check in DEFAULT_INVARIANTS}
-            if not any(failures.values()):
-                break
-            time.sleep(0.3)
+
+        def invariants_green():
+            failures.clear()
+            failures.update({check.__name__: check(cluster)
+                             for check in DEFAULT_INVARIANTS})
+            return not any(failures.values())
+
+        try:
+            wait_until(invariants_green, timeout=inv_timeout,
+                       interval=0.2, desc="invariants to go green")
+        except TimeoutError:
+            pass  # fall through to the assertion with the last snapshot
         bad = {k: v for k, v in failures.items() if v}
         assert not bad, f"invariants violated: {bad}"
         elapsed = time.monotonic() - t0
@@ -276,4 +282,5 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))

@@ -36,6 +36,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from mpi_operator_tpu.utils.waiters import wait_until  # noqa: E402
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
@@ -145,17 +147,23 @@ def check_tick_economics(jax, jnp, floor: float, problems: list) -> None:
 
     def sample():
         tm = b.telemetry
-        deadline = time.perf_counter() + 120
-        while b.ticks_fetched < 12 and b.fatal_error is None \
-                and time.perf_counter() < deadline:
-            time.sleep(0.001)
+        try:
+            wait_until(lambda: b.ticks_fetched >= 12
+                       or b.fatal_error is not None,
+                       timeout=120, interval=0.001,
+                       desc="12 fetched ticks (window open)")
+        except TimeoutError:
+            pass  # record the window anyway; the floor check reports
         window["t1"] = time.perf_counter()
         window["ticks1"] = tm["ticks_total"].value
         window["transfers1"] = tm["transfers_total"].value
-        while b.ticks_fetched < new_tokens - 12 \
-                and b.fatal_error is None \
-                and time.perf_counter() < deadline:
-            time.sleep(0.001)
+        try:
+            wait_until(lambda: b.ticks_fetched >= new_tokens - 12
+                       or b.fatal_error is not None,
+                       timeout=120, interval=0.001,
+                       desc="steady-state window to close")
+        except TimeoutError:
+            pass  # record the window anyway; the floor check reports
         window["t2"] = time.perf_counter()
         window["ticks2"] = tm["ticks_total"].value
         window["transfers2"] = tm["transfers_total"].value
@@ -192,10 +200,12 @@ def check_tick_economics(jax, jnp, floor: float, problems: list) -> None:
                   f"(floor {floor})")
         # The final dispatched-ahead overrun step drains shortly after
         # the last request completes; poll rather than race the loop.
-        deadline = time.perf_counter() + 10
-        while b.telemetry["pipeline_depth"].value \
-                and time.perf_counter() < deadline:
-            time.sleep(0.005)
+        try:
+            wait_until(lambda: not b.telemetry["pipeline_depth"].value,
+                       timeout=10, interval=0.005,
+                       desc="pipeline depth to drain")
+        except TimeoutError:
+            pass  # reported as a problem below
         depth = b.telemetry["pipeline_depth"].value
         if depth != 0:
             problems.append(f"pipeline_depth gauge stuck at {depth}")
@@ -237,4 +247,5 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))

@@ -36,6 +36,8 @@ import urllib.request
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from mpi_operator_tpu.utils.waiters import wait_until  # noqa: E402
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
@@ -239,10 +241,12 @@ def phase_b(jax, jnp, problems):
                        for i in range(8)]
             for t in threads:
                 t.start()
-            deadline = time.monotonic() + 30
-            while time.monotonic() < deadline \
-                    and len(fleet.router.healthy_replicas()) < 2:
-                time.sleep(0.1)
+            try:
+                wait_until(
+                    lambda: len(fleet.router.healthy_replicas()) >= 2,
+                    timeout=30, desc="2 healthy replicas")
+            except TimeoutError:
+                pass  # reported as a problem below
             up = len(fleet.router.healthy_replicas())
             stop.set()
             for t in threads:
@@ -254,14 +258,16 @@ def phase_b(jax, jnp, problems):
                 return
             print(f"serve-fleet-smoke: scaled up to {up} replicas "
                   f"under burst ({fleet.autoscaler.transitions[0][2]})")
-            deadline = time.monotonic() + 30
-            scaled_down = False
-            while time.monotonic() < deadline:
+            def scale_down_applied():
                 sj = fleet.client.serve_jobs("default").get("autosmoke")
-                if (sj.status.desired_replicas or 9) <= up - 1:
-                    scaled_down = True
-                    break
-                time.sleep(0.2)
+                return (sj.status.desired_replicas or 9) <= up - 1
+
+            scaled_down = True
+            try:
+                wait_until(scale_down_applied, timeout=30,
+                           interval=0.2, desc="autoscaler scale-down")
+            except TimeoutError:
+                scaled_down = False
             if not scaled_down:
                 problems.append(
                     f"autoscaler never scaled down (transitions "
@@ -306,4 +312,5 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))

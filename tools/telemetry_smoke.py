@@ -15,6 +15,8 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from mpi_operator_tpu.utils.waiters import wait_until  # noqa: E402
+
 REQUIRED_FAMILIES = (
     "# TYPE mpi_operator_reconcile_seconds histogram",
     "# TYPE mpi_operator_workqueue_depth histogram",
@@ -46,9 +48,11 @@ def main() -> int:
     app = OperatorApp(ServerOption(healthz_port=port,
                                    monitoring_port=port)).start()
     try:
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and app.controller is None:
-            time.sleep(0.02)
+        try:
+            wait_until(lambda: app.controller is not None, timeout=10,
+                       desc="leader election")
+        except TimeoutError:
+            pass  # reported below
         if app.controller is None:
             print("FAIL: controller never started (leader election)")
             return 1
@@ -68,10 +72,11 @@ def main() -> int:
                 }))
         app.client.mpi_jobs("default").create(job)
 
-        deadline = time.monotonic() + 15
-        while time.monotonic() < deadline and \
-                app.metrics["reconcile_seconds"].count == 0:
-            time.sleep(0.05)
+        try:
+            wait_until(lambda: app.metrics["reconcile_seconds"].count,
+                       timeout=15, desc="first reconcile")
+        except TimeoutError:
+            pass  # reported below
         if app.metrics["reconcile_seconds"].count == 0:
             print("FAIL: no reconcile observed within 15s")
             return 1
@@ -96,4 +101,5 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))

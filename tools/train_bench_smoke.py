@@ -244,4 +244,5 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    raise SystemExit(_gate(main()))
