@@ -7,7 +7,7 @@ PYTHON ?= python
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
 	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke \
-	trace-smoke topo-smoke analyze
+	trace-smoke topo-smoke durable-smoke analyze
 
 # Every smoke runs with the runtime lock-order detector armed
 # (docs/ANALYSIS.md): repo-created locks are tracked, lock-order cycles
@@ -101,6 +101,17 @@ sched-smoke:
 # byte-identical across two runs (docs/RESILIENCE.md).
 soak-smoke:
 	$(SMOKE_ENV) $(PYTHON) tools/soak_smoke.py
+
+# Durable apiserver (< 60s, CPU): WAL-backed store killed and replayed
+# byte-identical (canonical dump + uid/ownership indexes + per-kind
+# watch history + exact revision), informers resume across the restart
+# from their last-seen revision with ZERO full relists
+# (counter-asserted), a stale past-horizon resume gets a prompt 410 ->
+# exactly one clean relist, and the scripted workload's canonical dump
+# is byte-identical across two runs (docs/RESILIENCE.md "Durable
+# apiserver").
+durable-smoke:
+	$(SMOKE_ENV) $(PYTHON) tools/durable_smoke.py
 
 # Causal tracing (< 60s, CPU): one queue-gated LocalCluster job and one
 # routed serve request, each asserted as a COMPLETE causal chain —

@@ -58,6 +58,10 @@ SLO_TARGETS = {
     # propagation broke fails the gate outright.
     "ttfs_p99_s": 45.0,
     "traced_ttft_p99_s": 10.0,
+    # Durable apiserver (ISSUE 14): crash -> WAL-replayed store serving
+    # again.  Unpopulated (no apiserver_restart applied) fails the
+    # gate — the full profile guarantees at least one.
+    "apiserver_recovery_p99_s": 10.0,
 }
 
 
@@ -175,13 +179,16 @@ def main(argv=None) -> int:
           f"reconcile_p99={card.reconcile_p99_s and round(card.reconcile_p99_s, 4)}s "
           f"admission_p99={card.admission_p99_s and round(card.admission_p99_s, 2)}s "
           f"lost={card.requests_lost} violations={card.invariant_violations} "
-          f"restarts={card.controller_restarts}+{card.scheduler_restarts} "
+          f"restarts={card.controller_restarts}+{card.scheduler_restarts}"
+          f"+{card.apiserver_restarts} "
           f"recoveries={card.recoveries}; wrote {args.out}")
     ok = (card.ok
           and card.controller_restarts >= 1
           and card.scheduler_restarts >= 1
+          and card.apiserver_restarts >= 1
           and card.recoveries >= (card.controller_restarts
-                                  + card.scheduler_restarts)
+                                  + card.scheduler_restarts
+                                  + card.apiserver_restarts)
           and all(e["met"] for e in evaluation.values()))
     if not ok:
         print("bench_soak: FAIL —",

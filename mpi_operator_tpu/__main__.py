@@ -276,12 +276,24 @@ def _watch_events(server, namespace, emit, stop=None,
                 # 410 relist path — reconcile from a fresh list.
                 _emit_listed()
                 continue
+            if exc.code == "Unavailable":
+                # Apiserver down (crash->respawn window): keep
+                # re-dialing until it returns or the caller stops.
+                stop.wait(0.2)
+                continue
             raise
+        reconnect = False
         try:
             while not stop.is_set():
                 ev = watch.next(timeout=poll_timeout)
                 if ev is None:
                     continue
+                if ev.type == "CLOSED":
+                    # Server closed the stream (apiserver restart):
+                    # break to the outer loop, which re-dials from the
+                    # seen-RV watermark (history replay or 410→relist).
+                    reconnect = True
+                    break
                 if ev.type == "RELIST" or ev.obj is None:
                     _emit_listed()
                     continue
@@ -303,6 +315,8 @@ def _watch_events(server, namespace, emit, stop=None,
                 emit(obj)
         finally:
             watch.stop()
+        if reconnect:
+            continue
         return  # stream consumed to stop
 
 

@@ -280,6 +280,36 @@ def inject_scheduler_restart(ctx, fault):
     return heal
 
 
+@register_injector("apiserver_restart")
+def inject_apiserver_restart(ctx, fault):
+    """Kill the apiserver ITSELF — the last single point of total state
+    loss (docs/RESILIENCE.md "Durable apiserver").  Every verb fails
+    Unavailable for ``duration``, the un-fsynced WAL tail is lost, and
+    every watch stream is CLOSED; the heal replays snapshot + WAL back
+    to the exact acknowledged revision and swaps the fresh store into
+    the shared clientset — controller, scheduler, kubelet and fleet
+    must all survive on resumed watches with zero acknowledged writes
+    lost.  No-ops (logged) against systems without the surface or with
+    a memory-only apiserver (nothing would survive to respawn)."""
+    crash = getattr(ctx.system, "crash_apiserver", None)
+    respawn = getattr(ctx.system, "respawn_apiserver", None)
+    durable = getattr(ctx.system, "apiserver_durable", None)
+    if crash is None or respawn is None:
+        ctx.log_result(fault, resolved_target="",
+                       result="no-restartable-apiserver")
+        return None
+    if durable is not None and not durable():
+        ctx.log_result(fault, resolved_target="", result="no-wal")
+        return None
+    crashed = crash()
+    ctx.log_result(fault, resolved_target="apiserver",
+                   result="crashed" if crashed else "already-down")
+
+    def heal():
+        respawn()
+    return heal
+
+
 @register_injector("pod_delete")
 def inject_pod_delete(ctx, fault):
     """Delete the pod object through the API (eviction/drain analogue):

@@ -115,14 +115,17 @@ SCHED_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + ("spot_reclaim",)
 
 # The macro-soak's everything-on tuple (docs/RESILIENCE.md "Macro-soak
 # & crash recovery"): every opt-in kind plus the control-plane restart
-# injectors.  Only full-stack systems (soak harness: training gangs
-# through queues + serving fleet + restartable control plane) exercise
+# injectors — including the apiserver itself (``apiserver_restart``,
+# the durable-control-plane fault: WAL replay + watch-from-revision
+# resume, docs/RESILIENCE.md "Durable apiserver").  Only full-stack
+# systems (soak harness: training gangs through queues + serving fleet
+# + restartable control plane over a WAL-backed apiserver) exercise
 # every member; the rest no-op with a logged reason.  The DEFAULT tuple
 # stays untouched — recorded seeds keep deriving byte-identical plans
 # (regression-tested in tests/test_soak.py).
 FULL_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + (
     "replica_kill", "spot_reclaim", "controller_restart",
-    "scheduler_restart")
+    "scheduler_restart", "apiserver_restart")
 
 # Named presets for `randomized_plan(profile=...)`.
 PLAN_PROFILES = {
@@ -189,6 +192,11 @@ def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
             # duration = the control-plane outage before the respawn;
             # the restarted loop rebuilds its state from the apiserver.
             fault.duration = round(rng.uniform(0.4, 1.5), 3)
+        elif kind == "apiserver_restart":
+            # duration = the apiserver outage before the WAL replay
+            # respawns the store; every component rides it out on
+            # retried verbs + resumed watches.
+            fault.duration = round(rng.uniform(0.4, 1.2), 3)
         faults.append(fault)
     return FaultPlan(name=name or f"randomized-{seed}", seed=seed,
                      faults=faults)

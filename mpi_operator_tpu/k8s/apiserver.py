@@ -37,6 +37,7 @@ substrate-agnostic.
 from __future__ import annotations
 
 import http.client as _http_client
+import json as _json
 import queue as _queue
 import threading
 import urllib.error as _urllib_error
@@ -45,7 +46,8 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from ..analysis.lockcheck import name_lock
-from .meta import Clock, deep_copy, get_controller_of
+from . import wal as _walmod
+from .meta import Clock, deep_copy, format_time, get_controller_of
 from .selectors import match_labels
 
 ADDED = "ADDED"
@@ -55,6 +57,12 @@ DELETED = "DELETED"
 # continuity (410 Expired / buffer overflow) and the consumer must
 # relist NOW rather than wait for its periodic resync.
 RELIST = "RELIST"
+# Synthetic client-side event (obj=None): the server side of this
+# stream is GONE (apiserver crash).  The consumer must re-open its
+# watch — against the respawned server — from its last-seen
+# resourceVersion (history replay when in-horizon, 410 -> RELIST past
+# it; docs/RESILIENCE.md "Durable apiserver").
+CLOSED = "CLOSED"
 
 
 class ApiError(Exception):
@@ -81,6 +89,34 @@ TRANSPORT_ERRORS = (ApiError, _urllib_error.URLError, ConnectionError,
 # must reconnect on all of these, never die.
 STREAM_ERRORS = TRANSPORT_ERRORS + (ValueError, KeyError,
                                     AttributeError)
+
+
+def redial_watch(clientset, api_version: str, kind: str, stop=None,
+                 deadline: Optional[float] = None,
+                 interval: float = 0.05):
+    """Re-open a watch after the server ended the stream (the CLOSED
+    sentinel of an apiserver restart), riding out the crash->respawn
+    window — the shared shape every raw watch consumer (kubelet, batch
+    Job controller, gang scheduler, wait helpers, soak monitor) uses.
+    Re-reads ``clientset.server`` per attempt so the respawned store is
+    picked up.  Returns None once ``stop`` (a threading.Event) is set;
+    raises TimeoutError past ``deadline`` (monotonic seconds).
+    Informers resume from their last-seen revision instead
+    (SharedInformer._reconnect) — this helper is the relist-driven
+    consumers' from-now re-dial."""
+    import time as _time
+    while stop is None or not stop.is_set():
+        if deadline is not None and _time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"apiserver still down re-dialing {kind} watch")
+        try:
+            return clientset.server.watch(api_version, kind)
+        except TRANSPORT_ERRORS:
+            if stop is not None:
+                stop.wait(interval)
+            else:
+                _time.sleep(interval)
+    return None
 
 
 def not_found(kind: str, name: str) -> ApiError:
@@ -161,6 +197,13 @@ class Watch:
         if self.stopped:
             return
         with self._olock:
+            if ev.type == CLOSED:
+                # Stream termination outranks overflow state: the
+                # consumer must learn the server died even if it was
+                # slow (its pending RELIST is moot — the resumed watch
+                # or its 410 covers the gap).
+                self._q.put(ev)
+                return
             if self._overflowed:
                 self.dropped_events += 1
                 return
@@ -192,6 +235,51 @@ class Watch:
     def stop(self):
         self.stopped = True
         self._server._remove_watch(self._key, self)
+
+
+_METRICS: Optional[dict] = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics() -> dict:
+    """Apiserver/WAL observability in the shared process registry
+    (lazy: keeps k8s.apiserver importable before telemetry; get-or-
+    create, so respawned apiservers keep accumulating into the same
+    families — docs/OBSERVABILITY.md)."""
+    global _METRICS
+    with _METRICS_LOCK:
+        if _METRICS is None:
+            from ..telemetry.metrics import default_registry
+            reg = default_registry()
+            _METRICS = {
+                "history_purged": reg.counter_vec(
+                    "mpi_operator_apiserver_history_purged_total",
+                    "Watch-history events purged past the per-kind"
+                    " retention cap, by kind (a hot family here explains"
+                    " 410 storms: resumes older than the purge horizon"
+                    " must relist)", ["kind"]),
+                "horizon": reg.gauge_vec(
+                    "mpi_operator_apiserver_watch_horizon_rv",
+                    "Per-kind retained watch-history horizon: the"
+                    " highest purged revision — a watch resuming from"
+                    " at-or-below it gets 410 Expired", ["kind"]),
+                "wal_appends": reg.counter(
+                    "mpi_operator_wal_appends_total",
+                    "Mutating verbs appended to the apiserver"
+                    " write-ahead log"),
+                "wal_fsyncs": reg.counter(
+                    "mpi_operator_wal_fsyncs_total",
+                    "Group-commit fsync barriers issued by the WAL"
+                    " flusher (one covers every record buffered while"
+                    " the previous barrier ran — fsyncs << appends"
+                    " under concurrency)"),
+                "wal_snapshots": reg.counter(
+                    "mpi_operator_wal_snapshots_total",
+                    "Store snapshots committed (each rolls the WAL"
+                    " onto a fresh segment and prunes the replayed"
+                    " prefix)"),
+            }
+        return _METRICS
 
 
 class _KindStore:
@@ -235,7 +323,14 @@ class ApiServer:
     # overflows into a RELIST (slow-consumer isolation).
     WATCH_BUFFER = 8192
 
-    def __init__(self, clock: Optional[Clock] = None):
+    # Records appended between snapshots before the next snapshot rolls
+    # the log (durable mode; docs/RESILIENCE.md "Durable apiserver").
+    WAL_SNAPSHOT_EVERY = 4096
+
+    def __init__(self, clock: Optional[Clock] = None,
+                 wal_dir: Optional[str] = None,
+                 wal_fsync: bool = True,
+                 wal_snapshot_every: Optional[int] = None):
         self.clock = clock or Clock()
         self._kinds: dict = {}  # (api_version, kind) -> _KindStore
         self._kinds_lock = threading.Lock()
@@ -253,9 +348,57 @@ class ApiServer:
         # lock so an injected delay stalls only the calling client, not
         # the whole apiserver.  None = production no-op.
         self.fault_injector = None
+        # Durable mode (docs/RESILIENCE.md "Durable apiserver"): every
+        # mutating verb appends a WAL record keyed by the global
+        # revision and acknowledges only after a group-commit fsync;
+        # construction replays snapshot + WAL tail back to the exact
+        # revision.  None = the classic memory-only store, byte-for-
+        # byte the old write path (no encode, no wait).
+        self.crashed = False
+        self.wal_dir = wal_dir
+        self.wal_fsync = wal_fsync
+        self.wal_snapshot_every = (wal_snapshot_every
+                                   if wal_snapshot_every is not None
+                                   else self.WAL_SNAPSHOT_EVERY)
+        self.wal: Optional[_walmod.WriteAheadLog] = None
+        self.replay_stats: dict = {}
+        self._replay_history_floor: dict = {}
+        self._snap_stop = threading.Event()
+        self._snap_thread: Optional[threading.Thread] = None
+        self._snapshotted_appends = 0
+        # Post-commit watch delivery (durable mode): events queue here
+        # (per-kind order == revision order, guaranteed by the kind
+        # lock) and fan out only after their record's group commit —
+        # watchers must never observe a write a crash could roll back.
+        from collections import deque
+        self._pending_events = deque()
+        self._pending_lock = threading.Lock()
+        self._deliver_lock = threading.Lock()
+        # Per-thread seq of the last record this thread appended (set
+        # by _log_rv under the kind lock, read by _notify right after —
+        # saves a WAL lock round trip per write) + a one-deep timestamp
+        # format cache.
+        self._last_wal_seq = threading.local()
+        self._ts_cache: Optional[tuple] = None
+        if wal_dir is not None:
+            self._replay()
+            m = _metrics()
+            self.wal = _walmod.WriteAheadLog(
+                wal_dir, fsync=wal_fsync,
+                counters={"appends": m["wal_appends"],
+                          "fsyncs": m["wal_fsyncs"],
+                          "snapshots": m["wal_snapshots"]},
+                on_commit=self._deliver_committed)
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_loop, daemon=True,
+                name="apiserver-snapshot")
+            self._snap_thread.start()
 
     def _inject(self, verb: str, api_version: str, kind: str,
                 namespace: str = "", name: str = "") -> None:
+        if self.crashed:
+            raise ApiError("Unavailable",
+                           "apiserver is down (crashed; awaiting respawn)")
         hook = self.fault_injector
         if hook is not None:
             hook(verb, api_version, kind, namespace, name)
@@ -284,6 +427,330 @@ class ApiServer:
         """The store-wide resourceVersion a List response carries."""
         with self._rv_lock:
             return str(self._rv)
+
+    def _log_rv(self, verb: str, obj) -> str:
+        """Assign the next global revision (stamped onto ``obj``); in
+        durable mode, also append the WAL record UNDER THE SAME LOCK —
+        that coupling is what makes append order == revision order, so
+        the fsynced set is always a revision-prefix and an acknowledged
+        write can never be durable ahead of an earlier one.  ``verb``
+        is the replay shape (create/update/delete); the record carries
+        the full post-write object, encoded LAZILY by the committing
+        leader (safe: stored objects are replaced, never mutated in
+        place, so ``obj`` is frozen from here on).  Only buffering happens here
+        (no I/O): the caller holds its kind lock, and the durability
+        wait is :meth:`_wal_barrier`, AFTER every lock is released."""
+        if self.wal is None:
+            rv_str = self._next_rv()
+            obj.metadata.resource_version = rv_str
+            return rv_str
+        ts = self._wal_ts()
+        with self._rv_lock:
+            self._rv += 1
+            rv = self._rv
+            rv_str = str(rv)
+            obj.metadata.resource_version = rv_str
+
+            def build(rv=rv, verb=verb, obj=obj, ts=ts):
+                # gv/kind/ns/name live inside the encoded object —
+                # duplicating them in the head would cost bytes + time
+                # on every storm write (replay derives them).
+                from . import registry
+                return {"rv": rv, "verb": verb, "ts": ts,
+                        "obj": registry.encode(obj)}
+
+            try:
+                seq = self.wal.append(build)
+            except _walmod.WalCrashedError:
+                raise ApiError(
+                    "Unavailable",
+                    "apiserver crashed before this write committed"
+                ) from None
+            self._last_wal_seq.seq = seq
+        return rv_str
+
+    def _wal_ts(self) -> str:
+        """Injectable-clock timestamp for WAL records, formatted at most
+        once per distinct clock reading (strftime per storm write is
+        real money)."""
+        now = self.clock.now()
+        cached = self._ts_cache
+        if cached is not None and cached[0] == now:
+            return cached[1]
+        formatted = format_time(now)
+        self._ts_cache = (now, formatted)
+        return formatted
+
+    def _wal_barrier(self) -> None:
+        """Group-commit acknowledgement point: block (holding NO store
+        lock) until this thread's last-appended record is fsynced —
+        becoming the commit leader if nobody is flushing.  Memory-only
+        mode is a no-op — the classic write path is untouched."""
+        if self.wal is None:
+            return
+        try:
+            self.wal.barrier(getattr(self._last_wal_seq, "seq", None))
+        except _walmod.WalCrashedError:
+            raise ApiError(
+                "Unavailable",
+                "apiserver crashed before this write committed") from None
+        # Close the append->enqueue race: a concurrent leader can
+        # commit this verb's record BEFORE its event reached the
+        # pending queue (the queue append happens a few instructions
+        # after the WAL append) — that leader's delivery pass missed
+        # it, and the fast path above would ack without anyone ever
+        # fanning it out.  By here the event IS queued and its record
+        # IS durable: drain.
+        self._deliver_committed(self.wal.durable_seq())
+
+    # -- durability: replay / snapshot / crash -----------------------------
+    def _history_append(self, ks: _KindStore, kind: str, ev_rv: int,
+                        ev: WatchEvent) -> None:
+        """Single-sourced history push + retention trim (live _notify
+        and WAL replay must purge identically, or the post-restart
+        resume horizon would drift from the pre-crash one)."""
+        ks.history.append((ev_rv, ev))
+        purged = 0
+        while len(ks.history) > self.HISTORY_LIMIT:
+            ks.purged_rv = max(ks.purged_rv, ks.history.pop(0)[0])
+            purged += 1
+        if purged:
+            m = _metrics()
+            m["history_purged"].labels(kind).inc(purged)
+            m["horizon"].labels(kind).set(float(ks.purged_rv))
+
+    def history_horizon(self, api_version: str, kind: str) -> int:
+        """The kind's retained watch-history horizon: the highest
+        purged revision.  A watch resuming from a revision at-or-below
+        it gets 410 Expired (diagnosable via
+        mpi_operator_apiserver_watch_horizon_rv)."""
+        ks = self._kind((api_version, kind))
+        with ks.lock:
+            return ks.purged_rv
+
+    def _replay(self) -> None:
+        """Rebuild the exact pre-crash store from snapshot + WAL tail:
+        objects, the global revision counter, uid/ownership indexes and
+        per-kind event history (so watch-from-revision resumes behave
+        identically across the restart).  Records are full post-write
+        states applied under a per-object revision guard, which makes
+        replay idempotent — the fuzz of a concurrent snapshot capture
+        resolves to the same bytes."""
+        from . import registry
+        torn: list = []
+        payload, base_segment = _walmod.load_snapshot(self.wal_dir)
+        max_rv = 0
+        if payload is not None:
+            max_rv = int(payload.get("rv", 0))
+            for kd in payload.get("kinds", []):
+                gvk = (kd["gv"], kd["kind"])
+                ks = self._kind(gvk)
+                ks.purged_rv = int(kd.get("purged_rv", 0))
+                if ks.purged_rv:
+                    _metrics()["horizon"].labels(kd["kind"]).set(
+                        float(ks.purged_rv))
+                for enc in kd.get("objects", []):
+                    obj = registry.decode(enc)
+                    key = (obj.metadata.namespace, obj.metadata.name)
+                    ks.objs[key] = obj
+                    ks.index_key(key)
+                    self._track(gvk, key, obj)
+                    try:
+                        max_rv = max(max_rv,
+                                     int(obj.metadata.resource_version))
+                    except (TypeError, ValueError):
+                        pass
+                for ev_rv, ev_type, enc in kd.get("history", []):
+                    ks.history.append(
+                        (int(ev_rv),
+                         WatchEvent(ev_type, registry.decode(enc))))
+                    max_rv = max(max_rv, int(ev_rv))
+                # Events at-or-below this floor are covered by the
+                # snapshotted history; only newer WAL records append.
+                self._replay_history_floor[gvk] = int(
+                    kd.get("history_rv", 0))
+        records = 0
+        for record in _walmod.iter_records(self.wal_dir, base_segment,
+                                           on_torn=torn.append):
+            self._apply_record(record)
+            records += 1
+            max_rv = max(max_rv, int(record["rv"]))
+        with self._rv_lock:
+            self._rv = max(self._rv, max_rv)
+        self._replay_history_floor = {}
+        self.replay_stats = {
+            "snapshot": payload is not None,
+            "snapshot_rv": int(payload.get("rv", 0)) if payload else 0,
+            "records": records,
+            "torn_dropped": len(torn),
+            "rv": max_rv,
+        }
+
+    def _apply_record(self, record: dict) -> None:
+        from . import registry
+        rv = int(record["rv"])
+        obj = registry.decode(record["obj"])
+        gvk = (obj.api_version, obj.kind)
+        key = (obj.metadata.namespace, obj.metadata.name)
+        ks = self._kind(gvk)
+        verb = record["verb"]
+        with ks.lock:
+            cur = ks.objs.get(key)
+            cur_rv = 0
+            if cur is not None:
+                try:
+                    cur_rv = int(cur.metadata.resource_version)
+                except (TypeError, ValueError):
+                    cur_rv = 0
+            if verb == "delete":
+                # Skip only when the stored object is NEWER (snapshot
+                # captured a later re-create of the same key).
+                if cur is not None and cur_rv <= rv:
+                    ks.objs.pop(key)
+                    ks.unindex_key(key)
+                    self._untrack(gvk, key, cur)
+            else:
+                if cur is None or cur_rv < rv:
+                    ks.objs[key] = obj
+                    ks.index_key(key)
+                    if cur is None:
+                        self._track(gvk, key, obj)
+                    else:
+                        self._retrack(gvk, key, cur, obj)
+            if rv > self._replay_history_floor.get(gvk, 0):
+                ev_type = {"create": ADDED, "update": MODIFIED,
+                           "delete": DELETED}[verb]
+                self._history_append(ks, obj.kind, rv,
+                                     WatchEvent(ev_type, obj))
+
+    def _snapshot_loop(self) -> None:
+        while not self._snap_stop.wait(0.2):
+            wal = self.wal
+            if wal is None:
+                return
+            if (wal.appends_total - self._snapshotted_appends
+                    >= self.wal_snapshot_every):
+                try:
+                    self.take_snapshot()
+                except (_walmod.WalCrashedError, OSError):
+                    return  # crashed/closed underneath us: done
+
+    def take_snapshot(self) -> int:
+        """Roll the WAL onto a fresh segment, dump every kind (objects
+        + event history + purge horizon, per-kind-consistent), commit
+        atomically, prune the replayed prefix.  Concurrent writes keep
+        flowing — the per-object revision guard in replay makes the
+        fuzzy capture exact.  Returns the snapshot's base segment."""
+        from . import registry
+        wal = self.wal
+        if wal is None:
+            raise ApiError("Invalid", "snapshotting a memory-only store")
+        appends_before = wal.appends_total
+        base_segment = wal.roll_segment()
+        # Every record in the segments this snapshot will prune must be
+        # durable AND history-delivered BEFORE the capture — otherwise
+        # a just-fsynced event could be absent from the captured
+        # history while its record is pruned away: gone from both,
+        # silently skipped by an "in-horizon" resume after replay.
+        wal.barrier()
+        self._deliver_committed(wal.durable_seq())
+        kinds = []
+        for (gv, kind), ks in sorted(self._kind_items()):
+            with ks.lock:
+                objects = [registry.encode(ks.objs[key])
+                           for key in sorted(ks.objs)]
+                history = [[ev_rv, ev.type, registry.encode(ev.obj)]
+                           for ev_rv, ev in ks.history]
+                history_rv = (ks.history[-1][0] if ks.history
+                              else ks.purged_rv)
+                purged_rv = ks.purged_rv
+            kinds.append({"gv": gv, "kind": kind, "objects": objects,
+                          "history": history, "history_rv": history_rv,
+                          "purged_rv": purged_rv})
+        payload = {"rv": int(self.current_rv()), "kinds": kinds,
+                   "base_segment": base_segment,
+                   "ts": format_time(self.clock.now())}
+        # Every store state the capture observed is backed by an
+        # already-appended record (all verbs log BEFORE mutating the
+        # store): make those records durable before committing, so a
+        # crash in between ABORTS the snapshot instead of resurrecting
+        # writes whose records the power cut truncated away.
+        wal.barrier()
+        wal.commit_snapshot(base_segment, payload)
+        self._snapshotted_appends = appends_before
+        return base_segment
+
+    def crash(self) -> None:
+        """Simulated process death (chaos ``apiserver_restart``): every
+        verb fails Unavailable from now on, the WAL loses its
+        un-fsynced tail (acknowledged writes are durable by contract;
+        in-flight ones error out unacknowledged), and every live watch
+        stream receives the CLOSED sentinel so consumers re-dial the
+        respawned server from their last-seen revision.  Idempotent."""
+        if self.crashed:
+            return
+        self.crashed = True
+        self._snap_stop.set()
+        if self.wal is not None:
+            self.wal.crash()
+        if self._snap_thread is not None:
+            # A snapshot mid-commit could otherwise prune segments
+            # WHILE the respawned server replays them — the crash must
+            # be quiescent before a successor reads the directory.
+            self._snap_thread.join(timeout=10.0)
+        with self._pending_lock:
+            # Undelivered events die with the process: their records
+            # were never fsynced-and-fanned-out, and their writers were
+            # never acknowledged.
+            self._pending_events.clear()
+        closed = []
+        for _, ks in self._kind_items():
+            with ks.lock:
+                closed.extend(ks.watches)
+                ks.watches = []
+        for w in closed:
+            w._send(WatchEvent(CLOSED, None))
+
+    def close(self) -> None:
+        """Graceful shutdown of the durability machinery (drain +
+        fsync); memory-only stores have nothing to do."""
+        self._snap_stop.set()
+        if self._snap_thread is not None:
+            self._snap_thread.join(timeout=10.0)
+        if self.wal is not None:
+            self.wal.close()
+
+    def canonical_dump(self, strip_volatile: bool = False) -> bytes:
+        """Deterministic byte rendering of the whole store (sorted
+        kinds/keys, wire encoding, sorted JSON keys) — the replay-
+        exactness oracle.  ``strip_volatile`` removes per-run
+        nondeterminism (uids and uid-derived fields) for cross-run
+        byte-identity checks on seeded scripted workloads."""
+        from . import registry
+        kinds: dict = {}
+        for (gv, kind), ks in sorted(self._kind_items()):
+            with ks.lock:
+                items = {f"{ns}/{name}": registry.encode(ks.objs[(ns,
+                                                                  name)])
+                         for ns, name in sorted(ks.objs)}
+            if strip_volatile:
+                for enc in items.values():
+                    self._strip_volatile(enc)
+            if items:
+                kinds[f"{gv}/{kind}"] = items
+        return _json.dumps({"rv": self.current_rv(), "kinds": kinds},
+                           sort_keys=True,
+                           separators=(",", ":")).encode()
+
+    @staticmethod
+    def _strip_volatile(enc: dict) -> None:
+        from ..api import constants as _constants
+        meta_dict = enc.get("metadata") or {}
+        meta_dict.pop("uid", None)
+        for ref in meta_dict.get("ownerReferences") or []:
+            ref.pop("uid", None)
+        annotations = meta_dict.get("annotations") or {}
+        annotations.pop(_constants.TRACE_CONTEXT_ANNOTATION, None)
 
     # -- relationship indexes ---------------------------------------------
     def _track(self, gvk, key, obj) -> None:
@@ -344,12 +811,44 @@ class ApiServer:
         except (TypeError, ValueError):
             with self._rv_lock:
                 ev_rv = self._rv
-        ks.history.append((ev_rv, ev))
-        while len(ks.history) > self.HISTORY_LIMIT:
-            ks.purged_rv = max(ks.purged_rv, ks.history.pop(0)[0])
-        for w in list(ks.watches):
-            w._send(ev)
+        if self.wal is None:
+            self._history_append(ks, obj.kind, ev_rv, ev)
+            for w in list(ks.watches):
+                w._send(ev)
+            return ev
+        # Durable mode: DEFER history + fan-out to the record's group
+        # commit (etcd semantics — a watcher must never observe a write
+        # a crash could still roll back; otherwise informer caches
+        # could hold phantom future revisions the replayed store never
+        # assigned).  Per-kind ordering is safe: the kind lock is held
+        # here, so queue order == revision order within the kind.
+        with self._pending_lock:
+            self._pending_events.append(
+                (self._last_wal_seq.seq, ks, obj.kind, ev_rv, ev))
         return ev
+
+    def _deliver_committed(self, durable_seq: int) -> None:
+        """WAL flusher callback (post-fsync, no WAL lock held): fan out
+        every queued event whose record is now durable, in queue
+        order.  The pending lock is never held across the kind lock
+        (verbs nest kind->pending; nesting the other way here would
+        deadlock)."""
+        if not self._pending_events:
+            return  # dirty fast path: every verb drains post-barrier
+        with self._deliver_lock:
+            with self._pending_lock:
+                batch = []
+                pending = self._pending_events
+                while pending and pending[0][0] <= durable_seq:
+                    batch.append(pending.popleft())
+            for _, ks, kind, ev_rv, ev in batch:
+                if self.crashed:
+                    return
+                with ks.lock:
+                    self._history_append(ks, kind, ev_rv, ev)
+                    watchers = list(ks.watches)
+                for w in watchers:
+                    w._send(ev)
 
     def relist_watches(self, api_version: Optional[str] = None,
                        kind: Optional[str] = None) -> int:
@@ -414,7 +913,6 @@ class ApiServer:
                 raise already_exists(obj.kind, obj.metadata.name)
             if not obj.metadata.uid:
                 obj.metadata.uid = str(uuid.uuid4())
-            obj.metadata.resource_version = self._next_rv()
             if obj.metadata.creation_timestamp is None:
                 obj.metadata.creation_timestamp = self.clock.now()
             if obj.kind == "MPIJob":
@@ -424,6 +922,9 @@ class ApiServer:
                 # unscheduled (e.g. gang-gated) pod must count as active
                 # for Job controllers, not as missing.
                 obj.status.phase = "Pending"
+            # Revision assignment LAST (after every defaulting mutation)
+            # so the WAL record is the exact post-write object.
+            obj.metadata.resource_version = self._log_rv("create", obj)
             ks.objs[key] = obj
             ks.index_key(key)
             self._track(gvk, key, obj)
@@ -441,6 +942,7 @@ class ApiServer:
         # object of every kind on every owned create.)
         if ctrl_ref is not None and not self._uid_exists(ctrl_ref.uid):
             self._reap(gvk, key, obj)
+        self._wal_barrier()
         return created
 
     def _reap(self, gvk, key, inserted) -> None:
@@ -449,10 +951,13 @@ class ApiServer:
             cur = ks.objs.get(key)
             if cur is not inserted:
                 return  # replaced or deleted since the insert
+            # Log BEFORE removing: every store-visible mutation must be
+            # backed by an already-appended record (the snapshot's
+            # durability barrier relies on it).
+            cur.metadata.resource_version = self._log_rv("delete", cur)
             ks.objs.pop(key)
             ks.unindex_key(key)
             self._untrack(gvk, key, cur)
-            cur.metadata.resource_version = self._next_rv()
             self._notify(ks, DELETED, cur)
         self._cascade_delete(cur)
 
@@ -532,14 +1037,16 @@ class ApiServer:
             obj.metadata.resource_version = current.metadata.resource_version
             if obj == current:
                 return deep_copy(current)
-            obj.metadata.resource_version = self._next_rv()
+            obj.metadata.resource_version = self._log_rv("update", obj)
             ks.objs[key] = obj
             # Owner references may legally change on a spec update:
             # keep the relationship indexes in lockstep (atomic swap —
             # no transient zero refcount for the unchanged uid).
             self._retrack(gvk, key, current, obj)
             self._notify(ks, MODIFIED, obj)
-            return deep_copy(obj)
+            updated = deep_copy(obj)
+        self._wal_barrier()
+        return updated
 
     def patch_status(self, api_version: str, kind: str, namespace: str,
                      name: str, fields: dict):
@@ -560,25 +1067,31 @@ class ApiServer:
                 setattr(new.status, field_name, deep_copy(value))
             if new == current:
                 return deep_copy(current)
-            new.metadata.resource_version = self._next_rv()
+            new.metadata.resource_version = self._log_rv("update", new)
             ks.objs[key] = new
-            return self._notify(ks, MODIFIED, new).obj
+            frozen = self._notify(ks, MODIFIED, new).obj
+        self._wal_barrier()
+        return frozen
 
     def delete(self, api_version: str, kind: str, namespace: str, name: str):
         self._inject("delete", api_version, kind, namespace, name)
         gvk = (api_version, kind)
         ks = self._kind(gvk)
         with ks.lock:
-            obj = ks.objs.pop((namespace, name), None)
+            obj = ks.objs.get((namespace, name))
             if obj is None:
                 raise not_found(kind, f"{namespace}/{name}")
-            ks.unindex_key((namespace, name))
-            self._untrack(gvk, (namespace, name), obj)
             # A real apiserver bumps the RV on delete; the DELETED event
             # carries the new version (required for exact watch replay).
-            obj.metadata.resource_version = self._next_rv()
+            # Logged BEFORE the removal so every store-visible mutation
+            # is backed by an already-appended record.
+            obj.metadata.resource_version = self._log_rv("delete", obj)
+            ks.objs.pop((namespace, name))
+            ks.unindex_key((namespace, name))
+            self._untrack(gvk, (namespace, name), obj)
             self._notify(ks, DELETED, obj)
         self._cascade_delete(obj)
+        self._wal_barrier()
         return deep_copy(obj)
 
     def _cascade_delete(self, owner) -> None:
@@ -601,14 +1114,15 @@ class ApiServer:
                 ref = get_controller_of(o)
                 if ref is None or ref.uid != owner_uid or not ref.controller:
                     continue
-                ks.objs.pop(key)
-                ks.unindex_key(key)
-                self._untrack(gvk, key, o)
                 # Same RV bump as a direct delete: every DELETED event
                 # must carry a fresh RV or watch-history replay (and a
                 # live client's resume RV) would rewind to the object's
-                # stale last-write version.
-                o.metadata.resource_version = self._next_rv()
+                # stale last-write version.  Logged BEFORE the removal
+                # (see delete()).
+                o.metadata.resource_version = self._log_rv("delete", o)
+                ks.objs.pop(key)
+                ks.unindex_key(key)
+                self._untrack(gvk, key, o)
                 self._notify(ks, DELETED, o)
                 dead_list.append(o)
         for dead in dead_list:
@@ -628,6 +1142,9 @@ class ApiServer:
         ``buffer`` overrides the per-stream fan-out bound
         (``WATCH_BUFFER``); 0 disables it.
         """
+        if self.crashed:
+            raise ApiError("Unavailable",
+                           "apiserver is down (crashed; awaiting respawn)")
         gvk = (api_version, kind)
         ks = self._kind(gvk)
         with ks.lock:
@@ -636,6 +1153,17 @@ class ApiServer:
                 rv = int(resource_version)
                 if rv < ks.purged_rv:
                     raise expired(kind, resource_version)
+                with self._rv_lock:
+                    current = self._rv
+                if rv > current:
+                    # A revision from the FUTURE: this client last saw
+                    # a different store incarnation (e.g. a memory-only
+                    # restart reset the counter).  Resuming would
+                    # silently miss the whole gap — force the relist
+                    # path instead (the 410 contract).
+                    raise expired(kind, f"{resource_version} is ahead "
+                                        f"of the store (restarted "
+                                        f"apiserver?)")
                 for ev_rv, ev in ks.history:
                     if ev_rv > rv:
                         w._send(ev)

@@ -147,6 +147,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             while not self.server.stopping:  # type: ignore[attr-defined]
                 ev = watch.next(timeout=0.5)
+                if ev is not None and ev.type == "CLOSED":
+                    # The store crashed under this stream: end the
+                    # response cleanly — the client reconnects (from
+                    # its last delivered RV) against the respawned
+                    # store and replays the gap or gets its 410.
+                    break
                 if ev is None:
                     chunk = b": keepalive\n"
                 elif ev.obj is None:

@@ -34,8 +34,8 @@ import os
 import threading
 from typing import Callable, Optional
 
-from .apiserver import (ADDED, DELETED, MODIFIED, RELIST, ApiError,
-                        ApiServer, Clientset)
+from .apiserver import (ADDED, CLOSED, DELETED, MODIFIED, RELIST,
+                        TRANSPORT_ERRORS, ApiError, ApiServer, Clientset)
 from .meta import deep_copy, get_controller_of
 from .selectors import match_labels
 
@@ -73,6 +73,16 @@ def _counters() -> dict:
             "Failures isolated inside informer watch/resync loops"
             " (per-object install faults, relist API weather) instead"
             " of killing the watch thread"),
+        "watch_resumes": reg.counter(
+            "mpi_operator_informer_watch_resumes_total",
+            "Watch streams re-opened from the informer's last-seen"
+            " resourceVersion after the server closed them (apiserver"
+            " restart): in-horizon resumes replay history — no relist"),
+        "resume_relists": reg.counter(
+            "mpi_operator_informer_resume_relists_total",
+            "Watch resumes rejected 410 Expired (last-seen revision"
+            " past the retained horizon): the informer fell back to a"
+            " full relist (must stay 0 for in-horizon restarts)"),
     }
 
 
@@ -428,6 +438,14 @@ class SharedInformer:
         self.synced = False
         self.resync_suppressed = 0
         self._resync_session: Optional[dict] = None
+        # Watch-from-revision resume (docs/RESILIENCE.md "Durable
+        # apiserver"): the highest resourceVersion this informer has
+        # observed — on a CLOSED stream (apiserver restart) the watch
+        # re-opens FROM it, replaying the gap from the respawned
+        # server's history instead of a full relist.
+        self._last_rv = 0
+        self.watch_resumes = 0
+        self.resume_relists = 0
 
     def add_index_func(self, name: str, fn: Callable) -> None:
         """Register a pluggable index function (client-go AddIndexers)."""
@@ -473,12 +491,66 @@ class SharedInformer:
                 # The list response is a server-side copy: install it
                 # directly as the shared snapshot.
                 self._store[(obj.metadata.namespace, obj.metadata.name)] = obj
+                self._note_rv(obj.metadata.resource_version)
         self.synced = True
         for obj in initial:
             self._dispatch(ADDED, None, obj)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"informer-{self.kind}")
         self._thread.start()
+
+    def _note_rv(self, rv) -> None:
+        """Advance the stream position (max observed resourceVersion —
+        the revision a post-restart resume starts from)."""
+        try:
+            self._last_rv = max(self._last_rv, int(rv))
+        except (TypeError, ValueError):
+            pass  # non-numeric RV: resume falls back to from-now
+
+    def _reconnect(self) -> None:
+        """The server closed this stream (apiserver crash).  Re-dial —
+        against whatever server the clientset now points at — FROM the
+        last-seen revision: an in-horizon resume replays the gap from
+        the server's history (zero relists, counter-asserted by the
+        durable smoke); a 410 Expired past the horizon falls back to
+        one clean full relist.  Retries ride out the crash->respawn
+        window.
+
+        Scope note: only the in-process ApiServer emits CLOSED (and
+        raises Expired synchronously from watch()).  The HTTP
+        transports (_RemoteWatch/_KubeWatch) reconnect-and-resume
+        INTERNALLY and surface a past-horizon 410 as a RELIST sentinel
+        on the existing stream — so the resume counters below describe
+        the in-process substrate; remote relists land in the normal
+        RELIST branch of the run loop."""
+        resume_rv = str(self._last_rv) if self._last_rv else None
+        while not self._stopped.is_set():
+            try:
+                self._watch = self._cs.server.watch(
+                    self.api_version, self.kind,
+                    resource_version=resume_rv)
+            except ApiError as exc:
+                if exc.code == "Expired" and resume_rv is not None:
+                    # Past the retained horizon: the gap is gone from
+                    # history — fall back to watch-from-now + relist.
+                    self.resume_relists += 1
+                    _COUNTERS["resume_relists"].inc()
+                    resume_rv = None
+                    continue
+                self._stopped.wait(0.05)  # respawn pending; retry
+            except TRANSPORT_ERRORS:
+                self._stopped.wait(0.05)
+            else:
+                self.watch_resumes += 1
+                _COUNTERS["watch_resumes"].inc()
+                if resume_rv is None:
+                    # From-now stream (fresh informer or post-410): a
+                    # relist closes the gap the history could not.
+                    try:
+                        self._begin_resync()
+                    except Exception:
+                        _COUNTERS["isolated_errors"].inc()
+                return
 
     def _run(self) -> None:
         import time
@@ -488,6 +560,12 @@ class SharedInformer:
             # the session keeps making progress on a quiet stream.
             timeout = 0.005 if self._resync_session is not None else 0.1
             ev = self._watch.next(timeout=timeout)
+            if ev is not None and ev.type == CLOSED:
+                # Server-side stream termination (apiserver restart):
+                # resume from the last-seen revision, not a relist.
+                self._reconnect()
+                last_resync = time.monotonic()
+                continue
             if ev is not None and ev.type == RELIST:
                 # The watch lost replay continuity (410 Expired /
                 # fan-out buffer overflow): start a fresh relist session
@@ -503,6 +581,12 @@ class SharedInformer:
                     # original schedule rather than a full fresh interval.
                     _COUNTERS["isolated_errors"].inc()
                 continue
+            if ev is not None and ev.obj is not None:
+                # Every observed event advances the resume position —
+                # including cross-namespace ones the filter below drops
+                # (the stream HAS delivered them; a resume must not
+                # replay the whole foreign-namespace backlog).
+                self._note_rv(ev.obj.metadata.resource_version)
             # Note: the resync check below must run on EVERY iteration —
             # a `continue` for filtered events would let sustained
             # cross-namespace traffic starve resync.

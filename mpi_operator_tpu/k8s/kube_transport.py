@@ -178,11 +178,11 @@ class _KubeWatch:
     resourceVersion, BOOKMARK events consumed for progress only."""
 
     def __init__(self, transport: "KubeApiServer", api_version: str,
-                 kind: str):
+                 kind: str, resource_version: Optional[str] = None):
         self._t = transport
         self._api_version = api_version
         self._kind = kind
-        self._rv: Optional[str] = None
+        self._rv: Optional[str] = resource_version
         self._q: "queue.Queue[WatchEvent]" = queue.Queue()
         self.stopped = False
         self._resp = None
@@ -438,8 +438,10 @@ class KubeApiServer:
             return None
         return _decode_as(data, api_version, kind)
 
-    def watch(self, api_version: str, kind: str) -> _KubeWatch:
-        w = _KubeWatch(self, api_version, kind)
+    def watch(self, api_version: str, kind: str,
+              resource_version: Optional[str] = None) -> _KubeWatch:
+        w = _KubeWatch(self, api_version, kind,
+                       resource_version=resource_version)
         # Block briefly until the stream is live: informers list AFTER
         # watch, relying on "events since the watch started" — an
         # unconnected stream would silently drop that window (healed only
@@ -751,6 +753,14 @@ class _FixtureHandler(BaseHTTPRequestHandler):
                         self._write_chunk(b": keepalive\n")
                         last_write = _time.monotonic()
                     continue
+                if ev.type == "CLOSED":
+                    # Apiserver crashed under the fixture: end the
+                    # stream cleanly (terminal chunk); the client
+                    # reconnects from its last RV against the
+                    # respawned store — history replay in-horizon,
+                    # 410 past it.
+                    self._write_chunk(b"")
+                    break
                 if ev.type == "RELIST":
                     # Chaos (ApiServer.relist_watches): the store stream
                     # lost continuity.  Over the wire that is a 410

@@ -97,19 +97,41 @@ def _snake(name: str) -> str:
     return s.lower()
 
 
+# Per-class (field name, camelCase wire name) cache: to_dict is on the
+# durable apiserver's per-write path (WAL record encoding), where the
+# original fields()-reflection-per-node walk dominated the write cost.
+_TO_DICT_SPEC: dict = {}
+
+
 def to_dict(obj: Any) -> Any:
     """Serialize a dataclass tree to a JSON-compatible dict, dropping empty
     fields (matching k8s `omitempty` rendering)."""
     if dataclasses.is_dataclass(obj):
+        spec = _TO_DICT_SPEC.get(obj.__class__)
+        if spec is None:
+            spec = _TO_DICT_SPEC[obj.__class__] = [
+                (f.name, _camel(f.name))
+                for f in dataclasses.fields(obj)]
         out = {}
-        for f in dataclasses.fields(obj):
-            val = to_dict(getattr(obj, f.name))
+        for name, camel in spec:
+            raw = getattr(obj, name)
             # omitempty: drop None/empty containers/empty strings.  0 and
             # False are kept — they are meaningful for Optional fields
             # (e.g. worker replicas=0 mirrors Go's non-nil *int32).
+            if raw is None:
+                continue
+            t = raw.__class__
+            if t is str:
+                if raw:
+                    out[camel] = raw
+                continue
+            if t is int or t is float or t is bool:
+                out[camel] = raw
+                continue
+            val = to_dict(raw)
             if val is None or val == "" or val == {} or val == []:
                 continue
-            out[_camel(f.name)] = val
+            out[camel] = val
         return out
     if isinstance(obj, dict):
         return {k: to_dict(v) for k, v in obj.items() if v is not None}
@@ -123,21 +145,34 @@ def to_dict(obj: Any) -> Any:
     return obj
 
 
+# Per-class decode spec cache: resolved type hints + field-name set +
+# a wire-name -> snake-name memo.  typing.get_type_hints costs ~100us
+# per CALL — it dominated WAL replay (one from_dict tree per record),
+# turning crash recovery into seconds it doesn't need to be.
+_FROM_DICT_SPEC: dict = {}
+_SNAKE_MEMO: dict = {}
+
+
 def from_dict(cls, data: Any):
     """Deserialize a JSON dict into dataclass `cls` (best-effort typed)."""
     if data is None:
         return None
     if not dataclasses.is_dataclass(cls):
         return data
-    import typing
+    spec = _FROM_DICT_SPEC.get(cls)
+    if spec is None:
+        hints = typing.get_type_hints(cls)
+        spec = _FROM_DICT_SPEC[cls] = {
+            f.name: hints.get(f.name, Any)
+            for f in dataclasses.fields(cls)}
     kwargs = {}
-    hints = typing.get_type_hints(cls)
-    fields = {f.name: f for f in dataclasses.fields(cls)}
     for key, val in data.items():
-        name = _snake(key)
-        if name not in fields:
+        name = _SNAKE_MEMO.get(key)
+        if name is None:
+            name = _SNAKE_MEMO[key] = _snake(key)
+        ftype = spec.get(name)
+        if ftype is None:
             continue
-        ftype = hints.get(name, Any)
         kwargs[name] = _coerce(ftype, val)
     return cls(**kwargs)
 

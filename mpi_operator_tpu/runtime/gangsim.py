@@ -109,13 +109,25 @@ class GangSchedulerSim:
         # Reconcile every ~0.15s tick (cheap and idempotent; relists, so
         # the watches exist only to bound memory, not to carry state) —
         # set_capacity kicks an immediate pass.
+        from ..k8s.apiserver import CLOSED, redial_watch
+        kinds = (_VOLCANO, _SCHED_PLUGINS, ("v1", "Pod"))
         while not self._stop.is_set():
             # Drain watch queues fully: one event per tick would let the
             # backlog grow without bound under pod churn (reconcile's own
             # binds generate events too).
-            for w in self._watches:
-                while w.next(timeout=0) is not None:
-                    pass
+            for i, w in enumerate(self._watches):
+                while True:
+                    ev = w.next(timeout=0)
+                    if ev is None:
+                        break
+                    if ev.type == CLOSED:
+                        # Apiserver restarted: re-dial; the relist-
+                        # shaped reconcile covers the gap.
+                        fresh = redial_watch(self.client, *kinds[i],
+                                             stop=self._stop)
+                        if fresh is not None:
+                            self._watches[i] = fresh
+                        break
             self._kick.clear()
             try:
                 self.reconcile_once()

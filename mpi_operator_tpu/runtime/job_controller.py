@@ -58,15 +58,26 @@ class JobController:
 
     def _loop(self, resync_interval: float) -> None:
         import time as _time
+        from ..k8s.apiserver import CLOSED, redial_watch
         next_resync = 0.0
         while not self._stop.is_set():
             dirty = False
-            for w in (self._job_watch, self._pod_watch):
+            for attr, gv, kind in (("_job_watch", "batch/v1", "Job"),
+                                   ("_pod_watch", "v1", "Pod")):
+                w = getattr(self, attr)
                 while True:
                     ev = w.next(timeout=0)
                     if ev is None:
                         break
                     dirty = True
+                    if ev.type == CLOSED:
+                        # Apiserver restarted: re-dial (the loop's
+                        # relist-shaped sync_all covers the gap).
+                        fresh = redial_watch(self.client, gv, kind,
+                                             stop=self._stop)
+                        if fresh is not None:
+                            setattr(self, attr, fresh)
+                        break
             now = _time.monotonic()
             if dirty or now >= next_resync:
                 try:

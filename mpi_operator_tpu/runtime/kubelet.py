@@ -344,12 +344,30 @@ class LocalKubelet:
             r.stop()
         shutil.rmtree(self.root_dir, ignore_errors=True)
 
+    def _redial_watch(self, api_version: str, kind: str):
+        """Re-open a watch the server closed (apiserver restart);
+        None when the kubelet is stopping."""
+        from ..k8s.apiserver import redial_watch
+        return redial_watch(self.client, api_version, kind,
+                            stop=self._stop)
+
     def _loop(self) -> None:
-        from ..k8s.apiserver import ADDED, DELETED, MODIFIED, RELIST
+        from ..k8s.apiserver import (ADDED, CLOSED, DELETED, MODIFIED,
+                                     RELIST, WatchEvent)
         while not self._stop.is_set():
             ev = self._watch.next(timeout=0.1)
             if ev is None:
                 continue
+            if ev.type == CLOSED:
+                # Apiserver restarted: re-dial against the respawned
+                # server, then reconcile the outage gap exactly like a
+                # RELIST (runners are the surviving data plane — only
+                # the watch stream died).
+                w = self._redial_watch("v1", "Pod")
+                if w is None:
+                    return
+                self._watch = w
+                ev = WatchEvent(RELIST, None)
             if ev.type == RELIST:
                 # Watch lost replay continuity (410): reconcile against a
                 # fresh list so gap events aren't missed (obj is None) —
@@ -389,9 +407,15 @@ class LocalKubelet:
                 self.release_pod_ip(*key)
 
     def _cm_loop(self) -> None:
-        from ..k8s.apiserver import MODIFIED
+        from ..k8s.apiserver import CLOSED, MODIFIED
         while not self._stop.is_set():
             ev = self._cm_watch.next(timeout=0.1)
+            if ev is not None and ev.type == CLOSED:
+                w = self._redial_watch("v1", "ConfigMap")
+                if w is None:
+                    return
+                self._cm_watch = w
+                continue
             if ev is None or ev.type != MODIFIED:
                 continue
             cm = ev.obj

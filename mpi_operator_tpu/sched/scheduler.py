@@ -204,9 +204,10 @@ class GangScheduler:
     # Lifecycle
     # ------------------------------------------------------------------
     def start(self) -> "GangScheduler":
-        for api_version, kind in ((MPIJOB_GV, constants.KIND),
-                                  (SCHED_GROUP_VERSION, CLUSTER_QUEUE_KIND),
-                                  (SCHED_GROUP_VERSION, LOCAL_QUEUE_KIND)):
+        self._watch_kinds = ((MPIJOB_GV, constants.KIND),
+                             (SCHED_GROUP_VERSION, CLUSTER_QUEUE_KIND),
+                             (SCHED_GROUP_VERSION, LOCAL_QUEUE_KIND))
+        for api_version, kind in self._watch_kinds:
             self._watches.append(self.client.server.watch(api_version, kind))
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gang-scheduler")
@@ -224,10 +225,22 @@ class GangScheduler:
     def _loop(self) -> None:
         # gangsim-style: cheap idempotent relist reconcile per tick; the
         # watches only bound latency (drained, not interpreted).
+        from ..k8s.apiserver import CLOSED, redial_watch
         while not self._stop.is_set():
-            for w in self._watches:
-                while w.next(timeout=0) is not None:
-                    pass
+            for i, w in enumerate(self._watches):
+                while True:
+                    ev = w.next(timeout=0)
+                    if ev is None:
+                        break
+                    if ev.type == CLOSED:
+                        # Apiserver restarted: re-dial (the relist
+                        # reconcile below covers the outage gap).
+                        fresh = redial_watch(self.client,
+                                             *self._watch_kinds[i],
+                                             stop=self._stop)
+                        if fresh is not None:
+                            self._watches[i] = fresh
+                        break
             self._kick.clear()
             try:
                 self.reconcile_once()

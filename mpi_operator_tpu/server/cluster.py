@@ -13,7 +13,7 @@ from typing import Optional
 
 from ..controller.controller import MPIJobController
 from ..controller.podgroup import new_pod_group_ctrl
-from ..k8s.apiserver import Clientset
+from ..k8s.apiserver import CLOSED, ApiServer, Clientset
 from ..runtime.gangsim import GangSchedulerSim
 from ..runtime.job_controller import JobController
 from ..runtime.kubelet import LocalKubelet
@@ -28,10 +28,17 @@ class LocalCluster:
                  gang_capacity: Optional[int] = None,
                  client: Optional[Clientset] = None,
                  sched_slices=None,
-                 sched_options: Optional[dict] = None):
+                 sched_options: Optional[dict] = None,
+                 wal_dir: Optional[str] = None):
         # An injected client lets the identical stack run over a remote
         # transport (e.g. KubeApiServer against kube path grammar).
-        self.client = client or Clientset()
+        # ``wal_dir`` makes the in-process apiserver DURABLE (WAL +
+        # snapshots, docs/RESILIENCE.md "Durable apiserver") and arms
+        # the crash_apiserver/respawn_apiserver chaos surface.
+        if client is None:
+            client = Clientset(server=ApiServer(wal_dir=wal_dir)) \
+                if wal_dir is not None else Clientset()
+        self.client = client
         # Respawn config (crash_controller/respawn_controller — the
         # chaos controller_restart surface, docs/RESILIENCE.md): what a
         # fresh controller process would read from its flags.
@@ -184,6 +191,45 @@ class LocalCluster:
         self.scheduler.start()
         return self.scheduler
 
+    def apiserver_durable(self) -> bool:
+        """True when the apiserver can survive a crash (WAL-backed)."""
+        return getattr(self.client.server, "wal", None) is not None
+
+    def crash_apiserver(self) -> bool:
+        """Kill the apiserver itself — the last single point of total
+        state loss.  Every verb fails Unavailable, the un-fsynced WAL
+        tail is lost (never acknowledged), and every watch stream gets
+        the CLOSED sentinel; controller, scheduler, kubelet and fleet
+        all survive on their resumed watches once the respawn replays
+        the store.  Idempotent; False when already down or when the
+        server is memory-only (nothing could be recovered — the chaos
+        injector logs that as a no-op)."""
+        if not self.apiserver_durable() \
+                or getattr(self, "_apiserver_down", False):
+            return False
+        self._apiserver_down = True
+        self.client.server.crash()
+        return True
+
+    def respawn_apiserver(self) -> ApiServer:
+        """Construct a fresh ApiServer over the SAME wal_dir: replay
+        snapshot + WAL tail back to the exact acknowledged revision
+        (byte-identical store, uid/ownership indexes, per-kind event
+        history), then swap it into the shared clientset — every
+        component's next verb and every resumed watch lands on the
+        replayed store.  The chaos fault bank carries over (the engine
+        installed it on the old incarnation)."""
+        if not getattr(self, "_apiserver_down", False):
+            return self.client.server  # already live (overlapping heals)
+        old = self.client.server
+        fresh = ApiServer(clock=old.clock, wal_dir=old.wal_dir,
+                          wal_fsync=old.wal_fsync,
+                          wal_snapshot_every=old.wal_snapshot_every)
+        fresh.fault_injector = old.fault_injector
+        self.client.server = fresh
+        self._apiserver_down = False
+        return fresh
+
     # -- conveniences ------------------------------------------------------
     def submit(self, mpi_job):
         return self.client.mpi_jobs(
@@ -209,6 +255,16 @@ class LocalCluster:
                         f"{describe or predicate}")
                 ev = watch.next(timeout=min(remaining, 1.0))
                 if ev is None:
+                    continue
+                if ev.type == CLOSED:
+                    # Apiserver restarted mid-wait: re-dial against the
+                    # respawned store and re-evaluate (the predicate may
+                    # have been satisfied inside the outage gap).
+                    watch = self._redial(api_version, kind, deadline)
+                    for obj in self.client.server.list(api_version,
+                                                       kind, namespace):
+                        if predicate(obj):
+                            return obj
                     continue
                 if ev.type == "RELIST":
                     # Watch lost replay continuity (410, obj is None):
@@ -243,11 +299,22 @@ class LocalCluster:
                 if remaining <= 0:
                     raise TimeoutError(
                         f"never satisfied: {describe or fn}")
-                watch.next(timeout=min(remaining, 0.5))
+                ev = watch.next(timeout=min(remaining, 0.5))
+                if ev is not None and ev.type == CLOSED:
+                    # Apiserver restarted mid-wait: re-dial so events
+                    # keep driving the predicate re-evaluation.
+                    watch = self._redial(api_version, kind, deadline)
                 if fn():
                     return
         finally:
             watch.stop()
+
+    def _redial(self, api_version: str, kind: str, deadline: float):
+        """Re-open a wait helper's watch after a CLOSED stream, riding
+        out the crash->respawn window (bounded by the wait deadline)."""
+        from ..k8s.apiserver import redial_watch
+        return redial_watch(self.client, api_version, kind,
+                            deadline=deadline)
 
     def wait_for_condition(self, namespace: str, name: str, cond_type: str,
                            status: str = "True", timeout: float = 60.0):

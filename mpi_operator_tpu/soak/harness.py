@@ -93,6 +93,12 @@ class SoakConfig:
     converge_timeout: float = 60.0
     settle: float = 10.0
     threadiness: int = 4
+    # Durable apiserver (docs/RESILIENCE.md "Durable apiserver"): the
+    # WAL directory backing the in-process apiserver.  None = the
+    # harness makes (and cleans up) a temp dir — the soak's apiserver
+    # is ALWAYS durable, because the full chaos profile includes
+    # apiserver_restart faults.
+    wal_dir: Optional[str] = None
 
 
 @dataclass
@@ -208,13 +214,27 @@ class _JobMonitor:
 
     # -- loop ----------------------------------------------------------------
     def _loop(self) -> None:
-        from ..k8s.apiserver import DELETED, RELIST
+        from ..k8s.apiserver import CLOSED, DELETED, RELIST, WatchEvent
         while not self._stop.is_set():
             ev = self._watch.next(timeout=0.2)
             now = time.monotonic()
             self._drain_engine_events()
             if ev is None:
                 continue
+            if ev.type == CLOSED:
+                # Apiserver restarted mid-soak: re-dial against the
+                # respawned store, then reconcile like a RELIST so the
+                # goodput timeline never stalls on a dead stream.
+                from ..k8s.apiserver import redial_watch
+                redialed = redial_watch(self.client,
+                                        constants.GROUP_VERSION,
+                                        constants.KIND,
+                                        stop=self._stop)
+                if redialed is None:
+                    return
+                self._watch = redialed
+                ev = WatchEvent(RELIST, None)
+                now = time.monotonic()
             if ev.type == RELIST:
                 for job in self.client.server.list(
                         constants.GROUP_VERSION, constants.KIND,
@@ -337,8 +357,15 @@ class SoakHarness:
     with injected-latency occupancy on the 1-core host)."""
 
     def __init__(self, config: SoakConfig, server_factory):
+        import tempfile
+        from ..k8s.apiserver import ApiServer
         self.config = config
-        self.client = Clientset()
+        self._owned_wal_dir = None
+        wal_dir = config.wal_dir
+        if wal_dir is None:
+            wal_dir = self._owned_wal_dir = tempfile.mkdtemp(
+                prefix="soak-wal-")
+        self.client = Clientset(server=ApiServer(wal_dir=wal_dir))
         self.cluster = LocalCluster(
             threadiness=config.threadiness,
             namespace="default",
@@ -451,6 +478,29 @@ class SoakHarness:
         self._recovered("scheduler", time.monotonic() - t0)
         return sched
 
+    def apiserver_durable(self) -> bool:
+        return self.cluster.apiserver_durable()
+
+    def crash_apiserver(self) -> bool:
+        crashed = self.cluster.crash_apiserver()
+        if crashed:
+            flight.record("other", "apiserver_crash",
+                          component="apiserver")
+        return crashed
+
+    def respawn_apiserver(self):
+        if not getattr(self.cluster, "_apiserver_down", False):
+            return self.cluster.respawn_apiserver()  # no-op: see above
+        t0 = time.monotonic()
+        server = self.cluster.respawn_apiserver()
+        # Recovered = the WAL replay finished and the store serves
+        # again (components re-attach asynchronously on their resumed
+        # watches; their lag is already scored by goodput/reconcile).
+        self._recovered("apiserver", time.monotonic() - t0)
+        flight.record("other", "apiserver_respawned",
+                      records=server.replay_stats.get("records", 0))
+        return server
+
     def _admitted_condition_keys(self) -> set:
         from ..controller.status import get_condition, is_finished
         out = set()
@@ -512,6 +562,12 @@ class SoakHarness:
         self.monitor.stop()
         self.fleet.stop()
         self.cluster.stop()
+        server_close = getattr(self.client.server, "close", None)
+        if server_close is not None:
+            server_close()  # drain + fsync the WAL
+        if self._owned_wal_dir is not None:
+            import shutil
+            shutil.rmtree(self._owned_wal_dir, ignore_errors=True)
         self._started = False
 
     def __enter__(self) -> "SoakHarness":
@@ -531,12 +587,14 @@ class SoakHarness:
                                profile="full",
                                name=f"soak-{self.config.seed}")
         # The soak's contract includes surviving control-plane crashes:
-        # guarantee at least one of each restart kind, at seeded
-        # offsets, when the draw happened to produce none.
+        # guarantee at least one of each restart kind — including the
+        # apiserver itself — at seeded offsets, when the draw happened
+        # to produce none.
         import random
         rng = random.Random(self.config.seed ^ 0x50AC)
         kinds = {f.kind for f in plan.faults}
-        for kind in ("controller_restart", "scheduler_restart"):
+        for kind in ("controller_restart", "scheduler_restart",
+                     "apiserver_restart"):
             if kind not in kinds:
                 plan.faults.append(Fault(
                     at=round(rng.uniform(0.3, 0.9)
@@ -578,8 +636,15 @@ class SoakHarness:
         traffic.start()
         smalls.start()
         try:
+            # The engine's convergence deadline counts from SCENARIO
+            # START; converge_timeout is documented as the budget AFTER
+            # the fault timeline, so add the horizon.  (A plan whose
+            # last fault lands near the horizon otherwise gets zero
+            # convergence polls — exactly what a reshuffled seed did
+            # when apiserver_restart joined the full profile.)
             report = engine.run(converge=self._converged,
-                                timeout=self.config.converge_timeout,
+                                timeout=(self.config.duration
+                                         + self.config.converge_timeout),
                                 invariants=DEFAULT_INVARIANTS,
                                 settle=self.config.settle,
                                 bundle="always")
@@ -652,9 +717,13 @@ class SoakHarness:
             faults_applied=len(applied),
             controller_restarts=restarts("controller_restart"),
             scheduler_restarts=restarts("scheduler_restart"),
+            apiserver_restarts=restarts("apiserver_restart"),
             recoveries=len(self._recoveries),
             recovery_p99_s=quantile([s for _, s in self._recoveries],
                                     0.99),
+            apiserver_recovery_p99_s=quantile(
+                [s for c, s in self._recoveries if c == "apiserver"],
+                0.99),
             converged=report.converged,
             detail={
                 "trace_segments": trace_segments,
@@ -695,6 +764,7 @@ class SoakHarness:
             "admission_p99_s": card.admission_p99_s,
             "ttfs_p99_s": card.ttfs_p99_s,
             "traced_ttft_p99_s": card.traced_ttft_p99_s,
+            "apiserver_recovery_p99_s": card.apiserver_recovery_p99_s,
             "requests_lost": card.requests_lost,
             "invariant_violations": card.invariant_violations,
         }

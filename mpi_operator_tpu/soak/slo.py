@@ -110,8 +110,13 @@ class SloScorecard:
     faults_applied: int = 0
     controller_restarts: int = 0
     scheduler_restarts: int = 0
+    apiserver_restarts: int = 0
     recoveries: int = 0
     recovery_p99_s: Optional[float] = None
+    # Apiserver crash -> WAL-replayed store live again (the durable
+    # control plane's recovery SLO, docs/RESILIENCE.md "Durable
+    # apiserver"); None when the plan applied no apiserver_restart.
+    apiserver_recovery_p99_s: Optional[float] = None
     converged: bool = True
     # Free-form context the bench attaches (windows, per-gang detail).
     detail: Dict[str, object] = field(default_factory=dict)
@@ -178,8 +183,10 @@ class SloScorecard:
             "faults_applied": self.faults_applied,
             "controller_restarts": self.controller_restarts,
             "scheduler_restarts": self.scheduler_restarts,
+            "apiserver_restarts": self.apiserver_restarts,
             "recoveries": self.recoveries,
             "recovery_p99_s": r(self.recovery_p99_s),
+            "apiserver_recovery_p99_s": r(self.apiserver_recovery_p99_s),
             "converged": self.converged,
             "ok": self.ok,
             "violations": self.violations(),

@@ -571,3 +571,111 @@ def test_gang_feedback_over_kube_transport(fixture_server):
             "default", "kgang", constants.JOB_WORKERS_GATED,
             status="False", timeout=30)
         assert cleared is not None
+
+
+# --- durable apiserver: resume across a SERVER restart (ISSUE 14) --------
+
+def test_stale_rv_against_restarted_server_gets_prompt_410_relist():
+    """Regression (ISSUE 14 satellite): a client watch resuming against
+    a RESTARTED server whose revision counter reset (memory-only
+    restart — the client's RV is now from the future) must surface a
+    prompt 410 -> RELIST instead of hanging or silently missing the
+    gap.  Pre-fix, a fresh store accepted any RV and replayed nothing:
+    the restart gap was silently lost until the 30s resync."""
+    import time
+
+    srv = KubeFixtureServer().start()
+    port = srv.port
+    client = Clientset(server=KubeApiServer(srv.client_config()))
+    watch = client.pods("default").watch()
+    try:
+        pods = client.pods("default")
+        for i in range(5):
+            pods.create(_pod(f"old-{i}"))
+        # Drain until the client's resume RV is well past a fresh
+        # store's counter.
+        def drain_old():
+            seen = 0
+            while watch.next(timeout=0.5) is not None:
+                seen += 1
+            return seen
+        wait_until(lambda: int(watch._rv or 0) >= 5, timeout=10,
+                   desc="client resume RV advanced",
+                   on_timeout=lambda: f"rv={watch._rv}, "
+                                      f"drained={drain_old()}")
+        srv.stop()
+        # Restarted server: FRESH memory-only store, same port — its
+        # revisions restart from 1, so the client's RV is from the
+        # future of this incarnation.
+        srv2 = KubeFixtureServer(port=port).start()
+        try:
+            pods2 = Clientset(server=KubeApiServer(
+                srv2.client_config())).pods("default")
+            pods2.create(_pod("gap-0"))   # created inside the gap
+            deadline = time.monotonic() + 25
+            saw_relist = False
+            while time.monotonic() < deadline and not saw_relist:
+                ev = watch.next(timeout=1.0)
+                if ev is not None and ev.type == RELIST:
+                    saw_relist = True
+            assert saw_relist, ("stale future-RV resume neither 410d "
+                                "nor relisted — restart gap silently "
+                                "lost")
+            # And the stream is live again from "now".
+            pods2.create(_pod("fresh-after-relist"))
+            wait_until(
+                lambda: _next_name(watch) == "fresh-after-relist",
+                timeout=15, desc="stream live after the relist")
+        finally:
+            srv2.stop()
+    finally:
+        watch.stop()
+
+
+def _next_name(watch):
+    ev = watch.next(timeout=1.0)
+    return ev.obj.metadata.name if ev is not None and ev.obj is not None \
+        else None
+
+
+def test_kube_watch_resumes_from_rv_across_wal_respawn(tmp_path):
+    """The HTTP resume contract over a DURABLE restart: the fixture's
+    store crashes and is replayed from its WAL; the client reconnects
+    from its last-seen RV and receives the restart-gap events from the
+    respawned store's history — no RELIST, no loss."""
+    wal_dir = str(tmp_path / "wal")
+    store = ApiServer(wal_dir=wal_dir)
+    srv = KubeFixtureServer(store=store).start()
+    client = Clientset(server=KubeApiServer(srv.client_config()))
+    watch = client.pods("default").watch()
+    try:
+        client.pods("default").create(_pod("before"))
+        wait_until(lambda: _next_name(watch) == "before", timeout=10,
+                   desc="pre-crash event delivered")
+        store.crash()
+        respawned = ApiServer(wal_dir=wal_dir)
+        # The gap write lands BEFORE the fixture serves again: the
+        # client can only see it via history replay from its RV.
+        Clientset(server=respawned).pods("default").create(_pod("gap"))
+        srv.store = respawned
+        srv._http.store = respawned
+        got, relisted = [], False
+        def collect():
+            nonlocal relisted
+            ev = watch.next(timeout=0.5)
+            if ev is None:
+                return "gap" in got
+            if ev.type == RELIST:
+                relisted = True
+            elif ev.obj is not None:
+                got.append(ev.obj.metadata.name)
+            return "gap" in got
+        wait_until(collect, timeout=20,
+                   desc="gap event replayed from resume RV",
+                   on_timeout=lambda: f"got={got} relisted={relisted}")
+        assert not relisted, ("in-horizon resume fell back to a "
+                              "relist — history replay broken")
+        respawned.close()
+    finally:
+        watch.stop()
+        srv.stop()

@@ -344,9 +344,11 @@ def test_randomized_plan_full_profile():
     assert p1.to_json() == p2.to_json()  # seed-deterministic
     kinds = {f.kind for f in p1.faults}
     assert {"controller_restart", "scheduler_restart",
-            "replica_kill", "spot_reclaim"} <= kinds
+            "replica_kill", "spot_reclaim",
+            "apiserver_restart"} <= kinds
     for f in p1.faults:
-        if f.kind in ("controller_restart", "scheduler_restart"):
+        if f.kind in ("controller_restart", "scheduler_restart",
+                      "apiserver_restart"):
             assert f.duration > 0  # outage before the respawn
     with pytest.raises(KeyError):
         randomized_plan(7, profile="nope")

@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Durable apiserver smoke (`make durable-smoke`, < 60s).
+
+Asserts the WAL contract end-to-end (docs/RESILIENCE.md "Durable
+apiserver"):
+
+1. **Kill/replay exact state** — a scripted seeded workload (explicit
+   uids + FakeClock, so every byte is deterministic) against
+   ``ApiServer(wal_dir=...)``: crash mid-life, replay, and the
+   replayed store is BYTE-IDENTICAL (canonical dump), with the
+   uid/ownership indexes and per-kind watch history rebuilt, and the
+   revision counter at the exact acknowledged revision.
+2. **Watch-from-revision resume, zero full relists** — a LocalCluster
+   (controller + kubelet + batch Job controller) survives
+   crash_apiserver/respawn_apiserver while a job completes: every
+   controller informer resumed from its last-seen revision with the
+   full-relist counter asserted ZERO, and a post-restart job runs to
+   completion through resumed watches.
+3. **Past-horizon 410** — a resume from below the respawned store's
+   retained horizon surfaces a prompt 410 -> exactly one clean full
+   relist (counter-asserted), cache still correct.
+4. **Run-twice determinism** — the scripted workload's
+   volatile-stripped canonical dump is byte-identical across two
+   independent runs (fresh WAL dirs), and so are the two replays.
+
+Exit 0 = all checks green.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def scripted_workload(server):
+    """Deterministic op sequence: creates, status patches, updates,
+    deletes, an owner cascade and a dangling-owner reap."""
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec,
+                                            ReplicaSpec)
+    from mpi_operator_tpu.k8s import core
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta, new_controller_ref
+
+    cs = Clientset(server=server)
+    pods = cs.pods("default")
+    jobs = cs.mpi_jobs("default")
+    for i in range(6):
+        pods.create(core.Pod(metadata=ObjectMeta(
+            name=f"pod-{i}", namespace="default", uid=f"uid-pod-{i}",
+            labels={"app": "smoke"})))
+    for i in range(6):
+        pods.patch_status(f"pod-{i}", phase="Running",
+                          message=f"tick-{i}")
+    job = jobs.create(MPIJob(
+        metadata=ObjectMeta(name="owner", namespace="default",
+                            uid="uid-owner"),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(spec=PodSpec(
+                        containers=[Container(name="w",
+                                              image="local")])))})))
+    for i in range(3):
+        pods.create(core.Pod(metadata=ObjectMeta(
+            name=f"owned-{i}", namespace="default",
+            uid=f"uid-owned-{i}",
+            owner_references=[new_controller_ref(
+                job, constants.API_VERSION, constants.KIND)])))
+    pods.delete("pod-5")
+    jobs.delete("owner")        # cascades the 3 owned pods
+    for i in range(3):
+        pods.patch_status(f"pod-{i}", message=f"round2-{i}")
+    return cs
+
+
+def check_exact_replay() -> list:
+    from mpi_operator_tpu.k8s.apiserver import ApiServer
+    from mpi_operator_tpu.k8s.meta import FakeClock
+
+    problems = []
+    wal_dir = tempfile.mkdtemp(prefix="durable-smoke-exact-")
+    server = ApiServer(clock=FakeClock(), wal_dir=wal_dir)
+    scripted_workload(server)
+    live = server.canonical_dump()
+    live_uid_refs = dict(server._uid_refs)
+    live_hist = [(rv, ev.type)
+                 for rv, ev in server._kind(("v1", "Pod")).history]
+    server.crash()
+    replayed = ApiServer(clock=FakeClock(), wal_dir=wal_dir)
+    if replayed.canonical_dump() != live:
+        problems.append("exact-replay: canonical dump differs")
+    if replayed._uid_refs != live_uid_refs:
+        problems.append("exact-replay: uid refcounts differ")
+    got_hist = [(rv, ev.type)
+                for rv, ev in replayed._kind(("v1", "Pod")).history]
+    if got_hist != live_hist:
+        problems.append("exact-replay: Pod event history differs")
+    if replayed.current_rv() != server.current_rv():
+        problems.append(
+            f"exact-replay: revision {replayed.current_rv()} != "
+            f"{server.current_rv()}")
+    replayed.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
+    return problems
+
+
+def _tiny_job(name: str, seconds: float):
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec,
+                                            ReplicaSpec, RunPolicy)
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+    def sleeper(cname, secs):
+        return Container(name=cname, image="local",
+                         command=[sys.executable, "-c",
+                                  f"import time; time.sleep({secs})"])
+
+    return MPIJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(clean_pod_policy="Running"),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    template=PodTemplateSpec(spec=PodSpec(
+                        containers=[sleeper("l", seconds)]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(spec=PodSpec(
+                        containers=[sleeper("w", seconds + 5)]))),
+            }))
+
+
+def check_resume_zero_relists() -> list:
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.server.cluster import LocalCluster
+
+    problems = []
+    wal_dir = tempfile.mkdtemp(prefix="durable-smoke-resume-")
+    with LocalCluster(wal_dir=wal_dir) as lc:
+        lc.submit(_tiny_job("pre-crash", 0.3))
+        lc.wait_for_condition("default", "pre-crash",
+                              constants.JOB_SUCCEEDED, timeout=30)
+        if not lc.crash_apiserver():
+            problems.append("resume: crash_apiserver returned False")
+        time.sleep(0.3)
+        server = lc.respawn_apiserver()
+        if not server.replay_stats.get("records"):
+            problems.append("resume: replay saw no records")
+        # The whole stack must keep working through resumed watches.
+        lc.submit(_tiny_job("post-crash", 0.3))
+        lc.wait_for_condition("default", "post-crash",
+                              constants.JOB_SUCCEEDED, timeout=40)
+        informers = list(lc.controller.factory._informers.values())
+        resumed = sum(inf.watch_resumes for inf in informers)
+        relists = sum(inf.resume_relists for inf in informers)
+        if resumed < len(informers):
+            problems.append(
+                f"resume: only {resumed} watch resumes across "
+                f"{len(informers)} informers")
+        if relists != 0:
+            problems.append(
+                f"resume: {relists} full relists (wanted ZERO — "
+                f"in-horizon resumes must replay history)")
+    shutil.rmtree(wal_dir, ignore_errors=True)
+    return problems
+
+
+def check_past_horizon_relist() -> list:
+    from mpi_operator_tpu.k8s import core
+    from mpi_operator_tpu.k8s.apiserver import ApiServer, Clientset
+    from mpi_operator_tpu.k8s.informers import SharedInformer
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    from mpi_operator_tpu.utils.waiters import wait_until
+
+    problems = []
+    wal_dir = tempfile.mkdtemp(prefix="durable-smoke-horizon-")
+    server = ApiServer(wal_dir=wal_dir)
+    cs = Clientset(server=server)
+    inf = SharedInformer(cs, "v1", "Pod")
+    cs.pods("default").create(core.Pod(metadata=ObjectMeta(
+        name="seed", namespace="default")))
+    inf.start()
+    wait_until(lambda: inf.lister.get("default", "seed") is not None,
+               10, desc="informer synced")
+    # Freeze the informer's resume position, then churn far past a tiny
+    # retained horizon so its revision falls out of the window.
+    inf._note_rv = lambda rv: None
+    inf._last_rv = 1
+    for i in range(40):
+        cs.pods("default").patch_status("seed", message=f"m-{i}")
+    server.crash()
+
+    class SmallHistory(ApiServer):
+        HISTORY_LIMIT = 8
+
+    respawned = SmallHistory(wal_dir=wal_dir)
+    cs.server = respawned
+    horizon = respawned.history_horizon("v1", "Pod")
+    if horizon <= 1:
+        problems.append(f"horizon: replayed purge horizon {horizon} "
+                        f"not past the stale revision")
+    try:
+        wait_until(lambda: inf.resume_relists == 1, 10,
+                   desc="exactly one 410-driven full relist")
+        wait_until(
+            lambda: (inf.lister.get("default", "seed") is not None
+                     and inf.lister.get("default",
+                                        "seed").status.message
+                     == "m-39"),
+            10, desc="cache healed by the relist")
+    except TimeoutError as exc:
+        problems.append(f"horizon: {exc}")
+    if inf.resume_relists != 1:
+        problems.append(f"horizon: {inf.resume_relists} relists, "
+                        f"wanted exactly 1")
+    inf.stop()
+    respawned.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
+    return problems
+
+
+def check_run_twice_deterministic() -> list:
+    from mpi_operator_tpu.k8s.apiserver import ApiServer
+    from mpi_operator_tpu.k8s.meta import FakeClock
+
+    problems = []
+    dumps = []
+    replay_dumps = []
+    for run in (1, 2):
+        wal_dir = tempfile.mkdtemp(prefix=f"durable-smoke-det{run}-")
+        server = ApiServer(clock=FakeClock(), wal_dir=wal_dir)
+        scripted_workload(server)
+        dumps.append(server.canonical_dump(strip_volatile=True))
+        server.crash()
+        replayed = ApiServer(clock=FakeClock(), wal_dir=wal_dir)
+        replay_dumps.append(
+            replayed.canonical_dump(strip_volatile=True))
+        replayed.close()
+        shutil.rmtree(wal_dir, ignore_errors=True)
+    if dumps[0] != dumps[1]:
+        problems.append("determinism: live canonical dumps differ"
+                        " across runs")
+    if replay_dumps[0] != replay_dumps[1]:
+        problems.append("determinism: replayed canonical dumps differ"
+                        " across runs")
+    if not dumps[0]:
+        problems.append("determinism: empty canonical dump")
+    return problems
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    problems = []
+    print("durable-smoke: 1/4 kill/replay exact state...", flush=True)
+    problems += check_exact_replay()
+    print("durable-smoke: 2/4 watch-from-revision resume"
+          " (zero full relists)...", flush=True)
+    problems += check_resume_zero_relists()
+    print("durable-smoke: 3/4 past-horizon 410 -> one relist...",
+          flush=True)
+    problems += check_past_horizon_relist()
+    print("durable-smoke: 4/4 run-twice canonical determinism...",
+          flush=True)
+    problems += check_run_twice_deterministic()
+    elapsed = time.perf_counter() - t0
+    if problems:
+        print(f"durable-smoke: FAIL ({elapsed:.1f}s)")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"durable-smoke: PASS in {elapsed:.1f}s — exact replay,"
+          f" zero-relist resume, clean past-horizon 410,"
+          f" byte-identical across runs")
+    return 0
+
+
+if __name__ == "__main__":
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))

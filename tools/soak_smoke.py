@@ -3,7 +3,9 @@
 harness at minimum scale — ONE training gang admitted through a
 ClusterQueue + a 2-replica ServeJob fleet under live traffic — driven
 through a scripted chaos plan containing exactly one
-``controller_restart`` and one ``scheduler_restart``.
+``controller_restart``, one ``scheduler_restart`` and one
+``apiserver_restart`` (the WAL-backed store is killed, replayed, and
+every component survives on resumed watches).
 
 Asserts the soak contract end-to-end (docs/RESILIENCE.md "Macro-soak
 & crash recovery"):
@@ -59,6 +61,7 @@ def run_once(debug_dir: str, factory) -> tuple:
     plan = FaultPlan(name="soak-smoke", seed=1, faults=[
         Fault(at=2.0, kind="controller_restart", duration=0.5),
         Fault(at=4.5, kind="scheduler_restart", duration=0.5),
+        Fault(at=6.5, kind="apiserver_restart", duration=0.5),
     ])
     config = SoakConfig(
         seed=1, duration=8.0, gangs=1, gang_workers=2,
@@ -120,13 +123,18 @@ def check_card(card, label: str) -> list:
         problems.append(f"{label}: {card.requests_lost} lost requests")
     if not card.converged:
         problems.append(f"{label}: never converged")
-    if card.controller_restarts != 1 or card.scheduler_restarts != 1:
+    if card.controller_restarts != 1 or card.scheduler_restarts != 1 \
+            or card.apiserver_restarts != 1:
         problems.append(
             f"{label}: restarts {card.controller_restarts}+"
-            f"{card.scheduler_restarts}, wanted 1+1")
-    if card.recoveries != 2:
+            f"{card.scheduler_restarts}+{card.apiserver_restarts},"
+            f" wanted 1+1+1")
+    if card.recoveries != 3:
         problems.append(f"{label}: {card.recoveries} recoveries,"
-                        f" wanted 2")
+                        f" wanted 3")
+    if card.apiserver_recovery_p99_s is None:
+        problems.append(f"{label}: apiserver_recovery_p99_s"
+                        f" unpopulated (WAL replay never measured)")
     if card.requests_total <= 0:
         problems.append(f"{label}: no serve traffic flowed")
     return problems
@@ -176,8 +184,9 @@ def main() -> int:
           f" reconcile_p99={card1.reconcile_p99_s:.4f}s,"
           f" admission_p99={card1.admission_p99_s:.2f}s,"
           f" ttfs_p99={card1.ttfs_p99_s:.2f}s,"
-          f" traced_ttft_p99={card1.traced_ttft_p99_s:.3f}s),"
-          f" 0 violations, 0 lost, 1+1 restarts recovered,"
+          f" traced_ttft_p99={card1.traced_ttft_p99_s:.3f}s,"
+          f" apiserver_recovery_p99={card1.apiserver_recovery_p99_s:.3f}s),"
+          f" 0 violations, 0 lost, 1+1+1 restarts recovered,"
           f" bundle lanes complete, canonical log byte-identical")
     return 0
 
