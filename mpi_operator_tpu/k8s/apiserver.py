@@ -653,6 +653,19 @@ class ApiServer:
         # history while its record is pruned away: gone from both,
         # silently skipped by an "in-horizon" resume after replay.
         wal.barrier()
+        # Quiesce in-flight verbs: a verb can sit BETWEEN its WAL
+        # append (_log_rv) and its pending enqueue (_notify) — both
+        # under its kind lock — so the drain below would miss an event
+        # whose record a concurrent leader already flushed into a
+        # pre-roll (to-be-pruned) segment.  Only records appended
+        # before the roll can land in those segments, and their verbs
+        # hold the kind lock across append->enqueue: touching every
+        # kind lock guarantees each such event is queued, and the
+        # barrier above already made its record durable, so the drain
+        # history-delivers it before capture.
+        for _, ks in self._kind_items():
+            with ks.lock:
+                pass
         self._deliver_committed(wal.durable_seq())
         kinds = []
         for (gv, kind), ks in sorted(self._kind_items()):
@@ -841,6 +854,26 @@ class ApiServer:
                 pending = self._pending_events
                 while pending and pending[0][0] <= durable_seq:
                     batch.append(pending.popleft())
+                if pending:
+                    # Cross-kind enqueue order can lag seq order (the
+                    # pending lock is taken a few instructions after
+                    # the WAL append): a durable record's event may sit
+                    # BEHIND a not-yet-durable head, and leaving it
+                    # there would delay an acknowledged write's fan-out
+                    # until the head's writer runs its own barrier.
+                    # Take every durable entry regardless of position —
+                    # per-kind order survives because one kind's
+                    # entries are enqueued under its kind lock in
+                    # revision order (their seqs increase, and the
+                    # durable set is a seq prefix).
+                    stragglers, remaining = [], []
+                    for e in pending:
+                        (stragglers if e[0] <= durable_seq
+                         else remaining).append(e)
+                    if stragglers:
+                        batch.extend(stragglers)
+                        pending.clear()
+                        pending.extend(remaining)
             for _, ks, kind, ev_rv, ev in batch:
                 if self.crashed:
                     return

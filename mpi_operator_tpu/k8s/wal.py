@@ -122,6 +122,15 @@ class WriteAheadLog:
         segs = self.segments()
         self._segment = segs[-1] if segs else 1
         path = os.path.join(self.dir, _segment_name(self._segment))
+        if segs:
+            # A crash can leave torn final-record bytes that fstat
+            # would count as durable: appending after them would weld
+            # the next record onto the partial line — turning a LEGAL
+            # torn tail into mid-log corruption on the next replay (or
+            # silently swallowing the new record if the merged line
+            # stayed last).  Trim back to the last intact-record
+            # boundary BEFORE opening for append.
+            self.torn_records_dropped += truncate_torn_tail(path)
         # Raw fd + os.write + os.fdatasync: every syscall is a GIL
         # release/reacquire round trip, brutal on a loaded single-core
         # host — the buffered write/flush/fsync triple costs one more
@@ -439,6 +448,78 @@ def _snapshots(wal_dir: str) -> List[int]:
         for n in os.listdir(wal_dir)) if i is not None)
 
 
+def _parse_record(line: bytes) -> dict:
+    """Decode + validate ONE log line — the single record-validity
+    predicate shared by replay and the respawn-time torn-tail
+    truncation.  The two must agree byte-for-byte: if truncation kept
+    a line replay drops, the respawned log would append after it and
+    weld it into mid-log corruption; if it dropped a line replay
+    accepts, an acknowledged write would vanish.  Raises ValueError on
+    anything replay refuses."""
+    record = json.loads(line)
+    if not isinstance(record, dict) or "rv" not in record:
+        raise ValueError("not a WAL record")
+    return record
+
+
+def truncate_torn_tail(path: str) -> int:
+    """Trim a final segment back to its last intact-record boundary,
+    dropping exactly the records :func:`iter_records` legally drops: a
+    trailing line with no newline, or — only when the tail's newline is
+    intact — a final line whose payload fails to parse (partial page
+    flush).  Returns the number of records dropped.  Damage anywhere
+    else is left in place for replay to refuse loudly — truncating it
+    here would silently discard acknowledged writes."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return 0
+    keep = len(data)
+    dropped = 0
+    if data and not data.endswith(b"\n"):
+        keep = data.rfind(b"\n") + 1
+        dropped += 1
+    tail_ok = False
+    if keep:
+        # The (possibly new) final newline-terminated line may itself
+        # be a torn payload (partial page flush) — the other legal
+        # final-record tear.
+        nl = data.rfind(b"\n", 0, keep - 1)
+        last = data[nl + 1:keep - 1]
+        if last:
+            try:
+                _parse_record(last)
+                tail_ok = True
+            except ValueError:
+                keep = nl + 1
+                dropped += 1
+    if not dropped:
+        return 0
+    # Only the SINGLE final record of a sequential-append crash may
+    # legally tear.  Two torn records, or a would-be new tail whose
+    # last non-empty line is unparseable (replay skips empty lines but
+    # still refuses garbage before them), is corruption iter_records
+    # refuses loudly — leave the file untouched (tail included) so it
+    # still does, never launder it into a legal-looking single tear.
+    if dropped > 1:
+        return 0
+    if keep and not tail_ok:
+        end = keep - 1                   # position of the final newline
+        while end > 0 and data[end - 1:end] == b"\n":
+            end -= 1
+        nl = data.rfind(b"\n", 0, end)
+        prev = data[nl + 1:end]
+        if prev:
+            try:
+                _parse_record(prev)
+            except ValueError:
+                return 0
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+    return dropped
+
+
 def iter_records(wal_dir: str, base_segment: int,
                  on_torn: Optional[Callable[[str], None]] = None,
                  ) -> Iterator[dict]:
@@ -463,9 +544,7 @@ def iter_records(wal_dir: str, base_segment: int,
             if not line:
                 continue
             try:
-                record = json.loads(line)
-                if not isinstance(record, dict) or "rv" not in record:
-                    raise ValueError("not a WAL record")
+                record = _parse_record(line)
             except ValueError as exc:
                 if last_segment and i == len(lines) - 1 and not torn_tail:
                     # Newline present but the payload itself is torn

@@ -131,6 +131,79 @@ def test_wal_torn_final_record_dropped_mid_log_fatal(wal_dir):
         list(walmod.iter_records(wal_dir, 1))
 
 
+def test_wal_respawn_truncates_torn_tail_before_appending(wal_dir):
+    """Review fix: respawning onto a segment with torn final-record
+    bytes must truncate them BEFORE opening for append — otherwise the
+    first new record welds onto the partial line, and the NEXT replay
+    sees mid-log corruption (or silently drops an acknowledged record
+    if the merged line stays last)."""
+    wal = walmod.WriteAheadLog(wal_dir)
+    for i in range(3):
+        wal.barrier(wal.append({"rv": i, "verb": "create", "obj": {}}))
+    wal.close()
+    seg = os.path.join(wal_dir, walmod._segment_name(1))
+    with open(seg, "ab") as f:
+        f.write(b'{"rv": 3, "verb": "crea')   # torn tail, no newline
+    wal2 = walmod.WriteAheadLog(wal_dir)
+    assert wal2.torn_records_dropped == 1
+    wal2.barrier(wal2.append({"rv": 4, "verb": "create", "obj": {}}))
+    wal2.close()
+    # Replay is clean TWICE: the torn bytes are gone from disk, not
+    # merely skipped in memory.
+    for _ in range(2):
+        assert [r["rv"] for r in walmod.iter_records(wal_dir, 1)] \
+            == [0, 1, 2, 4]
+
+
+def test_wal_truncate_torn_tail_cases(wal_dir):
+    seg = os.path.join(wal_dir, "seg.log")
+    # Intact file: untouched.
+    with open(seg, "wb") as f:
+        f.write(b'{"rv": 1, "verb": "create", "obj": {}}\n')
+    assert walmod.truncate_torn_tail(seg) == 0
+    # Newline intact but the payload itself is torn (partial page
+    # flush): the legal final-record tear iter_records drops.
+    with open(seg, "ab") as f:
+        f.write(b'{"rv": 2, "verb": "crea\n')
+    assert walmod.truncate_torn_tail(seg) == 1
+    assert walmod.truncate_torn_tail(seg) == 0   # idempotent
+    with open(seg, "rb") as f:
+        assert f.read() == b'{"rv": 1, "verb": "create", "obj": {}}\n'
+    # Missing file: no-op.
+    assert walmod.truncate_torn_tail(seg + ".absent") == 0
+    # Double tear (unparseable terminated line + unterminated bytes) is
+    # corruption iter_records refuses loudly — truncation must leave
+    # the file untouched so it still does, never launder it into a
+    # legal-looking single tear.
+    with open(seg, "ab") as f:
+        f.write(b'{"rv": 2, "verb": "crea\n{"rv": 3, "ve')
+    with open(seg, "rb") as f:
+        before = f.read()
+    assert walmod.truncate_torn_tail(seg) == 0
+    with open(seg, "rb") as f:
+        assert f.read() == before
+    # Same for TWO unparseable newline-terminated lines: dropping only
+    # the last would leave the first as a "legal" final tear for the
+    # next replay — corruption laundered into silent record loss.
+    with open(seg, "wb") as f:
+        f.write(b'{"rv": 1, "verb": "create", "obj": {}}\n'
+                b'GARBAGE1\nGARBAGE2\n')
+    with open(seg, "rb") as f:
+        before = f.read()
+    assert walmod.truncate_torn_tail(seg) == 0
+    with open(seg, "rb") as f:
+        assert f.read() == before
+    # Garbage hidden behind a blank line before the tear: replay skips
+    # empty lines but still refuses the garbage — so must truncation.
+    with open(seg, "wb") as f:
+        f.write(b'GARBAGE\n\n{"rv": 9, "ve')
+    with open(seg, "rb") as f:
+        before = f.read()
+    assert walmod.truncate_torn_tail(seg) == 0
+    with open(seg, "rb") as f:
+        assert f.read() == before
+
+
 # ---------------------------------------------------------------------------
 # Crash-replay exactness
 # ---------------------------------------------------------------------------
@@ -269,6 +342,37 @@ def test_seeded_crash_replay_at_every_acked_prefix(wal_dir):
     server.crash()
 
 
+def test_apiserver_double_respawn_after_torn_tail(wal_dir):
+    """The review's end-to-end scenario: a crash leaves a torn tail;
+    the respawned server drops it AND WRITES; a second respawn must
+    replay cleanly (no WalCorruptionError from a welded line) with
+    every post-respawn acknowledged write intact."""
+    server = ApiServer(clock=FakeClock(), wal_dir=wal_dir,
+                       wal_snapshot_every=10 ** 9)
+    cs = Clientset(server=server)
+    cs.pods("default").create(_pod("a", uid="uid-a"))
+    cs.pods("default").create(_pod("b", uid="uid-b"))
+    server.crash()
+    seg = os.path.join(
+        wal_dir, walmod._segment_name(walmod._segments(wal_dir)[-1]))
+    with open(seg, "ab") as f:
+        f.write(b'{"rv": 99, "verb": "crea')   # torn tail, no newline
+    second = ApiServer(clock=FakeClock(), wal_dir=wal_dir,
+                       wal_snapshot_every=10 ** 9)
+    assert second.replay_stats["torn_dropped"] == 1
+    cs2 = Clientset(server=second)
+    cs2.pods("default").create(_pod("c", uid="uid-c"))
+    cs2.pods("default").create(_pod("d", uid="uid-d"))
+    dump = second.canonical_dump()
+    hist = _history(second)
+    second.crash()
+    third = ApiServer(clock=FakeClock(), wal_dir=wal_dir)
+    assert third.replay_stats["torn_dropped"] == 0
+    assert third.canonical_dump() == dump
+    assert _history(third) == hist
+    third.close()
+
+
 def test_snapshot_roll_prune_and_replay(wal_dir):
     server = ApiServer(clock=FakeClock(), wal_dir=wal_dir,
                        wal_snapshot_every=10 ** 9)
@@ -290,6 +394,98 @@ def test_snapshot_roll_prune_and_replay(wal_dir):
     assert replayed.replay_stats["snapshot"]
     assert replayed.canonical_dump() == live_dump
     assert _history(replayed) == live_hist
+    replayed.close()
+
+
+def test_deliver_committed_pops_stragglers_behind_nondurable_head(
+        wal_dir):
+    """Review fix: cross-kind enqueue order can lag seq order — an
+    acknowledged (durable) record's event sitting BEHIND a not-yet-
+    durable head must fan out at its own commit, not wait for the head
+    writer's barrier."""
+    from mpi_operator_tpu.k8s.apiserver import ADDED, WatchEvent
+    server = ApiServer(clock=FakeClock(), wal_dir=wal_dir)
+    pod_gvk = ("v1", "Pod")
+    job_gvk = (constants.API_VERSION, constants.KIND)
+    ks_pod = server._kind(pod_gvk)
+    ks_job = server._kind(job_gvk)
+    server._pending_events.append(
+        (5, ks_job, constants.KIND, 50, WatchEvent(ADDED, _job("head"))))
+    server._pending_events.append(
+        (3, ks_pod, "Pod", 30, WatchEvent(ADDED, _pod("late"))))
+    server._deliver_committed(3)
+    assert _history(server, pod_gvk) == [(30, ADDED, "late")]
+    assert _history(server, job_gvk) == []      # head still pending
+    assert len(server._pending_events) == 1
+    server._deliver_committed(5)
+    assert _history(server, job_gvk) == [(50, ADDED, "head")]
+    assert not server._pending_events
+    server.close()
+
+
+def test_snapshot_quiesces_verb_between_append_and_enqueue(wal_dir):
+    """Review fix: take_snapshot must quiesce a verb sitting between
+    its WAL append (_log_rv) and its pending enqueue (_notify) — both
+    under the kind lock — before capturing.  Otherwise the pre-capture
+    drain misses the event while its record sits flushed in a
+    to-be-pruned segment, and a post-restart in-horizon watch resume
+    silently skips it."""
+    server = ApiServer(clock=FakeClock(), wal_dir=wal_dir,
+                       wal_snapshot_every=10 ** 9)
+    cs = Clientset(server=server)
+    warm = cs.pods("default").create(_pod("warm", uid="uid-warm"))
+    gate = threading.Event()
+    entered = threading.Event()
+    real_notify = server._notify
+
+    def gated_notify(ks, ev_type, obj):
+        if obj.metadata.name == "slow":
+            entered.set()
+            gate.wait(30)
+        return real_notify(ks, ev_type, obj)
+
+    server._notify = gated_notify
+    verb = threading.Thread(target=lambda: cs.pods("default").create(
+        _pod("slow", uid="uid-slow")))
+    verb.start()
+    assert entered.wait(30)
+    # The record is appended but its event is NOT yet queued; commit
+    # it into the pre-roll segment like a concurrent leader would.
+    server.wal.barrier()
+    # Release the stalled verb only once the snapshot thread has
+    # reached its kind-lock fence (take_snapshot's first _kind_items
+    # call after the roll) — deterministic: the capture CANNOT have
+    # happened yet, so the append->enqueue window is guaranteed to
+    # straddle it.
+    fence_reached = threading.Event()
+    real_kind_items = server._kind_items
+
+    def traced_kind_items():
+        if threading.current_thread() is snap:
+            fence_reached.set()
+        return real_kind_items()
+
+    server._kind_items = traced_kind_items
+    snap = threading.Thread(target=server.take_snapshot)
+    snap.start()
+    assert fence_reached.wait(30)
+    gate.set()
+    verb.join(30)
+    snap.join(30)
+    assert not snap.is_alive()
+    live_hist = _history(server)
+    assert (int(cs.pods("default").get("slow").metadata
+                .resource_version), "ADDED", "slow") in live_hist
+    server.crash()
+    replayed = ApiServer(clock=FakeClock(), wal_dir=wal_dir)
+    assert replayed.replay_stats["snapshot"]
+    assert _history(replayed) == live_hist
+    # The review's failure mode, asserted directly: an in-horizon
+    # resume from just after "warm" must deliver the "slow" ADDED.
+    w = replayed.watch("v1", "Pod",
+                       resource_version=warm.metadata.resource_version)
+    ev = w.next(timeout=10.0)
+    assert ev is not None and ev.obj.metadata.name == "slow"
     replayed.close()
 
 
