@@ -7,7 +7,7 @@ PYTHON ?= python
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
 	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke \
-	trace-smoke topo-smoke durable-smoke analyze
+	trace-smoke topo-smoke durable-smoke elastic-smoke analyze
 
 # Every smoke runs with the runtime lock-order detector armed
 # (docs/ANALYSIS.md): repo-created locks are tracked, lock-order cycles
@@ -91,6 +91,16 @@ serve-fleet-smoke:
 # (docs/SCHEDULING.md).
 sched-smoke:
 	$(SMOKE_ENV) $(PYTHON) tools/sched_smoke.py
+
+# Elastic gang resize (< 60s, CPU): one LocalCluster gang grows 2->4
+# then shrinks 4->2 LIVE — survivors' step counters strictly monotone
+# (never restarted), departing workers drain on the
+# K_RESIZE_NOTICE_FILE notice, resize counters/histogram/per-gang
+# gauge populated, every invariant green (incl.
+# resize_never_loses_a_step with a real step probe), run twice with
+# identical protocol outcomes (docs/SCHEDULING.md "Elastic gangs").
+elastic-smoke:
+	$(SMOKE_ENV) $(PYTHON) tools/elastic_smoke.py
 
 # Macro-soak (< 60s, CPU): the whole stack at minimum scale — one
 # training gang through a ClusterQueue + a 2-replica serving fleet

@@ -1,0 +1,507 @@
+#!/usr/bin/env python
+"""Elastic gang resize bench -> BENCH_ELASTIC.json.
+
+The question (ISSUE 15 / docs/SCHEDULING.md "Elastic gangs"): under a
+BENCH_SCHED-style contention storm — long-running training gangs
+sharing a pool with bursts of higher-priority jobs — what does elastic
+resize (shrink under contention + goodput-aware grow into idle, live
+re-sharding, no checkpoint rewind) buy over the PR 9 baseline
+(checkpoint-then-evict-then-requeue, frozen gang sizes)?
+
+Three sections:
+
+- ``storm``: the SAME seeded workload against both configs.  3 elastic
+  training gangs share 4x16-chip slices with seeded bursts of
+  higher-priority 16-chip prod jobs.  Baseline (``elastic=False``):
+  every burst preempts whole gangs (notice -> grace -> evict ->
+  requeue) and each eviction pays checkpoint rewind (work since the
+  last checkpoint is lost); gang sizes stay frozen, so post-burst idle
+  chips go unused.  Elastic: preemption SHRINKS gangs just enough
+  (training continues on the survivors from the same step) and the
+  TrainAutoscaler grows them back into idle capacity, cost-model
+  priced.  Scored: aggregate training goodput (productive chip-seconds
+  minus rewind losses), cluster utilization, lost work, eviction/resize
+  counters — with capacity conservation checked THROUGHOUT and every
+  chaos invariant green at the end.  Gate: elastic >= 1.2x baseline
+  goodput, zero elastic evictions, zero lost chip-seconds.
+
+- ``reshard``: the live re-shard numerics proof (parallel/train.py
+  reshard_train_state): a ZeRO-sharded run resized dp=2x4 -> dp=4x8
+  mid-training (and back) continues from the SAME step and lands
+  allclose-equal to an uninterrupted run.
+
+- ``live_process``: tools/elastic_smoke.py's LocalCluster scenario —
+  a real gang grows 2->4 and shrinks 4->2 with survivor step counters
+  strictly monotone (no restart, ever).
+
+Usage: python bench_elastic.py [--quick] [-o BENCH_ELASTIC.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import heapq
+import json
+import os
+import platform
+import sys
+import threading
+import time
+import types
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from mpi_operator_tpu.api import constants  # noqa: E402
+from mpi_operator_tpu.api.types import (JobCondition, MPIJob, MPIJobSpec,  # noqa: E402
+                                        ReplicaSpec, RunPolicy)
+from mpi_operator_tpu.controller.controller import MPIJobController  # noqa: E402
+from mpi_operator_tpu.controller.status import get_condition  # noqa: E402
+from mpi_operator_tpu.k8s.apiserver import Clientset, is_conflict  # noqa: E402
+from mpi_operator_tpu.k8s.core import (Container, PodSpec,  # noqa: E402
+                                       PodTemplateSpec)
+from mpi_operator_tpu.k8s.meta import ObjectMeta  # noqa: E402
+from mpi_operator_tpu.sched import (ClusterQueue, GangScheduler,  # noqa: E402
+                                    LocalQueue, SlicePool, TpuSlice)
+from mpi_operator_tpu.sched.elastic import TrainAutoscaler  # noqa: E402
+
+NAMESPACE = "default"
+
+
+def mk_job(name, workers, queue, prio=None, elastic=None):
+    meta = ObjectMeta(name=name, namespace=NAMESPACE,
+                      labels={constants.QUEUE_NAME_LABEL: queue})
+    meta.annotations = {}
+    if prio is not None:
+        meta.annotations[constants.SCHED_PRIORITY_ANNOTATION] = str(prio)
+    if elastic is not None:
+        meta.annotations[constants.ELASTIC_ANNOTATION] = elastic
+    return MPIJob(
+        metadata=meta,
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(clean_pod_policy="All"),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    replicas=1, template=PodTemplateSpec(spec=PodSpec(
+                        containers=[Container(name="l", image="img",
+                                              command=["true"])]))),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers, template=PodTemplateSpec(spec=PodSpec(
+                        containers=[Container(name="w", image="img",
+                                              command=["true"])]))),
+            }))
+
+
+# ---------------------------------------------------------------------------
+# Section 1: the contention storm
+# ---------------------------------------------------------------------------
+
+def run_storm(elastic: bool, w: dict) -> dict:
+    client = Clientset()
+    controller = MPIJobController(client, shards=2)
+    pool = SlicePool([TpuSlice(f"s{i}", w["slice_chips"])
+                      for i in range(w["slices"])])
+    sched = GangScheduler(
+        client, pool, fair_share=True, backfill=True, preemption=True,
+        checkpoint_grace=w["grace_s"], tick=0.05, elastic=elastic,
+        resize_deadline=w["resize_deadline_s"],
+        registry=controller.metrics.get("registry"))
+    for cq_name, lq_name, weight in (("cq-train", "train", 1.0),
+                                     ("cq-prod", "prod", 4.0)):
+        cq = ClusterQueue()
+        cq.metadata.name = cq_name
+        cq.spec.quotas = {}
+        cq.spec.cohort = "pool"
+        cq.spec.weight = weight
+        client.cluster_queues(NAMESPACE).create(cq)
+        lq = LocalQueue()
+        lq.metadata.name = lq_name
+        lq.metadata.namespace = NAMESPACE
+        lq.spec.cluster_queue = cq_name
+        client.local_queues(NAMESPACE).create(lq)
+    controller.run()
+    sched.start()
+    auto = None
+    if elastic:
+        auto = TrainAutoscaler(sched, poll_interval=0.25, up_stable=2,
+                               down_stable=2,
+                               resize_deadline=w["resize_deadline_s"])
+        auto.start()
+
+    gangs = [f"gang-{i}" for i in range(w["gangs"])]
+    bounds = f'{w["gang_min"]}-{w["gang_max"]}'
+    for name in gangs:
+        client.mpi_jobs(NAMESPACE).create(mk_job(
+            name, w["gang_workers"], "train", elastic=bounds))
+
+    # Seeded prod-burst schedule: (at, name, workers).
+    prod_schedule = []
+    for b, at in enumerate(w["burst_at"]):
+        for j in range(w["burst_jobs"]):
+            prod_schedule.append((at + 0.1 * j, f"prod-{b}-{j}",
+                                  w["prod_workers"]))
+    prod_schedule.sort(key=lambda s: s[0])
+
+    system = types.SimpleNamespace(client=client, kubelet=None,
+                                   controller=controller,
+                                   scheduler=sched)
+    capacity = pool.total_chips
+    gang_keys = {f"{NAMESPACE}/{g}": g for g in gangs}
+
+    def complete(name):
+        for _ in range(20):
+            try:
+                job = client.mpi_jobs(NAMESPACE).get(name)
+                job.status.conditions.append(JobCondition(
+                    type=constants.JOB_SUCCEEDED, status="True",
+                    reason="BenchCompleted", message="hold elapsed"))
+                job.status.completion_time = datetime.datetime.now(
+                    datetime.timezone.utc)
+                client.mpi_jobs(NAMESPACE).update_status(job)
+                return
+            except Exception as exc:
+                if is_conflict(exc):
+                    continue
+                raise
+
+    # Watch-driven eviction/rewind accounting: a gang flipping
+    # Admitted True -> False loses everything accrued since its last
+    # checkpoint (the PR 9 evict path's rewind cost); elastic shrinks
+    # never flip the condition, so they lose nothing.
+    watch = client.server.watch(constants.GROUP_VERSION, constants.KIND)
+    admitted_state = {g: False for g in gangs}
+    accrued = {g: 0.0 for g in gangs}       # chip-s since last ckpt
+    ckpt_at = {g: 0.0 for g in gangs}       # next checkpoint wall time
+    productive = {g: 0.0 for g in gangs}
+    lost = 0.0
+    evictions_seen = 0
+    prod_admitted = {}
+    completions = []  # heapq (due, name)
+    util_integral = 0.0
+    conservation_violations = []
+
+    t0 = time.monotonic()
+    deadline = t0 + w["duration_s"]
+    pending = list(prod_schedule)
+    last = t0
+    last_conservation = t0
+    try:
+        while True:
+            now = time.monotonic()
+            dt = now - last
+            last = now
+            elapsed = now - t0
+            # Submissions.
+            while pending and pending[0][0] <= elapsed:
+                _, name, workers = pending.pop(0)
+                client.mpi_jobs(NAMESPACE).create(
+                    mk_job(name, workers, "prod", prio=10))
+            # Watch events: admission flips + prod completions.
+            while True:
+                ev = watch.next(timeout=0)
+                if ev is None:
+                    break
+                if ev.type in ("RELIST",) or ev.obj is None:
+                    continue
+                job = ev.obj
+                name = job.metadata.name
+                cond = get_condition(job.status, constants.JOB_ADMITTED)
+                is_adm = cond is not None and cond.status == "True"
+                if name in admitted_state:
+                    if admitted_state[name] and not is_adm:
+                        # Evicted (baseline path): pay the rewind.
+                        lost += accrued[name]
+                        accrued[name] = 0.0
+                        evictions_seen += 1
+                    if not admitted_state[name] and is_adm:
+                        ckpt_at[name] = elapsed + w["ckpt_s"]
+                    admitted_state[name] = is_adm
+                elif name.startswith("prod-") and is_adm \
+                        and name not in prod_admitted:
+                    prod_admitted[name] = now
+                    heapq.heappush(completions,
+                                   (now + w["prod_hold_s"], name))
+            while completions and completions[0][0] <= now:
+                _, name = heapq.heappop(completions)
+                complete(name)
+            # Accounting sample — ONE atomic (scheduler-lock-held)
+            # capacity snapshot, so a resize committing mid-sample can
+            # never read as spurious conservation drift.
+            snap = sched.capacity_snapshot()
+            for key, g in gang_keys.items():
+                held = snap["gangs"].get(key, {}).get("held", 0)
+                if admitted_state[g]:
+                    productive[g] += held * dt
+                    accrued[g] += held * dt
+                    if elapsed >= ckpt_at[g]:
+                        accrued[g] = 0.0  # checkpoint committed
+                        ckpt_at[g] = elapsed + w["ckpt_s"]
+            held_total = snap["total_chips"] - snap["free_chips"]
+            util_integral += held_total * dt
+            charged_held = sum(e["held"] for e in snap["gangs"].values())
+            if charged_held + snap["free_chips"] != snap["total_chips"]:
+                conservation_violations.append(
+                    f"t={elapsed:.2f}: admitted holdings {charged_held}"
+                    f" + free {snap['free_chips']} !="
+                    f" {snap['total_chips']}")
+            if now - last_conservation >= 1.0:
+                last_conservation = now
+                from mpi_operator_tpu.chaos.invariants import \
+                    sched_capacity_conserved
+                conservation_violations.extend(
+                    f"t={elapsed:.2f}: {v}"
+                    for v in sched_capacity_conserved(system))
+            if now >= deadline and not pending and not completions:
+                break
+            time.sleep(0.05)
+        duration = time.monotonic() - t0
+
+        # Wind down: finish the gangs, let the stack settle, then hold
+        # every invariant.
+        if auto is not None:
+            auto.stop()
+        for g in gangs:
+            complete(g)
+        from mpi_operator_tpu.chaos.invariants import DEFAULT_INVARIANTS
+        settle_deadline = time.monotonic() + 30
+        failures = {}
+        while time.monotonic() < settle_deadline:
+            failures = {check.__name__: check(system)
+                        for check in DEFAULT_INVARIANTS}
+            if not any(failures.values()):
+                break
+            time.sleep(0.5)
+        violations = [f for v in failures.values() for f in v]
+
+        m = sched.metrics
+        goodput = sum(productive.values()) - lost
+        resize_counts = {
+            f"{d}_{o}": int(m["resizes"].get(d, o))
+            for d in ("grow", "shrink")
+            for o in ("completed", "timeout", "fallback_evict",
+                      "aborted")
+            if m["resizes"].get(d, o)}
+        return {
+            "elastic": elastic,
+            "duration_s": round(duration, 2),
+            "aggregate_goodput_chip_s": round(goodput, 1),
+            "productive_chip_s": round(sum(productive.values()), 1),
+            "lost_chip_s": round(lost, 1),
+            "cluster_utilization": round(
+                util_integral / (capacity * duration), 4),
+            "gang_evictions": evictions_seen,
+            "evictions_by_reason": {
+                reason: int(m["evictions"].get(reason))
+                for reason in ("preempted", "spot_reclaim", "requeued",
+                               "resize_fallback")
+                if m["evictions"].get(reason)},
+            "resizes": resize_counts,
+            "prod_jobs_admitted": len(prod_admitted),
+            "per_gang_productive_chip_s": {
+                g: round(v, 1) for g, v in sorted(productive.items())},
+            "conservation_violations": conservation_violations,
+            "invariant_violations": violations,
+        }
+    finally:
+        watch.stop()
+        if auto is not None:
+            auto.stop()
+        sched.stop()
+        controller.stop()
+
+
+# ---------------------------------------------------------------------------
+# Section 2: the live re-shard numerics proof
+# ---------------------------------------------------------------------------
+
+def run_reshard_proof() -> dict:
+    import jax
+    import numpy as np
+    import optax
+    from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
+    from mpi_operator_tpu.parallel.train import (build_train_step,
+                                                 reshard_train_state)
+
+    devs = jax.devices()
+    mesh_small = create_mesh(MeshConfig(dp=2, fsdp=2), devs[:4])
+    mesh_big = create_mesh(MeshConfig(dp=4, fsdp=2), devs)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = jax.nn.relu(x @ params["w1"])
+        return (((h @ params["w2"]) - y) ** 2).mean()
+
+    rng = np.random.default_rng(20260805)
+    params = {"w1": jax.numpy.asarray(rng.normal(size=(16, 32)),
+                                      "float32"),
+              "w2": jax.numpy.asarray(rng.normal(size=(32, 8)),
+                                      "float32")}
+    opt = optax.adam(1e-2)
+    steps, switch = 10, 5
+    batches = [(jax.numpy.asarray(rng.normal(size=(16, 16)), "float32"),
+                jax.numpy.asarray(rng.normal(size=(16, 8)), "float32"))
+               for _ in range(steps)]
+
+    def run(meshes, switch_at):
+        init, step = build_train_step(loss_fn, opt, meshes[0],
+                                      shard_update=True)
+        state = init(dict(params))
+        resumed_at = None
+        for i, batch in enumerate(batches):
+            if i == switch_at and len(meshes) > 1:
+                state = reshard_train_state(state, meshes[1],
+                                            shard_update=True)
+                resumed_at = int(state.step)
+                _, step = build_train_step(loss_fn, opt, meshes[1],
+                                           shard_update=True)
+            state, _ = step(state, batch)
+        return jax.device_get(state), resumed_at
+
+    golden, _ = run([mesh_big], None)
+    out = {"steps": steps, "resize_at_step": switch, "directions": {}}
+    for name, meshes in (("grow_2x4_to_4x8", [mesh_small, mesh_big]),
+                         ("shrink_4x8_to_2x4", [mesh_big, mesh_small])):
+        got, resumed_at = run(meshes, switch)
+        diffs = [float(np.max(np.abs(golden.params[k] - got.params[k])))
+                 for k in golden.params]
+        allclose = all(
+            np.allclose(golden.params[k], got.params[k],
+                        rtol=1e-5, atol=1e-5) for k in golden.params)
+        out["directions"][name] = {
+            "resumed_at_step": resumed_at,
+            "continued_from_same_step": resumed_at == switch,
+            "final_step": int(got.step),
+            "allclose_vs_uninterrupted": bool(allclose),
+            "max_abs_param_diff": max(diffs),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Main
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--out", default="BENCH_ELASTIC.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="reduced storm (CI-sized)")
+    ap.add_argument("--skip-live-proof", action="store_true")
+    args = ap.parse_args()
+
+    workload = {
+        "seed": 20260805,
+        "slices": 4, "slice_chips": 16,
+        "gangs": 3, "gang_workers": 11, "gang_min": 3, "gang_max": 15,
+        "burst_at": [6.0, 20.0, 34.0], "burst_jobs": 2,
+        "prod_workers": 15, "prod_hold_s": 5.0,
+        "ckpt_s": 6.0, "grace_s": 0.4, "resize_deadline_s": 10.0,
+        "duration_s": 48.0,
+    }
+    if args.quick:
+        workload.update({"burst_at": [4.0, 14.0], "duration_s": 24.0,
+                         "prod_hold_s": 3.0})
+
+    print("bench_elastic: live re-shard numerics proof...", flush=True)
+    reshard = run_reshard_proof()
+    for name, d in reshard["directions"].items():
+        print(f"  {name}: resumed at step {d['resumed_at_step']},"
+              f" allclose={d['allclose_vs_uninterrupted']}"
+              f" (max diff {d['max_abs_param_diff']:.2e})", flush=True)
+
+    results = {}
+    for label, elastic in (("evict_requeue", False), ("elastic", True)):
+        print(f"bench_elastic: running storm [{label}]...", flush=True)
+        results[label] = run_storm(elastic, workload)
+        r = results[label]
+        print(f"  goodput {r['aggregate_goodput_chip_s']} chip-s |"
+              f" util {r['cluster_utilization']} | lost"
+              f" {r['lost_chip_s']} chip-s | evictions"
+              f" {r['gang_evictions']} | resizes {r['resizes']}",
+              flush=True)
+
+    live = None
+    if not args.skip_live_proof:
+        print("bench_elastic: live-process resize proof"
+              " (LocalCluster)...", flush=True)
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import elastic_smoke
+        live = elastic_smoke.run_scenario()
+        print(f"  grow+shrink live, worker-0 steps"
+              f" {live['worker0_steps']} monotone", flush=True)
+
+    base = results["evict_requeue"]
+    el = results["elastic"]
+    speedup = (el["aggregate_goodput_chip_s"]
+               / max(base["aggregate_goodput_chip_s"], 1e-9))
+    report = {
+        "bench": "elastic_resize_storm",
+        "host": {"platform": platform.platform(),
+                 "python": platform.python_version(),
+                 "cpus": os.cpu_count()},
+        "workload": workload,
+        "reshard_proof": reshard,
+        "results": results,
+        "live_process_proof": live,
+        "improvement": {
+            "aggregate_goodput_x": round(speedup, 2),
+            "utilization_delta": round(
+                el["cluster_utilization"]
+                - base["cluster_utilization"], 4),
+            "lost_chip_s_baseline": base["lost_chip_s"],
+            "lost_chip_s_elastic": el["lost_chip_s"],
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"bench_elastic: wrote {args.out}")
+
+    failures = []
+    for label, r in results.items():
+        if r["conservation_violations"]:
+            failures.append(f"{label}: capacity conservation violated:"
+                            f" {r['conservation_violations'][:3]}")
+        if r["invariant_violations"]:
+            failures.append(f"{label}: invariants violated:"
+                            f" {r['invariant_violations'][:3]}")
+    for name, d in reshard["directions"].items():
+        if not (d["allclose_vs_uninterrupted"]
+                and d["continued_from_same_step"]):
+            failures.append(f"reshard {name}: continuity broken")
+    if el["lost_chip_s"] > 0:
+        failures.append(f"elastic lost {el['lost_chip_s']} chip-s"
+                        f" (must be 0: no rewind ever)")
+    if el["gang_evictions"] > 0:
+        failures.append(f"elastic evicted {el['gang_evictions']}"
+                        f" gang(s) (shrink must cover contention)")
+    if live is not None and not live["monotone"]:
+        failures.append("live-process proof: steps not monotone")
+    if speedup < 1.2:
+        failures.append(f"goodput speedup {speedup:.2f}x < 1.2x gate")
+    if failures:
+        print("bench_elastic: FAIL —")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print(f"bench_elastic: PASS — aggregate goodput"
+          f" {base['aggregate_goodput_chip_s']} ->"
+          f" {el['aggregate_goodput_chip_s']} chip-s"
+          f" ({speedup:.2f}x >= 1.2x), utilization"
+          f" {base['cluster_utilization']} ->"
+          f" {el['cluster_utilization']}, lost work"
+          f" {base['lost_chip_s']} -> 0 chip-s, 0 conservation"
+          f" violations, re-shard allclose at both sizes, live gang"
+          f" resized with monotone steps")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

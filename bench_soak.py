@@ -62,6 +62,11 @@ SLO_TARGETS = {
     # again.  Unpopulated (no apiserver_restart applied) fails the
     # gate — the full profile guarantees at least one.
     "apiserver_recovery_p99_s": 10.0,
+    # Elastic gang resize (ISSUE 15): accepted offer -> settled new
+    # size.  Unpopulated (no resize COMPLETED) fails the gate — the
+    # harness guarantees at least one gang_resize fault per plan, and
+    # the soak gangs are elastic with drain-aware workers.
+    "resize_p99_s": 10.0,
 }
 
 

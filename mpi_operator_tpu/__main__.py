@@ -505,6 +505,8 @@ def _print_gang_placements(client, namespace) -> None:
     import json
     from .api import constants as api_constants
     from .sched.api import job_queue_name
+    from .sched.elastic import (resize_state, resize_target,
+                                settled_workers)
     from .sched.topology import decode_placement, placement_shape_summary
 
     rows = []
@@ -520,6 +522,15 @@ def _print_gang_placements(client, namespace) -> None:
             api_constants.SCHED_PLACEMENT_ANNOTATION, ""))
         if blocks:
             shape = placement_shape_summary(blocks)
+        # Elastic size column: current→target(state) while a resize
+        # negotiates, plain worker count when settled.
+        current = settled_workers(job)
+        target = resize_target(job)
+        state = resize_state(job)
+        if target is not None and state:
+            size = f"{current}->{target}({state})"
+        else:
+            size = str(current)
         # Annotations are user-tamperable input: anything malformed
         # renders as-is instead of crashing the verb.
         cost = "-"
@@ -538,15 +549,16 @@ def _print_gang_placements(client, namespace) -> None:
                 chips += int(part.partition(":")[2] or 0)
             except ValueError:
                 continue
-        rows.append((job.metadata.name, chips,
+        rows.append((job.metadata.name, size, chips,
                      len([p for p in slices.split(",") if p]),
                      shape, cost))
     if not rows:
         return
-    print(f"\n{'GANG':24} {'CHIPS':>6} {'SLICES':>6} {'SHAPE':16} "
-          f"PREDICTED-COST")
-    for name, chips, nslices, shape, cost in sorted(rows):
-        print(f"{name:24} {chips:>6} {nslices:>6} {shape:16} {cost}")
+    print(f"\n{'GANG':24} {'WORKERS':>16} {'CHIPS':>6} {'SLICES':>6} "
+          f"{'SHAPE':16} PREDICTED-COST")
+    for name, size, chips, nslices, shape, cost in sorted(rows):
+        print(f"{name:24} {size:>16} {chips:>6} {nslices:>6} "
+              f"{shape:16} {cost}")
 
 
 def cmd_debug_bundle(args) -> int:

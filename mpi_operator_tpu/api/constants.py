@@ -184,6 +184,32 @@ NUM_SLICES_ENV = "MPI_OPERATOR_NUM_SLICES"
 # "Macro-soak & crash recovery").
 SCHED_RESERVATION_ANNOTATION = "scheduling.kubeflow.org/reservation"
 
+# --- Elastic gang resize (sched/elastic.py, docs/SCHEDULING.md
+# "Elastic gangs") -------------------------------------------------------
+# Opt-in: "MIN-MAX" worker-count bounds ("2-8").  Only jobs carrying
+# this annotation are resize candidates; everything else keeps the
+# frozen-at-admission gang size.
+ELASTIC_ANNOTATION = "scheduling.kubeflow.org/elastic"
+# The settled EFFECTIVE worker count after a completed resize
+# (scheduler-owned; absent = spec.workerReplicas).  The controller
+# reconciles the worker set to this count, and the scheduler's demand
+# math charges quota/capacity for it.
+SCHED_GANG_WORKERS_ANNOTATION = "scheduling.kubeflow.org/gang-workers"
+# In-flight resize protocol state (present only while a resize is
+# negotiating; a restarted scheduler re-adopts the transition from
+# these — docs/SCHEDULING.md "Elastic gangs"):
+#   resize-target   the worker count being negotiated toward
+#   resize-state    "growing" (chips granted, workers joining) or
+#                   "draining" (departing workers flushing their shards)
+#   resize-deadline epoch-seconds wall deadline; a lapsed shrink falls
+#                   back to the checkpoint-evict-requeue path, a lapsed
+#                   grow rolls the granted chips back
+SCHED_RESIZE_TARGET_ANNOTATION = "scheduling.kubeflow.org/resize-target"
+SCHED_RESIZE_STATE_ANNOTATION = "scheduling.kubeflow.org/resize-state"
+SCHED_RESIZE_DEADLINE_ANNOTATION = "scheduling.kubeflow.org/resize-deadline"
+RESIZE_STATE_GROWING = "growing"
+RESIZE_STATE_DRAINING = "draining"
+
 # Admission condition types (Queued -> Admitted; eviction flips back).
 JOB_QUEUED = "Queued"
 JOB_ADMITTED = "Admitted"

@@ -310,6 +310,92 @@ def inject_apiserver_restart(ctx, fault):
     return heal
 
 
+@register_injector("gang_resize")
+def inject_gang_resize(ctx, fault):
+    """Negotiate an admitted elastic gang up or down through the live
+    resize protocol (sched/elastic.py): grow grants idle aligned
+    blocks, shrink opens a drain window for the departing workers —
+    either way training continues from the same step on the survivors
+    (the ``resize_never_loses_a_step`` invariant watches).  The drawn
+    direction flips at a bound (a gang at max grows nowhere), and the
+    injector logs honestly when no scheduler / no elastic gang exists
+    or the scheduler rejects the offer (e.g. no appendable
+    capacity)."""
+    scheduler = getattr(ctx.system, "scheduler", None)
+    if scheduler is None:
+        ctx.log_result(fault, resolved_target="", result="no-scheduler")
+        return None
+    from ..sched.elastic import elastic_bounds, settled_workers
+    jobs = {f"{j.metadata.namespace}/{j.metadata.name}": j
+            for j in ctx.server.list("kubeflow.org/v2beta1", "MPIJob")}
+    candidates = []
+    for key in scheduler.admitted_keys():
+        job = jobs.get(key)
+        if job is None or scheduler.resizer.in_flight(key):
+            continue
+        bounds = elastic_bounds(job)
+        if bounds is None:
+            continue
+        candidates.append((key, job, bounds))
+    if fault.target:
+        candidates = [c for c in candidates if c[0] == fault.target]
+    if not candidates:
+        ctx.log_result(fault, resolved_target="",
+                       result="no-elastic-gang")
+        return None
+    key, job, bounds = ctx.rng.choice(sorted(candidates,
+                                             key=lambda c: c[0]))
+    current = settled_workers(job)
+    direction = fault.params.get("direction") or \
+        ctx.rng.choice(["grow", "shrink"])
+    # Flip at a bound so a drawn direction that cannot move still
+    # exercises the protocol when the other one can.
+    if direction == "grow" and current >= bounds[1]:
+        direction = "shrink"
+    elif direction == "shrink" and current <= bounds[0]:
+        direction = "grow"
+    target = current + 1 if direction == "grow" else current - 1
+    if not bounds[0] <= target <= bounds[1]:
+        # min == max bounds: no move exists ("no-" prefix keeps the
+        # no-op out of the applied-faults accounting).
+        ctx.log_result(fault, resolved_target=key,
+                       result="no-move-at-bounds")
+        return None
+    raw_deadline = fault.params.get("deadline")
+    deadline = float(raw_deadline) if raw_deadline is not None else None
+
+    def offer(direction, target):
+        accepted, msg = scheduler.request_resize(
+            *key.split("/", 1), target, deadline=deadline,
+            reason="chaos gang_resize")
+        return accepted, msg
+
+    accepted, msg = offer(direction, target)
+    if not accepted:
+        # Try the opposite direction once (a grow with no appendable
+        # capacity can still shrink, and vice versa) — the soak's
+        # resize SLO needs negotiated transitions, not coin-flip
+        # no-ops.
+        other = "shrink" if direction == "grow" else "grow"
+        alt = current - 1 if other == "shrink" else current + 1
+        if bounds[0] <= alt <= bounds[1]:
+            flipped, msg2 = offer(other, alt)
+            if flipped:
+                ctx.log_result(
+                    fault, resolved_target=key,
+                    result=f"{other} {current}->{alt} accepted"
+                           f" ({direction} rejected)")
+                return None
+            msg = f"{msg}; {other}: {msg2}"
+    # A rejected offer changed nothing: the "no-" prefix keeps it out
+    # of the applied-faults accounting (_fault_applied), like every
+    # other injector no-op.
+    result = (f"{direction} {current}->{target} accepted" if accepted
+              else f"no-accept {direction} {current}->{target}: {msg}")
+    ctx.log_result(fault, resolved_target=key, result=result)
+    return None
+
+
 @register_injector("pod_delete")
 def inject_pod_delete(ctx, fault):
     """Delete the pod object through the API (eviction/drain analogue):

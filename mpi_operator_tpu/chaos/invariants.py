@@ -174,6 +174,20 @@ def sched_capacity_conserved(system) -> List[str]:
                    f" admitted record")
     for key in sorted(admitted - placed):
         out.append(f"ghost gang: {key} admitted with no slice placement")
+    # Elastic resize must conserve capacity THROUGH every transition:
+    # the chips the scheduler accounts (quota/demand) and the chips the
+    # pool actually holds for a gang move in lockstep — a grow that
+    # placed chips without charging them (or a shrink that released
+    # without crediting) is a quiet capacity leak.  One ATOMIC snapshot
+    # (scheduler-lock-held): separate reads would race a committing
+    # resize into spurious drift.
+    snapshot = scheduler.capacity_snapshot()
+    for key, entry in sorted(snapshot["gangs"].items()):
+        if entry["held"] != entry["charged"]:
+            out.append(
+                f"resize accounting drift: {key} holds"
+                f" {entry['held']} chips on the pool but the scheduler"
+                f" charges {entry['charged']}")
     for job in system.client.server.list("kubeflow.org/v2beta1", "MPIJob"):
         if not job_queue_name(job) or is_finished(job.status) \
                 or job.spec.run_policy.suspend:
@@ -185,6 +199,34 @@ def sched_capacity_conserved(system) -> List[str]:
             out.append(f"MPIJob {key} is Admitted=True but unknown to"
                        f" the scheduler (not adopted — double-admission"
                        f" risk)")
+    return out
+
+
+def resize_never_loses_a_step(system) -> List[str]:
+    """Elastic-resize continuity invariant: a COMPLETED resize must
+    never move a gang's step counter backwards — shrink drains the
+    departing workers' shards and grow re-partitions from on-device
+    state, so training continues from the same step (no checkpoint
+    rewind).  Checked against the resizer's terminal log; step
+    watermarks come from an embedder-registered ``step_probe``
+    (smoke/bench wire one to the workers' step files) — entries
+    without both watermarks no-op, as does every system without a
+    scheduler."""
+    scheduler = getattr(system, "scheduler", None)
+    resizer = getattr(scheduler, "resizer", None)
+    if resizer is None:
+        return []
+    out = []
+    for rec in resizer.log:
+        before, after = rec.get("step_before"), rec.get("step_after")
+        if rec.get("outcome") != "completed" \
+                or before is None or after is None:
+            continue
+        if after < before:
+            out.append(
+                f"resize lost steps: {rec['job']}"
+                f" {rec['direction']} {rec['from_workers']}->"
+                f"{rec['target']} stepped {before} -> {after}")
     return out
 
 
@@ -215,11 +257,16 @@ def no_surplus_worker_pods(system) -> List[str]:
     for j in system.client.server.list("batch/v1", "Job"):
         key = (j.metadata.namespace, j.metadata.name)
         launcher_count[key] = launcher_count.get(key, 0) + 1
+    from ..sched.elastic import max_workers_seen
     for job in jobs:
         try:
             replicas = worker_replicas(job) or 0
         except (AttributeError, KeyError, TypeError, ValueError):
             continue  # malformed spec: demand math undefined, skip
+        # Elastic gangs legitimately run more workers than the spec
+        # count mid-grow: the bound is the largest effective size the
+        # resize protocol ever granted, not the frozen spec.
+        replicas = max(replicas, max_workers_seen(job))
         selector = worker_selector(job.metadata.name)
         bucket = pods_by_job.get(
             (job.metadata.namespace, job.metadata.name), ())
@@ -243,7 +290,9 @@ DEFAULT_INVARIANTS = (no_orphaned_runners, no_leaked_pod_ips,
                       no_orphaned_pods, gang_restarts_bounded,
                       jobs_converged, workqueue_idle,
                       serve_requests_intact, sched_no_partial_gangs,
-                      sched_capacity_conserved, no_surplus_worker_pods)
+                      sched_capacity_conserved,
+                      resize_never_loses_a_step,
+                      no_surplus_worker_pods)
 
 
 def checkpoint_intact(directory: str) -> List[str]:

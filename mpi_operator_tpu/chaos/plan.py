@@ -117,15 +117,18 @@ SCHED_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + ("spot_reclaim",)
 # & crash recovery"): every opt-in kind plus the control-plane restart
 # injectors — including the apiserver itself (``apiserver_restart``,
 # the durable-control-plane fault: WAL replay + watch-from-revision
-# resume, docs/RESILIENCE.md "Durable apiserver").  Only full-stack
-# systems (soak harness: training gangs through queues + serving fleet
-# + restartable control plane over a WAL-backed apiserver) exercise
-# every member; the rest no-op with a logged reason.  The DEFAULT tuple
-# stays untouched — recorded seeds keep deriving byte-identical plans
-# (regression-tested in tests/test_soak.py).
+# resume, docs/RESILIENCE.md "Durable apiserver") — plus
+# ``gang_resize`` (negotiate an admitted elastic gang up or down
+# through the live resize protocol; docs/SCHEDULING.md "Elastic
+# gangs").  Only full-stack systems (soak harness: training gangs
+# through queues + serving fleet + restartable control plane over a
+# WAL-backed apiserver) exercise every member; the rest no-op with a
+# logged reason.  The DEFAULT tuple stays untouched — recorded seeds
+# keep deriving byte-identical plans (regression-tested in
+# tests/test_soak.py).
 FULL_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + (
     "replica_kill", "spot_reclaim", "controller_restart",
-    "scheduler_restart", "apiserver_restart")
+    "scheduler_restart", "apiserver_restart", "gang_resize")
 
 # Named presets for `randomized_plan(profile=...)`.
 PLAN_PROFILES = {
@@ -197,6 +200,14 @@ def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
             # respawns the store; every component rides it out on
             # retried verbs + resumed watches.
             fault.duration = round(rng.uniform(0.4, 1.2), 3)
+        elif kind == "gang_resize":
+            # Target gang + direction resolved at inject time against
+            # the live admitted elastic gangs (the injector prefers
+            # the drawn direction and flips at a bound); deadline =
+            # the negotiation window before rollback/fallback-evict.
+            fault.params = {
+                "direction": rng.choice(["grow", "shrink"]),
+                "deadline": round(rng.uniform(1.0, 3.0), 3)}
         faults.append(fault)
     return FaultPlan(name=name or f"randomized-{seed}", seed=seed,
                      faults=faults)

@@ -635,8 +635,12 @@ class MPIJobController:
                 if p.status.phase == core.POD_RUNNING]
 
     def _get_or_create_config_map(self, job: MPIJob):
-        """getOrCreateConfigMap (:875-911)."""
-        new_cm = builders.new_config_map(job, worker_replicas(job),
+        """getOrCreateConfigMap (:875-911).  The hostfile covers the
+        EFFECTIVE worker count (elastic resize), and discover_hosts.sh
+        regenerates from running pods — the in-pod membership substrate
+        (bootstrap/elastic.py) sees a resize as hosts appearing or
+        leaving this script."""
+        new_cm = builders.new_config_map(job, self._effective_workers(job),
                                          self.cluster_domain)
         running = self._get_running_worker_pods(job)
         builders.update_discover_hosts_in_config_map(new_cm, job, running,
@@ -863,13 +867,24 @@ class MPIJobController:
             raise RuntimeError(
                 "persisting gang-restart count: conflicts exhausted")
 
+    def _effective_workers(self, job: MPIJob) -> int:
+        """The worker count this sync reconciles to: the spec count,
+        overridden by the gang scheduler's elastic-resize contract
+        (settled gang-workers / in-flight grow target; during a drain
+        the OLD size is held so departing workers keep their flush
+        window — sched/elastic.py, docs/SCHEDULING.md "Elastic
+        gangs").  Identical to the spec count for every non-elastic
+        job."""
+        from ..sched.elastic import controller_workers
+        return controller_workers(job)
+
     def _get_or_create_workers(self, job: MPIJob) -> list:
         """getOrCreateWorker (:982-1042)."""
         workers: list = []
         spec = job.worker_spec
         if spec is None:
             return workers
-        replicas = spec.replicas or 0
+        replicas = self._effective_workers(job)
 
         # Scale-down: remove pods whose index >= replicas (:998-1014).
         # The label is padded by one under runLauncherAsWorker
@@ -888,8 +903,14 @@ class MPIJobController:
                 except ValueError:
                     continue
                 if index >= replicas:
-                    self.client.pods(pod.metadata.namespace).delete(
-                        pod.metadata.name)
+                    try:
+                        self.client.pods(pod.metadata.namespace).delete(
+                            pod.metadata.name)
+                    except Exception as exc:
+                        # Stale informer cache: a prior sync (or the
+                        # elastic drain) already deleted it — converged.
+                        if not is_not_found(exc):
+                            raise
 
         for i in range(replicas):
             pod = self.pod_informer.lister.get(job.metadata.namespace,
@@ -924,11 +945,15 @@ class MPIJobController:
                           for c in p.status.conditions))
 
     def _delete_worker_pods(self, job: MPIJob) -> None:
-        """deleteWorkerPods (:1052-1092)."""
+        """deleteWorkerPods (:1052-1092).  The deletion range covers
+        the LARGEST worker index this job may ever have had (spec,
+        settled elastic size, in-flight resize target) — cleanup after
+        a grow must reach the grown indices."""
+        from ..sched.elastic import max_workers_seen
         spec = job.worker_spec
         if spec is None:
             return
-        for i in range(spec.replicas or 0):
+        for i in range(max_workers_seen(job)):
             name = builders.worker_name(job, i)
             pod = self.pod_informer.lister.get(job.metadata.namespace, name)
             if pod is None:

@@ -41,6 +41,50 @@ jax.tree_util.register_pytree_node(
     TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
 
 
+def _spec_axes(entry):
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def graft_spec(shape, base_spec, axis: str, size: int):
+    """Base PartitionSpec with ``axis`` grafted onto the first free
+    dimension divisible by ``size``; base unchanged when no dimension
+    qualifies or the axis already appears.  Shared by the ZeRO update
+    sharding, the hierarchical-allreduce layout, and the elastic
+    re-shard (reshard_train_state)."""
+    from jax.sharding import PartitionSpec as P
+    base = tuple(base_spec) if base_spec is not None else ()
+    base = base + (None,) * (len(shape) - len(base))
+    used = {n for e in base for n in _spec_axes(e)}
+    if axis not in used:
+        for d, dim in enumerate(shape):
+            if base[d] is None and dim > 0 and dim % size == 0:
+                base = base[:d] + (axis,) + base[d + 1:]
+                break
+    return P(*base)
+
+
+def zero_shape_specs(params, base_specs, dp_size: int) -> dict:
+    """shape -> ZeRO spec map for optimizer-state leaves (optax state
+    trees don't share the params' treedef, so leaves match by SHAPE).
+    Two same-shape params with different base specs make the mapping
+    ambiguous — those shapes are dropped and XLA propagates a
+    consistent sharding from the constrained grads/params instead."""
+    zspecs = jax.tree_util.tree_map(
+        lambda p, s: graft_spec(p.shape, s, "dp", dp_size),
+        params, base_specs)
+    seen, conflicts = {}, set()
+    for leaf, spec in zip(jax.tree_util.tree_leaves(params),
+                          jax.tree_util.tree_leaves(zspecs)):
+        if seen.setdefault(leaf.shape, spec) != spec:
+            conflicts.add(leaf.shape)
+    return {
+        shape: spec for shape, spec in seen.items()
+        if shape not in conflicts
+        and "dp" in {n for e in spec for n in _spec_axes(e)}}
+
+
 def build_train_step(loss_fn: Callable, optimizer, mesh,
                      param_specs=None,
                      donate: bool = True,
@@ -124,40 +168,21 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
     ici_size = mesh.shape.get(ici_axis, 1)
     hier = hierarchical_allreduce and ici_size > 1
 
-    def _spec_axes(entry):
-        if entry is None:
-            return ()
-        return entry if isinstance(entry, tuple) else (entry,)
-
     def _base_specs(params):
         if param_specs is not None:
             return param_specs
         return jax.tree_util.tree_map(lambda p: P(), params)
 
-    def _graft_spec(shape, base_spec, axis, size):
-        """Base spec with ``axis`` grafted onto the first free
-        dimension divisible by ``size``; base unchanged when no
-        dimension qualifies or the axis already appears."""
-        base = tuple(base_spec) if base_spec is not None else ()
-        base = base + (None,) * (len(shape) - len(base))
-        used = {n for e in base for n in _spec_axes(e)}
-        if axis not in used:
-            for d, dim in enumerate(shape):
-                if base[d] is None and dim > 0 and dim % size == 0:
-                    base = base[:d] + (axis,) + base[d + 1:]
-                    break
-        return P(*base)
-
     def _zero_spec(shape, base_spec):
         """Base spec with 'dp' grafted onto the first free dimension
         divisible by dp (the ZeRO shard axis); base unchanged when no
         dimension qualifies or dp already appears."""
-        return _graft_spec(shape, base_spec, "dp", dp_size)
+        return graft_spec(shape, base_spec, "dp", dp_size)
 
     def _hier_spec(shape, base_spec):
         """Base spec with the intra-slice axis grafted (the
         hierarchical reduce-scatter layout)."""
-        return _graft_spec(shape, base_spec, ici_axis, ici_size)
+        return graft_spec(shape, base_spec, ici_axis, ici_size)
 
     def _zero_plan(params):
         """(param zero specs, base specs, shape->zero spec map for
@@ -166,23 +191,7 @@ def build_train_step(loss_fn: Callable, optimizer, mesh,
         base_specs = _base_specs(params)
         zspecs = jax.tree_util.tree_map(
             lambda p, s: _zero_spec(p.shape, s), params, base_specs)
-        # Optimizer-state leaves are matched to their param's zero spec
-        # by SHAPE (optax state trees don't share the params' treedef).
-        # Two same-shape params with different base specs would make
-        # that ambiguous — pinning one param's moments to the other's
-        # spec forces a reshard every step — so conflicting shapes are
-        # dropped from the map: those moments are left unconstrained
-        # and XLA propagates a consistent sharding from the (correctly
-        # per-param constrained) grads/params operands instead.
-        seen, conflicts = {}, set()
-        for leaf, spec in zip(jax.tree_util.tree_leaves(params),
-                              jax.tree_util.tree_leaves(zspecs)):
-            if seen.setdefault(leaf.shape, spec) != spec:
-                conflicts.add(leaf.shape)
-        shape_spec = {
-            shape: spec for shape, spec in seen.items()
-            if shape not in conflicts
-            and "dp" in {n for e in spec for n in _spec_axes(e)}}
+        shape_spec = zero_shape_specs(params, base_specs, dp_size)
         return zspecs, base_specs, shape_spec
 
     def _constrain(tree, specs):
@@ -368,6 +377,87 @@ def preemption_notice_path() -> Optional[str]:
 def preemption_requested(path: Optional[str] = None) -> bool:
     path = path or preemption_notice_path()
     return bool(path) and os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# Elastic gang resize: live re-sharding, no checkpoint rewind
+# ---------------------------------------------------------------------------
+
+# The kubelet (runtime/kubelet.py) exports the pod's resize-notice path
+# here; the scheduler touches the file on DEPARTING workers of a shrink
+# and the content is the target worker count (docs/SCHEDULING.md
+# "Elastic gangs").
+RESIZE_NOTICE_ENV = "K_RESIZE_NOTICE_FILE"
+
+
+def resize_notice_path() -> Optional[str]:
+    """Where this process's elastic-resize notice appears (None when no
+    channel is configured)."""
+    path = os.environ.get(RESIZE_NOTICE_ENV)
+    if path:
+        return path
+    sandbox = os.environ.get("K_SANDBOX_DIR")
+    if sandbox:
+        return os.path.join(sandbox, "resize.notice")
+    return None
+
+
+def resize_requested(path: Optional[str] = None) -> Optional[int]:
+    """The target worker count from a delivered resize notice, or None
+    when no (parsable) notice exists.  A departing worker (index >=
+    target) should flush its state and exit 0 inside the drain window;
+    survivors re-form the world at the next membership change
+    (bootstrap/elastic.watch_hosts)."""
+    path = path or resize_notice_path()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return int(f.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def reshard_train_state(state: TrainState, mesh, param_specs=None,
+                        shard_update: bool = False) -> TrainState:
+    """Live elastic re-shard: move a TrainState onto a NEW mesh (the
+    post-resize gang) and continue from the SAME step — no checkpoint
+    rewind (docs/SCHEDULING.md "Elastic gangs", arXiv:2004.13336).
+
+    ``jax.device_get`` materializes every leaf in full on the host —
+    for the ZeRO-partitioned optimizer state that IS the all-gather of
+    the per-replica shards onto the surviving members' coordinator.
+    The gathered state is then re-placed exactly like init_fn would on
+    the new mesh: params onto their base specs, optimizer-state leaves
+    onto the new dp axis's ZeRO shards (``shard_update=True``) or
+    replicated.  Pure data movement, no arithmetic: the resumed run is
+    numerically identical to an uninterrupted one at the new size (up
+    to f32 reassociation inside subsequent steps — allclose-asserted
+    in tests/test_elastic.py and bench_elastic.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import shard_params
+
+    host = jax.device_get(state)
+    base_specs = param_specs
+    if base_specs is None:
+        base_specs = jax.tree_util.tree_map(lambda p: P(), host.params)
+    params = shard_params(host.params, base_specs, mesh)
+    replicated = NamedSharding(mesh, P())
+    dp_size = mesh.shape.get("dp", 1)
+    shape_spec = {}
+    if shard_update and dp_size > 1:
+        shape_spec = zero_shape_specs(params, base_specs, dp_size)
+
+    def _place(x):
+        spec = shape_spec.get(getattr(x, "shape", None))
+        sharding = replicated if spec is None \
+            else NamedSharding(mesh, spec)
+        return jax.device_put(x, sharding)
+
+    opt_state = jax.tree_util.tree_map(_place, host.opt_state)
+    step = jax.device_put(jnp.asarray(host.step, jnp.int32), replicated)
+    return TrainState(step=step, params=params, opt_state=opt_state)
 
 
 class _NoticePoller:

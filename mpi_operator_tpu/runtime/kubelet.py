@@ -61,6 +61,8 @@ class _PodRunner:
         self.log_path = os.path.join(self.sandbox, "container.log")
         self.preemption_notice_path = os.path.join(self.sandbox,
                                                    "preemption.notice")
+        self.resize_notice_path = os.path.join(self.sandbox,
+                                               "resize.notice")
         self.proc: Optional[subprocess.Popen] = None
         self.restart_count = 0
         self.stopped = threading.Event()
@@ -147,6 +149,13 @@ class _PodRunner:
         # node drainer) touches this file; preemption-aware workloads
         # (parallel/train.run_train_loop) checkpoint-then-exit on it.
         env["K_PREEMPTION_NOTICE_FILE"] = self.preemption_notice_path
+        # Elastic-resize notice channel (sched/elastic.py): the
+        # scheduler touches this file on DEPARTING workers of a shrink
+        # — the file's content is the target worker count — so the
+        # workload can drain its optimizer-state shards and exit
+        # cleanly inside the drain window (parallel/train.py
+        # resize_requested; docs/SCHEDULING.md "Elastic gangs").
+        env["K_RESIZE_NOTICE_FILE"] = self.resize_notice_path
 
         for ev in container.env:
             env[ev.name] = self.kubelet.resolve_env_value(ev.value)
@@ -202,6 +211,11 @@ class _PodRunner:
             # an infinite checkpoint/exit/restart loop.
             try:
                 os.unlink(self.preemption_notice_path)
+            except OSError:
+                pass
+            # Resize notices are per-incarnation for the same reason.
+            try:
+                os.unlink(self.resize_notice_path)
             except OSError:
                 pass
             with open(self.log_path, "ab") as log:
@@ -504,6 +518,28 @@ class LocalKubelet:
         timer = threading.Timer(grace, _enforce)
         timer.daemon = True
         timer.start()
+        return True
+
+    def inject_resize(self, namespace: str, name: str, target: int,
+                      deadline: float = 5.0) -> bool:
+        """Deliver an elastic-resize notice to a DEPARTING worker pod
+        (touch its K_RESIZE_NOTICE_FILE with the target worker count).
+        Unlike a preemption notice there is NO kill timer — the
+        scheduler owns the drain deadline and falls back to the full
+        checkpoint-evict protocol if the worker never exits
+        (sched/elastic.py).  Returns False when no runner matches."""
+        with self._lock:
+            runner = self._runners.get((namespace, name))
+        if runner is None:
+            return False
+        try:
+            with open(runner.resize_notice_path, "w") as f:
+                f.write(f"{int(target)}\n")
+        except OSError:
+            return False
+        flight.record("kubelet", "resize_notice",
+                      pod=f"{namespace}/{name}", target=int(target),
+                      deadline=deadline)
         return True
 
     # -- status reflection -------------------------------------------------

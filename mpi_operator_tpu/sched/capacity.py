@@ -215,19 +215,20 @@ class SlicePool:
                 remaining -= take
         return plan if remaining == 0 else None
 
-    def _topo_candidates(self, chips: int) \
+    def _aligned_candidates(self, eligible: List[tuple], chips: int) \
             -> List[Dict[str, List[Block]]]:
+        """Aligned candidate plans over an ``(name, free)`` slice set:
+        a single-slice plan per slice that fits, plus one spanning plan
+        over the most-free-first order.  Shared by initial placement
+        (every online slice) and elastic grow (the append-only tail
+        set), so planner fixes apply to both identically."""
         candidates: List[Dict[str, List[Block]]] = []
-        online = [(n, self._views[n].free) for n in self._slices
-                  if n not in self._offline]
-        # Aligned single-slice plans for every slice that fits.
-        for name, free in sorted(online):
+        for name, free in sorted(eligible):
             if free >= chips:
                 blocks = self._views[name].plan(chips)
                 if blocks is not None:
                     candidates.append({name: blocks})
-        # Aligned spanning plan over the greedy slice set.
-        ordered = sorted(online, key=lambda item: (-item[1], item[0]))
+        ordered = sorted(eligible, key=lambda item: (-item[1], item[0]))
         if sum(f for _, f in ordered) >= chips:
             plan: Dict[str, List[Block]] = {}
             remaining = chips
@@ -245,6 +246,12 @@ class SlicePool:
             if plan and remaining == 0:
                 candidates.append(plan)
         return candidates
+
+    def _topo_candidates(self, chips: int) \
+            -> List[Dict[str, List[Block]]]:
+        online = [(n, self._views[n].free) for n in self._slices
+                  if n not in self._offline]
+        return self._aligned_candidates(online, chips)
 
     def place(self, key: str, chips: int) -> Optional[Dict[str, int]]:
         """All-or-nothing: claim ``chips`` across online slices or
@@ -346,6 +353,150 @@ class SlicePool:
                     return False
                 seen.add(c)
         return all(view.is_free(b) for b in blocks)
+
+    # -- elastic resize (sched/elastic.py) ---------------------------------
+    #
+    # Canonical chip order (sorted slice names, blocks in recorded
+    # order, row-major within a block — topology.chip_of_index) is the
+    # worker-rank -> chip mapping, and SURVIVING workers' chips must
+    # never move under a resize.  Two rules enforce that:
+    #
+    # - grow only APPENDS in canonical order: new blocks land on the
+    #   placement's canonically-last slice or on later-named slices,
+    #   so the existing chip enumeration stays a strict prefix;
+    # - shrink releases exactly the canonical-order SUFFIX (the
+    #   highest-ranked workers' chips), splitting a straddled block
+    #   into kept unit blocks when the cut lands mid-block.
+
+    def _grow_candidates(self, key: str, extra: int) \
+            -> List[Dict[str, List[Block]]]:
+        existing = self._blocks.get(key) or {}
+        last = max(existing) if existing else None
+        allowed = [(n, self._views[n].free) for n in self._slices
+                   if n not in self._offline
+                   and (last is None or n >= last)]
+        return self._aligned_candidates(allowed, extra)
+
+    def _merged(self, key: str, added: Dict[str, List[Block]]) \
+            -> Dict[str, List[Block]]:
+        merged = {n: list(bs) for n, bs
+                  in (self._blocks.get(key) or {}).items()}
+        for name, blocks in added.items():
+            merged.setdefault(name, []).extend(blocks)
+        return merged
+
+    def plan_grow(self, key: str, extra_chips: int) -> Optional[dict]:
+        """Side-effect-free grow preview for the autoscaler's pricing:
+        the cheapest append-only plan for ``extra_chips`` more chips,
+        plus the predicted hierarchical collective cost of the CURRENT
+        and the MERGED placement ({"added", "cost_us", "grown_cost_us"})
+        — None when the gang is unplaced or the chips don't fit under
+        the append-only rule."""
+        if extra_chips <= 0:
+            raise ValueError("extra_chips must be positive")
+        with self._lock:
+            if key not in self._placements:
+                return None
+            candidates = self._grow_candidates(key, extra_chips)
+            if not candidates:
+                return None
+            ranked = min(
+                candidates,
+                key=lambda plan: (round(self._plan_cost(
+                    self._merged(key, plan)), 6),
+                    len(plan), tuple(sorted(plan))))
+            current = self._blocks.get(key) or {}
+            return {
+                "added": {n: list(bs) for n, bs in ranked.items()},
+                "cost_us": self._plan_cost(current) if current else 0.0,
+                "grown_cost_us": self._plan_cost(
+                    self._merged(key, ranked)),
+            }
+
+    def grow(self, key: str, extra_chips: int) \
+            -> Optional[Dict[str, int]]:
+        """All-or-nothing append-only extension of an existing
+        placement by ``extra_chips``: commits the cheapest
+        ``plan_grow`` candidate and returns the ADDED per-slice
+        assignment, or None (claiming nothing) when it cannot fit."""
+        preview = self.plan_grow(key, extra_chips)
+        if preview is None:
+            return None
+        with self._lock:
+            if key not in self._placements:
+                return None
+            added = preview["added"]
+            # Re-validate under the lock (plan_grow dropped it).
+            for name, blocks in added.items():
+                view = self._views.get(name)
+                if view is None or name in self._offline \
+                        or not all(view.is_free(b) for b in blocks):
+                    return None
+            assignment: Dict[str, int] = {}
+            for name, blocks in added.items():
+                take = sum(b.chips for b in blocks)
+                if take <= 0:
+                    continue
+                self._views[name].commit(blocks)
+                self._blocks.setdefault(key, {}).setdefault(
+                    name, []).extend(blocks)
+                self._placements[key][name] = \
+                    self._placements[key].get(name, 0) + take
+                assignment[name] = take
+            return assignment
+
+    def shrink_to_prefix(self, key: str, keep_chips: int) -> Optional[int]:
+        """Release everything past the first ``keep_chips`` chips of a
+        placement in canonical order — the departing (highest-rank)
+        workers' chips; survivors' coordinates are untouched.  A block
+        straddling the cut is split: its kept coordinates re-commit as
+        unit blocks (honestly priced as a fragmented holding by the
+        cost model).  Returns the chips returned to the ONLINE free
+        pool (offline-slice chips are book-kept like :meth:`release`),
+        or None when the key is unplaced or ``keep_chips`` exceeds the
+        placement."""
+        if keep_chips < 0:
+            raise ValueError("keep_chips must be >= 0")
+        with self._lock:
+            blocks = self._blocks.get(key)
+            if key not in self._placements:
+                return None
+            blocks = blocks or {}
+            total = sum(b.chips for bs in blocks.values() for b in bs)
+            if keep_chips > total:
+                return None
+            if keep_chips == total:
+                return 0
+            new_blocks: Dict[str, List[Block]] = {}
+            released: Dict[str, int] = {}
+            remaining = keep_chips
+            for name in sorted(blocks):
+                view = self._views[name]
+                for b in blocks[name]:
+                    if remaining >= b.chips:
+                        new_blocks.setdefault(name, []).append(b)
+                        remaining -= b.chips
+                    elif remaining > 0:
+                        # Straddled block: release it whole, re-commit
+                        # the kept prefix as unit blocks.
+                        coords = b.coords()
+                        view.release([b])
+                        units = [Block(c, (1,) * len(c))
+                                 for c in coords[:remaining]]
+                        view.commit(units)
+                        new_blocks.setdefault(name, []).extend(units)
+                        released[name] = released.get(name, 0) \
+                            + b.chips - remaining
+                        remaining = 0
+                    else:
+                        view.release([b])
+                        released[name] = released.get(name, 0) + b.chips
+            self._blocks[key] = new_blocks
+            assignment = {n: sum(b.chips for b in bs)
+                          for n, bs in new_blocks.items() if bs}
+            self._placements[key] = assignment
+            return sum(take for name, take in released.items()
+                       if name not in self._offline)
 
     def clear_placements(self) -> None:
         """Drop every placement, freeing all chips, while keeping slice

@@ -1,0 +1,872 @@
+"""Elastic gang resize — negotiate a running gang's size up or down
+without killing it (docs/SCHEDULING.md "Elastic gangs").
+
+The reference (and PR 9's scheduler) freezes a gang's size at
+admission: contention means checkpoint-then-evict-then-requeue the
+whole job, throwing away warm state and paying full rewind plus
+re-admission latency.  arXiv:2011.03641 shows gang size vs throughput
+is a *tradeable* axis, and the ZeRO-partitioned weight update
+(parallel/train.py, arXiv:2004.13336) means optimizer state can be
+re-gathered and re-partitioned from on-device state — so a gang can
+shrink under contention and grow into idle capacity while training
+continues from the *same* step.
+
+Three pieces:
+
+- **Size helpers** — the annotation contract.  A job opts in with
+  ``scheduling.kubeflow.org/elastic: "MIN-MAX"`` worker bounds; the
+  scheduler owns ``gang-workers`` (the settled effective size) and the
+  in-flight ``resize-target``/``resize-state``/``resize-deadline``
+  triple.  The controller reconciles the worker set to
+  :func:`controller_workers`, the scheduler charges quota/capacity for
+  :func:`demand_workers` (the LARGER of settled and target while a
+  transition is in flight — chips are committed up-front on grow and
+  held until drain on shrink, so capacity is conserved through every
+  transition).
+
+- **ElasticResizer** — the negotiation protocol state machine, owned
+  by the GangScheduler (every method runs under the scheduler lock).
+  Grow: chips are placed append-only (SlicePool.grow — survivors'
+  chip coordinates never move), annotations flip to
+  ``resize-state=growing``, the controller scales the worker set up,
+  and the resize completes when every worker of the target size runs.
+  Shrink: ``resize-state=draining`` opens a drain window — departing
+  (highest-index) workers get the kubelet resize notice
+  (K_RESIZE_NOTICE_FILE) so they can flush their optimizer-state
+  shards and exit cleanly; only then are their chips released
+  (SlicePool.shrink_to_prefix) and the settled size lowered.  A lapsed
+  shrink deadline falls back to the PR 9 checkpoint-evict-requeue
+  path; a lapsed grow rolls the granted chips back.  A restarted
+  scheduler re-adopts in-flight transitions from the annotations.
+
+- **TrainAutoscaler** — the goodput-aware policy loop (mirror of the
+  PR 8 serve autoscaler): grows elastic gangs into idle capacity and
+  shrinks them under contention *instead of* evict-requeueing, with
+  hysteresis on both directions.  Candidate grown placements are
+  priced with the PR 12 topology cost model: predicted step time is
+  ``work_us / chips + collective_cost_us(placement)``, so a grow that
+  crosses a DCN boundary is taken only when the extra chips still win
+  against the slower collective.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import constants
+from ..api.types import MPIJob, worker_replicas
+from ..k8s.apiserver import TRANSPORT_ERRORS, is_conflict, is_not_found
+from ..k8s.quantity import parse_quantity
+from ..telemetry import flight
+from .api import PODS_RESOURCE
+
+logger = logging.getLogger("mpi_operator_tpu.sched.elastic")
+
+DIRECTION_GROW = "grow"
+DIRECTION_SHRINK = "shrink"
+
+# Terminal outcomes of a resize (the resizes_total outcome label).
+OUTCOME_COMPLETED = "completed"
+OUTCOME_REJECTED = "rejected"
+OUTCOME_TIMEOUT = "timeout"                # grow deadline: rolled back
+OUTCOME_FALLBACK_EVICT = "fallback_evict"  # shrink deadline: PR 9 path
+OUTCOME_ABORTED = "aborted"                # gang left mid-resize
+
+
+# ---------------------------------------------------------------------------
+# The annotation contract (size helpers)
+# ---------------------------------------------------------------------------
+
+def elastic_bounds(job: MPIJob) -> Optional[Tuple[int, int]]:
+    """(min, max) worker bounds from the elastic annotation, or None
+    when the job is not elastic (absent/malformed annotation, or an
+    explicit schedulingPolicy.minAvailable — the demand math scales
+    the default workers+1 minAvailable and must not second-guess an
+    explicit gang contract)."""
+    raw = (job.metadata.annotations or {}).get(
+        constants.ELASTIC_ANNOTATION)
+    if not raw:
+        return None
+    policy = job.spec.run_policy.scheduling_policy
+    if policy is not None and policy.min_available is not None:
+        return None
+    lo, sep, hi = raw.partition("-")
+    if not sep:
+        return None
+    try:
+        bounds = (int(lo), int(hi))
+    except ValueError:
+        return None
+    if bounds[0] < 1 or bounds[1] < bounds[0]:
+        return None
+    return bounds
+
+
+def spec_workers(job: MPIJob) -> int:
+    try:
+        return worker_replicas(job) or 0
+    except (AttributeError, KeyError, TypeError, ValueError):
+        return 0
+
+
+def settled_workers(job: MPIJob) -> int:
+    """The settled effective worker count: the scheduler-owned
+    gang-workers annotation (written when a resize completes), else
+    the spec's workerReplicas."""
+    raw = (job.metadata.annotations or {}).get(
+        constants.SCHED_GANG_WORKERS_ANNOTATION)
+    if raw:
+        try:
+            value = int(raw)
+            if value >= 1:
+                return value
+        except ValueError:
+            pass
+    return spec_workers(job)
+
+
+def resize_target(job: MPIJob) -> Optional[int]:
+    raw = (job.metadata.annotations or {}).get(
+        constants.SCHED_RESIZE_TARGET_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        value = int(raw)
+    except ValueError:
+        return None
+    return value if value >= 1 else None
+
+
+def resize_state(job: MPIJob) -> str:
+    """"growing", "draining", or "" (no resize in flight)."""
+    return (job.metadata.annotations or {}).get(
+        constants.SCHED_RESIZE_STATE_ANNOTATION, "")
+
+
+def resize_deadline(job: MPIJob) -> Optional[float]:
+    raw = (job.metadata.annotations or {}).get(
+        constants.SCHED_RESIZE_DEADLINE_ANNOTATION)
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        return None
+
+
+def controller_workers(job: MPIJob) -> int:
+    """The worker count the CONTROLLER reconciles to.  During a grow
+    the new workers are created immediately (the chips are already
+    granted); during a drain the old size is held — survivors are
+    never touched and departing workers keep their drain window until
+    the scheduler settles the shrink."""
+    target = resize_target(job)
+    if target is not None \
+            and resize_state(job) == constants.RESIZE_STATE_GROWING:
+        return target
+    return settled_workers(job)
+
+
+def demand_workers(job: MPIJob) -> int:
+    """The worker count the SCHEDULER charges quota/capacity for: the
+    larger of settled and in-flight target — grow commits chips
+    up-front, shrink holds them until the drain completes, so the
+    accounted demand always covers the chips actually held."""
+    settled = settled_workers(job)
+    target = resize_target(job)
+    if target is not None and resize_state(job):
+        return max(settled, target)
+    return settled
+
+
+def max_workers_seen(job: MPIJob) -> int:
+    """Upper bound on worker indices that may ever have existed for
+    this job (spec, settled, and any in-flight target) — the range
+    deletion/cleanup paths must cover."""
+    return max(spec_workers(job), settled_workers(job),
+               resize_target(job) or 0)
+
+
+def per_worker_chips(job: MPIJob) -> int:
+    """TPU chips one worker replica holds (requests win, limits fill
+    the gap — the podgroup math's precedence), floor 1 so the capacity
+    model stays meaningful for chip-less jobs."""
+    spec = job.worker_spec
+    if spec is None or spec.template is None:
+        return 1
+    total = 0.0
+    for container in spec.template.spec.containers or []:
+        resources = getattr(container, "resources", None)
+        if resources is None:
+            continue
+        merged = dict(resources.requests or {})
+        for name, lim in (resources.limits or {}).items():
+            merged.setdefault(name, lim)
+        raw = merged.get(constants.TPU_RESOURCE)
+        if raw is not None:
+            try:
+                total += float(parse_quantity(raw))
+            except (ValueError, TypeError):
+                continue
+    return max(1, int(total))
+
+
+# ---------------------------------------------------------------------------
+# The negotiation protocol
+# ---------------------------------------------------------------------------
+
+class ElasticResizer:
+    """Resize protocol state machine.  Owned by a GangScheduler; every
+    method is called with the scheduler lock held (the scheduler's
+    ``request_resize`` public surface takes it).  Deadlines are wall
+    clock (epoch seconds) and persisted in the resize-deadline
+    annotation, so a restarted scheduler resumes the SAME window."""
+
+    def __init__(self, sched, default_deadline: float = 5.0):
+        self.sched = sched
+        self.default_deadline = float(default_deadline)
+        # key -> {"direction","from_workers","target","deadline","t0",
+        #         "delta_chips","per_worker","trigger","step_before"}
+        self._active: Dict[str, dict] = {}
+        # Terminal records (newest last): the resize_never_loses_a_step
+        # invariant and the bench read these.
+        self.log: List[dict] = []
+        # Optional embedder hook: key -> current step counter of the
+        # gang's workload (None when unknown).  Smoke/bench register a
+        # file-reading probe; without one the step fields stay None and
+        # the step-loss invariant no-ops.
+        self.step_probe: Optional[Callable[[str], Optional[int]]] = None
+
+    # -- introspection -----------------------------------------------------
+    def in_flight(self, key: str) -> bool:
+        return key in self._active
+
+    def active_keys(self) -> List[str]:
+        return sorted(self._active)
+
+    def pending_release_demands(self) -> List[Tuple[str, Dict[str, int]]]:
+        """(cq name, demand delta) per in-flight shrink: the capacity +
+        quota that WILL free once the drain completes — preemption's
+        pending-free accounting counts these exactly like open grace
+        windows, or every pass during a drain would select fresh
+        victims."""
+        out = []
+        for key, entry in self._active.items():
+            if entry["direction"] != DIRECTION_SHRINK:
+                continue
+            rec = self.sched._admitted.get(key)
+            if rec is None:
+                continue
+            delta_w = entry["from_workers"] - entry["target"]
+            out.append((rec["cq"], {
+                PODS_RESOURCE: delta_w,
+                constants.TPU_RESOURCE: delta_w * entry["per_worker"]}))
+        return out
+
+    def pending_release_chips(self) -> int:
+        return sum(d[constants.TPU_RESOURCE]
+                   for _, d in self.pending_release_demands())
+
+    # -- the offer ---------------------------------------------------------
+    def begin(self, key: str, job, rec, cq, cqs, usage,
+              target: int, deadline: Optional[float],
+              trigger: str) -> Tuple[bool, str]:
+        """Open a resize toward ``target`` workers.  Returns (accepted,
+        reason).  Rejections are counted; nothing is mutated on a
+        rejection."""
+        # Direction is known as soon as target vs current is — later
+        # rejections (bounds, quota, capacity) carry the real
+        # grow/shrink label; only pre-direction rejections count as
+        # "none".
+        current = settled_workers(job)
+        direction = None if target == current else (
+            DIRECTION_GROW if target > current else DIRECTION_SHRINK)
+
+        def reject(why: str) -> Tuple[bool, str]:
+            self._count(direction, OUTCOME_REJECTED)
+            flight.record("sched", "resize_rejected", job=key,
+                          target=target, reason=why, trigger=trigger)
+            return False, why
+
+        if not getattr(self.sched, "elastic", True):
+            return reject("elastic resize disabled")
+        bounds = elastic_bounds(job)
+        if bounds is None:
+            return reject("job is not elastic (no valid MIN-MAX bounds)")
+        if key in self._active:
+            return reject("resize already in flight")
+        if key in self.sched._preempting:
+            return reject("eviction grace window open")
+        if not bounds[0] <= target <= bounds[1]:
+            return reject(f"target {target} outside bounds "
+                          f"{bounds[0]}-{bounds[1]}")
+        if direction is None:
+            return reject(f"already at {current} workers")
+        per_w = per_worker_chips(job)
+        window = self.default_deadline if deadline is None \
+            else float(deadline)
+        due = time.time() + window
+        delta_w = abs(target - current)
+        delta_chips = delta_w * per_w
+        if direction == DIRECTION_GROW:
+            delta_demand = {PODS_RESOURCE: delta_w,
+                            constants.TPU_RESOURCE: delta_chips}
+            if not self.sched._quota_allows(cq, delta_demand, cqs, usage):
+                return reject("quota exhausted for the grown size")
+            added = self.sched.pool.grow(key, delta_chips)
+            if added is None:
+                return reject("no appendable capacity for the grown"
+                              " placement")
+            rec["chips"] += delta_chips
+            rec["demand"] = dict(rec["demand"])
+            rec["demand"][PODS_RESOURCE] = \
+                rec["demand"].get(PODS_RESOURCE, 0) + delta_w
+            rec["demand"][constants.TPU_RESOURCE] = \
+                rec["demand"].get(constants.TPU_RESOURCE, 0) + delta_chips
+            self._write_placement_annotations(
+                key, extra={
+                    constants.SCHED_RESIZE_TARGET_ANNOTATION: str(target),
+                    constants.SCHED_RESIZE_STATE_ANNOTATION:
+                        constants.RESIZE_STATE_GROWING,
+                    constants.SCHED_RESIZE_DEADLINE_ANNOTATION:
+                        f"{due:.3f}"})
+        else:
+            self._write_annotations(
+                key, {
+                    constants.SCHED_RESIZE_TARGET_ANNOTATION: str(target),
+                    constants.SCHED_RESIZE_STATE_ANNOTATION:
+                        constants.RESIZE_STATE_DRAINING,
+                    constants.SCHED_RESIZE_DEADLINE_ANNOTATION:
+                        f"{due:.3f}"}, ())
+            self._notify_departing(job, current, target, window)
+        self._active[key] = {
+            "direction": direction, "from_workers": current,
+            "target": target, "deadline": due, "t0": time.time(),
+            "delta_chips": delta_chips, "per_worker": per_w,
+            "trigger": trigger, "step_before": self._probe(key)}
+        flight.record("sched", "resize_offered", job=key,
+                      direction=direction, from_workers=current,
+                      target=target, chips_delta=delta_chips,
+                      trigger=trigger)
+        return True, f"{direction} {current}->{target} accepted"
+
+    # -- progress ----------------------------------------------------------
+    def tick(self, jobs: Dict[str, object]) -> None:
+        """Advance every in-flight resize (called from each reconcile
+        pass, scheduler lock held)."""
+        if not self._active:
+            return
+        pods = None
+        now = time.time()
+        for key in sorted(self._active):
+            entry = self._active[key]
+            job = jobs.get(key)
+            rec = self.sched._admitted.get(key)
+            if job is None or rec is None:
+                # The gang left (finished, deleted, evicted) mid-resize:
+                # its release path reclaims everything — just retire the
+                # protocol entry.
+                self._finish(key, entry, OUTCOME_ABORTED)
+                continue
+            if pods is None:
+                pods = self._pod_index()
+                if pods is None:
+                    return  # API weather: no safe progress judgment
+            if entry["direction"] == DIRECTION_GROW:
+                self._tick_grow(key, entry, job, rec, pods, now)
+            else:
+                self._tick_shrink(key, entry, job, rec, pods, now)
+
+    def _tick_grow(self, key, entry, job, rec, pods, now) -> None:
+        from ..controller import builders
+        from ..k8s import core
+        want = entry["target"]
+        ready = 0
+        for i in range(want):
+            pod = pods.get((job.metadata.namespace,
+                            builders.worker_name(job, i)))
+            if pod is None:
+                continue
+            if self.sched.kubelet is None \
+                    or pod.status.phase == core.POD_RUNNING:
+                # Control-plane-only stacks have no kubelet to flip
+                # phases: worker-set actuation (the pod exists) is the
+                # observable completion there.
+                ready += 1
+        if ready >= want:
+            self._write_annotations(
+                key,
+                {constants.SCHED_GANG_WORKERS_ANNOTATION:
+                 str(entry["target"])},
+                (constants.SCHED_RESIZE_TARGET_ANNOTATION,
+                 constants.SCHED_RESIZE_STATE_ANNOTATION,
+                 constants.SCHED_RESIZE_DEADLINE_ANNOTATION))
+            self._finish(key, entry, OUTCOME_COMPLETED, now)
+            return
+        if now >= entry["deadline"]:
+            # The granted workers never materialized: roll the chips
+            # back (release the appended canonical suffix) and settle
+            # at the old size.
+            freed = self.sched.pool.shrink_to_prefix(
+                key, rec["chips"] - entry["delta_chips"])
+            self._shrink_accounting(rec, entry, freed or 0)
+            self._write_placement_annotations(
+                key, clear=(
+                    constants.SCHED_RESIZE_TARGET_ANNOTATION,
+                    constants.SCHED_RESIZE_STATE_ANNOTATION,
+                    constants.SCHED_RESIZE_DEADLINE_ANNOTATION))
+            self._finish(key, entry, OUTCOME_TIMEOUT, now)
+
+    def _tick_shrink(self, key, entry, job, rec, pods, now) -> None:
+        from ..controller import builders
+        from ..k8s import core
+        departing_live = 0
+        for i in range(entry["target"], entry["from_workers"]):
+            pod = pods.get((job.metadata.namespace,
+                            builders.worker_name(job, i)))
+            if pod is None:
+                continue
+            if self.sched.kubelet is not None and pod.status.phase in (
+                    core.POD_RUNNING, core.POD_PENDING):
+                departing_live += 1
+        if departing_live > 0 and now < entry["deadline"]:
+            # Idempotent re-notify every tick: a departing pod that
+            # restarted (chaos kill, OnFailure restart) starts with a
+            # FRESH sandbox — its original notice file is gone, and
+            # without re-delivery the drain would silently run out and
+            # fallback-evict the whole gang.
+            self._notify_departing(job, entry["from_workers"],
+                                   entry["target"],
+                                   max(0.1, entry["deadline"] - now))
+        if departing_live == 0:
+            # Drained: every departing worker flushed and exited (or
+            # never ran).  NOW release their chips — the canonical
+            # suffix, so survivors' coordinates are untouched — and
+            # settle the new size.
+            keep = rec["chips"] - entry["delta_chips"]
+            freed = self.sched.pool.shrink_to_prefix(key, keep)
+            self._shrink_accounting(rec, entry, freed or 0)
+            self._write_placement_annotations(
+                key,
+                extra={constants.SCHED_GANG_WORKERS_ANNOTATION:
+                       str(entry["target"])},
+                clear=(constants.SCHED_RESIZE_TARGET_ANNOTATION,
+                       constants.SCHED_RESIZE_STATE_ANNOTATION,
+                       constants.SCHED_RESIZE_DEADLINE_ANNOTATION))
+            self._finish(key, entry, OUTCOME_COMPLETED, now)
+            return
+        if now >= entry["deadline"]:
+            # The drain window lapsed with departing workers still
+            # running: fall back to the PR 9 checkpoint-evict-requeue
+            # protocol for the WHOLE gang (the only remaining way to
+            # reclaim the chips without corrupting the workload).
+            from .scheduler import EVICT_RESIZE_FALLBACK
+            self._write_annotations(
+                key, {}, (constants.SCHED_RESIZE_TARGET_ANNOTATION,
+                          constants.SCHED_RESIZE_STATE_ANNOTATION,
+                          constants.SCHED_RESIZE_DEADLINE_ANNOTATION))
+            self._finish(key, entry, OUTCOME_FALLBACK_EVICT, now)
+            self.sched._begin_eviction(
+                key, EVICT_RESIZE_FALLBACK,
+                message=f"shrink to {entry['target']} workers missed its"
+                        f" drain deadline; falling back to"
+                        f" checkpoint-evict")
+
+    def _shrink_accounting(self, rec, entry, freed: int) -> None:
+        delta_w = entry["delta_chips"] // max(1, entry["per_worker"])
+        rec["chips"] -= entry["delta_chips"]
+        rec["demand"] = dict(rec["demand"])
+        rec["demand"][PODS_RESOURCE] = \
+            max(0, rec["demand"].get(PODS_RESOURCE, 0) - delta_w)
+        rec["demand"][constants.TPU_RESOURCE] = max(
+            0, rec["demand"].get(constants.TPU_RESOURCE, 0)
+            - entry["delta_chips"])
+        # Freed chips accrue to a fenced gang's reservation exactly
+        # like a full release (the fence's no-starvation bound must
+        # not leak through the resize path).
+        blocked = self.sched._blocked
+        if blocked is not None and freed > 0:
+            blocked["reserved"] = min(blocked["reserved"] + freed,
+                                      blocked["chips"])
+            self.sched._persist_reservation(blocked["key"],
+                                            blocked["reserved"])
+
+    # -- restart adoption --------------------------------------------------
+    def adopt(self, jobs: Dict[str, object]) -> None:
+        """Rebuild in-flight transitions from annotations after a
+        scheduler restart: the grown chips were already re-placed by
+        the slices/placement adoption path (demand_workers covers the
+        target), so only the protocol entry and the drain notices need
+        re-arming.  The persisted wall-clock deadline is resumed, not
+        reset."""
+        from .scheduler import job_demand
+        for key, job in sorted(jobs.items()):
+            if key in self._active or key not in self.sched._admitted:
+                continue
+            state = resize_state(job)
+            target = resize_target(job)
+            if not state or target is None:
+                continue
+            current = settled_workers(job)
+            if target == current:
+                continue
+            rec = self.sched._admitted[key]
+            # Stale-settle guard: the transition may ALREADY be applied
+            # in-memory (pool + rec moved) with only the settle
+            # annotation write lost to API weather — replaying it would
+            # release chips the SURVIVORS still occupy (a shrink run
+            # twice) or re-roll a finished rollback.  The signature:
+            # the accounted chips no longer match the demand the
+            # (stale) annotations imply.  Finish the protocol instead —
+            # re-issue the settle write, retried here every reconcile
+            # until it lands.
+            expected_pending = job_demand(job)[constants.TPU_RESOURCE]
+            if rec["chips"] != expected_pending:
+                if state == constants.RESIZE_STATE_DRAINING \
+                        and rec["chips"] < expected_pending:
+                    self._write_placement_annotations(
+                        key,
+                        extra={constants.SCHED_GANG_WORKERS_ANNOTATION:
+                               str(target)},
+                        clear=(constants.SCHED_RESIZE_TARGET_ANNOTATION,
+                               constants.SCHED_RESIZE_STATE_ANNOTATION,
+                               constants.
+                               SCHED_RESIZE_DEADLINE_ANNOTATION))
+                else:  # grow rollback already applied
+                    self._write_placement_annotations(
+                        key, clear=(
+                            constants.SCHED_RESIZE_TARGET_ANNOTATION,
+                            constants.SCHED_RESIZE_STATE_ANNOTATION,
+                            constants.SCHED_RESIZE_DEADLINE_ANNOTATION))
+                flight.record("sched", "resize_settle_rewritten",
+                              job=key, state=state, target=target)
+                continue
+            per_w = per_worker_chips(job)
+            due = resize_deadline(job)
+            if due is None:
+                due = time.time() + self.default_deadline
+            direction = (DIRECTION_GROW
+                         if state == constants.RESIZE_STATE_GROWING
+                         else DIRECTION_SHRINK)
+            self._active[key] = {
+                "direction": direction, "from_workers": current,
+                "target": target, "deadline": due, "t0": time.time(),
+                "delta_chips": abs(target - current) * per_w,
+                "per_worker": per_w, "trigger": "adopted",
+                "step_before": self._probe(key)}
+            if direction == DIRECTION_SHRINK:
+                # Idempotent re-notify: the notice files survive in the
+                # pod sandboxes, but the kubelet may have restarted the
+                # pods since (fresh sandboxes, notice gone).
+                self._notify_departing(job, current, target,
+                                       max(0.1, due - time.time()))
+            flight.record("sched", "resize_adopted", job=key,
+                          direction=direction, target=target)
+
+    def on_release(self, key: str) -> None:
+        """The gang's placement is being fully released (finished,
+        deleted, suspended, evicted): retire any in-flight entry."""
+        entry = self._active.get(key)
+        if entry is not None:
+            self._finish(key, entry, OUTCOME_ABORTED)
+
+    # -- plumbing ----------------------------------------------------------
+    def _probe(self, key: str) -> Optional[int]:
+        if self.step_probe is None:
+            return None
+        try:
+            return self.step_probe(key)
+        except Exception as exc:
+            # A broken embedder probe must not wedge the protocol; the
+            # step watermark just reads unknown for this transition.
+            logger.debug("step probe for %s failed: %s", key, exc)
+            return None
+
+    def _pod_index(self) -> Optional[Dict[tuple, object]]:
+        """Live pod index, or None on API weather — the caller must
+        SKIP the tick then: an empty dict would read as "every
+        departing worker already exited" and settle a drain (releasing
+        chips live workers still occupy) off a transient list
+        failure."""
+        try:
+            pods = self.sched.client.server.list(
+                "v1", "Pod", self.sched.namespace)
+        except TRANSPORT_ERRORS:
+            return None
+        return {(p.metadata.namespace, p.metadata.name): p for p in pods}
+
+    def _notify_departing(self, job, current: int, target: int,
+                          window: float) -> int:
+        if self.sched.kubelet is None:
+            return 0
+        from ..controller import builders
+        noticed = 0
+        for i in range(target, current):
+            try:
+                if self.sched.kubelet.inject_resize(
+                        job.metadata.namespace,
+                        builders.worker_name(job, i), target=target,
+                        deadline=window):
+                    noticed += 1
+            except TRANSPORT_ERRORS + (KeyError,):
+                continue
+        return noticed
+
+    def _count(self, direction: Optional[str], outcome: str) -> None:
+        counter = self.sched.metrics.get("resizes")
+        if counter is not None:
+            counter.labels(direction or "none", outcome).inc()
+
+    def _finish(self, key: str, entry: dict, outcome: str,
+                now: Optional[float] = None) -> None:
+        self._active.pop(key, None)
+        now = time.time() if now is None else now
+        seconds = max(0.0, now - entry["t0"])
+        self._count(entry["direction"], outcome)
+        if outcome == OUTCOME_COMPLETED:
+            hist = self.sched.metrics.get("resize_seconds")
+            if hist is not None:
+                hist.observe(seconds)
+        record = {
+            "job": key, "direction": entry["direction"],
+            "from_workers": entry["from_workers"],
+            "target": entry["target"], "outcome": outcome,
+            "seconds": round(seconds, 4), "trigger": entry["trigger"],
+            "step_before": entry["step_before"],
+            "step_after": self._probe(key)
+            if outcome == OUTCOME_COMPLETED else None,
+        }
+        self.log.append(record)
+        flight.record("sched", "resize_" + outcome, job=key,
+                      direction=entry["direction"],
+                      from_workers=entry["from_workers"],
+                      target=entry["target"],
+                      seconds=record["seconds"])
+
+    def _write_placement_annotations(self, key: str,
+                                     extra: Optional[dict] = None,
+                                     clear: tuple = ()) -> None:
+        """Annotation write that also refreshes the slices + placement
+        records from the pool (grow/shrink moved chips)."""
+        import json as _json
+
+        from .topology import encode_placement
+        placed = self.sched.pool.placement_of(key) or {}
+        blocks = self.sched.pool.placement_blocks(key) or {}
+        costs = self.sched.pool.predicted_costs(key)
+        values = {
+            constants.SCHED_SLICES_ANNOTATION: ",".join(
+                f"{name}:{take}"
+                for name, take in sorted(placed.items())),
+            constants.SCHED_PLACEMENT_ANNOTATION:
+                encode_placement(blocks),
+            constants.SCHED_COST_ANNOTATION:
+                _json.dumps(costs, sort_keys=True) if costs else "",
+        }
+        values.update(extra or {})
+        self._write_annotations(key, values, clear)
+
+    def _write_annotations(self, key: str, values: dict,
+                           clear: tuple) -> None:
+        """Conflict-retried annotation read-modify-write.  Losing the
+        write entirely (NotFound) is safe — the release path owns a
+        departed job; other transport errors are retried next tick by
+        the level-triggered reconcile."""
+        namespace, _, name = key.partition("/")
+        for _ in range(5):
+            try:
+                job = self.sched.client.mpi_jobs(namespace).get(name)
+            except Exception as exc:
+                if is_not_found(exc):
+                    return
+                logger.debug("resize annotation read for %s: %s",
+                             key, exc)
+                return
+            annotations = dict(job.metadata.annotations or {})
+            for anno in clear:
+                annotations.pop(anno, None)
+            for anno, value in values.items():
+                if value:
+                    annotations[anno] = value
+                else:
+                    annotations.pop(anno, None)
+            if annotations == (job.metadata.annotations or {}):
+                return
+            job.metadata.annotations = annotations
+            try:
+                self.sched.client.mpi_jobs(namespace).update(job)
+                return
+            except Exception as exc:
+                if is_conflict(exc):
+                    continue
+                if is_not_found(exc):
+                    return
+                logger.debug("resize annotation write for %s: %s",
+                             key, exc)
+                return
+
+
+# ---------------------------------------------------------------------------
+# The goodput-aware training autoscaler
+# ---------------------------------------------------------------------------
+
+class TrainAutoscaler:
+    """Polls the gang scheduler and steers elastic gangs' sizes — the
+    training-side mirror of serving/autoscaler.py, with the same
+    hysteresis shape (consecutive-poll stability windows; the shrink
+    window is the longer one, since a too-eager shrink immediately
+    re-pays a grow negotiation).
+
+    - **shrink under contention**: a capacity-blocked front gang held
+      for ``down_stable`` polls shrinks the lowest-priority (then
+      largest) elastic gang by just enough workers to cover the
+      shortfall, instead of evict-requeueing anyone.
+    - **grow into idle**: free chips with NO pending demand for
+      ``up_stable`` polls grow the highest-priority (then smallest)
+      growable gang — but only when the cost model says the bigger
+      gang still steps faster: predicted step time is
+      ``work_us/chips + collective_cost_us``, so a grow that must
+      cross a DCN boundary is refused when the collective slowdown
+      eats the compute win (falls back to trying a single-worker
+      grow, which may stay inside the slice).
+    """
+
+    def __init__(self, scheduler, poll_interval: float = 0.5,
+                 up_stable: int = 2, down_stable: int = 4,
+                 work_us: float = 200_000.0,
+                 resize_deadline: Optional[float] = None):
+        self.sched = scheduler
+        self.poll_interval = float(poll_interval)
+        self.up_stable = int(up_stable)
+        self.down_stable = int(down_stable)
+        self.work_us = float(work_us)
+        self.resize_deadline = resize_deadline
+        self._up_hits = 0
+        self._down_hits = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Observable trail: (direction, key, from, target, reason).
+        self.transitions: List[tuple] = []
+
+    # -- decision ----------------------------------------------------------
+    def evaluate_once(self) -> Optional[tuple]:
+        """One poll; returns the applied transition or None."""
+        snap = self.sched.elastic_snapshot()
+        if snap is None:
+            return None
+        blocked = snap["blocked"]
+        if blocked is not None and blocked["short_chips"] > 0:
+            self._up_hits = 0
+            self._down_hits += 1
+            if self._down_hits < self.down_stable:
+                return None
+            self._down_hits = 0
+            return self._shrink_for(snap, blocked)
+        growable = [g for g in snap["gangs"]
+                    if g["workers"] < g["max_workers"]
+                    and not g["resizing"]]
+        if snap["free_chips"] > 0 and growable \
+                and not snap["pending_jobs"]:
+            self._down_hits = 0
+            self._up_hits += 1
+            if self._up_hits < self.up_stable:
+                return None
+            self._up_hits = 0
+            return self._grow_into_idle(snap, growable)
+        self._up_hits = self._down_hits = 0
+        return None
+
+    def _shrink_for(self, snap, blocked) -> Optional[tuple]:
+        victims = [g for g in snap["gangs"]
+                   if g["workers"] > g["min_workers"]
+                   and not g["resizing"]
+                   and g["key"] != blocked["key"]]
+        if not victims:
+            return None
+        victims.sort(key=lambda g: (g["priority"], -g["workers"],
+                                    g["key"]))
+        victim = victims[0]
+        short = blocked["short_chips"]
+        per_w = victim["per_worker_chips"]
+        shrink_w = min(victim["workers"] - victim["min_workers"],
+                       max(1, -(-short // per_w)))
+        target = victim["workers"] - shrink_w
+        reason = (f"shrink: {short} chips short for blocked"
+                  f" {blocked['key']}")
+        ok, msg = self.sched.request_resize(
+            victim["namespace"], victim["name"], target,
+            deadline=self.resize_deadline, reason=reason)
+        if not ok:
+            return None
+        transition = (DIRECTION_SHRINK, victim["key"],
+                      victim["workers"], target, reason)
+        self.transitions.append(transition)
+        return transition
+
+    def _grow_into_idle(self, snap, growable) -> Optional[tuple]:
+        growable.sort(key=lambda g: (-g["priority"], g["workers"],
+                                     g["key"]))
+        for gang in growable:
+            per_w = gang["per_worker_chips"]
+            room = snap["free_chips"] // per_w
+            if room < 1:
+                continue
+            want = min(gang["max_workers"],
+                       gang["workers"] + room)
+            for target in dict.fromkeys((want, gang["workers"] + 1)):
+                if target <= gang["workers"]:
+                    continue
+                verdict = self._priced(gang, target)
+                if verdict is None:
+                    continue
+                ok, msg = self.sched.request_resize(
+                    gang["namespace"], gang["name"], target,
+                    deadline=self.resize_deadline, reason=verdict)
+                if ok:
+                    transition = (DIRECTION_GROW, gang["key"],
+                                  gang["workers"], target, verdict)
+                    self.transitions.append(transition)
+                    return transition
+        return None
+
+    def _priced(self, gang, target: int) -> Optional[str]:
+        """Cost-model gate: accept the grow only when the predicted
+        step time of the grown placement beats the current one."""
+        per_w = gang["per_worker_chips"]
+        delta_chips = (target - gang["workers"]) * per_w
+        preview = self.sched.preview_grow(gang["key"], delta_chips)
+        if preview is None:
+            return None
+        cur_chips = max(1, gang["chips"])
+        new_chips = cur_chips + delta_chips
+        t_cur = self.work_us / cur_chips + preview["cost_us"]
+        t_new = self.work_us / new_chips + preview["grown_cost_us"]
+        if t_new >= t_cur:
+            flight.record("sched", "resize_grow_vetoed",
+                          job=gang["key"], target=target,
+                          step_us_current=round(t_cur, 1),
+                          step_us_grown=round(t_new, 1))
+            return None
+        return (f"grow: predicted step {t_cur:.0f}us ->"
+                f" {t_new:.0f}us at {new_chips} chips")
+
+    # -- lifecycle ---------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.evaluate_once()
+            except Exception:
+                logger.exception("train autoscaler poll failed")
+
+    def start(self) -> "TrainAutoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="train-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -72,6 +72,10 @@ MPIJOB_GV = constants.GROUP_VERSION
 EVICT_PREEMPTED = "preempted"
 EVICT_SPOT_RECLAIM = "spot_reclaim"
 EVICT_REQUEUED = "requeued"
+# A shrink whose drain window lapsed with departing workers still
+# running: the gang falls back to the full checkpoint-evict protocol
+# (docs/SCHEDULING.md "Elastic gangs").
+EVICT_RESIZE_FALLBACK = "resize_fallback"
 
 
 def new_sched_metrics(registry: Optional[Registry] = None) -> dict:
@@ -123,6 +127,23 @@ def new_sched_metrics(registry: Optional[Registry] = None) -> dict:
             "Predicted per-step collective cost (seconds, hierarchical"
             " schedule) of each admitted gang's placement under the"
             " ICI/DCN latency model"),
+        "resizes": registry.counter_vec(
+            "mpi_operator_sched_resizes_total",
+            "Elastic gang resizes by direction (grow/shrink) and"
+            " terminal outcome: completed, rejected, timeout (grow"
+            " rolled back), fallback_evict (shrink drain lapsed),"
+            " aborted (gang left mid-resize)",
+            ["direction", "outcome"]),
+        "resize_seconds": registry.histogram(
+            "mpi_operator_sched_resize_seconds",
+            "Accepted resize offer to settled new size (completed"
+            " resizes only)"),
+        "gang_workers": registry.gauge_vec(
+            "mpi_operator_sched_gang_workers",
+            "Per-admitted-gang worker count: kind=current is the"
+            " settled effective size, kind=target the in-flight resize"
+            " goal (equal when no resize is negotiating)",
+            ["job", "kind"]),
     }
 
 
@@ -131,11 +152,25 @@ def job_demand(job) -> Dict[str, int]:
     (all-or-nothing member count), chips come from the priority-ordered
     ``calPGMinResource`` sum of ``google.com/tpu`` requests.  A gang
     that declares no TPU resources counts one chip per member, so the
-    capacity model stays meaningful for plain-CPU jobs."""
+    capacity model stays meaningful for plain-CPU jobs.
+
+    Elastic gangs (docs/SCHEDULING.md "Elastic gangs") are charged for
+    their EFFECTIVE size, not the spec size: the settled gang-workers
+    annotation, or — while a resize is in flight — the larger of
+    settled and target (grow commits chips up-front, shrink holds them
+    until the drain completes)."""
+    from .elastic import demand_workers, per_worker_chips, spec_workers
     min_member = calculate_min_available(job)
     resources = cal_pg_min_resource(min_member, job) or {}
     chips = int(parse_quantity(resources.get(constants.TPU_RESOURCE, "0")))
-    if chips <= 0:
+    fallback = chips <= 0
+    declared = spec_workers(job)
+    effective = demand_workers(job)
+    if effective != declared:
+        min_member = max(1, min_member + (effective - declared))
+        if not fallback:
+            chips += (effective - declared) * per_worker_chips(job)
+    if fallback or chips <= 0:
         chips = min_member
     return {PODS_RESOURCE: min_member, constants.TPU_RESOURCE: chips}
 
@@ -156,7 +191,9 @@ class GangScheduler:
                  preemption: bool = True, checkpoint_grace: float = 1.0,
                  clock: Optional[Clock] = None, recorder=None,
                  registry: Optional[Registry] = None,
-                 tick: float = 0.1):
+                 tick: float = 0.1, elastic: bool = True,
+                 resize_deadline: float = 5.0):
+        from .elastic import ElasticResizer
         self.client = clientset
         self.pool = pool
         self.kubelet = kubelet
@@ -165,6 +202,11 @@ class GangScheduler:
         self.backfill = backfill
         self.preemption = preemption
         self.checkpoint_grace = checkpoint_grace
+        # Elastic resize (docs/SCHEDULING.md "Elastic gangs"):
+        # ``elastic=False`` is the frozen-gang-size baseline — every
+        # resize request rejects and preemption never shrinks.
+        self.elastic = elastic
+        self.resizer = ElasticResizer(self, resize_deadline)
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(clientset)
         self.metrics = new_sched_metrics(registry)
@@ -181,6 +223,9 @@ class GangScheduler:
         self._blocked: Optional[dict] = None  # {"key","epoch","reserved","chips"}
         self._epoch = 0
         self._invalid_warned: set = set()
+        # Elastic gangs currently carried by the per-gang size gauge
+        # (stale series are removed when the gang leaves).
+        self._gang_gauge_keys: set = set()
         # (key -> (resourceVersion, demand, valid)): validation +
         # demand math memoized per object version — the admission walk
         # re-examines every pending job after each admission, and
@@ -262,6 +307,116 @@ class GangScheduler:
         with self._lock:
             return self._blocked["reserved"] if self._blocked else 0
 
+    def admitted_chips(self) -> Dict[str, int]:
+        """Per-gang accounted chip holdings (the capacity-conservation
+        invariant cross-checks these against the pool's placements
+        through every resize transition)."""
+        with self._lock:
+            return {key: rec["chips"]
+                    for key, rec in self._admitted.items()}
+
+    def capacity_snapshot(self) -> dict:
+        """ATOMIC capacity view for conservation checks: per-gang
+        charged (demand-accounted) vs pool-held chips, plus the free
+        and total pool — read under the scheduler lock, which every
+        placement mutation (admission, release, resize grow/shrink)
+        also holds, so the numbers are mutually consistent even while
+        transitions are mid-flight (a lock-free multi-read would race
+        a committing resize into spurious drift)."""
+        with self._lock:
+            gangs = {}
+            for key, rec in self._admitted.items():
+                held = sum((self.pool.placement_of(key) or {}).values())
+                gangs[key] = {"charged": rec["chips"], "held": held}
+            return {"gangs": gangs,
+                    "free_chips": self.pool.free_chips,
+                    "total_chips": self.pool.total_chips}
+
+    # ------------------------------------------------------------------
+    # Elastic resize surface (sched/elastic.py, docs/SCHEDULING.md
+    # "Elastic gangs")
+    # ------------------------------------------------------------------
+    def request_resize(self, namespace: str, name: str, target: int,
+                       deadline: Optional[float] = None,
+                       reason: str = "requested") -> tuple:
+        """Negotiate an admitted elastic gang toward ``target`` workers
+        (grow grants idle aligned blocks; shrink opens a drain window
+        for the departing workers).  Returns ``(accepted, message)`` —
+        nothing is mutated on a rejection."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            rec = self._admitted.get(key)
+            if rec is None:
+                return False, "job is not admitted"
+            try:
+                job = self.client.mpi_jobs(namespace).get(name)
+            except Exception as exc:
+                if is_not_found(exc):
+                    return False, "job not found"
+                return False, f"api error: {exc}"
+            cqs, lqs = self._load_queues()
+            cq = self._cq_of(job, lqs, cqs)
+            if cq is None:
+                return False, "unknown LocalQueue/ClusterQueue"
+            accepted, msg = self.resizer.begin(
+                key, job, rec, cq, cqs, self._usage(), target,
+                deadline, reason)
+        if accepted:
+            self.kick()
+        return accepted, msg
+
+    def preview_grow(self, key: str, extra_chips: int) -> Optional[dict]:
+        """Side-effect-free grow pricing for the autoscaler: the
+        current vs grown predicted collective cost of the cheapest
+        append-only plan (None when it cannot fit)."""
+        return self.pool.plan_grow(key, extra_chips)
+
+    def elastic_snapshot(self) -> Optional[dict]:
+        """One consistent view for the TrainAutoscaler: every admitted
+        elastic gang's size/bounds, the free pool, the capacity-blocked
+        front (with its shortfall net of in-flight drains), and whether
+        any pending demand exists (grow must not starve the queue)."""
+        from .elastic import (elastic_bounds, per_worker_chips,
+                              settled_workers)
+        with self._lock:
+            try:
+                jobs = {self._key(j): j for j in self.client.server.list(
+                    MPIJOB_GV, constants.KIND, self.namespace)}
+            except TRANSPORT_ERRORS:
+                return None
+            gangs = []
+            for key, rec in sorted(self._admitted.items()):
+                job = jobs.get(key)
+                if job is None:
+                    continue
+                bounds = elastic_bounds(job)
+                if bounds is None:
+                    continue
+                gangs.append({
+                    "key": key, "namespace": job.metadata.namespace,
+                    "name": job.metadata.name,
+                    "workers": settled_workers(job),
+                    "min_workers": bounds[0], "max_workers": bounds[1],
+                    "per_worker_chips": per_worker_chips(job),
+                    "chips": rec["chips"],
+                    "priority": job_priority(job),
+                    "resizing": self.resizer.in_flight(key)})
+            blocked = None
+            if self._blocked is not None:
+                short = max(0, self._blocked["chips"]
+                            - self.pool.free_chips
+                            - self.resizer.pending_release_chips())
+                blocked = {"key": self._blocked["key"],
+                           "short_chips": short}
+            pending = any(
+                key not in self._admitted
+                and job_queue_name(job)
+                and not is_finished(job.status)
+                and not job.spec.run_policy.suspend
+                for key, job in jobs.items())
+            return {"gangs": gangs, "free_chips": self.pool.free_chips,
+                    "blocked": blocked, "pending_jobs": pending}
+
     # ------------------------------------------------------------------
     # Spot reclamation (chaos surface)
     # ------------------------------------------------------------------
@@ -306,7 +461,12 @@ class GangScheduler:
             self._release_departed(jobs)
             self._finish_due_evictions(jobs)
             self._adopt_admitted(jobs, lqs, cqs)
+            self.resizer.adopt(jobs)
             self._sweep_partial_gangs(jobs)
+            # Progress in-flight resizes BEFORE the admission walk so
+            # chips a completed drain just freed are placeable in the
+            # same pass.
+            self.resizer.tick(jobs)
             admissions = self._admission_passes(jobs, lqs, cqs)
             self._maybe_preempt(jobs, lqs, cqs)
             self._publish(jobs, lqs, cqs)
@@ -463,6 +623,7 @@ class GangScheduler:
         rec = self._admitted.pop(key, None)
         if rec is None:
             return
+        self.resizer.on_release(key)
         freed = self.pool.release(key)
         blocked = self._blocked
         if blocked is not None:
@@ -994,6 +1155,10 @@ class GangScheduler:
         pending_free = sum(self.pool.online_chips_of(k)
                            for k in self._preempting
                            if k in self._admitted)
+        # In-flight shrink drains release their delta when they settle:
+        # count them as pending-free too, or every pass during a drain
+        # would select a fresh victim set on top of the shrink.
+        pending_free += self.resizer.pending_release_chips()
         hypo_usage = {name: dict(used) for name, used in usage.items()}
         for key in self._preempting:
             rec = self._admitted.get(key)
@@ -1001,6 +1166,10 @@ class GangScheduler:
                 continue
             bucket = hypo_usage.setdefault(rec["cq"], {})
             for res, amount in rec["demand"].items():
+                bucket[res] = bucket.get(res, 0.0) - amount
+        for cq_name, delta in self.resizer.pending_release_demands():
+            bucket = hypo_usage.setdefault(cq_name, {})
+            for res, amount in delta.items():
                 bucket[res] = bucket.get(res, 0.0) - amount
         if chips <= self.pool.free_chips + pending_free \
                 and self._quota_allows(cq, demand, cqs, hypo_usage):
@@ -1013,7 +1182,7 @@ class GangScheduler:
         cohort = cq.spec.cohort
         candidates = []
         for key, rec in self._admitted.items():
-            if key in self._preempting:
+            if key in self._preempting or self.resizer.in_flight(key):
                 continue
             victim_cq = cqs.get(rec["cq"])
             if victim_cq is None:
@@ -1030,22 +1199,73 @@ class GangScheduler:
                 continue
             candidates.append((victim_priority, -rec["epoch"], key, rec))
         candidates.sort(key=lambda c: c[:3])
-        freed = pending_free
-        victims = []
-        for _, _, key, rec in candidates:
-            if chips <= self.pool.free_chips + freed \
-                    and self._quota_allows(cq, demand, cqs, hypo_usage):
-                break
-            victims.append(key)
-            freed += rec["chips"]
-            bucket = hypo_usage.setdefault(rec["cq"], {})
-            for res, amount in rec["demand"].items():
-                bucket[res] = bucket.get(res, 0.0) - amount
-        if chips > self.pool.free_chips + freed \
-                or not self._quota_allows(cq, demand, cqs, hypo_usage):
+        from .elastic import (elastic_bounds, per_worker_chips,
+                              settled_workers)
+
+        def plan_victims(allow_shrink: bool):
+            """One victim-selection pass; returns (feasible, victims,
+            shrinks, hypo).  Shrink-instead-of-evict (docs/SCHEDULING.md
+            "Elastic gangs"): an elastic victim gives up just enough
+            workers to cover the remaining shortfall — its training
+            continues from the SAME step on the surviving members
+            instead of paying checkpoint rewind + re-admission."""
+            hypo = {name: dict(used) for name, used in hypo_usage.items()}
+            freed = pending_free
+            victims, shrinks = [], []
+            for _, _, key, rec in candidates:
+                if chips <= self.pool.free_chips + freed \
+                        and self._quota_allows(cq, demand, cqs, hypo):
+                    break
+                victim_job = jobs[key]
+                bounds = elastic_bounds(victim_job) if allow_shrink \
+                    else None
+                if bounds is not None:
+                    current = settled_workers(victim_job)
+                    per_w = per_worker_chips(victim_job)
+                    headroom = current - bounds[0]
+                    short = max(0, chips - self.pool.free_chips - freed)
+                    if headroom > 0 and short > 0:
+                        shrink_w = min(headroom,
+                                       max(1, -(-short // per_w)))
+                        target = current - shrink_w
+                        shrinks.append((key, rec, cqs.get(rec["cq"]),
+                                        target))
+                        freed += shrink_w * per_w
+                        bucket = hypo.setdefault(rec["cq"], {})
+                        bucket[PODS_RESOURCE] = \
+                            bucket.get(PODS_RESOURCE, 0.0) - shrink_w
+                        bucket[constants.TPU_RESOURCE] = bucket.get(
+                            constants.TPU_RESOURCE, 0.0) \
+                            - shrink_w * per_w
+                        continue
+                victims.append(key)
+                freed += rec["chips"]
+                bucket = hypo.setdefault(rec["cq"], {})
+                for res, amount in rec["demand"].items():
+                    bucket[res] = bucket.get(res, 0.0) - amount
+            feasible = chips <= self.pool.free_chips + freed \
+                and self._quota_allows(cq, demand, cqs, hypo)
+            return feasible, victims, shrinks
+
+        feasible, victims, shrinks = plan_victims(
+            allow_shrink=self.elastic)
+        if not feasible and shrinks:
+            # Shrink headroom alone cannot cover the claim: fall back
+            # to full evictions (elastic victims included) — a
+            # higher-priority front must never starve behind a
+            # lower-priority gang just because that gang is elastic.
+            feasible, victims, shrinks = plan_victims(allow_shrink=False)
+        if not feasible:
             # Even evicting every candidate would not fit: this claim
             # is unservable — let the next-ranked candidate try.
             return False
+        usage_now = self._usage()
+        for key, rec, victim_cq, target in shrinks:
+            if victim_cq is None:
+                continue
+            self.resizer.begin(
+                key, jobs[key], rec, victim_cq, cqs, usage_now, target,
+                None, trigger=f"preempted-by {self._key(front)}")
         for key in victims:
             self._begin_eviction(
                 key, EVICT_PREEMPTED,
@@ -1154,6 +1374,18 @@ class GangScheduler:
                 annotations.pop(constants.SCHED_PLACEMENT_ANNOTATION, None)
                 annotations.pop(constants.SCHED_COST_ANNOTATION, None)
                 annotations.pop(constants.SCHED_BACKFILL_ANNOTATION, None)
+                # Un-admission resets the elastic protocol: a requeued
+                # gang re-enters at its SPEC size (the learned size died
+                # with the placement; docs/SCHEDULING.md "Elastic
+                # gangs"), and no in-flight resize survives eviction.
+                annotations.pop(constants.SCHED_GANG_WORKERS_ANNOTATION,
+                                None)
+                annotations.pop(constants.SCHED_RESIZE_TARGET_ANNOTATION,
+                                None)
+                annotations.pop(constants.SCHED_RESIZE_STATE_ANNOTATION,
+                                None)
+                annotations.pop(
+                    constants.SCHED_RESIZE_DEADLINE_ANNOTATION, None)
             meta_changed = annotations != (job.metadata.annotations or {})
             if not changed and not meta_changed:
                 return
@@ -1215,6 +1447,7 @@ class GangScheduler:
                 pending_lq[lq_key] = pending_lq.get(lq_key, 0) + 1
         self.metrics["free_chips"].set(self.pool.free_chips)
         self.metrics["fragmentation"].set(self.pool.fragmentation())
+        self._publish_gang_sizes(jobs)
         for name, cq in cqs.items():
             self.metrics["pending"].labels(name).set(
                 pending_cq.get(name, 0))
@@ -1228,6 +1461,28 @@ class GangScheduler:
         for (ns, name), lq in lqs.items():
             self._update_lq_status(lq, pending_lq.get((ns, name), 0),
                                    admitted_lq.get((ns, name), 0))
+
+    def _publish_gang_sizes(self, jobs) -> None:
+        """Per-gang current-vs-target worker gauge for admitted
+        elastic gangs; series are removed when the gang leaves so the
+        exposition never accumulates dead jobs."""
+        from .elastic import elastic_bounds, resize_target, settled_workers
+        gauge = self.metrics.get("gang_workers")
+        if gauge is None:
+            return
+        live: set = set()
+        for key in self._admitted:
+            job = jobs.get(key)
+            if job is None or elastic_bounds(job) is None:
+                continue
+            live.add(key)
+            current = settled_workers(job)
+            gauge.labels(key, "current").set(current)
+            gauge.labels(key, "target").set(resize_target(job) or current)
+        for stale in self._gang_gauge_keys - live:
+            gauge.remove(stale, "current")
+            gauge.remove(stale, "target")
+        self._gang_gauge_keys = live
 
     def _update_cq_status(self, cq, used: Dict[str, float],
                           pending: int, admitted: int) -> None:

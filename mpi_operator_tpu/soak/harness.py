@@ -27,6 +27,7 @@ docs/RESILIENCE.md "Macro-soak & crash recovery".
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -166,9 +167,13 @@ class _JobMonitor:
         # the restart (checkpoint rewind + re-form).
         running = conds.get(constants.JOB_RUNNING) == true
         if running:
-            from ..api.types import worker_replicas
+            from ..sched.elastic import controller_workers
             try:
-                desired = worker_replicas(job) or 0
+                # The EFFECTIVE size (elastic resize): a settled shrink
+                # lowers the bar, an in-flight grow raises it — a
+                # resized-down gang running all its surviving workers
+                # is productive, not degraded.
+                desired = controller_workers(job)
             except Exception:
                 desired = 0
             ws = job.status.replica_statuses.get(
@@ -300,18 +305,85 @@ def _sleep_container(name: str, seconds: float) -> Container:
                               f"import time; time.sleep({seconds})"])
 
 
+# Resize-aware soak worker: "trains" (bumps a checkpoint-persisted
+# step counter when SOAK_STEP_DIR is set — a restarted pod RESUMES
+# from the persisted step, the checkpoint-recovery model) and honors
+# the elastic drain contract — on a resize notice naming a target
+# below its own index it exits 0 (its shards are "drained"; the real
+# protocol is proven numerically in
+# parallel/train.reshard_train_state).  Without the notice handling
+# the gang_resize injector's shrinks would always miss the drain
+# deadline and fall back to eviction; the step counter feeds the
+# resizer's step probe so ``resize_never_loses_a_step`` checks REAL
+# watermarks in the soak, not Nones.
+_ELASTIC_WORKER = (
+    "import os, sys, time\n"
+    "deadline = time.time() + {seconds}\n"
+    "notice = os.environ.get('K_RESIZE_NOTICE_FILE')\n"
+    "pod = os.environ.get('K_POD_NAME', '')\n"
+    "step_dir = os.environ.get('SOAK_STEP_DIR')\n"
+    "step_file = os.path.join(step_dir, 'step-' + pod) \\\n"
+    "    if step_dir else None\n"
+    "try:\n"
+    "    idx = int(pod.rsplit('-', 1)[-1])\n"
+    "except ValueError:\n"
+    "    idx = -1\n"
+    "step = 0\n"
+    "if step_file and os.path.exists(step_file):\n"
+    "    try:\n"
+    "        step = int(open(step_file).read().strip() or 0)\n"
+    "    except (OSError, ValueError):\n"
+    "        step = 0\n"
+    "while time.time() < deadline:\n"
+    "    step += 1\n"
+    "    if step_file:\n"
+    "        with open(step_file + '.tmp', 'w') as f:\n"
+    "            f.write(str(step))\n"
+    "        os.replace(step_file + '.tmp', step_file)\n"
+    "    if notice and idx >= 0 and os.path.exists(notice):\n"
+    "        try:\n"
+    "            target = int(open(notice).read().split()[0])\n"
+    "        except (OSError, ValueError, IndexError):\n"
+    "            target = None\n"
+    "        if target is not None and idx >= target:\n"
+    "            sys.exit(0)\n"
+    "    time.sleep(0.05)\n")
+
+
+def _elastic_worker_container(name: str, seconds: float,
+                              step_dir: Optional[str]) -> Container:
+    import sys
+    from ..k8s.core import EnvVar
+    env = [EnvVar("SOAK_STEP_DIR", step_dir)] if step_dir else []
+    return Container(name=name, image="local",
+                     command=[sys.executable, "-c",
+                              _ELASTIC_WORKER.format(seconds=seconds)],
+                     env=env)
+
+
 def gang_job(name: str, workers: int, queue: str, run_seconds: float,
-             priority: int = 0) -> MPIJob:
+             priority: int = 0, elastic: bool = True,
+             step_dir: Optional[str] = None) -> MPIJob:
     """A long-running training gang admitted through ``queue``:
     restartPolicy ExitCode so chaos kills trigger gang restarts (slice
     repair) instead of failing the job, with a backoff budget sized for
-    a chaos soak."""
+    a chaos soak.  ``elastic`` (default) opts the gang into the resize
+    protocol (bounds 1..workers+2) with drain-aware workers, so the
+    full profile's ``gang_resize`` faults negotiate real transitions;
+    ``step_dir`` arms the workers' persisted step counters (the
+    resize-continuity watermark source)."""
+    annotations = {constants.SCHED_PRIORITY_ANNOTATION: str(priority)}
+    if elastic:
+        annotations[constants.ELASTIC_ANNOTATION] = f"1-{workers + 2}"
+        worker_container = _elastic_worker_container(
+            "worker", run_seconds + 30, step_dir)
+    else:
+        worker_container = _sleep_container("worker", run_seconds + 30)
     return MPIJob(
         metadata=ObjectMeta(
             name=name, namespace="default",
             labels={constants.QUEUE_NAME_LABEL: queue},
-            annotations={constants.SCHED_PRIORITY_ANNOTATION:
-                         str(priority)}),
+            annotations=annotations),
         spec=MPIJobSpec(
             mpi_implementation=constants.IMPL_JAX,
             run_policy=RunPolicy(backoff_limit=100,
@@ -324,8 +396,7 @@ def gang_job(name: str, workers: int, queue: str, run_seconds: float,
                     replicas=workers,
                     restart_policy=constants.RESTART_POLICY_EXIT_CODE,
                     template=PodTemplateSpec(spec=PodSpec(containers=[
-                        _sleep_container("worker",
-                                         run_seconds + 30)]))),
+                        worker_container]))),
             }))
 
 
@@ -386,6 +457,7 @@ class SoakHarness:
                                      client=self.client, policy="prefix")
         self.monitor = _JobMonitor(self.client, self.soak_metrics)
         self._recoveries: List[tuple] = []  # (component, seconds)
+        self._resize_log_archive: List[dict] = []
         self._started = False
         # Causal-trace scoring: the tracer's ring is bounded (65536)
         # and a long soak wraps it — scoring from tracer.events() at
@@ -455,8 +527,14 @@ class SoakHarness:
         return ctrl
 
     def crash_scheduler(self) -> bool:
+        scheduler = self.cluster.scheduler
         crashed = self.cluster.crash_scheduler()
         if crashed:
+            # The resizer's terminal log dies with the scheduler
+            # process; archive it so the resize SLO scores the WHOLE
+            # run, not just the last incarnation.
+            if scheduler is not None:
+                self._resize_log_archive.extend(scheduler.resizer.log)
             flight.record("sched", "crash", component="scheduler")
         return crashed
 
@@ -467,6 +545,9 @@ class SoakHarness:
         sched = self.cluster.respawn_scheduler()
         if sched is None:
             return None
+        # The fresh resizer needs the step probe back (the old one
+        # died with the crashed scheduler).
+        self._register_step_probe(sched)
         # Recovered = every Admitted=True job re-adopted (admitted-set,
         # quota usage and slice placements rebuilt from the apiserver).
         deadline = time.monotonic() + 15.0
@@ -538,17 +619,39 @@ class SoakHarness:
                 metadata=ObjectMeta(name=lq_name, namespace="default"),
                 spec=LocalQueueSpec(cluster_queue=cq_name)))
 
+    def _register_step_probe(self, scheduler) -> None:
+        """Wire the resizer's step probe to the gangs' persisted step
+        counters (worker-0 is the watermark), so the
+        ``resize_never_loses_a_step`` invariant checks REAL continuity
+        in the soak.  Re-registered after every scheduler respawn."""
+        step_dir = self._step_dir
+
+        def probe(key: str):
+            name = key.split("/", 1)[-1]
+            try:
+                with open(os.path.join(
+                        step_dir, f"step-{name}-worker-0")) as f:
+                    return int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                return None
+
+        scheduler.resizer.step_probe = probe
+
     def start(self) -> "SoakHarness":
+        import tempfile
         from ..telemetry.trace import default_tracer
         default_tracer().add_listener(self._span_listener)
         self.cluster.start()
+        self._step_dir = tempfile.mkdtemp(prefix="soak-steps-")
+        if self.cluster.scheduler is not None:
+            self._register_step_probe(self.cluster.scheduler)
         self._create_queues()
         self.monitor.start()
         run_seconds = self.config.duration + self.config.converge_timeout
         for i in range(self.config.gangs):
             self.cluster.submit(gang_job(
                 f"{GANG_PREFIX}{i}", self.config.gang_workers, "q-gang",
-                run_seconds))
+                run_seconds, step_dir=self._step_dir))
         self.fleet.start()
         self.fleet.wait_ready(self.config.serve_replicas, timeout=120)
         self._started = True
@@ -568,6 +671,9 @@ class SoakHarness:
         if self._owned_wal_dir is not None:
             import shutil
             shutil.rmtree(self._owned_wal_dir, ignore_errors=True)
+        if getattr(self, "_step_dir", None):
+            import shutil
+            shutil.rmtree(self._step_dir, ignore_errors=True)
         self._started = False
 
     def __enter__(self) -> "SoakHarness":
@@ -601,6 +707,16 @@ class SoakHarness:
                              * self.config.duration, 3),
                     kind=kind,
                     duration=round(rng.uniform(0.4, 1.5), 3)))
+        # Elastic resize rides the same contract (ISSUE 15): the resize
+        # SLO (resize_p99_s) needs at least one negotiated transition
+        # per soak, so guarantee a gang_resize when the draw produced
+        # none.
+        if "gang_resize" not in kinds:
+            plan.faults.append(Fault(
+                at=round(rng.uniform(0.3, 0.9) * self.config.duration,
+                         3),
+                kind="gang_resize",
+                params={"deadline": round(rng.uniform(1.5, 3.0), 3)}))
         return plan
 
     def _converged(self) -> bool:
@@ -702,6 +818,14 @@ class SoakHarness:
                        and ev.get("result") == "crashed")
 
         trace_ttfs, trace_ttft, trace_segments = self._trace_slos()
+        resize_log = list(self._resize_log_archive)
+        if self.scheduler is not None:
+            resize_log += list(self.scheduler.resizer.log)
+        resized = [r for r in resize_log if r["outcome"] == "completed"]
+        resize_outcomes: Dict[str, int] = {}
+        for r in resize_log:
+            resize_outcomes[r["outcome"]] = \
+                resize_outcomes.get(r["outcome"], 0) + 1
         card = SloScorecard(
             train_goodput_pct=goodput_pct(productive, disrupted),
             serve_ttft_p50_s=quantile(ttfts, 0.50),
@@ -724,6 +848,9 @@ class SoakHarness:
             apiserver_recovery_p99_s=quantile(
                 [s for c, s in self._recoveries if c == "apiserver"],
                 0.99),
+            resizes=len(resized),
+            resize_p99_s=quantile([r["seconds"] for r in resized],
+                                  0.99),
             converged=report.converged,
             detail={
                 "trace_segments": trace_segments,
@@ -743,6 +870,7 @@ class SoakHarness:
                     router_tm["retries_total"].value),
                 "recoveries_s": [(c, round(s, 3))
                                  for c, s in self._recoveries],
+                "resizes_by_outcome": resize_outcomes,
                 "chaos_violations": list(report.violations),
             })
         self._publish(card)
@@ -765,6 +893,7 @@ class SoakHarness:
             "ttfs_p99_s": card.ttfs_p99_s,
             "traced_ttft_p99_s": card.traced_ttft_p99_s,
             "apiserver_recovery_p99_s": card.apiserver_recovery_p99_s,
+            "resize_p99_s": card.resize_p99_s,
             "requests_lost": card.requests_lost,
             "invariant_violations": card.invariant_violations,
         }

@@ -117,6 +117,12 @@ class SloScorecard:
     # control plane's recovery SLO, docs/RESILIENCE.md "Durable
     # apiserver"); None when the plan applied no apiserver_restart.
     apiserver_recovery_p99_s: Optional[float] = None
+    # Elastic gang resize (ISSUE 15, docs/SCHEDULING.md "Elastic
+    # gangs"): COMPLETED negotiated transitions and their offer ->
+    # settled latency; None when no resize completed (the full
+    # profile's harness guarantees at least one gang_resize fault).
+    resizes: int = 0
+    resize_p99_s: Optional[float] = None
     converged: bool = True
     # Free-form context the bench attaches (windows, per-gang detail).
     detail: Dict[str, object] = field(default_factory=dict)
@@ -187,6 +193,8 @@ class SloScorecard:
             "recoveries": self.recoveries,
             "recovery_p99_s": r(self.recovery_p99_s),
             "apiserver_recovery_p99_s": r(self.apiserver_recovery_p99_s),
+            "resizes": self.resizes,
+            "resize_p99_s": r(self.resize_p99_s),
             "converged": self.converged,
             "ok": self.ok,
             "violations": self.violations(),

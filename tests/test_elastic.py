@@ -1,0 +1,918 @@
+"""Elastic gang resize subsystem tests (mpi_operator_tpu/sched/elastic.py,
+docs/SCHEDULING.md "Elastic gangs"): the annotation size contract, the
+append-only/suffix-release pool extensions, the negotiation protocol
+state machine (offer/complete/timeout/fallback-to-evict), capacity +
+quota conservation through seeded grow/shrink storms, scheduler-restart
+mid-resize recovery, the goodput-aware autoscaler with its cost-model
+veto, preemption-shrink, the chaos injector, and the live ZeRO
+re-shard's numerical equivalence."""
+
+import time
+import types
+
+import pytest
+
+from mpi_operator_tpu.api import constants
+from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec, ReplicaSpec,
+                                        RunPolicy)
+from mpi_operator_tpu.chaos.invariants import (resize_never_loses_a_step,
+                                               sched_capacity_conserved)
+from mpi_operator_tpu.controller.status import get_condition
+from mpi_operator_tpu.k8s.apiserver import Clientset
+from mpi_operator_tpu.k8s.core import (Container, Pod, PodSpec,
+                                       PodTemplateSpec,
+                                       ResourceRequirements)
+from mpi_operator_tpu.k8s.meta import ObjectMeta
+from mpi_operator_tpu.sched import (ClusterQueue, GangScheduler, LocalQueue,
+                                    SlicePool, TpuSlice, job_demand)
+from mpi_operator_tpu.sched import elastic as el
+from mpi_operator_tpu.sched.topology import chip_of_index
+
+
+def mk_job(name, workers, queue="q", prio=None, elastic=None,
+           tpu_per_worker=None, namespace="default", annotations=None):
+    meta = ObjectMeta(name=name, namespace=namespace)
+    if queue:
+        meta.labels = {constants.QUEUE_NAME_LABEL: queue}
+    meta.annotations = dict(annotations or {})
+    if prio is not None:
+        meta.annotations[constants.SCHED_PRIORITY_ANNOTATION] = str(prio)
+    if elastic is not None:
+        meta.annotations[constants.ELASTIC_ANNOTATION] = elastic
+    worker_container = Container(name="w", image="img")
+    if tpu_per_worker is not None:
+        worker_container.resources = ResourceRequirements(
+            requests={constants.TPU_RESOURCE: str(tpu_per_worker)})
+    return MPIJob(metadata=meta, spec=MPIJobSpec(
+        slots_per_worker=1, ssh_auth_mount_path="/root/.ssh",
+        mpi_implementation=constants.IMPL_JAX,
+        run_policy=RunPolicy(clean_pod_policy="None"),
+        mpi_replica_specs={
+            constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                replicas=1, restart_policy="OnFailure",
+                template=PodTemplateSpec(spec=PodSpec(
+                    containers=[Container(name="l", image="img")]))),
+            constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                replicas=workers, restart_policy="Never",
+                template=PodTemplateSpec(spec=PodSpec(
+                    containers=[worker_container]))),
+        }))
+
+
+def mk_queues(cs, quotas=None, cq_name="cq", lq_name="q",
+              namespace="default", cohort="pool", preemption=True):
+    cq = ClusterQueue()
+    cq.metadata.name = cq_name
+    cq.spec.quotas = dict(quotas or {})
+    cq.spec.cohort = cohort
+    cq.spec.preemption = preemption
+    cs.cluster_queues(namespace).create(cq)
+    lq = LocalQueue()
+    lq.metadata.name = lq_name
+    lq.metadata.namespace = namespace
+    lq.spec.cluster_queue = cq_name
+    cs.local_queues(namespace).create(lq)
+
+
+class Stack:
+    """Reconcile-driven scheduler stack (no threads, no controller):
+    worker pods are fabricated on demand to play the controller's
+    actuation role, so protocol transitions are stepped deterministically
+    through reconcile_once()."""
+
+    def __init__(self, slices=None, quotas=None, **sched_kw):
+        self.client = Clientset()
+        self.pool = SlicePool(slices or [TpuSlice("s0", 16)])
+        self.sched = GangScheduler(self.client, self.pool,
+                                   tick=0.01, **sched_kw)
+        mk_queues(self.client, quotas=quotas)
+        # LocalCluster-ish shape for invariants.
+        self.kubelet = None
+        self.controller = None
+        self.scheduler = self.sched
+
+    def submit(self, job):
+        self.client.mpi_jobs("default").create(job)
+        self.sched.reconcile_once()
+        return job.metadata.name
+
+    def job(self, name):
+        return self.client.mpi_jobs("default").get(name)
+
+    def annotations(self, name):
+        return dict(self.job(name).metadata.annotations or {})
+
+    def make_worker_pods(self, name, count, phase="Running"):
+        """Fabricate the controller's actuation: worker pods 0..count-1
+        exist (extra indices deleted)."""
+        from mpi_operator_tpu.controller import builders
+        existing = {p.metadata.name: p
+                    for p in self.client.server.list("v1", "Pod",
+                                                     "default")
+                    if p.metadata.name.startswith(f"{name}-worker-")}
+        want = {f"{name}-worker-{i}" for i in range(count)}
+        for pod_name in sorted(set(existing) - want):
+            self.client.pods("default").delete(pod_name)
+        job = self.job(name)
+        for i in range(count):
+            pod_name = f"{name}-worker-{i}"
+            if pod_name in existing:
+                continue
+            pod = Pod(metadata=ObjectMeta(
+                name=pod_name, namespace="default",
+                labels=dict(builders.worker_selector(name),
+                            **{constants.REPLICA_INDEX_LABEL: str(i)})),
+                spec=PodSpec(containers=[Container(name="w",
+                                                   image="img")]))
+            created = self.client.pods("default").create(pod)
+            created.status.phase = phase
+            self.client.pods("default").update_status(created)
+        return job
+
+
+def admitted(stack, name):
+    cond = get_condition(stack.job(name).status, constants.JOB_ADMITTED)
+    return cond is not None and cond.status == "True"
+
+
+# ---------------------------------------------------------------------------
+# Size-contract helpers
+# ---------------------------------------------------------------------------
+
+def test_elastic_bounds_parse_and_guards():
+    assert el.elastic_bounds(mk_job("a", 2, elastic="2-8")) == (2, 8)
+    for bad in ("", "8", "0-4", "5-3", "x-4", "2-y"):
+        assert el.elastic_bounds(mk_job("a", 2, elastic=bad)) is None
+    assert el.elastic_bounds(mk_job("a", 2)) is None
+    # An explicit schedulingPolicy.minAvailable opts OUT: the demand
+    # math scales the default workers+1 contract only.
+    from mpi_operator_tpu.api.types import SchedulingPolicy
+    job = mk_job("a", 2, elastic="1-4")
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+        min_available=2)
+    assert el.elastic_bounds(job) is None
+
+
+def test_size_helpers_through_protocol_states():
+    job = mk_job("a", 3, elastic="2-8")
+    assert el.settled_workers(job) == 3
+    assert el.controller_workers(job) == 3
+    assert el.demand_workers(job) == 3
+    # Growing: controller actuates the target, demand covers it.
+    job.metadata.annotations.update({
+        constants.SCHED_RESIZE_TARGET_ANNOTATION: "5",
+        constants.SCHED_RESIZE_STATE_ANNOTATION:
+            constants.RESIZE_STATE_GROWING})
+    assert el.controller_workers(job) == 5
+    assert el.demand_workers(job) == 5
+    assert el.max_workers_seen(job) == 5
+    # Draining: controller HOLDS the old size (drain window), demand
+    # still covers the held chips.
+    job.metadata.annotations[constants.SCHED_RESIZE_STATE_ANNOTATION] = \
+        constants.RESIZE_STATE_DRAINING
+    job.metadata.annotations[constants.SCHED_RESIZE_TARGET_ANNOTATION] = "2"
+    assert el.controller_workers(job) == 3
+    assert el.demand_workers(job) == 3
+    # Settled shrink.
+    job.metadata.annotations.pop(constants.SCHED_RESIZE_STATE_ANNOTATION)
+    job.metadata.annotations.pop(constants.SCHED_RESIZE_TARGET_ANNOTATION)
+    job.metadata.annotations[
+        constants.SCHED_GANG_WORKERS_ANNOTATION] = "2"
+    assert el.settled_workers(job) == 2
+    assert el.controller_workers(job) == 2
+    assert el.max_workers_seen(job) == 3  # spec still saw 3
+    # Malformed settled size falls back to spec.
+    job.metadata.annotations[
+        constants.SCHED_GANG_WORKERS_ANNOTATION] = "bogus"
+    assert el.settled_workers(job) == 3
+
+
+def test_elastic_demand_scales_with_effective_size():
+    plain = mk_job("a", 3, elastic="2-8")
+    base = job_demand(plain)
+    assert base == {"pods": 4, constants.TPU_RESOURCE: 4}
+    grown = mk_job("b", 3, elastic="2-8", annotations={
+        constants.SCHED_GANG_WORKERS_ANNOTATION: "5"})
+    assert job_demand(grown) == {"pods": 6, constants.TPU_RESOURCE: 6}
+    # Declared per-worker chips scale by the worker delta only.
+    chippy = mk_job("c", 3, elastic="2-8", tpu_per_worker=2,
+                    annotations={
+                        constants.SCHED_GANG_WORKERS_ANNOTATION: "5"})
+    assert job_demand(chippy)[constants.TPU_RESOURCE] == 10
+    assert el.per_worker_chips(chippy) == 2
+
+
+# ---------------------------------------------------------------------------
+# SlicePool: append-only grow, canonical-suffix shrink
+# ---------------------------------------------------------------------------
+
+def test_pool_grow_preserves_survivor_chip_order():
+    pool = SlicePool([TpuSlice("s0", 16)])
+    pool.place("j", 4)
+    before = [chip_of_index(pool.placement_blocks("j"), i)
+              for i in range(4)]
+    assert pool.grow("j", 4) == {"s0": 4}
+    blocks = pool.placement_blocks("j")
+    after = [chip_of_index(blocks, i) for i in range(8)]
+    # The existing 4 chips are a strict prefix: survivors never move.
+    assert after[:4] == before
+    assert pool.placement_of("j") == {"s0": 8}
+    assert pool.free_chips == 8
+
+
+def test_pool_grow_is_all_or_nothing_and_tail_slice_only():
+    pool = SlicePool([TpuSlice("a", 8), TpuSlice("b", 8)])
+    pool.place("j", 6)  # lands on one slice (most-free tie -> 'a')
+    placed_on = sorted(pool.placement_of("j"))
+    assert placed_on == ["a"]
+    free_before = pool.free_chips
+    # 12 chips can never fit: nothing may be claimed.
+    assert pool.grow("j", 12) is None
+    assert pool.free_chips == free_before
+    # A gang holding the canonically-LAST slice can only grow onto it
+    # or later-named slices: growth that would insert earlier-named
+    # chips (shifting every survivor's canonical rank) is refused even
+    # when the chips are free.
+    pool2 = SlicePool([TpuSlice("a", 8), TpuSlice("b", 8)])
+    pool2.place("x", 6)          # fills most of 'a'
+    pool2.place("j2", 8)         # forced onto 'b' entirely
+    assert sorted(pool2.placement_of("j2")) == ["b"]
+    assert pool2.grow("j2", 2) is None  # only 'a' has room: refused
+    assert pool2.placement_of("j2") == {"b": 8}
+
+
+def test_pool_shrink_releases_canonical_suffix_with_block_split():
+    pool = SlicePool([TpuSlice("s0", 16)])
+    pool.place("j", 8)
+    before = [chip_of_index(pool.placement_blocks("j"), i)
+              for i in range(8)]
+    freed = pool.shrink_to_prefix("j", 5)  # splits the 8-chip holding
+    assert freed == 3
+    blocks = pool.placement_blocks("j")
+    after = [chip_of_index(blocks, i) for i in range(5)]
+    assert after == before[:5]
+    assert sum(pool.placement_of("j").values()) == 5
+    assert pool.free_chips == 11
+    # Freed coordinates are genuinely reusable.
+    assert pool.place("k", 11) is not None
+    # Degenerate edges.
+    assert pool.shrink_to_prefix("j", 5) == 0      # no-op at size
+    assert pool.shrink_to_prefix("j", 99) is None  # beyond holding
+    assert pool.shrink_to_prefix("missing", 1) is None
+
+
+def test_pool_plan_grow_is_pure_and_priced():
+    pool = SlicePool([TpuSlice("s0", 16)])
+    pool.place("j", 4)
+    free = pool.free_chips
+    preview = pool.plan_grow("j", 4)
+    assert preview is not None
+    assert preview["grown_cost_us"] >= preview["cost_us"] >= 0
+    assert pool.free_chips == free  # nothing committed
+    assert sum(b.chips for bs in preview["added"].values()
+               for b in bs) == 4
+
+
+# ---------------------------------------------------------------------------
+# The negotiation protocol state machine
+# ---------------------------------------------------------------------------
+
+def test_grow_protocol_offer_actuate_complete():
+    st = Stack()
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    assert admitted(st, "ej")
+    assert st.sched.admitted_chips()["default/ej"] == 4
+
+    ok, msg = st.sched.request_resize("default", "ej", 6, deadline=30)
+    assert ok, msg
+    annos = st.annotations("ej")
+    assert annos[constants.SCHED_RESIZE_TARGET_ANNOTATION] == "6"
+    assert annos[constants.SCHED_RESIZE_STATE_ANNOTATION] == "growing"
+    # Chips committed up-front; demand covers the target.
+    assert st.sched.admitted_chips()["default/ej"] == 7
+    assert sum((st.pool.placement_of("default/ej") or {}).values()) == 7
+    # Controller-side view: actuate the target NOW.
+    assert el.controller_workers(st.job("ej")) == 6
+
+    # The controller "creates" the grown worker set -> completion.
+    st.make_worker_pods("ej", 6)
+    st.sched.reconcile_once()
+    annos = st.annotations("ej")
+    assert annos[constants.SCHED_GANG_WORKERS_ANNOTATION] == "6"
+    assert constants.SCHED_RESIZE_STATE_ANNOTATION not in annos
+    assert constants.SCHED_RESIZE_TARGET_ANNOTATION not in annos
+    assert not st.sched.resizer.in_flight("default/ej")
+    assert st.sched.metrics["resizes"].get("grow", "completed") == 1
+    assert st.sched.metrics["resize_seconds"].snapshot()["count"] == 1
+    rec = st.sched.resizer.log[-1]
+    assert rec["outcome"] == "completed" and rec["target"] == 6
+    # The slices/placement annotations track the grown holding.
+    assert annos[constants.SCHED_SLICES_ANNOTATION] == "s0:7"
+
+
+def test_grow_deadline_rolls_back():
+    st = Stack()
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    ok, _ = st.sched.request_resize("default", "ej", 6, deadline=0.0)
+    assert ok
+    assert st.sched.admitted_chips()["default/ej"] == 7
+    # Workers never materialize; the (already-lapsed) deadline rolls
+    # the granted chips back on the next pass.
+    st.sched.reconcile_once()
+    assert st.sched.admitted_chips()["default/ej"] == 4
+    assert sum((st.pool.placement_of("default/ej") or {}).values()) == 4
+    annos = st.annotations("ej")
+    assert constants.SCHED_RESIZE_STATE_ANNOTATION not in annos
+    assert constants.SCHED_GANG_WORKERS_ANNOTATION not in annos
+    assert st.sched.metrics["resizes"].get("grow", "timeout") == 1
+    assert sched_capacity_conserved(st) == []
+
+
+def test_shrink_protocol_drain_then_release():
+    st = Stack()
+    st.submit(mk_job("ej", 5, elastic="2-8"))
+    st.make_worker_pods("ej", 5)
+    assert st.sched.admitted_chips()["default/ej"] == 6
+    ok, msg = st.sched.request_resize("default", "ej", 2, deadline=30)
+    assert ok, msg
+    annos = st.annotations("ej")
+    assert annos[constants.SCHED_RESIZE_STATE_ANNOTATION] == "draining"
+    # During the drain the controller HOLDS the old size and the
+    # scheduler still charges the held chips.
+    assert el.controller_workers(st.job("ej")) == 5
+    assert st.sched.admitted_chips()["default/ej"] == 6
+    # Departing workers exit (kubelet-less stacks treat existing pods
+    # as drained); the next pass releases the canonical suffix.
+    st.sched.reconcile_once()
+    assert st.sched.admitted_chips()["default/ej"] == 3
+    assert sum((st.pool.placement_of("default/ej") or {}).values()) == 3
+    annos = st.annotations("ej")
+    assert annos[constants.SCHED_GANG_WORKERS_ANNOTATION] == "2"
+    assert constants.SCHED_RESIZE_STATE_ANNOTATION not in annos
+    assert st.sched.metrics["resizes"].get("shrink", "completed") == 1
+    assert sched_capacity_conserved(st) == []
+
+
+def test_shrink_deadline_falls_back_to_evict():
+    st = Stack()
+
+    class StubbornKubelet:
+        """Delivers notices but the departing workers never exit."""
+        def __init__(self):
+            self.notices = []
+
+        def inject_resize(self, namespace, name, target, deadline=5.0):
+            self.notices.append((name, target))
+            return True
+
+        def inject_preemption(self, namespace, name, grace=1.0):
+            return True
+
+    st.sched.kubelet = StubbornKubelet()
+    st.submit(mk_job("ej", 5, elastic="2-8"))
+    st.make_worker_pods("ej", 5, phase="Running")
+    ok, _ = st.sched.request_resize("default", "ej", 2, deadline=0.0)
+    assert ok
+    # Departing workers (indices 2..4) got the notice.
+    assert sorted(n for n, _ in st.sched.kubelet.notices) == \
+        ["ej-worker-2", "ej-worker-3", "ej-worker-4"]
+    assert all(t == 2 for _, t in st.sched.kubelet.notices)
+    # They keep Running past the (lapsed) deadline: fallback evict.
+    st.sched.reconcile_once()
+    assert st.sched.metrics["resizes"].get(
+        "shrink", "fallback_evict") == 1
+    assert not st.sched.resizer.in_flight("default/ej")
+    # The PR 9 protocol took over: grace window open, Admitted=False.
+    assert "default/ej" in st.sched._preempting
+    assert not admitted(st, "ej")
+    # The eviction completes after the grace window.
+    st.sched._preempting["default/ej"]["deadline"] = 0.0
+    st.sched.reconcile_once()
+    assert st.sched.metrics["evictions"].get("resize_fallback") == 1
+
+
+def test_resize_rejections():
+    st = Stack(quotas={constants.TPU_RESOURCE: "8"})
+    st.submit(mk_job("plain", 2))
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    cases = [
+        ("plain", 4, "not elastic"),
+        ("ej", 3, "already at"),
+        ("ej", 9, "outside bounds"),
+        ("ej", 1, "outside bounds"),
+        ("ej", 8, "quota"),  # 8 workers + launcher = 9 > quota 8
+    ]
+    for name, target, needle in cases:
+        ok, msg = st.sched.request_resize("default", name, target)
+        assert not ok and needle in msg, (name, target, msg)
+    ok, _ = st.sched.request_resize("default", "missing", 4)
+    assert not ok
+    # In-flight resize blocks a second offer (grow to 4 fits quota:
+    # ej 4 chips + plain 3 + 1 delta = 8).
+    ok, msg = st.sched.request_resize("default", "ej", 4, deadline=30)
+    assert ok, msg
+    ok, msg = st.sched.request_resize("default", "ej", 6)
+    assert not ok and "in flight" in msg
+    rejected = sum(st.sched.metrics["resizes"].get(d, "rejected")
+                   for d in ("none", "grow", "shrink"))
+    assert rejected >= len(cases)
+    # Direction-known rejections carry the real label (the quota case
+    # is a grow), "none" only covers pre-direction rejections.
+    assert st.sched.metrics["resizes"].get("grow", "rejected") >= 1
+    # elastic=False is the frozen-size baseline: everything rejects.
+    st2 = Stack(elastic=False)
+    st2.submit(mk_job("ej", 3, elastic="2-8"))
+    ok, msg = st2.sched.request_resize("default", "ej", 5)
+    assert not ok and "disabled" in msg
+
+
+def test_capacity_and_quota_conserved_through_seeded_storm():
+    import random
+    rng = random.Random(20260805)
+    st = Stack(slices=[TpuSlice("s0", 16), TpuSlice("s1", 16)],
+               quotas={constants.TPU_RESOURCE: "28"})
+    gangs = {}
+    for i in range(3):
+        name = f"ej-{i}"
+        st.submit(mk_job(name, 3, elastic="1-9"))
+        gangs[name] = True
+        st.make_worker_pods(name, 3)
+    total = st.pool.total_chips
+
+    def check(context):
+        drift = sched_capacity_conserved(st)
+        assert drift == [], (context, drift)
+        held = sum(st.sched.admitted_chips().values())
+        assert st.pool.free_chips + held == total, context
+        usage = st.sched._usage()
+        quota_used = sum(b.get(constants.TPU_RESOURCE, 0)
+                         for b in usage.values())
+        assert quota_used == held, (context, usage)
+
+    check("initial")
+    for step in range(40):
+        name = rng.choice(sorted(gangs))
+        job = st.job(name)
+        cur = el.settled_workers(job)
+        direction = rng.choice(["grow", "shrink"])
+        target = cur + rng.randint(1, 2) if direction == "grow" \
+            else cur - rng.randint(1, 2)
+        lag = rng.random() < 0.3  # controller "lags": grow times out
+        deadline = 0.0 if lag and direction == "grow" else 30.0
+        ok, msg = st.sched.request_resize("default", name, target,
+                                          deadline=deadline)
+        check(f"step {step} after request {name} {cur}->{target}")
+        if ok and not lag and direction == "grow":
+            st.make_worker_pods(name, target)
+        st.sched.reconcile_once()
+        check(f"step {step} after reconcile ({msg})")
+        # Align the fabricated controller with the settled size.
+        settled = el.settled_workers(st.job(name))
+        st.make_worker_pods(name, settled)
+        st.sched.reconcile_once()
+        check(f"step {step} settled")
+    outcomes = {r["outcome"] for r in st.sched.resizer.log}
+    assert "completed" in outcomes  # the storm really moved sizes
+
+
+def test_scheduler_restart_recovers_mid_resize_and_tamper():
+    st = Stack()
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    ok, _ = st.sched.request_resize("default", "ej", 6, deadline=30)
+    assert ok
+    grown = st.sched.admitted_chips()["default/ej"]
+    assert grown == 7
+
+    # Crash: placements were in-memory; the pool (hardware) persists.
+    st.pool.clear_placements()
+    fresh = GangScheduler(st.client, st.pool, tick=0.01)
+    fresh.reconcile_once()
+    # Adoption re-placed the GROWN holding exactly and re-armed the
+    # in-flight protocol entry from the annotations.
+    assert fresh.admitted_chips()["default/ej"] == grown
+    assert fresh.resizer.in_flight("default/ej")
+    st.scheduler = fresh  # for the invariant check
+    st.sched = fresh
+    assert sched_capacity_conserved(st) == []
+    # The resumed transition completes once the workers exist.
+    st.make_worker_pods("ej", 6)
+    fresh.reconcile_once()
+    annos = st.annotations("ej")
+    assert annos[constants.SCHED_GANG_WORKERS_ANNOTATION] == "6"
+    assert not fresh.resizer.in_flight("default/ej")
+
+    # Tamper: a hand-edited settled size WINS (the apiserver is the
+    # source of truth), malformed values fall back to spec.
+    job = st.job("ej")
+    job.metadata.annotations[
+        constants.SCHED_GANG_WORKERS_ANNOTATION] = "4"
+    st.client.mpi_jobs("default").update(job)
+    st.pool.clear_placements()
+    rebuilt = GangScheduler(st.client, st.pool, tick=0.01)
+    rebuilt.reconcile_once()
+    assert rebuilt.admitted_chips()["default/ej"] == 5  # 4 workers + 1
+
+
+def test_unadmission_clears_elastic_protocol_annotations():
+    st = Stack()
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    ok, _ = st.sched.request_resize("default", "ej", 5, deadline=30)
+    assert ok
+    st.make_worker_pods("ej", 5)
+    st.sched.reconcile_once()
+    assert st.annotations("ej")[
+        constants.SCHED_GANG_WORKERS_ANNOTATION] == "5"
+    # Suspend releases capacity and resets the elastic state: the
+    # requeued gang re-enters at its SPEC size.
+    job = st.job("ej")
+    job.spec.run_policy.suspend = True
+    st.client.mpi_jobs("default").update(job)
+    st.sched.reconcile_once()
+    annos = st.annotations("ej")
+    assert constants.SCHED_GANG_WORKERS_ANNOTATION not in annos
+    assert constants.SCHED_RESIZE_STATE_ANNOTATION not in annos
+
+
+# ---------------------------------------------------------------------------
+# Preemption shrinks instead of evicting
+# ---------------------------------------------------------------------------
+
+def test_preemption_prefers_shrink_over_evict():
+    st = Stack()
+    st.submit(mk_job("ej", 7, elastic="2-8"))  # 8 of 16 chips
+    st.make_worker_pods("ej", 7)
+    assert admitted(st, "ej")
+    # Priority job needing 13 chips: 8 free, 5 short — the elastic
+    # victim gives up 5 workers instead of dying.
+    st.submit(mk_job("prod", 12, prio=10))
+    assert st.sched.resizer.in_flight("default/ej")
+    assert st.sched.metrics["evictions"].get("preempted") == 0
+    assert st.sched.metrics["preemption_notices"].value == 0
+    # Drain completes (kubelet-less), chips free, the preemptor admits.
+    st.sched.reconcile_once()
+    st.sched.reconcile_once()
+    assert admitted(st, "prod")
+    assert el.settled_workers(st.job("ej")) == 2
+    assert admitted(st, "ej")  # the victim NEVER left
+    assert sched_capacity_conserved(st) == []
+    rec = [r for r in st.sched.resizer.log
+           if r["outcome"] == "completed"][-1]
+    assert rec["trigger"].startswith("preempted-by")
+
+
+def test_shrink_tick_holds_through_api_weather():
+    """A transient pod-list failure must NOT read as "every departing
+    worker exited" — settling a drain off API weather would release
+    chips live workers still occupy."""
+    st = Stack()
+    st.submit(mk_job("ej", 5, elastic="2-8"))
+    st.make_worker_pods("ej", 5)
+    ok, _ = st.sched.request_resize("default", "ej", 2, deadline=30)
+    assert ok
+    original = st.sched.resizer._pod_index
+    st.sched.resizer._pod_index = lambda: None  # API weather
+    st.sched.reconcile_once()
+    assert st.sched.resizer.in_flight("default/ej")  # held, not settled
+    assert st.sched.admitted_chips()["default/ej"] == 6
+    st.sched.resizer._pod_index = original
+    st.sched.reconcile_once()
+    assert not st.sched.resizer.in_flight("default/ej")
+    assert st.sched.admitted_chips()["default/ej"] == 3
+
+
+def test_lost_settle_write_heals_without_double_release():
+    """The settle annotation write can be lost to API weather AFTER the
+    pool/accounting already moved; the adopt() stale-settle guard must
+    re-issue the write instead of replaying the shrink (which would
+    release the survivors' chips)."""
+    st = Stack()
+    st.submit(mk_job("ej", 4, elastic="2-8"))
+    st.make_worker_pods("ej", 4)
+    ok, _ = st.sched.request_resize("default", "ej", 2, deadline=30)
+    assert ok
+    resizer = st.sched.resizer
+    original = resizer._write_placement_annotations
+    resizer._write_placement_annotations = lambda *a, **k: None  # lost
+    st.sched.reconcile_once()  # drain settles: pool + rec move
+    assert st.sched.admitted_chips()["default/ej"] == 3
+    annos = st.annotations("ej")  # ...but the annotations are STALE
+    assert annos[constants.SCHED_RESIZE_STATE_ANNOTATION] == "draining"
+    resizer._write_placement_annotations = original
+    st.sched.reconcile_once()  # adopt() guard: finish the protocol
+    annos = st.annotations("ej")
+    assert annos[constants.SCHED_GANG_WORKERS_ANNOTATION] == "2"
+    assert constants.SCHED_RESIZE_STATE_ANNOTATION not in annos
+    # The survivors' chips were NEVER double-released.
+    assert st.sched.admitted_chips()["default/ej"] == 3
+    assert sum((st.pool.placement_of("default/ej") or {}).values()) == 3
+    assert sched_capacity_conserved(st) == []
+
+
+def test_preemption_falls_back_to_evict_when_shrink_cannot_cover():
+    """A higher-priority claim larger than the total shrink headroom
+    must not starve behind an elastic victim: the planner falls back to
+    full eviction."""
+    st = Stack(slices=[TpuSlice("s0", 8)])
+    st.submit(mk_job("ej", 7, elastic="4-8"))  # 8 chips, headroom 3
+    assert admitted(st, "ej")
+    st.submit(mk_job("prod", 7, prio=10))     # needs all 8 chips
+    # Shrink headroom (3) < shortfall (8): the elastic gang is evicted
+    # outright, no half-measures left dangling.
+    assert not st.sched.resizer.in_flight("default/ej")
+    assert "default/ej" in st.sched._preempting
+    assert st.sched.metrics["preemption_notices"].value == 1
+    st.sched._preempting["default/ej"]["deadline"] = 0.0
+    st.sched.reconcile_once()
+    st.sched.reconcile_once()
+    assert admitted(st, "prod")
+
+
+def test_preemption_still_evicts_inelastic_victims():
+    st = Stack()
+    st.submit(mk_job("rigid", 7))  # not elastic
+    st.submit(mk_job("prod", 12, prio=10))
+    assert st.sched.metrics["preemption_notices"].value == 1
+    assert "default/rigid" in st.sched._preempting
+
+
+# ---------------------------------------------------------------------------
+# The goodput-aware autoscaler
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_grows_into_idle_with_hysteresis():
+    st = Stack()
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    auto = el.TrainAutoscaler(st.sched, up_stable=2, down_stable=2,
+                              resize_deadline=30.0)
+    assert auto.evaluate_once() is None          # first hit: hold
+    transition = auto.evaluate_once()            # second hit: grow
+    assert transition is not None
+    direction, key, cur, target, reason = transition
+    assert direction == "grow" and key == "default/ej"
+    assert target > cur
+    assert st.sched.resizer.in_flight("default/ej")
+    assert "predicted step" in reason
+
+
+def test_autoscaler_cost_model_vetoes_dcn_crossing_grow():
+    # The gang fills slice 'a' exactly; the only growth room is slice
+    # 'b' across DCN.  With negligible compute per step the collective
+    # slowdown dominates -> veto; with heavy compute the chips win.
+    slices = [TpuSlice("a", 8), TpuSlice("b", 8)]
+    st = Stack(slices=slices)
+    st.submit(mk_job("ej", 7, elastic="2-12"))
+    assert st.pool.placement_of("default/ej") == {"a": 8}
+    starved = el.TrainAutoscaler(st.sched, up_stable=1,
+                                 work_us=10.0, resize_deadline=30.0)
+    assert starved.evaluate_once() is None
+    assert not st.sched.resizer.in_flight("default/ej")
+    heavy = el.TrainAutoscaler(st.sched, up_stable=1,
+                               work_us=10_000_000.0,
+                               resize_deadline=30.0)
+    assert heavy.evaluate_once() is not None
+    assert st.sched.resizer.in_flight("default/ej")
+
+
+def test_autoscaler_shrinks_under_contention():
+    st = Stack()
+    st.submit(mk_job("ej", 7, elastic="2-8"))
+    st.make_worker_pods("ej", 7)
+    # Same-priority pending gang: preemption cannot help, the fence
+    # arms — contention the autoscaler resolves by shrinking.
+    st.submit(mk_job("blocked", 12))
+    assert st.sched._blocked is not None
+    auto = el.TrainAutoscaler(st.sched, up_stable=2, down_stable=2,
+                              resize_deadline=30.0)
+    assert auto.evaluate_once() is None
+    transition = auto.evaluate_once()
+    assert transition is not None and transition[0] == "shrink"
+    st.sched.reconcile_once()  # drain completes (kubelet-less)
+    st.sched.reconcile_once()  # freed chips admit the blocked gang
+    assert admitted(st, "blocked")
+    assert admitted(st, "ej")
+    assert sched_capacity_conserved(st) == []
+
+
+# ---------------------------------------------------------------------------
+# Chaos wiring
+# ---------------------------------------------------------------------------
+
+def test_gang_resize_injector_noops_and_resizes():
+    from mpi_operator_tpu.chaos.engine import ChaosEngine
+    from mpi_operator_tpu.chaos.plan import Fault, FaultPlan
+
+    class Bare:
+        def __init__(self):
+            self.client = Clientset()
+            self.kubelet = None
+
+    plan = FaultPlan(name="t", faults=[Fault(at=0.0, kind="gang_resize")])
+    report = ChaosEngine(Bare(), plan, seed=1).run(invariants=())
+    inject = [e for e in report.events if e.get("event") == "inject"][0]
+    assert inject["result"] == "no-scheduler"
+
+    st = Stack()
+    st.submit(mk_job("plain", 2))  # admitted but NOT elastic
+    report = ChaosEngine(st, plan, seed=1).run(invariants=())
+    inject = [e for e in report.events if e.get("event") == "inject"][0]
+    assert inject["result"] == "no-elastic-gang"
+
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    report = ChaosEngine(st, plan, seed=1).run(invariants=())
+    inject = [e for e in report.events if e.get("event") == "inject"][0]
+    assert inject["resolved_target"] == "default/ej"
+    assert "accepted" in inject["result"]
+    assert st.sched.resizer.in_flight("default/ej")
+
+
+def test_gang_resize_only_in_full_profile_and_goldens_stand():
+    import hashlib
+    from mpi_operator_tpu.chaos.plan import (FLEET_RANDOMIZABLE_KINDS,
+                                             FULL_RANDOMIZABLE_KINDS,
+                                             RANDOMIZABLE_KINDS,
+                                             SCHED_RANDOMIZABLE_KINDS,
+                                             randomized_plan)
+    assert "gang_resize" in FULL_RANDOMIZABLE_KINDS
+    for tuple_ in (RANDOMIZABLE_KINDS, FLEET_RANDOMIZABLE_KINDS,
+                   SCHED_RANDOMIZABLE_KINDS):
+        assert "gang_resize" not in tuple_
+    # The default-tuple plan goldens must stand (recorded seeds replay).
+    digest = hashlib.sha256(
+        randomized_plan(7).to_json().encode()).hexdigest()
+    assert digest == ("65923a09656af203d3373742bf4b9a1c4476fee0d23e"
+                      "7d52c4b47d7325cad572")
+
+
+def test_resize_never_loses_a_step_invariant():
+    system = types.SimpleNamespace(client=Clientset(), kubelet=None,
+                                   controller=None, scheduler=None)
+    assert resize_never_loses_a_step(system) == []
+    st = Stack()
+    system.scheduler = st.sched
+    log = st.sched.resizer.log
+    log.append({"job": "default/a", "direction": "grow",
+                "from_workers": 2, "target": 4, "outcome": "completed",
+                "step_before": 10, "step_after": 17})
+    log.append({"job": "default/b", "direction": "shrink",
+                "from_workers": 4, "target": 2, "outcome": "completed",
+                "step_before": None, "step_after": None})  # no probe
+    log.append({"job": "default/c", "direction": "shrink",
+                "from_workers": 4, "target": 2,
+                "outcome": "fallback_evict",
+                "step_before": 9, "step_after": 1})  # eviction: exempt
+    assert resize_never_loses_a_step(system) == []
+    log.append({"job": "default/d", "direction": "shrink",
+                "from_workers": 4, "target": 2, "outcome": "completed",
+                "step_before": 30, "step_after": 12})
+    failures = resize_never_loses_a_step(system)
+    assert len(failures) == 1 and "default/d" in failures[0]
+
+
+def test_step_probe_feeds_resize_log():
+    st = Stack()
+    steps = {"default/ej": 41}
+    st.sched.resizer.step_probe = lambda key: steps.get(key)
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    ok, _ = st.sched.request_resize("default", "ej", 5, deadline=30)
+    assert ok
+    steps["default/ej"] = 47  # training progressed during the grow
+    st.make_worker_pods("ej", 5)
+    st.sched.reconcile_once()
+    rec = st.sched.resizer.log[-1]
+    assert rec["step_before"] == 41 and rec["step_after"] == 47
+    assert resize_never_loses_a_step(st) == []
+
+
+# ---------------------------------------------------------------------------
+# Controller actuation + gauge + live ZeRO re-shard
+# ---------------------------------------------------------------------------
+
+def test_controller_actuates_resize_worker_delta():
+    from test_controller import Fixture
+
+    f = Fixture()
+    job = mk_job("ej", 2, queue=None, elastic="1-6")
+    job.metadata.annotations.update({
+        constants.SCHED_RESIZE_TARGET_ANNOTATION: "4",
+        constants.SCHED_RESIZE_STATE_ANNOTATION:
+            constants.RESIZE_STATE_GROWING})
+    f.register_job(job)
+    f.sync(job)
+    pods = [p for p in f.client.server.list("v1", "Pod")
+            if "-worker-" in p.metadata.name]
+    assert len(pods) == 4  # the grow target, not the spec count
+
+    # Settled shrink: survivors stay, the grown indices are deleted.
+    stored = f.get_job("ej")
+    stored.metadata.annotations.pop(
+        constants.SCHED_RESIZE_TARGET_ANNOTATION)
+    stored.metadata.annotations.pop(
+        constants.SCHED_RESIZE_STATE_ANNOTATION)
+    stored.metadata.annotations[
+        constants.SCHED_GANG_WORKERS_ANNOTATION] = "1"
+    f.client.mpi_jobs("default").update(stored)
+    f.refresh_caches()
+    f.sync(stored)
+    pods = sorted(p.metadata.name
+                  for p in f.client.server.list("v1", "Pod")
+                  if "-worker-" in p.metadata.name)
+    assert pods == ["ej-worker-0"]
+
+
+def test_gang_workers_gauge_published_and_removed():
+    st = Stack()
+    st.submit(mk_job("ej", 3, elastic="2-8"))
+    gauge = st.sched.metrics["gang_workers"]
+    assert gauge.get("default/ej", "current") == 3
+    assert gauge.get("default/ej", "target") == 3
+    ok, _ = st.sched.request_resize("default", "ej", 6, deadline=30)
+    assert ok
+    st.sched.reconcile_once()
+    assert gauge.get("default/ej", "target") == 6
+    # The gang finishes: its series are removed, not zeroed.
+    import test_sched
+    test_sched.finish(st.client, "ej")
+    st.sched.reconcile_once()
+    assert st.sched._gang_gauge_keys == set()
+
+
+def test_reshard_train_state_allclose_both_directions():
+    jax = pytest.importorskip("jax")
+    import numpy as np
+    import optax
+    from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
+    from mpi_operator_tpu.parallel.train import (build_train_step,
+                                                 reshard_train_state)
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 host devices")
+    mesh_small = create_mesh(MeshConfig(dp=2, fsdp=2), devs[:4])
+    mesh_big = create_mesh(MeshConfig(dp=4, fsdp=2), devs)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return (((x @ params["w1"]) @ params["w2"] - y) ** 2).mean()
+
+    rng = np.random.default_rng(0)
+    params = {"w1": jax.numpy.asarray(rng.normal(size=(8, 16)),
+                                      "float32"),
+              "w2": jax.numpy.asarray(rng.normal(size=(16, 4)),
+                                      "float32")}
+    opt = optax.adam(1e-2)
+    batches = [(jax.numpy.asarray(rng.normal(size=(16, 8)), "float32"),
+                jax.numpy.asarray(rng.normal(size=(16, 4)), "float32"))
+               for _ in range(6)]
+
+    def run(meshes, switch_at):
+        init, step = build_train_step(loss_fn, opt, meshes[0],
+                                      shard_update=True)
+        state = init(dict(params))
+        for i, batch in enumerate(batches):
+            if i == switch_at and len(meshes) > 1:
+                state = reshard_train_state(state, meshes[1],
+                                            shard_update=True)
+                # Step continuity: the SAME step, no rewind.
+                assert int(state.step) == switch_at
+                _, step = build_train_step(loss_fn, opt, meshes[1],
+                                           shard_update=True)
+            state, _ = step(state, batch)
+        return jax.device_get(state)
+
+    golden = run([mesh_big], None)
+    for name, meshes in (("grow", [mesh_small, mesh_big]),
+                         ("shrink", [mesh_big, mesh_small])):
+        got = run(meshes, 3)
+        assert int(got.step) == len(batches)
+        for key in golden.params:
+            assert np.allclose(golden.params[key], got.params[key],
+                               rtol=1e-5, atol=1e-5), (name, key)
+
+
+def test_reshard_is_pure_data_movement():
+    jax = pytest.importorskip("jax")
+    import numpy as np
+    import optax
+    from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
+    from mpi_operator_tpu.parallel.train import (build_train_step,
+                                                 reshard_train_state)
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 host devices")
+    mesh_a = create_mesh(MeshConfig(dp=4, fsdp=1), devs[:4])
+    mesh_b = create_mesh(MeshConfig(dp=8, fsdp=1), devs)
+
+    def loss_fn(params, batch):
+        return ((batch @ params["w"]) ** 2).mean()
+
+    init, _ = build_train_step(loss_fn, optax.sgd(0.1), mesh_a,
+                               shard_update=True)
+    state = init({"w": jax.numpy.ones((8, 8), "float32")})
+    moved = reshard_train_state(state, mesh_b, shard_update=True)
+    before, after = jax.device_get(state), jax.device_get(moved)
+    assert int(after.step) == int(before.step)
+    assert np.array_equal(before.params["w"], after.params["w"])
+    for x, y in zip(jax.tree_util.tree_leaves(before.opt_state),
+                    jax.tree_util.tree_leaves(after.opt_state)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Elastic gang resize smoke (< 60s): one LocalCluster gang grows 2→4
+then shrinks 4→2 LIVE — no restart, no checkpoint rewind.
+
+The scenario (docs/SCHEDULING.md "Elastic gangs"):
+
+1. A 2-worker elastic gang (bounds 2-4) is admitted on one 8-chip
+   slice; every worker is a real process bumping a per-pod step
+   counter.
+2. ``request_resize`` grows it to 4: the scheduler grants chips
+   append-only, the controller creates workers 2 and 3, the resize
+   settles (``gang-workers=4``) — and the ORIGINAL workers' step
+   counters never reset (survivors untouched).
+3. ``request_resize`` shrinks back to 2: the departing workers (2, 3)
+   get the K_RESIZE_NOTICE_FILE drain notice, flush and exit 0 inside
+   the drain window, their chips release, the resize settles at 2.
+4. Asserted: worker-0's step counter is STRICTLY MONOTONE across the
+   whole scenario (one process lifetime — the live-resize proof),
+   survivors never logged a second start, resize counters + histogram +
+   per-gang gauge populated, every chaos invariant green (including
+   ``resize_never_loses_a_step`` with a real step probe), and the
+   whole scenario is run TWICE with identical protocol outcomes.
+
+Usage: python tools/elastic_smoke.py
+Exit 0 = all assertions held.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_operator_tpu.utils.waiters import wait_until  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# The elastic worker: bumps a per-pod step counter file every tick,
+# logs each process start (a survivor must log exactly once), and on a
+# resize notice naming a target at-or-below its own index drains
+# (marker file) and exits 0 — the PR 2 checkpoint-then-exit contract's
+# elastic sibling.
+WORKER_SCRIPT = textwrap.dedent("""\
+    import os, sys, time
+    d = os.environ["SMOKE_DIR"]
+    pod = os.environ["K_POD_NAME"]
+    idx = int(pod.rsplit("-", 1)[-1])
+    notice = os.environ.get("K_RESIZE_NOTICE_FILE")
+    step_file = os.path.join(d, f"step-{idx}")
+    with open(os.path.join(d, "events.log"), "a") as f:
+        f.write(f"start {idx}\\n")
+    step = 0
+    while True:
+        step += 1
+        with open(step_file + ".tmp", "w") as f:
+            f.write(str(step))
+        os.replace(step_file + ".tmp", step_file)
+        if notice and os.path.exists(notice):
+            try:
+                target = int(open(notice).read().split()[0])
+            except (OSError, ValueError, IndexError):
+                target = None
+            if target is not None and idx >= target:
+                with open(os.path.join(d, "events.log"), "a") as f:
+                    f.write(f"drained {idx} {step}\\n")
+                sys.exit(0)
+        time.sleep(0.05)
+""")
+
+
+def mk_elastic_job(name, workers, bounds, script_path, smoke_dir):
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.api.types import (MPIJob, MPIJobSpec,
+                                            ReplicaSpec, RunPolicy)
+    from mpi_operator_tpu.k8s.core import (Container, EnvVar, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+
+    env = [EnvVar("SMOKE_DIR", smoke_dir)]
+
+    def tpl(cname, command):
+        return PodTemplateSpec(spec=PodSpec(containers=[Container(
+            name=cname, image="local", command=command, env=list(env))]))
+
+    return MPIJob(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            labels={constants.QUEUE_NAME_LABEL: "q"},
+            annotations={constants.ELASTIC_ANNOTATION: bounds}),
+        spec=MPIJobSpec(
+            mpi_implementation=constants.IMPL_JAX,
+            run_policy=RunPolicy(),
+            mpi_replica_specs={
+                constants.REPLICA_TYPE_LAUNCHER: ReplicaSpec(
+                    replicas=1,
+                    template=tpl("l", [sys.executable, "-c",
+                                       "import time; time.sleep(300)"])),
+                constants.REPLICA_TYPE_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=tpl("w", [sys.executable, script_path])),
+            }))
+
+
+def wait_for(predicate, timeout, what):
+    try:
+        wait_until(predicate, timeout=timeout, interval=0.05, desc=what)
+    except TimeoutError as exc:
+        raise AssertionError(str(exc)) from None
+
+
+def read_step(smoke_dir, idx) -> int:
+    try:
+        with open(os.path.join(smoke_dir, f"step-{idx}")) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def run_scenario() -> dict:
+    """One grow-then-shrink pass; returns the protocol outcome record
+    (also consumed by bench_elastic.py as its live-process proof).
+    Raises AssertionError on any violation."""
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.chaos.invariants import DEFAULT_INVARIANTS
+    from mpi_operator_tpu.sched import ClusterQueue, LocalQueue, TpuSlice
+    from mpi_operator_tpu.sched.api import (ClusterQueueSpec,
+                                            LocalQueueSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    from mpi_operator_tpu.server.cluster import LocalCluster
+
+    t0 = time.monotonic()
+    smoke_dir = tempfile.mkdtemp(prefix="elastic-smoke-")
+    script_path = os.path.join(smoke_dir, "worker.py")
+    with open(script_path, "w") as f:
+        f.write(WORKER_SCRIPT)
+
+    cluster = LocalCluster(
+        sched_slices=[TpuSlice("s0", 8)],
+        sched_options={"tick": 0.05, "resize_deadline": 15.0,
+                       "checkpoint_grace": 1.0})
+    cluster.start()
+    client = cluster.client
+    sched = cluster.scheduler
+    # Real step probe: the resize log carries the gang's step watermark
+    # (worker-0's counter), so resize_never_loses_a_step checks REAL
+    # continuity, not Nones.
+    sched.resizer.step_probe = lambda key: read_step(smoke_dir, 0)
+    try:
+        client.cluster_queues("default").create(ClusterQueue(
+            metadata=ObjectMeta(name="cq", namespace="default"),
+            spec=ClusterQueueSpec(
+                quotas={constants.TPU_RESOURCE: "8"})))
+        client.local_queues("default").create(LocalQueue(
+            metadata=ObjectMeta(name="q", namespace="default"),
+            spec=LocalQueueSpec(cluster_queue="cq")))
+
+        def job():
+            return client.mpi_jobs("default").get("ej")
+
+        def settled_size():
+            from mpi_operator_tpu.sched.elastic import settled_workers
+            return settled_workers(job())
+
+        def running_workers():
+            return sorted(
+                int(p.metadata.name.rsplit("-", 1)[-1])
+                for p in client.server.list("v1", "Pod", "default")
+                if "-worker-" in p.metadata.name
+                and p.status.phase == "Running")
+
+        client.mpi_jobs("default").create(
+            mk_elastic_job("ej", 2, "2-4", script_path, smoke_dir))
+        wait_for(lambda: running_workers() == [0, 1], 30,
+                 "2-worker gang running")
+        wait_for(lambda: read_step(smoke_dir, 0) >= 3, 15,
+                 "worker-0 making progress")
+        grow_mark = read_step(smoke_dir, 0)
+        print(f"elastic-smoke: gang running, worker-0 at step"
+              f" {grow_mark}")
+
+        # Grow 2 -> 4 live.
+        ok, msg = sched.request_resize("default", "ej", 4)
+        assert ok, f"grow rejected: {msg}"
+        wait_for(lambda: settled_size() == 4, 30, "grow to settle at 4")
+        wait_for(lambda: running_workers() == [0, 1, 2, 3], 20,
+                 "4 workers running")
+        step_after_grow = read_step(smoke_dir, 0)
+        assert step_after_grow >= grow_mark, \
+            "worker-0 step went backwards across the grow"
+        print(f"elastic-smoke: grew 2->4, worker-0 at step"
+              f" {step_after_grow} (monotone)")
+
+        # Shrink 4 -> 2 live: departing workers drain on the notice.
+        wait_for(lambda: read_step(smoke_dir, 3) >= 2, 15,
+                 "worker-3 making progress before the shrink")
+        ok, msg = sched.request_resize("default", "ej", 2)
+        assert ok, f"shrink rejected: {msg}"
+        wait_for(lambda: settled_size() == 2, 30,
+                 "shrink to settle at 2")
+        wait_for(lambda: running_workers() == [0, 1], 20,
+                 "departed workers gone")
+        step_after_shrink = read_step(smoke_dir, 0)
+        assert step_after_shrink >= step_after_grow, \
+            "worker-0 step went backwards across the shrink"
+        events = open(os.path.join(smoke_dir, "events.log")).read()
+        starts = [line for line in events.splitlines()
+                  if line.startswith("start ")]
+        # Survivors (0, 1) started exactly once each: the gang was
+        # NEVER restarted — the live-resize proof.
+        assert starts.count("start 0") == 1, starts
+        assert starts.count("start 1") == 1, starts
+        drained = sorted(int(line.split()[1])
+                         for line in events.splitlines()
+                         if line.startswith("drained "))
+        assert drained == [2, 3], \
+            f"departing workers must drain via the notice: {drained}"
+        print(f"elastic-smoke: shrank 4->2, workers 2+3 drained,"
+              f" worker-0 at step {step_after_shrink} (monotone)")
+
+        # Counters, gauge, protocol log.
+        m = sched.metrics
+        assert m["resizes"].get("grow", "completed") == 1
+        assert m["resizes"].get("shrink", "completed") == 1
+        assert m["resize_seconds"].snapshot()["count"] == 2
+        wait_for(lambda: m["gang_workers"].get("default/ej",
+                                               "current") == 2,
+                 10, "per-gang size gauge to publish the settled size")
+        outcomes = [(r["direction"], r["outcome"], r["from_workers"],
+                     r["target"]) for r in sched.resizer.log]
+        assert outcomes == [("grow", "completed", 2, 4),
+                            ("shrink", "completed", 4, 2)], outcomes
+        for rec in sched.resizer.log:
+            assert rec["step_before"] is not None
+            assert rec["step_after"] is not None
+            assert rec["step_after"] >= rec["step_before"]
+
+        # Every invariant green (incl. resize_never_loses_a_step with
+        # the live probe wired).
+        failures = {}
+
+        def invariants_green():
+            failures.clear()
+            failures.update({check.__name__: check(cluster)
+                             for check in DEFAULT_INVARIANTS})
+            return not any(failures.values())
+
+        try:
+            wait_until(invariants_green, timeout=20, interval=0.2,
+                       desc="invariants to go green")
+        except TimeoutError:
+            pass
+        bad = {k: v for k, v in failures.items() if v}
+        assert not bad, f"invariants violated: {bad}"
+        return {
+            "elapsed_s": round(time.monotonic() - t0, 2),
+            "outcomes": outcomes,
+            "final_workers": settled_size(),
+            "worker0_steps": (grow_mark, step_after_grow,
+                              step_after_shrink),
+            "survivor_starts": 1,
+            "drained_workers": drained,
+            "monotone": (grow_mark <= step_after_grow
+                         <= step_after_shrink),
+            "invariant_violations": 0,
+        }
+    finally:
+        cluster.stop()
+
+
+def main() -> int:
+    first = run_scenario()
+    print(f"elastic-smoke: first pass OK in {first['elapsed_s']}s")
+    second = run_scenario()
+    # Run-twice determinism: the PROTOCOL outcome is identical (step
+    # counts are wall-clock-paced and legitimately vary).
+    for field in ("outcomes", "final_workers", "drained_workers",
+                  "survivor_starts", "invariant_violations"):
+        assert first[field] == second[field], \
+            (field, first[field], second[field])
+    elapsed = first["elapsed_s"] + second["elapsed_s"]
+    print(f"elastic-smoke: PASS in {elapsed:.1f}s — grow 2->4 and"
+          f" shrink 4->2 live, worker-0 steps"
+          f" {first['worker0_steps']} monotone, survivors started"
+          f" once, departing workers drained on the notice, run-twice"
+          f" deterministic, invariants green")
+    assert elapsed < 60, f"smoke took {elapsed}s (budget 60s)"
+    return 0
+
+
+if __name__ == "__main__":
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))

@@ -60,6 +60,8 @@ def run_once(debug_dir: str, factory) -> tuple:
     os.environ["MPI_OPERATOR_DEBUG_DIR"] = debug_dir
     plan = FaultPlan(name="soak-smoke", seed=1, faults=[
         Fault(at=2.0, kind="controller_restart", duration=0.5),
+        Fault(at=3.0, kind="gang_resize",
+              params={"deadline": 2.0}),
         Fault(at=4.5, kind="scheduler_restart", duration=0.5),
         Fault(at=6.5, kind="apiserver_restart", duration=0.5),
     ])
@@ -135,6 +137,14 @@ def check_card(card, label: str) -> list:
     if card.apiserver_recovery_p99_s is None:
         problems.append(f"{label}: apiserver_recovery_p99_s"
                         f" unpopulated (WAL replay never measured)")
+    # Elastic resize (ISSUE 15): the scripted gang_resize fault must
+    # have negotiated a real transition on the (elastic) soak gang.
+    if card.resizes < 1:
+        problems.append(
+            f"{label}: no completed resize (outcomes:"
+            f" {card.detail.get('resizes_by_outcome')})")
+    if card.resize_p99_s is None:
+        problems.append(f"{label}: resize_p99_s unpopulated")
     if card.requests_total <= 0:
         problems.append(f"{label}: no serve traffic flowed")
     return problems
