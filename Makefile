@@ -7,7 +7,8 @@ PYTHON ?= python
 	bench dryrun clean telemetry-smoke chaos-smoke obs-smoke \
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
 	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke \
-	trace-smoke topo-smoke durable-smoke elastic-smoke analyze
+	trace-smoke topo-smoke durable-smoke elastic-smoke ckpt-smoke \
+	analyze
 
 # Every smoke runs with the runtime lock-order detector armed
 # (docs/ANALYSIS.md): repo-created locks are tracked, lock-order cycles
@@ -102,6 +103,16 @@ sched-smoke:
 elastic-smoke:
 	$(SMOKE_ENV) $(PYTHON) tools/elastic_smoke.py
 
+# Checkpoint data plane (< 60s, CPU): a live gang streams full + 2
+# delta manifests to the blob store, is preempted mid-interval (the
+# notice triggers delta@4 + exit 143; the scheduler's checkpoint probe
+# closes the grace window early), and a gang at a DIFFERENT size
+# restores the chain bit-stable; invariants green with the live store,
+# run twice with byte-identical manifests (docs/RESILIENCE.md
+# "Checkpoint data plane").
+ckpt-smoke:
+	$(SMOKE_ENV) $(PYTHON) tools/ckpt_smoke.py
+
 # Macro-soak (< 60s, CPU): the whole stack at minimum scale — one
 # training gang through a ClusterQueue + a 2-replica serving fleet
 # under live traffic — surviving one controller_restart and one
@@ -176,6 +187,9 @@ bench-llama:
 
 bench-serve:
 	$(PYTHON) bench_serve.py
+
+bench-ckpt:
+	$(PYTHON) bench_ckpt.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,0 +1,518 @@
+#!/usr/bin/env python
+"""Checkpoint data plane bench -> BENCH_CKPT.json.
+
+The question (ISSUE 16 / docs/RESILIENCE.md "Checkpoint data plane"):
+what does the manifest protocol — sharded streaming writes, delta
+chunks against a content-addressed store, parallel resharded restores —
+buy over the monolithic pause-and-write checkpoint every resilience
+path used to ride?
+
+Four sections:
+
+- ``overhead_vs_interval``: the arXiv:2011.03641-shaped curve.  A
+  seeded fine-tune-shaped train loop — a frozen backbone table
+  dominating state bytes plus an adam-trained dense head, the
+  chunk-stability regime delta checkpoints exploit — checkpoints at
+  each interval twice onto the SAME simulated blob store: once
+  monolithic (the whole serialized state uploaded per save — the
+  pre-data-plane shape) and once as chunked delta manifests
+  (full_every/MAX_DELTA_DEPTH compaction).  Scored on bytes actually
+  uploaded and on a declared modeled link (step time, bandwidth,
+  commit cost — the sim numbers are labeled as such).  Gate: delta
+  steady-state overhead <= half of monolithic at every interval, and
+  the delta store restores the final state bit-identical.
+
+- ``restore_vs_gang_size``: one 8 MiB state written at 1/2/4/8 shards;
+  restore latency (manifest resolve + parallel shard fetch) measured
+  per shard count — restore cost tracks state bytes, not gang size.
+
+- ``migration_restore``: the elastic/migration proof.  Train at
+  dp=2x4, checkpoint mid-run (full + delta chain), restore the chain
+  onto dp=4x8 via ``restore_resharded`` and keep training — final
+  params allclose to an uninterrupted run at the destination size.
+  Both directions, with restore-at-different-size timed within 1.5x of
+  restore-in-place.
+
+- ``storm``: the honest baseline to beat.  PR 15's contention storm
+  (bench_elastic.py, seed 20260805) measured 71 chip-s of evict-requeue
+  rewind loss at the monolithic 6 s checkpoint interval.  Delta writes
+  shrink bytes-per-save by the measured section-1 ratio, so the same
+  upload budget affords a proportionally shorter interval; the SAME
+  storm re-run at that interval must lose strictly less than the 71
+  chip-s figure.
+
+Usage: python bench_ckpt.py [--quick] [-o BENCH_CKPT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from mpi_operator_tpu.ckpt.blobstore import BlobStore  # noqa: E402
+from mpi_operator_tpu.ckpt.manager import (ManifestCheckpointManager,  # noqa: E402
+                                           fetch_stream, serialize_state)
+from mpi_operator_tpu.ckpt.manifest import latest_restorable  # noqa: E402
+
+SEED = 20260806
+
+# Declared link model for the overhead curve: a 100 ms training step
+# streaming to a 200 MB/s object-store link with a 5 ms manifest
+# commit.  Sim numbers — the bytes under them are measured.
+MODEL_STEP_S = 0.1
+MODEL_LINK_BPS = 200e6
+MODEL_COMMIT_S = 0.005
+
+# PR 15's recorded evict-requeue rewind loss (chip-s) at the
+# monolithic 6 s interval — the figure the storm section must beat.
+PR15_LOST_CHIP_S = 71.0
+PR15_CKPT_S = 6.0
+
+
+# ---------------------------------------------------------------------------
+# Section 1: overhead vs interval (delta vs monolithic)
+# ---------------------------------------------------------------------------
+
+def _finetune_workload(steps: int):
+    """Seeded fine-tune-shaped workload: a frozen backbone table owns
+    most of the state bytes, an adam-trained dense head mutates every
+    step.  Adam leaves the frozen chunks bit-unchanged, so a delta
+    uploads only the head + its optimizer slots — the chunk stability
+    delta checkpoints exploit."""
+    import jax
+    import numpy as np
+    import optax
+
+    rows, dim = 8192, 128
+    rng = np.random.default_rng(SEED)
+    emb = jax.numpy.asarray(rng.normal(size=(rows, dim)), "float32")
+    head = {
+        "w1": jax.numpy.asarray(rng.normal(size=(dim, dim)), "float32"),
+        "w2": jax.numpy.asarray(rng.normal(size=(dim, 8)), "float32"),
+    }
+    opt = optax.adam(1e-2)
+
+    def loss_fn(head, ids, y):
+        e = emb[ids]
+        h = jax.nn.relu(e @ head["w1"])
+        return (((h @ head["w2"]) - y) ** 2).mean()
+
+    @jax.jit
+    def train_step(head, opt_state, ids, y):
+        loss, grads = jax.value_and_grad(loss_fn)(head, ids, y)
+        updates, opt_state = opt.update(grads, opt_state, head)
+        return optax.apply_updates(head, updates), opt_state, loss
+
+    batches = [(jax.numpy.asarray(rng.integers(0, rows, size=8)),
+                jax.numpy.asarray(rng.normal(size=(8, 8)), "float32"))
+               for _ in range(steps)]
+    return emb, head, opt.init(head), train_step, batches
+
+
+def run_overhead_curve(intervals, steps: int = 24) -> dict:
+    import jax
+
+    emb, head0, opt0, train_step, batches = _finetune_workload(steps)
+    # Warm the jit before any timing.
+    h, o, _ = train_step(head0, opt0, *batches[0])
+    jax.block_until_ready(h["w1"])
+
+    curve = []
+    bitstable = True
+    for interval in intervals:
+        per = {"interval_steps": interval}
+        for mode in ("monolithic", "delta"):
+            store = BlobStore()
+            mgr = None
+            if mode == "delta":
+                mgr = ManifestCheckpointManager(
+                    store, "bench/curve", every=0, num_shards=4,
+                    chunk_bytes=1024, async_save=False)
+            head, opt_state = head0, opt0
+            compute_s = save_s = 0.0
+            saves = 0
+            kinds = {"full": 0, "delta": 0}
+            for i, (ids, y) in enumerate(batches):
+                t0 = time.perf_counter()
+                head, opt_state, _ = train_step(head, opt_state,
+                                                ids, y)
+                jax.block_until_ready(head["w1"])
+                compute_s += time.perf_counter() - t0
+                if (i + 1) % interval:
+                    continue
+                state = {"emb": emb, "head": head, "opt": opt_state}
+                t0 = time.perf_counter()
+                if mgr is not None:
+                    kinds[mgr.save(state, i + 1)] += 1
+                else:
+                    # Monolithic pause-and-write: the whole serialized
+                    # state uploaded as one object per save.
+                    _, stream = serialize_state(state)
+                    store.put(stream)
+                save_s += time.perf_counter() - t0
+                saves += 1
+            uploaded = store.counters["bytes_written"]
+            modeled_ckpt_s = (uploaded / MODEL_LINK_BPS
+                              + saves * MODEL_COMMIT_S)
+            per[mode] = {
+                "saves": saves,
+                "uploaded_bytes": uploaded,
+                "bytes_per_save": round(uploaded / max(saves, 1)),
+                "puts": store.counters["puts"],
+                "dedup_hits": store.counters["dedup_hits"],
+                "measured_save_s": round(save_s, 4),
+                "modeled_overhead_pct": round(
+                    100.0 * modeled_ckpt_s / (steps * MODEL_STEP_S), 2),
+            }
+            if mode == "delta":
+                per[mode]["kinds"] = kinds
+                # Bit-stability: the chain must restore the exact
+                # final saved state.
+                final = {"emb": emb, "head": head, "opt": opt_state}
+                _, want = serialize_state(final)
+                _, chain = latest_restorable(store, "bench/curve")
+                if fetch_stream(store, chain) != want:
+                    bitstable = False
+        per["delta_bytes_ratio"] = round(
+            per["delta"]["uploaded_bytes"]
+            / max(per["monolithic"]["uploaded_bytes"], 1), 4)
+        curve.append(per)
+    return {
+        "steps": steps,
+        "state_bytes": len(serialize_state(
+            {"emb": emb, "head": head0, "opt": opt0})[1]),
+        "model": {"step_s": MODEL_STEP_S, "link_Bps": MODEL_LINK_BPS,
+                  "commit_s": MODEL_COMMIT_S},
+        "curve": curve,
+        "delta_restores_bitstable": bitstable,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Section 2: restore latency vs gang size
+# ---------------------------------------------------------------------------
+
+def run_restore_vs_gang_size(shard_counts, state_mib: int = 8) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(SEED)
+    buf = rng.integers(0, 256, size=state_mib << 20,
+                       dtype=np.uint8)
+    out = {"state_bytes": int(buf.nbytes), "per_shards": []}
+    for shards in shard_counts:
+        store = BlobStore()
+        job = f"bench/restore-{shards}"
+        mgr = ManifestCheckpointManager(
+            store, job, every=0, num_shards=shards,
+            chunk_bytes=128 << 10, async_save=False)
+        state = {"buf": buf.copy()}
+        mgr.save(state, 1)
+        for step in (2, 3):
+            # Dirty one 64 KiB region between saves: a delta chain,
+            # so the timed restore resolves full + 2 deltas.
+            lo = (step * 1_000_003) % (buf.nbytes - 65536)
+            state["buf"][lo:lo + 65536] ^= 0xA5
+            mgr.save(state, step)
+        _, chain = latest_restorable(store, job)
+        samples = []
+        stream = b""
+        for _ in range(7):
+            t0 = time.perf_counter()
+            stream = fetch_stream(store, chain)
+            samples.append(time.perf_counter() - t0)
+        _, want = serialize_state(state)
+        out["per_shards"].append({
+            "shards": shards,
+            "chain_kinds": [m["kind"] for m in chain],
+            "restore_s_median": round(statistics.median(samples), 4),
+            "restore_s_min": round(min(samples), 4),
+            "bitstable": stream == want,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Section 3: migration restore (write at one gang size, restore at
+# another, allclose both directions, within 1.5x of in-place)
+# ---------------------------------------------------------------------------
+
+def run_migration_restore() -> dict:
+    import jax
+    import numpy as np
+    import optax
+    from mpi_operator_tpu.parallel.mesh import MeshConfig, create_mesh
+    from mpi_operator_tpu.parallel.train import build_train_step
+
+    devs = jax.devices()
+    mesh_small = create_mesh(MeshConfig(dp=2, fsdp=2), devs[:4])
+    mesh_big = create_mesh(MeshConfig(dp=4, fsdp=2), devs)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = jax.nn.relu(x @ params["w1"])
+        return (((h @ params["w2"]) - y) ** 2).mean()
+
+    rng = np.random.default_rng(SEED)
+    params = {"w1": jax.numpy.asarray(rng.normal(size=(16, 32)),
+                                      "float32"),
+              "w2": jax.numpy.asarray(rng.normal(size=(32, 8)),
+                                      "float32")}
+    opt = optax.adam(1e-2)
+    steps, ckpt_at, switch = 10, 3, 5
+    batches = [(jax.numpy.asarray(rng.normal(size=(16, 16)), "float32"),
+                jax.numpy.asarray(rng.normal(size=(16, 8)), "float32"))
+               for _ in range(steps)]
+
+    def uninterrupted(mesh):
+        init, step = build_train_step(loss_fn, opt, mesh,
+                                      shard_update=True)
+        state = init(dict(params))
+        for batch in batches:
+            state, _ = step(state, batch)
+        return jax.device_get(state)
+
+    def timed_restore(mgr, mesh, target, repeats=9):
+        # Warm once (jit of the reshard put path), then median.
+        mgr.restore_resharded(target, mesh, shard_update=True)
+        samples = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            restored = mgr.restore_resharded(target, mesh,
+                                             shard_update=True)
+            samples.append(time.perf_counter() - t0)
+        return restored, statistics.median(samples)
+
+    out = {"steps": steps, "ckpt_full_at": ckpt_at,
+           "ckpt_delta_at": switch, "directions": {}}
+    for name, src, dst in (("write_2x4_restore_4x8", mesh_small,
+                            mesh_big),
+                           ("write_4x8_restore_2x4", mesh_big,
+                            mesh_small)):
+        store = BlobStore()
+        job = f"bench/{name}"
+        init_src, step_src = build_train_step(loss_fn, opt, src,
+                                              shard_update=True)
+        state = init_src(dict(params))
+        mgr = ManifestCheckpointManager(store, job, every=0,
+                                        num_shards=4, chunk_bytes=4096,
+                                        async_save=False)
+        for i in range(switch):
+            state, _ = step_src(state, batches[i])
+            if i + 1 in (ckpt_at, switch):
+                mgr.save(state, i + 1)  # full@3, then delta@5
+        _, chain = latest_restorable(store, job)
+
+        init_dst, step_dst = build_train_step(loss_fn, opt, dst,
+                                              shard_update=True)
+        target = init_dst(dict(params))
+        restored, cross_s = timed_restore(mgr, dst, target)
+        target_src = init_src(dict(params))
+        _, inplace_s = timed_restore(mgr, src, target_src)
+
+        resumed_at = int(restored.step)
+        for i in range(switch, steps):
+            restored, _ = step_dst(restored, batches[i])
+        golden = uninterrupted(dst)
+        got = jax.device_get(restored)
+        diffs = [float(np.max(np.abs(golden.params[k] - got.params[k])))
+                 for k in golden.params]
+        allclose = all(
+            np.allclose(golden.params[k], got.params[k],
+                        rtol=1e-5, atol=1e-5) for k in golden.params)
+        out["directions"][name] = {
+            "chain_kinds": [m["kind"] for m in chain],
+            "resumed_at_step": resumed_at,
+            "continued_from_same_step": resumed_at == switch,
+            "final_step": int(got.step),
+            "allclose_vs_uninterrupted": bool(allclose),
+            "max_abs_param_diff": max(diffs),
+            "restore_cross_s": round(cross_s, 4),
+            "restore_inplace_s": round(inplace_s, 4),
+            "cross_over_inplace_x": round(
+                cross_s / max(inplace_s, 1e-9), 2),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Section 4: the PR 15 storm at the delta-affordable interval
+# ---------------------------------------------------------------------------
+
+def run_storm_section(delta_ratio: float, quick: bool) -> dict:
+    import bench_elastic
+
+    workload = {
+        "seed": 20260805,
+        "slices": 4, "slice_chips": 16,
+        "gangs": 3, "gang_workers": 11, "gang_min": 3, "gang_max": 15,
+        "burst_at": [6.0, 20.0, 34.0], "burst_jobs": 2,
+        "prod_workers": 15, "prod_hold_s": 5.0,
+        "ckpt_s": PR15_CKPT_S, "grace_s": 0.4,
+        "resize_deadline_s": 10.0, "duration_s": 48.0,
+    }
+    if quick:
+        workload.update({"burst_at": [4.0, 14.0], "duration_s": 24.0,
+                         "prod_hold_s": 3.0})
+
+    # Same upload budget, delta-sized saves: the interval shrinks by
+    # the measured steady-state bytes ratio (floored at 1 s — commit
+    # latency doesn't vanish).
+    delta_ckpt_s = max(1.0, round(PR15_CKPT_S * delta_ratio, 2))
+    results = {}
+    for label, ckpt_s in (("monolithic_6s", PR15_CKPT_S),
+                          ("dataplane_delta", delta_ckpt_s)):
+        w = dict(workload, ckpt_s=ckpt_s)
+        print(f"bench_ckpt: running evict-requeue storm [{label},"
+              f" ckpt every {ckpt_s}s]...", flush=True)
+        r = bench_elastic.run_storm(False, w)
+        print(f"  lost {r['lost_chip_s']} chip-s over"
+              f" {r['gang_evictions']} evictions | goodput"
+              f" {r['aggregate_goodput_chip_s']} chip-s", flush=True)
+        results[label] = r
+    return {
+        "pr15_recorded_lost_chip_s": PR15_LOST_CHIP_S,
+        "delta_bytes_ratio": round(delta_ratio, 4),
+        "delta_ckpt_interval_s": delta_ckpt_s,
+        "workload": workload,
+        "results": results,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Main
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--out", default="BENCH_CKPT.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="reduced storm (CI-sized)")
+    ap.add_argument("--skip-storm", action="store_true")
+    args = ap.parse_args()
+
+    print("bench_ckpt: overhead-vs-interval curve...", flush=True)
+    overhead = run_overhead_curve([1, 2, 4, 8])
+    for p in overhead["curve"]:
+        print(f"  every {p['interval_steps']:>2} steps: monolithic"
+              f" {p['monolithic']['bytes_per_save']} B/save vs delta"
+              f" {p['delta']['bytes_per_save']} B/save"
+              f" (ratio {p['delta_bytes_ratio']}, modeled overhead"
+              f" {p['monolithic']['modeled_overhead_pct']}% ->"
+              f" {p['delta']['modeled_overhead_pct']}%)", flush=True)
+
+    print("bench_ckpt: restore latency vs gang size...", flush=True)
+    restore = run_restore_vs_gang_size([1, 2, 4, 8])
+    for p in restore["per_shards"]:
+        print(f"  {p['shards']} shard(s): {p['restore_s_median']}s"
+              f" median ({'bit-stable' if p['bitstable'] else 'MISMATCH'},"
+              f" chain {'+'.join(p['chain_kinds'])})", flush=True)
+
+    print("bench_ckpt: migration restore proof...", flush=True)
+    migration = run_migration_restore()
+    for name, d in migration["directions"].items():
+        print(f"  {name}: resumed at step {d['resumed_at_step']},"
+              f" allclose={d['allclose_vs_uninterrupted']}"
+              f" (max diff {d['max_abs_param_diff']:.2e}),"
+              f" restore {d['cross_over_inplace_x']}x in-place",
+              flush=True)
+
+    # Steady-state ratio at the shortest interval — the regime the
+    # storm's frequent-checkpoint argument rests on.
+    steady_ratio = overhead["curve"][0]["delta_bytes_ratio"]
+    storm = None
+    if not args.skip_storm:
+        storm = run_storm_section(steady_ratio, args.quick)
+
+    report = {
+        "bench": "checkpoint_data_plane",
+        "host": {"platform": platform.platform(),
+                 "python": platform.python_version(),
+                 "cpus": os.cpu_count()},
+        "overhead_vs_interval": overhead,
+        "restore_vs_gang_size": restore,
+        "migration_restore": migration,
+        "storm": storm,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"bench_ckpt: wrote {args.out}")
+
+    failures = []
+    for p in overhead["curve"]:
+        mono = p["monolithic"]["modeled_overhead_pct"]
+        delta = p["delta"]["modeled_overhead_pct"]
+        if delta > 0.5 * mono:
+            failures.append(
+                f"interval {p['interval_steps']}: delta overhead"
+                f" {delta}% > half of monolithic {mono}%")
+    if not overhead["delta_restores_bitstable"]:
+        failures.append("delta chain did not restore bit-stable")
+    for p in restore["per_shards"]:
+        if not p["bitstable"]:
+            failures.append(
+                f"{p['shards']}-shard restore not bit-stable")
+    for name, d in migration["directions"].items():
+        if not (d["allclose_vs_uninterrupted"]
+                and d["continued_from_same_step"]):
+            failures.append(f"migration {name}: continuity broken")
+        if d["cross_over_inplace_x"] > 1.5:
+            failures.append(
+                f"migration {name}: cross-size restore"
+                f" {d['cross_over_inplace_x']}x in-place (> 1.5x)")
+    if storm is not None:
+        base = storm["results"]["monolithic_6s"]
+        plane = storm["results"]["dataplane_delta"]
+        for label, r in storm["results"].items():
+            if r["conservation_violations"]:
+                failures.append(
+                    f"storm {label}: capacity conservation violated:"
+                    f" {r['conservation_violations'][:3]}")
+            if r["invariant_violations"]:
+                failures.append(f"storm {label}: invariants violated:"
+                                f" {r['invariant_violations'][:3]}")
+        if not args.quick and plane["lost_chip_s"] >= PR15_LOST_CHIP_S:
+            failures.append(
+                f"storm: lost {plane['lost_chip_s']} chip-s, not"
+                f" strictly below the PR 15 {PR15_LOST_CHIP_S} chip-s"
+                f" baseline")
+        if plane["lost_chip_s"] >= base["lost_chip_s"]:
+            failures.append(
+                f"storm: delta-interval lost work"
+                f" {plane['lost_chip_s']} chip-s did not beat the"
+                f" re-measured monolithic {base['lost_chip_s']} chip-s")
+    if failures:
+        print("bench_ckpt: FAIL —")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    worst = max(p["delta_bytes_ratio"] for p in overhead["curve"])
+    msg = (f"bench_ckpt: PASS — delta uploads <= {worst:.0%} of"
+           f" monolithic bytes at every interval (gate: overhead <="
+           f" half), restores bit-stable at 1-8 shards, migration"
+           f" restore allclose both directions within 1.5x of in-place")
+    if storm is not None:
+        msg += (f", storm rewind loss"
+                f" {storm['results']['monolithic_6s']['lost_chip_s']} ->"
+                f" {storm['results']['dataplane_delta']['lost_chip_s']}"
+                f" chip-s at the delta-affordable"
+                f" {storm['delta_ckpt_interval_s']}s interval")
+    print(msg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

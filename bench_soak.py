@@ -67,6 +67,13 @@ SLO_TARGETS = {
     # harness guarantees at least one gang_resize fault per plan, and
     # the soak gangs are elastic with drain-aware workers.
     "resize_p99_s": 10.0,
+    # Checkpoint data plane (ISSUE 16): manifest-write wall time as a
+    # percentage of gang loop time (delta streams keep it low), and the
+    # harness-probed chain-resolve + parallel-fetch restore latency.
+    # Unpopulated (no gang ever committed a manifest) fails the gate —
+    # the soak gangs' rank-0 workers checkpoint every 20 steps.
+    "ckpt_overhead_pct": 20.0,
+    "restore_p99_s": 2.0,
 }
 
 

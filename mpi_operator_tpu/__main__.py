@@ -10,6 +10,7 @@
     python -m mpi_operator_tpu queues [-n ns] [--master ...]
     python -m mpi_operator_tpu debug-bundle NAME [-o dir] [--master ...]
     python -m mpi_operator_tpu trace TARGET [-n ns] [--spans FILE]
+    python -m mpi_operator_tpu checkpoints NAME [-n ns] --store DIR
     python -m mpi_operator_tpu suspend/resume/delete NAME [--master ...]
     python -m mpi_operator_tpu version
 
@@ -561,6 +562,62 @@ def _print_gang_placements(client, namespace) -> None:
               f"{shape:16} {cost}")
 
 
+def cmd_checkpoints(args) -> int:
+    """Manifest-chain view of one job's checkpoint data plane
+    (docs/RESILIENCE.md "Checkpoint data plane"): every committed
+    step with its kind/depth/base, the chunks that manifest actually
+    names (a delta lists only dirty chunks), and whether the chain
+    under it still restores — audited against the live blob set, so a
+    garbage-collected or torn link shows up as NO with a reason."""
+    from .ckpt.blobstore import BlobStore
+    from .ckpt.manifest import (chain_complete, effective_chunks,
+                                latest_restorable, resolve_chain)
+
+    store = BlobStore(root=args.store)
+    job = args.name if "/" in args.name else f"{args.namespace}/{args.name}"
+    steps = store.manifest_steps(job)
+    if not steps:
+        known = ", ".join(store.jobs()) or "<none>"
+        print(f"no committed checkpoints for {job} in {args.store}"
+              f" (jobs with manifests: {known})", file=sys.stderr)
+        return 1
+    print(f"{'STEP':>8} {'KIND':6} {'DEPTH':>5} {'BASE':>8} "
+          f"{'SHARDS':>6} {'CHUNKS':>6} {'BYTES':>12} RESTORABLE")
+    for step in steps:
+        manifest = store.read_manifest(job, step)
+        if manifest is None:
+            print(f"{step:>8} {'?':6} {'-':>5} {'-':>8} {'-':>6} "
+                  f"{'-':>6} {'-':>12} no (manifest unreadable)")
+            continue
+        named = sum(len(s.get("chunks", {}))
+                    for s in manifest["shards"].values())
+        chain = resolve_chain(store, job, step)
+        if chain is None:
+            status = "no (chain link missing or over depth bound)"
+        else:
+            problems = chain_complete(store, chain)
+            status = "yes" if not problems else f"no ({problems[0]})"
+        base = manifest.get("base_step")
+        print(f"{step:>8} {manifest['kind']:6} {manifest['depth']:>5} "
+              f"{base if base is not None else '-':>8} "
+              f"{manifest['num_shards']:>6} {named:>6} "
+              f"{manifest['total_bytes']:>12} {status}")
+    latest = latest_restorable(store, job)
+    if latest is None:
+        print("\nlatest restorable: NONE — committed manifests exist "
+              "but no chain is fully readable")
+        return 1
+    step, chain = latest
+    links = " <- ".join(f"{m['kind']}@{m['step']}" for m in chain)
+    view = effective_chunks(chain)
+    blobs = {ref["blob"] for chunks in view.values()
+             for ref in chunks.values()}
+    print(f"\nlatest restorable: step {step} "
+          f"(chain {links}; {len(blobs)} distinct blob(s), "
+          f"{chain[-1]['total_bytes']} bytes)")
+    return 0
+
+
 def cmd_debug_bundle(args) -> int:
     from .telemetry import flight
 
@@ -771,6 +828,15 @@ def main(argv=None) -> int:
     p.add_argument("--once", action="store_true",
                    help="print one snapshot and exit")
 
+    p = sub.add_parser("checkpoints",
+                       help="manifest-chain view of a job's checkpoints"
+                            " (full/delta chain, restorability audit)")
+    p.add_argument("name", help="job name or namespace/name")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--store", required=True,
+                   help="blob store root directory (the gang's"
+                        " checkpoint data plane, docs/RESILIENCE.md)")
+
     p = sub.add_parser("debug-bundle",
                        help="write an on-demand black-box bundle for a job")
     p.add_argument("name")
@@ -837,6 +903,8 @@ def main(argv=None) -> int:
             return cmd_queues(args)
         if args.command == "top":
             return cmd_top(args)
+        if args.command == "checkpoints":
+            return cmd_checkpoints(args)
         if args.command == "debug-bundle":
             return cmd_debug_bundle(args)
         if args.command == "trace":

@@ -275,6 +275,12 @@ SIM_SCOPE = frozenset((
     "mpi_operator_tpu/sched/topology.py",
     "mpi_operator_tpu/sched/capacity.py",
     "mpi_operator_tpu/runtime/netsim.py",
+    # Checkpoint data plane: manifests are canonically encoded and
+    # carry no wallclock — run-twice byte-identity (ckpt_smoke) breaks
+    # the moment either file reads the clock or the global RNG.
+    # (time.sleep for armed slow-faults is injected delay, not a read.)
+    "mpi_operator_tpu/ckpt/blobstore.py",
+    "mpi_operator_tpu/ckpt/manifest.py",
 ))
 
 _WALLCLOCK_FNS = {("time", "time"), ("time", "time_ns"),

@@ -530,6 +530,33 @@ def inject_api_latency(ctx, fault):
     return heal
 
 
+@register_injector("blob_fault")
+def inject_blob_fault(ctx, fault):
+    """Checkpoint blob-store weather (docs/RESILIENCE.md "Checkpoint
+    data plane"): arm rules on the system's blob store fault bank —
+    ``slow`` uploads, ``fail``-ed uploads, or a ``torn`` manifest at
+    the next job-level commit (the writer dies mid-commit and leaves
+    truncated bytes at the final name).  Writers must retry or die
+    loudly, and the ``ckpt_manifest_consistent`` invariant holds: a
+    readable manifest always restores bit-stable.  No-ops (logged)
+    against systems without a blob store."""
+    store = getattr(ctx.system, "blobstore", None)
+    if store is None:
+        ctx.log_result(fault, resolved_target="", result="no-blobstore")
+        return None
+    mode = fault.params.get("mode", "slow")
+    count = int(fault.params.get("count", 1))
+    op = fault.params.get("op", "commit" if mode == "torn" else "put")
+    store.faults.arm(op, mode, count=count,
+                     delay=float(fault.params.get("delay", 0.05)))
+    ctx.log_result(fault, resolved_target=f"blobstore:{op}",
+                   result=f"armed-{mode} count={count}")
+
+    def heal():
+        store.faults.clear()
+    return heal
+
+
 @register_injector("api_partition")
 def inject_api_partition(ctx, fault):
     """Full control-plane partition: every verb from every component

@@ -286,13 +286,61 @@ def no_surplus_worker_pods(system) -> List[str]:
     return out
 
 
+def ckpt_manifest_consistent(system) -> List[str]:
+    """Checkpoint data plane (docs/RESILIENCE.md): for every job in the
+    system's blob store, the latest readable manifest chain must be
+    fully restorable — every chunk blob present and content-verified,
+    and the reassembled stream exactly ``total_bytes`` long.  Torn or
+    partially-uploaded checkpoints are expected casualties (readers
+    never see them); a READABLE manifest that cannot restore bit-stable
+    is the corruption this invariant exists to catch.  Vacuous against
+    systems without a blob store."""
+    store = getattr(system, "blobstore", None)
+    if store is None:
+        return []
+    from ..ckpt.blobstore import BlobError
+    from ..ckpt.manifest import effective_chunks, latest_restorable
+
+    out = []
+    for job in store.jobs():
+        if not store.manifest_steps(job):
+            continue  # only torn/uncommitted artifacts: nothing visible
+        latest = latest_restorable(store, job)
+        if latest is None:
+            out.append(f"ckpt {job}: committed manifests exist but no"
+                       f" chain is restorable")
+            continue
+        step, chain = latest
+        head = chain[-1]
+        view = effective_chunks(chain)
+        total = 0
+        for shard in range(head["num_shards"]):
+            for idx, ref in sorted(view.get(shard, {}).items()):
+                try:
+                    data = store.get(ref["blob"])  # verifies content
+                except BlobError as exc:
+                    out.append(f"ckpt {job} step {step} shard {shard}"
+                               f" chunk {idx}: {exc}")
+                    continue
+                if len(data) != ref["nbytes"]:
+                    out.append(
+                        f"ckpt {job} step {step} shard {shard} chunk"
+                        f" {idx}: {len(data)} bytes != manifest"
+                        f" {ref['nbytes']}")
+                total += len(data)
+        if total != head["total_bytes"]:
+            out.append(f"ckpt {job} step {step}: reassembled {total}"
+                       f" bytes != manifest total {head['total_bytes']}")
+    return out
+
+
 DEFAULT_INVARIANTS = (no_orphaned_runners, no_leaked_pod_ips,
                       no_orphaned_pods, gang_restarts_bounded,
                       jobs_converged, workqueue_idle,
                       serve_requests_intact, sched_no_partial_gangs,
                       sched_capacity_conserved,
                       resize_never_loses_a_step,
-                      no_surplus_worker_pods)
+                      no_surplus_worker_pods, ckpt_manifest_consistent)
 
 
 def checkpoint_intact(directory: str) -> List[str]:

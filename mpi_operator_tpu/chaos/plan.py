@@ -128,7 +128,8 @@ SCHED_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + ("spot_reclaim",)
 # tests/test_soak.py).
 FULL_RANDOMIZABLE_KINDS = RANDOMIZABLE_KINDS + (
     "replica_kill", "spot_reclaim", "controller_restart",
-    "scheduler_restart", "apiserver_restart", "gang_resize")
+    "scheduler_restart", "apiserver_restart", "gang_resize",
+    "blob_fault")
 
 # Named presets for `randomized_plan(profile=...)`.
 PLAN_PROFILES = {
@@ -200,6 +201,15 @@ def randomized_plan(seed: int, n_faults: int = 8, horizon: float = 6.0,
             # respawns the store; every component rides it out on
             # retried verbs + resumed watches.
             fault.duration = round(rng.uniform(0.4, 1.2), 3)
+        elif kind == "blob_fault":
+            # Checkpoint blob-store weather (ckpt/blobstore.py): slowed
+            # or failed uploads, or a torn manifest at the next commit.
+            # The ckpt_manifest_consistent invariant counter-asserts
+            # that whatever survives stays bit-stable restorable.
+            mode = rng.choice(["slow", "fail", "torn"])
+            fault.params = {"mode": mode,
+                            "count": rng.randint(1, 3),
+                            "delay": round(rng.uniform(0.01, 0.1), 3)}
         elif kind == "gang_resize":
             # Target gang + direction resolved at inject time against
             # the live admitted elastic gangs (the injector prefers

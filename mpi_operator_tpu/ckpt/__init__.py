@@ -1,0 +1,23 @@
+"""Checkpoint data plane (docs/RESILIENCE.md "Checkpoint data plane").
+
+Turns checkpointing from a per-job pause-and-write into a data plane:
+each ZeRO shard streams its own partition to a content-addressed blob
+store (:mod:`blobstore`), manifests make torn uploads invisible
+(:mod:`manifest`), delta checkpoints upload only changed chunks, and
+restore feeds ``parallel.train.reshard_train_state`` directly so a
+restore onto a different gang size costs the same as in place
+(:mod:`manager`).
+"""
+
+from .blobstore import (BlobError, BlobFaultBank, BlobStore,
+                        BlobUnavailableError, BlobWriterKilledError)
+from .manifest import (MAX_DELTA_DEPTH, canonical_manifest_bytes,
+                       resolve_chain)
+from .manager import ManifestCheckpointManager, ShardStreamWriter
+
+__all__ = [
+    "BlobError", "BlobFaultBank", "BlobStore", "BlobUnavailableError",
+    "BlobWriterKilledError", "MAX_DELTA_DEPTH",
+    "canonical_manifest_bytes", "resolve_chain",
+    "ManifestCheckpointManager", "ShardStreamWriter",
+]

@@ -1,0 +1,474 @@
+"""Checkpoint data-plane writers and the train-loop manager.
+
+Three layers (docs/RESILIENCE.md "Checkpoint data plane"):
+
+- :func:`serialize_state` / :func:`rebuild_state` — a pytree as one
+  deterministic byte stream plus a layout table (shape/dtype/nbytes per
+  leaf, in tree order).  Global leaf shapes are gang-size-independent,
+  which is why a manifest written at one gang size restores at another.
+- :class:`ShardStreamWriter` — the per-worker primitive: each ZeRO
+  shard streams only its own byte range, chunked and content-hashed, so
+  a delta step uploads only chunks whose hash changed.  A coordinator
+  (:func:`commit_step`) publishes the atomic job-level manifest once
+  every shard manifest is staged.
+- :class:`ManifestCheckpointManager` — the drop-in for
+  ``utils.checkpoint.CheckpointManager`` in ``run_train_loop``: same
+  snapshot-then-off-thread-write shape (PR 6), same fatal-loud writer
+  error contract, but saves land as manifests in a
+  :class:`~.blobstore.BlobStore` and ``restore_resharded`` feeds
+  ``parallel.train.reshard_train_state`` directly, so restoring onto a
+  different gang size costs the same as restoring in place.
+
+The preemption contract (satellite of ISSUE 16): ``save`` with no
+explicit kind writes a DELTA whenever a recent base manifest exists —
+the grace-window save triggered by the kubelet's preemption notice
+(parallel/train.py handle_preemption) almost never pays for a full
+write.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..telemetry.metrics import default_registry
+from .blobstore import BlobStore, blob_id_for
+from .manifest import (KIND_DELTA, KIND_FULL, MAX_DELTA_DEPTH,
+                       build_manifest, chunk_spans, effective_chunks,
+                       latest_restorable, shard_ranges)
+
+DEFAULT_CHUNK_BYTES = 1 << 18
+
+
+def ckpt_metrics(registry=None) -> dict:
+    """The data plane's registry families (docs/OBSERVABILITY.md)."""
+    registry = registry or default_registry()
+    return {
+        "registry": registry,
+        "writes": registry.counter_vec(
+            "mpi_operator_ckpt_writes_total",
+            "Checkpoint manifests committed to the blob store, by kind"
+            " (full = complete chunk map, delta = changed chunks"
+            " chained onto a base)", ["kind"]),
+        "bytes": registry.counter_vec(
+            "mpi_operator_ckpt_bytes_total",
+            "Bytes actually uploaded to the blob store per checkpoint"
+            " kind (content-hash dedup excluded — the delta savings are"
+            " visible here)", ["kind"]),
+        "restores": registry.counter_vec(
+            "mpi_operator_ckpt_restores_total",
+            "States restored from a manifest chain, by the head"
+            " manifest's kind", ["kind"]),
+        "write_seconds": registry.histogram(
+            "mpi_operator_ckpt_write_seconds",
+            "Chunk/hash/upload/commit wall time of one manifest write"
+            " (off the step path when async)"),
+        "restore_seconds": registry.histogram(
+            "mpi_operator_ckpt_restore_seconds",
+            "Manifest chain resolve + parallel shard fetch + rebuild"
+            " wall time of one restore"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Serialization: pytree <-> (layout, byte stream)
+# ---------------------------------------------------------------------------
+
+def _flatten(tree) -> Tuple[List[Any], Any]:
+    import jax
+    return jax.tree_util.tree_flatten(tree)
+
+
+def serialize_state(state) -> Tuple[List[dict], bytes]:
+    """(layout, stream): every leaf materialized to host memory (the
+    device-to-host snapshot — for a ZeRO-partitioned state this is the
+    all-gather, exactly like reshard_train_state) and concatenated in
+    tree order.  Deterministic bytes for identical values."""
+    import numpy as np
+    leaves, _ = _flatten(state)
+    layout = []
+    parts = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        data = arr.tobytes()
+        layout.append({"shape": list(arr.shape),
+                       "dtype": str(arr.dtype),
+                       "nbytes": len(data)})
+        parts.append(data)
+    return layout, b"".join(parts)
+
+
+def rebuild_state(stream: bytes, layout: List[dict], target):
+    """Rebuild the pytree of ``target``'s structure from a restored
+    stream.  Bit-stable: the arrays are views of the exact bytes the
+    manifest named."""
+    import numpy as np
+
+    import jax
+    leaves, treedef = _flatten(target)
+    if len(leaves) != len(layout):
+        raise ValueError(
+            f"target has {len(leaves)} leaves, manifest layout has "
+            f"{len(layout)} — structure mismatch")
+    out = []
+    off = 0
+    for entry in layout:
+        nbytes = entry["nbytes"]
+        chunk = stream[off:off + nbytes]
+        if len(chunk) != nbytes:
+            raise ValueError(
+                f"stream truncated: wanted {nbytes} bytes at {off}, "
+                f"got {len(chunk)}")
+        arr = np.frombuffer(chunk, dtype=entry["dtype"]).reshape(
+            entry["shape"]).copy()
+        out.append(arr)
+        off += nbytes
+    if off != len(stream):
+        raise ValueError(f"stream has {len(stream) - off} trailing bytes")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Per-shard streaming writer + job-level commit
+# ---------------------------------------------------------------------------
+
+class ShardStreamWriter:
+    """One worker's half of the protocol: stream MY byte range,
+    chunked; upload only what changed; stage my shard manifest.  Keeps
+    the previous step's chunk map in memory so a delta write hashes
+    locally and touches the store only for changed chunks."""
+
+    def __init__(self, store: BlobStore, job: str, shard: int,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+        self.store = store
+        self.job = job
+        self.shard = shard
+        self.chunk_bytes = chunk_bytes
+        # chunk index -> blob id of the last committed write (the delta
+        # comparison base).  Seed from the store after a restart via
+        # seed_from_store().
+        self.base_view: Dict[int, str] = {}
+
+    def seed_from_store(self) -> Optional[int]:
+        """Adopt the latest restorable manifest's view of this shard
+        (a restarted worker deltas against what the store has, not
+        against nothing).  Returns the adopted step or None."""
+        latest = latest_restorable(self.store, self.job)
+        if latest is None:
+            return None
+        step, chain = latest
+        view = effective_chunks(chain).get(self.shard, {})
+        self.base_view = {idx: ref["blob"] for idx, ref in view.items()}
+        return step
+
+    def write(self, step: int, data: bytes, kind: str,
+              base_step: Optional[int] = None) -> Tuple[dict, int]:
+        """Upload this shard's changed chunks for ``step`` and stage
+        its shard manifest.  Returns (shard manifest body, bytes
+        uploaded).  ``kind=full`` lists (and puts) every chunk — puts
+        of unchanged content dedup to zero transfer; ``kind=delta``
+        lists only changed chunks."""
+        spans = chunk_spans(len(data), self.chunk_bytes)
+        chunks: Dict[str, dict] = {}
+        new_view: Dict[int, str] = {}
+        uploaded = 0
+        before = self.store.counters["bytes_written"]
+        for idx, (lo, hi) in enumerate(spans):
+            piece = data[lo:hi]
+            cid = blob_id_for(piece)
+            new_view[idx] = cid
+            if kind == KIND_DELTA and self.base_view.get(idx) == cid:
+                continue  # unchanged: the delta skips it entirely
+            self.store.put(piece)
+            chunks[str(idx)] = {"blob": cid, "nbytes": len(piece)}
+        uploaded = self.store.counters["bytes_written"] - before
+        body = {
+            "shard": self.shard,
+            "num_chunks": len(spans),
+            "length": len(data),
+            "kind": kind,
+            "base_step": base_step if kind == KIND_DELTA else None,
+            "chunks": chunks,
+        }
+        self.store.commit_shard_manifest(self.job, step, self.shard, body)
+        self.base_view = new_view
+        return body, uploaded
+
+
+def commit_step(store: BlobStore, job: str, step: int, kind: str,
+                num_shards: int, layout: List[dict], total_bytes: int,
+                chunk_bytes: int, base_step: Optional[int] = None,
+                depth: int = 0) -> dict:
+    """The coordinator's half: once every shard manifest for ``step``
+    is staged, publish the atomic job-level manifest.  Raises if any
+    shard is missing — a partial gang write can never become visible."""
+    staged = store.shard_manifests(job, step)
+    missing = [s for s in range(num_shards) if s not in staged]
+    if missing:
+        raise ValueError(
+            f"cannot commit {job} step {step}: shard manifests missing "
+            f"for shards {missing}")
+    body = build_manifest(
+        job=job, step=step, kind=kind, num_shards=num_shards,
+        layout=layout, total_bytes=total_bytes, chunk_bytes=chunk_bytes,
+        shards={s: staged[s] for s in range(num_shards)},
+        base_step=base_step, depth=depth)
+    store.commit_manifest(job, step, body)
+    return body
+
+
+def fetch_stream(store: BlobStore, chain: List[dict],
+                 max_workers: int = 8) -> bytes:
+    """Parallel resharded-restore read path: resolve the chain's
+    effective chunk view and fetch ALL shards concurrently — restore
+    cost scales with state bytes / parallelism, not with gang size or
+    chain length."""
+    head = chain[-1]
+    view = effective_chunks(chain)
+    num_shards = head["num_shards"]
+
+    def fetch_shard(shard: int) -> bytes:
+        chunks = view.get(shard, {})
+        return b"".join(store.get(chunks[idx]["blob"])
+                        for idx in sorted(chunks))
+
+    if num_shards == 1:
+        return fetch_shard(0)
+    with ThreadPoolExecutor(
+            max_workers=min(max_workers, num_shards),
+            thread_name_prefix="ckpt-restore") as pool:
+        parts = list(pool.map(fetch_shard, range(num_shards)))
+    return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Train-loop manager
+# ---------------------------------------------------------------------------
+
+class ManifestCheckpointManager:
+    """Drop-in for ``utils.checkpoint.CheckpointManager`` over the blob
+    store: ``maybe_save``/``save``/``drain``/``restore``/``resume_step``
+    plus ``completed_since_last_poll`` all keep their contracts, so
+    ``run_train_loop`` (and its preemption checkpoint-then-exit path)
+    runs on the data plane unchanged.
+
+    Kind selection (``save(..., kind=None)``): DELTA whenever a recent
+    base exists — same serialized size, chain depth under the
+    compaction bound, and fewer than ``full_every`` saves since the
+    last full; otherwise FULL.  The compaction bound keeps restores at
+    O(shards) reads (manifest.MAX_DELTA_DEPTH).
+    """
+
+    def __init__(self, store: BlobStore, job: str, every: int = 100,
+                 num_shards: int = 1,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 full_every: int = 4,
+                 max_delta_depth: int = MAX_DELTA_DEPTH,
+                 async_save: bool = True, goodput=None, registry=None):
+        self.store = store
+        self.job = job
+        self.every = every
+        self.num_shards = num_shards
+        self.chunk_bytes = chunk_bytes
+        self.full_every = full_every
+        self.max_delta_depth = min(max_delta_depth, MAX_DELTA_DEPTH)
+        self.async_save = async_save
+        self.goodput = goodput
+        self.metrics = ckpt_metrics(registry)
+        self._writers = [ShardStreamWriter(store, job, s, chunk_bytes)
+                         for s in range(num_shards)]
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._writer_error: Optional[BaseException] = None
+        self._completed_since_poll = False
+        self.last_written_step: Optional[int] = None
+        self.last_save_kind: Optional[str] = None
+        # Base-chain state for kind selection.
+        self._base_step: Optional[int] = None
+        self._depth = 0
+        self._since_full = 0
+        self._base_total: Optional[int] = None
+        self._adopt_base()
+
+    def _adopt_base(self) -> None:
+        """Chain onto whatever the store already has (a respawned
+        writer deltas against the surviving manifests)."""
+        latest = latest_restorable(self.store, self.job)
+        if latest is None:
+            return
+        step, chain = latest
+        head = chain[-1]
+        if (head["num_shards"] != self.num_shards
+                or head["chunk_bytes"] != self.chunk_bytes):
+            return  # layout changed (resharded restart): next save is full
+        self._base_step = step
+        self._depth = head["depth"]
+        self._base_total = head["total_bytes"]
+        view = effective_chunks(chain)
+        for writer in self._writers:
+            writer.base_view = {
+                idx: ref["blob"]
+                for idx, ref in view.get(writer.shard, {}).items()}
+
+    # -- async writer machinery (utils/checkpoint.py idiom) ----------------
+    def _join_inflight(self) -> None:
+        thread = self._thread
+        if thread is not None:
+            thread.join()
+
+    def _raise_writer_error(self) -> None:
+        with self._lock:
+            err, self._writer_error = self._writer_error, None
+        if err is not None:
+            raise err
+
+    def drain(self) -> None:
+        """Block until the in-flight write finished; re-raise a stored
+        writer failure (fatal-loud, never a silently dead writer)."""
+        self._join_inflight()
+        self._raise_writer_error()
+
+    def completed_since_last_poll(self) -> bool:
+        with self._lock:
+            done, self._completed_since_poll = \
+                self._completed_since_poll, False
+        return done
+
+    @property
+    def in_flight(self) -> bool:
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    # -- kind selection ----------------------------------------------------
+    def _choose_kind(self, total_bytes: int) -> str:
+        if self._base_step is None or self._base_total != total_bytes:
+            return KIND_FULL
+        if self._depth >= self.max_delta_depth:
+            return KIND_FULL  # compaction: bound the chain
+        if self._since_full >= self.full_every:
+            return KIND_FULL
+        return KIND_DELTA
+
+    # -- save --------------------------------------------------------------
+    def maybe_save(self, state, step: int) -> bool:
+        if self.every and step % self.every == 0 and step > 0:
+            self.save(state, step)
+            return True
+        return False
+
+    def save(self, state, step: int, kind: Optional[str] = None) -> str:
+        """Snapshot on the caller thread, chunk/hash/upload/commit on
+        the writer thread (async default).  Returns the chosen kind."""
+        self._raise_writer_error()
+        self._join_inflight()
+        self._raise_writer_error()
+        if self.goodput is not None:
+            with self.goodput.checkpoint_save():
+                layout, stream = serialize_state(state)
+        else:
+            layout, stream = serialize_state(state)
+        chosen = kind or self._choose_kind(len(stream))
+        if not self.async_save:
+            self._write(layout, stream, step, chosen)
+            self._raise_writer_error()
+            return chosen
+        self._thread = threading.Thread(
+            target=self._write, args=(layout, stream, step, chosen),
+            name=f"ckpt-manifest-writer-{step}", daemon=True)
+        self._thread.start()
+        return chosen
+
+    def _write(self, layout: List[dict], stream: bytes, step: int,
+               kind: str) -> None:
+        try:
+            with self.metrics["write_seconds"].time():
+                uploaded = 0
+                base = self._base_step if kind == KIND_DELTA else None
+                for writer, (lo, hi) in zip(
+                        self._writers,
+                        shard_ranges(len(stream), self.num_shards)):
+                    _, nbytes = writer.write(step, stream[lo:hi], kind,
+                                             base_step=base)
+                    uploaded += nbytes
+                depth = self._depth + 1 if kind == KIND_DELTA else 0
+                commit_step(
+                    self.store, self.job, step, kind, self.num_shards,
+                    layout, len(stream), self.chunk_bytes,
+                    base_step=base, depth=depth)
+            self.metrics["writes"].labels(kind).inc()
+            self.metrics["bytes"].labels(kind).inc(uploaded)
+            with self._lock:
+                self._completed_since_poll = True
+                self.last_written_step = step
+                self.last_save_kind = kind
+                self._base_step = step
+                self._depth = depth
+                self._base_total = len(stream)
+                self._since_full = 0 if kind == KIND_FULL \
+                    else self._since_full + 1
+        except BaseException as exc:  # fatal-loud, re-raised on the loop
+            try:
+                from ..telemetry import flight
+                flight.record("ckpt", "manifest_writer_error", step=step,
+                              kind=kind, error=repr(exc))
+            # Best-effort telemetry must never mask the stored error.
+            except Exception:  # lint: allow[silent-except]
+                pass
+            with self._lock:
+                self._completed_since_poll = True
+                self._writer_error = exc
+
+    # -- restore -----------------------------------------------------------
+    def resume_step(self) -> int:
+        self.drain()
+        latest = latest_restorable(self.store, self.job)
+        return latest[0] if latest is not None else 0
+
+    def restore(self, target, step: Optional[int] = None):
+        """Rebuild the newest restorable state (or ``step``'s) into
+        ``target``'s structure as host arrays; ``target`` unchanged
+        when the store has nothing for this job."""
+        self.drain()
+        with self.metrics["restore_seconds"].time():
+            if step is None:
+                latest = latest_restorable(self.store, self.job)
+                if latest is None:
+                    return target
+                step, chain = latest
+            else:
+                from .manifest import chain_complete, resolve_chain
+                chain = resolve_chain(self.store, self.job, step)
+                if chain is None or chain_complete(self.store, chain):
+                    raise BlobRestoreError(
+                        f"{self.job} step {step} is not restorable")
+            stream = fetch_stream(self.store, chain)
+            restored = rebuild_state(stream, chain[-1]["layout"], target)
+        self.metrics["restores"].labels(chain[-1]["kind"]).inc()
+        return restored
+
+    def restore_resharded(self, target, mesh, param_specs=None,
+                          shard_update: bool = False,
+                          step: Optional[int] = None):
+        """Restore + live re-shard in one motion: rebuild the host
+        state from the manifest chain and feed it straight to
+        ``reshard_train_state`` — the restore-onto-a-different-gang-size
+        path (elastic fallback, migration) priced the same as restore
+        in place.  ``target`` supplies the tree structure (an init-fn
+        state on the NEW mesh works: global leaf shapes are
+        size-independent)."""
+        from ..parallel.train import reshard_train_state
+        host = self.restore(target, step=step)
+        if host is target:
+            return target  # nothing restorable: keep the fresh init
+        t0 = time.perf_counter()
+        placed = reshard_train_state(host, mesh, param_specs=param_specs,
+                                     shard_update=shard_update)
+        self.metrics["restore_seconds"].observe(
+            time.perf_counter() - t0)
+        return placed
+
+
+class BlobRestoreError(Exception):
+    """An explicitly requested step could not be restored."""

@@ -138,6 +138,11 @@ def new_sched_metrics(registry: Optional[Registry] = None) -> dict:
             "mpi_operator_sched_resize_seconds",
             "Accepted resize offer to settled new size (completed"
             " resizes only)"),
+        "ckpt_early_evictions": registry.counter(
+            "mpi_operator_sched_ckpt_early_evictions_total",
+            "Grace windows closed early because the victim gang's"
+            " checkpoint manifest committed after the preemption notice"
+            " (ckpt data plane wired via scheduler.ckpt_probe)"),
         "gang_workers": registry.gauge_vec(
             "mpi_operator_sched_gang_workers",
             "Per-admitted-gang worker count: kind=current is the"
@@ -207,6 +212,13 @@ class GangScheduler:
         # resize request rejects and preemption never shrinks.
         self.elastic = elastic
         self.resizer = ElasticResizer(self, resize_deadline)
+        # Checkpoint data plane hook (docs/RESILIENCE.md "Checkpoint
+        # data plane"): an optional ``job key -> latest committed
+        # manifest step (or None)`` probe, set post-construction like
+        # ``resizer.step_probe``.  When a victim gang commits a manifest
+        # AFTER its preemption notice, the grace window closes early —
+        # no reason to keep the hardware parked for the full grace.
+        self.ckpt_probe = None
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(clientset)
         self.metrics = new_sched_metrics(registry)
@@ -795,9 +807,24 @@ class GangScheduler:
         noticed = self._notify_pods(rec["ns"], rec["name"], grace)
         self.metrics["preemption_notices"].inc()
         self._preempting[key] = {
-            "deadline": time.monotonic() + grace, "reason": reason}
+            "deadline": time.monotonic() + grace, "reason": reason,
+            "notice_ckpt_step": self._probe_ckpt_step(key)}
         flight.record("sched", "preemption_notice", job=key,
                       reason=reason, grace=grace, pods_noticed=noticed)
+
+    def _probe_ckpt_step(self, key: str) -> int:
+        """Latest committed manifest step per the injected probe, -1
+        when unprobed/unknown (a first manifest then counts as newer)."""
+        if self.ckpt_probe is None:
+            return -1
+        try:
+            step = self.ckpt_probe(key)
+        except Exception as exc:
+            # Probe weather: fall back to the full grace window.
+            flight.record("sched", "ckpt_probe_error", job=key,
+                          error=str(exc))
+            return -1
+        return -1 if step is None else int(step)
 
     def _notify_pods(self, namespace: str, name: str, grace: float) -> int:
         if self.kubelet is None:
@@ -827,7 +854,16 @@ class GangScheduler:
         for key in sorted(self._preempting):
             state = self._preempting[key]
             if now < state["deadline"]:
-                continue
+                # Early close: the gang checkpointed after the notice
+                # (manifest committed), so the grace window has done
+                # its job — reclaim the chips immediately.
+                if (self.ckpt_probe is None
+                        or self._probe_ckpt_step(key)
+                        <= state.get("notice_ckpt_step", -1)):
+                    continue
+                self.metrics["ckpt_early_evictions"].inc()
+                flight.record("sched", "ckpt_early_eviction", job=key,
+                              reason=state["reason"])
             self._preempting.pop(key)
             job = jobs.get(key)
             if job is not None:

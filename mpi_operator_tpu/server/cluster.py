@@ -150,8 +150,14 @@ class LocalCluster:
             self.controller.register_kind_handler(prefix, handler)
         self.job_controller = JobController(self.client,
                                             namespace=self._namespace)
-        self.controller.run(self._threadiness)
-        self.job_controller.start()
+        try:
+            self.controller.run(self._threadiness)
+            self.job_controller.start()
+        except Exception:
+            # Same crash-loop contract as respawn_scheduler: a respawn
+            # into an apiserver outage stays down until retried.
+            self._controller_down = True
+            raise
         return self.controller
 
     def crash_scheduler(self) -> bool:
@@ -188,7 +194,15 @@ class LocalCluster:
             namespace=self._namespace,
             registry=self.controller.metrics.get("registry"),
             **self._sched_options)
-        self.scheduler.start()
+        try:
+            self.scheduler.start()
+        except Exception:
+            # Respawned into an apiserver outage: the fresh process
+            # cannot re-list.  Restore crash state so a retry after the
+            # apiserver comes back re-runs this whole path (the real
+            # pod would crash-loop until the apiserver is reachable).
+            self._scheduler_down = True
+            raise
         return self.scheduler
 
     def apiserver_durable(self) -> bool:

@@ -37,7 +37,7 @@ from ..api import constants
 from ..api.types import MPIJob, MPIJobSpec, ReplicaSpec, RunPolicy
 from ..chaos import DEFAULT_INVARIANTS, ChaosEngine, FaultPlan
 from ..k8s import core
-from ..k8s.apiserver import Clientset
+from ..k8s.apiserver import ApiError, Clientset
 from ..k8s.core import Container, PodSpec, PodTemplateSpec
 from ..k8s.meta import ObjectMeta
 from ..sched.api import (ClusterQueue, ClusterQueueSpec, LocalQueue,
@@ -317,7 +317,7 @@ def _sleep_container(name: str, seconds: float) -> Container:
 # resizer's step probe so ``resize_never_loses_a_step`` checks REAL
 # watermarks in the soak, not Nones.
 _ELASTIC_WORKER = (
-    "import os, sys, time\n"
+    "import json, os, sys, time\n"
     "deadline = time.time() + {seconds}\n"
     "notice = os.environ.get('K_RESIZE_NOTICE_FILE')\n"
     "pod = os.environ.get('K_POD_NAME', '')\n"
@@ -334,12 +334,70 @@ _ELASTIC_WORKER = (
     "        step = int(open(step_file).read().strip() or 0)\n"
     "    except (OSError, ValueError):\n"
     "        step = 0\n"
+    # Checkpoint data plane (docs/RESILIENCE.md): rank 0 streams the
+    # gang's state to the shared blob store as a full + delta manifest
+    # chain — a restarted rank adopts the surviving chain and deltas
+    # against it; a blob fault resets it to a fresh full.  The mutation
+    # is localized (like optimizer state), so deltas upload only the
+    # dirty chunks — the ckpt_overhead_pct SLO scores exactly this.
+    "writer = None\n"
+    "blob_dir = os.environ.get('SOAK_BLOB_DIR')\n"
+    "repo = os.environ.get('SOAK_REPO_ROOT')\n"
+    "job = os.environ.get('SOAK_JOB_KEY', '')\n"
+    "if blob_dir and repo and job and idx == 0:\n"
+    "    sys.path.insert(0, repo)\n"
+    "    from mpi_operator_tpu.ckpt.blobstore import BlobError, BlobStore\n"
+    "    from mpi_operator_tpu.ckpt.manager import (ShardStreamWriter,\n"
+    "                                               commit_step)\n"
+    "    store = BlobStore(root=blob_dir)\n"
+    "    writer = ShardStreamWriter(store, job, 0, chunk_bytes=1024)\n"
+    "    last_step = writer.seed_from_store()\n"
+    "    since_full = 99\n"
+    "    payload = bytearray(8192)\n"
+    "    save_s = 0.0\n"
+    "    ckpts = 0\n"
+    "    loop_t0 = time.time()\n"
+    "    stats_file = os.path.join(blob_dir, 'stats-' + pod + '.json')\n"
     "while time.time() < deadline:\n"
     "    step += 1\n"
     "    if step_file:\n"
     "        with open(step_file + '.tmp', 'w') as f:\n"
     "            f.write(str(step))\n"
     "        os.replace(step_file + '.tmp', step_file)\n"
+    "    if writer is not None and step % 20 == 0:\n"
+    "        payload[step % 8192] = step % 256\n"
+    "        data = bytes(payload) + step.to_bytes(8, 'little')\n"
+    "        t0 = time.time()\n"
+    "        try:\n"
+    "            committed = store.manifest_steps(job)\n"
+    "            depth = 0\n"
+    "            kind, base = 'full', None\n"
+    "            if last_step is not None and last_step in committed \\\n"
+    "                    and since_full < 4:\n"
+    "                prev = store.read_manifest(job, last_step)\n"
+    "                if prev is not None and prev['depth'] < 4:\n"
+    "                    kind, base = 'delta', last_step\n"
+    "                    depth = prev['depth'] + 1\n"
+    "            if kind == 'full':\n"
+    "                writer.base_view = dict()\n"
+    "            layout = [dict(shape=[len(data)], dtype='uint8',\n"
+    "                           nbytes=len(data))]\n"
+    "            writer.write(step, data, kind, base)\n"
+    "            commit_step(store, job, step, kind, 1, layout,\n"
+    "                        len(data), 1024, base_step=base, depth=depth)\n"
+    "            last_step = step\n"
+    "            since_full = 0 if kind == 'full' else since_full + 1\n"
+    "            ckpts += 1\n"
+    "        except BlobError:\n"
+    "            last_step = None\n"
+    "            since_full = 99\n"
+    "        save_s += time.time() - t0\n"
+    "        stats = dict(save_s=round(save_s, 4),\n"
+    "                     loop_s=round(time.time() - loop_t0, 4),\n"
+    "                     ckpts=ckpts)\n"
+    "        with open(stats_file + '.tmp', 'w') as f:\n"
+    "            f.write(json.dumps(stats))\n"
+    "        os.replace(stats_file + '.tmp', stats_file)\n"
     "    if notice and idx >= 0 and os.path.exists(notice):\n"
     "        try:\n"
     "            target = int(open(notice).read().split()[0])\n"
@@ -351,10 +409,18 @@ _ELASTIC_WORKER = (
 
 
 def _elastic_worker_container(name: str, seconds: float,
-                              step_dir: Optional[str]) -> Container:
+                              step_dir: Optional[str],
+                              blob_dir: Optional[str] = None,
+                              job_key: Optional[str] = None) -> Container:
     import sys
     from ..k8s.core import EnvVar
     env = [EnvVar("SOAK_STEP_DIR", step_dir)] if step_dir else []
+    if blob_dir and job_key:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env += [EnvVar("SOAK_BLOB_DIR", blob_dir),
+                EnvVar("SOAK_REPO_ROOT", repo_root),
+                EnvVar("SOAK_JOB_KEY", job_key)]
     return Container(name=name, image="local",
                      command=[sys.executable, "-c",
                               _ELASTIC_WORKER.format(seconds=seconds)],
@@ -363,7 +429,8 @@ def _elastic_worker_container(name: str, seconds: float,
 
 def gang_job(name: str, workers: int, queue: str, run_seconds: float,
              priority: int = 0, elastic: bool = True,
-             step_dir: Optional[str] = None) -> MPIJob:
+             step_dir: Optional[str] = None,
+             blob_dir: Optional[str] = None) -> MPIJob:
     """A long-running training gang admitted through ``queue``:
     restartPolicy ExitCode so chaos kills trigger gang restarts (slice
     repair) instead of failing the job, with a backoff budget sized for
@@ -376,7 +443,8 @@ def gang_job(name: str, workers: int, queue: str, run_seconds: float,
     if elastic:
         annotations[constants.ELASTIC_ANNOTATION] = f"1-{workers + 2}"
         worker_container = _elastic_worker_container(
-            "worker", run_seconds + 30, step_dir)
+            "worker", run_seconds + 30, step_dir,
+            blob_dir=blob_dir, job_key=f"default/{name}")
     else:
         worker_container = _sleep_container("worker", run_seconds + 30)
     return MPIJob(
@@ -456,8 +524,16 @@ class SoakHarness:
         self.fleet = LocalServeFleet(serve_job, server_factory,
                                      client=self.client, policy="prefix")
         self.monitor = _JobMonitor(self.client, self.soak_metrics)
+        # Checkpoint data plane: the gangs' shared file-backed blob
+        # store (created in start(); None until then so the invariant
+        # and injector read "no blobstore" before the soak is live).
+        self.blobstore = None
         self._recoveries: List[tuple] = []  # (component, seconds)
         self._resize_log_archive: List[dict] = []
+        # Control-plane respawns that landed inside an apiserver
+        # outage crash-loop: they park here and respawn_apiserver
+        # drains them once the replayed store serves again.
+        self._deferred_respawns: set = set()
         self._started = False
         # Causal-trace scoring: the tracer's ring is bounded (65536)
         # and a long soak wraps it — scoring from tracer.events() at
@@ -520,7 +596,17 @@ class SoakHarness:
             # respawned — no recovery happened here, record none.
             return self.cluster.respawn_controller()
         t0 = time.monotonic()
-        ctrl = self.cluster.respawn_controller()
+        try:
+            ctrl = self.cluster.respawn_controller()
+        except ApiError:
+            # Respawn landed inside an apiserver outage: the fresh
+            # controller cannot re-list (a real pod would crash-loop).
+            # Park it; respawn_apiserver drains deferred respawns once
+            # the WAL-replayed store serves again.
+            self._deferred_respawns.add("controller")
+            flight.record("controller", "respawn_deferred",
+                          reason="apiserver-down")
+            return None
         # run() blocks on informer cache sync: by return, the fresh
         # controller has re-listed the world and enqueued every job.
         self._recovered("controller", time.monotonic() - t0)
@@ -542,12 +628,23 @@ class SoakHarness:
         if not getattr(self.cluster, "_scheduler_down", False):
             return self.cluster.respawn_scheduler()  # no-op: see above
         t0 = time.monotonic()
-        sched = self.cluster.respawn_scheduler()
+        try:
+            sched = self.cluster.respawn_scheduler()
+        except ApiError:
+            # Same crash-loop contract as respawn_controller: finish
+            # this respawn after the apiserver is back.
+            self._deferred_respawns.add("scheduler")
+            flight.record("sched", "respawn_deferred",
+                          reason="apiserver-down")
+            return None
         if sched is None:
             return None
         # The fresh resizer needs the step probe back (the old one
-        # died with the crashed scheduler).
+        # died with the crashed scheduler), and the fresh scheduler
+        # needs the checkpoint probe for early grace-window closes.
         self._register_step_probe(sched)
+        if self.blobstore is not None:
+            self._register_ckpt_probe(sched)
         # Recovered = every Admitted=True job re-adopted (admitted-set,
         # quota usage and slice placements rebuilt from the apiserver).
         deadline = time.monotonic() + 15.0
@@ -580,6 +677,14 @@ class SoakHarness:
         self._recovered("apiserver", time.monotonic() - t0)
         flight.record("other", "apiserver_respawned",
                       records=server.replay_stats.get("records", 0))
+        # Drain respawns that crash-looped through the outage: the
+        # cluster restored their crash state on the failed attempt, so
+        # the normal respawn path (recovery timing included) re-runs.
+        deferred, self._deferred_respawns = self._deferred_respawns, set()
+        if "controller" in deferred:
+            self.respawn_controller()
+        if "scheduler" in deferred:
+            self.respawn_scheduler()
         return server
 
     def _admitted_condition_keys(self) -> set:
@@ -637,21 +742,40 @@ class SoakHarness:
 
         scheduler.resizer.step_probe = probe
 
+    def _register_ckpt_probe(self, scheduler) -> None:
+        """Wire the scheduler's checkpoint probe to the blob store's
+        committed manifests, so a preempted gang that checkpoints
+        inside its grace window is evicted early instead of parking the
+        chips for the full grace (sched ckpt_early_evictions_total).
+        Re-registered after every scheduler respawn."""
+        store = self.blobstore
+
+        def probe(key: str):
+            steps = store.manifest_steps(key)
+            return steps[-1] if steps else None
+
+        scheduler.ckpt_probe = probe
+
     def start(self) -> "SoakHarness":
         import tempfile
+        from ..ckpt.blobstore import BlobStore
         from ..telemetry.trace import default_tracer
         default_tracer().add_listener(self._span_listener)
         self.cluster.start()
         self._step_dir = tempfile.mkdtemp(prefix="soak-steps-")
+        self._blob_dir = tempfile.mkdtemp(prefix="soak-blobs-")
+        self.blobstore = BlobStore(root=self._blob_dir)
         if self.cluster.scheduler is not None:
             self._register_step_probe(self.cluster.scheduler)
+            self._register_ckpt_probe(self.cluster.scheduler)
         self._create_queues()
         self.monitor.start()
         run_seconds = self.config.duration + self.config.converge_timeout
         for i in range(self.config.gangs):
             self.cluster.submit(gang_job(
                 f"{GANG_PREFIX}{i}", self.config.gang_workers, "q-gang",
-                run_seconds, step_dir=self._step_dir))
+                run_seconds, step_dir=self._step_dir,
+                blob_dir=self._blob_dir))
         self.fleet.start()
         self.fleet.wait_ready(self.config.serve_replicas, timeout=120)
         self._started = True
@@ -674,6 +798,10 @@ class SoakHarness:
         if getattr(self, "_step_dir", None):
             import shutil
             shutil.rmtree(self._step_dir, ignore_errors=True)
+        if getattr(self, "_blob_dir", None):
+            import shutil
+            shutil.rmtree(self._blob_dir, ignore_errors=True)
+            self.blobstore = None
         self._started = False
 
     def __enter__(self) -> "SoakHarness":
@@ -717,6 +845,18 @@ class SoakHarness:
                          3),
                 kind="gang_resize",
                 params={"deadline": round(rng.uniform(1.5, 3.0), 3)}))
+        # The checkpoint data plane rides it too (ISSUE 16): the
+        # ckpt_manifest_consistent invariant only bites when blob-store
+        # weather actually happened, so guarantee at least one
+        # blob_fault when the draw produced none.
+        if "blob_fault" not in kinds:
+            plan.faults.append(Fault(
+                at=round(rng.uniform(0.3, 0.9) * self.config.duration,
+                         3),
+                kind="blob_fault",
+                params={"mode": rng.choice(["slow", "fail", "torn"]),
+                        "count": rng.randint(1, 3),
+                        "delay": round(rng.uniform(0.01, 0.08), 3)}))
         return plan
 
     def _converged(self) -> bool:
@@ -801,6 +941,56 @@ class SoakHarness:
             for kind, buckets in sorted(segments.items())}
         return ttfs, ttft, attribution
 
+    # -- checkpoint data plane scoring ---------------------------------------
+    def _ckpt_slos(self) -> tuple:
+        """(overhead pct, restore latency samples, detail dict) from
+        the gangs' manifest checkpoints: overhead aggregates the rank-0
+        writers' stats files (save wall time / loop wall time); restore
+        latency is the harness probing a REAL chain resolve + parallel
+        shard fetch per gang at scoring time."""
+        import glob
+        import json as jsonlib
+        if self.blobstore is None:
+            return None, [], {}
+        from ..ckpt.manager import fetch_stream
+        from ..ckpt.manifest import latest_restorable
+        save_s = loop_s = 0.0
+        ckpts = 0
+        for path in sorted(glob.glob(os.path.join(self._blob_dir,
+                                                  "stats-*.json"))):
+            try:
+                with open(path) as f:
+                    stats = jsonlib.load(f)
+            except (OSError, ValueError):
+                continue  # torn stats file mid-write: next writer
+            save_s += float(stats.get("save_s", 0.0))
+            loop_s += float(stats.get("loop_s", 0.0))
+            ckpts += int(stats.get("ckpts", 0))
+        overhead = 100.0 * save_s / loop_s if loop_s > 0 else None
+        restore_samples: List[float] = []
+        chains: Dict[str, dict] = {}
+        for job in self.blobstore.jobs():
+            t0 = time.monotonic()
+            latest = latest_restorable(self.blobstore, job)
+            if latest is None:
+                continue
+            step, chain = latest
+            stream = fetch_stream(self.blobstore, chain)
+            restore_samples.append(time.monotonic() - t0)
+            chains[job] = {
+                "step": step,
+                "chain": [m["kind"] for m in chain],
+                "bytes": len(stream),
+                "manifests": len(self.blobstore.manifest_steps(job)),
+            }
+        detail = {
+            "checkpoints_written": ckpts,
+            "save_s": round(save_s, 3),
+            "restorable_jobs": chains,
+            "torn_manifests": self.blobstore.counters["torn_manifests"],
+        }
+        return overhead, restore_samples, detail
+
     # -- scoring -------------------------------------------------------------
     def _score(self, report, traffic: ServeTraffic,
                smalls: SmallJobStream) -> SloScorecard:
@@ -818,6 +1008,8 @@ class SoakHarness:
                        and ev.get("result") == "crashed")
 
         trace_ttfs, trace_ttft, trace_segments = self._trace_slos()
+        (ckpt_overhead, restore_samples,
+         ckpt_detail) = self._ckpt_slos()
         resize_log = list(self._resize_log_archive)
         if self.scheduler is not None:
             resize_log += list(self.scheduler.resizer.log)
@@ -851,6 +1043,8 @@ class SoakHarness:
             resizes=len(resized),
             resize_p99_s=quantile([r["seconds"] for r in resized],
                                   0.99),
+            ckpt_overhead_pct=ckpt_overhead,
+            restore_p99_s=quantile(restore_samples, 0.99),
             converged=report.converged,
             detail={
                 "trace_segments": trace_segments,
@@ -871,6 +1065,7 @@ class SoakHarness:
                 "recoveries_s": [(c, round(s, 3))
                                  for c, s in self._recoveries],
                 "resizes_by_outcome": resize_outcomes,
+                "ckpt": ckpt_detail,
                 "chaos_violations": list(report.violations),
             })
         self._publish(card)
@@ -894,6 +1089,8 @@ class SoakHarness:
             "traced_ttft_p99_s": card.traced_ttft_p99_s,
             "apiserver_recovery_p99_s": card.apiserver_recovery_p99_s,
             "resize_p99_s": card.resize_p99_s,
+            "ckpt_overhead_pct": card.ckpt_overhead_pct,
+            "restore_p99_s": card.restore_p99_s,
             "requests_lost": card.requests_lost,
             "invariant_violations": card.invariant_violations,
         }

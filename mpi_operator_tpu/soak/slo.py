@@ -123,6 +123,13 @@ class SloScorecard:
     # profile's harness guarantees at least one gang_resize fault).
     resizes: int = 0
     resize_p99_s: Optional[float] = None
+    # Checkpoint data plane (ISSUE 16, docs/RESILIENCE.md "Checkpoint
+    # data plane"): gang wall time spent writing manifests as a
+    # percentage of loop time (delta streams keep this low), and the
+    # harness-probed manifest-chain restore latency; None when no gang
+    # ever committed a manifest (the gate must notice, not pass).
+    ckpt_overhead_pct: Optional[float] = None
+    restore_p99_s: Optional[float] = None
     converged: bool = True
     # Free-form context the bench attaches (windows, per-gang detail).
     detail: Dict[str, object] = field(default_factory=dict)
@@ -195,6 +202,8 @@ class SloScorecard:
             "apiserver_recovery_p99_s": r(self.apiserver_recovery_p99_s),
             "resizes": self.resizes,
             "resize_p99_s": r(self.resize_p99_s),
+            "ckpt_overhead_pct": r(self.ckpt_overhead_pct),
+            "restore_p99_s": r(self.restore_p99_s),
             "converged": self.converged,
             "ok": self.ok,
             "violations": self.violations(),

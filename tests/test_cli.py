@@ -283,3 +283,44 @@ def test_cli_queues_verb(tmp_path):
             cluster.wait(timeout=10)
         except subprocess.TimeoutExpired:
             cluster.kill()
+
+
+def test_cli_checkpoints_verb(tmp_path):
+    """`checkpoints NAME --store DIR` renders the manifest chain —
+    kind/depth/base per committed step, dirty-chunk counts (a delta
+    names fewer chunks than a full), the restorability audit, and the
+    latest-restorable footer (docs/RESILIENCE.md "Checkpoint data
+    plane")."""
+    import numpy as np
+
+    from mpi_operator_tpu.ckpt import BlobStore, ManifestCheckpointManager
+
+    store_root = str(tmp_path / "blobs")
+    store = BlobStore(root=store_root)
+    mgr = ManifestCheckpointManager(store, "default/train", every=1,
+                                    num_shards=2, chunk_bytes=64,
+                                    async_save=False)
+    state = {"w": np.arange(64, dtype=np.float32)}
+    assert mgr.save(state, 1) == "full"
+    state["w"][3] = 9.0  # one dirty chunk in shard 0
+    assert mgr.save(state, 2) == "delta"
+
+    proc = run_cli("checkpoints", "train", "--store", store_root)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = {line.split()[1]: line.split()
+            for line in proc.stdout.splitlines()
+            if line.strip() and line.split()[0] in ("1", "2")}
+    # STEP KIND DEPTH BASE SHARDS CHUNKS BYTES RESTORABLE
+    assert rows["full"][0] == "1" and rows["delta"][0] == "2"
+    assert rows["delta"][2] == "1"  # depth
+    assert rows["delta"][3] == "1"  # base step
+    assert int(rows["delta"][5]) < int(rows["full"][5])  # dirty chunks
+    assert rows["full"][7] == "yes" and rows["delta"][7] == "yes"
+    assert "latest restorable: step 2" in proc.stdout
+    assert "full@1 <- delta@2" in proc.stdout
+
+    # Unknown job: clean one-line error, nonzero exit, known jobs named.
+    proc = run_cli("checkpoints", "nope", "--store", store_root)
+    assert proc.returncode == 1
+    assert "no committed checkpoints for default/nope" in proc.stderr
+    assert "default/train" in proc.stderr

@@ -377,6 +377,36 @@ def test_preemption_checkpoint_then_evict_then_requeue():
     assert admitted_status(cs, "victim") == "True"
 
 
+def test_ckpt_probe_closes_grace_window_early():
+    """Checkpoint data plane wiring: a victim that commits a manifest
+    AFTER its preemption notice is evicted immediately — the grace
+    window exists to let it checkpoint, and the probe proves it did."""
+    cs = Clientset()
+    mk_queues(cs, quotas={})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]),
+                          checkpoint_grace=30.0)  # never elapses in-test
+    manifest_step = {"default/victim": 7}
+    sched.ckpt_probe = lambda key: manifest_step.get(key)
+    cs.mpi_jobs("default").create(mk_job("victim", 3))
+    sched.reconcile_once()
+    assert admitted_status(cs, "victim") == "True"
+    cs.mpi_jobs("default").create(mk_job("urgent", 3, prio=5))
+    sched.reconcile_once()
+    assert "default/victim" in sched._preempting
+    # No manifest newer than the at-notice step yet: window stays open.
+    sched.reconcile_once()
+    assert "default/victim" in sched._preempting
+    assert sched.metrics["ckpt_early_evictions"].value == 0
+    # The gang checkpoints (manifest commits at a newer step) -> the
+    # next sweep evicts without waiting out the 30s grace.
+    manifest_step["default/victim"] = 8
+    sched.reconcile_once()
+    assert "default/victim" not in sched._preempting
+    assert sched.metrics["ckpt_early_evictions"].value == 1
+    assert sched.metrics["evictions"].get("preempted") == 1
+    assert admitted_status(cs, "urgent") == "True"
+
+
 def test_equal_priority_never_preempts():
     cs = Clientset()
     mk_queues(cs, quotas={})

@@ -339,13 +339,13 @@ def test_randomized_plan_presets_byte_stable():
 
 def test_randomized_plan_full_profile():
     from mpi_operator_tpu.chaos.plan import randomized_plan
-    p1 = randomized_plan(7, n_faults=60, profile="full")
-    p2 = randomized_plan(7, n_faults=60, profile="full")
+    p1 = randomized_plan(7, n_faults=80, profile="full")
+    p2 = randomized_plan(7, n_faults=80, profile="full")
     assert p1.to_json() == p2.to_json()  # seed-deterministic
     kinds = {f.kind for f in p1.faults}
     assert {"controller_restart", "scheduler_restart",
             "replica_kill", "spot_reclaim",
-            "apiserver_restart"} <= kinds
+            "apiserver_restart", "blob_fault"} <= kinds
     for f in p1.faults:
         if f.kind in ("controller_restart", "scheduler_restart",
                       "apiserver_restart"):
