@@ -8,7 +8,7 @@ PYTHON ?= python
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
 	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke \
 	trace-smoke topo-smoke durable-smoke elastic-smoke ckpt-smoke \
-	analyze
+	bench-disagg analyze
 
 # Every smoke runs with the runtime lock-order detector armed
 # (docs/ANALYSIS.md): repo-created locks are tracked, lock-order cycles
@@ -190,6 +190,12 @@ bench-serve:
 
 bench-ckpt:
 	$(PYTHON) bench_ckpt.py
+
+# Disaggregated prefill/decode serving bench (docs/SERVING.md): unified
+# vs split pools at chip parity, 32k-prefill interference probe,
+# scale-to-zero round trip, pool rebalancer -> BENCH_DISAGG.json.
+bench-disagg:
+	$(SMOKE_ENV) $(PYTHON) bench_disagg.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,0 +1,641 @@
+#!/usr/bin/env python
+"""Disaggregated prefill/decode serving bench (ISSUE 17,
+docs/SERVING.md).
+
+Drives the SAME Zipf-distributed multi-model trace (mixed short/long
+prompts, per-model shared system prefix) against two chip-identical
+fleets built from the same ModelPoolSpecs:
+
+- **unified**: every replica serves prefill + decode (the baseline —
+  a long prefill holds the replica's device lock through admission and
+  stalls every decode stream on it);
+- **disagg**: each model split into a prefill pool and a decode pool
+  with content-addressed KV-page transfer between them
+  (serving/disagg.py, serving/kv_transfer.py).
+
+Measured per variant: p99 TTFT over the steady-state trace window,
+tokens/s/chip (chip counts are equal by construction), and the
+**interference probe** — inter-token gap p99 of a steady decode stream
+while a 32k-token prefill runs on the same model.  Disagg must hold
+decode p99 still (the prefill lands on the prefill pool and its pages
+stream over in batched waves); unified eats the whole prefill as one
+giant gap.
+
+Disagg-only phases: the **scale-to-zero round trip** (idle model paged
+out with every chip back in its ClusterQueue and the ChipLedger
+conservation invariant checked, then woken by the next request — cold
+start measured into the routing metrics) and the **pool rebalancer**
+(prefill-heavy then decode-heavy traffic must move a replica each way).
+
+Gates (exit 1 on failure): routed streams byte-identical to a direct
+replica, zero lost requests, disagg TTFT p99 and tokens/s/chip no
+worse than unified (5% noise floor), interference + cold-start SLOs
+met via SloScorecard.evaluate, conservation clean, and at least one
+applied rebalance move in each direction.  Writes BENCH_DISAGG.json.
+
+Usage:
+  python bench_disagg.py --smoke     # reduced-size sanity run
+  python bench_disagg.py             # full run -> BENCH_DISAGG.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PAGE = 16
+
+
+def build_model(jax, jnp, max_seq_len, vocab=512):
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = LlamaConfig(vocab_size=vocab, dim=32, n_layers=1, n_heads=1,
+                      n_kv_heads=1, max_seq_len=max_seq_len)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def stream_tokens(url, payload, timeout=600, gaps=None):
+    """One streaming request; returns (ttft, tokens).  When ``gaps``
+    is a list, every inter-token gap (seconds) is appended to it as
+    (wall_time, gap) — the interference probe's raw signal."""
+    hostport = url.split("//")[1]
+    host, _, port = hostport.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    t0 = time.perf_counter()
+    conn.request("POST", "/generate",
+                 body=json.dumps(dict(payload, stream=True)).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    ttft = None
+    toks = []
+    last = None
+    err = None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if line.startswith(b"data: "):
+            ev = json.loads(line[6:])
+            if "token" in ev:
+                now = time.perf_counter()
+                if ttft is None:
+                    ttft = now - t0
+                elif gaps is not None:
+                    gaps.append((now, now - last))
+                last = now
+                toks.append(ev["token"])
+            elif "error" in ev:
+                err = ev["error"]
+                break
+            elif ev.get("done"):
+                break
+    conn.close()
+    if err is not None:
+        raise RuntimeError(err)
+    return ttft, toks
+
+
+def p99(values):
+    import numpy as np
+    return (round(float(np.percentile(np.array(values), 99)), 4)
+            if len(values) else None)
+
+
+def build_fleet(args, pools, unified):
+    """Chip-identical fleet from shared ModelPoolSpecs; ``pools`` maps
+    model name -> (cfg, model, variables, prefill_n, decode_n,
+    blocks, idle_timeout)."""
+    from mpi_operator_tpu.sched.capacity import ChipLedger
+    from mpi_operator_tpu.sched.elastic import RatioBalancer
+    from mpi_operator_tpu.serving.disagg import (DisaggServeFleet,
+                                                 ModelPoolSpec)
+    from mpi_operator_tpu.serving.server import InferenceServer
+    total_chips = sum(p + d for _, _, _, p, d, _, _ in pools.values())
+    ledger = ChipLedger()
+    ledger.register_queue("serve", total_chips)
+    specs = []
+    for name, (cfg, model, variables, pn, dn, blocks,
+               idle) in pools.items():
+
+        def factory(spec, role, _m=model, _v=variables, _b=blocks):
+            return InferenceServer(
+                _m, _v, max_batch_slots=args.slots, kv_page_size=PAGE,
+                kv_cache_blocks=_b, kv_prefill_chunk=args.prefill_chunk,
+                role=role, model_name=spec.name)
+
+        # Price the stages' per-token service costs into the balancer:
+        # a decode token costs decode_latency/slots of device time
+        # (ticks amortize over active slots), a prefill token costs
+        # prefill_token_latency.  Without this the balancer reads the
+        # raw token ratio (prompts >> outputs) and drags every pool
+        # toward prefill.
+        decode_cost = args.decode_latency / max(1, args.slots)
+        sr = (args.prefill_token_latency / decode_cost
+              if decode_cost > 0 else 1.0)
+        # stable=10^9 keeps the balancer quiescent through warmup and
+        # the scored trace (a mid-warm move retires a replica and kills
+        # its in-flight streams); rebalance_drift re-arms it via
+        # balancer.reset(stable=...) for the drift phase.
+        specs.append(ModelPoolSpec(
+            name=name, server_factory=factory, page_size=PAGE,
+            prefill_replicas=pn, decode_replicas=dn,
+            chips_per_replica=1, queue="serve", idle_timeout_s=idle,
+            balancer=RatioBalancer(stable=10 ** 9, deadband=0.1,
+                                   service_ratio=sr)))
+    fleet = DisaggServeFleet(specs, ledger=ledger, unified=unified,
+                             rebalance_interval=args.rebalance_interval,
+                             reap_interval=0.2,
+                             cold_start_price=0.0)
+    return fleet, ledger, total_chips
+
+
+def warm_fleet(fleet, workload, pools, args):
+    """Warm every replica, jit-program shape, and the long-document
+    working set BEFORE the scored trace: distinct sessions spread over
+    each model's replicas (affinity + P2C), each session walks the
+    short/mid/long width buckets plus the chunked-prefill and
+    KV-transfer paths, and every recurring long document is served
+    twice so its pages sit in the prefix caches (and, disagg, on the
+    decode pool).  Without this the scored window measures XLA compile
+    storms (0.3-1s each, serialized under each replica's device lock)
+    and first-touch document misses instead of steady-state serving."""
+    url = fleet.router.url
+    sem = threading.Semaphore(6)
+    errors = []
+
+    def send(model, body, session):
+        try:
+            stream_tokens(url, {
+                "tokens": [workload.prefixes[model] + body],
+                "max_new_tokens": 4, "temperature": 0.0,
+                "model": model, "session": session}, timeout=300)
+        except Exception as exc:
+            errors.append(repr(exc))
+
+    def warm_session(model, i):
+        with sem:
+            for n in (8, 40, 440, 780):
+                send(model, [((7 * j + i) % 490) + 1 for j in range(n)],
+                     f"warm-{model}-{i}")
+
+    def warm_replica_docs(model, role, rurl):
+        # Pre-position the doc working set on THIS replica: prefill
+        # replicas via the pure cache-warm /prefill path, decode and
+        # unified replicas via a 1-token /generate.  Session affinity
+        # then never strands a doc request on a replica that must
+        # re-prefill (unified) or pull a transfer (disagg) — both
+        # variants serve the working set from cache, symmetrically.
+        from urllib import request as _urlreq
+        with sem:
+            for doc in workload.long_documents[model]:
+                body = workload.prefixes[model] + list(doc)
+                try:
+                    if role == "prefill":
+                        req = _urlreq.Request(
+                            rurl.rstrip("/") + "/prefill",
+                            data=json.dumps({"tokens": body}).encode(),
+                            headers={"Content-Type": "application/json"})
+                        with _urlreq.urlopen(req, timeout=300):
+                            pass
+                    else:
+                        stream_tokens(rurl, {
+                            "tokens": [body], "max_new_tokens": 1,
+                            "temperature": 0.0}, timeout=300)
+                except Exception as exc:
+                    errors.append(repr(exc))
+
+    threads = []
+    for model, (_, _, _, pn, dn, _, _) in pools.items():
+        for i in range(2 * (pn + dn)):
+            threads.append(threading.Thread(
+                target=warm_session, args=(model, i), daemon=True))
+    for model, role, rurl in fleet.replica_urls():
+        threads.append(threading.Thread(
+            target=warm_replica_docs, args=(model, role, rurl),
+            daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    if errors:
+        raise RuntimeError(f"fleet warmup failed: {errors[:3]}")
+
+
+def run_trace(fleet, workload, args):
+    """Steady-state Zipf trace via soak traffic clients; returns the
+    windowed stats."""
+    import numpy as np
+    from mpi_operator_tpu.soak.traffic import ServeTraffic
+    traffic = ServeTraffic(lambda: fleet.router.url, workload,
+                           closed=args.closed, open_rate=args.open_rate,
+                           seed=7)
+    t_start = time.perf_counter()
+    traffic.start()
+    time.sleep(args.warmup + args.duration)
+    # Score the SCHEDULED steady-state window only — the drain after
+    # stop() must not stretch one variant's denominator.
+    w0, w1 = t_start + args.warmup, time.perf_counter()
+    traffic.stop()
+    window = [c for c in traffic.completions
+              if c[0] >= w0 and c[3] <= w1]
+    ttfts = [c[1] for c in window if c[1] is not None]
+    tokens = sum(c[2] for c in window)
+    secs = w1 - w0
+    return {
+        "requests_completed": len(window),
+        "errors": len(traffic.errors),
+        "tokens_per_s": round(tokens / secs, 2),
+        "ttft_p50_s": (round(float(np.percentile(ttfts, 50)), 4)
+                       if ttfts else None),
+        "ttft_p99_s": p99(ttfts),
+        "window_seconds": round(secs, 1),
+    }
+
+
+def interference_probe(fleet, head_model, head_prefix, args):
+    """Inter-token gap p99 of a steady decode stream on the head
+    model while a long prefill (args.long_prefill_tokens) runs against
+    the same model."""
+    import numpy as np
+    url = fleet.router.url
+    gaps = []
+    stop = threading.Event()
+
+    def decode_stream():
+        rng = np.random.default_rng(11)
+        while not stop.is_set():
+            payload = {"tokens": [head_prefix + list(map(int,
+                       rng.integers(1, 500, 4)))],
+                       "max_new_tokens": args.probe_decode_tokens,
+                       "temperature": 0.0, "model": head_model,
+                       "session": "probe"}
+            try:
+                stream_tokens(url, payload, gaps=gaps)
+            except Exception:
+                if not stop.is_set():
+                    raise
+
+    t = threading.Thread(target=decode_stream, daemon=True)
+    t.start()
+    time.sleep(2.0)  # steady-state decode before the disturbance
+    baseline = [g for _, g in gaps]
+    long_prompt = [((13 * i) % 500) + 1
+                   for i in range(args.long_prefill_tokens)]
+    # Same session as the decode stream: on a unified fleet affinity
+    # lands the giant prefill on the probe's own replica (the worst
+    # case disagg must neutralize — same chat session pasting a huge
+    # context mid-conversation).
+    t0 = time.perf_counter()
+    _, _ = stream_tokens(url, {"tokens": [long_prompt],
+                               "max_new_tokens": 2, "temperature": 0.0,
+                               "model": head_model,
+                               "session": "probe"}, timeout=900)
+    t1 = time.perf_counter()
+    time.sleep(0.5)
+    stop.set()
+    t.join(timeout=60)
+    during = [g for w, g in gaps if t0 <= w <= t1]
+    return {
+        "long_prefill_tokens": args.long_prefill_tokens,
+        "long_prefill_wall_s": round(t1 - t0, 2),
+        "decode_gap_p99_baseline_s": p99(baseline),
+        "decode_gap_p99_during_s": p99(during),
+        "decode_gaps_during": len(during),
+    }
+
+
+def scale_to_zero_round_trip(fleet, ledger, tail_model, workload):
+    """Page the idle tail model out, verify chips return to the queue
+    (conservation), then wake it with one request and prove the reply
+    matches the warm fleet byte-for-byte."""
+    url = fleet.router.url
+    payload = {"tokens": [workload.prefixes[tail_model] + [3, 1, 4]],
+               "max_new_tokens": 4, "temperature": 0.0,
+               "model": tail_model}
+    warm_ttft, warm_tokens = stream_tokens(url, dict(payload))
+    deadline = time.monotonic() + 60
+    while fleet.awake(tail_model) and time.monotonic() < deadline:
+        time.sleep(0.1)
+    paged_out = not fleet.awake(tail_model)
+    conservation = ledger.conservation_violations()
+    used_while_out = ledger.used("serve")
+    cold_ttft, cold_tokens = stream_tokens(url, dict(payload),
+                                           timeout=900)
+    colds = fleet.router.cold_start_stats().get(tail_model, [])
+    return {
+        "paged_out": paged_out,
+        "chips_used_while_paged_out": used_while_out,
+        "conservation_violations": conservation,
+        "byte_identical_after_wake": cold_tokens == warm_tokens,
+        "warm_ttft_s": round(warm_ttft, 4),
+        "cold_ttft_s": round(cold_ttft, 4),
+        "cold_starts_recorded": len(colds),
+        "cold_start_p99_s": p99(colds),
+        "wakes_total": fleet.router.telemetry["model_wakes"]
+        .labels(tail_model).value,
+    }
+
+
+def rebalance_drift(fleet, head_model, head_prefix, args):
+    """Drive the live prefill/decode token ratio both ways and record
+    the RatioBalancer's applied moves (PR 15's resizer, pointed at the
+    pools)."""
+    import numpy as np
+    url = fleet.router.url
+    spec = fleet.models[head_model]
+    # Arm the balancer only now (see build_fleet): drift is its phase.
+    for s in fleet.models.values():
+        s.balancer.reset(stable=args.rebalance_stable)
+    before = dict(fleet.pool_sizes(head_model))
+    rng = np.random.default_rng(23)
+
+    def drive(prompt_tokens, max_new, seconds):
+        stop = time.perf_counter() + seconds
+        while time.perf_counter() < stop:
+            body = [head_prefix + list(map(int, rng.integers(
+                1, 500, prompt_tokens)))]
+            try:
+                stream_tokens(url, {"tokens": body,
+                                    "max_new_tokens": max_new,
+                                    "temperature": 0.0,
+                                    "model": head_model})
+            except Exception:
+                pass
+
+    # Prefill-heavy first: the balancer enters this phase at the
+    # initial decode-leaning split (it is held quiescent until here),
+    # so the prefill push is the direction with headroom; the decode
+    # push then walks it back.
+    drive(args.drift_prompt_tokens, 1, args.drift_seconds)
+    mid = dict(fleet.pool_sizes(head_model))
+    drive(4, args.drift_decode_tokens, args.drift_seconds)
+    time.sleep(1.0)
+    after = dict(fleet.pool_sizes(head_model))
+    moves = [m for m in spec.balancer.log if m["outcome"] == "applied"]
+    return {
+        "pools_before": before,
+        "pools_after_prefill_heavy": mid,
+        "pools_after_decode_heavy": after,
+        "applied_moves": [{k: m[k] for k in
+                           ("seq", "from", "to", "want_share",
+                            "have_share")} for m in moves],
+        "moved_toward_prefill": any(m["to"] == "prefill"
+                                    for m in moves),
+        "moved_toward_decode": any(m["to"] == "decode"
+                                   for m in moves),
+    }
+
+
+def byte_identity_check(fleet, pools, workload, args, jax, jnp):
+    """Replay a fixed sample through the router and against a
+    standalone unified replica of the same model."""
+    from mpi_operator_tpu.serving.server import InferenceServer
+    sample_model = workload.models[0]
+    cfg, model, variables, _, _, blocks, _ = pools[sample_model]
+    sample = [{"tokens": [workload.prefixes[sample_model]
+                          + [7, i + 1]],
+               "max_new_tokens": args.max_new, "temperature": 0.0,
+               "model": sample_model} for i in range(4)]
+    routed = [stream_tokens(fleet.router.url, dict(p))[1]
+              for p in sample]
+    direct_srv = InferenceServer(
+        model, variables, max_batch_slots=args.slots,
+        kv_page_size=PAGE, kv_cache_blocks=blocks,
+        kv_prefill_chunk=args.prefill_chunk).start()
+    try:
+        direct = [stream_tokens(direct_srv.url, dict(p))[1]
+                  for p in sample]
+    finally:
+        direct_srv.stop()
+    return routed == direct
+
+
+def run_variant(unified, pools, args, jax, jnp):
+    from mpi_operator_tpu.soak.traffic import MultiModelWorkload
+    fleet, ledger, chips = build_fleet(args, pools, unified)
+    head = list(pools)[0]
+    tail = list(pools)[-1]
+    workload = MultiModelWorkload(
+        models=list(pools), vocab_size=500, seed=13,
+        prefix_tokens=args.prefix_tokens,
+        short_prompt_tokens=(4, 24),
+        long_prompt_tokens=(args.long_min, args.long_max),
+        long_frac=args.long_frac, max_new=args.max_new)
+    out = {"variant": "unified" if unified else "disagg",
+           "chips": chips}
+    with fleet:
+        fleet.wait_ready(timeout=300)
+        warm_fleet(fleet, workload, pools, args)
+        trace = run_trace(fleet, workload, args)
+        out["trace"] = trace
+        out["tokens_per_s_per_chip"] = round(
+            trace["tokens_per_s"] / chips, 3)
+        out["interference"] = interference_probe(
+            fleet, head, workload.prefixes[head], args)
+        out["byte_identical_to_direct"] = byte_identity_check(
+            fleet, pools, workload, args, jax, jnp)
+        if not unified:
+            out["rebalance"] = rebalance_drift(
+                fleet, head, workload.prefixes[head], args)
+            # Arm scale-to-zero on the tail model only now (see the
+            # pools comment in main): the reaper reads the spec live.
+            fleet.models[tail].idle_timeout_s = 3.0
+            out["scale_to_zero"] = scale_to_zero_round_trip(
+                fleet, ledger, tail, workload)
+            tm = fleet.router.telemetry
+            out["kv_transfer"] = {
+                "prefill_dispatches": tm["disagg_prefills"].value,
+                "fallbacks": tm["disagg_fallback"].value,
+                "pages_shipped": tm["kv_pages_shipped"].value,
+                "pages_deduped": tm["kv_pages_deduped"].value,
+                "transfer_mb": round(
+                    tm["kv_transfer_bytes"].value / 1e6, 2),
+            }
+        out["router_lost"] = fleet.router.telemetry[
+            "requests_lost_total"].value
+    out["ledger_conservation_ok"] = \
+        ledger.conservation_violations() == []
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--prefix-tokens", type=int, default=48)
+    ap.add_argument("--max-new", type=int, default=12)
+    ap.add_argument("--long-min", type=int, default=400)
+    ap.add_argument("--long-max", type=int, default=800)
+    ap.add_argument("--long-frac", type=float, default=0.2)
+    # Open-loop at a rate below either fleet's saturation point
+    # (~4/s unified, ~3/s disagg on the single-core sim host): the
+    # latency comparison measures service + interference, not queue
+    # blowup (closed-loop clients would push both variants into deep
+    # saturation, where the comparison degenerates into raw capacity).
+    ap.add_argument("--closed", type=int, default=0)
+    ap.add_argument("--open-rate", type=float, default=2.5)
+    ap.add_argument("--duration", type=float, default=45.0)
+    ap.add_argument("--warmup", type=float, default=6.0)
+    ap.add_argument("--long-prefill-tokens", type=int, default=32768)
+    ap.add_argument("--probe-decode-tokens", type=int, default=48)
+    ap.add_argument("--prefill-chunk", type=int, default=512)
+    ap.add_argument("--rebalance-interval", type=float, default=1.0)
+    ap.add_argument("--rebalance-stable", type=int, default=3)
+    ap.add_argument("--drift-seconds", type=float, default=6.0)
+    ap.add_argument("--drift-prompt-tokens", type=int, default=300)
+    ap.add_argument("--drift-decode-tokens", type=int, default=48)
+    ap.add_argument("--decode-latency", type=float, default=0.003)
+    ap.add_argument("--prefill-token-latency", type=float,
+                    default=0.0005)
+    ap.add_argument("--interference-target-s", type=float, default=0.25)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--out", default="BENCH_DISAGG.json")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.duration, args.warmup = 10.0, 3.0
+        args.long_prefill_tokens = 4096
+
+    os.environ["MPI_OPERATOR_SERVE_DECODE_LATENCY"] = \
+        str(args.decode_latency)
+    os.environ["MPI_OPERATOR_SERVE_PREFILL_TOKEN_LATENCY"] = \
+        str(args.prefill_token_latency)
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    # Three models, Zipf-weighted: the head model gets the big context
+    # window (it also hosts the 32k interference probe and the pool
+    # rebalancer); the tail model is the scale-to-zero candidate.
+    head_seq = args.long_prefill_tokens + 128
+    head_blocks = head_seq // PAGE + 256 + args.slots * 8 + 64
+    small_seq = 1024
+    # Pool must hold the recurring long-document working set (4 docs x
+    # ~50 pages) PLUS live slots, or doc reuse thrashes the cache.
+    small_blocks = 4 * (small_seq // PAGE) + args.slots * 8 + 64
+    cfg_a, model_a, var_a = build_model(jax, jnp, head_seq)
+    cfg_b, model_b, var_b = build_model(jax, jnp, small_seq)
+    cfg_c, model_c, var_c = build_model(jax, jnp, small_seq)
+    pools = {
+        # name: (cfg, model, variables, prefill_n, decode_n, blocks,
+        #        idle_timeout_s)
+        # mC is the scale-to-zero candidate; its idle timeout is armed
+        # by run_variant right before the dedicated round-trip phase.
+        # Leaving it armed during the steady-state trace would thrash
+        # (its mean inter-arrival at the trace rate is about the
+        # timeout), and every wake's cold-start TTFT would dominate
+        # the trace p99 — which has its own SLO key (cold_start_p99_s)
+        # and phase.
+        "mA": (cfg_a, model_a, var_a, 1, 2, head_blocks, None),
+        "mB": (cfg_b, model_b, var_b, 1, 1, small_blocks, None),
+        "mC": (cfg_c, model_c, var_c, 1, 1, small_blocks, None),
+    }
+
+    results = {}
+    for unified in (True, False):
+        name = "unified" if unified else "disagg"
+        print(f"bench_disagg: running variant={name} "
+              f"(duration {args.duration}s, long prefill "
+              f"{args.long_prefill_tokens} tokens)...", flush=True)
+        results[name] = run_variant(unified, pools, args, jax, jnp)
+        print(json.dumps(results[name], indent=2), flush=True)
+
+    uni, dis = results["unified"], results["disagg"]
+
+    # SLO scorecard: the three ISSUE-17 keys, gated via evaluate().
+    from mpi_operator_tpu.soak.slo import SloScorecard
+    card = SloScorecard(
+        disagg_ttft_p99_s=dis["trace"]["ttft_p99_s"],
+        decode_interference_p99_s=dis["interference"]
+        ["decode_gap_p99_during_s"],
+        cold_start_p99_s=dis["scale_to_zero"]["cold_start_p99_s"],
+    )
+    slo = card.evaluate({
+        "disagg_ttft_p99_s": max(
+            0.05, (uni["trace"]["ttft_p99_s"] or 0.0) * 1.05),
+        "decode_interference_p99_s": args.interference_target_s,
+        "cold_start_p99_s": 120.0,
+    })
+
+    gates = {
+        "byte_identical": (dis["byte_identical_to_direct"]
+                           and uni["byte_identical_to_direct"]),
+        "no_lost_requests": (dis["router_lost"] == 0
+                             and uni["router_lost"] == 0),
+        "ttft_no_worse": slo["disagg_ttft_p99_s"]["met"],
+        "throughput_no_worse": (
+            dis["tokens_per_s_per_chip"]
+            >= 0.95 * uni["tokens_per_s_per_chip"]),
+        "interference_held": slo["decode_interference_p99_s"]["met"],
+        "cold_start_bounded": slo["cold_start_p99_s"]["met"],
+        "conservation_ok": (
+            dis["ledger_conservation_ok"]
+            and not dis["scale_to_zero"]["conservation_violations"]
+            and dis["scale_to_zero"]["chips_used_while_paged_out"]
+            < dis["chips"]),
+        "scale_to_zero_round_trip": (
+            dis["scale_to_zero"]["paged_out"]
+            and dis["scale_to_zero"]["byte_identical_after_wake"]
+            and dis["scale_to_zero"]["cold_starts_recorded"] >= 1),
+        "rebalancer_reshaped_both_ways": (
+            dis["rebalance"]["moved_toward_prefill"]
+            and dis["rebalance"]["moved_toward_decode"]),
+        "pages_actually_shipped": dis["kv_transfer"]
+        ["pages_shipped"] > 0,
+    }
+    report = {
+        "bench": "disagg",
+        "host": "single-core CPU sim (injected-latency replicas)",
+        "workload": {
+            "models": list(pools), "page_size": PAGE,
+            "slots": args.slots, "prefix_tokens": args.prefix_tokens,
+            "long_prompt_tokens": [args.long_min, args.long_max],
+            "long_frac": args.long_frac, "max_new": args.max_new,
+            "closed_loop_clients": args.closed,
+            "open_loop_rate_per_s": args.open_rate,
+            "duration_s": args.duration,
+            "long_prefill_tokens": args.long_prefill_tokens,
+            "decode_latency_s": args.decode_latency,
+            "prefill_token_latency_s": args.prefill_token_latency,
+        },
+        "unified": uni,
+        "disagg": dis,
+        "slo": slo,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"bench_disagg: ttft p99 {uni['trace']['ttft_p99_s']}s "
+          f"(unified) vs {dis['trace']['ttft_p99_s']}s (disagg); "
+          f"decode p99 gap during 32k prefill "
+          f"{uni['interference']['decode_gap_p99_during_s']}s -> "
+          f"{dis['interference']['decode_gap_p99_during_s']}s; "
+          f"cold start p99 "
+          f"{dis['scale_to_zero']['cold_start_p99_s']}s; "
+          f"wrote {args.out}")
+    if not report["ok"]:
+        failed = [g for g, v in gates.items() if not v]
+        print(f"bench_disagg: FAIL ({', '.join(failed)})")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -550,3 +550,165 @@ class SlicePool:
                 return False
             self._offline.discard(slice_name)
             return True
+
+
+# ---------------------------------------------------------------------------
+# Serve-side chip accounting (ISSUE 17 scale-to-zero)
+# ---------------------------------------------------------------------------
+
+class ChipLedger:
+    """Chip accounting for serve fleets against PR 9 ClusterQueues.
+
+    A disaggregated serve fleet (serving/disagg.py) holds chips per
+    model; scale-to-zero means an idle model's chips go BACK to its
+    ClusterQueue — visibly, so training gangs can be admitted into
+    them — and a wake re-charges them.  This ledger is that
+    book-keeping: per-holder charges against named queues, a
+    conservation invariant (``sum(holdings) + free == quota``, per
+    queue, always), and an optional clientset mirror that publishes
+    each queue's serve usage into ``ClusterQueue.status.used`` the
+    same way the gang scheduler publishes train usage.
+
+    The ledger is authoritative for its own queues (serve fleets get
+    dedicated ClusterQueues; sharing one queue between this ledger and
+    the gang scheduler would double-account ``status.used``).
+    """
+
+    def __init__(self, clientset=None, namespace: str = "default"):
+        self._lock = threading.Lock()
+        self._quota: Dict[str, int] = {}       # queue -> chip quota
+        self._holdings: Dict[str, tuple] = {}  # holder -> (queue, chips)
+        self.client = clientset
+        self.namespace = namespace
+
+    def register_queue(self, name: str, quota_chips: int) -> None:
+        """Declare (or resize) a queue's chip quota.  With a clientset,
+        the ClusterQueue object of the same name is created if absent
+        (quota in spec.quotas[google.com/tpu], Kueue shape)."""
+        if quota_chips < 0:
+            raise ValueError("quota_chips must be >= 0")
+        with self._lock:
+            held = sum(c for q, c in self._holdings.values()
+                       if q == name)
+            if quota_chips < held:
+                raise ValueError(
+                    f"queue {name!r} quota {quota_chips} below current"
+                    f" holdings {held}")
+            self._quota[name] = int(quota_chips)
+        if self.client is not None:
+            from ..api import constants
+            from .api import (ClusterQueue, ClusterQueueSpec)
+            from ..k8s.meta import ObjectMeta
+            cqs = self.client.cluster_queues(self.namespace)
+            try:
+                cq = cqs.get(name)
+                cq.spec.quotas[constants.TPU_RESOURCE] = str(quota_chips)
+                cqs.update(cq)
+            except Exception:
+                try:
+                    cqs.create(ClusterQueue(
+                        metadata=ObjectMeta(name=name,
+                                            namespace=self.namespace),
+                        spec=ClusterQueueSpec(quotas={
+                            constants.TPU_RESOURCE: str(quota_chips)})))
+                except Exception:  # lint: allow[silent-except]
+                    pass  # mirror is best-effort; the ledger is truth
+        self._mirror(name)
+
+    def charge(self, holder: str, queue: str, chips: int) -> bool:
+        """Reserve ``chips`` for ``holder`` from ``queue``; False when
+        the queue lacks free quota (all-or-nothing, like placement).
+        A holder holds at most one charge — re-charging releases the
+        old one first (idempotent wake)."""
+        if chips < 0:
+            raise ValueError("chips must be >= 0")
+        with self._lock:
+            if queue not in self._quota:
+                raise KeyError(f"unknown queue {queue!r}")
+            old = self._holdings.pop(holder, None)
+            free = self._quota[queue] - sum(
+                c for q, c in self._holdings.values() if q == queue)
+            if chips > free:
+                if old is not None:       # failed re-charge keeps the
+                    self._holdings[holder] = old   # old holding intact
+                return False
+            self._holdings[holder] = (queue, int(chips))
+        self._mirror(queue)
+        return True
+
+    def release(self, holder: str) -> int:
+        """Return ``holder``'s chips to their queue; returns the chip
+        count released (0 if it held nothing)."""
+        with self._lock:
+            held = self._holdings.pop(holder, None)
+        if held is None:
+            return 0
+        queue, chips = held
+        self._mirror(queue)
+        return chips
+
+    def used(self, queue: str) -> int:
+        with self._lock:
+            return sum(c for q, c in self._holdings.values()
+                       if q == queue)
+
+    def free(self, queue: str) -> int:
+        with self._lock:
+            return self._quota.get(queue, 0) - sum(
+                c for q, c in self._holdings.values() if q == queue)
+
+    def holdings(self) -> Dict[str, tuple]:
+        with self._lock:
+            return dict(self._holdings)
+
+    def conservation_violations(self) -> List[str]:
+        """The capacity-conservation invariant, checkable at any time:
+        per queue, holdings never exceed quota and never go negative,
+        and the mirrored ClusterQueue.status.used agrees with the
+        ledger.  Returns human-readable violations (empty = holds)."""
+        out: List[str] = []
+        with self._lock:
+            quota = dict(self._quota)
+            per_q: Dict[str, int] = {q: 0 for q in quota}
+            for holder, (q, c) in self._holdings.items():
+                if c < 0:
+                    out.append(f"holder {holder!r} holds {c} < 0 chips")
+                per_q[q] = per_q.get(q, 0) + c
+        for q, used in per_q.items():
+            if q not in quota:
+                out.append(f"holdings against unregistered queue {q!r}")
+            elif used > quota[q]:
+                out.append(f"queue {q!r}: holdings {used} exceed"
+                           f" quota {quota[q]}")
+        if self.client is not None:
+            from ..api import constants
+            for q in quota:
+                try:
+                    cq = self.client.cluster_queues(self.namespace).get(q)
+                except Exception:  # lint: allow[silent-except]
+                    continue  # mirror unreadable != ledger corrupt
+                mirrored = int(cq.status.used.get(
+                    constants.TPU_RESOURCE, "0"))
+                if mirrored != per_q.get(q, 0):
+                    out.append(
+                        f"queue {q!r}: status.used {mirrored} !="
+                        f" ledger {per_q.get(q, 0)}")
+        return out
+
+    def _mirror(self, queue: str) -> None:
+        """Publish the queue's serve usage into its ClusterQueue
+        status (same shape as the gang scheduler's _update_cq_status;
+        best-effort with conflict retry)."""
+        if self.client is None:
+            return
+        from ..api import constants
+        used = self.used(queue)
+        cqs = self.client.cluster_queues(self.namespace)
+        for _ in range(3):
+            try:
+                cq = cqs.get(queue)
+                cq.status.used[constants.TPU_RESOURCE] = str(used)
+                cqs.update_status(cq)
+                return
+            except Exception:  # lint: allow[silent-except]
+                continue  # conflict/weather: retry; ledger is truth

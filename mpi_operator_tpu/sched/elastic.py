@@ -870,3 +870,107 @@ class TrainAutoscaler:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# Prefill/decode pool rebalancing (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+class RatioBalancer:
+    """The ElasticResizer's policy, pointed at a disaggregated serve
+    fleet: resize a model's prefill and decode pools *against each
+    other* as the live prefill/decode token ratio drifts (total
+    replicas stay fixed — the balancer moves capacity between stages,
+    it does not scale the model; the serve autoscaler owns that axis).
+
+    Pure hysteresis math, no threads and no wall clock — the caller
+    (serving/disagg.py PoolRebalancer) feeds cumulative token counters
+    and current pool sizes, and gets back either ``None`` or a single
+    one-replica move ``{"from": role, "to": role, ...}``.  A move is
+    proposed only after the *instantaneous* ratio (between consecutive
+    observations) has pointed the same way for ``stable`` consecutive
+    observations, so a bursty trace cannot thrash a replica back and
+    forth; ``service_ratio`` prices the stages' different per-replica
+    throughputs (decode emits one token per tick across slots, prefill
+    chews whole prompts), mirroring how the TrainAutoscaler prices a
+    grow with the topology cost model rather than raw chip counts.
+
+    Same log idiom as ElasticResizer: every proposal appends a record
+    with a terminal outcome filled in by the caller via
+    :meth:`settle`."""
+
+    def __init__(self, stable: int = 2, deadband: float = 0.15,
+                 service_ratio: float = 1.0, min_pool: int = 1):
+        if stable < 1:
+            raise ValueError("stable must be >= 1")
+        self.stable = int(stable)
+        self.deadband = float(deadband)
+        self.service_ratio = float(service_ratio)
+        self.min_pool = int(min_pool)
+        self.log: List[dict] = []
+        self._last: Optional[Tuple[int, int]] = None
+        self._streak = 0          # signed: +n toward prefill, -n decode
+        self._moves = 0
+
+    def observe(self, prefill_tokens: int, decode_tokens: int,
+                prefill_pool: int, decode_pool: int) -> Optional[dict]:
+        """Feed cumulative token counters + current pool sizes; returns
+        a one-replica move proposal or None.  The proposal is appended
+        to ``log`` with outcome=None — the caller settles it."""
+        if self._last is None:
+            self._last = (prefill_tokens, decode_tokens)
+            return None
+        dp = max(0, prefill_tokens - self._last[0])
+        dd = max(0, decode_tokens - self._last[1])
+        self._last = (prefill_tokens, decode_tokens)
+        total = dp + dd
+        if total <= 0 or prefill_pool + decode_pool < 2 * self.min_pool:
+            self._streak = 0
+            return None
+        # Demand share of prefill work, priced by per-replica service
+        # rate, vs the share of replicas currently serving it.
+        want = (dp * self.service_ratio) / (dp * self.service_ratio + dd)
+        have = prefill_pool / (prefill_pool + decode_pool)
+        drift = want - have
+        if abs(drift) <= self.deadband:
+            self._streak = 0
+            return None
+        direction = 1 if drift > 0 else -1
+        self._streak = (self._streak + direction
+                        if self._streak * direction >= 0 else direction)
+        if abs(self._streak) < self.stable:
+            return None
+        src, dst = (("decode", "prefill") if direction > 0
+                    else ("prefill", "decode"))
+        src_pool = decode_pool if direction > 0 else prefill_pool
+        if src_pool - 1 < self.min_pool:
+            return None  # never starve a stage below its floor
+        self._streak = 0
+        self._moves += 1
+        move = {"seq": self._moves, "from": src, "to": dst,
+                "want_share": round(want, 4), "have_share": round(have, 4),
+                "prefill_pool": prefill_pool, "decode_pool": decode_pool,
+                "outcome": None, "seconds": None}
+        self.log.append(move)
+        return move
+
+    def reset(self, stable: Optional[int] = None) -> None:
+        """Clear the hysteresis state (and optionally retune
+        ``stable``): a caller that held the balancer quiescent through
+        a warmup or migration phase re-arms it without the stale
+        streak/counter baseline proposing an instant move."""
+        if stable is not None:
+            if stable < 1:
+                raise ValueError("stable must be >= 1")
+            self.stable = int(stable)
+        self._last = None
+        self._streak = 0
+
+    def settle(self, move: dict, outcome: str,
+               seconds: Optional[float] = None) -> None:
+        """Terminal outcome of an applied (or failed) move, mirroring
+        the resizer's resizes_total accounting."""
+        move["outcome"] = outcome
+        move["seconds"] = seconds
+        flight.record("serving", "pool_rebalance", **{
+            k: move[k] for k in ("seq", "from", "to", "outcome")})

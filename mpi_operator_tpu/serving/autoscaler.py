@@ -25,6 +25,7 @@ so even a buggy or stale status write cannot scale past the spec.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from ..k8s.apiserver import TRANSPORT_ERRORS, Clientset
@@ -50,7 +51,8 @@ class ServeAutoscaler:
 
     def __init__(self, clientset: Clientset, namespace: str, name: str,
                  router, poll_interval: float = 0.5,
-                 up_stable: int = 2, down_stable: int = 4):
+                 up_stable: int = 2, down_stable: int = 4,
+                 model: str = ""):
         self.client = clientset
         self.namespace = namespace
         self.name = name
@@ -58,15 +60,23 @@ class ServeAutoscaler:
         self.poll_interval = float(poll_interval)
         self.up_stable = int(up_stable)
         self.down_stable = int(down_stable)
+        # Label for the cold-start histogram; a multi-model fleet runs
+        # one autoscaler per ServeJob, so the job IS the model.
+        self.model = model or name
         self._up_hits = 0
         self._down_hits = 0
         self._ttft_count_seen = 0
         self._req_count_seen = 0.0
+        self._wake_started: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # Observable trail for tests/smokes: every applied transition
         # as (old_desired, new_desired, reason).
         self.transitions: list = []
+        # Measured wake->serving cold starts (seconds), mirrored into
+        # mpi_operator_serve_cold_start_seconds{model} on the router's
+        # registry.
+        self.cold_starts: list = []
 
     # -- decision ----------------------------------------------------------
     def _ttft_p99_since_last_poll(self) -> Optional[float]:
@@ -122,8 +132,23 @@ class ServeAutoscaler:
                 return None  # apiserver weather: next poll re-asserts
             self.transitions.append(
                 (current, desired, "up: traffic while scaled to zero"))
+            # Cold-start clock starts at the wake DECISION — the user
+            # request is already waiting, so everything from here to
+            # the first Ready replica is cost the requester pays.
+            if self._wake_started is None:
+                self._wake_started = time.monotonic()
             return desired
         replicas = stats["replicas"]
+        if self._wake_started is not None:
+            # First poll with a live replica after a wake: the fleet is
+            # serving again — that elapsed span is the model's measured
+            # cold-start cost (per-model histogram, ISSUE 17).
+            elapsed = time.monotonic() - self._wake_started
+            self._wake_started = None
+            self.cold_starts.append(elapsed)
+            hist = self.router.telemetry.get("cold_start_seconds")
+            if hist is not None:
+                hist.labels(self.model).observe(elapsed)
         per_replica = stats["queue_depth_total"] / replicas
         ttft_p99 = self._ttft_p99_since_last_poll()
 

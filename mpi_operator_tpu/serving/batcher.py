@@ -76,6 +76,20 @@ PIPELINE_ENV = "MPI_OPERATOR_SERVE_PIPELINE"
 DECODE_LATENCY_ENV = "MPI_OPERATOR_SERVE_DECODE_LATENCY"
 PREFILL_TOKEN_LATENCY_ENV = "MPI_OPERATOR_SERVE_PREFILL_TOKEN_LATENCY"
 
+# KV export/import waves are padded to a FIXED width so the gather /
+# `.at[blks].set` programs compile exactly ONCE per pool leaf, ever
+# (variable widths would compile a fresh XLA program per distinct
+# page count — a compile storm under the device lock).  The widths
+# differ on purpose: exports run on prefill replicas where nothing
+# competes for the device lock, so one wide wave per transfer batch
+# (matching MAX_PAGES_PER_PUSH in serving/kv_transfer.py) is
+# cheapest; imports land on DECODE replicas with live token streams,
+# so waves are kept narrow — the lock is released between waves and
+# decode steps interleave, bounding the per-import decode stall to
+# one narrow scatter instead of one full transfer batch.
+_EXPORT_WAVE_WIDTH = 64
+_IMPORT_WAVE_WIDTH = 8
+
 
 def _page_digest(parent_hex: str, page) -> str:
     """Content digest of one prompt page CHAINED through its parent's
@@ -96,6 +110,15 @@ def prefix_page_digests(tokens, page_size: int) -> List[str]:
     [0, (j+1)*page_size); the fleet router computes these for an
     incoming prompt and matches them against each replica's advertised
     ``prefix_digest()`` to find the longest cached run."""
+    if page_size <= 0:
+        # Disaggregated transfer and router prefix matching are both
+        # meaningless without a paged cache; surface the misconfig at
+        # the digest layer too so no caller can half-work (the disagg
+        # fleet rejects page_size == 0 at construction — see
+        # serving/disagg.py DisaggConfigError).
+        raise ValueError(
+            f"prefix_page_digests requires a paged KV cache "
+            f"(page_size > 0), got page_size={page_size}")
     out: List[str] = []
     parent = ""
     for j in range((len(tokens) - 1) // page_size):
@@ -142,6 +165,14 @@ class _WaitQueue:
             if not self._items:
                 self._cond.wait(timeout)
             return bool(self._items)
+
+    def poke(self) -> None:
+        """Wake an idle ``wait_nonempty`` without enqueuing anything —
+        used by out-of-band scheduler work (KV-page imports) so an idle
+        batcher services it immediately instead of at the next 50ms
+        idle-poll tick."""
+        with self._cond:
+            self._cond.notify_all()
 
 
 @dataclass
@@ -373,6 +404,13 @@ class ContinuousBatcher:
             self.prefix_stats = {"lookups": 0, "hit_blocks": 0,
                                  "hit_tokens": 0, "evicted": 0}
             self._suffix_prefill_cache: dict = {}
+            # Disaggregated serving (serving/kv_transfer.py): KV pages
+            # pushed by a prefill replica wait here until the scheduler
+            # thread imports them — ALL pool/registry mutation stays on
+            # the scheduler thread, same contract as admission.
+            self._kv_imports: deque = deque()
+            self._kv_imports_lock = name_lock(
+                threading.Lock(), "batcher.kv_imports_lock")
         else:
             self._decode_model = model
         decode_model = self._decode_model
@@ -798,27 +836,8 @@ class ContinuousBatcher:
             # children always have refs <= their parent's.)
             return False
         while len(self._free_blocks) < need:
-            # Leaf-first LRU eviction: a block is evictable once no slot
-            # references it AND no registered child chains through it
-            # (children always have refs <= parent's, so freeing leaves
-            # unlocks parents on subsequent passes).
-            victim = min(
-                (b for b, m in self._block_meta.items()
-                 if m["refs"] == 0 and not m["children"]
-                 and b not in shared_set),
-                key=lambda b: self._block_meta[b]["last"], default=None)
-            if victim is None:
+            if not self._evict_one(shared_set):
                 return False
-            meta = self._block_meta.pop(victim)
-            del self._registry[meta["key"]]
-            self._block_digest.pop(victim, None)
-            if meta["parent"] is not None:
-                parent_meta = self._block_meta.get(meta["parent"])
-                if parent_meta is not None:
-                    parent_meta["children"].discard(victim)
-            self._free_blocks.append(victim)
-            self.prefix_stats["evicted"] += 1
-            self.telemetry["prefix_evicted"].inc()
         self._prefix_clock += 1
         for blk in shared:
             meta = self._block_meta[blk]
@@ -833,6 +852,31 @@ class ContinuousBatcher:
         priv = [self._free_blocks.pop() for _ in range(need)]
         self._slot_blocks[slot] = shared + priv
         self._slot_shared[slot] = len(shared)
+        return True
+
+    def _evict_one(self, protect: set) -> bool:
+        """Evict ONE cached block back to the free list (leaf-first
+        LRU): a block is evictable once no slot references it AND no
+        registered child chains through it (children always have
+        refs <= parent's, so freeing leaves unlocks parents on
+        subsequent passes).  Scheduler thread only."""
+        victim = min(
+            (b for b, m in self._block_meta.items()
+             if m["refs"] == 0 and not m["children"]
+             and b not in protect),
+            key=lambda b: self._block_meta[b]["last"], default=None)
+        if victim is None:
+            return False
+        meta = self._block_meta.pop(victim)
+        del self._registry[meta["key"]]
+        self._block_digest.pop(victim, None)
+        if meta["parent"] is not None:
+            parent_meta = self._block_meta.get(meta["parent"])
+            if parent_meta is not None:
+                parent_meta["children"].discard(victim)
+        self._free_blocks.append(victim)
+        self.prefix_stats["evicted"] += 1
+        self.telemetry["prefix_evicted"].inc()
         return True
 
     def _register_blocks(self, slot: int, tokens: List[int]) -> None:
@@ -879,6 +923,284 @@ class ContinuousBatcher:
             except RuntimeError:
                 continue
         return []
+
+    # -- disaggregated KV-page transfer (serving/kv_transfer.py) -----------
+    def free_blocks(self) -> int:
+        """Pool blocks not reserved by any live slot (cached refcount-0
+        blocks count as free: they are evictable on demand).  Read from
+        HTTP threads; a momentarily stale value only skews routing, so
+        no lock is taken."""
+        if self.page_size <= 0:
+            return 0
+        for _ in range(8):
+            try:
+                cached = sum(1 for m in self._block_meta.values()
+                             if m["refs"] == 0)
+                return len(self._free_blocks) + cached
+            except RuntimeError:
+                continue
+        return len(self._free_blocks)
+
+    def export_kv_pages(self, digests: List[str]) -> List[dict]:
+        """Snapshot the requested prefix-cache pages for transfer to a
+        decode replica: for each chain digest this replica has
+        registered, the page's tokens, parent digest, and raw pool
+        K/V leaves (numpy, host-side).
+
+        Read-only over the immutable cache tree, so it is safe from
+        HTTP threads while the scheduler ticks; a block that is evicted
+        and reallocated mid-export is caught by re-checking its digest
+        after the leaf gather and dropped (best-effort protocol — a
+        missing page just means the importer prefills that span)."""
+        import numpy as np
+        if self.page_size <= 0:
+            raise ValueError(
+                "export_kv_pages requires the paged KV cache "
+                "(page_size > 0)")
+        cache = self._cache  # immutable tree; ticks swap the reference
+        by_digest: dict = {}
+        for _ in range(8):
+            try:
+                by_digest = {d: b
+                             for b, d in list(self._block_digest.items())}
+                break
+            except RuntimeError:
+                continue
+
+        jnp = self._jnp
+        entries: List[tuple] = []  # (digest, blk, parent, tokens)
+        for digest in digests:
+            blk = by_digest.get(digest)
+            if blk is None:
+                continue
+            meta = self._block_meta.get(blk)
+            if meta is None:
+                continue
+            parent_blk = meta["parent"]
+            parent = ("" if parent_blk is None
+                      else self._block_digest.get(parent_blk, ""))
+            entries.append((digest, blk, parent,
+                            [int(t) for t in meta["key"][1]]))
+
+        leaf_paths: List[tuple] = []
+
+        def walk(node, prefix):
+            if "pool_key" in node:
+                for name in node:
+                    if name.startswith("pool_"):
+                        leaf_paths.append((prefix + name, node[name]))
+                return
+            for k in node:
+                walk(node[k], prefix + k + "/")
+
+        walk(cache, "")
+
+        pages: List[dict] = []
+        for off in range(0, len(entries), _EXPORT_WAVE_WIDTH):
+            wave = entries[off:off + _EXPORT_WAVE_WIDTH]
+            blks = [e[1] for e in wave]
+            # Batched fixed-width gather, mirroring the import-side
+            # scatter: padding to the wave width keeps it at ONE
+            # compiled program per leaf shape, and batching keeps it at
+            # a few dispatches per wave — a per-page eager gather costs
+            # two GIL-contended dispatches per page, which at 32k
+            # tokens (2k pages) is minutes of export under live decode
+            # traffic, starving the dispatching router past its
+            # upstream timeout.
+            pad = blks + [blks[-1]] * (_EXPORT_WAVE_WIDTH - len(blks))
+            idx = jnp.asarray(pad)
+            rows = {path: np.asarray(leaf[idx])
+                    for path, leaf in leaf_paths}
+            for i, (digest, blk, parent, tokens) in enumerate(wave):
+                if self._block_digest.get(blk) != digest:
+                    continue  # evicted/reallocated mid-gather: drop
+                pages.append({"digest": digest, "parent": parent,
+                              "tokens": tokens,
+                              "leaves": {path: arr[i]
+                                         for path, arr in rows.items()}})
+                self.telemetry["kv_pages_exported"].inc()
+        return pages
+
+    def import_kv_pages(self, pages: List[dict],
+                        timeout: float = 30.0) -> dict:
+        """Install transferred KV pages into this replica's pool and
+        registry (decode-replica side).  Called from HTTP threads: the
+        pages are queued for the scheduler thread — the only thread
+        allowed to mutate the pool — and this call blocks until that
+        import wave completes.  Returns per-page accounting
+        ``{"imported", "deduped", "rejected"}``."""
+        if self.page_size <= 0:
+            raise ValueError(
+                "import_kv_pages requires the paged KV cache "
+                "(page_size > 0)")
+        if self._stop.is_set():
+            raise self._shutdown_error()
+        result = {"imported": 0, "deduped": 0, "rejected": 0}
+        done = threading.Event()
+        with self._kv_imports_lock:
+            self._kv_imports.append((pages, result, done))
+        self._queue.poke()
+        if not done.wait(timeout):
+            raise TimeoutError("KV-page import timed out")
+        if self._stop.is_set() and self.fatal_error is not None:
+            raise self._shutdown_error()
+        return result
+
+    def _drain_kv_imports(self) -> None:
+        """Scheduler thread: install every queued KV-page wave.  Pages
+        arrive parent-first (chain order); each is digest-verified and
+        registered exactly like a locally-prefilled block, then the
+        whole wave's K/V data lands in ONE gathered ``.at[blks].set``
+        per pool leaf — a per-page functional update would copy the
+        entire pool per page, turning a long-prompt transfer (32k
+        tokens = 2k pages) into gigabytes of memcpy.  Staged blocks are
+        unreadable-by-construction until the scatter lands: prefix
+        matching happens on this same thread, strictly after this
+        method returns.  Best-effort: a page whose parent is missing or
+        whose digest fails verification is rejected (its descendants
+        will be too), and pool exhaustion rejects rather than stealing
+        blocks from live slots."""
+        while True:
+            with self._kv_imports_lock:
+                if not self._kv_imports:
+                    return
+                pages, result, done = self._kv_imports.popleft()
+            protected: set = set()
+            staged: List[tuple] = []  # (blk, wire leaves dict)
+            try:
+                shapes = self._pool_leaf_shapes()
+                for page in pages:
+                    verdict, blk = self._stage_import(page, protected,
+                                                      shapes)
+                    result[verdict] += 1
+                    if verdict == "imported":
+                        staged.append((blk, page.get("leaves", {})))
+                        self.telemetry["kv_pages_imported"].inc()
+                self._scatter_staged(staged)
+            except Exception as exc:
+                # Import shares the cache tree with decode ticks; a
+                # failure here (device error mid-scatter) poisons it
+                # the same way a failed donated prefill does — fail the
+                # batcher loudly, never serve from a half-written pool.
+                self._tick_fatal(exc, "kv-import")
+                return
+            finally:
+                done.set()
+
+    def _pool_leaf_shapes(self) -> dict:
+        """Leaf path -> per-block shape of every pool_* array (a
+        shape-only walk of the cache tree; no data touched)."""
+        shapes: dict = {}
+
+        def walk(node, prefix):
+            if "pool_key" in node:
+                for name, leaf in node.items():
+                    if name.startswith("pool_"):
+                        shapes[prefix + name] = tuple(leaf.shape[1:])
+                return
+            for k in node:
+                walk(node[k], prefix + k + "/")
+
+        walk(self._cache, "")
+        return shapes
+
+    def _stage_import(self, page: dict, protected: set,
+                      shapes: dict) -> tuple:
+        """Verify one transferred page and claim a pool block for it.
+        Returns ``(verdict, blk)``; on "imported" the block is
+        REGISTERED (so later pages in the wave can chain through it as
+        a parent) but its data is not yet in the pool — the caller
+        batch-scatters every staged block before the scheduler does
+        anything else."""
+        import numpy as np
+        tokens = [int(t) for t in page.get("tokens", ())]
+        digest = page.get("digest", "")
+        parent_digest = page.get("parent", "")
+        if (len(tokens) != self.page_size
+                or _page_digest(parent_digest, tokens) != digest):
+            self.telemetry["kv_import_rejected"].labels(
+                "digest_mismatch").inc()
+            return "rejected", None
+        # Parent chain: root pages have parent ""; others must chain
+        # through an already-registered block (shipped parent-first or
+        # already cached here).
+        parent_blk: Optional[int] = None
+        if parent_digest:
+            for b, d in self._block_digest.items():
+                if d == parent_digest:
+                    parent_blk = b
+                    break
+            if parent_blk is None:
+                self.telemetry["kv_import_rejected"].labels(
+                    "missing_parent").inc()
+                return "rejected", None
+        key = (parent_blk, tuple(tokens))
+        if key in self._registry or digest in self._block_digest.values():
+            return "deduped", None
+        leaves = page.get("leaves", {})
+        for path, shape in shapes.items():
+            arr = leaves.get(path)
+            if arr is None or tuple(np.shape(arr)) != shape:
+                self.telemetry["kv_import_rejected"].labels(
+                    "shape").inc()
+                return "rejected", None
+        if not self._free_blocks and not self._evict_one(protected):
+            self.telemetry["kv_import_rejected"].labels(
+                "pool_exhausted").inc()
+            return "rejected", None
+        blk = self._free_blocks.pop()
+        self._prefix_clock += 1
+        self._registry[key] = blk
+        self._block_meta[blk] = {"key": key, "refs": 0,
+                                 "last": self._prefix_clock,
+                                 "parent": parent_blk, "children": set()}
+        self._block_digest[blk] = digest
+        if parent_blk is not None and parent_blk in self._block_meta:
+            self._block_meta[parent_blk]["children"].add(blk)
+        protected.add(blk)
+        return "imported", blk
+
+    def _scatter_staged(self, staged: List[tuple]) -> None:
+        """Land an import wave's K/V data: one gathered functional
+        update per pool leaf (every staged page was shape-verified)."""
+        if not staged:
+            return
+        import numpy as np
+        jnp = self._jnp
+        # Pad every wave to the FIXED import width by repeating the
+        # last entry (same index, same values — the duplicate write is
+        # idempotent), chunking oversized batches first.
+        # `.at[blks].set` compiles one XLA program per distinct wave
+        # width; unpadded, every transfer's unique page count would
+        # compile a fresh scatter under the device lock — a compile
+        # storm that stalls decode for seconds per import.  Fixed
+        # width = exactly one program per leaf for the replica's
+        # lifetime.  The width is deliberately NARROW: the device lock
+        # is dropped between waves, so a live decode stream on this
+        # replica stalls for at most one narrow scatter, not one full
+        # 64-page transfer batch (which measurably moves decode p99
+        # during a 32k-token import).
+        for off in range(0, len(staged), _IMPORT_WAVE_WIDTH):
+            wave = staged[off:off + _IMPORT_WAVE_WIDTH]
+            wave = wave + [wave[-1]] * (_IMPORT_WAVE_WIDTH - len(wave))
+            blks = jnp.asarray([blk for blk, _ in wave])
+
+            def scatter(node, prefix):
+                if "pool_key" in node:
+                    out = dict(node)
+                    for name, leaf in node.items():
+                        if not name.startswith("pool_"):
+                            continue
+                        stack = np.stack([lv[prefix + name]
+                                          for _, lv in wave])
+                        out[name] = leaf.at[blks].set(
+                            jnp.asarray(stack).astype(leaf.dtype))
+                    return out
+                return {k: scatter(node[k], prefix + k + "/")
+                        for k in node}
+
+            with self._device_lock:
+                self._cache = scatter(self._cache, "")
 
     def _retire_slot(self, slot: int) -> None:
         """Drop the slot's block references and point its table back at
@@ -1322,6 +1644,13 @@ class ContinuousBatcher:
                     self._retire_slot(i)
 
         while not self._stop.is_set():
+            # Transferred KV pages (disaggregated serving) install
+            # before this tick's admissions, so a /generate that raced
+            # its own page push still hits the prefix cache.
+            if self.page_size > 0 and self._kv_imports:
+                self._drain_kv_imports()
+                if self._stop.is_set():
+                    break
             # Pipelined dispatch-ahead: enqueue step k+1 from step k's
             # still-on-device tokens BEFORE fetching step k, so the
             # device computes k+1 while the host runs step k's
@@ -1542,6 +1871,13 @@ class ContinuousBatcher:
         if deferred is not None:
             deferred.error = self._shutdown_error()
             deferred.done.set()
+        if self.page_size > 0:
+            # Unblock KV-page importers waiting on a dead scheduler
+            # (import_kv_pages re-checks fatal state after the event).
+            with self._kv_imports_lock:
+                while self._kv_imports:
+                    _, _, done = self._kv_imports.popleft()
+                    done.set()
         while True:
             try:
                 req = self._queue.get_nowait()

@@ -1,0 +1,445 @@
+"""Disaggregated prefill/decode serve fleet (ISSUE 17).
+
+Prefill is compute-bound (one long matmul-heavy pass over the prompt);
+decode is memory-bandwidth-bound (one token per tick, weights + KV
+streamed every step).  On a unified replica the two interfere: a 32k
+chunked prefill holds the device lock through admission and every
+decode stream on that replica stalls for the duration.  This module
+splits each model's replicas into two pools —
+
+- **prefill pool**: replicas that ONLY run chunked prefill.  The
+  router sends them the prompt with ``max_new_tokens=1``; the request
+  retires at admission (zero decode ticks) and the populated KV pages
+  are pushed to the chosen decode replica over the content-addressed
+  page-transfer channel (serving/kv_transfer.py).  Pages the decode
+  replica already advertises (prefix_page_digests chain) are never
+  shipped.
+- **decode pool**: replicas that serve /generate.  A transferred
+  prefix is a prefix-cache hit, so the decode replica prefills only
+  the suffix the transfer did not cover — output stays byte-identical
+  to unified serving.
+
+The router schedules the stages independently (prefill by queue
+depth, decode by free KV blocks — serving/router.py), so a long
+prompt saturates a prefill replica while decode p99 stands still.
+
+Multi-model + weight paging + scale-to-zero: each model is a pool
+pair charged against a PR 9 ClusterQueue through the
+:class:`~..sched.capacity.ChipLedger`.  An idle model (no in-flight
+requests past its idle timeout) is *paged out* — replicas stopped,
+chips released back to the queue where training gangs can take them —
+and woken synchronously by the router's wake-on-traffic hook when the
+next request for it arrives (the requester pays the measured cold
+start).  The measured cold-start cost is priced into the page-out
+decision: a model that is expensive to wake must be idle
+proportionally longer before it is drained.
+
+A :class:`PoolRebalancer` thread runs the
+:class:`~..sched.elastic.RatioBalancer` per model, moving one replica
+at a time between the prefill and decode pools as the live
+prefill/decode token ratio drifts — the serving twin of PR 15's
+ElasticResizer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..analysis.lockcheck import name_lock
+from ..sched.capacity import ChipLedger
+from ..sched.elastic import RatioBalancer
+from ..telemetry import flight
+from .router import FleetRouter
+
+
+class DisaggConfigError(ValueError):
+    """A disaggregated fleet was configured in a way that would
+    silently degrade to unified serving (the failure mode ISSUE 17's
+    fail-fast satellite forbids)."""
+
+
+@dataclass
+class ModelPoolSpec:
+    """One model's slice of the fleet.
+
+    ``server_factory(spec, role) -> InferenceServer`` must build an
+    UNstarted server whose ``kv_page_size`` equals ``page_size`` and
+    whose ``role``/``model_name`` match what the fleet asks for —
+    the fleet validates the page size up front (fail fast) and the
+    server constructor enforces role/paging consistency again.
+    """
+    name: str
+    server_factory: Callable
+    page_size: int
+    prefill_replicas: int = 1
+    decode_replicas: int = 2
+    chips_per_replica: int = 1
+    queue: str = "serve"
+    #: Seconds with zero in-flight requests before the model is paged
+    #: out (scale-to-zero).  ``None`` keeps the model resident forever.
+    idle_timeout_s: Optional[float] = None
+    balancer: RatioBalancer = field(default_factory=RatioBalancer)
+
+
+def validate_spec(spec: ModelPoolSpec, unified: bool = False) -> None:
+    """Fail-fast config validation (ISSUE 17 satellite): a disagg pool
+    pair over an unpaged cache has no KV pages to transfer and would
+    silently serve unified — reject it loudly at build time instead."""
+    if not unified and spec.page_size <= 0:
+        raise DisaggConfigError(
+            f"model {spec.name!r}: disaggregated prefill/decode serving"
+            f" requires a paged KV cache (page_size > 0), got"
+            f" page_size={spec.page_size}; run the fleet with"
+            f" unified=True if you want unpaged serving")
+    if spec.prefill_replicas < (0 if unified else 1):
+        raise DisaggConfigError(
+            f"model {spec.name!r}: prefill_replicas must be >= 1")
+    if spec.decode_replicas < 1:
+        raise DisaggConfigError(
+            f"model {spec.name!r}: decode_replicas must be >= 1")
+    if spec.chips_per_replica < 1:
+        raise DisaggConfigError(
+            f"model {spec.name!r}: chips_per_replica must be >= 1")
+
+
+class DisaggServeFleet:
+    """Multi-model disaggregated serve fleet in one process (see
+    module docstring).  ``unified=True`` runs the SAME specs as a
+    single unified pool per model (prefill+decode replica budget, all
+    role="unified") — the chip-parity baseline bench_disagg.py
+    compares against."""
+
+    def __init__(self, models: List[ModelPoolSpec],
+                 ledger: Optional[ChipLedger] = None,
+                 unified: bool = False,
+                 policy: str = "prefix",
+                 router_seed: int = 0,
+                 router_refresh: float = 0.1,
+                 rebalance_interval: float = 0.5,
+                 reap_interval: float = 0.25,
+                 cold_start_price: float = 2.0,
+                 wake_timeout: float = 120.0):
+        if not models:
+            raise DisaggConfigError("fleet needs at least one model")
+        seen = set()
+        for spec in models:
+            if spec.name in seen:
+                raise DisaggConfigError(
+                    f"duplicate model name {spec.name!r}")
+            seen.add(spec.name)
+            validate_spec(spec, unified=unified)
+        self.models: Dict[str, ModelPoolSpec] = {
+            s.name: s for s in models}
+        self.ledger = ledger
+        self.unified = bool(unified)
+        self.rebalance_interval = float(rebalance_interval)
+        self.reap_interval = float(reap_interval)
+        # Cold-start pricing for page-out: a model must be idle for
+        # idle_timeout + cold_start_price * EWMA(cold start seconds)
+        # before it is drained — expensive wakes buy residency.
+        self.cold_start_price = float(cold_start_price)
+        self.wake_timeout = float(wake_timeout)
+        self.router = FleetRouter(policy=policy, seed=router_seed,
+                                  refresh_interval=router_refresh)
+        self.router.set_waker(self._wake)
+        self._lock = name_lock(threading.RLock(), "disagg.fleet")
+        # (model, role) -> [(replica_name, server), ...]
+        self._pools: Dict[Tuple[str, str], list] = {}
+        # Pool sizes survive a sleep/wake cycle so a rebalanced split
+        # is not lost to scale-to-zero.
+        self._pool_sizes: Dict[str, Dict[str, int]] = {}
+        self._awake: Dict[str, bool] = {}
+        self._awake_since: Dict[str, float] = {}
+        self._cold_ewma: Dict[str, float] = {}
+        self._seq = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    # -- replica plumbing --------------------------------------------------
+    def _roles_for(self, spec: ModelPoolSpec) -> Dict[str, int]:
+        sizes = self._pool_sizes.get(spec.name)
+        if sizes is None:
+            if self.unified:
+                sizes = {"unified":
+                         spec.prefill_replicas + spec.decode_replicas}
+            else:
+                sizes = {"prefill": spec.prefill_replicas,
+                         "decode": spec.decode_replicas}
+            self._pool_sizes[spec.name] = sizes
+        return sizes
+
+    def _spawn(self, spec: ModelPoolSpec, role: str) -> None:
+        # caller holds self._lock
+        srv = spec.server_factory(spec, role)
+        srv.start()
+        self._seq += 1
+        name = f"{spec.name}.{role}.{self._seq}"
+        self._pools.setdefault((spec.name, role), []).append((name, srv))
+        self.router.add_replica(name, srv.url, role=role,
+                                model=spec.name)
+        self._set_pool_gauge(spec.name, role)
+
+    def _retire(self, model: str, role: str) -> bool:
+        # caller holds self._lock; newest replica first (its prefix
+        # cache is the coldest of the pool).
+        pool = self._pools.get((model, role)) or []
+        if not pool:
+            return False
+        name, srv = pool.pop()
+        self.router.remove_replica(name)
+        try:
+            srv.stop()
+        except Exception as exc:
+            flight.record("serving", "disagg_replica_stop_error",
+                          replica=name, error=repr(exc))
+        self._set_pool_gauge(model, role)
+        return True
+
+    def _set_pool_gauge(self, model: str, role: str) -> None:
+        self.router.telemetry["pool_replicas"].labels(model, role).set(
+            len(self._pools.get((model, role)) or []))
+
+    def pool_sizes(self, model: str) -> Dict[str, int]:
+        with self._lock:
+            return {role: len(pool) for (m, role), pool
+                    in self._pools.items() if m == model}
+
+    def replica_urls(self, model: Optional[str] = None,
+                     role: Optional[str] = None) -> List[Tuple[str, str, str]]:
+        """Snapshot of live replicas as ``(model, role, url)`` tuples,
+        optionally filtered — the ops surface for cache pre-positioning
+        (warming a document working set on every replica) and direct
+        replica probes."""
+        with self._lock:
+            return [(m, r, srv.url)
+                    for (m, r), pool in self._pools.items()
+                    for _, srv in pool
+                    if (model is None or m == model)
+                    and (role is None or r == role)]
+
+    # -- model lifecycle ---------------------------------------------------
+    def _bring_up(self, spec: ModelPoolSpec) -> bool:
+        """Charge chips and start every pool of a model.  All-or-
+        nothing: a failed charge or spawn tears the model back down."""
+        with self._lock:
+            if self._awake.get(spec.name):
+                return True
+            sizes = self._roles_for(spec)
+            chips = sum(sizes.values()) * spec.chips_per_replica
+            if self.ledger is not None:
+                if not self.ledger.charge(spec.name, spec.queue, chips):
+                    flight.record("serving", "model_wake_denied",
+                                  model=spec.name, queue=spec.queue,
+                                  chips=chips)
+                    return False
+            try:
+                for role, count in sizes.items():
+                    for _ in range(count):
+                        self._spawn(spec, role)
+            except Exception as exc:
+                flight.record("serving", "model_bring_up_failed",
+                              model=spec.name, error=repr(exc))
+                self._tear_down(spec.name)
+                return False
+            self._awake[spec.name] = True
+            self._awake_since[spec.name] = time.monotonic()
+        return True
+
+    def _tear_down(self, model: str) -> None:
+        # caller holds self._lock
+        for (m, role) in [k for k in self._pools if k[0] == model]:
+            while self._retire(m, role):
+                pass
+        self._awake[model] = False
+        if self.ledger is not None:
+            self.ledger.release(model)
+
+    def _wake(self, model: str) -> bool:
+        """Router wake-on-traffic hook (synchronous; the requester
+        pays).  Returns True once the model's decode path is serving
+        again."""
+        spec = self.models.get(model)
+        if spec is None:
+            return False  # unknown model: clean 503
+        t0 = time.perf_counter()
+        if not self._bring_up(spec):
+            return False
+        ok = self._wait_serving(model, self.wake_timeout)
+        cold = time.perf_counter() - t0
+        if ok:
+            prev = self._cold_ewma.get(model)
+            self._cold_ewma[model] = (cold if prev is None
+                                      else 0.5 * prev + 0.5 * cold)
+            flight.record("serving", "model_wake", model=model,
+                          seconds=round(cold, 3))
+        return ok
+
+    def page_out(self, model: str) -> bool:
+        """Drain an idle model: stop its replicas and release its
+        chips back to the ClusterQueue (scale-to-zero page-out).
+        Refuses while requests are in flight."""
+        stats = self.router.model_stats().get(model)
+        if stats is not None and stats["inflight"] > 0:
+            return False
+        with self._lock:
+            if not self._awake.get(model):
+                return False
+            self._tear_down(model)
+        flight.record("serving", "model_page_out", model=model)
+        return True
+
+    def awake(self, model: str) -> bool:
+        with self._lock:
+            return bool(self._awake.get(model))
+
+    def cold_start_ewma(self, model: str) -> Optional[float]:
+        return self._cold_ewma.get(model)
+
+    # -- background loops --------------------------------------------------
+    def _reap_once(self) -> None:
+        now = time.monotonic()
+        stats = self.router.model_stats()
+        for model, spec in self.models.items():
+            if spec.idle_timeout_s is None or not self.awake(model):
+                continue
+            s = stats.get(model)
+            last = max(self._awake_since.get(model, now),
+                       (s or {}).get("last_request", 0.0))
+            if s is not None and s["inflight"] > 0:
+                continue
+            threshold = spec.idle_timeout_s + self.cold_start_price * \
+                self._cold_ewma.get(model, 0.0)
+            if now - last > threshold:
+                self.page_out(model)
+
+    def _reap_loop(self) -> None:
+        while not self._stop.wait(self.reap_interval):
+            try:
+                self._reap_once()
+            except Exception as exc:
+                flight.record("serving", "disagg_reaper_error",
+                              error=repr(exc))
+
+    def rebalance_once(self) -> List[dict]:
+        """One RatioBalancer pass over every awake model; applies at
+        most one replica move per model.  Returns the applied moves."""
+        applied: List[dict] = []
+        if self.unified:
+            return applied
+        stats = self.router.model_stats()
+        for model, spec in self.models.items():
+            if not self.awake(model):
+                continue
+            s = stats.get(model)
+            if s is None:
+                continue
+            sizes = self.pool_sizes(model)
+            move = spec.balancer.observe(
+                s["prefill_tokens"], s["decode_tokens"],
+                sizes.get("prefill", 0), sizes.get("decode", 0))
+            if move is None:
+                continue
+            t0 = time.perf_counter()
+            with self._lock:
+                if not self._awake.get(model):
+                    spec.balancer.settle(move, "model_paged_out")
+                    continue
+                if not self._retire(model, move["from"]):
+                    spec.balancer.settle(move, "source_pool_empty")
+                    continue
+                try:
+                    self._spawn(spec, move["to"])
+                except Exception as exc:
+                    # Give the replica back to its old pool rather
+                    # than leak a chip's worth of capacity.
+                    flight.record("serving", "pool_rebalance_failed",
+                                  model=model, error=repr(exc))
+                    self._spawn(spec, move["from"])
+                    spec.balancer.settle(
+                        move, "spawn_failed",
+                        time.perf_counter() - t0)
+                    continue
+                self._pool_sizes[model] = {
+                    role: len(pool) for (m, role), pool
+                    in self._pools.items() if m == model and pool}
+            spec.balancer.settle(move, "applied",
+                                 time.perf_counter() - t0)
+            applied.append(move)
+        return applied
+
+    def _rebalance_loop(self) -> None:
+        while not self._stop.wait(self.rebalance_interval):
+            try:
+                self.rebalance_once()
+            except Exception as exc:
+                flight.record("serving", "disagg_rebalancer_error",
+                              error=repr(exc))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "DisaggServeFleet":
+        self.router.start()
+        for spec in self.models.values():
+            if not self._bring_up(spec):
+                self.stop()
+                raise RuntimeError(
+                    f"model {spec.name!r} failed to start (insufficient"
+                    f" chips in queue {spec.queue!r}?)")
+        for target, tag in ((self._reap_loop, "disagg-reaper"),
+                            (self._rebalance_loop, "disagg-rebalancer")):
+            t = threading.Thread(target=target, daemon=True, name=tag)
+            t.start()
+            self._threads.append(t)
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+        with self._lock:
+            for model in list(self.models):
+                if self._awake.get(model):
+                    self._tear_down(model)
+        self.router.stop()
+        self._started = False
+
+    def __enter__(self) -> "DisaggServeFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _wait_serving(self, model: str, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for r in self.router.healthy_replicas():
+                if r.role != "prefill" and r.serves(model):
+                    return True
+            time.sleep(0.02)
+        return False
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        """Block until every model's full replica complement is
+        healthy in the router."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            healthy = self.router.healthy_replicas()
+            want = ok = 0
+            with self._lock:
+                for model in self.models:
+                    if not self._awake.get(model):
+                        continue
+                    expect = sum(
+                        self._pool_sizes.get(model, {}).values())
+                    want += expect
+                    ok += min(expect, sum(
+                        1 for r in healthy if r.model == model))
+            if want and ok >= want:
+                return
+            time.sleep(0.05)
+        raise TimeoutError("disagg fleet never reached full strength")

@@ -254,7 +254,8 @@ class LocalServeFleet:
         if job.spec.autoscale is not None:
             self.autoscaler = ServeAutoscaler(
                 self.client, self.namespace, job.metadata.name,
-                self.router, poll_interval=autoscaler_poll)
+                self.router, poll_interval=autoscaler_poll,
+                model=job.metadata.name)
         # LocalCluster-shape for the chaos engine + default invariants.
         self.kubelet = None
         self._started = False

@@ -1,0 +1,157 @@
+"""Paged KV-transfer protocol for disaggregated prefill/decode serving.
+
+Disaggregation (serving/disagg.py, docs/SERVING.md) splits a model's
+replicas into a *prefill* pool (compute-bound: chunked prefill only,
+requests retire at admission) and a *decode* pool (memory-bandwidth
+bound: steady-state ticks only).  The handoff between them is the
+prompt's KV cache — and because the paged cache is already
+content-addressed by `prefix_page_digests` chain digests
+(serving/batcher.py), the handoff is a *content-addressed page
+transfer*: the router tells the prefill replica which chain digests the
+chosen decode replica already advertises, and only the missing pages
+ever cross the wire.  A page that was shipped once (or computed locally
+by the decode replica) is never shipped again.
+
+Wire format (POST /kv/pages on the receiving replica, JSON):
+
+    {"pages": [{"digest":  "<blake2b-8 chain digest>",
+                "parent":  "<parent chain digest or ''>",
+                "tokens":  [<page_size ints>],
+                "leaves":  {"<cache-path>/pool_key":
+                              {"b64": ..., "dtype": ..., "shape": ...},
+                            ...}},
+               ...]}
+
+Pages are ordered parent-first so the receiver can rebuild the chain in
+one pass.  The receiver verifies every digest against its own
+`_page_digest` chain before installing — a transfer is *proposed*, not
+trusted — and the whole protocol is best-effort: any rejected page just
+means the decode replica prefills that span itself (correctness never
+depends on a transfer landing).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Dict, List, Optional
+from urllib import request as _urlreq
+
+import numpy as np
+
+#: Ceiling on pages per POST /kv/pages body; longer chains are shipped
+#: in consecutive parent-first batches so one 32k-token prompt cannot
+#: head-of-line-block a replica's HTTP handler on a single giant body.
+MAX_PAGES_PER_PUSH = 64
+
+
+class KVTransferError(RuntimeError):
+    """A page push failed in transport (the receiving replica is
+    unreachable or errored).  Callers fall back to decode-side
+    self-prefill — this error is flow control, not data loss."""
+
+
+def encode_leaf(arr) -> dict:
+    """One pool leaf (numpy/JAX array) -> JSON-safe dict."""
+    arr = np.asarray(arr)
+    return {"b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+            "dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def decode_leaf(spec: dict) -> np.ndarray:
+    """Inverse of :func:`encode_leaf` (raises on malformed specs —
+    the importer maps that to a rejected page, never a crash)."""
+    raw = base64.b64decode(spec["b64"])
+    return np.frombuffer(raw, dtype=np.dtype(spec["dtype"])).reshape(
+        spec["shape"]).copy()
+
+
+def encode_pages(pages: List[dict]) -> List[dict]:
+    """Batcher ``export_kv_pages`` output -> wire form."""
+    out = []
+    for page in pages:
+        out.append({"digest": page["digest"], "parent": page["parent"],
+                    "tokens": [int(t) for t in page["tokens"]],
+                    "leaves": {path: encode_leaf(leaf)
+                               for path, leaf in page["leaves"].items()}})
+    return out
+
+
+def decode_pages(wire: List[dict]) -> List[dict]:
+    """Wire form -> batcher ``import_kv_pages`` input.  A page whose
+    leaves fail to decode is dropped here (best-effort), so one corrupt
+    page cannot poison the rest of its batch."""
+    out = []
+    for page in wire:
+        try:
+            out.append({"digest": str(page["digest"]),
+                        "parent": str(page.get("parent", "")),
+                        "tokens": [int(t) for t in page["tokens"]],
+                        "leaves": {path: decode_leaf(spec)
+                                   for path, spec
+                                   in page["leaves"].items()}})
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+def payload_bytes(wire_pages: List[dict]) -> int:
+    """Serialized size of a wire-form page list (the router's
+    ``kv_transfer_bytes`` accounting)."""
+    return len(json.dumps({"pages": wire_pages}).encode())
+
+
+def push_pages(url: str, wire_pages: List[dict],
+               timeout: float = 30.0) -> dict:
+    """POST wire-form pages to ``url``/kv/pages in parent-first
+    batches.  Returns aggregate receiver accounting
+    ``{"imported", "deduped", "rejected", "bytes"}``."""
+    total = {"imported": 0, "deduped": 0, "rejected": 0, "bytes": 0}
+    for off in range(0, len(wire_pages), MAX_PAGES_PER_PUSH):
+        batch = wire_pages[off:off + MAX_PAGES_PER_PUSH]
+        body = json.dumps({"pages": batch}).encode()
+        req = _urlreq.Request(
+            url.rstrip("/") + "/kv/pages", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with _urlreq.urlopen(req, timeout=timeout) as resp:
+                reply = json.loads(resp.read().decode())
+        except Exception as exc:  # urllib raises a small zoo here
+            raise KVTransferError(
+                f"KV-page push to {url} failed: {exc}") from exc
+        for key in ("imported", "deduped", "rejected"):
+            total[key] += int(reply.get(key, 0))
+        total["bytes"] += len(body)
+    return total
+
+
+def transfer_pages(batcher, digests: List[str], dest_url: str,
+                   have: Optional[List[str]] = None,
+                   timeout: float = 30.0) -> Dict[str, int]:
+    """The prefill-replica side of a disaggregated handoff: export the
+    chain pages for ``digests`` that the destination does NOT already
+    advertise (``have``), and push them parent-first to ``dest_url``.
+
+    Returns ``{"shipped", "deduped", "imported", "rejected",
+    "bytes"}`` — ``deduped`` counts pages never exported because the
+    destination's advertised digest set already contained them (the
+    content-addressed dedup that keeps warm prefixes off the wire)."""
+    have_set = set(have or ())
+    missing = [d for d in digests if d not in have_set]
+    stats = {"shipped": 0, "deduped": len(digests) - len(missing),
+             "imported": 0, "rejected": 0, "bytes": 0}
+    if not missing:
+        return stats
+    pages = batcher.export_kv_pages(missing)
+    if not pages:
+        return stats
+    wire = encode_pages(pages)
+    reply = push_pages(dest_url, wire, timeout=timeout)
+    stats["shipped"] = len(wire)
+    stats["imported"] = reply["imported"]
+    # Receiver-side dedup (it learned the page since `have` was
+    # snapshotted) folds into the dedup figure too.
+    stats["deduped"] += reply["deduped"]
+    stats["rejected"] = reply["rejected"]
+    stats["bytes"] = reply["bytes"]
+    return stats

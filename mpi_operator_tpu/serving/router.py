@@ -71,7 +71,8 @@ MAX_SESSIONS = 65536
 class _Replica:
     """Router-side view of one fleet member."""
 
-    def __init__(self, name: str, url: str):
+    def __init__(self, name: str, url: str, role: str = "unified",
+                 model: str = ""):
         self.name = name
         self.url = url  # http://host:port
         self.alive = True
@@ -81,10 +82,22 @@ class _Replica:
         self.slots = 0
         self.page_size = 0
         self.digests: set = set()     # advertised prefix-cache index
+        # Disaggregated serving (ISSUE 17): the stage this replica runs
+        # ("unified" serves both), the model it holds ("" = any), and
+        # its last-polled free pool blocks — the decode-placement
+        # signal (a decode replica out of blocks defers admissions).
+        self.role = role
+        self.model = model
+        self.free_blocks = 0
 
     @property
     def load(self) -> float:
         return self.outstanding + self.queue_depth + self.active_slots
+
+    def serves(self, model: str) -> bool:
+        """Model match: a replica with no declared model serves any
+        request; a request with no model accepts any replica."""
+        return not self.model or not model or self.model == model
 
     def host_port(self) -> tuple:
         hostport = self.url.split("//", 1)[-1]
@@ -159,6 +172,13 @@ class FleetRouter:
         self.telemetry = new_router_metrics(self.telemetry_registry)
         self._replicas: Dict[str, _Replica] = {}
         self._sessions: Dict[str, str] = {}  # session -> replica name
+        # Multi-model serving (ISSUE 17): per-model traffic counters
+        # (the rebalancer's prefill/decode ratio signal and the idle
+        # reaper's last-arrival clock), measured cold starts, and the
+        # scale-to-zero wake hook (set_waker).
+        self._model_stats: Dict[str, dict] = {}
+        self._cold_starts: Dict[str, List[float]] = {}
+        self._waker = None
         # Named hot lock: blocking here serializes every placement
         # (docs/ANALYSIS.md, lockcheck).
         self._lock = name_lock(threading.Lock(), "router.state")
@@ -180,9 +200,11 @@ class FleetRouter:
         record_build_info()
 
     # -- membership --------------------------------------------------------
-    def add_replica(self, name: str, url: str) -> None:
+    def add_replica(self, name: str, url: str, role: str = "unified",
+                    model: str = "") -> None:
         with self._lock:
-            self._replicas[name] = _Replica(name, url)
+            self._replicas[name] = _Replica(name, url, role=role,
+                                            model=model)
         self.refresh_replica(name)
         self._update_replica_gauge()
 
@@ -225,6 +247,148 @@ class FleetRouter:
             "per_replica": per,
         }
 
+    # -- multi-model accounting / scale-to-zero ---------------------------
+    def set_waker(self, waker) -> None:
+        """Install the scale-to-zero wake hook: ``waker(model) -> bool``
+        blocks until the model's replicas are serving (True) or the
+        wake failed (False).  With no waker installed, a request for a
+        fully-drained model is load-shed with 503 — the 503-vs-wake
+        decision is exactly whether this hook exists."""
+        self._waker = waker
+
+    def _model_stat(self, model: str) -> dict:
+        # caller holds self._lock
+        s = self._model_stats.get(model)
+        if s is None:
+            s = {"requests": 0, "prefill_tokens": 0, "decode_tokens": 0,
+                 "inflight": 0, "last_request": 0.0}
+            self._model_stats[model] = s
+        return s
+
+    def model_stats(self) -> Dict[str, dict]:
+        """Per-model traffic snapshot: cumulative prompt (prefill) and
+        emitted (decode) token counters — the pool rebalancer's ratio
+        signal — plus in-flight count and last-arrival time (the idle
+        reaper's drain signal)."""
+        with self._lock:
+            return {m: dict(s) for m, s in self._model_stats.items()}
+
+    def _note_arrival(self, payload: dict) -> str:
+        model = str(payload.get("model", "") or "")
+        prompt = len(self._prompt_row(payload))
+        with self._lock:
+            s = self._model_stat(model)
+            s["requests"] += 1
+            s["prefill_tokens"] += prompt
+            s["inflight"] += 1
+            s["last_request"] = time.monotonic()
+        return model
+
+    def _note_done(self, model: str, emitted: int) -> None:
+        with self._lock:
+            s = self._model_stat(model)
+            s["inflight"] -= 1
+            s["decode_tokens"] += int(emitted)
+
+    def _ensure_capacity(self, model: str) -> None:
+        """Scale-to-zero wake-on-traffic: when no decode-capable
+        replica exists for the request's model and a waker is
+        installed, wake the model SYNCHRONOUSLY (the requester pays
+        the cold start — measured and published per model) instead of
+        load-shedding with 503."""
+        with self._lock:
+            if any(r.alive and r.role != "prefill" and r.serves(model)
+                   for r in self._replicas.values()):
+                return
+            waker = self._waker
+        if waker is None:
+            return  # _pick will raise -> clean 503 load-shed
+        self.telemetry["model_wakes"].labels(model or "-").inc()
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            ok = bool(waker(model))
+        finally:
+            cold = time.perf_counter() - t0
+            if ok:
+                self.telemetry["cold_start_seconds"].labels(
+                    model or "-").observe(cold)
+                with self._lock:
+                    self._cold_starts.setdefault(model, []).append(cold)
+
+    def cold_start_stats(self) -> Dict[str, List[float]]:
+        """Measured cold-start durations by model (routing metrics
+        surface for the scale-to-zero acceptance gate; also exposed as
+        the mpi_operator_serve_cold_start_seconds histogram)."""
+        with self._lock:
+            return {m: list(v) for m, v in self._cold_starts.items()}
+
+    # -- disaggregated prefill stage --------------------------------------
+    def _dispatch_prefill(self, payload: dict, decode: _Replica,
+                          plan: dict, ctx) -> None:
+        """Run the prefill stage for a disaggregated request: pick the
+        least-queued prefill replica for the model and have it prefill
+        the prompt + push the pages the decode replica is missing.
+        Best-effort — any failure falls back to decode-side
+        self-prefill (the decode replica simply misses its prefix
+        cache), so correctness never rides on this path."""
+        missing = plan.get("missing") or []
+        if not missing:
+            return
+        model = plan.get("model", "")
+        with self._lock:
+            pool = [r for r in self._replicas.values()
+                    if r.alive and r.role == "prefill"
+                    and r.serves(model)]
+            if not pool:
+                return
+            # Prefill placement is queue-depth driven (ISSUE 17): the
+            # stage is compute-bound and FIFO, so shortest queue wins.
+            pf = min(pool, key=lambda r: (r.queue_depth + r.outstanding,
+                                          r.name))
+            pf.outstanding += 1
+        self.telemetry["disagg_prefills"].inc()
+        import http.client
+        try:
+            with default_tracer().span("disagg_prefill", ctx=ctx,
+                                       replica=pf.name):
+                host, port = pf.host_port()
+                conn = http.client.HTTPConnection(
+                    host, port, timeout=self.upstream_timeout)
+                body = json.dumps({
+                    "tokens": self._prompt_row(payload),
+                    "transfer": {"url": decode.url,
+                                 "have": plan.get("have") or []},
+                    "trace_context": payload.get("trace_context"),
+                }).encode()
+                try:
+                    conn.request(
+                        "POST", "/prefill", body=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    reply = json.loads(resp.read())
+                    status = resp.status
+                finally:
+                    conn.close()
+            if status != 200:
+                raise RuntimeError(reply.get("error", status))
+            self.telemetry["kv_pages_shipped"].inc(
+                int(reply.get("shipped", 0)))
+            self.telemetry["kv_pages_deduped"].inc(
+                int(reply.get("deduped", 0)))
+            self.telemetry["kv_transfer_bytes"].inc(
+                int(reply.get("bytes", 0)))
+            # The prefill replica now holds these pages too: advertise
+            # them so same-prefix requests dedup before the next poll.
+            with self._lock:
+                pf.digests.update(plan.get("digests") or [])
+        except Exception:
+            self.telemetry["disagg_fallback"].inc()
+            self._replica_dead(pf)  # transport death marks it dead
+        finally:
+            with self._lock:
+                pf.outstanding -= 1
+
     # -- replica state refresh --------------------------------------------
     def refresh_replica(self, name: str) -> bool:
         with self._lock:
@@ -254,6 +418,12 @@ class FleetRouter:
             # retire optimistic entries, or routing chases ghosts.
             r.digests = set(state.get("prefix_digests", ()))
             r.alive = bool(state.get("healthy", True))
+            r.free_blocks = int(state.get("free_blocks", 0))
+            # The replica's own identity wins over the add_replica
+            # hint (a pool rebalance restarts a replica under a new
+            # role; the router must follow, not remember).
+            r.role = str(state.get("role", r.role) or "unified")
+            r.model = str(state.get("model", r.model) or "")
             if r.page_size:
                 self._page_size = r.page_size
         self._update_replica_gauge()
@@ -280,9 +450,18 @@ class FleetRouter:
             tokens = tokens[0] if tokens else []
         return [int(t) for t in tokens]
 
-    def _pick(self, payload: dict, exclude=()) -> _Replica:
+    def _pick(self, payload: dict, exclude=(),
+              plan: Optional[dict] = None) -> _Replica:
         """Choose a replica for this request (see module docstring for
-        the policy ladder) and account the placement path."""
+        the policy ladder) and account the placement path.
+
+        Only decode-capable replicas (role "unified" or "decode",
+        model match) are candidates — prefill replicas never take
+        /generate.  When ``plan`` is given it is filled with the
+        disagg prefill stage to run BEFORE the relay: the prompt's
+        chain digests, the subset the chosen decode replica was
+        missing (pre-optimistic-extension, so dedup is honest), and
+        the winner's advertised ``have`` set for the transfer."""
         # Digest the prompt BEFORE taking the router lock: the hash is
         # a pure function of payload + page_size, and hashing long
         # prompts under the lock would serialize every placement and
@@ -292,26 +471,31 @@ class FleetRouter:
         if self.policy != "round_robin" and page > 0:
             digests = prefix_page_digests(self._prompt_row(payload),
                                           page)
+        model = str(payload.get("model", "") or "")
         with self._lock:
             candidates = [r for r in self._replicas.values()
-                          if r.alive and r.name not in exclude]
+                          if r.alive and r.name not in exclude
+                          and r.role != "prefill" and r.serves(model)]
             if not candidates:
-                raise RuntimeError("no healthy replicas")
+                raise RuntimeError(
+                    f"no healthy replicas"
+                    + (f" for model {model!r}" if model else ""))
             if self.policy == "round_robin":
                 self._rr_counter += 1
                 pick = candidates[self._rr_counter % len(candidates)]
                 self.telemetry["routed_total"].labels("rr").inc()
                 return pick
             session = payload.get("session")
+            pick = path = None
             if session is not None:
                 pinned = self._replicas.get(
                     self._sessions.get(str(session), ""))
                 if pinned is not None and pinned.alive \
-                        and pinned.name not in exclude:
-                    self.telemetry["routed_total"].labels("affinity").inc()
-                    return pinned
-            pick = path = None
-            if digests:
+                        and pinned.name not in exclude \
+                        and pinned.role != "prefill" \
+                        and pinned.serves(model):
+                    pick, path = pinned, "affinity"
+            if pick is None and digests:
                 best_hits = 0
                 best: List[_Replica] = []
                 for r in candidates:
@@ -330,11 +514,22 @@ class FleetRouter:
             if pick is None:
                 two = (self._rng.sample(candidates, 2)
                        if len(candidates) >= 2 else candidates)
-                pick = min(two, key=lambda r: r.load)
+                # Decode placement is block-pressure aware: load ties
+                # break toward the replica with more free KV blocks
+                # (ISSUE 17 — a decode replica out of blocks defers
+                # admissions even at queue depth 0).
+                pick = min(two, key=lambda r: (r.load, -r.free_blocks))
                 path = "p2c"
+            if plan is not None:
+                plan["digests"] = digests
+                plan["have"] = sorted(pick.digests)
+                plan["missing"] = [d for d in digests
+                                   if d not in pick.digests]
+                plan["model"] = model
             # Optimistic index extension: the pick will register these
-            # pages at admission; advertise them now so the next
-            # same-prefix request follows without waiting for a poll.
+            # pages at admission (or receive them over the KV-transfer
+            # channel); advertise them now so the next same-prefix
+            # request follows without waiting for a poll.
             pick.digests.update(digests)
             if session is not None:
                 self._sessions[str(session)] = pick.name
@@ -419,22 +614,34 @@ class FleetRouter:
         Returns (status, body-dict) for the front-door handler."""
         self.telemetry["requests_total"].inc()
         payload = self._prepare(payload)
+        model = self._note_arrival(payload)
         ctx = self._begin_trace(payload)
         start = time.perf_counter()
         start_wall = time.time()
+        emitted = 0
         try:
-            return self._relay_attempts(payload, ctx, start, start_wall)
+            status, body = self._relay_attempts(payload, ctx, start,
+                                                start_wall)
+            if status == 200:
+                rows = body.get("tokens") or []
+                emitted = sum(len(r) for r in rows
+                              if isinstance(r, (list, tuple)))
+            return status, body
         finally:
+            self._note_done(model, emitted)
             self._end_trace(ctx, start_wall, time.perf_counter() - start)
 
     def _relay_attempts(self, payload: dict, ctx: TraceContext,
                         start: float, start_wall: float) -> tuple:
+        self._ensure_capacity(str(payload.get("model", "") or ""))
         exclude: List[str] = []
         for attempt in range(2):
+            plan: dict = {}
             try:
                 with default_tracer().span("route", ctx=ctx,
                                            attempt=attempt):
-                    replica = self._pick(payload, exclude=exclude)
+                    replica = self._pick(payload, exclude=exclude,
+                                         plan=plan)
             except RuntimeError as exc:
                 # Lost means an ACCEPTED request died past its retry;
                 # a pre-dispatch 503 (no healthy replicas, nothing
@@ -443,6 +650,7 @@ class FleetRouter:
                 if attempt:
                     self.telemetry["requests_lost_total"].inc()
                 return 503, {"error": str(exc)}
+            self._dispatch_prefill(payload, replica, plan, ctx)
             with self._lock:
                 replica.outstanding += 1
             failed = False
@@ -491,6 +699,7 @@ class FleetRouter:
         pinned seed makes the replay exact)."""
         self.telemetry["requests_total"].inc()
         payload = self._prepare(payload)
+        model = self._note_arrival(payload)
         ctx = self._begin_trace(payload)
         start = time.perf_counter()
         start_wall = time.time()
@@ -519,29 +728,36 @@ class FleetRouter:
             except (BrokenPipeError, ConnectionResetError, OSError) as exc:
                 raise _ClientGone(str(exc)) from exc
 
+        emitted = [0]
         try:
             self._relay_stream_attempts(payload, ctx, start, start_wall,
-                                        emit, finish)
+                                        emit, finish, emitted)
         finally:
+            self._note_done(model, emitted[0])
             self._end_trace(ctx, start_wall,
                             time.perf_counter() - start, stream=True)
 
     def _relay_stream_attempts(self, payload: dict, ctx: TraceContext,
                                start: float, start_wall: float,
-                               emit, finish) -> None:
+                               emit, finish,
+                               emitted: Optional[list] = None) -> None:
+        self._ensure_capacity(str(payload.get("model", "") or ""))
         sent = 0          # tokens already forwarded to the client
         first_at = None
         exclude: List[str] = []
         for attempt in range(2):
+            plan: dict = {}
             try:
                 with default_tracer().span("route", ctx=ctx,
                                            attempt=attempt):
-                    replica = self._pick(payload, exclude=exclude)
+                    replica = self._pick(payload, exclude=exclude,
+                                         plan=plan)
             except RuntimeError as exc:
                 if attempt:  # see relay(): pre-dispatch 503 != lost
                     self.telemetry["requests_lost_total"].inc()
                 emit({"error": str(exc)})
                 return finish()
+            self._dispatch_prefill(payload, replica, plan, ctx)
             with self._lock:
                 replica.outstanding += 1
             died = False
@@ -582,6 +798,8 @@ class FleetRouter:
                                     self._trace_ttft(ctx, start_wall,
                                                      first_at - start)
                                 sent += 1
+                                if emitted is not None:
+                                    emitted[0] = sent
                                 emit(event)
                             elif "error" in event:
                                 # A live replica's error is the

@@ -119,6 +119,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(404, {"error": "not found"})
 
     def do_POST(self):
+        if self.path == "/prefill":
+            return self._prefill()
+        if self.path == "/kv/pages":
+            return self._kv_pages()
         if self.path != "/generate":
             return self._respond(404, {"error": "not found"})
         server: "InferenceServer" = self.server.inference  # type: ignore
@@ -143,6 +147,48 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._stream(server, tokens, kwargs)
             out = server.generate(tokens, **kwargs)
             self._respond(200, {"tokens": out})
+        except Exception as exc:
+            self._respond(400, {"error": str(exc)})
+
+    def _prefill(self) -> None:
+        """POST /prefill — the prefill stage of a disaggregated request
+        (serving/kv_transfer.py): chunk-prefill the prompt into this
+        replica's paged pool (the request retires at admission, so no
+        decode tick is ever spent here), then push the populated pages
+        the destination decode replica is missing.  Returns the prompt's
+        chain digests plus transfer accounting."""
+        server: "InferenceServer" = self.server.inference  # type: ignore
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            tokens = [int(t) for t in req["tokens"]]
+            transfer = req.get("transfer") or {}
+            out = server.prefill(
+                tokens, dest_url=transfer.get("url"),
+                have=transfer.get("have"),
+                trace_ctx=TraceContext.decode(req.get("trace_context")))
+            self._respond(200, out)
+        except Exception as exc:
+            self._respond(400, {"error": str(exc)})
+
+    def _kv_pages(self) -> None:
+        """POST /kv/pages — receive content-addressed KV pages from a
+        prefill replica and install them into the local pool (decode
+        side of the disaggregated handoff).  Best-effort: the response
+        reports per-page accounting; rejected pages are simply
+        prefilled locally by the next /generate."""
+        server: "InferenceServer" = self.server.inference  # type: ignore
+        try:
+            from . import kv_transfer
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            b = server._batcher
+            if b is None or b.page_size <= 0:
+                return self._respond(400, {
+                    "error": "KV-page import requires the paged cache "
+                             "(kv_page_size > 0)"})
+            pages = kv_transfer.decode_pages(req.get("pages") or [])
+            self._respond(200, b.import_kv_pages(pages))
         except Exception as exc:
             self._respond(400, {"error": str(exc)})
 
@@ -198,7 +244,27 @@ class InferenceServer:
                  draft_len: int = 4, prompt_lookup_ngram: int = 3,
                  kv_prefill_chunk: int = 0, weight_dtype: str = "auto",
                  pipelined: Optional[bool] = None,
-                 telemetry_registry: Optional[Registry] = None):
+                 telemetry_registry: Optional[Registry] = None,
+                 role: str = "unified", model_name: str = ""):
+        # Disaggregated serving identity (serving/disagg.py): which
+        # stage this replica runs and which model it holds.  The role
+        # only changes what the replica ADVERTISES (/fleet-state) and
+        # what the router sends it; either role can serve either verb,
+        # so a mid-failover fallback is always correct.
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'unified', 'prefill' or 'decode', "
+                f"got {role!r}")
+        if role != "unified" and kv_page_size <= 0:
+            # The disagg handoff IS the paged pool; without it there is
+            # nothing to transfer and the fleet would silently degrade
+            # to unified serving (ISSUE 17 fail-fast satellite).
+            raise ValueError(
+                f"role={role!r} (disaggregated serving) requires a "
+                f"paged KV cache (kv_page_size > 0); unpaged replicas "
+                f"can only serve unified")
+        self.role = role
+        self.model_name = model_name
         if weight_dtype not in ("auto", "int8"):
             raise ValueError(
                 f"weight_dtype must be 'auto' or 'int8', "
@@ -471,14 +537,52 @@ class InferenceServer:
         finally:
             gen.close()
 
+    def prefill(self, tokens, dest_url: Optional[str] = None,
+                have=None, trace_ctx=None) -> dict:
+        """Disaggregated prefill stage: populate this replica's paged
+        prefix cache with the prompt's full pages (a max_new_tokens=1
+        submit retires at admission — chunked prefill runs, pages
+        register, and no decode tick is ever consumed), then push the
+        pages ``dest_url`` is missing over the KV-transfer channel.
+
+        Returns the prompt's chain digests and transfer accounting;
+        with ``dest_url=None`` it is a pure cache-warm."""
+        from . import kv_transfer
+        from .batcher import prefix_page_digests
+        b = self._batcher
+        if b is None or b.page_size <= 0:
+            raise ValueError(
+                "prefill stage requires the paged cache "
+                "(max_batch_slots > 0 and kv_page_size > 0)")
+        rows = [int(t) for t in tokens]
+        if not rows:
+            raise ValueError("empty prompt")
+        digests = prefix_page_digests(rows, b.page_size)
+        with self.telemetry["request_seconds"].time():
+            # Greedy, budget 1: the emitted token is discarded — the
+            # decode replica re-derives it from the transferred pages
+            # (byte-identical; K/V depends only on the token prefix).
+            b.submit(rows, 1, temperature=0.0, seed=0,
+                     trace_ctx=trace_ctx)
+        out = {"digests": digests, "shipped": 0, "deduped": 0,
+               "imported": 0, "rejected": 0, "bytes": 0}
+        if dest_url and digests:
+            out.update(kv_transfer.transfer_pages(
+                b, digests, dest_url, have=have))
+        return out
+
     def fleet_state(self) -> dict:
         """The GET /fleet-state payload (see _Handler): live queue
-        depth + slot occupancy for load balancing, and the batcher's
-        advertised prefix-cache digests for prefix-aware routing."""
+        depth + slot occupancy for load balancing, the batcher's
+        advertised prefix-cache digests for prefix-aware routing, and
+        the disagg identity (role/model) + free pool blocks the router
+        schedules decode placement by."""
         b = self._batcher
         if b is None:
             return {"healthy": True, "queue_depth": 0, "active_slots": 0,
-                    "slots": 0, "page_size": 0, "prefix_digests": []}
+                    "slots": 0, "page_size": 0, "prefix_digests": [],
+                    "role": self.role, "model": self.model_name,
+                    "free_blocks": 0}
         return {
             "healthy": b.fatal_error is None,
             "queue_depth": b._queue.qsize(),
@@ -486,6 +590,9 @@ class InferenceServer:
             "slots": b.max_slots,
             "page_size": b.page_size,
             "prefix_digests": b.prefix_digest(),
+            "role": self.role,
+            "model": self.model_name,
+            "free_blocks": b.free_blocks(),
         }
 
     # -- lifecycle ---------------------------------------------------------

@@ -1091,6 +1091,10 @@ class SoakHarness:
             "resize_p99_s": card.resize_p99_s,
             "ckpt_overhead_pct": card.ckpt_overhead_pct,
             "restore_p99_s": card.restore_p99_s,
+            "disagg_ttft_p99_s": card.disagg_ttft_p99_s,
+            "decode_interference_p99_s":
+                card.decode_interference_p99_s,
+            "cold_start_p99_s": card.cold_start_p99_s,
             "requests_lost": card.requests_lost,
             "invariant_violations": card.invariant_violations,
         }

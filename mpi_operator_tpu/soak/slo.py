@@ -130,6 +130,15 @@ class SloScorecard:
     # ever committed a manifest (the gate must notice, not pass).
     ckpt_overhead_pct: Optional[float] = None
     restore_p99_s: Optional[float] = None
+    # Disaggregated serving (ISSUE 17, docs/SERVING.md): TTFT p99 of
+    # the split prefill/decode fleet, decode p99 measured WHILE a long
+    # prefill saturates the prefill pool (the interference gate — a
+    # 32k prefill must not move it), and the measured scale-to-zero
+    # cold start p99 per wake; None when the run never exercised the
+    # disagg path (the gate must notice, not pass).
+    disagg_ttft_p99_s: Optional[float] = None
+    decode_interference_p99_s: Optional[float] = None
+    cold_start_p99_s: Optional[float] = None
     converged: bool = True
     # Free-form context the bench attaches (windows, per-gang detail).
     detail: Dict[str, object] = field(default_factory=dict)
@@ -204,6 +213,10 @@ class SloScorecard:
             "resize_p99_s": r(self.resize_p99_s),
             "ckpt_overhead_pct": r(self.ckpt_overhead_pct),
             "restore_p99_s": r(self.restore_p99_s),
+            "disagg_ttft_p99_s": r(self.disagg_ttft_p99_s),
+            "decode_interference_p99_s": r(
+                self.decode_interference_p99_s),
+            "cold_start_p99_s": r(self.cold_start_p99_s),
             "converged": self.converged,
             "ok": self.ok,
             "violations": self.violations(),

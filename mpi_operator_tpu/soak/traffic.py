@@ -83,6 +83,92 @@ class ServeWorkload:
                 "max_new_tokens": self.max_new, "session": f"tenant{t}"}
 
 
+class MultiModelWorkload:
+    """Zipf-distributed multi-model trace with mixed short/long prompts
+    (bench_disagg.py, ISSUE 17).
+
+    Model popularity follows a Zipf law (rank r gets weight 1/r^s), so
+    the head model stays hot while tail models go idle long enough for
+    scale-to-zero to page them out mid-trace — exactly the regime the
+    wake-on-traffic path must survive.  Prompts mix short conversational
+    turns with long-context requests: ``long_frac`` of arrivals pick
+    one of ``long_docs`` recurring per-model documents (sized from
+    ``long_prompt_tokens``) plus a tiny unique suffix — long contexts
+    in production are reused (RAG corpora, codebases, pasted specs),
+    which is exactly the working set the content-addressed KV transfer
+    and prefix caches are built to keep warm.  Every request shares a
+    per-model system prefix too.  Seeded: same seed, same trace.
+    """
+
+    def __init__(self, models: List[str], vocab_size: int,
+                 seed: int, zipf_s: float = 1.2,
+                 prefix_tokens: int = 48,
+                 short_prompt_tokens: tuple = (4, 24),
+                 long_prompt_tokens: tuple = (200, 400),
+                 long_frac: float = 0.2, max_new: int = 8,
+                 sessions_per_model: int = 8, long_docs: int = 4):
+        if not models:
+            raise ValueError("need at least one model")
+        if sessions_per_model < 1:
+            raise ValueError("sessions_per_model must be >= 1")
+        if long_docs < 1:
+            raise ValueError("long_docs must be >= 1")
+        self.models = list(models)
+        self.max_new = max_new
+        self.long_frac = float(long_frac)
+        # Many sessions per model: session affinity must spread over
+        # the model's replicas, not funnel the whole trace through one
+        # pinned replica.
+        self.sessions_per_model = int(sessions_per_model)
+        self._short = short_prompt_tokens
+        self._long = long_prompt_tokens
+        weights = [1.0 / (rank + 1) ** zipf_s
+                   for rank in range(len(self.models))]
+        total = sum(weights)
+        self.popularity = [w / total for w in weights]
+        rng = random.Random(seed)
+        # Per-model system prefix: requests to one model share it, so
+        # page transfer + prefix cache have something to dedup.
+        self.prefixes = {
+            m: [rng.randrange(1, vocab_size) for _ in range(prefix_tokens)]
+            for m in self.models}
+        # Recurring long documents (the long-context working set).
+        self.long_documents = {
+            m: [[rng.randrange(1, vocab_size)
+                 for _ in range(rng.randint(*long_prompt_tokens))]
+                for _ in range(long_docs)]
+            for m in self.models}
+        self._rng = random.Random(seed + 1)
+        self._vocab = vocab_size
+        self._lock = threading.Lock()
+        self.issued: List[str] = []  # model per arrival, for asserts
+
+    def _pick_model(self) -> str:
+        x = self._rng.random()
+        acc = 0.0
+        for model, p in zip(self.models, self.popularity):
+            acc += p
+            if x <= acc:
+                return model
+        return self.models[-1]
+
+    def next_payload(self) -> dict:
+        with self._lock:
+            model = self._pick_model()
+            body: List[int] = []
+            if self._rng.random() < self.long_frac:
+                docs = self.long_documents[model]
+                body.extend(docs[self._rng.randrange(len(docs))])
+            n = self._rng.randint(*self._short)
+            body.extend(self._rng.randrange(1, self._vocab)
+                        for _ in range(n))
+            self.issued.append(model)
+            sess = self._rng.randrange(self.sessions_per_model)
+        return {"tokens": [self.prefixes[model] + body],
+                "max_new_tokens": self.max_new, "model": model,
+                "session": f"{model}-s{sess}"}
+
+
 class ServeTraffic:
     """Closed-loop client threads + one seeded open-loop arrival thread
     against a router URL.  Completions and errors are recorded for

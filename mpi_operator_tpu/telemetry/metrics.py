@@ -487,6 +487,26 @@ def new_serving_metrics(registry: Registry) -> dict:
             "mpi_operator_serve_prefix_evicted_total",
             "Refcount-0 cached prefix blocks evicted under pool"
             " pressure"),
+        # Disaggregated prefill/decode (ISSUE 17): the paged
+        # KV-transfer protocol's replica-side accounting — pages a
+        # prefill replica exported for shipping, pages a decode replica
+        # imported into its pool, and imports rejected by reason (the
+        # protocol is best-effort: a rejected page just means the
+        # decode replica prefills that span itself).
+        "kv_pages_exported": registry.counter(
+            "mpi_operator_serve_kv_pages_exported_total",
+            "KV pages exported by this replica for transfer to a"
+            " decode replica (disaggregated serving)"),
+        "kv_pages_imported": registry.counter(
+            "mpi_operator_serve_kv_pages_imported_total",
+            "KV pages imported into this replica's pool from a"
+            " prefill replica (disaggregated serving)"),
+        "kv_import_rejected": registry.counter_vec(
+            "mpi_operator_serve_kv_import_rejected_total",
+            "KV-page imports rejected, by reason (digest mismatch,"
+            " missing parent chain, pool exhausted, shape/dtype"
+            " mismatch, duplicate)",
+            label_names=("reason",)),
     }
 
 
@@ -521,4 +541,44 @@ def new_router_metrics(registry: Registry) -> dict:
             "mpi_operator_router_ttft_seconds",
             "Router-observed time from request accept to first"
             " upstream token (the autoscaler's TTFT signal)"),
+        # Disaggregated prefill/decode (ISSUE 17): the router runs the
+        # prefill stage explicitly — these count stage dispatches, the
+        # content-addressed dedup that keeps already-cached pages off
+        # the wire, and the fallback path (prefill stage failed, decode
+        # replica prefills itself; correctness is unaffected).
+        "disagg_prefills": registry.counter(
+            "mpi_operator_router_disagg_prefills_total",
+            "Prefill-stage dispatches to a prefill replica"
+            " (disaggregated serving)"),
+        "disagg_fallback": registry.counter(
+            "mpi_operator_router_disagg_fallback_total",
+            "Prefill-stage dispatches that failed and fell back to"
+            " decode-replica self-prefill"),
+        "kv_pages_shipped": registry.counter(
+            "mpi_operator_router_kv_pages_shipped_total",
+            "KV pages shipped prefill->decode across the fleet"),
+        "kv_pages_deduped": registry.counter(
+            "mpi_operator_router_kv_pages_deduped_total",
+            "KV pages NOT shipped because the decode replica already"
+            " advertised their chain digest (content-addressed dedup)"),
+        "kv_transfer_bytes": registry.counter(
+            "mpi_operator_router_kv_transfer_bytes_total",
+            "Serialized bytes of KV pages shipped prefill->decode"),
+        # Multi-model weight paging / scale-to-zero (ISSUE 17): wakes
+        # and their measured cold-start cost, per model — the routing
+        # layer prices this into page-out decisions.
+        "model_wakes": registry.counter_vec(
+            "mpi_operator_serve_model_wakes_total",
+            "Scale-to-zero wakes triggered by traffic, by model",
+            label_names=("model",)),
+        "cold_start_seconds": registry.histogram_vec(
+            "mpi_operator_serve_cold_start_seconds",
+            "Cold-start duration of a scale-to-zero wake (wake"
+            " decision to replicas serving), by model",
+            label_names=("model",)),
+        "pool_replicas": registry.gauge_vec(
+            "mpi_operator_disagg_pool_replicas",
+            "Replicas per disaggregated pool, by model and role"
+            " (prefill, decode, unified)",
+            label_names=("model", "role")),
     }

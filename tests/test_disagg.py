@@ -1,0 +1,512 @@
+"""Disaggregated prefill/decode serving (ISSUE 17): prefix digest
+chain edge cases, the KV-transfer wire codec, chip-ledger conservation,
+the RatioBalancer pool policy, fail-fast configuration, and the
+autoscaler/router 503-vs-wake decision.  Real-replica end-to-end
+coverage (byte-identity across a page transfer, scale-to-zero round
+trip) lives in the slow tier + bench_disagg.py."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from mpi_operator_tpu.sched.capacity import ChipLedger
+from mpi_operator_tpu.utils.waiters import wait_until
+from mpi_operator_tpu.sched.elastic import RatioBalancer
+from mpi_operator_tpu.serving import kv_transfer
+from mpi_operator_tpu.serving.batcher import (_page_digest,
+                                              prefix_page_digests)
+from mpi_operator_tpu.serving.disagg import (DisaggConfigError,
+                                             ModelPoolSpec,
+                                             validate_spec)
+
+
+# ---------------------------------------------------------------------------
+# prefix_page_digests edge cases (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+def test_digests_empty_prompt_is_empty():
+    assert prefix_page_digests([], 16) == []
+
+
+def test_digests_prompt_shorter_than_one_page_is_empty():
+    assert prefix_page_digests(list(range(7)), 16) == []
+
+
+def test_digests_exact_page_multiple_holds_back_final_token():
+    # One token is always left to prefill, so an exact k*page prompt
+    # yields k-1 digests — the last page is never fully cacheable.
+    page = 8
+    assert prefix_page_digests(list(range(page)), page) == []
+    assert len(prefix_page_digests(list(range(2 * page)), page)) == 1
+    assert len(prefix_page_digests(list(range(3 * page)), page)) == 2
+    # One past the boundary makes the page below it whole.
+    assert len(prefix_page_digests(list(range(page + 1)), page)) == 1
+
+
+def test_digest_chain_stable_under_rechunking():
+    # Digests are a function of the token PREFIX, not of how the
+    # caller later slices the prompt: extending the prompt must keep
+    # every earlier digest byte-identical (this is what makes them
+    # safe content addresses for cross-replica transfer).
+    page = 4
+    tokens = list(range(1, 40))
+    full = prefix_page_digests(tokens, page)
+    for cut in range(len(tokens) + 1):
+        sub = prefix_page_digests(tokens[:cut], page)
+        assert full[:len(sub)] == sub
+    # And the chain really chains: digest j depends on all pages <= j.
+    mutated = list(tokens)
+    mutated[0] += 1
+    assert prefix_page_digests(mutated, page)[-1] != full[-1]
+
+
+def test_digests_reject_unpaged_cache():
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match="page_size > 0"):
+            prefix_page_digests([1, 2, 3], bad)
+
+
+def test_page_digest_depends_on_parent():
+    page = [1, 2, 3, 4]
+    assert _page_digest("", page) != _page_digest("aa", page)
+
+
+# ---------------------------------------------------------------------------
+# KV-transfer wire codec
+# ---------------------------------------------------------------------------
+
+def test_kv_wire_codec_round_trip():
+    rng = np.random.default_rng(0)
+    pages = [{
+        "digest": "d1", "parent": "",
+        "tokens": list(range(8)),
+        "leaves": {"layer0/pool_k": rng.standard_normal((1, 8, 4))
+                   .astype(np.float32),
+                   "layer0/pool_v": rng.integers(0, 9, (1, 8, 4))
+                   .astype(np.int8)},
+    }]
+    wire = kv_transfer.encode_pages(pages)
+    json.dumps({"pages": wire})  # must be JSON-serializable as-is
+    back = kv_transfer.decode_pages(wire)
+    assert len(back) == 1
+    assert back[0]["digest"] == "d1"
+    assert back[0]["tokens"] == list(range(8))
+    for path, leaf in pages[0]["leaves"].items():
+        got = back[0]["leaves"][path]
+        assert got.dtype == leaf.dtype
+        np.testing.assert_array_equal(got, leaf)
+
+
+def test_kv_wire_decode_drops_malformed_pages():
+    wire = kv_transfer.encode_pages([{
+        "digest": "ok", "parent": "", "tokens": [1],
+        "leaves": {"p/pool_k": np.zeros((1, 1), np.float32)}}])
+    wire.append({"digest": "broken"})  # missing tokens/leaves
+    wire.append({"digest": "bad-leaf", "parent": "", "tokens": [2],
+                 "leaves": {"p/pool_k": {"b64": "!!", "dtype": "x",
+                                         "shape": [1]}}})
+    back = kv_transfer.decode_pages(wire)
+    assert [p["digest"] for p in back] == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# ChipLedger: scale-to-zero capacity conservation
+# ---------------------------------------------------------------------------
+
+def test_chip_ledger_charge_release_conservation():
+    ledger = ChipLedger()
+    ledger.register_queue("serve", 8)
+    assert ledger.charge("modelA", "serve", 6)
+    assert not ledger.charge("modelB", "serve", 4)  # over quota
+    assert ledger.used("serve") == 6 and ledger.free("serve") == 2
+    assert ledger.conservation_violations() == []
+    assert ledger.release("modelA") == 6
+    assert ledger.release("modelA") == 0  # idempotent
+    assert ledger.free("serve") == 8
+    assert ledger.charge("modelB", "serve", 4)
+    assert ledger.conservation_violations() == []
+
+
+def test_chip_ledger_recharge_is_atomic():
+    ledger = ChipLedger()
+    ledger.register_queue("serve", 4)
+    assert ledger.charge("m", "serve", 3)
+    # A failed re-charge must keep the old holding, not drop it.
+    assert not ledger.charge("m", "serve", 5)
+    assert ledger.used("serve") == 3
+    # A successful re-charge replaces it (pool resize on wake).
+    assert ledger.charge("m", "serve", 2)
+    assert ledger.used("serve") == 2
+    assert ledger.conservation_violations() == []
+
+
+def test_chip_ledger_rejects_shrink_below_holdings():
+    ledger = ChipLedger()
+    ledger.register_queue("serve", 4)
+    assert ledger.charge("m", "serve", 3)
+    with pytest.raises(ValueError):
+        ledger.register_queue("serve", 2)
+
+
+def test_chip_ledger_mirrors_cluster_queue_status():
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    client = Clientset()
+    ledger = ChipLedger(clientset=client)
+    ledger.register_queue("serve", 8)
+    assert ledger.charge("m", "serve", 5)
+    cq = client.cluster_queues("default").get("serve")
+    assert cq.spec.quotas[constants.TPU_RESOURCE] == "8"
+    assert cq.status.used[constants.TPU_RESOURCE] == "5"
+    ledger.release("m")
+    cq = client.cluster_queues("default").get("serve")
+    assert cq.status.used[constants.TPU_RESOURCE] == "0"
+    assert ledger.conservation_violations() == []
+
+
+# ---------------------------------------------------------------------------
+# RatioBalancer: prefill/decode pool policy
+# ---------------------------------------------------------------------------
+
+def test_ratio_balancer_first_observation_only_seeds():
+    bal = RatioBalancer(stable=1)
+    assert bal.observe(1000, 0, 1, 1) is None
+
+
+def test_ratio_balancer_moves_toward_prefill_demand():
+    bal = RatioBalancer(stable=2, deadband=0.1)
+    bal.observe(0, 0, 1, 3)
+    # Prefill-heavy traffic: wants ~1/2 share, has 1/4.
+    assert bal.observe(1000, 1000, 1, 3) is None  # streak 1
+    move = bal.observe(2000, 2000, 1, 3)          # streak 2 -> move
+    assert move is not None
+    assert (move["from"], move["to"]) == ("decode", "prefill")
+    bal.settle(move, "applied", 0.1)
+    assert bal.log[-1]["outcome"] == "applied"
+
+
+def test_ratio_balancer_moves_toward_decode_demand():
+    bal = RatioBalancer(stable=1, deadband=0.1)
+    bal.observe(0, 0, 3, 1)
+    move = bal.observe(10, 1000, 3, 1)
+    assert move is not None
+    assert (move["from"], move["to"]) == ("prefill", "decode")
+
+
+def test_ratio_balancer_deadband_and_floor():
+    bal = RatioBalancer(stable=1, deadband=0.2, min_pool=1)
+    bal.observe(0, 0, 2, 2)
+    # Balanced-ish traffic inside the deadband: no move.
+    assert bal.observe(1100, 900, 2, 2) is None
+    # Decode pool at the floor: never starved below min_pool.
+    floor = RatioBalancer(stable=1, deadband=0.05, min_pool=1)
+    floor.observe(0, 0, 1, 1)
+    assert floor.observe(1000, 1, 1, 1) is None
+
+
+def test_ratio_balancer_streak_resets_on_direction_flip():
+    bal = RatioBalancer(stable=2, deadband=0.05)
+    bal.observe(0, 0, 2, 2)
+    assert bal.observe(1000, 10, 2, 2) is None    # toward prefill, 1
+    assert bal.observe(1010, 1000, 2, 2) is None  # toward decode, -1
+    assert bal.observe(2000, 1010, 2, 2) is None  # toward prefill, 1
+    move = bal.observe(3000, 1020, 2, 2)          # toward prefill, 2
+    assert move is not None and move["to"] == "prefill"
+
+
+def test_ratio_balancer_reset_rearms_without_stale_state():
+    # Quiescent (huge stable) through a warm phase, then re-armed:
+    # the accumulated streak and counter baseline must not propose an
+    # instant move on the first post-reset observation.
+    bal = RatioBalancer(stable=10 ** 9, deadband=0.05)
+    bal.observe(0, 0, 1, 2)
+    for i in range(1, 6):
+        assert bal.observe(1000 * i, 10 * i, 1, 2) is None
+    bal.reset(stable=1)
+    assert bal.stable == 1
+    assert bal.observe(10_000, 60, 1, 2) is None  # seeds baseline only
+    move = bal.observe(20_000, 70, 1, 2)
+    assert move is not None and move["to"] == "prefill"
+    with pytest.raises(ValueError):
+        bal.reset(stable=0)
+
+
+def test_ratio_balancer_service_ratio_prices_stages():
+    # With decode 4x cheaper per replica, the same token mix wants a
+    # larger prefill share than the unpriced balancer would give it.
+    raw = RatioBalancer(stable=1, deadband=0.0)
+    priced = RatioBalancer(stable=1, deadband=0.0, service_ratio=4.0)
+    raw.observe(0, 0, 2, 2)
+    priced.observe(0, 0, 2, 2)
+    raw_move = raw.observe(500, 500, 2, 2)
+    priced_move = priced.observe(500, 500, 2, 2)
+    assert raw_move is None or raw_move["want_share"] == 0.5
+    assert priced_move is not None
+    assert priced_move["want_share"] > 0.5
+
+
+# ---------------------------------------------------------------------------
+# Fail-fast configuration (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+def _spec(**kw):
+    base = dict(name="m", server_factory=lambda s, r: None, page_size=16)
+    base.update(kw)
+    return ModelPoolSpec(**base)
+
+
+def test_unpaged_disagg_spec_fails_fast():
+    with pytest.raises(DisaggConfigError, match="page_size > 0"):
+        validate_spec(_spec(page_size=0))
+    # ... but is fine as an explicit unified fleet.
+    validate_spec(_spec(page_size=0), unified=True)
+
+
+def test_disagg_spec_pool_floors():
+    with pytest.raises(DisaggConfigError):
+        validate_spec(_spec(decode_replicas=0))
+    with pytest.raises(DisaggConfigError):
+        validate_spec(_spec(chips_per_replica=0))
+
+
+def test_unpaged_role_rejected_by_server():
+    from mpi_operator_tpu.serving.server import InferenceServer
+    with pytest.raises(ValueError, match="paged KV cache"):
+        InferenceServer(object(), {}, role="prefill", kv_page_size=0)
+    with pytest.raises(ValueError, match="role"):
+        InferenceServer(object(), {}, role="warmish")
+
+
+# ---------------------------------------------------------------------------
+# 503-vs-wake: router waker hook + autoscaler wake-on-traffic
+# ---------------------------------------------------------------------------
+
+def test_router_wakes_model_on_traffic_and_measures_cold_start():
+    from mpi_operator_tpu.serving.router import FleetRouter
+    router = FleetRouter()
+    woken = []
+
+    def waker(model):
+        woken.append(model)
+        time.sleep(0.01)
+        return True
+
+    router.set_waker(waker)
+    router._ensure_capacity("llama")
+    assert woken == ["llama"]
+    stats = router.cold_start_stats()
+    assert len(stats["llama"]) == 1 and stats["llama"][0] >= 0.01
+    hist = router.telemetry["cold_start_seconds"].labels("llama")
+    assert hist.snapshot()["count"] == 1
+    # A live decode-capable replica suppresses the wake.
+    router.add_replica("r1", "http://127.0.0.1:9", model="llama")
+    router._replicas["r1"].alive = True
+    router._ensure_capacity("llama")
+    assert woken == ["llama"]
+    router._http.server_close()
+
+
+def test_router_without_waker_load_sheds_503():
+    from mpi_operator_tpu.serving.router import FleetRouter
+    router = FleetRouter()
+    # No waker installed: a request for a drained model is a clean 503
+    # (the "decision" half of 503-vs-wake).
+    status, body = router.relay({"tokens": [[1, 2, 3]],
+                                 "max_new_tokens": 1, "model": "ghost"})
+    assert status == 503
+    assert "error" in body
+    router._http.server_close()
+
+
+def _fake_autoscale_fleet(min_replicas=0):
+    from mpi_operator_tpu.api.defaults import set_defaults_servejob
+    from mpi_operator_tpu.api.types import (ServeAutoscaleSpec, ServeJob,
+                                            ServeJobSpec)
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    from mpi_operator_tpu.k8s.core import (Container, PodSpec,
+                                           PodTemplateSpec)
+    from mpi_operator_tpu.k8s.meta import ObjectMeta
+    from mpi_operator_tpu.serving.autoscaler import ServeAutoscaler
+    from mpi_operator_tpu.serving.router import FleetRouter
+    client = Clientset()
+    job = ServeJob(
+        metadata=ObjectMeta(name="paged", namespace="default"),
+        spec=ServeJobSpec(
+            replicas=0,
+            autoscale=ServeAutoscaleSpec(min_replicas=min_replicas,
+                                         max_replicas=3),
+            template=PodTemplateSpec(spec=PodSpec(
+                containers=[Container(name="c", image="local")]))))
+    set_defaults_servejob(job)
+    client.serve_jobs("default").create(job)
+    router = FleetRouter()
+    scaler = ServeAutoscaler(client, "default", "paged", router,
+                             model="paged")
+    return client, router, scaler
+
+
+def test_autoscaler_wakes_scaled_to_zero_fleet_on_traffic():
+    client, router, scaler = _fake_autoscale_fleet()
+    try:
+        # Zero replicas, zero arrivals: stay asleep (the 503 side).
+        assert scaler.evaluate_once() is None
+        assert scaler.transitions == []
+        # Traffic arrives while scaled to zero: wake to one replica.
+        router.telemetry["requests_total"].inc()
+        assert scaler.evaluate_once() == 1
+        assert scaler.transitions[-1][2] == \
+            "up: traffic while scaled to zero"
+        job = client.serve_jobs("default").get("paged")
+        assert job.status.desired_replicas == 1
+        assert "scaled to zero" in job.status.scaling_reason
+        # The wake clock is armed; when replicas come up the elapsed
+        # span lands in the per-model cold-start histogram.
+        assert scaler._wake_started is not None
+        router.add_replica("r1", "http://127.0.0.1:9", model="paged")
+        router._replicas["r1"].alive = True
+        scaler.evaluate_once()
+        assert len(scaler.cold_starts) == 1
+        hist = router.telemetry["cold_start_seconds"].labels("paged")
+        assert hist.snapshot()["count"] == 1
+    finally:
+        router._http.server_close()
+
+
+def test_autoscaler_holds_during_full_outage_with_nonzero_desired():
+    client, router, scaler = _fake_autoscale_fleet(min_replicas=1)
+    try:
+        client.serve_jobs("default").patch_status(
+            "paged", desired_replicas=2)
+        router.telemetry["requests_total"].inc()
+        # Replicas all dead but desired > 0: absence of signal, not of
+        # demand — no wake transition, no scale-down.
+        assert scaler.evaluate_once() is None
+        assert scaler.transitions == []
+        assert scaler.cold_starts == []
+    finally:
+        router._http.server_close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end with real replicas (slow tier; bench_disagg.py is the
+# full-trace version of these)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+    from mpi_operator_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = LlamaConfig(vocab_size=128, dim=32, n_layers=1, n_heads=1,
+                      n_kv_heads=1, max_seq_len=128)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def _post(url, path, payload, timeout=120):
+    import urllib.request
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _server(tiny_model, role="unified", name="", blocks=48, slots=2):
+    from mpi_operator_tpu.serving.server import InferenceServer
+    cfg, model, variables = tiny_model
+    return InferenceServer(model, variables, max_batch_slots=slots,
+                           kv_page_size=16, kv_cache_blocks=blocks,
+                           role=role, model_name=name)
+
+
+@pytest.mark.slow
+def test_kv_transfer_end_to_end_byte_identical(tiny_model):
+    page = 16
+    prompt = [(7 * i) % 120 + 1 for i in range(3 * page + 3)]
+    prefill = _server(tiny_model, role="prefill").start()
+    decode = _server(tiny_model, role="decode").start()
+    control = _server(tiny_model).start()
+    try:
+        status, reply = _post(prefill.url, "/prefill", {
+            "tokens": prompt,
+            "transfer": {"url": decode.url, "have": []}})
+        assert status == 200
+        assert len(reply["digests"]) == 3
+        assert reply["shipped"] == 3 and reply["imported"] == 3
+        assert reply["rejected"] == 0 and reply["bytes"] > 0
+
+        # The decode replica now serves the prompt byte-identically,
+        # prefilling only the un-transferred tail.
+        payload = {"tokens": [prompt], "max_new_tokens": 8,
+                   "temperature": 0.0}
+        _, via_decode = _post(decode.url, "/generate", dict(payload))
+        _, direct = _post(control.url, "/generate", dict(payload))
+        assert via_decode["tokens"] == direct["tokens"]
+        stats = decode._batcher.prefix_stats
+        assert stats["hit_blocks"] >= 3
+
+        # Re-shipping the same chain is pure dedup, nothing on the wire.
+        status, reply2 = _post(prefill.url, "/prefill", {
+            "tokens": prompt,
+            "transfer": {"url": decode.url,
+                         "have": reply["digests"]}})
+        assert status == 200
+        assert reply2["shipped"] == 0 and reply2["deduped"] == 3
+    finally:
+        prefill.stop()
+        decode.stop()
+        control.stop()
+
+
+@pytest.mark.slow
+def test_disagg_fleet_scale_to_zero_round_trip(tiny_model):
+    from mpi_operator_tpu.serving.disagg import (DisaggServeFleet,
+                                                 ModelPoolSpec)
+
+    def factory(spec, role):
+        return _server(tiny_model, role=role, name=spec.name)
+
+    ledger = ChipLedger()
+    ledger.register_queue("serve", 4)
+    spec = ModelPoolSpec(name="m0", server_factory=factory,
+                         page_size=16, prefill_replicas=1,
+                         decode_replicas=1, chips_per_replica=1,
+                         queue="serve", idle_timeout_s=0.6)
+    fleet = DisaggServeFleet([spec], ledger=ledger,
+                             reap_interval=0.1, cold_start_price=0.0)
+    with fleet:
+        fleet.wait_ready(timeout=120)
+        assert ledger.used("serve") == 2
+        payload = {"tokens": [[5, 6, 7] * 12], "max_new_tokens": 4,
+                   "temperature": 0.0, "model": "m0"}
+        status, body = _post(fleet.router.url, "/generate",
+                             dict(payload))
+        assert status == 200
+        warm_tokens = body["tokens"]
+
+        # Idle past the timeout: the model is paged out and every chip
+        # goes back to the ClusterQueue (capacity conservation).
+        wait_until(lambda: not fleet.awake("m0"), timeout=30,
+                   desc="model m0 paged out")
+        assert ledger.used("serve") == 0 and ledger.free("serve") == 4
+        assert ledger.conservation_violations() == []
+
+        # First request after page-out wakes the model synchronously
+        # and completes, byte-identical; the measured cold start lands
+        # in the routing metrics.
+        status, body = _post(fleet.router.url, "/generate",
+                             dict(payload), timeout=300)
+        assert status == 200
+        assert body["tokens"] == warm_tokens
+        assert ledger.used("serve") == 2
+        colds = fleet.router.cold_start_stats()
+        assert colds.get("m0") and colds["m0"][0] > 0
+        wakes = fleet.router.telemetry["model_wakes"].labels("m0")
+        assert wakes.value >= 1
+    assert ledger.used("serve") == 0
+    assert ledger.conservation_violations() == []
