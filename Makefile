@@ -8,7 +8,7 @@ PYTHON ?= python
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
 	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke \
 	trace-smoke topo-smoke durable-smoke elastic-smoke ckpt-smoke \
-	bench-disagg analyze
+	obsplane-smoke bench-disagg bench-obsplane analyze
 
 # Every smoke runs with the runtime lock-order detector armed
 # (docs/ANALYSIS.md): repo-created locks are tracked, lock-order cycles
@@ -123,6 +123,15 @@ ckpt-smoke:
 soak-smoke:
 	$(SMOKE_ENV) $(PYTHON) tools/soak_smoke.py
 
+# Metrics plane (< 60s, CPU): a LocalCluster gang with worker-0
+# SIGSTOP-throttled via a scripted slow_node fault — StragglerAlert
+# must fire with the offending {job,worker} labels, a second identical
+# run must produce a byte-identical canonical alert history, and a
+# quiescent run must fire zero alerts (docs/OBSERVABILITY.md "Metrics
+# plane & alerting").
+obsplane-smoke:
+	$(SMOKE_ENV) $(PYTHON) tools/obsplane_smoke.py
+
 # Durable apiserver (< 60s, CPU): WAL-backed store killed and replayed
 # byte-identical (canonical dump + uid/ownership indexes + per-kind
 # watch history + exact revision), informers resume across the restart
@@ -196,6 +205,14 @@ bench-ckpt:
 # scale-to-zero round trip, pool rebalancer -> BENCH_DISAGG.json.
 bench-disagg:
 	$(SMOKE_ENV) $(PYTHON) bench_disagg.py
+
+# Metrics-plane proof (BENCH_OBSPLANE.json): straggler detection
+# precision/recall >= 0.9 + time-to-detect p99 on seeded simulated
+# step streams, alert fidelity on a scripted chaos soak (every mapped
+# fault class alerts within the deadline; quiescent run silent), and
+# scrape overhead <= 1.05x on the PR 7 reconcile storm.
+bench-obsplane:
+	$(SMOKE_ENV) $(PYTHON) bench_obsplane.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,0 +1,397 @@
+#!/usr/bin/env python
+"""Metrics-plane proof (ISSUE 18, docs/OBSERVABILITY.md "Metrics plane
+& alerting") -> BENCH_OBSPLANE.json.
+
+Three sections, each with hard gates (exit 1 on any failure):
+
+**straggler** — detection quality on seeded SIMULATED step streams
+(logical clock, zero wallclock): a fleet of gangs feeds cumulative
+step counters through the real scoring path
+(StragglerScorer.observe_progress -> published
+``mpi_operator_straggler_score`` -> AlertEngine[StragglerRule]); a
+seeded subset of workers degrades to ~0.3x step rate at a known
+onset.  Gates: precision >= 0.9, recall >= 0.9 against the seeded
+truth set, and time-to-detect p99 <= 30 logical seconds.
+
+**alert_fidelity** — the full stack: a SoakHarness run driven by a
+SCRIPTED chaos plan containing one fault of every FIDELITY_MAP kind
+(controller/scheduler/apiserver restarts, pod kill/delete, preempt,
+replica kill, and a slow_node SIGSTOP throttle for the flagship
+StragglerAlert).  Gates: the scorecard's alert-fidelity section is ok
+(every applied mapped fault class raised its alert within the
+deadline), every planned kind actually applied, zero invariant
+violations; then a QUIESCENT run (same harness, empty plan) must fire
+ZERO fidelity-mapped alerts — the false-positive side of the contract.
+
+**scrape_overhead** — the plane must be affordable: the PR 7 reconcile
+storm (bench_controller.run_bench) with a live scraper + stock rule
+set evaluating on a 0.5s cadence (the SoakConfig production default)
+vs the same storm bare.  Gate:
+busy-throughput ratio (bare / scraped, best-of-N per arm) <= 1.05x.
+
+Usage:
+  python bench_obsplane.py --smoke   # reduced-size sanity run
+  python bench_obsplane.py           # full run -> BENCH_OBSPLANE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+GATE_PRECISION = 0.9
+GATE_RECALL = 0.9
+GATE_TTD_P99_S = 30.0
+GATE_OVERHEAD_X = 1.05
+
+
+# ---------------------------------------------------------------------------
+# Section 1: straggler detection quality (simulated, logical clock)
+# ---------------------------------------------------------------------------
+
+def run_straggler_sim(jobs: int, workers: int, seed: int,
+                      degrade_to: float = 0.3, onset_s: float = 10.0,
+                      horizon_s: float = 60.0, dt: float = 1.0) -> dict:
+    """Seeded step-stream simulation through the REAL scoring path.
+
+    Every worker advances a cumulative step counter at its own rate
+    (healthy: ~2 steps/s with +/-10% per-worker skew and +/-5%
+    per-tick jitter).  At ``onset_s`` the seeded straggler subset
+    (one worker in ~half the gangs) drops to ``degrade_to`` of its
+    rate.  Each tick mirrors the soak harness's scrape cycle:
+    observe_progress -> publish -> store -> AlertEngine.evaluate.
+    """
+    from mpi_operator_tpu.obsplane import (AlertEngine, StragglerRule,
+                                           StragglerScorer,
+                                           TimeSeriesStore)
+    from mpi_operator_tpu.soak.slo import quantile
+    from mpi_operator_tpu.telemetry.metrics import Registry
+
+    rng = random.Random(seed)
+    registry = Registry()
+    store = TimeSeriesStore(retention_s=10 * horizon_s)
+    scorer = StragglerScorer(registry=registry)
+    engine = AlertEngine(store, [StragglerRule()], registry=registry)
+
+    fleet = {}
+    truth = set()
+    for j in range(jobs):
+        job = f"sim-{j}"
+        bad = rng.randrange(workers) if rng.random() < 0.5 else None
+        for w in range(workers):
+            worker = f"worker-{w}"
+            if w == bad:
+                truth.add((job, worker))
+            fleet[(job, worker)] = {
+                "interval": 0.5 * rng.uniform(0.9, 1.1),
+                "steps": 0.0,
+                "bad": w == bad,
+            }
+
+    t = 0.0
+    for _ in range(int(horizon_s / dt)):
+        t += dt
+        for (job, worker), st in sorted(fleet.items()):
+            interval = st["interval"]
+            if st["bad"] and t > onset_s:
+                interval /= degrade_to
+            st["steps"] += (dt / interval) * rng.uniform(0.95, 1.05)
+            scorer.observe_progress(job, worker, int(st["steps"]), t)
+        for (job, worker), score in sorted(scorer.publish(t).items()):
+            store.add_sample("mpi_operator_straggler_score",
+                             {"job": job, "worker": worker}, score, t)
+        engine.evaluate(t)
+
+    first_fire = {}
+    for f in engine.firings():
+        if f["alert"] != "StragglerAlert":
+            continue
+        key = (f["labels"]["job"], f["labels"]["worker"])
+        if key not in first_fire or f["t"] < first_fire[key]:
+            first_fire[key] = f["t"]
+
+    predicted = set(first_fire)
+    tp = len(predicted & truth)
+    precision = tp / len(predicted) if predicted else 1.0
+    recall = tp / len(truth) if truth else 1.0
+    ttds = sorted(first_fire[k] - onset_s for k in predicted & truth)
+    return {
+        "jobs": jobs, "workers_per_job": workers,
+        "ticks": int(horizon_s / dt), "onset_s": onset_s,
+        "degrade_to_rate_x": degrade_to,
+        "stragglers_seeded": len(truth),
+        "stragglers_detected": tp,
+        "false_positives": sorted(predicted - truth),
+        "missed": sorted(truth - predicted),
+        "precision": round(precision, 3),
+        "recall": round(recall, 3),
+        "time_to_detect_p50_s": quantile(ttds, 0.50),
+        "time_to_detect_p99_s": quantile(ttds, 0.99),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Section 2: alert fidelity on a scripted chaos soak
+# ---------------------------------------------------------------------------
+
+def fidelity_plan(smoke: bool):
+    """One fault of every FIDELITY_MAP kind.  The three
+    GangDisruption-mapped kinds (pod_kill / pod_delete / preempt) are
+    spaced further apart than the fast alert window so the alert
+    RESOLVES between them — each injection must earn its own firing
+    transition, not coast on the previous one's."""
+    from mpi_operator_tpu.chaos import Fault, FaultPlan
+    faults = [
+        Fault(at=1.0, kind="slow_node",
+              target="default/gang-0-worker-0", duration=16.0,
+              params={"duty": 0.8, "period": 0.5, "wait": 8}),
+        Fault(at=1.5, kind="pod_kill",
+              target="default/gang-0-worker-1", params={"wait": 5}),
+        Fault(at=3.0, kind="controller_restart", duration=0.4),
+        Fault(at=4.5, kind="scheduler_restart", duration=0.4),
+        Fault(at=6.0, kind="apiserver_restart", duration=0.4),
+        Fault(at=7.5, kind="replica_kill"),
+    ]
+    if not smoke:
+        faults += [
+            Fault(at=9.0, kind="pod_delete",
+                  target="default/gang-0-worker-2", params={"wait": 3}),
+            Fault(at=16.5, kind="preempt",
+                  target="default/gang-0-worker-1",
+                  params={"wait": 3, "grace": 0.5}),
+        ]
+    return FaultPlan(name="bench-obsplane-fidelity", seed=11,
+                     faults=faults)
+
+
+def _soak_config(seed: int, duration: float, plan, smoke: bool):
+    from mpi_operator_tpu.sched.capacity import TpuSlice
+    from mpi_operator_tpu.soak import SoakConfig
+    return SoakConfig(
+        seed=seed, duration=duration,
+        gangs=1, gang_workers=3,
+        small_rate=0.4, small_limit=3,
+        slices=[TpuSlice("slice-0", 8),
+                TpuSlice("slice-1", 4, spot=True)],
+        serve_replicas=2, tenants=4, prefix_tokens=32,
+        max_new_tokens=8, closed_clients=2, open_rate=3.0,
+        plan=plan, converge_timeout=30.0,
+        settle=3.0 if smoke else 5.0,
+        scrape_interval=0.5, alert_window=6.0,
+        alert_slow_window=20.0, alert_deadline=15.0)
+
+
+def _mapped_alert_names():
+    from mpi_operator_tpu.obsplane import FIDELITY_MAP
+    return {name for names in FIDELITY_MAP.values() for name in names}
+
+
+def run_fidelity(smoke: bool) -> dict:
+    from mpi_operator_tpu.chaos import FaultPlan
+    from mpi_operator_tpu.soak import SoakHarness, tiny_llama_server_factory
+
+    factory = tiny_llama_server_factory(replicas=2, slots=4, tenants=4,
+                                        prefix_tokens=32, max_new=8)
+    plan = fidelity_plan(smoke)
+    planned_kinds = sorted({f.kind for f in plan.faults})
+
+    print(f"bench_obsplane: fidelity soak ({len(plan.faults)} scripted"
+          f" faults, kinds: {', '.join(planned_kinds)})...", flush=True)
+    duration = 10.0 if smoke else 20.0
+    with SoakHarness(_soak_config(11, duration, plan, smoke),
+                     factory) as harness:
+        result = harness.run()
+    card = result.scorecard
+    fidelity = card.detail.get("alert_fidelity") or {}
+
+    print("bench_obsplane: quiescent soak (no faults)...", flush=True)
+    quiet_plan = FaultPlan(name="bench-obsplane-quiescent", seed=12,
+                           faults=[])
+    with SoakHarness(_soak_config(12, 6.0 if smoke else 8.0, quiet_plan,
+                                  smoke), factory) as harness:
+        quiet = harness.run().scorecard
+    quiet_fidelity = quiet.detail.get("alert_fidelity") or {}
+    mapped = _mapped_alert_names()
+    quiet_mapped_firings = sorted(
+        {h["alert"] for h in quiet_fidelity.get("history", [])
+         if h["alert"] in mapped})
+
+    return {
+        "planned_kinds": planned_kinds,
+        "fidelity": fidelity,
+        "converged": card.converged,
+        "invariant_violations": card.invariant_violations,
+        "faults_by_kind": card.detail.get("faults_by_kind"),
+        "quiescent": {
+            "converged": quiet.converged,
+            "invariant_violations": quiet.invariant_violations,
+            "mapped_alert_firings": quiet_mapped_firings,
+            "history": quiet_fidelity.get("history", []),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Section 3: scrape overhead on the reconcile storm
+# ---------------------------------------------------------------------------
+
+class _OverheadPlane:
+    """A live plane at full production cadence: scraper over the
+    process default registry (where the controller's informer /
+    workqueue families land) + the stock rule set evaluating every
+    cycle — the realistic per-scrape cost, not a no-op thread."""
+
+    def __init__(self, interval: float = 0.5):
+        from mpi_operator_tpu.obsplane import (AlertEngine, Scraper,
+                                               TimeSeriesStore,
+                                               default_fleet_rules)
+        from mpi_operator_tpu.telemetry.metrics import (Registry,
+                                                        default_registry)
+        self.registry = Registry()
+        self.store = TimeSeriesStore()
+        self.scraper = Scraper(self.store, registry=self.registry)
+        self.scraper.add_registry(default_registry())
+        self.scraper.add_registry(self.registry)
+        self.engine = AlertEngine(self.store, default_fleet_rules(),
+                                  registry=self.registry)
+        self.cycles = 0
+
+        def cycle(t: float) -> None:
+            self.engine.evaluate(t)
+            self.cycles += 1
+
+        self.scraper.start(interval, on_cycle=cycle)
+
+    def stop(self) -> int:
+        self.scraper.stop()
+        return self.cycles
+
+
+def run_scrape_overhead(jobs: int, workers: int, repeats: int) -> dict:
+    from bench_controller import run_bench
+
+    def one(scraped: bool) -> float:
+        plane = _OverheadPlane() if scraped else None
+        try:
+            record = run_bench(jobs, workers, threads=4, storm=1,
+                               timeout=180.0)
+        finally:
+            cycles = plane.stop() if plane else 0
+        busy = record["reconciles_per_sec_busy"] or 0.0
+        label = f"scraped ({cycles} scrape cycles)" if scraped \
+            else "bare"
+        print(f"bench_obsplane: storm {label}:"
+              f" {busy} reconciles/s busy", flush=True)
+        return busy
+
+    # Untimed warmup: the first storm pays import/allocator warmup that
+    # would otherwise be billed to whichever arm runs first.
+    run_bench(jobs, workers, threads=4, storm=1, timeout=180.0)
+    bare, scraped = [], []
+    for _ in range(repeats):
+        bare.append(one(scraped=False))
+        scraped.append(one(scraped=True))
+    best_bare, best_scraped = max(bare), max(scraped)
+    return {
+        "jobs": jobs, "workers": workers, "runs_per_arm": repeats,
+        "scrape_interval_s": 0.5,
+        "bare_busy_per_s": bare,
+        "scraped_busy_per_s": scraped,
+        "best_bare_per_s": best_bare,
+        "best_scraped_per_s": best_scraped,
+        "overhead_x": round(best_bare / best_scraped, 4)
+        if best_scraped else None,
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced-size sanity run")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--out", default="BENCH_OBSPLANE.json")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        sim_jobs, sim_workers, sim_horizon = 6, 4, 40.0
+        storm_jobs, storm_workers, storm_repeats = 10, 2, 1
+    else:
+        sim_jobs, sim_workers, sim_horizon = 20, 8, 60.0
+        storm_jobs, storm_workers, storm_repeats = 25, 3, 2
+
+    print(f"bench_obsplane: straggler sim ({sim_jobs} gangs x"
+          f" {sim_workers} workers, seed={args.seed})...", flush=True)
+    straggler = run_straggler_sim(sim_jobs, sim_workers, args.seed,
+                                  horizon_s=sim_horizon)
+    fidelity = run_fidelity(args.smoke)
+    overhead = run_scrape_overhead(storm_jobs, storm_workers,
+                                   storm_repeats)
+
+    fid = fidelity["fidelity"]
+    gates = {
+        "straggler_precision_ge_0.9":
+            straggler["precision"] >= GATE_PRECISION,
+        "straggler_recall_ge_0.9": straggler["recall"] >= GATE_RECALL,
+        "straggler_ttd_p99_le_30s":
+            straggler["time_to_detect_p99_s"] is not None
+            and straggler["time_to_detect_p99_s"] <= GATE_TTD_P99_S,
+        "fidelity_ok": bool(fid.get("ok")),
+        "fidelity_all_planned_kinds_applied":
+            fid.get("mapped_kinds_injected")
+            == len(fidelity["planned_kinds"]),
+        "fidelity_zero_violations":
+            fidelity["converged"]
+            and fidelity["invariant_violations"] == 0,
+        "quiescent_zero_mapped_firings":
+            fidelity["quiescent"]["converged"]
+            and not fidelity["quiescent"]["mapped_alert_firings"],
+        "scrape_overhead_le_1.05x":
+            overhead["overhead_x"] is not None
+            and overhead["overhead_x"] <= GATE_OVERHEAD_X,
+    }
+
+    report = {
+        "bench": "obsplane",
+        "smoke": args.smoke,
+        "seed": args.seed,
+        "host": "single-core CPU sim (logical-clock straggler sim,"
+                " subprocess soak gangs, in-memory reconcile storm)",
+        "straggler": straggler,
+        "alert_fidelity": fidelity,
+        "scrape_overhead": overhead,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+
+    ttd = straggler["time_to_detect_p99_s"]
+    print(f"bench_obsplane: straggler precision"
+          f" {straggler['precision']} recall {straggler['recall']}"
+          f" ttd_p99 {ttd}s;"
+          f" fidelity {fid.get('mapped_kinds_injected', 0)}/"
+          f"{len(fidelity['planned_kinds'])} kinds ok={fid.get('ok')};"
+          f" scrape overhead {overhead['overhead_x']}x;"
+          f" wrote {args.out}")
+    if not report["ok"]:
+        failed = [g for g, v in gates.items() if not v]
+        print(f"bench_obsplane: FAIL ({', '.join(failed)})")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))

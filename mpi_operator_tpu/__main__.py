@@ -28,6 +28,7 @@ import os
 import signal
 import sys
 import time
+from typing import Optional
 
 
 def _client(master: str):
@@ -409,20 +410,43 @@ def _top_snapshot(client, namespace, metrics: dict) -> str:
     return "\n".join(lines)
 
 
+def _cli_scrape_errors():
+    from .telemetry.metrics import default_registry
+    return default_registry().counter(
+        "mpi_operator_cli_scrape_errors_total",
+        "CLI /metrics scrapes that failed after the retry (top,"
+        " debug-bundle, series)")
+
+
+def _fetch_exposition(url: str, timeout: float = 5.0,
+                      attempts: int = 2) -> Optional[str]:
+    """GET a /metrics exposition, retrying once on transport errors.
+    A scrape that still fails is COUNTED (the CLI's own error counter)
+    and warned — a monitoring tool that only prints its blind spots is
+    itself unmonitorable."""
+    import http.client
+    import urllib.request
+    last: Optional[Exception] = None
+    for _ in range(max(1, attempts)):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return resp.read().decode()
+        except (OSError, ValueError,
+                http.client.HTTPException) as exc:
+            last = exc
+    _cli_scrape_errors().inc()
+    print(f"warning: could not scrape {url}: {last}", file=sys.stderr)
+    return None
+
+
 def cmd_top(args) -> int:
     client = _client(args.master)
 
     def fetch_metrics() -> dict:
         if not args.metrics_url:
             return {}
-        import http.client
-        import urllib.request
-        try:
-            with urllib.request.urlopen(args.metrics_url,
-                                        timeout=5) as resp:
-                return _parse_metrics_text(resp.read().decode())
-        except (OSError, ValueError, http.client.HTTPException):
-            return {}
+        text = _fetch_exposition(args.metrics_url)
+        return _parse_metrics_text(text) if text else {}
 
     if args.once:
         print(_top_snapshot(client, args.namespace, fetch_metrics()))
@@ -627,14 +651,7 @@ def cmd_debug_bundle(args) -> int:
     payload = flight.job_snapshot(client, args.namespace, args.name)
     metrics_text = None
     if args.metrics_url:
-        import urllib.request
-        try:
-            with urllib.request.urlopen(args.metrics_url,
-                                        timeout=5) as resp:
-                metrics_text = resp.read().decode()
-        except Exception as exc:
-            print(f"warning: could not scrape {args.metrics_url}: {exc}",
-                  file=sys.stderr)
+        metrics_text = _fetch_exposition(args.metrics_url)
     path = flight.dump_bundle(f"cli-{args.name}", directory=args.out,
                               job_payload=payload,
                               metrics_text=metrics_text)
@@ -642,6 +659,88 @@ def cmd_debug_bundle(args) -> int:
         print("error: bundle dump failed", file=sys.stderr)
         return 1
     print(f"debug bundle written: {path}")
+    return 0
+
+
+def cmd_alerts(args) -> int:
+    """Print the canonical alert history a flight bundle embedded
+    (alerts.json — the metrics plane's "what paged during this
+    incident" record, docs/OBSERVABILITY.md)."""
+    import glob
+    import json
+
+    from .telemetry import flight
+    path = args.bundle
+    if path and os.path.isdir(path):
+        path = os.path.join(path, "alerts.json")
+    if not path:
+        candidates = sorted(
+            glob.glob(os.path.join(flight.debug_dir(), "bundle-*",
+                                   "alerts.json")),
+            key=os.path.getmtime, reverse=True)
+        if not candidates:
+            print("no alert history found: no bundle with alerts.json"
+                  f" under {flight.debug_dir()}", file=sys.stderr)
+            return 1
+        path = candidates[0]
+    with open(path) as f:
+        history = json.load(f)
+    if not history:
+        print(f"{path}: quiescent (no alerts fired)")
+        return 0
+    print(f"alert history ({path}):")
+    width = max(len(h.get("alert", "")) for h in history)
+    for h in history:
+        labels = ",".join(f'{k}="{v}"' for k, v
+                          in sorted(h.get("labels", {}).items()))
+        print(f"  {h.get('severity', '-'):8} "
+              f"{h.get('alert', '?'):{width}}  {{{labels}}}")
+    return 0
+
+
+def cmd_series(args) -> int:
+    """Sample a live /metrics endpoint N times into a throwaway
+    time-series store, then print every series matching the selector:
+    last value, per-second rate for counters, windowed p99 for
+    histograms."""
+    from .obsplane import TimeSeriesStore, parse_exposition
+    from .obsplane.store import parse_selector
+    parse_selector(args.selector)  # malformed selectors fail fast
+    store = TimeSeriesStore()
+    samples = max(2, args.samples)
+    for i in range(samples):
+        text = _fetch_exposition(args.metrics_url)
+        t = time.monotonic()
+        if text:
+            for name, kind, labels, sample in parse_exposition(text):
+                store.add_sample(name, labels, sample, t, kind=kind)
+        if i < samples - 1:
+            time.sleep(args.interval)
+    matched = store.select(args.selector)
+    if not matched:
+        print(f"no series match {args.selector}", file=sys.stderr)
+        return 1
+    at = time.monotonic()
+    window = args.interval * samples + 1.0
+    rates = {tuple(sorted(labels.items())): r for labels, r
+             in store.rate(args.selector, window, at)}
+    p99s = {tuple(sorted(labels.items())): v for labels, v
+            in store.quantile_over_time(args.selector, 0.99, window,
+                                        at)}
+    for s in matched:
+        key = tuple(sorted(s.labels.items()))
+        label_s = ",".join(f'{k}="{v}"' for k, v in key)
+        _, last = s.samples[-1]
+        if isinstance(last, dict):
+            parts = [f"count={last.get('count', 0)}",
+                     f"sum={last.get('sum', 0.0):.6g}"]
+            if key in p99s:
+                parts.append(f"p99_over_window={p99s[key]:.6g}")
+        else:
+            parts = [f"last={last:.6g}"]
+            if s.kind == "counter" and key in rates:
+                parts.append(f"rate={rates[key]:.6g}/s")
+        print(f"{s.name}{{{label_s}}}  " + "  ".join(parts))
     return 0
 
 
@@ -847,6 +946,25 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--out", default=None,
                    help="bundle parent dir (default: debug dir)")
 
+    p = sub.add_parser("alerts",
+                       help="alert history from a flight bundle"
+                            " (metrics plane, docs/OBSERVABILITY.md)")
+    p.add_argument("--bundle", default=None,
+                   help="bundle dir or alerts.json path (default:"
+                        " newest bundle under the debug dir)")
+
+    p = sub.add_parser("series",
+                       help="sample a /metrics endpoint into a"
+                            " throwaway time-series store and print"
+                            " matching series")
+    p.add_argument("selector",
+                   help='name{label="value",...} series selector')
+    p.add_argument("--metrics-url",
+                   default="http://127.0.0.1:8001/metrics")
+    p.add_argument("--samples", type=int, default=3,
+                   help="scrape cycles to collect (>= 2 for rates)")
+    p.add_argument("--interval", type=float, default=1.0)
+
     p = sub.add_parser("trace",
                        help="critical-path decomposition of a job or"
                             " serve request (causal tracing)")
@@ -907,6 +1025,10 @@ def main(argv=None) -> int:
             return cmd_checkpoints(args)
         if args.command == "debug-bundle":
             return cmd_debug_bundle(args)
+        if args.command == "alerts":
+            return cmd_alerts(args)
+        if args.command == "series":
+            return cmd_series(args)
         if args.command == "trace":
             return cmd_trace(args)
         if args.command in ("suspend", "resume", "delete"):

@@ -104,6 +104,10 @@ class ProjectContext:
     root: str
     # metrics-catalog collect phase: name -> first (relpath, line) seen
     metric_sites: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    # alert-rule metric references: (metric, relpath, line) per string
+    # literal passed as metric=/..._metric= to an obsplane rule class
+    alert_rule_refs: List[Tuple[str, str, int]] = field(
+        default_factory=list)
 
 
 RULES: List[Rule] = []
@@ -281,6 +285,15 @@ SIM_SCOPE = frozenset((
     # (time.sleep for armed slow-faults is injected delay, not a read.)
     "mpi_operator_tpu/ckpt/blobstore.py",
     "mpi_operator_tpu/ckpt/manifest.py",
+    # Metrics plane: the store, rules, and straggler scorer run on
+    # caller-supplied logical time only — simulated feeds (bench,
+    # run-twice smoke) must evaluate bit-identically.  The scraper
+    # (obsplane/scrape.py) is deliberately NOT here: its default clock
+    # is time.monotonic for live cadence.
+    "mpi_operator_tpu/obsplane/store.py",
+    "mpi_operator_tpu/obsplane/rules.py",
+    "mpi_operator_tpu/obsplane/straggler.py",
+    "mpi_operator_tpu/obsplane/fleet.py",
 ))
 
 _WALLCLOCK_FNS = {("time", "time"), ("time", "time_ns"),
@@ -324,8 +337,11 @@ def check_wallclock_sim(ctx: FileContext) -> List[Finding]:
 # metrics-catalog (project-level: collect per file, compare vs docs)
 
 # Family names built with dynamic prefixes (f-strings the literal walk
-# cannot see); keep in sync with telemetry/goodput.py.
-DYNAMIC_METRIC_FAMILIES = ("train_goodput_fraction", "train_step_seconds")
+# cannot see) or synthesized straight into the time-series store rather
+# than a registry; keep in sync with telemetry/goodput.py and
+# obsplane/scrape.py.
+DYNAMIC_METRIC_FAMILIES = ("train_goodput_fraction", "train_step_seconds",
+                           "mpi_operator_worker_steps_total")
 
 _METRIC_FACTORIES = {"counter", "gauge", "histogram",
                      "counter_vec", "gauge_vec", "histogram_vec"}
@@ -335,13 +351,39 @@ _METRIC_CLASSES = {"Counter", "Gauge", "Histogram",
 _DOC_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_]+)(?:\{[^}]*\})?`")
 
 
+# Obsplane rule classes (obsplane/rules.py): every metric they are
+# handed as a string literal is an alert-rule reference the catalog
+# must cover — a rule watching a series that will never exist alerts
+# on nothing, forever, silently.
+_ALERT_RULE_CLASSES = {"ThresholdRule", "BurnRateRule", "AbsentRule",
+                       "StallRule", "StragglerRule"}
+
+
 def _collect_metrics(ctx: FileContext) -> None:
     for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Call) and node.args and
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = (f.attr if isinstance(f, ast.Attribute)
+                 else f.id if isinstance(f, ast.Name) else None)
+        if fname in _ALERT_RULE_CLASSES:
+            refs = [kw.value.value for kw in node.keywords
+                    if kw.arg and (kw.arg == "metric"
+                                   or kw.arg.endswith("_metric"))
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)]
+            # Rule(name, metric, ...) positional form.
+            if len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                refs.append(node.args[1].value)
+            for metric in refs:
+                ctx.project.alert_rule_refs.append(
+                    (metric, ctx.relpath, node.lineno))
+        if not (node.args and
                 isinstance(node.args[0], ast.Constant) and
                 isinstance(node.args[0].value, str)):
             continue
-        f = node.func
         name = None
         if isinstance(f, ast.Attribute) and f.attr in _METRIC_FACTORIES:
             name = node.args[0].value
@@ -387,6 +429,22 @@ def _finalize_metrics(project: ProjectContext) -> List[Finding]:
                 "metrics-catalog", "docs/OBSERVABILITY.md", lineno,
                 f"metric family {name!r} documented in the catalog but "
                 f"registered nowhere in mpi_operator_tpu/"))
+    # Alert-rule references: a rule may only watch a family that is
+    # both documented and actually registered (or a known dynamic
+    # family) — both directions of the catalog contract extend to the
+    # alerting policy.
+    for metric, relpath, lineno in sorted(project.alert_rule_refs):
+        problems = []
+        if metric not in documented:
+            problems.append("missing from the docs/OBSERVABILITY.md"
+                            " catalog")
+        if metric not in registered:
+            problems.append("registered nowhere in mpi_operator_tpu/")
+        if problems:
+            findings.append(Finding(
+                "metrics-catalog", relpath, lineno,
+                f"alert rule references metric {metric!r} "
+                + " and ".join(problems)))
     return findings
 
 

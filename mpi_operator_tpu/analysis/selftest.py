@@ -31,6 +31,10 @@ EXPECTED_STATIC = (
     ("wallclock-sim", "mpi_operator_tpu/chaos/plan.py", 2),
     ("metrics-catalog", "mpi_operator_tpu/seeded_metrics.py", 1),
     ("metrics-catalog", "docs/OBSERVABILITY.md", 1),
+    # The alert-rule extension's violation pair: one rule watching a
+    # metric that exists nowhere, one watching the documented-but-
+    # unregistered ghost.
+    ("metrics-catalog", "mpi_operator_tpu/seeded_rules.py", 2),
 )
 
 _SEEDED_FILES = {
@@ -73,6 +77,21 @@ _SEEDED_FILES = {
             return registry.counter(
                 "mpi_operator_selftest_undocumented_total",
                 "registered but missing from the catalog")
+    """,
+    "mpi_operator_tpu/seeded_rules.py": """\
+        from mpi_operator_tpu.obsplane.rules import ThresholdRule
+
+        def rules():
+            return [
+                ThresholdRule(
+                    "SeededPhantomWatch",
+                    metric="mpi_operator_selftest_phantom_total",
+                    above=0.0),
+                ThresholdRule(
+                    "SeededGhostWatch",
+                    metric="mpi_operator_selftest_ghost_total",
+                    above=0.0),
+            ]
     """,
     "docs/OBSERVABILITY.md": """\
         | metric | type | layer | meaning |

@@ -569,3 +569,73 @@ def inject_api_partition(ctx, fault):
     def heal():
         ctx.bank.remove_rule(rule)
     return heal
+
+
+@register_injector("slow_node")
+def inject_slow_node(ctx, fault):
+    """Gray failure: one worker runs at a duty-cycled fraction of full
+    speed (degraded NIC, thermal throttle, noisy neighbor) with NO
+    scheduler-visible symptom — the pod stays Running, heartbeats flow,
+    only its step cadence sags.  Implemented by SIGSTOP/SIGCONT
+    duty-cycling the container process from a shim thread: ``duty`` is
+    the stopped fraction of each ``period`` (duty 0.66 ~= a 3x slower
+    worker).  The only thing that should catch this is the metrics
+    plane's straggler score — the scheduler, by design, is given
+    nothing to mitigate with.
+
+    Scripted-plan only: not in any randomized-kind tuple (randomized
+    plan SHAs are pinned) and excluded from the converge predicate's
+    concerns because the pod never leaves Running.
+    """
+    import signal
+
+    target = _resolve_pod(ctx, fault)
+    if target is None:
+        ctx.log_result(fault, resolved_target="", result="no-candidate")
+        return None
+    wait = float(fault.params.get("wait", 0))
+    if wait > 0:
+        _wait_live_process(ctx, target, wait)
+    kubelet = ctx.system.kubelet
+    with kubelet._lock:
+        runner = kubelet._runners.get(tuple(target))
+    proc = runner.proc if runner is not None else None
+    if proc is None or proc.poll() is not None:
+        ctx.log_result(fault, resolved_target="/".join(target),
+                       result="no-process")
+        return None
+    # The period must dominate the worker's step interval for the duty
+    # cycle to translate into step-rate slowdown: a sleep-dominated
+    # step loop rides out sub-interval stop windows for free (sleep
+    # deadlines keep elapsing while stopped).
+    duty = min(0.95, max(0.05, float(fault.params.get("duty", 0.66))))
+    period = max(0.02, float(fault.params.get("period", 0.5)))
+    healed = threading.Event()
+
+    def shim():
+        while not healed.is_set():
+            try:
+                if proc.poll() is not None:
+                    return  # died (restart/kill): nothing left to slow
+                proc.send_signal(signal.SIGSTOP)
+                healed.wait(duty * period)
+                proc.send_signal(signal.SIGCONT)
+            except (OSError, ProcessLookupError):
+                return
+            healed.wait((1.0 - duty) * period)
+
+    thread = threading.Thread(target=shim, daemon=True,
+                              name=f"slow-node-{target[1]}")
+    thread.start()
+    ctx.log_result(fault, resolved_target="/".join(target),
+                   result=f"throttled duty={duty}")
+
+    def heal():
+        healed.set()
+        thread.join(timeout=2)
+        try:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGCONT)
+        except (OSError, ProcessLookupError):
+            pass
+    return heal

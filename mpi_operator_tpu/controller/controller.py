@@ -400,6 +400,13 @@ class MPIJobController:
         shared = self.mpi_job_informer.lister.get(namespace, name)
         if shared is None:
             logger.debug("MPIJob has been deleted: %s", key)
+            # Drop the job's info series with it: a departed job must
+            # disappear from the next scrape, not linger at 1 forever
+            # (stale-series leak; the obsplane TSDB would retain the
+            # ghost and staleness-bound alerts would still see it).
+            from .builders import LAUNCHER_SUFFIX
+            self.metrics["job_info"].remove(
+                f"{name}{LAUNCHER_SUFFIX}", namespace)
             return
         # NEVER modify informer cache objects (:591-594).
         mpi_job = deep_copy(shared)

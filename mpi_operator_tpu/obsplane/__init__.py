@@ -1,0 +1,26 @@
+"""Fleet metrics plane: scraper -> time-series store -> alert rules.
+
+The observability stack before this package was instant-only: registries
+answer "what is the value now" (telemetry/metrics.py), flight rings
+answer "what just happened" (telemetry/flight.py).  This package adds
+the change-over-time layer — a scraping TSDB with Prometheus-shaped
+range evaluators, SLO burn-rate alerting over the soak targets, and the
+flagship consumer: per-worker step-time distributions scored into
+``mpi_operator_straggler_score{job,worker}``.
+"""
+
+from .store import Series, TimeSeriesStore, parse_selector
+from .scrape import Scraper, parse_exposition
+from .rules import (Alert, AlertEngine, AbsentRule, BurnRateRule, Rule,
+                    StallRule, StragglerRule, ThresholdRule)
+from .straggler import StragglerScorer
+from .fleet import FIDELITY_MAP, default_fleet_rules, score_alert_fidelity
+
+__all__ = [
+    "Series", "TimeSeriesStore", "parse_selector",
+    "Scraper", "parse_exposition",
+    "Alert", "AlertEngine", "AbsentRule", "BurnRateRule", "Rule",
+    "StallRule", "StragglerRule", "ThresholdRule",
+    "StragglerScorer",
+    "FIDELITY_MAP", "default_fleet_rules", "score_alert_fidelity",
+]

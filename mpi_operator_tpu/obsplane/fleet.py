@@ -1,0 +1,197 @@
+"""The fleet's stock rule set and the alert-fidelity scorer.
+
+`default_fleet_rules` is the one place the operator's alerting policy
+lives: SLO burn-rate rules over the soak targets (goodput, serve TTFT)
+plus the structural rules that catch control-plane pathologies the SLO
+windows are too slow for (watch resume storms, WAL fsync stalls,
+queue-wait growth, gang disruption).  Every metric reference here is a
+string literal so the `metrics-catalog` lint rule can hold it against
+the documented catalog.
+
+`FIDELITY_MAP` + `score_alert_fidelity` close the loop: for each chaos
+fault class we can solidly map to an alert, an injected fault MUST
+raise one of its mapped alerts within the deadline — that is the
+soak scorecard's alert-fidelity section and BENCH_OBSPLANE's gate.
+Fault kinds with no solid mapping (e.g. `blob_fault`, absorbed by
+checkpoint retries by design) are reported as unmapped, not silently
+counted as covered.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .rules import (AbsentRule, BurnRateRule, Rule, StallRule,
+                    StragglerRule, ThresholdRule)
+
+__all__ = ["default_fleet_rules", "FIDELITY_MAP",
+           "score_alert_fidelity"]
+
+
+def default_fleet_rules(window: float = 30.0,
+                        slow_window: float = 120.0,
+                        for_s: float = 0.0,
+                        straggler_threshold: float = 1.8,
+                        queue_wait_p99: float = 2.0,
+                        ttft_objective_le: float = 2.5,
+                        goodput_target: float = 0.7,
+                        watchdog_selector: Optional[str] = None
+                        ) -> List[Rule]:
+    """The stock rule set the soak harness and smoke arm.
+
+    ``window``/``slow_window`` are the fast/slow burn windows — soak
+    runs are short, so defaults are tighter than a production 5m/1h
+    pair; the grammar is identical.  ``watchdog_selector`` optionally
+    adds an AbsentRule for a feed that must exist (e.g. the worker
+    step counters once a job is running).
+    """
+    rules: List[Rule] = [
+        # Flagship: per-worker straggler score (obsplane/straggler.py).
+        StragglerRule(threshold=straggler_threshold, for_s=for_s),
+        # Control-plane restarts, from the soak watchdog's recovery
+        # counter — one rule per component so the fidelity map can
+        # hold each fault class to its own alert.
+        ThresholdRule(
+            "ControllerRestart",
+            metric="mpi_operator_soak_recoveries_total",
+            selector='mpi_operator_soak_recoveries_total'
+                     '{component="controller"}',
+            mode="increase", window=window, above=0.0, for_s=for_s),
+        ThresholdRule(
+            "SchedulerRestart",
+            metric="mpi_operator_soak_recoveries_total",
+            selector='mpi_operator_soak_recoveries_total'
+                     '{component="scheduler"}',
+            mode="increase", window=window, above=0.0, for_s=for_s),
+        ThresholdRule(
+            "ApiserverRestart",
+            metric="mpi_operator_soak_recoveries_total",
+            selector='mpi_operator_soak_recoveries_total'
+                     '{component="apiserver"}',
+            mode="increase", window=window, above=0.0, for_s=for_s),
+        # Structural: informers re-listing in a loop — apiserver churn
+        # or a compaction horizon chasing the watchers.
+        ThresholdRule(
+            "WatchResumeStorm",
+            metric="mpi_operator_informer_watch_resumes_total",
+            mode="increase", window=window, above=2.0, for_s=for_s),
+        # Structural: WAL appends advancing while fsyncs do not.
+        StallRule(
+            "WalFsyncStall",
+            metric="mpi_operator_wal_fsyncs_total",
+            activity_metric="mpi_operator_wal_appends_total",
+            window=window, min_activity=5.0, for_s=for_s,
+            severity="critical"),
+        # Structural: admission queue wait growing — capacity crunch
+        # or a scheduler stall, visible before jobs actually miss SLO.
+        ThresholdRule(
+            "QueueWaitGrowth",
+            metric="mpi_operator_workqueue_wait_seconds",
+            mode="quantile", q=0.99, window=slow_window,
+            above=queue_wait_p99, for_s=for_s),
+        # Gang disruption: worker death / preemption restarted a gang.
+        ThresholdRule(
+            "GangDisruption",
+            metric="mpi_operator_gang_restarts_total",
+            mode="increase", window=window, above=0.0, for_s=for_s),
+        # Serving: router retries mean replicas are failing requests.
+        ThresholdRule(
+            "ServeRetryBurst",
+            metric="mpi_operator_router_retries_total",
+            mode="increase", window=window, above=0.0, for_s=for_s),
+        # SLO burn: TTFT objective (fraction of requests over the
+        # objective bucket bound, multiwindow).
+        BurnRateRule(
+            "ServeTtftBurnRate",
+            metric="mpi_operator_router_ttft_seconds",
+            objective=0.99, objective_le=ttft_objective_le,
+            fast_window=window, slow_window=slow_window,
+            severity="critical"),
+        # SLO burn: training goodput sagging below target.  Gauge
+        # error ratio saturates at 1.0, so burn thresholds are small
+        # multiples, not the 14x/6x of the histogram path.
+        BurnRateRule(
+            "GoodputBurnRate",
+            metric="train_goodput_fraction",
+            objective=0.9, gauge_target=goodput_target,
+            fast_window=window, slow_window=slow_window,
+            fast_burn=2.0, slow_burn=1.0, severity="critical"),
+    ]
+    if watchdog_selector:
+        rules.append(AbsentRule(
+            "FeedAbsent", metric=watchdog_selector.split("{")[0],
+            selector=watchdog_selector, for_s=for_s))
+    return rules
+
+
+# Chaos fault kind -> alert names that count as detecting it.  Only
+# kinds with a SOLID mapping appear; anything else is reported as
+# unmapped by score_alert_fidelity (an honest gap, not a silent pass).
+FIDELITY_MAP: Dict[str, tuple] = {
+    "controller_restart": ("ControllerRestart",),
+    "scheduler_restart": ("SchedulerRestart",),
+    "apiserver_restart": ("ApiserverRestart", "WatchResumeStorm"),
+    "pod_kill": ("GangDisruption",),
+    "pod_delete": ("GangDisruption",),
+    "preempt": ("GangDisruption",),
+    "replica_kill": ("ServeRetryBurst",),
+    "slow_node": ("StragglerAlert",),
+}
+
+# Results that mean the injector did NOT actually apply the fault
+# (mirrors the soak harness's applied-fault accounting).
+_SKIP_RESULT_PREFIXES = ("no-", "already-", "error", "unknown-kind")
+
+
+def _applied(event: dict) -> bool:
+    if event.get("event") != "inject":
+        return False
+    result = str(event.get("result", ""))
+    return not result.startswith(_SKIP_RESULT_PREFIXES)
+
+
+def score_alert_fidelity(events: List[dict], firings: List[dict],
+                         t0: float, deadline_s: float = 30.0) -> dict:
+    """Hold a chaos run's applied faults against the alert firings.
+
+    ``events`` is the chaos report's event log (plan offsets in
+    ``at``); ``firings`` is AlertEngine.firings() (engine clock in
+    ``t``); ``t0`` is the engine-clock time the chaos scenario
+    started, aligning the two timelines.
+    """
+    first_inject: Dict[str, float] = {}
+    unmapped: set = set()
+    for ev in events:
+        if not _applied(ev):
+            continue
+        kind = ev.get("kind", "")
+        if kind not in FIDELITY_MAP:
+            unmapped.add(kind)
+            continue
+        at = t0 + float(ev.get("at") or 0.0)
+        if kind not in first_inject or at < first_inject[kind]:
+            first_inject[kind] = at
+    per_kind = {}
+    for kind, injected_at in sorted(first_inject.items()):
+        expected = FIDELITY_MAP[kind]
+        detected = [f["t"] for f in firings
+                    if f["alert"] in expected and f["t"] >= injected_at]
+        detected_at = min(detected) if detected else None
+        ttd = (detected_at - injected_at
+               if detected_at is not None else None)
+        per_kind[kind] = {
+            "expected": list(expected),
+            "injected_at": round(injected_at, 3),
+            "detected_at": (round(detected_at, 3)
+                            if detected_at is not None else None),
+            "time_to_detect_s": (round(ttd, 3)
+                                 if ttd is not None else None),
+            "ok": ttd is not None and ttd <= deadline_s,
+        }
+    return {
+        "deadline_s": deadline_s,
+        "per_kind": per_kind,
+        "unmapped_kinds": sorted(unmapped),
+        "mapped_kinds_injected": len(per_kind),
+        "ok": all(v["ok"] for v in per_kind.values()),
+    }

@@ -1,0 +1,366 @@
+"""Alert rules + engine over the time-series store.
+
+The rule grammar is deliberately small (docs/OBSERVABILITY.md "Metrics
+plane & alerting"):
+
+- :class:`ThresholdRule` — one range evaluator (``last`` / ``rate`` /
+  ``increase`` / ``quantile``) over one selector, compared against a
+  bound; fires per offending series, carrying that series' labels.
+- :class:`BurnRateRule` — multiwindow SLO burn rate: the error ratio
+  (from a latency histogram's over-objective fraction, or a gauge's
+  distance from target) averaged over a FAST and a SLOW window, both
+  divided by the error budget; fires only when both burn factors
+  exceed their thresholds — the classic fast-burn page that a brief
+  blip cannot trip and a slow leak cannot hide from.
+- :class:`AbsentRule` — a feed that should exist does not.
+- :class:`StragglerRule` — a ThresholdRule over
+  ``mpi_operator_straggler_score`` in its flagship costume.
+
+Every rule names its ``metric`` as a string literal — the
+`metrics-catalog` lint rule (analysis/lint.py) cross-checks each
+reference against the documented catalog and the registered families,
+both directions, so a rule cannot silently watch a series that will
+never exist.
+
+The :class:`AlertEngine` runs rules on the scrape cadence with
+pending->firing promotion after ``for_s`` of sustained violation and
+resolution when the condition clears.  Alert history is recorded with
+engine timestamps; :meth:`AlertEngine.canonical_history` is the
+timestamp-free, (alert, labels)-sorted view that flight bundles embed
+and run-twice smoke tests byte-compare.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .store import TimeSeriesStore
+
+
+@dataclass
+class Alert:
+    """One (rule, labels) incident."""
+    name: str
+    labels: Dict[str, str]
+    severity: str = "warning"
+    state: str = "pending"        # pending | firing | resolved
+    since: float = 0.0            # first violating evaluation
+    fired_at: Optional[float] = None
+    resolved_at: Optional[float] = None
+    value: Optional[float] = None  # the offending evaluation value
+
+    def key(self) -> tuple:
+        return (self.name, tuple(sorted(self.labels.items())))
+
+    def to_dict(self) -> dict:
+        return {
+            "alert": self.name,
+            "labels": dict(sorted(self.labels.items())),
+            "severity": self.severity,
+            "state": self.state,
+            "since": round(self.since, 4),
+            "fired_at": (round(self.fired_at, 4)
+                         if self.fired_at is not None else None),
+            "resolved_at": (round(self.resolved_at, 4)
+                            if self.resolved_at is not None else None),
+            "value": (round(self.value, 6)
+                      if isinstance(self.value, float) else self.value),
+        }
+
+
+class Rule:
+    """Base: ``evaluate(store, t) -> [(labels, value)]`` listing every
+    series violating right now."""
+
+    def __init__(self, name: str, metric: str, severity: str = "warning",
+                 for_s: float = 0.0):
+        self.name = name
+        self.metric = metric
+        self.severity = severity
+        self.for_s = float(for_s)
+
+    def evaluate(self, store: TimeSeriesStore, t: float) -> List[tuple]:
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {"name": self.name, "metric": self.metric,
+                "severity": self.severity, "for_s": self.for_s,
+                "kind": type(self).__name__}
+
+
+class ThresholdRule(Rule):
+    """``mode`` over ``selector`` compared against ``above``/``below``
+    (at least one required).  Modes: ``last`` (newest sample),
+    ``rate`` / ``increase`` (counter windows), ``quantile`` (gauge or
+    histogram windows, with ``q``)."""
+
+    def __init__(self, name: str, metric: str, selector: Optional[str]
+                 = None, mode: str = "last", window: float = 60.0,
+                 q: float = 0.99, above: Optional[float] = None,
+                 below: Optional[float] = None, **kwargs):
+        super().__init__(name, metric, **kwargs)
+        if above is None and below is None:
+            raise ValueError(f"rule {name}: need above= or below=")
+        if mode not in ("last", "rate", "increase", "quantile"):
+            raise ValueError(f"rule {name}: unknown mode {mode!r}")
+        self.selector = selector or metric
+        self.mode = mode
+        self.window = float(window)
+        self.q = q
+        self.above = above
+        self.below = below
+
+    def _offending(self, value: float) -> bool:
+        if self.above is not None and value > self.above:
+            return True
+        if self.below is not None and value < self.below:
+            return True
+        return False
+
+    def evaluate(self, store: TimeSeriesStore, t: float) -> List[tuple]:
+        if self.mode == "last":
+            # The window doubles as a staleness bound: a series whose
+            # feed stopped (worker departed, store still retains it)
+            # must stop alerting, not freeze at its last bad value.
+            rows = [(labels, v) for labels, ts, v
+                    in store.latest(self.selector)
+                    if isinstance(v, (int, float))
+                    and ts > t - self.window]
+        elif self.mode == "rate":
+            rows = store.rate(self.selector, self.window, t)
+        elif self.mode == "increase":
+            rows = store.increase(self.selector, self.window, t)
+        else:
+            rows = store.quantile_over_time(self.selector, self.q,
+                                            self.window, t)
+        return [(labels, v) for labels, v in rows
+                if self._offending(v)]
+
+
+class BurnRateRule(Rule):
+    """Multiwindow SLO burn rate.
+
+    For a histogram series: error ratio = fraction of windowed
+    observations above ``objective_le`` (a real bucket bound).  For a
+    gauge series (``gauge_target`` given): error ratio = how far below
+    target the windowed mean sits, as a fraction of target.  Budget =
+    1 - objective (e.g. objective 0.99 -> 1% budget).  Fires when
+    fast-window burn >= ``fast_burn`` AND slow-window burn >=
+    ``slow_burn``.
+    """
+
+    def __init__(self, name: str, metric: str, objective: float,
+                 selector: Optional[str] = None,
+                 objective_le: Optional[float] = None,
+                 gauge_target: Optional[float] = None,
+                 fast_window: float = 60.0, slow_window: float = 300.0,
+                 fast_burn: float = 14.0, slow_burn: float = 6.0,
+                 **kwargs):
+        super().__init__(name, metric, **kwargs)
+        if (objective_le is None) == (gauge_target is None):
+            raise ValueError(f"rule {name}: exactly one of objective_le"
+                             f" (histogram) or gauge_target (gauge)")
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"rule {name}: objective in (0, 1)")
+        self.selector = selector or metric
+        self.objective = objective
+        self.objective_le = objective_le
+        self.gauge_target = gauge_target
+        self.fast_window = float(fast_window)
+        self.slow_window = float(slow_window)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+
+    def _error_ratios(self, store: TimeSeriesStore, window: float,
+                      t: float) -> Dict[tuple, float]:
+        if self.objective_le is not None:
+            rows = store.histogram_error_ratio(
+                self.selector, self.objective_le, window, t)
+        else:
+            rows = [(labels,
+                     max(0.0, (self.gauge_target - mean)
+                         / self.gauge_target))
+                    for labels, mean in store.avg_over_time(
+                        self.selector, window, t)
+                    if self.gauge_target > 0]
+        return {tuple(sorted(labels.items())): (labels, ratio)
+                for labels, ratio in rows}
+
+    def evaluate(self, store: TimeSeriesStore, t: float) -> List[tuple]:
+        budget = 1.0 - self.objective
+        fast = self._error_ratios(store, self.fast_window, t)
+        slow = self._error_ratios(store, self.slow_window, t)
+        out = []
+        for key, (labels, fast_ratio) in fast.items():
+            if key not in slow:
+                continue
+            fast_factor = fast_ratio / budget
+            slow_factor = slow[key][1] / budget
+            if fast_factor >= self.fast_burn \
+                    and slow_factor >= self.slow_burn:
+                out.append((labels, fast_factor))
+        return out
+
+
+class AbsentRule(Rule):
+    """Fires when no matching series holds any retained sample."""
+
+    def __init__(self, name: str, metric: str,
+                 selector: Optional[str] = None, **kwargs):
+        super().__init__(name, metric, **kwargs)
+        self.selector = selector or metric
+
+    def evaluate(self, store: TimeSeriesStore, t: float) -> List[tuple]:
+        if store.absent(self.selector):
+            return [({"selector": self.selector}, 1.0)]
+        return []
+
+
+class StallRule(Rule):
+    """Activity without completion: the ``activity_metric`` counter
+    advanced by at least ``min_activity`` over the window while the
+    watched ``metric`` counter did not move at all.  The WAL fsync
+    stall is the canonical instance — appends keep arriving, fsyncs
+    stop, and durability silently evaporates."""
+
+    def __init__(self, name: str, metric: str, activity_metric: str,
+                 window: float = 60.0, min_activity: float = 1.0,
+                 **kwargs):
+        super().__init__(name, metric, **kwargs)
+        self.activity_metric = activity_metric
+        self.window = float(window)
+        self.min_activity = float(min_activity)
+
+    def evaluate(self, store: TimeSeriesStore, t: float) -> List[tuple]:
+        active = [(labels, inc) for labels, inc
+                  in store.increase(self.activity_metric, self.window, t)
+                  if inc >= self.min_activity]
+        if not active:
+            return []
+        stalled = {tuple(sorted(labels.items())): inc for labels, inc
+                   in store.increase(self.metric, self.window, t)}
+        out = []
+        for labels, activity in active:
+            key = tuple(sorted(labels.items()))
+            if stalled.get(key, 0.0) <= 0.0:
+                out.append((labels, activity))
+        return out
+
+
+class StragglerRule(ThresholdRule):
+    """The flagship consumer's rule: a worker whose straggler score
+    (its rolling mean step time over the gang's rolling median,
+    obsplane/straggler.py) sustains above ``threshold`` is paced by
+    something — NIC, thermal, noisy neighbor — that per-job metrics
+    cannot see."""
+
+    def __init__(self, name: str = "StragglerAlert",
+                 metric: str = "mpi_operator_straggler_score",
+                 threshold: float = 1.8, **kwargs):
+        kwargs.setdefault("severity", "critical")
+        super().__init__(name, metric, mode="last", above=threshold,
+                         **kwargs)
+
+
+class AlertEngine:
+    """Evaluates rules on the scrape cadence; owns alert lifecycle and
+    history.  Thread-safe: the scrape thread evaluates while the CLI /
+    harness reads."""
+
+    def __init__(self, store: TimeSeriesStore, rules: List[Rule],
+                 registry=None):
+        self.store = store
+        self.rules = list(rules)
+        self._alerts: Dict[tuple, Alert] = {}
+        self._history: List[dict] = []
+        self._lock = threading.Lock()
+        self._fired_counter = None
+        if registry is not None:
+            self._fired_counter = registry.counter_vec(
+                "mpi_operator_obsplane_alerts_total",
+                "Alert firing transitions (pending->firing), by alert"
+                " rule name", ["alert"])
+
+    def evaluate(self, t: float) -> List[Alert]:
+        """Run every rule at logical time ``t``; returns alerts that
+        TRANSITIONED to firing this evaluation."""
+        fired: List[Alert] = []
+        with self._lock:
+            for rule in self.rules:
+                violating = rule.evaluate(self.store, t)
+                seen = set()
+                for labels, value in violating:
+                    alert = Alert(rule.name, dict(labels),
+                                  severity=rule.severity, since=t,
+                                  value=value)
+                    key = alert.key()
+                    seen.add(key)
+                    live = self._alerts.get(key)
+                    if live is None or live.state == "resolved":
+                        self._alerts[key] = live = alert
+                    live.value = value
+                    if live.state == "pending" \
+                            and t - live.since >= rule.for_s:
+                        live.state = "firing"
+                        live.fired_at = t
+                        fired.append(live)
+                        self._history.append(
+                            {"event": "firing", **live.to_dict(),
+                             "t": round(t, 4)})
+                        if self._fired_counter is not None:
+                            self._fired_counter.labels(rule.name).inc()
+                for key, live in list(self._alerts.items()):
+                    if live.name != rule.name or key in seen \
+                            or live.state == "resolved":
+                        continue
+                    if live.state == "firing":
+                        live.state = "resolved"
+                        live.resolved_at = t
+                        self._history.append(
+                            {"event": "resolved", **live.to_dict(),
+                             "t": round(t, 4)})
+                    else:
+                        del self._alerts[key]  # pending blip cleared
+        return fired
+
+    # -- views ---------------------------------------------------------------
+    def active(self) -> List[Alert]:
+        with self._lock:
+            return sorted((a for a in self._alerts.values()
+                           if a.state == "firing"),
+                          key=lambda a: a.key())
+
+    def all_alerts(self) -> List[Alert]:
+        with self._lock:
+            return sorted(self._alerts.values(), key=lambda a: a.key())
+
+    def history(self) -> List[dict]:
+        with self._lock:
+            return list(self._history)
+
+    def firings(self) -> List[dict]:
+        """Every firing transition, chronological, with timestamps —
+        the alert-fidelity scorer's feed."""
+        return [h for h in self.history() if h["event"] == "firing"]
+
+    def canonical_history(self) -> List[dict]:
+        """Timestamp-free, deduplicated, (alert, labels)-sorted: the
+        set of incidents that ever fired.  Two identical seeded runs
+        produce byte-identical JSON of this view even when their wall
+        timings differ."""
+        seen = {}
+        for h in self.history():
+            if h["event"] != "firing":
+                continue
+            key = (h["alert"], tuple(sorted(h["labels"].items())))
+            seen[key] = {"alert": h["alert"],
+                         "labels": dict(sorted(h["labels"].items())),
+                         "severity": h["severity"]}
+        return [seen[k] for k in sorted(seen)]
+
+    def canonical_history_json(self) -> str:
+        return json.dumps(self.canonical_history(), indent=2,
+                          sort_keys=True) + "\n"

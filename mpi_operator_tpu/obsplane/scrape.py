@@ -1,0 +1,265 @@
+"""The metrics-plane scraper: registries + sidecars -> TimeSeriesStore.
+
+One `Scraper` samples every configured source on a cadence and feeds
+the time-series store (obsplane/store.py).  Sources:
+
+- **in-process registries** (controller, scheduler, apiserver, kubelet,
+  router, batcher, soak) via the structured ``Registry.collect()``
+  snapshot — no exposition-text round trip for local state;
+- **text sources** — a zero-arg callable returning a Prometheus text
+  exposition (a remote ``/metrics`` fetch, a worker's exported
+  ``metrics-*.prom`` sidecar next to its flight ring) parsed by
+  :func:`parse_exposition`, histogram families reassembled from their
+  ``_bucket``/``_sum``/``_count`` lines;
+- **step-file probes** (:meth:`Scraper.add_step_dir`) — the soak
+  workers' persisted ``step-<pod>`` counters, published as
+  ``mpi_operator_worker_steps_total{job,worker}`` so the straggler
+  scorer can derive per-step latency from progress deltas even for
+  workers that emit no spans.
+
+Timestamps come from the injectable ``clock``; ``scrape_once(t=...)``
+lets a simulated feed drive the plane with logical time.  The scraper
+meters itself (scrapes, duration, live series) into a registry it is
+also scraping — the plane observes its own overhead.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .store import TimeSeriesStore
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>[^\s]+)$")
+_LABEL = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)='
+                    r'"(?P<v>(?:[^"\\]|\\.)*)"')
+
+
+def _unescape(value: str) -> str:
+    return (value.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str) -> List[tuple]:
+    """Prometheus text -> ``[(name, kind, labels_dict, sample)]``.
+
+    Scalar families yield one float sample per labeled series.
+    Histogram families are reassembled into cumulative snapshot dicts
+    (one per label set, ``le`` stripped) so the store's windowed
+    quantile math works on scraped text exactly as on collected
+    registries.  ``+Inf`` buckets are folded into ``count``.
+    """
+    kinds: Dict[str, str] = {}
+    scalars: List[tuple] = []
+    # (family, labels-sans-le as sorted tuple) -> snapshot parts
+    hists: Dict[tuple, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        name = m.group("name")
+        labels = {lm.group("k"): _unescape(lm.group("v"))
+                  for lm in _LABEL.finditer(m.group("labels") or "")}
+        family = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) \
+                    and kinds.get(name[: -len(suffix)]) == "histogram":
+                family = name[: -len(suffix)]
+                part = suffix[1:]
+                break
+        if family is None:
+            scalars.append((name, kinds.get(name, "untyped"),
+                            labels, value))
+            continue
+        le = labels.pop("le", None)
+        key = (family, tuple(sorted(labels.items())))
+        snap = hists.setdefault(key, {"buckets": {}, "sum": 0.0,
+                                      "count": 0, "labels": labels})
+        if part == "bucket":
+            if le is not None and le not in ("+Inf", "inf"):
+                snap["buckets"][float(le)] = int(value)
+        elif part == "sum":
+            snap["sum"] = value
+        else:
+            snap["count"] = int(value)
+    out = list(scalars)
+    for (family, _), snap in sorted(hists.items()):
+        labels = snap.pop("labels")
+        out.append((family, "histogram", labels, snap))
+    return out
+
+
+class Scraper:
+    """Periodic sampler feeding one TimeSeriesStore.  Single-writer by
+    design: one scrape thread (or one simulated driver) owns the
+    store; readers (alert engine, CLI) run on the same cadence."""
+
+    def __init__(self, store: Optional[TimeSeriesStore] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 registry=None):
+        import time
+        self.store = store if store is not None else TimeSeriesStore()
+        self.clock = clock if clock is not None else time.monotonic
+        self._registries: List[tuple] = []
+        self._text_sources: List[tuple] = []
+        self._step_dirs: List[tuple] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._self_metrics = None
+        if registry is not None:
+            self._self_metrics = {
+                "scrapes": registry.counter(
+                    "mpi_operator_obsplane_scrapes_total",
+                    "Scrape cycles completed by the metrics-plane"
+                    " scraper"),
+                "seconds": registry.histogram(
+                    "mpi_operator_obsplane_scrape_seconds",
+                    "Wall time of one scrape cycle across all"
+                    " configured sources"),
+                "series": registry.gauge(
+                    "mpi_operator_obsplane_series",
+                    "Live labeled series held by the metrics-plane"
+                    " time-series store"),
+            }
+
+    # -- sources -------------------------------------------------------------
+    def add_registry(self, registry,
+                     labels: Optional[Dict[str, str]] = None) -> None:
+        """Scrape an in-process Registry via collect(); ``labels`` are
+        stamped onto every sample (e.g. component="controller")."""
+        self._registries.append((registry, dict(labels or {})))
+
+    def add_text_source(self, fetch: Callable[[], Optional[str]],
+                        labels: Optional[Dict[str, str]] = None) -> None:
+        """Scrape a callable returning Prometheus exposition text (or
+        None to skip this cycle) — remote /metrics, sidecar files."""
+        self._text_sources.append((fetch, dict(labels or {})))
+
+    def add_sidecar_dir(self, directory: str,
+                        labels: Optional[Dict[str, str]] = None) -> None:
+        """Scrape every ``metrics-*.prom`` exposition a worker exported
+        next to its flight ring (telemetry/flight.py sidecar dir)."""
+        def fetch() -> Optional[str]:
+            try:
+                names = sorted(n for n in os.listdir(directory)
+                               if n.startswith("metrics-")
+                               and n.endswith(".prom"))
+            except OSError:
+                return None
+            parts = []
+            for name in names:
+                try:
+                    with open(os.path.join(directory, name)) as f:
+                        parts.append(f.read())
+                except OSError:
+                    continue
+            return "\n".join(parts) if parts else None
+        self._text_sources.append((fetch, dict(labels or {})))
+
+    def add_step_dir(self, directory: str,
+                     job_of: Optional[Callable[[str], Tuple[str, str]]]
+                     = None) -> None:
+        """Scrape ``step-<pod>`` progress files into
+        ``mpi_operator_worker_steps_total{job,worker}``.  ``job_of``
+        maps a pod name to (job, worker); the default splits the soak
+        convention ``<job>-worker-<i>``."""
+        def default_job_of(pod: str) -> Tuple[str, str]:
+            job, sep, idx = pod.rpartition("-worker-")
+            return (job, f"worker-{idx}") if sep else (pod, pod)
+        self._step_dirs.append((directory, job_of or default_job_of))
+
+    # -- scraping ------------------------------------------------------------
+    def _scrape_steps(self, directory: str, job_of, t: float) -> None:
+        try:
+            names = sorted(n for n in os.listdir(directory)
+                           if n.startswith("step-"))
+        except OSError:
+            return
+        for name in names:
+            if name.endswith(".tmp"):
+                continue
+            try:
+                with open(os.path.join(directory, name)) as f:
+                    steps = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                continue  # torn mid-replace: next cycle reads it
+            job, worker = job_of(name[len("step-"):])
+            self.store.add_sample(
+                "mpi_operator_worker_steps_total",
+                {"job": job, "worker": worker}, float(steps), t,
+                kind="counter")
+
+    def scrape_once(self, t: Optional[float] = None) -> int:
+        """One cycle over every source; returns samples ingested.
+        ``t`` overrides the clock (simulated feeds)."""
+        start = self.clock()
+        if t is None:
+            t = start
+        n = 0
+        for registry, extra in self._registries:
+            for name, kind, entries in registry.collect():
+                for labels, sample in entries:
+                    merged = {**labels, **extra} if extra else labels
+                    self.store.add_sample(name, merged, sample, t,
+                                          kind=kind)
+                    n += 1
+        for fetch, extra in self._text_sources:
+            try:
+                text = fetch()
+            except Exception:
+                text = None  # a dead source must not kill the cycle
+            if not text:
+                continue
+            for name, kind, labels, sample in parse_exposition(text):
+                merged = {**labels, **extra} if extra else labels
+                self.store.add_sample(name, merged, sample, t,
+                                      kind=kind)
+                n += 1
+        for directory, job_of in self._step_dirs:
+            self._scrape_steps(directory, job_of, t)
+        if self._self_metrics is not None:
+            self._self_metrics["scrapes"].inc()
+            self._self_metrics["seconds"].observe(self.clock() - start)
+            self._self_metrics["series"].set(self.store.series_count())
+        return n
+
+    # -- cadence -------------------------------------------------------------
+    def start(self, interval: float,
+              on_cycle: Optional[Callable[[float], None]] = None
+              ) -> "Scraper":
+        """Background scrape thread every ``interval`` seconds;
+        ``on_cycle(t)`` runs after each cycle (the alert engine's
+        evaluate hook rides the scrape cadence)."""
+        def loop() -> None:
+            while not self._stop.wait(interval):
+                t = self.clock()
+                self.scrape_once(t=t)
+                if on_cycle is not None:
+                    on_cycle(t)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="obsplane-scraper")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,292 @@
+"""Ring-buffered time-series store — the metrics plane's memory.
+
+Instant registries (telemetry/metrics.py) answer "what is the value
+now"; nothing in the stack could answer "how has it changed" — which is
+the only question that detects gray failures like a worker pacing every
+collective at 0.3x speed (the bandwidth-asymmetry failure mode of
+arXiv:1810.11112 / arXiv:1909.09756).  This module is the change-over-
+time half: labeled series of (t, sample) rings with bounded retention,
+plus the Prometheus-shaped range evaluators the alert rules
+(obsplane/rules.py) are written against:
+
+- ``rate()`` / ``increase()`` — counter deltas with reset correction
+  (a restarted process's counter dropping to zero contributes the
+  post-reset value, never a negative delta);
+- ``quantile_over_time()`` — exact quantiles over gauge samples in the
+  window, or windowed ``histogram_quantile`` via cumulative-snapshot
+  subtraction for histogram series;
+- ``avg_over_time()`` — the burn-rate rules' error-ratio mean;
+- ``absent()`` — "this series never appeared", the watchdog primitive.
+
+Everything is driven by caller-supplied timestamps from the injectable
+clock — no wallclock reads, so a simulated feed evaluates bit-identically
+on every run (the wallclock-sim lint rule enforces this file).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..soak.slo import histogram_quantile, quantile
+
+# name{label="value",...} — the selector grammar for queries and the
+# CLI `series` verb.  Labels given must match exactly; omitted labels
+# are wildcards.
+_SELECTOR = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?$")
+_LABEL = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)='
+                    r'"(?P<v>[^"]*)"')
+
+
+def parse_selector(selector: str) -> Tuple[str, Dict[str, str]]:
+    """``name{label="value"}`` -> (name, {label: value}).  Raises
+    ValueError on malformed input — a typo'd alert rule must fail
+    loudly at construction, not match nothing forever."""
+    m = _SELECTOR.match(selector.strip())
+    if m is None:
+        raise ValueError(f"malformed series selector: {selector!r}")
+    labels: Dict[str, str] = {}
+    body = m.group("labels")
+    if body:
+        consumed = 0
+        for lm in _LABEL.finditer(body):
+            labels[lm.group("k")] = lm.group("v")
+            consumed += 1
+        # Commas between matchers are the only other legal content.
+        leftover = _LABEL.sub("", body).replace(",", "").strip()
+        if leftover or (body.strip() and not consumed):
+            raise ValueError(f"malformed label matchers: {body!r}")
+    return m.group("name"), labels
+
+
+class Series:
+    """One labeled series: a bounded ring of (t, sample) where sample
+    is a float (counter/gauge) or a cumulative histogram snapshot."""
+
+    __slots__ = ("name", "labels", "kind", "samples")
+
+    def __init__(self, name: str, labels: Dict[str, str], kind: str,
+                 max_samples: int):
+        self.name = name
+        self.labels = dict(labels)
+        self.kind = kind
+        self.samples: deque = deque(maxlen=max_samples)
+
+    def window(self, start: float, end: float) -> List[tuple]:
+        return [(t, v) for t, v in self.samples if start < t <= end]
+
+    def last_at_or_before(self, t: float) -> Optional[tuple]:
+        out = None
+        for ts, v in self.samples:
+            if ts > t:
+                break
+            out = (ts, v)
+        return out
+
+
+def _increase(points: List[tuple]) -> Optional[float]:
+    """Monotone-counter increase over chronologically ordered samples,
+    with reset correction: a drop means the counter restarted, so the
+    post-reset absolute value IS the increase since the reset."""
+    if len(points) < 2:
+        return None
+    total = 0.0
+    prev = points[0][1]
+    for _, v in points[1:]:
+        total += v if v < prev else v - prev
+        prev = v
+    return total
+
+
+def _snapshot_delta(first: dict, last: dict) -> dict:
+    """Windowed histogram: cumulative ``last`` minus cumulative
+    ``first``.  A count regression (process restart reset the
+    histogram) falls back to ``last`` alone — the post-reset window."""
+    if last.get("count", 0) < first.get("count", 0):
+        return last
+    buckets = {
+        bound: cum - first.get("buckets", {}).get(bound, 0)
+        for bound, cum in last.get("buckets", {}).items()}
+    return {"buckets": buckets,
+            "sum": last.get("sum", 0.0) - first.get("sum", 0.0),
+            "count": last.get("count", 0) - first.get("count", 0)}
+
+
+class TimeSeriesStore:
+    """Labeled series rings with retention-bounded history and
+    deterministic range evaluators.  Not thread-locked per sample on
+    the read path beyond one dict lookup: the scraper is the single
+    writer; queries run on the scraper/engine cadence."""
+
+    def __init__(self, retention_s: float = 600.0,
+                 max_samples: int = 2048):
+        self.retention_s = float(retention_s)
+        self.max_samples = int(max_samples)
+        self._series: Dict[tuple, Series] = {}
+        # Name -> series index: every rule evaluation funnels through
+        # select(), and the alert engine runs the full rule set each
+        # scrape cycle — a flat scan over the whole store would make
+        # rule cost O(rules x total series) on the hot path.
+        self._by_name: Dict[str, List[Series]] = {}
+
+    # -- ingest --------------------------------------------------------------
+    @staticmethod
+    def _key(name: str, labels: Dict[str, str]) -> tuple:
+        return (name, tuple(sorted((str(k), str(v))
+                                   for k, v in labels.items())))
+
+    def add_sample(self, name: str, labels: Dict[str, str], value,
+                   t: float, kind: str = "gauge") -> None:
+        key = self._key(name, labels)
+        series = self._series.get(key)
+        if series is None:
+            series = self._series[key] = Series(
+                name, labels, kind, self.max_samples)
+            self._by_name.setdefault(name, []).append(series)
+        series.samples.append((float(t), value))
+        # Retention: prune from the left against the newest timestamp
+        # (logical time — the feed's clock, never the wall).
+        horizon = t - self.retention_s
+        while series.samples and series.samples[0][0] < horizon:
+            series.samples.popleft()
+
+    # -- selection -----------------------------------------------------------
+    def select(self, selector: str) -> List[Series]:
+        name, want = parse_selector(selector)
+        out = []
+        for series in self._by_name.get(name, ()):
+            if any(series.labels.get(k) != v for k, v in want.items()):
+                continue
+            out.append(series)
+        return sorted(out, key=lambda s: sorted(s.labels.items()))
+
+    def series_count(self) -> int:
+        return len(self._series)
+
+    def names(self) -> List[str]:
+        return sorted({s.name for s in self._series.values()})
+
+    # -- instant evaluators --------------------------------------------------
+    def latest(self, selector: str) -> List[tuple]:
+        """[(labels, t, value)] — the newest sample per matching
+        series."""
+        out = []
+        for s in self.select(selector):
+            if s.samples:
+                t, v = s.samples[-1]
+                out.append((dict(s.labels), t, v))
+        return out
+
+    def absent(self, selector: str) -> bool:
+        """True when NO matching series holds any retained sample —
+        the `absent()` watchdog for feeds that should exist."""
+        return not any(s.samples for s in self.select(selector))
+
+    # -- range evaluators ----------------------------------------------------
+    def increase(self, selector: str, window: float, at: float
+                 ) -> List[tuple]:
+        """[(labels, increase)] per series over (at-window, at] with
+        counter-reset correction; series with < 2 samples in the window
+        are skipped (no delta exists yet).  Histogram series are
+        skipped too — their samples are cumulative snapshots, not
+        scalars; window them via ``quantile_over_time`` /
+        ``histogram_error_ratio`` instead."""
+        out = []
+        for s in self.select(selector):
+            points = s.window(at - window, at)
+            if points and isinstance(points[-1][1], dict):
+                continue
+            inc = _increase(points)
+            if inc is not None:
+                out.append((dict(s.labels), inc))
+        return out
+
+    def rate(self, selector: str, window: float, at: float
+             ) -> List[tuple]:
+        """[(labels, per-second rate)] — increase divided by the span
+        the samples actually cover (never the nominal window, which
+        would understate rates early in a run).  Histogram series are
+        skipped, as in ``increase``."""
+        out = []
+        for s in self.select(selector):
+            points = s.window(at - window, at)
+            if points and isinstance(points[-1][1], dict):
+                continue
+            inc = _increase(points)
+            if inc is None:
+                continue
+            span = points[-1][0] - points[0][0]
+            if span <= 0:
+                continue
+            out.append((dict(s.labels), inc / span))
+        return out
+
+    def avg_over_time(self, selector: str, window: float, at: float
+                      ) -> List[tuple]:
+        """[(labels, mean)] of gauge samples in the window."""
+        out = []
+        for s in self.select(selector):
+            vals = [v for _, v in s.window(at - window, at)
+                    if isinstance(v, (int, float))]
+            if vals:
+                out.append((dict(s.labels), sum(vals) / len(vals)))
+        return out
+
+    def quantile_over_time(self, selector: str, q: float,
+                           window: float, at: float) -> List[tuple]:
+        """[(labels, quantile)] per series over (at-window, at].
+
+        Gauge series: exact quantile of the raw samples (soak/slo.py
+        `quantile` — empty window -> series skipped, single sample is
+        every quantile of itself).  Histogram series: windowed
+        snapshot subtraction, then `histogram_quantile`; a window
+        whose delta observed nothing is skipped, and a mid-window
+        counter reset scores the post-reset snapshot alone.
+        """
+        out = []
+        for s in self.select(selector):
+            points = s.window(at - window, at)
+            if not points:
+                continue
+            if isinstance(points[-1][1], dict):
+                base = s.last_at_or_before(at - window)
+                first = base[1] if base is not None \
+                    and isinstance(base[1], dict) else \
+                    {"buckets": {}, "sum": 0.0, "count": 0}
+                delta = _snapshot_delta(first, points[-1][1])
+                value = histogram_quantile(delta, q)
+            else:
+                value = quantile([v for _, v in points], q)
+            if value is not None:
+                out.append((dict(s.labels), value))
+        return out
+
+    def histogram_error_ratio(self, selector: str, le: float,
+                              window: float, at: float) -> List[tuple]:
+        """[(labels, fraction of windowed observations ABOVE the
+        ``le`` bucket bound)] — the burn-rate rules' error ratio for
+        latency SLOs (e.g. "TTFT over 2.5s").  ``le`` must be an
+        actual bucket bound of the series.  A window with zero new
+        observations is skipped (no traffic burns no budget)."""
+        out = []
+        for s in self.select(selector):
+            points = s.window(at - window, at)
+            if not points or not isinstance(points[-1][1], dict):
+                continue
+            base = s.last_at_or_before(at - window)
+            first = base[1] if base is not None \
+                and isinstance(base[1], dict) else \
+                {"buckets": {}, "sum": 0.0, "count": 0}
+            delta = _snapshot_delta(first, points[-1][1])
+            count = delta.get("count", 0)
+            if count <= 0:
+                continue
+            good = delta.get("buckets", {}).get(le)
+            if good is None:
+                continue  # not a bucket bound of this histogram
+            out.append((dict(s.labels),
+                        max(0.0, 1.0 - good / count)))
+        return out

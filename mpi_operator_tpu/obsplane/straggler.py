@@ -1,0 +1,176 @@
+"""Per-worker step-time distributions and the straggler score.
+
+The gray failure this plane exists to catch: one worker in a
+data-parallel gang running at 0.3x — a degraded NIC, a thermally
+throttled chip, a noisy neighbor — paces EVERY collective, so the
+whole gang slows down while every per-job metric still looks healthy
+(the bandwidth-asymmetry effect of arXiv:1810.11112; arXiv:1909.09756
+measures the same at pod scale).  Detection needs per-WORKER step
+latency, which this scorer assembles from two feeds:
+
+- :meth:`observe_step` — explicit per-step durations from the span
+  stream (PR 11 ``first_step``/train spans, flight sidecar records);
+- :meth:`observe_progress` — cumulative step counters (the soak
+  workers' persisted ``step-<pod>`` files, scraped into
+  ``mpi_operator_worker_steps_total``): per-step latency is the time
+  delta over the progress delta between scrapes.  A counter going
+  BACKWARDS (pod restarted, checkpoint rewind) resets the baseline
+  and contributes no sample — a restart is disruption, not slowness.
+
+Score: the worker's rolling mean step time divided by the gang's
+rolling MEDIAN of per-worker means.  The median is the robust center —
+one straggler cannot drag it, so its own score stands out; a uniformly
+slow gang scores ~1.0 everywhere (that is a capacity problem, not a
+straggler).  Published as ``mpi_operator_straggler_score{job,worker}``
+(plus per-worker ``mpi_operator_worker_step_seconds`` distributions),
+with departed workers' series REMOVED on the next publish — the same
+live-set idiom as the scheduler's gang gauges.
+
+All timestamps are caller-supplied logical time; no wallclock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..soak.slo import quantile
+
+# A worker must show this many step samples before it is scored at
+# all — one noisy first step must not page anyone.
+MIN_SAMPLES = 3
+# Rolling window of per-step samples kept per worker.
+WINDOW_SAMPLES = 64
+# Samples older than this (logical seconds) fall out of the mean even
+# if the ring is not full — a worker that STOPPED reporting keeps its
+# last known speed only this long.
+SAMPLE_TTL_S = 120.0
+
+
+class StragglerScorer:
+    """Assembles per-worker step-time windows and publishes scores."""
+
+    def __init__(self, registry=None, min_samples: int = MIN_SAMPLES,
+                 window_samples: int = WINDOW_SAMPLES,
+                 sample_ttl_s: float = SAMPLE_TTL_S):
+        self.min_samples = int(min_samples)
+        self.window_samples = int(window_samples)
+        self.sample_ttl_s = float(sample_ttl_s)
+        self._lock = threading.Lock()
+        # (job, worker) -> deque[(t, step_seconds)]
+        self._windows: Dict[tuple, deque] = {}
+        # (job, worker) -> (t, cumulative_steps) progress baseline
+        self._progress: Dict[tuple, tuple] = {}
+        self._score_gauge = None
+        self._step_hist = None
+        self._published: set = set()
+        if registry is not None:
+            self._score_gauge = registry.gauge_vec(
+                "mpi_operator_straggler_score",
+                "Worker rolling-mean step time over the gang's rolling"
+                " median (1.0 = keeping pace; sustained >1.8 pages via"
+                " StragglerAlert)", ["job", "worker"])
+            self._step_hist = registry.histogram_vec(
+                "mpi_operator_worker_step_seconds",
+                "Per-worker train step wall time as assembled by the"
+                " straggler scorer (span stream + progress deltas)",
+                ["job", "worker"])
+
+    # -- feeds ---------------------------------------------------------------
+    def observe_step(self, job: str, worker: str, seconds: float,
+                     t: float) -> None:
+        """One measured step duration (span stream)."""
+        if seconds <= 0:
+            return
+        key = (str(job), str(worker))
+        with self._lock:
+            ring = self._windows.get(key)
+            if ring is None:
+                ring = self._windows[key] = deque(
+                    maxlen=self.window_samples)
+            ring.append((float(t), float(seconds)))
+        if self._step_hist is not None:
+            self._step_hist.labels(*key).observe(float(seconds))
+
+    def observe_progress(self, job: str, worker: str, steps: float,
+                         t: float) -> None:
+        """A cumulative step-counter reading (flight step probe /
+        scraped worker counter).  Derives per-step latency from the
+        delta against the previous reading."""
+        key = (str(job), str(worker))
+        with self._lock:
+            prev = self._progress.get(key)
+            if prev is None or steps < prev[1]:
+                # First reading, or backwards = restart/rewind:
+                # (re)set the baseline, observe nothing — a restart
+                # is disruption, not slowness.
+                self._progress[key] = (float(t), float(steps))
+                return
+            prev_t, prev_steps = prev
+            dsteps = steps - prev_steps
+            dt = t - prev_t
+            if dsteps == 0 or dt <= 0:
+                # Idle interval: the current step is still in flight.
+                # KEEP the baseline — advancing it here would charge a
+                # slow step only for its final interval and make a
+                # straggler look healthy.
+                return
+            self._progress[key] = (float(t), float(steps))
+        self.observe_step(job, worker, dt / dsteps, t)
+
+    # -- scoring -------------------------------------------------------------
+    def _means(self, t: float) -> Dict[tuple, float]:
+        horizon = t - self.sample_ttl_s
+        out: Dict[tuple, float] = {}
+        with self._lock:
+            for key, ring in self._windows.items():
+                while ring and ring[0][0] < horizon:
+                    ring.popleft()
+                if len(ring) < self.min_samples:
+                    continue
+                vals = [s for _, s in ring]
+                out[key] = sum(vals) / len(vals)
+        return out
+
+    def scores(self, t: float) -> Dict[tuple, float]:
+        """{(job, worker): score} for every scoreable worker.  Gangs
+        with fewer than 2 reporting workers are skipped — a median of
+        one is the worker itself and every score would be 1.0."""
+        means = self._means(t)
+        by_job: Dict[str, List[Tuple[str, float]]] = {}
+        for (job, worker), mean in means.items():
+            by_job.setdefault(job, []).append((worker, mean))
+        out: Dict[tuple, float] = {}
+        for job, rows in by_job.items():
+            if len(rows) < 2:
+                continue
+            median = quantile([m for _, m in rows], 0.5)
+            if not median:
+                continue
+            for worker, mean in rows:
+                out[(job, worker)] = mean / median
+        return out
+
+    def publish(self, t: float) -> Dict[tuple, float]:
+        """Compute scores at ``t``, set the gauge series, and REMOVE
+        series for workers that departed the scoreable set (died,
+        resized away, went stale) so the scrape never carries ghosts."""
+        scores = self.scores(t)
+        if self._score_gauge is not None:
+            live = set(scores)
+            for key, score in sorted(scores.items()):
+                self._score_gauge.labels(*key).set(round(score, 6))
+            for stale in self._published - live:
+                self._score_gauge.remove(*stale)
+            self._published = live
+        return scores
+
+    def worker_distribution(self, job: str, worker: str,
+                            q: float, t: float) -> Optional[float]:
+        """Quantile of the worker's retained step-time window."""
+        horizon = t - self.sample_ttl_s
+        with self._lock:
+            ring = self._windows.get((str(job), str(worker)))
+            vals = [s for ts, s in (ring or ()) if ts >= horizon]
+        return quantile(vals, q)

@@ -238,6 +238,9 @@ class GangScheduler:
         # Elastic gangs currently carried by the per-gang size gauge
         # (stale series are removed when the gang leaves).
         self._gang_gauge_keys: set = set()
+        # ClusterQueues currently carried by the per-CQ gauges (same
+        # stale-series contract).
+        self._cq_gauge_keys: set = set()
         # (key -> (resourceVersion, demand, valid)): validation +
         # demand math memoized per object version — the admission walk
         # re-examines every pending job after each admission, and
@@ -1494,6 +1497,15 @@ class GangScheduler:
             self._update_cq_status(cq, usage.get(name, {}),
                                    pending_cq.get(name, 0),
                                    admitted_cq.get(name, 0))
+        # A deleted ClusterQueue's series must leave the exposition
+        # with it (same live-set idiom as _publish_gang_sizes) — a
+        # departed queue frozen at its last pending count reads as a
+        # live backlog to the metrics plane.
+        live_cqs = set(cqs)
+        for stale in self._cq_gauge_keys - live_cqs:
+            for family in ("pending", "admitted", "used_chips"):
+                self.metrics[family].remove(stale)
+        self._cq_gauge_keys = live_cqs
         for (ns, name), lq in lqs.items():
             self._update_lq_status(lq, pending_lq.get((ns, name), 0),
                                    admitted_lq.get((ns, name), 0))

@@ -254,6 +254,10 @@ class DisaggServeFleet:
         for (m, role) in [k for k in self._pools if k[0] == model]:
             while self._retire(m, role):
                 pass
+            # _retire left the gauge at 0; a paged-out model must
+            # DISAPPEAR from the scrape, not report an empty pool
+            # forever (stale-series contract).
+            self.router.telemetry["pool_replicas"].remove(m, role)
         self._awake[model] = False
         if self.ledger is not None:
             self.ledger.release(model)

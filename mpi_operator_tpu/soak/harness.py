@@ -100,6 +100,16 @@ class SoakConfig:
     # is ALWAYS durable, because the full chaos profile includes
     # apiserver_restart faults.
     wal_dir: Optional[str] = None
+    # Metrics plane (docs/OBSERVABILITY.md "Metrics plane & alerting"):
+    # the harness scrapes ITSELF — every in-process registry plus the
+    # workers' step files — and runs the stock alert rules on the
+    # scrape cadence.  The scorecard's alert-fidelity section holds
+    # every solidly-mapped injected fault class to "its alert fired
+    # within alert_deadline".  scrape_interval <= 0 disables the plane.
+    scrape_interval: float = 0.5
+    alert_window: float = 10.0
+    alert_slow_window: float = 30.0
+    alert_deadline: float = 20.0
 
 
 @dataclass
@@ -547,6 +557,12 @@ class SoakHarness:
             "time_to_first_step": [], "request_ttft": []}
         self._traced_events: List[dict] = []
         self._traced_cap = 120_000
+        # Metrics plane (created in start(), None until then).
+        self.tsdb = None
+        self.scraper = None
+        self.straggler = None
+        self.alerts = None
+        self._chaos_t0: Optional[float] = None
 
         def _on_span(event: dict) -> None:
             if not event.get("trace_id"):
@@ -778,14 +794,71 @@ class SoakHarness:
                 blob_dir=self._blob_dir))
         self.fleet.start()
         self.fleet.wait_ready(self.config.serve_replicas, timeout=120)
+        if self.config.scrape_interval > 0:
+            self._start_obsplane()
         self._started = True
         return self
+
+    def _start_obsplane(self) -> None:
+        """The soak scrapes itself: every in-process registry plus the
+        workers' step files feed one store; the straggler scorer and
+        the alert engine ride the scrape cadence."""
+        from ..obsplane import (AlertEngine, Scraper, StragglerScorer,
+                                TimeSeriesStore, default_fleet_rules)
+        from ..telemetry.metrics import default_registry
+        cfg = self.config
+        self.tsdb = TimeSeriesStore(
+            retention_s=max(600.0, cfg.duration + cfg.converge_timeout))
+        self.straggler = StragglerScorer(registry=self.registry)
+        self.scraper = Scraper(store=self.tsdb, registry=self.registry)
+        # controller + scheduler + soak + straggler share one registry;
+        # apiserver/informer/workqueue families live in the process
+        # default; the serve router keeps its own.
+        self.scraper.add_registry(self.registry)
+        self.scraper.add_registry(default_registry())
+        self.scraper.add_registry(self.router.telemetry_registry)
+        self.scraper.add_step_dir(self._step_dir)
+        # A counter child born mid-window shows NO increase until its
+        # second sample (the store deltas within the window, honestly),
+        # so the lazily-created recovery children must exist at 0 from
+        # the first scrape or the restart alerts miss the 0->1 edge.
+        for component in ("controller", "scheduler", "apiserver"):
+            self.soak_metrics["recoveries"].labels(component)
+        self.alerts = AlertEngine(
+            self.tsdb,
+            default_fleet_rules(window=cfg.alert_window,
+                                slow_window=cfg.alert_slow_window),
+            registry=self.registry)
+        flight.set_alert_history_provider(self.alerts.canonical_history)
+
+        def cycle(t: float) -> None:
+            # Scraped step counters -> per-step latency -> scores; the
+            # published gauge is mirrored straight into the store so
+            # StragglerAlert sees this cycle's score, not last cycle's.
+            for labels, ts, v in self.tsdb.latest(
+                    "mpi_operator_worker_steps_total"):
+                self.straggler.observe_progress(
+                    labels.get("job", ""), labels.get("worker", ""),
+                    v, ts)
+            for (job, worker), score in \
+                    self.straggler.publish(t).items():
+                self.tsdb.add_sample(
+                    "mpi_operator_straggler_score",
+                    {"job": job, "worker": worker}, score, t,
+                    kind="gauge")
+            self.alerts.evaluate(t)
+
+        self._obsplane_cycle = cycle
+        self.scraper.start(cfg.scrape_interval, on_cycle=cycle)
 
     def stop(self) -> None:
         if not self._started:
             return
         from ..telemetry.trace import default_tracer
         default_tracer().remove_listener(self._span_listener)
+        if self.scraper is not None:
+            self.scraper.stop()
+            flight.set_alert_history_provider(None)
         self.monitor.stop()
         self.fleet.stop()
         self.cluster.stop()
@@ -891,6 +964,10 @@ class SoakHarness:
                       serve_replicas=self.config.serve_replicas)
         traffic.start()
         smalls.start()
+        # Align the fidelity scorer's timelines: fault offsets are
+        # relative to scenario start, alert firings carry the scrape
+        # clock (monotonic) — capture the boundary.
+        self._chaos_t0 = time.monotonic()
         try:
             # The engine's convergence deadline counts from SCENARIO
             # START; converge_timeout is documented as the budget AFTER
@@ -1067,9 +1144,31 @@ class SoakHarness:
                 "resizes_by_outcome": resize_outcomes,
                 "ckpt": ckpt_detail,
                 "chaos_violations": list(report.violations),
+                "alert_fidelity": self._alert_fidelity(report),
             })
         self._publish(card)
         return card
+
+    def _alert_fidelity(self, report) -> Optional[dict]:
+        """The scorecard's alert-fidelity section: every injected fault
+        class with a solid alert mapping must have raised its alert
+        within the deadline; unmapped kinds are listed, not silently
+        passed (docs/OBSERVABILITY.md)."""
+        if self.alerts is None or self._chaos_t0 is None:
+            return None
+        from ..obsplane import score_alert_fidelity
+        # One final scrape + evaluation so a fault landing in the last
+        # scrape interval still gets its firing before scoring.
+        t = self.scraper.clock()
+        self.scraper.scrape_once(t=t)
+        self._obsplane_cycle(t)
+        firings = self.alerts.firings()
+        out = score_alert_fidelity(
+            report.events, firings, t0=self._chaos_t0,
+            deadline_s=self.config.alert_deadline)
+        out["firings_total"] = len(firings)
+        out["history"] = self.alerts.canonical_history()
+        return out
 
     @staticmethod
     def _by_kind(events: List[dict]) -> Dict[str, int]:

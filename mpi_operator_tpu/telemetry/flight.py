@@ -471,6 +471,21 @@ def job_snapshot(clientset, namespace: Optional[str] = None,
     return {"jobs": jobs}
 
 
+# Metrics plane hook: a zero-arg callable returning the canonical
+# alert history (obsplane AlertEngine.canonical_history).  When set,
+# every bundle carries an alerts.json artifact — "what paged during
+# this incident" rides along with "what happened".
+_alert_history_provider = None
+
+
+def set_alert_history_provider(provider) -> None:
+    """Register (or clear, with None) the alert-history source bundles
+    embed.  The soak harness points this at its alert engine for the
+    run's lifetime."""
+    global _alert_history_provider
+    _alert_history_provider = provider
+
+
 def dump_bundle(reason: str,
                 directory: Optional[str] = None,
                 recorder: Optional[FlightRecorder] = None,
@@ -584,6 +599,23 @@ def _dump_bundle_inner(reason, directory, recorder, tracer, registry,
         json.dump(job_payload if job_payload is not None
                   else {"jobs": []}, f, indent=2, default=str)
 
+    # 7. alerts.json — the metrics plane's canonical alert history,
+    # when an alert engine registered itself (soak harness, smoke).
+    # Canonical = timestamp-free and sorted, so two identical seeded
+    # runs bundle byte-identical histories.
+    alerts = None
+    provider = _alert_history_provider
+    if provider is not None:
+        try:
+            alerts = provider()
+        # A dying alert engine must not block the bundle dump.
+        except Exception:  # lint: allow[silent-except]
+            alerts = None
+    if alerts is not None:
+        with open(os.path.join(path, "alerts.json"), "w") as f:
+            json.dump(alerts, f, indent=2, sort_keys=True)
+            f.write("\n")
+
     manifest = {
         "reason": reason,
         "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -592,8 +624,9 @@ def _dump_bundle_inner(reason, directory, recorder, tracer, registry,
                  "total": recorder.seq,
                  "dropped": recorder.dropped},
         "sidecar_records": len(sidecars),
-        "artifacts": ["flight.jsonl", "events.jsonl", "trace.json",
-                      "critical_path.json", "metrics.prom", "job.json"],
+        "artifacts": (["flight.jsonl", "events.jsonl", "trace.json",
+                       "critical_path.json", "metrics.prom", "job.json"]
+                      + (["alerts.json"] if alerts is not None else [])),
     }
     with open(os.path.join(path, "MANIFEST.json"), "w") as f:
         json.dump(manifest, f, indent=2)

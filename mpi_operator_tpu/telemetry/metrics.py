@@ -64,6 +64,12 @@ class Counter:
                 f"# TYPE {self.name} {self._TYPE}\n"
                 f"{self.name} {self.value}\n")
 
+    def collect(self) -> list:
+        """Structured samples: ``[(labels_dict, value)]`` — the
+        scraper-facing snapshot (obsplane/scrape.py), one entry per
+        live series."""
+        return [({}, self.value)]
+
 
 class Gauge(Counter):
     """Value that can go up and down."""
@@ -136,6 +142,14 @@ class Histogram:
             return {"buckets": cumulative, "sum": self._sum,
                     "count": self._count}
 
+    _TYPE = "histogram"
+
+    def collect(self) -> list:
+        """``[(labels_dict, snapshot_dict)]`` — histogram samples are
+        the full cumulative snapshot so range queries can window them
+        by subtraction (obsplane/store.py)."""
+        return [({}, self.snapshot())]
+
     def expose(self) -> str:
         snap = self.snapshot()
         lines = [f"# HELP {self.name} {self.help}",
@@ -203,6 +217,17 @@ class _Vec:
         with self._lock:
             return sorted(self._children.items())
 
+    def collect(self) -> list:
+        """``[(labels_dict, sample)]`` per live child — a removed
+        series stops appearing here, which is exactly what the stale-
+        gauge regression tests assert against."""
+        return [(dict(zip(self.label_names, key)),
+                 self._collect_child(child))
+                for key, child in self._items()]
+
+    def _collect_child(self, child):
+        raise NotImplementedError
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self._TYPE}"]
@@ -229,6 +254,9 @@ class CounterVec(_Vec):
         labels = _format_labels(self.label_names, key)
         yield f"{self.name}{{{labels}}} {child.value}"
 
+    def _collect_child(self, child) -> float:
+        return child.value
+
 
 class GaugeVec(CounterVec):
     _TYPE = "gauge"
@@ -244,6 +272,9 @@ class HistogramVec(_Vec):
         return Histogram(self.name, self.help,
                          buckets=self._child_kwargs.get(
                              "buckets", DEFAULT_BUCKETS))
+
+    def _collect_child(self, child) -> dict:
+        return child.snapshot()
 
     def _expose_child(self, key, child):
         labels = _format_labels(self.label_names, key)
@@ -327,6 +358,17 @@ class Registry:
         with self._lock:
             metrics = list(self._order)
         return "".join(m.expose() for m in metrics)
+
+    def collect(self) -> list:
+        """Structured registry snapshot for the metrics plane's scraper
+        (obsplane/scrape.py): ``[(name, type, [(labels, sample)])]``
+        in registration order.  Scalar metrics sample their float
+        value; histograms sample their full cumulative snapshot dict.
+        Reads each metric under its own lock — no exposition-text
+        round trip, no parse ambiguity."""
+        with self._lock:
+            metrics = list(self._order)
+        return [(m.name, m._TYPE, m.collect()) for m in metrics]
 
 
 _DEFAULT_REGISTRY = Registry()

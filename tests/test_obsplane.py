@@ -1,0 +1,714 @@
+"""Metrics-plane tests (mpi_operator_tpu/obsplane/, docs/OBSERVABILITY.md
+"Metrics plane & alerting"): the time-series store's range evaluators,
+the alert-rule grammar and engine lifecycle, the scraper's three source
+shapes, the straggler scorer, the fleet rule set + alert-fidelity
+scorer, the flight-bundle alert history, and the stale-gauge
+regression sweep (a departed object's series must leave the scrape
+with it)."""
+
+import json
+import os
+
+import pytest
+
+from mpi_operator_tpu.obsplane import (AbsentRule, AlertEngine,
+                                       BurnRateRule, FIDELITY_MAP,
+                                       Scraper, StallRule,
+                                       StragglerRule, StragglerScorer,
+                                       ThresholdRule, TimeSeriesStore,
+                                       default_fleet_rules,
+                                       parse_exposition, parse_selector,
+                                       score_alert_fidelity)
+from mpi_operator_tpu.soak.slo import quantile
+from mpi_operator_tpu.telemetry import flight
+from mpi_operator_tpu.telemetry.goodput import GoodputTracker
+from mpi_operator_tpu.telemetry.metrics import Registry
+
+
+# ---------------------------------------------------------------------------
+# Selector grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_selector_name_and_labels():
+    assert parse_selector("up") == ("up", {})
+    name, labels = parse_selector(
+        'mpi_operator_straggler_score{job="j1",worker="worker-2"}')
+    assert name == "mpi_operator_straggler_score"
+    assert labels == {"job": "j1", "worker": "worker-2"}
+
+
+@pytest.mark.parametrize("bad", [
+    "", "{job=\"x\"}", "up{job=x}", "up{job}", "up{job='x'}",
+    "up{job=\"x\" nonsense}"])
+def test_parse_selector_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_selector(bad)
+
+
+# ---------------------------------------------------------------------------
+# Store: ingest, retention, range evaluators
+# ---------------------------------------------------------------------------
+
+def test_store_retention_prunes_by_logical_time():
+    store = TimeSeriesStore(retention_s=10.0)
+    for t in range(0, 30, 5):
+        store.add_sample("m", {}, float(t), float(t), kind="counter")
+    (series,) = store.select("m")
+    assert [t for t, _ in series.samples] == [15.0, 20.0, 25.0]
+
+
+def test_increase_with_counter_reset_mid_window():
+    store = TimeSeriesStore()
+    # 0 -> 8, restart (drops to 2), -> 5: increase = 8 + 2 + 3 = 13.
+    for t, v in [(1, 0), (2, 8), (3, 2), (4, 5)]:
+        store.add_sample("c", {}, float(v), float(t), kind="counter")
+    ((labels, inc),) = store.increase("c", window=10, at=4.0)
+    assert inc == pytest.approx(13.0)
+    # Rate divides by the span the samples actually cover (3s), not
+    # the nominal window.
+    ((_, rate),) = store.rate("c", window=10, at=4.0)
+    assert rate == pytest.approx(13.0 / 3.0)
+
+
+def test_increase_needs_two_samples_in_window():
+    store = TimeSeriesStore()
+    store.add_sample("c", {}, 7.0, 1.0, kind="counter")
+    assert store.increase("c", window=10, at=5.0) == []
+
+
+def test_rate_and_increase_skip_histogram_series():
+    # The CLI `series` verb runs rate() over whatever matched the
+    # selector; histogram snapshots must be skipped, not compared as
+    # dicts (regression: '<' not supported between dict and dict).
+    store = TimeSeriesStore()
+    for t, count in [(1, 5), (2, 9)]:
+        store.add_sample(
+            "h", {}, {"buckets": {1.0: count}, "sum": 1.0 * count,
+                      "count": count}, float(t), kind="histogram")
+    assert store.rate("h", window=10, at=2.0) == []
+    assert store.increase("h", window=10, at=2.0) == []
+
+
+def test_quantile_over_time_gauge_edges():
+    store = TimeSeriesStore()
+    # Empty window: the series is skipped, not scored 0.
+    store.add_sample("g", {}, 3.0, 1.0)
+    assert store.quantile_over_time("g", 0.99, window=2, at=10.0) == []
+    # Single sample is every quantile of itself (soak/slo.py contract).
+    ((_, v),) = store.quantile_over_time("g", 0.99, window=2, at=1.5)
+    assert v == 3.0
+    # Multi-sample windows agree with the exact slo.quantile.
+    for t, v in [(2, 1.0), (3, 2.0), (4, 10.0)]:
+        store.add_sample("g", {}, float(v), float(t))
+    ((_, got),) = store.quantile_over_time("g", 0.5, window=2.5, at=4.0)
+    assert got == quantile([1.0, 2.0, 10.0], 0.5)
+
+
+def _hist_snap(buckets, total, count):
+    return {"buckets": dict(buckets), "sum": total, "count": count}
+
+
+def test_quantile_over_time_histogram_windowed_delta():
+    store = TimeSeriesStore()
+    # 10 observations <= 1.0 before the window, then 10 more <= 4.0
+    # inside it: the windowed quantile must see ONLY the new ones.
+    store.add_sample("h", {}, _hist_snap({1.0: 10, 4.0: 10}, 5.0, 10),
+                     1.0, kind="histogram")
+    store.add_sample("h", {}, _hist_snap({1.0: 10, 4.0: 20}, 35.0, 20),
+                     10.0, kind="histogram")
+    ((_, p50),) = store.quantile_over_time("h", 0.5, window=5, at=10.0)
+    assert 1.0 < p50 <= 4.0
+
+
+def test_quantile_over_time_histogram_reset_mid_window():
+    store = TimeSeriesStore()
+    store.add_sample("h", {}, _hist_snap({1.0: 100}, 50.0, 100), 1.0,
+                     kind="histogram")
+    # Count regressed (process restart): the post-reset snapshot alone
+    # is the window, never a negative delta.
+    store.add_sample("h", {}, _hist_snap({1.0: 4}, 2.0, 4), 2.0,
+                     kind="histogram")
+    ((_, p99),) = store.quantile_over_time("h", 0.99, window=5, at=2.0)
+    assert 0.0 < p99 <= 1.0
+
+
+def test_histogram_error_ratio_and_zero_traffic_window():
+    store = TimeSeriesStore()
+    store.add_sample("h", {}, _hist_snap({2.5: 9, 5.0: 10}, 30.0, 10),
+                     1.0, kind="histogram")
+    ((_, ratio),) = store.histogram_error_ratio("h", le=2.5, window=5,
+                                                at=1.0)
+    assert ratio == pytest.approx(0.1)
+    # le that is not a bucket bound: skipped, not guessed.
+    assert store.histogram_error_ratio("h", le=3.0, window=5,
+                                       at=1.0) == []
+    # A later window with zero NEW observations burns no budget.
+    store.add_sample("h", {}, _hist_snap({2.5: 9, 5.0: 10}, 30.0, 10),
+                     10.0, kind="histogram")
+    assert store.histogram_error_ratio("h", le=2.5, window=5,
+                                       at=10.0) == []
+
+
+def test_absent_and_latest():
+    store = TimeSeriesStore()
+    assert store.absent("never_seen")
+    store.add_sample("up", {"job": "a"}, 1.0, 1.0)
+    assert not store.absent('up{job="a"}')
+    assert store.absent('up{job="b"}')
+    ((labels, t, v),) = store.latest('up{job="a"}')
+    assert (labels, t, v) == ({"job": "a"}, 1.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Rules + engine lifecycle
+# ---------------------------------------------------------------------------
+
+def test_threshold_last_mode_is_staleness_bounded():
+    store = TimeSeriesStore()
+    store.add_sample("score", {"worker": "w0"}, 3.0, 1.0)
+    rule = ThresholdRule("S", metric="score", mode="last", window=30,
+                         above=1.8)
+    assert rule.evaluate(store, 2.0) == [({"worker": "w0"}, 3.0)]
+    # The worker departed; its retained last sample must stop alerting
+    # once it falls outside the staleness window.
+    assert rule.evaluate(store, 100.0) == []
+
+
+def test_threshold_rule_requires_bound_and_known_mode():
+    with pytest.raises(ValueError):
+        ThresholdRule("NoBound", metric="m")
+    with pytest.raises(ValueError):
+        ThresholdRule("BadMode", metric="m", mode="derivative", above=0)
+
+
+def test_engine_pending_firing_resolved_lifecycle():
+    store = TimeSeriesStore()
+    rule = ThresholdRule("Hot", metric="g", mode="last", window=60,
+                         above=5.0, for_s=2.0)
+    engine = AlertEngine(store, [rule])
+    store.add_sample("g", {}, 9.0, 1.0)
+    assert engine.evaluate(1.0) == []          # pending, not fired
+    assert engine.evaluate(2.0) == []          # still inside for_s
+    (fired,) = engine.evaluate(3.0)            # sustained >= for_s
+    assert fired.name == "Hot" and fired.state == "firing"
+    store.add_sample("g", {}, 1.0, 4.0)
+    engine.evaluate(4.0)
+    (alert,) = engine.all_alerts()
+    assert alert.state == "resolved" and alert.resolved_at == 4.0
+    events = [h["event"] for h in engine.history()]
+    assert events == ["firing", "resolved"]
+
+
+def test_engine_pending_blip_leaves_no_history():
+    store = TimeSeriesStore()
+    rule = ThresholdRule("Blip", metric="g", mode="last", window=60,
+                         above=5.0, for_s=10.0)
+    engine = AlertEngine(store, [rule])
+    store.add_sample("g", {}, 9.0, 1.0)
+    engine.evaluate(1.0)
+    store.add_sample("g", {}, 1.0, 2.0)
+    engine.evaluate(2.0)
+    assert engine.history() == [] and engine.all_alerts() == []
+
+
+def test_engine_counts_firings_into_registry():
+    reg = Registry()
+    store = TimeSeriesStore()
+    engine = AlertEngine(store, [ThresholdRule(
+        "Hot", metric="g", mode="last", window=60, above=0.0)],
+        registry=reg)
+    store.add_sample("g", {}, 1.0, 1.0)
+    engine.evaluate(1.0)
+    fams = {name: entries for name, _, entries in reg.collect()}
+    assert fams["mpi_operator_obsplane_alerts_total"] == \
+        [({"alert": "Hot"}, 1.0)]
+
+
+def test_stall_rule_activity_without_completion():
+    store = TimeSeriesStore()
+    rule = StallRule("WalFsyncStall",
+                     metric="fsyncs", activity_metric="appends",
+                     window=30, min_activity=5.0)
+    for t, appends, fsyncs in [(1, 0, 0), (5, 10, 0)]:
+        store.add_sample("appends", {}, float(appends), float(t),
+                         kind="counter")
+        store.add_sample("fsyncs", {}, float(fsyncs), float(t),
+                         kind="counter")
+    ((_, activity),) = rule.evaluate(store, 5.0)
+    assert activity == pytest.approx(10.0)
+    # Fsyncs advancing again clears the stall.
+    store.add_sample("appends", {}, 20.0, 10.0, kind="counter")
+    store.add_sample("fsyncs", {}, 3.0, 10.0, kind="counter")
+    assert rule.evaluate(store, 10.0) == []
+
+
+def test_stall_rule_quiet_activity_does_not_fire():
+    store = TimeSeriesStore()
+    rule = StallRule("S", metric="fsyncs", activity_metric="appends",
+                     window=30, min_activity=5.0)
+    for t, v in [(1, 0), (5, 2)]:   # only 2 appends: below min_activity
+        store.add_sample("appends", {}, float(v), float(t),
+                         kind="counter")
+    assert rule.evaluate(store, 5.0) == []
+
+
+def test_burn_rate_histogram_needs_both_windows():
+    rule = BurnRateRule("Ttft", metric="h", objective=0.9,
+                        objective_le=1.0, fast_window=10,
+                        slow_window=40, fast_burn=2.0, slow_burn=2.0)
+    store = TimeSeriesStore()
+    # Slow window: healthy traffic (all <= 1.0).  Fast window: 50%
+    # over objective — fast burn trips but slow does not: no fire.
+    store.add_sample("h", {}, _hist_snap({1.0: 100, 5.0: 100}, 50.0,
+                                         100), 1.0, kind="histogram")
+    store.add_sample("h", {}, _hist_snap({1.0: 105, 5.0: 110}, 90.0,
+                                         110), 35.0, kind="histogram")
+    assert rule.evaluate(store, 36.0) == []
+    # Sustained degradation fills the slow window too: fires.
+    store.add_sample("h", {}, _hist_snap({1.0: 110, 5.0: 160}, 300.0,
+                                         160), 39.0, kind="histogram")
+    ((_, factor),) = rule.evaluate(store, 39.5)
+    assert factor >= 2.0
+
+
+def test_burn_rate_gauge_target_path():
+    rule = BurnRateRule("Goodput", metric="g", objective=0.9,
+                        gauge_target=0.8, fast_window=10,
+                        slow_window=30, fast_burn=2.0, slow_burn=1.0)
+    store = TimeSeriesStore()
+    for t in (1, 10, 20, 29):
+        store.add_sample("g", {}, 0.5, float(t))
+    # Error ratio = (0.8-0.5)/0.8 = 0.375; budget 0.1 -> burn 3.75.
+    ((_, factor),) = rule.evaluate(store, 30.0)
+    assert factor == pytest.approx(3.75)
+    store2 = TimeSeriesStore()
+    for t in (1, 10, 20, 29):
+        store2.add_sample("g", {}, 0.85, float(t))   # above target
+    assert rule.evaluate(store2, 30.0) == []
+
+
+def test_burn_rate_rejects_ambiguous_config():
+    with pytest.raises(ValueError):
+        BurnRateRule("Both", metric="m", objective=0.9,
+                     objective_le=1.0, gauge_target=0.5)
+    with pytest.raises(ValueError):
+        BurnRateRule("Neither", metric="m", objective=0.9)
+    with pytest.raises(ValueError):
+        BurnRateRule("BadObj", metric="m", objective=1.5,
+                     objective_le=1.0)
+
+
+def test_absent_rule_fires_until_feed_appears():
+    store = TimeSeriesStore()
+    rule = AbsentRule("FeedAbsent", metric="steps",
+                      selector='steps{job="j"}')
+    assert rule.evaluate(store, 1.0) == \
+        [({"selector": 'steps{job="j"}'}, 1.0)]
+    store.add_sample("steps", {"job": "j"}, 1.0, 2.0, kind="counter")
+    assert rule.evaluate(store, 2.0) == []
+
+
+def test_canonical_history_is_deterministic():
+    def run():
+        store = TimeSeriesStore()
+        engine = AlertEngine(store, [StragglerRule(window=60)])
+        store.add_sample("mpi_operator_straggler_score",
+                         {"job": "j", "worker": "w1"}, 2.5, 1.0)
+        store.add_sample("mpi_operator_straggler_score",
+                         {"job": "j", "worker": "w0"}, 2.1, 1.0)
+        engine.evaluate(1.0)
+        engine.evaluate(2.0)   # still firing: no duplicate incident
+        return engine.canonical_history_json()
+    a, b = run(), run()
+    assert a == b
+    incidents = json.loads(a)
+    assert [i["labels"]["worker"] for i in incidents] == ["w0", "w1"]
+    assert all(i["severity"] == "critical" for i in incidents)
+    assert "since" not in incidents[0]   # timestamp-free by contract
+
+
+# ---------------------------------------------------------------------------
+# Scraper: registries, exposition text, step files
+# ---------------------------------------------------------------------------
+
+def test_scraper_ingests_registry_collect(tmp_path):
+    reg = Registry()
+    reg.counter("reconciles_total", "x").inc(3)
+    reg.histogram("latency_seconds", "x",
+                  buckets=(0.1, 1.0)).observe(0.05)
+    store = TimeSeriesStore()
+    scraper = Scraper(store, clock=lambda: 0.0, registry=reg)
+    scraper.add_registry(reg, labels={"component": "ctl"})
+    scraper.scrape_once(t=1.0)
+    ((labels, _, v),) = store.latest("reconciles_total")
+    assert labels == {"component": "ctl"} and v == 3.0
+    ((_, _, snap),) = store.latest("latency_seconds")
+    assert snap["count"] == 1
+    # The plane meters itself into a registry it is also scraping.
+    scraper.scrape_once(t=2.0)
+    ((_, _, scrapes),) = store.latest(
+        "mpi_operator_obsplane_scrapes_total")
+    assert scrapes == 1.0   # first cycle's count, seen by the second
+    assert not store.absent("mpi_operator_obsplane_series")
+
+
+def test_parse_exposition_round_trips_registry_expose():
+    reg = Registry()
+    reg.counter_vec("req_total", "x", ["code"]).labels("200").inc(7)
+    hist = reg.histogram_vec("lat_seconds", "x", ["job"],
+                             buckets=(0.5, 2.5))
+    hist.labels("j1").observe(0.1)
+    hist.labels("j1").observe(3.0)
+    parsed = {(name, tuple(sorted(labels.items()))): (kind, sample)
+              for name, kind, labels, sample
+              in parse_exposition(reg.expose())}
+    kind, v = parsed[("req_total", (("code", "200"),))]
+    assert kind == "counter" and v == 7.0
+    kind, snap = parsed[("lat_seconds", (("job", "j1"),))]
+    assert kind == "histogram"
+    assert snap["count"] == 2 and snap["buckets"][0.5] == 1
+    assert "le" not in dict(snap["buckets"])
+    assert snap["sum"] == pytest.approx(3.1)
+
+
+def test_scraper_step_dir_probe(tmp_path):
+    (tmp_path / "step-trainA-worker-0").write_text("12")
+    (tmp_path / "step-trainA-worker-1").write_text("9")
+    (tmp_path / "step-trainA-worker-2.tmp").write_text("999")  # torn
+    (tmp_path / "unrelated.txt").write_text("nope")
+    store = TimeSeriesStore()
+    scraper = Scraper(store, clock=lambda: 0.0)
+    scraper.add_step_dir(str(tmp_path))
+    scraper.scrape_once(t=1.0)
+    rows = {labels["worker"]: v for labels, _, v in store.latest(
+        'mpi_operator_worker_steps_total{job="trainA"}')}
+    assert rows == {"worker-0": 12.0, "worker-1": 9.0}
+
+
+def test_scraper_dead_text_source_does_not_kill_cycle():
+    store = TimeSeriesStore()
+    scraper = Scraper(store, clock=lambda: 0.0)
+
+    def explode():
+        raise OSError("connection refused")
+    scraper.add_text_source(explode)
+    scraper.add_text_source(lambda: "# TYPE up gauge\nup 1\n")
+    assert scraper.scrape_once(t=1.0) == 1
+    assert not store.absent("up")
+
+
+# ---------------------------------------------------------------------------
+# Straggler scorer
+# ---------------------------------------------------------------------------
+
+def test_straggler_scores_slow_worker_against_gang_median():
+    s = StragglerScorer()
+    for i in range(4):
+        seconds = 3.0 if i == 3 else 1.0
+        for step in range(4):
+            s.observe_step("j", f"w{i}", seconds, t=float(step))
+    scores = s.scores(t=4.0)
+    assert scores[("j", "w3")] == pytest.approx(3.0)
+    for i in range(3):
+        assert scores[("j", f"w{i}")] == pytest.approx(1.0)
+
+
+def test_straggler_min_samples_and_single_worker_gang():
+    s = StragglerScorer()
+    s.observe_step("j", "w0", 1.0, 0.0)
+    s.observe_step("j", "w0", 1.0, 1.0)   # below MIN_SAMPLES
+    for t in range(4):
+        s.observe_step("j", "w1", 1.0, float(t))
+    # Only w1 is scoreable -> gang of one -> nothing published.
+    assert s.scores(t=4.0) == {}
+    s.observe_step("lonely", "solo", 9.0, 0.0)
+    s.observe_step("lonely", "solo", 9.0, 1.0)
+    s.observe_step("lonely", "solo", 9.0, 2.0)
+    assert ("lonely", "solo") not in s.scores(t=3.0)
+
+
+def test_straggler_progress_deltas_derive_step_time():
+    s = StragglerScorer(min_samples=2)
+    # 2 steps per 10s interval -> 5 s/step for w0; 10 steps -> 1 s/step
+    # for w1.
+    for i, t in enumerate((0.0, 10.0, 20.0, 30.0)):
+        s.observe_progress("j", "w0", steps=2 * i, t=t)
+        s.observe_progress("j", "w1", steps=10 * i, t=t)
+    scores = s.scores(t=30.0)
+    assert scores[("j", "w0")] == pytest.approx(5.0 / 3.0)
+    assert scores[("j", "w1")] == pytest.approx(1.0 / 3.0)
+
+
+def test_straggler_progress_idle_interval_keeps_baseline():
+    s = StragglerScorer(min_samples=1)
+    s.observe_progress("j", "w", steps=5, t=0.0)
+    s.observe_progress("j", "w", steps=5, t=10.0)  # step in flight
+    s.observe_progress("j", "w", steps=6, t=20.0)
+    # The slow step is charged its FULL 20s, not the final 10s.
+    assert s.worker_distribution("j", "w", 0.5, t=20.0) == \
+        pytest.approx(20.0)
+
+
+def test_straggler_progress_restart_resets_baseline():
+    s = StragglerScorer(min_samples=1)
+    s.observe_progress("j", "w", steps=100, t=0.0)
+    s.observe_progress("j", "w", steps=3, t=10.0)   # rewind: restart
+    assert s.worker_distribution("j", "w", 0.5, t=10.0) is None
+    s.observe_progress("j", "w", steps=5, t=20.0)   # post-restart delta
+    assert s.worker_distribution("j", "w", 0.5, t=20.0) == \
+        pytest.approx(5.0)
+
+
+def test_straggler_publish_removes_departed_series():
+    reg = Registry()
+    s = StragglerScorer(registry=reg, min_samples=1, sample_ttl_s=15.0)
+    for t in (0.0, 1.0):
+        s.observe_step("j", "w0", 1.0, t)
+        s.observe_step("j", "w1", 2.0, t)
+    assert len(s.publish(t=2.0)) == 2
+    fams = {name: entries for name, _, entries in reg.collect()}
+    assert len(fams["mpi_operator_straggler_score"]) == 2
+    # w1 stops reporting; its samples age out past the TTL and its
+    # gauge series must leave the exposition, not freeze at 2.0.
+    for t in (20.0, 21.0):
+        s.observe_step("j", "w0", 1.0, t)
+        s.observe_step("j", "w2", 1.0, t)
+    s.publish(t=22.0)
+    fams = {name: entries for name, _, entries in reg.collect()}
+    workers = {labels["worker"] for labels, _
+               in fams["mpi_operator_straggler_score"]}
+    assert workers == {"w0", "w2"}
+
+
+# ---------------------------------------------------------------------------
+# Fleet rule set + alert fidelity
+# ---------------------------------------------------------------------------
+
+def test_fidelity_map_alerts_all_exist_in_default_rules():
+    names = {r.name for r in default_fleet_rules()}
+    for kind, alerts in FIDELITY_MAP.items():
+        for alert in alerts:
+            assert alert in names, (kind, alert)
+
+
+def test_default_rules_watchdog_selector_adds_absent_rule():
+    rules = default_fleet_rules(
+        watchdog_selector='mpi_operator_worker_steps_total{job="j"}')
+    (absent,) = [r for r in rules if r.name == "FeedAbsent"]
+    assert absent.metric == "mpi_operator_worker_steps_total"
+
+
+def test_score_alert_fidelity_detect_miss_and_unmapped():
+    events = [
+        {"event": "inject", "kind": "pod_kill", "at": 2.0,
+         "result": "killed"},
+        {"event": "inject", "kind": "slow_node", "at": 4.0,
+         "result": "throttled duty=0.66"},
+        {"event": "inject", "kind": "blob_fault", "at": 5.0,
+         "result": "injected"},                      # unmapped kind
+        {"event": "inject", "kind": "replica_kill", "at": 6.0,
+         "result": "no-candidate"},                  # not applied
+        {"event": "heal", "kind": "pod_kill", "at": 9.0},
+    ]
+    firings = [
+        {"alert": "GangDisruption", "labels": {}, "t": 104.0},
+        {"alert": "StragglerAlert", "labels": {"worker": "w3"},
+         "t": 103.0},   # BEFORE slow_node's inject at t0+4: ignored
+    ]
+    out = score_alert_fidelity(events, firings, t0=100.0,
+                               deadline_s=5.0)
+    assert out["unmapped_kinds"] == ["blob_fault"]
+    assert out["mapped_kinds_injected"] == 2    # replica_kill skipped
+    assert out["per_kind"]["pod_kill"]["ok"]
+    assert out["per_kind"]["pod_kill"]["time_to_detect_s"] == 2.0
+    assert not out["per_kind"]["slow_node"]["ok"]
+    assert out["per_kind"]["slow_node"]["detected_at"] is None
+    assert not out["ok"]
+
+
+def test_score_alert_fidelity_quiescent_and_all_detected():
+    out = score_alert_fidelity([], [], t0=0.0)
+    assert out["ok"] and out["per_kind"] == {}
+    events = [{"event": "inject", "kind": "scheduler_restart",
+               "at": 1.0, "result": "restarted"}]
+    firings = [{"alert": "SchedulerRestart", "labels": {}, "t": 3.0}]
+    out = score_alert_fidelity(events, firings, t0=0.0, deadline_s=10.0)
+    assert out["ok"] and out["per_kind"]["scheduler_restart"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Flight bundle: alert history artifact
+# ---------------------------------------------------------------------------
+
+def test_bundle_embeds_alert_history_when_provider_set(tmp_path):
+    rec = flight.FlightRecorder()
+    rec.record("chaos", "inject", kind="pod_kill")
+    history = [{"alert": "GangDisruption", "labels": {"job": "j"},
+                "severity": "warning"}]
+    flight.set_alert_history_provider(lambda: history)
+    try:
+        path = flight.dump_bundle("alert-test",
+                                  directory=str(tmp_path),
+                                  recorder=rec, registry=Registry(),
+                                  include_sidecars=False)
+    finally:
+        flight.set_alert_history_provider(None)
+    assert json.load(open(os.path.join(path, "alerts.json"))) == history
+    manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert "alerts.json" in manifest["artifacts"]
+
+
+def test_bundle_without_provider_has_no_alerts_artifact(tmp_path):
+    rec = flight.FlightRecorder()
+    path = flight.dump_bundle("no-alerts", directory=str(tmp_path),
+                              recorder=rec, registry=Registry(),
+                              include_sidecars=False)
+    assert not os.path.exists(os.path.join(path, "alerts.json"))
+    manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert "alerts.json" not in manifest["artifacts"]
+
+
+# ---------------------------------------------------------------------------
+# Stale-gauge regression sweep: departed objects leave the scrape
+# ---------------------------------------------------------------------------
+
+def test_controller_job_info_removed_on_job_deletion():
+    from test_controller import Fixture, new_mpi_job
+
+    f = Fixture()
+    job = new_mpi_job(workers=1)
+    f.register_job(job)
+    f.sync(job)
+    f.refresh_caches()
+    f.sync(job)   # launcher Job now in cache: job_info set
+    info = f.controller.metrics["job_info"]
+    assert info.collect() == [({"launcher": "test-launcher",
+                                "namespace": "default"}, 1.0)]
+    f.client.mpi_jobs("default").delete("test")
+    f.refresh_caches()
+    f.controller.sync_handler("default/test")   # deletion path
+    assert info.collect() == []
+
+
+def test_scheduler_cq_gauges_removed_on_queue_deletion():
+    from test_sched import mk_job, mk_queues
+
+    from mpi_operator_tpu.api import constants
+    from mpi_operator_tpu.k8s.apiserver import Clientset
+    from mpi_operator_tpu.sched import GangScheduler, SlicePool, TpuSlice
+
+    cs = Clientset()
+    mk_queues(cs, quotas={constants.TPU_RESOURCE: "8"})
+    sched = GangScheduler(cs, SlicePool([TpuSlice("s0", 4)]))
+    cs.mpi_jobs("default").create(mk_job("a", 2))
+    sched.reconcile_once()
+    assert sched.metrics["pending"].collect() == [({"queue": "cq"}, 0.0)]
+    assert sched._cq_gauge_keys == {"cq"}
+    cs.cluster_queues("default").delete("cq")
+    sched.reconcile_once()
+    for family in ("pending", "admitted", "used_chips"):
+        assert sched.metrics[family].collect() == [], family
+    assert sched._cq_gauge_keys == set()
+
+
+def test_disagg_pool_replicas_removed_on_page_out():
+    from mpi_operator_tpu.serving.disagg import (DisaggServeFleet,
+                                                 ModelPoolSpec)
+
+    class FakeServer:
+        url = "http://127.0.0.1:1"
+
+        def start(self):
+            return self
+
+        def stop(self):
+            pass
+
+    spec = ModelPoolSpec(name="m0", page_size=16,
+                         server_factory=lambda s, role: FakeServer(),
+                         prefill_replicas=1, decode_replicas=1)
+    fleet = DisaggServeFleet([spec])
+    try:
+        with fleet._lock:
+            for role, count in fleet._roles_for(spec).items():
+                for _ in range(count):
+                    fleet._spawn(spec, role)
+        gauge = fleet.router.telemetry["pool_replicas"]
+        assert {(labels["model"], labels["role"]): v
+                for labels, v in gauge.collect()} == \
+            {("m0", "prefill"): 1.0, ("m0", "decode"): 1.0}
+        with fleet._lock:
+            fleet._tear_down("m0")
+        # A paged-out model must DISAPPEAR from the scrape, not
+        # report an empty pool forever.
+        assert gauge.collect() == []
+    finally:
+        fleet.router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Goodput + SLO quantile edges under the range evaluators (satellite)
+# ---------------------------------------------------------------------------
+
+def test_slo_quantile_edges():
+    assert quantile([], 0.99) is None
+    assert quantile([7.0], 0.0) == 7.0
+    assert quantile([7.0], 1.0) == 7.0
+    assert quantile([1.0, 3.0], 5.0) == 3.0    # q clamped to [0, 1]
+    assert quantile([1.0, 3.0], -1.0) == 1.0
+
+
+def test_goodput_tracker_scraped_through_range_evaluators():
+    clock = {"t": 0.0}
+    reg = Registry()
+    gp = GoodputTracker(registry=reg, clock=lambda: clock["t"])
+    store = TimeSeriesStore()
+    scraper = Scraper(store, clock=lambda: clock["t"])
+    scraper.add_registry(reg)
+
+    # Empty window: nothing accounted yet -> histogram delta observes
+    # nothing, gauge window is empty; both evaluators stay silent.
+    scraper.scrape_once(t=1.0)
+    assert store.quantile_over_time("train_step_seconds", 0.99,
+                                    window=10, at=1.0) == []
+    ((_, g),) = store.quantile_over_time("train_goodput_fraction",
+                                         0.5, window=10, at=1.0)
+    assert g == 0.0   # the gauge exists (registered) at 0
+
+    # Single sample: one productive step; the windowed histogram
+    # quantile scores that one observation.
+    gp.add("productive", 2.0)
+    scraper.scrape_once(t=2.0)
+    ((_, p99),) = store.quantile_over_time(
+        "train_step_seconds", 0.99, window=0.5, at=2.0)
+    assert p99 > 0.0
+    ((_, frac),) = store.quantile_over_time(
+        "train_goodput_fraction", 0.5, window=0.5, at=2.0)
+    assert frac == 1.0
+
+    # Counter reset mid-window: a restarted tracker re-registers at
+    # zero; the windowed delta must score the post-reset snapshot
+    # alone, never go negative.
+    reg2 = Registry()
+    gp2 = GoodputTracker(registry=reg2, clock=lambda: clock["t"])
+    gp2.add("productive", 0.25)
+    scraper2 = Scraper(store, clock=lambda: clock["t"])
+    scraper2.add_registry(reg2)
+    scraper2.scrape_once(t=3.0)
+    ((_, p99),) = store.quantile_over_time(
+        "train_step_seconds", 0.99, window=2.5, at=3.0)
+    assert 0.0 < p99 <= 0.25
+    # And the goodput burn-rate path sees the degraded gauge: the
+    # fast window holds only degraded samples, the slow window still
+    # mixes in the healthy early run.
+    gp2.add("data_wait", 0.75)   # goodput drops to 0.25
+    scraper2.scrape_once(t=4.0)
+    gp2.add("data_wait", 4.0)    # goodput collapses to 0.05
+    scraper2.scrape_once(t=5.0)
+    rule = BurnRateRule("GoodputBurnRate",
+                        metric="train_goodput_fraction",
+                        objective=0.9, gauge_target=0.7,
+                        fast_window=1.5, slow_window=3.5,
+                        fast_burn=2.0, slow_burn=1.0)
+    ((_, factor),) = rule.evaluate(store, 5.0)
+    assert factor > 2.0
