@@ -971,9 +971,16 @@ class GangScheduler:
 
     def _admission_passes(self, jobs, lqs, cqs) -> int:
         admissions = 0
+        # The pending set is computed ONCE per reconcile pass: within a
+        # single pass the only thing that removes a candidate is an
+        # admission in this very loop (jobs/lqs/cqs are a snapshot and
+        # _preempting/_admitted only change through _admit below), so
+        # re-filtering every job after every admission was pure
+        # O(backlog) waste.  The ordering still recomputes per
+        # admission — fair-share ranks move as usage changes.
+        pending = self._pending(jobs, lqs, cqs)
         while True:
             usage = self._usage()
-            pending = self._pending(jobs, lqs, cqs)
             order = self._order(pending, usage)
             if not order:
                 if self._blocked is not None:
@@ -1095,6 +1102,8 @@ class GangScheduler:
                 if self._blocked is not None \
                         and self._blocked["key"] == key:
                     self._blocked = None
+                pending = [item for item in pending
+                           if self._key(item[1]) != key]
                 admissions += 1
                 admitted_this_walk = True
                 break  # usage changed: recompute the walk
