@@ -8,7 +8,7 @@ PYTHON ?= python
 	controller-bench-smoke controller-shard-smoke serve-bench-smoke \
 	train-bench-smoke serve-fleet-smoke sched-smoke soak-smoke \
 	trace-smoke topo-smoke durable-smoke elastic-smoke ckpt-smoke \
-	obsplane-smoke bench-disagg bench-obsplane analyze
+	obsplane-smoke twin-smoke bench-disagg bench-obsplane analyze
 
 # Every smoke runs with the runtime lock-order detector armed
 # (docs/ANALYSIS.md): repo-created locks are tracked, lock-order cycles
@@ -131,6 +131,15 @@ soak-smoke:
 # plane & alerting").
 obsplane-smoke:
 	$(SMOKE_ENV) $(PYTHON) tools/obsplane_smoke.py
+
+# Control-plane scale twin (< 60s, CPU): bench_scale_twin.py's
+# event-driven twin (real apiserver + GangScheduler + controller twin
+# on one logical clock) at 4k pods, run twice — canonical store dumps
+# byte-identical, 0 capacity-conservation violations across every
+# event, decision-latency p99 within the smoke budget (docs/PERF.md
+# "O(delta) scheduling & the scale twin").
+twin-smoke:
+	$(SMOKE_ENV) $(PYTHON) tools/twin_smoke.py
 
 # Durable apiserver (< 60s, CPU): WAL-backed store killed and replayed
 # byte-identical (canonical dump + uid/ownership indexes + per-kind

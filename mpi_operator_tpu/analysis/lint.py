@@ -334,6 +334,55 @@ def check_wallclock_sim(ctx: FileContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# full-relist-in-loop
+
+@rule("full-relist-in-loop", "error",
+      "apiserver .list() lexically inside a loop in the scheduler — "
+      "the O(backlog)-per-decision class PR 19 burned down: per-event "
+      "paths must consume watch deltas / maintained indexes; a "
+      "deliberate resync site takes a pragma",
+      scope=lambda p: p.startswith("mpi_operator_tpu/sched/"))
+def check_full_relist_in_loop(ctx: FileContext) -> List[Finding]:
+    findings = []
+
+    def scan(node, in_loop):
+        if isinstance(node, ast.Call) and in_loop:
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "list":
+                findings.append(Finding(
+                    "full-relist-in-loop", ctx.relpath, node.lineno,
+                    ".list() inside a loop — relisting the world per "
+                    "iteration is O(backlog) per decision; use the "
+                    "watch mirror / maintained index (pragma "
+                    "deliberate resyncs)"))
+        # A nested def resets loop context (the loop runs the def,
+        # not the list call).
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for child in ast.iter_child_nodes(node):
+                scan(child, False)
+            return
+        # ``for x in client.list(...)`` evaluates its iterator ONCE —
+        # the iter expression keeps the OUTER loop context; only the
+        # body/orelse re-run per iteration.
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            scan(node.iter, in_loop)
+            for child in ast.iter_child_nodes(node):
+                if child is not node.iter:
+                    scan(child, True)
+            return
+        if isinstance(node, ast.While):
+            for child in ast.iter_child_nodes(node):
+                scan(child, True)
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child, in_loop)
+
+    scan(ctx.tree, False)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # metrics-catalog (project-level: collect per file, compare vs docs)
 
 # Family names built with dynamic prefixes (f-strings the literal walk

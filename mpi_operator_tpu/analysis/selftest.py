@@ -35,6 +35,10 @@ EXPECTED_STATIC = (
     # metric that exists nowhere, one watching the documented-but-
     # unregistered ghost.
     ("metrics-catalog", "mpi_operator_tpu/seeded_rules.py", 2),
+    # One relist in a while loop fires; the pragma'd resync and the
+    # for-iterator list (evaluated once) in the same file must NOT —
+    # precision is asserted by no-extra-findings.
+    ("full-relist-in-loop", "mpi_operator_tpu/sched/seeded_relist.py", 1),
 )
 
 _SEEDED_FILES = {
@@ -92,6 +96,23 @@ _SEEDED_FILES = {
                     metric="mpi_operator_selftest_ghost_total",
                     above=0.0),
             ]
+    """,
+    "mpi_operator_tpu/sched/seeded_relist.py": """\
+        def hot_path(server, pending):
+            while pending:
+                jobs = server.list("kubeflow.org/v2beta1", "MPIJob")
+                pending = admit(jobs, pending)
+
+        def deliberate_resync(server, pending):
+            for _ in range(3):
+                jobs = server.list(  # lint: allow[full-relist-in-loop] — seeded resync
+                    "kubeflow.org/v2beta1", "MPIJob")
+                if jobs:
+                    return jobs
+
+        def iter_once(server):
+            for job in server.list("kubeflow.org/v2beta1", "MPIJob"):
+                mark(job)
     """,
     "docs/OBSERVABILITY.md": """\
         | metric | type | layer | meaning |

@@ -318,12 +318,14 @@ class ElasticResizer:
             if added is None:
                 return reject("no appendable capacity for the grown"
                               " placement")
+            before = rec["demand"]
             rec["chips"] += delta_chips
             rec["demand"] = dict(rec["demand"])
             rec["demand"][PODS_RESOURCE] = \
                 rec["demand"].get(PODS_RESOURCE, 0) + delta_w
             rec["demand"][constants.TPU_RESOURCE] = \
                 rec["demand"].get(constants.TPU_RESOURCE, 0) + delta_chips
+            self.sched._usage_replace(rec["cq"], before, rec["demand"])
             self._write_placement_annotations(
                 key, extra={
                     constants.SCHED_RESIZE_TARGET_ANNOTATION: str(target),
@@ -475,6 +477,7 @@ class ElasticResizer:
 
     def _shrink_accounting(self, rec, entry, freed: int) -> None:
         delta_w = entry["delta_chips"] // max(1, entry["per_worker"])
+        before = rec["demand"]
         rec["chips"] -= entry["delta_chips"]
         rec["demand"] = dict(rec["demand"])
         rec["demand"][PODS_RESOURCE] = \
@@ -482,6 +485,10 @@ class ElasticResizer:
         rec["demand"][constants.TPU_RESOURCE] = max(
             0, rec["demand"].get(constants.TPU_RESOURCE, 0)
             - entry["delta_chips"])
+        # Mirror the clamped delta into the maintained usage (the diff
+        # form keeps the live map byte-equal to a from-scratch rebuild
+        # even when a clamp fires).
+        self.sched._usage_replace(rec["cq"], before, rec["demand"])
         # Freed chips accrue to a fenced gang's reservation exactly
         # like a full release (the fence's no-starvation bound must
         # not leak through the resize path).
@@ -501,8 +508,14 @@ class ElasticResizer:
         re-arming.  The persisted wall-clock deadline is resumed, not
         reset."""
         from .scheduler import job_demand
-        for key, job in sorted(jobs.items()):
-            if key in self._active or key not in self.sched._admitted:
+        # Iterate the (small) admitted set, not every stored job — the
+        # candidate predicate is identical and the sorted() keeps the
+        # adoption order deterministic.
+        for key in sorted(self.sched._admitted):
+            if key in self._active:
+                continue
+            job = jobs.get(key)
+            if job is None:
                 continue
             state = resize_state(job)
             target = resize_target(job)

@@ -1,0 +1,216 @@
+"""Maintained scheduler indexes — the O(delta) hot path.
+
+The GangScheduler's reconcile used to rebuild its world per pass: the
+pending candidate list re-filtered every stored job, the admission walk
+re-sorted it after every single admission, and the preemption victim
+scan iterated every admitted gang.  All of that is O(backlog) *per
+decision*, which is exactly the goodput-vs-concurrency collapse the
+PR 7 storm measured at 10k jobs.
+
+These structures replace the rebuilds with incrementally maintained
+state (docs/PERF.md "O(delta) scheduling & the scale twin"):
+
+- :class:`PendingIndex` — per-ClusterQueue sorted candidate lists,
+  updated O(log n) per dirty key.  ``walk()`` reproduces the legacy
+  ``GangScheduler._order`` sequence lazily, so a walk that admits its
+  front job costs O(#queues log #queues), not O(backlog log backlog).
+- :class:`AdmittedIndex` — per-ClusterQueue admitted gangs sorted by
+  (priority asc, admission epoch desc): the preemption victim order.
+  ``victims()`` merges only the claimant's cohort and the consumer can
+  stop at the first candidate outranking the claim — enumeration is
+  O(candidates), not O(all gangs).
+
+Both indexes hold (cq name, job key, sort key) tuples only — never job
+objects — so they are cheap to rebuild exactly from the store on a
+scheduler restart (tests/test_sched_indexes.py proves rebuild
+equivalence and order parity against the legacy reference walk over
+seeded churn).
+
+Invariants (asserted by the property tests, relied on by scheduler.py):
+
+- membership == the legacy ``_pending`` predicate over (mirror,
+  admitted, preempting) at the last reindex;
+- per-queue lists are totally ordered by
+  ``(-priority, creation_timestamp, name, key)`` — the legacy job sort
+  key plus the job key as an explicit final tiebreak;
+- ``walk(shares, fair_share=True)`` round-robins queues in ascending
+  (share, name) order with shares FROZEN at walk start, byte-matching
+  the legacy eager order.
+"""
+
+from __future__ import annotations
+
+import bisect
+import heapq
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+
+class PendingIndex:
+    """Pending admission candidates, sorted per ClusterQueue.
+
+    Entries are ``(sort_key, key)`` where ``sort_key`` is the admission
+    priority tuple; the job key rides last so ties are deterministic
+    regardless of event arrival order.
+    """
+
+    def __init__(self) -> None:
+        # cq name -> ascending list of (sort_key, key)
+        self._by_cq: Dict[str, List[tuple]] = {}
+        # key -> (cq name, sort_key)
+        self._entries: Dict[str, tuple] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    def keys(self) -> Iterable[str]:
+        return self._entries.keys()
+
+    def cq_names(self) -> Iterable[str]:
+        return self._by_cq.keys()
+
+    def per_cq_counts(self) -> Dict[str, int]:
+        return {name: len(items) for name, items in self._by_cq.items()}
+
+    def max_priority(self) -> Optional[int]:
+        """Highest job priority among all pending candidates (None when
+        empty).  O(#queues): the sort key leads with -priority, so each
+        bucket's front holds its queue's maximum.  The admission walk
+        uses this to prove no pending job can outrank the armed fence
+        before skipping a saturated-pool scan."""
+        if not self._by_cq:
+            return None
+        return max(-items[0][0][0] for items in self._by_cq.values())
+
+    def upsert(self, key: str, cq_name: str, sort_key: tuple) -> None:
+        """Insert or reposition one candidate, O(log n) bisect (plus
+        the list splice; a linked structure would shave that, but the
+        observed constant is tiny next to a single admission's API
+        writes)."""
+        current = self._entries.get(key)
+        if current == (cq_name, sort_key):
+            return
+        if current is not None:
+            self._remove(key, current)
+        bucket = self._by_cq.setdefault(cq_name, [])
+        bisect.insort(bucket, (sort_key, key))
+        self._entries[key] = (cq_name, sort_key)
+
+    def discard(self, key: str) -> None:
+        current = self._entries.pop(key, None)
+        if current is not None:
+            self._remove(key, current)
+
+    def _remove(self, key: str, current: tuple) -> None:
+        cq_name, sort_key = current
+        bucket = self._by_cq[cq_name]
+        i = bisect.bisect_left(bucket, (sort_key, key))
+        # The entries map and the lists move together under the
+        # scheduler lock; a miss here means the index invariant broke.
+        assert i < len(bucket) and bucket[i] == (sort_key, key), \
+            f"pending index out of sync for {key}"
+        del bucket[i]
+        if not bucket:
+            del self._by_cq[cq_name]
+
+    def clear(self) -> None:
+        self._by_cq.clear()
+        self._entries.clear()
+
+    def walk(self, shares: Optional[Dict[str, float]],
+             fair_share: bool) -> Iterator[Tuple[str, str]]:
+        """Yield ``(cq name, key)`` in admission-walk order, lazily.
+
+        FIFO mode merges every queue's list into the global
+        (priority desc, age, name) order.  Fair-share mode round-robins
+        queues in ascending ``(share, name)`` with one front job per
+        queue per round — ``shares`` is evaluated once by the caller at
+        walk start, exactly like the legacy eager ordering.  The index
+        must not be mutated while a walk iterator is live (the
+        scheduler admits then restarts the walk, so each iterator is
+        abandoned at the first mutation)."""
+        if not fair_share:
+            for _, key in heapq.merge(*self._by_cq.values()):
+                yield self._entries[key][0], key
+            return
+        shares = shares or {}
+        buckets = {name: items for name, items in self._by_cq.items()}
+        position = {name: 0 for name in buckets}
+        remaining = set(buckets)
+        while remaining:
+            for name in sorted(remaining,
+                               key=lambda n: (shares.get(n, 0.0), n)):
+                items, at = buckets[name], position[name]
+                yield name, items[at][1]
+                position[name] = at + 1
+            remaining = {name for name in remaining
+                         if position[name] < len(buckets[name])}
+
+
+class AdmittedIndex:
+    """Admitted gangs per ClusterQueue in preemption-victim order:
+    ``(priority asc, epoch desc, key)`` — cheapest victims first,
+    most-recently-admitted first within a priority band."""
+
+    def __init__(self) -> None:
+        # cq name -> ascending list of (priority, -epoch, key)
+        self._by_cq: Dict[str, List[tuple]] = {}
+        # key -> (cq name, priority, -epoch)
+        self._entries: Dict[str, tuple] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    def per_cq_counts(self) -> Dict[str, int]:
+        return {name: len(items) for name, items in self._by_cq.items()}
+
+    def add(self, key: str, cq_name: str, priority: int,
+            epoch: int) -> None:
+        self.discard(key)
+        bucket = self._by_cq.setdefault(cq_name, [])
+        bisect.insort(bucket, (priority, -epoch, key))
+        self._entries[key] = (cq_name, priority, -epoch)
+
+    def discard(self, key: str) -> None:
+        current = self._entries.pop(key, None)
+        if current is None:
+            return
+        cq_name, priority, neg_epoch = current
+        bucket = self._by_cq[cq_name]
+        i = bisect.bisect_left(bucket, (priority, neg_epoch, key))
+        assert i < len(bucket) and bucket[i] == (priority, neg_epoch,
+                                                 key), \
+            f"admitted index out of sync for {key}"
+        del bucket[i]
+        if not bucket:
+            del self._by_cq[cq_name]
+
+    def reprioritize(self, key: str, priority: int) -> None:
+        """Refresh one admitted gang's priority after a job update (the
+        dirty-set reindex calls this; a no-op when unchanged)."""
+        current = self._entries.get(key)
+        if current is None or current[1] == priority:
+            return
+        cq_name, _, neg_epoch = current
+        self.discard(key)
+        bucket = self._by_cq.setdefault(cq_name, [])
+        bisect.insort(bucket, (priority, neg_epoch, key))
+        self._entries[key] = (cq_name, priority, neg_epoch)
+
+    def clear(self) -> None:
+        self._by_cq.clear()
+        self._entries.clear()
+
+    def victims(self, cq_names: Iterable[str]) -> Iterator[tuple]:
+        """Merged ``(priority, -epoch, key)`` stream over the given
+        queues (the claimant's cohort) in victim-selection order; the
+        caller breaks at the first entry outranking its claim."""
+        buckets = [self._by_cq[name]
+                   for name in sorted(set(cq_names))
+                   if name in self._by_cq]
+        return heapq.merge(*buckets)

@@ -97,6 +97,15 @@ def new_sched_metrics(registry: Optional[Registry] = None) -> dict:
         "admission_wait": registry.histogram(
             "mpi_operator_sched_admission_wait_seconds",
             "Job submit (creationTimestamp) to Admitted condition"),
+        "decision_seconds": registry.histogram(
+            "mpi_operator_sched_decision_seconds",
+            "Wall seconds per admission decision (walk restart to"
+            " committed placement) — the O(delta) hot-path gate: must"
+            " stay flat as the pending backlog grows"),
+        "dirty_keys": registry.gauge(
+            "mpi_operator_sched_dirty_keys",
+            "Job keys marked dirty (watch deltas + state transitions)"
+            " consumed by the last reconcile pass's reindex"),
         "admissions": registry.counter_vec(
             "mpi_operator_sched_admissions_total",
             "Gang admissions by path: front (in-order), backfill"
@@ -254,21 +263,54 @@ class GangScheduler:
         # never recreates the condition, so the (O(pods)) sweep runs
         # exactly once per scheduler lifetime.
         self._swept = False
+        # O(delta) reconcile state (docs/PERF.md "O(delta) scheduling
+        # & the scale twin").  The mirror holds the watch-maintained
+        # MPIJob view (SHARED frozen event snapshots — never mutated;
+        # every write path re-gets its own copy first); the dirty set
+        # names the keys whose derived state (pending index, admitted
+        # index, publish counters) must be recomputed this pass.
+        from .indexes import AdmittedIndex, PendingIndex
+        self._mirror: Dict[str, object] = {}
+        self._dirty: set = set()
+        self._pub_dirty: set = set()
+        self._pending_idx = PendingIndex()
+        self._admitted_idx = AdmittedIndex()
+        # Maintained per-CQ usage (what _usage() used to rebuild from
+        # every admitted rec per call): updated at admit/release and by
+        # the elastic resize accounting.
+        self._usage_live: Dict[str, Dict[str, float]] = {}
+        # LocalQueue status counters, maintained per dirty key: job key
+        # -> ((namespace, queue), "pending"|"admitted") memo plus the
+        # two live count maps _publish reads.
+        self._lq_contrib: Dict[str, tuple] = {}
+        self._pending_lq: Dict[tuple, int] = {}
+        self._admitted_lq: Dict[tuple, int] = {}
+        # (valid CQ names, LQ->CQ wiring) signature: a change means any
+        # job's queue resolution may have flipped — the whole mirror
+        # goes dirty (rare; queue churn, not status writes, moves it).
+        self._queue_sig: Optional[tuple] = None
+        self._needs_resync = True
+        # Per-admission-decision hook (key, wall seconds, cpu seconds),
+        # set post-construction like ckpt_probe — the scale twin's
+        # latency probe.  CPU time rides along because an in-process
+        # twin gates on the decision's *algorithmic* cost; wall time
+        # over a minutes-long run includes OS preemption noise.
+        self.decision_probe = None
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._kick = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watches: list = []
+        self._watch_kinds = ((MPIJOB_GV, constants.KIND),
+                             (SCHED_GROUP_VERSION, CLUSTER_QUEUE_KIND),
+                             (SCHED_GROUP_VERSION, LOCAL_QUEUE_KIND))
 
     # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
     def start(self) -> "GangScheduler":
-        self._watch_kinds = ((MPIJOB_GV, constants.KIND),
-                             (SCHED_GROUP_VERSION, CLUSTER_QUEUE_KIND),
-                             (SCHED_GROUP_VERSION, LOCAL_QUEUE_KIND))
-        for api_version, kind in self._watch_kinds:
-            self._watches.append(self.client.server.watch(api_version, kind))
+        with self._lock:
+            self._ensure_watches()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gang-scheduler")
         self._thread.start()
@@ -283,24 +325,10 @@ class GangScheduler:
             self._thread.join(timeout=2)
 
     def _loop(self) -> None:
-        # gangsim-style: cheap idempotent relist reconcile per tick; the
-        # watches only bound latency (drained, not interpreted).
-        from ..k8s.apiserver import CLOSED, redial_watch
+        # The watch streams are DRAINED AND INTERPRETED inside
+        # reconcile_once (watch -> dirty-set plumbing); the loop only
+        # paces the passes.
         while not self._stop.is_set():
-            for i, w in enumerate(self._watches):
-                while True:
-                    ev = w.next(timeout=0)
-                    if ev is None:
-                        break
-                    if ev.type == CLOSED:
-                        # Apiserver restarted: re-dial (the relist
-                        # reconcile below covers the outage gap).
-                        fresh = redial_watch(self.client,
-                                             *self._watch_kinds[i],
-                                             stop=self._stop)
-                        if fresh is not None:
-                            self._watches[i] = fresh
-                        break
             self._kick.clear()
             try:
                 self.reconcile_once()
@@ -310,6 +338,84 @@ class GangScheduler:
 
     def kick(self) -> None:
         self._kick.set()
+
+    # -- watch -> dirty-set plumbing ---------------------------------------
+    def _ensure_watches(self) -> None:
+        """Open the watch streams on first use (start() or a direct
+        reconcile_once() in tests/benches) — mutations from before this
+        point are covered by the initial full resync."""
+        if self._watches:
+            return
+        for api_version, kind in self._watch_kinds:
+            self._watches.append(
+                self.client.server.watch(api_version, kind))
+        self._needs_resync = True
+
+    def _drain_events(self) -> None:
+        """Apply pending watch deltas to the job mirror and mark the
+        touched keys dirty — the O(delta) feed of the reconcile.
+        Stream discontinuities (overflow RELIST, apiserver-restart
+        CLOSED) degrade to one full resync, the legitimate relist."""
+        from ..k8s.apiserver import CLOSED, DELETED, RELIST, redial_watch
+        for i, w in enumerate(self._watches):
+            while True:
+                ev = w.next(timeout=0)
+                if ev is None:
+                    break
+                if ev.type == CLOSED:
+                    fresh = redial_watch(self.client,
+                                         *self._watch_kinds[i],
+                                         stop=self._stop)
+                    if fresh is not None:
+                        self._watches[i] = fresh
+                    self._needs_resync = True
+                    break
+                if ev.type == RELIST:
+                    self._needs_resync = True
+                    continue
+                if i != 0:
+                    # CQ/LQ object churn is interpreted per pass via
+                    # the cheap _load_queues signature (status-only
+                    # writes must NOT dirty the whole mirror).
+                    continue
+                obj = ev.obj
+                if obj is None:
+                    continue
+                if self.namespace \
+                        and obj.metadata.namespace != self.namespace:
+                    continue
+                key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+                if ev.type == DELETED:
+                    self._mirror.pop(key, None)
+                    self._job_cache.pop(key, None)
+                else:
+                    self._mirror[key] = obj
+                self._dirty.add(key)
+
+    def _resync_mirror(self) -> None:
+        """Full relist fallback — first pass, watch overflow, apiserver
+        restart.  Diffs the listed world against the mirror so only
+        actually-changed keys go dirty (a fresh instance dirties
+        everything, which is how restart adoption sees the store)."""
+        listed = {self._key(j): j for j in self.client.server.list(
+            MPIJOB_GV, constants.KIND, self.namespace)}
+        for key in [k for k in self._mirror if k not in listed]:
+            del self._mirror[key]
+            self._job_cache.pop(key, None)
+            self._dirty.add(key)
+        for key, job in listed.items():
+            held = self._mirror.get(key)
+            if held is None or held.metadata.resource_version \
+                    != job.metadata.resource_version:
+                self._dirty.add(key)
+            self._mirror[key] = job
+        self._needs_resync = False
+
+    def _mark_dirty(self, key: str) -> None:
+        """A state transition (admit/release/evict/adopt) changed this
+        key's derived view: reindex next pass, republish this pass."""
+        self._dirty.add(key)
+        self._pub_dirty.add(key)
 
     # ------------------------------------------------------------------
     # Introspection (tests, invariants, smoke)
@@ -466,13 +572,33 @@ class GangScheduler:
     # The reconcile
     # ------------------------------------------------------------------
     def reconcile_once(self) -> int:
-        """One full pass; returns the number of admissions it made."""
+        """One full pass; returns the number of admissions it made.
+
+        Dirty-set driven: watch deltas (not a per-pass relist) maintain
+        the job mirror, and every derived structure — pending index,
+        admitted/victim index, usage, publish counters — is updated
+        only for the dirtied keys.  The observable semantics (admission
+        order, annotations, conditions, restart adoption) are identical
+        to the legacy O(backlog) pass; tests/test_sched_indexes.py
+        holds the two walks equal over seeded churn."""
         with self._lock:
+            self._ensure_watches()
+            self._drain_events()
+            if self._needs_resync:
+                self._resync_mirror()
             cqs, lqs = self._load_queues()
-            jobs = {self._key(j): j for j in self.client.server.list(
-                MPIJOB_GV, constants.KIND, self.namespace)}
-            for stale in [k for k in self._job_cache if k not in jobs]:
-                del self._job_cache[stale]
+            sig = (tuple(sorted(cqs)),
+                   tuple(sorted((ns, name, lq.spec.cluster_queue)
+                                for (ns, name), lq in lqs.items())))
+            if sig != self._queue_sig:
+                # Queue wiring changed: any job's CQ resolution (and
+                # with it index placement) may have flipped.  Status
+                # writes do not move the signature, so this full
+                # re-dirty fires on queue churn only.
+                self._queue_sig = sig
+                self._dirty.update(self._mirror)
+                self._dirty.update(list(self._pending_idx.keys()))
+            jobs = self._mirror
             self._release_departed(jobs)
             self._finish_due_evictions(jobs)
             self._adopt_admitted(jobs, lqs, cqs)
@@ -482,10 +608,50 @@ class GangScheduler:
             # chips a completed drain just freed are placeable in the
             # same pass.
             self.resizer.tick(jobs)
+            self._reindex(jobs, lqs, cqs)
             admissions = self._admission_passes(jobs, lqs, cqs)
             self._maybe_preempt(jobs, lqs, cqs)
             self._publish(jobs, lqs, cqs)
             return admissions
+
+    def _reindex(self, jobs, lqs, cqs) -> None:
+        """Consume the dirty set: recompute each touched key's pending
+        eligibility (the legacy ``_pending`` predicate) and its sort
+        position, O(log pending) per key.  Admitted keys refresh their
+        victim-index priority instead."""
+        dirty, self._dirty = self._dirty, set()
+        self.metrics["dirty_keys"].set(len(dirty))
+        self._pub_dirty |= dirty
+        for key in dirty:
+            job = jobs.get(key)
+            if job is None:
+                self._pending_idx.discard(key)
+                continue
+            if key in self._admitted:
+                self._pending_idx.discard(key)
+                self._admitted_idx.reprioritize(key, job_priority(job))
+                continue
+            if key in self._preempting or is_finished(job.status) \
+                    or job.spec.run_policy.suspend \
+                    or not job_queue_name(job):
+                self._pending_idx.discard(key)
+                continue
+            cq = self._cq_of(job, lqs, cqs)
+            if cq is None:
+                self._warn_invalid(f"job-queue/{key}", "MPIJob queue",
+                                   key, ["unknown LocalQueue/ClusterQueue "
+                                         f"{job_queue_name(job)!r}"])
+                self._pending_idx.discard(key)
+                continue
+            _, valid = self._job_facts(key, job)
+            if not valid:
+                self._pending_idx.discard(key)
+                continue
+            self._pending_idx.upsert(
+                key, cq.metadata.name,
+                (-job_priority(job),
+                 str(job.metadata.creation_timestamp or ""),
+                 job.metadata.name))
 
     # -- helpers -----------------------------------------------------------
     def _key(self, job) -> str:
@@ -576,12 +742,39 @@ class GangScheduler:
                 for res, quantity in (cq.spec.quotas or {}).items()}
 
     def _usage(self) -> Dict[str, Dict[str, float]]:
-        used: Dict[str, Dict[str, float]] = {}
-        for rec in self._admitted.values():
-            bucket = used.setdefault(rec["cq"], {})
-            for res, amount in rec["demand"].items():
-                bucket[res] = bucket.get(res, 0.0) + amount
-        return used
+        """Per-CQ admitted usage — a fresh copy of the MAINTAINED
+        accumulator (callers mutate their copies for hypotheticals), so
+        the read is O(#queues) instead of O(#admitted) per admission."""
+        return {name: dict(bucket)
+                for name, bucket in self._usage_live.items()}
+
+    def _usage_apply(self, cq_name: str, demand: Dict[str, int],
+                     sign: int = 1) -> None:
+        """Fold one demand into the maintained usage.  Zero entries are
+        pruned so an emptied queue disappears exactly like the legacy
+        rebuild-from-recs (demand values are integers: the float sums
+        cancel exactly)."""
+        bucket = self._usage_live.setdefault(cq_name, {})
+        for res, amount in demand.items():
+            value = bucket.get(res, 0.0) + sign * amount
+            if value == 0:
+                bucket.pop(res, None)
+            else:
+                bucket[res] = value
+        if not bucket:
+            self._usage_live.pop(cq_name, None)
+
+    def _usage_replace(self, cq_name: str, before: Dict[str, int],
+                       after: Dict[str, int]) -> None:
+        """Swap one gang's accounted demand (elastic resize commits
+        mutate the admitted rec in place; the accumulator follows)."""
+        delta = {}
+        for res in set(before) | set(after):
+            d = after.get(res, 0) - before.get(res, 0)
+            if d:
+                delta[res] = d
+        if delta:
+            self._usage_apply(cq_name, delta)
 
     def _quota_allows(self, cq, demand, cqs,
                       usage: Dict[str, Dict[str, float]]) -> bool:
@@ -610,7 +803,10 @@ class GangScheduler:
 
     # -- release / adoption ------------------------------------------------
     def _release_departed(self, jobs) -> None:
-        for key in list(self._admitted):
+        # Only a job CHANGE (finish, delete, suspend flip) can make an
+        # admitted gang releasable, and every change dirties its key —
+        # the walk is O(dirty ∩ admitted), not O(admitted).
+        for key in sorted(k for k in self._dirty if k in self._admitted):
             job = jobs.get(key)
             if job is not None and not is_finished(job.status):
                 if job.spec.run_policy.suspend:
@@ -638,6 +834,9 @@ class GangScheduler:
         rec = self._admitted.pop(key, None)
         if rec is None:
             return
+        self._admitted_idx.discard(key)
+        self._usage_apply(rec["cq"], rec["demand"], sign=-1)
+        self._mark_dirty(key)
         self.resizer.on_release(key)
         freed = self.pool.release(key)
         blocked = self._blocked
@@ -709,8 +908,16 @@ class GangScheduler:
         record is missing/unsatisfiable (slice reclaimed, annotation
         lost) does adoption fall back to a fresh greedy placement, and
         a job that no longer fits at all is evicted and requeued
-        immediately."""
-        for key, job in sorted(jobs.items()):
+        immediately.
+
+        Dirty-driven: only a changed key can carry an Admitted=True
+        condition this instance does not know, and a fresh instance's
+        first resync dirties the whole store — restart adoption walks
+        the same sorted world the legacy full scan did."""
+        for key in sorted(self._dirty):
+            job = jobs.get(key)
+            if job is None:
+                continue
             if key in self._admitted or is_finished(job.status) \
                     or job.spec.run_policy.suspend:
                 continue
@@ -737,6 +944,11 @@ class GangScheduler:
                     "chips": chips, "epoch": self._epoch,
                     "ns": job.metadata.namespace,
                     "name": job.metadata.name}
+                self._pending_idx.discard(key)
+                self._admitted_idx.add(key, cq.metadata.name,
+                                       job_priority(job), self._epoch)
+                self._usage_apply(cq.metadata.name, demand)
+                self._pub_dirty.add(key)
                 self.metrics["admissions"].labels("adopted").inc()
                 flight.record("sched", "adopted", job=key, chips=chips,
                               slices=",".join(
@@ -969,33 +1181,51 @@ class GangScheduler:
             return free
         return max(0, free - self._blocked["reserved"])
 
+    def _saturated_fenced(self) -> bool:
+        """True when the admission walk provably cannot change state:
+        the pool has zero free chips (every gang demands at least one,
+        so no placement can succeed), the fence is armed (so it will
+        not arm differently), and no pending job outranks the fence
+        owner (so no takeover).  The only legacy behavior a skipped
+        scan loses is backfill_denied increments for candidates that
+        could not have placed."""
+        if self._blocked is None or self.pool.free_chips != 0:
+            return False
+        top = self._pending_idx.max_priority()
+        return top is not None and top <= self._blocked["priority"]
+
     def _admission_passes(self, jobs, lqs, cqs) -> int:
         admissions = 0
-        # The pending set is computed ONCE per reconcile pass: within a
-        # single pass the only thing that removes a candidate is an
-        # admission in this very loop (jobs/lqs/cqs are a snapshot and
-        # _preempting/_admitted only change through _admit below), so
-        # re-filtering every job after every admission was pure
-        # O(backlog) waste.  The ordering still recomputes per
-        # admission — fair-share ranks move as usage changes.
-        pending = self._pending(jobs, lqs, cqs)
+        idx = self._pending_idx
         while True:
-            usage = self._usage()
-            order = self._order(pending, usage)
-            if not order:
+            if not len(idx):
                 if self._blocked is not None:
                     self._clear_reservation(self._blocked["key"])
                     self._blocked = None
                 return admissions
+            decision_t0 = time.perf_counter()
+            decision_cpu_t0 = time.thread_time()
+            usage = self._usage()
+            # The walk reads the maintained index LAZILY in the legacy
+            # order (fair-share shares frozen at walk start): a walk
+            # that admits its front costs O(#queues log #queues), and
+            # the post-admission restart re-ranks queues without
+            # rebuilding anything.
+            shares = None
+            if self.fair_share:
+                shares = {
+                    name: usage.get(name, {}).get(
+                        constants.TPU_RESOURCE, 0.0)
+                    / (cqs[name].spec.weight or 1.0)
+                    for name in idx.cq_names()}
             # The reservation protects ONE gang; release the fence once
             # that gang stops being pending (admitted or gone).
             # Strictly HIGHER-priority jobs are never fence-gated (see
             # is_backfill below) — they outrank the fenced gang
             # everywhere else (admission order, preemption), so the
             # fence only holds back peers and lower classes.
-            pending_keys = {self._key(job) for _, job in order}
             if self._blocked is not None \
-                    and self._blocked["key"] not in pending_keys:
+                    and self._blocked["key"] not in idx:
                 # The gang stopped being pending without admitting
                 # (finished, deleted, suspended): its earned
                 # reservation is void — clear the persisted record so
@@ -1007,6 +1237,15 @@ class GangScheduler:
                 # exactly the episodes it should.)
                 self._clear_reservation(self._blocked["key"])
                 self._blocked = None
+            if self._saturated_fenced():
+                # Zero free chips, fence armed, and no pending job
+                # outranks its owner: every candidate below would fail
+                # placement (all gangs need >= 1 chip) and none may
+                # take over the fence — the scan could only bump
+                # backfill_denied for jobs that cannot place anyway.
+                # Skipping it keeps a saturated reconcile O(#queues)
+                # instead of O(backlog) (docs/PERF.md).
+                return admissions
             admitted_this_walk = False
             # Queues whose front (oldest eligible) job failed QUOTA this
             # walk: younger same-queue jobs may only pass it as
@@ -1016,8 +1255,9 @@ class GangScheduler:
             # walks earlier), so the jump is a visible policy, not a
             # silent starvation (docs/SCHEDULING.md).
             quota_blocked_queues: set = set()
-            for position, (cq, job) in enumerate(order):
-                key = self._key(job)
+            for cq_name, key in idx.walk(shares, self.fair_share):
+                cq = cqs[cq_name]
+                job = jobs[key]
                 demand, _ = self._job_facts(key, job)
                 chips = demand[constants.TPU_RESOURCE]
                 if not self._quota_allows(cq, demand, cqs, usage):
@@ -1096,17 +1336,27 @@ class GangScheduler:
                                          "priority": job_priority(job)}
                     if not self.backfill:
                         break  # head-of-line blocking (FIFO baseline)
+                    if self._saturated_fenced():
+                        break  # the fence just armed on a dry pool:
+                        # same proof as the walk-start skip
                     continue
                 self._admit(job, cq, demand, chips, placement,
                             "backfill" if is_backfill else "front")
                 if self._blocked is not None \
                         and self._blocked["key"] == key:
                     self._blocked = None
-                pending = [item for item in pending
-                           if self._key(item[1]) != key]
+                seconds = time.perf_counter() - decision_t0
+                cpu_seconds = time.thread_time() - decision_cpu_t0
+                self.metrics["decision_seconds"].observe(seconds)
+                if self.decision_probe is not None:
+                    try:
+                        self.decision_probe(key, seconds, cpu_seconds)
+                    except Exception as exc:
+                        flight.record("sched", "decision_probe_error",
+                                      job=key, error=str(exc))
                 admissions += 1
                 admitted_this_walk = True
-                break  # usage changed: recompute the walk
+                break  # usage changed: restart the walk re-ranked
             if not admitted_this_walk:
                 return admissions
 
@@ -1121,6 +1371,11 @@ class GangScheduler:
             "cq": cq.metadata.name, "demand": demand, "chips": chips,
             "epoch": self._epoch, "ns": job.metadata.namespace,
             "name": job.metadata.name}
+        self._pending_idx.discard(key)
+        self._admitted_idx.add(key, cq.metadata.name,
+                               job_priority(job), self._epoch)
+        self._usage_apply(cq.metadata.name, demand)
+        self._mark_dirty(key)
         slices = ",".join(f"{name}:{take}"
                           for name, take in sorted(placement.items()))
         blocks = self.pool.placement_blocks(key) or {}
@@ -1165,21 +1420,21 @@ class GangScheduler:
     def _maybe_preempt(self, jobs, lqs, cqs) -> None:
         if not self.preemption:
             return
-        usage = self._usage()
-        pending = self._pending(jobs, lqs, cqs)
-        if not pending:
+        if not len(self._pending_idx):
             return
+        usage = self._usage()
         # Preemption is a PRIORITY right, independent of the fair-share
         # walk order: consider pending jobs in global (priority desc,
         # age) order and act for the FIRST one that is entitled to and
         # helped by eviction.  A front in a preemption-disabled queue
         # (or one even full eviction could not fit) must not block the
         # next candidate's claim — at most one victim set per pass.
-        ranked = sorted(pending, key=lambda item: (
-            -job_priority(item[1]),
-            str(item[1].metadata.creation_timestamp or ""),
-            item[1].metadata.name))
-        for cq, front in ranked:
+        # walk(None, False) merges the per-queue lists into exactly
+        # that global order, lazily — entitled fronts are usually near
+        # the head, so the common pass touches O(1) candidates.
+        for cq_name, key in self._pending_idx.walk(None, False):
+            cq = cqs[cq_name]
+            front = jobs[key]
             if not cq.spec.preemption:
                 continue
             if self._try_preempt_for(cq, front, jobs, cqs, usage):
@@ -1228,25 +1483,27 @@ class GangScheduler:
         # victim's release frees BOTH its chips and its quota, so the
         # quota check runs against the hypothetical post-eviction usage.
         cohort = cq.spec.cohort
+        pool_names = {cq.metadata.name}
+        if cohort:
+            pool_names.update(c.metadata.name for c in cqs.values()
+                              if c.spec.cohort == cohort)
         candidates = []
-        for key, rec in self._admitted.items():
+        # The admitted index streams the cohort's gangs in victim order
+        # (priority asc, newest first): the first entry at or above the
+        # claimant's priority ends enumeration — O(candidates), never
+        # O(all admitted gangs).
+        for vprio, neg_epoch, key in self._admitted_idx.victims(
+                pool_names):
+            if vprio >= priority:
+                break
             if key in self._preempting or self.resizer.in_flight(key):
                 continue
-            victim_cq = cqs.get(rec["cq"])
-            if victim_cq is None:
+            rec = self._admitted.get(key)
+            if rec is None or cqs.get(rec["cq"]) is None:
                 continue
-            same_pool = (victim_cq.metadata.name == cq.metadata.name
-                         or (cohort and victim_cq.spec.cohort == cohort))
-            if not same_pool:
+            if jobs.get(key) is None:
                 continue
-            victim_job = jobs.get(key)
-            if victim_job is None:
-                continue
-            victim_priority = job_priority(victim_job)
-            if victim_priority >= priority:
-                continue
-            candidates.append((victim_priority, -rec["epoch"], key, rec))
-        candidates.sort(key=lambda c: c[:3])
+            candidates.append((vprio, neg_epoch, key, rec))
         from .elastic import (elastic_bounds, per_worker_chips,
                               settled_workers)
 
@@ -1463,20 +1720,51 @@ class GangScheduler:
                        namespace, name)
 
     def _publish(self, jobs, lqs, cqs) -> None:
-        """Per-queue gauges + ClusterQueue/LocalQueue status."""
+        """Per-queue gauges + ClusterQueue/LocalQueue status.
+
+        Counts come from the maintained indexes and the per-LocalQueue
+        contribution memo — only TOUCHED keys (watch deltas + this
+        pass's transitions) are re-examined, so publish is O(dirty +
+        #queues), not O(all jobs)."""
         usage = self._usage()
-        pending_cq: Dict[str, int] = {}
-        pending_lq: Dict[tuple, int] = {}
-        admitted_lq: Dict[tuple, int] = {}
-        admitted_cq: Dict[str, int] = {}
-        for key, rec in self._admitted.items():
-            admitted_cq[rec["cq"]] = admitted_cq.get(rec["cq"], 0) + 1
-        for cq, job in self._pending(jobs, lqs, cqs):
-            pending_cq[cq.metadata.name] = \
-                pending_cq.get(cq.metadata.name, 0) + 1
+        touched, self._pub_dirty = self._pub_dirty, set()
+        for key in touched:
+            prior = self._lq_contrib.pop(key, None)
+            if prior is not None:
+                lq_key, kind = prior
+                counts = (self._admitted_lq if kind == "admitted"
+                          else self._pending_lq)
+                left = counts.get(lq_key, 0) - 1
+                if left > 0:
+                    counts[lq_key] = left
+                else:
+                    counts.pop(lq_key, None)
+            job = jobs.get(key)
+            if job is None:
+                continue
+            queue = job_queue_name(job)
+            if not queue:
+                continue
+            lq_key = (job.metadata.namespace, queue)
+            if key in self._admitted:
+                self._admitted_lq[lq_key] = \
+                    self._admitted_lq.get(lq_key, 0) + 1
+                self._lq_contrib[key] = (lq_key, "admitted")
+            elif not is_finished(job.status):
+                self._pending_lq[lq_key] = \
+                    self._pending_lq.get(lq_key, 0) + 1
+                self._lq_contrib[key] = (lq_key, "pending")
+        for key in sorted(touched):
             # Make the wait visible on the job itself (the controller
             # also writes Queued when it syncs a gated job; this covers
             # quota/capacity-blocked jobs between controller syncs).
+            # Any later overwrite of the condition arrives as a watch
+            # MODIFIED event, which re-touches the key.
+            if key not in self._pending_idx:
+                continue
+            job = jobs.get(key)
+            if job is None:
+                continue
             queued = get_condition(job.status, constants.JOB_QUEUED)
             if queued is None or queued.status != core.CONDITION_TRUE:
                 self._set_conditions(
@@ -1484,15 +1772,10 @@ class GangScheduler:
                     admitted=False, reason=MPI_JOB_QUEUED_REASON,
                     message=f"queued in {job_queue_name(job)}: waiting"
                             f" for quota/capacity")
-        for key, job in jobs.items():
-            queue = job_queue_name(job)
-            if not queue:
-                continue
-            lq_key = (job.metadata.namespace, queue)
-            if key in self._admitted:
-                admitted_lq[lq_key] = admitted_lq.get(lq_key, 0) + 1
-            elif not is_finished(job.status):
-                pending_lq[lq_key] = pending_lq.get(lq_key, 0) + 1
+        pending_cq = self._pending_idx.per_cq_counts()
+        admitted_cq = self._admitted_idx.per_cq_counts()
+        pending_lq = self._pending_lq
+        admitted_lq = self._admitted_lq
         self.metrics["free_chips"].set(self.pool.free_chips)
         self.metrics["fragmentation"].set(self.pool.fragmentation())
         self._publish_gang_sizes(jobs)

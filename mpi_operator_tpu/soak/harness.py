@@ -1122,6 +1122,9 @@ class SoakHarness:
                                   0.99),
             ckpt_overhead_pct=ckpt_overhead,
             restore_p99_s=quantile(restore_samples, 0.99),
+            sched_decision_p99_s=(histogram_quantile(
+                self.scheduler.metrics["decision_seconds"].snapshot(),
+                0.99) if self.scheduler is not None else None),
             converged=report.converged,
             detail={
                 "trace_segments": trace_segments,

@@ -139,6 +139,12 @@ class SloScorecard:
     disagg_ttft_p99_s: Optional[float] = None
     decode_interference_p99_s: Optional[float] = None
     cold_start_p99_s: Optional[float] = None
+    # O(delta) scheduler hot path (ISSUE 19, docs/PERF.md "O(delta)
+    # scheduling & the scale twin"): per-admission decision cost
+    # (walk restart -> committed placement) from the scheduler's
+    # mpi_operator_sched_decision_seconds histogram; None when the
+    # run admitted nothing through the gang scheduler.
+    sched_decision_p99_s: Optional[float] = None
     converged: bool = True
     # Free-form context the bench attaches (windows, per-gang detail).
     detail: Dict[str, object] = field(default_factory=dict)
@@ -217,6 +223,7 @@ class SloScorecard:
             "decode_interference_p99_s": r(
                 self.decode_interference_p99_s),
             "cold_start_p99_s": r(self.cold_start_p99_s),
+            "sched_decision_p99_s": r(self.sched_decision_p99_s),
             "converged": self.converged,
             "ok": self.ok,
             "violations": self.violations(),

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Scale-twin smoke: the control-plane twin's three contracts at a
+CI-sized scale (docs/PERF.md "O(delta) scheduling & the scale twin").
+
+Reuses bench_scale_twin.py's ``run_twin`` verbatim — real ApiServer,
+real GangScheduler, controller twin on one logical clock — at 400
+jobs (4k pods), twice, and asserts:
+
+1. **run-twice identity** — both runs' canonical store dumps and
+   event-log digests are byte-identical (the twin's results are
+   reproducible evidence, not a one-off trace);
+2. **capacity conservation** — 0 violations across every event of
+   both runs (free + held == total; scheduler usage == driver ledger)
+   and a clean drain (empty store, fully free pool);
+3. **decision-latency sanity** — the p99 admission decision (thread
+   CPU time, the same statistic the full bench gates) stays under a
+   generous absolute bound, so an O(backlog) regression in the
+   maintained-index hot path fails the smoke long before the full
+   bench would catch it.
+
+Usage: python tools/twin_smoke.py
+Exit 0 = identical digests, 0 violations, p99 within bound, < 60s.
+Runs with the lock-order detector armed (make twin-smoke).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import bench_scale_twin as twin  # noqa: E402
+
+JOBS = 400                     # 4k pods: deep enough to saturate the
+                               # pool and arm the admission fence
+P99_BUDGET_S = 0.050           # ~20x the measured p99 — a regression
+                               # to O(backlog) walks blows through this
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    first = twin.run_twin(JOBS, twin.DEFAULT_WORKLOAD)
+    second = twin.run_twin(JOBS, twin.DEFAULT_WORKLOAD)
+    elapsed = round(time.monotonic() - t0, 1)
+
+    failures = []
+    if first["state_digest"] != second["state_digest"]:
+        failures.append(
+            f"run-twice digests differ: {first['state_digest'][:12]} "
+            f"vs {second['state_digest'][:12]}")
+    violations = (first["conservation_violations"]
+                  + second["conservation_violations"])
+    if violations:
+        failures.append(f"{len(violations)} conservation violations, "
+                        f"first: {violations[0]}")
+    p99 = first["decision_cpu_s"]["p99"]
+    if p99 > P99_BUDGET_S:
+        failures.append(f"decision p99 {p99 * 1e3:.1f}ms over the "
+                        f"{P99_BUDGET_S * 1e3:.0f}ms smoke budget")
+    if elapsed >= 60:
+        failures.append(f"smoke took {elapsed}s (budget 60s)")
+
+    if failures:
+        print("twin-smoke: FAIL")
+        for f in failures:
+            print(f"  {f}")
+        return 1
+    print(f"twin-smoke: PASS in {elapsed}s — {first['pods']} pods x2 "
+          f"runs byte-identical ({first['state_digest'][:12]}...), "
+          f"0/{first['events'] * 2} events violated conservation, "
+          f"decision p99 {p99 * 1e6:.0f}us (budget "
+          f"{P99_BUDGET_S * 1e3:.0f}ms), backlog peak "
+          f"{first['peak_pending_backlog']}")
+    return 0
+
+
+if __name__ == "__main__":
+    from mpi_operator_tpu.analysis.lockcheck import gate as _gate
+    sys.exit(_gate(main()))
